@@ -1,0 +1,77 @@
+"""L0 CRD type tests (model: reference api/v1alpha1 + conditions semantics)."""
+
+from wva_tpu.api import (
+    Condition,
+    CrossVersionObjectReference,
+    ObjectMeta,
+    VariantAutoscaling,
+    VariantAutoscalingSpec,
+    TYPE_METRICS_AVAILABLE,
+    TYPE_TARGET_RESOLVED,
+    REASON_METRICS_FOUND,
+    REASON_METRICS_MISSING,
+    REASON_TARGET_FOUND,
+)
+from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST
+
+
+def make_va(name="llama-v5e-8", ns="default", model="meta-llama/Llama-3.1-8B",
+            cost="", target="llama-v5e-8-deploy"):
+    return VariantAutoscaling(
+        metadata=ObjectMeta(name=name, namespace=ns,
+                            labels={"inference.optimization/acceleratorName": "v5e-8"}),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name=target),
+            model_id=model,
+            variant_cost=cost,
+        ),
+    )
+
+
+def test_cost_default_and_parse():
+    assert make_va().spec.cost() == DEFAULT_VARIANT_COST
+    assert make_va(cost="40.0").spec.cost() == 40.0
+    assert make_va(cost="bogus").spec.cost() == DEFAULT_VARIANT_COST
+
+
+def test_scale_target_helpers():
+    va = make_va()
+    assert va.scale_target_name() == "llama-v5e-8-deploy"
+    assert va.scale_target_kind() == "Deployment"
+    assert va.scale_target_api() == "apps/v1"
+
+
+def test_set_condition_upsert_and_transition_time():
+    va = make_va()
+    va.set_condition(TYPE_METRICS_AVAILABLE, "True", REASON_METRICS_FOUND, now=100.0)
+    va.set_condition(TYPE_TARGET_RESOLVED, "True", REASON_TARGET_FOUND, now=100.0)
+    assert len(va.status.conditions) == 2
+
+    # Same status -> transition time unchanged.
+    va.set_condition(TYPE_METRICS_AVAILABLE, "True", REASON_METRICS_FOUND, now=200.0)
+    c = va.get_condition(TYPE_METRICS_AVAILABLE)
+    assert c is not None and c.last_transition_time == 100.0
+
+    # Status flip -> transition time moves.
+    va.set_condition(TYPE_METRICS_AVAILABLE, "False", REASON_METRICS_MISSING, now=300.0)
+    c = va.get_condition(TYPE_METRICS_AVAILABLE)
+    assert c.last_transition_time == 300.0 and c.reason == REASON_METRICS_MISSING
+    assert len(va.status.conditions) == 2
+
+
+def test_dict_roundtrip():
+    va = make_va(cost="25.5")
+    va.status.desired_optimized_alloc.accelerator = "v5e-8"
+    va.status.desired_optimized_alloc.num_replicas = 3
+    va.set_condition(TYPE_METRICS_AVAILABLE, "True", REASON_METRICS_FOUND, now=1.0)
+
+    d = va.to_dict()
+    assert d["apiVersion"] == "wva.tpu.llmd.ai/v1alpha1"
+    assert d["spec"]["modelID"] == "meta-llama/Llama-3.1-8B"
+    assert d["spec"]["variantCost"] == "25.5"
+    assert d["status"]["desiredOptimizedAlloc"]["numReplicas"] == 3
+
+    back = VariantAutoscaling.from_dict(d)
+    assert back.spec.cost() == 25.5
+    assert back.status.desired_optimized_alloc.accelerator == "v5e-8"
+    assert back.get_condition(TYPE_METRICS_AVAILABLE).status == "True"

@@ -1,0 +1,83 @@
+"""L0 interface-type tests (model: internal/interfaces semantics)."""
+
+import pytest
+
+from wva_tpu.interfaces import (
+    ACTION_SCALE_UP,
+    ReplicaMetrics,
+    SaturationScalingConfig,
+    VariantDecision,
+)
+from wva_tpu.interfaces.saturation_config import (
+    DEFAULT_SCALE_DOWN_BOUNDARY,
+    DEFAULT_SCALE_UP_THRESHOLD,
+)
+
+
+def test_decision_steps_append_and_last():
+    d = VariantDecision(variant_name="llama-v5e-8", target_replicas=2)
+    d.action = ACTION_SCALE_UP
+    d.target_replicas = 3
+    d.add_step("saturation", "kv spare below trigger", now=1.0)
+    d.target_replicas = 2
+    d.add_step("limiter", "chip inventory exhausted", was_constrained=True, now=2.0)
+    assert len(d.decision_steps) == 2
+    last = d.last_step()
+    assert last.name == "limiter" and last.was_constrained and last.target_replicas == 2
+
+
+def test_saturation_config_defaults_only_for_v2():
+    c = SaturationScalingConfig()
+    c.apply_defaults()
+    assert c.scale_up_threshold == 0.0  # V1 path: untouched
+
+    c2 = SaturationScalingConfig(analyzer_name="saturation")
+    c2.apply_defaults()
+    assert c2.scale_up_threshold == DEFAULT_SCALE_UP_THRESHOLD
+    assert c2.scale_down_boundary == DEFAULT_SCALE_DOWN_BOUNDARY
+    c2.validate()
+
+
+@pytest.mark.parametrize(
+    "kwargs,msg",
+    [
+        (dict(kv_cache_threshold=1.5), "kvCacheThreshold"),
+        (dict(queue_length_threshold=-1), "queueLengthThreshold"),
+        (dict(kv_spare_trigger=2.0), "kvSpareTrigger"),
+        (dict(queue_spare_trigger=-0.1), "queueSpareTrigger"),
+        (dict(kv_cache_threshold=0.05, kv_spare_trigger=0.1), "should be >="),
+        (dict(analyzer_name="saturation", scale_up_threshold=0.5,
+              scale_down_boundary=0.7), "must be >"),
+        (dict(analyzer_name="saturation", scale_up_threshold=1.5,
+              scale_down_boundary=0.7), "scaleUpThreshold"),
+    ],
+)
+def test_saturation_config_validation_errors(kwargs, msg):
+    c = SaturationScalingConfig(**kwargs)
+    with pytest.raises(ValueError, match=msg):
+        c.validate()
+
+
+def test_saturation_config_yaml_roundtrip():
+    d = {
+        "kvCacheThreshold": 0.9,
+        "queueLengthThreshold": 10,
+        "enableLimiter": "true",
+        "analyzerName": "saturation",
+    }
+    c = SaturationScalingConfig.from_dict(d)
+    assert c.kv_cache_threshold == 0.9
+    assert c.queue_length_threshold == 10.0
+    assert c.enable_limiter is True
+    assert c.get_analyzer_name() == "saturation"
+
+
+def test_replica_metrics_tpu_fields():
+    m = ReplicaMetrics(
+        pod_name="llama-0", kv_cache_usage=0.5, queue_length=2,
+        total_kv_capacity_tokens=131072, tokens_in_use=65536,
+        generate_backlog=1, slots_used=48, slots_total=96,
+        accelerator_name="v5e-8",
+    )
+    assert m.slots_total - m.slots_used == 48
+    assert m.tokens_in_use <= m.total_kv_capacity_tokens

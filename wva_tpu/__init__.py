@@ -1,0 +1,22 @@
+"""wva_tpu — TPU-native workload-variant autoscaler framework.
+
+A from-scratch re-design of llm-d/llm-d-workload-variant-autoscaler (studied in
+SURVEY.md at the repo root) for TPU-backed LLM inference: it watches
+``VariantAutoscaling`` resources, scrapes JetStream / vLLM-TPU serving metrics,
+runs saturation- and token-capacity analysis per model, chooses the cheapest TPU
+slice variant, and emits ``wva_*`` desired-replica metrics for HPA/KEDA — plus
+direct 0->1 scale-from-zero when requests queue for an inactive model.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  L0  api/ interfaces/ config/ constants/ utils/
+  L2  collector/ discovery/ datastore/
+  L3  analyzers/ pipeline/
+  L4  engines/
+  L5  controller/
+  L1  actuator/ metrics/
+  aux k8s/ (client abstraction + in-memory fake cluster), emulator/ (fake-TPU
+      nodes + JetStream emulator), models/ ops/ parallel/ (JAX serving path used
+      by the emulator and the queueing solver).
+"""
+
+__version__ = "0.1.0"

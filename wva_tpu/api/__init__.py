@@ -1,0 +1,56 @@
+"""CRD API types for the ``wva.tpu.llmd.ai`` group.
+
+Python equivalent of the reference's ``api/v1alpha1`` package
+(``/root/reference/api/v1alpha1/variantautoscaling_types.go:9-156``).
+"""
+
+from wva_tpu.api.v1alpha1 import (
+    ActuationStatus,
+    Condition,
+    CrossVersionObjectReference,
+    ObjectMeta,
+    OptimizedAlloc,
+    VariantAutoscaling,
+    VariantAutoscalingSpec,
+    VariantAutoscalingStatus,
+    # condition types / reasons
+    TYPE_TARGET_RESOLVED,
+    TYPE_METRICS_AVAILABLE,
+    TYPE_OPTIMIZATION_READY,
+    REASON_METRICS_FOUND,
+    REASON_METRICS_MISSING,
+    REASON_METRICS_STALE,
+    REASON_PROMETHEUS_ERROR,
+    REASON_OPTIMIZATION_SUCCEEDED,
+    REASON_OPTIMIZATION_FAILED,
+    REASON_METRICS_UNAVAILABLE,
+    REASON_INVALID_CONFIGURATION,
+    REASON_SKIPPED_PROCESSING,
+    REASON_TARGET_FOUND,
+    REASON_TARGET_NOT_FOUND,
+)
+
+__all__ = [
+    "ActuationStatus",
+    "Condition",
+    "CrossVersionObjectReference",
+    "ObjectMeta",
+    "OptimizedAlloc",
+    "VariantAutoscaling",
+    "VariantAutoscalingSpec",
+    "VariantAutoscalingStatus",
+    "TYPE_TARGET_RESOLVED",
+    "TYPE_METRICS_AVAILABLE",
+    "TYPE_OPTIMIZATION_READY",
+    "REASON_METRICS_FOUND",
+    "REASON_METRICS_MISSING",
+    "REASON_METRICS_STALE",
+    "REASON_PROMETHEUS_ERROR",
+    "REASON_OPTIMIZATION_SUCCEEDED",
+    "REASON_OPTIMIZATION_FAILED",
+    "REASON_METRICS_UNAVAILABLE",
+    "REASON_INVALID_CONFIGURATION",
+    "REASON_SKIPPED_PROCESSING",
+    "REASON_TARGET_FOUND",
+    "REASON_TARGET_NOT_FOUND",
+]

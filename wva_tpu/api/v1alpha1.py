@@ -1,0 +1,349 @@
+"""``VariantAutoscaling`` v1alpha1 resource types.
+
+Re-designed from the reference CRD (``/root/reference/api/v1alpha1/
+variantautoscaling_types.go:9-96``, ``conditions.go:9``) for TPU variants:
+``status.desiredOptimizedAlloc.accelerator`` names a **TPU slice variant**
+(e.g. ``"v5e-8"``, ``"v5p-16"``) rather than a GPU product, and the default
+per-replica cost maps to chip-hours of the slice.
+
+Group/version: ``wva.tpu.llmd.ai/v1alpha1``, kind ``VariantAutoscaling``,
+shortname ``va``.
+"""
+
+from __future__ import annotations
+
+import calendar as _calendar
+import copy
+import time as _time
+from dataclasses import dataclass, field
+from typing import Any
+
+GROUP = "wva.tpu.llmd.ai"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "VariantAutoscaling"
+PLURAL = "variantautoscalings"
+SHORT_NAME = "va"
+
+# Default per-replica cost when spec.variantCost is unset
+# (reference: internal/saturation/constants.go:13, api types :20-24).
+DEFAULT_VARIANT_COST = 10.0
+
+# --- Condition types (reference api/v1alpha1/variantautoscaling_types.go:103-110) ---
+TYPE_TARGET_RESOLVED = "TargetResolved"
+TYPE_METRICS_AVAILABLE = "MetricsAvailable"
+TYPE_OPTIMIZATION_READY = "OptimizationReady"
+
+# --- Condition reasons (reference :113-141) ---
+REASON_METRICS_FOUND = "MetricsFound"
+REASON_METRICS_MISSING = "MetricsMissing"
+REASON_METRICS_STALE = "MetricsStale"
+REASON_PROMETHEUS_ERROR = "PrometheusError"
+REASON_OPTIMIZATION_SUCCEEDED = "OptimizationSucceeded"
+REASON_OPTIMIZATION_FAILED = "OptimizationFailed"
+REASON_METRICS_UNAVAILABLE = "MetricsUnavailable"
+REASON_INVALID_CONFIGURATION = "InvalidConfiguration"
+REASON_SKIPPED_PROCESSING = "SkippedProcessing"
+REASON_TARGET_FOUND = "TargetFound"
+REASON_TARGET_NOT_FOUND = "TargetNotFound"
+
+
+def _rfc3339(ts: float) -> str:
+    return _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(ts))
+
+
+def _parse_rfc3339(s: str) -> float:
+    if not s:
+        return 0.0
+    try:
+        return _calendar.timegm(_time.strptime(s, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return 0.0
+
+
+@dataclass
+class ObjectMeta:
+    """Subset of k8s ObjectMeta the framework uses."""
+
+    name: str = ""
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    uid: str = ""
+    resource_version: str = "0"
+    generation: int = 1
+    creation_timestamp: float = 0.0
+    deletion_timestamp: float | None = None
+    owner_references: list[dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"name": self.name, "namespace": self.namespace}
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.annotations:
+            d["annotations"] = dict(self.annotations)
+        if self.uid:
+            d["uid"] = self.uid
+        d["resourceVersion"] = self.resource_version
+        d["generation"] = self.generation
+        if self.creation_timestamp:
+            d["creationTimestamp"] = _rfc3339(self.creation_timestamp)
+        if self.owner_references:
+            d["ownerReferences"] = copy.deepcopy(self.owner_references)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            uid=d.get("uid", ""),
+            resource_version=str(d.get("resourceVersion", "0")),
+            generation=int(d.get("generation", 1)),
+            creation_timestamp=_parse_rfc3339(d.get("creationTimestamp", "")),
+            owner_references=list(d.get("ownerReferences") or []),
+        )
+
+
+@dataclass
+class CrossVersionObjectReference:
+    """HPA-style scale target reference (reference types :13)."""
+
+    kind: str = "Deployment"
+    name: str = ""
+    api_version: str = "apps/v1"
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"kind": self.kind, "name": self.name, "apiVersion": self.api_version}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "CrossVersionObjectReference":
+        return cls(
+            kind=d.get("kind", "Deployment"),
+            name=d.get("name", ""),
+            api_version=d.get("apiVersion", "apps/v1"),
+        )
+
+
+@dataclass
+class Condition:
+    """metav1.Condition equivalent."""
+
+    type: str
+    status: str  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+    observed_generation: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "type": self.type,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "lastTransitionTime": _rfc3339(self.last_transition_time),
+            "observedGeneration": self.observed_generation,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Condition":
+        return cls(
+            type=d.get("type", ""),
+            status=d.get("status", "Unknown"),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_transition_time=_parse_rfc3339(d.get("lastTransitionTime", "")),
+            observed_generation=int(d.get("observedGeneration", 0)),
+        )
+
+
+@dataclass
+class VariantAutoscalingSpec:
+    """Desired state (reference types :9-25).
+
+    ``model_id`` is the served model identity (e.g. ``meta-llama/Llama-3.1-8B``)
+    used to group variants; ``variant_cost`` is the per-replica cost used by the
+    cost-aware optimizer — for TPU variants, chips-per-slice x per-chip-hour
+    rate is the natural convention.
+    """
+
+    scale_target_ref: CrossVersionObjectReference = field(
+        default_factory=CrossVersionObjectReference
+    )
+    model_id: str = ""
+    variant_cost: str = ""  # decimal string, CRD pattern ^\d+(\.\d+)?$
+
+    def cost(self) -> float:
+        """Parsed cost with reference default 10.0 on empty/invalid."""
+        try:
+            return float(self.variant_cost) if self.variant_cost else DEFAULT_VARIANT_COST
+        except ValueError:
+            return DEFAULT_VARIANT_COST
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "scaleTargetRef": self.scale_target_ref.to_dict(),
+            "modelID": self.model_id,
+        }
+        if self.variant_cost:
+            d["variantCost"] = self.variant_cost
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "VariantAutoscalingSpec":
+        return cls(
+            scale_target_ref=CrossVersionObjectReference.from_dict(
+                d.get("scaleTargetRef") or {}
+            ),
+            model_id=d.get("modelID", ""),
+            variant_cost=str(d.get("variantCost", "") or ""),
+        )
+
+
+@dataclass
+class OptimizedAlloc:
+    """Target optimized allocation (reference types :46-58).
+
+    ``accelerator`` is a TPU slice variant name, e.g. ``v5e-8`` (a
+    single-host 8-chip v5e slice) or ``v5e-16`` (2 hosts x 8 chips scaling
+    as one unit).
+    """
+
+    accelerator: str = ""
+    num_replicas: int = 0
+    last_run_time: float = 0.0
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "accelerator": self.accelerator,
+            "numReplicas": self.num_replicas,
+        }
+        if self.last_run_time:
+            d["lastRunTime"] = _rfc3339(self.last_run_time)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "OptimizedAlloc":
+        return cls(
+            accelerator=d.get("accelerator", ""),
+            num_replicas=int(d.get("numReplicas", 0)),
+            last_run_time=_parse_rfc3339(d.get("lastRunTime", "")),
+        )
+
+
+@dataclass
+class ActuationStatus:
+    applied: bool = False
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"applied": self.applied}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ActuationStatus":
+        return cls(applied=bool(d.get("applied", False)))
+
+
+@dataclass
+class VariantAutoscalingStatus:
+    desired_optimized_alloc: OptimizedAlloc = field(default_factory=OptimizedAlloc)
+    actuation: ActuationStatus = field(default_factory=ActuationStatus)
+    conditions: list[Condition] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "desiredOptimizedAlloc": self.desired_optimized_alloc.to_dict(),
+            "actuation": self.actuation.to_dict(),
+            "conditions": [c.to_dict() for c in self.conditions],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "VariantAutoscalingStatus":
+        return cls(
+            desired_optimized_alloc=OptimizedAlloc.from_dict(
+                d.get("desiredOptimizedAlloc") or {}
+            ),
+            actuation=ActuationStatus.from_dict(d.get("actuation") or {}),
+            conditions=[Condition.from_dict(c) for c in d.get("conditions") or []],
+        )
+
+
+@dataclass
+class VariantAutoscaling:
+    """The VariantAutoscaling resource (reference types :77-86)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: VariantAutoscalingSpec = field(default_factory=VariantAutoscalingSpec)
+    status: VariantAutoscalingStatus = field(default_factory=VariantAutoscalingStatus)
+
+    api_version: str = API_VERSION
+    kind: str = KIND
+
+    # --- helpers (reference types :144-156) ---
+    def scale_target_api(self) -> str:
+        return self.spec.scale_target_ref.api_version
+
+    def scale_target_name(self) -> str:
+        return self.spec.scale_target_ref.name
+
+    def scale_target_kind(self) -> str:
+        return self.spec.scale_target_ref.kind
+
+    def set_condition(
+        self,
+        ctype: str,
+        status: str,
+        reason: str,
+        message: str = "",
+        now: float | None = None,
+    ) -> None:
+        """Upsert a condition; last_transition_time only moves when the status
+        flips (metav1 SetStatusCondition semantics; reference conditions.go:9).
+        """
+        ts = _time.time() if now is None else now
+        for c in self.status.conditions:
+            if c.type == ctype:
+                if c.status != status:
+                    c.last_transition_time = ts
+                c.status = status
+                c.reason = reason
+                c.message = message
+                c.observed_generation = self.metadata.generation
+                return
+        self.status.conditions.append(
+            Condition(
+                type=ctype,
+                status=status,
+                reason=reason,
+                message=message,
+                last_transition_time=ts,
+                observed_generation=self.metadata.generation,
+            )
+        )
+
+    def get_condition(self, ctype: str) -> Condition | None:
+        for c in self.status.conditions:
+            if c.type == ctype:
+                return c
+        return None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "VariantAutoscaling":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=VariantAutoscalingSpec.from_dict(d.get("spec") or {}),
+            status=VariantAutoscalingStatus.from_dict(d.get("status") or {}),
+            api_version=d.get("apiVersion", API_VERSION),
+            kind=d.get("kind", KIND),
+        )

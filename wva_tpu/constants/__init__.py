@@ -1,0 +1,25 @@
+"""Centralized constants (reference ``internal/constants/{labels,metrics}.go``)."""
+
+from wva_tpu.constants.labels import (
+    CONTROLLER_INSTANCE_LABEL_KEY,
+    NAMESPACE_CONFIG_ENABLED_LABEL_KEY,
+    NAMESPACE_EXCLUDE_ANNOTATION_KEY,
+    ACCELERATOR_NAME_LABEL_KEY,
+    GKE_NODEPOOL_NODE_LABEL,
+    GKE_TPU_ACCELERATOR_NODE_LABEL,
+    GKE_TPU_TOPOLOGY_NODE_LABEL,
+    TPU_RESOURCE_NAME,
+)
+from wva_tpu.constants.metrics import *  # noqa: F401,F403
+from wva_tpu.constants.metrics import __all__ as _metrics_all
+
+__all__ = [
+    "CONTROLLER_INSTANCE_LABEL_KEY",
+    "NAMESPACE_CONFIG_ENABLED_LABEL_KEY",
+    "NAMESPACE_EXCLUDE_ANNOTATION_KEY",
+    "ACCELERATOR_NAME_LABEL_KEY",
+    "GKE_NODEPOOL_NODE_LABEL",
+    "GKE_TPU_ACCELERATOR_NODE_LABEL",
+    "GKE_TPU_TOPOLOGY_NODE_LABEL",
+    "TPU_RESOURCE_NAME",
+] + list(_metrics_all)

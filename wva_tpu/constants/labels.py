@@ -1,0 +1,32 @@
+"""Kubernetes label/annotation keys (reference ``internal/constants/labels.go:7-28``,
+``internal/utils/variant.go`` accelerator label) plus the GKE TPU node-label
+schema the discovery layer consumes."""
+
+# Associates VAs with a specific controller instance (multi-controller isolation).
+CONTROLLER_INSTANCE_LABEL_KEY = "wva.tpu.llmd.ai/controller-instance"
+
+# Namespace opt-in for namespace-local ConfigMap overrides.
+NAMESPACE_CONFIG_ENABLED_LABEL_KEY = "wva.tpu.llmd.ai/config-enabled"
+
+# Namespace exclusion annotation — set "true" to exclude from WVA management.
+NAMESPACE_EXCLUDE_ANNOTATION_KEY = "wva.tpu.llmd.ai/exclude"
+
+# VA label naming the TPU slice variant served by this VA's target
+# (reference uses `inference.optimization/acceleratorName` for the GPU type;
+# internal/utils/variant.go:GetAcceleratorType). Values like "v5e-8", "v5p-16".
+ACCELERATOR_NAME_LABEL_KEY = "inference.optimization/acceleratorName"
+
+# --- GKE TPU node-pool labels (discovery layer; SURVEY.md section 7 stage 3) ---
+
+# TPU generation/class, e.g. "tpu-v5-lite-podslice" (v5e), "tpu-v5p-slice".
+GKE_TPU_ACCELERATOR_NODE_LABEL = "cloud.google.com/gke-tpu-accelerator"
+
+# Physical slice topology, e.g. "2x4" (8 chips, 1 host) or "4x4" (16 chips, 2 hosts).
+GKE_TPU_TOPOLOGY_NODE_LABEL = "cloud.google.com/gke-tpu-topology"
+
+# Extended resource advertised by the TPU device plugin on each node.
+TPU_RESOURCE_NAME = "google.com/tpu"
+
+# Node label for the GKE node pool name (slice grouping: all hosts of one
+# multi-host slice live in one node pool and carry the same topology).
+GKE_NODEPOOL_NODE_LABEL = "cloud.google.com/gke-nodepool"

@@ -1,0 +1,79 @@
+"""Metric-name constants (reference ``internal/constants/metrics.go:8-121``).
+
+Three input families:
+- ``vllm:*`` — vLLM-TPU emits the same engine-agnostic names as CUDA vLLM, so
+  the reference's queries transfer unchanged (SURVEY.md section 7 stage 2).
+- ``jetstream_*`` — JetStream / MaxText serving gauges (prefill/generate
+  backlogs, decode slots, HBM KV utilization).
+- ``inference_extension_*`` — llm-d inference-scheduler flow-control metrics
+  (model-scoped, engine-agnostic).
+
+Output family ``wva_*`` is byte-identical to the reference so the HPA /
+KEDA / Prometheus-Adapter glue transfers verbatim.
+"""
+
+# --- vLLM(-TPU) input metrics ---
+VLLM_NUM_REQUESTS_RUNNING = "vllm:num_requests_running"
+VLLM_REQUEST_SUCCESS_TOTAL = "vllm:request_success_total"
+VLLM_REQUEST_PROMPT_TOKENS_SUM = "vllm:request_prompt_tokens_sum"
+VLLM_REQUEST_PROMPT_TOKENS_COUNT = "vllm:request_prompt_tokens_count"
+VLLM_REQUEST_GENERATION_TOKENS_SUM = "vllm:request_generation_tokens_sum"
+VLLM_REQUEST_GENERATION_TOKENS_COUNT = "vllm:request_generation_tokens_count"
+VLLM_TTFT_SECONDS_SUM = "vllm:time_to_first_token_seconds_sum"
+VLLM_TTFT_SECONDS_COUNT = "vllm:time_to_first_token_seconds_count"
+VLLM_TPOT_SECONDS_SUM = "vllm:time_per_output_token_seconds_sum"
+VLLM_TPOT_SECONDS_COUNT = "vllm:time_per_output_token_seconds_count"
+VLLM_KV_CACHE_USAGE_PERC = "vllm:kv_cache_usage_perc"
+VLLM_NUM_REQUESTS_WAITING = "vllm:num_requests_waiting"
+VLLM_CACHE_CONFIG_INFO = "vllm:cache_config_info"
+VLLM_PREFIX_CACHE_HITS = "vllm:prefix_cache_hits"
+VLLM_PREFIX_CACHE_QUERIES = "vllm:prefix_cache_queries"
+
+# --- JetStream input metrics ---
+# Requests accepted but not yet prefilled (the saturation "queue length").
+JETSTREAM_PREFILL_BACKLOG_SIZE = "jetstream_prefill_backlog_size"
+# Prefilled requests waiting for a free decode slot.
+JETSTREAM_GENERATE_BACKLOG_SIZE = "jetstream_generate_backlog_size"
+# Concurrent decode slots currently in use / configured maximum.
+JETSTREAM_SLOTS_USED = "jetstream_slots_used"
+JETSTREAM_SLOTS_AVAILABLE = "jetstream_slots_available"
+# HBM KV-cache utilization of the slice, 0.0-1.0 (the "kv_cache_usage" analogue).
+JETSTREAM_KV_CACHE_UTILIZATION = "jetstream_kv_cache_utilization"
+# Latency/token histograms (sum/count pairs, same shape as the vllm ones).
+JETSTREAM_TTFT_SECONDS_SUM = "jetstream_time_to_first_token_seconds_sum"
+JETSTREAM_TTFT_SECONDS_COUNT = "jetstream_time_to_first_token_seconds_count"
+JETSTREAM_TPOT_SECONDS_SUM = "jetstream_time_per_output_token_seconds_sum"
+JETSTREAM_TPOT_SECONDS_COUNT = "jetstream_time_per_output_token_seconds_count"
+JETSTREAM_REQUEST_SUCCESS_TOTAL = "jetstream_request_success_total"
+JETSTREAM_PROMPT_TOKENS_SUM = "jetstream_request_input_length_sum"
+JETSTREAM_PROMPT_TOKENS_COUNT = "jetstream_request_input_length_count"
+JETSTREAM_GENERATION_TOKENS_SUM = "jetstream_request_output_length_sum"
+JETSTREAM_GENERATION_TOKENS_COUNT = "jetstream_request_output_length_count"
+# Info-style gauge exposing serving config as labels (max_concurrent_decodes,
+# max_target_length, tokens_per_slot, tpu_topology) — value always 1.0; the V2
+# analyzer's capacity analogue of vllm:cache_config_info.
+JETSTREAM_SERVING_CONFIG_INFO = "jetstream_serving_config_info"
+
+# --- Inference-scheduler flow-control metrics (model-scoped, no namespace label) ---
+SCHEDULER_FLOW_CONTROL_QUEUE_SIZE = "inference_extension_flow_control_queue_size"
+SCHEDULER_FLOW_CONTROL_QUEUE_BYTES = "inference_extension_flow_control_queue_bytes"
+
+# --- WVA output metrics (identical to reference for HPA/KEDA glue) ---
+WVA_REPLICA_SCALING_TOTAL = "wva_replica_scaling_total"
+WVA_DESIRED_REPLICAS = "wva_desired_replicas"
+WVA_CURRENT_REPLICAS = "wva_current_replicas"
+WVA_DESIRED_RATIO = "wva_desired_ratio"
+
+# --- Common metric label names ---
+LABEL_MODEL_NAME = "model_name"
+LABEL_TARGET_MODEL_NAME = "target_model_name"
+LABEL_NAMESPACE = "namespace"
+LABEL_VARIANT_NAME = "variant_name"
+LABEL_DIRECTION = "direction"
+LABEL_REASON = "reason"
+LABEL_ACCELERATOR_TYPE = "accelerator_type"
+LABEL_CONTROLLER_INSTANCE = "controller_instance"
+LABEL_POD = "pod"
+LABEL_METRIC_NAME = "__name__"
+
+__all__ = [n for n in dir() if n.isupper()]

@@ -1,0 +1,132 @@
+"""Saturation scaling configuration with defaults + validation
+(reference ``internal/interfaces/saturation_scaling.go:8-108``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DEFAULT_SCALE_UP_THRESHOLD = 0.85
+DEFAULT_SCALE_DOWN_BOUNDARY = 0.70
+
+# V1 defaults (reference docs/saturation-scaling-config.md:24-44).
+DEFAULT_KV_CACHE_THRESHOLD = 0.80
+DEFAULT_QUEUE_LENGTH_THRESHOLD = 5.0
+DEFAULT_KV_SPARE_TRIGGER = 0.10
+DEFAULT_QUEUE_SPARE_TRIGGER = 3.0
+
+V2_ANALYZER_NAME = "saturation"
+SLO_ANALYZER_NAME = "slo"
+
+
+@dataclass
+class SaturationScalingConfig:
+    """Per-model saturation thresholds; override entries carry model_id+namespace."""
+
+    model_id: str = ""
+    namespace: str = ""
+
+    # Replica saturated iff kv >= kv_cache_threshold OR queue >= queue_length_threshold.
+    kv_cache_threshold: float = DEFAULT_KV_CACHE_THRESHOLD
+    queue_length_threshold: float = DEFAULT_QUEUE_LENGTH_THRESHOLD
+    # Scale-up iff avg spare kv < kv_spare_trigger OR avg spare queue < queue_spare_trigger.
+    kv_spare_trigger: float = DEFAULT_KV_SPARE_TRIGGER
+    queue_spare_trigger: float = DEFAULT_QUEUE_SPARE_TRIGGER
+
+    # Include the TPU-slice limiter stage in the pipeline (default off).
+    enable_limiter: bool = False
+
+    # "" -> V1 percentage analyzer; "saturation" -> V2 token analyzer;
+    # "slo" -> queueing-model (SLO) analyzer.
+    analyzer_name: str = ""
+
+    # V2 thresholds (0 means "apply default" when analyzer is V2).
+    scale_up_threshold: float = 0.0
+    scale_down_boundary: float = 0.0
+
+    def get_analyzer_name(self) -> str:
+        return self.analyzer_name
+
+    def apply_defaults(self) -> None:
+        """Fill zero-valued V2 fields (reference :61-70); extended to the SLO
+        analyzer, which reuses the same utilization thresholds."""
+        if self.analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
+            if self.scale_up_threshold == 0:
+                self.scale_up_threshold = DEFAULT_SCALE_UP_THRESHOLD
+            if self.scale_down_boundary == 0:
+                self.scale_down_boundary = DEFAULT_SCALE_DOWN_BOUNDARY
+
+    def validate(self) -> None:
+        """Raise ValueError on invalid thresholds (reference :75-108)."""
+        if not 0 <= self.kv_cache_threshold <= 1:
+            raise ValueError(
+                f"kvCacheThreshold must be between 0 and 1, got {self.kv_cache_threshold:.2f}"
+            )
+        if self.queue_length_threshold < 0:
+            raise ValueError(
+                f"queueLengthThreshold must be >= 0, got {self.queue_length_threshold:.1f}"
+            )
+        if not 0 <= self.kv_spare_trigger <= 1:
+            raise ValueError(
+                f"kvSpareTrigger must be between 0 and 1, got {self.kv_spare_trigger:.2f}"
+            )
+        if self.queue_spare_trigger < 0:
+            raise ValueError(
+                f"queueSpareTrigger must be >= 0, got {self.queue_spare_trigger:.1f}"
+            )
+        if self.kv_cache_threshold < self.kv_spare_trigger:
+            raise ValueError(
+                f"kvCacheThreshold ({self.kv_cache_threshold:.2f}) should be >= "
+                f"kvSpareTrigger ({self.kv_spare_trigger:.2f})"
+            )
+        if self.analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
+            if not 0 < self.scale_up_threshold <= 1:
+                raise ValueError(
+                    f"scaleUpThreshold must be in (0, 1], got {self.scale_up_threshold:.2f}"
+                )
+            if not 0 < self.scale_down_boundary <= 1:
+                raise ValueError(
+                    f"scaleDownBoundary must be in (0, 1], got {self.scale_down_boundary:.2f}"
+                )
+            if self.scale_up_threshold <= self.scale_down_boundary:
+                raise ValueError(
+                    f"scaleUpThreshold ({self.scale_up_threshold:.2f}) must be > "
+                    f"scaleDownBoundary ({self.scale_down_boundary:.2f})"
+                )
+
+    # --- YAML dict mapping (camelCase keys, as the ConfigMap format) ---
+
+    _KEYS = {
+        "model_id": "model_id",
+        "namespace": "namespace",
+        "kvCacheThreshold": "kv_cache_threshold",
+        "queueLengthThreshold": "queue_length_threshold",
+        "kvSpareTrigger": "kv_spare_trigger",
+        "queueSpareTrigger": "queue_spare_trigger",
+        "enableLimiter": "enable_limiter",
+        "analyzerName": "analyzer_name",
+        "scaleUpThreshold": "scale_up_threshold",
+        "scaleDownBoundary": "scale_down_boundary",
+    }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SaturationScalingConfig":
+        cfg = cls()
+        for yaml_key, attr in cls._KEYS.items():
+            if yaml_key in d and d[yaml_key] is not None:
+                cur = getattr(cfg, attr)
+                val = d[yaml_key]
+                if isinstance(cur, bool):
+                    val = bool(val) if not isinstance(val, str) else val.lower() == "true"
+                elif isinstance(cur, float):
+                    val = float(val)
+                setattr(cfg, attr, val)
+        return cfg
+
+    def to_dict(self) -> dict:
+        d = {}
+        for yaml_key, attr in self._KEYS.items():
+            val = getattr(self, attr)
+            if val != "" or yaml_key not in ("model_id", "namespace", "analyzerName"):
+                d[yaml_key] = val
+        return d
