@@ -1,0 +1,191 @@
+"""Config system tests (model: internal/config/{loader,validation,scale_to_zero}_test.go)."""
+
+import pytest
+
+from wva_tpu import config as cfgpkg
+from wva_tpu.config import (
+    Config,
+    ImmutableParameterError,
+    ModelScaleToZeroConfig,
+    is_scale_to_zero_enabled,
+    load,
+    min_num_replicas,
+    new_test_config,
+    parse_saturation_configmap,
+    parse_scale_to_zero_configmap,
+    scale_to_zero_retention_seconds,
+)
+from wva_tpu.config.validation import detect_immutable_parameter_changes
+from wva_tpu.interfaces import SaturationScalingConfig
+from wva_tpu.utils import parse_duration
+
+
+# --- loader precedence ---
+
+def test_load_requires_prometheus_url():
+    with pytest.raises(ValueError, match="prometheus BaseURL"):
+        load(env={})
+
+
+def test_load_defaults(tmp_path):
+    cfg = load(env={"PROMETHEUS_BASE_URL": "http://prom:9090"})
+    assert cfg.optimization_interval() == 60.0
+    assert cfg.scale_from_zero_max_concurrency() == 10
+    assert cfg.scale_to_zero_enabled() is False
+    assert cfg.probe_addr() == ":8081"
+    assert cfg.prometheus_cache_config().ttl == 30.0
+
+
+def test_load_precedence_flags_env_file(tmp_path):
+    f = tmp_path / "config.yaml"
+    f.write_text(
+        "PROMETHEUS_BASE_URL: http://from-file:9090\n"
+        "GLOBAL_OPT_INTERVAL: 30s\n"
+        "WVA_SCALE_TO_ZERO: true\n"
+    )
+    # file only
+    cfg = load(env={}, config_file_path=str(f))
+    assert cfg.prometheus_base_url() == "http://from-file:9090"
+    assert cfg.optimization_interval() == 30.0
+    assert cfg.scale_to_zero_enabled() is True
+
+    # env over file
+    cfg = load(env={"GLOBAL_OPT_INTERVAL": "90s"}, config_file_path=str(f))
+    assert cfg.optimization_interval() == 90.0
+
+    # flags over env
+    cfg = load(flags={"GLOBAL_OPT_INTERVAL": "15s"},
+               env={"GLOBAL_OPT_INTERVAL": "90s"}, config_file_path=str(f))
+    assert cfg.optimization_interval() == 15.0
+
+
+def test_load_invalid_concurrency_fails_fast():
+    with pytest.raises(ValueError, match="max concurrency"):
+        load(env={"PROMETHEUS_BASE_URL": "http://p",
+                  "SCALE_FROM_ZERO_ENGINE_MAX_CONCURRENCY": "-1"})
+
+
+# --- durations ---
+
+@pytest.mark.parametrize("s,expected", [
+    ("30s", 30.0), ("10m", 600.0), ("1h30m", 5400.0), ("100ms", 0.1),
+    ("1.5s", 1.5), ("0", 0.0), ("-15s", -15.0),
+])
+def test_parse_duration(s, expected):
+    assert parse_duration(s) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize("s", ["", "10", "5x", "s", "10s5"])
+def test_parse_duration_invalid(s):
+    with pytest.raises(ValueError):
+        parse_duration(s)
+
+
+# --- namespace-aware hot-reload resolution ---
+
+def test_saturation_config_namespace_resolution():
+    cfg = new_test_config()
+    g = {"default": SaturationScalingConfig(kv_cache_threshold=0.8)}
+    ns = {"default": SaturationScalingConfig(kv_cache_threshold=0.9)}
+    cfg.update_saturation_config(g)
+    cfg.update_saturation_config_for_namespace("team-a", ns)
+
+    assert cfg.saturation_config_for_namespace("team-a")["default"].kv_cache_threshold == 0.9
+    assert cfg.saturation_config_for_namespace("team-b")["default"].kv_cache_threshold == 0.8
+    assert cfg.saturation_config()["default"].kv_cache_threshold == 0.8
+
+    cfg.remove_namespace_config("team-a")
+    assert cfg.saturation_config_for_namespace("team-a")["default"].kv_cache_threshold == 0.8
+
+
+def test_saturation_config_returns_copy():
+    cfg = new_test_config()
+    cfg.update_saturation_config({"default": SaturationScalingConfig()})
+    got = cfg.saturation_config()
+    got["default"].kv_cache_threshold = 0.123
+    assert cfg.saturation_config()["default"].kv_cache_threshold != 0.123
+
+
+# --- immutable params ---
+
+def test_detect_immutable_parameter_changes():
+    cfg = new_test_config("http://prom:9090")
+    # unchanged -> ok
+    assert detect_immutable_parameter_changes(cfg, {"PROMETHEUS_BASE_URL": "http://prom:9090"}) == []
+    # changed -> error listing the parameter
+    with pytest.raises(ImmutableParameterError, match="Prometheus BaseURL"):
+        detect_immutable_parameter_changes(cfg, {"PROMETHEUS_BASE_URL": "http://other:9090"})
+
+
+# --- scale-to-zero config ---
+
+def test_parse_scale_to_zero_configmap_defaults_and_overrides():
+    data = {
+        "default": "enable_scale_to_zero: false\nretention_period: 5m\n",
+        "llama": "model_id: meta-llama/Llama-3.1-8B\nenable_scale_to_zero: true\n",
+        "broken": ":::not yaml",
+        "no-model-id": "enable_scale_to_zero: true\n",
+    }
+    parsed = parse_scale_to_zero_configmap(data)
+    assert set(parsed) == {"default", "meta-llama/Llama-3.1-8B"}
+
+    assert is_scale_to_zero_enabled(parsed, "meta-llama/Llama-3.1-8B") is True
+    assert is_scale_to_zero_enabled(parsed, "other-model") is False
+    # partial override: llama has no retention -> inherits default 5m
+    assert scale_to_zero_retention_seconds(parsed, "meta-llama/Llama-3.1-8B") == 300.0
+    assert min_num_replicas(parsed, "meta-llama/Llama-3.1-8B") == 0
+    assert min_num_replicas(parsed, "other-model") == 1
+
+
+def test_scale_to_zero_env_fallback(monkeypatch):
+    monkeypatch.setenv("WVA_SCALE_TO_ZERO", "true")
+    assert is_scale_to_zero_enabled({}, "any") is True
+    monkeypatch.delenv("WVA_SCALE_TO_ZERO")
+    assert is_scale_to_zero_enabled({}, "any") is False
+
+
+def test_scale_to_zero_duplicate_model_id_first_key_wins():
+    data = {
+        "a-entry": "model_id: m1\nretention_period: 1m\n",
+        "b-entry": "model_id: m1\nretention_period: 2m\n",
+    }
+    parsed = parse_scale_to_zero_configmap(data)
+    assert scale_to_zero_retention_seconds(parsed, "m1") == 60.0
+
+
+def test_retention_falls_back_to_system_default():
+    assert scale_to_zero_retention_seconds({}, "m") == 600.0
+    bad = {"default": ModelScaleToZeroConfig(retention_period="not-a-duration")}
+    assert scale_to_zero_retention_seconds(bad, "m") == 600.0
+
+
+# --- saturation ConfigMap parsing ---
+
+def test_parse_saturation_configmap():
+    data = {
+        "default": "kvCacheThreshold: 0.8\nqueueLengthThreshold: 5\n",
+        "v2-model": "analyzerName: saturation\n",  # minimal V2 entry: defaults applied
+        "invalid": "kvCacheThreshold: 3.0\n",
+    }
+    configs, count = parse_saturation_configmap(data)
+    assert count == 2
+    assert configs["default"].kv_cache_threshold == 0.8
+    assert configs["v2-model"].scale_up_threshold == 0.85  # default applied pre-validate
+    assert "invalid" not in configs
+
+
+def test_configmap_value_helpers():
+    data = {"d": "15s", "i": "7", "b": "yes", "bad": "zzz"}
+    assert cfgpkg.parse_duration_from_config(data, "d", 1.0) == 15.0
+    assert cfgpkg.parse_duration_from_config(data, "bad", 1.0) == 1.0
+    assert cfgpkg.parse_int_from_config(data, "i", 0, 1) == 7
+    assert cfgpkg.parse_int_from_config(data, "bad", 3, 1) == 3
+    assert cfgpkg.parse_bool_from_config(data, "b", False) is True
+    assert cfgpkg.parse_bool_from_config(data, "missing", True) is True
+
+
+def test_system_namespace(monkeypatch):
+    monkeypatch.delenv("POD_NAMESPACE", raising=False)
+    assert cfgpkg.system_namespace() == "workload-variant-autoscaler-system"
+    monkeypatch.setenv("POD_NAMESPACE", "custom-ns")
+    assert cfgpkg.system_namespace() == "custom-ns"

@@ -1,0 +1,128 @@
+"""FakeCluster semantics tests (model: controller-runtime fake client behavior)."""
+
+import pytest
+
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+from wva_tpu.k8s import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    Deployment,
+    FakeCluster,
+    NotFoundError,
+)
+
+
+def make_deploy(name="d1", ns="default", replicas=1, labels=None):
+    return Deployment(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        replicas=replicas,
+    )
+
+
+def test_create_get_roundtrip_and_isolation():
+    c = FakeCluster()
+    c.create(make_deploy())
+    got = c.get("Deployment", "default", "d1")
+    got.replicas = 99  # mutating the returned copy must not affect the store
+    assert c.get("Deployment", "default", "d1").replicas == 1
+
+
+def test_create_duplicate_conflicts():
+    c = FakeCluster()
+    c.create(make_deploy())
+    with pytest.raises(ConflictError):
+        c.create(make_deploy())
+
+
+def test_get_missing_raises():
+    c = FakeCluster()
+    with pytest.raises(NotFoundError):
+        c.get("Deployment", "default", "nope")
+    assert c.try_get("Deployment", "default", "nope") is None
+
+
+def test_list_with_namespace_and_labels():
+    c = FakeCluster()
+    c.create(make_deploy("a", "ns1", labels={"app": "x"}))
+    c.create(make_deploy("b", "ns1", labels={"app": "y"}))
+    c.create(make_deploy("c", "ns2", labels={"app": "x"}))
+    assert len(c.list("Deployment")) == 3
+    assert len(c.list("Deployment", namespace="ns1")) == 2
+    assert [d.metadata.name for d in c.list("Deployment", label_selector={"app": "x"})] == ["a", "c"]
+
+
+def test_update_bumps_resource_version_and_generation():
+    c = FakeCluster()
+    created = c.create(make_deploy())
+    updated = c.update(make_deploy(replicas=5))
+    assert updated.replicas == 5
+    assert int(updated.metadata.resource_version) > int(created.metadata.resource_version)
+    assert updated.metadata.generation == created.metadata.generation + 1
+    assert updated.metadata.uid == created.metadata.uid
+
+
+def test_update_status_only_touches_status():
+    c = FakeCluster()
+    c.create(make_deploy(replicas=3))
+    patch = make_deploy(replicas=1)  # spec difference must NOT be applied
+    patch.status.ready_replicas = 2
+    c.update_status(patch)
+    got = c.get("Deployment", "default", "d1")
+    assert got.replicas == 3
+    assert got.status.ready_replicas == 2
+
+
+def test_patch_scale_and_noop():
+    c = FakeCluster()
+    c.create(make_deploy(replicas=1))
+    events = []
+    c.watch("Deployment", lambda ev, obj: events.append((ev, obj.replicas)))
+    c.patch_scale("Deployment", "default", "d1", 4)
+    assert c.get("Deployment", "default", "d1").replicas == 4
+    c.patch_scale("Deployment", "default", "d1", 4)  # no-op: no event
+    assert events == [(MODIFIED, 4)]
+
+
+def test_watch_events():
+    c = FakeCluster()
+    events = []
+    c.watch("Deployment", lambda ev, obj: events.append((ev, obj.metadata.name)))
+    c.create(make_deploy())
+    c.update(make_deploy(replicas=2))
+    c.delete("Deployment", "default", "d1")
+    assert events == [(ADDED, "d1"), (MODIFIED, "d1"), (DELETED, "d1")]
+
+
+def test_va_storage():
+    c = FakeCluster()
+    va = VariantAutoscaling(
+        metadata=ObjectMeta(name="v1", namespace="default"),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name="d1"),
+            model_id="m",
+        ),
+    )
+    c.create(va)
+    assert c.variant_autoscalings()[0].spec.model_id == "m"
+
+
+def test_update_cannot_touch_status_and_stale_rv_conflicts():
+    c = FakeCluster()
+    c.create(make_deploy(replicas=3))
+    status_patch = make_deploy(replicas=3)
+    status_patch.status.ready_replicas = 2
+    c.update_status(status_patch)
+
+    # Main-resource update with its own (stale) status must not clobber it.
+    fresh = c.get("Deployment", "default", "d1")
+    fresh.metadata.labels["x"] = "y"
+    fresh.status.ready_replicas = 0
+    updated = c.update(fresh)
+    assert updated.status.ready_replicas == 2
+
+    # Stale resourceVersion -> Conflict.
+    with pytest.raises(ConflictError, match="stale"):
+        c.update(fresh)  # fresh.rv predates the update above

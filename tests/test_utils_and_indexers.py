@@ -1,0 +1,195 @@
+"""Variant filters, grouping, indexer, datastore, backoff tests
+(model: internal/utils/variant_test, internal/indexers/suite_test)."""
+
+import pytest
+
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+from wva_tpu.datastore import Datastore, PoolNotFoundError
+from wva_tpu.indexers import Indexer, MultipleVAsError
+from wva_tpu.k8s import Deployment, FakeCluster
+from wva_tpu.utils import (
+    EndpointPool,
+    FakeClock,
+    active_variant_autoscalings,
+    get_accelerator_type,
+    group_variant_autoscalings_by_model,
+    inactive_variant_autoscalings,
+    retry_with_backoff,
+)
+from wva_tpu.utils.pool import EndpointPicker
+
+
+def make_va(name, ns="default", model="m1", target=None, labels=None):
+    return VariantAutoscaling(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name=target or f"{name}-deploy"),
+            model_id=model,
+        ),
+    )
+
+
+def make_deploy(name, ns="default", replicas=1):
+    return Deployment(metadata=ObjectMeta(name=name, namespace=ns), replicas=replicas)
+
+
+def setup_cluster():
+    c = FakeCluster()
+    c.create(make_deploy("va1-deploy", replicas=2))
+    c.create(make_deploy("va2-deploy", replicas=0))
+    c.create(make_va("va1", labels={"inference.optimization/acceleratorName": "v5e-8"}))
+    c.create(make_va("va2", model="m1"))
+    c.create(make_va("orphan", target="missing-deploy"))
+    return c
+
+
+def test_active_inactive_filters():
+    c = setup_cluster()
+    assert [v.metadata.name for v in active_variant_autoscalings(c)] == ["va1"]
+    assert [v.metadata.name for v in inactive_variant_autoscalings(c)] == ["va2"]
+
+
+def test_group_by_model_and_namespace():
+    vas = [make_va("a", model="m1"), make_va("b", model="m1"),
+           make_va("c", model="m2"), make_va("d", model="m1", ns="other")]
+    groups = group_variant_autoscalings_by_model(vas)
+    assert sorted(groups) == ["m1|default", "m1|other", "m2|default"]
+    assert len(groups["m1|default"]) == 2
+
+
+def test_accelerator_type_label():
+    va = make_va("x", labels={"inference.optimization/acceleratorName": "v5p-16"})
+    assert get_accelerator_type(va) == "v5p-16"
+    assert get_accelerator_type(make_va("y")) == ""
+
+
+def test_controller_instance_filter(monkeypatch):
+    c = FakeCluster()
+    c.create(make_deploy("a-deploy"))
+    c.create(make_va("a", labels={"wva.tpu.llmd.ai/controller-instance": "blue"}))
+    c.create(make_deploy("b-deploy"))
+    c.create(make_va("b"))
+    monkeypatch.setenv("CONTROLLER_INSTANCE", "blue")
+    assert [v.metadata.name for v in active_variant_autoscalings(c)] == ["a"]
+    monkeypatch.delenv("CONTROLLER_INSTANCE")
+    assert len(active_variant_autoscalings(c)) == 2
+
+
+# --- indexer ---
+
+def test_indexer_reverse_lookup_and_move():
+    c = FakeCluster()
+    idx = Indexer(c)
+    idx.setup()
+    c.create(make_va("va1", target="d1"))
+    found = idx.find_va_for_deployment("d1", "default")
+    assert found is not None and found.metadata.name == "va1"
+    assert idx.find_va_for_deployment("other", "default") is None
+
+    # retarget va1 -> d2; stale index entry must disappear
+    moved = make_va("va1", target="d2")
+    c.update(moved)
+    assert idx.find_va_for_deployment("d1", "default") is None
+    assert idx.find_va_for_deployment("d2", "default").metadata.name == "va1"
+
+    c.delete("VariantAutoscaling", "default", "va1")
+    assert idx.find_va_for_deployment("d2", "default") is None
+
+
+def test_indexer_rejects_duplicate_targets():
+    c = FakeCluster()
+    idx = Indexer(c)
+    idx.setup()
+    c.create(make_va("va1", target="d1"))
+    c.create(make_va("va2", target="d1"))
+    with pytest.raises(MultipleVAsError):
+        idx.find_va_for_deployment("d1", "default")
+
+
+# --- datastore ---
+
+class _FakeRegistry:
+    def __init__(self):
+        self.sources = {}
+
+    def register(self, name, src):
+        self.sources[name] = src
+
+    def get(self, name):
+        return self.sources.get(name)
+
+    def unregister(self, name):
+        self.sources.pop(name, None)
+
+
+def test_datastore_pool_lifecycle():
+    reg = _FakeRegistry()
+    ds = Datastore(source_registry=reg, source_factory=lambda pool: f"src-{pool.name}")
+    pool = EndpointPool(name="p1", namespace="default", selector={"app": "llama"},
+                        endpoint_picker=EndpointPicker(service_name="epp"))
+    ds.pool_set(pool)
+    assert ds.pool_get("p1").name == "p1"
+    assert ds.pool_get_metrics_source("p1") == "src-p1"
+    assert ds.pool_get_from_labels({"app": "llama", "extra": "1"}).name == "p1"
+    with pytest.raises(PoolNotFoundError):
+        ds.pool_get_from_labels({"app": "other"})
+    ds.pool_delete("p1")
+    with pytest.raises(PoolNotFoundError):
+        ds.pool_get("p1")
+    assert reg.get("p1") is None
+
+
+def test_datastore_namespace_tracking():
+    ds = Datastore()
+    ds.namespace_track("VariantAutoscaling", "va1", "ns1")
+    ds.namespace_track("VariantAutoscaling", "va1", "ns1")  # idempotent
+    ds.namespace_track("InferencePool", "p1", "ns1")
+    assert ds.is_namespace_tracked("ns1")
+    ds.namespace_untrack("VariantAutoscaling", "va1", "ns1")
+    assert ds.is_namespace_tracked("ns1")  # pool still tracked
+    ds.namespace_untrack("InferencePool", "p1", "ns1")
+    assert not ds.is_namespace_tracked("ns1")
+    assert ds.list_tracked_namespaces() == []
+
+
+# --- backoff ---
+
+def test_retry_with_backoff_retries_then_succeeds():
+    clock = FakeClock()
+    calls = []
+
+    def flaky():
+        calls.append(clock.now())
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert retry_with_backoff(flaky, clock=clock) == "ok"
+    assert len(calls) == 3
+    assert clock.now() == pytest.approx(0.1 + 0.2)  # 0.1 then 0.2 backoff
+
+
+def test_retry_with_backoff_nonretriable_raises_immediately():
+    calls = []
+
+    def fail():
+        calls.append(1)
+        raise KeyError("not found")
+
+    with pytest.raises(KeyError):
+        retry_with_backoff(fail, retriable=lambda e: not isinstance(e, KeyError),
+                           clock=FakeClock())
+    assert len(calls) == 1
+
+
+def test_indexer_clearing_target_removes_stale_entry():
+    c = FakeCluster()
+    idx = Indexer(c)
+    idx.setup()
+    c.create(make_va("va1", target="d1"))
+    assert idx.find_va_for_deployment("d1", "default").metadata.name == "va1"
+    cleared = c.get("VariantAutoscaling", "default", "va1")
+    cleared.spec.scale_target_ref = CrossVersionObjectReference(kind="", name="", api_version="")
+    c.update(cleared)
+    assert idx.find_va_for_deployment("d1", "default") is None

@@ -88,6 +88,8 @@ class ObjectMeta:
         d["generation"] = self.generation
         if self.creation_timestamp:
             d["creationTimestamp"] = _rfc3339(self.creation_timestamp)
+        if self.deletion_timestamp is not None:
+            d["deletionTimestamp"] = _rfc3339(self.deletion_timestamp)
         if self.owner_references:
             d["ownerReferences"] = copy.deepcopy(self.owner_references)
         return d
@@ -103,6 +105,10 @@ class ObjectMeta:
             resource_version=str(d.get("resourceVersion", "0")),
             generation=int(d.get("generation", 1)),
             creation_timestamp=_parse_rfc3339(d.get("creationTimestamp", "")),
+            deletion_timestamp=(
+                _parse_rfc3339(d["deletionTimestamp"])
+                if d.get("deletionTimestamp") else None
+            ),
             owner_references=list(d.get("ownerReferences") or []),
         )
 

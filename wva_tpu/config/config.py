@@ -1,0 +1,273 @@
+"""Unified, thread-safe runtime configuration
+(reference ``internal/config/config.go:15-631``).
+
+All mutable state sits behind one RLock; hot-reloadable sections (saturation,
+scale-to-zero, prometheus cache) support global + namespace-local scoping with
+namespace-local > global resolution.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from wva_tpu.config.types import CacheConfig, ScaleToZeroConfigData
+from wva_tpu.interfaces.saturation_config import SaturationScalingConfig
+
+log = logging.getLogger(__name__)
+
+# model ID (or "default") -> SaturationScalingConfig
+SaturationConfigPerModel = dict[str, SaturationScalingConfig]
+
+
+@dataclass
+class InfrastructureConfig:
+    metrics_addr: str = "0"
+    probe_addr: str = ":8081"
+    enable_leader_election: bool = False
+    leader_election_id: str = "72dd1cf1.wva.tpu.llmd.ai"
+    lease_duration: float = 60.0
+    renew_deadline: float = 50.0
+    retry_period: float = 10.0
+    rest_timeout: float = 60.0
+    secure_metrics: bool = True
+    enable_http2: bool = False
+    watch_namespace: str = ""
+    logger_verbosity: int = 0
+    optimization_interval: float = 60.0
+
+
+@dataclass
+class TLSConfig:
+    webhook_cert_path: str = ""
+    webhook_cert_name: str = "tls.crt"
+    webhook_cert_key: str = "tls.key"
+    metrics_cert_path: str = ""
+    metrics_cert_name: str = "tls.crt"
+    metrics_cert_key: str = "tls.key"
+
+
+@dataclass
+class PrometheusConfig:
+    base_url: str = ""
+    bearer_token: str = ""
+    token_path: str = ""
+    insecure_skip_verify: bool = False
+    ca_cert_path: str = ""
+    client_cert_path: str = ""
+    client_key_path: str = ""
+    server_name: str = ""
+    cache: CacheConfig | None = None
+
+
+@dataclass
+class EPPConfig:
+    metric_reader_bearer_token: str = ""
+
+
+@dataclass
+class FeatureFlagsConfig:
+    scale_to_zero_enabled: bool = False
+    limited_mode_enabled: bool = False
+    scale_from_zero_max_concurrency: int = 10
+
+
+@dataclass
+class ConfigSyncState:
+    configmaps_bootstrap_complete: bool = False
+    last_configmaps_sync_at: float = 0.0
+    last_configmaps_sync_error: str = ""
+
+
+class Config:
+    """The unified configuration object. All access is via thread-safe
+    methods; hot-reload updates swap whole sections under the lock."""
+
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._sync = ConfigSyncState()
+        self.infrastructure = InfrastructureConfig()
+        self.tls = TLSConfig()
+        self._prometheus = PrometheusConfig()
+        self._epp = EPPConfig()
+        self._features = FeatureFlagsConfig()
+        self._saturation_global: SaturationConfigPerModel = {}
+        self._saturation_ns: dict[str, SaturationConfigPerModel] = {}
+        self._scale_to_zero_global: ScaleToZeroConfigData = {}
+        self._scale_to_zero_ns: dict[str, ScaleToZeroConfigData] = {}
+
+    # --- infrastructure getters ---
+
+    def metrics_addr(self) -> str:
+        with self._mu:
+            return self.infrastructure.metrics_addr
+
+    def probe_addr(self) -> str:
+        with self._mu:
+            return self.infrastructure.probe_addr
+
+    def leader_election_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.enable_leader_election
+
+    def leader_election_id(self) -> str:
+        with self._mu:
+            return self.infrastructure.leader_election_id
+
+    def optimization_interval(self) -> float:
+        with self._mu:
+            return self.infrastructure.optimization_interval
+
+    def watch_namespace(self) -> str:
+        with self._mu:
+            return self.infrastructure.watch_namespace
+
+    def logger_verbosity(self) -> int:
+        with self._mu:
+            return self.infrastructure.logger_verbosity
+
+    # --- prometheus getters ---
+
+    def prometheus_base_url(self) -> str:
+        with self._mu:
+            return self._prometheus.base_url
+
+    def prometheus_bearer_token(self) -> str:
+        with self._mu:
+            return self._prometheus.bearer_token
+
+    def prometheus_cache_config(self) -> CacheConfig | None:
+        with self._mu:
+            return copy.deepcopy(self._prometheus.cache)
+
+    def prometheus(self) -> PrometheusConfig:
+        with self._mu:
+            return copy.deepcopy(self._prometheus)
+
+    def set_prometheus(self, p: PrometheusConfig) -> None:
+        with self._mu:
+            self._prometheus = copy.deepcopy(p)
+
+    def update_prometheus_cache_config(self, cache: CacheConfig | None) -> None:
+        with self._mu:
+            self._prometheus.cache = copy.deepcopy(cache)
+
+    # --- EPP getters ---
+
+    def epp_metric_reader_bearer_token(self) -> str:
+        with self._mu:
+            return self._epp.metric_reader_bearer_token
+
+    def set_epp(self, epp: EPPConfig) -> None:
+        with self._mu:
+            self._epp = copy.deepcopy(epp)
+
+    # --- feature flags ---
+
+    def scale_to_zero_enabled(self) -> bool:
+        with self._mu:
+            return self._features.scale_to_zero_enabled
+
+    def limited_mode_enabled(self) -> bool:
+        with self._mu:
+            return self._features.limited_mode_enabled
+
+    def scale_from_zero_max_concurrency(self) -> int:
+        with self._mu:
+            return self._features.scale_from_zero_max_concurrency
+
+    def set_features(self, f: FeatureFlagsConfig) -> None:
+        with self._mu:
+            self._features = copy.deepcopy(f)
+
+    # --- saturation config (namespace-aware; reference config.go:318-354) ---
+
+    def saturation_config(self) -> SaturationConfigPerModel:
+        return self.saturation_config_for_namespace("")
+
+    def saturation_config_for_namespace(self, namespace: str) -> SaturationConfigPerModel:
+        """Resolution: namespace-local > global. Returns a copy."""
+        with self._mu:
+            if namespace:
+                ns_cfg = self._saturation_ns.get(namespace)
+                if ns_cfg:
+                    return copy.deepcopy(ns_cfg)
+            return copy.deepcopy(self._saturation_global)
+
+    def update_saturation_config(self, cfg: SaturationConfigPerModel) -> None:
+        self.update_saturation_config_for_namespace("", cfg)
+
+    def update_saturation_config_for_namespace(
+        self, namespace: str, cfg: SaturationConfigPerModel
+    ) -> None:
+        with self._mu:
+            new = copy.deepcopy(cfg)
+            if not namespace:
+                self._saturation_global = new
+            else:
+                self._saturation_ns[namespace] = new
+
+    # --- scale-to-zero config (namespace-aware) ---
+
+    def scale_to_zero_config(self) -> ScaleToZeroConfigData:
+        return self.scale_to_zero_config_for_namespace("")
+
+    def scale_to_zero_config_for_namespace(self, namespace: str) -> ScaleToZeroConfigData:
+        with self._mu:
+            if namespace:
+                ns_cfg = self._scale_to_zero_ns.get(namespace)
+                if ns_cfg:
+                    return copy.deepcopy(ns_cfg)
+            return copy.deepcopy(self._scale_to_zero_global)
+
+    def update_scale_to_zero_config(self, cfg: ScaleToZeroConfigData) -> None:
+        self.update_scale_to_zero_config_for_namespace("", cfg)
+
+    def update_scale_to_zero_config_for_namespace(
+        self, namespace: str, cfg: ScaleToZeroConfigData
+    ) -> None:
+        with self._mu:
+            new = copy.deepcopy(cfg)
+            if not namespace:
+                self._scale_to_zero_global = new
+            else:
+                self._scale_to_zero_ns[namespace] = new
+
+    def remove_namespace_config(self, namespace: str) -> None:
+        """Drop namespace-local overrides (ConfigMap deleted) so resolution
+        falls back to global (reference config.go:497-520)."""
+        if not namespace:
+            return
+        with self._mu:
+            removed = self._saturation_ns.pop(namespace, None) is not None
+            removed = self._scale_to_zero_ns.pop(namespace, None) is not None or removed
+        if removed:
+            log.info("Removed namespace-local config for %s", namespace)
+
+    # --- bootstrap / readiness state ---
+
+    def mark_configmaps_bootstrap_complete(self) -> None:
+        with self._mu:
+            self._sync.configmaps_bootstrap_complete = True
+            self._sync.last_configmaps_sync_at = time.time()
+            self._sync.last_configmaps_sync_error = ""
+
+    def record_configmaps_sync_error(self, err: str) -> None:
+        with self._mu:
+            self._sync.last_configmaps_sync_error = err
+
+    def configmaps_bootstrap_complete(self) -> bool:
+        with self._mu:
+            return self._sync.configmaps_bootstrap_complete
+
+
+def new_test_config(prometheus_url: str = "http://prometheus.test:9090") -> Config:
+    """Minimal valid Config for tests (reference config.go:541-579): no live
+    Prometheus required, sane defaults everywhere."""
+    cfg = Config()
+    cfg._prometheus.base_url = prometheus_url
+    cfg._prometheus.cache = CacheConfig()
+    return cfg

@@ -1,0 +1,101 @@
+"""Well-known names + ConfigMap value parsing helpers
+(reference ``internal/config/helpers.go:11-97``) and the saturation ConfigMap
+parser (reference ``internal/controller/configmap_helpers.go:33-52``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import yaml
+
+from wva_tpu.config.config import SaturationConfigPerModel
+from wva_tpu.interfaces.saturation_config import SaturationScalingConfig
+from wva_tpu.utils.durations import parse_duration
+
+log = logging.getLogger(__name__)
+
+DEFAULT_CONFIGMAP_NAME = "wva-variantautoscaling-config"
+DEFAULT_SATURATION_CONFIGMAP_NAME = "wva-saturation-scaling-config"
+DEFAULT_NAMESPACE = "workload-variant-autoscaler-system"
+
+
+def config_value(data: dict[str, str], key: str, default: str) -> str:
+    return data.get(key, default)
+
+
+def parse_duration_from_config(data: dict[str, str], key: str, default: float) -> float:
+    s = data.get(key, "")
+    if s:
+        try:
+            return parse_duration(s)
+        except ValueError:
+            log.info("Invalid duration %r for key %s, using default %s", s, key, default)
+    return default
+
+
+def parse_int_from_config(data: dict[str, str], key: str, default: int, min_value: int) -> int:
+    s = data.get(key, "")
+    if s:
+        try:
+            val = int(s)
+            if val >= min_value:
+                return val
+        except ValueError:
+            pass
+        log.info("Invalid int %r for key %s (min %d), using default %d", s, key, min_value, default)
+    return default
+
+
+def parse_bool_from_config(data: dict[str, str], key: str, default: bool) -> bool:
+    s = data.get(key, "")
+    if s:
+        return s in ("true", "1", "yes")
+    return default
+
+
+def system_namespace() -> str:
+    """POD_NAMESPACE env or the default controller namespace."""
+    return os.environ.get("POD_NAMESPACE") or DEFAULT_NAMESPACE
+
+
+def configmap_name() -> str:
+    return os.environ.get("CONFIG_MAP_NAME") or DEFAULT_CONFIGMAP_NAME
+
+
+def saturation_configmap_name() -> str:
+    return os.environ.get("SATURATION_CONFIG_MAP_NAME") or DEFAULT_SATURATION_CONFIGMAP_NAME
+
+
+def parse_saturation_configmap(data: dict[str, str] | None) -> tuple[SaturationConfigPerModel, int]:
+    """Parse saturation scaling entries (key -> YAML doc). Invalid entries are
+    skipped. Returns (configs, parsed_count).
+
+    Unlike the reference (configmap_helpers.go:42-47, which validates before
+    applying V2 defaults and therefore rejects minimal ``analyzerName:
+    saturation`` entries), defaults are applied before validation.
+    """
+    configs: SaturationConfigPerModel = {}
+    count = 0
+    if not data:
+        return configs, count
+    for key in sorted(data):
+        try:
+            raw = yaml.safe_load(data[key]) or {}
+        except yaml.YAMLError as e:
+            log.error("Failed to parse saturation config entry %s: %s", key, e)
+            continue
+        if not isinstance(raw, dict):
+            log.error("Saturation config entry %s is not a mapping", key)
+            continue
+        try:
+            cfg = SaturationScalingConfig.from_dict(raw)
+            cfg.apply_defaults()
+            cfg.validate()
+        except (ValueError, TypeError) as e:
+            log.error("Invalid saturation config entry %s: %s", key, e)
+            continue
+        configs[key] = cfg
+        count += 1
+    return configs, count

@@ -1,0 +1,199 @@
+"""Config loading with precedence flags > env > config file > defaults
+(reference ``internal/config/loader.go:40-219``; viper semantics re-created
+with a small resolver).
+
+Keys are the same env-style names the reference uses (``PROMETHEUS_BASE_URL``,
+``GLOBAL_OPT_INTERVAL``, ...) so deployment manifests transfer unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Mapping
+
+import yaml
+
+from wva_tpu.config.config import (
+    Config,
+    EPPConfig,
+    FeatureFlagsConfig,
+    InfrastructureConfig,
+    PrometheusConfig,
+    TLSConfig,
+)
+from wva_tpu.config.types import CacheConfig, FreshnessThresholds
+from wva_tpu.config.validation import validate
+from wva_tpu.utils.durations import parse_duration, parse_duration_or_default
+
+log = logging.getLogger(__name__)
+
+DEFAULTS: dict[str, Any] = {
+    "METRICS_BIND_ADDRESS": "0",
+    "HEALTH_PROBE_BIND_ADDRESS": ":8081",
+    "LEADER_ELECT": False,
+    "LEADER_ELECTION_ID": "72dd1cf1.wva.tpu.llmd.ai",
+    "LEADER_ELECTION_LEASE_DURATION": "60s",
+    "LEADER_ELECTION_RENEW_DEADLINE": "50s",
+    "LEADER_ELECTION_RETRY_PERIOD": "10s",
+    "REST_CLIENT_TIMEOUT": "60s",
+    "METRICS_SECURE": True,
+    "ENABLE_HTTP2": False,
+    "WATCH_NAMESPACE": "",
+    "V": 0,
+    "WEBHOOK_CERT_PATH": "",
+    "WEBHOOK_CERT_NAME": "tls.crt",
+    "WEBHOOK_CERT_KEY": "tls.key",
+    "METRICS_CERT_PATH": "",
+    "METRICS_CERT_NAME": "tls.crt",
+    "METRICS_CERT_KEY": "tls.key",
+    "WVA_SCALE_TO_ZERO": False,
+    "WVA_LIMITED_MODE": False,
+    "SCALE_FROM_ZERO_ENGINE_MAX_CONCURRENCY": 10,
+    "EPP_METRIC_READER_BEARER_TOKEN": "",
+    "GLOBAL_OPT_INTERVAL": "60s",
+}
+
+
+class _Resolver:
+    """Layered key resolver: flags > env > file > defaults."""
+
+    def __init__(
+        self,
+        flags: Mapping[str, Any] | None,
+        env: Mapping[str, str],
+        file_values: Mapping[str, Any],
+    ) -> None:
+        self.flags = flags or {}
+        self.env = env
+        self.file_values = file_values
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self.flags and self.flags[key] is not None:
+            return self.flags[key]
+        if key in self.env:
+            return self.env[key]
+        if key in self.file_values and self.file_values[key] is not None:
+            return self.file_values[key]
+        return DEFAULTS.get(key, default)
+
+    def get_str(self, key: str) -> str:
+        v = self.get(key)
+        return "" if v is None else str(v)
+
+    def get_bool(self, key: str) -> bool:
+        v = self.get(key)
+        if isinstance(v, bool):
+            return v
+        if isinstance(v, str):
+            return v.strip().lower() in ("true", "1", "yes")
+        return bool(v)
+
+    def get_int(self, key: str) -> int:
+        v = self.get(key)
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return int(DEFAULTS.get(key, 0))
+
+    def get_duration(self, key: str) -> float:
+        v = self.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+        try:
+            return parse_duration(str(v))
+        except ValueError:
+            d = DEFAULTS.get(key, "0s")
+            return parse_duration(str(d)) if isinstance(d, str) else float(d)
+
+
+def load(flags: Mapping[str, Any] | None = None,
+         config_file_path: str = "",
+         env: Mapping[str, str] | None = None) -> Config:
+    """Load + validate the unified configuration (fail-fast).
+
+    ``flags`` is a mapping of env-style keys to explicitly-set flag values
+    (None values are treated as not-set). Raises on unreadable config file or
+    failed validation.
+    """
+    file_values: dict[str, Any] = {}
+    if config_file_path:
+        with open(config_file_path, "r", encoding="utf-8") as f:
+            loaded = yaml.safe_load(f) or {}
+        if not isinstance(loaded, dict):
+            raise ValueError(f"config file {config_file_path} is not a mapping")
+        file_values = loaded
+        log.info("Loaded config from file %s", config_file_path)
+
+    r = _Resolver(flags, env if env is not None else os.environ, file_values)
+
+    cfg = Config()
+    cfg.infrastructure = InfrastructureConfig(
+        metrics_addr=r.get_str("METRICS_BIND_ADDRESS"),
+        probe_addr=r.get_str("HEALTH_PROBE_BIND_ADDRESS"),
+        enable_leader_election=r.get_bool("LEADER_ELECT"),
+        leader_election_id=r.get_str("LEADER_ELECTION_ID"),
+        lease_duration=r.get_duration("LEADER_ELECTION_LEASE_DURATION"),
+        renew_deadline=r.get_duration("LEADER_ELECTION_RENEW_DEADLINE"),
+        retry_period=r.get_duration("LEADER_ELECTION_RETRY_PERIOD"),
+        rest_timeout=r.get_duration("REST_CLIENT_TIMEOUT"),
+        secure_metrics=r.get_bool("METRICS_SECURE"),
+        enable_http2=r.get_bool("ENABLE_HTTP2"),
+        watch_namespace=r.get_str("WATCH_NAMESPACE"),
+        logger_verbosity=r.get_int("V"),
+        optimization_interval=r.get_duration("GLOBAL_OPT_INTERVAL"),
+    )
+    cfg.tls = TLSConfig(
+        webhook_cert_path=r.get_str("WEBHOOK_CERT_PATH"),
+        webhook_cert_name=r.get_str("WEBHOOK_CERT_NAME"),
+        webhook_cert_key=r.get_str("WEBHOOK_CERT_KEY"),
+        metrics_cert_path=r.get_str("METRICS_CERT_PATH"),
+        metrics_cert_name=r.get_str("METRICS_CERT_NAME"),
+        metrics_cert_key=r.get_str("METRICS_CERT_KEY"),
+    )
+    cfg.set_features(FeatureFlagsConfig(
+        scale_to_zero_enabled=r.get_bool("WVA_SCALE_TO_ZERO"),
+        limited_mode_enabled=r.get_bool("WVA_LIMITED_MODE"),
+        scale_from_zero_max_concurrency=r.get_int("SCALE_FROM_ZERO_ENGINE_MAX_CONCURRENCY"),
+    ))
+    cfg.set_epp(EPPConfig(
+        metric_reader_bearer_token=r.get_str("EPP_METRIC_READER_BEARER_TOKEN"),
+    ))
+
+    prom = PrometheusConfig(
+        base_url=r.get_str("PROMETHEUS_BASE_URL"),
+        bearer_token=r.get_str("PROMETHEUS_BEARER_TOKEN"),
+        token_path=r.get_str("PROMETHEUS_TOKEN_PATH"),
+        insecure_skip_verify=r.get_bool("PROMETHEUS_TLS_INSECURE_SKIP_VERIFY"),
+        ca_cert_path=r.get_str("PROMETHEUS_CA_CERT_PATH"),
+        client_cert_path=r.get_str("PROMETHEUS_CLIENT_CERT_PATH"),
+        client_key_path=r.get_str("PROMETHEUS_CLIENT_KEY_PATH"),
+        server_name=r.get_str("PROMETHEUS_SERVER_NAME"),
+        cache=_parse_cache_config(r),
+    )
+    cfg.set_prometheus(prom)
+
+    validate(cfg)
+    log.info("Configuration loaded successfully")
+    return cfg
+
+
+def _parse_cache_config(r: _Resolver) -> CacheConfig:
+    """Prometheus cache config (reference loader.go:176-219)."""
+    d = CacheConfig()
+    cache = CacheConfig(
+        ttl=parse_duration_or_default(r.get_str("PROMETHEUS_METRICS_CACHE_TTL"), d.ttl),
+        cleanup_interval=parse_duration_or_default(
+            r.get_str("PROMETHEUS_METRICS_CACHE_CLEANUP_INTERVAL"), d.cleanup_interval),
+        fetch_interval=parse_duration_or_default(
+            r.get_str("PROMETHEUS_METRICS_CACHE_FETCH_INTERVAL"), d.fetch_interval),
+        freshness=FreshnessThresholds(),
+    )
+    f = cache.freshness
+    f.fresh_threshold = parse_duration_or_default(
+        r.get_str("PROMETHEUS_METRICS_CACHE_FRESH_THRESHOLD"), f.fresh_threshold)
+    f.stale_threshold = parse_duration_or_default(
+        r.get_str("PROMETHEUS_METRICS_CACHE_STALE_THRESHOLD"), f.stale_threshold)
+    f.unavailable_threshold = parse_duration_or_default(
+        r.get_str("PROMETHEUS_METRICS_CACHE_UNAVAILABLE_THRESHOLD"), f.unavailable_threshold)
+    return cache

@@ -1,0 +1,114 @@
+"""Per-model scale-to-zero configuration resolution + ConfigMap parsing
+(reference ``internal/config/scale_to_zero.go:38-225``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import yaml
+
+from wva_tpu.config.types import (
+    DEFAULT_SCALE_TO_ZERO_RETENTION_SECONDS,
+    GLOBAL_DEFAULTS_KEY,
+    ModelScaleToZeroConfig,
+    ScaleToZeroConfigData,
+)
+from wva_tpu.utils.durations import parse_duration
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME = "wva-model-scale-to-zero-config"
+
+
+def is_scale_to_zero_enabled(data: ScaleToZeroConfigData, model_id: str) -> bool:
+    """Priority: per-model setting > ConfigMap global defaults >
+    WVA_SCALE_TO_ZERO env var > false (reference :67-85)."""
+    cfg = data.get(model_id)
+    if cfg is not None and cfg.enable_scale_to_zero is not None:
+        return cfg.enable_scale_to_zero
+    defaults = data.get(GLOBAL_DEFAULTS_KEY)
+    if defaults is not None and defaults.enable_scale_to_zero is not None:
+        return defaults.enable_scale_to_zero
+    return os.environ.get("WVA_SCALE_TO_ZERO", "").lower() == "true"
+
+
+def validate_retention_period(retention_period: str) -> float:
+    """Parse + validate a retention period; raises ValueError (reference :89-112)."""
+    if not retention_period:
+        raise ValueError("retention period cannot be empty")
+    seconds = parse_duration(retention_period)
+    if seconds <= 0:
+        raise ValueError(f"retention period must be positive, got {retention_period}")
+    if seconds > 24 * 3600:
+        log.info(
+            "Retention period is unusually long: %s — consider a shorter period",
+            retention_period,
+        )
+    return seconds
+
+
+def scale_to_zero_retention_seconds(data: ScaleToZeroConfigData, model_id: str) -> float:
+    """Priority: per-model > ConfigMap defaults > 10 min (reference :119-148)."""
+    cfg = data.get(model_id)
+    if cfg is not None and cfg.retention_period:
+        try:
+            return validate_retention_period(cfg.retention_period)
+        except ValueError as e:
+            log.info("Invalid retention period for %s (%s); checking defaults", model_id, e)
+    defaults = data.get(GLOBAL_DEFAULTS_KEY)
+    if defaults is not None and defaults.retention_period:
+        try:
+            return validate_retention_period(defaults.retention_period)
+        except ValueError as e:
+            log.info("Invalid default retention period (%s); using system default", e)
+            return DEFAULT_SCALE_TO_ZERO_RETENTION_SECONDS
+    return DEFAULT_SCALE_TO_ZERO_RETENTION_SECONDS
+
+
+def min_num_replicas(data: ScaleToZeroConfigData, model_id: str) -> int:
+    """0 if scale-to-zero enabled for the model, else 1 (reference :152-157)."""
+    return 0 if is_scale_to_zero_enabled(data, model_id) else 1
+
+
+def parse_scale_to_zero_configmap(data: dict[str, str] | None) -> ScaleToZeroConfigData:
+    """Parse ConfigMap data: key "default" holds global defaults; other keys
+    hold per-model YAML entries that must carry ``model_id``. Keys are
+    processed in sorted order so duplicate model_ids resolve deterministically
+    (first key wins; reference :165-225)."""
+    out: ScaleToZeroConfigData = {}
+    if not data:
+        return out
+    seen_model_keys: dict[str, str] = {}
+    for key in sorted(data):
+        try:
+            raw = yaml.safe_load(data[key]) or {}
+        except yaml.YAMLError as e:
+            log.info("Failed to parse scale-to-zero entry %s, skipping: %s", key, e)
+            continue
+        if not isinstance(raw, dict):
+            log.info("Scale-to-zero entry %s is not a mapping, skipping", key)
+            continue
+        enable = raw.get("enable_scale_to_zero")
+        cfg = ModelScaleToZeroConfig(
+            model_id=str(raw.get("model_id", "") or ""),
+            namespace=str(raw.get("namespace", "") or ""),
+            enable_scale_to_zero=None if enable is None else bool(enable),
+            retention_period=str(raw.get("retention_period", "") or ""),
+        )
+        if key == GLOBAL_DEFAULTS_KEY:
+            out[GLOBAL_DEFAULTS_KEY] = cfg
+            continue
+        if not cfg.model_id:
+            log.info("Skipping scale-to-zero entry %s without model_id", key)
+            continue
+        if cfg.model_id in seen_model_keys:
+            log.info(
+                "Duplicate model_id %s in scale-to-zero ConfigMap — key %s wins, %s skipped",
+                cfg.model_id, seen_model_keys[cfg.model_id], key,
+            )
+            continue
+        seen_model_keys[cfg.model_id] = key
+        out[cfg.model_id] = cfg
+    return out

@@ -1,0 +1,57 @@
+"""Config value types: cache/freshness + scale-to-zero per-model config
+(reference ``internal/config/prometheus.go:26-62``, ``scale_to_zero.go:16-56``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from wva_tpu.interfaces.replica_metrics import FRESH, STALE, UNAVAILABLE
+
+# Default retention after the last request before scaling to zero.
+DEFAULT_SCALE_TO_ZERO_RETENTION_SECONDS = 10 * 60.0
+
+# Key in per-model ConfigMaps used for global defaults.
+GLOBAL_DEFAULTS_KEY = "default"
+
+
+@dataclass
+class FreshnessThresholds:
+    """Age thresholds classifying metric freshness."""
+
+    fresh_threshold: float = 60.0
+    stale_threshold: float = 120.0
+    unavailable_threshold: float = 300.0
+
+    def determine_status(self, age_seconds: float) -> str:
+        if age_seconds < self.fresh_threshold:
+            return FRESH
+        if age_seconds < self.unavailable_threshold:
+            return STALE
+        return UNAVAILABLE
+
+
+@dataclass
+class CacheConfig:
+    """Metrics-cache configuration shared by all collector sources."""
+
+    ttl: float = 30.0
+    cleanup_interval: float = 60.0
+    # 0 disables background fetching.
+    fetch_interval: float = 30.0
+    freshness: FreshnessThresholds = field(default_factory=FreshnessThresholds)
+
+
+@dataclass
+class ModelScaleToZeroConfig:
+    """Scale-to-zero config for one model. ``enable_scale_to_zero`` is
+    tri-state (None = inherit) to support partial overrides."""
+
+    model_id: str = ""
+    namespace: str = ""
+    enable_scale_to_zero: bool | None = None
+    retention_period: str = ""  # Go duration string; "" = inherit
+
+
+# model ID (or GLOBAL_DEFAULTS_KEY) -> config
+ScaleToZeroConfigData = dict[str, ModelScaleToZeroConfig]

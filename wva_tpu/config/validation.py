@@ -1,0 +1,79 @@
+"""Config validation + immutable-parameter change detection
+(reference ``internal/config/validation.go:11-149``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from wva_tpu.config.config import Config
+
+
+class ConfigValidationError(ValueError):
+    pass
+
+
+class ImmutableParameterError(ValueError):
+    def __init__(self, changes: list["ImmutableParameterChange"]) -> None:
+        self.changes = changes
+        detail = "; ".join(
+            f"{c.parameter} (old: {c.old_value!r}, new: {c.new_value!r})" for c in changes
+        )
+        super().__init__(
+            "attempted to change immutable parameters that require controller "
+            f"restart: {detail}. Please restart the controller to apply these changes"
+        )
+
+
+@dataclass
+class ImmutableParameterChange:
+    key: str
+    old_value: str
+    new_value: str
+    parameter: str  # human-readable name
+
+
+def validate(cfg: "Config") -> None:
+    """Fail-fast startup validation (reference validation.go:11-29)."""
+    if not cfg.prometheus_base_url():
+        raise ConfigValidationError("prometheus BaseURL is required")
+    if cfg.optimization_interval() <= 0:
+        raise ConfigValidationError(
+            f"optimization interval must be positive, got {cfg.optimization_interval()}"
+        )
+    if cfg.scale_from_zero_max_concurrency() <= 0:
+        raise ConfigValidationError(
+            "scale-from-zero max concurrency must be positive, "
+            f"got {cfg.scale_from_zero_max_concurrency()}"
+        )
+
+
+def detect_immutable_parameter_changes(
+    cfg: "Config", configmap_data: dict[str, str]
+) -> list[ImmutableParameterChange]:
+    """Detect ConfigMap attempts to change restart-only parameters
+    (reference validation.go:55-149). Raises ImmutableParameterError when any
+    are found; returns [] otherwise."""
+    checks = [
+        ("PROMETHEUS_BASE_URL", cfg.prometheus_base_url(), "Prometheus BaseURL"),
+        ("METRICS_BIND_ADDRESS", cfg.metrics_addr(), "Metrics bind address"),
+        ("HEALTH_PROBE_BIND_ADDRESS", cfg.probe_addr(), "Health probe bind address"),
+        ("LEADER_ELECTION_ID", cfg.leader_election_id(), "Leader election ID"),
+        ("WEBHOOK_CERT_PATH", cfg.tls.webhook_cert_path, "Webhook certificate path"),
+        ("WEBHOOK_CERT_NAME", cfg.tls.webhook_cert_name, "Webhook certificate name"),
+        ("WEBHOOK_CERT_KEY", cfg.tls.webhook_cert_key, "Webhook certificate key"),
+        ("METRICS_CERT_PATH", cfg.tls.metrics_cert_path, "Metrics certificate path"),
+        ("METRICS_CERT_NAME", cfg.tls.metrics_cert_name, "Metrics certificate name"),
+        ("METRICS_CERT_KEY", cfg.tls.metrics_cert_key, "Metrics certificate key"),
+    ]
+    changes = [
+        ImmutableParameterChange(key=key, old_value=current, new_value=configmap_data[key],
+                                 parameter=name)
+        for key, current, name in checks
+        if key in configmap_data and configmap_data[key] != current
+    ]
+    if changes:
+        raise ImmutableParameterError(changes)
+    return []

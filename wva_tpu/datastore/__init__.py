@@ -1,0 +1,113 @@
+"""Thread-safe cache of EndpointPools + namespace tracking
+(reference ``internal/datastore/datastore.go:39-260``).
+
+On ``pool_set`` a per-pool metrics source is created via an injected factory
+(the wiring layer provides the EPP pod-scraping source factory) and registered
+under the pool's name — dependency-inverted so the datastore doesn't import
+the collector.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from wva_tpu.utils.pool import EndpointPool, selector_is_subset
+
+# factory(pool) -> MetricsSource-like object; registry has register/get.
+SourceFactory = Callable[[EndpointPool], object]
+
+
+class PoolNotFoundError(KeyError):
+    pass
+
+
+class Datastore:
+    def __init__(
+        self,
+        source_registry=None,
+        source_factory: SourceFactory | None = None,
+    ) -> None:
+        self._mu = threading.RLock()
+        self._pools: dict[str, EndpointPool] = {}
+        self._registry = source_registry
+        self._source_factory = source_factory
+        # namespace -> resourceType -> set of resource names
+        self._namespaces: dict[str, dict[str, set[str]]] = {}
+
+    # --- pools ---
+
+    def pool_set(self, pool: EndpointPool) -> None:
+        if pool is None:
+            raise ValueError("pool is null")
+        if self._registry is not None and self._source_factory is not None:
+            if self._registry.get(pool.name) is None:
+                self._registry.register(pool.name, self._source_factory(pool))
+        with self._mu:
+            self._pools[pool.name] = pool
+
+    def pool_get(self, name: str) -> EndpointPool:
+        with self._mu:
+            pool = self._pools.get(name)
+        if pool is None:
+            raise PoolNotFoundError(f"pool {name} not found")
+        return pool
+
+    def pool_get_metrics_source(self, name: str):
+        if self._registry is None:
+            return None
+        return self._registry.get(name)
+
+    def pool_list(self) -> list[EndpointPool]:
+        with self._mu:
+            return list(self._pools.values())
+
+    def pool_get_from_labels(self, labels: dict[str, str]) -> EndpointPool:
+        """First pool whose selector is a subset of the given pod-template
+        labels (scale-from-zero target matching; reference :133-152)."""
+        with self._mu:
+            pools = list(self._pools.values())
+        for pool in pools:
+            if pool.selector and selector_is_subset(pool.selector, labels):
+                return pool
+        raise PoolNotFoundError(f"no pool matches labels {labels}")
+
+    def pool_delete(self, name: str) -> None:
+        with self._mu:
+            self._pools.pop(name, None)
+        if self._registry is not None:
+            self._registry.unregister(name)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._pools.clear()
+
+    # --- namespace tracking (feeds the ConfigMap watch filter) ---
+
+    def namespace_track(self, resource_type: str, resource_name: str, namespace: str) -> None:
+        if not namespace:
+            return
+        with self._mu:
+            self._namespaces.setdefault(namespace, {}).setdefault(
+                resource_type, set()).add(resource_name)
+
+    def namespace_untrack(self, resource_type: str, resource_name: str, namespace: str) -> None:
+        with self._mu:
+            ns = self._namespaces.get(namespace)
+            if not ns:
+                return
+            names = ns.get(resource_type)
+            if names:
+                names.discard(resource_name)
+                if not names:
+                    del ns[resource_type]
+            if not ns:
+                del self._namespaces[namespace]
+
+    def is_namespace_tracked(self, namespace: str) -> bool:
+        with self._mu:
+            return namespace in self._namespaces
+
+    def list_tracked_namespaces(self) -> list[str]:
+        with self._mu:
+            return sorted(self._namespaces)

@@ -1,0 +1,94 @@
+"""Field index on VA ``.spec.scaleTargetRef`` for O(1) reverse lookup
+(reference ``internal/indexers/indexers.go:41-111``).
+
+The index key is the composite ``namespace/apiVersion/kind/name`` so different
+resource types and API groups can't collide. The Indexer maintains itself from
+watch events; at most one VA per scale target is enforced on lookup.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference, VariantAutoscaling
+from wva_tpu.k8s.client import ADDED, DELETED, KubeClient
+
+VA_SCALE_TARGET_KEY = ".spec.scaleTargetRef.nsAPIVersionKindName"
+
+
+class MultipleVAsError(RuntimeError):
+    pass
+
+
+def scale_target_index_key(namespace: str, ref: CrossVersionObjectReference) -> str:
+    api_version = ref.api_version or "apps/v1"
+    return f"{namespace}/{api_version}/{ref.kind}/{ref.name}"
+
+
+class Indexer:
+    """Maintains name sets per index key from VA watch events."""
+
+    def __init__(self, client: KubeClient) -> None:
+        self._client = client
+        self._mu = threading.RLock()
+        self._index: dict[str, set[str]] = {}  # index key -> set of VA names
+
+    def setup(self) -> None:
+        """Seed from current VAs and subscribe to watch events
+        (reference SetupIndexes, indexers.go:61)."""
+        for va in self._client.list(VariantAutoscaling.kind):
+            self._on_event(ADDED, va)
+        self._client.watch(VariantAutoscaling.kind, self._on_event)
+
+    def _on_event(self, event: str, va: VariantAutoscaling) -> None:
+        ref = va.spec.scale_target_ref
+        has_target = ref.kind != "" and ref.name != ""
+        key = scale_target_index_key(va.metadata.namespace, ref) if has_target else None
+        with self._mu:
+            # Drop the VA from any entry that no longer matches — covers
+            # retargets, target clears, and deletion alike.
+            ns_prefix = f"{va.metadata.namespace}/"
+            for k, names in list(self._index.items()):
+                if k != key and va.metadata.name in names and k.startswith(ns_prefix):
+                    names.discard(va.metadata.name)
+                    if not names:
+                        del self._index[k]
+            if event == DELETED:
+                if key is not None:
+                    names = self._index.get(key)
+                    if names:
+                        names.discard(va.metadata.name)
+                        if not names:
+                            del self._index[key]
+            elif key is not None:
+                self._index.setdefault(key, set()).add(va.metadata.name)
+
+    def find_va_for_scale_target(
+        self, ref: CrossVersionObjectReference, namespace: str
+    ) -> VariantAutoscaling | None:
+        """The unique VA targeting the resource; None if absent. Raises
+        MultipleVAsError when >1 VA targets the same resource
+        (reference FindVAForScaleTarget :80-100)."""
+        key = scale_target_index_key(namespace, ref)
+        with self._mu:
+            names = sorted(self._index.get(key, ()))
+        if not names:
+            return None
+        if len(names) > 1:
+            raise MultipleVAsError(
+                f"multiple VariantAutoscalings found for {ref.kind} {namespace}/{ref.name}: {names}"
+            )
+        try:
+            return self._client.get(VariantAutoscaling.kind, namespace, names[0])
+        except KeyError:
+            return None
+
+    def find_va_for_deployment(
+        self, deployment_name: str, namespace: str
+    ) -> VariantAutoscaling | None:
+        return self.find_va_for_scale_target(
+            CrossVersionObjectReference(
+                kind="Deployment", name=deployment_name, api_version="apps/v1"
+            ),
+            namespace,
+        )

@@ -117,7 +117,12 @@ class SaturationScalingConfig:
                 cur = getattr(cfg, attr)
                 val = d[yaml_key]
                 if isinstance(cur, bool):
-                    val = bool(val) if not isinstance(val, str) else val.lower() == "true"
+                    # Same truthy strings as config.helpers.parse_bool_from_config
+                    # so both config surfaces agree on "1"/"yes".
+                    if isinstance(val, str):
+                        val = val.strip().lower() in ("true", "1", "yes")
+                    else:
+                        val = bool(val)
                 elif isinstance(cur, float):
                     val = float(val)
                 setattr(cfg, attr, val)

@@ -1,0 +1,269 @@
+"""Kubernetes client abstraction.
+
+``KubeClient`` is the narrow API surface the framework needs (get/list/create/
+update/update-status/delete/scale + watch). Two implementations:
+
+- :class:`FakeCluster` — in-memory, thread-safe object store with watch-event
+  dispatch and a scale subresource; the analogue of controller-runtime's fake
+  client + envtest used throughout the reference's test tiers (SURVEY.md §4),
+  and the substrate of the kind-emulator-equivalent harness in
+  ``wva_tpu.emulator``.
+- a REST client against a real API server can implement the same interface
+  (out-of-cluster use); engines and controllers depend only on this interface.
+"""
+
+from __future__ import annotations
+
+import abc
+import copy
+import logging
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable
+
+log = logging.getLogger(__name__)
+
+from wva_tpu.api.v1alpha1 import VariantAutoscaling
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+# Watch event types.
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+WatchHandler = Callable[[str, Any], None]
+
+
+class NotFoundError(KeyError):
+    def __init__(self, kind: str, namespace: str, name: str) -> None:
+        self.kind, self.namespace, self.name = kind, namespace, name
+        super().__init__(f"{kind} {namespace}/{name} not found")
+
+
+class ConflictError(RuntimeError):
+    pass
+
+
+def _kind_of(obj: Any) -> str:
+    kind = getattr(obj, "KIND", None) or getattr(obj, "kind", None)
+    if not kind:
+        raise TypeError(f"object {obj!r} has no kind")
+    return kind
+
+
+def _labels_match(selector: dict[str, str] | None, labels: dict[str, str]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class KubeClient(abc.ABC):
+    """The API surface engines/controllers/collectors depend on."""
+
+    @abc.abstractmethod
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        """Return a deep copy; raises NotFoundError."""
+
+    @abc.abstractmethod
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None) -> list[Any]:
+        """Deep-copied objects, optionally namespace- and label-filtered."""
+
+    @abc.abstractmethod
+    def create(self, obj: Any) -> Any: ...
+
+    @abc.abstractmethod
+    def update(self, obj: Any) -> Any: ...
+
+    @abc.abstractmethod
+    def update_status(self, obj: Any) -> Any:
+        """Write only the status subresource."""
+
+    @abc.abstractmethod
+    def delete(self, kind: str, namespace: str, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def patch_scale(self, kind: str, namespace: str, name: str, replicas: int) -> None:
+        """Scale-subresource write; works for any registered scalable kind."""
+
+    @abc.abstractmethod
+    def watch(self, kind: str, handler: WatchHandler) -> None:
+        """Register a handler invoked on every ADDED/MODIFIED/DELETED of kind."""
+
+
+@dataclass
+class _Stored:
+    obj: Any
+
+
+class FakeCluster(KubeClient):
+    """In-memory cluster. Objects are deep-copied on the way in and out so
+    callers can't mutate the store (same guarantee an API server gives)."""
+
+    def __init__(self, clock: Clock | None = None) -> None:
+        self._mu = threading.RLock()
+        self._objs: dict[tuple[str, str, str], _Stored] = {}
+        self._watchers: dict[str, list[WatchHandler]] = {}
+        self._rv = 0
+        self.clock = clock or SYSTEM_CLOCK
+
+    # --- internals ---
+
+    def _key(self, kind: str, namespace: str, name: str) -> tuple[str, str, str]:
+        return (kind, namespace or "", name)
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _dispatch(self, event: str, obj: Any) -> None:
+        # Handlers are isolated: one throwing must not break the writer or
+        # starve later handlers (controller-runtime event-handler semantics).
+        for handler in self._watchers.get(_kind_of(obj), []):
+            try:
+                handler(event, _copy(obj))
+            except Exception:  # noqa: BLE001
+                log.exception("watch handler failed for %s event on %s/%s",
+                              event, obj.metadata.namespace, obj.metadata.name)
+
+    # --- KubeClient ---
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._mu:
+            stored = self._objs.get(self._key(kind, namespace, name))
+            if stored is None:
+                raise NotFoundError(kind, namespace or "", name)
+            return _copy(stored.obj)
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Any | None:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None) -> list[Any]:
+        with self._mu:
+            out = []
+            for (k, ns, _), stored in sorted(self._objs.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != (namespace or ""):
+                    continue
+                if not _labels_match(label_selector, stored.obj.metadata.labels):
+                    continue
+                out.append(_copy(stored.obj))
+            return out
+
+    def create(self, obj: Any) -> Any:
+        kind = _kind_of(obj)
+        with self._mu:
+            key = self._key(kind, obj.metadata.namespace, obj.metadata.name)
+            if key in self._objs:
+                raise ConflictError(f"{kind} {key[1]}/{key[2]} already exists")
+            stored = _copy(obj)
+            stored.metadata.uid = stored.metadata.uid or str(uuid.uuid4())
+            stored.metadata.resource_version = self._next_rv()
+            stored.metadata.generation = 1
+            if not stored.metadata.creation_timestamp:
+                stored.metadata.creation_timestamp = self.clock.now()
+            self._objs[key] = _Stored(stored)
+            snapshot = _copy(stored)
+        self._dispatch(ADDED, snapshot)
+        return snapshot
+
+    def update(self, obj: Any) -> Any:
+        kind = _kind_of(obj)
+        with self._mu:
+            key = self._key(kind, obj.metadata.namespace, obj.metadata.name)
+            cur = self._objs.get(key)
+            if cur is None:
+                raise NotFoundError(kind, key[1], key[2])
+            # Optimistic concurrency: a caller presenting a stale
+            # resourceVersion gets Conflict, as a real API server would.
+            # rv "0"/"" means "not read from the store" and skips the check.
+            presented_rv = obj.metadata.resource_version
+            if presented_rv not in ("", "0") and presented_rv != cur.obj.metadata.resource_version:
+                raise ConflictError(
+                    f"{kind} {key[1]}/{key[2]}: resourceVersion {presented_rv} "
+                    f"is stale (current {cur.obj.metadata.resource_version})"
+                )
+            stored = _copy(obj)
+            stored.metadata.uid = cur.obj.metadata.uid
+            stored.metadata.creation_timestamp = cur.obj.metadata.creation_timestamp
+            # Status is a subresource: main-resource updates cannot touch it.
+            if hasattr(stored, "status"):
+                stored.status = _copy(cur.obj.status)
+            stored.metadata.resource_version = self._next_rv()
+            stored.metadata.generation = cur.obj.metadata.generation + 1
+            self._objs[key] = _Stored(stored)
+            snapshot = _copy(stored)
+        self._dispatch(MODIFIED, snapshot)
+        return snapshot
+
+    def update_status(self, obj: Any) -> Any:
+        kind = _kind_of(obj)
+        with self._mu:
+            key = self._key(kind, obj.metadata.namespace, obj.metadata.name)
+            cur = self._objs.get(key)
+            if cur is None:
+                raise NotFoundError(kind, key[1], key[2])
+            cur.obj.status = _copy(obj.status)
+            cur.obj.metadata.resource_version = self._next_rv()
+            snapshot = _copy(cur.obj)
+        self._dispatch(MODIFIED, snapshot)
+        return snapshot
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._mu:
+            key = self._key(kind, namespace, name)
+            stored = self._objs.pop(key, None)
+            if stored is None:
+                raise NotFoundError(kind, namespace or "", name)
+            snapshot = _copy(stored.obj)
+        self._dispatch(DELETED, snapshot)
+
+    def patch_scale(self, kind: str, namespace: str, name: str, replicas: int) -> None:
+        """Works against any stored kind carrying a ``replicas`` field
+        (Deployment now; JobSet/LeaderWorkerSet adapters later) — mirrors the
+        reference DirectActuator's unstructured scale-subresource handling
+        (direct_actuator.go:54-121)."""
+        with self._mu:
+            key = self._key(kind, namespace, name)
+            cur = self._objs.get(key)
+            if cur is None:
+                raise NotFoundError(kind, namespace or "", name)
+            if not hasattr(cur.obj, "replicas"):
+                raise TypeError(f"{kind} has no scale subresource")
+            if cur.obj.replicas == replicas:
+                return
+            cur.obj.replicas = replicas
+            cur.obj.metadata.resource_version = self._next_rv()
+            cur.obj.metadata.generation += 1
+            snapshot = _copy(cur.obj)
+        self._dispatch(MODIFIED, snapshot)
+
+    def watch(self, kind: str, handler: WatchHandler) -> None:
+        with self._mu:
+            self._watchers.setdefault(kind, []).append(handler)
+
+    # --- conveniences for tests/emulator ---
+
+    def apply(self, *objs: Any) -> None:
+        for o in objs:
+            try:
+                self.create(o)
+            except ConflictError:
+                self.update(o)
+
+    def variant_autoscalings(self, namespace: str | None = None) -> list[VariantAutoscaling]:
+        return self.list(VariantAutoscaling.kind, namespace)
+
+
+def _copy(obj: Any) -> Any:
+    return copy.deepcopy(obj)
+
+
+def list_all(client: KubeClient, kinds: Iterable[str]) -> dict[str, list[Any]]:
+    return {k: client.list(k) for k in kinds}

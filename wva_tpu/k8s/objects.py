@@ -1,0 +1,185 @@
+"""Typed models of the Kubernetes kinds the autoscaler touches.
+
+The reference uses client-go's generated types; this framework defines the
+narrow slices it actually consumes. All types share ``ObjectMeta`` from the
+CRD module and serialize to K8s-shaped dicts where needed.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any
+
+from wva_tpu.api.v1alpha1 import ObjectMeta
+
+
+@dataclass
+class ResourceRequirements:
+    """Container resources; values are stringly-typed K8s quantities for
+    extended resources (``google.com/tpu: "8"``)."""
+
+    requests: dict[str, str] = field(default_factory=dict)
+    limits: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: list[str] = field(default_factory=list)
+    args: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    ports: dict[str, int] = field(default_factory=dict)  # name -> containerPort
+
+
+@dataclass
+class PodTemplateSpec:
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    containers: list[Container] = field(default_factory=list)
+    init_containers: list[Container] = field(default_factory=list)
+    node_selector: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class DeploymentStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    updated_replicas: int = 0
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    replicas: int | None = 1  # spec.replicas; None = K8s default (1)
+    selector: dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+    KIND = "Deployment"
+    API_VERSION = "apps/v1"
+
+    def desired_replicas(self) -> int:
+        """spec.replicas with the K8s nil-default of 1
+        (reference utils/variant.go GetDesiredReplicas)."""
+        return 1 if self.replicas is None else self.replicas
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+    ready: bool = False
+    pod_ip: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    node_name: str = ""
+    status: PodStatus = field(default_factory=PodStatus)
+
+    KIND = "Pod"
+    API_VERSION = "v1"
+
+    def is_ready(self) -> bool:
+        return self.status.phase == "Running" and self.status.ready
+
+
+@dataclass
+class NodeStatus:
+    capacity: dict[str, str] = field(default_factory=dict)
+    allocatable: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus = field(default_factory=NodeStatus)
+    ready: bool = True
+
+    KIND = "Node"
+    API_VERSION = "v1"
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, str] = field(default_factory=dict)
+
+    KIND = "ConfigMap"
+    API_VERSION = "v1"
+
+
+@dataclass
+class Secret:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, str] = field(default_factory=dict)  # values pre-decoded
+
+    KIND = "Secret"
+    API_VERSION = "v1"
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: dict[str, str] = field(default_factory=dict)
+    ports: dict[str, int] = field(default_factory=dict)  # name -> port
+
+    KIND = "Service"
+    API_VERSION = "v1"
+
+
+@dataclass
+class Namespace:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    KIND = "Namespace"
+    API_VERSION = "v1"
+
+
+@dataclass
+class ExtensionRef:
+    """InferencePool's endpoint-picker (EPP) service reference."""
+
+    service_name: str = ""
+    port_number: int = 9090
+
+
+@dataclass
+class InferencePool:
+    """Gateway-API inference-extension InferencePool (v1 / v1alpha2 shapes
+    both converge here; reference internal/utils/pool/pool.go:54-100)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: dict[str, str] = field(default_factory=dict)
+    target_port_number: int = 8000
+    extension_ref: ExtensionRef = field(default_factory=ExtensionRef)
+
+    KIND = "InferencePool"
+    API_VERSION = "inference.networking.k8s.io/v1"
+
+
+@dataclass
+class ServiceMonitor:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: dict[str, str] = field(default_factory=dict)
+
+    KIND = "ServiceMonitor"
+    API_VERSION = "monitoring.coreos.com/v1"
+
+
+def deep_copy(obj):
+    return copy.deepcopy(obj)
+
+
+# kind string -> class, for generic client paths
+KINDS: dict[str, Any] = {
+    c.KIND: c
+    for c in (
+        Deployment, Pod, Node, ConfigMap, Secret, Service, Namespace,
+        InferencePool, ServiceMonitor,
+    )
+}

@@ -1,0 +1,53 @@
+"""Shared utilities (reference ``internal/utils``)."""
+
+from wva_tpu.utils.durations import (
+    format_duration,
+    parse_duration,
+    parse_duration_or_default,
+)
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock, FakeClock
+from wva_tpu.utils.backoff import retry_with_backoff
+from wva_tpu.utils.variant import (
+    active_variant_autoscalings,
+    get_accelerator_type,
+    get_controller_instance,
+    get_deployment_with_backoff,
+    get_va_with_backoff,
+    group_variant_autoscalings_by_model,
+    inactive_variant_autoscalings,
+    namespaced_key,
+    ready_variant_autoscalings,
+    update_va_status_with_backoff,
+)
+from wva_tpu.utils.pool import (
+    EndpointPicker,
+    EndpointPool,
+    endpoint_pool_from_inference_pool,
+    get_pool_api_version,
+    selector_is_subset,
+)
+
+__all__ = [
+    "format_duration",
+    "parse_duration",
+    "parse_duration_or_default",
+    "SYSTEM_CLOCK",
+    "Clock",
+    "FakeClock",
+    "retry_with_backoff",
+    "active_variant_autoscalings",
+    "get_accelerator_type",
+    "get_controller_instance",
+    "get_deployment_with_backoff",
+    "get_va_with_backoff",
+    "group_variant_autoscalings_by_model",
+    "inactive_variant_autoscalings",
+    "namespaced_key",
+    "ready_variant_autoscalings",
+    "update_va_status_with_backoff",
+    "EndpointPicker",
+    "EndpointPool",
+    "endpoint_pool_from_inference_pool",
+    "get_pool_api_version",
+    "selector_is_subset",
+]

@@ -1,0 +1,54 @@
+"""Exponential-backoff retry helpers
+(reference ``internal/utils/utils.go:69-123,373-416``: backoff-wrapped K8s
+gets/status-updates and Prometheus queries).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, TypeVar
+
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+# Defaults mirror client-go wait.Backoff conventions used by the reference.
+DEFAULT_STEPS = 4
+DEFAULT_INITIAL_SECONDS = 0.1
+DEFAULT_FACTOR = 2.0
+DEFAULT_CAP_SECONDS = 4.0
+
+
+def retry_with_backoff(
+    fn: Callable[[], T],
+    *,
+    steps: int = DEFAULT_STEPS,
+    initial: float = DEFAULT_INITIAL_SECONDS,
+    factor: float = DEFAULT_FACTOR,
+    cap: float = DEFAULT_CAP_SECONDS,
+    retriable: Callable[[Exception], bool] | None = None,
+    clock: Clock | None = None,
+    description: str = "",
+) -> T:
+    """Call ``fn`` up to ``steps`` times with exponential backoff between
+    attempts. ``retriable`` can stop retries early (e.g. NotFound is final).
+    Re-raises the last exception."""
+    clk = clock or SYSTEM_CLOCK
+    delay = initial
+    last_exc: Exception | None = None
+    for attempt in range(steps):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — retry boundary
+            if retriable is not None and not retriable(e):
+                raise
+            last_exc = e
+            if attempt < steps - 1:
+                log.debug("retry %d/%d for %s after error: %s",
+                          attempt + 1, steps, description or fn, e)
+                clk.sleep(delay)
+                delay = min(delay * factor, cap)
+    assert last_exc is not None
+    raise last_exc

@@ -1,0 +1,76 @@
+"""Go-style duration strings ("30s", "10m", "1h30m", "100ms").
+
+The reference's config surface uses Go ``time.ParseDuration`` strings
+everywhere (ConfigMap values, env vars); this module keeps that exact format
+so deployment configs transfer unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+
+_UNITS = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "µs": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+}
+
+_TOKEN = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+
+
+def parse_duration(s: str) -> float:
+    """Parse a Go duration string into seconds. Raises ValueError on bad input."""
+    if not isinstance(s, str) or not s:
+        raise ValueError(f"invalid duration {s!r}")
+    text = s.strip()
+    sign = 1.0
+    if text.startswith(("-", "+")):
+        sign = -1.0 if text[0] == "-" else 1.0
+        text = text[1:]
+    if text == "0":
+        return 0.0
+    pos = 0
+    total = 0.0
+    for m in _TOKEN.finditer(text):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration {s!r}")
+        total += float(m.group(1)) * _UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(text) or pos == 0:
+        raise ValueError(f"invalid duration {s!r}")
+    return sign * total
+
+
+def parse_duration_or_default(s: str | None, default: float) -> float:
+    """Best-effort parse; returns default on empty/invalid (reference
+    loader.go:200-209)."""
+    if not s:
+        return default
+    try:
+        return parse_duration(s)
+    except ValueError:
+        return default
+
+
+def format_duration(seconds: float) -> str:
+    """Compact Go-style rendering, for logs and status messages."""
+    if seconds == 0:
+        return "0s"
+    sign = "-" if seconds < 0 else ""
+    rem = abs(seconds)
+    parts = []
+    for unit, size in (("h", 3600.0), ("m", 60.0)):
+        if rem >= size:
+            n = int(rem // size)
+            parts.append(f"{n}{unit}")
+            rem -= n * size
+    if rem > 0 or not parts:
+        if rem >= 1 or not parts:
+            parts.append(f"{rem:g}s")
+        else:
+            parts.append(f"{rem * 1000:g}ms")
+    return sign + "".join(parts)

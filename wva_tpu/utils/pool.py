@@ -1,0 +1,62 @@
+"""EndpointPool model of an InferencePool + converters
+(reference ``internal/utils/pool/pool.go:40-100``, ``gvr.go:25``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from wva_tpu.k8s.objects import InferencePool
+
+
+@dataclass
+class EndpointPicker:
+    """EPP service the pool's metrics are scraped from."""
+
+    service_name: str = ""
+    namespace: str = ""
+    metrics_port_number: int = 9090
+
+
+@dataclass
+class EndpointPool:
+    """Internal model of an InferencePool: the label selector that matches the
+    serving pods plus the EPP metrics endpoint."""
+
+    name: str = ""
+    namespace: str = ""
+    selector: dict[str, str] = field(default_factory=dict)
+    target_port_number: int = 8000
+    endpoint_picker: EndpointPicker = field(default_factory=EndpointPicker)
+
+
+def endpoint_pool_from_inference_pool(pool: InferencePool) -> EndpointPool:
+    """Convert either InferencePool API version (the typed model collapses
+    v1 / v1alpha2 differences; reference pool.go:54-100)."""
+    return EndpointPool(
+        name=pool.metadata.name,
+        namespace=pool.metadata.namespace,
+        selector=dict(pool.selector),
+        target_port_number=pool.target_port_number,
+        endpoint_picker=EndpointPicker(
+            service_name=pool.extension_ref.service_name,
+            namespace=pool.metadata.namespace,
+            metrics_port_number=pool.extension_ref.port_number,
+        ),
+    )
+
+
+def get_pool_api_version() -> str:
+    """POOL_GROUP env selects the InferencePool API group/version to watch
+    (reference cmd/main.go:444-449, gvr.go)."""
+    group = os.environ.get("POOL_GROUP", "inference.networking.k8s.io")
+    if group == "inference.networking.x-k8s.io":
+        return f"{group}/v1alpha2"
+    return f"{group}/v1"
+
+
+def selector_is_subset(selector: dict[str, str], labels: dict[str, str]) -> bool:
+    """True iff every selector entry matches labels (used by
+    PoolGetFromLabels; reference datastore.go:133-152)."""
+    return all(labels.get(k) == v for k, v in selector.items())

@@ -1,0 +1,111 @@
+"""VariantAutoscaling list filters + helpers
+(reference ``internal/utils/variant.go:38-216``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from wva_tpu.api.v1alpha1 import VariantAutoscaling
+from wva_tpu.constants import ACCELERATOR_NAME_LABEL_KEY, CONTROLLER_INSTANCE_LABEL_KEY
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Deployment
+from wva_tpu.utils.backoff import retry_with_backoff
+
+log = logging.getLogger(__name__)
+
+
+def get_controller_instance() -> str:
+    """Multi-controller isolation id (reference internal/metrics controller
+    instance; configured via CONTROLLER_INSTANCE env)."""
+    return os.environ.get("CONTROLLER_INSTANCE", "")
+
+
+def get_deployment_with_backoff(client: KubeClient, name: str, namespace: str) -> Deployment:
+    return retry_with_backoff(
+        lambda: client.get(Deployment.KIND, namespace, name),
+        retriable=lambda e: not isinstance(e, NotFoundError),
+        description=f"get deployment {namespace}/{name}",
+    )
+
+
+def get_va_with_backoff(client: KubeClient, name: str, namespace: str) -> VariantAutoscaling:
+    return retry_with_backoff(
+        lambda: client.get("VariantAutoscaling", namespace, name),
+        retriable=lambda e: not isinstance(e, NotFoundError),
+        description=f"get VA {namespace}/{name}",
+    )
+
+
+def update_va_status_with_backoff(client: KubeClient, va: VariantAutoscaling) -> VariantAutoscaling:
+    return retry_with_backoff(
+        lambda: client.update_status(va),
+        retriable=lambda e: not isinstance(e, NotFoundError),
+        description=f"update VA status {va.metadata.namespace}/{va.metadata.name}",
+    )
+
+
+def ready_variant_autoscalings(client: KubeClient) -> list[VariantAutoscaling]:
+    """All non-deleted VAs, filtered to this controller instance when
+    CONTROLLER_INSTANCE is set (reference variant.go:157-196)."""
+    selector = None
+    instance = get_controller_instance()
+    if instance:
+        selector = {CONTROLLER_INSTANCE_LABEL_KEY: instance}
+    vas = client.list("VariantAutoscaling", label_selector=selector)
+    return [va for va in vas if va.metadata.deletion_timestamp is None]
+
+
+def _filter_by_deployment(client: KubeClient, want_active: bool) -> list[VariantAutoscaling]:
+    out = []
+    for va in ready_variant_autoscalings(client):
+        if not va.spec.scale_target_ref.name:
+            log.debug("Skipping VA %s/%s without scaleTargetRef",
+                      va.metadata.namespace, va.metadata.name)
+            continue
+        try:
+            deploy = get_deployment_with_backoff(
+                client, va.spec.scale_target_ref.name, va.metadata.namespace)
+        except NotFoundError:
+            log.debug("Deployment %s for VA %s/%s not found",
+                      va.spec.scale_target_ref.name, va.metadata.namespace,
+                      va.metadata.name)
+            continue
+        if deploy.metadata.deletion_timestamp is not None:
+            continue
+        active = deploy.desired_replicas() > 0
+        if active == want_active:
+            out.append(va)
+    return out
+
+
+def active_variant_autoscalings(client: KubeClient) -> list[VariantAutoscaling]:
+    """VAs whose target has >= 1 desired replica."""
+    return _filter_by_deployment(client, want_active=True)
+
+
+def inactive_variant_autoscalings(client: KubeClient) -> list[VariantAutoscaling]:
+    """VAs whose target is scaled to zero."""
+    return _filter_by_deployment(client, want_active=False)
+
+
+def group_variant_autoscalings_by_model(
+    vas: list[VariantAutoscaling],
+) -> dict[str, list[VariantAutoscaling]]:
+    """Group variants by "modelID|namespace" so cost-based optimization sees
+    all of a model's variants together (reference variant.go:64-79)."""
+    groups: dict[str, list[VariantAutoscaling]] = {}
+    for va in vas:
+        key = f"{va.spec.model_id}|{va.metadata.namespace}"
+        groups.setdefault(key, []).append(va)
+    return groups
+
+
+def get_accelerator_type(va: VariantAutoscaling) -> str:
+    """TPU slice variant from the VA's accelerator label, "" if unset."""
+    return va.metadata.labels.get(ACCELERATOR_NAME_LABEL_KEY, "")
+
+
+def namespaced_key(namespace: str, name: str) -> str:
+    return f"{namespace}/{name}"
