@@ -1,0 +1,226 @@
+"""Collector-layer tests: sources, cache, registration, replica metrics
+(model: prometheus_source_test.go, pod_scraping_source_test.go,
+replica_metrics tests)."""
+
+import pytest
+
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+from wva_tpu.collector.registration import (
+    QUERY_KV_CACHE_USAGE,
+    collect_model_request_count,
+    register_saturation_queries,
+    register_scale_to_zero_queries,
+)
+from wva_tpu.collector.registration.scale_to_zero import RequestCountUnavailableError
+from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
+from wva_tpu.collector.source import (
+    InMemoryPromAPI,
+    PodScrapingSource,
+    PodVAMapper,
+    PrometheusSource,
+    RefreshSpec,
+    SourceRegistry,
+    TimeSeriesDB,
+    parse_prometheus_text,
+)
+from wva_tpu.config.types import CacheConfig
+from wva_tpu.indexers import Indexer
+from wva_tpu.k8s import Deployment, FakeCluster, Pod, PodStatus, Service
+from wva_tpu.utils import FakeClock
+
+NS = "inf"
+MODEL = "meta-llama/Llama-3.1-8B"
+
+
+def build_world(engine="vllm"):
+    """FakeCluster + TSDB + registered prometheus source + one VA/deployment
+    with two serving pods emitting either vllm or jetstream metrics."""
+    clock = FakeClock(start=10_000.0)
+    cluster = FakeCluster(clock=clock)
+    tsdb = TimeSeriesDB(clock=clock)
+
+    registry = SourceRegistry()
+    prom = PrometheusSource(InMemoryPromAPI(tsdb), CacheConfig(ttl=30.0), clock=clock)
+    registry.register("prometheus", prom)
+    register_saturation_queries(registry)
+    register_scale_to_zero_queries(registry)
+
+    cluster.create(Deployment(
+        metadata=ObjectMeta(name="llama-v5e", namespace=NS), replicas=2))
+    va = VariantAutoscaling(
+        metadata=ObjectMeta(name="llama-v5e", namespace=NS,
+                            labels={"inference.optimization/acceleratorName": "v5e-8"}),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name="llama-v5e"),
+            model_id=MODEL, variant_cost="40.0"))
+    indexer = Indexer(cluster)
+    indexer.setup()
+    cluster.create(va)
+
+    for i in range(2):
+        cluster.create(Pod(
+            metadata=ObjectMeta(
+                name=f"llama-v5e-{i}", namespace=NS,
+                owner_references=[{"kind": "Deployment", "name": "llama-v5e"}]),
+            status=PodStatus(phase="Running", ready=True, pod_ip=f"10.0.0.{i}")))
+
+    base = {"namespace": NS, "model_name": MODEL}
+    if engine == "vllm":
+        for i, (kv, q) in enumerate([(0.5, 2), (0.9, 8)]):
+            pod = {"pod": f"llama-v5e-{i}", **base}
+            tsdb.add_sample("vllm:kv_cache_usage_perc", pod, kv)
+            tsdb.add_sample("vllm:num_requests_waiting", pod, q)
+            tsdb.add_sample("vllm:cache_config_info",
+                            {**pod, "num_gpu_blocks": "4096", "block_size": "32"}, 1.0)
+    else:
+        for i, (kv, q) in enumerate([(0.5, 2), (0.9, 8)]):
+            pod = {"pod": f"llama-v5e-{i}", **base}
+            tsdb.add_sample("jetstream_kv_cache_utilization", pod, kv)
+            tsdb.add_sample("jetstream_prefill_backlog_size", pod, q)
+            tsdb.add_sample("jetstream_generate_backlog_size", pod, q // 2)
+            tsdb.add_sample("jetstream_slots_used", pod, 40 + i)
+            tsdb.add_sample("jetstream_slots_available", pod, 56 - i)
+            tsdb.add_sample("jetstream_serving_config_info",
+                            {**pod, "max_concurrent_decodes": "96",
+                             "tokens_per_slot": "1365"}, 1.0)
+
+    mapper = PodVAMapper(cluster, indexer)
+    collector = ReplicaMetricsCollector(prom, mapper, clock=clock)
+    return cluster, tsdb, prom, collector, clock
+
+
+def _collect(collector):
+    deployments = {f"{NS}/llama-v5e": None}
+    vas = {}
+    costs = {f"{NS}/llama-v5e": 40.0}
+    # fetch actual objects for labels
+    return collector, deployments, vas, costs
+
+
+def test_collect_replica_metrics_vllm():
+    cluster, tsdb, prom, collector, clock = build_world("vllm")
+    va = cluster.get("VariantAutoscaling", NS, "llama-v5e")
+    metrics = collector.collect_replica_metrics(
+        MODEL, NS,
+        deployments={f"{NS}/llama-v5e": cluster.get("Deployment", NS, "llama-v5e")},
+        variant_autoscalings={f"{NS}/llama-v5e": va},
+        variant_costs={f"{NS}/llama-v5e": 40.0})
+    assert len(metrics) == 2
+    by_pod = {m.pod_name: m for m in metrics}
+    m0 = by_pod["llama-v5e-0"]
+    assert m0.kv_cache_usage == 0.5
+    assert m0.queue_length == 2
+    assert m0.variant_name == "llama-v5e"
+    assert m0.accelerator_name == "v5e-8"
+    assert m0.cost == 40.0
+    assert m0.total_kv_capacity_tokens == 4096 * 32
+    assert m0.tokens_in_use == int(0.5 * 4096 * 32)
+
+
+def test_collect_replica_metrics_jetstream():
+    cluster, tsdb, prom, collector, clock = build_world("jetstream")
+    va = cluster.get("VariantAutoscaling", NS, "llama-v5e")
+    metrics = collector.collect_replica_metrics(
+        MODEL, NS,
+        deployments={f"{NS}/llama-v5e": cluster.get("Deployment", NS, "llama-v5e")},
+        variant_autoscalings={f"{NS}/llama-v5e": va},
+        variant_costs={f"{NS}/llama-v5e": 40.0})
+    assert len(metrics) == 2
+    m1 = {m.pod_name: m for m in metrics}["llama-v5e-1"]
+    assert m1.kv_cache_usage == 0.9
+    assert m1.queue_length == 8
+    assert m1.generate_backlog == 4
+    assert m1.slots_total == 96  # 41 used + 55 available
+    assert m1.total_kv_capacity_tokens == 96 * 1365
+
+
+def test_scheduler_queue_metrics():
+    cluster, tsdb, prom, collector, clock = build_world("vllm")
+    assert collector.collect_scheduler_queue_metrics(MODEL) is None  # no data
+    tsdb.add_sample("inference_extension_flow_control_queue_size",
+                    {"target_model_name": MODEL}, 12)
+    tsdb.add_sample("inference_extension_flow_control_queue_bytes",
+                    {"target_model_name": MODEL}, 48_000)
+    sq = collector.collect_scheduler_queue_metrics(MODEL)
+    assert sq.queue_size == 12 and sq.queue_bytes == 48_000
+
+
+def test_request_count_fail_safe():
+    cluster, tsdb, prom, collector, clock = build_world("vllm")
+    # No success counter data -> must raise (never treat as zero).
+    with pytest.raises(RequestCountUnavailableError):
+        collect_model_request_count(prom, MODEL, NS, 600)
+    # With data: increase over window.
+    for i in range(11):
+        tsdb.add_sample("vllm:request_success_total",
+                        {"namespace": NS, "model_name": MODEL, "pod": "p0"},
+                        i * 10, timestamp=10_000.0 + i * 30)
+    clock.set(10_000.0 + 300)
+    count = collect_model_request_count(prom, MODEL, NS, 600)
+    assert count == pytest.approx(100.0, rel=0.2)
+
+
+def test_prometheus_source_cache():
+    cluster, tsdb, prom, collector, clock = build_world("vllm")
+    params = {"namespace": NS, "modelID": MODEL}
+    prom.refresh(RefreshSpec(queries=[QUERY_KV_CACHE_USAGE], params=params))
+    cached = prom.get(QUERY_KV_CACHE_USAGE, params)
+    assert cached is not None and len(cached.result.values) == 2
+    clock.advance(31.0)  # past TTL
+    assert prom.get(QUERY_KV_CACHE_USAGE, params) is None
+
+
+# --- pod scraping ---
+
+EXPO_TEXT = """
+# HELP inference_extension_flow_control_queue_size requests queued
+# TYPE inference_extension_flow_control_queue_size gauge
+inference_extension_flow_control_queue_size{target_model_name="m1"} 5
+inference_extension_flow_control_queue_size{target_model_name="m2"} 0
+some_malformed_line{{{
+jetstream_prefill_backlog_size 2
+"""
+
+
+def test_parse_prometheus_text():
+    samples = parse_prometheus_text(EXPO_TEXT)
+    assert ("inference_extension_flow_control_queue_size",
+            {"target_model_name": "m1"}, 5.0) in samples
+    assert ("jetstream_prefill_backlog_size", {}, 2.0) in samples
+    assert len(samples) == 3  # malformed line skipped
+
+
+def test_pod_scraping_source():
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.create(Service(metadata=ObjectMeta(name="epp", namespace=NS),
+                           selector={"app": "epp"}))
+    for i, ready in [(0, True), (1, True), (2, False)]:
+        cluster.create(Pod(
+            metadata=ObjectMeta(name=f"epp-{i}", namespace=NS, labels={"app": "epp"}),
+            status=PodStatus(phase="Running", ready=ready, pod_ip=f"10.1.0.{i}")))
+
+    def fetcher(pod):
+        if pod.metadata.name == "epp-1":
+            raise RuntimeError("connection refused")
+        return 'inference_extension_flow_control_queue_size{target_model_name="m1"} 3\n'
+
+    src = PodScrapingSource(cluster, "epp", NS, fetcher, clock=clock)
+    results = src.refresh(RefreshSpec())
+    result = results["all_metrics"]
+    # ready pod epp-0 scraped; epp-1 failed (isolated); epp-2 not ready
+    assert len(result.values) == 1
+    v = result.values[0]
+    assert v.labels["pod"] == "epp-0"
+    assert v.labels["__name__"] == "inference_extension_flow_control_queue_size"
+    assert v.value == 3.0
+    # cached
+    assert src.get("all_metrics", {}) is not None
+
+
+def test_pod_scraping_no_service():
+    clock = FakeClock()
+    cluster = FakeCluster(clock=clock)
+    src = PodScrapingSource(cluster, "missing", NS, lambda p: "", clock=clock)
+    assert src.refresh(RefreshSpec())["all_metrics"].values == []

@@ -167,8 +167,8 @@ def test_parse_saturation_configmap():
         "v2-model": "analyzerName: saturation\n",  # minimal V2 entry: defaults applied
         "invalid": "kvCacheThreshold: 3.0\n",
     }
-    configs, count = parse_saturation_configmap(data)
-    assert count == 2
+    configs = parse_saturation_configmap(data)
+    assert len(configs) == 2
     assert configs["default"].kv_cache_threshold == 0.8
     assert configs["v2-model"].scale_up_threshold == 0.85  # default applied pre-validate
     assert "invalid" not in configs

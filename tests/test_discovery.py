@@ -1,0 +1,145 @@
+"""TPU slice discovery tests (model: k8s_with_gpu_operator_test.go, adapted
+to GKE TPU node-pool label schema)."""
+
+import pytest
+
+from wva_tpu.api import ObjectMeta
+from wva_tpu.discovery import (
+    TPUSliceDiscovery,
+    parse_tpu_topology,
+    variant_name_for,
+)
+from wva_tpu.k8s import (
+    Container,
+    FakeCluster,
+    Node,
+    NodeStatus,
+    Pod,
+    PodStatus,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+
+TPU_ACCEL = "cloud.google.com/gke-tpu-accelerator"
+TPU_TOPO = "cloud.google.com/gke-tpu-topology"
+NODEPOOL = "cloud.google.com/gke-nodepool"
+
+
+def tpu_node(name, accel="tpu-v5-lite-podslice", topo="2x4", pool="pool-a",
+             chips=8, ready=True):
+    return Node(
+        metadata=ObjectMeta(name=name, labels={
+            TPU_ACCEL: accel, TPU_TOPO: topo, NODEPOOL: pool}),
+        status=NodeStatus(allocatable={"google.com/tpu": str(chips)}),
+        ready=ready,
+    )
+
+
+def tpu_pod(name, node, chips=8, phase="Running"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="inf"),
+        spec=PodTemplateSpec(containers=[Container(
+            name="srv",
+            resources=ResourceRequirements(requests={"google.com/tpu": str(chips)}))]),
+        node_name=node,
+        status=PodStatus(phase=phase, ready=True),
+    )
+
+
+@pytest.mark.parametrize("accel,topo,variant,chips,hosts", [
+    ("tpu-v5-lite-podslice", "2x4", "v5e-8", 8, 1),
+    ("tpu-v5-lite-podslice", "4x4", "v5e-16", 16, 2),
+    ("tpu-v5-lite-podslice", "4x8", "v5e-32", 32, 4),
+    ("tpu-v5p-slice", "2x2x1", "v5p-4", 4, 1),
+    ("tpu-v5p-slice", "2x2x2", "v5p-8", 8, 2),
+    ("tpu-v4-podslice", "2x2x4", "v4-16", 16, 4),
+    ("tpu-v6e-slice", "2x4", "v6e-8", 8, 1),
+])
+def test_topology_parsing(accel, topo, variant, chips, hosts):
+    info = parse_tpu_topology(accel, topo)
+    assert info.variant == variant
+    assert info.chips == chips
+    assert info.hosts == hosts
+    assert variant_name_for(accel, topo) == variant
+
+
+def test_topology_parsing_unknown():
+    assert parse_tpu_topology("nvidia.com/gpu", "2x4") is None
+    assert parse_tpu_topology("tpu-v5-lite-podslice", "bogus") is None
+
+
+def test_discover_per_node_inventory():
+    c = FakeCluster()
+    c.create(tpu_node("n0"))
+    c.create(tpu_node("n1", accel="tpu-v5p-slice", topo="2x2x1", pool="pool-b", chips=4))
+    c.create(Node(metadata=ObjectMeta(name="cpu-node")))  # no TPU labels
+    d = TPUSliceDiscovery(c)
+    inv = d.discover()
+    assert set(inv) == {"n0", "n1"}
+    assert inv["n0"]["v5e-8"].count == 8
+    assert inv["n0"]["v5e-8"].memory == "16Gi"
+    assert inv["n1"]["v5p-4"].memory == "95Gi"
+
+
+def test_discover_slices_multi_host_atomicity():
+    c = FakeCluster()
+    # pool-a: 3 single-host v5e-8 slices
+    for i in range(3):
+        c.create(tpu_node(f"a{i}", pool="pool-a"))
+    # pool-b: v5e-16 (2 hosts/slice) with 5 hosts -> only 2 whole slices
+    for i in range(5):
+        c.create(tpu_node(f"b{i}", topo="4x4", pool="pool-b"))
+    d = TPUSliceDiscovery(c)
+    slices = d.discover_slices()
+    assert slices["v5e-8"].total_slices == 3
+    assert slices["v5e-8"].chips_per_slice == 8
+    assert slices["v5e-16"].total_slices == 2  # floor(5/2): partial slice unusable
+    assert slices["v5e-16"].hosts_per_slice == 2
+    assert slices["v5e-16"].total_chips == 40
+
+
+def test_discover_usage_and_slice_usage():
+    c = FakeCluster()
+    c.create(tpu_node("n0", pool="pool-a"))
+    c.create(tpu_node("n1", pool="pool-a"))
+    c.create(tpu_pod("p0", "n0", chips=8))
+    c.create(tpu_pod("p1", "n1", chips=4))
+    c.create(tpu_pod("done", "n1", chips=8, phase="Succeeded"))  # ignored
+    c.create(tpu_pod("unscheduled", "", chips=8))  # ignored
+    d = TPUSliceDiscovery(c)
+    assert d.discover_usage() == {"v5e-8": 12}
+    assert d.discover_slice_usage() == {"v5e-8": 2}  # ceil(12/8)
+
+
+def test_node_selector_sharding(monkeypatch):
+    c = FakeCluster()
+    n = tpu_node("n0")
+    n.metadata.labels["shard"] = "blue"
+    c.create(n)
+    c.create(tpu_node("n1"))
+    d = TPUSliceDiscovery(c)
+    monkeypatch.setenv("WVA_NODE_SELECTOR", "shard=blue")
+    assert set(d.discover()) == {"n0"}
+    monkeypatch.delenv("WVA_NODE_SELECTOR")
+    assert set(d.discover()) == {"n0", "n1"}
+
+
+def test_not_ready_nodes_excluded():
+    c = FakeCluster()
+    c.create(tpu_node("n0", ready=False))
+    d = TPUSliceDiscovery(c)
+    assert d.discover() == {}
+
+
+def test_discover_slices_four_chip_hosts():
+    # Real GKE multi-host v5e pools use 4-chip hosts (ct5lp-hightpu-4t):
+    # a 4x4 slice is 16 chips over 4 hosts, not 2. hosts-per-slice must come
+    # from node allocatable, not the per-generation default.
+    c = FakeCluster()
+    for i in range(4):
+        c.create(tpu_node(f"m{i}", topo="4x4", pool="pool-mh", chips=4))
+    d = TPUSliceDiscovery(c)
+    slices = d.discover_slices()
+    assert slices["v5e-16"].hosts_per_slice == 4
+    assert slices["v5e-16"].total_slices == 1
+    assert slices["v5e-16"].total_chips == 16

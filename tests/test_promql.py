@@ -1,0 +1,146 @@
+"""TSDB-lite + PromQL-subset evaluator tests."""
+
+import pytest
+
+from wva_tpu.collector.source.promql import (
+    PromQLEngine,
+    PromQLError,
+    TimeSeriesDB,
+    format_promql_duration,
+    parse_promql_duration,
+)
+from wva_tpu.utils import FakeClock
+
+
+@pytest.fixture()
+def db():
+    clock = FakeClock(start=1000.0)
+    return TimeSeriesDB(clock=clock), clock
+
+
+def test_instant_vector_with_matchers(db):
+    tsdb, clock = db
+    tsdb.add_sample("vllm:kv_cache_usage_perc",
+                    {"pod": "p0", "namespace": "inf", "model_name": "m"}, 0.5)
+    tsdb.add_sample("vllm:kv_cache_usage_perc",
+                    {"pod": "p1", "namespace": "other", "model_name": "m"}, 0.9)
+    engine = PromQLEngine(tsdb)
+    pts = engine.query('vllm:kv_cache_usage_perc{namespace="inf",model_name="m"}')
+    assert len(pts) == 1 and pts[0].value == 0.5 and pts[0].labels["pod"] == "p0"
+
+
+def test_max_over_time_catches_peaks(db):
+    tsdb, clock = db
+    for t, v in [(0, 0.2), (20, 0.95), (40, 0.3)]:
+        tsdb.add_sample("m", {"pod": "p0"}, v, timestamp=1000.0 + t)
+    clock.set(1050.0)
+    engine = PromQLEngine(tsdb)
+    pts = engine.query("max by (pod) (max_over_time(m[1m]))")
+    assert pts[0].value == 0.95
+
+
+def test_aggregation_by_groups(db):
+    tsdb, clock = db
+    tsdb.add_sample("q", {"pod": "a", "ns": "1"}, 3)
+    tsdb.add_sample("q", {"pod": "b", "ns": "1"}, 5)
+    engine = PromQLEngine(tsdb)
+    total = engine.query("sum(q)")
+    assert len(total) == 1 and total[0].value == 8
+    per_pod = engine.query("max by (pod) (q)")
+    assert {p.labels["pod"]: p.value for p in per_pod} == {"a": 3, "b": 5}
+
+
+def test_aggregation_over_empty_vector_is_empty(db):
+    tsdb, _ = db
+    engine = PromQLEngine(tsdb)
+    # Critical for scale-to-zero safety: no data != zero.
+    assert engine.query('sum(increase(missing_metric{x="y"}[10m]))') == []
+
+
+def test_rate_and_division(db):
+    tsdb, clock = db
+    # counter: 10 tokens/s for 100s; count: 1 req/10s
+    for i in range(11):
+        t = 1000.0 + i * 10
+        tsdb.add_sample("tok_sum", {"pod": "p"}, i * 100, timestamp=t)
+        tsdb.add_sample("tok_cnt", {"pod": "p"}, i, timestamp=t)
+    clock.set(1100.0)
+    engine = PromQLEngine(tsdb)
+    pts = engine.query("max by (pod) (rate(tok_sum[5m]) / rate(tok_cnt[5m]))")
+    assert pts[0].value == pytest.approx(100.0)  # avg tokens per request
+
+
+def test_counter_reset_handling(db):
+    tsdb, clock = db
+    samples = [(0, 100), (10, 200), (20, 50), (30, 150)]  # reset at t=20
+    for t, v in samples:
+        tsdb.add_sample("c", {}, v, timestamp=1000.0 + t)
+    clock.set(1030.0)
+    engine = PromQLEngine(tsdb)
+    pts = engine.query("sum(increase(c[30s]))")
+    # increases: 100 + (reset: 50) + 100 = 250
+    assert pts[0].value == pytest.approx(250.0)
+
+
+def test_or_fallback_semantics(db):
+    tsdb, clock = db
+    tsdb.add_sample("vllm:num_requests_waiting", {"pod": "gpu0"}, 7)
+    tsdb.add_sample("jetstream_prefill_backlog_size", {"pod": "tpu0"}, 3)
+    engine = PromQLEngine(tsdb)
+    pts = engine.query(
+        "max by (pod) (max_over_time(vllm:num_requests_waiting[1m])"
+        " or max_over_time(jetstream_prefill_backlog_size[1m]))")
+    assert {p.labels["pod"]: p.value for p in pts} == {"gpu0": 7.0, "tpu0": 3.0}
+
+
+def test_or_prefers_left_on_same_series(db):
+    tsdb, clock = db
+    tsdb.add_sample("a", {"pod": "p"}, 1)
+    tsdb.add_sample("b", {"pod": "p"}, 2)
+    engine = PromQLEngine(tsdb)
+    pts = engine.query("a or b")
+    assert len(pts) == 1 and pts[0].value == 1
+
+
+def test_info_gauge_labels_flow_through(db):
+    tsdb, clock = db
+    tsdb.add_sample("vllm:cache_config_info",
+                    {"pod": "p0", "num_gpu_blocks": "4096", "block_size": "32",
+                     "namespace": "inf", "model_name": "m"}, 1.0)
+    engine = PromQLEngine(tsdb)
+    pts = engine.query(
+        "max by (pod, num_gpu_blocks, block_size) "
+        '(vllm:cache_config_info{namespace="inf",model_name="m"})')
+    assert pts[0].labels == {"pod": "p0", "num_gpu_blocks": "4096", "block_size": "32"}
+
+
+def test_lookback_excludes_stale_series(db):
+    tsdb, clock = db
+    tsdb.add_sample("g", {"pod": "old"}, 1.0, timestamp=1000.0)
+    clock.set(1000.0 + 600)  # 10 min later: beyond 5m lookback
+    engine = PromQLEngine(tsdb)
+    assert engine.query("g") == []
+
+
+def test_division_by_zero_drops_series(db):
+    tsdb, clock = db
+    tsdb.add_sample("num", {"pod": "p"}, 5)
+    tsdb.add_sample("den", {"pod": "p"}, 0)
+    engine = PromQLEngine(tsdb)
+    assert engine.query("num / den") == []
+
+
+def test_parse_errors():
+    tsdb = TimeSeriesDB(clock=FakeClock())
+    engine = PromQLEngine(tsdb)
+    for bad in ["sum(", "max_over_time(m)", 'm{pod=}', "m{pod='x'}", "foo bar"]:
+        with pytest.raises(PromQLError):
+            engine.query(bad)
+
+
+def test_promql_durations():
+    assert parse_promql_duration("1m") == 60.0
+    assert parse_promql_duration("90s") == 90.0
+    assert format_promql_duration(600) == "10m"
+    assert format_promql_duration(3600) == "1h"
+    assert format_promql_duration(90) == "90s"

@@ -116,6 +116,11 @@ class _FakeRegistry:
     def register(self, name, src):
         self.sources[name] = src
 
+    def register_if_absent(self, name, factory):
+        if name not in self.sources:
+            self.sources[name] = factory()
+        return self.sources[name]
+
     def get(self, name):
         return self.sources.get(name)
 

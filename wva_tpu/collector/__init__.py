@@ -1,0 +1,1 @@
+"""Data-acquisition layer (reference ``internal/collector``)."""

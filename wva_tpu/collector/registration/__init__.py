@@ -1,0 +1,43 @@
+"""Query registration for the saturation + scale-to-zero pipelines."""
+
+from wva_tpu.collector.registration.saturation import (
+    QUERY_AVG_INPUT_TOKENS,
+    QUERY_AVG_OUTPUT_TOKENS,
+    QUERY_CACHE_CONFIG_INFO,
+    QUERY_GENERATE_BACKLOG,
+    QUERY_KV_CACHE_USAGE,
+    QUERY_PREFIX_CACHE_HIT_RATE,
+    QUERY_QUEUE_LENGTH,
+    QUERY_SCHEDULER_QUEUE_BYTES,
+    QUERY_SCHEDULER_QUEUE_SIZE,
+    QUERY_SERVING_CONFIG_INFO,
+    QUERY_SLOTS_AVAILABLE,
+    QUERY_SLOTS_USED,
+    register_saturation_queries,
+)
+from wva_tpu.collector.registration.scale_to_zero import (
+    PARAM_RETENTION_PERIOD,
+    QUERY_MODEL_REQUEST_COUNT,
+    collect_model_request_count,
+    register_scale_to_zero_queries,
+)
+
+__all__ = [
+    "QUERY_AVG_INPUT_TOKENS",
+    "QUERY_AVG_OUTPUT_TOKENS",
+    "QUERY_CACHE_CONFIG_INFO",
+    "QUERY_GENERATE_BACKLOG",
+    "QUERY_KV_CACHE_USAGE",
+    "QUERY_PREFIX_CACHE_HIT_RATE",
+    "QUERY_QUEUE_LENGTH",
+    "QUERY_SCHEDULER_QUEUE_BYTES",
+    "QUERY_SCHEDULER_QUEUE_SIZE",
+    "QUERY_SERVING_CONFIG_INFO",
+    "QUERY_SLOTS_AVAILABLE",
+    "QUERY_SLOTS_USED",
+    "register_saturation_queries",
+    "PARAM_RETENTION_PERIOD",
+    "QUERY_MODEL_REQUEST_COUNT",
+    "collect_model_request_count",
+    "register_scale_to_zero_queries",
+]

@@ -1,0 +1,172 @@
+"""Saturation query registration
+(reference ``internal/collector/registration/saturation.go:8-122``).
+
+Every per-pod query merges the vLLM-TPU and JetStream metric families with a
+PromQL ``or`` so one pipeline serves both engines: vLLM-TPU emits the same
+``vllm:*`` names as CUDA vLLM, JetStream emits ``jetstream_*`` gauges. The
+merge is per-series — a pod only ever exposes one family, so ``or`` acts as a
+per-pod fallback, not a mixing operator.
+"""
+
+from __future__ import annotations
+
+from wva_tpu.collector.source.query_template import QueryTemplate
+from wva_tpu.collector.source.registry import PROMETHEUS_SOURCE_NAME, SourceRegistry
+from wva_tpu.collector.source.source import PARAM_MODEL_ID, PARAM_NAMESPACE
+
+# Saturation queries (per-pod peaks over 1m windows).
+QUERY_KV_CACHE_USAGE = "kv_cache_usage"
+QUERY_QUEUE_LENGTH = "queue_length"
+
+# V2 token-capacity queries.
+QUERY_CACHE_CONFIG_INFO = "cache_config_info"
+QUERY_SERVING_CONFIG_INFO = "serving_config_info"
+QUERY_AVG_OUTPUT_TOKENS = "avg_output_tokens"
+QUERY_AVG_INPUT_TOKENS = "avg_input_tokens"
+QUERY_PREFIX_CACHE_HIT_RATE = "prefix_cache_hit_rate"
+
+# JetStream disaggregated-serving queries.
+QUERY_GENERATE_BACKLOG = "generate_backlog"
+QUERY_SLOTS_USED = "slots_used"
+QUERY_SLOTS_AVAILABLE = "slots_available"
+
+# Scheduler flow-control queries (model-level).
+QUERY_SCHEDULER_QUEUE_SIZE = "scheduler_queue_size"
+QUERY_SCHEDULER_QUEUE_BYTES = "scheduler_queue_bytes"
+
+_NS_MODEL = '{namespace="{{.namespace}}",model_name="{{.modelID}}"}'
+
+
+def register_saturation_queries(source_registry: SourceRegistry) -> None:
+    src = source_registry.get(PROMETHEUS_SOURCE_NAME)
+    if src is None:
+        return
+    registry = src.query_list()
+
+    registry.register(QueryTemplate(
+        name=QUERY_KV_CACHE_USAGE,
+        template=(
+            f"max by (pod) (max_over_time(vllm:kv_cache_usage_perc{_NS_MODEL}[1m])"
+            f" or max_over_time(jetstream_kv_cache_utilization{_NS_MODEL}[1m]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Peak HBM KV-cache utilization per pod (0.0-1.0) over last minute",
+    ))
+
+    registry.register(QueryTemplate(
+        name=QUERY_QUEUE_LENGTH,
+        template=(
+            f"max by (pod) (max_over_time(vllm:num_requests_waiting{_NS_MODEL}[1m])"
+            f" or max_over_time(jetstream_prefill_backlog_size{_NS_MODEL}[1m]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Peak waiting-request / prefill-backlog depth per pod over last minute",
+    ))
+
+    # --- V2 token-capacity queries ---
+
+    registry.register(QueryTemplate(
+        name=QUERY_CACHE_CONFIG_INFO,
+        template=(
+            "max by (pod, num_gpu_blocks, block_size) "
+            f"(vllm:cache_config_info{_NS_MODEL})"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="vLLM KV cache configuration per pod (labels carry block counts)",
+    ))
+
+    registry.register(QueryTemplate(
+        name=QUERY_SERVING_CONFIG_INFO,
+        template=(
+            "max by (pod, max_concurrent_decodes, max_target_length, tokens_per_slot) "
+            f"(jetstream_serving_config_info{_NS_MODEL})"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="JetStream serving configuration per pod (labels carry slot budget)",
+    ))
+
+    registry.register(QueryTemplate(
+        name=QUERY_AVG_OUTPUT_TOKENS,
+        template=(
+            "max by (pod) ("
+            f"rate(vllm:request_generation_tokens_sum{_NS_MODEL}[5m])"
+            f" / rate(vllm:request_generation_tokens_count{_NS_MODEL}[5m])"
+            f" or rate(jetstream_request_output_length_sum{_NS_MODEL}[5m])"
+            f" / rate(jetstream_request_output_length_count{_NS_MODEL}[5m]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Average output tokens per completed request (5m rate)",
+    ))
+
+    registry.register(QueryTemplate(
+        name=QUERY_AVG_INPUT_TOKENS,
+        template=(
+            "max by (pod) ("
+            f"rate(vllm:request_prompt_tokens_sum{_NS_MODEL}[5m])"
+            f" / rate(vllm:request_prompt_tokens_count{_NS_MODEL}[5m])"
+            f" or rate(jetstream_request_input_length_sum{_NS_MODEL}[5m])"
+            f" / rate(jetstream_request_input_length_count{_NS_MODEL}[5m]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Average input tokens per completed request (5m rate)",
+    ))
+
+    registry.register(QueryTemplate(
+        name=QUERY_PREFIX_CACHE_HIT_RATE,
+        template=(
+            "max by (pod) ("
+            f"rate(vllm:prefix_cache_hits{_NS_MODEL}[5m])"
+            f" / rate(vllm:prefix_cache_queries{_NS_MODEL}[5m]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Prefix cache hit rate per pod (0.0-1.0, 5m rate; vLLM only)",
+    ))
+
+    # --- JetStream disaggregated-serving extensions ---
+
+    registry.register(QueryTemplate(
+        name=QUERY_GENERATE_BACKLOG,
+        template=(
+            f"max by (pod) (max_over_time(jetstream_generate_backlog_size{_NS_MODEL}[1m]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Peak decode-slot backlog per pod over last minute (JetStream)",
+    ))
+
+    registry.register(QueryTemplate(
+        name=QUERY_SLOTS_USED,
+        template=f"max by (pod) (jetstream_slots_used{_NS_MODEL})",
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Concurrent decode slots in use per pod (JetStream)",
+    ))
+
+    registry.register(QueryTemplate(
+        name=QUERY_SLOTS_AVAILABLE,
+        template=f"max by (pod) (jetstream_slots_available{_NS_MODEL})",
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Free concurrent decode slots per pod (JetStream)",
+    ))
+
+    # --- Scheduler flow-control (model-level; no namespace label upstream) ---
+
+    registry.register(QueryTemplate(
+        name=QUERY_SCHEDULER_QUEUE_SIZE,
+        template=(
+            'sum(inference_extension_flow_control_queue_size{target_model_name="{{.modelID}}"})'
+            ' or sum(inference_extension_flow_control_queue_size'
+            '{model_name="{{.modelID}}",target_model_name=""})'
+        ),
+        params=[PARAM_MODEL_ID],
+        description="Total requests queued in scheduler flow control for this model",
+    ))
+
+    registry.register(QueryTemplate(
+        name=QUERY_SCHEDULER_QUEUE_BYTES,
+        template=(
+            'sum(inference_extension_flow_control_queue_bytes{target_model_name="{{.modelID}}"})'
+            ' or sum(inference_extension_flow_control_queue_bytes'
+            '{model_name="{{.modelID}}",target_model_name=""})'
+        ),
+        params=[PARAM_MODEL_ID],
+        description="Total bytes queued in scheduler flow control for this model",
+    ))

@@ -1,0 +1,77 @@
+"""Scale-to-zero request counting
+(reference ``internal/collector/registration/scale_to_zero.go:30-138``).
+
+``collect_model_request_count`` errors when the count cannot be determined —
+the enforcer treats that as "do not scale to zero" (fail-safe).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.collector.source.promql import format_promql_duration
+from wva_tpu.collector.source.query_template import QueryTemplate
+from wva_tpu.collector.source.registry import PROMETHEUS_SOURCE_NAME, SourceRegistry
+from wva_tpu.collector.source.source import (
+    PARAM_MODEL_ID,
+    PARAM_NAMESPACE,
+    MetricsSource,
+    RefreshSpec,
+)
+
+log = logging.getLogger(__name__)
+
+QUERY_MODEL_REQUEST_COUNT = "model_request_count"
+PARAM_RETENTION_PERIOD = "retentionPeriod"
+
+_NS_MODEL = '{namespace="{{.namespace}}",model_name="{{.modelID}}"}'
+
+
+class RequestCountUnavailableError(RuntimeError):
+    pass
+
+
+def register_scale_to_zero_queries(source_registry: SourceRegistry) -> None:
+    src = source_registry.get(PROMETHEUS_SOURCE_NAME)
+    if src is None:
+        log.debug("Prometheus source not registered; skipping scale-to-zero queries")
+        return
+    src.query_list().register_if_absent(QueryTemplate(
+        name=QUERY_MODEL_REQUEST_COUNT,
+        template=(
+            f"sum(increase(vllm:request_success_total{_NS_MODEL}[{{{{.retentionPeriod}}}}])"
+            f" or increase(jetstream_request_success_total{_NS_MODEL}[{{{{.retentionPeriod}}}}]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID, PARAM_RETENTION_PERIOD],
+        description="Total successful requests for a model over the retention period",
+    ))
+
+
+def collect_model_request_count(
+    metrics_source: MetricsSource,
+    model_id: str,
+    namespace: str,
+    retention_seconds: float,
+) -> float:
+    """Total successful requests over the retention window. Raises
+    RequestCountUnavailableError when the count cannot be determined — callers
+    MUST treat that as "unknown", never as zero."""
+    params = {
+        PARAM_MODEL_ID: model_id,
+        PARAM_NAMESPACE: namespace,
+        PARAM_RETENTION_PERIOD: format_promql_duration(retention_seconds),
+    }
+    results = metrics_source.refresh(
+        RefreshSpec(queries=[QUERY_MODEL_REQUEST_COUNT], params=params))
+    result = results.get(QUERY_MODEL_REQUEST_COUNT)
+    if result is None:
+        raise RequestCountUnavailableError(
+            f"no result for request count query for model {model_id}")
+    if result.has_error():
+        raise RequestCountUnavailableError(
+            f"request count query failed for model {model_id}: {result.error}")
+    if not result.values:
+        raise RequestCountUnavailableError(
+            f"no values in request count result for model {model_id} "
+            "(metrics may not be scraped yet)")
+    return result.first_value().value

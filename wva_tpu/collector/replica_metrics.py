@@ -1,0 +1,311 @@
+"""Replica metrics collection: joins per-pod query results, maps pods to VAs,
+derives token capacity (reference ``internal/collector/replica_metrics.go:60-468``).
+
+TPU capacity derivation: vLLM-TPU pods expose ``vllm:cache_config_info``
+(num_gpu_blocks x block_size, as on GPU); JetStream pods expose
+``jetstream_serving_config_info`` whose slot budget gives
+``max_concurrent_decodes x tokens_per_slot`` (falling back to
+``max_target_length`` per slot) — either way the analyzer sees one
+``total_kv_capacity_tokens`` number.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass, field
+
+from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST, VariantAutoscaling
+from wva_tpu.collector.registration.saturation import (
+    QUERY_AVG_INPUT_TOKENS,
+    QUERY_AVG_OUTPUT_TOKENS,
+    QUERY_CACHE_CONFIG_INFO,
+    QUERY_GENERATE_BACKLOG,
+    QUERY_KV_CACHE_USAGE,
+    QUERY_PREFIX_CACHE_HIT_RATE,
+    QUERY_QUEUE_LENGTH,
+    QUERY_SCHEDULER_QUEUE_BYTES,
+    QUERY_SCHEDULER_QUEUE_SIZE,
+    QUERY_SERVING_CONFIG_INFO,
+    QUERY_SLOTS_AVAILABLE,
+    QUERY_SLOTS_USED,
+)
+from wva_tpu.collector.source.pod_va_mapper import PodVAMapper
+from wva_tpu.collector.source.source import (
+    PARAM_MODEL_ID,
+    PARAM_NAMESPACE,
+    MetricResult,
+    MetricsSource,
+    RefreshSpec,
+)
+from wva_tpu.constants import ACCELERATOR_NAME_LABEL_KEY
+from wva_tpu.interfaces import (
+    FRESH,
+    ReplicaMetrics,
+    ReplicaMetricsMetadata,
+    SchedulerQueueMetrics,
+)
+from wva_tpu.k8s.objects import Deployment, Pod
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from wva_tpu.utils.variant import namespaced_key
+
+log = logging.getLogger(__name__)
+
+
+class MetricsCollectionError(RuntimeError):
+    pass
+
+
+@dataclass
+class _PodData:
+    kv_usage: float = 0.0
+    has_kv: bool = False
+    queue_len: int = 0
+    has_queue: bool = False
+    num_kv_blocks: int = 0
+    block_size: int = 0
+    has_cache_config: bool = False
+    jetstream_capacity_tokens: int = 0
+    avg_output_tokens: float = 0.0
+    avg_input_tokens: float = 0.0
+    prefix_cache_hit_rate: float = 0.0
+    generate_backlog: int = 0
+    slots_used: int = 0
+    slots_available: int = 0
+    has_slots: bool = False
+
+
+def _finite(v: float) -> bool:
+    return not (math.isnan(v) or math.isinf(v))
+
+
+def _pod_name(labels: dict[str, str]) -> str:
+    return labels.get("pod") or labels.get("pod_name") or ""
+
+
+class ReplicaMetricsCollector:
+    def __init__(self, source: MetricsSource, pod_va_mapper: PodVAMapper | None = None,
+                 clock: Clock | None = None) -> None:
+        self.source = source
+        self.pod_va_mapper = pod_va_mapper
+        self.clock = clock or SYSTEM_CLOCK
+
+    def collect_replica_metrics(
+        self,
+        model_id: str,
+        namespace: str,
+        deployments: dict[str, Deployment],
+        variant_autoscalings: dict[str, VariantAutoscaling],
+        variant_costs: dict[str, float] | None = None,
+    ) -> list[ReplicaMetrics]:
+        """Per-pod metrics for saturation analysis. ``deployments`` and
+        ``variant_autoscalings`` are keyed by "namespace/name"."""
+        params = {PARAM_MODEL_ID: model_id, PARAM_NAMESPACE: namespace}
+        queries = [
+            QUERY_KV_CACHE_USAGE,
+            QUERY_QUEUE_LENGTH,
+            QUERY_CACHE_CONFIG_INFO,
+            QUERY_SERVING_CONFIG_INFO,
+            QUERY_AVG_OUTPUT_TOKENS,
+            QUERY_AVG_INPUT_TOKENS,
+            QUERY_PREFIX_CACHE_HIT_RATE,
+            QUERY_GENERATE_BACKLOG,
+            QUERY_SLOTS_USED,
+            QUERY_SLOTS_AVAILABLE,
+        ]
+        results = self.source.refresh(RefreshSpec(queries=queries, params=params))
+
+        pod_data: dict[str, _PodData] = {}
+
+        def data_for(labels: dict[str, str]) -> _PodData | None:
+            name = _pod_name(labels)
+            if not name:
+                return None
+            return pod_data.setdefault(name, _PodData())
+
+        # KV cache + queue are the load-bearing queries: their failure aborts
+        # collection (reference :132-136,160-164).
+        kv = results.get(QUERY_KV_CACHE_USAGE)
+        if kv is not None and kv.has_error():
+            raise MetricsCollectionError(f"KV cache query failed: {kv.error}")
+        for v in (kv.values if kv else []):
+            d = data_for(v.labels)
+            if d is not None:
+                d.kv_usage, d.has_kv = v.value, True
+
+        queue = results.get(QUERY_QUEUE_LENGTH)
+        if queue is not None and queue.has_error():
+            raise MetricsCollectionError(f"queue length query failed: {queue.error}")
+        for v in (queue.values if queue else []):
+            d = data_for(v.labels)
+            if d is not None:
+                d.queue_len, d.has_queue = int(v.value), True
+
+        # V2 capacity info: vLLM block config.
+        for v in _ok_values(results, QUERY_CACHE_CONFIG_INFO):
+            d = data_for(v.labels)
+            if d is None:
+                continue
+            d.num_kv_blocks = _int_label(v.labels, "num_gpu_blocks", d.num_kv_blocks)
+            d.block_size = _int_label(v.labels, "block_size", d.block_size)
+            if d.num_kv_blocks > 0 and d.block_size > 0:
+                d.has_cache_config = True
+
+        # V2 capacity info: JetStream slot budget.
+        for v in _ok_values(results, QUERY_SERVING_CONFIG_INFO):
+            d = data_for(v.labels)
+            if d is None:
+                continue
+            decodes = _int_label(v.labels, "max_concurrent_decodes", 0)
+            per_slot = _int_label(v.labels, "tokens_per_slot", 0) or \
+                _int_label(v.labels, "max_target_length", 0)
+            if decodes > 0 and per_slot > 0:
+                d.jetstream_capacity_tokens = decodes * per_slot
+
+        for v in _ok_values(results, QUERY_AVG_OUTPUT_TOKENS):
+            d = data_for(v.labels)
+            if d is not None and _finite(v.value):
+                d.avg_output_tokens = v.value
+        for v in _ok_values(results, QUERY_AVG_INPUT_TOKENS):
+            d = data_for(v.labels)
+            if d is not None and _finite(v.value):
+                d.avg_input_tokens = v.value
+        for v in _ok_values(results, QUERY_PREFIX_CACHE_HIT_RATE):
+            d = data_for(v.labels)
+            if d is not None and _finite(v.value) and 0 <= v.value <= 1:
+                d.prefix_cache_hit_rate = v.value
+
+        for v in _ok_values(results, QUERY_GENERATE_BACKLOG):
+            d = data_for(v.labels)
+            if d is not None and _finite(v.value):
+                d.generate_backlog = int(v.value)
+        for v in _ok_values(results, QUERY_SLOTS_USED):
+            d = data_for(v.labels)
+            if d is not None and _finite(v.value):
+                d.slots_used, d.has_slots = int(v.value), True
+        for v in _ok_values(results, QUERY_SLOTS_AVAILABLE):
+            d = data_for(v.labels)
+            if d is not None and _finite(v.value):
+                d.slots_available = int(v.value)
+                d.has_slots = True
+
+        # Join into ReplicaMetrics.
+        collected_at = self.clock.now()
+        out: list[ReplicaMetrics] = []
+        for pod_name in sorted(pod_data):
+            data = pod_data[pod_name]
+            if not data.has_kv and not data.has_queue:
+                continue
+
+            va_name = self._find_va_for_pod(pod_name, namespace, deployments)
+            if not va_name:
+                log.info("Skipping pod %s: no matching deployment/VA", pod_name)
+                continue
+            variant_key = namespaced_key(namespace, va_name)
+
+            accelerator = ""
+            va = variant_autoscalings.get(variant_key)
+            if va is not None:
+                accelerator = va.metadata.labels.get(ACCELERATOR_NAME_LABEL_KEY, "")
+
+            cost = DEFAULT_VARIANT_COST
+            if variant_costs and variant_key in variant_costs:
+                cost = variant_costs[variant_key]
+
+            total_capacity = 0
+            if data.has_cache_config:
+                total_capacity = data.num_kv_blocks * data.block_size
+            elif data.jetstream_capacity_tokens > 0:
+                total_capacity = data.jetstream_capacity_tokens
+            tokens_in_use = 0
+            if total_capacity > 0:
+                tokens_in_use = int(
+                    min(max(round(data.kv_usage * total_capacity), 0), total_capacity))
+
+            out.append(ReplicaMetrics(
+                pod_name=pod_name,
+                model_id=model_id,
+                namespace=namespace,
+                variant_name=va_name,
+                accelerator_name=accelerator,
+                kv_cache_usage=data.kv_usage,
+                queue_length=data.queue_len,
+                cost=cost,
+                num_kv_blocks=data.num_kv_blocks,
+                block_size=data.block_size,
+                total_kv_capacity_tokens=total_capacity,
+                tokens_in_use=tokens_in_use,
+                avg_output_tokens=data.avg_output_tokens,
+                avg_input_tokens=data.avg_input_tokens,
+                prefix_cache_hit_rate=data.prefix_cache_hit_rate,
+                generate_backlog=data.generate_backlog,
+                slots_used=data.slots_used,
+                slots_total=data.slots_used + data.slots_available if data.has_slots else 0,
+                metadata=ReplicaMetricsMetadata(
+                    collected_at=collected_at, age_seconds=0.0, freshness=FRESH),
+            ))
+        log.debug("Collected %d replica metrics for %s/%s",
+                  len(out), namespace, model_id)
+        return out
+
+    def _find_va_for_pod(self, pod_name: str, namespace: str,
+                         deployments: dict[str, Deployment]) -> str:
+        if self.pod_va_mapper is None:
+            return ""
+        pod = self.pod_va_mapper.client.try_get(Pod.KIND, namespace, pod_name)
+        if pod is None:
+            # Pod metrics can outlive the pod briefly; fall back to prefix
+            # matching against the tracked deployments.
+            for key in deployments:
+                dep_name = key.split("/", 1)[1]
+                if pod_name.startswith(dep_name + "-"):
+                    va = self.pod_va_mapper.indexer.find_va_for_deployment(
+                        dep_name, namespace)
+                    return va.metadata.name if va else ""
+            return ""
+        tracked = {key.split("/", 1)[1] for key in deployments}
+        va = self.pod_va_mapper.va_for_pod(pod, tracked_deployments=tracked)
+        return va.metadata.name if va else ""
+
+    def collect_scheduler_queue_metrics(self, model_id: str) -> SchedulerQueueMetrics | None:
+        """Model-level flow-control queue; None when unavailable
+        (reference :409-468)."""
+        params = {PARAM_MODEL_ID: model_id}
+        try:
+            results = self.source.refresh(RefreshSpec(
+                queries=[QUERY_SCHEDULER_QUEUE_SIZE, QUERY_SCHEDULER_QUEUE_BYTES],
+                params=params))
+        except Exception as e:  # noqa: BLE001
+            log.debug("scheduler queue metrics unavailable for %s: %s", model_id, e)
+            return None
+
+        queue_size = queue_bytes = 0
+        has_data = False
+        for v in _ok_values(results, QUERY_SCHEDULER_QUEUE_SIZE):
+            if _finite(v.value):
+                queue_size += int(v.value)
+                has_data = True
+        for v in _ok_values(results, QUERY_SCHEDULER_QUEUE_BYTES):
+            if _finite(v.value):
+                queue_bytes += int(v.value)
+                has_data = True
+        if not has_data:
+            return None
+        return SchedulerQueueMetrics(queue_size=queue_size, queue_bytes=queue_bytes)
+
+
+def _ok_values(results: dict[str, MetricResult], name: str):
+    result = results.get(name)
+    if result is None or result.has_error():
+        return []
+    return result.values
+
+
+def _int_label(labels: dict[str, str], key: str, default: int) -> int:
+    raw = labels.get(key, "")
+    if not raw:
+        return default
+    try:
+        return int(float(raw))
+    except ValueError:
+        return default

@@ -1,0 +1,78 @@
+"""Metrics-source abstraction (reference ``internal/collector/source``)."""
+
+from wva_tpu.collector.source.source import (
+    PARAM_MODEL_ID,
+    PARAM_NAMESPACE,
+    PARAM_POD_FILTER,
+    MetricResult,
+    MetricValue,
+    MetricsSource,
+    RefreshSpec,
+)
+from wva_tpu.collector.source.query_template import (
+    QUERY_TYPE_METRIC_NAME,
+    QUERY_TYPE_PROMQL,
+    QueryList,
+    QueryTemplate,
+    escape_promql_value,
+)
+from wva_tpu.collector.source.cache import CachedValue, MetricsCache, cache_key
+from wva_tpu.collector.source.registry import PROMETHEUS_SOURCE_NAME, SourceRegistry
+from wva_tpu.collector.source.prometheus import (
+    HTTPPromAPI,
+    InMemoryPromAPI,
+    PrometheusSource,
+    parse_prometheus_response,
+)
+from wva_tpu.collector.source.promql import (
+    PromQLEngine,
+    PromQLError,
+    SeriesPoint,
+    TimeSeriesDB,
+    format_promql_duration,
+    parse_promql_duration,
+)
+from wva_tpu.collector.source.pod_scrape import (
+    ALL_METRICS_QUERY,
+    PodScrapingSource,
+    http_pod_fetcher,
+    parse_prometheus_text,
+)
+from wva_tpu.collector.source.pod_va_mapper import PodVAMapper
+from wva_tpu.collector.source.noop import NoopSource
+
+__all__ = [
+    "PARAM_MODEL_ID",
+    "PARAM_NAMESPACE",
+    "PARAM_POD_FILTER",
+    "MetricResult",
+    "MetricValue",
+    "MetricsSource",
+    "RefreshSpec",
+    "QUERY_TYPE_METRIC_NAME",
+    "QUERY_TYPE_PROMQL",
+    "QueryList",
+    "QueryTemplate",
+    "escape_promql_value",
+    "CachedValue",
+    "MetricsCache",
+    "cache_key",
+    "PROMETHEUS_SOURCE_NAME",
+    "SourceRegistry",
+    "HTTPPromAPI",
+    "InMemoryPromAPI",
+    "PrometheusSource",
+    "parse_prometheus_response",
+    "PromQLEngine",
+    "PromQLError",
+    "SeriesPoint",
+    "TimeSeriesDB",
+    "format_promql_duration",
+    "parse_promql_duration",
+    "ALL_METRICS_QUERY",
+    "PodScrapingSource",
+    "http_pod_fetcher",
+    "parse_prometheus_text",
+    "PodVAMapper",
+    "NoopSource",
+]

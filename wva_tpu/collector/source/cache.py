@@ -1,0 +1,75 @@
+"""TTL cache for query results
+(reference ``internal/collector/source/{cache,cache_value}.go``).
+
+Cleanup is opportunistic (on writes) plus an explicit ``cleanup()`` the owner
+can call periodically — no background thread, so simulated-clock runs stay
+deterministic.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from wva_tpu.collector.source.source import MetricResult
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+
+@dataclass
+class CachedValue:
+    result: MetricResult
+    cached_at: float
+
+    def age(self, clock: Clock) -> float:
+        return clock.now() - self.cached_at
+
+
+def cache_key(query_name: str, params: dict[str, str]) -> str:
+    """Key = query name + sorted params (reference cache_value.go)."""
+    if not params:
+        return query_name
+    parts = [f"{k}={v}" for k, v in sorted(params.items())]
+    return query_name + "?" + "&".join(parts)
+
+
+class MetricsCache:
+    def __init__(self, ttl: float = 30.0, cleanup_interval: float = 60.0,
+                 clock: Clock | None = None) -> None:
+        self.ttl = ttl
+        self.cleanup_interval = cleanup_interval
+        self.clock = clock or SYSTEM_CLOCK
+        self._mu = threading.RLock()
+        self._values: dict[str, CachedValue] = {}
+        self._last_cleanup = self.clock.now()
+
+    def set(self, query_name: str, params: dict[str, str], result: MetricResult) -> None:
+        now = self.clock.now()
+        with self._mu:
+            self._values[cache_key(query_name, params)] = CachedValue(result, now)
+            if now - self._last_cleanup >= self.cleanup_interval:
+                self._cleanup_locked(now)
+
+    def get(self, query_name: str, params: dict[str, str]) -> CachedValue | None:
+        with self._mu:
+            cached = self._values.get(cache_key(query_name, params))
+            if cached is None:
+                return None
+            if cached.age(self.clock) > self.ttl:
+                return None
+            return cached
+
+    def cleanup(self) -> int:
+        """Evict expired entries; returns evicted count."""
+        with self._mu:
+            return self._cleanup_locked(self.clock.now())
+
+    def _cleanup_locked(self, now: float) -> int:
+        expired = [k for k, v in self._values.items() if now - v.cached_at > self.ttl]
+        for k in expired:
+            del self._values[k]
+        self._last_cleanup = now
+        return len(expired)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._values)

@@ -1,0 +1,20 @@
+"""No-op source (reference ``internal/collector/source/noop_source.go``)."""
+
+from __future__ import annotations
+
+from wva_tpu.collector.source.query_template import QueryList
+from wva_tpu.collector.source.source import MetricResult, MetricsSource, RefreshSpec
+
+
+class NoopSource(MetricsSource):
+    def __init__(self) -> None:
+        self._queries = QueryList()
+
+    def query_list(self) -> QueryList:
+        return self._queries
+
+    def refresh(self, spec: RefreshSpec) -> dict[str, MetricResult]:
+        return {}
+
+    def get(self, query_name: str, params: dict[str, str]):
+        return None

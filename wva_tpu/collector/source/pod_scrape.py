@@ -1,0 +1,186 @@
+"""Direct pod-scraping metrics source
+(reference ``internal/collector/source/pod/pod_scraping_source.go:29-388``).
+
+Discovers Ready pods behind the EPP Service's selector, scrapes each pod's
+``/metrics`` with bounded concurrency, parses Prometheus text format, tags
+every sample with ``pod`` and ``__name__`` labels, and aggregates everything
+under the single query name ``all_metrics``.
+
+The actual fetch is behind a ``PodMetricsFetcher`` so the emulation harness
+can serve pod metrics in-process while production uses HTTP.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable
+
+from wva_tpu.collector.source.cache import MetricsCache
+from wva_tpu.collector.source.query_template import (
+    QUERY_TYPE_METRIC_NAME,
+    QueryList,
+    QueryTemplate,
+)
+from wva_tpu.collector.source.source import (
+    MetricResult,
+    MetricValue,
+    MetricsSource,
+    RefreshSpec,
+)
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Pod, Service
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+ALL_METRICS_QUERY = "all_metrics"
+DEFAULT_SCRAPE_CONCURRENCY = 10
+DEFAULT_SCRAPE_TIMEOUT_SECONDS = 5.0
+
+# pod -> Prometheus text exposition (or raises)
+PodMetricsFetcher = Callable[[Pod], str]
+
+_METRIC_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?\d+))?$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus_text(text: str) -> list[tuple[str, dict[str, str], float]]:
+    """Parse text exposition into (name, labels, value) tuples. HELP/TYPE
+    comments and malformed lines are skipped."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _METRIC_LINE_RE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = {}
+        if m.group("labels"):
+            for lm in _LABEL_RE.finditer(m.group("labels")):
+                labels[lm.group(1)] = (
+                    lm.group(2).replace('\\"', '"').replace("\\n", "\n")
+                    .replace("\\\\", "\\")
+                )
+        out.append((m.group("name"), labels, value))
+    return out
+
+
+def http_pod_fetcher(metrics_port: int, bearer_token: str = "",
+                     timeout: float = DEFAULT_SCRAPE_TIMEOUT_SECONDS) -> PodMetricsFetcher:
+    """Production fetcher: GET http://<podIP>:<port>/metrics."""
+
+    def fetch(pod: Pod) -> str:
+        if not pod.status.pod_ip:
+            raise RuntimeError(f"pod {pod.metadata.name} has no IP")
+        req = urllib.request.Request(
+            f"http://{pod.status.pod_ip}:{metrics_port}/metrics")
+        if bearer_token:
+            req.add_header("Authorization", f"Bearer {bearer_token}")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode("utf-8", errors="replace")
+
+    return fetch
+
+
+class PodScrapingSource(MetricsSource):
+    def __init__(
+        self,
+        client: KubeClient,
+        service_name: str,
+        service_namespace: str,
+        fetcher: PodMetricsFetcher,
+        *,
+        max_concurrency: int = DEFAULT_SCRAPE_CONCURRENCY,
+        cache_ttl: float = 30.0,
+        clock: Clock | None = None,
+    ) -> None:
+        self.client = client
+        self.service_name = service_name
+        self.service_namespace = service_namespace
+        self.fetcher = fetcher
+        self.max_concurrency = max_concurrency
+        self.clock = clock or SYSTEM_CLOCK
+        self._cache = MetricsCache(ttl=cache_ttl, clock=self.clock)
+        self._queries = QueryList()
+        self._queries.register(QueryTemplate(
+            name=ALL_METRICS_QUERY,
+            type=QUERY_TYPE_METRIC_NAME,
+            template="*",
+            description="All metrics scraped from pods behind the EPP service",
+        ))
+
+    def query_list(self) -> QueryList:
+        return self._queries
+
+    def discover_pods(self) -> list[Pod]:
+        """Ready pods matched by the Service's selector
+        (reference :163-201)."""
+        try:
+            svc: Service = self.client.get(
+                Service.KIND, self.service_namespace, self.service_name)
+        except NotFoundError:
+            log.debug("EPP service %s/%s not found",
+                      self.service_namespace, self.service_name)
+            return []
+        if not svc.selector:
+            return []
+        pods = self.client.list(Pod.KIND, namespace=self.service_namespace,
+                                label_selector=svc.selector)
+        return [p for p in pods if p.is_ready()]
+
+    def refresh(self, spec: RefreshSpec) -> dict[str, MetricResult]:
+        collected_at = self.clock.now()
+        pods = self.discover_pods()
+        values: list[MetricValue] = []
+        errors: list[str] = []
+
+        def scrape(pod: Pod) -> tuple[Pod, str | None, str]:
+            try:
+                return pod, self.fetcher(pod), ""
+            except Exception as e:  # noqa: BLE001 — per-pod isolation
+                return pod, None, str(e)
+
+        if pods:
+            with ThreadPoolExecutor(
+                    max_workers=min(self.max_concurrency, len(pods))) as pool:
+                scraped = list(pool.map(scrape, pods))
+        else:
+            scraped = []
+
+        for pod, text, err in scraped:
+            if text is None:
+                log.debug("scrape failed for pod %s: %s", pod.metadata.name, err)
+                errors.append(f"{pod.metadata.name}: {err}")
+                continue
+            for name, labels, value in parse_prometheus_text(text):
+                tagged = dict(labels)
+                tagged["pod"] = pod.metadata.name
+                tagged["__name__"] = name
+                values.append(MetricValue(value=value, timestamp=collected_at,
+                                          labels=tagged))
+
+        result = MetricResult(
+            query_name=ALL_METRICS_QUERY,
+            values=values,
+            collected_at=collected_at,
+            error="" if (values or not errors) else "; ".join(errors),
+        )
+        if not result.has_error():
+            self._cache.set(ALL_METRICS_QUERY, {}, result)
+        return {ALL_METRICS_QUERY: result}
+
+    def get(self, query_name: str, params: dict[str, str]):
+        return self._cache.get(query_name, params)

@@ -1,0 +1,58 @@
+"""Pod -> owning VariantAutoscaling mapping
+(reference ``internal/collector/source/pod_va_mapper.go:32-99``).
+
+Walks pod ownerReferences up (ReplicaSet -> Deployment, or Deployment
+directly) and resolves the VA through the scale-target index.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.api.v1alpha1 import VariantAutoscaling
+from wva_tpu.indexers import Indexer
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Pod
+
+log = logging.getLogger(__name__)
+
+
+class PodVAMapper:
+    def __init__(self, client: KubeClient, indexer: Indexer) -> None:
+        self.client = client
+        self.indexer = indexer
+
+    def deployment_for_pod(self, pod: Pod) -> str | None:
+        """Owning Deployment name, walking Pod -> ReplicaSet -> Deployment."""
+        for ref in pod.metadata.owner_references:
+            kind = ref.get("kind", "")
+            name = ref.get("name", "")
+            if kind == "Deployment":
+                return name
+            if kind == "ReplicaSet":
+                # K8s convention: ReplicaSet name = "<deployment>-<hash>".
+                # Resolve through the stored ReplicaSet when present, else
+                # strip the trailing hash segment.
+                try:
+                    rs = self.client.get("ReplicaSet", pod.metadata.namespace, name)
+                    for rs_ref in rs.metadata.owner_references:
+                        if rs_ref.get("kind") == "Deployment":
+                            return rs_ref.get("name")
+                except NotFoundError:
+                    pass
+                if "-" in name:
+                    return name.rsplit("-", 1)[0]
+        return None
+
+    def va_for_pod(self, pod: Pod,
+                   tracked_deployments: set[str] | None = None) -> VariantAutoscaling | None:
+        """The VA whose scale target owns the pod, or None. When
+        ``tracked_deployments`` is given, the deployment must be in it
+        (reference :72-84)."""
+        deploy_name = self.deployment_for_pod(pod)
+        if not deploy_name:
+            log.debug("pod %s has no Deployment owner", pod.metadata.name)
+            return None
+        if tracked_deployments is not None and deploy_name not in tracked_deployments:
+            return None
+        return self.indexer.find_va_for_deployment(deploy_name, pod.metadata.namespace)

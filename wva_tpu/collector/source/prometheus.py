@@ -1,0 +1,187 @@
+"""Prometheus metrics source
+(reference ``internal/collector/source/prometheus/prometheus_source.go:40-322``).
+
+The source executes registered PromQL queries against a ``PromAPI`` backend and
+caches results with a TTL. Two backends:
+
+- :class:`HTTPPromAPI` — real Prometheus over ``/api/v1/query`` (urllib, 10s
+  timeout, bearer token), parsing vector/scalar/matrix with NaN -> 0.
+- :class:`InMemoryPromAPI` — the TSDB-lite + PromQL-subset engine
+  (:mod:`wva_tpu.collector.source.promql`), used by tests, the emulation
+  harness, and bench.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import ssl
+import urllib.parse
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Protocol
+
+from wva_tpu.collector.source.cache import MetricsCache
+from wva_tpu.collector.source.promql import PromQLEngine, SeriesPoint, TimeSeriesDB
+from wva_tpu.collector.source.query_template import QueryList, escape_promql_value
+from wva_tpu.collector.source.source import (
+    MetricResult,
+    MetricValue,
+    MetricsSource,
+    RefreshSpec,
+)
+from wva_tpu.config.types import CacheConfig
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+DEFAULT_QUERY_TIMEOUT_SECONDS = 10.0
+DEFAULT_CACHE_TTL_SECONDS = 30.0
+
+
+class PromAPI(Protocol):
+    def query(self, promql: str) -> list[SeriesPoint]:
+        """Evaluate an instant query; raises on backend errors."""
+
+
+class InMemoryPromAPI:
+    """PromAPI over the in-memory TSDB."""
+
+    def __init__(self, db: TimeSeriesDB) -> None:
+        self.db = db
+        self.engine = PromQLEngine(db)
+
+    def query(self, promql: str) -> list[SeriesPoint]:
+        return self.engine.query(promql)
+
+
+class HTTPPromAPI:
+    """PromAPI over a real Prometheus HTTP endpoint."""
+
+    def __init__(self, base_url: str, bearer_token: str = "",
+                 timeout: float = DEFAULT_QUERY_TIMEOUT_SECONDS,
+                 insecure_skip_verify: bool = False) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.bearer_token = bearer_token
+        self.timeout = timeout
+        self._ssl_ctx = None
+        if insecure_skip_verify:
+            self._ssl_ctx = ssl.create_default_context()
+            self._ssl_ctx.check_hostname = False
+            self._ssl_ctx.verify_mode = ssl.CERT_NONE
+
+    def query(self, promql: str) -> list[SeriesPoint]:
+        url = (f"{self.base_url}/api/v1/query?"
+               + urllib.parse.urlencode({"query": promql}))
+        req = urllib.request.Request(url)
+        if self.bearer_token:
+            req.add_header("Authorization", f"Bearer {self.bearer_token}")
+        with urllib.request.urlopen(req, timeout=self.timeout,
+                                    context=self._ssl_ctx) as resp:
+            payload = json.loads(resp.read())
+        if payload.get("status") != "success":
+            raise RuntimeError(f"prometheus query failed: {payload.get('error')}")
+        return parse_prometheus_response(payload.get("data") or {})
+
+
+def parse_prometheus_response(data: dict) -> list[SeriesPoint]:
+    """Parse vector/scalar/matrix results; NaN/Inf values become 0
+    (reference prometheus_source.go:171-250)."""
+    result_type = data.get("resultType", "")
+    result = data.get("result", [])
+    points: list[SeriesPoint] = []
+
+    def _value(raw) -> float:
+        try:
+            v = float(raw)
+        except (TypeError, ValueError):
+            return 0.0
+        return 0.0 if (math.isnan(v) or math.isinf(v)) else v
+
+    if result_type == "vector":
+        for series in result:
+            ts, raw = series.get("value", [0, "0"])
+            labels = dict(series.get("metric") or {})
+            labels.pop("__name__", None)
+            points.append(SeriesPoint(labels, _value(raw), float(ts)))
+    elif result_type == "scalar":
+        ts, raw = result if isinstance(result, list) else (0, "0")
+        points.append(SeriesPoint({}, _value(raw), float(ts)))
+    elif result_type == "matrix":
+        for series in result:
+            values = series.get("values") or []
+            if not values:
+                continue
+            ts, raw = values[-1]  # latest sample of each series
+            labels = dict(series.get("metric") or {})
+            labels.pop("__name__", None)
+            points.append(SeriesPoint(labels, _value(raw), float(ts)))
+    else:
+        raise RuntimeError(f"unsupported prometheus result type {result_type!r}")
+    return points
+
+
+class PrometheusSource(MetricsSource):
+    """Executes registered queries (concurrently for HTTP backends), caches
+    results keyed by (query, params)."""
+
+    def __init__(self, api: PromAPI, cache_config: CacheConfig | None = None,
+                 clock: Clock | None = None, concurrent: bool | None = None) -> None:
+        self.api = api
+        self.clock = clock or SYSTEM_CLOCK
+        cache_cfg = cache_config or CacheConfig(ttl=DEFAULT_CACHE_TTL_SECONDS)
+        self._cache = MetricsCache(ttl=cache_cfg.ttl,
+                                   cleanup_interval=cache_cfg.cleanup_interval,
+                                   clock=self.clock)
+        self._queries = QueryList()
+        # In-memory backends are fast + deterministic: run sequentially.
+        if concurrent is None:
+            concurrent = not isinstance(api, InMemoryPromAPI)
+        self._concurrent = concurrent
+
+    def query_list(self) -> QueryList:
+        return self._queries
+
+    def refresh(self, spec: RefreshSpec) -> dict[str, MetricResult]:
+        names = spec.queries or self._queries.names()
+        results: dict[str, MetricResult] = {}
+        # Escape every param against PromQL label-matcher injection before
+        # templating (reference prometheus_source.go:123).
+        escaped_params = {k: escape_promql_value(v) for k, v in spec.params.items()}
+
+        def run_one(name: str) -> MetricResult:
+            collected_at = self.clock.now()
+            try:
+                promql = self._queries.build(name, escaped_params)
+                points = self.api.query(promql)
+            except Exception as e:  # noqa: BLE001 — per-query isolation
+                log.debug("query %s failed: %s", name, e)
+                return MetricResult(query_name=name, collected_at=collected_at,
+                                    error=str(e))
+            values = [
+                MetricValue(
+                    value=0.0 if math.isnan(p.value) or math.isinf(p.value) else p.value,
+                    timestamp=p.timestamp,
+                    labels=dict(p.labels),
+                )
+                for p in points
+            ]
+            return MetricResult(query_name=name, values=values,
+                                collected_at=collected_at)
+
+        if self._concurrent and len(names) > 1:
+            with ThreadPoolExecutor(max_workers=min(8, len(names))) as pool:
+                for name, result in zip(names, pool.map(run_one, names)):
+                    results[name] = result
+        else:
+            for name in names:
+                results[name] = run_one(name)
+
+        for name, result in results.items():
+            if not result.has_error():
+                self._cache.set(name, spec.params, result)
+        return results
+
+    def get(self, query_name: str, params: dict[str, str]):
+        return self._cache.get(query_name, params)

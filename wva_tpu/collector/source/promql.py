@@ -1,0 +1,491 @@
+"""TSDB-lite + PromQL-subset evaluator.
+
+The reference runs its e2e suites against a real Prometheus fed by a fake
+inference server (SURVEY.md section 4). This module is the TPU build's
+equivalent fidelity trick without a cluster: an in-memory time-series store
+plus an evaluator for exactly the query shapes the autoscaler registers
+(``internal/collector/registration/saturation.go:8-122``):
+
+- aggregations:  sum | max | min | avg | count, with optional ``by (l1, l2)``
+- range funcs:   rate | increase | max_over_time | avg_over_time
+- selectors:     ``name{label="v",other!="w",re=~"x.*"}``
+- binary ops:    vector / vector (label-matched), expr or expr
+- literals:      numeric scalars
+
+Prometheus semantics that matter for correctness are preserved: instant
+lookback (5m), aggregation over an empty vector returns an EMPTY vector (not
+0 — scale-to-zero safety depends on "no data" being distinguishable from 0),
+division drops unmatched/zero-denominator series, and ``or`` keeps the right
+side's series only when the left has no series with the same label set.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from dataclasses import dataclass, field
+
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+DEFAULT_LOOKBACK_SECONDS = 300.0
+DEFAULT_RETENTION_SECONDS = 3600.0
+
+_AGG_OPS = {"sum", "max", "min", "avg", "count"}
+_RANGE_FUNCS = {"rate", "increase", "max_over_time", "avg_over_time"}
+
+_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h|d)$")
+_DURATION_UNITS = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def parse_promql_duration(s: str) -> float:
+    m = _DURATION_RE.match(s)
+    if not m:
+        raise PromQLError(f"invalid duration {s!r}")
+    return float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+
+
+def format_promql_duration(seconds: float) -> str:
+    """Render seconds as a Prometheus range duration (reference
+    utils.FormatPrometheusDuration)."""
+    if seconds <= 0:
+        return "0s"
+    if seconds % 3600 == 0:
+        return f"{int(seconds // 3600)}h"
+    if seconds % 60 == 0:
+        return f"{int(seconds // 60)}m"
+    return f"{int(math.ceil(seconds))}s"
+
+
+class PromQLError(ValueError):
+    pass
+
+
+@dataclass
+class Sample:
+    timestamp: float
+    value: float
+
+
+@dataclass
+class SeriesPoint:
+    """One evaluated output series."""
+
+    labels: dict[str, str]
+    value: float
+    timestamp: float
+
+
+class TimeSeriesDB:
+    """Append-only store of samples keyed by full label set (incl __name__)."""
+
+    def __init__(self, clock: Clock | None = None,
+                 retention: float = DEFAULT_RETENTION_SECONDS) -> None:
+        self.clock = clock or SYSTEM_CLOCK
+        self.retention = retention
+        self._mu = threading.RLock()
+        self._series: dict[tuple, tuple[dict[str, str], list[Sample]]] = {}
+
+    @staticmethod
+    def _key(name: str, labels: dict[str, str]) -> tuple:
+        return tuple(sorted({**labels, "__name__": name}.items()))
+
+    def add_sample(self, name: str, labels: dict[str, str], value: float,
+                   timestamp: float | None = None) -> None:
+        ts = self.clock.now() if timestamp is None else timestamp
+        key = self._key(name, labels)
+        with self._mu:
+            entry = self._series.get(key)
+            if entry is None:
+                entry = ({**labels, "__name__": name}, [])
+                self._series[key] = entry
+            samples = entry[1]
+            samples.append(Sample(ts, value))
+            # Trim beyond retention occasionally.
+            if len(samples) % 256 == 0:
+                cutoff = ts - self.retention
+                while samples and samples[0].timestamp < cutoff:
+                    samples.pop(0)
+
+    set_gauge = add_sample  # gauges and counters are both just samples
+
+    def drop_series(self, name: str, labels: dict[str, str]) -> None:
+        """Remove a series entirely (e.g. pod deleted — Prometheus staleness)."""
+        with self._mu:
+            self._series.pop(self._key(name, labels), None)
+
+    def matching_series(self, matchers: list[tuple[str, str, str]]):
+        """Series whose labels satisfy all (label, op, value) matchers."""
+        with self._mu:
+            out = []
+            for labels, samples in self._series.values():
+                if all(_match(labels.get(lbl, ""), op, val) for lbl, op, val in matchers):
+                    out.append((dict(labels), list(samples)))
+            return out
+
+
+def _match(actual: str, op: str, expected: str) -> bool:
+    if op == "=":
+        return actual == expected
+    if op == "!=":
+        return actual != expected
+    if op == "=~":
+        return re.fullmatch(expected, actual) is not None
+    if op == "!~":
+        return re.fullmatch(expected, actual) is None
+    raise PromQLError(f"unknown matcher op {op!r}")
+
+
+# --- AST ---
+
+@dataclass
+class Selector:
+    name: str
+    matchers: list[tuple[str, str, str]] = field(default_factory=list)
+    range_seconds: float = 0.0  # >0 -> range selector
+
+
+@dataclass
+class FuncCall:
+    func: str
+    arg: Selector
+
+
+@dataclass
+class Aggregation:
+    op: str
+    by: list[str]
+    arg: object
+
+
+@dataclass
+class BinaryOp:
+    op: str  # "/" or "or"
+    left: object
+    right: object
+
+
+@dataclass
+class NumberLiteral:
+    value: float
+
+
+# --- Lexer/parser (recursive descent over the subset grammar) ---
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<duration>\d+(?:\.\d+)?(?:ms|s|m|h|d)\b)
+  | (?P<number>\d+(?:\.\d+)?)
+  | (?P<ident>[a-zA-Z_:][a-zA-Z0-9_:]*)
+  | (?P<op>=~|!~|!=|=|\{|\}|\(|\)|\[|\]|,|/)
+  | (?P<ws>\s+)
+    """,
+    re.VERBOSE,
+)
+
+
+def _tokenize(text: str) -> list[tuple[str, str]]:
+    tokens = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m:
+            raise PromQLError(f"unexpected character {text[pos]!r} at {pos} in {text!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        tokens.append((kind, m.group()))
+    return tokens
+
+
+class _Parser:
+    def __init__(self, text: str) -> None:
+        self.text = text
+        self.tokens = _tokenize(text)
+        self.pos = 0
+
+    def peek(self) -> tuple[str, str] | None:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> tuple[str, str]:
+        tok = self.peek()
+        if tok is None:
+            raise PromQLError(f"unexpected end of query: {self.text!r}")
+        self.pos += 1
+        return tok
+
+    def expect(self, value: str) -> None:
+        tok = self.next()
+        if tok[1] != value:
+            raise PromQLError(f"expected {value!r}, got {tok[1]!r} in {self.text!r}")
+
+    def parse(self):
+        expr = self.parse_or()
+        if self.peek() is not None:
+            raise PromQLError(f"trailing tokens at {self.peek()} in {self.text!r}")
+        return expr
+
+    def parse_or(self):
+        left = self.parse_div()
+        while True:
+            tok = self.peek()
+            if tok and tok[0] == "ident" and tok[1] == "or":
+                self.next()
+                left = BinaryOp("or", left, self.parse_div())
+            else:
+                return left
+
+    def parse_div(self):
+        left = self.parse_primary()
+        while True:
+            tok = self.peek()
+            if tok and tok[1] == "/":
+                self.next()
+                left = BinaryOp("/", left, self.parse_primary())
+            else:
+                return left
+
+    def parse_primary(self):
+        tok = self.peek()
+        if tok is None:
+            raise PromQLError(f"unexpected end of query: {self.text!r}")
+        if tok[1] == "(":
+            self.next()
+            inner = self.parse_or()
+            self.expect(")")
+            return inner
+        if tok[0] == "number":
+            self.next()
+            return NumberLiteral(float(tok[1]))
+        if tok[0] == "ident":
+            name = tok[1]
+            if name in _AGG_OPS:
+                return self.parse_aggregation()
+            if name in _RANGE_FUNCS:
+                return self.parse_func()
+            return self.parse_selector()
+        raise PromQLError(f"unexpected token {tok[1]!r} in {self.text!r}")
+
+    def parse_aggregation(self):
+        op = self.next()[1]
+        by: list[str] = []
+        tok = self.peek()
+        if tok and tok[0] == "ident" and tok[1] == "by":
+            self.next()
+            self.expect("(")
+            while True:
+                t = self.next()
+                if t[0] != "ident":
+                    raise PromQLError(f"expected label name, got {t[1]!r}")
+                by.append(t[1])
+                t = self.next()
+                if t[1] == ")":
+                    break
+                if t[1] != ",":
+                    raise PromQLError(f"expected , or ) in by-clause, got {t[1]!r}")
+        self.expect("(")
+        arg = self.parse_or()
+        self.expect(")")
+        return Aggregation(op, by, arg)
+
+    def parse_func(self):
+        func = self.next()[1]
+        self.expect("(")
+        sel = self.parse_selector()
+        self.expect(")")
+        if sel.range_seconds <= 0:
+            raise PromQLError(f"{func}() requires a range selector in {self.text!r}")
+        return FuncCall(func, sel)
+
+    def parse_selector(self) -> Selector:
+        tok = self.next()
+        if tok[0] != "ident":
+            raise PromQLError(f"expected metric name, got {tok[1]!r}")
+        sel = Selector(name=tok[1])
+        nxt = self.peek()
+        if nxt and nxt[1] == "{":
+            self.next()
+            while True:
+                t = self.next()
+                if t[1] == "}":
+                    break
+                if t[0] != "ident":
+                    raise PromQLError(f"expected label name, got {t[1]!r}")
+                label = t[1]
+                op = self.next()[1]
+                if op not in ("=", "!=", "=~", "!~"):
+                    raise PromQLError(f"bad matcher op {op!r}")
+                val_tok = self.next()
+                if val_tok[0] != "string":
+                    raise PromQLError(f"expected quoted value, got {val_tok[1]!r}")
+                value = val_tok[1][1:-1].replace('\\"', '"').replace("\\\\", "\\")
+                sel.matchers.append((label, op, value))
+                t2 = self.peek()
+                if t2 and t2[1] == ",":
+                    self.next()
+        nxt = self.peek()
+        if nxt and nxt[1] == "[":
+            self.next()
+            dur = self.next()
+            if dur[0] not in ("duration", "number"):
+                raise PromQLError(f"expected duration, got {dur[1]!r}")
+            sel.range_seconds = parse_promql_duration(dur[1]) \
+                if dur[0] == "duration" else float(dur[1])
+            self.expect("]")
+        return sel
+
+
+def parse_query(text: str):
+    return _Parser(text).parse()
+
+
+# --- Evaluator ---
+
+def _series_identity(labels: dict[str, str]) -> tuple:
+    return tuple(sorted((k, v) for k, v in labels.items() if k != "__name__"))
+
+
+class PromQLEngine:
+    def __init__(self, db: TimeSeriesDB,
+                 lookback: float = DEFAULT_LOOKBACK_SECONDS) -> None:
+        self.db = db
+        self.lookback = lookback
+
+    def query(self, text: str, at: float | None = None) -> list[SeriesPoint]:
+        now = self.db.clock.now() if at is None else at
+        return self._eval(parse_query(text), now)
+
+    def _eval(self, node, now: float) -> list[SeriesPoint]:
+        if isinstance(node, NumberLiteral):
+            return [SeriesPoint({}, node.value, now)]
+        if isinstance(node, Selector):
+            return self._eval_instant(node, now)
+        if isinstance(node, FuncCall):
+            return self._eval_range_func(node, now)
+        if isinstance(node, Aggregation):
+            return self._eval_agg(node, now)
+        if isinstance(node, BinaryOp):
+            return self._eval_binop(node, now)
+        raise PromQLError(f"unknown node {node!r}")
+
+    def _select(self, sel: Selector):
+        matchers = [("__name__", "=", sel.name)] + sel.matchers
+        return self.db.matching_series(matchers)
+
+    def _eval_instant(self, sel: Selector, now: float) -> list[SeriesPoint]:
+        if sel.range_seconds > 0:
+            raise PromQLError(f"range selector {sel.name} needs a function")
+        out = []
+        for labels, samples in self._select(sel):
+            latest = _latest_at_or_before(samples, now)
+            if latest is None or now - latest.timestamp > self.lookback:
+                continue
+            out.append(SeriesPoint(labels, latest.value, latest.timestamp))
+        return out
+
+    def _eval_range_func(self, call: FuncCall, now: float) -> list[SeriesPoint]:
+        window = call.arg.range_seconds
+        out = []
+        for labels, samples in self._select(call.arg):
+            in_window = [s for s in samples if now - window <= s.timestamp <= now]
+            if not in_window:
+                continue
+            val = _apply_range_func(call.func, in_window, window)
+            if val is None:
+                continue
+            result_labels = {k: v for k, v in labels.items() if k != "__name__"}
+            out.append(SeriesPoint(result_labels, val, in_window[-1].timestamp))
+        return out
+
+    def _eval_agg(self, agg: Aggregation, now: float) -> list[SeriesPoint]:
+        inputs = self._eval(agg.arg, now)
+        if not inputs:
+            return []  # Prometheus: aggregation over empty vector is empty
+        groups: dict[tuple, list[SeriesPoint]] = {}
+        for point in inputs:
+            key_labels = {l: point.labels.get(l, "") for l in agg.by}
+            groups.setdefault(tuple(sorted(key_labels.items())), []).append(point)
+        out = []
+        for key, points in sorted(groups.items()):
+            values = [p.value for p in points]
+            if agg.op == "sum":
+                val = sum(values)
+            elif agg.op == "max":
+                val = max(values)
+            elif agg.op == "min":
+                val = min(values)
+            elif agg.op == "avg":
+                val = sum(values) / len(values)
+            elif agg.op == "count":
+                val = float(len(values))
+            else:
+                raise PromQLError(f"unknown aggregation {agg.op!r}")
+            out.append(SeriesPoint(dict(key), val, max(p.timestamp for p in points)))
+        return out
+
+    def _eval_binop(self, node: BinaryOp, now: float) -> list[SeriesPoint]:
+        left = self._eval(node.left, now)
+        if node.op == "or":
+            left_ids = {_series_identity(p.labels) for p in left}
+            right = self._eval(node.right, now)
+            return left + [p for p in right if _series_identity(p.labels) not in left_ids]
+        if node.op == "/":
+            right = self._eval(node.right, now)
+            # scalar division
+            if len(right) == 1 and not right[0].labels:
+                divisor = right[0].value
+                if divisor == 0:
+                    return []
+                return [SeriesPoint(p.labels, p.value / divisor, p.timestamp) for p in left]
+            right_by_id = {_series_identity(p.labels): p for p in right}
+            out = []
+            for p in left:
+                match = right_by_id.get(_series_identity(p.labels))
+                if match is None or match.value == 0:
+                    continue  # unmatched or div-by-zero series are dropped
+                out.append(SeriesPoint(p.labels, p.value / match.value, p.timestamp))
+            return out
+        raise PromQLError(f"unknown binary op {node.op!r}")
+
+
+def _latest_at_or_before(samples: list[Sample], now: float) -> Sample | None:
+    latest = None
+    for s in samples:
+        if s.timestamp <= now:
+            latest = s
+        else:
+            break
+    return latest
+
+
+def _apply_range_func(func: str, samples: list[Sample], window: float) -> float | None:
+    values = [s.value for s in samples]
+    if func == "max_over_time":
+        return max(values)
+    if func == "avg_over_time":
+        return sum(values) / len(values)
+    if func in ("rate", "increase"):
+        if len(samples) < 2:
+            return None
+        # Counter-reset handling: accumulate positive deltas.
+        total = 0.0
+        prev = samples[0].value
+        for s in samples[1:]:
+            delta = s.value - prev
+            total += delta if delta >= 0 else s.value
+            prev = s.value
+        span = samples[-1].timestamp - samples[0].timestamp
+        if span <= 0:
+            return None
+        # Prometheus-style bounded extrapolation: extend toward the window
+        # edges by at most ~one sample interval per side, so a series younger
+        # than the window isn't inflated to the full window.
+        window_start = samples[-1].timestamp - window  # eval time ~ last sample
+        interval = span / (len(samples) - 1)
+        limit = interval * 1.1
+        extend_start = min(max(samples[0].timestamp - window_start, 0.0), limit)
+        scaled = total * ((span + extend_start) / span)
+        return scaled / window if func == "rate" else scaled
+    raise PromQLError(f"unknown range function {func!r}")

@@ -1,0 +1,74 @@
+"""Named, parameter-validated query templates with PromQL-injection escaping
+(reference ``internal/collector/source/query_template.go:36-153``).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+# Simple metric name (backends without PromQL: pod-scrape, EPP).
+QUERY_TYPE_METRIC_NAME = "metric"
+# Full PromQL with {{.param}} placeholders (Prometheus backend only).
+QUERY_TYPE_PROMQL = "promql"
+
+
+@dataclass
+class QueryTemplate:
+    name: str
+    template: str
+    type: str = QUERY_TYPE_PROMQL
+    params: list[str] = field(default_factory=list)
+    description: str = ""
+
+
+class QueryList:
+    """Per-source query registry."""
+
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._queries: dict[str, QueryTemplate] = {}
+
+    def register(self, query: QueryTemplate) -> None:
+        if not query.name:
+            raise ValueError("query name is required")
+        if not query.template:
+            raise ValueError(f"query template is required for {query.name!r}")
+        with self._mu:
+            if query.name in self._queries:
+                raise ValueError(f"query {query.name!r} already registered")
+            self._queries[query.name] = query
+
+    def register_if_absent(self, query: QueryTemplate) -> None:
+        with self._mu:
+            if query.name not in self._queries:
+                self._queries[query.name] = query
+
+    def get(self, name: str) -> QueryTemplate | None:
+        with self._mu:
+            return self._queries.get(name)
+
+    def build(self, name: str, params: dict[str, str]) -> str:
+        """Substitute {{.param}} placeholders after validating required params
+        are present. Values must be pre-escaped by the caller when they come
+        from user-controlled fields (see escape_promql_value)."""
+        with self._mu:
+            query = self._queries.get(name)
+        if query is None:
+            raise KeyError(f"query {name!r} not found")
+        for p in query.params:
+            if p not in params:
+                raise ValueError(f"missing required parameter {p!r} for query {name!r}")
+        result = query.template
+        for key, value in params.items():
+            result = result.replace("{{." + key + "}}", value)
+        return result
+
+    def names(self) -> list[str]:
+        with self._mu:
+            return sorted(self._queries)
+
+
+def escape_promql_value(value: str) -> str:
+    """Escape backslashes then quotes for safe PromQL label-matcher embedding."""
+    return value.replace("\\", "\\\\").replace('"', '\\"')

@@ -1,0 +1,51 @@
+"""Named registry of MetricsSources
+(reference ``internal/collector/source/registry.go:19-58``): "prometheus"
+plus one pod-scraping source per InferencePool.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from wva_tpu.collector.source.source import MetricsSource
+
+PROMETHEUS_SOURCE_NAME = "prometheus"
+
+
+class SourceRegistry:
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._sources: dict[str, MetricsSource] = {}
+
+    def register(self, name: str, source: MetricsSource) -> None:
+        if not name:
+            raise ValueError("source name is required")
+        with self._mu:
+            if name in self._sources:
+                raise ValueError(f"source {name!r} already registered")
+            self._sources[name] = source
+
+    def register_if_absent(self, name: str, source_factory) -> MetricsSource:
+        """Atomic check-and-register; returns the winning source. The factory
+        is only invoked when the name is free."""
+        if not name:
+            raise ValueError("source name is required")
+        with self._mu:
+            existing = self._sources.get(name)
+            if existing is not None:
+                return existing
+            created = source_factory()
+            self._sources[name] = created
+            return created
+
+    def get(self, name: str) -> MetricsSource | None:
+        with self._mu:
+            return self._sources.get(name)
+
+    def unregister(self, name: str) -> None:
+        with self._mu:
+            self._sources.pop(name, None)
+
+    def names(self) -> list[str]:
+        with self._mu:
+            return sorted(self._sources)

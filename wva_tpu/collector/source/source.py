@@ -1,0 +1,84 @@
+"""MetricsSource interface + result types
+(reference ``internal/collector/source/source.go:14-130``).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+# Common parameter names (reference query_template.go:18-22).
+PARAM_NAMESPACE = "namespace"
+PARAM_MODEL_ID = "modelID"
+PARAM_POD_FILTER = "podFilter"
+
+
+@dataclass
+class MetricValue:
+    """A single sample with backend timestamp + labels."""
+
+    value: float = 0.0
+    timestamp: float = 0.0  # backend sample time; 0 = unknown
+    labels: dict[str, str] = field(default_factory=dict)
+
+    def age(self, clock: Clock = SYSTEM_CLOCK) -> float:
+        return 0.0 if self.timestamp == 0 else clock.now() - self.timestamp
+
+    def is_stale(self, threshold: float, clock: Clock = SYSTEM_CLOCK) -> bool:
+        if self.timestamp == 0:
+            return True
+        return self.age(clock) > threshold
+
+
+@dataclass
+class MetricResult:
+    """Result of one query: one value per returned series."""
+
+    query_name: str = ""
+    values: list[MetricValue] = field(default_factory=list)
+    collected_at: float = 0.0
+    error: str = ""
+
+    def has_error(self) -> bool:
+        return bool(self.error)
+
+    def first_value(self) -> MetricValue:
+        return self.values[0] if self.values else MetricValue()
+
+    def oldest_timestamp(self) -> float:
+        if not self.values:
+            return 0.0
+        return min(v.timestamp for v in self.values)
+
+    def is_stale(self, threshold: float, clock: Clock = SYSTEM_CLOCK) -> bool:
+        if not self.values:
+            return True
+        return any(v.is_stale(threshold, clock) for v in self.values)
+
+
+@dataclass
+class RefreshSpec:
+    """Which queries to refresh with what parameters; empty = all registered."""
+
+    queries: list[str] = field(default_factory=list)
+    params: dict[str, str] = field(default_factory=dict)
+
+
+class MetricsSource(abc.ABC):
+    """A metrics backend: registered queries + refresh + cached reads."""
+
+    @abc.abstractmethod
+    def query_list(self):
+        """The QueryList registry for this source."""
+
+    @abc.abstractmethod
+    def refresh(self, spec: RefreshSpec) -> dict[str, MetricResult]:
+        """Execute queries (all registered if spec.queries empty), update the
+        cache, return name -> result."""
+
+    @abc.abstractmethod
+    def get(self, query_name: str, params: dict[str, str]):
+        """Cached value for (query, params) or None if absent/expired. The
+        returned value must not be modified."""

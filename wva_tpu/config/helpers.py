@@ -51,7 +51,9 @@ def parse_int_from_config(data: dict[str, str], key: str, default: int, min_valu
 def parse_bool_from_config(data: dict[str, str], key: str, default: bool) -> bool:
     s = data.get(key, "")
     if s:
-        return s in ("true", "1", "yes")
+        # Same truthy set, case-insensitive, as the loader and
+        # SaturationScalingConfig.from_dict — all config surfaces agree.
+        return s.strip().lower() in ("true", "1", "yes")
     return default
 
 
@@ -68,18 +70,17 @@ def saturation_configmap_name() -> str:
     return os.environ.get("SATURATION_CONFIG_MAP_NAME") or DEFAULT_SATURATION_CONFIGMAP_NAME
 
 
-def parse_saturation_configmap(data: dict[str, str] | None) -> tuple[SaturationConfigPerModel, int]:
+def parse_saturation_configmap(data: dict[str, str] | None) -> SaturationConfigPerModel:
     """Parse saturation scaling entries (key -> YAML doc). Invalid entries are
-    skipped. Returns (configs, parsed_count).
+    skipped (logged).
 
     Unlike the reference (configmap_helpers.go:42-47, which validates before
     applying V2 defaults and therefore rejects minimal ``analyzerName:
     saturation`` entries), defaults are applied before validation.
     """
     configs: SaturationConfigPerModel = {}
-    count = 0
     if not data:
-        return configs, count
+        return configs
     for key in sorted(data):
         try:
             raw = yaml.safe_load(data[key]) or {}
@@ -97,5 +98,4 @@ def parse_saturation_configmap(data: dict[str, str] | None) -> tuple[SaturationC
             log.error("Invalid saturation config entry %s: %s", key, e)
             continue
         configs[key] = cfg
-        count += 1
-    return configs, count
+    return configs

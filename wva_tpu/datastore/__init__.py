@@ -41,8 +41,8 @@ class Datastore:
         if pool is None:
             raise ValueError("pool is null")
         if self._registry is not None and self._source_factory is not None:
-            if self._registry.get(pool.name) is None:
-                self._registry.register(pool.name, self._source_factory(pool))
+            self._registry.register_if_absent(
+                pool.name, lambda: self._source_factory(pool))
         with self._mu:
             self._pools[pool.name] = pool
 

@@ -1,0 +1,35 @@
+"""TPU slice discovery (replaces reference ``internal/discovery`` GPU-operator
+scan; same CapacityDiscovery/UsageDiscovery split — ``interface.go:6-27``,
+``k8s_with_gpu_operator.go:36-143``).
+
+GKE TPU node pools advertise:
+- ``cloud.google.com/gke-tpu-accelerator``: generation (``tpu-v5-lite-podslice``)
+- ``cloud.google.com/gke-tpu-topology``: physical topology (``2x4``, ``4x4``,
+  ``2x2x2``)
+- ``status.allocatable["google.com/tpu"]``: chips on this host
+- ``cloud.google.com/gke-nodepool``: slice grouping — every host of a
+  multi-host slice lives in one node pool
+
+The TPU-native unit is the **slice**: a ``v5e-16`` slice is 2 hosts x 8 chips
+that scale together (SURVEY.md section 7, hard part 1). Discovery therefore
+exposes both the per-node view (reference parity) and the slice-granular view
+the limiter allocates from.
+"""
+
+from wva_tpu.discovery.tpu import (
+    AcceleratorModelInfo,
+    SliceCapacity,
+    TPUSliceDiscovery,
+    TpuTopologyInfo,
+    parse_tpu_topology,
+    variant_name_for,
+)
+
+__all__ = [
+    "AcceleratorModelInfo",
+    "SliceCapacity",
+    "TPUSliceDiscovery",
+    "TpuTopologyInfo",
+    "parse_tpu_topology",
+    "variant_name_for",
+]

@@ -1,0 +1,259 @@
+"""TPU node-pool discovery implementation."""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+
+from wva_tpu.constants.labels import (
+    GKE_NODEPOOL_NODE_LABEL,
+    GKE_TPU_ACCELERATOR_NODE_LABEL,
+    GKE_TPU_TOPOLOGY_NODE_LABEL,
+    TPU_RESOURCE_NAME,
+)
+from wva_tpu.k8s.client import KubeClient
+from wva_tpu.k8s.objects import Node, Pod
+
+log = logging.getLogger(__name__)
+
+# GKE accelerator label value -> (short generation name, chips per host,
+# HBM GiB per chip). Chips-per-host bounds how large a single-host slice can
+# be; larger topologies span hosts.
+TPU_GENERATIONS: dict[str, tuple[str, int, int]] = {
+    "tpu-v3-slice": ("v3", 4, 16),
+    "tpu-v4-podslice": ("v4", 4, 32),
+    "tpu-v5-lite-podslice": ("v5e", 8, 16),
+    "tpu-v5p-slice": ("v5p", 4, 95),
+    "tpu-v6e-slice": ("v6e", 8, 32),
+}
+
+
+@dataclass
+class TpuTopologyInfo:
+    generation: str  # "v5e"
+    chips: int  # total chips in the slice (product of topology dims)
+    hosts: int  # hosts per slice
+    chips_per_host: int
+    hbm_gib_per_chip: int
+
+    @property
+    def variant(self) -> str:
+        """Canonical slice-variant name, e.g. "v5e-8". This replaces the
+        reference's normalizeAcceleratorName (type_inventory.go:23-65)."""
+        return f"{self.generation}-{self.chips}"
+
+
+def parse_tpu_topology(accelerator_label: str, topology_label: str,
+                       chips_per_host: int = 0) -> TpuTopologyInfo | None:
+    """Derive slice shape from the GKE labels; None when unrecognized.
+
+    ``chips_per_host`` should be the node's allocatable ``google.com/tpu``
+    when known — GKE machine shapes vary (multi-host v5e pools use 4-chip
+    ct5lp-hightpu-4t hosts while single-host v5e-8 is one 8-chip machine), so
+    the per-generation constant is only a fallback for label-only contexts
+    (e.g. workload-args parsing)."""
+    gen_info = TPU_GENERATIONS.get(accelerator_label)
+    if gen_info is None:
+        return None
+    gen, default_chips_per_host, hbm = gen_info
+    dims = []
+    for part in topology_label.lower().split("x"):
+        try:
+            dims.append(int(part))
+        except ValueError:
+            return None
+    if not dims or any(d <= 0 for d in dims):
+        return None
+    chips = 1
+    for d in dims:
+        chips *= d
+    per_host = chips_per_host if chips_per_host > 0 else default_chips_per_host
+    hosts = max(1, chips // per_host)
+    return TpuTopologyInfo(
+        generation=gen,
+        chips=chips,
+        hosts=hosts,
+        chips_per_host=min(chips, per_host),
+        hbm_gib_per_chip=hbm,
+    )
+
+
+def variant_name_for(accelerator_label: str, topology_label: str) -> str:
+    info = parse_tpu_topology(accelerator_label, topology_label)
+    return info.variant if info else ""
+
+
+@dataclass
+class AcceleratorModelInfo:
+    """Per-node accelerator info (reference discovery types): chip count +
+    HBM per chip."""
+
+    count: int = 0
+    memory: str = ""  # e.g. "16Gi" per chip
+
+
+@dataclass
+class SliceCapacity:
+    """Slice-granular capacity for one TPU variant."""
+
+    variant: str = ""
+    chips_per_slice: int = 0
+    hosts_per_slice: int = 0
+    hbm_gib_per_chip: int = 0
+    total_slices: int = 0
+    total_chips: int = 0
+    nodepools: list[str] = field(default_factory=list)
+
+
+def _parse_node_selector(selector: str) -> dict[str, str]:
+    """WVA_NODE_SELECTOR sharding: "k=v,k2=v2" equality selectors only."""
+    out = {}
+    for part in selector.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"invalid WVA_NODE_SELECTOR entry {part!r}")
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+class TPUSliceDiscovery:
+    """CapacityDiscovery + UsageDiscovery over GKE TPU node pools."""
+
+    def __init__(self, client: KubeClient) -> None:
+        self.client = client
+
+    def _node_snapshot(self) -> list[tuple[Node, TpuTopologyInfo, int]]:
+        """One node-list pass: (node, slice topology derived from node
+        allocatable chips, chips on node) per ready TPU node."""
+        selector = None
+        env_selector = os.environ.get("WVA_NODE_SELECTOR", "")
+        if env_selector:
+            selector = _parse_node_selector(env_selector)
+        out = []
+        for node in self.client.list(Node.KIND, label_selector=selector):
+            labels = node.metadata.labels
+            if GKE_TPU_ACCELERATOR_NODE_LABEL not in labels or not node.ready:
+                continue
+            chips = _int_quantity(node.status.allocatable.get(TPU_RESOURCE_NAME, "0"))
+            info = parse_tpu_topology(
+                labels.get(GKE_TPU_ACCELERATOR_NODE_LABEL, ""),
+                labels.get(GKE_TPU_TOPOLOGY_NODE_LABEL, ""),
+                chips_per_host=chips,
+            )
+            if info is None:
+                log.debug("node %s has unrecognized TPU labels", node.metadata.name)
+                continue
+            out.append((node, info, chips))
+        return out
+
+    # --- CapacityDiscovery (per-node view; reference Discover :36-99) ---
+
+    def discover(self) -> dict[str, dict[str, AcceleratorModelInfo]]:
+        """node name -> {variant -> AcceleratorModelInfo}."""
+        inventory: dict[str, dict[str, AcceleratorModelInfo]] = {}
+        for node, info, chips in self._node_snapshot():
+            inventory.setdefault(node.metadata.name, {})[info.variant] = \
+                AcceleratorModelInfo(count=chips, memory=f"{info.hbm_gib_per_chip}Gi")
+        return inventory
+
+    # --- slice-granular view (TPU-native; feeds the limiter) ---
+
+    def discover_slices(self) -> dict[str, SliceCapacity]:
+        """variant -> SliceCapacity. Hosts are grouped per node pool; each
+        pool contributes floor(hosts / hosts_per_slice) whole slices —
+        partial slices are unschedulable and never counted. Hosts-per-slice
+        comes from each node's allocatable chips, so 4-chip multi-host v5e
+        machines and 8-chip single-host machines both resolve correctly."""
+        return self._slices_from_snapshot(self._node_snapshot())
+
+    @staticmethod
+    def _slices_from_snapshot(
+        snapshot: list[tuple[Node, TpuTopologyInfo, int]],
+    ) -> dict[str, SliceCapacity]:
+        pools: dict[tuple[str, str], tuple[TpuTopologyInfo, int, int]] = {}
+        for node, info, chips in snapshot:
+            pool_name = node.metadata.labels.get(
+                GKE_NODEPOOL_NODE_LABEL, node.metadata.name)
+            key = (pool_name, info.variant)
+            prev = pools.get(key)
+            if prev is None:
+                pools[key] = (info, 1, chips)
+            else:
+                pools[key] = (info, prev[1] + 1, prev[2] + chips)
+
+        out: dict[str, SliceCapacity] = {}
+        for (pool_name, variant), (info, host_count, chip_count) in sorted(pools.items()):
+            slices = host_count // info.hosts
+            cap = out.setdefault(variant, SliceCapacity(
+                variant=variant,
+                chips_per_slice=info.chips,
+                hosts_per_slice=info.hosts,
+                hbm_gib_per_chip=info.hbm_gib_per_chip,
+            ))
+            cap.total_slices += slices
+            cap.total_chips += chip_count
+            cap.nodepools.append(pool_name)
+        return out
+
+    # --- UsageDiscovery (reference DiscoverUsage :103-143) ---
+
+    def discover_usage(self) -> dict[str, int]:
+        """variant -> chips in use, from TPU requests of scheduled,
+        non-terminal pods. Init containers take the max request; app
+        containers sum (K8s effective-request semantics)."""
+        return self._usage_from_snapshot(self._node_snapshot())
+
+    def _usage_from_snapshot(
+        self, snapshot: list[tuple[Node, TpuTopologyInfo, int]],
+    ) -> dict[str, int]:
+        node_variant = {node.metadata.name: info.variant for node, info, _ in snapshot}
+        usage: dict[str, int] = {}
+        for pod in self.client.list(Pod.KIND):
+            if not pod.node_name or pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            variant = node_variant.get(pod.node_name)
+            if variant is None:
+                continue
+            chips = self._pod_tpu_request(pod)
+            if chips > 0:
+                usage[variant] = usage.get(variant, 0) + chips
+        return usage
+
+    def discover_slice_usage(self) -> dict[str, int]:
+        """variant -> whole slices in use (chips used / chips per slice,
+        rounded up — a partially-used slice is unavailable). Single node-list
+        snapshot shared by the capacity and usage passes."""
+        snapshot = self._node_snapshot()
+        capacities = self._slices_from_snapshot(snapshot)
+        usage = self._usage_from_snapshot(snapshot)
+        out = {}
+        for variant, chips in usage.items():
+            cap = capacities.get(variant)
+            if cap is None or cap.chips_per_slice <= 0:
+                continue
+            out[variant] = -(-chips // cap.chips_per_slice)
+        return out
+
+    @staticmethod
+    def _pod_tpu_request(pod: Pod) -> int:
+        app = sum(
+            _int_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+            for c in pod.spec.containers
+        )
+        init = max(
+            (_int_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+             for c in pod.spec.init_containers),
+            default=0,
+        )
+        return max(app, init)
+
+
+def _int_quantity(raw: str) -> int:
+    try:
+        return int(float(raw))
+    except (TypeError, ValueError):
+        return 0

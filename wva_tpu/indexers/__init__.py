@@ -34,11 +34,13 @@ class Indexer:
         self._index: dict[str, set[str]] = {}  # index key -> set of VA names
 
     def setup(self) -> None:
-        """Seed from current VAs and subscribe to watch events
-        (reference SetupIndexes, indexers.go:61)."""
+        """Subscribe to watch events, then seed from current VAs
+        (reference SetupIndexes, indexers.go:61). Watch-first ordering closes
+        the window where a VA created mid-setup would never be indexed; the
+        ADDED path is idempotent so double-delivery is harmless."""
+        self._client.watch(VariantAutoscaling.kind, self._on_event)
         for va in self._client.list(VariantAutoscaling.kind):
             self._on_event(ADDED, va)
-        self._client.watch(VariantAutoscaling.kind, self._on_event)
 
     def _on_event(self, event: str, va: VariantAutoscaling) -> None:
         ref = va.spec.scale_target_ref

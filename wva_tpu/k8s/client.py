@@ -25,6 +25,7 @@ from typing import Any, Callable, Iterable
 log = logging.getLogger(__name__)
 
 from wva_tpu.api.v1alpha1 import VariantAutoscaling
+from wva_tpu.k8s.objects import labels_match
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 # Watch event types.
@@ -52,10 +53,6 @@ def _kind_of(obj: Any) -> str:
     return kind
 
 
-def _labels_match(selector: dict[str, str] | None, labels: dict[str, str]) -> bool:
-    if not selector:
-        return True
-    return all(labels.get(k) == v for k, v in selector.items())
 
 
 class KubeClient(abc.ABC):
@@ -151,7 +148,7 @@ class FakeCluster(KubeClient):
                     continue
                 if namespace is not None and ns != (namespace or ""):
                     continue
-                if not _labels_match(label_selector, stored.obj.metadata.labels):
+                if not labels_match(label_selector, stored.obj.metadata.labels):
                     continue
                 out.append(_copy(stored.obj))
             return out

@@ -171,6 +171,15 @@ class ServiceMonitor:
     API_VERSION = "monitoring.coreos.com/v1"
 
 
+def labels_match(selector: dict[str, str] | None, labels: dict[str, str]) -> bool:
+    """K8s equality-selector semantics: every selector entry must match; an
+    empty/None selector matches everything. The single source of truth for
+    label matching (client listing, pool selection)."""
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
 def deep_copy(obj):
     return copy.deepcopy(obj)
 

@@ -1,4 +1,10 @@
-"""Shared utilities (reference ``internal/utils``)."""
+"""Shared utilities (reference ``internal/utils``).
+
+Low-level modules (durations, clock, backoff) are imported eagerly; the
+VA/pool helpers are re-exported lazily because they depend on ``wva_tpu.k8s``,
+which itself uses the low-level utils — eager imports here would create an
+init cycle whenever ``wva_tpu.k8s`` loads first.
+"""
 
 from wva_tpu.utils.durations import (
     format_duration,
@@ -7,34 +13,8 @@ from wva_tpu.utils.durations import (
 )
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock, FakeClock
 from wva_tpu.utils.backoff import retry_with_backoff
-from wva_tpu.utils.variant import (
-    active_variant_autoscalings,
-    get_accelerator_type,
-    get_controller_instance,
-    get_deployment_with_backoff,
-    get_va_with_backoff,
-    group_variant_autoscalings_by_model,
-    inactive_variant_autoscalings,
-    namespaced_key,
-    ready_variant_autoscalings,
-    update_va_status_with_backoff,
-)
-from wva_tpu.utils.pool import (
-    EndpointPicker,
-    EndpointPool,
-    endpoint_pool_from_inference_pool,
-    get_pool_api_version,
-    selector_is_subset,
-)
 
-__all__ = [
-    "format_duration",
-    "parse_duration",
-    "parse_duration_or_default",
-    "SYSTEM_CLOCK",
-    "Clock",
-    "FakeClock",
-    "retry_with_backoff",
+_VARIANT_EXPORTS = {
     "active_variant_autoscalings",
     "get_accelerator_type",
     "get_controller_instance",
@@ -45,9 +25,35 @@ __all__ = [
     "namespaced_key",
     "ready_variant_autoscalings",
     "update_va_status_with_backoff",
+}
+_POOL_EXPORTS = {
     "EndpointPicker",
     "EndpointPool",
     "endpoint_pool_from_inference_pool",
     "get_pool_api_version",
     "selector_is_subset",
+}
+
+__all__ = [
+    "format_duration",
+    "parse_duration",
+    "parse_duration_or_default",
+    "SYSTEM_CLOCK",
+    "Clock",
+    "FakeClock",
+    "retry_with_backoff",
+    *sorted(_VARIANT_EXPORTS),
+    *sorted(_POOL_EXPORTS),
 ]
+
+
+def __getattr__(name: str):
+    if name in _VARIANT_EXPORTS:
+        from wva_tpu.utils import variant
+
+        return getattr(variant, name)
+    if name in _POOL_EXPORTS:
+        from wva_tpu.utils import pool
+
+        return getattr(pool, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -7,7 +7,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
-from wva_tpu.k8s.objects import InferencePool
+from wva_tpu.k8s.objects import InferencePool, labels_match
 
 
 @dataclass
@@ -58,5 +58,6 @@ def get_pool_api_version() -> str:
 
 def selector_is_subset(selector: dict[str, str], labels: dict[str, str]) -> bool:
     """True iff every selector entry matches labels (used by
-    PoolGetFromLabels; reference datastore.go:133-152)."""
-    return all(labels.get(k) == v for k, v in selector.items())
+    PoolGetFromLabels; reference datastore.go:133-152). Alias of the k8s
+    label-matching single source of truth."""
+    return labels_match(selector, labels)
