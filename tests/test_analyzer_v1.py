@@ -1,0 +1,133 @@
+"""V1 saturation analyzer tests (model: internal/saturation/analyzer_test.go)."""
+
+from wva_tpu.analyzers.saturation import SaturationAnalyzer
+from wva_tpu.interfaces import (
+    ReplicaMetrics,
+    SaturationScalingConfig,
+    VariantReplicaState,
+)
+from wva_tpu.utils import FakeClock
+
+CFG = SaturationScalingConfig()  # defaults: kv 0.8, queue 5, triggers 0.1 / 3
+
+
+def rm(pod, variant="v5e", kv=0.2, queue=0, cost=10.0, accel="v5e-8"):
+    return ReplicaMetrics(pod_name=pod, variant_name=variant, kv_cache_usage=kv,
+                          queue_length=queue, cost=cost, accelerator_name=accel)
+
+
+def state(variant="v5e", current=1, desired=0, pending=0):
+    return VariantReplicaState(variant_name=variant, current_replicas=current,
+                               desired_replicas=desired, pending_replicas=pending)
+
+
+def analyzer():
+    return SaturationAnalyzer(clock=FakeClock())
+
+
+def test_empty_metrics():
+    a = analyzer().analyze_model_saturation("m", "ns", [], CFG)
+    assert a.total_replicas == 0
+    assert not a.should_scale_up and not a.scale_down_safe
+
+
+def test_saturation_detection_and_spare():
+    metrics = [
+        rm("p0", kv=0.9),            # saturated by KV
+        rm("p1", queue=7),           # saturated by queue
+        rm("p2", kv=0.4, queue=1),   # spare kv 0.4, queue 4
+        rm("p3", kv=0.6, queue=3),   # spare kv 0.2, queue 2
+    ]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    assert a.non_saturated_count == 2
+    assert a.avg_spare_kv_capacity == (0.4 + 0.2) / 2
+    assert a.avg_spare_queue_length == (4 + 2) / 2
+    va = a.variant_analyses[0]
+    assert sorted(va.saturated_replicas) == ["p0", "p1"]
+    assert va.max_kv_cache_usage == 0.9
+    assert va.max_queue_length == 7
+
+
+def test_scale_up_trigger_kv():
+    # avg spare kv below 0.1 trigger
+    metrics = [rm("p0", kv=0.75), rm("p1", kv=0.78)]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    assert a.should_scale_up
+    assert "KV spare" in a.scale_up_reason
+
+
+def test_no_scale_up_when_spare_is_adequate():
+    metrics = [rm("p0", kv=0.2, queue=0), rm("p1", kv=0.3, queue=1)]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    assert not a.should_scale_up
+    assert a.scale_down_safe  # plenty of headroom for N->N-1
+
+
+def test_scale_down_unsafe_with_one_nonsaturated():
+    metrics = [rm("p0", kv=0.9), rm("p1", kv=0.2)]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    assert not a.scale_down_safe
+
+
+def test_scale_down_unsafe_when_redistribution_saturates():
+    # Two replicas at kv 0.45 -> load 0.45 each; removing one -> 0.9 > 0.8
+    metrics = [rm("p0", kv=0.45), rm("p1", kv=0.45)]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    assert not a.scale_down_safe
+
+
+# --- target calculation ---
+
+def test_targets_scale_up_cheapest_variant():
+    metrics = [rm("a0", variant="exp", kv=0.75, cost=40.0),
+               rm("b0", variant="cheap", kv=0.78, cost=10.0)]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    assert a.should_scale_up
+    targets = analyzer().calculate_saturation_targets(
+        a, [state("exp", current=1), state("cheap", current=1)])
+    assert targets == {"exp": 1, "cheap": 2}
+
+
+def test_targets_scale_up_skips_pending_variant():
+    metrics = [rm("a0", variant="exp", kv=0.75, cost=40.0),
+               rm("b0", variant="cheap", kv=0.78, cost=10.0)]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    targets = analyzer().calculate_saturation_targets(
+        a, [state("exp", current=1), state("cheap", current=1, pending=1)])
+    # cheap has pending -> next cheapest (exp) takes the +1... but wait:
+    # cheap's metrics(1) != current(1)? both 1; pending means current includes
+    # a non-ready pod? Here current=1 ready metric=1, pending extra.
+    assert targets["exp"] == 2
+    assert targets["cheap"] == 1
+
+
+def test_targets_blocked_during_transition():
+    metrics = [rm("a0", variant="v", kv=0.75)]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    assert a.should_scale_up
+    # desired(3) != current(1): transition -> keep desired, no scaling
+    targets = analyzer().calculate_saturation_targets(
+        a, [state("v", current=1, desired=3)])
+    assert targets == {"v": 3}
+    # metrics(1) != current(2): transition -> keep current
+    targets = analyzer().calculate_saturation_targets(
+        a, [state("v", current=2)])
+    assert targets == {"v": 2}
+
+
+def test_targets_scale_down_most_expensive():
+    metrics = [rm("a0", variant="exp", kv=0.1, cost=40.0),
+               rm("a1", variant="exp", kv=0.1, cost=40.0),
+               rm("b0", variant="cheap", kv=0.1, cost=10.0)]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    assert a.scale_down_safe and not a.should_scale_up
+    targets = analyzer().calculate_saturation_targets(
+        a, [state("exp", current=2), state("cheap", current=1)])
+    assert targets == {"exp": 1, "cheap": 1}
+
+
+def test_targets_scale_down_floors_at_one():
+    metrics = [rm("a0", variant="only", kv=0.1), rm("a1", variant="only", kv=0.1)]
+    a = analyzer().analyze_model_saturation("m", "ns", metrics, CFG)
+    targets = analyzer().calculate_saturation_targets(a, [state("only", current=2)])
+    assert targets == {"only": 1}

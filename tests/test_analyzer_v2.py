@@ -1,0 +1,286 @@
+"""V2 analyzer + capacity store + engine-params parser tests
+(model: saturation_v2/{analyzer,capacity_store,deployment_parser,history}_test.go)."""
+
+import pytest
+
+from wva_tpu.analyzers.saturation_v2 import (
+    CapacityKnowledgeStore,
+    SaturationV2Analyzer,
+    estimate_capacity_from_params,
+    parse_engine_args,
+)
+from wva_tpu.analyzers.saturation_v2.capacity_store import CapacityRecord
+from wva_tpu.api import ObjectMeta
+from wva_tpu.interfaces import (
+    AnalyzerInput,
+    ReplicaMetrics,
+    SaturationScalingConfig,
+    SchedulerQueueMetrics,
+    VariantReplicaState,
+)
+from wva_tpu.k8s import Container, Deployment, PodTemplateSpec
+from wva_tpu.utils import FakeClock
+
+
+def v2_config():
+    c = SaturationScalingConfig(analyzer_name="saturation")
+    c.apply_defaults()
+    return c
+
+
+def make_analyzer():
+    clock = FakeClock(start=1000.0)
+    store = CapacityKnowledgeStore(clock=clock)
+    return SaturationV2Analyzer(store, clock=clock), store, clock
+
+
+def rm(pod, variant="v5e", kv=0.5, queue=0, capacity=100_000, avg_in=100.0,
+       avg_out=200.0, cost=10.0, accel="v5e-8", slots_used=0, slots_total=0,
+       gen_backlog=0):
+    return ReplicaMetrics(
+        pod_name=pod, variant_name=variant, kv_cache_usage=kv, queue_length=queue,
+        total_kv_capacity_tokens=capacity, tokens_in_use=int(kv * capacity),
+        avg_input_tokens=avg_in, avg_output_tokens=avg_out, cost=cost,
+        accelerator_name=accel, slots_used=slots_used, slots_total=slots_total,
+        generate_backlog=gen_backlog)
+
+
+def state(variant="v5e", current=1, pending=0, chips=8):
+    return VariantReplicaState(variant_name=variant, current_replicas=current,
+                               pending_replicas=pending, chips_per_replica=chips)
+
+
+def test_analyze_basic_supply_demand():
+    analyzer, store, _ = make_analyzer()
+    result = analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns",
+        replica_metrics=[rm("p0", kv=0.5), rm("p1", kv=0.3)],
+        variant_states=[state(current=2)],
+        config=v2_config()))
+    # k1 = 100k * 0.8 = 80k per replica; demand = tokens_in_use (no queue)
+    assert result.total_supply == pytest.approx(160_000)
+    assert result.total_demand == pytest.approx(50_000 + 30_000)
+    assert result.required_capacity == 0  # demand/0.85 < supply
+    # spare = 160k - 80k/0.7 > 0
+    assert result.spare_capacity > 0
+    # live capacity learned
+    rec = store.get("ns", "m", "v5e")
+    assert rec is not None and rec.learned_from == "live"
+    assert rec.effective_capacity == 80_000
+
+
+def test_analyze_requires_scale_up_under_pressure():
+    analyzer, _, _ = make_analyzer()
+    result = analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns",
+        replica_metrics=[rm("p0", kv=0.79, queue=4)],
+        variant_states=[state(current=1)],
+        config=v2_config()))
+    # demand = 79k + 4*100 = 79.4k; supply = 80k; required = 79.4k/0.85 - 80k > 0
+    assert result.required_capacity > 0
+
+
+def test_k2_observed_when_queue_saturated():
+    analyzer, _, _ = make_analyzer()
+    m = rm("p0", kv=0.6, queue=10)  # queue >= threshold 5 -> k2 = tokens_in_use
+    result = analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns", replica_metrics=[m],
+        variant_states=[state(current=1)], config=v2_config()))
+    vc = result.variant_capacities[0]
+    assert vc.per_replica_capacity == 60_000  # min(k1=80k, k2-observed=60k)
+
+
+def test_k2_observed_on_jetstream_slot_exhaustion():
+    analyzer, _, _ = make_analyzer()
+    m = rm("p0", kv=0.6, queue=0, slots_used=96, slots_total=96)
+    result = analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns", replica_metrics=[m],
+        variant_states=[state(current=1)], config=v2_config()))
+    assert result.variant_capacities[0].per_replica_capacity == 60_000
+
+
+def test_k2_history_used_after_observation():
+    analyzer, _, _ = make_analyzer()
+    cfg = v2_config()
+    # First tick: saturated -> records k2 = 60k into history
+    analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns",
+        replica_metrics=[rm("p0", kv=0.6, queue=10)],
+        variant_states=[state(current=1)], config=cfg))
+    # Second tick: not saturated -> uses historical average
+    result = analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns",
+        replica_metrics=[rm("p0", kv=0.1, queue=0)],
+        variant_states=[state(current=1)], config=cfg))
+    assert result.variant_capacities[0].per_replica_capacity == 60_000
+
+
+def test_generate_backlog_adds_demand():
+    analyzer, _, _ = make_analyzer()
+    base = analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns", replica_metrics=[rm("p0")],
+        variant_states=[state()], config=v2_config()))
+    analyzer2, _, _ = make_analyzer()
+    with_backlog = analyzer2.analyze(AnalyzerInput(
+        model_id="m", namespace="ns",
+        replica_metrics=[rm("p0", gen_backlog=10)],
+        variant_states=[state()], config=v2_config()))
+    # +10 requests x avg_out/2 = +1000 tokens demand
+    assert with_backlog.total_demand == base.total_demand + 1000
+
+
+def test_scheduler_queue_demand_with_prefix_discount():
+    analyzer, _, _ = make_analyzer()
+    m = rm("p0", avg_in=100.0, avg_out=200.0)
+    m.prefix_cache_hit_rate = 0.5
+    result = analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns", replica_metrics=[m],
+        variant_states=[state()], config=v2_config(),
+        scheduler_queue=SchedulerQueueMetrics(queue_size=10, queue_bytes=2000)))
+    # input = max(2000/4, 10*100)=1000 * (1-0.5) = 500; output = 10*200 = 2000
+    assert result.total_demand == pytest.approx(50_000 + 500 + 2000)
+
+
+def test_zero_replica_variant_estimated_from_store():
+    analyzer, store, _ = make_analyzer()
+    store.update("ns", "m", "cold", CapacityRecord(
+        accelerator_name="v5p-4", chip_count=4, effective_capacity=50_000,
+        learned_from="live"))
+    result = analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns",
+        replica_metrics=[rm("p0")],
+        variant_states=[state(), state("cold", current=0, chips=4)],
+        config=v2_config()))
+    cold = [vc for vc in result.variant_capacities if vc.variant_name == "cold"][0]
+    assert cold.per_replica_capacity == 50_000
+    assert cold.total_capacity == 0  # no ready replicas
+
+
+def test_pending_replicas_counted_in_anticipated_supply():
+    analyzer, _, _ = make_analyzer()
+    # 1 ready + 1 pending: demand pushes required over ready supply but
+    # anticipated supply (incl pending) covers it -> no scale-up.
+    result = analyzer.analyze(AnalyzerInput(
+        model_id="m", namespace="ns",
+        replica_metrics=[rm("p0", kv=0.75)],
+        variant_states=[state(current=2, pending=1)],
+        config=v2_config()))
+    # demand=75k; anticipated=(1+1)*80k=160k; required = 75k/0.85-160k < 0
+    assert result.required_capacity == 0
+
+
+# --- engine params parsing ---
+
+def deploy_with_args(args, command=None, env=None):
+    return Deployment(
+        metadata=ObjectMeta(name="d"),
+        template=PodTemplateSpec(containers=[Container(
+            name="c", command=command or [], args=args, env=env or {})]))
+
+
+def test_parse_vllm_args_forms():
+    d = deploy_with_args([
+        "--gpu-memory-utilization=0.85", "--block_size", "32",
+        "--tensor-parallel-size=4", "--max-num-seqs", "128",
+        "--enforce-eager", "--max-num-batched-tokens=4096"])
+    p = parse_engine_args(d)
+    assert p.engine == "vllm"
+    assert p.gpu_memory_utilization == 0.85
+    assert p.block_size == 32
+    assert p.tensor_parallel_size == 4
+    assert p.max_num_seqs == 128
+    assert p.enforce_eager is True
+    assert p.effective_max_batched_tokens == 4096
+
+
+def test_parse_shell_command():
+    d = deploy_with_args([], command=[
+        "/bin/sh", "-c",
+        "vllm serve 'meta-llama/Llama-3.1-8B' --max-model-len 8192 --block-size=16"])
+    p = parse_engine_args(d)
+    assert p.max_model_len == 8192
+
+
+def test_vllm_v0_engine_detection():
+    d = deploy_with_args(["--max-model-len", "4096"], env={"VLLM_USE_V1": "0"})
+    p = parse_engine_args(d)
+    assert p.is_v1_engine is False
+    # V0 without chunked prefill: unchunked -> max_model_len
+    assert p.effective_max_batched_tokens == 4096
+
+
+def test_v1_default_batched_tokens():
+    p = parse_engine_args(deploy_with_args([]))
+    assert p.effective_max_batched_tokens == 8192  # V1 chunked default
+
+
+def test_parse_jetstream_args():
+    d = deploy_with_args([
+        "--tpu_topology=2x4", "--max_concurrent_decodes=96",
+        "--max_prefill_predict_length=1024", "--max_target_length=2048"])
+    p = parse_engine_args(d)
+    assert p.engine == "jetstream"
+    assert p.tpu_topology == "2x4"
+    assert p.max_num_seqs == 96  # S = decode slots
+    assert p.effective_max_batched_tokens == 1024  # B = prefill budget
+    assert p.tokens_per_slot == 2048  # defaults to max_target_length
+
+
+def test_k2_derivation_formula():
+    p = parse_engine_args(deploy_with_args(["--max-num-batched-tokens=8192",
+                                            "--max-num-seqs=256"]))
+    # N_steady = min(8192*200/(100+200), 256) = 256; k2 = 256*(100+100) = 51200
+    assert estimate_capacity_from_params(p, 100.0, 200.0) == 51_200
+    assert estimate_capacity_from_params(p, 100.0, 0.0) == 0
+    assert estimate_capacity_from_params(None, 100.0, 200.0) == 0
+
+
+def test_capacity_compatibility():
+    a = parse_engine_args(deploy_with_args(["--block-size=16"]))
+    b = parse_engine_args(deploy_with_args(["--block-size=16"]))
+    c = parse_engine_args(deploy_with_args(["--block-size=32"]))
+    assert a.is_capacity_compatible(b)
+    assert not a.is_capacity_compatible(c)
+    js = parse_engine_args(deploy_with_args(["--tpu_topology=2x4"]))
+    assert not a.is_capacity_compatible(js)  # engines differ
+
+
+# --- capacity store ---
+
+def test_store_live_not_overwritten_by_deployment():
+    clock = FakeClock()
+    store = CapacityKnowledgeStore(clock=clock)
+    store.update("ns", "m", "v", CapacityRecord(
+        accelerator_name="v5e-8", effective_capacity=90_000, learned_from="live"))
+    store.load_from_deployment("ns", "m", "v", "v5e-8", 8,
+                               deploy_with_args(["--max-num-seqs=8"]))
+    assert store.get("ns", "m", "v").learned_from == "live"
+    assert store.get("ns", "m", "v").effective_capacity == 90_000
+
+
+def test_store_deployment_seed_and_eviction():
+    clock = FakeClock(start=0.0)
+    store = CapacityKnowledgeStore(clock=clock)
+    store.load_from_deployment("ns", "m", "v", "v5e-8", 8, deploy_with_args([]))
+    rec = store.get("ns", "m", "v")
+    assert rec.learned_from == "deployment"
+    assert rec.effective_capacity == 8192  # conservative floor
+    clock.advance(8 * 24 * 3600)
+    assert store.evict_stale(7 * 24 * 3600.0) == 1
+    assert store.get("ns", "m", "v") is None
+
+
+def test_find_compatible_prefers_live():
+    clock = FakeClock()
+    store = CapacityKnowledgeStore(clock=clock)
+    params = parse_engine_args(deploy_with_args([]))
+    store.update("ns-a", "m", "va", CapacityRecord(
+        accelerator_name="v5e-8", chip_count=8, effective_capacity=10_000,
+        engine_params=params, learned_from="deployment"))
+    store.update("ns-b", "m", "vb", CapacityRecord(
+        accelerator_name="v5e-8", chip_count=8, effective_capacity=70_000,
+        engine_params=params, learned_from="live"))
+    best = store.find_compatible("m", "v5e-8", 8, params)
+    assert best.learned_from == "live" and best.effective_capacity == 70_000
+    assert store.find_compatible("m", "v5p-4", 8, params) is None
+    assert store.find_compatible("other-model", "v5e-8", 8, params) is None

@@ -1,0 +1,207 @@
+"""Optimizer, enforcer, limiter tests (model: pipeline/*_test.go)."""
+
+import pytest
+
+from wva_tpu.api import ObjectMeta
+from wva_tpu.config import ModelScaleToZeroConfig
+from wva_tpu.discovery import TPUSliceDiscovery
+from wva_tpu.interfaces import (
+    ACTION_NO_CHANGE,
+    ACTION_SCALE_UP,
+    AnalyzerResult,
+    VariantCapacity,
+    VariantDecision,
+    VariantReplicaState,
+    VariantSaturationAnalysis,
+)
+from wva_tpu.k8s import FakeCluster, Node, NodeStatus
+from wva_tpu.pipeline import (
+    CostAwareOptimizer,
+    DefaultLimiter,
+    Enforcer,
+    GreedyBySaturation,
+    ModelScalingRequest,
+    SliceInventory,
+)
+
+
+def vc(name, cost=10.0, per_replica=10_000.0, count=1, pending=0, accel="v5e-8"):
+    return VariantCapacity(variant_name=name, cost=cost,
+                           per_replica_capacity=per_replica, replica_count=count,
+                           pending_replicas=pending, accelerator_name=accel,
+                           total_capacity=count * per_replica)
+
+
+def req(required=0.0, spare=0.0, capacities=None, states=None):
+    return ModelScalingRequest(
+        model_id="m", namespace="ns",
+        result=AnalyzerResult(required_capacity=required, spare_capacity=spare,
+                              variant_capacities=capacities or []),
+        variant_states=states or [])
+
+
+# --- cost-aware optimizer ---
+
+def test_optimizer_scale_up_fills_cheapest_efficiency_first():
+    capacities = [vc("exp", cost=40.0, per_replica=20_000.0),
+                  vc("cheap", cost=10.0, per_replica=10_000.0)]
+    states = [VariantReplicaState(variant_name="exp", current_replicas=1),
+              VariantReplicaState(variant_name="cheap", current_replicas=1)]
+    decisions = CostAwareOptimizer().optimize(
+        [req(required=25_000.0, capacities=capacities, states=states)])
+    by_name = {d.variant_name: d for d in decisions}
+    # cheap efficiency 0.001 < exp 0.002: ceil(25k/10k)=3 replicas on cheap
+    assert by_name["cheap"].target_replicas == 4
+    assert by_name["cheap"].action == ACTION_SCALE_UP
+    assert by_name["exp"].target_replicas == 1
+    assert by_name["exp"].action == ACTION_NO_CHANGE
+
+
+def test_optimizer_scale_down_most_expensive_first():
+    capacities = [vc("exp", cost=40.0, per_replica=10_000.0, count=2),
+                  vc("cheap", cost=10.0, per_replica=10_000.0, count=2)]
+    states = [VariantReplicaState(variant_name="exp", current_replicas=2),
+              VariantReplicaState(variant_name="cheap", current_replicas=2)]
+    decisions = CostAwareOptimizer().optimize(
+        [req(spare=15_000.0, capacities=capacities, states=states)])
+    by_name = {d.variant_name: d for d in decisions}
+    # floor(15k/10k)=1 replica off the expensive variant
+    assert by_name["exp"].target_replicas == 1
+    assert by_name["cheap"].target_replicas == 2
+
+
+def test_optimizer_scale_down_protects_cheapest_only_when_last():
+    capacities = [vc("cheap", cost=10.0, per_replica=10_000.0, count=2)]
+    states = [VariantReplicaState(variant_name="cheap", current_replicas=2)]
+    decisions = CostAwareOptimizer().optimize(
+        [req(spare=100_000.0, capacities=capacities, states=states)])
+    assert decisions[0].target_replicas == 1  # protected at 1
+
+
+def test_optimizer_allows_cheapest_to_zero_when_other_variant_has_replicas():
+    capacities = [vc("exp", cost=40.0, per_replica=10_000.0, count=1),
+                  vc("cheap", cost=10.0, per_replica=10_000.0, count=1)]
+    states = [VariantReplicaState(variant_name="exp", current_replicas=1),
+              VariantReplicaState(variant_name="cheap", current_replicas=1)]
+    decisions = CostAwareOptimizer().optimize(
+        [req(spare=100_000.0, capacities=capacities, states=states)])
+    by_name = {d.variant_name: d for d in decisions}
+    # exp removed first, then cheap CAN go to 0 because exp... was already 0?
+    # order: exp (cost 40) removed -> targets exp=0; cheap: other has 0 now ->
+    # protected at 1.
+    assert by_name["exp"].target_replicas == 0
+    assert by_name["cheap"].target_replicas == 1
+
+
+# --- enforcer ---
+
+def make_enforcer(count=None, error=False):
+    def fn(model_id, namespace, retention):
+        if error:
+            raise RuntimeError("prometheus down")
+        return count
+
+    return Enforcer(fn)
+
+
+S2Z_ON = {"default": ModelScaleToZeroConfig(enable_scale_to_zero=True,
+                                            retention_period="10m")}
+S2Z_OFF = {}
+
+
+def test_enforcer_scales_to_zero_on_no_requests():
+    targets, applied = make_enforcer(count=0.0).enforce_policy(
+        "m", "ns", {"a": 2, "b": 1}, [], S2Z_ON)
+    assert applied and targets == {"a": 0, "b": 0}
+
+
+def test_enforcer_keeps_targets_with_requests():
+    targets, applied = make_enforcer(count=42.0).enforce_policy(
+        "m", "ns", {"a": 2}, [], S2Z_ON)
+    assert not applied and targets == {"a": 2}
+
+
+def test_enforcer_fail_safe_on_query_error():
+    targets, applied = make_enforcer(error=True).enforce_policy(
+        "m", "ns", {"a": 2}, [], S2Z_ON)
+    assert not applied and targets == {"a": 2}
+
+
+def test_enforcer_minimum_replica_on_cheapest():
+    analyses = [VariantSaturationAnalysis(variant_name="exp", cost=40.0),
+                VariantSaturationAnalysis(variant_name="cheap", cost=10.0)]
+    targets, applied = make_enforcer().enforce_policy(
+        "m", "ns", {"exp": 0, "cheap": 0}, analyses, S2Z_OFF)
+    assert applied and targets == {"exp": 0, "cheap": 1}
+
+
+def test_enforcer_no_minimum_needed():
+    targets, applied = make_enforcer().enforce_policy(
+        "m", "ns", {"a": 1}, [], S2Z_OFF)
+    assert not applied and targets == {"a": 1}
+
+
+# --- limiter ---
+
+TPU_LABELS = {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+              "cloud.google.com/gke-tpu-topology": "2x4",
+              "cloud.google.com/gke-nodepool": "pool-a"}
+
+
+def cluster_with_slices(n):
+    c = FakeCluster()
+    for i in range(n):
+        c.create(Node(metadata=ObjectMeta(name=f"n{i}", labels=dict(TPU_LABELS)),
+                      status=NodeStatus(allocatable={"google.com/tpu": "8"})))
+    return c
+
+
+def decision(name, current, target, accel="v5e-8", chips=8, spare=0.0, cost=10.0):
+    return VariantDecision(variant_name=name, accelerator_name=accel,
+                           current_replicas=current, target_replicas=target,
+                           chips_per_replica=chips, spare_capacity=spare,
+                           cost=cost)
+
+
+def test_limiter_constrains_to_whole_slices():
+    # 3 slices of v5e-8 = 24 chips; 1 in use; want +3 -> only 2 more fit
+    c = cluster_with_slices(3)
+    limiter = DefaultLimiter("tpu-limiter", SliceInventory(TPUSliceDiscovery(c)),
+                             GreedyBySaturation())
+    d = decision("v", current=1, target=4)
+    limiter.limit([d])
+    assert d.target_replicas == 3
+    assert d.was_limited
+    assert d.limited_by == "tpu-limiter"
+    assert d.chips_allocated == 16
+    assert d.decision_steps[-1].name == "tpu-limiter"
+
+
+def test_limiter_priority_most_saturated_first():
+    c = cluster_with_slices(3)  # 24 chips; both use 8 now -> 8 available
+    limiter = DefaultLimiter("tpu-limiter", SliceInventory(TPUSliceDiscovery(c)),
+                             GreedyBySaturation())
+    hot = decision("hot", current=1, target=2, spare=0.05)
+    cold = decision("cold", current=1, target=2, spare=0.5)
+    limiter.limit([cold, hot])
+    assert hot.target_replicas == 2  # saturated one wins the last slice
+    assert cold.target_replicas == 1 and cold.was_limited
+
+
+def test_limiter_no_cross_variant_allocation():
+    c = cluster_with_slices(2)  # only v5e-8 capacity
+    limiter = DefaultLimiter("tpu-limiter", SliceInventory(TPUSliceDiscovery(c)),
+                             GreedyBySaturation())
+    d = decision("v5p-var", current=0, target=1, accel="v5p-4", chips=4)
+    limiter.limit([d])
+    assert d.target_replicas == 0 and d.was_limited
+
+
+def test_limiter_compute_constraints_v2_path():
+    c = cluster_with_slices(2)
+    limiter = DefaultLimiter("tpu-limiter", SliceInventory(TPUSliceDiscovery(c)),
+                             GreedyBySaturation())
+    rc = limiter.compute_constraints({"v5e-8": 8})
+    assert rc.pools["v5e-8"].limit == 16
+    assert rc.pools["v5e-8"].available == 8
+    assert rc.total_available == 8

@@ -1,0 +1,3 @@
+"""Scaling analyzers: V1 percentage saturation, V2 token-capacity, SLO
+queueing model (reference ``internal/saturation``,
+``internal/engines/analyzers/saturation_v2``, ``pkg/analyzer``)."""

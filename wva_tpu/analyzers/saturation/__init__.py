@@ -1,0 +1,13 @@
+"""V1 percentage-based saturation analyzer."""
+
+from wva_tpu.analyzers.saturation.analyzer import (
+    DEFAULT_VARIANT_COST,
+    MIN_NON_SATURATED_REPLICAS_FOR_SCALE_DOWN,
+    SaturationAnalyzer,
+)
+
+__all__ = [
+    "DEFAULT_VARIANT_COST",
+    "MIN_NON_SATURATED_REPLICAS_FOR_SCALE_DOWN",
+    "SaturationAnalyzer",
+]

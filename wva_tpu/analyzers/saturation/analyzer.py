@@ -1,0 +1,232 @@
+"""V1 percentage-based saturation analyzer
+(reference ``internal/saturation/analyzer.go:31-439``, ``constants.go:8-13``).
+
+Semantics preserved exactly:
+- a replica is saturated iff ``kv >= kvCacheThreshold OR queue >=
+  queueLengthThreshold`` (:163-164);
+- spare capacity is averaged over NON-saturated replicas only;
+- scale-up iff ``avgSpareKv < kvSpareTrigger OR avgSpareQueue <
+  queueSpareTrigger`` (:199-225);
+- scale-down is safe iff >= 2 non-saturated replicas AND the simulated
+  N -> N-1 load redistribution keeps spare above both triggers (:233-280);
+- target building blocks ALL scaling while any variant transitions
+  (desired != current or metrics != current), else +1 on the cheapest
+  pending-free variant / -1 on the most expensive (floor 1) (:290-439).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST
+from wva_tpu.interfaces import (
+    ModelSaturationAnalysis,
+    ReplicaMetrics,
+    SaturationScalingConfig,
+    VariantReplicaState,
+    VariantSaturationAnalysis,
+)
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+# Scale-down needs at least this many non-saturated replicas
+# (reference constants.go:8).
+MIN_NON_SATURATED_REPLICAS_FOR_SCALE_DOWN = 2
+
+
+class SaturationAnalyzer:
+    """Pure-CPU analysis over collected replica metrics; no I/O."""
+
+    def __init__(self, clock: Clock | None = None) -> None:
+        self.clock = clock or SYSTEM_CLOCK
+
+    def analyze_model_saturation(
+        self,
+        model_id: str,
+        namespace: str,
+        replica_metrics: list[ReplicaMetrics],
+        config: SaturationScalingConfig,
+    ) -> ModelSaturationAnalysis:
+        now = self.clock.now()
+        if not replica_metrics:
+            return ModelSaturationAnalysis(
+                model_id=model_id, namespace=namespace, analyzed_at=now,
+                total_replicas=0, should_scale_up=False, scale_down_safe=False)
+
+        by_variant: dict[str, list[ReplicaMetrics]] = {}
+        for m in replica_metrics:
+            by_variant.setdefault(m.variant_name, []).append(m)
+
+        total_spare_kv = total_spare_queue = 0.0
+        non_saturated = 0
+        variant_analyses = []
+        for variant_name in sorted(by_variant):
+            va = self._analyze_variant(variant_name, by_variant[variant_name], config)
+            variant_analyses.append(va)
+            non_saturated += va.non_saturated_count
+            total_spare_kv += va.avg_spare_kv_capacity * va.non_saturated_count
+            total_spare_queue += va.avg_spare_queue_length * va.non_saturated_count
+
+        analysis = ModelSaturationAnalysis(
+            model_id=model_id, namespace=namespace, analyzed_at=now,
+            total_replicas=len(replica_metrics),
+            non_saturated_count=non_saturated,
+            variant_analyses=variant_analyses)
+        if non_saturated > 0:
+            analysis.avg_spare_kv_capacity = total_spare_kv / non_saturated
+            analysis.avg_spare_queue_length = total_spare_queue / non_saturated
+
+        analysis.should_scale_up, analysis.scale_up_reason = self._should_scale_up(
+            analysis.avg_spare_kv_capacity, analysis.avg_spare_queue_length, config)
+        analysis.scale_down_safe = self._is_scale_down_safe(
+            non_saturated, analysis.avg_spare_kv_capacity,
+            analysis.avg_spare_queue_length, config)
+        return analysis
+
+    @staticmethod
+    def _analyze_variant(
+        variant_name: str,
+        metrics: list[ReplicaMetrics],
+        config: SaturationScalingConfig,
+    ) -> VariantSaturationAnalysis:
+        analysis = VariantSaturationAnalysis(
+            variant_name=variant_name,
+            replica_count=len(metrics),
+            accelerator_name=metrics[0].accelerator_name if metrics else "",
+            cost=metrics[0].cost if metrics else DEFAULT_VARIANT_COST,
+        )
+        total_spare_kv = total_spare_queue = 0.0
+        non_saturated = 0
+        for m in metrics:
+            saturated = (m.kv_cache_usage >= config.kv_cache_threshold
+                         or m.queue_length >= config.queue_length_threshold)
+            if saturated:
+                analysis.saturated_replicas.append(m.pod_name)
+            else:
+                total_spare_kv += config.kv_cache_threshold - m.kv_cache_usage
+                total_spare_queue += config.queue_length_threshold - m.queue_length
+                non_saturated += 1
+            analysis.max_kv_cache_usage = max(analysis.max_kv_cache_usage,
+                                              m.kv_cache_usage)
+            analysis.max_queue_length = max(analysis.max_queue_length, m.queue_length)
+        analysis.non_saturated_count = non_saturated
+        if non_saturated > 0:
+            analysis.avg_spare_kv_capacity = total_spare_kv / non_saturated
+            analysis.avg_spare_queue_length = total_spare_queue / non_saturated
+        return analysis
+
+    @staticmethod
+    def _should_scale_up(
+        avg_spare_kv: float, avg_spare_queue: float,
+        config: SaturationScalingConfig,
+    ) -> tuple[bool, str]:
+        kv_triggered = avg_spare_kv < config.kv_spare_trigger
+        queue_triggered = avg_spare_queue < config.queue_spare_trigger
+        if not kv_triggered and not queue_triggered:
+            return False, ""
+        if kv_triggered and queue_triggered:
+            return True, (
+                f"both KV spare ({avg_spare_kv:.3f} < {config.kv_spare_trigger:.3f}) "
+                f"and queue spare ({avg_spare_queue:.1f} < {config.queue_spare_trigger:.1f})")
+        if kv_triggered:
+            return True, (f"KV spare capacity low "
+                          f"({avg_spare_kv:.3f} < {config.kv_spare_trigger:.3f})")
+        return True, (f"queue spare capacity low "
+                      f"({avg_spare_queue:.1f} < {config.queue_spare_trigger:.1f})")
+
+    @staticmethod
+    def _is_scale_down_safe(
+        non_saturated_count: int,
+        avg_spare_kv: float,
+        avg_spare_queue: float,
+        config: SaturationScalingConfig,
+    ) -> bool:
+        if non_saturated_count < MIN_NON_SATURATED_REPLICAS_FOR_SCALE_DOWN:
+            return False
+        # Load = threshold - spare; removing a replica scales load by N/(N-1).
+        avg_kv_load = config.kv_cache_threshold - avg_spare_kv
+        avg_queue_load = config.queue_length_threshold - avg_spare_queue
+        factor = non_saturated_count / (non_saturated_count - 1)
+        remaining_spare_kv = config.kv_cache_threshold - avg_kv_load * factor
+        remaining_spare_queue = config.queue_length_threshold - avg_queue_load * factor
+        return (remaining_spare_kv >= config.kv_spare_trigger
+                and remaining_spare_queue >= config.queue_spare_trigger)
+
+    def calculate_saturation_targets(
+        self,
+        analysis: ModelSaturationAnalysis | None,
+        variant_states: list[VariantReplicaState],
+    ) -> dict[str, int]:
+        """map variant -> target replicas (reference :290-439)."""
+        targets: dict[str, int] = {}
+        if analysis is None or not analysis.variant_analyses:
+            return {s.variant_name: s.current_replicas for s in variant_states}
+
+        states = {s.variant_name: s for s in variant_states}
+
+        def state_of(name: str) -> VariantReplicaState:
+            return states.get(name, VariantReplicaState(variant_name=name))
+
+        # STEP 1: model-level transition check — block scaling on incomplete
+        # capacity data. Multi-host note: pending_replicas already counts in
+        # slice units (BuildVariantStates divides pods by hosts_per_slice).
+        in_transition = False
+        reasons = []
+        for va in analysis.variant_analyses:
+            st = state_of(va.variant_name)
+            if st.desired_replicas != 0 and st.desired_replicas != st.current_replicas:
+                in_transition = True
+                reasons.append(f"{va.variant_name}: desired({st.desired_replicas})"
+                               f"!=current({st.current_replicas})")
+            if va.replica_count != st.current_replicas:
+                in_transition = True
+                reasons.append(f"{va.variant_name}: metrics({va.replica_count})"
+                               f"!=current({st.current_replicas})")
+
+        # STEP 2: initialize targets.
+        for va in analysis.variant_analyses:
+            st = state_of(va.variant_name)
+            if in_transition:
+                if st.desired_replicas != 0 and st.desired_replicas != st.current_replicas:
+                    targets[va.variant_name] = st.desired_replicas
+                else:
+                    targets[va.variant_name] = st.current_replicas
+            else:
+                targets[va.variant_name] = va.replica_count
+
+        if in_transition:
+            log.info("Model %s in transition, blocking scaling: %s",
+                     analysis.model_id, "; ".join(reasons))
+            return targets
+
+        # STEP 4: stable model — scale decisions.
+        if analysis.should_scale_up:
+            cheapest = None
+            for va in analysis.variant_analyses:
+                if state_of(va.variant_name).pending_replicas > 0:
+                    continue  # cascade-prevention
+                if (cheapest is None or va.cost < cheapest.cost
+                        or (va.cost == cheapest.cost
+                            and va.variant_name < cheapest.variant_name)):
+                    cheapest = va
+            if cheapest is not None:
+                targets[cheapest.variant_name] += 1
+                log.debug("Scale-up cheapest variant %s -> %d (%s)",
+                          cheapest.variant_name, targets[cheapest.variant_name],
+                          analysis.scale_up_reason)
+        elif analysis.scale_down_safe:
+            most_expensive = None
+            for va in analysis.variant_analyses:
+                if targets[va.variant_name] <= 1:
+                    continue
+                if (most_expensive is None or va.cost > most_expensive.cost
+                        or (va.cost == most_expensive.cost
+                            and va.variant_name > most_expensive.variant_name)):
+                    most_expensive = va
+            if most_expensive is not None:
+                targets[most_expensive.variant_name] -= 1
+                log.debug("Scale-down most expensive variant %s -> %d",
+                          most_expensive.variant_name,
+                          targets[most_expensive.variant_name])
+        return targets

@@ -1,0 +1,38 @@
+"""V2 token-based saturation analyzer
+(reference ``internal/engines/analyzers/saturation_v2``)."""
+
+from wva_tpu.analyzers.saturation_v2.engine_params import (
+    EngineParams,
+    parse_engine_args,
+)
+from wva_tpu.analyzers.saturation_v2.capacity_store import (
+    CapacityKnowledgeStore,
+    CapacityRecord,
+)
+from wva_tpu.analyzers.saturation_v2.analyzer import (
+    ReplicaCapacity,
+    SaturationV2Analyzer,
+    estimate_capacity_from_params,
+)
+from wva_tpu.analyzers.saturation_v2.constants import (
+    BYTES_PER_TOKEN,
+    CAPACITY_EVICTION_TIMEOUT,
+    CAPACITY_STALENESS_TIMEOUT,
+    HISTORY_EVICTION_TIMEOUT,
+    ROLLING_AVERAGE_WINDOW_SIZE,
+)
+
+__all__ = [
+    "EngineParams",
+    "parse_engine_args",
+    "CapacityKnowledgeStore",
+    "CapacityRecord",
+    "ReplicaCapacity",
+    "SaturationV2Analyzer",
+    "estimate_capacity_from_params",
+    "BYTES_PER_TOKEN",
+    "CAPACITY_EVICTION_TIMEOUT",
+    "CAPACITY_STALENESS_TIMEOUT",
+    "HISTORY_EVICTION_TIMEOUT",
+    "ROLLING_AVERAGE_WINDOW_SIZE",
+]

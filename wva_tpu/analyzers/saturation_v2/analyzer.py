@@ -1,0 +1,389 @@
+"""V2 token-based saturation analyzer
+(reference ``saturation_v2/analyzer.go:59-520``).
+
+Capacity model per replica:
+- demand = tokens_in_use + queue_length x avg_input_tokens
+  (+ generate_backlog x avg_output/2 on JetStream — admitted-but-undecoded
+  requests will still grow their KV; a TPU/disaggregated-serving extension)
+- k1 (memory-bound) = total_kv_capacity_tokens x kv_cache_threshold
+- k2 (compute-bound) priority chain: observed-under-saturation -> rolling
+  history (bucketed by model|accelerator|output-length) -> derived from
+  workload args (N_steady = min(B*O/(I+O), S); cap = N_steady*(I+O/2)) ->
+  fallback k1. On JetStream, decode-slot exhaustion (slots_used >=
+  slots_total) is an additional "observed" trigger — the engine's native
+  compute-bound signal.
+
+Model level:
+- required = demand/scale_up_threshold - anticipated supply (incl. pending)
+- spare    = supply - demand/scale_down_boundary
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+from wva_tpu.analyzers.saturation_v2.capacity_store import (
+    CapacityKnowledgeStore,
+    CapacityRecord,
+    LEARNED_FROM_LIVE,
+)
+from wva_tpu.analyzers.saturation_v2.constants import (
+    BYTES_PER_TOKEN,
+    ROLLING_AVERAGE_WINDOW_SIZE,
+    classify_output_length,
+)
+from wva_tpu.analyzers.saturation_v2.engine_params import EngineParams
+from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST
+from wva_tpu.analyzers.saturation_v2.history import RollingAverage
+from wva_tpu.interfaces import (
+    Analyzer,
+    AnalyzerInput,
+    AnalyzerResult,
+    ReplicaMetrics,
+    SaturationScalingConfig,
+    SchedulerQueueMetrics,
+    VariantCapacity,
+)
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ReplicaCapacity:
+    """Per-replica capacity breakdown (internal; reference types.go:7-18)."""
+
+    pod_name: str = ""
+    variant_name: str = ""
+    accelerator_name: str = ""
+    tokens_in_use: int = 0
+    total_kv_capacity_tokens: int = 0
+    memory_bound_capacity: int = 0  # k1
+    compute_bound_capacity: int = 0  # k2
+    effective_capacity: int = 0  # min(k1, k2)
+    is_saturated: bool = False
+    replica_demand: int = 0
+
+
+class SaturationV2Analyzer(Analyzer):
+    """Implements interfaces.Analyzer; selected by analyzerName "saturation"."""
+
+    def __init__(self, store: CapacityKnowledgeStore,
+                 clock: Clock | None = None) -> None:
+        self._mu = threading.Lock()
+        self._history: dict[str, RollingAverage] = {}
+        self.capacity_store = store
+        self.clock = clock or SYSTEM_CLOCK
+
+    def name(self) -> str:
+        return "saturation-token-based"
+
+    def evict_stale_history(self, timeout: float) -> int:
+        with self._mu:
+            now = self.clock.now()
+            expired = [k for k, ra in self._history.items()
+                       if now - ra.last_updated > timeout]
+            for k in expired:
+                del self._history[k]
+            return len(expired)
+
+    def analyze(self, input: AnalyzerInput) -> AnalyzerResult:
+        config = input.config
+        if not isinstance(config, SaturationScalingConfig):
+            raise TypeError(f"expected SaturationScalingConfig, got {type(config)}")
+
+        chips_by_variant = {vs.variant_name: vs.chips_per_replica
+                            for vs in input.variant_states}
+
+        # Phase 1: per-replica capacity.
+        replica_capacities = []
+        for rm in input.replica_metrics:
+            rc = self._compute_replica_capacity(
+                rm, config, input.model_id, input.namespace,
+                chips_by_variant.get(rm.variant_name, 0))
+            if rc is not None:
+                replica_capacities.append(rc)
+
+        # Phase 2: per-variant aggregation.
+        variant_capacities = self._aggregate_by_variant(
+            replica_capacities, input.replica_metrics, input.variant_states,
+            input.model_id, input.namespace, config.kv_cache_threshold)
+
+        # Phase 3: model-level aggregation.
+        total_supply = total_anticipated = total_demand = 0.0
+        for vc in variant_capacities:
+            total_supply += vc.total_capacity
+            total_demand += vc.total_demand
+            total_anticipated += (
+                (vc.replica_count + vc.pending_replicas) * vc.per_replica_capacity)
+
+        total_demand += estimate_scheduler_queue_demand(
+            input.scheduler_queue, input.replica_metrics)
+
+        utilization = total_demand / total_supply if total_supply > 0 else 0.0
+
+        # Phase 4: scaling signals.
+        required = 0.0
+        if config.scale_up_threshold > 0:
+            required = total_demand / config.scale_up_threshold - total_anticipated
+        required = max(required, 0.0)
+        spare = 0.0
+        if config.scale_down_boundary > 0:
+            spare = total_supply - total_demand / config.scale_down_boundary
+        spare = max(spare, 0.0)
+
+        return AnalyzerResult(
+            analyzer_name=self.name(),
+            model_id=input.model_id,
+            namespace=input.namespace,
+            analyzed_at=self.clock.now(),
+            variant_capacities=variant_capacities,
+            total_supply=total_supply,
+            total_demand=total_demand,
+            utilization=utilization,
+            required_capacity=required,
+            spare_capacity=spare,
+        )
+
+    def _compute_replica_capacity(
+        self, rm: ReplicaMetrics, config: SaturationScalingConfig,
+        model_id: str, namespace: str, chip_count: int,
+    ) -> ReplicaCapacity | None:
+        if rm.total_kv_capacity_tokens <= 0:
+            return None
+
+        demand = rm.tokens_in_use
+        if rm.avg_input_tokens > 0:
+            demand += int(rm.queue_length * rm.avg_input_tokens)
+        if rm.generate_backlog > 0 and rm.avg_output_tokens > 0:
+            # Disaggregated-serving extension: prefilled requests waiting for
+            # a decode slot will still accrue ~O/2 more KV tokens each.
+            demand += int(rm.generate_backlog * rm.avg_output_tokens / 2)
+
+        k1 = int(rm.total_kv_capacity_tokens * config.kv_cache_threshold)
+
+        existing = self.capacity_store.get(namespace, model_id, rm.variant_name)
+        engine_params = existing.engine_params if existing else None
+        k2 = self._compute_k2(
+            model_id, rm.accelerator_name, rm, config.queue_length_threshold,
+            engine_params, k1)
+
+        effective = min(k1, k2)
+        self.capacity_store.update(namespace, model_id, rm.variant_name, CapacityRecord(
+            accelerator_name=rm.accelerator_name,
+            chip_count=chip_count,
+            num_kv_blocks=rm.num_kv_blocks,
+            block_size=rm.block_size,
+            total_kv_capacity_tokens=rm.total_kv_capacity_tokens,
+            effective_capacity=effective,
+            engine_params=engine_params,
+            learned_from=LEARNED_FROM_LIVE,
+        ))
+        return ReplicaCapacity(
+            pod_name=rm.pod_name,
+            variant_name=rm.variant_name,
+            accelerator_name=rm.accelerator_name,
+            tokens_in_use=rm.tokens_in_use,
+            total_kv_capacity_tokens=rm.total_kv_capacity_tokens,
+            memory_bound_capacity=k1,
+            compute_bound_capacity=k2,
+            effective_capacity=effective,
+            is_saturated=demand >= effective,
+            replica_demand=demand,
+        )
+
+    def _compute_k2(
+        self, model_id: str, accelerator: str, rm: ReplicaMetrics,
+        queue_threshold: float, engine_params: EngineParams | None, k1: int,
+    ) -> int:
+        history_key = f"{model_id}|{accelerator}|{classify_output_length(rm.avg_output_tokens)}"
+
+        # Priority 1: observed under compute saturation — queue at threshold,
+        # or (JetStream) every decode slot busy.
+        compute_saturated = rm.queue_length >= int(queue_threshold) or (
+            rm.slots_total > 0 and rm.slots_used >= rm.slots_total)
+        if compute_saturated and rm.tokens_in_use > 0:
+            with self._mu:
+                ra = self._history.get(history_key)
+                if ra is None:
+                    ra = RollingAverage(ROLLING_AVERAGE_WINDOW_SIZE, self.clock)
+                    self._history[history_key] = ra
+                ra.add(float(rm.tokens_in_use))
+            return rm.tokens_in_use
+
+        # Priority 2: historical rolling average.
+        with self._mu:
+            ra = self._history.get(history_key)
+            hist_avg = ra.average() if ra else 0.0
+        if hist_avg > 0:
+            return int(hist_avg)
+
+        # Priority 3: derived from workload args.
+        derived = estimate_capacity_from_params(
+            engine_params, rm.avg_input_tokens, rm.avg_output_tokens)
+        if derived > 0:
+            return derived
+
+        # Priority 4: fallback to k1.
+        return k1
+
+    def _aggregate_by_variant(
+        self,
+        replica_capacities: list[ReplicaCapacity],
+        input_metrics: list[ReplicaMetrics],
+        variant_states,
+        model_id: str,
+        namespace: str,
+        kv_cache_threshold: float,
+    ) -> list[VariantCapacity]:
+        by_variant: dict[str, list[ReplicaCapacity]] = {}
+        for rc in replica_capacities:
+            by_variant.setdefault(rc.variant_name, []).append(rc)
+
+        variant_cost: dict[str, float] = {}
+        variant_accel: dict[str, str] = {}
+        for rm in input_metrics:
+            variant_cost.setdefault(rm.variant_name, rm.cost)
+            variant_accel.setdefault(rm.variant_name, rm.accelerator_name)
+
+        model_avg_input, model_avg_output, _ = compute_model_workload_averages(
+            input_metrics)
+
+        result = []
+        for vs in variant_states:
+            replicas = by_variant.get(vs.variant_name, [])
+            accelerator = variant_accel.get(vs.variant_name, "")
+            cost = variant_cost.get(vs.variant_name, DEFAULT_VARIANT_COST)
+            ready_count = max(vs.current_replicas - vs.pending_replicas, 0)
+
+            per_replica = 0.0
+            total_demand = 0.0
+            if replicas:
+                capacities = sorted(rc.effective_capacity for rc in replicas)
+                total_demand = float(sum(rc.replica_demand for rc in replicas))
+                per_replica = float(_median(capacities))
+                if not accelerator:
+                    accelerator = replicas[0].accelerator_name
+            else:
+                rec = self.capacity_store.get(namespace, model_id, vs.variant_name)
+                if rec is not None and rec.effective_capacity > 0:
+                    per_replica = self._estimate_stored_capacity(
+                        rec, model_id, kv_cache_threshold,
+                        model_avg_input, model_avg_output)
+                else:
+                    compatible = self._lookup_compatible_capacity(
+                        namespace, model_id, vs.variant_name)
+                    if compatible is not None:
+                        per_replica = float(compatible.effective_capacity)
+
+            total_capacity = ready_count * per_replica
+            result.append(VariantCapacity(
+                variant_name=vs.variant_name,
+                accelerator_name=accelerator,
+                cost=cost,
+                replica_count=ready_count,
+                pending_replicas=vs.pending_replicas,
+                per_replica_capacity=per_replica,
+                total_capacity=total_capacity,
+                total_demand=total_demand,
+                utilization=total_demand / total_capacity if total_capacity > 0 else 0.0,
+            ))
+        return result
+
+    def _lookup_compatible_capacity(self, namespace: str, model_id: str,
+                                    variant_name: str) -> CapacityRecord | None:
+        rec = self.capacity_store.get(namespace, model_id, variant_name)
+        if rec is None or rec.engine_params is None:
+            return None
+        return self.capacity_store.find_compatible(
+            model_id, rec.accelerator_name, rec.chip_count, rec.engine_params)
+
+    def _estimate_stored_capacity(
+        self, rec: CapacityRecord, model_id: str, kv_cache_threshold: float,
+        model_avg_input: float, model_avg_output: float,
+    ) -> float:
+        """Zero-replica estimation (reference :375-411): live records are
+        authoritative; deployment records try the k2 derivation bounded by own
+        k1 and any compatible live sibling; else the stored floor."""
+        if rec.learned_from == LEARNED_FROM_LIVE:
+            return float(rec.effective_capacity)
+        if rec.engine_params is not None and model_avg_output > 0:
+            derived = estimate_capacity_from_params(
+                rec.engine_params, model_avg_input, model_avg_output)
+            if derived > 0:
+                bounded = derived
+                if rec.total_kv_capacity_tokens > 0 and kv_cache_threshold > 0:
+                    k1 = int(rec.total_kv_capacity_tokens * kv_cache_threshold)
+                    if 0 < k1 < bounded:
+                        bounded = k1
+                compatible = self.capacity_store.find_compatible(
+                    model_id, rec.accelerator_name, rec.chip_count,
+                    rec.engine_params)
+                if compatible is not None and \
+                        compatible.learned_from == LEARNED_FROM_LIVE and \
+                        0 < compatible.effective_capacity < bounded:
+                    bounded = compatible.effective_capacity
+                return float(bounded)
+        return float(rec.effective_capacity)
+
+
+def estimate_capacity_from_params(params: EngineParams | None,
+                                  avg_input: float, avg_output: float) -> int:
+    """k2 derivation: N_steady = min(B*O/(I+O), S); cap = N_steady*(I+O/2)
+    (reference :418-437). For JetStream B is the prefill budget and S the
+    decode-slot count (resolved in engine_params)."""
+    if params is None or params.effective_max_batched_tokens <= 0 or avg_output <= 0:
+        return 0
+    b = float(params.effective_max_batched_tokens)
+    s = float(params.max_num_seqs)
+    i, o = avg_input, avg_output
+    n_steady = min(b * o / (i + o), s)
+    derived = int(n_steady * (i + o / 2))
+    return derived if derived > 0 else 0
+
+
+def compute_model_workload_averages(
+    replica_metrics: list[ReplicaMetrics],
+) -> tuple[float, float, float]:
+    """Model-level (avg_input, avg_output, avg_prefix_hit_rate) across live
+    replicas (reference :443-459)."""
+    avg_input = avg_output = avg_hit = 0.0
+    count = 0
+    for rm in replica_metrics:
+        if rm.avg_input_tokens > 0 or rm.avg_output_tokens > 0:
+            avg_input += rm.avg_input_tokens
+            avg_output += rm.avg_output_tokens
+            avg_hit += rm.prefix_cache_hit_rate
+            count += 1
+    if count > 0:
+        avg_input /= count
+        avg_output /= count
+        avg_hit /= count
+    return avg_input, avg_output, avg_hit
+
+
+def estimate_scheduler_queue_demand(
+    sq: SchedulerQueueMetrics | None,
+    replica_metrics: list[ReplicaMetrics],
+) -> float:
+    """Token demand of requests queued upstream in flow control
+    (reference :476-502): input = max(bytes/BytesPerToken, size*avgInput) *
+    (1 - prefixHitRate); output = size*avgOutput."""
+    if sq is None or (sq.queue_size == 0 and sq.queue_bytes == 0):
+        return 0.0
+    avg_input, avg_output, avg_hit = compute_model_workload_averages(replica_metrics)
+    input_tokens = max(sq.queue_bytes / BYTES_PER_TOKEN, sq.queue_size * avg_input)
+    input_tokens *= (1 - avg_hit)
+    output_tokens = sq.queue_size * avg_output
+    return input_tokens + output_tokens
+
+
+def _median(sorted_values: list[int]) -> int:
+    n = len(sorted_values)
+    if n == 0:
+        return 0
+    if n % 2 == 0:
+        return (sorted_values[n // 2 - 1] + sorted_values[n // 2]) // 2
+    return sorted_values[n // 2]

@@ -1,0 +1,132 @@
+"""Capacity knowledge store
+(reference ``saturation_v2/capacity_store.go:16-187``).
+
+Thread-safe cache keyed ``namespace|model|variant`` holding learned
+per-replica capacity. Live data is authoritative; deployment-derived
+estimates seed brand-new variants; ``find_compatible`` matches siblings
+across namespaces on model + accelerator + chip count + engine params.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from wva_tpu.analyzers.saturation_v2.constants import CAPACITY_STALENESS_TIMEOUT
+from wva_tpu.analyzers.saturation_v2.engine_params import EngineParams, parse_engine_args
+from wva_tpu.k8s.objects import Deployment
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+LEARNED_FROM_LIVE = "live"
+LEARNED_FROM_DEPLOYMENT = "deployment"
+
+
+@dataclass
+class CapacityRecord:
+    accelerator_name: str = ""
+    chip_count: int = 0  # chips per replica (reference: GpuCount)
+    num_kv_blocks: int = 0
+    block_size: int = 0
+    total_kv_capacity_tokens: int = 0
+    effective_capacity: int = 0
+    engine_params: EngineParams | None = None
+    learned_from: str = LEARNED_FROM_DEPLOYMENT
+    learned_at: float = 0.0
+
+
+def _store_key(namespace: str, model_id: str, variant_name: str) -> str:
+    # "|" is safe: K8s names are DNS-constrained.
+    return f"{namespace}|{model_id}|{variant_name}"
+
+
+class CapacityKnowledgeStore:
+    def __init__(self, clock: Clock | None = None) -> None:
+        self._mu = threading.RLock()
+        self._records: dict[str, CapacityRecord] = {}
+        self.clock = clock or SYSTEM_CLOCK
+
+    def update(self, namespace: str, model_id: str, variant_name: str,
+               record: CapacityRecord) -> None:
+        """Store/overwrite; live data always goes through here."""
+        with self._mu:
+            record.learned_at = self.clock.now()
+            self._records[_store_key(namespace, model_id, variant_name)] = record
+
+    def get(self, namespace: str, model_id: str, variant_name: str) -> CapacityRecord | None:
+        with self._mu:
+            return self._records.get(_store_key(namespace, model_id, variant_name))
+
+    def is_stale(self, namespace: str, model_id: str, variant_name: str) -> bool:
+        with self._mu:
+            rec = self._records.get(_store_key(namespace, model_id, variant_name))
+            if rec is None:
+                return True
+            return self.clock.now() - rec.learned_at > CAPACITY_STALENESS_TIMEOUT
+
+    def load_from_deployment(self, namespace: str, model_id: str, variant_name: str,
+                             accelerator: str, chip_count: int,
+                             deploy: Deployment | None) -> None:
+        """Seed an estimate from parsed args; never overwrites live data
+        (reference :86-126)."""
+        if deploy is None:
+            return
+        with self._mu:
+            key = _store_key(namespace, model_id, variant_name)
+            existing = self._records.get(key)
+            if existing is not None and existing.learned_from == LEARNED_FROM_LIVE:
+                return
+            params = parse_engine_args(deploy)
+            record = CapacityRecord(
+                accelerator_name=accelerator,
+                chip_count=chip_count,
+                engine_params=params,
+                learned_from=LEARNED_FROM_DEPLOYMENT,
+                learned_at=self.clock.now(),
+            )
+            if params.engine == "vllm" and params.num_gpu_blocks_override > 0:
+                record.num_kv_blocks = params.num_gpu_blocks_override
+                record.block_size = params.block_size
+                record.total_kv_capacity_tokens = (
+                    params.num_gpu_blocks_override * params.block_size)
+            elif params.engine == "jetstream" and params.max_concurrent_decodes > 0 \
+                    and params.tokens_per_slot > 0:
+                record.total_kv_capacity_tokens = (
+                    params.max_concurrent_decodes * params.tokens_per_slot)
+            # Conservative floor so brand-new variants are still considered
+            # for scale-up: the per-step token budget is a safe lower bound.
+            if record.effective_capacity <= 0 and params.effective_max_batched_tokens > 0:
+                record.effective_capacity = params.effective_max_batched_tokens
+            self._records[key] = record
+
+    def evict_stale(self, timeout: float) -> int:
+        with self._mu:
+            now = self.clock.now()
+            expired = [k for k, r in self._records.items()
+                       if now - r.learned_at > timeout]
+            for k in expired:
+                del self._records[k]
+            return len(expired)
+
+    def find_compatible(self, model_id: str, accelerator: str, chip_count: int,
+                        params: EngineParams | None) -> CapacityRecord | None:
+        """Cross-namespace sibling with same model + accelerator + chips +
+        compatible engine params; prefers live records (reference :150-187)."""
+        if params is None:
+            return None
+        with self._mu:
+            best: CapacityRecord | None = None
+            for key, rec in self._records.items():
+                parts = key.split("|", 2)
+                if len(parts) < 3 or parts[1] != model_id:
+                    continue
+                if rec.accelerator_name != accelerator or rec.chip_count != chip_count:
+                    continue
+                if rec.engine_params is None or \
+                        not rec.engine_params.is_capacity_compatible(params):
+                    continue
+                if rec.effective_capacity <= 0 and rec.total_kv_capacity_tokens <= 0:
+                    continue
+                if best is None or (best.learned_from != LEARNED_FROM_LIVE
+                                    and rec.learned_from == LEARNED_FROM_LIVE):
+                    best = rec
+            return best

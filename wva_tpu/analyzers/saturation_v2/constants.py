@@ -1,0 +1,28 @@
+"""V2 analyzer constants (reference ``saturation_v2/constants.go:5-41``)."""
+
+# Samples retained per k2 history bucket.
+ROLLING_AVERAGE_WINDOW_SIZE = 10
+
+# Stored capacity records older than this should be refreshed from live data.
+CAPACITY_STALENESS_TIMEOUT = 30 * 60.0
+
+# Capacity knowledge is kept long (zero-replica weekends scale back Monday).
+CAPACITY_EVICTION_TIMEOUT = 7 * 24 * 3600.0
+
+# k2 history is shorter-lived: stale workload shapes mislead decisions.
+HISTORY_EVICTION_TIMEOUT = 24 * 3600.0
+
+# Approximate bytes per token for scheduler queue-bytes conversion.
+BYTES_PER_TOKEN = 4
+
+# Output-length buckets for k2 history keying.
+SHORT_OUTPUT_THRESHOLD = 100
+MEDIUM_OUTPUT_THRESHOLD = 500
+
+
+def classify_output_length(avg_output_tokens: float) -> str:
+    if avg_output_tokens < SHORT_OUTPUT_THRESHOLD:
+        return "short"
+    if avg_output_tokens < MEDIUM_OUTPUT_THRESHOLD:
+        return "medium"
+    return "long"

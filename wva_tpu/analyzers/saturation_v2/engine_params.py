@@ -1,0 +1,264 @@
+"""Serving-engine argument parsing for capacity derivation.
+
+TPU re-design of the reference's vLLM-only parser
+(``saturation_v2/deployment_parser.go:13-268``): one ``EngineParams`` covers
+both engines the TPU build scales —
+
+- **vLLM-TPU**: same CLI surface as CUDA vLLM (gpu_memory_utilization,
+  block_size, tensor_parallel_size, max_num_batched_tokens, ...), so the
+  reference's parsing semantics transfer unchanged.
+- **JetStream / MaxText**: ``--tpu_topology``, ``--max_concurrent_decodes``,
+  ``--max_prefill_predict_length``, ``--max_target_length``,
+  ``--tokens_per_slot``, ``--prefill_lengths`` — the decode-slot budget plays
+  the role of vLLM's max_num_seqs and the prefill budget plays
+  max_num_batched_tokens in the k2 derivation.
+
+Both engines resolve to the two numbers k2 derivation needs:
+``effective_max_batched_tokens`` (per-step token budget B) and ``max_num_seqs``
+(concurrency ceiling S).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from wva_tpu.k8s.objects import Deployment
+
+# JetStream-specific normalized arg keys used for engine detection.
+_JETSTREAM_KEYS = {
+    "tpu_topology",
+    "max_concurrent_decodes",
+    "tokens_per_slot",
+    "max_prefill_predict_length",
+    "prefill_lengths",
+    "max_target_length",
+}
+
+# vLLM V1 chunked-prefill default per-step budget; V0 default; floor.
+_V1_DEFAULT_BATCHED_TOKENS = 8192
+_V0_DEFAULT_BATCHED_TOKENS = 2048
+
+# JetStream defaults (MaxText serving defaults).
+_JETSTREAM_DEFAULT_CONCURRENT_DECODES = 96
+_JETSTREAM_DEFAULT_TARGET_LENGTH = 2048
+
+
+@dataclass
+class EngineParams:
+    """Engine configuration parsed from a workload's pod template."""
+
+    engine: str = "vllm"  # "vllm" | "jetstream"
+
+    # --- vLLM fields (defaults per vLLM v0.8+; reference :34-44) ---
+    gpu_memory_utilization: float = 0.9
+    block_size: int = 16
+    kv_cache_dtype: str = "auto"
+    tensor_parallel_size: int = 1
+    num_gpu_blocks_override: int = 0
+    max_num_batched_tokens: int = 0
+    max_num_seqs: int = 256
+    max_model_len: int = 0
+    enforce_eager: bool = False
+    is_v1_engine: bool = True
+    chunked_prefill_enabled: bool = True
+
+    # --- JetStream fields ---
+    tpu_topology: str = ""  # e.g. "2x4"
+    max_concurrent_decodes: int = 0
+    tokens_per_slot: int = 0
+    max_prefill_predict_length: int = 0
+    max_target_length: int = 0
+    prefill_lengths: list[int] = field(default_factory=list)
+
+    # Resolved per-step token budget for k2 derivation.
+    effective_max_batched_tokens: int = 0
+
+    def is_capacity_compatible(self, other: "EngineParams | None") -> bool:
+        """Equality on every knob that changes per-replica capacity
+        (reference :225-235, extended with the JetStream knobs)."""
+        if other is None or self.engine != other.engine:
+            return False
+        if self.engine == "jetstream":
+            return (self.tpu_topology == other.tpu_topology
+                    and self.max_concurrent_decodes == other.max_concurrent_decodes
+                    and self.tokens_per_slot == other.tokens_per_slot
+                    and self.max_target_length == other.max_target_length
+                    and self.effective_max_batched_tokens == other.effective_max_batched_tokens)
+        return (self.gpu_memory_utilization == other.gpu_memory_utilization
+                and self.block_size == other.block_size
+                and self.kv_cache_dtype == other.kv_cache_dtype
+                and self.tensor_parallel_size == other.tensor_parallel_size
+                and self.num_gpu_blocks_override == other.num_gpu_blocks_override
+                and self.effective_max_batched_tokens == other.effective_max_batched_tokens)
+
+
+def parse_engine_args(deploy: Deployment | None) -> EngineParams:
+    """Parse engine args + env from a Deployment pod template. Handles
+    ``--k=v`` / ``--k v`` forms, hyphen/underscore normalization,
+    ``/bin/sh -c`` shell-string splitting with quotes, boolean flags, and
+    ``VLLM_USE_V1`` (reference :55-88)."""
+    params = EngineParams()
+    if deploy is None or not deploy.template.containers:
+        _resolve_effective_max_batched_tokens(params)
+        return params
+
+    for container in deploy.template.containers:
+        if container.env.get("VLLM_USE_V1") == "0":
+            params.is_v1_engine = False
+            params.chunked_prefill_enabled = False
+        all_args = _collect_args(container.command, container.args)
+        _parse_args(all_args, params)
+
+    _resolve_effective_max_batched_tokens(params)
+    return params
+
+
+def _collect_args(command: list[str], args: list[str]) -> list[str]:
+    """Merge Command + Args, expanding ["/bin/sh", "-c", "..."] shell strings
+    (reference :93-109)."""
+    all_args = [*command, *args]
+    for i, base in enumerate(all_args[:-2]):
+        if base in ("/bin/sh", "/bin/bash", "sh", "bash") and all_args[i + 1] == "-c":
+            return _split_shell_string(all_args[i + 2])
+    return all_args
+
+
+def _split_shell_string(s: str) -> list[str]:
+    """Basic shell-like splitting honoring single/double quotes; no escape
+    sequences, expansion, or substitution (reference :115-141)."""
+    tokens: list[str] = []
+    current: list[str] = []
+    in_single = in_double = False
+    for ch in s:
+        if ch == "'" and not in_double:
+            in_single = not in_single
+        elif ch == '"' and not in_single:
+            in_double = not in_double
+        elif ch == " " and not in_single and not in_double:
+            if current:
+                tokens.append("".join(current))
+                current = []
+        else:
+            current.append(ch)
+    if current:
+        tokens.append("".join(current))
+    return tokens
+
+
+def _normalize_key(key: str) -> str:
+    return key.lstrip("-").replace("-", "_")
+
+
+def _parse_args(args: list[str], params: EngineParams) -> None:
+    i = 0
+    while i < len(args):
+        arg = args[i]
+        if not arg.startswith("--"):
+            i += 1
+            continue
+        if "=" in arg:
+            raw_key, value = arg.split("=", 1)
+            key = _normalize_key(raw_key)
+        else:
+            key = _normalize_key(arg)
+            value = ""
+            if i + 1 < len(args) and not args[i + 1].startswith("--"):
+                value = args[i + 1]
+                i += 1
+        _apply_param(key, value, params)
+        i += 1
+
+
+def _apply_param(key: str, value: str, params: EngineParams) -> None:
+    """Set the matching field; parse errors silently keep the default
+    (graceful degradation — args are operator-controlled; reference :182-219).
+    """
+    if key in _JETSTREAM_KEYS:
+        params.engine = "jetstream"
+
+    def _int(setter):
+        try:
+            setter(int(float(value)))
+        except (ValueError, TypeError):
+            pass
+
+    if key == "gpu_memory_utilization":
+        try:
+            params.gpu_memory_utilization = float(value)
+        except (ValueError, TypeError):
+            pass
+    elif key == "block_size":
+        _int(lambda v: setattr(params, "block_size", v))
+    elif key == "kv_cache_dtype":
+        params.kv_cache_dtype = value
+    elif key == "tensor_parallel_size":
+        _int(lambda v: setattr(params, "tensor_parallel_size", v))
+    elif key == "num_gpu_blocks_override":
+        _int(lambda v: setattr(params, "num_gpu_blocks_override", v))
+    elif key == "max_num_batched_tokens":
+        _int(lambda v: setattr(params, "max_num_batched_tokens", v))
+    elif key == "max_num_seqs":
+        _int(lambda v: setattr(params, "max_num_seqs", v))
+    elif key == "max_model_len":
+        _int(lambda v: setattr(params, "max_model_len", v))
+    elif key == "enforce_eager":
+        params.enforce_eager = True
+    elif key == "enable_chunked_prefill":
+        params.chunked_prefill_enabled = True
+    elif key == "tpu_topology":
+        params.tpu_topology = value
+    elif key == "max_concurrent_decodes":
+        _int(lambda v: setattr(params, "max_concurrent_decodes", v))
+    elif key == "tokens_per_slot":
+        _int(lambda v: setattr(params, "tokens_per_slot", v))
+    elif key == "max_prefill_predict_length":
+        _int(lambda v: setattr(params, "max_prefill_predict_length", v))
+    elif key == "max_target_length":
+        _int(lambda v: setattr(params, "max_target_length", v))
+    elif key == "prefill_lengths":
+        lengths = []
+        for part in value.split(","):
+            try:
+                lengths.append(int(part))
+            except ValueError:
+                continue
+        if lengths:
+            params.prefill_lengths = lengths
+
+
+def _resolve_effective_max_batched_tokens(params: EngineParams) -> None:
+    """Per-step token budget B for k2 derivation.
+
+    vLLM (reference :246-268): explicit > V1-chunked 8192 > V0-chunked 2048 >
+    max_model_len > 2048.
+    JetStream: explicit prefill budget (max_prefill_predict_length or the
+    largest bucketed prefill length) > max_target_length > default; the
+    concurrency ceiling S becomes max_concurrent_decodes.
+    """
+    if params.engine == "jetstream":
+        if params.max_concurrent_decodes <= 0:
+            params.max_concurrent_decodes = _JETSTREAM_DEFAULT_CONCURRENT_DECODES
+        if params.max_target_length <= 0:
+            params.max_target_length = _JETSTREAM_DEFAULT_TARGET_LENGTH
+        if params.tokens_per_slot <= 0:
+            params.tokens_per_slot = params.max_target_length
+        # S for k2 derivation is the decode-slot count.
+        params.max_num_seqs = params.max_concurrent_decodes
+        if params.max_prefill_predict_length > 0:
+            params.effective_max_batched_tokens = params.max_prefill_predict_length
+        elif params.prefill_lengths:
+            params.effective_max_batched_tokens = max(params.prefill_lengths)
+        else:
+            params.effective_max_batched_tokens = params.max_target_length
+        return
+
+    if params.max_num_batched_tokens > 0:
+        params.effective_max_batched_tokens = params.max_num_batched_tokens
+    elif params.chunked_prefill_enabled:
+        params.effective_max_batched_tokens = (
+            _V1_DEFAULT_BATCHED_TOKENS if params.is_v1_engine
+            else _V0_DEFAULT_BATCHED_TOKENS)
+    elif params.max_model_len > _V0_DEFAULT_BATCHED_TOKENS:
+        params.effective_max_batched_tokens = params.max_model_len
+    else:
+        params.effective_max_batched_tokens = _V0_DEFAULT_BATCHED_TOKENS

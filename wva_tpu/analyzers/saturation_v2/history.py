@@ -1,0 +1,27 @@
+"""Fixed-window rolling average for k2 smoothing
+(reference ``saturation_v2/history.go:8-47``)."""
+
+from __future__ import annotations
+
+from collections import deque
+
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+
+class RollingAverage:
+    def __init__(self, max_size: int, clock: Clock | None = None) -> None:
+        self._values: deque[float] = deque(maxlen=max_size)
+        self._clock = clock or SYSTEM_CLOCK
+        self.last_updated = self._clock.now()
+
+    def add(self, value: float) -> None:
+        self._values.append(value)
+        self.last_updated = self._clock.now()
+
+    def average(self) -> float:
+        if not self._values:
+            return 0.0
+        return sum(self._values) / len(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)

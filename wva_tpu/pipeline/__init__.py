@@ -1,0 +1,35 @@
+"""Decision pipeline stages (reference ``internal/engines/pipeline``)."""
+
+from wva_tpu.pipeline.optimizer import (
+    CostAwareOptimizer,
+    ModelScalingRequest,
+    ScalingOptimizer,
+)
+from wva_tpu.pipeline.enforcer import Enforcer
+from wva_tpu.pipeline.limiter import (
+    AllocationAlgorithm,
+    DefaultLimiter,
+    GreedyBySaturation,
+    Inventory,
+    Limiter,
+    ResourceAllocator,
+    ResourceConstraints,
+    ResourcePool,
+    SliceInventory,
+)
+
+__all__ = [
+    "CostAwareOptimizer",
+    "ModelScalingRequest",
+    "ScalingOptimizer",
+    "Enforcer",
+    "AllocationAlgorithm",
+    "DefaultLimiter",
+    "GreedyBySaturation",
+    "Inventory",
+    "Limiter",
+    "ResourceAllocator",
+    "ResourceConstraints",
+    "ResourcePool",
+    "SliceInventory",
+]

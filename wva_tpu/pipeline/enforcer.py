@@ -1,0 +1,93 @@
+"""Scale-to-zero / minimum-replica enforcement
+(reference ``pipeline/enforcer.go:18-183``).
+
+Fail-safe contract: an unknown request count keeps the current targets —
+scale-to-zero only happens on positive confirmation of zero traffic.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST
+from wva_tpu.config import (
+    ScaleToZeroConfigData,
+    is_scale_to_zero_enabled,
+    scale_to_zero_retention_seconds,
+)
+from wva_tpu.interfaces import VariantSaturationAnalysis
+
+log = logging.getLogger(__name__)
+
+# (model_id, namespace, retention_seconds) -> request count; raises when the
+# count cannot be determined.
+RequestCountFunc = Callable[[str, str, float], float]
+
+
+class Enforcer:
+    def __init__(self, request_count_func: RequestCountFunc) -> None:
+        self.request_count_func = request_count_func
+
+    def enforce_policy(
+        self,
+        model_id: str,
+        namespace: str,
+        saturation_targets: dict[str, int],
+        variant_analyses: list[VariantSaturationAnalysis],
+        scale_to_zero_config: ScaleToZeroConfigData,
+    ) -> tuple[dict[str, int], bool]:
+        """Returns (targets, applied). When scale-to-zero is enabled for the
+        model: zero requests over retention => all targets 0; query error =>
+        keep targets. When disabled: guarantee >= 1 total replica, restored
+        on the cheapest variant."""
+        if is_scale_to_zero_enabled(scale_to_zero_config, model_id):
+            return self._apply_scale_to_zero(
+                model_id, namespace, saturation_targets, scale_to_zero_config)
+        return self._ensure_minimum_replicas(
+            model_id, saturation_targets, variant_analyses)
+
+    def _apply_scale_to_zero(
+        self,
+        model_id: str,
+        namespace: str,
+        targets: dict[str, int],
+        scale_to_zero_config: ScaleToZeroConfigData,
+    ) -> tuple[dict[str, int], bool]:
+        retention = scale_to_zero_retention_seconds(scale_to_zero_config, model_id)
+        try:
+            count = self.request_count_func(model_id, namespace, retention)
+        except Exception as e:  # noqa: BLE001 — fail-safe boundary
+            log.warning("Failed to get request count for %s, keeping targets: %s",
+                        model_id, e)
+            return targets, False
+        if count > 0:
+            return targets, False
+        log.info("No requests for %s/%s in %.0fs retention, scaling to zero",
+                 namespace, model_id, retention)
+        for variant in targets:
+            targets[variant] = 0
+        return targets, True
+
+    @staticmethod
+    def _ensure_minimum_replicas(
+        model_id: str,
+        targets: dict[str, int],
+        variant_analyses: list[VariantSaturationAnalysis],
+    ) -> tuple[dict[str, int], bool]:
+        if sum(targets.values()) > 0:
+            return targets, False
+        costs = {va.variant_name: va.cost for va in variant_analyses}
+        cheapest = ""
+        cheapest_cost = -1.0
+        for variant in targets:
+            cost = costs.get(variant, DEFAULT_VARIANT_COST)
+            if cheapest_cost < 0 or cost < cheapest_cost or \
+                    (cost == cheapest_cost and variant < cheapest):
+                cheapest, cheapest_cost = variant, cost
+        if cheapest:
+            targets[cheapest] = 1
+            log.info("Preserving minimum replica for %s on cheapest variant %s",
+                     model_id, cheapest)
+            return targets, True
+        return targets, False

@@ -1,0 +1,243 @@
+"""Resource limiter: slice-granular inventory x allocation algorithm
+(reference ``pipeline/{limiter_interfaces,default_limiter,type_inventory,
+greedy_saturation_algorithm}.go``).
+
+TPU re-design of the reference's per-GPU-type pooling: the inventory unit is
+the **whole slice**. Each variant pool (e.g. ``v5e-8``) counts chips backed by
+whole schedulable slices; allocation is quantized to a replica's chip
+requirement (= chips per slice for slice-spanning replicas), and a typed
+allocator prevents cross-variant allocation, replacing the reference's
+``normalizeAcceleratorName`` GPU-product matching (type_inventory.go:23-65)
+with the canonical variant names from discovery.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from dataclasses import dataclass, field
+
+from wva_tpu.discovery import TPUSliceDiscovery
+from wva_tpu.interfaces import VariantDecision
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ResourcePool:
+    """Per-variant chip pool."""
+
+    accelerator_type: str = ""
+    limit: int = 0  # chips in whole slices
+    used: int = 0
+
+    @property
+    def available(self) -> int:
+        return max(self.limit - self.used, 0)
+
+
+@dataclass
+class ResourceConstraints:
+    """Per-type availability for the V2 optimizer path."""
+
+    provider_name: str = ""
+    pools: dict[str, ResourcePool] = field(default_factory=dict)
+    total_limit: int = 0
+    total_used: int = 0
+    total_available: int = 0
+
+
+class ResourceAllocator(abc.ABC):
+    """Abstracts reservation granularity for allocation algorithms."""
+
+    @abc.abstractmethod
+    def try_allocate(self, decision: VariantDecision, chips: int) -> int:
+        """Reserve up to ``chips`` for the decision; returns granted count."""
+
+
+class Inventory(abc.ABC):
+    @abc.abstractmethod
+    def refresh(self) -> None: ...
+
+    @abc.abstractmethod
+    def set_used(self, used_by_type: dict[str, int]) -> None: ...
+
+    @abc.abstractmethod
+    def create_allocator(self) -> ResourceAllocator: ...
+
+    @abc.abstractmethod
+    def pools(self) -> dict[str, ResourcePool]: ...
+
+    def total_limit(self) -> int:
+        return sum(p.limit for p in self.pools().values())
+
+    def total_used(self) -> int:
+        return sum(p.used for p in self.pools().values())
+
+    def total_available(self) -> int:
+        return sum(p.available for p in self.pools().values())
+
+
+class AllocationAlgorithm(abc.ABC):
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def allocate(self, decisions: list[VariantDecision],
+                 allocator: ResourceAllocator) -> None: ...
+
+
+class Limiter(abc.ABC):
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def limit(self, decisions: list[VariantDecision]) -> None:
+        """Constrain decisions in place."""
+
+
+class SliceInventory(Inventory):
+    """Chip pools per TPU slice variant, fed by discovery. Only chips that
+    belong to whole schedulable slices count toward the limit."""
+
+    def __init__(self, discovery: TPUSliceDiscovery) -> None:
+        self.discovery = discovery
+        self._pools: dict[str, ResourcePool] = {}
+
+    def refresh(self) -> None:
+        slices = self.discovery.discover_slices()
+        pools = {}
+        for variant, cap in slices.items():
+            pools[variant] = ResourcePool(
+                accelerator_type=variant,
+                limit=cap.total_slices * cap.chips_per_slice,
+                used=self._pools.get(variant, ResourcePool()).used,
+            )
+        self._pools = pools
+
+    def set_used(self, used_by_type: dict[str, int]) -> None:
+        for pool in self._pools.values():
+            pool.used = 0
+        for variant, used in used_by_type.items():
+            pool = self._pools.get(variant)
+            if pool is not None:
+                pool.used = used
+
+    def create_allocator(self) -> ResourceAllocator:
+        return _TypedSliceAllocator(self._pools)
+
+    def pools(self) -> dict[str, ResourcePool]:
+        # Value copies: consumers (the V2 constraint path) may decrement
+        # availability while planning without corrupting inventory state.
+        return {k: ResourcePool(accelerator_type=p.accelerator_type,
+                                limit=p.limit, used=p.used)
+                for k, p in self._pools.items()}
+
+
+class _TypedSliceAllocator(ResourceAllocator):
+    """Allocates only from the decision's own variant pool — cross-type
+    allocation is impossible (reference typeAllocator :337-377)."""
+
+    def __init__(self, pools: dict[str, ResourcePool]) -> None:
+        self._pools = pools
+
+    def try_allocate(self, decision: VariantDecision, chips: int) -> int:
+        pool = self._pools.get(decision.accelerator_name)
+        if pool is None or chips <= 0:
+            return 0
+        granted = min(chips, pool.available)
+        pool.used += granted
+        return granted
+
+
+class GreedyBySaturation(AllocationAlgorithm):
+    """Allocate to the most saturated variants first
+    (reference greedy_saturation_algorithm.go:34-106)."""
+
+    def name(self) -> str:
+        return "greedy-by-saturation"
+
+    def allocate(self, decisions: list[VariantDecision],
+                 allocator: ResourceAllocator) -> None:
+        candidates = [d for d in decisions
+                      if d.target_replicas > d.current_replicas]
+        # Most saturated first (lowest spare), then cheapest.
+        candidates.sort(key=lambda d: (d.spare_capacity, d.cost))
+        for d in candidates:
+            self._allocate_for_decision(d, allocator)
+
+    @staticmethod
+    def _allocate_for_decision(d: VariantDecision,
+                               allocator: ResourceAllocator) -> None:
+        replicas_needed = d.target_replicas - d.current_replicas
+        if replicas_needed <= 0:
+            return
+        chips_per_replica = d.chips_per_replica if d.chips_per_replica > 0 else 1
+        requested = replicas_needed * chips_per_replica
+        granted = allocator.try_allocate(d, requested)
+        # Partial allocation floors to whole replicas (whole slices).
+        replicas_allocated = granted // chips_per_replica
+        d.chips_allocated = replicas_allocated * chips_per_replica
+        d.target_replicas = d.current_replicas + replicas_allocated
+        if replicas_allocated < replicas_needed:
+            d.was_limited = True
+
+
+class DefaultLimiter(Limiter):
+    """Inventory x algorithm (reference default_limiter.go:20-121)."""
+
+    def __init__(self, name: str, inventory: Inventory,
+                 algorithm: AllocationAlgorithm) -> None:
+        self._name = name
+        self.inventory = inventory
+        self.algorithm = algorithm
+
+    def name(self) -> str:
+        return self._name
+
+    def limit(self, decisions: list[VariantDecision]) -> None:
+        if not decisions:
+            return
+        self.inventory.refresh()
+        self.inventory.set_used(self._calculate_used_chips(decisions))
+        allocator = self.inventory.create_allocator()
+        self.algorithm.allocate(decisions, allocator)
+        self._update_metadata(decisions)
+
+    @staticmethod
+    def _calculate_used_chips(decisions: list[VariantDecision]) -> dict[str, int]:
+        used: dict[str, int] = {}
+        for d in decisions:
+            if not d.accelerator_name:
+                continue
+            used[d.accelerator_name] = used.get(d.accelerator_name, 0) + \
+                d.current_replicas * max(d.chips_per_replica, 1)
+        return used
+
+    def _update_metadata(self, decisions: list[VariantDecision]) -> None:
+        for d in decisions:
+            if d.was_limited:
+                d.limited_by = self._name
+            change = d.target_replicas - d.current_replicas
+            if change <= 0:
+                reason = (f"no scale-up (target={d.target_replicas}, "
+                          f"current={d.current_replicas})")
+            elif d.was_limited:
+                reason = (f"limited: allocated {d.chips_allocated} chips "
+                          f"for +{change} replicas")
+            else:
+                reason = f"allocated {d.chips_allocated} chips for +{change} replicas"
+            d.add_step(self._name, reason, d.was_limited)
+
+    def compute_constraints(self, current_usage: dict[str, int]) -> ResourceConstraints:
+        """V2 path: expose availability instead of mutating decisions
+        (reference default_limiter.go:113-135)."""
+        self.inventory.refresh()
+        self.inventory.set_used(current_usage)
+        return ResourceConstraints(
+            provider_name=self._name,
+            pools=self.inventory.pools(),
+            total_limit=self.inventory.total_limit(),
+            total_used=self.inventory.total_used(),
+            total_available=self.inventory.total_available(),
+        )
