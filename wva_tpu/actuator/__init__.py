@@ -1,0 +1,68 @@
+"""Actuation layer (reference ``internal/actuator/{actuator,direct_actuator}.go``).
+
+``Actuator`` emits the ``wva_*`` gauges that external actuators (HPA/KEDA via
+Prometheus Adapter) act on — the only steady-state scaling output.
+``DirectActuator`` writes the scale subresource directly and is used solely by
+scale-from-zero (HPA cannot act on a 0-replica target).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.api.v1alpha1 import VariantAutoscaling
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Deployment
+from wva_tpu.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+
+class Actuator:
+    """Metric-emission actuator (reference actuator.go:16-87)."""
+
+    def __init__(self, client: KubeClient, registry: MetricsRegistry) -> None:
+        self.client = client
+        self.registry = registry
+
+    def emit_metrics(self, va: VariantAutoscaling) -> None:
+        """Read REAL current replicas from the target and emit
+        current/desired/ratio gauges. Raises on missing target (caller logs
+        but never fails the loop on emission errors)."""
+        deploy: Deployment = self.client.get(
+            Deployment.KIND, va.metadata.namespace, va.spec.scale_target_ref.name)
+        current = deploy.status.replicas or deploy.desired_replicas()
+        desired = va.status.desired_optimized_alloc.num_replicas
+        accelerator = va.status.desired_optimized_alloc.accelerator
+        self.registry.emit_replica_metrics(
+            variant_name=va.metadata.name,
+            namespace=va.metadata.namespace,
+            accelerator=accelerator,
+            current=current,
+            desired=desired,
+        )
+
+
+class DirectActuator:
+    """Scale-subresource actuator (reference direct_actuator.go:37-121).
+    Works against any registered scalable kind (Deployment now; JobSet /
+    LeaderWorkerSet adapters for multi-host slices use the same path)."""
+
+    def __init__(self, client: KubeClient) -> None:
+        self.client = client
+
+    def scale_target_object(self, kind: str, namespace: str, name: str,
+                            replicas: int) -> bool:
+        """Set spec.replicas via the scale subresource; returns True when a
+        write happened (False = already at the target)."""
+        try:
+            current = self.client.get(kind, namespace, name)
+        except NotFoundError:
+            raise
+        current_replicas = getattr(current, "replicas", None)
+        if current_replicas == replicas:
+            return False
+        self.client.patch_scale(kind, namespace, name, replicas)
+        log.info("Scaled %s %s/%s: %s -> %d", kind, namespace, name,
+                 current_replicas, replicas)
+        return True

@@ -169,8 +169,10 @@ class SaturationAnalyzer:
             return states.get(name, VariantReplicaState(variant_name=name))
 
         # STEP 1: model-level transition check — block scaling on incomplete
-        # capacity data. Multi-host note: pending_replicas already counts in
-        # slice units (BuildVariantStates divides pods by hosts_per_slice).
+        # capacity data. Multi-host note: replica counts here must be in
+        # SLICE units; Deployment-backed states are pod==slice (hosts_per_
+        # slice=1), and multi-host adapters (JobSet/LWS) must convert pod
+        # counts to slice units before building states.
         in_transition = False
         reasons = []
         for va in analysis.variant_analyses:

@@ -1,0 +1,21 @@
+"""K8s reconcilers (reference ``internal/controller``)."""
+
+from wva_tpu.controller.va_reconciler import VariantAutoscalingReconciler
+from wva_tpu.controller.configmap_reconciler import ConfigMapReconciler
+from wva_tpu.controller.inferencepool_reconciler import InferencePoolReconciler
+from wva_tpu.controller.predicates import (
+    configmap_event_allowed,
+    deployment_event_allowed,
+    namespace_excluded,
+    va_event_allowed,
+)
+
+__all__ = [
+    "VariantAutoscalingReconciler",
+    "ConfigMapReconciler",
+    "InferencePoolReconciler",
+    "configmap_event_allowed",
+    "deployment_event_allowed",
+    "namespace_excluded",
+    "va_event_allowed",
+]

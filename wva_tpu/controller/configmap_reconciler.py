@@ -1,0 +1,99 @@
+"""ConfigMap reconciler + bootstrap
+(reference ``internal/controller/configmap_{reconciler,bootstrap,helpers}.go``).
+
+Keeps the unified Config synced to the well-known ConfigMaps (saturation
+scaling, scale-to-zero), with global (system namespace) + namespace-local
+override scoping. The pre-manager bootstrap read gates the readiness probe.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.config import (
+    Config,
+    detect_immutable_parameter_changes,
+    parse_saturation_configmap,
+    parse_scale_to_zero_configmap,
+    saturation_configmap_name,
+    system_namespace,
+)
+from wva_tpu.config.scale_to_zero import DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME
+from wva_tpu.config.validation import ImmutableParameterError
+from wva_tpu.controller.predicates import configmap_event_allowed
+from wva_tpu.datastore import Datastore
+from wva_tpu.k8s.client import DELETED, KubeClient, NotFoundError
+from wva_tpu.k8s.objects import ConfigMap
+
+log = logging.getLogger(__name__)
+
+
+class ConfigMapReconciler:
+    def __init__(self, client: KubeClient, config: Config,
+                 datastore: Datastore) -> None:
+        self.client = client
+        self.config = config
+        self.datastore = datastore
+
+    def setup(self) -> None:
+        self.client.watch(ConfigMap.KIND, self._on_event)
+
+    def _on_event(self, event: str, cm: ConfigMap) -> None:
+        if event == DELETED:
+            # Namespace-local ConfigMap deleted: fall back to global.
+            if cm.metadata.namespace != system_namespace() and \
+                    cm.metadata.name in (saturation_configmap_name(),
+                                         DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME):
+                self.config.remove_namespace_config(cm.metadata.namespace)
+            return
+        if not configmap_event_allowed(self.client, self.datastore, cm):
+            return
+        self.reconcile(cm)
+
+    def reconcile(self, cm: ConfigMap) -> None:
+        """Classify global vs namespace-local and apply
+        (reference configmap_reconciler.go:49-98)."""
+        ns = cm.metadata.namespace
+        scope_ns = "" if ns == system_namespace() else ns
+        try:
+            if cm.metadata.name == saturation_configmap_name():
+                self._handle_saturation(cm, scope_ns)
+            elif cm.metadata.name == DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME:
+                self._handle_scale_to_zero(cm, scope_ns)
+            self.config.mark_configmaps_bootstrap_complete()
+        except ImmutableParameterError as e:
+            self.config.record_configmaps_sync_error(str(e))
+            log.error("Rejected ConfigMap %s/%s: %s", ns, cm.metadata.name, e)
+
+    def _handle_saturation(self, cm: ConfigMap, scope_ns: str) -> None:
+        detect_immutable_parameter_changes(self.config, cm.data)
+        configs = parse_saturation_configmap(cm.data)
+        self.config.update_saturation_config_for_namespace(scope_ns, configs)
+        log.info("Applied saturation config from %s/%s (%d entries, scope=%s)",
+                 cm.metadata.namespace, cm.metadata.name, len(configs),
+                 scope_ns or "global")
+
+    def _handle_scale_to_zero(self, cm: ConfigMap, scope_ns: str) -> None:
+        parsed = parse_scale_to_zero_configmap(cm.data)
+        self.config.update_scale_to_zero_config_for_namespace(scope_ns, parsed)
+        log.info("Applied scale-to-zero config from %s/%s (%d models, scope=%s)",
+                 cm.metadata.namespace, cm.metadata.name, len(parsed),
+                 scope_ns or "global")
+
+    def bootstrap_initial_configmaps(self) -> bool:
+        """Pre-manager read of the global ConfigMaps; marks bootstrap state
+        that gates readiness (reference configmap_bootstrap.go:16-61).
+        Missing ConfigMaps are not an error (defaults apply)."""
+        ns = system_namespace()
+        found_any = False
+        for name in (saturation_configmap_name(), DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME):
+            try:
+                cm = self.client.get(ConfigMap.KIND, ns, name)
+            except NotFoundError:
+                log.info("Bootstrap: ConfigMap %s/%s not found, using defaults",
+                         ns, name)
+                continue
+            self.reconcile(cm)
+            found_any = True
+        self.config.mark_configmaps_bootstrap_complete()
+        return found_any

@@ -1,0 +1,47 @@
+"""InferencePool reconciler
+(reference ``internal/controller/inferencepool_reconciler.go:41-103``).
+
+Watches InferencePools (v1 or v1alpha2, chosen by POOL_GROUP), converts them
+to EndpointPools, and stores them in the datastore — which spins up the EPP
+pod-scraping source for the pool.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.datastore import Datastore
+from wva_tpu.k8s.client import DELETED, KubeClient
+from wva_tpu.k8s.objects import InferencePool
+from wva_tpu.utils.pool import endpoint_pool_from_inference_pool
+
+log = logging.getLogger(__name__)
+
+
+class InferencePoolReconciler:
+    def __init__(self, client: KubeClient, datastore: Datastore) -> None:
+        self.client = client
+        self.datastore = datastore
+
+    def setup(self) -> None:
+        self.client.watch(InferencePool.KIND, self._on_event)
+        # Seed from existing pools.
+        for pool in self.client.list(InferencePool.KIND):
+            self.reconcile(pool)
+
+    def _on_event(self, event: str, pool: InferencePool) -> None:
+        if event == DELETED:
+            self.datastore.pool_delete(pool.metadata.name)
+            self.datastore.namespace_untrack(
+                InferencePool.KIND, pool.metadata.name, pool.metadata.namespace)
+            return
+        self.reconcile(pool)
+
+    def reconcile(self, pool: InferencePool) -> None:
+        endpoint_pool = endpoint_pool_from_inference_pool(pool)
+        self.datastore.pool_set(endpoint_pool)
+        self.datastore.namespace_track(
+            InferencePool.KIND, pool.metadata.name, pool.metadata.namespace)
+        log.info("Registered InferencePool %s/%s (EPP service %s)",
+                 pool.metadata.namespace, pool.metadata.name,
+                 endpoint_pool.endpoint_picker.service_name)

@@ -1,0 +1,85 @@
+"""Event filters (reference ``internal/controller/predicates.go:31-243``)."""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.api.v1alpha1 import VariantAutoscaling
+from wva_tpu.config import configmap_name, saturation_configmap_name, system_namespace
+from wva_tpu.config.scale_to_zero import DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME
+from wva_tpu.constants import (
+    CONTROLLER_INSTANCE_LABEL_KEY,
+    NAMESPACE_CONFIG_ENABLED_LABEL_KEY,
+    NAMESPACE_EXCLUDE_ANNOTATION_KEY,
+)
+from wva_tpu.k8s.client import ADDED, DELETED, KubeClient, NotFoundError
+from wva_tpu.k8s.objects import ConfigMap, Namespace
+from wva_tpu.utils.variant import get_controller_instance
+
+log = logging.getLogger(__name__)
+
+
+def namespace_excluded(client: KubeClient, namespace: str) -> bool:
+    """Namespace opted out via the exclude annotation
+    (reference configmap_helpers.go isNamespaceExcluded)."""
+    if not namespace:
+        return False
+    try:
+        ns: Namespace = client.get(Namespace.KIND, "", namespace)
+    except NotFoundError:
+        return False
+    return ns.metadata.annotations.get(NAMESPACE_EXCLUDE_ANNOTATION_KEY) == "true"
+
+
+def namespace_config_enabled(client: KubeClient, namespace: str) -> bool:
+    """Namespace opted IN for namespace-local ConfigMaps via label."""
+    if not namespace:
+        return False
+    try:
+        ns: Namespace = client.get(Namespace.KIND, "", namespace)
+    except NotFoundError:
+        return False
+    return ns.metadata.labels.get(NAMESPACE_CONFIG_ENABLED_LABEL_KEY) == "true"
+
+
+def va_event_allowed(client: KubeClient, event: str, va: VariantAutoscaling) -> bool:
+    """VA predicate (reference predicates.go:101+): only CREATE events pass
+    (the periodic loop covers update/delete); excluded namespaces and foreign
+    controller instances are filtered."""
+    if event != ADDED:
+        return False
+    if namespace_excluded(client, va.metadata.namespace):
+        return False
+    instance = get_controller_instance()
+    if instance and va.metadata.labels.get(CONTROLLER_INSTANCE_LABEL_KEY) != instance:
+        return False
+    return True
+
+
+def deployment_event_allowed(event: str) -> bool:
+    """Only create/delete Deployment events matter — spec changes flow
+    through the periodic loop (reference predicates.go deployment filter)."""
+    return event in (ADDED, DELETED)
+
+
+def well_known_configmap_names() -> set[str]:
+    return {
+        configmap_name(),
+        saturation_configmap_name(),
+        DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME,
+    }
+
+
+def configmap_event_allowed(client: KubeClient, datastore, cm: ConfigMap) -> bool:
+    """ConfigMap filter: well-known names, in the system namespace or a
+    tracked/opted-in namespace (reference predicates.go:31-99)."""
+    if cm.metadata.name not in well_known_configmap_names():
+        return False
+    ns = cm.metadata.namespace
+    if ns == system_namespace():
+        return True
+    if namespace_excluded(client, ns):
+        return False
+    if datastore is not None and datastore.is_namespace_tracked(ns):
+        return True
+    return namespace_config_enabled(client, ns)

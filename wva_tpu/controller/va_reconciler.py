@@ -1,0 +1,139 @@
+"""VariantAutoscaling reconciler
+(reference ``internal/controller/variantautoscaling_controller.go:90-367``).
+
+Event-driven status writer: resolves the scale target (TargetResolved
+condition), consumes the engine's DecisionCache into
+``status.desiredOptimizedAlloc`` + MetricsAvailable condition, and tracks the
+namespace for ConfigMap watching. Triggered by VA creates, Deployment
+create/delete (mapped through the scale-target index), and DecisionTrigger
+events from the engines.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+
+from wva_tpu.api.v1alpha1 import (
+    REASON_TARGET_FOUND,
+    REASON_TARGET_NOT_FOUND,
+    TYPE_METRICS_AVAILABLE,
+    TYPE_TARGET_RESOLVED,
+    VariantAutoscaling,
+)
+from wva_tpu.datastore import Datastore
+from wva_tpu.engines import common
+from wva_tpu.indexers import Indexer
+from wva_tpu.k8s.client import ADDED, DELETED, KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Deployment
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from wva_tpu.utils.variant import update_va_status_with_backoff
+from wva_tpu.controller.predicates import deployment_event_allowed, va_event_allowed
+
+log = logging.getLogger(__name__)
+
+
+class VariantAutoscalingReconciler:
+    def __init__(self, client: KubeClient, datastore: Datastore,
+                 indexer: Indexer, clock: Clock | None = None) -> None:
+        self.client = client
+        self.datastore = datastore
+        self.indexer = indexer
+        self.clock = clock or SYSTEM_CLOCK
+
+    # --- wiring (reference SetupWithManager :291-319) ---
+
+    def setup(self) -> None:
+        self.client.watch(VariantAutoscaling.kind, self._on_va_event)
+        self.client.watch(Deployment.KIND, self._on_deployment_event)
+
+    def _on_va_event(self, event: str, va: VariantAutoscaling) -> None:
+        if event == DELETED:
+            self.datastore.namespace_untrack(
+                VariantAutoscaling.kind, va.metadata.name, va.metadata.namespace)
+            common.DecisionCache.delete(va.metadata.name, va.metadata.namespace)
+            return
+        if not va_event_allowed(self.client, event, va):
+            return
+        self.reconcile(va.metadata.name, va.metadata.namespace)
+
+    def _on_deployment_event(self, event: str, deploy: Deployment) -> None:
+        """Map Deployment create/delete to the owning VA via the index
+        (reference handleDeploymentEvent :258-288)."""
+        if not deployment_event_allowed(event):
+            return
+        try:
+            va = self.indexer.find_va_for_deployment(
+                deploy.metadata.name, deploy.metadata.namespace)
+        except Exception as e:  # noqa: BLE001
+            log.debug("deployment->VA mapping failed: %s", e)
+            return
+        if va is not None:
+            self.reconcile(va.metadata.name, va.metadata.namespace)
+
+    def drain_triggers(self, max_events: int = 1000) -> int:
+        """Consume pending DecisionTrigger events (the channel-watch analogue;
+        reference SetupWithManager :313). Returns processed count."""
+        processed = 0
+        while processed < max_events:
+            try:
+                ev = common.DecisionTrigger.get_nowait()
+            except queue.Empty:
+                break
+            self.reconcile(ev.name, ev.namespace)
+            processed += 1
+        return processed
+
+    def run_trigger_loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            try:
+                ev = common.DecisionTrigger.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self.reconcile(ev.name, ev.namespace)
+            except Exception as e:  # noqa: BLE001
+                log.error("reconcile %s/%s failed: %s", ev.namespace, ev.name, e)
+
+    # --- reconcile (reference :90-235) ---
+
+    def reconcile(self, name: str, namespace: str) -> None:
+        try:
+            va = self.client.get(VariantAutoscaling.kind, namespace, name)
+        except NotFoundError:
+            self.datastore.namespace_untrack(VariantAutoscaling.kind, name, namespace)
+            common.DecisionCache.delete(name, namespace)
+            return
+        if va.metadata.deletion_timestamp is not None:
+            self.datastore.namespace_untrack(VariantAutoscaling.kind, name, namespace)
+            return
+
+        self.datastore.namespace_track(VariantAutoscaling.kind, name, namespace)
+        now = self.clock.now()
+
+        # Resolve target Deployment -> TargetResolved condition.
+        try:
+            self.client.get(Deployment.KIND, namespace, va.spec.scale_target_ref.name)
+            va.set_condition(TYPE_TARGET_RESOLVED, "True", REASON_TARGET_FOUND,
+                             f"Scale target {va.spec.scale_target_ref.name} found",
+                             now=now)
+        except NotFoundError:
+            va.set_condition(TYPE_TARGET_RESOLVED, "False", REASON_TARGET_NOT_FOUND,
+                             f"Scale target {va.spec.scale_target_ref.name} not found",
+                             now=now)
+            update_va_status_with_backoff(self.client, va)
+            return
+
+        # Consume the engine's decision.
+        decision = common.DecisionCache.get(name, namespace)
+        if decision is not None:
+            if decision.accelerator_name or decision.target_replicas:
+                va.status.desired_optimized_alloc = \
+                    common.decision_to_optimized_alloc(decision)
+            va.set_condition(
+                TYPE_METRICS_AVAILABLE,
+                "True" if decision.metrics_available else "False",
+                decision.metrics_reason or "MetricsMissing",
+                decision.metrics_message, now=now)
+        update_va_status_with_backoff(self.client, va)

@@ -13,7 +13,7 @@ from wva_tpu.constants.labels import (
     TPU_RESOURCE_NAME,
 )
 from wva_tpu.k8s.client import KubeClient
-from wva_tpu.k8s.objects import Node, Pod
+from wva_tpu.k8s.objects import Node, Pod, parse_quantity
 
 log = logging.getLogger(__name__)
 
@@ -138,7 +138,7 @@ class TPUSliceDiscovery:
             labels = node.metadata.labels
             if GKE_TPU_ACCELERATOR_NODE_LABEL not in labels or not node.ready:
                 continue
-            chips = _int_quantity(node.status.allocatable.get(TPU_RESOURCE_NAME, "0"))
+            chips = parse_quantity(node.status.allocatable.get(TPU_RESOURCE_NAME, "0"))
             info = parse_tpu_topology(
                 labels.get(GKE_TPU_ACCELERATOR_NODE_LABEL, ""),
                 labels.get(GKE_TPU_TOPOLOGY_NODE_LABEL, ""),
@@ -241,19 +241,13 @@ class TPUSliceDiscovery:
     @staticmethod
     def _pod_tpu_request(pod: Pod) -> int:
         app = sum(
-            _int_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+            parse_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
             for c in pod.spec.containers
         )
         init = max(
-            (_int_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+            (parse_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
              for c in pod.spec.init_containers),
             default=0,
         )
         return max(app, init)
 
-
-def _int_quantity(raw: str) -> int:
-    try:
-        return int(float(raw))
-    except (TypeError, ValueError):
-        return 0

@@ -1,0 +1,1 @@
+"""Periodic optimization engines (reference ``internal/engines``)."""

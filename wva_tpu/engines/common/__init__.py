@@ -1,0 +1,74 @@
+"""Engine <-> controller bus (reference ``internal/engines/common/cache.go:14-53``).
+
+The engine never writes VA status through the API from inside the loop;
+it publishes decisions into the process-global ``DecisionCache`` and pokes
+the reconciler through the bounded ``DecisionTrigger`` queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+
+from wva_tpu.api.v1alpha1 import OptimizedAlloc
+from wva_tpu.interfaces import VariantDecision
+
+DECISION_TRIGGER_BUFFER = 1000
+
+
+@dataclass
+class TriggerEvent:
+    """GenericEvent analogue: identifies the VA to reconcile."""
+
+    name: str
+    namespace: str
+
+
+class DecisionCacheType:
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._decisions: dict[str, VariantDecision] = {}
+
+    @staticmethod
+    def _key(name: str, namespace: str) -> str:
+        return f"{namespace}/{name}"
+
+    def set(self, name: str, namespace: str, decision: VariantDecision) -> None:
+        with self._mu:
+            self._decisions[self._key(name, namespace)] = decision
+
+    def get(self, name: str, namespace: str) -> VariantDecision | None:
+        with self._mu:
+            return self._decisions.get(self._key(name, namespace))
+
+    def delete(self, name: str, namespace: str) -> None:
+        with self._mu:
+            self._decisions.pop(self._key(name, namespace), None)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._decisions.clear()
+
+
+def decision_to_optimized_alloc(decision: VariantDecision) -> OptimizedAlloc:
+    return OptimizedAlloc(
+        accelerator=decision.accelerator_name,
+        num_replicas=decision.target_replicas,
+        last_run_time=decision.last_run_time,
+    )
+
+
+# Process-global bus (reference cache.go:40-46).
+DecisionCache = DecisionCacheType()
+DecisionTrigger: "queue.Queue[TriggerEvent]" = queue.Queue(maxsize=DECISION_TRIGGER_BUFFER)
+
+
+def fire_trigger(name: str, namespace: str) -> bool:
+    """Non-blocking send; drops when the buffer is full (the periodic loop
+    will cover missed triggers). Returns False on drop."""
+    try:
+        DecisionTrigger.put_nowait(TriggerEvent(name=name, namespace=namespace))
+        return True
+    except queue.Full:
+        return False

@@ -1,0 +1,79 @@
+"""Polling executor (reference ``internal/engines/executor/{executor,polling}.go``).
+
+Fixed-interval loop; each tick retries the task with capped exponential
+backoff until it succeeds or the stop signal fires (reference: infinite
+retry, x2 factor, 4s cap). ``run_once``/``tick`` support single-threaded
+simulation under a FakeClock.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import threading
+from typing import Callable
+
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+RETRY_INITIAL_SECONDS = 0.25
+RETRY_FACTOR = 2.0
+RETRY_CAP_SECONDS = 4.0
+
+
+class Executor(abc.ABC):
+    @abc.abstractmethod
+    def start(self, stop: threading.Event) -> None:
+        """Run until the stop event is set."""
+
+
+class PollingExecutor(Executor):
+    def __init__(self, task: Callable[[], None], interval: float,
+                 clock: Clock | None = None, name: str = "engine",
+                 max_retries_per_tick: int | None = None) -> None:
+        self.task = task
+        self.interval = interval
+        self.clock = clock or SYSTEM_CLOCK
+        self.name = name
+        # None = retry forever within the tick (reference behavior); bounded
+        # values are for simulation.
+        self.max_retries_per_tick = max_retries_per_tick
+
+    def tick(self, stop: threading.Event | None = None) -> None:
+        """Execute the task once, retrying with backoff on failure."""
+        delay = RETRY_INITIAL_SECONDS
+        attempt = 0
+        while True:
+            if stop is not None and stop.is_set():
+                return
+            try:
+                self.task()
+                return
+            except Exception as e:  # noqa: BLE001 — retry boundary
+                attempt += 1
+                log.warning("%s tick failed (attempt %d): %s",
+                            self.name, attempt, e)
+                if (self.max_retries_per_tick is not None
+                        and attempt >= self.max_retries_per_tick):
+                    return
+                self.clock.sleep(delay)
+                delay = min(delay * RETRY_FACTOR, RETRY_CAP_SECONDS)
+
+    def start(self, stop: threading.Event) -> None:
+        from wva_tpu.utils.clock import FakeClock
+
+        simulated = isinstance(self.clock, FakeClock)
+        while not stop.is_set():
+            self.tick(stop)
+            if simulated:
+                self.clock.sleep(self.interval)
+            else:
+                # Interruptible wall-clock sleep.
+                stop.wait(self.interval)
+
+    def start_in_thread(self, stop: threading.Event) -> threading.Thread:
+        thread = threading.Thread(target=self.start, args=(stop,),
+                                  name=f"{self.name}-loop", daemon=True)
+        thread.start()
+        return thread

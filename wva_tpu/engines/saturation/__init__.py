@@ -1,0 +1,5 @@
+"""Saturation engine (reference ``internal/engines/saturation``)."""
+
+from wva_tpu.engines.saturation.engine import SaturationEngine
+
+__all__ = ["SaturationEngine"]

@@ -1,0 +1,557 @@
+"""The main optimization loop
+(reference ``internal/engines/saturation/{engine,engine_v2}.go``).
+
+Per tick: list active VAs -> group by model -> per-model data preparation
+(deployments, costs, live metrics, variant states with pending replicas and
+chips-per-replica from pod TPU requests) -> V1 or V2 analysis path (selected
+by ``analyzerName`` in the default saturation config) -> enforcer -> (V1,
+optional) slice limiter -> apply: update VA status + conditions, emit
+``wva_*`` gauges, publish to DecisionCache, fire DecisionTrigger.
+
+Failure safety net: when analysis fails for a model, previous-desired or
+current replicas are still emitted so the external HPA never starves
+(reference engine.go:1022-1095).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from wva_tpu.actuator import Actuator
+from wva_tpu.analyzers.saturation import SaturationAnalyzer
+from wva_tpu.analyzers.saturation_v2 import (
+    CapacityKnowledgeStore,
+    SaturationV2Analyzer,
+)
+from wva_tpu.api.v1alpha1 import (
+    OptimizedAlloc,
+    REASON_OPTIMIZATION_SUCCEEDED,
+    TYPE_OPTIMIZATION_READY,
+    REASON_METRICS_FOUND,
+    REASON_METRICS_MISSING,
+    VariantAutoscaling,
+)
+from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
+from wva_tpu.config import Config
+from wva_tpu.constants import TPU_RESOURCE_NAME
+from wva_tpu.engines import common
+from wva_tpu.engines.executor import PollingExecutor
+from wva_tpu.interfaces import (
+    ACTION_NO_CHANGE,
+    ACTION_SCALE_DOWN,
+    ACTION_SCALE_UP,
+    AnalyzerInput,
+    ModelSaturationAnalysis,
+    ReplicaMetrics,
+    SaturationScalingConfig,
+    VariantDecision,
+    VariantReplicaState,
+    VariantSaturationAnalysis,
+)
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Deployment, parse_quantity
+from wva_tpu.pipeline import (
+    CostAwareOptimizer,
+    Enforcer,
+    Limiter,
+    ModelScalingRequest,
+    ScalingOptimizer,
+)
+from wva_tpu.utils import variant as variant_utils
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from wva_tpu.utils.variant import namespaced_key
+
+log = logging.getLogger(__name__)
+
+DEFAULT_ENGINE_POLL_INTERVAL = 30.0  # reference engine.go:147
+
+METRICS_REASON_AVAILABLE = REASON_METRICS_FOUND
+METRICS_REASON_UNAVAILABLE = REASON_METRICS_MISSING
+METRICS_MESSAGE_AVAILABLE = "Saturation metrics data is available for scaling decisions"
+METRICS_MESSAGE_UNAVAILABLE = (
+    "No saturation metrics available - pods may not be ready or metrics not yet scraped")
+
+
+@dataclass
+class _ModelData:
+    """Pre-processed per-model inputs shared by V1/V2 (reference engine.go:662-672)."""
+
+    model_id: str = ""
+    namespace: str = ""
+    replica_metrics: list[ReplicaMetrics] = field(default_factory=list)
+    deployments: dict[str, Deployment] = field(default_factory=dict)
+    variant_autoscalings: dict[str, VariantAutoscaling] = field(default_factory=dict)
+    variant_costs: dict[str, float] = field(default_factory=dict)
+    variant_states: list[VariantReplicaState] = field(default_factory=list)
+
+
+class SaturationEngine:
+    def __init__(
+        self,
+        client: KubeClient,
+        config: Config,
+        collector: ReplicaMetricsCollector,
+        actuator: Actuator,
+        enforcer: Enforcer,
+        limiter: Limiter | None = None,
+        optimizer: ScalingOptimizer | None = None,
+        capacity_store: CapacityKnowledgeStore | None = None,
+        clock: Clock | None = None,
+        poll_interval: float = DEFAULT_ENGINE_POLL_INTERVAL,
+    ) -> None:
+        self.client = client
+        self.config = config
+        self.collector = collector
+        self.actuator = actuator
+        self.enforcer = enforcer
+        self.limiter = limiter
+        self.clock = clock or SYSTEM_CLOCK
+        self.v1_analyzer = SaturationAnalyzer(clock=self.clock)
+        self.capacity_store = capacity_store or CapacityKnowledgeStore(clock=self.clock)
+        self.v2_analyzer = SaturationV2Analyzer(self.capacity_store, clock=self.clock)
+        self.optimizer = optimizer or CostAwareOptimizer()
+        self.executor = PollingExecutor(self.optimize, poll_interval,
+                                        clock=self.clock, name="saturation-engine")
+
+    # --- loop entry ---
+
+    def start_optimize_loop(self, stop) -> None:
+        self.executor.start(stop)
+
+    def optimize(self) -> None:
+        """One optimization tick (reference engine.go:171-277)."""
+        active_vas = variant_utils.active_variant_autoscalings(self.client)
+        if not active_vas:
+            log.debug("No active VariantAutoscalings, skipping optimization")
+            return
+
+        model_groups = variant_utils.group_variant_autoscalings_by_model(active_vas)
+        va_map = {namespaced_key(va.metadata.namespace, va.metadata.name): va
+                  for va in active_vas}
+
+        use_v2 = False
+        global_cfg = self.config.saturation_config().get("default")
+        if global_cfg is not None:
+            global_cfg.apply_defaults()
+            use_v2 = global_cfg.analyzer_name == "saturation"
+
+        if use_v2:
+            decisions = self._optimize_v2(model_groups)
+        else:
+            decisions = self._optimize_v1(model_groups)
+
+        self._apply_decisions(decisions, va_map)
+
+    # --- V1 path ---
+
+    def _optimize_v1(
+        self, model_groups: dict[str, list[VariantAutoscaling]],
+    ) -> list[VariantDecision]:
+        all_decisions: list[VariantDecision] = []
+        for group_key in sorted(model_groups):
+            model_vas = model_groups[group_key]
+            model_id = model_vas[0].spec.model_id
+            namespace = model_vas[0].metadata.namespace
+
+            sat_cfg_map = self.config.saturation_config_for_namespace(namespace)
+            sat_cfg = sat_cfg_map.get("default")
+            if sat_cfg is None:
+                log.info("No default saturation config for namespace %s; "
+                         "skipping model %s", namespace, model_id)
+                continue
+
+            try:
+                data = self._prepare_model_data(model_id, model_vas)
+            except Exception as e:  # noqa: BLE001 — per-model isolation
+                log.error("Model data preparation failed for %s: %s", model_id, e)
+                self._emit_safety_net_metrics(model_vas)
+                continue
+            if data is None:
+                continue
+
+            analysis = self.v1_analyzer.analyze_model_saturation(
+                model_id, namespace, data.replica_metrics, sat_cfg)
+            targets = self.v1_analyzer.calculate_saturation_targets(
+                analysis, data.variant_states)
+
+            s2z_cfg = self.config.scale_to_zero_config_for_namespace(namespace)
+            targets, scaled_to_zero = self.enforcer.enforce_policy(
+                model_id, namespace, targets, analysis.variant_analyses, s2z_cfg)
+            if scaled_to_zero:
+                log.info("Scale-to-zero enforcement applied for %s", model_id)
+
+            all_decisions.extend(self._targets_to_decisions(
+                targets, analysis, data.variant_states))
+
+        # Optional slice limiter (V1 path only; reference engine.go:363-395).
+        global_cfg = self.config.saturation_config().get("default")
+        if (global_cfg is not None and global_cfg.enable_limiter
+                and self.limiter is not None and all_decisions):
+            try:
+                self.limiter.limit(all_decisions)
+            except Exception as e:  # noqa: BLE001
+                log.error("Limiter failed, proceeding with original decisions: %s", e)
+        return all_decisions
+
+    # --- V2 path ---
+
+    def _optimize_v2(
+        self, model_groups: dict[str, list[VariantAutoscaling]],
+    ) -> list[VariantDecision]:
+        requests: list[ModelScalingRequest] = []
+        for group_key in sorted(model_groups):
+            model_vas = model_groups[group_key]
+            model_id = model_vas[0].spec.model_id
+            namespace = model_vas[0].metadata.namespace
+
+            sat_cfg = self.config.saturation_config_for_namespace(namespace).get("default")
+            if sat_cfg is None:
+                log.info("No default saturation config for namespace %s; "
+                         "skipping model %s", namespace, model_id)
+                continue
+            sat_cfg.apply_defaults()
+
+            try:
+                data = self._prepare_model_data(model_id, model_vas)
+            except Exception as e:  # noqa: BLE001
+                log.error("Model data preparation failed for %s: %s", model_id, e)
+                self._emit_safety_net_metrics(model_vas)
+                continue
+            if data is None:
+                continue
+
+            try:
+                result = self._run_v2_analysis(model_id, namespace, data, sat_cfg)
+            except Exception as e:  # noqa: BLE001
+                log.error("V2 analysis failed for %s: %s", model_id, e)
+                self._emit_safety_net_metrics(model_vas)
+                continue
+            requests.append(ModelScalingRequest(
+                model_id=model_id, namespace=namespace, result=result,
+                variant_states=data.variant_states))
+
+        if not requests:
+            return []
+
+        decisions = self.optimizer.optimize(requests, None)
+
+        # Enforcer bridge per model (reference engine_v2.go:76-127).
+        for req in requests:
+            s2z_cfg = self.config.scale_to_zero_config_for_namespace(req.namespace)
+            targets = {d.variant_name: d.target_replicas for d in decisions
+                       if d.model_id == req.model_id and d.namespace == req.namespace}
+            analyses = [
+                VariantSaturationAnalysis(
+                    variant_name=d.variant_name, accelerator_name=d.accelerator_name,
+                    cost=d.cost, replica_count=d.current_replicas)
+                for d in decisions
+                if d.model_id == req.model_id and d.namespace == req.namespace
+            ]
+            enforced, scaled_to_zero = self.enforcer.enforce_policy(
+                req.model_id, req.namespace, targets, analyses, s2z_cfg)
+            if scaled_to_zero:
+                log.info("Scale-to-zero enforcement applied (V2) for %s", req.model_id)
+            for d in decisions:
+                if d.model_id != req.model_id or d.namespace != req.namespace:
+                    continue
+                target = enforced.get(d.variant_name)
+                if target is not None and target != d.target_replicas:
+                    d.target_replicas = target
+                    if target > d.current_replicas:
+                        d.action = ACTION_SCALE_UP
+                    elif target < d.current_replicas:
+                        d.action = ACTION_SCALE_DOWN
+                    else:
+                        d.action = ACTION_NO_CHANGE
+                    d.reason = (f"V2 {d.action} (optimizer: "
+                                f"{self.optimizer.name()}, enforced)")
+        return decisions
+
+    def _run_v2_analysis(self, model_id: str, namespace: str, data: _ModelData,
+                         sat_cfg: SaturationScalingConfig):
+        # Pre-populate capacity store from deployment args (engine_v2.go:31-45).
+        for key, va in data.variant_autoscalings.items():
+            deploy = data.deployments.get(
+                namespaced_key(va.metadata.namespace, va.spec.scale_target_ref.name))
+            if deploy is None:
+                continue
+            accelerator = variant_utils.get_accelerator_type(va)
+            chips = get_deployment_chips_per_replica(deploy)
+            self.capacity_store.load_from_deployment(
+                namespace, model_id, va.metadata.name, accelerator, chips, deploy)
+
+        scheduler_queue = self.collector.collect_scheduler_queue_metrics(model_id)
+        return self.v2_analyzer.analyze(AnalyzerInput(
+            model_id=model_id, namespace=namespace,
+            replica_metrics=data.replica_metrics,
+            variant_states=data.variant_states,
+            config=sat_cfg,
+            scheduler_queue=scheduler_queue,
+        ))
+
+    # --- shared data preparation ---
+
+    def _prepare_model_data(
+        self, model_id: str, model_vas: list[VariantAutoscaling],
+    ) -> _ModelData | None:
+        """Collect metrics + build lookup maps (reference engine.go:677-803).
+        Returns None when no metrics are available (skip the model)."""
+        if not model_vas:
+            raise ValueError(f"no VAs provided for model {model_id}")
+        namespace = model_vas[0].metadata.namespace
+
+        deployments: dict[str, Deployment] = {}
+        variant_autoscalings: dict[str, VariantAutoscaling] = {}
+        variant_costs: dict[str, float] = {}
+        for va in model_vas:
+            key = namespaced_key(va.metadata.namespace, va.metadata.name)
+            variant_autoscalings[key] = va
+            variant_costs[key] = va.spec.cost()
+            try:
+                deploy = variant_utils.get_deployment_with_backoff(
+                    self.client, va.spec.scale_target_ref.name, va.metadata.namespace)
+            except NotFoundError:
+                log.debug("No deployment for VA %s", va.metadata.name)
+                continue
+            deployments[namespaced_key(va.metadata.namespace,
+                                       deploy.metadata.name)] = deploy
+
+        replica_metrics = self.collector.collect_replica_metrics(
+            model_id, namespace, deployments, variant_autoscalings, variant_costs)
+        if not replica_metrics:
+            log.debug("No replica metrics for model %s", model_id)
+            return None
+
+        variant_states = self.build_variant_states(model_vas, deployments)
+        return _ModelData(
+            model_id=model_id, namespace=namespace,
+            replica_metrics=replica_metrics, deployments=deployments,
+            variant_autoscalings=variant_autoscalings,
+            variant_costs=variant_costs, variant_states=variant_states)
+
+    def build_variant_states(
+        self, vas: list[VariantAutoscaling],
+        deployments: dict[str, Deployment] | None = None,
+    ) -> list[VariantReplicaState]:
+        """Current/desired/pending replica counts per variant
+        (reference engine.go:491-556). Pending counts pods that exist but are
+        not Ready — slice provisioning + model load take minutes on TPU."""
+        states = []
+        for va in vas:
+            key = namespaced_key(va.metadata.namespace, va.spec.scale_target_ref.name)
+            deploy = (deployments or {}).get(key)
+            if deploy is None:
+                try:
+                    deploy = variant_utils.get_deployment_with_backoff(
+                        self.client, va.spec.scale_target_ref.name,
+                        va.metadata.namespace)
+                except NotFoundError:
+                    log.debug("Could not get deployment for VA %s", va.metadata.name)
+                    continue
+            current = deploy.status.replicas or deploy.desired_replicas()
+            pending = max(current - deploy.status.ready_replicas, 0)
+            states.append(VariantReplicaState(
+                variant_name=va.metadata.name,
+                current_replicas=current,
+                desired_replicas=va.status.desired_optimized_alloc.num_replicas,
+                pending_replicas=pending,
+                chips_per_replica=get_deployment_chips_per_replica(deploy),
+            ))
+        return states
+
+    def _targets_to_decisions(
+        self,
+        targets: dict[str, int],
+        analysis: ModelSaturationAnalysis,
+        variant_states: list[VariantReplicaState],
+    ) -> list[VariantDecision]:
+        """Convert V1 targets to decisions (reference engine.go:586-659)."""
+        analyses = {va.variant_name: va for va in analysis.variant_analyses}
+        states = {s.variant_name: s for s in variant_states}
+        decisions = []
+        for variant_name in sorted(targets):
+            target = targets[variant_name]
+            state = states.get(variant_name,
+                               VariantReplicaState(variant_name=variant_name))
+            va = analyses.get(variant_name)
+            if target > state.current_replicas:
+                action = ACTION_SCALE_UP
+            elif target < state.current_replicas:
+                action = ACTION_SCALE_DOWN
+            else:
+                action = ACTION_NO_CHANGE
+            decision = VariantDecision(
+                variant_name=variant_name,
+                namespace=analysis.namespace,
+                model_id=analysis.model_id,
+                current_replicas=state.current_replicas,
+                target_replicas=target,
+                original_target_replicas=target,
+                desired_replicas=state.desired_replicas,
+                action=action,
+                saturation_based=True,
+                saturation_only=True,
+                reason=f"saturation-only mode: {action}",
+                chips_per_replica=max(state.chips_per_replica, 1),
+            )
+            if va is not None:
+                decision.accelerator_name = va.accelerator_name
+                decision.cost = va.cost
+                decision.spare_capacity = va.avg_spare_kv_capacity
+            decisions.append(decision)
+        return decisions
+
+    # --- decision application ---
+
+    def _apply_decisions(
+        self,
+        decisions: list[VariantDecision],
+        va_map: dict[str, VariantAutoscaling],
+    ) -> None:
+        """Update VA status, emit metrics, publish cache + trigger
+        (reference engine.go:805-1019). Iterates ALL active VAs so status and
+        metric emission happen every tick even without decisions."""
+        decision_map = {namespaced_key(d.namespace, d.variant_name): d
+                        for d in decisions}
+        now = self.clock.now()
+
+        for va_key in sorted(va_map):
+            va = va_map[va_key]
+            decision = decision_map.get(va_key)
+
+            try:
+                update_va = variant_utils.get_va_with_backoff(
+                    self.client, va.metadata.name, va.metadata.namespace)
+            except NotFoundError:
+                log.debug("VA %s disappeared; skipping", va_key)
+                continue
+
+            if decision is not None:
+                target_replicas = decision.target_replicas
+                accelerator = decision.accelerator_name
+                reason = decision.reason
+            else:
+                # No decision this tick (metrics gap / fresh VA): keep the
+                # previous desired, else fall back to the deployment's CURRENT
+                # replicas — never emit desired=0 for a serving deployment
+                # (reference engine.go:866-877).
+                target_replicas = update_va.status.desired_optimized_alloc.num_replicas
+                if target_replicas <= 0:
+                    try:
+                        deploy = self.client.get(
+                            Deployment.KIND, update_va.metadata.namespace,
+                            update_va.spec.scale_target_ref.name)
+                        target_replicas = deploy.status.replicas or \
+                            deploy.desired_replicas()
+                    except NotFoundError:
+                        target_replicas = 0
+                accelerator = update_va.status.desired_optimized_alloc.accelerator
+                reason = "No scaling decision (optimization loop)"
+
+            if not accelerator:
+                accelerator = variant_utils.get_accelerator_type(update_va)
+            if not accelerator:
+                # Can't produce a sensible status; still publish metrics-missing
+                # state so the reconciler sets MetricsAvailable=False.
+                common.DecisionCache.set(va.metadata.name, va.metadata.namespace,
+                                         VariantDecision(
+                                             variant_name=va.metadata.name,
+                                             namespace=va.metadata.namespace,
+                                             metrics_available=False,
+                                             metrics_reason=METRICS_REASON_UNAVAILABLE,
+                                             metrics_message=METRICS_MESSAGE_UNAVAILABLE))
+                common.fire_trigger(va.metadata.name, va.metadata.namespace)
+                continue
+
+            update_va.status.desired_optimized_alloc = OptimizedAlloc(
+                accelerator=accelerator,
+                num_replicas=target_replicas,
+                last_run_time=now,
+            )
+            update_va.status.actuation.applied = False
+            update_va.set_condition(
+                TYPE_OPTIMIZATION_READY, "True",
+                "SaturationOnlyMode" if decision is not None
+                else REASON_OPTIMIZATION_SUCCEEDED,
+                (f"saturation decision: {reason} (target: {target_replicas} replicas)"
+                 if decision is not None
+                 else "Optimization loop ran (no scaling change needed)"),
+                now=now)
+
+            try:
+                self.actuator.emit_metrics(update_va)
+                update_va.status.actuation.applied = True
+            except Exception as e:  # noqa: BLE001 — emission never fails the loop
+                log.error("Failed to emit metrics for %s: %s", va_key, e)
+
+            # Persist the engine-owned status fields (OptimizationReady,
+            # actuation.applied, desired alloc). Divergence from the
+            # reference, whose engine-side condition writes are lost because
+            # only the reconciler patches status; here the status write is a
+            # cheap full-subresource put and the reconciler remains the
+            # owner of MetricsAvailable/TargetResolved.
+            try:
+                variant_utils.update_va_status_with_backoff(self.client, update_va)
+            except NotFoundError:
+                continue
+
+            metrics_available = decision is not None
+            common.DecisionCache.set(va.metadata.name, va.metadata.namespace,
+                                     VariantDecision(
+                                         variant_name=va.metadata.name,
+                                         namespace=va.metadata.namespace,
+                                         model_id=update_va.spec.model_id,
+                                         accelerator_name=accelerator,
+                                         target_replicas=target_replicas,
+                                         last_run_time=now,
+                                         metrics_available=metrics_available,
+                                         metrics_reason=(METRICS_REASON_AVAILABLE
+                                                         if metrics_available
+                                                         else METRICS_REASON_UNAVAILABLE),
+                                         metrics_message=(METRICS_MESSAGE_AVAILABLE
+                                                          if metrics_available
+                                                          else METRICS_MESSAGE_UNAVAILABLE)))
+            common.fire_trigger(va.metadata.name, va.metadata.namespace)
+
+    def _emit_safety_net_metrics(self, model_vas: list[VariantAutoscaling]) -> None:
+        """On analysis failure, emit previous-desired or current replicas so
+        the external HPA keeps a signal (reference engine.go:1022-1095)."""
+        for va in model_vas:
+            current = 0
+            try:
+                deploy = self.client.get(Deployment.KIND, va.metadata.namespace,
+                                         va.spec.scale_target_ref.name)
+                current = deploy.status.replicas or deploy.desired_replicas()
+            except NotFoundError:
+                log.debug("Safety net: deployment missing for %s", va.metadata.name)
+
+            if va.status.desired_optimized_alloc.num_replicas > 0:
+                desired = va.status.desired_optimized_alloc.num_replicas
+            else:
+                desired = current
+
+            accelerator = va.status.desired_optimized_alloc.accelerator or \
+                variant_utils.get_accelerator_type(va)
+            if not accelerator:
+                log.info("Safety net: no accelerator for %s, skipping emission",
+                         va.metadata.name)
+                continue
+            self.actuator.registry.emit_replica_metrics(
+                va.metadata.name, va.metadata.namespace, accelerator,
+                current, desired)
+            log.info("Safety net: emitted fallback metrics for %s "
+                     "(current=%d desired=%d)", va.metadata.name, current, desired)
+
+
+def get_deployment_chips_per_replica(deploy: Deployment | None) -> int:
+    """TPU chips one replica consumes, from pod-template ``google.com/tpu``
+    requests (reference getDeploymentGPUsPerReplica, engine.go:563-584).
+    Defaults to 1 when unset."""
+    if deploy is None:
+        return 1
+    total = sum(
+        parse_quantity(container.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+        for container in deploy.template.containers
+    )
+    return total if total > 0 else 1

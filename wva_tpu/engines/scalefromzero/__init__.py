@@ -1,0 +1,5 @@
+"""Scale-from-zero engine (reference ``internal/engines/scalefromzero``)."""
+
+from wva_tpu.engines.scalefromzero.engine import ScaleFromZeroEngine
+
+__all__ = ["ScaleFromZeroEngine"]

@@ -1,0 +1,166 @@
+"""Scale-from-zero detection loop
+(reference ``internal/engines/scalefromzero/engine.go:104-358``).
+
+A fast (100ms) loop watches models whose targets are scaled to zero. When the
+inference scheduler's flow-control layer reports queued requests for such a
+model (``inference_extension_flow_control_queue_size{target_model_name=...} >
+0``, scraped directly from the EPP pods), the engine writes the scale
+subresource 0 -> 1 directly — HPA cannot act on a zero-replica target.
+
+Improvement over the reference (its engine.go:272 TODO): when a model has
+several inactive variants, only the CHEAPEST one is woken, not all of them.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+
+from wva_tpu.actuator import DirectActuator
+from wva_tpu.api.v1alpha1 import (
+    OptimizedAlloc,
+    TYPE_OPTIMIZATION_READY,
+    VariantAutoscaling,
+)
+from wva_tpu.collector.source.pod_scrape import ALL_METRICS_QUERY
+from wva_tpu.config import Config
+from wva_tpu.constants import (
+    LABEL_MODEL_NAME,
+    LABEL_TARGET_MODEL_NAME,
+    SCHEDULER_FLOW_CONTROL_QUEUE_SIZE,
+)
+from wva_tpu.datastore import Datastore, PoolNotFoundError
+from wva_tpu.engines import common
+from wva_tpu.engines.executor import PollingExecutor
+from wva_tpu.interfaces import ACTION_SCALE_UP, VariantDecision
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Deployment
+from wva_tpu.utils import variant as variant_utils
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from wva_tpu.collector.source.source import RefreshSpec
+
+log = logging.getLogger(__name__)
+
+DEFAULT_POLL_INTERVAL = 0.1  # 100ms (reference engine.go:108)
+
+
+class ScaleFromZeroEngine:
+    def __init__(self, client: KubeClient, config: Config, datastore: Datastore,
+                 actuator: DirectActuator, clock: Clock | None = None,
+                 poll_interval: float = DEFAULT_POLL_INTERVAL) -> None:
+        self.client = client
+        self.config = config
+        self.datastore = datastore
+        self.actuator = actuator
+        self.clock = clock or SYSTEM_CLOCK
+        self.executor = PollingExecutor(self.optimize, poll_interval,
+                                        clock=self.clock, name="scale-from-zero")
+
+    def start_loop(self, stop) -> None:
+        self.executor.start(stop)
+
+    def optimize(self) -> None:
+        """One detection tick (reference engine.go:122-195)."""
+        inactive = variant_utils.inactive_variant_autoscalings(self.client)
+        if not inactive:
+            return
+        # Wake only the cheapest inactive variant per model.
+        by_model = variant_utils.group_variant_autoscalings_by_model(inactive)
+        candidates = [min(vas, key=lambda va: (va.spec.cost(), va.metadata.name))
+                      for vas in by_model.values()]
+        max_workers = max(self.config.scale_from_zero_max_concurrency(), 1)
+        if len(candidates) == 1:
+            self._process_inactive_variant(candidates[0])
+            return
+        with ThreadPoolExecutor(max_workers=min(max_workers, len(candidates))) as pool:
+            list(pool.map(self._process_inactive_variant, candidates))
+
+    def _process_inactive_variant(self, va: VariantAutoscaling) -> None:
+        """Check queued requests for the VA's model; scale 0->1 when present
+        (reference engine.go:198-358)."""
+        try:
+            deploy: Deployment = self.client.get(
+                va.spec.scale_target_ref.kind, va.metadata.namespace,
+                va.spec.scale_target_ref.name)
+        except NotFoundError:
+            log.debug("Scale target missing for %s", va.metadata.name)
+            return
+
+        try:
+            pool = self.datastore.pool_get_from_labels(deploy.template.labels)
+        except PoolNotFoundError:
+            log.debug("No InferencePool matches labels of %s", va.metadata.name)
+            return
+
+        source = self.datastore.pool_get_metrics_source(pool.name)
+        if source is None:
+            return
+        try:
+            results = source.refresh(RefreshSpec())
+        except Exception as e:  # noqa: BLE001 — scrape errors skip this tick
+            log.debug("EPP scrape failed for pool %s: %s", pool.name, e)
+            return
+        result = results.get(ALL_METRICS_QUERY)
+        if result is None or result.has_error():
+            return
+
+        if not self._has_pending_requests(result.values, va.spec.model_id):
+            return
+
+        try:
+            changed = self.actuator.scale_target_object(
+                va.spec.scale_target_ref.kind, va.metadata.namespace,
+                va.spec.scale_target_ref.name, 1)
+        except NotFoundError:
+            return
+        if not changed:
+            return
+
+        now = self.clock.now()
+        accelerator = (va.status.desired_optimized_alloc.accelerator
+                       or variant_utils.get_accelerator_type(va))
+        decision = VariantDecision(
+            variant_name=va.metadata.name,
+            namespace=va.metadata.namespace,
+            model_id=va.spec.model_id,
+            accelerator_name=accelerator,
+            action=ACTION_SCALE_UP,
+            current_replicas=0,
+            target_replicas=1,
+            last_run_time=now,
+            reason="scale-from-zero: pending requests in scheduler flow control",
+            metrics_available=True,
+            metrics_reason="MetricsFound",
+            metrics_message="Pending requests detected in scheduler queue",
+        )
+        common.DecisionCache.set(va.metadata.name, va.metadata.namespace, decision)
+
+        # Seed status so the reconciler and the next saturation tick agree.
+        try:
+            update_va = variant_utils.get_va_with_backoff(
+                self.client, va.metadata.name, va.metadata.namespace)
+            update_va.status.desired_optimized_alloc = OptimizedAlloc(
+                accelerator=accelerator, num_replicas=1, last_run_time=now)
+            update_va.set_condition(
+                TYPE_OPTIMIZATION_READY, "True", "ScaleFromZero",
+                "Scaled 0->1: pending requests in scheduler flow control", now=now)
+            variant_utils.update_va_status_with_backoff(self.client, update_va)
+        except NotFoundError:
+            pass
+        common.fire_trigger(va.metadata.name, va.metadata.namespace)
+        log.info("Scale-from-zero: woke %s/%s for model %s",
+                 va.metadata.namespace, va.metadata.name, va.spec.model_id)
+
+    @staticmethod
+    def _has_pending_requests(values, model_id: str) -> bool:
+        """Scan scraped EPP samples for flow-control queue size > 0 for this
+        model (reference engine.go:254-264)."""
+        for v in values:
+            if v.labels.get("__name__") != SCHEDULER_FLOW_CONTROL_QUEUE_SIZE:
+                continue
+            target = v.labels.get(LABEL_TARGET_MODEL_NAME, "")
+            model = v.labels.get(LABEL_MODEL_NAME, "")
+            if (target == model_id or (not target and model == model_id)) \
+                    and v.value > 0:
+                return True
+        return False

@@ -171,6 +171,15 @@ class ServiceMonitor:
     API_VERSION = "monitoring.coreos.com/v1"
 
 
+def parse_quantity(raw: str) -> int:
+    """Parse a K8s integer resource quantity ("8", "8.0"); 0 on bad input.
+    Single source of truth for extended-resource counts (google.com/tpu)."""
+    try:
+        return int(float(raw))
+    except (TypeError, ValueError):
+        return 0
+
+
 def labels_match(selector: dict[str, str] | None, labels: dict[str, str]) -> bool:
     """K8s equality-selector semantics: every selector entry must match; an
     empty/None selector matches everything. The single source of truth for

@@ -1,0 +1,204 @@
+"""Process wiring (reference ``cmd/main.go:83-520``).
+
+Assembles the full controller: config load (fail-fast), datastore with the
+EPP pod-scraping source factory, Prometheus source + query registration,
+engines as leader-gated runnables, reconcilers, health endpoints, metric
+registration. ``Manager`` supports wall-clock threaded operation and
+single-threaded simulated ticks (the emulation harness and bench drive
+``run_once``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+from wva_tpu.actuator import Actuator, DirectActuator
+from wva_tpu.analyzers.saturation_v2 import CapacityKnowledgeStore
+from wva_tpu.collector.registration import (
+    register_saturation_queries,
+    register_scale_to_zero_queries,
+)
+from wva_tpu.collector.registration.scale_to_zero import collect_model_request_count
+from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
+from wva_tpu.collector.source import (
+    HTTPPromAPI,
+    InMemoryPromAPI,
+    PodScrapingSource,
+    PodVAMapper,
+    PrometheusSource,
+    SourceRegistry,
+    TimeSeriesDB,
+    http_pod_fetcher,
+)
+from wva_tpu.collector.source.registry import PROMETHEUS_SOURCE_NAME
+from wva_tpu.config import Config
+from wva_tpu.controller import (
+    ConfigMapReconciler,
+    InferencePoolReconciler,
+    VariantAutoscalingReconciler,
+)
+from wva_tpu.datastore import Datastore
+from wva_tpu.discovery import TPUSliceDiscovery
+from wva_tpu.engines.saturation import SaturationEngine
+from wva_tpu.engines.scalefromzero import ScaleFromZeroEngine
+from wva_tpu.indexers import Indexer
+from wva_tpu.k8s.client import KubeClient
+from wva_tpu.metrics import MetricsRegistry
+from wva_tpu.pipeline import (
+    DefaultLimiter,
+    Enforcer,
+    GreedyBySaturation,
+    SliceInventory,
+)
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from wva_tpu.utils.variant import get_controller_instance
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class Manager:
+    """Everything wired; the process object."""
+
+    client: KubeClient
+    config: Config
+    clock: Clock
+    registry: MetricsRegistry
+    source_registry: SourceRegistry
+    datastore: Datastore
+    indexer: Indexer
+    engine: SaturationEngine
+    scale_from_zero: ScaleFromZeroEngine
+    va_reconciler: VariantAutoscalingReconciler
+    configmap_reconciler: ConfigMapReconciler
+    pool_reconciler: InferencePoolReconciler
+    capacity_store: CapacityKnowledgeStore
+
+    _threads: list[threading.Thread] = None
+
+    # --- health endpoints (reference cmd/main.go:482-498) ---
+
+    def healthz(self) -> bool:
+        return True
+
+    def readyz(self) -> bool:
+        return self.config.configmaps_bootstrap_complete()
+
+    # --- lifecycle ---
+
+    def setup(self) -> "Manager":
+        self.indexer.setup()
+        self.configmap_reconciler.bootstrap_initial_configmaps()
+        self.configmap_reconciler.setup()
+        self.pool_reconciler.setup()
+        self.va_reconciler.setup()
+        return self
+
+    def start(self, stop: threading.Event) -> None:
+        """Wall-clock mode: engines + trigger loop in daemon threads."""
+        self._threads = [
+            threading.Thread(target=self.engine.start_optimize_loop, args=(stop,),
+                             name="saturation-engine", daemon=True),
+            threading.Thread(target=self.scale_from_zero.start_loop, args=(stop,),
+                             name="scale-from-zero", daemon=True),
+            threading.Thread(target=self.va_reconciler.run_trigger_loop, args=(stop,),
+                             name="va-trigger-loop", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def run_once(self) -> None:
+        """Simulation mode: one saturation tick + one scale-from-zero tick +
+        drain reconcile triggers (single-threaded, deterministic)."""
+        self.engine.executor.tick()
+        self.scale_from_zero.executor.tick()
+        self.va_reconciler.drain_triggers()
+
+    def scale_from_zero_tick(self) -> None:
+        self.scale_from_zero.executor.tick()
+        self.va_reconciler.drain_triggers()
+
+
+def build_manager(
+    client: KubeClient,
+    config: Config,
+    clock: Clock | None = None,
+    tsdb: TimeSeriesDB | None = None,
+    pod_fetcher=None,
+    mirror_wva_metrics: bool = True,
+) -> Manager:
+    """Wire the full controller (reference cmd/main.go).
+
+    ``tsdb`` selects the in-memory Prometheus backend (emulation/bench);
+    when None, an HTTP backend against ``config.prometheus_base_url()`` is
+    used. ``pod_fetcher`` overrides EPP pod scraping (in-process harness);
+    defaults to HTTP.
+    """
+    clock = clock or SYSTEM_CLOCK
+
+    registry = MetricsRegistry(
+        controller_instance=get_controller_instance(),
+        # Mirror wva_* gauges into the TSDB so the emulated HPA loop can
+        # read them exactly as Prometheus Adapter would.
+        mirror_tsdb=tsdb if mirror_wva_metrics else None,
+    )
+
+    if tsdb is not None:
+        prom_api = InMemoryPromAPI(tsdb)
+    else:
+        prom_api = HTTPPromAPI(config.prometheus_base_url(),
+                               bearer_token=config.prometheus_bearer_token())
+    source_registry = SourceRegistry()
+    prom_source = PrometheusSource(prom_api, config.prometheus_cache_config(),
+                                   clock=clock)
+    source_registry.register(PROMETHEUS_SOURCE_NAME, prom_source)
+    register_saturation_queries(source_registry)
+    register_scale_to_zero_queries(source_registry)
+
+    def pod_source_factory(pool):
+        fetcher = pod_fetcher or http_pod_fetcher(
+            pool.endpoint_picker.metrics_port_number,
+            bearer_token=config.epp_metric_reader_bearer_token())
+        return PodScrapingSource(
+            client, pool.endpoint_picker.service_name,
+            pool.endpoint_picker.namespace, fetcher, clock=clock)
+
+    datastore = Datastore(source_registry=source_registry,
+                          source_factory=pod_source_factory)
+    indexer = Indexer(client)
+    mapper = PodVAMapper(client, indexer)
+    collector = ReplicaMetricsCollector(prom_source, mapper, clock=clock)
+
+    actuator = Actuator(client, registry)
+    direct_actuator = DirectActuator(client)
+
+    enforcer = Enforcer(
+        lambda model_id, namespace, retention: collect_model_request_count(
+            prom_source, model_id, namespace, retention))
+
+    discovery = TPUSliceDiscovery(client)
+    limiter = DefaultLimiter("tpu-slice-limiter", SliceInventory(discovery),
+                             GreedyBySaturation())
+
+    capacity_store = CapacityKnowledgeStore(clock=clock)
+    engine = SaturationEngine(
+        client=client, config=config, collector=collector, actuator=actuator,
+        enforcer=enforcer, limiter=limiter, capacity_store=capacity_store,
+        clock=clock, poll_interval=min(config.optimization_interval() / 2, 30.0))
+    scale_from_zero = ScaleFromZeroEngine(client, config, datastore,
+                                          direct_actuator, clock=clock)
+
+    va_reconciler = VariantAutoscalingReconciler(client, datastore, indexer,
+                                                 clock=clock)
+    configmap_reconciler = ConfigMapReconciler(client, config, datastore)
+    pool_reconciler = InferencePoolReconciler(client, datastore)
+
+    return Manager(
+        client=client, config=config, clock=clock, registry=registry,
+        source_registry=source_registry, datastore=datastore, indexer=indexer,
+        engine=engine, scale_from_zero=scale_from_zero,
+        va_reconciler=va_reconciler, configmap_reconciler=configmap_reconciler,
+        pool_reconciler=pool_reconciler, capacity_store=capacity_store,
+    )

@@ -1,0 +1,133 @@
+"""WVA output metrics registry (reference ``internal/metrics/metrics.go:37-165``).
+
+Four custom series with byte-identical names/labels to the reference so
+Prometheus-Adapter/HPA/KEDA glue transfers verbatim:
+
+- ``wva_replica_scaling_total`` (counter: variant_name, namespace, direction,
+  reason, accelerator_type)
+- ``wva_desired_replicas`` / ``wva_current_replicas`` / ``wva_desired_ratio``
+  (gauges: variant_name, namespace, accelerator_type)
+
+All series optionally carry ``controller_instance``. The registry renders
+Prometheus text exposition for the metrics endpoint and can mirror into a
+TimeSeriesDB so the emulation harness can close the HPA loop in-process.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from wva_tpu.constants import (
+    LABEL_ACCELERATOR_TYPE,
+    LABEL_CONTROLLER_INSTANCE,
+    LABEL_DIRECTION,
+    LABEL_NAMESPACE,
+    LABEL_REASON,
+    LABEL_VARIANT_NAME,
+    WVA_CURRENT_REPLICAS,
+    WVA_DESIRED_RATIO,
+    WVA_DESIRED_REPLICAS,
+    WVA_REPLICA_SCALING_TOTAL,
+)
+
+_LabelKey = tuple[tuple[str, str], ...]
+
+
+class _Series:
+    def __init__(self, name: str, kind: str, help_text: str) -> None:
+        self.name = name
+        self.kind = kind  # "gauge" | "counter"
+        self.help_text = help_text
+        self.values: dict[_LabelKey, float] = {}
+
+
+class MetricsRegistry:
+    def __init__(self, controller_instance: str = "", mirror_tsdb=None) -> None:
+        self._mu = threading.RLock()
+        self.controller_instance = controller_instance
+        # Optional TimeSeriesDB mirror (emulation harness / bench).
+        self.mirror_tsdb = mirror_tsdb
+        self._series: dict[str, _Series] = {}
+        self._register(WVA_REPLICA_SCALING_TOTAL, "counter",
+                       "Total number of replica scaling operations")
+        self._register(WVA_DESIRED_REPLICAS, "gauge",
+                       "Desired number of replicas per variant")
+        self._register(WVA_CURRENT_REPLICAS, "gauge",
+                       "Current number of replicas per variant")
+        self._register(WVA_DESIRED_RATIO, "gauge",
+                       "Ratio of desired to current replicas per variant")
+
+    def _register(self, name: str, kind: str, help_text: str) -> None:
+        self._series[name] = _Series(name, kind, help_text)
+
+    def _key(self, labels: dict[str, str]) -> _LabelKey:
+        if self.controller_instance:
+            labels = {**labels, LABEL_CONTROLLER_INSTANCE: self.controller_instance}
+        return tuple(sorted(labels.items()))
+
+    def set_gauge(self, name: str, labels: dict[str, str], value: float) -> None:
+        with self._mu:
+            series = self._series[name]
+            key = self._key(labels)
+            series.values[key] = value
+        if self.mirror_tsdb is not None:
+            self.mirror_tsdb.add_sample(name, dict(key), value)
+
+    def inc_counter(self, name: str, labels: dict[str, str], delta: float = 1.0) -> None:
+        with self._mu:
+            series = self._series[name]
+            key = self._key(labels)
+            series.values[key] = series.values.get(key, 0.0) + delta
+            value = series.values[key]
+        if self.mirror_tsdb is not None:
+            self.mirror_tsdb.add_sample(name, dict(key), value)
+
+    def get(self, name: str, labels: dict[str, str]) -> float | None:
+        with self._mu:
+            return self._series[name].values.get(self._key(labels))
+
+    def emit_replica_metrics(self, variant_name: str, namespace: str,
+                             accelerator: str, current: int, desired: int) -> None:
+        """Gauges for the external actuator (reference metrics.go:137-165).
+        Scale-from-zero encoding: current==0 && desired>0 => ratio = desired,
+        since desired/0 is undefined but HPA needs a >1 signal."""
+        labels = {
+            LABEL_VARIANT_NAME: variant_name,
+            LABEL_NAMESPACE: namespace,
+            LABEL_ACCELERATOR_TYPE: accelerator,
+        }
+        self.set_gauge(WVA_DESIRED_REPLICAS, labels, float(desired))
+        self.set_gauge(WVA_CURRENT_REPLICAS, labels, float(current))
+        if current > 0:
+            ratio = desired / current
+        else:
+            ratio = float(desired)
+        self.set_gauge(WVA_DESIRED_RATIO, labels, ratio)
+
+    def record_scaling(self, variant_name: str, namespace: str, accelerator: str,
+                       direction: str, reason: str) -> None:
+        self.inc_counter(WVA_REPLICA_SCALING_TOTAL, {
+            LABEL_VARIANT_NAME: variant_name,
+            LABEL_NAMESPACE: namespace,
+            LABEL_ACCELERATOR_TYPE: accelerator,
+            LABEL_DIRECTION: direction,
+            LABEL_REASON: reason,
+        })
+
+    def render_text(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        with self._mu:
+            for name in sorted(self._series):
+                series = self._series[name]
+                lines.append(f"# HELP {name} {series.help_text}")
+                lines.append(f"# TYPE {name} {series.kind}")
+                for key in sorted(series.values):
+                    label_str = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+                    suffix = f"{{{label_str}}}" if label_str else ""
+                    lines.append(f"{name}{suffix} {series.values[key]:g}")
+        return "\n".join(lines) + "\n"
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
