@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""North-star benchmark (BASELINE.md): Llama-3.1-8B on JetStream v5e-8 slices
+under ramped load, 1 -> N slices, measuring p99-TTFT SLO attainment and
+scale-up latency.
+
+Two policies run through the SAME emulated world (serving simulator, fake
+kubelet with slice-provisioning delay, HPA emulator):
+
+- baseline: the reference's shipped defaults — V1 percentage analyzer, 30s
+  engine tick, HPA stabilization 240s up/down (charts/workload-variant-
+  autoscaler/README.md:11-20).
+- ours: the TPU build's defaults — V2 token-capacity analyzer (anticipates
+  demand from the scheduler queue and pending-replica supply) with faster HPA
+  windows, which V2's transition blocking + anticipated-supply math make safe
+  against flapping.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <ours p99-TTFT SLO attainment>, "unit": ...,
+   "vs_baseline": <ours / baseline>, "detail": {...}}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from wva_tpu.emulator import (  # noqa: E402
+    EmulationHarness,
+    HPAParams,
+    ServingParams,
+    VariantSpec,
+    ramp,
+)
+from wva_tpu.interfaces import SaturationScalingConfig  # noqa: E402
+
+MODEL = "meta-llama/Llama-3.1-8B"
+SLO_TTFT_SECONDS = 1.0
+RAMP_SECONDS = 300.0
+HOLD_SECONDS = 1500.0
+PEAK_RATE = 90.0  # req/s at peak — needs ~5 v5e-8 slices
+STARTUP_SECONDS = 120.0  # slice provisioning + model load
+
+
+def run_policy(name: str) -> dict:
+    if name == "baseline":
+        sat_cfg = SaturationScalingConfig()  # V1 defaults
+        hpa = HPAParams()  # chart defaults: 240s stabilization
+        engine_interval = 30.0
+    else:
+        sat_cfg = SaturationScalingConfig(analyzer_name="saturation")
+        sat_cfg.apply_defaults()
+        hpa = HPAParams(stabilization_up_seconds=30.0,
+                        stabilization_down_seconds=120.0,
+                        sync_period_seconds=15.0)
+        engine_interval = 15.0
+
+    spec = VariantSpec(
+        name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
+        chips_per_replica=8, cost=10.0, initial_replicas=1,
+        serving=ServingParams(engine="jetstream"),
+        load=ramp(4.0, PEAK_RATE, RAMP_SECONDS, hold=HOLD_SECONDS),
+        hpa=hpa,
+    )
+    harness = EmulationHarness(
+        [spec],
+        saturation_config=sat_cfg,
+        nodepools=[("v5e-pool", "v5e", "2x4", 8)],
+        startup_seconds=STARTUP_SECONDS,
+        engine_interval=engine_interval,
+    )
+
+    max_replicas = {"v": 1}
+    first_scale_up = {"t": None}
+    ready_at_peak = {"t": None}
+
+    def watch(h: EmulationHarness, t: float) -> None:
+        reps = h.replicas_of("llama-v5e")
+        if reps > 1 and first_scale_up["t"] is None:
+            first_scale_up["t"] = t
+        if reps > max_replicas["v"]:
+            max_replicas["v"] = reps
+        ready = h.ready_replicas_of("llama-v5e")
+        if ready >= 4 and ready_at_peak["t"] is None:
+            ready_at_peak["t"] = t
+
+    harness.run(RAMP_SECONDS + HOLD_SECONDS, on_step=watch)
+
+    sim = harness.sim_of_model(MODEL)
+    measure_since = harness.start_time  # whole run, ramp included
+    now = harness.clock.now()
+    attainment = sim.slo_attainment(SLO_TTFT_SECONDS, since=measure_since)
+    p99 = sim.ttft_percentile(99.0, since=measure_since, now=now)
+    p50 = sim.ttft_percentile(50.0, since=measure_since, now=now)
+    return {
+        "slo_attainment": attainment,
+        "p50_ttft_s": round(p50, 3),
+        "p99_ttft_s": round(p99, 3),
+        "scale_up_decision_latency_s": first_scale_up["t"],
+        "time_to_4_ready_slices_s": ready_at_peak["t"],
+        "peak_slices": max_replicas["v"],
+        "chips_peak": max_replicas["v"] * 8,
+        "requests_served": int(sum(
+            r.success_total for r in sim._replicas.values())),
+    }
+
+
+def main() -> None:
+    t0 = time.time()
+    baseline = run_policy("baseline")
+    ours = run_policy("ours")
+    wall = time.time() - t0
+
+    value = ours["slo_attainment"]
+    base = baseline["slo_attainment"]
+    vs_baseline = value / base if base > 0 else float("inf")
+
+    print(json.dumps({
+        "metric": "p99_ttft_slo_attainment_ramped_1_to_N_v5e8",
+        "value": round(value, 4),
+        "unit": "fraction_of_requests_meeting_1s_TTFT_SLO",
+        "vs_baseline": round(vs_baseline, 3),
+        "detail": {
+            "ours": ours,
+            "baseline": baseline,
+            "scenario": {
+                "model": MODEL, "engine": "jetstream",
+                "ramp": f"4->{PEAK_RATE} req/s over {RAMP_SECONDS:.0f}s",
+                "hold_s": HOLD_SECONDS, "slo_ttft_s": SLO_TTFT_SECONDS,
+                "slice_startup_s": STARTUP_SECONDS,
+            },
+            "bench_wall_seconds": round(wall, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

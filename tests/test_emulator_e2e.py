@@ -1,0 +1,112 @@
+"""Emulated e2e scenarios — the reference's e2e suite shapes
+(test/e2e-saturation-based/e2e_saturation_test.go:131,320,396,919;
+scale_from_zero_test.go; scale_to_zero_test.go; limiter_test.go) run against
+the in-process harness with simulated time."""
+
+import pytest
+
+from wva_tpu.api.v1alpha1 import ObjectMeta
+from wva_tpu.config import new_test_config
+from wva_tpu.emulator import (
+    EmulationHarness,
+    HPAParams,
+    ServingParams,
+    VariantSpec,
+    constant,
+    ramp,
+)
+from wva_tpu.emulator.loadgen import SpikeProfile
+from wva_tpu.interfaces import SaturationScalingConfig
+from wva_tpu.k8s import ConfigMap
+
+MODEL = "meta-llama/Llama-3.1-8B"
+
+FAST_HPA = HPAParams(stabilization_up_seconds=30.0,
+                     stabilization_down_seconds=60.0,
+                     sync_period_seconds=15.0)
+
+
+def make_harness(load, replicas=1, hpa=None, serving=None, **kw):
+    spec = VariantSpec(
+        name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
+        chips_per_replica=8, cost=10.0, initial_replicas=replicas,
+        serving=serving or ServingParams(), load=load, hpa=hpa or FAST_HPA)
+    return EmulationHarness([spec], startup_seconds=60.0, **kw), spec
+
+
+def test_steady_light_load_is_stable():
+    h, spec = make_harness(constant(2.0))
+    h.run(600)
+    assert h.replicas_of("llama-v5e") == 1
+    sim = h.sim_of_model(MODEL)
+    assert sim.slo_attainment(1.0) > 0.95
+
+
+def test_scale_up_under_saturating_load():
+    # One v5e-8 replica decodes ~ 96 slots / 20ms = ... with 256-token outputs
+    # a replica sustains ~18 req/s; offer 3x that.
+    h, spec = make_harness(ramp(2.0, 50.0, 300.0, hold=1e9))
+    h.run(1200)
+    assert h.replicas_of("llama-v5e") > 1, "load should force scale-up"
+    # And replicas actually became ready + serving.
+    assert h.ready_replicas_of("llama-v5e") > 1
+
+
+def test_stability_under_constant_load_no_flapping():
+    h, spec = make_harness(constant(30.0))
+    h.run(900)
+    first = h.replicas_of("llama-v5e")
+    changes = []
+    h.run(900, on_step=lambda hh, t: changes.append(hh.replicas_of("llama-v5e")))
+    # Under constant load the replica count must settle (no flapping).
+    assert len(set(changes[-300:])) == 1
+
+
+def test_scale_from_zero_on_queued_requests():
+    h, spec = make_harness(SpikeProfile(idle_until=60.0, spike_rate=5.0,
+                                        spike_duration=1e9), replicas=0)
+    h.run(50)
+    assert h.replicas_of("llama-v5e") == 0
+    h.run(120)  # spike begins at t=60; detection is sub-second
+    assert h.replicas_of("llama-v5e") >= 1
+
+
+def test_scale_to_zero_after_idle():
+    h, spec = make_harness(SpikeProfile(idle_until=0.0, spike_rate=5.0,
+                                        spike_duration=120.0))
+    h.cluster.create(ConfigMap(
+        metadata=ObjectMeta(name="wva-model-scale-to-zero-config",
+                            namespace="workload-variant-autoscaler-system"),
+        data={"default": "enable_scale_to_zero: true\nretention_period: 3m\n"}))
+    h.run(120)  # serve the spike
+    assert h.replicas_of("llama-v5e") >= 1
+    h.run(900)  # idle >> retention + stabilization
+    assert h.replicas_of("llama-v5e") == 0
+
+
+def test_cost_based_variant_preference():
+    cheap = VariantSpec(name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
+                        chips_per_replica=8, cost=10.0, initial_replicas=1,
+                        serving=ServingParams(), load=ramp(2.0, 60.0, 300.0, hold=1e9),
+                        hpa=FAST_HPA)
+    exp = VariantSpec(name="llama-v5p", model_id=MODEL, accelerator="v5p-4",
+                      chips_per_replica=4, cost=40.0, initial_replicas=1,
+                      serving=ServingParams(), load=None, hpa=FAST_HPA)
+    h = EmulationHarness(
+        [cheap, exp],
+        nodepools=[("v5e-pool", "v5e", "2x4", 8), ("v5p-pool", "v5p", "2x2x1", 8)],
+        startup_seconds=60.0)
+    h.run(1200)
+    # Scale-ups land on the cheap variant; the expensive one stays put.
+    assert h.replicas_of("llama-v5e") > 1
+    assert h.replicas_of("llama-v5p") == 1
+
+
+def test_limiter_caps_at_inventory():
+    cfg = SaturationScalingConfig(enable_limiter=True)
+    h, spec = make_harness(ramp(2.0, 200.0, 200.0, hold=1e9),
+                           saturation_config=cfg,
+                           nodepools=[("v5e-pool", "v5e", "2x4", 2)])
+    h.run(1500)
+    # Only 2 whole slices exist: desired can never exceed 2.
+    assert h.replicas_of("llama-v5e") <= 2

@@ -1,0 +1,35 @@
+"""Emulation harness — the TPU build's equivalent of the reference's
+``deploy/kind-emulator`` + ``llm-d-inference-sim`` stack (SURVEY.md section 4):
+
+- :mod:`profiles`   — fake GKE TPU node pools in a FakeCluster
+- :mod:`server_sim` — JetStream / vLLM-TPU serving simulator emitting genuine
+  metric families into the in-memory TSDB
+- :mod:`kubelet`    — Deployment -> Pod reconciler with slice-provisioning
+  delays and chip-aware node binding
+- :mod:`hpa`        — HorizontalPodAutoscaler emulator acting on the
+  ``wva_desired_replicas`` gauge exactly as Prometheus Adapter + HPA would
+- :mod:`loadgen`    — deterministic load profiles (constant / step / ramp)
+- :mod:`harness`    — discrete-time world loop tying it all together
+"""
+
+from wva_tpu.emulator.profiles import add_tpu_nodepool
+from wva_tpu.emulator.server_sim import ModelServerSim, ServingParams
+from wva_tpu.emulator.kubelet import FakeKubelet
+from wva_tpu.emulator.hpa import HPAEmulator, HPAParams
+from wva_tpu.emulator.loadgen import LoadProfile, constant, ramp, step_profile
+from wva_tpu.emulator.harness import EmulationHarness, VariantSpec
+
+__all__ = [
+    "add_tpu_nodepool",
+    "ModelServerSim",
+    "ServingParams",
+    "FakeKubelet",
+    "HPAEmulator",
+    "HPAParams",
+    "LoadProfile",
+    "constant",
+    "ramp",
+    "step_profile",
+    "EmulationHarness",
+    "VariantSpec",
+]

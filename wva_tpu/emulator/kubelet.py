@@ -1,0 +1,190 @@
+"""Fake kubelet: Deployment -> Pod reconciliation with slice-provisioning
+delays and chip-aware node binding.
+
+The TPU-critical behavior being modeled (SURVEY.md section 7, hard part 4):
+slice provisioning + model loading take MINUTES — pods exist (pending) long
+before they serve, which is exactly what the engine's pending-replica
+cascade-prevention machinery has to handle.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from wva_tpu.api.v1alpha1 import ObjectMeta
+from wva_tpu.constants.labels import TPU_RESOURCE_NAME
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.objects import (
+    Deployment,
+    Node,
+    Pod,
+    PodStatus,
+    parse_quantity,
+)
+from wva_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class _PendingPod:
+    name: str
+    ready_at: float
+
+
+@dataclass
+class FakeKubelet:
+    """Reconciles spec.replicas with pods for every Deployment, binding pods
+    to nodes with free chips and marking them Ready after ``startup_seconds``.
+    """
+
+    client: KubeClient
+    clock: Clock
+    startup_seconds: float = 120.0  # model load + slice spin-up
+    _pending: dict[str, _PendingPod] = field(default_factory=dict)
+    _counters: dict[str, int] = field(default_factory=dict)
+
+    def step(self) -> None:
+        now = self.clock.now()
+        for deploy in self.client.list(Deployment.KIND):
+            self._reconcile_deployment(deploy, now)
+        self._retry_unscheduled(now)
+        self._mark_ready(now)
+
+    def _retry_unscheduled(self, now: float) -> None:
+        """Re-attempt binding for pods stuck without a node — chips may have
+        freed since creation (real kube-scheduler retries continuously)."""
+        for pod in self.client.list(Pod.KIND):
+            if pod.node_name or pod.status.phase != "Pending" \
+                    or pod.metadata.name in self._pending:
+                continue
+            chips_needed = sum(
+                parse_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+                for c in pod.spec.containers)
+            if chips_needed <= 0:
+                continue
+            node_name = self._find_node_with_chips(chips_needed)
+            if node_name is None:
+                continue
+            pod.node_name = node_name
+            try:
+                self.client.update(pod)
+            except NotFoundError:
+                continue
+            self._pending[pod.metadata.name] = _PendingPod(
+                name=pod.metadata.name, ready_at=now + self.startup_seconds)
+
+    def _pods_of(self, deploy: Deployment) -> list[Pod]:
+        return [
+            p for p in self.client.list(Pod.KIND, namespace=deploy.metadata.namespace)
+            if any(ref.get("kind") == "Deployment"
+                   and ref.get("name") == deploy.metadata.name
+                   for ref in p.metadata.owner_references)
+        ]
+
+    def _reconcile_deployment(self, deploy: Deployment, now: float) -> None:
+        pods = self._pods_of(deploy)
+        want = deploy.desired_replicas()
+        have = len(pods)
+
+        if have < want:
+            for _ in range(want - have):
+                self._create_pod(deploy, now)
+        elif have > want:
+            # Delete newest-first (approximates ReplicaSet downscale).
+            doomed = sorted(pods, key=lambda p: p.metadata.creation_timestamp,
+                            reverse=True)[: have - want]
+            for pod in doomed:
+                self._release_chips(pod)
+                self.client.delete(Pod.KIND, pod.metadata.namespace,
+                                   pod.metadata.name)
+                self._pending.pop(pod.metadata.name, None)
+
+        # refresh deployment status
+        pods = self._pods_of(deploy)
+        ready = sum(1 for p in pods if p.is_ready())
+        status_changed = (deploy.status.replicas != len(pods)
+                          or deploy.status.ready_replicas != ready)
+        if status_changed:
+            deploy.status.replicas = len(pods)
+            deploy.status.ready_replicas = ready
+            deploy.status.updated_replicas = len(pods)
+            try:
+                self.client.update_status(deploy)
+            except NotFoundError:
+                pass
+
+    def _create_pod(self, deploy: Deployment, now: float) -> None:
+        idx = self._counters.get(deploy.metadata.name, 0)
+        self._counters[deploy.metadata.name] = idx + 1
+        name = f"{deploy.metadata.name}-{idx}"
+        chips_needed = sum(
+            parse_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+            for c in deploy.template.containers)
+        node_name = self._find_node_with_chips(chips_needed)
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=name, namespace=deploy.metadata.namespace,
+                labels=dict(deploy.template.labels),
+                owner_references=[{"kind": "Deployment",
+                                   "name": deploy.metadata.name}]),
+            spec=deploy.template,
+            node_name=node_name or "",
+            status=PodStatus(phase="Pending", ready=False,
+                             pod_ip=f"10.244.0.{idx % 250 + 1}"),
+        )
+        self.client.create(pod)
+        if node_name or chips_needed == 0:
+            self._pending[name] = _PendingPod(name=name,
+                                              ready_at=now + self.startup_seconds)
+        else:
+            # Unschedulable now; _retry_unscheduled rebinds when chips free up
+            # (kube-scheduler retry semantics).
+            log.debug("pod %s unschedulable: no node with %d free chips",
+                      name, chips_needed)
+
+    def _find_node_with_chips(self, chips_needed: int) -> str | None:
+        """First node whose allocatable minus scheduled pod requests fits."""
+        if chips_needed <= 0:
+            return None
+        used: dict[str, int] = {}
+        for pod in self.client.list(Pod.KIND):
+            if not pod.node_name or pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            req = sum(parse_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+                      for c in pod.spec.containers)
+            used[pod.node_name] = used.get(pod.node_name, 0) + req
+        for node in self.client.list(Node.KIND):
+            alloc = parse_quantity(node.status.allocatable.get(TPU_RESOURCE_NAME, "0"))
+            if alloc - used.get(node.metadata.name, 0) >= chips_needed:
+                return node.metadata.name
+        return None
+
+    def _mark_ready(self, now: float) -> None:
+        for name, pending in list(self._pending.items()):
+            if pending.ready_at > now:
+                continue
+            # find the pod across namespaces
+            for pod in self.client.list(Pod.KIND):
+                if pod.metadata.name == name and not pod.status.ready:
+                    pod.status.phase = "Running"
+                    pod.status.ready = True
+                    try:
+                        self.client.update_status(pod)
+                    except NotFoundError:
+                        pass
+                    break
+            del self._pending[name]
+
+    def _release_chips(self, pod: Pod) -> None:
+        # Chips are derived from live pod listing; nothing to do explicitly.
+        return
+
+    def ready_pods_of(self, namespace: str, deployment_name: str) -> list[str]:
+        try:
+            deploy = self.client.get(Deployment.KIND, namespace, deployment_name)
+        except NotFoundError:
+            return []
+        return sorted(p.metadata.name for p in self._pods_of(deploy)
+                      if p.is_ready())

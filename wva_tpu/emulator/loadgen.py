@@ -1,0 +1,56 @@
+"""Deterministic load profiles
+(equivalent of ``test/utils/e2eutils.go:494`` CreateLoadGeneratorJob).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+# t_seconds -> requests/second
+LoadProfile = Callable[[float], float]
+
+
+def constant(rate: float) -> LoadProfile:
+    return lambda t: rate
+
+
+def step_profile(steps: list[tuple[float, float]]) -> LoadProfile:
+    """steps = [(start_time, rate), ...] sorted ascending."""
+
+    def profile(t: float) -> float:
+        rate = 0.0
+        for start, r in steps:
+            if t >= start:
+                rate = r
+        return rate
+
+    return profile
+
+
+def ramp(start_rate: float, end_rate: float, duration: float,
+         hold: float = float("inf")) -> LoadProfile:
+    """Linear ramp from start_rate to end_rate over ``duration``, then hold."""
+
+    def profile(t: float) -> float:
+        if t <= 0:
+            return start_rate
+        if t >= duration:
+            return end_rate if t < duration + hold else 0.0
+        return start_rate + (end_rate - start_rate) * (t / duration)
+
+    return profile
+
+
+@dataclass
+class SpikeProfile:
+    """Idle -> spike -> idle, for scale-from-zero / scale-to-zero scenarios."""
+
+    idle_until: float
+    spike_rate: float
+    spike_duration: float
+
+    def __call__(self, t: float) -> float:
+        if self.idle_until <= t < self.idle_until + self.spike_duration:
+            return self.spike_rate
+        return 0.0
