@@ -1,17 +1,28 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported anywhere
-so multi-chip sharding tests (dp/tp/sp/ep meshes) run without TPU hardware.
+Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported
+anywhere so multi-chip sharding tests (fleet meshes) run without TPU
+hardware. The baked axon TPU plugin self-registers from sitecustomize when
+``PALLAS_AXON_POOL_IPS`` is set and overrides ``JAX_PLATFORMS``, so that
+variable must be cleared too (the real chip is for bench.py, not unit tests).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize may have imported jax before this file ran, in which
+# case the env vars above are too late — but backends initialize lazily, so a
+# config update still redirects to the 8-device CPU platform.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,51 @@
+"""SLO (queueing-model) analyzer family — the TPU-native successor of the
+reference's dormant inferno optimizer (``pkg/analyzer``, ``pkg/core``,
+``pkg/solver``; SURVEY.md section 2 L(-1))."""
+
+from wva_tpu.analyzers.queueing.params import (
+    AnalysisMetrics,
+    PerfProfile,
+    PerfProfileStore,
+    QueueConfig,
+    RequestSize,
+    ServiceParms,
+    TargetPerf,
+    TargetRate,
+)
+from wva_tpu.analyzers.queueing.queue_model import (
+    CandidateBatch,
+    QueueAnalyzer,
+    analyze_batch,
+    candidate_batch,
+    size_batch,
+)
+from wva_tpu.analyzers.queueing.analyzer import QueueingModelAnalyzer
+from wva_tpu.analyzers.queueing.tuner import (
+    KalmanTuner,
+    TunedResults,
+    TunerConfig,
+    TunerController,
+    TunerEnvironment,
+)
+
+__all__ = [
+    "AnalysisMetrics",
+    "PerfProfile",
+    "PerfProfileStore",
+    "QueueConfig",
+    "RequestSize",
+    "ServiceParms",
+    "TargetPerf",
+    "TargetRate",
+    "CandidateBatch",
+    "QueueAnalyzer",
+    "analyze_batch",
+    "candidate_batch",
+    "size_batch",
+    "QueueingModelAnalyzer",
+    "KalmanTuner",
+    "TunedResults",
+    "TunerConfig",
+    "TunerController",
+    "TunerEnvironment",
+]

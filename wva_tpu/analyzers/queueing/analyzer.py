@@ -1,0 +1,249 @@
+"""SLO (queueing-model) analyzer — successor of the reference's dormant
+"inferno" model-based optimizer (``pkg/analyzer``, ``internal/modelanalyzer``),
+re-built as a third first-class :class:`~wva_tpu.interfaces.Analyzer` behind
+the same ``analyzerName`` switch that selects V2 (reference engine.go:236-254),
+so the whole engine → optimizer → enforcer → limiter pipeline is reused
+unchanged.
+
+Capacity semantics: a variant replica's capacity is the **max request rate
+(req/s) it can sustain while meeting the model's SLO targets** (TTFT/ITL/TPS
+from the service-class config), computed by sizing the M/M/1 state-dependent
+queue model (``pkg/analyzer/queueanalyzer.go:183-258``). Demand is the model's
+observed arrival rate. Required/spare capacity then use the same
+scale-up-threshold / scale-down-boundary headroom algebra as V2
+(``internal/interfaces/saturation_scaling.go:54-57``) so the
+CostAwareOptimizer consumes the result directly.
+
+TPU-native detail: every variant of every model in the tick is sized in ONE
+batched JAX call (:func:`~wva_tpu.analyzers.queueing.queue_model.size_batch`)
+— the per-candidate chain solves and bisections run as a single compiled XLA
+program (see ``__graft_entry__.py`` for the sharded multi-chip form).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+import jax.numpy as jnp
+
+from wva_tpu.analyzers.queueing.params import (
+    PerfProfile,
+    PerfProfileStore,
+    RequestSize,
+    TargetPerf,
+)
+from wva_tpu.analyzers.queueing.queue_model import candidate_batch, size_batch
+from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST
+
+if TYPE_CHECKING:  # pragma: no cover — config.slo imports queueing.params
+    from wva_tpu.config.slo import SLOConfigData
+from wva_tpu.interfaces import (
+    DEFAULT_SCALE_DOWN_BOUNDARY,
+    DEFAULT_SCALE_UP_THRESHOLD,
+    Analyzer,
+    AnalyzerInput,
+    AnalyzerResult,
+    SaturationScalingConfig,
+    VariantCapacity,
+)
+from wva_tpu.interfaces.saturation_config import SLO_ANALYZER_NAME
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+# Fallback request mix when no fresh replica reports token averages — matches
+# the V2 estimation defaults (reference saturation_v2/constants.go).
+DEFAULT_AVG_INPUT_TOKENS = 512.0
+DEFAULT_AVG_OUTPUT_TOKENS = 256.0
+
+
+@dataclass
+class _Candidate:
+    """One (variant, accelerator) sizing candidate prepared for the batch."""
+
+    variant_name: str
+    accelerator: str
+    cost: float
+    ready: int  # Ready replicas actually serving (current - pending)
+    pending: int  # exist-but-not-Ready pods (slice provisioning/model load)
+    profile: PerfProfile
+    targets: TargetPerf
+    request_size: RequestSize = field(default_factory=RequestSize)
+
+
+class QueueingModelAnalyzer(Analyzer):
+    """interfaces.Analyzer implementation selected by ``analyzerName: "slo"``."""
+
+    def __init__(self, profiles: PerfProfileStore | None = None,
+                 clock: Clock | None = None) -> None:
+        self.profiles = profiles or PerfProfileStore()
+        self.clock = clock or SYSTEM_CLOCK
+        self._slo: SLOConfigData | None = None
+
+    def name(self) -> str:
+        return SLO_ANALYZER_NAME
+
+    def sync_from_config(self, cfg: SLOConfigData | None,
+                         namespace: str = "") -> None:
+        """Adopt service classes + profiles from the hot-reloaded SLO
+        ConfigMap for one namespace scope ("" = global). Config-sourced
+        profiles are replaced wholesale (updates and deletions both take
+        effect); tuner-refined parameters survive re-syncs
+        (:meth:`PerfProfileStore.sync_namespace`)."""
+        self._slo = cfg
+        self.profiles.sync_namespace(
+            namespace, list(cfg.profiles) if cfg is not None else [])
+
+    # -- analysis --
+
+    def analyze(self, input: AnalyzerInput) -> AnalyzerResult:
+        result = AnalyzerResult(
+            analyzer_name=self.name(),
+            model_id=input.model_id,
+            namespace=input.namespace,
+            analyzed_at=self.clock.now(),
+        )
+        slo = input.slo_config if input.slo_config is not None else self._slo
+        if slo is None:
+            log.warning("SLO analyzer selected but no SLO config loaded; "
+                        "model %s skipped", input.model_id)
+            return result
+        targets, _priority = slo.targets_for_model(input.model_id)
+        if targets is None:
+            log.info("No SLO targets for model %s; skipped", input.model_id)
+            return result
+        if input.optimizer_metrics is None:
+            # Unknown demand must never read as zero demand — a Prometheus
+            # outage would otherwise scale the fleet down while traffic
+            # continues (fail-safe, same spirit as the V2 path skipping a
+            # model with no metrics and enforcer.go:100-106).
+            log.warning("Arrival-rate telemetry unavailable for model %s; "
+                        "skipping SLO analysis this tick", input.model_id)
+            return result
+
+        request_size = self._observed_request_size(input)
+        candidates = self._prepare_candidates(input, targets, request_size)
+        if not candidates:
+            return result
+
+        per_replica = self._size_candidates(candidates)
+
+        cfg = input.config if isinstance(input.config, SaturationScalingConfig) else SaturationScalingConfig()
+        scale_up = cfg.scale_up_threshold or DEFAULT_SCALE_UP_THRESHOLD
+        scale_down = cfg.scale_down_boundary or DEFAULT_SCALE_DOWN_BOUNDARY
+
+        demand = self._demand_per_s(input)
+        supply = 0.0
+        anticipated = 0.0
+        for cand, cap in zip(candidates, per_replica):
+            total = cap * cand.ready
+            supply += total
+            anticipated += cap * (cand.ready + cand.pending)
+            result.variant_capacities.append(VariantCapacity(
+                variant_name=cand.variant_name,
+                accelerator_name=cand.accelerator,
+                cost=cand.cost,
+                replica_count=cand.ready,
+                pending_replicas=cand.pending,
+                per_replica_capacity=cap,
+                total_capacity=total,
+                total_demand=0.0,
+                utilization=0.0,
+            ))
+
+        result.total_supply = supply
+        result.total_demand = demand
+        result.utilization = demand / supply if supply > 0 else (1.0 if demand > 0 else 0.0)
+        # Same anticipated-supply headroom algebra as V2
+        # (saturation_v2/analyzer.go:104-138 via saturation_scaling.go:54-57).
+        result.required_capacity = max(demand / scale_up - anticipated, 0.0)
+        result.spare_capacity = max(supply - demand / scale_down, 0.0) if supply > 0 else 0.0
+        return result
+
+    # -- internals --
+
+    def _observed_request_size(self, input: AnalyzerInput) -> RequestSize:
+        ins: list[float] = []
+        outs: list[float] = []
+        for rm in input.replica_metrics:
+            if rm.avg_input_tokens > 0:
+                ins.append(rm.avg_input_tokens)
+            if rm.avg_output_tokens > 0:
+                outs.append(rm.avg_output_tokens)
+        return RequestSize(
+            avg_input_tokens=sum(ins) / len(ins) if ins else DEFAULT_AVG_INPUT_TOKENS,
+            avg_output_tokens=max(sum(outs) / len(outs) if outs else DEFAULT_AVG_OUTPUT_TOKENS, 1.0),
+        )
+
+    def _demand_per_s(self, input: AnalyzerInput) -> float:
+        """Observed arrival rate (req/s). OptimizerMetrics carries req/min
+        (reference metrics_collector.go:12-24); scheduler-queue backlog is
+        drained over one optimization interval's worth of seconds as a
+        pressure term, mirroring V2's queue-demand estimate
+        (saturation_v2/analyzer.go:476-502)."""
+        demand = 0.0
+        if input.optimizer_metrics is not None:
+            demand += max(input.optimizer_metrics.arrival_rate, 0.0) / 60.0
+        if input.scheduler_queue is not None and input.scheduler_queue.queue_size > 0:
+            demand += input.scheduler_queue.queue_size / 60.0
+        return demand
+
+    def _prepare_candidates(
+        self, input: AnalyzerInput, targets: TargetPerf, request_size: RequestSize,
+    ) -> list[_Candidate]:
+        candidates: list[_Candidate] = []
+        for vs in input.variant_states:
+            profile = self.profiles.get(input.model_id, vs.accelerator_name,
+                                        namespace=input.namespace)
+            if profile is None or not profile.service_parms.valid():
+                log.warning(
+                    "No perf profile for (%s, %s); variant %s excluded from "
+                    "SLO sizing", input.model_id, vs.accelerator_name,
+                    vs.variant_name)
+                continue
+            cost = DEFAULT_VARIANT_COST
+            for rm in input.replica_metrics:
+                if rm.variant_name == vs.variant_name:
+                    cost = rm.cost
+                    break
+            # Same ready/pending split as V2 (saturation_v2/analyzer.py:259):
+            # not-yet-Ready slices are anticipated supply, not active supply.
+            candidates.append(_Candidate(
+                variant_name=vs.variant_name,
+                accelerator=vs.accelerator_name,
+                cost=cost,
+                ready=max(vs.current_replicas - vs.pending_replicas, 0),
+                pending=vs.pending_replicas,
+                profile=profile,
+                targets=targets,
+                request_size=request_size,
+            ))
+        return candidates
+
+    def _size_candidates(self, candidates: list[_Candidate]) -> list[float]:
+        """One batched size_batch call across every candidate. The batch is
+        padded to power-of-two buckets (min 8) so XLA compiles a handful of
+        shapes total instead of one executable per fleet size (first TPU
+        compile is 20-40s; recompiling per candidate-count would stall
+        ticks)."""
+        n = len(candidates)
+        bucket = max(8, 1 << (n - 1).bit_length())
+        padded = candidates + [candidates[0]] * (bucket - n)
+        cand = candidate_batch(
+            [c.profile.service_parms.alpha for c in padded],
+            [c.profile.service_parms.beta for c in padded],
+            [c.profile.service_parms.gamma for c in padded],
+            [c.request_size.avg_input_tokens for c in padded],
+            [c.request_size.avg_output_tokens for c in padded],
+            [c.profile.max_batch_size for c in padded],
+            [c.profile.max_batch_size + c.profile.max_queue_size for c in padded],
+        )
+        out = size_batch(
+            cand,
+            jnp.asarray([c.targets.target_ttft_ms for c in padded], jnp.float32),
+            jnp.asarray([c.targets.target_itl_ms for c in padded], jnp.float32),
+            jnp.asarray([c.targets.target_tps for c in padded], jnp.float32),
+        )
+        return [float(x) for x in out["max_rate_per_s"][:n]]

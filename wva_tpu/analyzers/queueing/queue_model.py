@@ -1,0 +1,378 @@
+"""Batched, TPU-native M/M/1 state-dependent queueing solver.
+
+Re-designs the reference's scalar chain solver
+(``pkg/analyzer/mm1modelstatedependent.go:70-117`` — a Python-style loop with
+overflow rescaling, one (server, accelerator) candidate at a time) as a dense
+JAX computation:
+
+- **Log-space chain.** The birth-death stationary distribution
+  ``p[n+1] = p[n] * lambda / mu(n+1)`` becomes a cumulative sum of
+  ``log(lambda) - log(mu)`` normalized with ``logsumexp`` — no overflow
+  rescaling loops, numerically stable at any utilization, and a single fused
+  scan/reduce on the accelerator.
+- **Batched candidates.** All (variant, accelerator, request-mix) candidates
+  are evaluated together as a ``[C, K_MAX]`` array program — one compiled
+  XLA executable regardless of fleet size. Occupancy bounds are static
+  (``K_MAX``) with per-candidate masks, so shapes never depend on data.
+- **Fixed-iteration vectorized bisection.** SLO sizing
+  (``pkg/analyzer/queueanalyzer.go:183-258`` + ``utils.go:26-70``) runs as a
+  ``lax.fori_loop`` of 48 bisection steps over the whole candidate batch at
+  once; TTFT and ITL searches share the same chain evaluations by stacking
+  along a leading axis of size 2.
+
+All arrays are float32 (TPU-native); internal rates are requests/ms to match
+the reference's millisecond time unit, public rates are requests/s.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+from wva_tpu.analyzers.queueing.params import (
+    EPSILON,
+    K_MAX,
+    MAX_BATCH_BOUND,
+    STABILITY_SAFETY_FRACTION,
+    AnalysisMetrics,
+    QueueConfig,
+    RequestSize,
+    TargetPerf,
+    TargetRate,
+)
+
+_BISECTION_ITERS = 48
+_NEG_INF = -1e30
+
+
+class CandidateBatch(NamedTuple):
+    """Struct-of-arrays description of C queue candidates; every field has
+    shape ``[C]``."""
+
+    alpha: jax.Array  # ms
+    beta: jax.Array  # ms / compute token
+    gamma: jax.Array  # ms / memory token
+    avg_input_tokens: jax.Array
+    avg_output_tokens: jax.Array
+    max_batch: jax.Array  # int32, <= MAX_BATCH_BOUND
+    k: jax.Array  # int32 occupancy bound (batch + queue), <= K_MAX
+
+
+def candidate_batch(
+    alphas, betas, gammas, avg_in, avg_out, max_batch, k
+) -> CandidateBatch:
+    """Build a CandidateBatch from python/numpy sequences."""
+    f = lambda x: jnp.asarray(x, dtype=jnp.float32)  # noqa: E731
+    i = lambda x: jnp.asarray(x, dtype=jnp.int32)  # noqa: E731
+    return CandidateBatch(
+        alpha=f(alphas),
+        beta=f(betas),
+        gamma=f(gammas),
+        avg_input_tokens=f(avg_in),
+        avg_output_tokens=f(avg_out),
+        max_batch=jnp.clip(i(max_batch), 1, MAX_BATCH_BOUND),
+        k=jnp.clip(i(k), 1, K_MAX),
+    )
+
+
+def _token_factors(cand: CandidateBatch) -> tuple[jax.Array, jax.Array]:
+    """computeTokens / memoryTokens per request (reference
+    queueanalyzer.go:262-264)."""
+    tokens_compute = (cand.avg_input_tokens + cand.avg_output_tokens) / (
+        cand.avg_output_tokens + 1.0
+    )
+    tokens_memory = cand.avg_input_tokens + cand.avg_output_tokens / 2.0
+    return tokens_compute, tokens_memory
+
+
+def _iteration_time(cand: CandidateBatch, batch: jax.Array) -> jax.Array:
+    """T(n) = alpha + n*(beta*tc + gamma*tm); ``batch`` broadcasts against the
+    candidate axis (reference queueanalyzer.go:261-266)."""
+    tc, tm = _token_factors(cand)
+    return cand.alpha[..., None] + batch * (
+        (cand.beta * tc)[..., None] + (cand.gamma * tm)[..., None]
+    )
+
+
+def _prefill_time(cand: CandidateBatch, batch: jax.Array) -> jax.Array:
+    """Prefill latency at occupancy ``batch``; 0 when there is no prompt
+    (reference queueanalyzer.go:269-274)."""
+    t = _iteration_time(cand, batch) + (
+        (cand.beta + cand.gamma) * cand.avg_input_tokens
+    )[..., None]
+    return jnp.where(cand.avg_input_tokens[..., None] > 0, t, 0.0)
+
+
+def _decode_time(cand: CandidateBatch, batch: jax.Array) -> jax.Array:
+    """Per-token decode latency at occupancy ``batch`` (reference
+    queueanalyzer.go:277-280)."""
+    return (
+        _iteration_time(cand, batch)
+        + cand.beta[..., None]
+        + (cand.gamma * (cand.avg_input_tokens + cand.avg_output_tokens / 2.0))[
+            ..., None
+        ]
+    )
+
+
+def _service_rate(cand: CandidateBatch, occupancy: jax.Array) -> jax.Array:
+    """State-dependent service rate mu(n) in req/ms: n requests finish every
+    prefill(n) + O*decode(n) ms, saturating at max_batch (reference
+    queueanalyzer.go:99-105 with the clamp from
+    mm1modelstatedependent.go:80-84)."""
+    eff = jnp.minimum(occupancy, cand.max_batch[..., None]).astype(jnp.float32)
+    per_req = _prefill_time(cand, eff) + cand.avg_output_tokens[..., None] * _decode_time(
+        cand, eff
+    )
+    return eff / jnp.maximum(per_req, 1e-12)
+
+
+def rate_bounds_per_ms(cand: CandidateBatch) -> tuple[jax.Array, jax.Array]:
+    """Feasible arrival-rate range [lambda_min, lambda_max] in req/ms
+    (reference queueanalyzer.go:107-110): epsilon*mu(1) to (1-eps)*mu(B)."""
+    mu1 = _service_rate(cand, jnp.ones((cand.alpha.shape[0], 1), jnp.int32))[:, 0]
+    mu_b = _service_rate(cand, cand.max_batch[:, None])[:, 0]
+    return mu1 * EPSILON, mu_b * (1.0 - EPSILON)
+
+
+def _chain_stats(lam: jax.Array, cand: CandidateBatch) -> dict[str, jax.Array]:
+    """Solve the stationary distribution for arrival rate ``lam`` (req/ms,
+    shape [C]) and return queue statistics (reference
+    mm1modelstatedependent.go:38-117, computed in log-space instead of with
+    overflow rescaling)."""
+    c = lam.shape[0]
+    states = jnp.arange(1, K_MAX + 1, dtype=jnp.int32)[None, :]  # [1, K_MAX]
+    mu = _service_rate(cand, jnp.broadcast_to(states, (c, K_MAX)))  # [C, K_MAX]
+
+    log_ratio = jnp.log(jnp.maximum(lam[:, None], 1e-30)) - jnp.log(
+        jnp.maximum(mu, 1e-30)
+    )
+    # States beyond the per-candidate occupancy bound k are unreachable.
+    log_ratio = jnp.where(states <= cand.k[:, None], log_ratio, _NEG_INF)
+
+    logp = jnp.concatenate(
+        [jnp.zeros((c, 1), jnp.float32), jnp.cumsum(log_ratio, axis=1)], axis=1
+    )  # [C, K_MAX+1], states 0..K_MAX
+    logp = jnp.maximum(logp, _NEG_INF)
+    logz = logsumexp(logp, axis=1, keepdims=True)
+    p = jnp.exp(logp - logz)
+
+    all_states = jnp.arange(0, K_MAX + 1, dtype=jnp.float32)[None, :]
+    n_in_system = jnp.sum(all_states * p, axis=1)
+    n_in_servers = jnp.sum(
+        jnp.minimum(all_states, cand.max_batch[:, None].astype(jnp.float32)) * p,
+        axis=1,
+    )
+    p_block = jnp.take_along_axis(p, cand.k[:, None], axis=1)[:, 0]
+    p0 = p[:, 0]
+
+    throughput = lam * (1.0 - p_block)  # req/ms
+    safe_x = jnp.maximum(throughput, 1e-30)
+    avg_resp = n_in_system / safe_x
+    avg_serv = n_in_servers / safe_x
+    avg_wait = jnp.maximum(avg_resp - avg_serv, 0.0)
+    return {
+        "p0": p0,
+        "p_block": p_block,
+        "throughput": throughput,
+        "avg_num_in_system": n_in_system,
+        "avg_num_in_servers": n_in_servers,
+        "avg_resp_time": avg_resp,
+        "avg_serv_time": avg_serv,
+        "avg_wait_time": avg_wait,
+        "rho_busy": 1.0 - p0,
+    }
+
+
+def _derived_latencies(
+    stats: dict[str, jax.Array], cand: CandidateBatch
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(prefill, itl, ttft) in ms from chain stats (reference
+    queueanalyzer.go:145-150)."""
+    n_serv = stats["avg_num_in_servers"]
+    prefill = _prefill_time(cand, n_serv[:, None])[:, 0]
+    itl = (stats["avg_serv_time"] - prefill) / jnp.maximum(
+        cand.avg_output_tokens, 1.0
+    )
+    ttft = stats["avg_wait_time"] + prefill + itl
+    return prefill, itl, ttft
+
+
+@jax.jit
+def analyze_batch(rate_per_s: jax.Array, cand: CandidateBatch) -> dict[str, jax.Array]:
+    """Steady-state metrics for each candidate at its arrival rate (req/s).
+
+    Vectorized equivalent of ``QueueAnalyzer.Analyze``
+    (reference queueanalyzer.go:127-168). Rates above the feasible maximum are
+    clamped and reported via ``valid``.
+    """
+    lam_min, lam_max = rate_bounds_per_ms(cand)
+    lam_req = jnp.asarray(rate_per_s, jnp.float32) / 1000.0
+    valid = (lam_req > 0) & (lam_req <= lam_max)
+    lam = jnp.clip(lam_req, lam_min, lam_max)
+
+    stats = _chain_stats(lam, cand)
+    prefill, itl, ttft = _derived_latencies(stats, cand)
+    rho = jnp.clip(
+        stats["avg_num_in_servers"] / cand.max_batch.astype(jnp.float32), 0.0, 1.0
+    )
+    return {
+        "valid": valid,
+        "throughput_per_s": stats["throughput"] * 1000.0,
+        "avg_resp_time_ms": stats["avg_resp_time"],
+        "avg_wait_time_ms": stats["avg_wait_time"],
+        "avg_num_in_serv": stats["avg_num_in_servers"],
+        "avg_prefill_time_ms": prefill,
+        "avg_token_time_ms": itl,
+        "avg_ttft_ms": ttft,
+        "max_rate_per_s": lam_max * 1000.0,
+        "rho": rho,
+    }
+
+
+@jax.jit
+def size_batch(
+    cand: CandidateBatch,
+    target_ttft_ms: jax.Array,
+    target_itl_ms: jax.Array,
+    target_tps: jax.Array,
+) -> dict[str, jax.Array]:
+    """Max arrival rate per candidate meeting its TTFT/ITL/TPS targets.
+
+    Vectorized equivalent of ``QueueAnalyzer.Size``
+    (reference queueanalyzer.go:183-258): per-target bisection on the arrival
+    rate (both TTFT and ITL are monotone increasing in lambda), TPS handled as
+    a stability-margin cap on the max service rate (reference :236-239,
+    StabilitySafetyFraction). Targets <= 0 are disabled and yield lambda_max.
+
+    The two latency bisections are stacked on a leading axis of size 2 so each
+    of the 48 iterations costs one chain solve over ``[2*C, K_MAX]``.
+    """
+    c = cand.alpha.shape[0]
+    lam_min, lam_max = rate_bounds_per_ms(cand)
+
+    stacked = jax.tree.map(lambda x: jnp.concatenate([x, x], axis=0), cand)
+    targets = jnp.concatenate(
+        [jnp.asarray(target_ttft_ms, jnp.float32), jnp.asarray(target_itl_ms, jnp.float32)]
+    )  # [2C]
+    lo0 = jnp.concatenate([lam_min, lam_min])
+    hi0 = jnp.concatenate([lam_max, lam_max])
+
+    def eval_metric(lam: jax.Array) -> jax.Array:
+        stats = _chain_stats(lam, stacked)
+        _, itl, ttft = _derived_latencies(stats, stacked)
+        return jnp.concatenate([ttft[:c], itl[c:]])
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = 0.5 * (lo + hi)
+        y = eval_metric(mid)
+        go_right = y < targets  # metric below target -> rate can grow
+        lo = jnp.where(go_right, mid, lo)
+        hi = jnp.where(go_right, hi, mid)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, _BISECTION_ITERS, body, (lo0, hi0))
+    lam_star = 0.5 * (lo + hi)
+
+    rate_ttft = jnp.where(targets[:c] > 0, lam_star[:c], lam_max)
+    rate_itl = jnp.where(targets[c:] > 0, lam_star[c:], lam_max)
+    rate_tps = jnp.where(
+        jnp.asarray(target_tps, jnp.float32) > 0,
+        lam_max * (1.0 - STABILITY_SAFETY_FRACTION),
+        lam_max,
+    )
+    lam_best = jnp.minimum(jnp.minimum(rate_ttft, rate_itl), rate_tps)
+
+    stats = _chain_stats(lam_best, cand)
+    prefill, itl, ttft = _derived_latencies(stats, cand)
+    return {
+        "rate_target_ttft_per_s": rate_ttft * 1000.0,
+        "rate_target_itl_per_s": rate_itl * 1000.0,
+        "rate_target_tps_per_s": rate_tps * 1000.0,
+        "max_rate_per_s": lam_best * 1000.0,
+        "achieved_ttft_ms": ttft,
+        "achieved_itl_ms": itl,
+        "achieved_tps": stats["throughput"] * 1000.0 * cand.avg_output_tokens,
+        "throughput_per_s": stats["throughput"] * 1000.0,
+        "rho": jnp.clip(
+            stats["avg_num_in_servers"] / cand.max_batch.astype(jnp.float32), 0.0, 1.0
+        ),
+    }
+
+
+class QueueAnalyzer:
+    """Scalar convenience facade over the batched solver — parity surface of
+    the reference ``QueueAnalyzer`` (``pkg/analyzer/queueanalyzer.go:84-124``)
+    for single-candidate use and tests. Production paths (SLO analyzer,
+    solver) call :func:`analyze_batch` / :func:`size_batch` directly."""
+
+    def __init__(self, config: QueueConfig, request_size: RequestSize) -> None:
+        if not config.valid():
+            raise ValueError(f"invalid queue configuration: {config}")
+        if not request_size.valid():
+            raise ValueError(f"invalid request size: {request_size}")
+        self.config = config
+        self.request_size = request_size
+        self._cand = candidate_batch(
+            [config.service_parms.alpha],
+            [config.service_parms.beta],
+            [config.service_parms.gamma],
+            [request_size.avg_input_tokens],
+            [request_size.avg_output_tokens],
+            [config.max_batch_size],
+            [config.max_batch_size + config.max_queue_size],
+        )
+        lam_min, lam_max = rate_bounds_per_ms(self._cand)
+        self.min_rate_per_s = float(lam_min[0]) * 1000.0
+        self.max_rate_per_s = float(lam_max[0]) * 1000.0
+
+    def analyze(self, request_rate_per_s: float) -> AnalysisMetrics:
+        if request_rate_per_s <= 0:
+            raise ValueError(f"invalid request rate {request_rate_per_s}")
+        if request_rate_per_s > self.max_rate_per_s:
+            raise ValueError(
+                f"rate={request_rate_per_s}, max allowed rate={self.max_rate_per_s}"
+            )
+        out = analyze_batch(jnp.asarray([request_rate_per_s]), self._cand)
+        return AnalysisMetrics(
+            throughput=float(out["throughput_per_s"][0]),
+            avg_resp_time_ms=float(out["avg_resp_time_ms"][0]),
+            avg_wait_time_ms=float(out["avg_wait_time_ms"][0]),
+            avg_num_in_serv=float(out["avg_num_in_serv"][0]),
+            avg_prefill_time_ms=float(out["avg_prefill_time_ms"][0]),
+            avg_token_time_ms=float(out["avg_token_time_ms"][0]),
+            avg_ttft_ms=float(out["avg_ttft_ms"][0]),
+            max_rate=float(out["max_rate_per_s"][0]),
+            rho=float(out["rho"][0]),
+        )
+
+    def size(self, targets: TargetPerf) -> tuple[TargetRate, AnalysisMetrics, TargetPerf]:
+        """Returns (max rates, metrics at the binding rate, achieved targets)
+        — reference queueanalyzer.go:183-258."""
+        if math.isnan(targets.target_ttft_ms) or math.isnan(targets.target_itl_ms):
+            raise ValueError(f"invalid targets: {targets}")
+        out = size_batch(
+            self._cand,
+            jnp.asarray([targets.target_ttft_ms]),
+            jnp.asarray([targets.target_itl_ms]),
+            jnp.asarray([targets.target_tps]),
+        )
+        rates = TargetRate(
+            rate_target_ttft=float(out["rate_target_ttft_per_s"][0]),
+            rate_target_itl=float(out["rate_target_itl_per_s"][0]),
+            rate_target_tps=float(out["rate_target_tps_per_s"][0]),
+        )
+        metrics = self.analyze(
+            min(max(out["max_rate_per_s"][0].item(), 1e-9), self.max_rate_per_s))
+        achieved = TargetPerf(
+            target_ttft_ms=float(out["achieved_ttft_ms"][0]),
+            target_itl_ms=float(out["achieved_itl_ms"][0]),
+            target_tps=float(out["achieved_tps"][0]),
+        )
+        return rates, metrics, achieved

@@ -1,0 +1,265 @@
+"""Online service-parameter tuner: an Extended Kalman Filter re-estimating
+the iteration-time parameters (alpha, beta, gamma) from observed TTFT/ITL.
+
+Successor of the reference's dormant tuner
+(``internal/engines/analyzers/queueingmodel/tuner/tuner.go:15-287``), which
+delegates to an external EKF library (``llm-inferno/kalman-filter`` + gonum)
+with numerically propagated Jacobians. The TPU-native redesign differentiates
+straight through the batched M/M/1-SD chain solver with ``jax.jacfwd`` —
+h(x) = (TTFT, ITL) predicted by the queueing model at the observed arrival
+rate, and H = dh/dx is exact to machine precision, one fused XLA program for
+h and H together.
+
+Acceptance follows the reference's NIS gate
+(``tuner/defaults.go:12-19``): under nominal conditions the Normalized
+Innovations Squared follows a chi-squared distribution with dof = observation
+dimension (2); updates outside the 95% confidence bound (7.378) are rolled
+back so a burst of anomalous telemetry cannot corrupt the state
+(``tuner.go:108-133`` stash/unstash).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wva_tpu.analyzers.queueing.params import (
+    K_MAX,
+    PerfProfileStore,
+    ServiceParms,
+)
+from wva_tpu.analyzers.queueing.queue_model import (
+    CandidateBatch,
+    _chain_stats,
+    _derived_latencies,
+    rate_bounds_per_ms,
+)
+
+log = logging.getLogger(__name__)
+
+# 95% chi-squared bound, dof=2 (reference tuner/defaults.go:12-19).
+DEFAULT_MAX_NIS = 7.378
+
+STATE_ALPHA, STATE_BETA, STATE_GAMMA = 0, 1, 2
+
+
+@dataclass
+class TunerEnvironment:
+    """Operating point the observations were taken at
+    (reference tuner/environment.go:10-28)."""
+
+    lambda_per_min: float = 0.0  # request arrival rate (per minute)
+    avg_input_tokens: float = 0.0
+    avg_output_tokens: float = 0.0
+    max_batch_size: int = 0
+    avg_ttft_ms: float = 0.0  # observed
+    avg_itl_ms: float = 0.0  # observed
+
+    def valid(self) -> bool:
+        vals = [self.lambda_per_min, self.avg_input_tokens,
+                self.avg_output_tokens, self.avg_ttft_ms, self.avg_itl_ms]
+        return (all(v > 0 and math.isfinite(v) for v in vals)
+                and self.max_batch_size > 0)
+
+
+@dataclass
+class TunerConfig:
+    """Filter tuning knobs (reference tuner/types.go:9-25, with the
+    reference's (errorLevel/tPercentile)^2/gammaFactor observation-noise
+    construction collapsed into one fraction)."""
+
+    # Expected 1-sigma relative change of each state param per step -> Q.
+    percent_change: tuple[float, float, float] = (0.05, 0.05, 0.05)
+    # Relative 1-sigma observation noise on (TTFT, ITL) -> R.
+    observation_noise_frac: float = 0.10
+    max_nis: float = DEFAULT_MAX_NIS
+    min_state: tuple[float, float, float] = (1e-4, 0.0, 0.0)
+    max_state: tuple[float, float, float] = (1e4, 10.0, 10.0)
+    # Re-acquisition: after this many consecutive NIS rejections the state
+    # covariance is inflated so the filter can converge from a badly wrong
+    # prior instead of rejecting forever (an improvement over the reference,
+    # which rolls back unconditionally, tuner.go:108-133 — a misfit initial
+    # profile there pins the filter permanently).
+    max_consecutive_rejections: int = 3
+    covariance_inflation: float = 10.0
+    # Queue bound used by the observation model, as a multiple of max batch
+    # (reference config.MaxQueueToBatchRatio).
+    max_queue_to_batch_ratio: int = 4
+
+
+@dataclass
+class TunedResults:
+    """Outcome of one filter step (reference tuner/tuner.go:21-27)."""
+
+    service_parms: ServiceParms
+    innovation: tuple[float, float] = (0.0, 0.0)
+    nis: float = -1.0
+    validation_failed: bool = False
+
+
+@partial(jax.jit, static_argnames=())
+def _observe_and_jacobian(x: jax.Array, env: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """h(x) = (TTFT_ms, ITL_ms) predicted at the environment's operating
+    point, plus H = dh/dx via forward-mode autodiff through the chain solver.
+
+    x = [alpha, beta, gamma]; env = [lam_per_ms, avg_in, avg_out, max_batch, k].
+    """
+
+    def h(params: jax.Array) -> jax.Array:
+        cand = CandidateBatch(
+            alpha=params[0:1],
+            beta=params[1:2],
+            gamma=params[2:3],
+            avg_input_tokens=env[1:2],
+            avg_output_tokens=env[2:3],
+            max_batch=env[3:4].astype(jnp.int32),
+            k=env[4:5].astype(jnp.int32),
+        )
+        lam_min, lam_max = rate_bounds_per_ms(cand)
+        lam = jnp.clip(env[0:1], lam_min, lam_max)
+        stats = _chain_stats(lam, cand)
+        _, itl, ttft = _derived_latencies(stats, cand)
+        return jnp.stack([ttft[0], itl[0]])
+
+    return h(x), jax.jacfwd(h)(x)
+
+
+class KalmanTuner:
+    """EKF over one (model, accelerator) profile's service parameters."""
+
+    def __init__(self, init: ServiceParms, config: TunerConfig | None = None) -> None:
+        if not init.valid():
+            raise ValueError(f"invalid initial service parms: {init}")
+        self.config = config or TunerConfig()
+        self.x = np.array([init.alpha, init.beta, init.gamma], dtype=np.float64)
+        pc = np.asarray(self.config.percent_change, dtype=np.float64)
+        # P0 and Q from expected relative change (reference
+        # configurator.go:82-91 GetStateCov).
+        self.P = np.diag((pc * self.x) ** 2)
+        self.steps = 0
+        self.rejected = 0
+        self._consecutive_rejections = 0
+
+    def _q(self) -> np.ndarray:
+        pc = np.asarray(self.config.percent_change, dtype=np.float64)
+        return np.diag(np.maximum((pc * self.x) ** 2, 1e-12))
+
+    def _r(self, z: np.ndarray) -> np.ndarray:
+        frac = self.config.observation_noise_frac
+        return np.diag(np.maximum((frac * z) ** 2, 1e-9))
+
+    def run(self, env: TunerEnvironment) -> TunedResults:
+        """One predict/update step against the observed environment
+        (reference tuner.go:82-143). On NIS rejection the previous state is
+        kept and returned with ``validation_failed=True``."""
+        if not env.valid():
+            raise ValueError(f"cannot run tuner with invalid environment: {env}")
+        cfg = self.config
+        k_bound = min(env.max_batch_size * (1 + cfg.max_queue_to_batch_ratio),
+                      K_MAX)
+        env_vec = jnp.asarray([
+            env.lambda_per_min / 60_000.0,  # per-minute -> per-ms
+            env.avg_input_tokens,
+            env.avg_output_tokens,
+            float(env.max_batch_size),
+            float(k_bound),
+        ], dtype=jnp.float32)
+        z = np.array([env.avg_ttft_ms, env.avg_itl_ms], dtype=np.float64)
+
+        x_prev, p_prev = self.x.copy(), self.P.copy()
+
+        # Predict (identity transition; reference stateTransitionFunc).
+        p_pred = self.P + self._q()
+
+        h_val, h_jac = _observe_and_jacobian(
+            jnp.asarray(self.x, jnp.float32), env_vec)
+        h_val = np.asarray(h_val, np.float64)
+        H = np.asarray(h_jac, np.float64)
+
+        r = self._r(z)
+        y = z - h_val
+        s = H @ p_pred @ H.T + r
+        try:
+            s_inv = np.linalg.inv(s)
+        except np.linalg.LinAlgError:
+            return TunedResults(service_parms=self._parms(), innovation=tuple(y),
+                                nis=-1.0, validation_failed=True)
+        nis = float(y @ s_inv @ y)
+
+        gain = p_pred @ H.T @ s_inv
+        x_new = self.x + gain @ y
+        x_new = np.clip(x_new, cfg.min_state, cfg.max_state)
+        eye = np.eye(3)
+        # Joseph form keeps P symmetric positive semi-definite.
+        p_new = (eye - gain @ H) @ p_pred @ (eye - gain @ H).T + gain @ r @ gain.T
+
+        self.steps += 1
+        if not math.isfinite(nis) or nis > cfg.max_nis or not np.all(
+                np.isfinite(x_new)):
+            self.x, self.P = x_prev, p_prev
+            self.rejected += 1
+            self._consecutive_rejections += 1
+            if self._consecutive_rejections >= cfg.max_consecutive_rejections:
+                # Persistent mismatch: the prior, not the telemetry, is wrong.
+                # Inflate P so subsequent steps can move the state.
+                self.P = self.P * cfg.covariance_inflation
+                self._consecutive_rejections = 0
+            return TunedResults(service_parms=self._parms(), innovation=tuple(y),
+                                nis=nis, validation_failed=True)
+
+        self._consecutive_rejections = 0
+        self.x, self.P = x_new, p_new
+        return TunedResults(service_parms=self._parms(), innovation=tuple(y),
+                            nis=nis, validation_failed=False)
+
+    def _parms(self) -> ServiceParms:
+        return ServiceParms(alpha=float(self.x[STATE_ALPHA]),
+                            beta=float(self.x[STATE_BETA]),
+                            gamma=float(self.x[STATE_GAMMA]))
+
+
+class TunerController:
+    """Owns one :class:`KalmanTuner` per (namespace, model, accelerator) and
+    writes accepted refinements back to the :class:`PerfProfileStore` (the
+    write-back path the reference never wired in — ``tuner.go`` is reachable
+    only from tests there; SURVEY.md section 2 L(-1))."""
+
+    def __init__(self, profiles: PerfProfileStore,
+                 config: TunerConfig | None = None) -> None:
+        self.profiles = profiles
+        self.config = config or TunerConfig()
+        self._mu = threading.Lock()
+        self._tuners: dict[tuple[str, str, str], KalmanTuner] = {}
+
+    def observe(self, namespace: str, model_id: str, accelerator: str,
+                env: TunerEnvironment) -> TunedResults | None:
+        """Feed one telemetry sample; returns the step result, or None when
+        there is no profile to refine / the environment is unusable."""
+        if not env.valid():
+            return None
+        profile = self.profiles.get(model_id, accelerator, namespace=namespace)
+        if profile is None or not profile.service_parms.valid():
+            return None
+        key = (namespace, model_id, accelerator)
+        with self._mu:
+            tuner = self._tuners.get(key)
+            if tuner is None:
+                tuner = KalmanTuner(profile.service_parms, self.config)
+                self._tuners[key] = tuner
+        result = tuner.run(env)
+        if not result.validation_failed and result.service_parms.valid():
+            self.profiles.update_service_parms(
+                model_id, accelerator, result.service_parms,
+                namespace=profile.namespace)
+            log.debug("Tuner refined (%s, %s, %s): alpha=%.4f beta=%.5f "
+                      "gamma=%.6f NIS=%.3f", namespace, model_id, accelerator,
+                      result.service_parms.alpha, result.service_parms.beta,
+                      result.service_parms.gamma, result.nis)
+        return result

@@ -21,6 +21,13 @@ from wva_tpu.collector.registration.scale_to_zero import (
     collect_model_request_count,
     register_scale_to_zero_queries,
 )
+from wva_tpu.collector.registration.slo import (
+    QUERY_ARRIVAL_RATE,
+    QUERY_AVG_ITL,
+    QUERY_AVG_TTFT,
+    collect_optimizer_metrics,
+    register_slo_queries,
+)
 
 __all__ = [
     "QUERY_AVG_INPUT_TOKENS",
@@ -40,4 +47,9 @@ __all__ = [
     "QUERY_MODEL_REQUEST_COUNT",
     "collect_model_request_count",
     "register_scale_to_zero_queries",
+    "QUERY_ARRIVAL_RATE",
+    "QUERY_AVG_ITL",
+    "QUERY_AVG_TTFT",
+    "collect_optimizer_metrics",
+    "register_slo_queries",
 ]

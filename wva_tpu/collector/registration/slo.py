@@ -1,0 +1,103 @@
+"""SLO-analyzer telemetry queries: model-level arrival rate and observed
+latencies.
+
+The reference's inferno path consumed the same shape through
+``interfaces.OptimizerMetrics`` (``internal/interfaces/metrics_collector.go:
+12-24``, arrival rate in req/min). Queries accept both vLLM-TPU (``vllm:*``)
+and JetStream metric families, like the saturation registrations.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+from wva_tpu.collector.source.query_template import QueryTemplate
+from wva_tpu.collector.source.registry import PROMETHEUS_SOURCE_NAME, SourceRegistry
+from wva_tpu.collector.source.source import (
+    PARAM_MODEL_ID,
+    PARAM_NAMESPACE,
+    MetricsSource,
+    RefreshSpec,
+)
+from wva_tpu.interfaces.allocation import OptimizerMetrics
+
+log = logging.getLogger(__name__)
+
+QUERY_ARRIVAL_RATE = "model_arrival_rate"
+QUERY_AVG_TTFT = "model_avg_ttft"
+QUERY_AVG_ITL = "model_avg_itl"
+
+_NS_MODEL = '{namespace="{{.namespace}}",model_name="{{.modelID}}"}'
+
+
+def register_slo_queries(source_registry: SourceRegistry) -> None:
+    src = source_registry.get(PROMETHEUS_SOURCE_NAME)
+    if src is None:
+        log.debug("Prometheus source not registered; skipping SLO queries")
+        return
+    ql = src.query_list()
+    ql.register_if_absent(QueryTemplate(
+        name=QUERY_ARRIVAL_RATE,
+        template=(
+            f"sum(rate(vllm:request_success_total{_NS_MODEL}[1m])"
+            f" or rate(jetstream_request_success_total{_NS_MODEL}[1m]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Model request arrival (completion) rate, req/s over 1m",
+    ))
+    ql.register_if_absent(QueryTemplate(
+        name=QUERY_AVG_TTFT,
+        template=(
+            f"sum(rate(vllm:time_to_first_token_seconds_sum{_NS_MODEL}[5m])"
+            f" or rate(jetstream_time_to_first_token_sum{_NS_MODEL}[5m]))"
+            f" / sum(rate(vllm:time_to_first_token_seconds_count{_NS_MODEL}[5m])"
+            f" or rate(jetstream_time_to_first_token_count{_NS_MODEL}[5m]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Observed mean TTFT (s) over 5m",
+    ))
+    ql.register_if_absent(QueryTemplate(
+        name=QUERY_AVG_ITL,
+        template=(
+            f"sum(rate(vllm:time_per_output_token_seconds_sum{_NS_MODEL}[5m])"
+            f" or rate(jetstream_time_per_output_token_sum{_NS_MODEL}[5m]))"
+            f" / sum(rate(vllm:time_per_output_token_seconds_count{_NS_MODEL}[5m])"
+            f" or rate(jetstream_time_per_output_token_count{_NS_MODEL}[5m]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description="Observed mean inter-token latency (s) over 5m",
+    ))
+
+
+def collect_optimizer_metrics(
+    metrics_source: MetricsSource, model_id: str, namespace: str,
+) -> OptimizerMetrics | None:
+    """Model-level rate/latency telemetry; None when the arrival rate is
+    unavailable (latencies are optional — used only by the tuner)."""
+    params = {PARAM_MODEL_ID: model_id, PARAM_NAMESPACE: namespace}
+    try:
+        results = metrics_source.refresh(RefreshSpec(
+            queries=[QUERY_ARRIVAL_RATE, QUERY_AVG_TTFT, QUERY_AVG_ITL],
+            params=params))
+    except Exception as e:  # noqa: BLE001
+        log.debug("optimizer metrics unavailable for %s: %s", model_id, e)
+        return None
+
+    def first_value(name: str) -> float | None:
+        result = results.get(name)
+        if result is None or result.has_error():
+            return None
+        for v in result.values:
+            if math.isfinite(v.value):
+                return float(v.value)
+        return None
+
+    rate = first_value(QUERY_ARRIVAL_RATE)
+    if rate is None:
+        return None
+    return OptimizerMetrics(
+        arrival_rate=rate * 60.0,  # req/s -> req/min (reference convention)
+        ttft_seconds=first_value(QUERY_AVG_TTFT) or 0.0,
+        itl_seconds=first_value(QUERY_AVG_ITL) or 0.0,
+    )

@@ -13,6 +13,10 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover — avoids a config -> analyzers cycle
+    from wva_tpu.config.slo import SLOConfigData
 
 from wva_tpu.config.types import CacheConfig, ScaleToZeroConfigData
 from wva_tpu.interfaces.saturation_config import SaturationScalingConfig
@@ -98,6 +102,8 @@ class Config:
         self._saturation_ns: dict[str, SaturationConfigPerModel] = {}
         self._scale_to_zero_global: ScaleToZeroConfigData = {}
         self._scale_to_zero_ns: dict[str, ScaleToZeroConfigData] = {}
+        self._slo_global: "SLOConfigData | None" = None
+        self._slo_ns: dict[str, "SLOConfigData"] = {}
 
     # --- infrastructure getters ---
 
@@ -236,6 +242,35 @@ class Config:
             else:
                 self._scale_to_zero_ns[namespace] = new
 
+    # --- SLO (queueing-model analyzer) config; peer of the saturation
+    # section, hot-reloaded from the wva-slo-config ConfigMap ---
+
+    def slo_config(self) -> "SLOConfigData | None":
+        return self.slo_config_for_namespace("")
+
+    def slo_config_for_namespace(self, namespace: str) -> "SLOConfigData | None":
+        with self._mu:
+            if namespace:
+                ns_cfg = self._slo_ns.get(namespace)
+                if ns_cfg is not None:
+                    return copy.deepcopy(ns_cfg)
+            return copy.deepcopy(self._slo_global)
+
+    def update_slo_config(self, cfg: "SLOConfigData | None") -> None:
+        self.update_slo_config_for_namespace("", cfg)
+
+    def update_slo_config_for_namespace(
+        self, namespace: str, cfg: "SLOConfigData | None"
+    ) -> None:
+        with self._mu:
+            new = copy.deepcopy(cfg)
+            if not namespace:
+                self._slo_global = new
+            elif new is not None:
+                self._slo_ns[namespace] = new
+            else:
+                self._slo_ns.pop(namespace, None)
+
     def remove_namespace_config(self, namespace: str) -> None:
         """Drop namespace-local overrides (ConfigMap deleted) so resolution
         falls back to global (reference config.go:497-520)."""
@@ -244,6 +279,7 @@ class Config:
         with self._mu:
             removed = self._saturation_ns.pop(namespace, None) is not None
             removed = self._scale_to_zero_ns.pop(namespace, None) is not None or removed
+            removed = self._slo_ns.pop(namespace, None) is not None or removed
         if removed:
             log.info("Removed namespace-local config for %s", namespace)
 

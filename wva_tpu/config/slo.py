@@ -1,0 +1,171 @@
+"""SLO analyzer configuration: service classes and per-(model, accelerator)
+performance profiles.
+
+Successor of the reference's inferno config specs
+(``pkg/config/types.go`` — AcceleratorSpec/ServiceClassSpec/OptimizerSpec) and
+the service-class model (``pkg/core/serviceclass.go``): a service class has a
+priority and per-model SLO targets (TTFT/ITL/TPS); profiles carry the fitted
+alpha/beta/gamma iteration-time parameters per TPU variant
+(``docs/tutorials/parameter-estimation.md:242-258`` describes the offline fit).
+
+Hot-reloaded from the ``wva-slo-config`` ConfigMap like the saturation config
+(same data-key YAML convention, reference configmap_reconciler.go:154-194).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import yaml
+
+from wva_tpu.analyzers.queueing.params import (
+    DEFAULT_MAX_BATCH_SIZE,
+    DEFAULT_MAX_NUM_TOKENS,
+    DEFAULT_MAX_QUEUE_SIZE,
+    K_MAX,
+    MAX_BATCH_BOUND,
+    PerfProfile,
+    ServiceParms,
+    TargetPerf,
+)
+
+# Well-known ConfigMap name (peer of wva-saturation-scaling-config,
+# reference internal/config/helpers.go:11-18).
+SLO_CONFIGMAP_NAME = "wva-slo-config"
+SLO_CONFIGMAP_DATA_KEY = "slo-config"
+
+DEFAULT_SERVICE_CLASS_PRIORITY = 10
+
+
+@dataclass
+class ServiceClass:
+    """Priority tier with per-model SLO targets (reference
+    pkg/core/serviceclass.go; lower priority value = more important)."""
+
+    name: str = "default"
+    priority: int = DEFAULT_SERVICE_CLASS_PRIORITY
+    # model_id -> SLO targets
+    model_targets: dict[str, TargetPerf] = field(default_factory=dict)
+
+
+@dataclass
+class SLOConfigData:
+    """Parsed SLO ConfigMap contents."""
+
+    service_classes: list[ServiceClass] = field(default_factory=list)
+    profiles: list[PerfProfile] = field(default_factory=list)
+    # Fallback targets for models not listed in any service class; None means
+    # "no SLO -> model is skipped by the SLO analyzer".
+    default_targets: TargetPerf | None = None
+    # Online alpha/beta/gamma re-estimation from observed TTFT/ITL (Kalman
+    # tuner). Off by default: the reference ships its tuner unwired
+    # (SURVEY.md section 2 L(-1)); here it is wired but opt-in.
+    tuner_enabled: bool = False
+
+    def targets_for_model(self, model_id: str) -> tuple[TargetPerf | None, int]:
+        """Resolve (targets, priority) for a model: best (lowest-priority-value)
+        service class listing it, else the default targets."""
+        best: tuple[TargetPerf, int] | None = None
+        for sc in self.service_classes:
+            t = sc.model_targets.get(model_id)
+            if t is None:
+                continue
+            if best is None or sc.priority < best[1]:
+                best = (t, sc.priority)
+        if best is not None:
+            return best
+        if self.default_targets is not None:
+            return self.default_targets, DEFAULT_SERVICE_CLASS_PRIORITY
+        return None, DEFAULT_SERVICE_CLASS_PRIORITY
+
+
+def _parse_targets(raw: dict) -> TargetPerf:
+    return TargetPerf(
+        target_ttft_ms=float(raw.get("ttft", raw.get("targetTTFT", 0.0)) or 0.0),
+        target_itl_ms=float(raw.get("itl", raw.get("targetITL", 0.0)) or 0.0),
+        target_tps=float(raw.get("tps", raw.get("targetTPS", 0.0)) or 0.0),
+    )
+
+
+def parse_slo_config(text: str) -> SLOConfigData:
+    """Parse the YAML payload of the SLO ConfigMap. Schema::
+
+        serviceClasses:
+          - name: premium
+            priority: 1
+            models:
+              meta-llama/Llama-3.1-8B: {ttft: 1000, itl: 50}
+        defaultTargets: {ttft: 2000}          # optional
+        profiles:
+          - model: meta-llama/Llama-3.1-8B
+            accelerator: v5e-8
+            alpha: 6.973
+            beta: 0.027
+            gamma: 0.001
+            maxBatchSize: 256
+            maxQueueSize: 1024
+
+    Raises ValueError on malformed entries (mirrors the fail-fast parse of
+    reference scale_to_zero.go:165-225).
+    """
+    raw = yaml.safe_load(text) or {}
+    if not isinstance(raw, dict):
+        raise ValueError("SLO config must be a YAML mapping")
+
+    data = SLOConfigData()
+    for sc_raw in raw.get("serviceClasses") or []:
+        if not isinstance(sc_raw, dict) or not sc_raw.get("name"):
+            raise ValueError(f"invalid service class entry: {sc_raw!r}")
+        sc = ServiceClass(
+            name=str(sc_raw["name"]),
+            priority=int(sc_raw.get("priority", DEFAULT_SERVICE_CLASS_PRIORITY)),
+        )
+        for model_id, t_raw in (sc_raw.get("models") or {}).items():
+            if not isinstance(t_raw, dict):
+                raise ValueError(
+                    f"invalid targets for model {model_id!r} in class {sc.name}")
+            sc.model_targets[str(model_id)] = _parse_targets(t_raw)
+        data.service_classes.append(sc)
+
+    if isinstance(raw.get("defaultTargets"), dict):
+        data.default_targets = _parse_targets(raw["defaultTargets"])
+
+    tuner_raw = raw.get("tuner")
+    if isinstance(tuner_raw, dict):
+        data.tuner_enabled = bool(tuner_raw.get("enabled", False))
+
+    for p_raw in raw.get("profiles") or []:
+        if not isinstance(p_raw, dict) or not p_raw.get("model") or not p_raw.get("accelerator"):
+            raise ValueError(f"invalid profile entry: {p_raw!r}")
+        parms = ServiceParms(
+            alpha=float(p_raw.get("alpha", 0.0)),
+            beta=float(p_raw.get("beta", 0.0)),
+            gamma=float(p_raw.get("gamma", 0.0)),
+        )
+        if not parms.valid():
+            raise ValueError(
+                f"invalid service parms for profile {p_raw.get('model')}/"
+                f"{p_raw.get('accelerator')}: {parms}")
+        max_batch = int(p_raw.get("maxBatchSize", DEFAULT_MAX_BATCH_SIZE))
+        max_queue = int(p_raw.get("maxQueueSize", DEFAULT_MAX_QUEUE_SIZE))
+        # Enforce the solver's static shape bounds at parse time so the
+        # sizing model and the tuner's observation model always agree
+        # (silent clipping downstream would make them diverge).
+        if not 1 <= max_batch <= MAX_BATCH_BOUND:
+            raise ValueError(
+                f"profile {p_raw['model']}/{p_raw['accelerator']}: "
+                f"maxBatchSize {max_batch} outside [1, {MAX_BATCH_BOUND}]")
+        if max_queue < 0 or max_batch + max_queue > K_MAX:
+            raise ValueError(
+                f"profile {p_raw['model']}/{p_raw['accelerator']}: "
+                f"maxBatchSize+maxQueueSize {max_batch + max_queue} exceeds "
+                f"{K_MAX}")
+        data.profiles.append(PerfProfile(
+            model_id=str(p_raw["model"]),
+            accelerator=str(p_raw["accelerator"]),
+            service_parms=parms,
+            max_batch_size=max_batch,
+            max_queue_size=max_queue,
+            max_num_tokens=int(p_raw.get("maxNumTokens", DEFAULT_MAX_NUM_TOKENS)),
+        ))
+    return data

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import logging
 
+import yaml
+
 from wva_tpu.config import (
     Config,
     detect_immutable_parameter_changes,
@@ -19,6 +21,11 @@ from wva_tpu.config import (
     system_namespace,
 )
 from wva_tpu.config.scale_to_zero import DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME
+from wva_tpu.config.slo import (
+    SLO_CONFIGMAP_DATA_KEY,
+    SLO_CONFIGMAP_NAME,
+    parse_slo_config,
+)
 from wva_tpu.config.validation import ImmutableParameterError
 from wva_tpu.controller.predicates import configmap_event_allowed
 from wva_tpu.datastore import Datastore
@@ -43,7 +50,8 @@ class ConfigMapReconciler:
             # Namespace-local ConfigMap deleted: fall back to global.
             if cm.metadata.namespace != system_namespace() and \
                     cm.metadata.name in (saturation_configmap_name(),
-                                         DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME):
+                                         DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME,
+                                         SLO_CONFIGMAP_NAME):
                 self.config.remove_namespace_config(cm.metadata.namespace)
             return
         if not configmap_event_allowed(self.client, self.datastore, cm):
@@ -60,6 +68,8 @@ class ConfigMapReconciler:
                 self._handle_saturation(cm, scope_ns)
             elif cm.metadata.name == DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME:
                 self._handle_scale_to_zero(cm, scope_ns)
+            elif cm.metadata.name == SLO_CONFIGMAP_NAME:
+                self._handle_slo(cm, scope_ns)
             self.config.mark_configmaps_bootstrap_complete()
         except ImmutableParameterError as e:
             self.config.record_configmaps_sync_error(str(e))
@@ -80,13 +90,32 @@ class ConfigMapReconciler:
                  cm.metadata.namespace, cm.metadata.name, len(parsed),
                  scope_ns or "global")
 
+    def _handle_slo(self, cm: ConfigMap, scope_ns: str) -> None:
+        text = cm.data.get(SLO_CONFIGMAP_DATA_KEY, "")
+        try:
+            parsed = parse_slo_config(text) if text else None
+        except (ValueError, yaml.YAMLError) as e:
+            # Keep the previous config; a bad edit must not crash startup or
+            # drop the running SLO config (sibling parsers skip-and-log too).
+            self.config.record_configmaps_sync_error(str(e))
+            log.error("Rejected SLO ConfigMap %s/%s: %s",
+                      cm.metadata.namespace, cm.metadata.name, e)
+            return
+        self.config.update_slo_config_for_namespace(scope_ns, parsed)
+        n_classes = len(parsed.service_classes) if parsed else 0
+        n_profiles = len(parsed.profiles) if parsed else 0
+        log.info("Applied SLO config from %s/%s (%d classes, %d profiles, "
+                 "scope=%s)", cm.metadata.namespace, cm.metadata.name,
+                 n_classes, n_profiles, scope_ns or "global")
+
     def bootstrap_initial_configmaps(self) -> bool:
         """Pre-manager read of the global ConfigMaps; marks bootstrap state
         that gates readiness (reference configmap_bootstrap.go:16-61).
         Missing ConfigMaps are not an error (defaults apply)."""
         ns = system_namespace()
         found_any = False
-        for name in (saturation_configmap_name(), DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME):
+        for name in (saturation_configmap_name(),
+                     DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME, SLO_CONFIGMAP_NAME):
             try:
                 cm = self.client.get(ConfigMap.KIND, ns, name)
             except NotFoundError:

@@ -7,6 +7,7 @@ import logging
 from wva_tpu.api.v1alpha1 import VariantAutoscaling
 from wva_tpu.config import configmap_name, saturation_configmap_name, system_namespace
 from wva_tpu.config.scale_to_zero import DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME
+from wva_tpu.config.slo import SLO_CONFIGMAP_NAME
 from wva_tpu.constants import (
     CONTROLLER_INSTANCE_LABEL_KEY,
     NAMESPACE_CONFIG_ENABLED_LABEL_KEY,
@@ -67,6 +68,7 @@ def well_known_configmap_names() -> set[str]:
         configmap_name(),
         saturation_configmap_name(),
         DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME,
+        SLO_CONFIGMAP_NAME,
     }
 
 

@@ -19,11 +19,14 @@ import logging
 from dataclasses import dataclass, field
 
 from wva_tpu.actuator import Actuator
+from wva_tpu.analyzers.queueing import QueueingModelAnalyzer
+from wva_tpu.analyzers.queueing.tuner import TunerController, TunerEnvironment
 from wva_tpu.analyzers.saturation import SaturationAnalyzer
 from wva_tpu.analyzers.saturation_v2 import (
     CapacityKnowledgeStore,
     SaturationV2Analyzer,
 )
+from wva_tpu.collector.registration.slo import collect_optimizer_metrics
 from wva_tpu.api.v1alpha1 import (
     OptimizedAlloc,
     REASON_OPTIMIZATION_SUCCEEDED,
@@ -49,6 +52,7 @@ from wva_tpu.interfaces import (
     VariantReplicaState,
     VariantSaturationAnalysis,
 )
+from wva_tpu.interfaces.saturation_config import SLO_ANALYZER_NAME, V2_ANALYZER_NAME
 from wva_tpu.k8s.client import KubeClient, NotFoundError
 from wva_tpu.k8s.objects import Deployment, parse_quantity
 from wva_tpu.pipeline import (
@@ -110,6 +114,8 @@ class SaturationEngine:
         self.v1_analyzer = SaturationAnalyzer(clock=self.clock)
         self.capacity_store = capacity_store or CapacityKnowledgeStore(clock=self.clock)
         self.v2_analyzer = SaturationV2Analyzer(self.capacity_store, clock=self.clock)
+        self.slo_analyzer = QueueingModelAnalyzer(clock=self.clock)
+        self.slo_tuner = TunerController(self.slo_analyzer.profiles)
         self.optimizer = optimizer or CostAwareOptimizer()
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock, name="saturation-engine")
@@ -130,14 +136,18 @@ class SaturationEngine:
         va_map = {namespaced_key(va.metadata.namespace, va.metadata.name): va
                   for va in active_vas}
 
-        use_v2 = False
+        analyzer_name = ""
         global_cfg = self.config.saturation_config().get("default")
         if global_cfg is not None:
             global_cfg.apply_defaults()
-            use_v2 = global_cfg.analyzer_name == "saturation"
+            analyzer_name = global_cfg.analyzer_name
 
-        if use_v2:
-            decisions = self._optimize_v2(model_groups)
+        # Analyzer selection by name (reference engine.go:236-254); "slo"
+        # reuses the V2 optimizer/enforcer flow with the queueing-model
+        # analyzer producing req/s capacities instead of token capacities.
+        if analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
+            decisions = self._optimize_v2(
+                model_groups, use_slo=analyzer_name == SLO_ANALYZER_NAME)
         else:
             decisions = self._optimize_v1(model_groups)
 
@@ -198,8 +208,20 @@ class SaturationEngine:
 
     def _optimize_v2(
         self, model_groups: dict[str, list[VariantAutoscaling]],
+        use_slo: bool = False,
     ) -> list[VariantDecision]:
         requests: list[ModelScalingRequest] = []
+        slo_cfg_by_ns: dict[str, object] = {}
+        if use_slo:
+            # Sync profiles once per distinct namespace per tick (not per
+            # model): the per-model resolved config is passed explicitly into
+            # analysis below.
+            for model_vas in model_groups.values():
+                ns = model_vas[0].metadata.namespace
+                if ns not in slo_cfg_by_ns:
+                    slo_cfg_by_ns[ns] = self.config.slo_config_for_namespace(ns)
+                    self.slo_analyzer.sync_from_config(
+                        slo_cfg_by_ns[ns], namespace=ns)
         for group_key in sorted(model_groups):
             model_vas = model_groups[group_key]
             model_id = model_vas[0].spec.model_id
@@ -222,10 +244,23 @@ class SaturationEngine:
                 continue
 
             try:
-                result = self._run_v2_analysis(model_id, namespace, data, sat_cfg)
+                if use_slo:
+                    result = self._run_slo_analysis(
+                        model_id, namespace, data, sat_cfg,
+                        slo_cfg_by_ns.get(namespace))
+                else:
+                    result = self._run_v2_analysis(model_id, namespace, data, sat_cfg)
             except Exception as e:  # noqa: BLE001
-                log.error("V2 analysis failed for %s: %s", model_id, e)
+                log.error("%s analysis failed for %s: %s",
+                          "SLO" if use_slo else "V2", model_id, e)
                 self._emit_safety_net_metrics(model_vas)
+                continue
+            if use_slo and not result.variant_capacities:
+                # No SLO targets/profiles for this model -> leave it to its
+                # current replica count rather than emitting zero-capacity
+                # decisions.
+                log.debug("SLO analyzer produced no capacities for %s; skipped",
+                          model_id)
                 continue
             requests.append(ModelScalingRequest(
                 model_id=model_id, namespace=namespace, result=result,
@@ -290,6 +325,60 @@ class SaturationEngine:
             scheduler_queue=scheduler_queue,
         ))
 
+    def _run_slo_analysis(self, model_id: str, namespace: str, data: _ModelData,
+                          sat_cfg: SaturationScalingConfig, slo_cfg):
+        """SLO path: attach the model's arrival-rate telemetry and run the
+        queueing-model analyzer with the namespace's resolved SLO config
+        (profiles were synced once for the namespace at tick start)."""
+        optimizer_metrics = collect_optimizer_metrics(
+            self.collector.source, model_id, namespace)
+        scheduler_queue = self.collector.collect_scheduler_queue_metrics(model_id)
+        if slo_cfg is not None and slo_cfg.tuner_enabled:
+            self._feed_slo_tuner(model_id, namespace, data, optimizer_metrics)
+        return self.slo_analyzer.analyze(AnalyzerInput(
+            model_id=model_id, namespace=namespace,
+            replica_metrics=data.replica_metrics,
+            variant_states=data.variant_states,
+            config=sat_cfg,
+            scheduler_queue=scheduler_queue,
+            optimizer_metrics=optimizer_metrics,
+            slo_config=slo_cfg,
+        ))
+
+    def _feed_slo_tuner(self, model_id: str, namespace: str, data: _ModelData,
+                        optimizer_metrics) -> None:
+        """One EKF step per accelerator from live TTFT/ITL telemetry; the
+        refined alpha/beta/gamma land in the shared PerfProfileStore."""
+        if optimizer_metrics is None:
+            return
+        by_accel: dict[str, list[ReplicaMetrics]] = {}
+        for rm in data.replica_metrics:
+            if rm.accelerator_name:
+                by_accel.setdefault(rm.accelerator_name, []).append(rm)
+        # arrival_rate is model-wide: attribute per-replica load by dividing
+        # by the model's TOTAL replica count, not the accelerator group's
+        # (dividing per group would double-count traffic).
+        total_replicas = max(sum(len(v) for v in by_accel.values()), 1)
+        for accelerator, rms in by_accel.items():
+            profile = self.slo_analyzer.profiles.get(
+                model_id, accelerator, namespace=namespace)
+            if profile is None:
+                continue
+            ins = [rm.avg_input_tokens for rm in rms if rm.avg_input_tokens > 0]
+            outs = [rm.avg_output_tokens for rm in rms if rm.avg_output_tokens > 0]
+            if not ins or not outs:
+                continue
+            env = TunerEnvironment(
+                # Filter models one replica's queue: per-replica arrival rate.
+                lambda_per_min=optimizer_metrics.arrival_rate / total_replicas,
+                avg_input_tokens=sum(ins) / len(ins),
+                avg_output_tokens=sum(outs) / len(outs),
+                max_batch_size=profile.max_batch_size,
+                avg_ttft_ms=optimizer_metrics.ttft_seconds * 1000.0,
+                avg_itl_ms=optimizer_metrics.itl_seconds * 1000.0,
+            )
+            self.slo_tuner.observe(namespace, model_id, accelerator, env)
+
     # --- shared data preparation ---
 
     def _prepare_model_data(
@@ -353,6 +442,7 @@ class SaturationEngine:
             pending = max(current - deploy.status.ready_replicas, 0)
             states.append(VariantReplicaState(
                 variant_name=va.metadata.name,
+                accelerator_name=variant_utils.get_accelerator_type(va),
                 current_replicas=current,
                 desired_replicas=va.status.desired_optimized_alloc.num_replicas,
                 pending_replicas=pending,

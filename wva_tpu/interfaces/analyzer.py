@@ -15,6 +15,7 @@ import abc
 from dataclasses import dataclass, field
 
 from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST
+from wva_tpu.interfaces.allocation import OptimizerMetrics
 from wva_tpu.interfaces.replica_metrics import ReplicaMetrics, SchedulerQueueMetrics
 from wva_tpu.interfaces.decision import VariantReplicaState
 
@@ -63,6 +64,14 @@ class AnalyzerInput:
     variant_states: list[VariantReplicaState] = field(default_factory=list)
     config: object | None = None  # AnalyzerConfig (SaturationScalingConfig, ...)
     scheduler_queue: SchedulerQueueMetrics | None = None
+    # Model-level rate/latency telemetry for the SLO analyzer family
+    # (reference internal/interfaces/metrics_collector.go:12-24).
+    optimizer_metrics: "OptimizerMetrics | None" = None
+    # Resolved SLO config (service classes + profiles) for this model's
+    # namespace — passed explicitly so analysis is not order-dependent on
+    # which namespace the analyzer synced last. Typed as object to avoid an
+    # interfaces -> config dependency (it is a config.slo.SLOConfigData).
+    slo_config: object | None = None
 
 
 class Analyzer(abc.ABC):

@@ -72,6 +72,10 @@ class VariantReplicaState:
     """
 
     variant_name: str = ""
+    # TPU slice variant serving this variant (VA accelerator label); lets
+    # analyzers resolve per-(model, accelerator) profiles for variants that
+    # currently have zero ready replicas.
+    accelerator_name: str = ""
     current_replicas: int = 0
     desired_replicas: int = 0
     # Pods that exist but are not Ready (slice provisioning + model load can

@@ -19,6 +19,7 @@ from wva_tpu.analyzers.saturation_v2 import CapacityKnowledgeStore
 from wva_tpu.collector.registration import (
     register_saturation_queries,
     register_scale_to_zero_queries,
+    register_slo_queries,
 )
 from wva_tpu.collector.registration.scale_to_zero import collect_model_request_count
 from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
@@ -156,6 +157,7 @@ def build_manager(
     source_registry.register(PROMETHEUS_SOURCE_NAME, prom_source)
     register_saturation_queries(source_registry)
     register_scale_to_zero_queries(source_registry)
+    register_slo_queries(source_registry)
 
     def pod_source_factory(pool):
         fetcher = pod_fetcher or http_pod_fetcher(
