@@ -1,0 +1,208 @@
+"""Fleet global-optimizer tests (model: reference ``pkg/solver/solver_test.go``
+and ``pkg/core/*_test.go`` behaviors — unlimited vs greedy, priorities,
+delta-regret, capacity exhaustion, best-effort policies, transition
+penalties)."""
+
+import pytest
+
+from wva_tpu.analyzers.queueing import (
+    PerfProfile,
+    PerfProfileStore,
+    ServiceParms,
+    TargetPerf,
+)
+from wva_tpu.config.slo import ServiceClass
+from wva_tpu.fleet import (
+    AcceleratorSpec,
+    CurrentAlloc,
+    FleetSystem,
+    SaturationPolicy,
+    ServerLoad,
+    ServerSpec,
+    SolverSpec,
+    analyze_model,
+    solve,
+    transition_penalty,
+)
+from wva_tpu.fleet.allocation import FleetAllocation
+
+V5E = ServiceParms(alpha=6.973, beta=0.027, gamma=0.001)
+V5P = ServiceParms(alpha=3.0, beta=0.012, gamma=0.0005)
+
+
+def make_profiles():
+    store = PerfProfileStore()
+    store.sync_namespace("", [
+        PerfProfile(model_id="llama", accelerator="v5e-8", service_parms=V5E,
+                    max_batch_size=64, max_queue_size=256),
+        PerfProfile(model_id="llama", accelerator="v5p-8", service_parms=V5P,
+                    max_batch_size=128, max_queue_size=256),
+        PerfProfile(model_id="gemma", accelerator="v5e-8",
+                    service_parms=ServiceParms(alpha=4.0, beta=0.02, gamma=0.001),
+                    max_batch_size=64, max_queue_size=256),
+    ])
+    return store
+
+
+def make_system(llama_rate=600.0, gemma_rate=1200.0, capacity=None,
+                llama_current=None):
+    return FleetSystem(
+        accelerators={
+            "v5e-8": AcceleratorSpec(name="v5e-8", type="v5e",
+                                     chips_per_replica=8, cost=1.0),
+            "v5p-8": AcceleratorSpec(name="v5p-8", type="v5p",
+                                     chips_per_replica=8, cost=3.0),
+        },
+        servers={
+            "inf/llama": ServerSpec(
+                name="inf/llama", namespace="inf", model_id="llama",
+                service_class="premium", current=llama_current,
+                load=ServerLoad(arrival_rate_per_min=llama_rate,
+                                avg_input_tokens=512, avg_output_tokens=256)),
+            "inf/gemma": ServerSpec(
+                name="inf/gemma", namespace="inf", model_id="gemma",
+                service_class="free",
+                load=ServerLoad(arrival_rate_per_min=gemma_rate,
+                                avg_input_tokens=256, avg_output_tokens=128)),
+        },
+        service_classes={
+            "premium": ServiceClass(
+                name="premium", priority=1,
+                model_targets={"llama": TargetPerf(target_ttft_ms=500,
+                                                   target_itl_ms=40)}),
+            "free": ServiceClass(
+                name="free", priority=100,
+                model_targets={"gemma": TargetPerf(target_ttft_ms=2000)}),
+        },
+        profiles=make_profiles(),
+        capacity_chips=capacity or {"v5e": 256, "v5p": 256},
+    )
+
+
+class TestUnlimited:
+    def test_picks_min_value_per_server(self):
+        sol = solve(make_system(), SolverSpec(unlimited=True))
+        # v5e is 3x cheaper; both servers should land there with enough
+        # replicas to meet SLO.
+        assert sol.allocations["inf/llama"].accelerator == "v5e-8"
+        assert sol.allocations["inf/llama"].num_replicas >= 2
+        assert sol.allocations["inf/gemma"].accelerator == "v5e-8"
+        a = sol.allocations["inf/llama"]
+        assert a.ttft_ms <= 500 * 1.01 and a.itl_ms <= 40 * 1.01
+
+    def test_replicas_scale_with_load(self):
+        lo = solve(make_system(llama_rate=120), SolverSpec(unlimited=True))
+        hi = solve(make_system(llama_rate=6000), SolverSpec(unlimited=True))
+        assert hi.allocations["inf/llama"].num_replicas > \
+            lo.allocations["inf/llama"].num_replicas
+
+    def test_zero_load_uses_min_replicas(self):
+        system = make_system(llama_rate=0)
+        system.servers["inf/llama"].min_replicas = 1
+        sol = solve(system, SolverSpec(unlimited=True))
+        assert sol.allocations["inf/llama"].num_replicas == 1
+        system.servers["inf/llama"].min_replicas = 0
+        sol = solve(system, SolverSpec(unlimited=True))
+        assert sol.allocations["inf/llama"].num_replicas == 0
+
+
+class TestGreedy:
+    def test_ample_capacity_matches_unlimited_choice(self):
+        sol = solve(make_system())
+        assert sol.allocations["inf/llama"].accelerator == "v5e-8"
+        assert not sol.unallocated
+
+    def test_capacity_pressure_moves_to_next_candidate(self):
+        # Only 8 v5e chips: llama (priority 1) must fall over to v5p.
+        sol = solve(make_system(capacity={"v5e": 8, "v5p": 64}))
+        assert sol.allocations["inf/llama"].accelerator == "v5p-8"
+
+    def test_priority_starves_low_class_last(self):
+        sol = solve(make_system(capacity={"v5e": 8, "v5p": 0}))
+        # llama (premium) gets the partial v5e allocation; gemma starves.
+        assert sol.allocations["inf/llama"].accelerator == "v5e-8"
+        assert "inf/gemma" in sol.unallocated
+
+    def test_best_effort_partial_allocation_scales_cost(self):
+        sol = solve(make_system(capacity={"v5e": 8, "v5p": 0}))
+        a = sol.allocations["inf/llama"]
+        assert a.num_replicas == 1 and a.chips == 8
+        assert a.cost == pytest.approx(1.0)
+
+    def test_saturation_policy_none_leaves_unallocated(self):
+        sol = solve(make_system(capacity={"v5e": 8, "v5p": 0}),
+                    SolverSpec(saturation_policy=SaturationPolicy.NONE))
+        assert "inf/llama" not in sol.allocations
+
+    def test_round_robin_splits_capacity(self):
+        # Two same-priority servers, capacity for only 2 of each's demand.
+        system = make_system(capacity={"v5e": 16, "v5p": 0})
+        system.service_classes["free"].priority = 1
+        system.servers["inf/llama"].load.arrival_rate_per_min = 6000
+        system.servers["inf/gemma"].load.arrival_rate_per_min = 6000
+        sol = solve(system, SolverSpec(
+            saturation_policy=SaturationPolicy.ROUND_ROBIN))
+        assert sol.allocations["inf/llama"].num_replicas == 1
+        assert sol.allocations["inf/gemma"].num_replicas == 1
+
+    def test_whole_slice_quantization(self):
+        # 12 chips can hold exactly one 8-chip slice, never 1.5.
+        sol = solve(make_system(capacity={"v5e": 12, "v5p": 0}))
+        used = sum(a.chips for a in sol.allocations.values())
+        assert used == 8
+
+    def test_diffs_report_changes_only(self):
+        cur = CurrentAlloc(accelerator="v5e-8", num_replicas=3, cost=3.0)
+        sol = solve(make_system(llama_current=cur))
+        if sol.allocations["inf/llama"].num_replicas == 3:
+            assert "inf/llama" not in sol.diffs
+        else:
+            assert sol.diffs["inf/llama"].old_num_replicas == 3
+
+
+class TestTransitions:
+    def test_same_accelerator_penalty_is_cost_delta(self):
+        new = FleetAllocation(accelerator="v5e-8", cost=4.0)
+        assert transition_penalty("v5e-8", 3.0, new) == pytest.approx(1.0)
+        new.cost = 3.0
+        assert transition_penalty("v5e-8", 3.0, new) == 0.0
+
+    def test_cross_accelerator_penalty_includes_switching_cost(self):
+        new = FleetAllocation(accelerator="v5p-8", cost=6.0)
+        p = transition_penalty("v5e-8", 3.0, new)
+        assert p == pytest.approx(0.1 * (3.0 + 6.0) + 3.0)
+
+    def test_keep_accelerator_pins_candidates(self):
+        system = make_system(llama_current=CurrentAlloc(
+            accelerator="v5p-8", num_replicas=1, cost=3.0))
+        system.servers["inf/llama"].keep_accelerator = True
+        allocs = analyze_model(system, "inf/llama")
+        assert {a.accelerator for a in allocs} == {"v5p-8"}
+
+    def test_sticky_placement_at_equal_cost(self):
+        # When accelerators cost the same, the switching penalty
+        # (ACCEL_PENALTY_FACTOR * both costs) keeps the current placement.
+        system = make_system(llama_current=CurrentAlloc(
+            accelerator="v5p-8", num_replicas=2, cost=6.0))
+        system.accelerators["v5e-8"].cost = 3.0  # equal per-replica cost
+        sol = solve(system, SolverSpec(unlimited=True))
+        assert sol.allocations["inf/llama"].accelerator == "v5p-8"
+
+    def test_large_saving_justifies_switching(self):
+        # Reference formula allocation.go:283-292: cost delta dominates the
+        # switching penalty when the saving is large (3x cheaper here).
+        system = make_system(llama_current=CurrentAlloc(
+            accelerator="v5p-8", num_replicas=2, cost=6.0))
+        sol = solve(system, SolverSpec(unlimited=True))
+        assert sol.allocations["inf/llama"].accelerator == "v5e-8"
+
+
+class TestAnalyzeModel:
+    def test_returns_all_candidates(self):
+        allocs = analyze_model(make_system(), "inf/llama")
+        assert {a.accelerator for a in allocs} == {"v5e-8", "v5p-8"}
+        for a in allocs:
+            assert a.num_replicas >= 1 and a.max_rate_per_replica > 0
+
+    def test_unknown_server_empty(self):
+        assert analyze_model(make_system(), "nope") == []

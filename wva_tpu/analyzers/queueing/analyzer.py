@@ -80,7 +80,9 @@ class QueueingModelAnalyzer(Analyzer):
                  clock: Clock | None = None) -> None:
         self.profiles = profiles or PerfProfileStore()
         self.clock = clock or SYSTEM_CLOCK
-        self._slo: SLOConfigData | None = None
+        # Last-synced config per namespace scope ("" = global); analyze()
+        # resolves namespace-local > global, never another namespace's.
+        self._slo_by_ns: dict[str, SLOConfigData | None] = {}
 
     def name(self) -> str:
         return SLO_ANALYZER_NAME
@@ -92,7 +94,7 @@ class QueueingModelAnalyzer(Analyzer):
         profiles are replaced wholesale (updates and deletions both take
         effect); tuner-refined parameters survive re-syncs
         (:meth:`PerfProfileStore.sync_namespace`)."""
-        self._slo = cfg
+        self._slo_by_ns[namespace] = cfg
         self.profiles.sync_namespace(
             namespace, list(cfg.profiles) if cfg is not None else [])
 
@@ -105,7 +107,13 @@ class QueueingModelAnalyzer(Analyzer):
             namespace=input.namespace,
             analyzed_at=self.clock.now(),
         )
-        slo = input.slo_config if input.slo_config is not None else self._slo
+        slo = input.slo_config
+        if slo is None:
+            # Namespace-local > global resolution; NEVER another namespace's
+            # config (order-independence across the engine's model loop).
+            slo = self._slo_by_ns.get(input.namespace)
+            if slo is None:
+                slo = self._slo_by_ns.get("")
         if slo is None:
             log.warning("SLO analyzer selected but no SLO config loaded; "
                         "model %s skipped", input.model_id)

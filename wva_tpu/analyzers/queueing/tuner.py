@@ -59,6 +59,10 @@ class TunerEnvironment:
     avg_input_tokens: float = 0.0
     avg_output_tokens: float = 0.0
     max_batch_size: int = 0
+    # Queue bound of the observed server; 0 falls back to
+    # max_batch * max_queue_to_batch_ratio. MUST match the profile used by
+    # the sizer so the EKF fits the same queue the capacity model solves.
+    max_queue_size: int = 0
     avg_ttft_ms: float = 0.0  # observed
     avg_itl_ms: float = 0.0  # observed
 
@@ -162,8 +166,11 @@ class KalmanTuner:
         if not env.valid():
             raise ValueError(f"cannot run tuner with invalid environment: {env}")
         cfg = self.config
-        k_bound = min(env.max_batch_size * (1 + cfg.max_queue_to_batch_ratio),
-                      K_MAX)
+        if env.max_queue_size > 0:
+            k_bound = min(env.max_batch_size + env.max_queue_size, K_MAX)
+        else:
+            k_bound = min(env.max_batch_size * (1 + cfg.max_queue_to_batch_ratio),
+                          K_MAX)
         env_vec = jnp.asarray([
             env.lambda_per_min / 60_000.0,  # per-minute -> per-ms
             env.avg_input_tokens,
@@ -247,6 +254,8 @@ class TunerController:
         profile = self.profiles.get(model_id, accelerator, namespace=namespace)
         if profile is None or not profile.service_parms.valid():
             return None
+        if env.max_queue_size == 0:
+            env.max_queue_size = profile.max_queue_size
         key = (namespace, model_id, accelerator)
         with self._mu:
             tuner = self._tuners.get(key)

@@ -355,10 +355,20 @@ class SaturationEngine:
         for rm in data.replica_metrics:
             if rm.accelerator_name:
                 by_accel.setdefault(rm.accelerator_name, []).append(rm)
-        # arrival_rate is model-wide: attribute per-replica load by dividing
-        # by the model's TOTAL replica count, not the accelerator group's
-        # (dividing per group would double-count traffic).
-        total_replicas = max(sum(len(v) for v in by_accel.values()), 1)
+        if len(by_accel) > 1:
+            # Observed TTFT/ITL is a model-wide mean blended across
+            # accelerator types; feeding it to per-accelerator filters would
+            # drag every profile toward the mixture. Needs per-accelerator
+            # latency queries before tuning heterogeneous fleets.
+            log.debug("Model %s served by %d accelerator types; skipping "
+                      "tuner this tick", model_id, len(by_accel))
+            return
+        # arrival_rate is model-wide: attribute per-replica load using the
+        # authoritative ready-replica count from variant states (replicas
+        # with missing metrics still serve traffic).
+        total_replicas = max(
+            sum(max(vs.current_replicas - vs.pending_replicas, 0)
+                for vs in data.variant_states), 1)
         for accelerator, rms in by_accel.items():
             profile = self.slo_analyzer.profiles.get(
                 model_id, accelerator, namespace=namespace)
@@ -374,6 +384,7 @@ class SaturationEngine:
                 avg_input_tokens=sum(ins) / len(ins),
                 avg_output_tokens=sum(outs) / len(outs),
                 max_batch_size=profile.max_batch_size,
+                max_queue_size=profile.max_queue_size,
                 avg_ttft_ms=optimizer_metrics.ttft_seconds * 1000.0,
                 avg_itl_ms=optimizer_metrics.itl_seconds * 1000.0,
             )

@@ -1,0 +1,235 @@
+"""Fleet assignment solver — successor of the reference's ``pkg/solver``
+(``solver.go:32-80`` Solve/SolveUnlimited, ``greedy.go:37-165`` SolveGreedy +
+allocate, ``greedy.go:168-260`` bestEffort policies), operating on an explicit
+:class:`~wva_tpu.fleet.system.FleetSystem` instead of the global singleton.
+
+- **unlimited**: per-server minimum-value allocation (separable objective).
+- **greedy**: servers ordered by (service-class priority, then delta-regret =
+  value gap to their next-best allocation, largest first); each takes its
+  best affordable allocation under per-accelerator-type chip capacity,
+  falling to the next candidate when a pool is exhausted. Whole-slice
+  quantization: a replica consumes chips_per_replica chips atomically.
+- **best-effort** for servers whose SLO-sized allocation never fits:
+  ``none`` (leave unallocated), ``priority-exhaustive`` (partial allocation,
+  largest-first), ``round-robin`` / ``priority-round-robin`` (one replica at
+  a time across the group).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from enum import Enum
+
+from wva_tpu.fleet.allocation import (
+    AllocationDiff,
+    FleetAllocation,
+    build_candidates,
+    diff_of,
+)
+from wva_tpu.fleet.system import FleetSystem, ServerSpec
+
+
+class SaturationPolicy(str, Enum):
+    """What to do for servers whose SLO demand cannot fit
+    (reference pkg/config/config.go:4-10)."""
+
+    NONE = "none"
+    PRIORITY_EXHAUSTIVE = "priority-exhaustive"
+    PRIORITY_ROUND_ROBIN = "priority-round-robin"
+    ROUND_ROBIN = "round-robin"
+
+
+@dataclass
+class SolverSpec:
+    """Reference config.OptimizerSpec subset."""
+
+    unlimited: bool = False
+    saturation_policy: SaturationPolicy = SaturationPolicy.PRIORITY_EXHAUSTIVE
+    # When True, allocate across ALL priorities first and best-effort once at
+    # the end; when False, allocate + best-effort per priority group
+    # (reference greedy.go:89-103 DelayedBestEffort).
+    delayed_best_effort: bool = False
+
+
+@dataclass
+class Solution:
+    """Solver output: chosen allocation + diff per server."""
+
+    allocations: dict[str, FleetAllocation] = field(default_factory=dict)
+    diffs: dict[str, AllocationDiff] = field(default_factory=dict)
+    unallocated: list[str] = field(default_factory=list)
+
+
+@dataclass
+class _Entry:
+    server: ServerSpec
+    priority: int
+    candidates: list[FleetAllocation]  # sorted by value asc
+    cur_index: int = 0
+    delta: float = 0.0
+
+    def recompute_delta(self) -> None:
+        nxt = self.cur_index + 1
+        if nxt < len(self.candidates):
+            self.delta = self.candidates[nxt].value - self.candidates[self.cur_index].value
+        else:
+            self.delta = math.inf
+
+    def current(self) -> FleetAllocation:
+        # Exhausted entries (cur_index past the end, parked in the
+        # unallocated list) sort by their last candidate.
+        return self.candidates[min(self.cur_index, len(self.candidates) - 1)]
+
+
+def solve(system: FleetSystem, spec: SolverSpec | None = None) -> Solution:
+    """Compute desired allocations for every server (reference solver.go:32-59)."""
+    spec = spec or SolverSpec()
+    candidates = build_candidates(system)
+
+    entries: list[_Entry] = []
+    for name in sorted(candidates):
+        server = system.servers[name]
+        cands = sorted(candidates[name], key=lambda a: (a.value, a.accelerator))
+        if not cands:
+            continue
+        e = _Entry(server=server, priority=system.priority(server),
+                   candidates=cands)
+        e.recompute_delta()
+        entries.append(e)
+
+    solution = Solution()
+    if spec.unlimited:
+        for e in entries:
+            solution.allocations[e.server.name] = e.candidates[0]
+    else:
+        _solve_greedy(system, spec, entries, solution)
+
+    for e in entries:
+        name = e.server.name
+        d = diff_of(name, e.server.current, solution.allocations.get(name))
+        if d is not None:
+            solution.diffs[name] = d
+    return solution
+
+
+def _order_key(e: _Entry):
+    # Priority asc, then delta-regret desc, then current value desc
+    # (reference greedy.go:75-85).
+    return (e.priority, -e.delta, -e.current().value, e.server.name)
+
+
+def _solve_greedy(system: FleetSystem, spec: SolverSpec,
+                  entries: list[_Entry], solution: Solution) -> None:
+    available = dict(system.capacity_chips)
+    if spec.delayed_best_effort:
+        unallocated = _allocate(entries, available, solution)
+        _best_effort(spec.saturation_policy, unallocated, available, solution)
+    else:
+        for group in _priority_groups(entries):
+            unallocated = _allocate(group, available, solution)
+            _best_effort(spec.saturation_policy, unallocated, available, solution)
+    solution.unallocated = [
+        e.server.name for e in entries
+        if e.server.name not in solution.allocations
+    ]
+
+
+def _priority_groups(entries: list[_Entry]) -> list[list[_Entry]]:
+    groups: dict[int, list[_Entry]] = {}
+    for e in entries:
+        groups.setdefault(e.priority, []).append(e)
+    return [groups[p] for p in sorted(groups)]
+
+
+def _allocate(entries: list[_Entry], available: dict[str, int],
+              solution: Solution) -> list[_Entry]:
+    """Greedy full-SLO allocation round (reference greedy.go:107-165).
+    Returns entries that could not be satisfied at any candidate."""
+    pending = sorted(entries, key=_order_key)
+    unallocated: list[_Entry] = []
+    while pending:
+        top = pending.pop(0)
+        alloc = top.current()
+        if not alloc.accelerator:  # zero-load empty allocation
+            solution.allocations[top.server.name] = alloc
+            continue
+        need = alloc.num_replicas * alloc.chips_per_replica
+        if available.get(alloc.accelerator_type, 0) >= need:
+            available[alloc.accelerator_type] -= need
+            solution.allocations[top.server.name] = alloc
+        else:
+            top.cur_index += 1
+            if top.cur_index >= len(top.candidates):
+                unallocated.append(top)
+                continue
+            top.recompute_delta()
+            pending.append(top)
+            pending.sort(key=_order_key)
+    return unallocated
+
+
+def _best_effort(policy: SaturationPolicy, unallocated: list[_Entry],
+                 available: dict[str, int], solution: Solution) -> None:
+    """Partial allocation for servers whose full SLO sizing never fit
+    (reference greedy.go:168-260)."""
+    if policy == SaturationPolicy.NONE or not unallocated:
+        return
+    if policy == SaturationPolicy.PRIORITY_EXHAUSTIVE:
+        for e in sorted(unallocated, key=_order_key):
+            _allocate_maximally(e, available, solution)
+        return
+    if policy == SaturationPolicy.ROUND_ROBIN:
+        _allocate_equally(sorted(unallocated, key=_order_key), available, solution)
+        return
+    # PRIORITY_ROUND_ROBIN
+    for group in _priority_groups(unallocated):
+        _allocate_equally(sorted(group, key=_order_key), available, solution)
+
+
+def _allocate_maximally(e: _Entry, available: dict[str, int],
+                        solution: Solution) -> None:
+    """As many replicas of the cheapest candidate as capacity affords
+    (reference greedy.go:194-224 allocateMaximally)."""
+    for alloc in e.candidates:
+        if not alloc.accelerator or alloc.chips_per_replica <= 0:
+            continue
+        max_replicas = min(
+            available.get(alloc.accelerator_type, 0) // alloc.chips_per_replica,
+            alloc.num_replicas)
+        if max_replicas > 0:
+            scaled = alloc.scaled_to(max_replicas)
+            available[alloc.accelerator_type] -= scaled.chips
+            solution.allocations[e.server.name] = scaled
+            return
+
+
+def _allocate_equally(group: list[_Entry], available: dict[str, int],
+                      solution: Solution) -> None:
+    """One replica at a time round-robin across the group until nothing fits
+    (reference greedy.go:240-260+ allocateEqually)."""
+    granted: dict[str, int] = {e.server.name: 0 for e in group}
+    chosen: dict[str, FleetAllocation] = {}
+    for e in group:
+        for alloc in e.candidates:
+            if alloc.accelerator and alloc.chips_per_replica > 0:
+                chosen[e.server.name] = alloc
+                break
+    progress = True
+    while progress:
+        progress = False
+        for e in group:
+            alloc = chosen.get(e.server.name)
+            if alloc is None:
+                continue
+            if granted[e.server.name] >= alloc.num_replicas:
+                continue
+            if available.get(alloc.accelerator_type, 0) >= alloc.chips_per_replica:
+                available[alloc.accelerator_type] -= alloc.chips_per_replica
+                granted[e.server.name] += 1
+                progress = True
+    for e in group:
+        n = granted.get(e.server.name, 0)
+        alloc = chosen.get(e.server.name)
+        if alloc is not None and n > 0:
+            solution.allocations[e.server.name] = alloc.scaled_to(n)
