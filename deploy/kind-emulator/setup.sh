@@ -1,0 +1,142 @@
+#!/bin/bash
+# Fake-TPU kind cluster: patches GKE TPU node labels and google.com/tpu
+# allocatable onto plain kind nodes so discovery, the slice limiter, and the
+# e2e suites behave exactly as on real TPU node pools.
+#
+# TPU port of the reference's GPU emulator (deploy/kind-emulator/setup.sh
+# there patches <vendor>.com/gpu labels + status.capacity; :144-262). The
+# label schema here matches wva_tpu/constants/labels.py and
+# wva_tpu/discovery/tpu.py: cloud.google.com/gke-tpu-accelerator,
+# cloud.google.com/gke-tpu-topology, allocatable["google.com/tpu"].
+
+set -euo pipefail
+
+DEFAULT_CLUSTER_NAME="kind-wva-tpu-cluster"
+DEFAULT_NODES=3
+DEFAULT_PROFILE="v5e"          # v5e | v5p | v6e | mix
+DEFAULT_K8S_VERSION="v1.32.0"
+
+cluster_name="${CLUSTER_NAME:-$DEFAULT_CLUSTER_NAME}"
+nodes="${NODES:-$DEFAULT_NODES}"
+profile="${TPU_PROFILE:-$DEFAULT_PROFILE}"
+k8s_version="${K8S_VERSION:-$DEFAULT_K8S_VERSION}"
+enable_scale_to_zero="${ENABLE_SCALE_TO_ZERO:-true}"
+
+usage() {
+    cat <<EOF
+Usage: $0 [OPTIONS]
+  -c NAME     Cluster name (default: $DEFAULT_CLUSTER_NAME)
+  -n NODES    Worker nodes (default: $DEFAULT_NODES)
+  -p PROFILE  TPU profile: v5e, v5p, v6e, mix (default: $DEFAULT_PROFILE)
+              - v5e: every node a ct5lp-hightpu-8t host (8 chips, 2x4)
+              - v5p: every node a 4-chip v5p host (2x2x1)
+              - v6e: every node an 8-chip v6e host (2x4)
+              - mix: rotate v5e-8 / v5p-4 / v6e-8 per node (limiter tests)
+  -k VERSION  Kubernetes version (default: $DEFAULT_K8S_VERSION)
+  -h          Show help
+EOF
+}
+
+while getopts "c:n:p:k:h" opt; do
+    case $opt in
+        c) cluster_name="$OPTARG" ;;
+        n) nodes="$OPTARG" ;;
+        p) profile="$OPTARG" ;;
+        k) k8s_version="$OPTARG" ;;
+        h) usage; exit 0 ;;
+        *) usage; exit 1 ;;
+    esac
+done
+
+cleanup() { [[ -f kind-config.yaml ]] && rm -f kind-config.yaml || true; }
+trap cleanup EXIT
+
+# ------------------------------------------------------------------
+# 1. kind cluster (control plane + N workers, HPAScaleToZero optional)
+# ------------------------------------------------------------------
+make_kind_config() {
+    cat > kind-config.yaml <<EOF
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+nodes:
+  - role: control-plane
+EOF
+    if [[ "$enable_scale_to_zero" == "true" ]]; then
+        cat >> kind-config.yaml <<EOF
+    kubeadmConfigPatches:
+      - |
+        kind: ClusterConfiguration
+        apiServer:
+          extraArgs:
+            feature-gates: HPAScaleToZero=true
+EOF
+    fi
+    for ((i = 0; i < nodes; i++)); do
+        echo "  - role: worker" >> kind-config.yaml
+    done
+}
+
+# ------------------------------------------------------------------
+# 2. per-profile label + capacity schema
+#    (accelerator label, topology, chips per host)
+# ------------------------------------------------------------------
+node_schema() {
+    local idx=$1
+    case "$profile" in
+        v5e) echo "tpu-v5-lite-podslice 2x4 8 ct5lp-hightpu-8t" ;;
+        v5p) echo "tpu-v5p-slice 2x2x1 4 ct5p-hightpu-4t" ;;
+        v6e) echo "tpu-v6e-slice 2x4 8 ct6e-standard-8t" ;;
+        mix)
+            case $((idx % 3)) in
+                0) echo "tpu-v5-lite-podslice 2x4 8 ct5lp-hightpu-8t" ;;
+                1) echo "tpu-v5p-slice 2x2x1 4 ct5p-hightpu-4t" ;;
+                2) echo "tpu-v6e-slice 2x4 8 ct6e-standard-8t" ;;
+            esac ;;
+        *) echo "unknown profile: $profile" >&2; exit 1 ;;
+    esac
+}
+
+# ------------------------------------------------------------------
+# 3. patch nodes: GKE TPU labels + google.com/tpu allocatable
+#    (kubectl patch --subresource=status, like the reference :256-262)
+# ------------------------------------------------------------------
+patch_nodes() {
+    local idx=0
+    for node in $(kubectl get nodes -o name | grep -v control-plane); do
+        read -r accel topology chips machine <<< "$(node_schema $idx)"
+        node_name="${node#node/}"
+        echo ">> $node_name: $accel topology=$topology chips=$chips"
+        kubectl label "$node" \
+            "cloud.google.com/gke-tpu-accelerator=$accel" \
+            "cloud.google.com/gke-tpu-topology=$topology" \
+            "cloud.google.com/gke-nodepool=tpu-pool-$((idx % 3))" \
+            "node.kubernetes.io/instance-type=$machine" \
+            --overwrite
+        kubectl patch "$node" --subresource=status --type=merge -p "{
+            \"status\": {
+                \"capacity\":    {\"google.com/tpu\": \"$chips\"},
+                \"allocatable\": {\"google.com/tpu\": \"$chips\"}
+            }
+        }"
+        idx=$((idx + 1))
+    done
+}
+
+main() {
+    command -v kind >/dev/null || { echo "kind not found" >&2; exit 1; }
+    command -v kubectl >/dev/null || { echo "kubectl not found" >&2; exit 1; }
+
+    if kind get clusters 2>/dev/null | grep -qx "$cluster_name"; then
+        echo "Cluster $cluster_name exists; reusing"
+    else
+        make_kind_config
+        kind create cluster --name "$cluster_name" \
+            --image "kindest/node:$k8s_version" --config kind-config.yaml
+    fi
+    kubectl config use-context "kind-$cluster_name"
+    patch_nodes
+    echo "Fake-TPU cluster ready. Verify with:"
+    echo "  kubectl get nodes -L cloud.google.com/gke-tpu-accelerator,cloud.google.com/gke-tpu-topology"
+}
+
+main

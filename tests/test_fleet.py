@@ -145,6 +145,29 @@ class TestGreedy:
         assert sol.allocations["inf/llama"].num_replicas == 1
         assert sol.allocations["inf/gemma"].num_replicas == 1
 
+    def test_round_robin_falls_to_pool_with_capacity(self):
+        # Cheapest pool empty, second pool has room: round-robin must use it.
+        system = make_system(capacity={"v5e": 0, "v5p": 16})
+        sol = solve(system, SolverSpec(
+            saturation_policy=SaturationPolicy.ROUND_ROBIN))
+        a = sol.allocations.get("inf/llama")
+        assert a is not None and a.accelerator == "v5p-8"
+        assert a.num_replicas >= 1
+
+    def test_candidateless_server_reported_unallocated(self):
+        # Service class removed from config: server must not vanish.
+        system = make_system()
+        system.servers["inf/llama"].service_class = "missing"
+        sol = solve(system)
+        assert "inf/llama" in sol.unallocated
+        assert "inf/llama" not in sol.allocations
+
+    def test_zero_load_min_replicas_zero_single_empty_candidate(self):
+        from wva_tpu.fleet.allocation import build_candidates
+        system = make_system(llama_rate=0)
+        cands = build_candidates(system)["inf/llama"]
+        assert len(cands) == 1 and cands[0].accelerator == ""
+
     def test_whole_slice_quantization(self):
         # 12 chips can hold exactly one 8-chip slice, never 1.5.
         sol = solve(make_system(capacity={"v5e": 12, "v5p": 0}))

@@ -1,0 +1,123 @@
+"""Deploy-asset validation (model: reference ``test/chart/`` render tests —
+manifests must stay consistent with the code's constants and parsers)."""
+
+import pathlib
+
+import yaml
+
+from wva_tpu.api import v1alpha1
+from wva_tpu.config.helpers import parse_saturation_configmap
+from wva_tpu.config.scale_to_zero import (
+    DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME,
+    parse_scale_to_zero_configmap,
+)
+from wva_tpu.config.slo import (
+    SLO_CONFIGMAP_DATA_KEY,
+    SLO_CONFIGMAP_NAME,
+    parse_slo_config,
+)
+from wva_tpu.constants.labels import (
+    GKE_TPU_ACCELERATOR_NODE_LABEL,
+    GKE_TPU_TOPOLOGY_NODE_LABEL,
+    TPU_RESOURCE_NAME,
+)
+from wva_tpu.discovery.tpu import TPU_GENERATIONS
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def load_all(path):
+    return [d for d in yaml.safe_load_all((REPO / path).read_text())
+            if d is not None]
+
+
+class TestCRD:
+    def test_crd_matches_api_module(self):
+        crd = load_all("config/crd/wva.tpu.llmd.ai_variantautoscalings.yaml")[0]
+        assert crd["spec"]["group"] == v1alpha1.GROUP
+        assert crd["spec"]["names"]["kind"] == "VariantAutoscaling"
+        assert v1alpha1.SHORT_NAME in crd["spec"]["names"]["shortNames"]
+        v1 = crd["spec"]["versions"][0]
+        assert v1["name"] == "v1alpha1" and v1["served"] and v1["storage"]
+        assert "status" in v1["subresources"]
+        spec_schema = v1["schema"]["openAPIV3Schema"]["properties"]["spec"]
+        assert set(spec_schema["required"]) == {"scaleTargetRef", "modelID"}
+
+    def test_sample_va_round_trips_through_api_types(self):
+        for doc in load_all("config/samples/variantautoscaling.yaml"):
+            assert doc["apiVersion"] == f"{v1alpha1.GROUP}/v1alpha1"
+            va = v1alpha1.VariantAutoscaling.from_dict(doc)
+            assert va.spec.model_id
+            assert va.spec.cost() > 0
+            assert va.spec.scale_target_ref.name
+            back = va.to_dict()
+            assert back["spec"]["modelID"] == doc["spec"]["modelID"]
+
+
+class TestSampleConfigMaps:
+    def docs(self):
+        return {d["metadata"]["name"]: d
+                for d in load_all("config/samples/configmaps.yaml")}
+
+    def test_names_match_constants(self):
+        names = set(self.docs())
+        assert "wva-saturation-scaling-config" in names
+        assert DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME in names
+        assert SLO_CONFIGMAP_NAME in names
+
+    def test_saturation_sample_parses(self):
+        cm = self.docs()["wva-saturation-scaling-config"]
+        parsed = parse_saturation_configmap(cm["data"])
+        assert "default" in parsed
+        assert parsed["default"].analyzer_name == "saturation"
+        parsed["default"].validate()
+
+    def test_scale_to_zero_sample_parses(self):
+        cm = self.docs()[DEFAULT_SCALE_TO_ZERO_CONFIGMAP_NAME]
+        parsed = parse_scale_to_zero_configmap(cm["data"])
+        assert "default" in parsed
+        model_entries = [k for k in parsed if k != "default"]
+        assert model_entries, "sample should include a per-model entry"
+
+    def test_slo_sample_parses(self):
+        cm = self.docs()[SLO_CONFIGMAP_NAME]
+        parsed = parse_slo_config(cm["data"][SLO_CONFIGMAP_DATA_KEY])
+        assert parsed.service_classes and parsed.profiles
+        targets, prio = parsed.targets_for_model("meta-llama/Llama-3.1-8B")
+        assert targets is not None and prio == 1
+
+
+class TestActuationGlue:
+    def test_hpa_targets_wva_gauge(self):
+        docs = load_all("deploy/hpa/hpa.yaml")
+        hpa = next(d for d in docs if d["kind"] == "HorizontalPodAutoscaler")
+        metric = hpa["spec"]["metrics"][0]["external"]["metric"]
+        assert metric["name"] == "wva_desired_replicas"
+        assert hpa["spec"]["behavior"]["scaleUp"]["stabilizationWindowSeconds"] == 240
+
+    def test_keda_query_uses_wva_gauge(self):
+        docs = load_all("deploy/keda/scaledobject.yaml")
+        so = next(d for d in docs if d["kind"] == "ScaledObject")
+        trig = so["spec"]["triggers"][0]
+        assert trig["type"] == "prometheus"
+        assert "wva_desired_replicas" in trig["metadata"]["query"]
+        assert so["spec"]["minReplicaCount"] == 0
+
+
+class TestKindEmulator:
+    def test_setup_script_patches_discovery_labels(self):
+        text = (REPO / "deploy/kind-emulator/setup.sh").read_text()
+        assert GKE_TPU_ACCELERATOR_NODE_LABEL in text
+        assert GKE_TPU_TOPOLOGY_NODE_LABEL in text
+        assert TPU_RESOURCE_NAME in text
+        # Every accelerator label value the script emits must be one
+        # discovery recognizes.
+        for label in ("tpu-v5-lite-podslice", "tpu-v5p-slice", "tpu-v6e-slice"):
+            assert label in text
+            assert label in TPU_GENERATIONS
+
+    def test_rbac_covers_crd_group(self):
+        docs = load_all("config/rbac/role.yaml")
+        role = next(d for d in docs if d["kind"] == "ClusterRole")
+        groups = {g for rule in role["rules"] for g in rule["apiGroups"]}
+        assert v1alpha1.GROUP in groups

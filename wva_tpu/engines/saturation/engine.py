@@ -355,13 +355,18 @@ class SaturationEngine:
         for rm in data.replica_metrics:
             if rm.accelerator_name:
                 by_accel.setdefault(rm.accelerator_name, []).append(rm)
-        if len(by_accel) > 1:
-            # Observed TTFT/ITL is a model-wide mean blended across
-            # accelerator types; feeding it to per-accelerator filters would
-            # drag every profile toward the mixture. Needs per-accelerator
-            # latency queries before tuning heterogeneous fleets.
+        # Observed TTFT/ITL is a model-wide mean blended across accelerator
+        # types; feeding it to per-accelerator filters would drag every
+        # profile toward the mixture. Key the guard on variant_states (the
+        # authoritative fleet shape) — replica_metrics alone misses variants
+        # whose pods exist but aren't scraped yet. Needs per-accelerator
+        # latency queries before tuning heterogeneous fleets.
+        fleet_accels = {vs.accelerator_name for vs in data.variant_states
+                        if vs.accelerator_name and vs.current_replicas > 0}
+        if len(fleet_accels | set(by_accel)) > 1:
             log.debug("Model %s served by %d accelerator types; skipping "
-                      "tuner this tick", model_id, len(by_accel))
+                      "tuner this tick", model_id,
+                      len(fleet_accels | set(by_accel)))
             return
         # arrival_rate is model-wide: attribute per-replica load using the
         # authoritative ready-replica count from variant states (replicas

@@ -15,6 +15,7 @@ from dataclasses import dataclass
 from typing import Any
 
 import jax.numpy as jnp
+import numpy as np
 
 from wva_tpu.analyzers.queueing.params import TargetPerf
 from wva_tpu.analyzers.queueing.queue_model import (
@@ -105,15 +106,27 @@ def build_candidates(
         targets = system.targets_for(server)
         if targets is None:
             continue
-        for acc in system.candidate_accelerators(server):
+        accels = system.candidate_accelerators(server)
+        if server.load.arrival_rate_per_min <= 0 or \
+                server.load.avg_output_tokens <= 0:
+            # Zero traffic (reference allocation.go:72-75): min_replicas on
+            # each candidate accelerator, or one empty allocation when
+            # min_replicas == 0 (the per-accelerator copies would be
+            # indistinguishable).
+            for acc in accels:
+                prof = system.profiles.get(server.model_id, acc.name,
+                                           namespace=server.namespace)
+                if prof is None:
+                    continue
+                alloc = _zero_load_allocation(server, acc, prof)
+                zero_load.setdefault(name, []).append(alloc)
+                if server.min_replicas <= 0:
+                    break
+            continue
+        for acc in accels:
             prof = system.profiles.get(server.model_id, acc.name,
                                        namespace=server.namespace)
             if prof is None:
-                continue
-            if server.load.arrival_rate_per_min <= 0 or \
-                    server.load.avg_output_tokens <= 0:
-                zero_load.setdefault(name, []).append(
-                    _zero_load_allocation(server, acc, prof))
                 continue
             pairs.append((server, acc, targets, prof))
 
@@ -145,7 +158,9 @@ def build_candidates(
     sized = size_batch(cand, jnp.asarray(t_ttft, jnp.float32),
                        jnp.asarray(t_itl, jnp.float32),
                        jnp.asarray(t_tps, jnp.float32))
-    rate_star = [float(x) for x in sized["throughput_per_s"]]
+    # One bulk device->host transfer per array (per-element float() would
+    # issue a blocking sync each).
+    rate_star = np.asarray(sized["throughput_per_s"]).tolist()
 
     # Replica counts + per-replica operating point, then one analyze pass for
     # the achieved latencies (reference allocation.go:125-150).
@@ -162,6 +177,10 @@ def build_candidates(
         per_replica_rate.append(total_rate / r)
 
     metrics = analyze_batch(jnp.asarray(per_replica_rate, jnp.float32), cand)
+    itl_arr = np.asarray(metrics["avg_token_time_ms"]).tolist()
+    ttft_arr = (np.asarray(metrics["avg_wait_time_ms"])
+                + np.asarray(metrics["avg_prefill_time_ms"])).tolist()
+    rho_arr = np.asarray(metrics["rho"]).tolist()
 
     for i, (server, acc, targets, prof) in enumerate(padded[:n]):
         alloc = FleetAllocation(
@@ -171,10 +190,9 @@ def build_candidates(
             max_batch=max_b[i],
             chips_per_replica=acc.chips_per_replica,
             cost=acc.cost * replicas[i],
-            itl_ms=float(metrics["avg_token_time_ms"][i]),
-            ttft_ms=float(metrics["avg_wait_time_ms"][i])
-            + float(metrics["avg_prefill_time_ms"][i]),
-            rho=float(metrics["rho"][i]),
+            itl_ms=itl_arr[i],
+            ttft_ms=ttft_arr[i],
+            rho=rho_arr[i],
             max_rate_per_replica=rate_star[i],
         )
         alloc.value = _value_of(server, alloc)

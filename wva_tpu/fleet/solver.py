@@ -105,6 +105,14 @@ def solve(system: FleetSystem, spec: SolverSpec | None = None) -> Solution:
     else:
         _solve_greedy(system, spec, entries, solution)
 
+    # Servers that produced no candidates at all (no SLO targets / no fitted
+    # profile) must still be visible to callers — report them unallocated so
+    # a transient config gap can't silently drop a server from accounting.
+    sized = {e.server.name for e in entries}
+    for name in sorted(system.servers):
+        if name not in sized and name not in solution.unallocated:
+            solution.unallocated.append(name)
+
     for e in entries:
         name = e.server.name
         d = diff_of(name, e.server.current, solution.allocations.get(name))
@@ -211,8 +219,13 @@ def _allocate_equally(group: list[_Entry], available: dict[str, int],
     granted: dict[str, int] = {e.server.name: 0 for e in group}
     chosen: dict[str, FleetAllocation] = {}
     for e in group:
+        # Cheapest candidate whose pool still has capacity for at least one
+        # replica (a pinned empty pool would otherwise starve the server
+        # while another pool sits free).
         for alloc in e.candidates:
-            if alloc.accelerator and alloc.chips_per_replica > 0:
+            if (alloc.accelerator and alloc.chips_per_replica > 0
+                    and available.get(alloc.accelerator_type, 0)
+                    >= alloc.chips_per_replica):
                 chosen[e.server.name] = alloc
                 break
     progress = True
