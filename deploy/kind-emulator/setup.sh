@@ -48,21 +48,24 @@ while getopts "c:n:p:k:h" opt; do
     esac
 done
 
-cleanup() { [[ -f kind-config.yaml ]] && rm -f kind-config.yaml || true; }
+# Generated config lives in a temp file so a user's own kind-config.yaml in
+# the cwd is never overwritten or deleted.
+kind_config="$(mktemp -t kind-wva-tpu-config.XXXXXX.yaml)"
+cleanup() { rm -f "$kind_config" || true; }
 trap cleanup EXIT
 
 # ------------------------------------------------------------------
 # 1. kind cluster (control plane + N workers, HPAScaleToZero optional)
 # ------------------------------------------------------------------
 make_kind_config() {
-    cat > kind-config.yaml <<EOF
+    cat > "$kind_config" <<EOF
 kind: Cluster
 apiVersion: kind.x-k8s.io/v1alpha4
 nodes:
   - role: control-plane
 EOF
     if [[ "$enable_scale_to_zero" == "true" ]]; then
-        cat >> kind-config.yaml <<EOF
+        cat >> "$kind_config" <<EOF
     kubeadmConfigPatches:
       - |
         kind: ClusterConfiguration
@@ -72,7 +75,7 @@ EOF
 EOF
     fi
     for ((i = 0; i < nodes; i++)); do
-        echo "  - role: worker" >> kind-config.yaml
+        echo "  - role: worker" >> "$kind_config"
     done
 }
 
@@ -131,7 +134,7 @@ main() {
     else
         make_kind_config
         kind create cluster --name "$cluster_name" \
-            --image "kindest/node:$k8s_version" --config kind-config.yaml
+            --image "kindest/node:$k8s_version" --config "$kind_config"
     fi
     kubectl config use-context "kind-$cluster_name"
     patch_nodes

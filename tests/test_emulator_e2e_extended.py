@@ -1,0 +1,129 @@
+"""Extended emulated e2e scenarios — the reference suites not yet covered by
+``test_emulator_e2e.py``: parallel multi-model load scale-up
+(test/e2e/parallel_load_scaleup_test.go), the V2 token-capacity path under
+load with scale-down on load drop (test/e2e-saturation-based assertions), and
+the SLO queueing-model analyzer driving the loop end-to-end."""
+
+from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms, TargetPerf
+from wva_tpu.api.v1alpha1 import ObjectMeta
+from wva_tpu.config.slo import SLOConfigData, ServiceClass
+from wva_tpu.emulator import (
+    EmulationHarness,
+    HPAParams,
+    ServingParams,
+    VariantSpec,
+    constant,
+    ramp,
+)
+from wva_tpu.interfaces import SaturationScalingConfig
+
+LLAMA = "meta-llama/Llama-3.1-8B"
+GEMMA = "google/gemma-7b"
+
+FAST_HPA = HPAParams(stabilization_up_seconds=30.0,
+                     stabilization_down_seconds=60.0,
+                     sync_period_seconds=15.0)
+
+
+def spec_for(name, model, load, accelerator="v5e-8", replicas=1):
+    return VariantSpec(
+        name=name, model_id=model, accelerator=accelerator,
+        chips_per_replica=8, cost=10.0, initial_replicas=replicas,
+        serving=ServingParams(), load=load, hpa=FAST_HPA)
+
+
+def test_parallel_multi_model_scaleup():
+    """Two models under simultaneous saturating load must both scale, on
+    their own variants, without cross-interference
+    (reference parallel_load_scaleup_test.go)."""
+    h = EmulationHarness(
+        [spec_for("llama-v5e", LLAMA, ramp(2.0, 50.0, 300.0, hold=1e9)),
+         spec_for("gemma-v5e", GEMMA, ramp(2.0, 50.0, 300.0, hold=1e9))],
+        nodepools=[("v5e-pool", "v5e", "2x4", 16)],
+        startup_seconds=60.0)
+    h.run(1200)
+    assert h.replicas_of("llama-v5e") > 1
+    assert h.replicas_of("gemma-v5e") > 1
+    # Each model's decisions carry its own variant; replica counts should be
+    # in the same ballpark under identical load.
+    assert abs(h.replicas_of("llama-v5e") - h.replicas_of("gemma-v5e")) <= 2
+
+
+def test_v2_path_scales_up_and_back_down():
+    """V2 token-capacity path: ramp to saturation then drop to a trickle;
+    replicas must rise and then shrink (reference e2e_saturation_test.go
+    scale-up :320 + stability/cost assertions :396,919)."""
+    cfg = SaturationScalingConfig(analyzer_name="saturation")
+    # ramp holds 900s after the 300s ramp, then drops to zero-ish load.
+    h = EmulationHarness(
+        [spec_for("llama-v5e", LLAMA, ramp(2.0, 50.0, 300.0, hold=900.0))],
+        saturation_config=cfg, startup_seconds=60.0)
+    h.run(1100)
+    peak = h.replicas_of("llama-v5e")
+    assert peak > 1, "V2 should scale up under load"
+    h.run(1200)  # load is now ~0 (past ramp+hold)
+    assert h.replicas_of("llama-v5e") < peak, "V2 should scale back down"
+    # Min-replica enforcement keeps the model serveable (scale-to-zero off).
+    assert h.replicas_of("llama-v5e") >= 1
+
+
+def _slo_world(load, tuner=False):
+    cfg = SaturationScalingConfig(analyzer_name="slo")
+    h = EmulationHarness([spec_for("llama-v5e", LLAMA, load)],
+                         saturation_config=cfg, startup_seconds=60.0,
+                         nodepools=[("v5e-pool", "v5e", "2x4", 16)])
+    # Profile roughly matching ServingParams: 96 decode slots at ~20 ms/token
+    # and 256-token outputs -> a replica sustains ~18 req/s.
+    h.manager.config.update_slo_config(SLOConfigData(
+        service_classes=[ServiceClass(
+            name="premium", priority=1,
+            model_targets={LLAMA: TargetPerf(target_ttft_ms=2000.0)})],
+        profiles=[PerfProfile(
+            model_id=LLAMA, accelerator="v5e-8",
+            service_parms=ServiceParms(alpha=18.0, beta=0.00267, gamma=0.00002),
+            max_batch_size=96, max_queue_size=384)],
+        tuner_enabled=tuner))
+    return h
+
+
+def test_slo_analyzer_drives_loop_end_to_end():
+    """SLO path against the live emulator: sizing from the queueing model
+    must scale the fleet to meet demand."""
+    h = _slo_world(ramp(2.0, 50.0, 300.0, hold=1e9))
+    h.run(1500)
+    replicas = h.replicas_of("llama-v5e")
+    # ~50 req/s demand / ~16 req/s SLO capacity / 0.85 headroom ~ 3.7.
+    assert replicas >= 3, f"SLO path should size for demand, got {replicas}"
+    sim = h.sim_of_model(LLAMA)
+    # After convergence the fleet should serve most requests within SLO.
+    assert sim.slo_attainment(2.0, since=h.clock.now() - 300) > 0.9
+
+
+def test_slo_analyzer_holds_steady_on_light_load():
+    h = _slo_world(constant(2.0))
+    h.run(900)
+    assert h.replicas_of("llama-v5e") == 1
+
+
+def test_slo_analyzer_with_tuner_enabled_stays_stable():
+    """Tuner enabled end-to-end: refinements must not destabilize scaling
+    (NIS gate + single-accelerator guard)."""
+    h = _slo_world(constant(10.0), tuner=True)
+    h.run(900)
+    assert 1 <= h.replicas_of("llama-v5e") <= 3
+    changes = []
+    h.run(600, on_step=lambda hh, t: changes.append(hh.replicas_of("llama-v5e")))
+    assert len(set(changes[-240:])) == 1, "no flapping with tuner active"
+
+
+def test_v1_scale_down_after_load_drop():
+    """V1 percentage path releases replicas when load subsides (reference
+    scale-down safety: >=2 non-saturated replicas + redistribution sim)."""
+    h = EmulationHarness(
+        [spec_for("llama-v5e", LLAMA, ramp(2.0, 50.0, 300.0, hold=600.0))],
+        startup_seconds=60.0)
+    h.run(800)
+    peak = h.replicas_of("llama-v5e")
+    assert peak > 1
+    h.run(1500)  # load gone
+    assert 1 <= h.replicas_of("llama-v5e") < peak

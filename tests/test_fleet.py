@@ -162,6 +162,33 @@ class TestGreedy:
         assert "inf/llama" in sol.unallocated
         assert "inf/llama" not in sol.allocations
 
+    def test_round_robin_repoints_after_competitor_drains_pool(self):
+        # Both servers prefer v5e (8 chips = 1 replica); after the first
+        # grant drains it, the second must re-point to v5p instead of
+        # starving while 64 v5p chips sit free.
+        system = make_system(capacity={"v5e": 8, "v5p": 64})
+        system.service_classes["free"].priority = 1
+        # Give gemma a v5p profile so it has a fallback candidate.
+        system.profiles.sync_namespace("", make_profiles().all() + [
+            PerfProfile(model_id="gemma", accelerator="v5p-8",
+                        service_parms=V5P, max_batch_size=128,
+                        max_queue_size=256)])
+        system.servers["inf/llama"].load.arrival_rate_per_min = 6000
+        system.servers["inf/gemma"].load.arrival_rate_per_min = 6000
+        sol = solve(system, SolverSpec(
+            saturation_policy=SaturationPolicy.ROUND_ROBIN))
+        accels = {a.accelerator_type for a in sol.allocations.values()}
+        assert len(sol.allocations) == 2, sol.unallocated
+        assert accels == {"v5e", "v5p"}
+
+    def test_zero_load_without_profile_still_scales_to_zero(self):
+        from wva_tpu.fleet.allocation import build_candidates
+        system = make_system(llama_rate=0)
+        system.profiles = PerfProfileStore()  # no profiles at all
+        cands = build_candidates(system).get("inf/llama")
+        assert cands is not None and len(cands) == 1
+        assert cands[0].accelerator == "" and cands[0].num_replicas == 0
+
     def test_zero_load_min_replicas_zero_single_empty_candidate(self):
         from wva_tpu.fleet.allocation import build_candidates
         system = make_system(llama_rate=0)

@@ -109,19 +109,22 @@ def build_candidates(
         accels = system.candidate_accelerators(server)
         if server.load.arrival_rate_per_min <= 0 or \
                 server.load.avg_output_tokens <= 0:
-            # Zero traffic (reference allocation.go:72-75): min_replicas on
-            # each candidate accelerator, or one empty allocation when
-            # min_replicas == 0 (the per-accelerator copies would be
-            # indistinguishable).
+            # Zero traffic (reference allocation.go:72-75): with
+            # min_replicas == 0 the empty (scale-to-zero) allocation needs no
+            # accelerator or profile at all; otherwise min_replicas on each
+            # candidate accelerator with a fitted profile.
+            if server.min_replicas <= 0:
+                zero_load[name] = [FleetAllocation(accelerator="",
+                                                   accelerator_type="",
+                                                   num_replicas=0, value=0.0)]
+                continue
             for acc in accels:
                 prof = system.profiles.get(server.model_id, acc.name,
                                            namespace=server.namespace)
                 if prof is None:
                     continue
-                alloc = _zero_load_allocation(server, acc, prof)
-                zero_load.setdefault(name, []).append(alloc)
-                if server.min_replicas <= 0:
-                    break
+                zero_load.setdefault(name, []).append(
+                    _zero_load_allocation(server, acc, prof))
             continue
         for acc in accels:
             prof = system.profiles.get(server.model_id, acc.name,

@@ -218,28 +218,37 @@ def _allocate_equally(group: list[_Entry], available: dict[str, int],
     (reference greedy.go:240-260+ allocateEqually)."""
     granted: dict[str, int] = {e.server.name: 0 for e in group}
     chosen: dict[str, FleetAllocation] = {}
-    for e in group:
-        # Cheapest candidate whose pool still has capacity for at least one
-        # replica (a pinned empty pool would otherwise starve the server
-        # while another pool sits free).
+
+    def repoint(e: "_Entry") -> FleetAllocation | None:
+        """Cheapest candidate whose pool can still grant one replica. A
+        server with zero grants may switch pools at any time; once granted,
+        it is pinned (replicas of one server never mix pools)."""
         for alloc in e.candidates:
             if (alloc.accelerator and alloc.chips_per_replica > 0
                     and available.get(alloc.accelerator_type, 0)
                     >= alloc.chips_per_replica):
-                chosen[e.server.name] = alloc
-                break
+                return alloc
+        return None
+
     progress = True
     while progress:
         progress = False
         for e in group:
-            alloc = chosen.get(e.server.name)
+            name = e.server.name
+            alloc = chosen.get(name)
+            if granted[name] == 0:
+                # Re-evaluate while nothing is granted: a competitor may have
+                # drained the pool picked earlier while another pool has room.
+                alloc = repoint(e)
+                if alloc is not None:
+                    chosen[name] = alloc
             if alloc is None:
                 continue
-            if granted[e.server.name] >= alloc.num_replicas:
+            if granted[name] >= alloc.num_replicas:
                 continue
             if available.get(alloc.accelerator_type, 0) >= alloc.chips_per_replica:
                 available[alloc.accelerator_type] -= alloc.chips_per_replica
-                granted[e.server.name] += 1
+                granted[name] += 1
                 progress = True
     for e in group:
         n = granted.get(e.server.name, 0)
