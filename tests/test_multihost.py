@@ -1,0 +1,190 @@
+"""Multi-host slice scale-target tests (SURVEY.md section 7 "hard parts" #2:
+a v5e-16 replica is 2 hosts x 8 chips that become ready together)."""
+
+from wva_tpu.api.v1alpha1 import (
+    CrossVersionObjectReference,
+    ObjectMeta,
+    VariantAutoscaling,
+    VariantAutoscalingSpec,
+)
+from wva_tpu.emulator import (
+    EmulationHarness,
+    HPAParams,
+    ServingParams,
+    VariantSpec,
+    ramp,
+)
+from wva_tpu.k8s import (
+    Container,
+    Deployment,
+    DeploymentStatus,
+    FakeCluster,
+    LeaderWorkerSet,
+    LeaderWorkerSetStatus,
+    Pod,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from wva_tpu.utils.scale_target import (
+    chips_per_replica,
+    get_scale_target_with_backoff,
+    scale_target_state,
+)
+
+MODEL = "meta-llama/Llama-3-70B"
+
+
+def tpu_template(chips="8"):
+    return PodTemplateSpec(
+        labels={"app": "llama70b"},
+        containers=[Container(
+            name="srv",
+            resources=ResourceRequirements(requests={"google.com/tpu": chips}))])
+
+
+class TestScaleTargetAdapter:
+    def test_deployment_state(self):
+        d = Deployment(metadata=ObjectMeta(name="d", namespace="ns"),
+                       replicas=3, template=tpu_template(),
+                       status=DeploymentStatus(replicas=3, ready_replicas=2))
+        st = scale_target_state(d)
+        assert st.hosts_per_replica == 1
+        assert st.desired_replicas == 3 and st.ready_replicas == 2
+        assert st.pending_replicas == 1
+        assert chips_per_replica(st) == 8
+
+    def test_lws_state_multiplies_chips_by_hosts(self):
+        lws = LeaderWorkerSet(
+            metadata=ObjectMeta(name="l", namespace="ns"),
+            replicas=2, size=2, template=tpu_template(),
+            status=LeaderWorkerSetStatus(replicas=2, ready_replicas=1))
+        st = scale_target_state(lws)
+        assert st.hosts_per_replica == 2
+        assert st.pending_replicas == 1  # one group not fully ready
+        assert chips_per_replica(st) == 16  # 2 hosts x 8 chips
+
+    def test_unknown_kind_rejected(self):
+        cluster = FakeCluster()
+        try:
+            get_scale_target_with_backoff(cluster, "StatefulSet", "x", "ns")
+            raise AssertionError("expected TypeError")
+        except TypeError:
+            pass
+
+    def test_fetch_lws_by_kind(self):
+        cluster = FakeCluster()
+        cluster.create(LeaderWorkerSet(
+            metadata=ObjectMeta(name="l", namespace="ns"), replicas=1, size=2,
+            template=tpu_template()))
+        obj = get_scale_target_with_backoff(cluster, "LeaderWorkerSet", "l", "ns")
+        assert isinstance(obj, LeaderWorkerSet)
+
+
+class TestKubeletLWS:
+    def make(self):
+        from wva_tpu.emulator.profiles import add_tpu_nodepool
+        from wva_tpu.emulator.kubelet import FakeKubelet
+        from wva_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(start=1000.0)
+        cluster = FakeCluster(clock=clock)
+        add_tpu_nodepool(cluster, "v5e-pool", "v5e", "4x4", 8)  # 8 hosts
+        kubelet = FakeKubelet(client=cluster, clock=clock, startup_seconds=60.0)
+        return clock, cluster, kubelet
+
+    def test_group_provisioning_and_atomic_readiness(self):
+        clock, cluster, kubelet = self.make()
+        cluster.create(LeaderWorkerSet(
+            metadata=ObjectMeta(name="l70b", namespace="inf"),
+            replicas=2, size=2, template=tpu_template()))
+        kubelet.step()
+        pods = cluster.list("Pod", namespace="inf")
+        assert len(pods) == 4  # 2 groups x 2 hosts
+        lws = cluster.get("LeaderWorkerSet", "inf", "l70b")
+        assert lws.status.replicas == 2 and lws.status.ready_replicas == 0
+
+        clock.advance(61)
+        kubelet.step()
+        lws = cluster.get("LeaderWorkerSet", "inf", "l70b")
+        assert lws.status.ready_replicas == 2
+        # Serving unit = one leader per ready group.
+        assert len(kubelet.ready_pods_of("inf", "l70b")) == 2
+
+    def test_partial_group_keeps_replica_pending(self):
+        clock, cluster, kubelet = self.make()
+        cluster.create(LeaderWorkerSet(
+            metadata=ObjectMeta(name="l70b", namespace="inf"),
+            replicas=1, size=2, template=tpu_template()))
+        kubelet.step()
+        clock.advance(61)
+        kubelet.step()
+        # Kill one host pod of the group.
+        pod = cluster.list("Pod", namespace="inf")[0]
+        pod.status.ready = False
+        cluster.update_status(pod)
+        kubelet.step()
+        lws = cluster.get("LeaderWorkerSet", "inf", "l70b")
+        assert lws.status.ready_replicas == 0
+        assert kubelet.ready_pods_of("inf", "l70b") == []
+
+    def test_downscale_removes_whole_groups(self):
+        clock, cluster, kubelet = self.make()
+        cluster.create(LeaderWorkerSet(
+            metadata=ObjectMeta(name="l70b", namespace="inf"),
+            replicas=3, size=2, template=tpu_template()))
+        kubelet.step()
+        assert len(cluster.list("Pod", namespace="inf")) == 6
+        cluster.patch_scale("LeaderWorkerSet", "inf", "l70b", 1)
+        kubelet.step()
+        pods = cluster.list("Pod", namespace="inf")
+        assert len(pods) == 2
+        # The surviving pods form one complete group.
+        groups = {p.metadata.labels["leaderworkerset.sigs.k8s.io/group-index"]
+                  for p in pods}
+        assert len(groups) == 1
+
+
+class TestMultiHostE2E:
+    def test_v5e16_slices_scale_under_load(self):
+        """North-star config 3 shape: Llama-3-70B on multi-host v5e-16
+        (2 hosts x 8 chips per replica) scaling 1 -> N whole slices."""
+        spec = VariantSpec(
+            name="llama70b-v5e16", model_id=MODEL, accelerator="v5e-16",
+            chips_per_replica=8,  # per host
+            hosts_per_slice=2,
+            cost=16.0, initial_replicas=1,
+            serving=ServingParams(),
+            load=ramp(2.0, 40.0, 300.0, hold=1e9),
+            hpa=HPAParams(stabilization_up_seconds=30.0,
+                          stabilization_down_seconds=60.0,
+                          sync_period_seconds=15.0))
+        h = EmulationHarness(
+            [spec], nodepools=[("v5e-pool", "v5e", "4x8", 16)],
+            startup_seconds=60.0)
+        h.run(1200)
+        groups = h.replicas_of("llama70b-v5e16")
+        assert groups > 1, "multi-host slices should scale up"
+        assert h.ready_replicas_of("llama70b-v5e16") > 1
+        # Whole-group invariant: pod count is exactly groups x hosts.
+        pods = [p for p in h.cluster.list("Pod", namespace=h.namespace)
+                if any(r.get("kind") == "LeaderWorkerSet"
+                       for r in p.metadata.owner_references)]
+        lws = h.cluster.get("LeaderWorkerSet", h.namespace, "llama70b-v5e16")
+        assert len(pods) == lws.status.replicas * 2
+
+    def test_engine_variant_state_reports_group_semantics(self):
+        """chips_per_replica = hosts x per-host chips; pending counts
+        not-fully-ready groups."""
+        spec = VariantSpec(
+            name="llama70b-v5e16", model_id=MODEL, accelerator="v5e-16",
+            chips_per_replica=8, hosts_per_slice=2, cost=16.0,
+            initial_replicas=2, serving=ServingParams(), load=None)
+        h = EmulationHarness([spec], nodepools=[("v5e-pool", "v5e", "4x8", 16)],
+                             startup_seconds=300.0)
+        vas = h.cluster.variant_autoscalings(h.namespace)
+        states = h.manager.engine.build_variant_states(vas)
+        assert len(states) == 1
+        st = states[0]
+        assert st.chips_per_replica == 16
+        assert st.hosts_per_slice == 2
+        assert st.current_replicas == 2

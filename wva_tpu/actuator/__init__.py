@@ -14,6 +14,7 @@ from wva_tpu.api.v1alpha1 import VariantAutoscaling
 from wva_tpu.k8s.client import KubeClient, NotFoundError
 from wva_tpu.k8s.objects import Deployment
 from wva_tpu.metrics import MetricsRegistry
+from wva_tpu.utils import scale_target
 
 log = logging.getLogger(__name__)
 
@@ -29,9 +30,10 @@ class Actuator:
         """Read REAL current replicas from the target and emit
         current/desired/ratio gauges. Raises on missing target (caller logs
         but never fails the loop on emission errors)."""
-        deploy: Deployment = self.client.get(
-            Deployment.KIND, va.metadata.namespace, va.spec.scale_target_ref.name)
-        current = deploy.status.replicas or deploy.desired_replicas()
+        target = scale_target.scale_target_state(self.client.get(
+            va.spec.scale_target_ref.kind or Deployment.KIND,
+            va.metadata.namespace, va.spec.scale_target_ref.name))
+        current = target.status_replicas or target.desired_replicas
         desired = va.status.desired_optimized_alloc.num_replicas
         accelerator = va.status.desired_optimized_alloc.accelerator
         self.registry.emit_replica_metrics(

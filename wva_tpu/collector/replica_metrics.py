@@ -259,7 +259,7 @@ class ReplicaMetricsCollector:
             for key in deployments:
                 dep_name = key.split("/", 1)[1]
                 if pod_name.startswith(dep_name + "-"):
-                    va = self.pod_va_mapper.indexer.find_va_for_deployment(
+                    va = self.pod_va_mapper.va_for_scale_target_name(
                         dep_name, namespace)
                     return va.metadata.name if va else ""
             return ""

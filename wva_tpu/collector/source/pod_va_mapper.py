@@ -9,10 +9,10 @@ from __future__ import annotations
 
 import logging
 
-from wva_tpu.api.v1alpha1 import VariantAutoscaling
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference, VariantAutoscaling
 from wva_tpu.indexers import Indexer
 from wva_tpu.k8s.client import KubeClient, NotFoundError
-from wva_tpu.k8s.objects import Pod
+from wva_tpu.k8s.objects import LeaderWorkerSet, Pod
 
 log = logging.getLogger(__name__)
 
@@ -23,12 +23,25 @@ class PodVAMapper:
         self.indexer = indexer
 
     def deployment_for_pod(self, pod: Pod) -> str | None:
-        """Owning Deployment name, walking Pod -> ReplicaSet -> Deployment."""
+        """Owning scale-target name, walking Pod -> ReplicaSet -> Deployment.
+        Multi-host slice pods are owned by their LeaderWorkerSet directly
+        (emulation convention); on a real cluster LWS interposes a per-group
+        StatefulSet named "<lws>-<group>", resolved through the stored
+        StatefulSet's owner or the trailing-segment strip."""
         for ref in pod.metadata.owner_references:
             kind = ref.get("kind", "")
             name = ref.get("name", "")
-            if kind == "Deployment":
+            if kind in ("Deployment", "LeaderWorkerSet"):
                 return name
+            if kind == "StatefulSet":
+                try:
+                    sts = self.client.get("StatefulSet", pod.metadata.namespace, name)
+                    for sts_ref in sts.metadata.owner_references:
+                        if sts_ref.get("kind") == LeaderWorkerSet.KIND:
+                            return sts_ref.get("name")
+                except NotFoundError:
+                    pass
+                return name.rsplit("-", 1)[0] if "-" in name else name
             if kind == "ReplicaSet":
                 # K8s convention: ReplicaSet name = "<deployment>-<hash>".
                 # Resolve through the stored ReplicaSet when present, else
@@ -55,4 +68,18 @@ class PodVAMapper:
             return None
         if tracked_deployments is not None and deploy_name not in tracked_deployments:
             return None
-        return self.indexer.find_va_for_deployment(deploy_name, pod.metadata.namespace)
+        return self.va_for_scale_target_name(deploy_name, pod.metadata.namespace)
+
+    def va_for_scale_target_name(self, name: str,
+                                 namespace: str) -> VariantAutoscaling | None:
+        """Resolve a VA by scale-target NAME across the supported kinds:
+        the Deployment index key first, then the LeaderWorkerSet key (the
+        index is keyed namespace/apiVersion/kind/name)."""
+        va = self.indexer.find_va_for_deployment(name, namespace)
+        if va is None:
+            va = self.indexer.find_va_for_scale_target(
+                CrossVersionObjectReference(
+                    kind=LeaderWorkerSet.KIND, name=name,
+                    api_version=LeaderWorkerSet.API_VERSION),
+                namespace)
+        return va

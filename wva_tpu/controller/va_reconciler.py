@@ -16,6 +16,7 @@ import queue
 import threading
 
 from wva_tpu.api.v1alpha1 import (
+    CrossVersionObjectReference,
     REASON_TARGET_FOUND,
     REASON_TARGET_NOT_FOUND,
     TYPE_METRICS_AVAILABLE,
@@ -26,7 +27,7 @@ from wva_tpu.datastore import Datastore
 from wva_tpu.engines import common
 from wva_tpu.indexers import Indexer
 from wva_tpu.k8s.client import ADDED, DELETED, KubeClient, NotFoundError
-from wva_tpu.k8s.objects import Deployment
+from wva_tpu.k8s.objects import Deployment, LeaderWorkerSet
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from wva_tpu.utils.variant import update_va_status_with_backoff
 from wva_tpu.controller.predicates import deployment_event_allowed, va_event_allowed
@@ -47,6 +48,7 @@ class VariantAutoscalingReconciler:
     def setup(self) -> None:
         self.client.watch(VariantAutoscaling.kind, self._on_va_event)
         self.client.watch(Deployment.KIND, self._on_deployment_event)
+        self.client.watch(LeaderWorkerSet.KIND, self._on_deployment_event)
 
     def _on_va_event(self, event: str, va: VariantAutoscaling) -> None:
         if event == DELETED:
@@ -58,16 +60,20 @@ class VariantAutoscalingReconciler:
             return
         self.reconcile(va.metadata.name, va.metadata.namespace)
 
-    def _on_deployment_event(self, event: str, deploy: Deployment) -> None:
-        """Map Deployment create/delete to the owning VA via the index
-        (reference handleDeploymentEvent :258-288)."""
+    def _on_deployment_event(self, event: str, target) -> None:
+        """Map scale-target create/delete (Deployment or LeaderWorkerSet) to
+        the owning VA via the index — keyed by the event object's own
+        kind/apiVersion (reference handleDeploymentEvent :258-288)."""
         if not deployment_event_allowed(event):
             return
         try:
-            va = self.indexer.find_va_for_deployment(
-                deploy.metadata.name, deploy.metadata.namespace)
+            va = self.indexer.find_va_for_scale_target(
+                CrossVersionObjectReference(
+                    kind=target.KIND, name=target.metadata.name,
+                    api_version=target.API_VERSION),
+                target.metadata.namespace)
         except Exception as e:  # noqa: BLE001
-            log.debug("deployment->VA mapping failed: %s", e)
+            log.debug("scale-target->VA mapping failed: %s", e)
             return
         if va is not None:
             self.reconcile(va.metadata.name, va.metadata.namespace)
@@ -112,9 +118,10 @@ class VariantAutoscalingReconciler:
         self.datastore.namespace_track(VariantAutoscaling.kind, name, namespace)
         now = self.clock.now()
 
-        # Resolve target Deployment -> TargetResolved condition.
+        # Resolve the scale target (any supported kind) -> TargetResolved.
         try:
-            self.client.get(Deployment.KIND, namespace, va.spec.scale_target_ref.name)
+            kind = va.spec.scale_target_ref.kind or Deployment.KIND
+            self.client.get(kind, namespace, va.spec.scale_target_ref.name)
             va.set_condition(TYPE_TARGET_RESOLVED, "True", REASON_TARGET_FOUND,
                              f"Scale target {va.spec.scale_target_ref.name} found",
                              now=now)

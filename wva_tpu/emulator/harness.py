@@ -32,6 +32,8 @@ from wva_tpu.k8s import (
     ExtensionRef,
     FakeCluster,
     InferencePool,
+    LeaderWorkerSet,
+    NotFoundError,
     Pod,
     PodStatus,
     PodTemplateSpec,
@@ -57,6 +59,10 @@ class VariantSpec:
     serving: ServingParams = field(default_factory=ServingParams)
     load: LoadProfile | None = None  # None = no direct load (shared model)
     hpa: HPAParams = field(default_factory=HPAParams)
+    # Hosts per slice replica: 1 = single-host Deployment; >1 = multi-host
+    # LeaderWorkerSet target (chips_per_replica is PER HOST in that case,
+    # matching pod-level google.com/tpu requests).
+    hosts_per_slice: int = 1
 
 
 class EmulationHarness:
@@ -116,8 +122,10 @@ class EmulationHarness:
                                    startup_seconds=startup_seconds)
         self.hpa = HPAEmulator(self.cluster, self.manager.registry, self.clock)
         for spec in variants:
+            kind = LeaderWorkerSet.KIND if spec.hosts_per_slice > 1 \
+                else Deployment.KIND
             self.hpa.add_target(namespace, spec.name, spec.name,
-                                spec.accelerator, spec.hpa)
+                                spec.accelerator, spec.hpa, kind=kind)
 
         self.engine_interval = engine_interval
         self.sfz_interval = sfz_interval
@@ -137,25 +145,39 @@ class EmulationHarness:
     def _create_variant(self, spec: VariantSpec) -> None:
         labels = {"app": spec.model_id.split("/")[-1].lower(),
                   "variant": spec.name}
-        self.cluster.create(Deployment(
-            metadata=ObjectMeta(name=spec.name, namespace=self.namespace),
-            replicas=spec.initial_replicas,
-            selector=dict(labels),
-            template=PodTemplateSpec(
-                labels=dict(labels),
-                containers=[Container(
-                    name="server",
-                    args=self._serving_args(spec),
-                    resources=ResourceRequirements(
-                        requests={TPU_RESOURCE_NAME: str(spec.chips_per_replica)}),
-                )]),
-        ))
+        template = PodTemplateSpec(
+            labels=dict(labels),
+            containers=[Container(
+                name="server",
+                args=self._serving_args(spec),
+                resources=ResourceRequirements(
+                    requests={TPU_RESOURCE_NAME: str(spec.chips_per_replica)}),
+            )])
+        if spec.hosts_per_slice > 1:
+            self.cluster.create(LeaderWorkerSet(
+                metadata=ObjectMeta(name=spec.name, namespace=self.namespace),
+                replicas=spec.initial_replicas,
+                size=spec.hosts_per_slice,
+                selector=dict(labels),
+                template=template,
+            ))
+            ref = CrossVersionObjectReference(
+                kind=LeaderWorkerSet.KIND, name=spec.name,
+                api_version=LeaderWorkerSet.API_VERSION)
+        else:
+            self.cluster.create(Deployment(
+                metadata=ObjectMeta(name=spec.name, namespace=self.namespace),
+                replicas=spec.initial_replicas,
+                selector=dict(labels),
+                template=template,
+            ))
+            ref = CrossVersionObjectReference(name=spec.name)
         self.cluster.create(VariantAutoscaling(
             metadata=ObjectMeta(
                 name=spec.name, namespace=self.namespace,
                 labels={ACCELERATOR_NAME_LABEL_KEY: spec.accelerator}),
             spec=VariantAutoscalingSpec(
-                scale_target_ref=CrossVersionObjectReference(name=spec.name),
+                scale_target_ref=ref,
                 model_id=spec.model_id,
                 variant_cost=str(spec.cost))))
         self.cluster.create(InferencePool(
@@ -238,13 +260,17 @@ class EmulationHarness:
 
     # --- measurement ---
 
+    def _target_of(self, name: str):
+        try:
+            return self.cluster.get(Deployment.KIND, self.namespace, name)
+        except NotFoundError:
+            return self.cluster.get(LeaderWorkerSet.KIND, self.namespace, name)
+
     def replicas_of(self, name: str) -> int:
-        return self.cluster.get(Deployment.KIND, self.namespace, name) \
-            .desired_replicas()
+        return self._target_of(name).desired_replicas()
 
     def ready_replicas_of(self, name: str) -> int:
-        deploy = self.cluster.get(Deployment.KIND, self.namespace, name)
-        return deploy.status.ready_replicas
+        return self._target_of(name).status.ready_replicas
 
     def sim_of_model(self, model_id: str) -> ModelServerSim:
         return self._sims_by_model[model_id]

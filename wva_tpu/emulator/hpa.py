@@ -38,10 +38,11 @@ class HPAParams:
 @dataclass
 class _Target:
     namespace: str
-    deployment: str
+    deployment: str  # scale-target name (Deployment or LeaderWorkerSet)
     variant_name: str
     accelerator: str
     params: HPAParams
+    kind: str = Deployment.KIND
     # (time, desired) observations for stabilization windows
     history: list[tuple[float, int]] = field(default_factory=list)
     last_scale_up_at: float = -1e18
@@ -58,9 +59,10 @@ class HPAEmulator:
         self._targets: list[_Target] = []
 
     def add_target(self, namespace: str, deployment: str, variant_name: str,
-                   accelerator: str, params: HPAParams | None = None) -> None:
+                   accelerator: str, params: HPAParams | None = None,
+                   kind: str = Deployment.KIND) -> None:
         self._targets.append(_Target(
-            namespace=namespace, deployment=deployment,
+            namespace=namespace, deployment=deployment, kind=kind,
             variant_name=variant_name, accelerator=accelerator,
             params=params or HPAParams()))
 
@@ -86,8 +88,7 @@ class HPAEmulator:
         desired = max(desired_raw, t.params.min_replicas)
 
         try:
-            deploy: Deployment = self.client.get(
-                Deployment.KIND, t.namespace, t.deployment)
+            deploy = self.client.get(t.kind, t.namespace, t.deployment)
         except NotFoundError:
             return
         current = deploy.desired_replicas()
@@ -142,7 +143,7 @@ class HPAEmulator:
 
     def _scale(self, t: _Target, replicas: int) -> None:
         try:
-            self.client.patch_scale(Deployment.KIND, t.namespace,
+            self.client.patch_scale(t.kind, t.namespace,
                                     t.deployment, replicas)
             log.info("HPA: scaled %s/%s -> %d", t.namespace, t.deployment, replicas)
         except NotFoundError:

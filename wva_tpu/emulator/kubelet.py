@@ -17,6 +17,7 @@ from wva_tpu.constants.labels import TPU_RESOURCE_NAME
 from wva_tpu.k8s.client import KubeClient, NotFoundError
 from wva_tpu.k8s.objects import (
     Deployment,
+    LeaderWorkerSet,
     Node,
     Pod,
     PodStatus,
@@ -47,10 +48,14 @@ class FakeKubelet:
 
     def step(self) -> None:
         now = self.clock.now()
+        # Readiness first so the status refresh below sees pods that became
+        # ready by now (otherwise statuses lag one step).
+        self._mark_ready(now)
         for deploy in self.client.list(Deployment.KIND):
             self._reconcile_deployment(deploy, now)
+        for lws in self.client.list(LeaderWorkerSet.KIND):
+            self._reconcile_lws(lws, now)
         self._retry_unscheduled(now)
-        self._mark_ready(now)
 
     def _retry_unscheduled(self, now: float) -> None:
         """Re-attempt binding for pods stuck without a node — chips may have
@@ -114,6 +119,79 @@ class FakeKubelet:
                 self.client.update_status(deploy)
             except NotFoundError:
                 pass
+
+    # --- multi-host slice groups (LeaderWorkerSet) ---
+
+    GROUP_INDEX_LABEL = "leaderworkerset.sigs.k8s.io/group-index"
+
+    def _lws_groups(self, lws: LeaderWorkerSet) -> dict[int, list[Pod]]:
+        groups: dict[int, list[Pod]] = {}
+        for p in self.client.list(Pod.KIND, namespace=lws.metadata.namespace):
+            if not any(ref.get("kind") == LeaderWorkerSet.KIND
+                       and ref.get("name") == lws.metadata.name
+                       for ref in p.metadata.owner_references):
+                continue
+            idx = int(p.metadata.labels.get(self.GROUP_INDEX_LABEL, "0"))
+            groups.setdefault(idx, []).append(p)
+        return groups
+
+    def _reconcile_lws(self, lws: LeaderWorkerSet, now: float) -> None:
+        """One replica = one group of ``size`` pods that provision together;
+        downscale removes whole groups, highest index first."""
+        size = max(lws.size, 1)
+        groups = self._lws_groups(lws)
+        want = lws.desired_replicas()
+
+        if len(groups) < want:
+            next_idx = max(groups, default=-1) + 1
+            for g in range(next_idx, next_idx + (want - len(groups))):
+                for h in range(size):
+                    self._create_lws_pod(lws, g, h, now)
+        elif len(groups) > want:
+            for g in sorted(groups, reverse=True)[: len(groups) - want]:
+                for pod in groups[g]:
+                    self._release_chips(pod)
+                    self.client.delete(Pod.KIND, pod.metadata.namespace,
+                                       pod.metadata.name)
+                    self._pending.pop(pod.metadata.name, None)
+
+        groups = self._lws_groups(lws)
+        # A group is ready only when EVERY host pod is ready — one unready
+        # host keeps the whole slice replica pending.
+        ready = sum(1 for pods in groups.values()
+                    if len(pods) >= size and all(p.is_ready() for p in pods))
+        if (lws.status.replicas != len(groups)
+                or lws.status.ready_replicas != ready):
+            lws.status.replicas = len(groups)
+            lws.status.ready_replicas = ready
+            try:
+                self.client.update_status(lws)
+            except NotFoundError:
+                pass
+
+    def _create_lws_pod(self, lws: LeaderWorkerSet, group: int, host: int,
+                        now: float) -> None:
+        name = f"{lws.metadata.name}-{group}-{host}"
+        chips_needed = sum(
+            parse_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+            for c in lws.template.containers)
+        node_name = self._find_node_with_chips(chips_needed)
+        labels = dict(lws.template.labels)
+        labels[self.GROUP_INDEX_LABEL] = str(group)
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=name, namespace=lws.metadata.namespace, labels=labels,
+                owner_references=[{"kind": LeaderWorkerSet.KIND,
+                                   "name": lws.metadata.name}]),
+            spec=lws.template,
+            node_name=node_name or "",
+            status=PodStatus(phase="Pending", ready=False,
+                             pod_ip=f"10.244.{group % 250}.{host % 250 + 1}"),
+        )
+        self.client.create(pod)
+        if node_name or chips_needed == 0:
+            self._pending[name] = _PendingPod(
+                name=name, ready_at=now + self.startup_seconds)
 
     def _create_pod(self, deploy: Deployment, now: float) -> None:
         idx = self._counters.get(deploy.metadata.name, 0)
@@ -182,9 +260,24 @@ class FakeKubelet:
         return
 
     def ready_pods_of(self, namespace: str, deployment_name: str) -> list[str]:
+        """Pod names that count as serving replicas. For a Deployment: every
+        ready pod. For a LeaderWorkerSet: one entry per FULLY-ready group
+        (its leader pod, host 0) — a multi-host slice serves as one unit and
+        exposes metrics through its leader."""
         try:
             deploy = self.client.get(Deployment.KIND, namespace, deployment_name)
+            return sorted(p.metadata.name for p in self._pods_of(deploy)
+                          if p.is_ready())
+        except NotFoundError:
+            pass
+        try:
+            lws = self.client.get(LeaderWorkerSet.KIND, namespace, deployment_name)
         except NotFoundError:
             return []
-        return sorted(p.metadata.name for p in self._pods_of(deploy)
-                      if p.is_ready())
+        size = max(lws.size, 1)
+        out = []
+        for g, pods in sorted(self._lws_groups(lws).items()):
+            if len(pods) >= size and all(p.is_ready() for p in pods):
+                leader = min(pods, key=lambda p: p.metadata.name)
+                out.append(leader.metadata.name)
+        return out

@@ -62,6 +62,7 @@ from wva_tpu.pipeline import (
     ModelScalingRequest,
     ScalingOptimizer,
 )
+from wva_tpu.utils import scale_target
 from wva_tpu.utils import variant as variant_utils
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from wva_tpu.utils.variant import namespaced_key
@@ -312,7 +313,8 @@ class SaturationEngine:
             if deploy is None:
                 continue
             accelerator = variant_utils.get_accelerator_type(va)
-            chips = get_deployment_chips_per_replica(deploy)
+            chips = scale_target.chips_per_replica(
+                scale_target.scale_target_state(deploy))
             self.capacity_store.load_from_deployment(
                 namespace, model_id, va.metadata.name, accelerator, chips, deploy)
 
@@ -406,7 +408,9 @@ class SaturationEngine:
             raise ValueError(f"no VAs provided for model {model_id}")
         namespace = model_vas[0].metadata.namespace
 
-        deployments: dict[str, Deployment] = {}
+        # Targets of any scalable kind (Deployment, LeaderWorkerSet); keyed
+        # like the reference's deployments map.
+        deployments: dict[str, object] = {}
         variant_autoscalings: dict[str, VariantAutoscaling] = {}
         variant_costs: dict[str, float] = {}
         for va in model_vas:
@@ -414,13 +418,17 @@ class SaturationEngine:
             variant_autoscalings[key] = va
             variant_costs[key] = va.spec.cost()
             try:
-                deploy = variant_utils.get_deployment_with_backoff(
-                    self.client, va.spec.scale_target_ref.name, va.metadata.namespace)
+                target = scale_target.get_scale_target_with_backoff(
+                    self.client, va.spec.scale_target_ref.kind,
+                    va.spec.scale_target_ref.name, va.metadata.namespace)
             except NotFoundError:
-                log.debug("No deployment for VA %s", va.metadata.name)
+                log.debug("No scale target for VA %s", va.metadata.name)
+                continue
+            except TypeError as e:
+                log.warning("VA %s: %s", va.metadata.name, e)
                 continue
             deployments[namespaced_key(va.metadata.namespace,
-                                       deploy.metadata.name)] = deploy
+                                       target.metadata.name)] = target
 
         replica_metrics = self.collector.collect_replica_metrics(
             model_id, namespace, deployments, variant_autoscalings, variant_costs)
@@ -437,32 +445,36 @@ class SaturationEngine:
 
     def build_variant_states(
         self, vas: list[VariantAutoscaling],
-        deployments: dict[str, Deployment] | None = None,
+        deployments: dict[str, object] | None = None,
     ) -> list[VariantReplicaState]:
         """Current/desired/pending replica counts per variant
-        (reference engine.go:491-556). Pending counts pods that exist but are
-        not Ready — slice provisioning + model load take minutes on TPU."""
+        (reference engine.go:491-556). Pending counts replicas that exist but
+        are not fully Ready — slice provisioning + model load take minutes on
+        TPU, and for a multi-host slice one unready host keeps the whole
+        replica pending (the scale-target adapter owns that math)."""
         states = []
         for va in vas:
             key = namespaced_key(va.metadata.namespace, va.spec.scale_target_ref.name)
-            deploy = (deployments or {}).get(key)
-            if deploy is None:
+            target = (deployments or {}).get(key)
+            if target is None:
                 try:
-                    deploy = variant_utils.get_deployment_with_backoff(
-                        self.client, va.spec.scale_target_ref.name,
-                        va.metadata.namespace)
-                except NotFoundError:
-                    log.debug("Could not get deployment for VA %s", va.metadata.name)
+                    target = scale_target.get_scale_target_with_backoff(
+                        self.client, va.spec.scale_target_ref.kind,
+                        va.spec.scale_target_ref.name, va.metadata.namespace)
+                except (NotFoundError, TypeError):
+                    log.debug("Could not get scale target for VA %s",
+                              va.metadata.name)
                     continue
-            current = deploy.status.replicas or deploy.desired_replicas()
-            pending = max(current - deploy.status.ready_replicas, 0)
+            st = scale_target.scale_target_state(target)
+            current = st.status_replicas or st.desired_replicas
             states.append(VariantReplicaState(
                 variant_name=va.metadata.name,
                 accelerator_name=variant_utils.get_accelerator_type(va),
                 current_replicas=current,
                 desired_replicas=va.status.desired_optimized_alloc.num_replicas,
-                pending_replicas=pending,
-                chips_per_replica=get_deployment_chips_per_replica(deploy),
+                pending_replicas=max(current - st.ready_replicas, 0),
+                chips_per_replica=scale_target.chips_per_replica(st),
+                hosts_per_slice=st.hosts_per_replica,
             ))
         return states
 
@@ -545,12 +557,13 @@ class SaturationEngine:
                 target_replicas = update_va.status.desired_optimized_alloc.num_replicas
                 if target_replicas <= 0:
                     try:
-                        deploy = self.client.get(
-                            Deployment.KIND, update_va.metadata.namespace,
-                            update_va.spec.scale_target_ref.name)
-                        target_replicas = deploy.status.replicas or \
-                            deploy.desired_replicas()
-                    except NotFoundError:
+                        tgt = scale_target.scale_target_state(self.client.get(
+                            update_va.spec.scale_target_ref.kind,
+                            update_va.metadata.namespace,
+                            update_va.spec.scale_target_ref.name))
+                        target_replicas = tgt.status_replicas or \
+                            tgt.desired_replicas
+                    except (NotFoundError, TypeError):
                         target_replicas = 0
                 accelerator = update_va.status.desired_optimized_alloc.accelerator
                 reason = "No scaling decision (optimization loop)"
@@ -626,11 +639,13 @@ class SaturationEngine:
         for va in model_vas:
             current = 0
             try:
-                deploy = self.client.get(Deployment.KIND, va.metadata.namespace,
-                                         va.spec.scale_target_ref.name)
-                current = deploy.status.replicas or deploy.desired_replicas()
-            except NotFoundError:
-                log.debug("Safety net: deployment missing for %s", va.metadata.name)
+                tgt = scale_target.scale_target_state(self.client.get(
+                    va.spec.scale_target_ref.kind, va.metadata.namespace,
+                    va.spec.scale_target_ref.name))
+                current = tgt.status_replicas or tgt.desired_replicas
+            except (NotFoundError, TypeError):
+                log.debug("Safety net: scale target missing for %s",
+                          va.metadata.name)
 
             if va.status.desired_optimized_alloc.num_replicas > 0:
                 desired = va.status.desired_optimized_alloc.num_replicas
@@ -649,15 +664,3 @@ class SaturationEngine:
             log.info("Safety net: emitted fallback metrics for %s "
                      "(current=%d desired=%d)", va.metadata.name, current, desired)
 
-
-def get_deployment_chips_per_replica(deploy: Deployment | None) -> int:
-    """TPU chips one replica consumes, from pod-template ``google.com/tpu``
-    requests (reference getDeploymentGPUsPerReplica, engine.go:563-584).
-    Defaults to 1 when unset."""
-    if deploy is None:
-        return 1
-    total = sum(
-        parse_quantity(container.resources.requests.get(TPU_RESOURCE_NAME, "0"))
-        for container in deploy.template.containers
-    )
-    return total if total > 0 else 1

@@ -18,7 +18,6 @@ _VARIANT_EXPORTS = {
     "active_variant_autoscalings",
     "get_accelerator_type",
     "get_controller_instance",
-    "get_deployment_with_backoff",
     "get_va_with_backoff",
     "group_variant_autoscalings_by_model",
     "inactive_variant_autoscalings",

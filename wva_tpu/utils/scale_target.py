@@ -1,0 +1,116 @@
+"""Scale-target adapter layer: one surface over every kind a
+VariantAutoscaling may point at.
+
+The reference assumes pod == replica (Deployment semantics baked into
+``BuildVariantStates``, engine.go:491-556) and notes multi-host targets as
+future work. Here the adapter makes the difference explicit: a Deployment
+replica is one pod; a LeaderWorkerSet replica is a group of
+``hosts_per_replica`` pods that become ready together — so "ready replicas"
+counts fully-ready groups and chips-per-replica multiplies by hosts
+(SURVEY.md section 7 "hard parts" #2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from wva_tpu.constants import TPU_RESOURCE_NAME
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.objects import (
+    Deployment,
+    LeaderWorkerSet,
+    PodTemplateSpec,
+    parse_quantity,
+)
+from wva_tpu.utils.backoff import retry_with_backoff
+
+# Kinds a VA's scaleTargetRef may name (all expose a scale subresource).
+SCALABLE_KINDS = {
+    Deployment.KIND: Deployment,
+    LeaderWorkerSet.KIND: LeaderWorkerSet,
+}
+
+
+@dataclass
+class ScaleTargetState:
+    """Kind-independent view of a scale target."""
+
+    kind: str = Deployment.KIND
+    name: str = ""
+    namespace: str = ""
+    desired_replicas: int = 0  # spec-level replica (group) count
+    status_replicas: int = 0  # replicas (groups) that exist
+    ready_replicas: int = 0  # fully-ready replicas (every pod of the group)
+    hosts_per_replica: int = 1  # pods per replica (1 = single-host)
+    template: PodTemplateSpec | None = None
+    selector: dict[str, str] | None = None
+    deleted: bool = False
+
+    @property
+    def pending_replicas(self) -> int:
+        """Replicas that exist but are not fully ready — for a multi-host
+        group, ONE unready host keeps the whole replica pending (the slice
+        cannot serve until every host is up)."""
+        return max(self.status_replicas - self.ready_replicas, 0)
+
+
+def get_scale_target_with_backoff(
+    client: KubeClient, kind: str, name: str, namespace: str,
+):
+    """Fetch a scale target of any supported kind (reference
+    GetDeploymentWithBackoff generalized; unknown kinds raise TypeError so a
+    bad scaleTargetRef surfaces as a condition, not a silent skip)."""
+    if kind not in SCALABLE_KINDS:
+        raise TypeError(f"unsupported scale target kind {kind!r} "
+                        f"(supported: {sorted(SCALABLE_KINDS)})")
+    return retry_with_backoff(
+        lambda: client.get(kind, namespace, name),
+        retriable=lambda e: not isinstance(e, NotFoundError),
+        description=f"get {kind} {namespace}/{name}",
+    )
+
+
+def scale_target_state(obj) -> ScaleTargetState:
+    """Project any supported target object to the adapter view."""
+    if isinstance(obj, LeaderWorkerSet):
+        return ScaleTargetState(
+            kind=LeaderWorkerSet.KIND,
+            name=obj.metadata.name,
+            namespace=obj.metadata.namespace,
+            desired_replicas=obj.desired_replicas(),
+            status_replicas=obj.status.replicas,
+            ready_replicas=obj.status.ready_replicas,
+            hosts_per_replica=max(obj.size, 1),
+            template=obj.template,
+            selector=obj.selector,
+            deleted=obj.metadata.deletion_timestamp is not None,
+        )
+    if isinstance(obj, Deployment):
+        return ScaleTargetState(
+            kind=Deployment.KIND,
+            name=obj.metadata.name,
+            namespace=obj.metadata.namespace,
+            desired_replicas=obj.desired_replicas(),
+            status_replicas=obj.status.replicas,
+            ready_replicas=obj.status.ready_replicas,
+            hosts_per_replica=1,
+            template=obj.template,
+            selector=obj.selector,
+            deleted=obj.metadata.deletion_timestamp is not None,
+        )
+    raise TypeError(f"not a scalable kind: {type(obj).__name__}")
+
+
+def chips_per_replica(state: ScaleTargetState) -> int:
+    """TPU chips one replica consumes: per-host ``google.com/tpu`` requests
+    x hosts per replica (reference getDeploymentGPUsPerReplica,
+    engine.go:563-584, extended with the multi-host factor). Defaults to 1
+    when unset."""
+    if state.template is None:
+        return 1
+    per_host = sum(
+        parse_quantity(c.resources.requests.get(TPU_RESOURCE_NAME, "0"))
+        for c in state.template.containers
+    )
+    total = per_host * state.hosts_per_replica
+    return total if total > 0 else 1

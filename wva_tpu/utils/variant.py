@@ -10,7 +10,7 @@ import os
 from wva_tpu.api.v1alpha1 import VariantAutoscaling
 from wva_tpu.constants import ACCELERATOR_NAME_LABEL_KEY, CONTROLLER_INSTANCE_LABEL_KEY
 from wva_tpu.k8s.client import KubeClient, NotFoundError
-from wva_tpu.k8s.objects import Deployment
+from wva_tpu.utils import scale_target
 from wva_tpu.utils.backoff import retry_with_backoff
 
 log = logging.getLogger(__name__)
@@ -20,14 +20,6 @@ def get_controller_instance() -> str:
     """Multi-controller isolation id (reference internal/metrics controller
     instance; configured via CONTROLLER_INSTANCE env)."""
     return os.environ.get("CONTROLLER_INSTANCE", "")
-
-
-def get_deployment_with_backoff(client: KubeClient, name: str, namespace: str) -> Deployment:
-    return retry_with_backoff(
-        lambda: client.get(Deployment.KIND, namespace, name),
-        retriable=lambda e: not isinstance(e, NotFoundError),
-        description=f"get deployment {namespace}/{name}",
-    )
 
 
 def get_va_with_backoff(client: KubeClient, name: str, namespace: str) -> VariantAutoscaling:
@@ -57,37 +49,41 @@ def ready_variant_autoscalings(client: KubeClient) -> list[VariantAutoscaling]:
     return [va for va in vas if va.metadata.deletion_timestamp is None]
 
 
-def _filter_by_deployment(client: KubeClient, want_active: bool) -> list[VariantAutoscaling]:
+def _filter_by_target(client: KubeClient, want_active: bool) -> list[VariantAutoscaling]:
     out = []
     for va in ready_variant_autoscalings(client):
-        if not va.spec.scale_target_ref.name:
+        ref = va.spec.scale_target_ref
+        if not ref.name:
             log.debug("Skipping VA %s/%s without scaleTargetRef",
                       va.metadata.namespace, va.metadata.name)
             continue
         try:
-            deploy = get_deployment_with_backoff(
-                client, va.spec.scale_target_ref.name, va.metadata.namespace)
+            target = scale_target.get_scale_target_with_backoff(
+                client, ref.kind, ref.name, va.metadata.namespace)
         except NotFoundError:
-            log.debug("Deployment %s for VA %s/%s not found",
-                      va.spec.scale_target_ref.name, va.metadata.namespace,
-                      va.metadata.name)
+            log.debug("%s %s for VA %s/%s not found", ref.kind, ref.name,
+                      va.metadata.namespace, va.metadata.name)
             continue
-        if deploy.metadata.deletion_timestamp is not None:
+        except TypeError as e:
+            log.warning("VA %s/%s: %s", va.metadata.namespace,
+                        va.metadata.name, e)
             continue
-        active = deploy.desired_replicas() > 0
-        if active == want_active:
+        state = scale_target.scale_target_state(target)
+        if state.deleted:
+            continue
+        if (state.desired_replicas > 0) == want_active:
             out.append(va)
     return out
 
 
 def active_variant_autoscalings(client: KubeClient) -> list[VariantAutoscaling]:
     """VAs whose target has >= 1 desired replica."""
-    return _filter_by_deployment(client, want_active=True)
+    return _filter_by_target(client, want_active=True)
 
 
 def inactive_variant_autoscalings(client: KubeClient) -> list[VariantAutoscaling]:
     """VAs whose target is scaled to zero."""
-    return _filter_by_deployment(client, want_active=False)
+    return _filter_by_target(client, want_active=False)
 
 
 def group_variant_autoscalings_by_model(
