@@ -1,0 +1,177 @@
+"""Leader election + Event recorder tests (reference cmd/main.go:257-287:
+lease 60s / renew 50s / retry 10s, ReleaseOnCancel fast failover)."""
+
+from wva_tpu.api.v1alpha1 import ObjectMeta
+from wva_tpu.k8s import FakeCluster
+from wva_tpu.k8s.events import EventRecorder
+from wva_tpu.k8s.objects import ConfigMap, Event
+from wva_tpu.leaderelection import LeaderElector, LeaderElectorConfig
+from wva_tpu.utils.clock import FakeClock
+
+
+def make_pair():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    cfg = LeaderElectorConfig()
+    a = LeaderElector(cluster, "pod-a", cfg, clock=clock)
+    b = LeaderElector(cluster, "pod-b", cfg, clock=clock)
+    return clock, cluster, a, b
+
+
+class TestLeaderElector:
+    def test_first_candidate_acquires(self):
+        clock, cluster, a, b = make_pair()
+        assert a.tick() is True
+        assert a.is_leader()
+        assert b.tick() is False
+        assert not b.is_leader()
+
+    def test_renewal_keeps_leadership(self):
+        clock, cluster, a, b = make_pair()
+        a.tick()
+        for _ in range(20):
+            clock.advance(10)
+            assert a.tick() is True
+            assert b.tick() is False
+        assert a.is_leader() and not b.is_leader()
+
+    def test_failover_after_lease_expiry(self):
+        clock, cluster, a, b = make_pair()
+        a.tick()
+        # a dies (stops ticking); b takes over only after the lease expires.
+        clock.advance(30)
+        assert b.tick() is False
+        clock.advance(31)  # > 60s since a's last renewal
+        assert b.tick() is True
+        assert b.is_leader()
+        # a comes back: must observe b's lease, not reclaim.
+        assert a.tick() is False
+        assert not a.is_leader()
+
+    def test_release_on_cancel_fast_failover(self):
+        clock, cluster, a, b = make_pair()
+        a.tick()
+        a.release()  # voluntary step-down
+        assert not a.is_leader()
+        clock.advance(1)  # ~1s, far below the 60s lease
+        assert b.tick() is True
+
+    def test_renew_deadline_self_demotion(self):
+        clock, cluster, a, b = make_pair()
+        a.tick()
+        # a cannot reach the API server (no ticks); after renew_deadline it
+        # must stop acting as leader even though the lease still names it.
+        clock.advance(51)
+        assert not a.is_leader()
+
+    def test_lease_transitions_counted(self):
+        clock, cluster, a, b = make_pair()
+        a.tick()
+        clock.advance(61)
+        b.tick()
+        lease = cluster.get("Lease", a.config.namespace, a.config.lease_name)
+        assert lease.lease_transitions == 1
+        assert lease.holder_identity == "pod-b"
+
+    def test_callbacks_fire_on_transitions(self):
+        clock, cluster, a, b = make_pair()
+        started, stopped = [], []
+        a.on_started_leading = lambda: started.append(1)
+        a.on_stopped_leading = lambda: stopped.append(1)
+        a.tick()
+        assert started == [1]
+        a.release()
+        assert stopped == [1]
+
+    def test_callbacks_may_reenter_elector(self):
+        # Regression: callbacks run outside the lock, so calling back into
+        # the elector (e.g. logging is_leader()) must not deadlock.
+        clock, cluster, a, b = make_pair()
+        seen = []
+        a.on_started_leading = lambda: seen.append(a.is_leader())
+        a.on_stopped_leading = lambda: seen.append(a.is_leader())
+        a.tick()
+        a.release()
+        assert seen == [True, False]
+
+    def test_demoted_leader_does_not_actuate_mid_retry(self):
+        # Executor gate is re-checked inside the retry loop: a task that
+        # keeps failing stops retrying once leadership is lost.
+        from wva_tpu.engines.executor import PollingExecutor
+        clock, cluster, a, b = make_pair()
+        a.tick()
+        calls = []
+
+        def failing_task():
+            calls.append(clock.now())
+            clock.advance(60)  # renew deadline passes inside the retry
+            raise RuntimeError("api down")
+
+        ex = PollingExecutor(failing_task, 30.0, clock=clock,
+                             gate=a.is_leader)
+        ex.tick()  # must terminate: gate goes False after first failure
+        assert len(calls) == 1
+
+
+class TestManagerGating:
+    def test_engines_skip_ticks_when_not_leader(self):
+        import sys
+        sys.path.insert(0, "tests")
+        from test_engine_integration import make_world, get_va
+
+        mgr, cluster, tsdb, clock = make_world(kv=0.85, queue=8)
+        mgr.elector = LeaderElector(cluster, "me",
+                                    LeaderElectorConfig(), clock=clock)
+        mgr.engine.executor.gate = mgr.elector.is_leader
+        # Competitor holds the lease: no engine tick, no decision.
+        other = LeaderElector(cluster, "other", LeaderElectorConfig(),
+                              clock=clock)
+        other.tick()
+        mgr.run_once()
+        va = get_va(cluster)
+        assert va.status.desired_optimized_alloc.num_replicas == 0
+        # Competitor releases; we acquire on the next election cycle
+        # (run_once throttles lease traffic to the retry period).
+        other.release()
+        clock.advance(mgr.elector.config.retry_period)
+        mgr.run_once()
+        va = get_va(cluster)
+        assert va.status.desired_optimized_alloc.num_replicas >= 2
+
+
+class TestEventRecorder:
+    def test_records_and_deduplicates(self):
+        clock = FakeClock(start=50.0)
+        cluster = FakeCluster(clock=clock)
+        cm = ConfigMap(metadata=ObjectMeta(name="cfg", namespace="ns"))
+        cluster.create(cm)
+        rec = EventRecorder(cluster, clock=clock)
+        rec.warning(cm, "BadConfig", "field x is invalid")
+        rec.warning(cm, "BadConfig", "field x is invalid")
+        events = cluster.list(Event.KIND, namespace="ns")
+        assert len(events) == 1
+        assert events[0].count == 2
+        assert events[0].type == "Warning"
+        # Different message -> same aggregation key updates message? No:
+        # message change creates a fresh series under the same name.
+        rec.warning(cm, "BadConfig", "field y is invalid")
+        events = cluster.list(Event.KIND, namespace="ns")
+        assert len(events) == 1 and events[0].message == "field y is invalid"
+
+    def test_configmap_rejection_emits_event(self):
+        from wva_tpu.config import new_test_config
+        from wva_tpu.config.helpers import system_namespace
+        from wva_tpu.config.slo import SLO_CONFIGMAP_DATA_KEY, SLO_CONFIGMAP_NAME
+        from wva_tpu.controller.configmap_reconciler import ConfigMapReconciler
+
+        cluster = FakeCluster()
+        cfg = new_test_config()
+        rec = ConfigMapReconciler(cluster, cfg, datastore=None,
+                                  recorder=EventRecorder(cluster))
+        bad = ConfigMap(
+            metadata=ObjectMeta(name=SLO_CONFIGMAP_NAME,
+                                namespace=system_namespace()),
+            data={SLO_CONFIGMAP_DATA_KEY: "profiles: [{model: m}]"})
+        rec.reconcile(bad)
+        events = cluster.list(Event.KIND, namespace=system_namespace())
+        assert any(e.reason == "InvalidSLOConfig" for e in events)

@@ -37,10 +37,11 @@ log = logging.getLogger(__name__)
 
 class ConfigMapReconciler:
     def __init__(self, client: KubeClient, config: Config,
-                 datastore: Datastore) -> None:
+                 datastore: Datastore, recorder=None) -> None:
         self.client = client
         self.config = config
         self.datastore = datastore
+        self.recorder = recorder  # k8s.events.EventRecorder | None
 
     def setup(self) -> None:
         self.client.watch(ConfigMap.KIND, self._on_event)
@@ -74,6 +75,8 @@ class ConfigMapReconciler:
         except ImmutableParameterError as e:
             self.config.record_configmaps_sync_error(str(e))
             log.error("Rejected ConfigMap %s/%s: %s", ns, cm.metadata.name, e)
+            if self.recorder is not None:
+                self.recorder.warning(cm, "ImmutableParameterChange", str(e))
 
     def _handle_saturation(self, cm: ConfigMap, scope_ns: str) -> None:
         detect_immutable_parameter_changes(self.config, cm.data)
@@ -100,6 +103,8 @@ class ConfigMapReconciler:
             self.config.record_configmaps_sync_error(str(e))
             log.error("Rejected SLO ConfigMap %s/%s: %s",
                       cm.metadata.namespace, cm.metadata.name, e)
+            if self.recorder is not None:
+                self.recorder.warning(cm, "InvalidSLOConfig", str(e))
             return
         self.config.update_slo_config_for_namespace(scope_ns, parsed)
         n_classes = len(parsed.service_classes) if parsed else 0

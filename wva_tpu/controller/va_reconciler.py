@@ -37,11 +37,13 @@ log = logging.getLogger(__name__)
 
 class VariantAutoscalingReconciler:
     def __init__(self, client: KubeClient, datastore: Datastore,
-                 indexer: Indexer, clock: Clock | None = None) -> None:
+                 indexer: Indexer, clock: Clock | None = None,
+                 recorder=None) -> None:
         self.client = client
         self.datastore = datastore
         self.indexer = indexer
         self.clock = clock or SYSTEM_CLOCK
+        self.recorder = recorder  # k8s.events.EventRecorder | None
 
     # --- wiring (reference SetupWithManager :291-319) ---
 
@@ -129,6 +131,11 @@ class VariantAutoscalingReconciler:
             va.set_condition(TYPE_TARGET_RESOLVED, "False", REASON_TARGET_NOT_FOUND,
                              f"Scale target {va.spec.scale_target_ref.name} not found",
                              now=now)
+            if self.recorder is not None:
+                self.recorder.warning(
+                    va, REASON_TARGET_NOT_FOUND,
+                    f"Scale target {va.spec.scale_target_ref.kind} "
+                    f"{va.spec.scale_target_ref.name} not found")
             update_va_status_with_backoff(self.client, va)
             return
 

@@ -31,7 +31,8 @@ class Executor(abc.ABC):
 class PollingExecutor(Executor):
     def __init__(self, task: Callable[[], None], interval: float,
                  clock: Clock | None = None, name: str = "engine",
-                 max_retries_per_tick: int | None = None) -> None:
+                 max_retries_per_tick: int | None = None,
+                 gate: Callable[[], bool] | None = None) -> None:
         self.task = task
         self.interval = interval
         self.clock = clock or SYSTEM_CLOCK
@@ -39,13 +40,23 @@ class PollingExecutor(Executor):
         # None = retry forever within the tick (reference behavior); bounded
         # values are for simulation.
         self.max_retries_per_tick = max_retries_per_tick
+        # Leader gate: when set and False, ticks are skipped (the reference
+        # achieves this by registering engines as leader-gated Runnables).
+        self.gate = gate
 
     def tick(self, stop: threading.Event | None = None) -> None:
         """Execute the task once, retrying with backoff on failure."""
+        if self.gate is not None and not self.gate():
+            return
         delay = RETRY_INITIAL_SECONDS
         attempt = 0
         while True:
             if stop is not None and stop.is_set():
+                return
+            # Re-check the leader gate inside the retry loop: a replica that
+            # lost leadership mid-retry must not actuate when its API
+            # connectivity returns (split-brain prevention).
+            if self.gate is not None and not self.gate():
                 return
             try:
                 self.task()

@@ -1,0 +1,83 @@
+"""Event recorder (the reference uses controller-runtime's EventRecorder;
+events surface operational state transitions to ``kubectl describe``).
+
+Deduplicates like the API server's event aggregation: a repeat of the same
+(object, reason, message) within the dedup window bumps ``count`` and
+``lastTimestamp`` instead of creating a new Event object.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Event, ObjectMeta
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+
+class EventRecorder:
+    def __init__(self, client: KubeClient, component: str = "wva-tpu",
+                 clock: Clock | None = None) -> None:
+        self.client = client
+        self.component = component
+        self.clock = clock or SYSTEM_CLOCK
+
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        """Record an event against ``obj`` (anything with KIND + metadata).
+        Failures are logged, never raised — event emission must not break
+        reconciliation."""
+        try:
+            self._record(obj, event_type, reason, message)
+        except Exception as e:  # noqa: BLE001
+            log.debug("event emission failed for %s/%s: %s",
+                      obj.metadata.namespace, obj.metadata.name, e)
+
+    def normal(self, obj, reason: str, message: str) -> None:
+        self.event(obj, TYPE_NORMAL, reason, message)
+
+    def warning(self, obj, reason: str, message: str) -> None:
+        self.event(obj, TYPE_WARNING, reason, message)
+
+    def _record(self, obj, event_type: str, reason: str, message: str) -> None:
+        now = self.clock.now()
+        kind = getattr(obj, "KIND", getattr(obj, "kind", ""))
+        name = f"{obj.metadata.name}.{self.component}.{reason.lower()}"
+        namespace = obj.metadata.namespace
+        try:
+            existing: Event | None = self.client.try_get(Event.KIND, namespace, name)
+        except NotFoundError:
+            existing = None
+        if existing is not None:
+            if existing.message == message and existing.type == event_type:
+                existing.count += 1
+                existing.last_timestamp = now
+            else:
+                # Same aggregation key, new content: restart the series.
+                existing.type = event_type
+                existing.message = message
+                existing.count = 1
+                existing.first_timestamp = now
+                existing.last_timestamp = now
+            try:
+                self.client.update(existing)
+                return
+            except (ConflictError, NotFoundError):
+                pass  # raced; fall through to create-or-overwrite
+        fresh = Event(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            involved_kind=kind, involved_name=obj.metadata.name,
+            involved_namespace=namespace,
+            type=event_type, reason=reason, message=message,
+            count=1, first_timestamp=now, last_timestamp=now)
+        try:
+            self.client.create(fresh)
+        except ConflictError:
+            cur = self.client.try_get(Event.KIND, namespace, name)
+            if cur is not None:
+                fresh.metadata.resource_version = cur.metadata.resource_version
+                self.client.update(fresh)

@@ -68,6 +68,41 @@ class Deployment:
 
 
 @dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease for leader election."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: int = 60
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+    KIND = "Lease"
+    API_VERSION = "coordination.k8s.io/v1"
+
+
+@dataclass
+class Event:
+    """core/v1 Event (the recorder surface the reference gets from
+    controller-runtime's EventRecorder)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = ""
+    type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+
+    KIND = "Event"
+    API_VERSION = "v1"
+
+
+@dataclass
 class LeaderWorkerSetStatus:
     """Group-level status: a "replica" is a whole leader+workers group."""
 

@@ -1,0 +1,158 @@
+"""Lease-based leader election gating the engines
+(reference ``cmd/main.go:257-287``: LeaseDuration 60s / RenewDeadline 50s /
+RetryPeriod 10s, LeaderElectionReleaseOnCancel=true for ~1-2s voluntary
+failover instead of a full lease timeout).
+
+Implements the coordination.k8s.io Lease acquire/renew protocol directly on
+the KubeClient abstraction (the reference delegates to controller-runtime's
+leaderelection package): a candidate acquires the lease when it is absent,
+expired, or already its own; renews on every tick; and steps down by clearing
+the holder on release. Conflict-safe through the client's optimistic
+concurrency (ConflictError on stale resourceVersion => another candidate won
+the race; re-observe next tick).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+from wva_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Lease, ObjectMeta
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+DEFAULT_LEASE_DURATION = 60.0
+DEFAULT_RENEW_DEADLINE = 50.0
+DEFAULT_RETRY_PERIOD = 10.0
+
+
+@dataclass
+class LeaderElectorConfig:
+    lease_name: str = "72dd1cf1.wva.tpu.llmd.ai"
+    namespace: str = "workload-variant-autoscaler-system"
+    lease_duration: float = DEFAULT_LEASE_DURATION
+    renew_deadline: float = DEFAULT_RENEW_DEADLINE
+    retry_period: float = DEFAULT_RETRY_PERIOD
+    release_on_exit: bool = True  # LeaderElectionReleaseOnCancel
+
+
+class LeaderElector:
+    """Tick-driven elector: call :meth:`tick` every retry_period (the manager
+    loop owns scheduling so fake-clock tests stay deterministic)."""
+
+    def __init__(self, client: KubeClient, identity: str,
+                 config: LeaderElectorConfig | None = None,
+                 clock: Clock | None = None) -> None:
+        self.client = client
+        self.identity = identity
+        self.config = config or LeaderElectorConfig()
+        self.clock = clock or SYSTEM_CLOCK
+        self._mu = threading.Lock()
+        self._leader = False
+        self._renewed_at = -1e18
+        self.on_started_leading = None  # optional callbacks
+        self.on_stopped_leading = None
+
+    def is_leader(self) -> bool:
+        """Leadership with renew-deadline self-demotion: if this process has
+        not managed to renew within renew_deadline it must stop acting as
+        leader even before another candidate takes the lease."""
+        with self._mu:
+            if not self._leader:
+                return False
+            if self.clock.now() - self._renewed_at > self.config.renew_deadline:
+                cb = self._set_leader(False)
+            else:
+                return True
+        self._fire(cb)
+        return False
+
+    def tick(self) -> bool:
+        """One acquire-or-renew attempt; returns leadership after the step."""
+        cfg = self.config
+        now = self.clock.now()
+        try:
+            lease = self.client.try_get(Lease.KIND, cfg.namespace, cfg.lease_name)
+            if lease is None:
+                self.client.create(Lease(
+                    metadata=ObjectMeta(name=cfg.lease_name,
+                                        namespace=cfg.namespace),
+                    holder_identity=self.identity,
+                    lease_duration_seconds=int(cfg.lease_duration),
+                    acquire_time=now, renew_time=now, lease_transitions=0))
+                self._became_leader(now, "acquired (new lease)")
+                return True
+
+            expired = now - lease.renew_time > cfg.lease_duration
+            if lease.holder_identity == self.identity:
+                lease.renew_time = now
+                self.client.update(lease)
+                with self._mu:
+                    self._renewed_at = now
+                    cb = self._set_leader(True)
+                self._fire(cb)
+                return True
+            if not lease.holder_identity or expired:
+                lease.holder_identity = self.identity
+                lease.acquire_time = now
+                lease.renew_time = now
+                lease.lease_transitions += 1
+                self.client.update(lease)
+                self._became_leader(now, "acquired (expired lease)")
+                return True
+        except ConflictError:
+            log.debug("Lease race lost by %s; retrying next period", self.identity)
+        except NotFoundError:
+            pass
+        with self._mu:
+            cb = self._set_leader(False)
+        self._fire(cb)
+        return False
+
+    def release(self) -> None:
+        """Voluntary step-down (ReleaseOnCancel): clears the holder so the
+        next candidate acquires in ~one retry period instead of waiting out
+        the lease (reference cmd/main.go:277-286)."""
+        if not self.config.release_on_exit:
+            return
+        try:
+            lease = self.client.try_get(
+                Lease.KIND, self.config.namespace, self.config.lease_name)
+            if lease is not None and lease.holder_identity == self.identity:
+                lease.holder_identity = ""
+                self.client.update(lease)
+        except (ConflictError, NotFoundError):
+            pass
+        with self._mu:
+            cb = self._set_leader(False)
+        self._fire(cb)
+
+    # -- internals --
+
+    def _became_leader(self, now: float, how: str) -> None:
+        with self._mu:
+            self._renewed_at = now
+            cb = self._set_leader(True)
+        self._fire(cb)
+        log.info("Leader election: %s %s", self.identity, how)
+
+    def _set_leader(self, value: bool):
+        """State flip under the lock; returns the transition callback to run
+        AFTER the lock is released (callbacks may call back into the elector,
+        and _mu is not reentrant)."""
+        changed = self._leader != value
+        self._leader = value
+        if not changed:
+            return None
+        return self.on_started_leading if value else self.on_stopped_leading
+
+    def _fire(self, cb) -> None:
+        if cb is None:
+            return
+        try:
+            cb()
+        except Exception:  # noqa: BLE001 — callbacks never break election
+            log.exception("leader-election callback failed")

@@ -11,6 +11,7 @@ single-threaded simulated ticks (the emulation harness and bench drive
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from dataclasses import dataclass
 
@@ -46,6 +47,8 @@ from wva_tpu.engines.saturation import SaturationEngine
 from wva_tpu.engines.scalefromzero import ScaleFromZeroEngine
 from wva_tpu.indexers import Indexer
 from wva_tpu.k8s.client import KubeClient
+from wva_tpu.k8s.events import EventRecorder
+from wva_tpu.leaderelection import LeaderElector, LeaderElectorConfig
 from wva_tpu.metrics import MetricsRegistry
 from wva_tpu.pipeline import (
     DefaultLimiter,
@@ -76,8 +79,13 @@ class Manager:
     configmap_reconciler: ConfigMapReconciler
     pool_reconciler: InferencePoolReconciler
     capacity_store: CapacityKnowledgeStore
+    # Leader election (None = disabled -> always act as leader). Engines are
+    # leader-gated; reconcilers and watches run on every replica (reference
+    # cmd/main.go:378-425 leader-gated Runnables).
+    elector: "LeaderElector | None" = None
 
     _threads: list[threading.Thread] = None
+    _last_election_tick: float = -1e18
 
     # --- health endpoints (reference cmd/main.go:482-498) ---
 
@@ -107,19 +115,57 @@ class Manager:
             threading.Thread(target=self.va_reconciler.run_trigger_loop, args=(stop,),
                              name="va-trigger-loop", daemon=True),
         ]
+        if self.elector is not None:
+            def election_loop():
+                while not stop.is_set():
+                    try:
+                        self.elector.tick()
+                    except Exception:  # noqa: BLE001 — election must outlive
+                        # transient client errors; a dead election thread
+                        # would silently demote this replica forever.
+                        logging.getLogger(__name__).exception(
+                            "leader-election tick failed; retrying")
+                    stop.wait(self.elector.config.retry_period)
+                self.elector.release()
+            self._threads.append(threading.Thread(
+                target=election_loop, name="leader-election", daemon=True))
         for t in self._threads:
             t.start()
+
+    def is_leader(self) -> bool:
+        return self.elector is None or self.elector.is_leader()
+
+    def election_tick(self) -> bool:
+        """One leader-election acquire/renew step, throttled to the elector's
+        retry_period so a fast simulation cadence doesn't multiply lease
+        traffic (no-op when disabled)."""
+        if self.elector is None:
+            return True
+        now = self.clock.now()
+        if now - self._last_election_tick < self.elector.config.retry_period \
+                and self._last_election_tick > -1e17:
+            return self.elector.is_leader()
+        self._last_election_tick = now
+        return self.elector.tick()
 
     def run_once(self) -> None:
         """Simulation mode: one saturation tick + one scale-from-zero tick +
         drain reconcile triggers (single-threaded, deterministic)."""
-        self.engine.executor.tick()
-        self.scale_from_zero.executor.tick()
+        self.election_tick()
+        if self.is_leader():
+            self.engine.executor.tick()
+            self.scale_from_zero.executor.tick()
         self.va_reconciler.drain_triggers()
 
     def scale_from_zero_tick(self) -> None:
-        self.scale_from_zero.executor.tick()
+        if self.is_leader():
+            self.scale_from_zero.executor.tick()
         self.va_reconciler.drain_triggers()
+
+    def shutdown(self) -> None:
+        """Voluntary leader step-down on exit (ReleaseOnCancel semantics)."""
+        if self.elector is not None:
+            self.elector.release()
 
 
 def build_manager(
@@ -192,10 +238,22 @@ def build_manager(
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,
                                           direct_actuator, clock=clock)
 
+    recorder = EventRecorder(client, clock=clock)
     va_reconciler = VariantAutoscalingReconciler(client, datastore, indexer,
-                                                 clock=clock)
-    configmap_reconciler = ConfigMapReconciler(client, config, datastore)
+                                                 clock=clock, recorder=recorder)
+    configmap_reconciler = ConfigMapReconciler(client, config, datastore,
+                                               recorder=recorder)
     pool_reconciler = InferencePoolReconciler(client, datastore)
+
+    elector = None
+    if config.leader_election_enabled():
+        elector = LeaderElector(
+            client, identity=f"{os.uname().nodename}-{os.getpid()}",
+            config=LeaderElectorConfig(lease_name=config.leader_election_id()),
+            clock=clock)
+        # Engines only act while leading (reference cmd/main.go:378-425).
+        engine.executor.gate = elector.is_leader
+        scale_from_zero.executor.gate = elector.is_leader
 
     return Manager(
         client=client, config=config, clock=clock, registry=registry,
@@ -203,4 +261,5 @@ def build_manager(
         engine=engine, scale_from_zero=scale_from_zero,
         va_reconciler=va_reconciler, configmap_reconciler=configmap_reconciler,
         pool_reconciler=pool_reconciler, capacity_store=capacity_store,
+        elector=elector,
     )
