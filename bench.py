@@ -50,12 +50,19 @@ def run_policy(name: str) -> dict:
         hpa = HPAParams()  # chart defaults: 240s stabilization
         engine_interval = 30.0
     else:
-        sat_cfg = SaturationScalingConfig(analyzer_name="saturation")
+        sat_cfg = SaturationScalingConfig(
+            analyzer_name="saturation",
+            # Size scale-up for the demand that will exist when a new slice
+            # becomes ready (slice provisioning + model load).
+            anticipation_horizon_seconds=STARTUP_SECONDS,
+            # Clamp desired to whole-slice inventory so unplaceable replicas
+            # never sit pending.
+            enable_limiter=True)
         sat_cfg.apply_defaults()
-        hpa = HPAParams(stabilization_up_seconds=30.0,
+        hpa = HPAParams(stabilization_up_seconds=10.0,
                         stabilization_down_seconds=120.0,
-                        sync_period_seconds=15.0)
-        engine_interval = 15.0
+                        sync_period_seconds=10.0)
+        engine_interval = 10.0
 
     spec = VariantSpec(
         name="llama-v5e", model_id=MODEL, accelerator="v5e-8",

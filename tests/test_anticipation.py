@@ -1,0 +1,147 @@
+"""Demand-trend anticipation tests (provisioning-horizon scaling: size
+scale-up for demand + slope x slice-startup time)."""
+
+import pytest
+
+from wva_tpu.analyzers.trend import DemandTrend
+
+
+class TestDemandTrend:
+    def test_linear_ramp_slope(self):
+        tr = DemandTrend()
+        slope = 0.0
+        for t in range(0, 120, 10):
+            slope = tr.observe("m", 1000.0 + t, 100.0 + 2.5 * t)
+        assert slope == pytest.approx(2.5, rel=1e-6)
+
+    def test_constant_demand_zero_slope(self):
+        tr = DemandTrend()
+        slope = 1.0
+        for t in range(0, 120, 10):
+            slope = tr.observe("m", 1000.0 + t, 500.0)
+        assert slope == pytest.approx(0.0, abs=1e-9)
+
+    def test_short_span_returns_zero(self):
+        tr = DemandTrend()
+        assert tr.observe("m", 1000.0, 10.0) == 0.0
+        assert tr.observe("m", 1005.0, 1000.0) == 0.0  # span < MIN_SPAN
+
+    def test_window_forgets_old_samples(self):
+        tr = DemandTrend(window_seconds=60.0)
+        for t in range(0, 60, 10):
+            tr.observe("m", 1000.0 + t, 5.0 * t)  # steep ramp
+        # Demand flattens; after the window rolls, slope decays to ~0.
+        slope = 0.0
+        for t in range(60, 180, 10):
+            slope = tr.observe("m", 1000.0 + t, 300.0)
+        assert abs(slope) < 0.1
+
+    def test_keys_are_independent(self):
+        tr = DemandTrend()
+        for t in range(0, 60, 10):
+            tr.observe("a", 1000.0 + t, 10.0 * t)
+            s_b = tr.observe("b", 1000.0 + t, 100.0)
+        assert s_b == pytest.approx(0.0, abs=1e-9)
+
+
+class TestV2Anticipation:
+    def make_input(self, demand_tokens, at):
+        from wva_tpu.interfaces import (
+            AnalyzerInput,
+            ReplicaMetrics,
+            SaturationScalingConfig,
+            VariantReplicaState,
+        )
+        cfg = SaturationScalingConfig(
+            analyzer_name="saturation",
+            anticipation_horizon_seconds=120.0)
+        cfg.apply_defaults()
+        return AnalyzerInput(
+            model_id="m", namespace="ns",
+            replica_metrics=[ReplicaMetrics(
+                pod_name="p0", variant_name="v", model_id="m",
+                accelerator_name="v5e-8", kv_cache_usage=0.5,
+                num_kv_blocks=4096, block_size=32,
+                total_kv_capacity_tokens=131072,
+                tokens_in_use=demand_tokens,
+                avg_input_tokens=512, avg_output_tokens=256)],
+            variant_states=[VariantReplicaState(
+                variant_name="v", accelerator_name="v5e-8",
+                current_replicas=1)],
+            config=cfg)
+
+    def test_growing_demand_raises_required_capacity(self):
+        from wva_tpu.analyzers.saturation_v2 import (
+            CapacityKnowledgeStore,
+            SaturationV2Analyzer,
+        )
+        from wva_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(start=1000.0)
+        an_flat = SaturationV2Analyzer(CapacityKnowledgeStore(clock=clock),
+                                       clock=clock)
+        an_ramp = SaturationV2Analyzer(CapacityKnowledgeStore(clock=clock),
+                                       clock=clock)
+        flat = ramp = None
+        for step in range(8):
+            flat = an_flat.analyze(self.make_input(60000, clock.now()))
+            ramp = an_ramp.analyze(
+                self.make_input(30000 + step * 8000, clock.now()))
+            clock.advance(15)
+        # Final tick demand is comparable (~86k vs 60k) but the ramping
+        # model must anticipate substantially beyond its current demand.
+        assert ramp.required_capacity > flat.required_capacity
+        assert ramp.required_capacity > (
+            ramp.total_demand / 0.85 - ramp.total_supply)
+
+    def test_horizon_zero_disables_anticipation(self):
+        from wva_tpu.analyzers.saturation_v2 import (
+            CapacityKnowledgeStore,
+            SaturationV2Analyzer,
+        )
+        from wva_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(start=1000.0)
+        an = SaturationV2Analyzer(CapacityKnowledgeStore(clock=clock),
+                                  clock=clock)
+        res = None
+        for step in range(8):
+            inp = self.make_input(30000 + step * 8000, clock.now())
+            inp.config.anticipation_horizon_seconds = 0.0
+            res = an.analyze(inp)
+            clock.advance(15)
+        expected = max(res.total_demand / inp.config.scale_up_threshold
+                       - res.total_supply, 0.0)
+        assert res.required_capacity == pytest.approx(expected, rel=1e-6)
+
+    def test_config_yaml_key_parses(self):
+        from wva_tpu.interfaces import SaturationScalingConfig
+        cfg = SaturationScalingConfig.from_dict(
+            {"analyzerName": "saturation",
+             "anticipationHorizonSeconds": 180})
+        assert cfg.anticipation_horizon_seconds == 180.0
+        cfg.apply_defaults()
+        cfg.validate()
+        with pytest.raises(ValueError):
+            bad = SaturationScalingConfig.from_dict(
+                {"analyzerName": "saturation",
+                 "anticipationHorizonSeconds": -5})
+            bad.apply_defaults()
+            bad.validate()
+
+
+class TestV2LimiterPath:
+    def test_limiter_clamps_v2_decisions_to_slice_inventory(self):
+        import sys
+        sys.path.insert(0, "tests")
+        from test_emulator_e2e import make_harness, MODEL
+        from wva_tpu.emulator import ramp as mk_ramp
+        from wva_tpu.interfaces import SaturationScalingConfig
+
+        cfg = SaturationScalingConfig(analyzer_name="saturation",
+                                      enable_limiter=True)
+        h, spec = make_harness(mk_ramp(2.0, 200.0, 200.0, hold=1e9),
+                               saturation_config=cfg,
+                               nodepools=[("v5e-pool", "v5e", "2x4", 3)])
+        h.run(1500)
+        assert h.replicas_of("llama-v5e") <= 3

@@ -35,6 +35,7 @@ from wva_tpu.analyzers.queueing.params import (
     TargetPerf,
 )
 from wva_tpu.analyzers.queueing.queue_model import candidate_batch, size_batch
+from wva_tpu.analyzers.trend import DemandTrend
 from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST
 
 if TYPE_CHECKING:  # pragma: no cover — config.slo imports queueing.params
@@ -80,12 +81,17 @@ class QueueingModelAnalyzer(Analyzer):
                  clock: Clock | None = None) -> None:
         self.profiles = profiles or PerfProfileStore()
         self.clock = clock or SYSTEM_CLOCK
+        self._demand_trend = DemandTrend()
         # Last-synced config per namespace scope ("" = global); analyze()
         # resolves namespace-local > global, never another namespace's.
         self._slo_by_ns: dict[str, SLOConfigData | None] = {}
 
     def name(self) -> str:
         return SLO_ANALYZER_NAME
+
+    def prune(self, active_model_keys: set[str]) -> None:
+        """Drop demand-trend series for models that no longer exist."""
+        self._demand_trend.evict_missing(active_model_keys)
 
     def sync_from_config(self, cfg: SLOConfigData | None,
                          namespace: str = "") -> None:
@@ -143,6 +149,14 @@ class QueueingModelAnalyzer(Analyzer):
         scale_down = cfg.scale_down_boundary or DEFAULT_SCALE_DOWN_BOUNDARY
 
         demand = self._demand_per_s(input)
+        # Provisioning-horizon anticipation (growth only), same semantics as
+        # the V2 analyzer: scale-up sizes for projected demand, scale-down
+        # keeps using current demand.
+        slope = self._demand_trend.observe(
+            f"{input.namespace}|{input.model_id}", result.analyzed_at, demand)
+        scaling_demand = demand
+        if cfg.anticipation_horizon_seconds > 0:
+            scaling_demand += max(slope, 0.0) * cfg.anticipation_horizon_seconds
         supply = 0.0
         anticipated = 0.0
         for cand, cap in zip(candidates, per_replica):
@@ -166,7 +180,7 @@ class QueueingModelAnalyzer(Analyzer):
         result.utilization = demand / supply if supply > 0 else (1.0 if demand > 0 else 0.0)
         # Same anticipated-supply headroom algebra as V2
         # (saturation_v2/analyzer.go:104-138 via saturation_scaling.go:54-57).
-        result.required_capacity = max(demand / scale_up - anticipated, 0.0)
+        result.required_capacity = max(scaling_demand / scale_up - anticipated, 0.0)
         result.spare_capacity = max(supply - demand / scale_down, 0.0) if supply > 0 else 0.0
         return result
 

@@ -31,10 +31,12 @@ from wva_tpu.analyzers.saturation_v2.capacity_store import (
 )
 from wva_tpu.analyzers.saturation_v2.constants import (
     BYTES_PER_TOKEN,
+    HISTORY_EVICTION_TIMEOUT,
     ROLLING_AVERAGE_WINDOW_SIZE,
     classify_output_length,
 )
 from wva_tpu.analyzers.saturation_v2.engine_params import EngineParams
+from wva_tpu.analyzers.trend import DemandTrend
 from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST
 from wva_tpu.analyzers.saturation_v2.history import RollingAverage
 from wva_tpu.interfaces import (
@@ -76,9 +78,16 @@ class SaturationV2Analyzer(Analyzer):
         self._history: dict[str, RollingAverage] = {}
         self.capacity_store = store
         self.clock = clock or SYSTEM_CLOCK
+        self._demand_trend = DemandTrend()
 
     def name(self) -> str:
         return "saturation-token-based"
+
+    def prune(self, active_model_keys: set[str]) -> None:
+        """Per-tick hygiene: drop demand-trend series for models that no
+        longer exist and expire stale k2 history (HISTORY_EVICTION_TIMEOUT)."""
+        self._demand_trend.evict_missing(active_model_keys)
+        self.evict_stale_history(HISTORY_EVICTION_TIMEOUT)
 
     def evict_stale_history(self, timeout: float) -> int:
         with self._mu:
@@ -124,10 +133,20 @@ class SaturationV2Analyzer(Analyzer):
 
         utilization = total_demand / total_supply if total_supply > 0 else 0.0
 
+        # Provisioning-horizon anticipation: size scale-up for the demand
+        # that will exist when new slices become ready (growth only; the
+        # spare/scale-down signal keeps using current demand).
+        now = self.clock.now()
+        slope = self._demand_trend.observe(
+            f"{input.namespace}|{input.model_id}", now, total_demand)
+        scaling_demand = total_demand
+        if config.anticipation_horizon_seconds > 0:
+            scaling_demand += max(slope, 0.0) * config.anticipation_horizon_seconds
+
         # Phase 4: scaling signals.
         required = 0.0
         if config.scale_up_threshold > 0:
-            required = total_demand / config.scale_up_threshold - total_anticipated
+            required = scaling_demand / config.scale_up_threshold - total_anticipated
         required = max(required, 0.0)
         spare = 0.0
         if config.scale_down_boundary > 0:

@@ -1,0 +1,79 @@
+"""Per-model demand-trend estimation for provisioning-horizon anticipation.
+
+TPU slices take minutes to provision and load a model (2-7 min design point,
+BASELINE.md); a replica sized for TODAY's demand is already undersized by the
+time it becomes ready when load is ramping. The estimator tracks each model's
+demand series and returns the growth rate (units/second) from a least-squares
+fit over a sliding window, so analyzers can size scale-up for
+``demand + max(slope, 0) * provisioning_horizon``.
+
+This machinery has no reference equivalent — the reference reacts to current
+saturation only (its cascade-prevention blocks over-reaction but nothing
+anticipates ramps; SURVEY.md section 7 "hard parts" #4 calls out slow slice
+provisioning as correctness-critical).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+DEFAULT_WINDOW_SECONDS = 180.0
+# Slope needs at least this much time span to be meaningful; below it the
+# estimator returns 0 (no anticipation) rather than extrapolating noise.
+MIN_SPAN_SECONDS = 20.0
+MAX_SAMPLES_PER_KEY = 64
+
+
+class DemandTrend:
+    """Thread-safe sliding-window linear-trend estimator keyed by model."""
+
+    def __init__(self, window_seconds: float = DEFAULT_WINDOW_SECONDS) -> None:
+        self.window_seconds = window_seconds
+        self._mu = threading.Lock()
+        self._series: dict[str, deque[tuple[float, float]]] = {}
+
+    def observe(self, key: str, now: float, demand: float) -> float:
+        """Record a sample and return the current demand slope (units/s)."""
+        with self._mu:
+            series = self._series.setdefault(
+                key, deque(maxlen=MAX_SAMPLES_PER_KEY))
+            series.append((now, demand))
+            while series and now - series[0][0] > self.window_seconds:
+                series.popleft()
+            return self._slope(series)
+
+    def evict(self, key: str) -> None:
+        with self._mu:
+            self._series.pop(key, None)
+
+    def evict_missing(self, active_keys: set[str]) -> int:
+        """Drop series for models no longer tracked (prevents unbounded key
+        growth as models come and go); returns how many were dropped."""
+        with self._mu:
+            stale = [k for k in self._series if k not in active_keys]
+            for k in stale:
+                del self._series[k]
+            return len(stale)
+
+    @staticmethod
+    def _slope(series: deque[tuple[float, float]]) -> float:
+        n = len(series)
+        if n < 2:
+            return 0.0
+        t0 = series[0][0]
+        span = series[-1][0] - t0
+        if span < MIN_SPAN_SECONDS:
+            return 0.0
+        # Least-squares slope of demand over time.
+        sum_t = sum_d = sum_tt = sum_td = 0.0
+        for t, d in series:
+            x = t - t0
+            sum_t += x
+            sum_d += d
+            sum_tt += x * x
+            sum_td += x * d
+        denom = n * sum_tt - sum_t * sum_t
+        if denom <= 0:
+            return 0.0
+        return (n * sum_td - sum_t * sum_d) / denom

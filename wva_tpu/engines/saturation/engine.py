@@ -137,6 +137,14 @@ class SaturationEngine:
         va_map = {namespaced_key(va.metadata.namespace, va.metadata.name): va
                   for va in active_vas}
 
+        # Per-tick state hygiene: learned per-model series (demand trends,
+        # k2 history) must not accumulate for deleted models.
+        active_keys = {
+            f"{vas[0].metadata.namespace}|{vas[0].spec.model_id}"
+            for vas in model_groups.values()}
+        self.v2_analyzer.prune(active_keys)
+        self.slo_analyzer.prune(active_keys)
+
         analyzer_name = ""
         global_cfg = self.config.saturation_config().get("default")
         if global_cfg is not None:
@@ -195,14 +203,7 @@ class SaturationEngine:
             all_decisions.extend(self._targets_to_decisions(
                 targets, analysis, data.variant_states))
 
-        # Optional slice limiter (V1 path only; reference engine.go:363-395).
-        global_cfg = self.config.saturation_config().get("default")
-        if (global_cfg is not None and global_cfg.enable_limiter
-                and self.limiter is not None and all_decisions):
-            try:
-                self.limiter.limit(all_decisions)
-            except Exception as e:  # noqa: BLE001
-                log.error("Limiter failed, proceeding with original decisions: %s", e)
+        self._apply_limiter(all_decisions)
         return all_decisions
 
     # --- V2 path ---
@@ -302,7 +303,24 @@ class SaturationEngine:
                         d.action = ACTION_NO_CHANGE
                     d.reason = (f"V2 {d.action} (optimizer: "
                                 f"{self.optimizer.name()}, enforced)")
+
+        self._apply_limiter(decisions)
         return decisions
+
+    def _apply_limiter(self, decisions: list[VariantDecision]) -> None:
+        """Optional slice limiter, applied on EVERY analysis path (the
+        reference leaves this a V1-only stage with a limited-mode TODO,
+        engine.go:120-127/363-395; on TPU, clamping desired to whole-slice
+        inventory matters everywhere — unplaceable replicas otherwise sit
+        pending forever and keep the anticipated-supply math inflated)."""
+        global_cfg = self.config.saturation_config().get("default")
+        if (global_cfg is None or not global_cfg.enable_limiter
+                or self.limiter is None or not decisions):
+            return
+        try:
+            self.limiter.limit(decisions)
+        except Exception as e:  # noqa: BLE001
+            log.error("Limiter failed, proceeding with original decisions: %s", e)
 
     def _run_v2_analysis(self, model_id: str, namespace: str, data: _ModelData,
                          sat_cfg: SaturationScalingConfig):

@@ -44,6 +44,14 @@ class SaturationScalingConfig:
     scale_up_threshold: float = 0.0
     scale_down_boundary: float = 0.0
 
+    # Demand-trend anticipation for slow slice provisioning: size scale-up
+    # for demand + max(slope, 0) x this horizon, where slope is the model's
+    # observed demand growth rate. Set to the slice provisioning + model-load
+    # time so new replicas are sized for the demand that will exist when they
+    # become ready (TPU pools take minutes; 0 = off). Scale-DOWN never
+    # anticipates — only growth is extrapolated.
+    anticipation_horizon_seconds: float = 0.0
+
     def get_analyzer_name(self) -> str:
         return self.analyzer_name
 
@@ -84,6 +92,10 @@ class SaturationScalingConfig:
                 raise ValueError(
                     f"scaleUpThreshold must be in (0, 1], got {self.scale_up_threshold:.2f}"
                 )
+            if self.anticipation_horizon_seconds < 0:
+                raise ValueError(
+                    "anticipationHorizonSeconds must be >= 0, got "
+                    f"{self.anticipation_horizon_seconds}")
             if not 0 < self.scale_down_boundary <= 1:
                 raise ValueError(
                     f"scaleDownBoundary must be in (0, 1], got {self.scale_down_boundary:.2f}"
@@ -107,6 +119,7 @@ class SaturationScalingConfig:
         "analyzerName": "analyzer_name",
         "scaleUpThreshold": "scale_up_threshold",
         "scaleDownBoundary": "scale_down_boundary",
+        "anticipationHorizonSeconds": "anticipation_horizon_seconds",
     }
 
     @classmethod

@@ -20,12 +20,17 @@ TYPE_NORMAL = "Normal"
 TYPE_WARNING = "Warning"
 
 
+DEFAULT_DEDUP_WINDOW_SECONDS = 3600.0
+
+
 class EventRecorder:
     def __init__(self, client: KubeClient, component: str = "wva-tpu",
-                 clock: Clock | None = None) -> None:
+                 clock: Clock | None = None,
+                 dedup_window_seconds: float = DEFAULT_DEDUP_WINDOW_SECONDS) -> None:
         self.client = client
         self.component = component
         self.clock = clock or SYSTEM_CLOCK
+        self.dedup_window_seconds = dedup_window_seconds
 
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
         """Record an event against ``obj`` (anything with KIND + metadata).
@@ -48,16 +53,20 @@ class EventRecorder:
         kind = getattr(obj, "KIND", getattr(obj, "kind", ""))
         name = f"{obj.metadata.name}.{self.component}.{reason.lower()}"
         namespace = obj.metadata.namespace
-        try:
-            existing: Event | None = self.client.try_get(Event.KIND, namespace, name)
-        except NotFoundError:
-            existing = None
+        existing: Event | None = self.client.try_get(Event.KIND, namespace, name)
         if existing is not None:
-            if existing.message == message and existing.type == event_type:
+            fresh_series = (
+                existing.message != message
+                or existing.type != event_type
+                # Dedup window: a recurrence long after the last occurrence
+                # starts a new series so firstTimestamp reflects the current
+                # episode, like the API server's aggregation window.
+                or now - existing.last_timestamp > self.dedup_window_seconds)
+            if not fresh_series:
                 existing.count += 1
                 existing.last_timestamp = now
             else:
-                # Same aggregation key, new content: restart the series.
+                # Same aggregation key, new content or new episode: restart.
                 existing.type = event_type
                 existing.message = message
                 existing.count = 1

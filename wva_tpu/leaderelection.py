@@ -32,7 +32,9 @@ DEFAULT_RETRY_PERIOD = 10.0
 @dataclass
 class LeaderElectorConfig:
     lease_name: str = "72dd1cf1.wva.tpu.llmd.ai"
-    namespace: str = "workload-variant-autoscaler-system"
+    # "" resolves to the controller's namespace (POD_NAMESPACE-aware) at
+    # elector construction, matching every other component's scoping.
+    namespace: str = ""
     lease_duration: float = DEFAULT_LEASE_DURATION
     renew_deadline: float = DEFAULT_RENEW_DEADLINE
     retry_period: float = DEFAULT_RETRY_PERIOD
@@ -49,6 +51,9 @@ class LeaderElector:
         self.client = client
         self.identity = identity
         self.config = config or LeaderElectorConfig()
+        if not self.config.namespace:
+            from wva_tpu.config.helpers import system_namespace
+            self.config.namespace = system_namespace()
         self.clock = clock or SYSTEM_CLOCK
         self._mu = threading.Lock()
         self._leader = False
