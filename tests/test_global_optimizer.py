@@ -1,0 +1,101 @@
+"""Global (fleet-assignment) optimizer mode in the engine — optimizerName
+"global" on the SLO path — plus ServiceMonitor deletion alerting."""
+
+import sys
+
+sys.path.insert(0, "tests")
+
+from test_engine_integration import MODEL, NS, get_va, make_world
+
+from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms, TargetPerf
+from wva_tpu.api.v1alpha1 import ObjectMeta
+from wva_tpu.config.slo import SLOConfigData, ServiceClass, parse_slo_config
+from wva_tpu.interfaces import SaturationScalingConfig
+from wva_tpu.k8s.objects import Event, ServiceMonitor
+
+PARMS = ServiceParms(alpha=6.973, beta=0.027, gamma=0.001)
+
+
+def slo_data():
+    return SLOConfigData(
+        service_classes=[ServiceClass(
+            name="premium", priority=1,
+            model_targets={MODEL: TargetPerf(target_ttft_ms=500.0)})],
+        profiles=[PerfProfile(model_id=MODEL, accelerator="v5e-8",
+                              service_parms=PARMS, max_batch_size=64,
+                              max_queue_size=512)])
+
+
+def heavy_load(tsdb, clock, rate_per_s=200.0):
+    labels = {"namespace": NS, "model_name": MODEL}
+    t0 = clock.now()
+    tsdb.add_sample("vllm:request_success_total", labels, 0.0, timestamp=t0 - 60)
+    tsdb.add_sample("vllm:request_success_total", labels, rate_per_s * 60,
+                    timestamp=t0)
+
+
+class TestGlobalOptimizerMode:
+    def make(self, rate=200.0):
+        cfg = SaturationScalingConfig(analyzer_name="slo",
+                                      optimizer_name="global")
+        mgr, cluster, tsdb, clock = make_world(kv=0.2, saturation_cfg=cfg)
+        mgr.config.update_slo_config(slo_data())
+        heavy_load(tsdb, clock, rate)
+        return mgr, cluster, tsdb, clock
+
+    def test_config_validates(self):
+        cfg = SaturationScalingConfig.from_dict(
+            {"analyzerName": "slo", "optimizerName": "global"})
+        cfg.apply_defaults()
+        cfg.validate()
+        bad = SaturationScalingConfig.from_dict(
+            {"analyzerName": "slo", "optimizerName": "mip"})
+        bad.apply_defaults()
+        try:
+            bad.validate()
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+
+    def test_global_mode_scales_for_demand(self):
+        mgr, cluster, tsdb, clock = self.make(rate=200.0)
+        mgr.run_once()
+        va = get_va(cluster)
+        # ~200 req/s / ~4.4 req/s SLO capacity -> dozens of replicas, but the
+        # world has a bounded v5e pool; the solver must size >1 and respect
+        # whole slices.
+        assert va.status.desired_optimized_alloc.num_replicas > 1
+        assert va.status.desired_optimized_alloc.accelerator == "v5e-8"
+
+    def test_global_mode_light_load_holds_minimum(self):
+        mgr, cluster, tsdb, clock = self.make(rate=2.0)
+        mgr.run_once()
+        va = get_va(cluster)
+        assert va.status.desired_optimized_alloc.num_replicas == 1
+
+    def test_global_mode_without_slo_config_no_decisions(self):
+        cfg = SaturationScalingConfig(analyzer_name="slo",
+                                      optimizer_name="global")
+        mgr, cluster, tsdb, clock = make_world(kv=0.2, saturation_cfg=cfg)
+        heavy_load(tsdb, clock)
+        mgr.run_once()  # no slo config -> model skipped upstream
+        va = get_va(cluster)
+        assert va.status.desired_optimized_alloc.num_replicas in (0, 1)
+
+
+class TestServiceMonitorAlerting:
+    def test_deletion_emits_warning_event(self):
+        mgr, cluster, tsdb, clock = make_world(kv=0.2)
+        name = mgr.va_reconciler.SERVICEMONITOR_NAME
+        cluster.create(ServiceMonitor(
+            metadata=ObjectMeta(name=name, namespace="monitoring")))
+        cluster.delete(ServiceMonitor.KIND, "monitoring", name)
+        events = cluster.list(Event.KIND, namespace="monitoring")
+        assert any(e.reason == "ServiceMonitorDeleted" for e in events)
+
+    def test_other_servicemonitors_ignored(self):
+        mgr, cluster, tsdb, clock = make_world(kv=0.2)
+        cluster.create(ServiceMonitor(
+            metadata=ObjectMeta(name="something-else", namespace="monitoring")))
+        cluster.delete(ServiceMonitor.KIND, "monitoring", "something-else")
+        assert cluster.list(Event.KIND, namespace="monitoring") == []

@@ -138,6 +138,8 @@ class QueueingModelAnalyzer(Analyzer):
             return result
 
         request_size = self._observed_request_size(input)
+        result.avg_input_tokens = request_size.avg_input_tokens
+        result.avg_output_tokens = request_size.avg_output_tokens
         candidates = self._prepare_candidates(input, targets, request_size)
         if not candidates:
             return result

@@ -78,6 +78,16 @@ class SLOConfigData:
             return self.default_targets, DEFAULT_SERVICE_CLASS_PRIORITY
         return None, DEFAULT_SERVICE_CLASS_PRIORITY
 
+    def class_for_model(self, model_id: str) -> str | None:
+        """Name of the best (lowest-priority-value) service class listing the
+        model; None when unlisted (and no classes would match)."""
+        best: tuple[str, int] | None = None
+        for sc in self.service_classes:
+            if model_id in sc.model_targets:
+                if best is None or sc.priority < best[1]:
+                    best = (sc.name, sc.priority)
+        return best[0] if best is not None else None
+
 
 def _parse_targets(raw: dict) -> TargetPerf:
     return TargetPerf(

@@ -27,7 +27,7 @@ from wva_tpu.datastore import Datastore
 from wva_tpu.engines import common
 from wva_tpu.indexers import Indexer
 from wva_tpu.k8s.client import ADDED, DELETED, KubeClient, NotFoundError
-from wva_tpu.k8s.objects import Deployment, LeaderWorkerSet
+from wva_tpu.k8s.objects import Deployment, LeaderWorkerSet, ServiceMonitor
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from wva_tpu.utils.variant import update_va_status_with_backoff
 from wva_tpu.controller.predicates import deployment_event_allowed, va_event_allowed
@@ -47,10 +47,30 @@ class VariantAutoscalingReconciler:
 
     # --- wiring (reference SetupWithManager :291-319) ---
 
+    # The controller's own metric-scrape contract: losing this ServiceMonitor
+    # silently starves HPA/KEDA of wva_* gauges (reference
+    # variantautoscaling_controller.go:330-367 — deletion alerting only).
+    SERVICEMONITOR_NAME = "wva-tpu-controller-manager-metrics"
+
     def setup(self) -> None:
         self.client.watch(VariantAutoscaling.kind, self._on_va_event)
         self.client.watch(Deployment.KIND, self._on_deployment_event)
         self.client.watch(LeaderWorkerSet.KIND, self._on_deployment_event)
+        self.client.watch(ServiceMonitor.KIND, self._on_servicemonitor_event)
+
+    def _on_servicemonitor_event(self, event: str, sm) -> None:
+        if event != DELETED or sm.metadata.name != self.SERVICEMONITOR_NAME:
+            return
+        log.warning(
+            "ServiceMonitor %s/%s deleted: wva_* metrics will stop being "
+            "scraped and HPA/KEDA actuation will starve",
+            sm.metadata.namespace, sm.metadata.name)
+        if self.recorder is not None:
+            self.recorder.warning(
+                sm, "ServiceMonitorDeleted",
+                "Controller metrics ServiceMonitor deleted; external "
+                "actuation (HPA/KEDA) will lose the wva_desired_replicas "
+                "signal")
 
     def _on_va_event(self, event: str, va: VariantAutoscaling) -> None:
         if event == DELETED:

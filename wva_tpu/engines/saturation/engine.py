@@ -271,7 +271,23 @@ class SaturationEngine:
         if not requests:
             return []
 
-        decisions = self.optimizer.optimize(requests, None)
+        # Optimizer selection respects namespace-local config (optimizerName
+        # is resolved per request's namespace, like every other knob).
+        global_reqs: list[ModelScalingRequest] = []
+        local_reqs: list[ModelScalingRequest] = []
+        for req in requests:
+            ns_cfg = self.config.saturation_config_for_namespace(
+                req.namespace).get("default")
+            if (use_slo and ns_cfg is not None
+                    and ns_cfg.optimizer_name == "global"):
+                global_reqs.append(req)
+            else:
+                local_reqs.append(req)
+        decisions = []
+        if global_reqs:
+            decisions.extend(self._optimize_global(global_reqs, slo_cfg_by_ns))
+        if local_reqs:
+            decisions.extend(self.optimizer.optimize(local_reqs, None))
 
         # Enforcer bridge per model (reference engine_v2.go:76-127).
         for req in requests:
@@ -344,6 +360,151 @@ class SaturationEngine:
             config=sat_cfg,
             scheduler_queue=scheduler_queue,
         ))
+
+    def _optimize_global(self, requests: list[ModelScalingRequest],
+                         slo_cfg_by_ns: dict[str, object]) -> list[VariantDecision]:
+        """Fleet-wide assignment (optimizerName "global", SLO path only):
+        builds one FleetSystem across every model — servers with observed
+        load, accelerators from the variants' slice specs, per-generation
+        chip capacity from discovery — and solves the greedy priority /
+        delta-regret assignment with transition penalties (the inferno
+        successor; ``wva_tpu.fleet``). Each model consolidates onto ONE slice
+        variant per solve, like the reference's per-server Allocation."""
+        from wva_tpu.fleet import (
+            AcceleratorSpec,
+            CurrentAlloc,
+            FleetSystem,
+            ServerLoad,
+            ServerSpec,
+            SolverSpec,
+            solve,
+        )
+
+        slices = {}
+        try:
+            slices = self.limiter.inventory.discovery.discover_slices() \
+                if self.limiter is not None else {}
+        except Exception as e:  # noqa: BLE001 — no inventory -> unlimited
+            log.debug("Slice discovery unavailable for global optimizer: %s", e)
+
+        accelerators: dict[str, AcceleratorSpec] = {}
+        capacity_chips: dict[str, int] = {}
+        servers: dict[str, ServerSpec] = {}
+        service_classes = {}
+        req_by_server: dict[str, ModelScalingRequest] = {}
+
+        from wva_tpu.config.slo import DEFAULT_SERVICE_CLASS_PRIORITY, ServiceClass
+
+        counted_variants: set[str] = set()
+        for req in requests:
+            slo_cfg = slo_cfg_by_ns.get(req.namespace)
+            if slo_cfg is None or req.result is None:
+                continue
+            # Service-class names are namespace-qualified in the shared
+            # system: same-named classes in different namespaces must not
+            # override each other's priority/targets.
+            sc_name = slo_cfg.class_for_model(req.model_id)
+            if sc_name is not None:
+                qualified = f"{req.namespace}|{sc_name}"
+                for sc in slo_cfg.service_classes:
+                    if sc.name == sc_name:
+                        service_classes[qualified] = sc
+            elif slo_cfg.default_targets is not None:
+                # Models covered only by defaultTargets still participate.
+                qualified = f"{req.namespace}|__default__"
+                sc = service_classes.setdefault(qualified, ServiceClass(
+                    name="__default__",
+                    priority=DEFAULT_SERVICE_CLASS_PRIORITY))
+                sc.model_targets[req.model_id] = slo_cfg.default_targets
+            else:
+                continue
+
+            chips_by_accel = {vs.accelerator_name: vs.chips_per_replica
+                              for vs in req.variant_states
+                              if vs.accelerator_name}
+            current = None
+            for vc in sorted(req.result.variant_capacities,
+                             key=lambda v: -v.replica_count):
+                accel = vc.accelerator_name
+                if not accel:
+                    continue
+                if accel not in accelerators:
+                    cap = slices.get(accel)
+                    gen = accel.split("-")[0]
+                    accelerators[accel] = AcceleratorSpec(
+                        name=accel, type=gen,
+                        # Per-variant chip count from pod TPU requests is
+                        # authoritative; discovery confirms, never guesses.
+                        chips_per_replica=(
+                            cap.chips_per_slice if cap is not None
+                            else chips_by_accel.get(accel, 1)),
+                        cost=vc.cost)
+                    if cap is not None and accel not in counted_variants:
+                        # Whole schedulable slices only (partial slices are
+                        # unplaceable; matches the limiter's pool sizing).
+                        # Each variant's slices contribute once to its
+                        # generation's pool.
+                        counted_variants.add(accel)
+                        capacity_chips[gen] = (
+                            capacity_chips.get(gen, 0)
+                            + cap.total_slices * cap.chips_per_slice)
+                if current is None and vc.replica_count > 0:
+                    current = CurrentAlloc(
+                        accelerator=accel, num_replicas=vc.replica_count,
+                        cost=vc.cost * vc.replica_count)
+
+            name = f"{req.namespace}/{req.model_id}"
+            servers[name] = ServerSpec(
+                name=name, namespace=req.namespace, model_id=req.model_id,
+                service_class=qualified,
+                load=ServerLoad(
+                    arrival_rate_per_min=req.result.total_demand * 60.0,
+                    avg_input_tokens=req.result.avg_input_tokens,
+                    avg_output_tokens=req.result.avg_output_tokens),
+                min_replicas=1,
+                # A fitted profile alone does not make a placement
+                # actuatable: only accelerators with deployed variants.
+                allowed_accelerators=frozenset(chips_by_accel),
+                current=current)
+            req_by_server[name] = req
+
+        if not servers:
+            return []
+        # Unlimited only when no inventory could be discovered.
+        spec = SolverSpec(unlimited=not capacity_chips)
+        system = FleetSystem(
+            accelerators=accelerators, servers=servers,
+            service_classes=service_classes,
+            profiles=self.slo_analyzer.profiles,
+            capacity_chips=capacity_chips)
+        solution = solve(system, spec)
+
+        decisions: list[VariantDecision] = []
+        for name, req in req_by_server.items():
+            alloc = solution.allocations.get(name)
+            for vs in req.variant_states:
+                if alloc is not None and alloc.accelerator \
+                        and vs.accelerator_name == alloc.accelerator:
+                    target = alloc.num_replicas
+                elif alloc is not None:
+                    target = 0  # consolidate onto the chosen variant
+                else:
+                    target = vs.current_replicas  # unallocated: hold steady
+                d = VariantDecision(
+                    variant_name=vs.variant_name, namespace=req.namespace,
+                    model_id=req.model_id,
+                    accelerator_name=vs.accelerator_name,
+                    current_replicas=vs.current_replicas,
+                    target_replicas=target,
+                    chips_per_replica=vs.chips_per_replica,
+                    cost=next((vc.cost for vc in req.result.variant_capacities
+                               if vc.variant_name == vs.variant_name), 0.0),
+                    action=(ACTION_SCALE_UP if target > vs.current_replicas
+                            else ACTION_SCALE_DOWN if target < vs.current_replicas
+                            else ACTION_NO_CHANGE),
+                    reason="global optimizer (fleet assignment)")
+                decisions.append(d)
+        return decisions
 
     def _run_slo_analysis(self, model_id: str, namespace: str, data: _ModelData,
                           sat_cfg: SaturationScalingConfig, slo_cfg):

@@ -72,6 +72,10 @@ class ServerSpec:
     # Restrict candidates to the currently-used accelerator (sticky placement,
     # reference server.go:70-82).
     keep_accelerator: bool = False
+    # When set, candidates are limited to these accelerator names (e.g. the
+    # accelerators the model actually has deployed variants for — a fitted
+    # profile alone does not make a placement actuatable).
+    allowed_accelerators: frozenset[str] | None = None
     current: CurrentAlloc | None = None
 
 
@@ -105,6 +109,9 @@ class FleetSystem:
             return [acc] if acc is not None else []
         out = []
         for acc in self.accelerators.values():
+            if server.allowed_accelerators is not None \
+                    and acc.name not in server.allowed_accelerators:
+                continue
             prof = self.profiles.get(server.model_id, acc.name,
                                      namespace=server.namespace)
             if prof is not None and prof.service_parms.valid():

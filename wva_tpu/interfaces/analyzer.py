@@ -52,6 +52,10 @@ class AnalyzerResult:
     required_capacity: float = 0.0
     # >0 means scale-down possible: supply - demand/scale_down_boundary.
     spare_capacity: float = 0.0
+    # Observed request mix (set by analyzers that compute it; consumed by the
+    # global optimizer's queueing-model candidate sizing).
+    avg_input_tokens: float = 0.0
+    avg_output_tokens: float = 0.0
 
 
 @dataclass

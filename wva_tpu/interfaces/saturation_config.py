@@ -44,6 +44,12 @@ class SaturationScalingConfig:
     scale_up_threshold: float = 0.0
     scale_down_boundary: float = 0.0
 
+    # Optimizer selection for the V2/SLO flow: "" = per-model cost-aware
+    # (reference CostAwareOptimizer); "global" = fleet-wide assignment solver
+    # (service-class priorities + per-generation chip capacity + transition
+    # penalties — the inferno successor, SLO analyzer only).
+    optimizer_name: str = ""
+
     # Demand-trend anticipation for slow slice provisioning: size scale-up
     # for demand + max(slope, 0) x this horizon, where slope is the model's
     # observed demand growth rate. Set to the slice provisioning + model-load
@@ -92,6 +98,10 @@ class SaturationScalingConfig:
                 raise ValueError(
                     f"scaleUpThreshold must be in (0, 1], got {self.scale_up_threshold:.2f}"
                 )
+            if self.optimizer_name not in ("", "global"):
+                raise ValueError(
+                    f'optimizerName must be "" or "global", got '
+                    f"{self.optimizer_name!r}")
             if self.anticipation_horizon_seconds < 0:
                 raise ValueError(
                     "anticipationHorizonSeconds must be >= 0, got "
@@ -120,6 +130,7 @@ class SaturationScalingConfig:
         "scaleUpThreshold": "scale_up_threshold",
         "scaleDownBoundary": "scale_down_boundary",
         "anticipationHorizonSeconds": "anticipation_horizon_seconds",
+        "optimizerName": "optimizer_name",
     }
 
     @classmethod
