@@ -99,3 +99,201 @@ class TestServiceMonitorAlerting:
             metadata=ObjectMeta(name="something-else", namespace="monitoring")))
         cluster.delete(ServiceMonitor.KIND, "monitoring", "something-else")
         assert cluster.list(Event.KIND, namespace="monitoring") == []
+
+
+class TestGlobalFanOut:
+    """Decision fan-out correctness: single-winner assignment when several
+    variants share the chosen accelerator, and readiness-aware migration
+    (losing variants hold until the winner's replicas are ready)."""
+
+    def _engine(self):
+        cfg = SaturationScalingConfig(analyzer_name="slo",
+                                      optimizer_name="global")
+        mgr, cluster, tsdb, clock = make_world(kv=0.2, saturation_cfg=cfg)
+        mgr.config.update_slo_config(slo_data())
+        return mgr.engine
+
+    def _request(self, states):
+        from wva_tpu.interfaces.analyzer import AnalyzerResult, VariantCapacity
+        from wva_tpu.pipeline.optimizer import ModelScalingRequest
+
+        caps = [VariantCapacity(variant_name=s.variant_name,
+                                accelerator_name=s.accelerator_name,
+                                cost=10.0, replica_count=s.current_replicas)
+                for s in states]
+        return ModelScalingRequest(
+            model_id=MODEL, namespace=NS,
+            result=AnalyzerResult(model_id=MODEL, namespace=NS,
+                                  variant_capacities=caps, total_demand=50.0,
+                                  avg_input_tokens=256.0, avg_output_tokens=128.0),
+            variant_states=states)
+
+    def _fan_out(self, engine, states, accelerator, num_replicas, monkeypatch):
+        import wva_tpu.fleet as fleet
+        from wva_tpu.fleet import FleetAllocation, Solution
+
+        req = self._request(states)
+
+        def fake_solve(system, spec):
+            return Solution(allocations={
+                f"{NS}/{MODEL}": FleetAllocation(
+                    accelerator=accelerator, num_replicas=num_replicas)})
+
+        monkeypatch.setattr(fleet, "solve", fake_solve)
+        slo_by_ns = {NS: engine.config.slo_config_for_namespace(NS)}
+        decisions = engine._optimize_global([req], slo_by_ns)
+        return {d.variant_name: d.target_replicas for d in decisions}
+
+    def test_duplicate_accelerator_single_winner(self, monkeypatch):
+        """Two VAs on the chosen accelerator: exactly one gets the replica
+        count (the one with most current replicas), never both."""
+        from wva_tpu.interfaces.decision import VariantReplicaState
+
+        engine = self._engine()
+        states = [
+            VariantReplicaState(variant_name="a", accelerator_name="v5e-8",
+                                current_replicas=3, pending_replicas=0),
+            VariantReplicaState(variant_name="b", accelerator_name="v5e-8",
+                                current_replicas=1, pending_replicas=0),
+        ]
+        targets = self._fan_out(engine, states, "v5e-8", 3, monkeypatch)
+        assert targets["a"] == 3
+        # Winner already has 3 ready -> migration complete -> loser drains.
+        assert targets["b"] == 0
+
+    def test_migration_holds_until_winner_ready(self, monkeypatch):
+        """Cross-accelerator consolidation: the old variant keeps serving
+        while the winner's slices are still provisioning."""
+        from wva_tpu.interfaces.decision import VariantReplicaState
+
+        engine = self._engine()
+        states = [
+            VariantReplicaState(variant_name="new", accelerator_name="v5e-8",
+                                current_replicas=1, pending_replicas=1),
+            VariantReplicaState(variant_name="old", accelerator_name="v5p-8",
+                                current_replicas=2, pending_replicas=0),
+        ]
+        targets = self._fan_out(engine, states, "v5e-8", 2, monkeypatch)
+        assert targets["new"] == 2
+        # Winner has 0 ready (1 current, 1 pending) < 2 -> old holds.
+        assert targets["old"] == 2
+
+    def test_migration_drains_old_when_winner_ready(self, monkeypatch):
+        from wva_tpu.interfaces.decision import VariantReplicaState
+
+        engine = self._engine()
+        states = [
+            VariantReplicaState(variant_name="new", accelerator_name="v5e-8",
+                                current_replicas=2, pending_replicas=0),
+            VariantReplicaState(variant_name="old", accelerator_name="v5p-8",
+                                current_replicas=2, pending_replicas=0),
+        ]
+        targets = self._fan_out(engine, states, "v5e-8", 2, monkeypatch)
+        assert targets["new"] == 2
+        assert targets["old"] == 0
+
+    def test_migration_decays_proportionally_to_winner_readiness(self, monkeypatch):
+        from wva_tpu.interfaces.decision import VariantReplicaState
+
+        engine = self._engine()
+        states = [
+            VariantReplicaState(variant_name="new", accelerator_name="v5e-8",
+                                current_replicas=2, pending_replicas=1),
+            VariantReplicaState(variant_name="old", accelerator_name="v5p-8",
+                                current_replicas=4, pending_replicas=0),
+        ]
+        # Winner 1/2 ready -> shortfall 50% -> old holds ceil(4 * 0.5) = 2.
+        targets = self._fan_out(engine, states, "v5e-8", 2, monkeypatch)
+        assert targets["new"] == 2
+        assert targets["old"] == 2
+
+    def test_migration_hold_timeout_forces_gradual_drain(self, monkeypatch):
+        """A pool too small for old + new variants simultaneously must not
+        wedge forever: past the hold timeout the loser drains one replica
+        per tick even with zero winner progress, freeing chips."""
+        from wva_tpu.engines.saturation.engine import MIGRATION_HOLD_TIMEOUT
+        from wva_tpu.interfaces.decision import VariantReplicaState
+
+        engine = self._engine()
+        states = [
+            VariantReplicaState(variant_name="new", accelerator_name="v5e-8",
+                                current_replicas=0, pending_replicas=0),
+            VariantReplicaState(variant_name="old", accelerator_name="v5p-8",
+                                current_replicas=3, pending_replicas=0),
+        ]
+        targets = self._fan_out(engine, states, "v5e-8", 2, monkeypatch)
+        assert targets["old"] == 3  # full hold: winner 0/2 ready
+        engine.clock.advance(MIGRATION_HOLD_TIMEOUT + 1)
+        targets = self._fan_out(engine, states, "v5e-8", 2, monkeypatch)
+        assert targets["old"] == 2  # forced drain, one replica per tick
+
+    def test_hold_timer_resets_after_unallocated_gap(self, monkeypatch):
+        """A transient no-allocation solve must clear the hold timer: when
+        allocation resumes, the migration clock restarts instead of charging
+        the gap and force-draining a healthy variant immediately."""
+        import wva_tpu.fleet as fleet
+        from wva_tpu.engines.saturation.engine import MIGRATION_HOLD_TIMEOUT
+        from wva_tpu.fleet import Solution
+        from wva_tpu.interfaces.decision import VariantReplicaState
+
+        engine = self._engine()
+        states = [
+            VariantReplicaState(variant_name="new", accelerator_name="v5e-8",
+                                current_replicas=0, pending_replicas=0),
+            VariantReplicaState(variant_name="old", accelerator_name="v5p-8",
+                                current_replicas=3, pending_replicas=0),
+        ]
+        targets = self._fan_out(engine, states, "v5e-8", 2, monkeypatch)
+        assert targets["old"] == 3  # hold begins
+        # Solver transiently returns nothing for the model.
+        monkeypatch.setattr(fleet, "solve", lambda sys_, spec: Solution())
+        slo_by_ns = {NS: engine.config.slo_config_for_namespace(NS)}
+        engine._optimize_global([self._request(states)], slo_by_ns)
+        assert engine._migration_holds == {}  # stale timer pruned
+        # Allocation resumes long past the would-be timeout: still a fresh
+        # full hold, NOT a forced drain.
+        engine.clock.advance(MIGRATION_HOLD_TIMEOUT + 100)
+        targets = self._fan_out(engine, states, "v5e-8", 2, monkeypatch)
+        assert targets["old"] == 3
+
+    def test_hold_timer_resets_on_retarget(self, monkeypatch):
+        """Retargeting the migration to a different accelerator mid-hold
+        restarts the clock (elapsed time of migration A is not charged to
+        migration B)."""
+        from wva_tpu.engines.saturation.engine import MIGRATION_HOLD_TIMEOUT
+        from wva_tpu.interfaces.decision import VariantReplicaState
+
+        engine = self._engine()
+        states = [
+            VariantReplicaState(variant_name="new", accelerator_name="v5e-8",
+                                current_replicas=0, pending_replicas=0),
+            VariantReplicaState(variant_name="new2", accelerator_name="v5e-16",
+                                current_replicas=0, pending_replicas=0),
+            VariantReplicaState(variant_name="old", accelerator_name="v5p-8",
+                                current_replicas=3, pending_replicas=0),
+        ]
+        self._fan_out(engine, states, "v5e-8", 2, monkeypatch)
+        engine.clock.advance(MIGRATION_HOLD_TIMEOUT - 10)
+        targets = self._fan_out(engine, states, "v5e-16", 2, monkeypatch)
+        assert targets["old"] == 3  # fresh hold for the new target
+        engine.clock.advance(MIGRATION_HOLD_TIMEOUT - 10)
+        targets = self._fan_out(engine, states, "v5e-16", 2, monkeypatch)
+        assert targets["old"] == 3  # still within the re-targeted window
+
+    def test_winner_prefers_ready_over_wedged_provisioning(self, monkeypatch):
+        """A variant stuck provisioning (many current, zero ready) must not
+        outrank a fully-ready serving variant on the same accelerator —
+        otherwise the healthy variant would be held and eventually drained
+        while the wedged one never serves."""
+        from wva_tpu.interfaces.decision import VariantReplicaState
+
+        engine = self._engine()
+        states = [
+            VariantReplicaState(variant_name="wedged", accelerator_name="v5e-8",
+                                current_replicas=5, pending_replicas=5),
+            VariantReplicaState(variant_name="serving", accelerator_name="v5e-8",
+                                current_replicas=3, pending_replicas=0),
+        ]
+        targets = self._fan_out(engine, states, "v5e-8", 3, monkeypatch)
+        assert targets["serving"] == 3  # ready variant wins the allocation
+        assert targets["wedged"] == 0   # winner is fully ready -> drain

@@ -38,10 +38,15 @@ class TestLeaderElector:
     def test_failover_after_lease_expiry(self):
         clock, cluster, a, b = make_pair()
         a.tick()
-        # a dies (stops ticking); b takes over only after the lease expires.
+        # a dies (stops ticking); b takes over only after it has locally
+        # observed the lease go unrenewed for a full lease_duration
+        # (client-go semantics — never by comparing a's renew_time to b's
+        # clock, which clock skew could make a dual-leader window).
         clock.advance(30)
+        assert b.tick() is False  # first observation starts b's timer
+        clock.advance(31)  # 61s since a renewed, but only 31s observed by b
         assert b.tick() is False
-        clock.advance(31)  # > 60s since a's last renewal
+        clock.advance(30)  # 61s of local observation
         assert b.tick() is True
         assert b.is_leader()
         # a comes back: must observe b's lease, not reclaim.
@@ -67,7 +72,8 @@ class TestLeaderElector:
     def test_lease_transitions_counted(self):
         clock, cluster, a, b = make_pair()
         a.tick()
-        clock.advance(61)
+        b.tick()  # b starts observing a's lease
+        clock.advance(61)  # a never renews for a full lease_duration
         b.tick()
         lease = cluster.get("Lease", a.config.namespace, a.config.lease_name)
         assert lease.lease_transitions == 1
@@ -175,3 +181,27 @@ class TestEventRecorder:
         rec.reconcile(bad)
         events = cluster.list(Event.KIND, namespace=system_namespace())
         assert any(e.reason == "InvalidSLOConfig" for e in events)
+
+
+class TestClockSkewSafety:
+    def test_skewed_standby_cannot_steal_actively_renewed_lease(self):
+        """A standby whose clock runs ahead of the leader's renew_time must
+        not treat the lease as expired while renewals keep arriving: expiry
+        is judged by locally observing NO renew-transition for a full
+        lease_duration, never by cross-replica clock comparison."""
+        clock = FakeClock(start=1000.0)
+        cluster = FakeCluster(clock=clock)
+        cfg = LeaderElectorConfig()
+        a = LeaderElector(cluster, "pod-a", cfg, clock=clock)
+        # b's clock is 90s ahead of a's (worse than the 60s lease duration).
+        skewed = FakeClock(start=1090.0)
+        b = LeaderElector(cluster, "pod-b", cfg, clock=skewed)
+        a.tick()
+        for _ in range(20):
+            clock.advance(10)
+            skewed.advance(10)
+            assert a.tick() is True
+            # Without local-observation expiry b would see
+            # now - renew_time = 90s > 60s and steal the lease here.
+            assert b.tick() is False
+        assert a.is_leader() and not b.is_leader()

@@ -499,3 +499,28 @@ class TestEngineSLOPath:
         va = get_va(cluster)
         # No SLO config -> model skipped, no decision written this tick.
         assert va.status.desired_optimized_alloc.num_replicas in (0, 1)
+
+
+class TestAnalyzeBatchValidMask:
+    def test_below_min_rate_is_flagged_invalid(self):
+        """A requested rate below lam_min is clamped UP to lam_min; the
+        metrics describe that different operating point, so valid must be
+        False and analyzed_rate_per_s must expose the substitution."""
+        import jax.numpy as jnp
+
+        cand = candidate_batch(
+            [PARMS.alpha] * 3, [PARMS.beta] * 3, [PARMS.gamma] * 3,
+            [REQ.avg_input_tokens] * 3, [REQ.avg_output_tokens] * 3,
+            [CFG.max_batch_size] * 3,
+            [CFG.max_batch_size + CFG.max_queue_size] * 3)
+        qa = QueueAnalyzer(CFG, REQ)
+        tiny = qa.min_rate_per_s / 10.0
+        mid = (qa.min_rate_per_s + qa.max_rate_per_s) / 2.0
+        huge = qa.max_rate_per_s * 10.0
+        out = analyze_batch(jnp.asarray([tiny, mid, huge]), cand)
+        valid = [bool(v) for v in out["valid"]]
+        assert valid == [False, True, False]
+        analyzed = [float(v) for v in out["analyzed_rate_per_s"]]
+        assert analyzed[0] == pytest.approx(qa.min_rate_per_s, rel=1e-4)
+        assert analyzed[1] == pytest.approx(mid, rel=1e-4)
+        assert analyzed[2] == pytest.approx(qa.max_rate_per_s, rel=1e-4)

@@ -133,3 +133,36 @@ class TestSLOTunerConfig:
         assert parse_slo_config("tuner: {enabled: true}").tuner_enabled
         assert not parse_slo_config("tuner: {enabled: false}").tuner_enabled
         assert not parse_slo_config("").tuner_enabled
+
+
+class TestTunerProfileEviction:
+    def test_tuner_profile_evicted_when_removed_from_config(self):
+        """A tuner-refined profile whose (model, accelerator) disappears from
+        the synced config set must be evicted — otherwise stale tuned parms
+        accumulate forever and shadow any future config refit for that key."""
+        from wva_tpu.analyzers.queueing.params import (
+            PROFILE_SOURCE_TUNER, PerfProfile, PerfProfileStore, ServiceParms)
+
+        store = PerfProfileStore()
+        store.sync_namespace("", [
+            PerfProfile(model_id="m", accelerator="v5e-8",
+                        service_parms=ServiceParms(alpha=7.0, beta=0.03,
+                                                   gamma=0.001)),
+            PerfProfile(model_id="gone", accelerator="v5e-8",
+                        service_parms=ServiceParms(alpha=7.0, beta=0.03,
+                                                   gamma=0.001)),
+        ])
+        assert store.update_service_parms(
+            "gone", "v5e-8", ServiceParms(alpha=5.0, beta=0.02, gamma=0.001))
+        assert store.update_service_parms(
+            "m", "v5e-8", ServiceParms(alpha=5.5, beta=0.02, gamma=0.001))
+        # Re-sync without "gone": its tuned profile must not survive, while
+        # the still-configured "m" keeps its refinement.
+        store.sync_namespace("", [
+            PerfProfile(model_id="m", accelerator="v5e-8",
+                        service_parms=ServiceParms(alpha=7.0, beta=0.03,
+                                                   gamma=0.001))])
+        assert store.get("gone", "v5e-8") is None
+        kept = store.get("m", "v5e-8")
+        assert kept.source == PROFILE_SOURCE_TUNER
+        assert kept.service_parms.alpha == 5.5

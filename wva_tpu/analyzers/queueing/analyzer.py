@@ -238,7 +238,7 @@ class QueueingModelAnalyzer(Analyzer):
                 variant_name=vs.variant_name,
                 accelerator=vs.accelerator_name,
                 cost=cost,
-                ready=max(vs.current_replicas - vs.pending_replicas, 0),
+                ready=vs.ready_replicas,
                 pending=vs.pending_replicas,
                 profile=profile,
                 targets=targets,

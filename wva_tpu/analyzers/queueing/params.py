@@ -194,11 +194,17 @@ class PerfProfileStore:
         """Adopt the config's profile set for one namespace scope: config-
         sourced profiles in that scope are replaced wholesale (updates apply,
         deletions take effect); tuner-refined profiles keep their refined
-        service_parms but adopt updated batching limits from config."""
+        service_parms but adopt updated batching limits from config. Tuner
+        profiles whose (model, accelerator) no longer appears in the synced
+        set are evicted too — otherwise stale tuned parms would accumulate
+        forever and shadow any future config refit for that key."""
         with self._lock:
+            incoming = {(p.model_id, p.accelerator) for p in profiles}
             keep = {
                 k: v for k, v in self._profiles.items()
-                if k[0] != namespace or v.source == PROFILE_SOURCE_TUNER
+                if k[0] != namespace or (
+                    v.source == PROFILE_SOURCE_TUNER
+                    and (v.model_id, v.accelerator) in incoming)
             }
             self._profiles = keep
             for prof in profiles:

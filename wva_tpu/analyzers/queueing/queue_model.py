@@ -208,12 +208,16 @@ def analyze_batch(rate_per_s: jax.Array, cand: CandidateBatch) -> dict[str, jax.
     """Steady-state metrics for each candidate at its arrival rate (req/s).
 
     Vectorized equivalent of ``QueueAnalyzer.Analyze``
-    (reference queueanalyzer.go:127-168). Rates above the feasible maximum are
-    clamped and reported via ``valid``.
+    (reference queueanalyzer.go:127-168). Rates outside [lam_min, lam_max]
+    are clamped; ``valid`` is False for any clamped candidate (a below-min
+    rate would otherwise return metrics for a different operating point and
+    overstate latency for very-low-traffic candidates), and
+    ``analyzed_rate_per_s`` reports the rate actually analyzed so callers
+    can detect the substitution.
     """
     lam_min, lam_max = rate_bounds_per_ms(cand)
     lam_req = jnp.asarray(rate_per_s, jnp.float32) / 1000.0
-    valid = (lam_req > 0) & (lam_req <= lam_max)
+    valid = (lam_req >= lam_min) & (lam_req <= lam_max)
     lam = jnp.clip(lam_req, lam_min, lam_max)
 
     stats = _chain_stats(lam, cand)
@@ -231,6 +235,7 @@ def analyze_batch(rate_per_s: jax.Array, cand: CandidateBatch) -> dict[str, jax.
         "avg_token_time_ms": itl,
         "avg_ttft_ms": ttft,
         "max_rate_per_s": lam_max * 1000.0,
+        "analyzed_rate_per_s": lam * 1000.0,
         "rho": rho,
     }
 

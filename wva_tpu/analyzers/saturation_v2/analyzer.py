@@ -275,7 +275,7 @@ class SaturationV2Analyzer(Analyzer):
             replicas = by_variant.get(vs.variant_name, [])
             accelerator = variant_accel.get(vs.variant_name, "")
             cost = variant_cost.get(vs.variant_name, DEFAULT_VARIANT_COST)
-            ready_count = max(vs.current_replicas - vs.pending_replicas, 0)
+            ready_count = vs.ready_replicas
 
             per_replica = 0.0
             total_demand = 0.0

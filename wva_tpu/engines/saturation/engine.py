@@ -16,6 +16,7 @@ current replicas are still emitted so the external HPA never starves
 from __future__ import annotations
 
 import logging
+import math
 from dataclasses import dataclass, field
 
 from wva_tpu.actuator import Actuator
@@ -70,6 +71,10 @@ from wva_tpu.utils.variant import namespaced_key
 log = logging.getLogger(__name__)
 
 DEFAULT_ENGINE_POLL_INTERVAL = 30.0  # reference engine.go:147
+# Make-before-break migrations: max time a losing variant may hold its
+# replicas waiting for the winner's slices to become ready (TPU node-pool
+# provisioning upper bound) before forced gradual drain.
+MIGRATION_HOLD_TIMEOUT = 600.0
 
 METRICS_REASON_AVAILABLE = REASON_METRICS_FOUND
 METRICS_REASON_UNAVAILABLE = REASON_METRICS_MISSING
@@ -118,6 +123,9 @@ class SaturationEngine:
         self.slo_analyzer = QueueingModelAnalyzer(clock=self.clock)
         self.slo_tuner = TunerController(self.slo_analyzer.profiles)
         self.optimizer = optimizer or CostAwareOptimizer()
+        # Active make-before-break holds: "ns/model|variant" ->
+        # (hold start time, replicas at hold start, target accelerator).
+        self._migration_holds: dict[str, tuple[float, int, str]] = {}
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock, name="saturation-engine")
 
@@ -480,16 +488,78 @@ class SaturationEngine:
         solution = solve(system, spec)
 
         decisions: list[VariantDecision] = []
+        active_holds: set[str] = set()
         for name, req in req_by_server.items():
             alloc = solution.allocations.get(name)
+            # Exactly ONE variant receives the solution's replica count even
+            # when several VariantAutoscalings share the chosen accelerator
+            # (a legal config) — otherwise the chip budget the solver spent
+            # once would be duplicated per variant. Winner = most READY
+            # replicas (a variant wedged in provisioning must not outrank a
+            # serving one), then most current, then name for determinism.
+            winner = None
+            if alloc is not None and alloc.accelerator:
+                matching = [vs for vs in req.variant_states
+                            if vs.accelerator_name == alloc.accelerator]
+                if matching:
+                    winner = max(matching, key=lambda vs: (
+                        vs.ready_replicas, vs.current_replicas,
+                        vs.variant_name))
+            # Readiness-aware migration: TPU slices take minutes to become
+            # ready, so a cross-variant consolidation must not zero the old
+            # variant while the winner's replicas are still provisioning.
+            # Losing variants decay proportionally to the winner's readiness
+            # (hold all replicas at 0% ready, none at 100%), and a hold
+            # timeout forces one-replica-per-tick drain so a pool too small
+            # to host old + new simultaneously cannot wedge the migration
+            # forever (the freed chips let the winner schedule).
+            migration_ready = True
+            winner_ready = 0
+            if winner is not None:
+                winner_ready = winner.ready_replicas
+                migration_ready = winner_ready >= alloc.num_replicas
+            now = self.clock.now()
             for vs in req.variant_states:
-                if alloc is not None and alloc.accelerator \
-                        and vs.accelerator_name == alloc.accelerator:
+                hold_key = f"{name}|{vs.variant_name}"
+                reason = "global optimizer (fleet assignment)"
+                if alloc is None:
+                    target = vs.current_replicas  # unallocated: hold steady
+                elif winner is not None and vs is winner:
                     target = alloc.num_replicas
-                elif alloc is not None:
+                elif migration_ready or vs.current_replicas == 0:
                     target = 0  # consolidate onto the chosen variant
                 else:
-                    target = vs.current_replicas  # unallocated: hold steady
+                    # Hold timers are scoped to one (variant -> target
+                    # accelerator) migration: a retarget restarts the clock,
+                    # and entries not refreshed this solve are pruned below
+                    # (so a transient no-allocation tick or a deleted model
+                    # can never leave a stale timer that would later charge
+                    # elapsed time to a different migration).
+                    held = self._migration_holds.get(hold_key)
+                    if held is None or held[2] != alloc.accelerator:
+                        held = (now, vs.current_replicas, alloc.accelerator)
+                    self._migration_holds[hold_key] = held
+                    active_holds.add(hold_key)
+                    started, initial, _ = held
+                    shortfall = 1.0 - winner_ready / max(alloc.num_replicas, 1)
+                    decayed = math.ceil(initial * shortfall)
+                    if now - started > MIGRATION_HOLD_TIMEOUT:
+                        # Deadlock escape: drain one replica per tick even
+                        # without winner progress, bounding the capacity dip.
+                        target = max(0, vs.current_replicas - 1)
+                        reason = ("global optimizer (migration hold timed "
+                                  f"out after {MIGRATION_HOLD_TIMEOUT:.0f}s; "
+                                  "draining to unblock the winner)")
+                        log.warning(
+                            "Migration of %s to %s stuck %ds (winner ready "
+                            "%d/%d); force-draining %s", name,
+                            alloc.accelerator, int(now - started),
+                            winner_ready, alloc.num_replicas, vs.variant_name)
+                    else:
+                        target = min(vs.current_replicas, decayed)
+                        reason = ("global optimizer (holding replicas until "
+                                  f"{alloc.accelerator} reports "
+                                  f"{alloc.num_replicas} ready)")
                 d = VariantDecision(
                     variant_name=vs.variant_name, namespace=req.namespace,
                     model_id=req.model_id,
@@ -502,8 +572,13 @@ class SaturationEngine:
                     action=(ACTION_SCALE_UP if target > vs.current_replicas
                             else ACTION_SCALE_DOWN if target < vs.current_replicas
                             else ACTION_NO_CHANGE),
-                    reason="global optimizer (fleet assignment)")
+                    reason=reason)
                 decisions.append(d)
+        # Prune holds that did not re-assert themselves this solve (migration
+        # completed, model unallocated/deleted, or retargeted under a new
+        # key): keeps the map bounded and timers honest.
+        self._migration_holds = {
+            k: v for k, v in self._migration_holds.items() if k in active_holds}
         return decisions
 
     def _run_slo_analysis(self, model_id: str, namespace: str, data: _ModelData,
@@ -553,8 +628,7 @@ class SaturationEngine:
         # authoritative ready-replica count from variant states (replicas
         # with missing metrics still serve traffic).
         total_replicas = max(
-            sum(max(vs.current_replicas - vs.pending_replicas, 0)
-                for vs in data.variant_states), 1)
+            sum(vs.ready_replicas for vs in data.variant_states), 1)
         for accelerator, rms in by_accel.items():
             profile = self.slo_analyzer.profiles.get(
                 model_id, accelerator, namespace=namespace)

@@ -179,6 +179,11 @@ def build_candidates(
         replicas.append(r)
         per_replica_rate.append(total_rate / r)
 
+    # Rates below a candidate's lam_min are clamped up inside analyze_batch
+    # (metrics["valid"] is False there): the reported latencies are then an
+    # UPPER bound on the true low-traffic latency, which is conservative for
+    # the allocations' informational itl/ttft fields — replica sizing comes
+    # from rate_star above, never from these metrics.
     metrics = analyze_batch(jnp.asarray(per_replica_rate, jnp.float32), cand)
     itl_arr = np.asarray(metrics["avg_token_time_ms"]).tolist()
     ttft_arr = (np.asarray(metrics["avg_wait_time_ms"])

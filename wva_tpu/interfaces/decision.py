@@ -86,6 +86,11 @@ class VariantReplicaState:
     # become ready together (SURVEY.md section 7 "hard parts" #2).
     hosts_per_slice: int = 1
 
+    @property
+    def ready_replicas(self) -> int:
+        """Replicas actually serving (slice provisioned + model loaded)."""
+        return max(self.current_replicas - self.pending_replicas, 0)
+
 
 @dataclass
 class VariantDecision:

@@ -58,6 +58,12 @@ class LeaderElector:
         self._mu = threading.Lock()
         self._leader = False
         self._renewed_at = -1e18
+        # Local observation of the remote lease record, for skew-safe expiry
+        # (client-go semantics): a lease only expires after THIS process has
+        # watched it go unchanged for a full lease_duration on its own clock,
+        # never by comparing another replica's renew_time to our clock.
+        self._observed_record: tuple[str, float] | None = None
+        self._observed_at = -1e18
         self.on_started_leading = None  # optional callbacks
         self.on_stopped_leading = None
 
@@ -91,7 +97,11 @@ class LeaderElector:
                 self._became_leader(now, "acquired (new lease)")
                 return True
 
-            expired = now - lease.renew_time > cfg.lease_duration
+            record = (lease.holder_identity, lease.renew_time)
+            if record != self._observed_record:
+                self._observed_record = record
+                self._observed_at = now
+            expired = now - self._observed_at > cfg.lease_duration
             if lease.holder_identity == self.identity:
                 lease.renew_time = now
                 self.client.update(lease)
