@@ -1,0 +1,182 @@
+"""``python -m wva_tpu`` — the controller process entry point.
+
+Mirrors the reference's flag surface and startup order
+(``cmd/main.go:83-520``): flags > env > config file > defaults through the
+unified loader; fail-fast on invalid config and unreachable Prometheus;
+REST client against the API server (kubeconfig or in-cluster); ConfigMap
+bootstrap before readiness; engines leader-gated; ``/metrics`` +
+``/healthz`` + ``/readyz`` served over HTTP(S).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+log = logging.getLogger("wva_tpu")
+
+# Reference verbosity convention (internal/logging/logger.go:13-37):
+# -v 2 DEFAULT / 3 VERBOSE / 4 DEBUG / 5 TRACE.
+_VERBOSITY_LEVELS = {0: logging.WARNING, 1: logging.INFO, 2: logging.INFO,
+                     3: logging.INFO, 4: logging.DEBUG, 5: logging.DEBUG}
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="wva-tpu",
+        description="TPU-native workload variant autoscaler controller")
+    p.add_argument("--config", default="", metavar="PATH",
+                   help="optional YAML config file (lowest precedence "
+                        "after flags and env)")
+    p.add_argument("--metrics-bind-address", default=None,
+                   help='metrics endpoint bind address (":8443", "0" to '
+                        "disable)")
+    p.add_argument("--health-probe-bind-address", default=None,
+                   help='health probe bind address (":8081")')
+    p.add_argument("--leader-elect", action="store_true", default=None,
+                   help="enable leader election for controller manager")
+    p.add_argument("--metrics-secure", dest="metrics_secure",
+                   action="store_true", default=None,
+                   help="serve metrics over TLS (requires cert path)")
+    p.add_argument("--metrics-cert-path", default=None,
+                   help="directory containing the metrics TLS certificate")
+    p.add_argument("--metrics-cert-name", default=None,
+                   help="metrics TLS certificate file name (tls.crt)")
+    p.add_argument("--metrics-cert-key", default=None,
+                   help="metrics TLS key file name (tls.key)")
+    p.add_argument("--kubeconfig", default="",
+                   help="path to kubeconfig (default: in-cluster, then "
+                        "~/.kube/config)")
+    p.add_argument("--context", default="", help="kubeconfig context")
+    p.add_argument("--namespace", default=None,
+                   help="restrict watches to one namespace")
+    p.add_argument("--skip-prometheus-validation", action="store_true",
+                   help="do not fail startup when Prometheus is unreachable")
+    p.add_argument("-v", "--verbosity", type=int, default=None,
+                   help="log verbosity (2 default, 3 verbose, 4 debug, "
+                        "5 trace)")
+    return p
+
+
+def setup_logging(verbosity: int) -> None:
+    logging.basicConfig(
+        level=_VERBOSITY_LEVELS.get(max(0, min(verbosity, 5)), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        stream=sys.stderr)
+
+
+def flags_from_args(args: argparse.Namespace) -> dict:
+    """argparse values -> the loader's env-style keys (None = not set)."""
+    return {
+        "METRICS_BIND_ADDRESS": args.metrics_bind_address,
+        "HEALTH_PROBE_BIND_ADDRESS": args.health_probe_bind_address,
+        "LEADER_ELECT": args.leader_elect,
+        "METRICS_SECURE": args.metrics_secure,
+        "METRICS_CERT_PATH": args.metrics_cert_path,
+        "METRICS_CERT_NAME": args.metrics_cert_name,
+        "METRICS_CERT_KEY": args.metrics_cert_key,
+        "WATCH_NAMESPACE": args.namespace,
+        "V": args.verbosity,
+    }
+
+
+def validate_prometheus(cfg, fatal: bool) -> None:
+    """Connectivity check, fatal like the reference (cmd/main.go:371-374)."""
+    from wva_tpu.collector.source import HTTPPromAPI
+
+    url = cfg.prometheus_base_url()
+    if not url:
+        if fatal:
+            log.error("PROMETHEUS_BASE_URL is required")
+            raise SystemExit(1)
+        return
+    api = HTTPPromAPI(url, bearer_token=cfg.prometheus_bearer_token())
+    try:
+        api.query("vector(1)")
+        log.info("Prometheus API validated at %s", url)
+    except Exception as e:  # noqa: BLE001 — connectivity failure
+        if fatal:
+            log.error("Prometheus unreachable at %s: %s", url, e)
+            raise SystemExit(1) from None
+        log.warning("Prometheus unreachable at %s: %s (continuing)", url, e)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    setup_logging(args.verbosity if args.verbosity is not None else 2)
+
+    from wva_tpu.config import load
+    from wva_tpu.k8s.kubeconfig import CredentialError, resolve_credentials
+    from wva_tpu.k8s.rest import RestKubeClient
+    from wva_tpu.main import build_manager
+    from wva_tpu.serving import HTTPEndpoints
+
+    try:
+        cfg = load(flags=flags_from_args(args), config_file_path=args.config)
+    except Exception as e:  # noqa: BLE001 — fail fast like the reference
+        log.error("configuration invalid: %s", e)
+        return 1
+    if args.verbosity is None:
+        setup_logging(cfg.logger_verbosity())
+
+    try:
+        creds = resolve_credentials(args.kubeconfig or None,
+                                    args.context or None)
+    except CredentialError as e:
+        log.error("no API server credentials: %s", e)
+        return 1
+    client = RestKubeClient(creds)
+    try:
+        client.list("Namespace")
+    except Exception as e:  # noqa: BLE001 — fail fast
+        log.error("API server unreachable at %s: %s", creds.server, e)
+        return 1
+    log.info("Connected to API server %s", creds.server)
+
+    validate_prometheus(cfg, fatal=not args.skip_prometheus_validation)
+
+    mgr = build_manager(client, cfg)
+    mgr.setup()
+
+    tls_cert = tls_key = ""
+    with cfg._mu:
+        infra, tls = cfg.infrastructure, cfg.tls
+    if infra.secure_metrics and tls.metrics_cert_path:
+        tls_cert = f"{tls.metrics_cert_path}/{tls.metrics_cert_name or 'tls.crt'}"
+        tls_key = f"{tls.metrics_cert_path}/{tls.metrics_cert_key or 'tls.key'}"
+    endpoints = HTTPEndpoints(
+        render_metrics=mgr.registry.render_text,
+        healthz=mgr.healthz, readyz=mgr.readyz,
+        metrics_addr=cfg.metrics_addr() or ":8443",
+        health_addr=cfg.probe_addr() or ":8081",
+        tls_cert_file=tls_cert, tls_key_file=tls_key,
+    ).start()
+    metrics_port, health_port = endpoints.ports()
+    log.info("Serving /metrics on :%d and /healthz /readyz on :%d",
+             metrics_port, health_port)
+
+    stop = threading.Event()
+
+    def _signal_handler(signum, frame):  # noqa: ARG001
+        log.info("Received signal %d; shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _signal_handler)
+    signal.signal(signal.SIGINT, _signal_handler)
+
+    mgr.start(stop)
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        mgr.shutdown()  # voluntary leader step-down (ReleaseOnCancel)
+        client.stop()
+        endpoints.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,7 +117,11 @@ class FakeCluster(KubeClient):
     def _dispatch(self, event: str, obj: Any) -> None:
         # Handlers are isolated: one throwing must not break the writer or
         # starve later handlers (controller-runtime event-handler semantics).
-        for handler in self._watchers.get(_kind_of(obj), []):
+        # Snapshot under the lock: unwatch() may mutate the list concurrently
+        # (e.g. a fake-apiserver watch stream detaching mid-dispatch).
+        with self._mu:
+            handlers = list(self._watchers.get(_kind_of(obj), []))
+        for handler in handlers:
             try:
                 handler(event, _copy(obj))
             except Exception:  # noqa: BLE001
@@ -244,6 +248,14 @@ class FakeCluster(KubeClient):
     def watch(self, kind: str, handler: WatchHandler) -> None:
         with self._mu:
             self._watchers.setdefault(kind, []).append(handler)
+
+    def unwatch(self, kind: str, handler: WatchHandler) -> None:
+        """Unregister a watch handler (no-op if absent) — lets transient
+        consumers like the fake API server's watch streams detach."""
+        with self._mu:
+            handlers = self._watchers.get(kind, [])
+            if handler in handlers:
+                handlers.remove(handler)
 
     # --- conveniences for tests/emulator ---
 

@@ -1,0 +1,335 @@
+"""An in-process HTTP API server speaking the Kubernetes REST subset the
+framework uses, backed by a :class:`FakeCluster`.
+
+This is the envtest analogue (reference test tier 3, SURVEY.md §4: a real
+apiserver+etcd without kubelets): :class:`RestKubeClient` and the full
+manager can be exercised over genuine HTTP — serialization, status/scale
+subresources, optimistic-concurrency conflicts, label selectors, watch
+streams — while the emulation harness still drives the world underneath
+through the same FakeCluster.
+
+Supported surface (what the controller actually calls):
+
+- ``GET/POST`` collection paths, ``GET/PUT/DELETE`` item paths for every
+  kind in :func:`wva_tpu.k8s.serde.known_kinds`, core and group APIs;
+- ``?labelSelector=k=v,...`` on lists;
+- ``?watch=true`` streaming (line-delimited JSON watch events, fed live
+  from FakeCluster's dispatch);
+- ``PUT .../status`` and ``GET/PATCH .../scale`` subresources;
+- 404/409 error bodies shaped like metav1.Status;
+- optional bearer-token auth.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from wva_tpu.k8s import serde
+from wva_tpu.k8s.client import ConflictError, FakeCluster, NotFoundError
+
+log = logging.getLogger(__name__)
+
+# Path shapes (namespaced and cluster-scoped, core and group APIs).
+_PATH_RE = re.compile(
+    r"^(?:/api/v1|/apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
+    r"(?:/namespaces/(?P<namespace>[^/]+))?"
+    r"/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?"
+    r"(?:/(?P<subresource>status|scale))?$"
+)
+
+
+def _plural_index() -> dict[tuple[str, str], str]:
+    """(group, plural) -> kind, for request routing."""
+    idx: dict[tuple[str, str], str] = {}
+    for kind in serde.known_kinds():
+        gvr = serde.gvr_for(kind)
+        idx[(gvr.group, gvr.plural)] = kind
+        if kind == "InferencePool":  # both API groups route here
+            idx[("inference.networking.k8s.io", "inferencepools")] = kind
+            idx[("inference.networking.x-k8s.io", "inferencepools")] = kind
+    return idx
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "wva-fake-apiserver"
+    protocol_version = "HTTP/1.1"
+
+    # injected via subclassing in FakeAPIServer
+    cluster: FakeCluster = None
+    plurals: dict[tuple[str, str], str] = {}
+    bearer_token: str = ""
+
+    # --- helpers ---
+
+    def _send_json(self, status: int, body: dict) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_status_error(self, code: int, reason: str, message: str) -> None:
+        self._send_json(code, {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": reason, "message": message, "code": code})
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _route(self):
+        """Returns (kind, namespace, name, subresource, query) or None."""
+        parsed = urlparse(self.path)
+        m = _PATH_RE.match(parsed.path)
+        if not m:
+            self._send_status_error(404, "NotFound",
+                                    f"unknown path {parsed.path}")
+            return None
+        group = m.group("group") or ""
+        kind = self.plurals.get((group, m.group("plural")))
+        if kind is None:
+            self._send_status_error(
+                404, "NotFound",
+                f"resource {m.group('plural')} in group {group!r} not served")
+            return None
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        return (kind, m.group("namespace") or "", m.group("name") or "",
+                m.group("subresource") or "", query)
+
+    def _authorized(self) -> bool:
+        if not self.bearer_token:
+            return True
+        if self.headers.get("Authorization") == f"Bearer {self.bearer_token}":
+            return True
+        self._send_status_error(401, "Unauthorized", "invalid bearer token")
+        return False
+
+    @staticmethod
+    def _label_selector(query: dict[str, str]) -> dict[str, str] | None:
+        raw = query.get("labelSelector", "")
+        if not raw:
+            return None
+        selector = {}
+        for pair in raw.split(","):
+            if "=" in pair:
+                k, _, v = pair.partition("=")
+                selector[k.lstrip("=")] = v
+        return selector
+
+    # --- verbs ---
+
+    def do_GET(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
+        routed = self._route()
+        if routed is None:
+            return
+        kind, ns, name, sub, query = routed
+        try:
+            if name and sub == "scale":
+                obj = self.cluster.get(kind, ns, name)
+                replicas = getattr(obj, "replicas", 0) or 0
+                self._send_json(200, {
+                    "kind": "Scale", "apiVersion": "autoscaling/v1",
+                    "metadata": {"name": name, "namespace": ns},
+                    "spec": {"replicas": replicas},
+                    "status": {"replicas": replicas}})
+            elif name:
+                self._send_json(200, serde.to_k8s(self.cluster.get(kind, ns, name)))
+            elif query.get("watch") == "true":
+                self._serve_watch(kind, query)
+            else:
+                objs = self.cluster.list(kind, namespace=ns or None,
+                                         label_selector=self._label_selector(query))
+                gvr = serde.gvr_for(kind)
+                self._send_json(200, {
+                    "kind": f"{kind}List", "apiVersion": gvr.api_version,
+                    "metadata": {"resourceVersion": str(self.cluster._rv)},
+                    "items": [serde.to_k8s(o) for o in objs]})
+        except NotFoundError as e:
+            self._send_status_error(404, "NotFound", str(e))
+
+    def do_POST(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
+        routed = self._route()
+        if routed is None:
+            return
+        kind, ns, _, _, _ = routed
+        try:
+            obj = serde.from_k8s(kind, self._read_body())
+            if ns:
+                obj.metadata.namespace = ns
+            created = self.cluster.create(obj)
+            self._send_json(201, serde.to_k8s(created))
+        except ConflictError as e:
+            self._send_status_error(409, "AlreadyExists", str(e))
+
+    def do_PUT(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
+        routed = self._route()
+        if routed is None:
+            return
+        kind, ns, name, sub, _ = routed
+        try:
+            obj = serde.from_k8s(kind, self._read_body())
+            obj.metadata.namespace = ns or obj.metadata.namespace
+            obj.metadata.name = name or obj.metadata.name
+            if sub == "status":
+                updated = self.cluster.update_status(obj)
+            else:
+                updated = self.cluster.update(obj)
+            self._send_json(200, serde.to_k8s(updated))
+        except NotFoundError as e:
+            self._send_status_error(404, "NotFound", str(e))
+        except ConflictError as e:
+            self._send_status_error(409, "Conflict", str(e))
+
+    def do_PATCH(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
+        routed = self._route()
+        if routed is None:
+            return
+        kind, ns, name, sub, _ = routed
+        body = self._read_body()
+        try:
+            if sub == "scale":
+                replicas = int((body.get("spec") or {}).get("replicas", 0))
+                self.cluster.patch_scale(kind, ns, name, replicas)
+                self._send_json(200, {
+                    "kind": "Scale", "apiVersion": "autoscaling/v1",
+                    "metadata": {"name": name, "namespace": ns},
+                    "spec": {"replicas": replicas},
+                    "status": {"replicas": replicas}})
+            else:
+                self._send_status_error(
+                    405, "MethodNotAllowed",
+                    "only the scale subresource supports PATCH here")
+        except NotFoundError as e:
+            self._send_status_error(404, "NotFound", str(e))
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
+        routed = self._route()
+        if routed is None:
+            return
+        kind, ns, name, _, _ = routed
+        try:
+            self.cluster.delete(kind, ns, name)
+            self._send_json(200, {"kind": "Status", "apiVersion": "v1",
+                                  "status": "Success"})
+        except NotFoundError as e:
+            self._send_status_error(404, "NotFound", str(e))
+
+    # --- watch streaming ---
+
+    def _serve_watch(self, kind: str, query: dict[str, str]) -> None:
+        """Stream watch events. Registers the handler FIRST, then replays
+        every stored object whose resourceVersion is newer than the client's
+        ``resourceVersion`` param as a synthetic ADDED — so mutations landing
+        between the client's initial list and handler registration are not
+        lost (deletes in that gap are still missed, like a real apiserver
+        past its watch cache; delivery is at-least-once, which level-
+        triggered reconcilers tolerate). Honors ``timeoutSeconds`` so each
+        stream — and its thread + watcher registration — is bounded."""
+        events: queue.Queue = queue.Queue(maxsize=1024)
+
+        def on_event(event: str, obj) -> None:
+            try:
+                events.put_nowait((event, obj))
+            except queue.Full:
+                pass  # slow consumer; the client will re-list on gaps
+
+        self.cluster.watch(kind, on_event)
+        try:
+            since_rv = int(query.get("resourceVersion") or 0)
+        except ValueError:
+            since_rv = 0
+        try:
+            timeout_s = float(query.get("timeoutSeconds") or 300)
+        except ValueError:
+            timeout_s = 300.0
+        deadline = time.monotonic() + timeout_s
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def send(event: str, obj) -> None:
+            line = json.dumps(
+                {"type": event, "object": serde.to_k8s(obj)}).encode()
+            chunk = f"{len(line) + 1:x}\r\n".encode() + line + b"\n\r\n"
+            self.wfile.write(chunk)
+            self.wfile.flush()
+
+        try:
+            if since_rv:
+                for obj in self.cluster.list(kind):
+                    try:
+                        obj_rv = int(obj.metadata.resource_version)
+                    except ValueError:
+                        obj_rv = 0
+                    if obj_rv > since_rv:
+                        send("ADDED", obj)
+            while time.monotonic() < deadline:
+                try:
+                    event, obj = events.get(timeout=0.2)
+                except queue.Empty:
+                    if getattr(self.server, "_shutting_down", False):
+                        break
+                    continue
+                send(event, obj)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away
+        finally:
+            self.cluster.unwatch(kind, on_event)
+
+    def log_message(self, fmt: str, *args) -> None:
+        log.debug("fake-apiserver: " + fmt, *args)
+
+
+class FakeAPIServer:
+    """Serve a FakeCluster over HTTP on 127.0.0.1:<port> (0 = ephemeral)."""
+
+    def __init__(self, cluster: FakeCluster, port: int = 0,
+                 bearer_token: str = "") -> None:
+        self.cluster = cluster
+        handler = type("Handler", (_Handler,), {
+            "cluster": cluster,
+            "plurals": _plural_index(),
+            "bearer_token": bearer_token,
+        })
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._server.daemon_threads = True
+        self._server._shutting_down = False
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeAPIServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="fake-apiserver", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._server._shutting_down = True
+        self._server.shutdown()
+        self._server.server_close()

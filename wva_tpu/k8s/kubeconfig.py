@@ -1,0 +1,161 @@
+"""API-server credential resolution: kubeconfig and in-cluster.
+
+The reference delegates this to client-go's config loading
+(``ctrl.GetConfigOrDie`` in ``cmd/main.go:266``); this module implements the
+same two paths the controller actually uses:
+
+- **in-cluster**: service-account token + CA from
+  ``/var/run/secrets/kubernetes.io/serviceaccount`` and the
+  ``KUBERNETES_SERVICE_HOST/PORT`` env (token re-read per request so
+  projected-token rotation is picked up);
+- **kubeconfig**: ``$KUBECONFIG`` or ``~/.kube/config`` — current-context
+  cluster/user with bearer token, token file, client certs (inline base64
+  ``*-data`` or file paths), cluster CA, and ``insecure-skip-tls-verify``.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import ssl
+import tempfile
+from dataclasses import dataclass, field
+
+import yaml
+
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class CredentialError(RuntimeError):
+    pass
+
+
+@dataclass
+class Credentials:
+    """Resolved connection parameters for one API server."""
+
+    server: str  # https://host:port
+    token: str = ""
+    token_file: str = ""  # re-read per request when set (rotation)
+    ca_file: str = ""
+    client_cert_file: str = ""
+    client_key_file: str = ""
+    insecure_skip_tls_verify: bool = False
+    _tmp_files: list[str] = field(default_factory=list)
+
+    def bearer_token(self) -> str:
+        if self.token_file:
+            try:
+                with open(self.token_file, encoding="utf-8") as f:
+                    return f.read().strip()
+            except OSError:
+                return self.token
+        return self.token
+
+    def cleanup(self) -> None:
+        """Remove temp files holding decoded key/cert material (created by
+        kubeconfig loading from inline ``*-data`` blobs). Call on shutdown —
+        private keys must not linger in the temp dir."""
+        for path in self._tmp_files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._tmp_files.clear()
+
+    def ssl_context(self) -> ssl.SSLContext | None:
+        if not self.server.startswith("https"):
+            return None
+        ctx = ssl.create_default_context()
+        if self.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif self.ca_file:
+            ctx.load_verify_locations(cafile=self.ca_file)
+        if self.client_cert_file and self.client_key_file:
+            ctx.load_cert_chain(self.client_cert_file, self.client_key_file)
+        return ctx
+
+
+def _materialize(data_b64: str, suffix: str, creds: Credentials) -> str:
+    """Inline base64 kubeconfig blobs -> temp files (ssl needs file paths)."""
+    fd, path = tempfile.mkstemp(suffix=suffix, prefix="wva-kube-")
+    with os.fdopen(fd, "wb") as f:
+        f.write(base64.b64decode(data_b64))
+    creds._tmp_files.append(path)
+    return path
+
+
+def in_cluster_credentials() -> Credentials:
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    token_file = os.path.join(SERVICEACCOUNT_DIR, "token")
+    ca_file = os.path.join(SERVICEACCOUNT_DIR, "ca.crt")
+    if not host or not os.path.exists(token_file):
+        raise CredentialError(
+            "not running in-cluster (no KUBERNETES_SERVICE_HOST or "
+            "serviceaccount token)")
+    return Credentials(
+        server=f"https://{host}:{port}",
+        token_file=token_file,
+        ca_file=ca_file if os.path.exists(ca_file) else "",
+    )
+
+
+def kubeconfig_credentials(path: str | None = None,
+                           context: str | None = None) -> Credentials:
+    path = path or os.environ.get("KUBECONFIG") or os.path.expanduser(
+        "~/.kube/config")
+    try:
+        with open(path, encoding="utf-8") as f:
+            cfg = yaml.safe_load(f) or {}
+    except OSError as e:
+        raise CredentialError(f"cannot read kubeconfig {path}: {e}") from e
+
+    ctx_name = context or cfg.get("current-context") or ""
+    ctx = next((c.get("context") or {} for c in cfg.get("contexts") or []
+                if c.get("name") == ctx_name), None)
+    if ctx is None:
+        raise CredentialError(f"context {ctx_name!r} not found in {path}")
+    cluster = next((c.get("cluster") or {} for c in cfg.get("clusters") or []
+                    if c.get("name") == ctx.get("cluster")), None)
+    user = next((u.get("user") or {} for u in cfg.get("users") or []
+                 if u.get("name") == ctx.get("user")), {})
+    if cluster is None or not cluster.get("server"):
+        raise CredentialError(f"cluster for context {ctx_name!r} has no server")
+
+    creds = Credentials(
+        server=cluster["server"].rstrip("/"),
+        insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
+    )
+    if cluster.get("certificate-authority"):
+        creds.ca_file = cluster["certificate-authority"]
+    elif cluster.get("certificate-authority-data"):
+        creds.ca_file = _materialize(
+            cluster["certificate-authority-data"], ".crt", creds)
+    creds.token = user.get("token", "")
+    if user.get("tokenFile"):
+        creds.token_file = user["tokenFile"]
+    if user.get("client-certificate"):
+        creds.client_cert_file = user["client-certificate"]
+    elif user.get("client-certificate-data"):
+        creds.client_cert_file = _materialize(
+            user["client-certificate-data"], ".crt", creds)
+    if user.get("client-key"):
+        creds.client_key_file = user["client-key"]
+    elif user.get("client-key-data"):
+        creds.client_key_file = _materialize(
+            user["client-key-data"], ".key", creds)
+    return creds
+
+
+def resolve_credentials(kubeconfig: str | None = None,
+                        context: str | None = None) -> Credentials:
+    """client-go loading-rules order: explicit kubeconfig > $KUBECONFIG >
+    in-cluster > ~/.kube/config."""
+    if kubeconfig or os.environ.get("KUBECONFIG"):
+        return kubeconfig_credentials(kubeconfig, context)
+    try:
+        return in_cluster_credentials()
+    except CredentialError:
+        return kubeconfig_credentials(None, context)

@@ -1,0 +1,293 @@
+"""REST ``KubeClient`` against a real Kubernetes API server.
+
+The production counterpart of :class:`wva_tpu.k8s.client.FakeCluster` — the
+same narrow interface the engines/controllers depend on, spoken over the
+API server's REST surface the way the reference's controller-runtime client
+does (``cmd/main.go:266-303``, ``internal/utils/utils.go:69-123``):
+
+- typed CRUD via the serde codecs (GET/POST/PUT/DELETE on GVR paths);
+- status subresource writes (``PUT .../status``);
+- scale subresource patches (``PATCH .../scale`` with merge-patch), kind-
+  agnostic like the reference DirectActuator (``direct_actuator.go:54-121``);
+- optimistic concurrency: HTTP 409 -> :class:`ConflictError`, 404 ->
+  :class:`NotFoundError` (the two signals the retry/backoff wrappers and the
+  leader elector key on);
+- list+watch streams per kind with automatic re-list on 410 Gone and
+  exponential backoff reconnects, dispatching ADDED/MODIFIED/DELETED to
+  registered handlers exactly like FakeCluster's in-process dispatch.
+
+Everything is stdlib (urllib + ssl + threads): no client library to vendor.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any
+
+from wva_tpu.k8s import serde
+from wva_tpu.k8s.client import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    KubeClient,
+    NotFoundError,
+    WatchHandler,
+    _kind_of,
+)
+from wva_tpu.k8s.kubeconfig import Credentials
+
+log = logging.getLogger(__name__)
+
+DEFAULT_TIMEOUT = 10.0
+WATCH_SERVER_TIMEOUT = 300  # server closes the stream; we reconnect
+WATCH_SOCKET_TIMEOUT = 330.0
+WATCH_BACKOFF_INITIAL = 1.0
+WATCH_BACKOFF_MAX = 30.0
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, message: str) -> None:
+        self.status = status
+        super().__init__(f"API server returned {status}: {message}")
+
+
+class RestKubeClient(KubeClient):
+    def __init__(self, credentials: Credentials,
+                 timeout: float = DEFAULT_TIMEOUT) -> None:
+        self.credentials = credentials
+        self.timeout = timeout
+        self._ssl = credentials.ssl_context()
+        self._mu = threading.Lock()
+        self._watchers: dict[str, list[WatchHandler]] = {}
+        self._watch_threads: dict[str, threading.Thread] = {}
+        self._stop = threading.Event()
+
+    # --- HTTP plumbing ---
+
+    def _request(self, method: str, path: str,
+                 query: dict[str, str] | None = None,
+                 body: dict | None = None,
+                 content_type: str = "application/json",
+                 timeout: float | None = None,
+                 stream: bool = False):
+        url = self.credentials.server + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        token = self.credentials.bearer_token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout or self.timeout, context=self._ssl)
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode(errors="replace")[:2048]
+            except Exception:  # noqa: BLE001
+                pass
+            raise ApiError(e.code, detail or e.reason) from None
+        if stream:
+            return resp
+        with resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+    @staticmethod
+    def _map_error(e: ApiError, kind: str, namespace: str, name: str):
+        if e.status == 404:
+            return NotFoundError(kind, namespace or "", name)
+        if e.status == 409:
+            return ConflictError(str(e))
+        return e
+
+    def _obj_path(self, kind: str, namespace: str, name: str | None = None,
+                  subresource: str | None = None) -> str:
+        return serde.gvr_for(kind).path(namespace, name, subresource)
+
+    # --- KubeClient ---
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        try:
+            d = self._request("GET", self._obj_path(kind, namespace, name))
+        except ApiError as e:
+            raise self._map_error(e, kind, namespace, name) from None
+        return serde.from_k8s(kind, d)
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Any | None:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None) -> list[Any]:
+        query: dict[str, str] = {}
+        if label_selector:
+            query["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(label_selector.items()))
+        try:
+            d = self._request("GET", self._obj_path(kind, namespace or ""),
+                              query=query or None)
+        except ApiError as e:
+            raise self._map_error(e, kind, namespace or "", "") from None
+        return [serde.from_k8s(kind, item) for item in d.get("items") or []]
+
+    def create(self, obj: Any) -> Any:
+        kind = _kind_of(obj)
+        ns, name = obj.metadata.namespace, obj.metadata.name
+        try:
+            d = self._request("POST", self._obj_path(kind, ns),
+                              body=serde.to_k8s(obj))
+        except ApiError as e:
+            raise self._map_error(e, kind, ns, name) from None
+        return serde.from_k8s(kind, d)
+
+    def update(self, obj: Any) -> Any:
+        kind = _kind_of(obj)
+        ns, name = obj.metadata.namespace, obj.metadata.name
+        try:
+            d = self._request("PUT", self._obj_path(kind, ns, name),
+                              body=serde.to_k8s(obj))
+        except ApiError as e:
+            raise self._map_error(e, kind, ns, name) from None
+        return serde.from_k8s(kind, d)
+
+    def update_status(self, obj: Any) -> Any:
+        kind = _kind_of(obj)
+        ns, name = obj.metadata.namespace, obj.metadata.name
+        try:
+            d = self._request("PUT", self._obj_path(kind, ns, name, "status"),
+                              body=serde.to_k8s(obj))
+        except ApiError as e:
+            if e.status == 404 and "the server could not find" in str(e):
+                # Kinds without a registered status subresource: fall back to
+                # a full update (FakeCluster allows status writes generically).
+                return self.update(obj)
+            raise self._map_error(e, kind, ns, name) from None
+        return serde.from_k8s(kind, d)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        try:
+            self._request("DELETE", self._obj_path(kind, namespace, name))
+        except ApiError as e:
+            raise self._map_error(e, kind, namespace, name) from None
+
+    def patch_scale(self, kind: str, namespace: str, name: str,
+                    replicas: int) -> None:
+        """Merge-patch the scale subresource — works for any scalable kind
+        (Deployment, LeaderWorkerSet, CRDs with scale), matching the
+        reference's unstructured scale handling."""
+        try:
+            self._request(
+                "PATCH", self._obj_path(kind, namespace, name, "scale"),
+                body={"spec": {"replicas": int(replicas)}},
+                content_type="application/merge-patch+json")
+        except ApiError as e:
+            raise self._map_error(e, kind, namespace, name) from None
+
+    # --- watch ---
+
+    def watch(self, kind: str, handler: WatchHandler) -> None:
+        """Register a handler and ensure a list+watch stream runs for kind.
+        Handler semantics match FakeCluster: invoked on every ADDED/MODIFIED/
+        DELETED after registration; exceptions are isolated."""
+        with self._mu:
+            self._watchers.setdefault(kind, []).append(handler)
+            if kind not in self._watch_threads:
+                t = threading.Thread(target=self._watch_loop, args=(kind,),
+                                     name=f"watch-{kind}", daemon=True)
+                self._watch_threads[kind] = t
+                t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.credentials.cleanup()
+
+    def _dispatch(self, kind: str, event: str, obj: Any) -> None:
+        with self._mu:
+            handlers = list(self._watchers.get(kind, []))
+        for handler in handlers:
+            try:
+                handler(event, obj)
+            except Exception:  # noqa: BLE001 — handler isolation
+                log.exception("watch handler failed for %s %s", event, kind)
+
+    def _watch_loop(self, kind: str) -> None:
+        backoff = WATCH_BACKOFF_INITIAL
+        rv = ""
+        while not self._stop.is_set():
+            try:
+                if not rv:
+                    # (Re)list to obtain a consistent resourceVersion; no
+                    # synthetic events (FakeCluster watch semantics: only
+                    # subsequent changes dispatch).
+                    d = self._request("GET", self._obj_path(kind, ""))
+                    rv = (d.get("metadata") or {}).get("resourceVersion", "")
+                rv = self._stream_watch(kind, rv)
+                backoff = WATCH_BACKOFF_INITIAL
+            except ApiError as e:
+                if e.status == 410:  # Gone: resourceVersion too old
+                    rv = ""
+                    continue
+                log.warning("watch %s failed (%s); retrying in %.0fs",
+                            kind, e, backoff)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, WATCH_BACKOFF_MAX)
+            except (OSError, socket.timeout, json.JSONDecodeError) as e:
+                # Normal stream end / server outage: reconnect with the same
+                # growing backoff as API errors (a down server must not be
+                # hammered at a constant rate).
+                log.debug("watch %s stream ended (%s); reconnecting in %.0fs",
+                          kind, e, backoff)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, WATCH_BACKOFF_MAX)
+            except Exception:  # noqa: BLE001 — one bad event (e.g. a decode
+                # error from a malformed object another client wrote) must
+                # never permanently kill the kind's only watch thread.
+                log.exception("watch %s hit an unexpected error; re-listing "
+                              "in %.0fs", kind, backoff)
+                rv = ""
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, WATCH_BACKOFF_MAX)
+
+    def _stream_watch(self, kind: str, rv: str) -> str:
+        """One watch stream; returns the last seen resourceVersion."""
+        resp = self._request(
+            "GET", self._obj_path(kind, ""),
+            query={"watch": "true", "resourceVersion": rv,
+                   "allowWatchBookmarks": "true",
+                   "timeoutSeconds": str(WATCH_SERVER_TIMEOUT)},
+            timeout=WATCH_SOCKET_TIMEOUT, stream=True)
+        with resp:
+            for raw in resp:
+                if self._stop.is_set():
+                    break
+                raw = raw.strip()
+                if not raw:
+                    continue
+                evt = json.loads(raw)
+                etype, item = evt.get("type"), evt.get("object") or {}
+                new_rv = (item.get("metadata") or {}).get("resourceVersion")
+                if new_rv:
+                    rv = new_rv
+                if etype == "BOOKMARK":
+                    continue
+                if etype == "ERROR":
+                    code = (item.get("code") or 0)
+                    raise ApiError(int(code) or 500, item.get("message", ""))
+                if etype in (ADDED, MODIFIED, DELETED):
+                    self._dispatch(kind, etype, serde.from_k8s(kind, item))
+        return rv

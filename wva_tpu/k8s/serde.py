@@ -1,0 +1,563 @@
+"""Kubernetes wire-format codecs for the framework's typed objects.
+
+The reference gets (de)serialization from client-go's generated types; here
+each kind the framework touches has an explicit ``to_k8s``/``from_k8s`` pair
+mapping the narrow dataclasses in :mod:`wva_tpu.k8s.objects` /
+:mod:`wva_tpu.api.v1alpha1` to the API server's JSON shapes, plus the
+group/version/resource table the REST client uses to build request paths
+(the RESTMapper equivalent; reference ``internal/utils/pool/gvr.go:25``).
+"""
+
+from __future__ import annotations
+
+import base64
+import calendar
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from wva_tpu.api import v1alpha1
+from wva_tpu.api.v1alpha1 import ObjectMeta, VariantAutoscaling
+from wva_tpu.k8s.objects import (
+    ConfigMap,
+    Container,
+    Deployment,
+    DeploymentStatus,
+    Event,
+    ExtensionRef,
+    InferencePool,
+    LeaderWorkerSet,
+    LeaderWorkerSetStatus,
+    Lease,
+    Namespace,
+    Node,
+    NodeStatus,
+    Pod,
+    PodStatus,
+    PodTemplateSpec,
+    ResourceRequirements,
+    Secret,
+    Service,
+    ServiceMonitor,
+)
+
+
+def rfc3339(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+def rfc3339_micro(ts: float) -> str:
+    """metav1.MicroTime (Lease acquire/renew times)."""
+    whole = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(ts))
+    return f"{whole}.{int((ts % 1) * 1e6):06d}Z"
+
+
+def parse_rfc3339(s: str | None) -> float:
+    if not s:
+        return 0.0
+    base, frac = s.rstrip("Z"), 0.0
+    if "." in base:
+        base, frac_s = base.split(".", 1)
+        try:
+            frac = float("0." + frac_s)
+        except ValueError:
+            frac = 0.0
+    try:
+        return calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S")) + frac
+    except ValueError:
+        return 0.0
+
+
+# --- GVR table ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GVR:
+    group: str  # "" = core
+    version: str
+    plural: str
+    namespaced: bool = True
+
+    @property
+    def api_prefix(self) -> str:
+        if self.group:
+            return f"/apis/{self.group}/{self.version}"
+        return f"/api/{self.version}"
+
+    def path(self, namespace: str | None = None, name: str | None = None,
+             subresource: str | None = None) -> str:
+        parts = [self.api_prefix]
+        if self.namespaced and namespace:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(self.plural)
+        if name:
+            parts.append(name)
+        if subresource:
+            parts.append(subresource)
+        return "/".join(parts)
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+
+_GVRS: dict[str, GVR] = {
+    "Pod": GVR("", "v1", "pods"),
+    "Service": GVR("", "v1", "services"),
+    "ConfigMap": GVR("", "v1", "configmaps"),
+    "Secret": GVR("", "v1", "secrets"),
+    "Event": GVR("", "v1", "events"),
+    "Node": GVR("", "v1", "nodes", namespaced=False),
+    "Namespace": GVR("", "v1", "namespaces", namespaced=False),
+    "Deployment": GVR("apps", "v1", "deployments"),
+    "Lease": GVR("coordination.k8s.io", "v1", "leases"),
+    "ServiceMonitor": GVR("monitoring.coreos.com", "v1", "servicemonitors"),
+    "LeaderWorkerSet": GVR("leaderworkerset.x-k8s.io", "v1", "leaderworkersets"),
+    "VariantAutoscaling": GVR(v1alpha1.GROUP, v1alpha1.VERSION, v1alpha1.PLURAL),
+}
+
+
+def gvr_for(kind: str) -> GVR:
+    """Resolve the request path components for a kind. InferencePool's group
+    is env-switchable like the reference's POOL_GROUP (``cmd/main.go:444-449``):
+    ``inference.networking.k8s.io`` (v1, default) or
+    ``inference.networking.x-k8s.io`` (v1alpha2)."""
+    if kind == "InferencePool":
+        group = os.environ.get("POOL_GROUP", "inference.networking.k8s.io")
+        version = "v1alpha2" if group.endswith("x-k8s.io") else "v1"
+        return GVR(group, version, "inferencepools")
+    try:
+        return _GVRS[kind]
+    except KeyError:
+        raise TypeError(f"no GVR mapping for kind {kind!r}") from None
+
+
+# --- ObjectMeta --------------------------------------------------------------
+
+
+def _meta_to_k8s(meta: ObjectMeta, namespaced: bool = True) -> dict[str, Any]:
+    d = meta.to_dict()
+    if not namespaced:
+        d.pop("namespace", None)
+    # A zero resourceVersion means "never read from a server" and must be
+    # omitted on the wire (the API server rejects rv "0" on update).
+    if d.get("resourceVersion") in ("", "0"):
+        d.pop("resourceVersion", None)
+    d.pop("generation", None)  # server-managed
+    return d
+
+
+def _meta_from_k8s(d: dict[str, Any]) -> ObjectMeta:
+    return ObjectMeta.from_dict(d or {})
+
+
+# --- pod template / containers ----------------------------------------------
+
+
+def _container_to_k8s(c: Container) -> dict[str, Any]:
+    d: dict[str, Any] = {"name": c.name}
+    if c.image:
+        d["image"] = c.image
+    if c.command:
+        d["command"] = list(c.command)
+    if c.args:
+        d["args"] = list(c.args)
+    if c.env:
+        d["env"] = [{"name": k, "value": v} for k, v in c.env.items()]
+    res: dict[str, Any] = {}
+    if c.resources.requests:
+        res["requests"] = dict(c.resources.requests)
+    if c.resources.limits:
+        res["limits"] = dict(c.resources.limits)
+    if res:
+        d["resources"] = res
+    if c.ports:
+        d["ports"] = [{"name": n, "containerPort": p} for n, p in c.ports.items()]
+    return d
+
+
+def _container_from_k8s(d: dict[str, Any]) -> Container:
+    res = d.get("resources") or {}
+    return Container(
+        name=d.get("name", ""),
+        image=d.get("image", ""),
+        command=list(d.get("command") or []),
+        args=list(d.get("args") or []),
+        env={e.get("name", ""): e.get("value", "")
+             for e in d.get("env") or [] if e.get("name")},
+        resources=ResourceRequirements(
+            requests={k: str(v) for k, v in (res.get("requests") or {}).items()},
+            limits={k: str(v) for k, v in (res.get("limits") or {}).items()}),
+        ports={p.get("name", ""): int(p.get("containerPort", 0))
+               for p in d.get("ports") or [] if p.get("name")},
+    )
+
+
+def _template_to_k8s(t: PodTemplateSpec) -> dict[str, Any]:
+    spec: dict[str, Any] = {
+        "containers": [_container_to_k8s(c) for c in t.containers]}
+    if t.init_containers:
+        spec["initContainers"] = [_container_to_k8s(c) for c in t.init_containers]
+    if t.node_selector:
+        spec["nodeSelector"] = dict(t.node_selector)
+    meta: dict[str, Any] = {}
+    if t.labels:
+        meta["labels"] = dict(t.labels)
+    if t.annotations:
+        meta["annotations"] = dict(t.annotations)
+    return {"metadata": meta, "spec": spec}
+
+
+def _template_from_k8s(d: dict[str, Any]) -> PodTemplateSpec:
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    return PodTemplateSpec(
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        containers=[_container_from_k8s(c) for c in spec.get("containers") or []],
+        init_containers=[_container_from_k8s(c)
+                         for c in spec.get("initContainers") or []],
+        node_selector=dict(spec.get("nodeSelector") or {}),
+    )
+
+
+# --- per-kind codecs ---------------------------------------------------------
+
+
+def _deployment_to_k8s(o: Deployment) -> dict[str, Any]:
+    spec: dict[str, Any] = {
+        "selector": {"matchLabels": dict(o.selector)},
+        "template": _template_to_k8s(o.template),
+    }
+    if o.replicas is not None:
+        spec["replicas"] = o.replicas
+    return {
+        "apiVersion": o.API_VERSION, "kind": o.KIND,
+        "metadata": _meta_to_k8s(o.metadata), "spec": spec,
+        "status": {"replicas": o.status.replicas,
+                   "readyReplicas": o.status.ready_replicas,
+                   "updatedReplicas": o.status.updated_replicas},
+    }
+
+
+def _deployment_from_k8s(d: dict[str, Any]) -> Deployment:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    return Deployment(
+        metadata=_meta_from_k8s(d.get("metadata")),
+        replicas=spec.get("replicas"),
+        selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
+        template=_template_from_k8s(spec.get("template") or {}),
+        status=DeploymentStatus(
+            replicas=int(status.get("replicas") or 0),
+            ready_replicas=int(status.get("readyReplicas") or 0),
+            updated_replicas=int(status.get("updatedReplicas") or 0)),
+    )
+
+
+def _pod_to_k8s(o: Pod) -> dict[str, Any]:
+    d = _template_to_k8s(o.spec)
+    spec = d["spec"]
+    if o.node_name:
+        spec["nodeName"] = o.node_name
+    meta = _meta_to_k8s(o.metadata)
+    # Pod labels live on metadata (the template's labels ARE the pod's).
+    if o.spec.labels and "labels" not in meta:
+        meta["labels"] = dict(o.spec.labels)
+    conditions = [{"type": "Ready",
+                   "status": "True" if o.status.ready else "False"}]
+    return {
+        "apiVersion": "v1", "kind": "Pod", "metadata": meta, "spec": spec,
+        "status": {"phase": o.status.phase, "podIP": o.status.pod_ip,
+                   "conditions": conditions},
+    }
+
+
+def _pod_from_k8s(d: dict[str, Any]) -> Pod:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    meta = _meta_from_k8s(d.get("metadata"))
+    ready = any(c.get("type") == "Ready" and c.get("status") == "True"
+                for c in status.get("conditions") or [])
+    template = _template_from_k8s({"metadata": {"labels": dict(meta.labels)},
+                                   "spec": spec})
+    return Pod(
+        metadata=meta, spec=template,
+        node_name=spec.get("nodeName", ""),
+        status=PodStatus(phase=status.get("phase", "Pending"), ready=ready,
+                         pod_ip=status.get("podIP", "")),
+    )
+
+
+def _node_to_k8s(o: Node) -> dict[str, Any]:
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": _meta_to_k8s(o.metadata, namespaced=False),
+        "status": {
+            "capacity": dict(o.status.capacity),
+            "allocatable": dict(o.status.allocatable),
+            "conditions": [{"type": "Ready",
+                            "status": "True" if o.ready else "False"}],
+        },
+    }
+
+
+def _node_from_k8s(d: dict[str, Any]) -> Node:
+    status = d.get("status") or {}
+    ready = any(c.get("type") == "Ready" and c.get("status") == "True"
+                for c in status.get("conditions") or [])
+    return Node(
+        metadata=_meta_from_k8s(d.get("metadata")),
+        status=NodeStatus(
+            capacity={k: str(v) for k, v in (status.get("capacity") or {}).items()},
+            allocatable={k: str(v)
+                         for k, v in (status.get("allocatable") or {}).items()}),
+        ready=ready,
+    )
+
+
+def _configmap_to_k8s(o: ConfigMap) -> dict[str, Any]:
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": _meta_to_k8s(o.metadata), "data": dict(o.data)}
+
+
+def _configmap_from_k8s(d: dict[str, Any]) -> ConfigMap:
+    return ConfigMap(metadata=_meta_from_k8s(d.get("metadata")),
+                     data={k: str(v) for k, v in (d.get("data") or {}).items()})
+
+
+def _secret_to_k8s(o: Secret) -> dict[str, Any]:
+    return {"apiVersion": "v1", "kind": "Secret",
+            "metadata": _meta_to_k8s(o.metadata),
+            "data": {k: base64.b64encode(v.encode()).decode()
+                     for k, v in o.data.items()}}
+
+
+def _secret_from_k8s(d: dict[str, Any]) -> Secret:
+    data = {}
+    for k, v in (d.get("data") or {}).items():
+        try:
+            data[k] = base64.b64decode(v).decode()
+        except Exception:  # noqa: BLE001 — undecodable entries skipped
+            continue
+    for k, v in (d.get("stringData") or {}).items():
+        data[k] = str(v)
+    return Secret(metadata=_meta_from_k8s(d.get("metadata")), data=data)
+
+
+def _service_to_k8s(o: Service) -> dict[str, Any]:
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": _meta_to_k8s(o.metadata),
+        "spec": {"selector": dict(o.selector),
+                 "ports": [{"name": n, "port": p} for n, p in o.ports.items()]},
+    }
+
+
+def _service_from_k8s(d: dict[str, Any]) -> Service:
+    spec = d.get("spec") or {}
+    return Service(
+        metadata=_meta_from_k8s(d.get("metadata")),
+        selector=dict(spec.get("selector") or {}),
+        ports={p.get("name", ""): int(p.get("port", 0))
+               for p in spec.get("ports") or [] if p.get("name")},
+    )
+
+
+def _namespace_to_k8s(o: Namespace) -> dict[str, Any]:
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": _meta_to_k8s(o.metadata, namespaced=False)}
+
+
+def _namespace_from_k8s(d: dict[str, Any]) -> Namespace:
+    return Namespace(metadata=_meta_from_k8s(d.get("metadata")))
+
+
+def _lease_to_k8s(o: Lease) -> dict[str, Any]:
+    spec: dict[str, Any] = {
+        "holderIdentity": o.holder_identity,
+        "leaseDurationSeconds": o.lease_duration_seconds,
+        "leaseTransitions": o.lease_transitions,
+    }
+    if o.acquire_time:
+        spec["acquireTime"] = rfc3339_micro(o.acquire_time)
+    if o.renew_time:
+        spec["renewTime"] = rfc3339_micro(o.renew_time)
+    return {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": _meta_to_k8s(o.metadata), "spec": spec}
+
+
+def _lease_from_k8s(d: dict[str, Any]) -> Lease:
+    spec = d.get("spec") or {}
+    return Lease(
+        metadata=_meta_from_k8s(d.get("metadata")),
+        holder_identity=spec.get("holderIdentity") or "",
+        lease_duration_seconds=int(spec.get("leaseDurationSeconds") or 60),
+        acquire_time=parse_rfc3339(spec.get("acquireTime")),
+        renew_time=parse_rfc3339(spec.get("renewTime")),
+        lease_transitions=int(spec.get("leaseTransitions") or 0),
+    )
+
+
+def _event_to_k8s(o: Event) -> dict[str, Any]:
+    return {
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": _meta_to_k8s(o.metadata),
+        "involvedObject": {"kind": o.involved_kind, "name": o.involved_name,
+                           "namespace": o.involved_namespace},
+        "type": o.type, "reason": o.reason, "message": o.message,
+        "count": o.count,
+        "firstTimestamp": rfc3339(o.first_timestamp) if o.first_timestamp else None,
+        "lastTimestamp": rfc3339(o.last_timestamp) if o.last_timestamp else None,
+        "source": {"component": "workload-variant-autoscaler"},
+    }
+
+
+def _event_from_k8s(d: dict[str, Any]) -> Event:
+    inv = d.get("involvedObject") or {}
+    return Event(
+        metadata=_meta_from_k8s(d.get("metadata")),
+        involved_kind=inv.get("kind", ""),
+        involved_name=inv.get("name", ""),
+        involved_namespace=inv.get("namespace", ""),
+        type=d.get("type", "Normal"),
+        reason=d.get("reason", ""),
+        message=d.get("message", ""),
+        count=int(d.get("count") or 1),
+        first_timestamp=parse_rfc3339(d.get("firstTimestamp")),
+        last_timestamp=parse_rfc3339(d.get("lastTimestamp")),
+    )
+
+
+def _lws_to_k8s(o: LeaderWorkerSet) -> dict[str, Any]:
+    spec: dict[str, Any] = {
+        "leaderWorkerTemplate": {
+            "size": o.size,
+            "workerTemplate": _template_to_k8s(o.template),
+        },
+    }
+    if o.replicas is not None:
+        spec["replicas"] = o.replicas
+    return {
+        "apiVersion": o.API_VERSION, "kind": o.KIND,
+        "metadata": _meta_to_k8s(o.metadata), "spec": spec,
+        "status": {"replicas": o.status.replicas,
+                   "readyReplicas": o.status.ready_replicas},
+    }
+
+
+def _lws_from_k8s(d: dict[str, Any]) -> LeaderWorkerSet:
+    spec = d.get("spec") or {}
+    lwt = spec.get("leaderWorkerTemplate") or {}
+    status = d.get("status") or {}
+    template = _template_from_k8s(lwt.get("workerTemplate") or {})
+    return LeaderWorkerSet(
+        metadata=_meta_from_k8s(d.get("metadata")),
+        replicas=spec.get("replicas"),
+        size=int(lwt.get("size") or 1),
+        selector=dict(template.labels),
+        template=template,
+        status=LeaderWorkerSetStatus(
+            replicas=int(status.get("replicas") or 0),
+            ready_replicas=int(status.get("readyReplicas") or 0)),
+    )
+
+
+def _pool_to_k8s(o: InferencePool) -> dict[str, Any]:
+    gvr = gvr_for("InferencePool")
+    spec: dict[str, Any] = {
+        "selector": {"matchLabels": dict(o.selector)},
+        "targetPortNumber": o.target_port_number,
+        "extensionRef": {"name": o.extension_ref.service_name,
+                         "portNumber": o.extension_ref.port_number},
+    }
+    return {"apiVersion": gvr.api_version, "kind": "InferencePool",
+            "metadata": _meta_to_k8s(o.metadata), "spec": spec}
+
+
+def _pool_from_k8s(d: dict[str, Any]) -> InferencePool:
+    """Accept both the v1 and v1alpha2 shapes (reference pool.go:54-100):
+    selector as matchLabels or flat map; extensionRef or endpointPickerRef;
+    targetPortNumber or targetPorts[0].number."""
+    spec = d.get("spec") or {}
+    selector = spec.get("selector") or {}
+    if "matchLabels" in selector:
+        selector = selector.get("matchLabels") or {}
+    ref = spec.get("extensionRef") or spec.get("endpointPickerRef") or {}
+    port = spec.get("targetPortNumber")
+    if port is None:
+        ports = spec.get("targetPorts") or []
+        port = ports[0].get("number", 8000) if ports else 8000
+    return InferencePool(
+        metadata=_meta_from_k8s(d.get("metadata")),
+        selector={str(k): str(v) for k, v in selector.items()},
+        target_port_number=int(port),
+        extension_ref=ExtensionRef(
+            service_name=ref.get("name", ""),
+            port_number=int(ref.get("portNumber") or ref.get("port") or 9090)),
+    )
+
+
+def _sm_to_k8s(o: ServiceMonitor) -> dict[str, Any]:
+    return {"apiVersion": o.API_VERSION, "kind": "ServiceMonitor",
+            "metadata": _meta_to_k8s(o.metadata),
+            "spec": {"selector": {"matchLabels": dict(o.selector)}}}
+
+
+def _sm_from_k8s(d: dict[str, Any]) -> ServiceMonitor:
+    spec = d.get("spec") or {}
+    return ServiceMonitor(
+        metadata=_meta_from_k8s(d.get("metadata")),
+        selector=dict((spec.get("selector") or {}).get("matchLabels") or {}))
+
+
+def _va_to_k8s(o: VariantAutoscaling) -> dict[str, Any]:
+    d = o.to_dict()
+    d["metadata"] = _meta_to_k8s(o.metadata)
+    return d
+
+
+_CODECS: dict[str, tuple[Callable[[Any], dict], Callable[[dict], Any]]] = {
+    "Deployment": (_deployment_to_k8s, _deployment_from_k8s),
+    "Pod": (_pod_to_k8s, _pod_from_k8s),
+    "Node": (_node_to_k8s, _node_from_k8s),
+    "ConfigMap": (_configmap_to_k8s, _configmap_from_k8s),
+    "Secret": (_secret_to_k8s, _secret_from_k8s),
+    "Service": (_service_to_k8s, _service_from_k8s),
+    "Namespace": (_namespace_to_k8s, _namespace_from_k8s),
+    "Lease": (_lease_to_k8s, _lease_from_k8s),
+    "Event": (_event_to_k8s, _event_from_k8s),
+    "LeaderWorkerSet": (_lws_to_k8s, _lws_from_k8s),
+    "InferencePool": (_pool_to_k8s, _pool_from_k8s),
+    "ServiceMonitor": (_sm_to_k8s, _sm_from_k8s),
+    "VariantAutoscaling": (_va_to_k8s, VariantAutoscaling.from_dict),
+}
+
+
+def to_k8s(obj: Any) -> dict[str, Any]:
+    kind = getattr(obj, "KIND", None) or getattr(obj, "kind", None)
+    try:
+        encode, _ = _CODECS[kind]
+    except KeyError:
+        raise TypeError(f"no codec for kind {kind!r}") from None
+    return encode(obj)
+
+
+def from_k8s(kind: str, d: dict[str, Any]) -> Any:
+    try:
+        _, decode = _CODECS[kind]
+    except KeyError:
+        raise TypeError(f"no codec for kind {kind!r}") from None
+    obj = decode(d)
+    # Cluster-scoped objects must decode with namespace "" — the wire form
+    # omits the field and ObjectMeta.from_dict would default it to
+    # "default", making the object unreachable by get/delete (which look up
+    # under namespace "").
+    if not gvr_for(kind).namespaced:
+        obj.metadata.namespace = ""
+    return obj
+
+
+def known_kinds() -> list[str]:
+    return sorted(_CODECS)

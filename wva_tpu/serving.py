@@ -1,0 +1,218 @@
+"""HTTP serving: ``/metrics`` + ``/healthz`` + ``/readyz``.
+
+The steady-state scaling output of this architecture is the ``wva_*`` gauge
+family — HPA/KEDA consume it through Prometheus Adapter — so serving the
+metrics registry over HTTP is what closes the actuation loop outside the
+emulator (reference ``cmd/main.go:482-511`` wires healthz/readyz and the
+controller-runtime metrics endpoint; ``cmd/main.go:213-219`` adds TLS with
+certificate hot-reload via certwatcher).
+
+Two listeners, matching the reference's split:
+
+- metrics server (default ``:8443``): ``GET /metrics`` -> Prometheus text
+  exposition of :class:`wva_tpu.metrics.MetricsRegistry`; optional TLS
+  (cert/key files re-loaded when their mtime changes — new handshakes pick
+  up rotated certs without a restart) and optional bearer-token auth;
+- health server (default ``:8081``): ``/healthz`` liveness and ``/readyz``
+  readiness, the latter gated on ConfigMap bootstrap like the reference
+  (``cmd/main.go:486-498``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+DEFAULT_METRICS_ADDR = ":8443"
+DEFAULT_HEALTH_ADDR = ":8081"
+CERT_WATCH_INTERVAL = 30.0
+
+
+def parse_bind_address(addr: str) -> tuple[str, int] | None:
+    """controller-runtime style bind address: ":8443", "0.0.0.0:8443", "0"
+    (disabled -> None). Port 0 in a host:port form binds an ephemeral port
+    (tests)."""
+    if addr in ("", "0"):
+        return None
+    host, _, port = addr.rpartition(":")
+    return (host or "0.0.0.0", int(port))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "wva-tpu"
+    routes: dict[str, Callable[[], tuple[int, str, str]]] = {}
+    bearer_token: str = ""
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        route = self.routes.get(path)
+        if route is None:
+            self.send_error(404)
+            return
+        if self.bearer_token and path == "/metrics":
+            auth = self.headers.get("Authorization", "")
+            if auth != f"Bearer {self.bearer_token}":
+                self.send_error(401)
+                return
+        try:
+            status, content_type, body = route()
+        except Exception:  # noqa: BLE001 — a probe must never kill the server
+            log.exception("handler for %s failed", path)
+            self.send_error(500)
+            return
+        payload = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet probes
+        log.debug("http: " + fmt, *args)
+
+
+class CertReloader:
+    """Re-load cert/key into the live SSLContext when files change (the
+    certwatcher equivalent): new TLS handshakes use the rotated cert, no
+    restart or socket rebind needed."""
+
+    def __init__(self, context: ssl.SSLContext, cert_file: str,
+                 key_file: str) -> None:
+        self.context = context
+        self.cert_file = cert_file
+        self.key_file = key_file
+        self._mtimes = self._stat()
+
+    def _stat(self) -> tuple[float, float]:
+        try:
+            return (os.stat(self.cert_file).st_mtime,
+                    os.stat(self.key_file).st_mtime)
+        except OSError:
+            return (0.0, 0.0)
+
+    def check(self) -> bool:
+        current = self._stat()
+        if current == self._mtimes or current == (0.0, 0.0):
+            return False
+        try:
+            self.context.load_cert_chain(self.cert_file, self.key_file)
+            self._mtimes = current
+            log.info("TLS certificate reloaded from %s", self.cert_file)
+            return True
+        except (OSError, ssl.SSLError):
+            log.exception("TLS certificate reload failed; keeping previous")
+            return False
+
+
+class HTTPEndpoints:
+    """Owns the two listeners and their serve threads."""
+
+    def __init__(
+        self,
+        render_metrics: Callable[[], str],
+        healthz: Callable[[], bool],
+        readyz: Callable[[], bool],
+        metrics_addr: str = DEFAULT_METRICS_ADDR,
+        health_addr: str = DEFAULT_HEALTH_ADDR,
+        tls_cert_file: str = "",
+        tls_key_file: str = "",
+        metrics_bearer_token: str = "",
+    ) -> None:
+        self._render = render_metrics
+        self._healthz = healthz
+        self._readyz = readyz
+        self.metrics_addr = parse_bind_address(metrics_addr)
+        self.health_addr = parse_bind_address(health_addr)
+        self.tls_cert_file = tls_cert_file
+        self.tls_key_file = tls_key_file
+        self.metrics_bearer_token = metrics_bearer_token
+        self._servers: list[ThreadingHTTPServer] = []
+        self._threads: list[threading.Thread] = []
+        self._reloader: CertReloader | None = None
+        self._stop = threading.Event()
+
+    # route bodies -------------------------------------------------------
+
+    def _metrics_route(self) -> tuple[int, str, str]:
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                self._render())
+
+    def _health_route(self, probe: Callable[[], bool]) -> tuple[int, str, str]:
+        try:
+            ok = probe()
+        except Exception:  # noqa: BLE001 — probe failure = not ok
+            log.exception("probe raised")
+            ok = False
+        return (200, "text/plain", "ok\n") if ok else (
+            500, "text/plain", "unavailable\n")
+
+    # lifecycle ----------------------------------------------------------
+
+    def _make_server(self, bind: tuple[str, int],
+                     routes: dict[str, Callable[[], tuple[int, str, str]]],
+                     use_tls: bool, bearer: str) -> ThreadingHTTPServer:
+        handler = type("Handler", (_Handler,),
+                       {"routes": routes, "bearer_token": bearer})
+        server = ThreadingHTTPServer(bind, handler)
+        server.daemon_threads = True
+        if use_tls:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.tls_cert_file, self.tls_key_file)
+            self._reloader = CertReloader(ctx, self.tls_cert_file,
+                                          self.tls_key_file)
+            server.socket = ctx.wrap_socket(server.socket, server_side=True)
+        return server
+
+    def start(self) -> "HTTPEndpoints":
+        if self.metrics_addr is not None:
+            use_tls = bool(self.tls_cert_file and self.tls_key_file)
+            srv = self._make_server(
+                self.metrics_addr, {"/metrics": self._metrics_route},
+                use_tls, self.metrics_bearer_token)
+            self._servers.append(srv)
+        if self.health_addr is not None:
+            srv = self._make_server(
+                self.health_addr,
+                {"/healthz": lambda: self._health_route(self._healthz),
+                 "/readyz": lambda: self._health_route(self._readyz)},
+                use_tls=False, bearer="")
+            self._servers.append(srv)
+        for srv in self._servers:
+            t = threading.Thread(target=srv.serve_forever,
+                                 name=f"http-{srv.server_address[1]}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self._reloader is not None:
+            t = threading.Thread(target=self._cert_watch_loop,
+                                 name="cert-watcher", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _cert_watch_loop(self) -> None:
+        while not self._stop.wait(CERT_WATCH_INTERVAL):
+            self._reloader.check()
+
+    def ports(self) -> tuple[int, int]:
+        """Actual bound ports (for tests binding port 0)."""
+        metrics_port = health_port = 0
+        i = 0
+        if self.metrics_addr is not None:
+            metrics_port = self._servers[i].server_address[1]
+            i += 1
+        if self.health_addr is not None:
+            health_port = self._servers[i].server_address[1]
+        return metrics_port, health_port
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for srv in self._servers:
+            srv.shutdown()
+            srv.server_close()
