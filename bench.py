@@ -1,27 +1,43 @@
 #!/usr/bin/env python3
 """North-star benchmark (BASELINE.md): Llama-3.1-8B on JetStream v5e-8 slices
 under ramped load, 1 -> N slices, measuring p99-TTFT SLO attainment and
-scale-up latency.
+scale-up latency — plus a device microbenchmark of the flagship compiled
+computation (the batched JAX queueing solver).
 
-Two policies run through the SAME emulated world (serving simulator, fake
-kubelet with slice-provisioning delay, HPA emulator):
+THREE policies run through the SAME emulated world (serving simulator, fake
+kubelet with slice-provisioning delay, HPA emulator), so the reported gain
+decomposes honestly:
 
-- baseline: the reference's shipped defaults — V1 percentage analyzer, 30s
-  engine tick, HPA stabilization 240s up/down (charts/workload-variant-
-  autoscaler/README.md:11-20).
-- ours: the TPU build's defaults — V2 token-capacity analyzer (anticipates
-  demand from the scheduler queue and pending-replica supply) with faster HPA
-  windows, which V2's transition blocking + anticipated-supply math make safe
-  against flapping.
+- baseline       — the reference's shipped defaults: V1 percentage analyzer,
+                   30s engine tick, HPA stabilization 240s up/down
+                   (charts/workload-variant-autoscaler/README.md:11-20).
+- baseline-fast  — the SAME V1 analyzer with OUR intervals (10s engine tick,
+                   10s/120s HPA windows): isolates interval tuning from
+                   analyzer improvements. vs_baseline is quoted against the
+                   STRONGER of the two baselines.
+- ours           — the SLO path: the batched JAX queueing-model analyzer
+                   (analyzerName "slo") sizes replicas against the 1s-TTFT
+                   SLO directly, with demand-trend anticipation sized to the
+                   slice-provisioning horizon and whole-slice limiting.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": <ours p99-TTFT SLO attainment>, "unit": ...,
-   "vs_baseline": <ours / baseline>, "detail": {...}}
+Metrics are split by phase: overall (headline, includes the ramp), ramp
+window, and steady state — the ramp tail is a provisioning-physics cost
+(120s slice startup against a 300s ramp) and must be visible, not hidden in
+an average.
+
+The solver microbench jits ``size_batch`` over 1k/8k candidate batches on
+the default JAX platform (the real TPU chip under the driver) and reports
+compile time, execute time, candidates/s, and the speedup over the scalar
+per-candidate facade (the reference solves one candidate at a time:
+pkg/analyzer/queueanalyzer.go:127-258).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -43,25 +59,57 @@ HOLD_SECONDS = 1500.0
 PEAK_RATE = 90.0  # req/s at peak — needs ~5 v5e-8 slices
 STARTUP_SECONDS = 120.0  # slice provisioning + model load
 
+# Queueing-model profile fitted to the emulator's serving params
+# (ServingParams defaults: ttft_base 200ms, 8000 prefill tok/s, 20ms ITL,
+# 96 decode slots) — the same fit the SLO e2e tier uses.
+PROFILE_ALPHA_MS = 18.0
+PROFILE_BETA = 0.00267
+PROFILE_GAMMA = 0.00002
+
+FAST_HPA = dict(stabilization_up_seconds=10.0,
+                stabilization_down_seconds=120.0,
+                sync_period_seconds=10.0)
+
+
+def _slo_config_data():
+    from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms, TargetPerf
+    from wva_tpu.config.slo import SLOConfigData, ServiceClass
+
+    return SLOConfigData(
+        service_classes=[ServiceClass(
+            name="premium", priority=1,
+            model_targets={MODEL: TargetPerf(
+                target_ttft_ms=SLO_TTFT_SECONDS * 1000.0)})],
+        profiles=[PerfProfile(
+            model_id=MODEL, accelerator="v5e-8",
+            service_parms=ServiceParms(alpha=PROFILE_ALPHA_MS,
+                                       beta=PROFILE_BETA,
+                                       gamma=PROFILE_GAMMA),
+            max_batch_size=96, max_queue_size=384)])
+
 
 def run_policy(name: str) -> dict:
     if name == "baseline":
         sat_cfg = SaturationScalingConfig()  # V1 defaults
         hpa = HPAParams()  # chart defaults: 240s stabilization
         engine_interval = 30.0
-    else:
+    elif name == "baseline-fast":
+        # Ablation: the reference analyzer with OUR intervals. Separates
+        # interval tuning (config anyone could apply) from analyzer gains.
+        sat_cfg = SaturationScalingConfig()
+        hpa = HPAParams(**FAST_HPA)
+        engine_interval = 10.0
+    else:  # ours
         sat_cfg = SaturationScalingConfig(
-            analyzer_name="saturation",
+            analyzer_name="slo",
             # Size scale-up for the demand that will exist when a new slice
-            # becomes ready (slice provisioning + model load).
-            anticipation_horizon_seconds=STARTUP_SECONDS,
+            # becomes ready (slice provisioning + model load + decision lag).
+            anticipation_horizon_seconds=STARTUP_SECONDS + 30.0,
             # Clamp desired to whole-slice inventory so unplaceable replicas
             # never sit pending.
             enable_limiter=True)
         sat_cfg.apply_defaults()
-        hpa = HPAParams(stabilization_up_seconds=10.0,
-                        stabilization_down_seconds=120.0,
-                        sync_period_seconds=10.0)
+        hpa = HPAParams(**FAST_HPA)
         engine_interval = 10.0
 
     spec = VariantSpec(
@@ -71,6 +119,11 @@ def run_policy(name: str) -> dict:
         load=ramp(4.0, PEAK_RATE, RAMP_SECONDS, hold=HOLD_SECONDS),
         hpa=hpa,
     )
+    if name == "ours":
+        # The TPU build's shipped defaults pair a fast metrics pipeline with
+        # a short arrival-rate window (chart: 10s scrape + 30s window); the
+        # emulator scrapes every second, so the pairing holds here.
+        os.environ["WVA_SLO_ARRIVAL_RATE_WINDOW"] = "30s"
     harness = EmulationHarness(
         [spec],
         saturation_config=sat_cfg,
@@ -78,6 +131,9 @@ def run_policy(name: str) -> dict:
         startup_seconds=STARTUP_SECONDS,
         engine_interval=engine_interval,
     )
+    os.environ.pop("WVA_SLO_ARRIVAL_RATE_WINDOW", None)
+    if name == "ours":
+        harness.config.update_slo_config(_slo_config_data())
 
     max_replicas = {"v": 1}
     first_scale_up = {"t": None}
@@ -96,15 +152,37 @@ def run_policy(name: str) -> dict:
     harness.run(RAMP_SECONDS + HOLD_SECONDS, on_step=watch)
 
     sim = harness.sim_of_model(MODEL)
-    measure_since = harness.start_time  # whole run, ramp included
+    start = harness.start_time
     now = harness.clock.now()
-    attainment = sim.slo_attainment(SLO_TTFT_SECONDS, since=measure_since)
-    p99 = sim.ttft_percentile(99.0, since=measure_since, now=now)
-    p50 = sim.ttft_percentile(50.0, since=measure_since, now=now)
+    # Phase split: the ramp window covers the ramp itself plus one full
+    # provisioning horizon (decisions made during the ramp land then);
+    # steady state is everything after.
+    ramp_end = start + RAMP_SECONDS + STARTUP_SECONDS
+    overall = {
+        "slo_attainment": sim.slo_attainment(SLO_TTFT_SECONDS, since=start),
+        "p50_ttft_s": round(sim.ttft_percentile(50.0, since=start, now=now), 3),
+        "p99_ttft_s": round(sim.ttft_percentile(99.0, since=start, now=now), 3),
+    }
+    ramp_phase = {
+        "slo_attainment": sim.slo_attainment(
+            SLO_TTFT_SECONDS, since=start, until=ramp_end),
+        "p99_ttft_s": round(sim.ttft_percentile(
+            99.0, since=start, now=now, until=ramp_end), 3),
+    }
+    steady = {
+        "slo_attainment": sim.slo_attainment(
+            SLO_TTFT_SECONDS, since=ramp_end),
+        "p99_ttft_s": round(sim.ttft_percentile(
+            99.0, since=ramp_end, now=now), 3),
+    }
+    def _rounded(d: dict) -> dict:
+        return {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in d.items()}
+
     return {
-        "slo_attainment": attainment,
-        "p50_ttft_s": round(p50, 3),
-        "p99_ttft_s": round(p99, 3),
+        **_rounded(overall),
+        "ramp_phase": _rounded(ramp_phase),
+        "steady_state": _rounded(steady),
         "scale_up_decision_latency_s": first_scale_up["t"],
         "time_to_4_ready_slices_s": ready_at_peak["t"],
         "peak_slices": max_replicas["v"],
@@ -114,15 +192,96 @@ def run_policy(name: str) -> dict:
     }
 
 
+def solver_microbench() -> dict:
+    """The flagship compiled computation on the default JAX platform (the
+    real chip under the driver): batched SLO sizing throughput."""
+    import jax
+    import numpy as np
+
+    from wva_tpu.analyzers.queueing.params import ServiceParms
+    from wva_tpu.analyzers.queueing.queue_model import (
+        QueueAnalyzer,
+        QueueConfig,
+        RequestSize,
+        TargetPerf,
+        candidate_batch,
+        size_batch,
+    )
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+
+    def batch(n):
+        import jax.numpy as jnp
+        cand = candidate_batch(
+            alphas=rng.uniform(3.0, 30.0, n),
+            betas=rng.uniform(0.001, 0.05, n),
+            gammas=rng.uniform(0.00001, 0.002, n),
+            avg_in=rng.uniform(128, 2048, n),
+            avg_out=rng.uniform(64, 1024, n),
+            max_batch=rng.integers(16, 256, n),
+            k=rng.integers(512, 2048, n))
+        return (cand, jnp.full((n,), 1000.0, jnp.float32),
+                jnp.full((n,), 50.0, jnp.float32),
+                jnp.zeros((n,), jnp.float32))
+
+    out: dict = {"platform": platform}
+    for n in (1024, 8192):
+        args = batch(n)
+        t0 = time.perf_counter()
+        res = size_batch(*args)
+        jax.block_until_ready(res)
+        compile_s = time.perf_counter() - t0
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            res = size_batch(*args)
+        jax.block_until_ready(res)
+        exec_s = (time.perf_counter() - t0) / reps
+        out[f"batch_{n}"] = {
+            "compile_s": round(compile_s, 3),
+            "execute_s": round(exec_s, 5),
+            "candidates_per_s": int(n / exec_s),
+        }
+
+    # Scalar facade (one candidate at a time — the reference's shape,
+    # pkg/analyzer/queueanalyzer.go:127-258) for the batching speedup.
+    qa = QueueAnalyzer(
+        QueueConfig(max_batch_size=96, max_queue_size=384,
+                    service_parms=ServiceParms(alpha=18.0, beta=0.00267,
+                                               gamma=0.00002)),
+        RequestSize(avg_input_tokens=512, avg_output_tokens=256))
+    qa.size(TargetPerf(target_ttft_ms=1000.0))  # warm-up: exclude the
+    # facade's own shape-[1] compile from the timed loop (the batched
+    # path's compile is reported separately too).
+    t0 = time.perf_counter()
+    scalar_n = 20
+    for _ in range(scalar_n):
+        qa.size(TargetPerf(target_ttft_ms=1000.0))
+    scalar_per = (time.perf_counter() - t0) / scalar_n
+    out["scalar_facade_per_candidate_s"] = round(scalar_per, 5)
+    out["batched_speedup_vs_scalar_facade"] = int(
+        scalar_per / (out["batch_8192"]["execute_s"] / 8192))
+    out["note"] = (
+        "scalar = this repo's Python one-candidate-per-call facade (the "
+        "reference's solve shape, incl. per-call dispatch overhead); "
+        "batched = compile-once execute-many on the default JAX device")
+    return out
+
+
 def main() -> None:
     t0 = time.time()
     baseline = run_policy("baseline")
+    baseline_fast = run_policy("baseline-fast")
     ours = run_policy("ours")
+    solver = solver_microbench()
     wall = time.time() - t0
 
     value = ours["slo_attainment"]
-    base = baseline["slo_attainment"]
-    vs_baseline = value / base if base > 0 else float("inf")
+    # Honest comparison: quote against the STRONGEST baseline.
+    strongest = max(baseline["slo_attainment"],
+                    baseline_fast["slo_attainment"])
+    vs_baseline = value / strongest if strongest > 0 else float("inf")
 
     print(json.dumps({
         "metric": "p99_ttft_slo_attainment_ramped_1_to_N_v5e8",
@@ -132,11 +291,16 @@ def main() -> None:
         "detail": {
             "ours": ours,
             "baseline": baseline,
+            "baseline_fast": baseline_fast,
+            "solver_microbench": solver,
             "scenario": {
                 "model": MODEL, "engine": "jetstream",
                 "ramp": f"4->{PEAK_RATE} req/s over {RAMP_SECONDS:.0f}s",
                 "hold_s": HOLD_SECONDS, "slo_ttft_s": SLO_TTFT_SECONDS,
                 "slice_startup_s": STARTUP_SECONDS,
+                "vs_baseline_quoted_against": (
+                    "baseline-fast" if baseline_fast["slo_attainment"]
+                    >= baseline["slo_attainment"] else "baseline"),
             },
             "bench_wall_seconds": round(wall, 1),
         },

@@ -29,8 +29,9 @@ def slo_data():
 def heavy_load(tsdb, clock, rate_per_s=200.0):
     labels = {"namespace": NS, "model_name": MODEL}
     t0 = clock.now()
-    tsdb.add_sample("vllm:request_success_total", labels, 0.0, timestamp=t0 - 60)
-    tsdb.add_sample("vllm:request_success_total", labels, rate_per_s * 60,
+    # Two counter samples inside the arrival query's 30s rate window.
+    tsdb.add_sample("vllm:request_success_total", labels, 0.0, timestamp=t0 - 30)
+    tsdb.add_sample("vllm:request_success_total", labels, rate_per_s * 30,
                     timestamp=t0)
 
 

@@ -478,13 +478,13 @@ class TestEngineSLOPath:
             profiles=[PerfProfile(model_id=MODEL, accelerator="v5e-8",
                                   service_parms=PARMS, max_batch_size=64,
                                   max_queue_size=512)]))
-        # Counter samples so rate(request_success_total[1m]) sees heavy load:
-        # ~200 req/s >> one replica's SLO capacity (~4.4 req/s).
+        # Counter samples so rate(request_success_total[30s]) sees heavy
+        # load: ~200 req/s >> one replica's SLO capacity (~4.4 req/s).
         labels = {"namespace": NS, "model_name": MODEL}
         t0 = clock.now()
         tsdb.add_sample("vllm:request_success_total", labels, 0.0,
-                        timestamp=t0 - 60)
-        tsdb.add_sample("vllm:request_success_total", labels, 12000.0,
+                        timestamp=t0 - 30)
+        tsdb.add_sample("vllm:request_success_total", labels, 6000.0,
                         timestamp=t0)
         mgr.run_once()
         va = get_va(cluster)

@@ -58,6 +58,11 @@ log = logging.getLogger(__name__)
 # the V2 estimation defaults (reference saturation_v2/constants.go).
 DEFAULT_AVG_INPUT_TOKENS = 512.0
 DEFAULT_AVG_OUTPUT_TOKENS = 256.0
+# Backlogged requests count as demand to be served within this horizon —
+# short enough that the solver sizes recovery capacity after a saturation
+# episode (sub-second TTFT SLOs cannot tolerate minutes-long drains), long
+# enough not to thrash on transient queue blips (≈ one engine tick).
+BACKLOG_DRAIN_HORIZON_SECONDS = 15.0
 
 
 @dataclass
@@ -202,16 +207,23 @@ class QueueingModelAnalyzer(Analyzer):
         )
 
     def _demand_per_s(self, input: AnalyzerInput) -> float:
-        """Observed arrival rate (req/s). OptimizerMetrics carries req/min
-        (reference metrics_collector.go:12-24); scheduler-queue backlog is
-        drained over one optimization interval's worth of seconds as a
-        pressure term, mirroring V2's queue-demand estimate
-        (saturation_v2/analyzer.go:476-502)."""
+        """Observed demand (req/s). OptimizerMetrics carries req/min
+        (reference metrics_collector.go:12-24) — but that telemetry is a
+        COMPLETION rate: under saturation it caps at capacity and hides
+        excess demand. The excess is visible as backlog — per-replica
+        waiting queues (prefill backlog on JetStream) plus the scheduler
+        flow-control queue (mirroring V2's queue-demand estimate,
+        saturation_v2/analyzer.go:476-502) — counted here as demand to be
+        drained within a short horizon: with sub-second TTFT SLOs, a
+        backlog drained over a minute is a minute of misses, so the solver
+        must size recovery capacity, not just steady-state capacity."""
         demand = 0.0
         if input.optimizer_metrics is not None:
             demand += max(input.optimizer_metrics.arrival_rate, 0.0) / 60.0
-        if input.scheduler_queue is not None and input.scheduler_queue.queue_size > 0:
-            demand += input.scheduler_queue.queue_size / 60.0
+        backlog = sum(max(rm.queue_length, 0) for rm in input.replica_metrics)
+        if input.scheduler_queue is not None:
+            backlog += max(input.scheduler_queue.queue_size, 0)
+        demand += backlog / BACKLOG_DRAIN_HORIZON_SECONDS
         return demand
 
     def _prepare_candidates(

@@ -25,6 +25,26 @@ from wva_tpu.interfaces.allocation import OptimizerMetrics
 log = logging.getLogger(__name__)
 
 QUERY_ARRIVAL_RATE = "model_arrival_rate"
+
+# Rate window for the arrival-rate query. During a ramp the measured rate is
+# ~half a window stale, and with slices taking minutes to provision, 30s less
+# telemetry lag is 30s less backlog to drain — but rate() needs >=2 samples
+# in the window, so the window must stay >= 2x the Prometheus scrape
+# interval. Default 1m tolerates the common 30s scrape; deployments scraping
+# at 15s or faster (our chart's default) should set 30s.
+ARRIVAL_RATE_WINDOW_ENV = "WVA_SLO_ARRIVAL_RATE_WINDOW"
+DEFAULT_ARRIVAL_RATE_WINDOW = "1m"
+
+
+def arrival_rate_window() -> str:
+    import os
+    import re
+
+    raw = os.environ.get(ARRIVAL_RATE_WINDOW_ENV,
+                         DEFAULT_ARRIVAL_RATE_WINDOW)
+    return raw if re.fullmatch(r"\d+[smh]", raw) else DEFAULT_ARRIVAL_RATE_WINDOW
+
+
 QUERY_AVG_TTFT = "model_avg_ttft"
 QUERY_AVG_ITL = "model_avg_itl"
 
@@ -37,14 +57,15 @@ def register_slo_queries(source_registry: SourceRegistry) -> None:
         log.debug("Prometheus source not registered; skipping SLO queries")
         return
     ql = src.query_list()
+    window = arrival_rate_window()
     ql.register_if_absent(QueryTemplate(
         name=QUERY_ARRIVAL_RATE,
         template=(
-            f"sum(rate(vllm:request_success_total{_NS_MODEL}[1m])"
-            f" or rate(jetstream_request_success_total{_NS_MODEL}[1m]))"
+            f"sum(rate(vllm:request_success_total{_NS_MODEL}[{window}])"
+            f" or rate(jetstream_request_success_total{_NS_MODEL}[{window}]))"
         ),
         params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
-        description="Model request arrival (completion) rate, req/s over 1m",
+        description=f"Model request arrival (completion) rate over {window}",
     ))
     ql.register_if_absent(QueryTemplate(
         name=QUERY_AVG_TTFT,

@@ -90,7 +90,11 @@ class ModelServerSim:
         self._replicas: dict[str, _ReplicaState] = {}
         self.scheduler_queue: list[_Request] = []
         self._arrival_carry = 0.0
-        self.ttft_samples: list[tuple[float, float]] = []  # (time, ttft)
+        # (arrival time, ttft): keyed by ARRIVAL so phase-split windows
+        # attribute a request to the phase that produced its latency —
+        # a ramp-era request first served minutes later is a ramp miss,
+        # not a steady-state one.
+        self.ttft_samples: list[tuple[float, float]] = []
         self.rejected_requests = 0
 
     # --- replica lifecycle (driven by the fake kubelet) ---
@@ -164,7 +168,7 @@ class ModelServerSim:
                 ttft = req.first_token_at - req.arrived_at
                 r.ttft_sum += ttft
                 r.ttft_count += 1
-                self.ttft_samples.append((req.first_token_at, ttft))
+                self.ttft_samples.append((req.arrived_at, ttft))
             effective = min(tokens_per_step,
                             max(now + dt - req.prefill_done_at, 0.0) / p.itl_seconds)
             req.generated += effective
@@ -275,33 +279,39 @@ class ModelServerSim:
         return out
 
     def ttft_percentile(self, pct: float, since: float = 0.0,
-                        now: float | None = None) -> float:
+                        now: float | None = None,
+                        until: float | None = None) -> float:
         """Percentile over served TTFTs, counting still-unserved requests at
         their current (lower-bound) age so under-scaling can't hide its worst
-        tail by never serving it."""
-        samples = [t for ts, t in self.ttft_samples if ts >= since]
+        tail by never serving it. ``until`` bounds the arrival window (for
+        ramp-phase vs steady-state splits)."""
+        end = float("inf") if until is None else until
+        samples = [t for ts, t in self.ttft_samples if since <= ts < end]
         if now is not None:
             samples.extend(now - req.arrived_at
                            for req in self._unserved_requests()
-                           if req.arrived_at >= since)
+                           if since <= req.arrived_at < end)
         if not samples:
             return 0.0
         samples.sort()
         idx = min(int(len(samples) * pct / 100.0), len(samples) - 1)
         return samples[idx]
 
-    def slo_attainment(self, slo_seconds: float, since: float = 0.0) -> float:
+    def slo_attainment(self, slo_seconds: float, since: float = 0.0,
+                       until: float | None = None) -> float:
         """Fraction of ARRIVALS meeting the TTFT SLO: requests still unserved
-        at measurement time count as misses (no survivorship bias)."""
+        at measurement time count as misses (no survivorship bias). ``until``
+        bounds the arrival window."""
+        end = float("inf") if until is None else until
         met = missed = 0
         for ts, t in self.ttft_samples:
-            if ts < since:
+            if not (since <= ts < end):
                 continue
             if t <= slo_seconds:
                 met += 1
             else:
                 missed += 1
         missed += sum(1 for req in self._unserved_requests()
-                      if req.arrived_at >= since)
+                      if since <= req.arrived_at < end)
         total = met + missed
         return met / total if total else 1.0
