@@ -1,0 +1,209 @@
+"""Minimal Helm-template renderer for chart render tests.
+
+The dev image has no ``helm`` binary, so the chart restricts itself to a
+well-defined Go-template subset (documented in ``charts/wva-tpu/README.md``)
+and this module renders it: enough to validate every manifest and the
+client-only install contract the way the reference does with
+``helm template`` subprocesses (``test/chart/client_only_install_test.go``).
+
+Supported:
+
+- value access: ``{{ .Values.a.b }}``, ``{{ .Release.Name }}``,
+  ``{{ .Release.Namespace }}``, ``{{ .Chart.Name }}``, ``{{ .Chart.Version }}``
+  (also inside quoted strings, e.g. ``"{{ .Values.a }}:{{ .Values.b }}"``);
+- pipelines: ``| quote``, ``| default <literal>``;
+- control flow: ``{{- if <expr> }}`` / ``{{- else }}`` / ``{{- end }}``
+  where <expr> is a value reference, ``not <ref>``, ``eq <ref> <literal>``,
+  or ``and <ref> <ref>``;
+- whitespace trimming markers ``{{-`` and ``-}}``.
+
+``--set``-style overrides use helm's dotted-path syntax with the same
+scalar coercions (true/false/ints stay typed).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import yaml
+
+_TAG_RE = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+def _coerce(raw: str):
+    if raw == "true":
+        return True
+    if raw == "false":
+        return False
+    try:
+        return int(raw)
+    except ValueError:
+        return raw
+
+
+def set_path(values: dict, dotted: str, raw: str) -> None:
+    """helm --set a.b.c=v"""
+    parts = dotted.split(".")
+    node = values
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = _coerce(raw)
+
+
+class Renderer:
+    def __init__(self, chart_dir: str, release_name: str = "wva",
+                 namespace: str = "wva-system",
+                 set_values: dict[str, str] | None = None) -> None:
+        self.chart_dir = Path(chart_dir)
+        chart_meta = yaml.safe_load(
+            (self.chart_dir / "Chart.yaml").read_text())
+        self.values = yaml.safe_load(
+            (self.chart_dir / "values.yaml").read_text()) or {}
+        for k, v in (set_values or {}).items():
+            set_path(self.values, k, v)
+        self.context = {
+            "Values": self.values,
+            "Release": {"Name": release_name, "Namespace": namespace},
+            "Chart": {"Name": chart_meta.get("name", ""),
+                      "Version": str(chart_meta.get("version", ""))},
+        }
+
+    # --- expression evaluation ---
+
+    def _resolve_ref(self, ref: str):
+        node = self.context
+        for part in ref.lstrip(".").split("."):
+            if not isinstance(node, dict) or part not in node:
+                return None
+            node = node[part]
+        return node
+
+    def _eval_value(self, expr: str):
+        """A value expression with optional pipeline stages."""
+        stages = [s.strip() for s in expr.split("|")]
+        head = stages[0]
+        if head.startswith('"') and head.endswith('"'):
+            value = head[1:-1]
+        elif head.startswith("."):
+            value = self._resolve_ref(head)
+        else:
+            value = _coerce(head)
+        for stage in stages[1:]:
+            if stage == "quote":
+                # helm's quote is Go %q: escape backslashes, quotes, and
+                # newlines so multi-line values survive as YAML strings.
+                if isinstance(value, bool):
+                    s = "true" if value else "false"
+                else:
+                    s = str("" if value is None else value)
+                value = json.dumps(s)
+            elif stage.startswith("default "):
+                arg = stage[len("default "):].strip().strip('"')
+                if value in (None, "", False, 0):
+                    value = arg
+            else:
+                raise ValueError(f"unsupported pipeline stage {stage!r}")
+        return value
+
+    def _eval_cond(self, expr: str) -> bool:
+        expr = expr.strip()
+        if expr.startswith("not "):
+            return not self._eval_cond(expr[4:])
+        if expr.startswith("eq "):
+            parts = expr[3:].split(None, 1)
+            left = self._eval_value(parts[0])
+            right = self._eval_value(parts[1])
+            return left == right
+        if expr.startswith("and "):
+            return all(self._eval_cond(p) for p in expr[4:].split())
+        return bool(self._eval_value(expr))
+
+    # --- template parsing ---
+
+    def render_text(self, text: str) -> str:
+        tokens = self._tokenize(text)
+        out, idx = self._render_block(tokens, 0)
+        if idx != len(tokens):
+            raise ValueError("unbalanced if/end in template")
+        return out
+
+    @staticmethod
+    def _tokenize(text: str):
+        tokens = []
+        pos = 0
+        for m in _TAG_RE.finditer(text):
+            literal = text[pos:m.start()]
+            raw = m.group(0)
+            if raw.startswith("{{-"):
+                literal = re.sub(r"[ \t]*\n?[ \t]*$", "", literal)
+            tokens.append(("text", literal))
+            tokens.append(("tag", m.group(1), raw.endswith("-}}")))
+            pos = m.end()
+        tokens.append(("text", text[pos:]))
+        return tokens
+
+    def _render_block(self, tokens, idx, depth=0):
+        out: list[str] = []
+        trim_next = False
+
+        def emit(s: str) -> None:
+            nonlocal trim_next
+            if trim_next:
+                s = re.sub(r"^[ \t]*\n?", "", s)
+                trim_next = False
+            out.append(s)
+
+        while idx < len(tokens):
+            tok = tokens[idx]
+            if tok[0] == "text":
+                emit(tok[1])
+                idx += 1
+                continue
+            expr, trim_after = tok[1], tok[2]
+            if expr.startswith("if "):
+                cond = self._eval_cond(expr[3:])
+                true_out, idx = self._render_block(tokens, idx + 1, depth + 1)
+                false_out = ""
+                if idx < len(tokens) and tokens[idx][0] == "tag" \
+                        and tokens[idx][1] == "else":
+                    false_out, idx = self._render_block(tokens, idx + 1,
+                                                        depth + 1)
+                # consume the end tag
+                assert tokens[idx][1] == "end", "expected {{ end }}"
+                end_trim = tokens[idx][2]
+                idx += 1
+                chosen = true_out if cond else false_out
+                if trim_after:  # "{{- if x -}}": trim the branch body start
+                    chosen = re.sub(r"^[ \t]*\n?", "", chosen)
+                emit(chosen)
+                trim_next = end_trim
+                continue
+            if expr in ("else", "end"):
+                return "".join(out), idx  # caller consumes
+            value = self._eval_value(expr)
+            emit("" if value is None else str(value))
+            if trim_after:
+                trim_next = True
+            idx += 1
+        return "".join(out), idx
+
+    # --- chart rendering ---
+
+    def render_chart(self) -> dict[str, str]:
+        """template path -> rendered text (templates/ only, like helm)."""
+        rendered: dict[str, str] = {}
+        for path in sorted((self.chart_dir / "templates").rglob("*.yaml")):
+            rel = str(path.relative_to(self.chart_dir))
+            rendered[rel] = self.render_text(path.read_text())
+        return rendered
+
+    def render_docs(self) -> list[dict]:
+        """Every non-empty YAML document across all templates, parsed."""
+        docs: list[dict] = []
+        for text in self.render_chart().values():
+            for doc in yaml.safe_load_all(text):
+                if doc:
+                    docs.append(doc)
+        return docs

@@ -1,0 +1,204 @@
+"""Helm chart render tests (charts/wva-tpu), mirroring the reference's
+``test/chart/client_only_install_test.go:28-50``: full installs render the
+whole controller stack, client-only installs exclude controller
+infrastructure, and every rendered manifest is valid YAML with the
+metric/config names the rest of the system depends on."""
+
+import sys
+
+sys.path.insert(0, "tests")
+
+import yaml
+
+from helmlite import Renderer
+
+CHART = "charts/wva-tpu"
+
+
+def kinds_and_names(docs):
+    return {(d.get("kind"), d.get("metadata", {}).get("name", "")) for d in docs}
+
+
+class TestFullInstall:
+    def test_all_docs_parse_and_have_kind_metadata(self):
+        docs = Renderer(CHART).render_docs()
+        assert len(docs) >= 12
+        for d in docs:
+            assert d.get("apiVersion") and d.get("kind"), d
+            assert d.get("metadata", {}).get("name"), d
+
+    def test_controller_stack_rendered(self):
+        docs = Renderer(CHART, release_name="wva").render_docs()
+        kn = kinds_and_names(docs)
+        assert ("Deployment", "wva-controller-manager") in kn
+        assert ("ServiceAccount", "wva-controller-manager") in kn
+        assert ("ClusterRole", "wva-manager-role") in kn
+        assert ("ClusterRoleBinding", "wva-manager-rolebinding") in kn
+        assert ("Role", "wva-leader-election-role") in kn
+        assert ("ConfigMap", "wva-saturation-scaling-config") in kn
+        assert ("Service", "wva-metrics-service") in kn
+        assert ("ServiceMonitor", "wva-controller-metrics") in kn
+        # Workload side.
+        assert ("VariantAutoscaling", "llama-v5e") in kn
+        assert ("HorizontalPodAutoscaler", "llama-v5e") in kn
+        assert ("ServiceMonitor", "llama-v5e-metrics") in kn
+
+    def test_deployment_runs_the_cli_with_leader_election(self):
+        docs = Renderer(CHART).render_docs()
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert c["command"] == ["python", "-m", "wva_tpu"]
+        assert "--leader-elect" in c["args"]
+        assert any(a.startswith("--metrics-bind-address=:8443")
+                   for a in c["args"])
+        env = {e["name"]: e.get("value") for e in c["env"]}
+        assert env["PROMETHEUS_BASE_URL"].startswith("http")
+        assert env["WVA_SLO_ARRIVAL_RATE_WINDOW"] == "30s"
+        assert c["livenessProbe"]["httpGet"]["path"] == "/healthz"
+        assert c["readinessProbe"]["httpGet"]["path"] == "/readyz"
+
+    def test_saturation_configmap_parses_with_our_schema(self):
+        from wva_tpu.interfaces import SaturationScalingConfig
+
+        docs = Renderer(CHART).render_docs()
+        cm = next(d for d in docs
+                  if d["kind"] == "ConfigMap"
+                  and d["metadata"]["name"] == "wva-saturation-scaling-config")
+        parsed = yaml.safe_load(cm["data"]["default"])
+        cfg = SaturationScalingConfig.from_dict(parsed)
+        cfg.apply_defaults()
+        cfg.validate()
+        assert cfg.kv_cache_threshold == 0.80
+        assert cfg.enable_limiter is True
+        assert cfg.anticipation_horizon_seconds == 150.0
+        assert cfg.analyzer_name == "saturation"
+
+    def test_hpa_reads_the_wva_gauge_with_reference_defaults(self):
+        docs = Renderer(CHART).render_docs()
+        hpa = next(d for d in docs if d["kind"] == "HorizontalPodAutoscaler")
+        metric = hpa["spec"]["metrics"][0]["external"]
+        assert metric["metric"]["name"] == "wva_desired_replicas"
+        assert metric["metric"]["selector"]["matchLabels"] == {
+            "variant_name": "llama-v5e", "namespace": "inference"}
+        assert metric["target"] == {"type": "AverageValue",
+                                    "averageValue": "1"}
+        up = hpa["spec"]["behavior"]["scaleUp"]
+        assert up["stabilizationWindowSeconds"] == 240
+        assert up["policies"][0] == {"type": "Pods", "value": 10,
+                                     "periodSeconds": 150}
+        assert hpa["spec"]["maxReplicas"] == 10
+
+    def test_va_carries_accelerator_label_and_cost(self):
+        docs = Renderer(CHART).render_docs()
+        va = next(d for d in docs if d["kind"] == "VariantAutoscaling")
+        assert va["metadata"]["labels"][
+            "inference.optimization/acceleratorName"] == "v5e-8"
+        assert va["spec"]["modelID"] == "meta-llama/Llama-3.1-8B"
+        assert va["spec"]["variantCost"] == "10.0"
+        assert va["spec"]["scaleTargetRef"]["name"] == "llama-v5e"
+
+    def test_crd_is_shipped_and_matches_config_dir(self):
+        import pathlib
+        chart_crd = pathlib.Path(
+            CHART, "crds", "wva.tpu.llmd.ai_variantautoscalings.yaml")
+        config_crd = pathlib.Path(
+            "config/crd/wva.tpu.llmd.ai_variantautoscalings.yaml")
+        assert chart_crd.read_text() == config_crd.read_text()
+        doc = yaml.safe_load(chart_crd.read_text())
+        assert doc["spec"]["group"] == "wva.tpu.llmd.ai"
+
+
+class TestClientOnlyInstall:
+    """controller.enabled=false -> only workload resources + user RBAC
+    (reference client_only_install_test.go contract)."""
+
+    CONTROLLER_KINDS = {"Deployment", "ServiceAccount", "Service"}
+
+    def _docs(self):
+        return Renderer(CHART, release_name="wva-model-b",
+                        set_values={
+                            "controller.enabled": "false",
+                            "llmd.modelName": "llama-v5p",
+                            "va.accelerator": "v5p-8",
+                        }).render_docs()
+
+    def test_excludes_controller_infrastructure(self):
+        docs = self._docs()
+        kinds = {d["kind"] for d in docs}
+        assert not (kinds & self.CONTROLLER_KINDS), kinds
+        names = {d["metadata"]["name"] for d in docs}
+        assert "wva-saturation-scaling-config" not in names
+        assert not any(n.endswith("-manager-role") for n in names)
+        assert not any(n.endswith("-leader-election-role") for n in names)
+
+    def test_includes_workload_resources(self):
+        kn = kinds_and_names(self._docs())
+        assert ("VariantAutoscaling", "llama-v5p") in kn
+        assert ("HorizontalPodAutoscaler", "llama-v5p") in kn
+        assert ("ServiceMonitor", "llama-v5p-metrics") in kn
+        # User-facing RBAC ClusterRoles stay (reference keeps them).
+        assert ("ClusterRole", "wva-model-b-variantautoscaling-viewer") in kn
+        assert ("ClusterRole", "wva-model-b-variantautoscaling-editor") in kn
+
+    def test_set_values_flow_into_va(self):
+        docs = self._docs()
+        va = next(d for d in docs if d["kind"] == "VariantAutoscaling")
+        assert va["metadata"]["labels"][
+            "inference.optimization/acceleratorName"] == "v5p-8"
+
+
+class TestValueToggles:
+    def test_scale_to_zero_renders_its_configmap(self):
+        docs = Renderer(CHART, set_values={
+            "wva.scaleToZero": "true"}).render_docs()
+        cm = next(d for d in docs
+                  if d["kind"] == "ConfigMap"
+                  and d["metadata"]["name"] == "wva-model-scale-to-zero-config")
+        parsed = yaml.safe_load(cm["data"]["default"])
+        assert parsed["enable_scale_to_zero"] is True
+        # Default install must NOT render it.
+        docs = Renderer(CHART).render_docs()
+        assert not any(d["metadata"]["name"] == "wva-model-scale-to-zero-config"
+                       for d in docs)
+
+    def test_slo_configmap_survives_multiline_yaml_verbatim(self):
+        slo_yaml = ("serviceClasses:\n- name: premium\n  priority: 1\n"
+                    "  modelTargets:\n    m: {ttft_ms: 1000}\n")
+        docs = Renderer(CHART, set_values={"wva.slo.enabled": "true"},
+                        ).render_docs()
+        assert not any(d["metadata"]["name"] == "wva-slo-config" and
+                       d["data"].get("slo-config") for d in docs
+                       if d["kind"] == "ConfigMap")
+        r = Renderer(CHART, set_values={"wva.slo.enabled": "true"})
+        r.values["wva"]["slo"]["config"] = slo_yaml  # verbatim multi-line
+        docs = r.render_docs()
+        cm = next(d for d in docs
+                  if d["kind"] == "ConfigMap"
+                  and d["metadata"]["name"] == "wva-slo-config")
+        # The quote pipeline must escape newlines so the inner document
+        # round-trips exactly (helm %q semantics).
+        assert cm["data"]["slo-config"] == slo_yaml
+        inner = yaml.safe_load(cm["data"]["slo-config"])
+        assert inner["serviceClasses"][0]["name"] == "premium"
+
+    def test_secure_metrics_adds_tls_flags(self):
+        docs = Renderer(CHART, set_values={
+            "wva.metrics.secure": "true"}).render_docs()
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--metrics-secure" in args
+        assert any(a.startswith("--metrics-cert-path=") for a in args)
+        sm = next(d for d in docs
+                  if d["kind"] == "ServiceMonitor"
+                  and d["metadata"]["name"].endswith("controller-metrics"))
+        assert sm["spec"]["endpoints"][0]["scheme"] == "https"
+
+    def test_namespace_scoped_sets_watch_namespace(self):
+        docs = Renderer(CHART, namespace="my-ns", set_values={
+            "wva.namespaceScoped": "true"}).render_docs()
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        env = {e["name"]: e.get("value")
+               for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+        # Watches the MODEL's namespace (where the chart's VA lives), not
+        # the release namespace.
+        assert env["WATCH_NAMESPACE"] == "inference"

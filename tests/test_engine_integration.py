@@ -228,3 +228,34 @@ def test_engine_persists_optimization_ready_condition():
     cond = va.get_condition("OptimizationReady")
     assert cond is not None and cond.status == "True"
     assert va.status.actuation.applied is True
+
+
+def test_watch_namespace_scopes_engine_to_one_namespace():
+    """WATCH_NAMESPACE (wva.namespaceScoped in the chart): engines must only
+    reconcile VAs in the configured namespace."""
+    mgr, cluster, tsdb, clock = make_world(kv=0.85, queue=8)
+    # A second saturated VA in another namespace with its own deployment.
+    other_ns = "other"
+    cluster.create(Deployment(
+        metadata=ObjectMeta(name="other-model", namespace=other_ns),
+        replicas=1, selector={"app": "other"},
+        template=PodTemplateSpec(labels={"app": "other"}, containers=[
+            Container(name="srv", resources=ResourceRequirements(
+                requests={"google.com/tpu": "8"}))]),
+        status=DeploymentStatus(replicas=1, ready_replicas=1)))
+    cluster.create(VariantAutoscaling(
+        metadata=ObjectMeta(
+            name="other-model", namespace=other_ns,
+            labels={"inference.optimization/acceleratorName": "v5e-8"}),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name="other-model"),
+            model_id="other/model")))
+
+    mgr.config.infrastructure.watch_namespace = NS
+    mgr.run_once()
+    scoped = get_va(cluster)
+    assert scoped.status.desired_optimized_alloc.num_replicas >= 2
+    other = cluster.get("VariantAutoscaling", other_ns, "other-model")
+    # Out-of-scope VA untouched: no decision written.
+    assert other.status.desired_optimized_alloc.num_replicas == 0
+    assert other.status.desired_optimized_alloc.accelerator == ""

@@ -136,7 +136,8 @@ class SaturationEngine:
 
     def optimize(self) -> None:
         """One optimization tick (reference engine.go:171-277)."""
-        active_vas = variant_utils.active_variant_autoscalings(self.client)
+        active_vas = variant_utils.active_variant_autoscalings(
+            self.client, namespace=self.config.watch_namespace() or None)
         if not active_vas:
             log.debug("No active VariantAutoscalings, skipping optimization")
             return

@@ -61,7 +61,8 @@ class ScaleFromZeroEngine:
 
     def optimize(self) -> None:
         """One detection tick (reference engine.go:122-195)."""
-        inactive = variant_utils.inactive_variant_autoscalings(self.client)
+        inactive = variant_utils.inactive_variant_autoscalings(
+            self.client, namespace=self.config.watch_namespace() or None)
         if not inactive:
             return
         # Wake only the cheapest inactive variant per model.

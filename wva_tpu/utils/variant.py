@@ -38,20 +38,25 @@ def update_va_status_with_backoff(client: KubeClient, va: VariantAutoscaling) ->
     )
 
 
-def ready_variant_autoscalings(client: KubeClient) -> list[VariantAutoscaling]:
+def ready_variant_autoscalings(
+    client: KubeClient, namespace: str | None = None,
+) -> list[VariantAutoscaling]:
     """All non-deleted VAs, filtered to this controller instance when
-    CONTROLLER_INSTANCE is set (reference variant.go:157-196)."""
+    CONTROLLER_INSTANCE is set (reference variant.go:157-196) and to one
+    namespace when the controller is namespace-scoped (WATCH_NAMESPACE)."""
     selector = None
     instance = get_controller_instance()
     if instance:
         selector = {CONTROLLER_INSTANCE_LABEL_KEY: instance}
-    vas = client.list("VariantAutoscaling", label_selector=selector)
+    vas = client.list("VariantAutoscaling", namespace=namespace or None,
+                      label_selector=selector)
     return [va for va in vas if va.metadata.deletion_timestamp is None]
 
 
-def _filter_by_target(client: KubeClient, want_active: bool) -> list[VariantAutoscaling]:
+def _filter_by_target(client: KubeClient, want_active: bool,
+                      namespace: str | None = None) -> list[VariantAutoscaling]:
     out = []
-    for va in ready_variant_autoscalings(client):
+    for va in ready_variant_autoscalings(client, namespace=namespace):
         ref = va.spec.scale_target_ref
         if not ref.name:
             log.debug("Skipping VA %s/%s without scaleTargetRef",
@@ -76,14 +81,18 @@ def _filter_by_target(client: KubeClient, want_active: bool) -> list[VariantAuto
     return out
 
 
-def active_variant_autoscalings(client: KubeClient) -> list[VariantAutoscaling]:
+def active_variant_autoscalings(
+    client: KubeClient, namespace: str | None = None,
+) -> list[VariantAutoscaling]:
     """VAs whose target has >= 1 desired replica."""
-    return _filter_by_target(client, want_active=True)
+    return _filter_by_target(client, want_active=True, namespace=namespace)
 
 
-def inactive_variant_autoscalings(client: KubeClient) -> list[VariantAutoscaling]:
+def inactive_variant_autoscalings(
+    client: KubeClient, namespace: str | None = None,
+) -> list[VariantAutoscaling]:
     """VAs whose target is scaled to zero."""
-    return _filter_by_target(client, want_active=False)
+    return _filter_by_target(client, want_active=False, namespace=namespace)
 
 
 def group_variant_autoscalings_by_model(
