@@ -1,0 +1,36 @@
+# Controller image for the TPU-native workload variant autoscaler.
+#
+# Mirrors the reference's two-stage build (/root/reference/Dockerfile:
+# builder -> distroless) in Python form: a builder stage wheels the package
+# and its pinned dependencies, the runtime stage installs only those wheels
+# on a slim base and runs as a non-root numeric UID so
+# runAsNonRoot/seccompProfile pod security contexts pass unchanged.
+FROM python:3.12-slim AS builder
+
+WORKDIR /workspace
+COPY pyproject.toml README.md ./
+COPY wva_tpu/ wva_tpu/
+
+# Build a wheel for the package plus wheels for every dependency so the
+# runtime stage never touches the network index metadata twice.
+RUN pip wheel --wheel-dir /wheels .
+
+FROM python:3.12-slim
+
+LABEL org.opencontainers.image.description="Workload Variant Autoscaler (WVA-TPU) - TPU-slice-aware autoscaler for LLM inference workloads"
+LABEL org.opencontainers.image.licenses="Apache-2.0"
+
+# jax on CPU inside the controller pod: the SLO analyzer / fleet solver
+# batch-size on the host platform; silence accelerator probing.
+ENV JAX_PLATFORMS=cpu \
+    PYTHONUNBUFFERED=1
+
+COPY --from=builder /wheels /wheels
+RUN pip install --no-cache-dir --no-index --find-links=/wheels wva-tpu \
+    && rm -rf /wheels
+
+# Same numeric non-root identity as the reference image (distroless nonroot).
+USER 65532:65532
+WORKDIR /
+
+ENTRYPOINT ["python", "-m", "wva_tpu"]

@@ -1,0 +1,104 @@
+# Makefile for the TPU-native workload variant autoscaler.
+# Target names track the reference lifecycle (/root/reference/Makefile:96-113,
+# 239-298: create-kind-cluster / deploy-wva-emulated-on-kind / test-e2e-smoke)
+# so operators migrating from the GPU WVA keep their muscle memory.
+
+# Image URL to use for all building/pushing image targets
+IMG ?= ghcr.io/llm-d/wva-tpu:v0.3.0
+
+# Tool binaries (override to pin versions, e.g. KIND=./bin/kind)
+KIND ?= kind
+KUBECTL ?= kubectl
+HELM ?= helm
+DOCKER ?= docker
+PYTHON ?= python
+
+# Fake-TPU kind cluster shape (deploy/kind-emulator/setup.sh)
+CLUSTER_NAME ?= kind-wva-tpu-cluster
+CLUSTER_NODES ?= 3
+CLUSTER_TPU_PROFILE ?= v5e
+CREATE_CLUSTER ?= false
+
+# Deploy knobs (deploy/install.sh)
+WVA_NS ?= wva-tpu-system
+LLMD_NS ?= llm-d-inference
+RELEASE_NAME ?= wva-tpu
+NAMESPACE_SCOPED ?= false
+VALUES_FILE ?= charts/wva-tpu/values.yaml
+
+.PHONY: help
+help: ## Display this help.
+	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_0-9-]+:.*?##/ { printf "  \033[36m%-32s\033[0m %s\n", $$1, $$2 }' $(MAKEFILE_LIST)
+
+##@ Development
+
+.PHONY: test
+test: ## Run the unit/integration suite (CPU, virtual 8-device mesh).
+	$(PYTHON) -m pytest tests/ -x -q
+
+.PHONY: bench
+bench: ## Run the north-star benchmark (one JSON line on stdout).
+	$(PYTHON) bench.py
+
+.PHONY: verify-deploy-pipeline
+verify-deploy-pipeline: ## Static-check the deploy pipeline (scripts parse, manifests render, Dockerfile paths exist).
+	$(PYTHON) -m pytest tests/test_deploy_pipeline.py -x -q
+
+##@ Build
+
+.PHONY: docker-build
+docker-build: ## Build the controller image.
+	$(DOCKER) build -t $(IMG) .
+
+.PHONY: docker-push
+docker-push: ## Push the controller image.
+	$(DOCKER) push $(IMG)
+
+.PHONY: kind-load
+kind-load: ## Load the controller image into the kind cluster.
+	$(KIND) load docker-image $(IMG) --name $(CLUSTER_NAME)
+
+##@ Cluster lifecycle (emulated TPUs on kind)
+
+.PHONY: create-kind-cluster
+create-kind-cluster: ## Create a kind cluster with fake GKE TPU node pools.
+	KIND=$(KIND) KUBECTL=$(KUBECTL) CLUSTER_NAME=$(CLUSTER_NAME) \
+		deploy/kind-emulator/setup.sh -n $(CLUSTER_NODES) -p $(CLUSTER_TPU_PROFILE)
+
+.PHONY: destroy-kind-cluster
+destroy-kind-cluster: ## Destroy the kind cluster created by create-kind-cluster.
+	KIND=$(KIND) CLUSTER_NAME=$(CLUSTER_NAME) \
+		deploy/kind-emulator/teardown.sh
+
+##@ Deployment
+
+.PHONY: deploy-wva-tpu-emulated-on-kind
+deploy-wva-tpu-emulated-on-kind: ## Build + load + deploy the controller on the fake-TPU kind cluster.
+	@echo ">>> Deploying wva-tpu (image: $(IMG), cluster: $(CLUSTER_NAME))"
+	KIND=$(KIND) KUBECTL=$(KUBECTL) HELM=$(HELM) DOCKER=$(DOCKER) IMG=$(IMG) \
+	CLUSTER_NAME=$(CLUSTER_NAME) CREATE_CLUSTER=$(CREATE_CLUSTER) \
+	CLUSTER_NODES=$(CLUSTER_NODES) CLUSTER_TPU_PROFILE=$(CLUSTER_TPU_PROFILE) \
+	WVA_NS=$(WVA_NS) LLMD_NS=$(LLMD_NS) RELEASE_NAME=$(RELEASE_NAME) \
+	NAMESPACE_SCOPED=$(NAMESPACE_SCOPED) VALUES_FILE=$(VALUES_FILE) \
+		deploy/install.sh
+
+.PHONY: undeploy-wva-tpu-emulated-on-kind
+undeploy-wva-tpu-emulated-on-kind: ## Remove the controller (and optionally the cluster).
+	KIND=$(KIND) KUBECTL=$(KUBECTL) HELM=$(HELM) \
+	CLUSTER_NAME=$(CLUSTER_NAME) WVA_NS=$(WVA_NS) RELEASE_NAME=$(RELEASE_NAME) \
+	DELETE_CLUSTER=$(DELETE_CLUSTER) \
+		deploy/install.sh --undeploy
+
+##@ End-to-end tests
+
+.PHONY: test-e2e-smoke
+test-e2e-smoke: ## Smoke test against a deployed controller (needs KUBECONFIG).
+	KUBECTL=$(KUBECTL) WVA_NS=$(WVA_NS) LLMD_NS=$(LLMD_NS) \
+		deploy/e2e/smoke.sh
+
+.PHONY: test-e2e-smoke-with-setup
+test-e2e-smoke-with-setup: deploy-wva-tpu-emulated-on-kind test-e2e-smoke ## Deploy then smoke test.
+
+.PHONY: test-e2e-smoke-local
+test-e2e-smoke-local: ## Same smoke assertions without a cluster: controller subprocess vs fake API server + fake Prometheus over real sockets.
+	$(PYTHON) deploy/e2e/smoke_local.py

@@ -1,0 +1,107 @@
+#!/bin/bash
+#
+# Smoke test against a DEPLOYED wva-tpu controller (reference
+# Makefile:239-262 test-e2e-smoke): applies a VariantAutoscaling + dummy
+# Deployment, waits for the controller to resolve the target and write
+# status, then asserts the wva_desired_replicas series appears on the
+# controller's /metrics endpoint.
+#
+# Requires: kubectl with KUBECONFIG pointing at the cluster where
+# `make deploy-wva-tpu-emulated-on-kind` ran.
+
+set -euo pipefail
+
+KUBECTL="${KUBECTL:-kubectl}"
+WVA_NS="${WVA_NS:-wva-tpu-system}"
+LLMD_NS="${LLMD_NS:-llm-d-inference}"
+RELEASE_NAME="${RELEASE_NAME:-wva-tpu}"
+TIMEOUT="${TIMEOUT:-180}"
+VA_NAME="smoke-llama-v5e"
+
+RED='\033[0;31m'; GREEN='\033[0;32m'; NC='\033[0m'
+fail() { echo -e "${RED}[smoke] FAIL:${NC} $*" >&2; cleanup || true; exit 1; }
+ok()   { echo -e "${GREEN}[smoke]${NC} $*"; }
+
+PF_PID=""
+cleanup() {
+    [[ -n "$PF_PID" ]] && kill "$PF_PID" 2>/dev/null || true
+    "$KUBECTL" -n "$LLMD_NS" delete variantautoscaling "$VA_NAME" \
+        deployment "$VA_NAME" --ignore-not-found=true >/dev/null 2>&1 || true
+}
+trap cleanup EXIT
+
+# 1. Controller up?
+"$KUBECTL" -n "$WVA_NS" get deployment >/dev/null \
+    || fail "cannot reach namespace $WVA_NS"
+"$KUBECTL" -n "$WVA_NS" wait --for=condition=Available --timeout="${TIMEOUT}s" \
+    deployment -l app.kubernetes.io/name=wva-tpu \
+    || fail "controller deployment not Available"
+ok "controller deployment Available"
+
+# 2. Dummy workload + VA
+"$KUBECTL" create namespace "$LLMD_NS" --dry-run=client -o yaml | "$KUBECTL" apply -f -
+cat <<EOF | "$KUBECTL" apply -f -
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: $VA_NAME
+  namespace: $LLMD_NS
+  labels: {app: $VA_NAME}
+spec:
+  replicas: 1
+  selector: {matchLabels: {app: $VA_NAME}}
+  template:
+    metadata:
+      labels: {app: $VA_NAME}
+    spec:
+      containers:
+        - name: srv
+          image: registry.k8s.io/pause:3.9
+          args: ["--max-num-batched-tokens=8192", "--max-num-seqs=256"]
+---
+apiVersion: wva.tpu.llmd.ai/v1alpha1
+kind: VariantAutoscaling
+metadata:
+  name: $VA_NAME
+  namespace: $LLMD_NS
+  labels:
+    inference.optimization/acceleratorName: v5e-8
+spec:
+  scaleTargetRef:
+    apiVersion: apps/v1
+    kind: Deployment
+    name: $VA_NAME
+  modelID: smoke/llama-3.1-8b
+  variantCost: "8.0"
+EOF
+ok "applied dummy Deployment + VariantAutoscaling"
+
+# 3. Wait for the controller to resolve the scale target (status written).
+deadline=$((SECONDS + TIMEOUT))
+until "$KUBECTL" -n "$LLMD_NS" get variantautoscaling "$VA_NAME" \
+        -o jsonpath='{.status.conditions[?(@.type=="TargetResolved")].status}' \
+        2>/dev/null | grep -q True; do
+    [[ $SECONDS -lt $deadline ]] || fail "TargetResolved condition never became True"
+    sleep 2
+done
+ok "VA TargetResolved=True"
+
+# 4. wva_desired_replicas visible on /metrics (through the metrics Service).
+PORT="${SMOKE_LOCAL_PORT:-18443}"
+"$KUBECTL" -n "$WVA_NS" port-forward "service/$RELEASE_NAME-metrics-service" \
+    "$PORT:8443" >/dev/null 2>&1 &
+PF_PID=$!
+sleep 2
+deadline=$((SECONDS + TIMEOUT))
+while true; do
+    metrics="$(curl -sk "https://127.0.0.1:$PORT/metrics" 2>/dev/null \
+        || curl -s "http://127.0.0.1:$PORT/metrics" 2>/dev/null || true)"
+    if echo "$metrics" | grep -q "wva_desired_replicas{.*variant_name=\"$VA_NAME\""; then
+        ok "wva_desired_replicas emitted for $VA_NAME"
+        break
+    fi
+    [[ $SECONDS -lt $deadline ]] || fail "wva_desired_replicas for $VA_NAME never appeared on /metrics"
+    sleep 3
+done
+
+ok "SMOKE PASSED"

@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Cluster-free smoke test: the SAME assertions as deploy/e2e/smoke.sh, but
+with every process boundary faked over real sockets.
+
+Proves the deploy pipeline up to the image-build boundary (the environment
+has no docker/kind): the controller runs as the Dockerfile's entrypoint
+(``python -m wva_tpu``) in a subprocess, talks to a FakeAPIServer over HTTP
+for list/watch/status-patch, collects saturated metrics from a
+FakePrometheusServer over HTTP, and must emit a scale-up decision on its
+real /metrics endpoint:
+
+1. controller subprocess starts, /healthz + /readyz go 200;
+2. a VariantAutoscaling + Deployment + Ready pods exist; pods report
+   kv_cache_usage 0.85 / queue depth 8 (saturated);
+3. wva_desired_replicas{variant_name="llama-v5e"} >= 2 appears on /metrics
+   — the full collect -> analyze -> decide -> emit loop ran;
+4. SIGTERM exits 0 (leader release / clean shutdown).
+
+Reference analogue: Makefile:239-262 test-e2e-smoke against a kind cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO_ROOT)
+
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec  # noqa: E402
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference  # noqa: E402
+from wva_tpu.collector.source import TimeSeriesDB  # noqa: E402
+from wva_tpu.emulator.prom_server import FakePrometheusServer  # noqa: E402
+from wva_tpu.k8s import (  # noqa: E402
+    ConfigMap,
+    Container,
+    Deployment,
+    DeploymentStatus,
+    FakeCluster,
+    Pod,
+    PodStatus,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from wva_tpu.k8s.fake_apiserver import FakeAPIServer  # noqa: E402
+
+NS = "llm-d-inference"
+SYSTEM_NS = "wva-tpu-system"
+MODEL = "meta-llama/Llama-3.1-8B"
+VARIANT = "llama-v5e"
+TIMEOUT = float(os.environ.get("SMOKE_TIMEOUT", "90"))
+
+SATURATION_CM = """\
+analyzerName: ""
+kvCacheThreshold: 0.80
+queueLengthThreshold: 5
+kvSpareTrigger: 0.10
+queueSpareTrigger: 3
+enableLimiter: false
+"""
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def build_world() -> tuple[FakeCluster, TimeSeriesDB]:
+    cluster = FakeCluster()
+    tsdb = TimeSeriesDB()
+
+    cluster.create(ConfigMap(
+        metadata=ObjectMeta(name="wva-saturation-scaling-config",
+                            namespace=SYSTEM_NS),
+        data={"default": SATURATION_CM}))
+
+    replicas = 1
+    cluster.create(Deployment(
+        metadata=ObjectMeta(name=VARIANT, namespace=NS),
+        replicas=replicas,
+        selector={"app": "llama"},
+        template=PodTemplateSpec(
+            labels={"app": "llama"},
+            containers=[Container(
+                name="srv",
+                args=["--max-num-batched-tokens=8192", "--max-num-seqs=256"],
+                resources=ResourceRequirements(
+                    requests={"google.com/tpu": "8"}))]),
+        status=DeploymentStatus(replicas=replicas, ready_replicas=replicas)))
+    cluster.create(VariantAutoscaling(
+        metadata=ObjectMeta(
+            name=VARIANT, namespace=NS,
+            labels={"inference.optimization/acceleratorName": "v5e-8"}),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name=VARIANT),
+            model_id=MODEL, variant_cost="8.0")))
+    for i in range(replicas):
+        cluster.create(Pod(
+            metadata=ObjectMeta(
+                name=f"{VARIANT}-{i}", namespace=NS, labels={"app": "llama"},
+                owner_references=[{"kind": "Deployment", "name": VARIANT}]),
+            status=PodStatus(phase="Running", ready=True,
+                             pod_ip=f"10.0.0.{i}")))
+        pod_labels = {"pod": f"{VARIANT}-{i}", "namespace": NS,
+                      "model_name": MODEL}
+        # Saturated: kv 0.85 > 0.80 threshold, queue 8 > 5 threshold.
+        tsdb.add_sample("vllm:kv_cache_usage_perc", pod_labels, 0.85)
+        tsdb.add_sample("vllm:num_requests_waiting", pod_labels, 8)
+        tsdb.add_sample("vllm:cache_config_info",
+                        {**pod_labels, "num_gpu_blocks": "4096",
+                         "block_size": "32"}, 1.0)
+    return cluster, tsdb
+
+
+def restamp(db: TimeSeriesDB) -> None:
+    """Re-stamp every seeded series with the current wall clock so the
+    collector's staleness windows keep passing while the smoke runs."""
+    for i in range(1):
+        pod_labels = {"pod": f"{VARIANT}-{i}", "namespace": NS,
+                      "model_name": MODEL}
+        db.add_sample("vllm:kv_cache_usage_perc", pod_labels, 0.85)
+        db.add_sample("vllm:num_requests_waiting", pod_labels, 8)
+        db.add_sample("vllm:cache_config_info",
+                      {**pod_labels, "num_gpu_blocks": "4096",
+                       "block_size": "32"}, 1.0)
+
+
+def fetch(url: str, timeout: float = 2.0) -> tuple[int, str]:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def main() -> int:
+    import json
+    import tempfile
+
+    cluster, tsdb = build_world()
+    apiserver = FakeAPIServer(cluster).start()
+    prom = FakePrometheusServer(tsdb, refresh=restamp).start()
+    print(f"[smoke-local] fake apiserver at {apiserver.url}, "
+          f"fake prometheus at {prom.url}")
+
+    mport, hport = free_port(), free_port()
+    with tempfile.TemporaryDirectory() as tmp:
+        kubeconfig = os.path.join(tmp, "kubeconfig")
+        with open(kubeconfig, "w") as f:
+            json.dump({
+                "current-context": "smoke",
+                "contexts": [{"name": "smoke", "context":
+                              {"cluster": "smoke", "user": "smoke"}}],
+                "clusters": [{"name": "smoke",
+                              "cluster": {"server": apiserver.url}}],
+                "users": [{"name": "smoke", "user": {}}],
+            }, f)
+        env = {**os.environ,
+               "KUBECONFIG": kubeconfig,
+               "PROMETHEUS_BASE_URL": prom.url,
+               "POD_NAMESPACE": SYSTEM_NS,
+               "GLOBAL_OPT_INTERVAL": "2s",
+               "JAX_PLATFORMS": "cpu"}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "wva_tpu",
+             "--metrics-bind-address", f"127.0.0.1:{mport}",
+             "--health-probe-bind-address", f"127.0.0.1:{hport}",
+             "-v", "2"],
+            cwd=REPO_ROOT, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        rc = 1
+        try:
+            # 1. health + readiness
+            deadline = time.time() + TIMEOUT
+            while time.time() < deadline:
+                try:
+                    if fetch(f"http://127.0.0.1:{hport}/healthz")[0] == 200:
+                        break
+                except (urllib.error.URLError, OSError):
+                    time.sleep(0.3)
+            else:
+                raise AssertionError("healthz never came up")
+            status, _ = fetch(f"http://127.0.0.1:{hport}/readyz")
+            assert status == 200, "readyz not 200 after bootstrap"
+            print("[smoke-local] healthz/readyz OK")
+
+            # 2+3. scale-up decision visible on /metrics
+            pattern = re.compile(
+                r'wva_desired_replicas\{[^}]*variant_name="%s"[^}]*\}\s+'
+                r'([0-9.e+]+)' % re.escape(VARIANT))
+            desired = None
+            while time.time() < deadline:
+                _, body = fetch(f"http://127.0.0.1:{mport}/metrics")
+                m = pattern.search(body)
+                if m and float(m.group(1)) >= 2:
+                    desired = float(m.group(1))
+                    break
+                time.sleep(1.0)
+            assert desired is not None, \
+                "wva_desired_replicas >= 2 never appeared on /metrics"
+            print(f"[smoke-local] scale-up decision emitted: "
+                  f"wva_desired_replicas={desired}")
+
+            # VA status written through the REST path too.
+            va = cluster.get("VariantAutoscaling", NS, VARIANT)
+            alloc = va.status.desired_optimized_alloc
+            assert alloc is not None and alloc.num_replicas >= 2, \
+                f"VA status not updated: {alloc}"
+            print(f"[smoke-local] VA status desired_optimized_alloc="
+                  f"{alloc.num_replicas} accel={alloc.accelerator}")
+
+            # 4. clean shutdown
+            proc.send_signal(signal.SIGTERM)
+            rc_proc = proc.wait(timeout=20)
+            assert rc_proc == 0, f"controller exited {rc_proc}"
+            print("[smoke-local] clean SIGTERM shutdown (rc=0)")
+            print("[smoke-local] SMOKE PASSED")
+            rc = 0
+        except AssertionError as e:
+            print(f"[smoke-local] FAIL: {e}", file=sys.stderr)
+            if proc.poll() is None:
+                proc.kill()
+            out = proc.stdout.read() if proc.stdout else ""
+            print("---- controller output ----", file=sys.stderr)
+            print(out[-8000:], file=sys.stderr)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            apiserver.shutdown()
+            prom.shutdown()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

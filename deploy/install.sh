@@ -1,0 +1,142 @@
+#!/bin/bash
+#
+# wva-tpu deployment script: image build -> kind load -> chart install.
+# Env-driven like the reference's deploy/install.sh; invoked by the
+# Makefile targets deploy-wva-tpu-emulated-on-kind /
+# undeploy-wva-tpu-emulated-on-kind (reference Makefile:107-118).
+#
+# Renders the chart with helm when available, falling back to the bundled
+# subset renderer (python -m wva_tpu.utils.helmlite) + kubectl apply so the
+# pipeline works on machines without a helm binary.
+
+set -euo pipefail
+
+RED='\033[0;31m'; GREEN='\033[0;32m'; BLUE='\033[0;34m'; NC='\033[0m'
+info()  { echo -e "${BLUE}[install]${NC} $*"; }
+ok()    { echo -e "${GREEN}[install]${NC} $*"; }
+fail()  { echo -e "${RED}[install]${NC} $*" >&2; exit 1; }
+
+# Tools
+KIND="${KIND:-kind}"
+KUBECTL="${KUBECTL:-kubectl}"
+HELM="${HELM:-helm}"
+DOCKER="${DOCKER:-docker}"
+PYTHON="${PYTHON:-python}"
+
+# Configuration
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+IMG="${IMG:-ghcr.io/llm-d/wva-tpu:v0.3.0}"
+CLUSTER_NAME="${CLUSTER_NAME:-kind-wva-tpu-cluster}"
+CREATE_CLUSTER="${CREATE_CLUSTER:-false}"
+CLUSTER_NODES="${CLUSTER_NODES:-3}"
+CLUSTER_TPU_PROFILE="${CLUSTER_TPU_PROFILE:-v5e}"
+WVA_NS="${WVA_NS:-wva-tpu-system}"
+LLMD_NS="${LLMD_NS:-llm-d-inference}"
+RELEASE_NAME="${RELEASE_NAME:-wva-tpu}"
+NAMESPACE_SCOPED="${NAMESPACE_SCOPED:-false}"
+VALUES_FILE="${VALUES_FILE:-$REPO_ROOT/charts/wva-tpu/values.yaml}"
+CHART_DIR="${CHART_DIR:-$REPO_ROOT/charts/wva-tpu}"
+PROMETHEUS_URL="${PROMETHEUS_URL:-http://prometheus-k8s.monitoring.svc:9090}"
+SKIP_BUILD="${SKIP_BUILD:-false}"
+DELETE_CLUSTER="${DELETE_CLUSTER:-false}"
+
+IMG_REPO="${IMG%:*}"
+IMG_TAG="${IMG##*:}"
+
+have() { command -v "$1" >/dev/null 2>&1; }
+
+render_chart() {
+    # Render to stdout with either helm or the bundled subset renderer.
+    local common_sets=(
+        "wva.image.repository=$IMG_REPO"
+        "wva.image.tag=$IMG_TAG"
+        "wva.imagePullPolicy=IfNotPresent"
+        "wva.namespaceScoped=$NAMESPACE_SCOPED"
+        "wva.prometheus.baseURL=$PROMETHEUS_URL"
+        "llmd.namespace=$LLMD_NS"
+    )
+    if have "$HELM"; then
+        local args=(template "$RELEASE_NAME" "$CHART_DIR" -n "$WVA_NS"
+                    --include-crds -f "$VALUES_FILE")
+        for s in "${common_sets[@]}"; do args+=(--set "$s"); done
+        "$HELM" "${args[@]}"
+    else
+        info "no helm binary; rendering with python -m wva_tpu.utils.helmlite"
+        local args=("$CHART_DIR" --release "$RELEASE_NAME" -n "$WVA_NS"
+                    --include-crds)
+        for s in "${common_sets[@]}"; do args+=(--set "$s"); done
+        (cd "$REPO_ROOT" && "$PYTHON" -m wva_tpu.utils.helmlite "${args[@]}")
+    fi
+}
+
+undeploy() {
+    info "Undeploying $RELEASE_NAME from namespace $WVA_NS"
+    if have "$HELM" && "$HELM" status "$RELEASE_NAME" -n "$WVA_NS" >/dev/null 2>&1; then
+        "$HELM" uninstall "$RELEASE_NAME" -n "$WVA_NS"
+    else
+        render_chart | "$KUBECTL" delete -f - --ignore-not-found=true
+    fi
+    "$KUBECTL" delete namespace "$WVA_NS" --ignore-not-found=true
+    if [[ "$DELETE_CLUSTER" == "true" ]]; then
+        KIND="$KIND" CLUSTER_NAME="$CLUSTER_NAME" \
+            "$REPO_ROOT/deploy/kind-emulator/teardown.sh"
+    fi
+    ok "Undeploy complete"
+}
+
+deploy() {
+    have "$KUBECTL" || fail "kubectl not found"
+
+    # 1. Cluster (optional)
+    if [[ "$CREATE_CLUSTER" == "true" ]]; then
+        have "$KIND" || fail "kind not found (CREATE_CLUSTER=true)"
+        KIND="$KIND" KUBECTL="$KUBECTL" CLUSTER_NAME="$CLUSTER_NAME" \
+            "$REPO_ROOT/deploy/kind-emulator/setup.sh" \
+            -n "$CLUSTER_NODES" -p "$CLUSTER_TPU_PROFILE"
+    fi
+
+    # 2. Image build + load
+    if [[ "$SKIP_BUILD" != "true" ]]; then
+        have "$DOCKER" || fail "docker not found (set SKIP_BUILD=true to use a pre-pushed image)"
+        info "Building $IMG"
+        "$DOCKER" build -t "$IMG" "$REPO_ROOT"
+        if have "$KIND" && "$KIND" get clusters 2>/dev/null | grep -qx "$CLUSTER_NAME"; then
+            info "Loading $IMG into kind cluster $CLUSTER_NAME"
+            "$KIND" load docker-image "$IMG" --name "$CLUSTER_NAME"
+        fi
+    fi
+
+    # 3. Namespaces
+    "$KUBECTL" create namespace "$WVA_NS" --dry-run=client -o yaml | "$KUBECTL" apply -f -
+    "$KUBECTL" create namespace "$LLMD_NS" --dry-run=client -o yaml | "$KUBECTL" apply -f -
+
+    # 4. Chart install (CRDs included)
+    if have "$HELM"; then
+        info "Installing chart with helm"
+        local args=(upgrade --install "$RELEASE_NAME" "$CHART_DIR" -n "$WVA_NS"
+                    -f "$VALUES_FILE"
+                    --set "wva.image.repository=$IMG_REPO"
+                    --set "wva.image.tag=$IMG_TAG"
+                    --set "wva.imagePullPolicy=IfNotPresent"
+                    --set "wva.namespaceScoped=$NAMESPACE_SCOPED"
+                    --set "wva.prometheus.baseURL=$PROMETHEUS_URL"
+                    --set "llmd.namespace=$LLMD_NS")
+        "$HELM" "${args[@]}"
+    else
+        info "Installing chart with the bundled renderer + kubectl apply"
+        render_chart | "$KUBECTL" apply -f -
+    fi
+
+    # 5. Wait for rollout
+    info "Waiting for controller rollout"
+    "$KUBECTL" -n "$WVA_NS" rollout status deployment -l app.kubernetes.io/name=wva-tpu --timeout=180s \
+        || "$KUBECTL" -n "$WVA_NS" rollout status "deployment/$RELEASE_NAME-controller-manager" --timeout=180s
+
+    ok "wva-tpu deployed. Smoke test with: make test-e2e-smoke"
+}
+
+if [[ "${1:-}" == "--undeploy" ]]; then
+    undeploy
+else
+    deploy
+fi

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Destroy the fake-TPU kind cluster created by setup.sh
+# (reference deploy/kind-emulator teardown path, Makefile:102-105).
+set -euo pipefail
+
+KIND="${KIND:-kind}"
+cluster_name="${CLUSTER_NAME:-kind-wva-tpu-cluster}"
+
+if "$KIND" get clusters 2>/dev/null | grep -qx "$cluster_name"; then
+    "$KIND" delete cluster --name "$cluster_name"
+    echo "Deleted kind cluster $cluster_name"
+else
+    echo "Cluster $cluster_name not found; nothing to do"
+fi

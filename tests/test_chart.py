@@ -10,7 +10,7 @@ sys.path.insert(0, "tests")
 
 import yaml
 
-from helmlite import Renderer
+from wva_tpu.utils.helmlite import Renderer
 
 CHART = "charts/wva-tpu"
 

@@ -1,0 +1,162 @@
+"""Deploy-pipeline verification: image build boundary, Makefile lifecycle,
+install script, and the cluster-free smoke test.
+
+The build environment has no docker/kind/helm binaries, so these tests prove
+the scripted path up to the image-build boundary (VERDICT round-2 item 1):
+every script parses, every Makefile target references files that exist, the
+Dockerfile copies real paths and runs the real CLI entrypoint, the chart
+renders through the same code path install.sh uses as its no-helm fallback,
+and the full smoke (controller subprocess + fake API server + fake
+Prometheus over genuine sockets) passes.
+
+Reference lifecycle being mirrored: Makefile:96-113,239-298 +
+deploy/install.sh + Dockerfile in /root/reference.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo(*parts: str) -> str:
+    return os.path.join(REPO, *parts)
+
+
+class TestScriptsParse:
+    SCRIPTS = [
+        "deploy/install.sh",
+        "deploy/e2e/smoke.sh",
+        "deploy/kind-emulator/setup.sh",
+        "deploy/kind-emulator/teardown.sh",
+    ]
+
+    def test_bash_syntax(self):
+        for script in self.SCRIPTS:
+            path = repo(script)
+            assert os.path.isfile(path), f"{script} missing"
+            subprocess.run(["bash", "-n", path], check=True)
+
+    def test_scripts_executable(self):
+        for script in self.SCRIPTS:
+            assert os.access(repo(script), os.X_OK), f"{script} not executable"
+
+
+class TestMakefile:
+    def _makefile(self) -> str:
+        with open(repo("Makefile")) as f:
+            return f.read()
+
+    def test_reference_lifecycle_targets_exist(self):
+        text = self._makefile()
+        for target in ["create-kind-cluster", "destroy-kind-cluster",
+                       "deploy-wva-tpu-emulated-on-kind",
+                       "undeploy-wva-tpu-emulated-on-kind",
+                       "test-e2e-smoke", "test-e2e-smoke-local",
+                       "docker-build", "docker-push", "test", "bench"]:
+            assert re.search(rf"^{re.escape(target)}:", text, re.M), \
+                f"Makefile target {target} missing"
+
+    def test_targets_reference_existing_files(self):
+        text = self._makefile()
+        for path in re.findall(r"deploy/[\w/.-]+\.(?:sh|py)", text):
+            assert os.path.isfile(repo(path)), \
+                f"Makefile references missing file {path}"
+
+    def test_dry_run_resolves(self):
+        # make -n proves the recipes expand (no missing variables/includes)
+        # without running docker/kind.
+        for target in ["docker-build", "create-kind-cluster",
+                       "deploy-wva-tpu-emulated-on-kind", "test-e2e-smoke"]:
+            subprocess.run(["make", "-n", target], cwd=REPO, check=True,
+                           capture_output=True)
+
+
+class TestDockerfile:
+    def _dockerfile(self) -> str:
+        with open(repo("Dockerfile")) as f:
+            return f.read()
+
+    def test_copy_paths_exist(self):
+        for m in re.finditer(r"^COPY\s+(?!--from)(\S+)", self._dockerfile(),
+                             re.M):
+            src = m.group(1)
+            assert os.path.exists(repo(src)), \
+                f"Dockerfile COPY source {src} missing"
+
+    def test_entrypoint_is_the_cli(self):
+        text = self._dockerfile()
+        m = re.search(r'^ENTRYPOINT\s+\[(.+)\]', text, re.M)
+        assert m, "no ENTRYPOINT"
+        entry = [p.strip().strip('"') for p in m.group(1).split(",")]
+        assert entry == ["python", "-m", "wva_tpu"]
+        # The module must actually be invocable the way the image runs it.
+        result = subprocess.run(
+            [sys.executable, "-m", "wva_tpu", "--help"], cwd=REPO,
+            capture_output=True, text=True, timeout=60)
+        assert result.returncode == 0
+        assert "--metrics-bind-address" in result.stdout
+
+    def test_nonroot_user(self):
+        assert re.search(r"^USER\s+65532", self._dockerfile(), re.M), \
+            "image must run as the same non-root UID as the reference"
+
+    def test_pyproject_dependencies_cover_imports(self):
+        with open(repo("pyproject.toml")) as f:
+            pyproject = f.read()
+        for dep in ["PyYAML", "numpy", "jax"]:
+            assert dep in pyproject, f"pyproject missing dependency {dep}"
+
+
+class TestInstallScriptFallbackRenderer:
+    """install.sh renders the chart with `python -m wva_tpu.utils.helmlite`
+    when no helm binary exists — validate that exact command line."""
+
+    def test_cli_renders_with_overrides(self):
+        result = subprocess.run(
+            [sys.executable, "-m", "wva_tpu.utils.helmlite",
+             "charts/wva-tpu", "--release", "wva-tpu", "-n", "wva-tpu-system",
+             "--include-crds",
+             "--set", "wva.image.repository=example.com/wva-tpu",
+             "--set", "wva.image.tag=smoke",
+             "--set", "wva.namespaceScoped=false"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert result.returncode == 0, result.stderr
+        docs = [d for d in yaml.safe_load_all(result.stdout) if d]
+        kinds = {d["kind"] for d in docs}
+        assert "CustomResourceDefinition" in kinds  # --include-crds
+        assert "Deployment" in kinds
+        deploy = next(d for d in docs if d["kind"] == "Deployment")
+        image = deploy["spec"]["template"]["spec"]["containers"][0]["image"]
+        assert image == "example.com/wva-tpu:smoke"
+        # helm template layout: every doc carries a # Source: comment.
+        assert "# Source: wva-tpu/" in result.stdout
+
+    def test_render_apply_stream_is_valid_yaml(self):
+        result = subprocess.run(
+            [sys.executable, "-m", "wva_tpu.utils.helmlite",
+             "charts/wva-tpu", "--include-crds"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert result.returncode == 0, result.stderr
+        for doc in yaml.safe_load_all(result.stdout):
+            if doc:
+                assert "kind" in doc and "apiVersion" in doc
+
+
+class TestSmokeLocal:
+    def test_smoke_local_passes(self):
+        """The full cluster-free smoke: controller subprocess + fake API
+        server + fake Prometheus over real sockets -> scale-up decision on
+        /metrics -> clean SIGTERM."""
+        result = subprocess.run(
+            [sys.executable, repo("deploy", "e2e", "smoke_local.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=180)
+        assert result.returncode == 0, \
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        assert "SMOKE PASSED" in result.stdout

@@ -265,6 +265,17 @@ class _Parser:
                 return self.parse_aggregation()
             if name in _RANGE_FUNCS:
                 return self.parse_func()
+            if name == "vector":
+                # vector(scalar) — Prometheus's connectivity-check idiom
+                # ("vector(1)"), used by the startup validation.
+                self.next()
+                self.expect("(")
+                num = self.next()
+                if num[0] != "number":
+                    raise PromQLError(
+                        f"vector() expects a number, got {num[1]!r}")
+                self.expect(")")
+                return NumberLiteral(float(num[1]))
             return self.parse_selector()
         raise PromQLError(f"unexpected token {tok[1]!r} in {self.text!r}")
 

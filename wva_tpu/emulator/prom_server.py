@@ -1,0 +1,110 @@
+"""A real-socket Prometheus API facade over the in-memory TSDB.
+
+Serves ``/api/v1/query`` (instant queries) from a
+:class:`~wva_tpu.collector.source.promql.TimeSeriesDB` through the bundled
+PromQL-subset engine, in the exact JSON shape
+:class:`~wva_tpu.collector.source.prometheus.HTTPPromAPI` parses. This is
+the emulated counterpart of the real Prometheus the reference's e2e suites
+deploy on kind (``test/e2e/suite_test.go:45-117``): it lets a controller
+*subprocess* collect genuine metrics over HTTP without a cluster
+(``deploy/e2e/smoke_local.py``, ``make test-e2e-smoke-local``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from wva_tpu.collector.source.promql import PromQLEngine, TimeSeriesDB
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "FakePrometheusServer"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — quiet
+        pass
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path == "/-/healthy":
+            self._send_json(200, {"status": "success"})
+            return
+        if parsed.path != "/api/v1/query":
+            self._send_json(404, {"status": "error", "error": "not found"})
+            return
+        query = urllib.parse.parse_qs(parsed.query).get("query", [""])[0]
+        try:
+            points = self.server.query(query)
+        except Exception as e:  # noqa: BLE001 — surfaced as API error
+            self._send_json(400, {"status": "error", "errorType": "bad_data",
+                                  "error": str(e)})
+            return
+        self._send_json(200, {
+            "status": "success",
+            "data": {
+                "resultType": "vector",
+                "result": [
+                    {"metric": dict(p.labels),
+                     "value": [p.timestamp, repr(float(p.value))]}
+                    for p in points
+                ],
+            },
+        })
+
+    do_POST = do_GET
+
+
+class FakePrometheusServer:
+    """ThreadingHTTPServer wrapping a TSDB + PromQL engine.
+
+    ``refresh`` (optional) runs under the server lock before every query —
+    use it to re-stamp samples with the current wall clock so staleness
+    windows keep passing during a long-running smoke test.
+    """
+
+    def __init__(self, db: TimeSeriesDB,
+                 refresh: Callable[[TimeSeriesDB], None] | None = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.db = db
+        self.engine = PromQLEngine(db)
+        self._refresh = refresh
+        self._lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        # Expose query() to handlers through the server object.
+        self._httpd.query = self.query  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def query(self, promql: str):
+        with self._lock:
+            if self._refresh is not None:
+                self._refresh(self.db)
+            return self.engine.query(promql)
+
+    def start(self) -> "FakePrometheusServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="fake-prometheus", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

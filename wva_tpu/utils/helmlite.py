@@ -1,10 +1,15 @@
-"""Minimal Helm-template renderer for chart render tests.
+"""Minimal Helm-template renderer for chart render tests and the no-helm
+deploy fallback.
 
 The dev image has no ``helm`` binary, so the chart restricts itself to a
 well-defined Go-template subset (documented in ``charts/wva-tpu/README.md``)
 and this module renders it: enough to validate every manifest and the
 client-only install contract the way the reference does with
 ``helm template`` subprocesses (``test/chart/client_only_install_test.go``).
+``deploy/install.sh`` uses the CLI form (``python -m wva_tpu.utils.helmlite``)
+to render the chart for ``kubectl apply`` when no helm binary exists;
+``tests/test_chart_golden.py`` snapshots its output and, when a real helm
+binary is present, diffs it against ``helm template``.
 
 Supported:
 
@@ -207,3 +212,53 @@ class Renderer:
                 if doc:
                     docs.append(doc)
         return docs
+
+    def render_manifest(self, include_crds: bool = False) -> str:
+        """One multi-doc YAML stream in ``helm template`` layout: each
+        rendered template prefixed with ``# Source: <chart>/<path>``."""
+        chart_name = self.context["Chart"]["Name"]
+        parts: list[str] = []
+        if include_crds:
+            crd_dir = self.chart_dir / "crds"
+            if crd_dir.is_dir():
+                for path in sorted(crd_dir.glob("*.yaml")):
+                    parts.append(f"---\n# Source: {chart_name}/crds/"
+                                 f"{path.name}\n{path.read_text().strip()}\n")
+        for rel, text in self.render_chart().items():
+            # Skip templates whose render is whitespace-only (condition off),
+            # like helm does.
+            if not any(bool(d) for d in yaml.safe_load_all(text)):
+                continue
+            parts.append(f"---\n# Source: {chart_name}/{rel}\n{text.strip()}\n")
+        return "".join(parts)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m wva_tpu.utils.helmlite CHART_DIR [--set k=v ...]`` —
+    a ``helm template``-shaped CLI for environments without a helm binary
+    (used by deploy/install.sh as its render fallback)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="helmlite", description="render a wva-tpu chart (helm subset)")
+    p.add_argument("chart_dir")
+    p.add_argument("--release", default="wva")
+    p.add_argument("-n", "--namespace", default="wva-system")
+    p.add_argument("--set", action="append", default=[], metavar="PATH=VAL",
+                   dest="set_values")
+    p.add_argument("--include-crds", action="store_true")
+    args = p.parse_args(argv)
+    overrides: dict[str, str] = {}
+    for item in args.set_values:
+        if "=" not in item:
+            p.error(f"--set expects PATH=VALUE, got {item!r}")
+        k, v = item.split("=", 1)
+        overrides[k] = v
+    renderer = Renderer(args.chart_dir, release_name=args.release,
+                        namespace=args.namespace, set_values=overrides)
+    print(renderer.render_manifest(include_crds=args.include_crds), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
