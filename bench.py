@@ -90,13 +90,15 @@ def _slo_config_data():
 
 def run_policy(name: str) -> dict:
     if name == "baseline":
-        sat_cfg = SaturationScalingConfig()  # V1 defaults
+        # V1 defaults; the reference has no scale-from-N fast path, so it is
+        # disabled for both baselines to keep the comparison honest.
+        sat_cfg = SaturationScalingConfig(fast_path_enabled=False)
         hpa = HPAParams()  # chart defaults: 240s stabilization
         engine_interval = 30.0
     elif name == "baseline-fast":
         # Ablation: the reference analyzer with OUR intervals. Separates
         # interval tuning (config anyone could apply) from analyzer gains.
-        sat_cfg = SaturationScalingConfig()
+        sat_cfg = SaturationScalingConfig(fast_path_enabled=False)
         hpa = HPAParams(**FAST_HPA)
         engine_interval = 10.0
     else:  # ours
@@ -107,10 +109,18 @@ def run_policy(name: str) -> dict:
             anticipation_horizon_seconds=STARTUP_SECONDS + 30.0,
             # Clamp desired to whole-slice inventory so unplaceable replicas
             # never sit pending.
-            enable_limiter=True)
+            enable_limiter=True,
+            # Scale-from-N fast path (on by default) + immediate scale-up
+            # actuation: with a 120s provisioning horizon, waiting out HPA
+            # sync + stabilization is pure added backlog.
+            fast_actuation=True)
         sat_cfg.apply_defaults()
         hpa = HPAParams(**FAST_HPA)
-        engine_interval = 10.0
+        # A tick is one batched solver call (~ms) + a handful of PromQL
+        # queries; 5s polling is cheap for the decision loop, and with the
+        # trend fed at the fast-path cadence the first sized scale-up lands
+        # one trend-span (~10s) into the ramp.
+        engine_interval = 5.0
 
     spec = VariantSpec(
         name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
@@ -194,8 +204,21 @@ def run_policy(name: str) -> dict:
 
 def solver_microbench() -> dict:
     """The flagship compiled computation on the default JAX platform (the
-    real chip under the driver): batched SLO sizing throughput."""
+    real chip under the driver): batched SLO sizing throughput.
+
+    Timing methodology: the repetition loop runs ON DEVICE (a jitted
+    ``lax.fori_loop`` whose carry creates a data dependency between solves)
+    and wall time is taken around a single host materialization, with the
+    per-solve cost extracted from the SLOPE between two rep counts. Plain
+    ``block_until_ready`` loops were measured returning before execution
+    completes under the experimental axon TPU backend (0.03ms "per call"
+    against XLA's own 4.9ms roofline estimate), so async-loop numbers are
+    not trustworthy there; the slope method is immune to both that and the
+    tunnel round-trip latency."""
+    from functools import partial
+
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from wva_tpu.analyzers.queueing.params import ServiceParms
@@ -212,7 +235,7 @@ def solver_microbench() -> dict:
     rng = np.random.default_rng(0)
 
     def batch(n):
-        import jax.numpy as jnp
+        ks = rng.integers(512, 2048, n)
         cand = candidate_batch(
             alphas=rng.uniform(3.0, 30.0, n),
             betas=rng.uniform(0.001, 0.05, n),
@@ -220,31 +243,44 @@ def solver_microbench() -> dict:
             avg_in=rng.uniform(128, 2048, n),
             avg_out=rng.uniform(64, 1024, n),
             max_batch=rng.integers(16, 256, n),
-            k=rng.integers(512, 2048, n))
+            k=ks)
         return (cand, jnp.full((n,), 1000.0, jnp.float32),
                 jnp.full((n,), 50.0, jnp.float32),
                 jnp.zeros((n,), jnp.float32))
 
+    @partial(jax.jit, static_argnames=("reps",))
+    def repeat_solve(cand, ttft, itl, tps, reps):
+        # Each solve's TTFT target depends on the previous solve's output
+        # (value unchanged) -> the final transfer proves reps solves ran.
+        def body(_, t):
+            r = size_batch(cand, t, itl, tps)
+            return ttft + 0.0 * r["max_rate_per_s"]
+        t = jax.lax.fori_loop(0, reps, body, ttft)
+        return size_batch(cand, t, itl, tps)["max_rate_per_s"]
+
     out: dict = {"platform": platform}
+    # Slope needs two rep counts; CPU fallback runs ~13s/solve at C=8192,
+    # so it gets the minimum spread while accelerators amortize more.
+    reps_lo, reps_hi = (5, 25) if platform != "cpu" else (1, 3)
     for n in (1024, 8192):
         args = batch(n)
         t0 = time.perf_counter()
-        res = size_batch(*args)
-        jax.block_until_ready(res)
+        jax.block_until_ready(size_batch(*args))
         compile_s = time.perf_counter() - t0
-        reps = 10
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            res = size_batch(*args)
-        jax.block_until_ready(res)
-        exec_s = (time.perf_counter() - t0) / reps
+        walls = {}
+        for reps in (reps_lo, reps_hi):
+            np.asarray(repeat_solve(*args, reps=reps))  # compile + warm
+            walls[reps] = min(
+                _timed(lambda: np.asarray(repeat_solve(*args, reps=reps)))
+                for _ in range(2))
+        exec_s = (walls[reps_hi] - walls[reps_lo]) / (reps_hi - reps_lo)
         out[f"batch_{n}"] = {
             "compile_s": round(compile_s, 3),
-            "execute_s": round(exec_s, 5),
+            "execute_s": round(exec_s, 6),
             "candidates_per_s": int(n / exec_s),
         }
 
-    # Scalar facade (one candidate at a time — the reference's shape,
+    # Scalar facade (one candidate at a time — the reference's solve shape,
     # pkg/analyzer/queueanalyzer.go:127-258) for the batching speedup.
     qa = QueueAnalyzer(
         QueueConfig(max_batch_size=96, max_queue_size=384,
@@ -264,9 +300,17 @@ def solver_microbench() -> dict:
         scalar_per / (out["batch_8192"]["execute_s"] / 8192))
     out["note"] = (
         "scalar = this repo's Python one-candidate-per-call facade (the "
-        "reference's solve shape, incl. per-call dispatch overhead); "
-        "batched = compile-once execute-many on the default JAX device")
+        "reference's solve shape, incl. per-call dispatch/sync overhead — "
+        "dominated by host-device round trips on remote TPUs); batched = "
+        "compile-once execute-many on the default JAX device, device-slope "
+        "timed")
     return out
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
 
 
 def main() -> None:

@@ -202,3 +202,51 @@ class TestValueToggles:
         # Watches the MODEL's namespace (where the chart's VA lives), not
         # the release namespace.
         assert env["WATCH_NAMESPACE"] == "inference"
+
+
+class TestNamespaceScopedInstall:
+    def test_scoped_mode_renders_roles_not_manager_clusterrole(self):
+        """wva.namespaceScoped=true narrows RBAC: namespaced Roles in the
+        workload + controller namespaces, and a ClusterRole covering only
+        genuinely cluster-scoped resources (nodes/namespaces)."""
+        docs = Renderer(CHART, release_name="wva-tpu",
+                        namespace="wva-tpu-system",
+                        set_values={"wva.namespaceScoped": "true",
+                                    "llmd.namespace": "llm-d-inference"},
+                        ).render_docs()
+        roles = [d for d in docs if d["kind"] == "Role"]
+        cluster_roles = [d for d in docs if d["kind"] == "ClusterRole"
+                         and "manager" in d["metadata"]["name"]]
+        role_ns = {d["metadata"]["namespace"] for d in roles}
+        assert {"llm-d-inference", "wva-tpu-system"} <= role_ns
+        # The workload-namespace Role carries the VA permissions.
+        workload = next(d for d in roles
+                        if d["metadata"]["namespace"] == "llm-d-inference")
+        resources = {r for rule in workload["rules"]
+                     for r in rule["resources"]}
+        assert "variantautoscalings" in resources
+        # The remaining manager ClusterRole covers ONLY cluster-scoped kinds.
+        assert len(cluster_roles) == 1
+        cluster_resources = {r for rule in cluster_roles[0]["rules"]
+                             for r in rule["resources"]}
+        assert cluster_resources == {"nodes", "namespaces"}
+        # RoleBindings bind the controller ServiceAccount in both namespaces.
+        bindings = [d for d in docs if d["kind"] == "RoleBinding"]
+        assert {d["metadata"]["namespace"] for d in bindings} \
+            == {"llm-d-inference", "wva-tpu-system"}
+        # The deployment scopes its watches.
+        deploy = next(d for d in docs if d["kind"] == "Deployment")
+        env = deploy["spec"]["template"]["spec"]["containers"][0]["env"]
+        env_map = {e["name"]: e.get("value") for e in env}
+        assert env_map.get("WATCH_NAMESPACE") == "llm-d-inference"
+        assert env_map.get("WVA_SERVICEMONITOR_NAME") \
+            == "wva-tpu-controller-metrics"
+
+    def test_unscoped_mode_keeps_single_clusterrole(self):
+        docs = Renderer(CHART, release_name="wva-tpu").render_docs()
+        assert not any(d["kind"] == "Role" and
+                       "manager" in d["metadata"]["name"] for d in docs)
+        manager_cluster_roles = [
+            d for d in docs if d["kind"] == "ClusterRole"
+            and d["metadata"]["name"] == "wva-tpu-manager-role"]
+        assert len(manager_cluster_roles) == 1

@@ -1,0 +1,237 @@
+"""Scale-from-N fast path: backlog-triggered immediate engine ticks, trend
+feeding, fast actuation, and the executor trigger plumbing.
+
+Reference seam being generalized: the separate-engine pattern of
+scale-from-zero (engine.go:104-110) — 100ms detection for inactive models —
+extended to ACTIVE models so the first scale-up decision lands at detection
+time instead of the next poll boundary (round-2 verdict item 2).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from wva_tpu.emulator import (
+    EmulationHarness,
+    HPAParams,
+    ServingParams,
+    VariantSpec,
+)
+from wva_tpu.interfaces import SaturationScalingConfig
+
+MODEL = "meta-llama/Llama-3.1-8B"
+
+
+class TestConfigKeys:
+    def test_from_dict_and_defaults(self):
+        cfg = SaturationScalingConfig.from_dict({
+            "fastPathEnabled": "false",
+            "fastPathQueueThreshold": "4",
+            "fastPathCooldownSeconds": "30",
+            "fastActuation": "true",
+        })
+        assert cfg.fast_path_enabled is False
+        assert cfg.fast_path_queue_threshold == 4.0
+        assert cfg.fast_path_cooldown_seconds == 30.0
+        assert cfg.fast_actuation is True
+        # Defaults: fast path on, direct actuation off (reference contract).
+        d = SaturationScalingConfig()
+        assert d.fast_path_enabled is True
+        assert d.fast_actuation is False
+        d.validate()
+
+    def test_validation(self):
+        bad = SaturationScalingConfig(fast_path_queue_threshold=-1)
+        with pytest.raises(ValueError, match="fastPathQueueThreshold"):
+            bad.validate()
+        bad = SaturationScalingConfig(fast_path_cooldown_seconds=-0.1)
+        with pytest.raises(ValueError, match="fastPathCooldownSeconds"):
+            bad.validate()
+
+
+class TestExecutorTrigger:
+    def test_consume_trigger(self):
+        from wva_tpu.engines.executor import PollingExecutor
+
+        ex = PollingExecutor(lambda: None, interval=10.0)
+        assert ex.consume_trigger() is False
+        ex.trigger()
+        assert ex.consume_trigger() is True
+        assert ex.consume_trigger() is False  # cleared
+
+    def test_trigger_wakes_wall_clock_loop_early(self):
+        from wva_tpu.engines.executor import PollingExecutor
+
+        ticks: list[float] = []
+        stop = threading.Event()
+        ex = PollingExecutor(lambda: ticks.append(time.monotonic()),
+                             interval=30.0)
+        thread = threading.Thread(target=ex.start, args=(stop,), daemon=True)
+        thread.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not ticks and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ticks, "first tick never ran"
+            t0 = time.monotonic()
+            ex.trigger()
+            while len(ticks) < 2 and time.monotonic() < t0 + 5.0:
+                time.sleep(0.01)
+            assert len(ticks) >= 2, "trigger did not wake the loop"
+            # Woke within ~1s, far below the 30s interval.
+            assert ticks[1] - t0 < 2.0
+        finally:
+            stop.set()
+            thread.join(timeout=5.0)
+
+
+def make_harness(load, sat_cfg=None, **kw):
+    spec = VariantSpec(
+        name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
+        chips_per_replica=8, cost=10.0, initial_replicas=1,
+        serving=ServingParams(engine="jetstream"),
+        load=load,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=120.0,
+                      sync_period_seconds=10.0))
+    return EmulationHarness([spec], saturation_config=sat_cfg,
+                            startup_seconds=kw.pop("startup_seconds", 60.0),
+                            engine_interval=kw.pop("engine_interval", 30.0),
+                            **kw)
+
+
+def slo_cfg(**kw):
+    cfg = SaturationScalingConfig(analyzer_name="slo", enable_limiter=True,
+                                  **kw)
+    cfg.apply_defaults()
+    return cfg
+
+
+def slo_config_data():
+    from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms, TargetPerf
+    from wva_tpu.config.slo import SLOConfigData, ServiceClass
+
+    return SLOConfigData(
+        service_classes=[ServiceClass(
+            name="premium", priority=1,
+            model_targets={MODEL: TargetPerf(target_ttft_ms=1000.0)})],
+        profiles=[PerfProfile(
+            model_id=MODEL, accelerator="v5e-8",
+            service_parms=ServiceParms(alpha=18.0, beta=0.00267,
+                                       gamma=0.00002),
+            max_batch_size=96, max_queue_size=384)])
+
+
+class TestFastPathMonitor:
+    def test_backlog_triggers_and_cooldown(self):
+        """A spike that floods the scheduler queue must request an immediate
+        engine tick; the per-model cooldown bounds repeats."""
+        # Steady 4 req/s for warm-up, then a sudden 80 req/s flood.
+        harness = make_harness(
+            load=lambda t: 4.0 if t < 60 else 80.0,
+            sat_cfg=slo_cfg(fast_path_cooldown_seconds=15.0))
+        harness.config.update_slo_config(slo_config_data())
+        harness.run(55.0)
+        monitor = harness.manager.fastpath
+        assert monitor.check() == []  # no backlog at 4 req/s on one slice
+
+        harness.run(20.0)  # flood hits; queue builds within seconds
+        triggered = monitor.check()
+        assert triggered == [f"inference|{MODEL}"]
+        # Engine executor got the wake-up.
+        assert harness.manager.engine.executor.consume_trigger() is True
+        # Cooldown: immediate re-check does not re-trigger.
+        assert monitor.check() == []
+
+    def test_disabled_by_config(self):
+        harness = make_harness(
+            load=lambda t: 80.0,
+            sat_cfg=slo_cfg(fast_path_enabled=False))
+        harness.config.update_slo_config(slo_config_data())
+        harness.run(30.0)
+        assert harness.manager.fastpath.check() == []
+        assert harness.manager.engine.executor.consume_trigger() is False
+
+
+class TestSpikeEndToEnd:
+    def test_fast_path_beats_poll_interval_on_spike(self):
+        """With a 30s engine interval, a spike at t=60 must produce a
+        scale-up decision within a few seconds (fast path + fast actuation),
+        not at the next poll boundary."""
+        harness = make_harness(
+            load=lambda t: 4.0 if t < 60 else 80.0,
+            sat_cfg=slo_cfg(fast_actuation=True),
+            engine_interval=30.0)
+        harness.config.update_slo_config(slo_config_data())
+
+        scale_up_at = {"t": None}
+
+        def watch(h, t):
+            if scale_up_at["t"] is None and h.replicas_of("llama-v5e") > 1:
+                scale_up_at["t"] = t
+
+        harness.run(120.0, on_step=watch)
+        assert scale_up_at["t"] is not None, "never scaled up"
+        # Spike at t=60; last scheduled tick at t=60 (interval 30 from 30),
+        # next at t=90. The fast path must beat t=90 by a wide margin, and
+        # fast actuation must not wait for the 10s HPA sync either.
+        assert 60.0 <= scale_up_at["t"] <= 75.0, scale_up_at["t"]
+
+    def test_without_fast_actuation_hpa_still_converges(self):
+        """Fast path on, fast actuation off: the decision is immediate but
+        application waits for HPA — desired replicas still rise, later."""
+        harness = make_harness(
+            load=lambda t: 4.0 if t < 60 else 80.0,
+            sat_cfg=slo_cfg(),
+            engine_interval=30.0)
+        harness.config.update_slo_config(slo_config_data())
+        harness.run(120.0)
+        assert harness.replicas_of("llama-v5e") > 1
+
+
+class TestArrivalRateFastWindow:
+    def test_max_of_windows_during_ramp(self):
+        """During a ramp the 10s window sees the current rate while the 30s
+        window lags; the collector must report the max of the two."""
+        from wva_tpu.collector.registration.slo import (
+            collect_optimizer_metrics,
+            register_slo_queries,
+        )
+        from wva_tpu.collector.source import (
+            InMemoryPromAPI,
+            PrometheusSource,
+            SourceRegistry,
+            TimeSeriesDB,
+        )
+        from wva_tpu.collector.source.registry import PROMETHEUS_SOURCE_NAME
+        from wva_tpu.utils.clock import FakeClock
+
+        import os
+        os.environ["WVA_SLO_ARRIVAL_RATE_WINDOW"] = "30s"
+        try:
+            clock = FakeClock(start=1000.0)
+            db = TimeSeriesDB(clock=clock)
+            reg = SourceRegistry()
+            src = PrometheusSource(InMemoryPromAPI(db), clock=clock)
+            reg.register(PROMETHEUS_SOURCE_NAME, src)
+            register_slo_queries(reg)
+
+            labels = {"namespace": "inf", "model_name": MODEL}
+            # Counter accelerating: 0 -> 10 -> 40 over 0/15/30s: the last 10s
+            # saw 30 requests (3/s... scaled below), the 30s average is lower.
+            total = 0.0
+            for t, incr in ((0, 0.0), (5, 5.0), (10, 5.0), (15, 5.0),
+                            (20, 10.0), (25, 15.0), (30, 20.0)):
+                total += incr
+                clock.advance(1000.0 + t - clock.now())
+                db.add_sample("jetstream_request_success_total", labels, total)
+            metrics = collect_optimizer_metrics(src, MODEL, "inf")
+            assert metrics is not None
+            # Long window: (60-0)/30 = 2/s. Fast window [10s]: (60-25)/10 =
+            # 3.5/s. max -> fast wins.
+            assert metrics.arrival_rate == pytest.approx(3.5 * 60.0, rel=0.01)
+        finally:
+            os.environ.pop("WVA_SLO_ARRIVAL_RATE_WINDOW", None)

@@ -87,7 +87,7 @@ class TestGlobalOptimizerMode:
 class TestServiceMonitorAlerting:
     def test_deletion_emits_warning_event(self):
         mgr, cluster, tsdb, clock = make_world(kv=0.2)
-        name = mgr.va_reconciler.SERVICEMONITOR_NAME
+        name = mgr.va_reconciler.servicemonitor_name
         cluster.create(ServiceMonitor(
             metadata=ObjectMeta(name=name, namespace="monitoring")))
         cluster.delete(ServiceMonitor.KIND, "monitoring", name)

@@ -1,0 +1,245 @@
+"""Namespace-scoped list+watch, re-list convergence, clean stream end, and
+the status-404 distinction (round-2 verdict items 6 + advisor findings).
+
+Reference semantics being matched: controller-runtime's cache scoping for
+WATCH_NAMESPACE (manager options in cmd/main.go) — a scoped manager's watch
+traffic and RBAC shrink to the namespace, and a level-triggered reconciler
+converges after a watch gap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+from wva_tpu.k8s import ConfigMap, Deployment, FakeCluster
+from wva_tpu.k8s.client import ADDED, DELETED
+from wva_tpu.k8s.fake_apiserver import FakeAPIServer
+from wva_tpu.k8s.kubeconfig import Credentials
+from wva_tpu.k8s.rest import RestKubeClient
+
+
+def make_va(name: str, ns: str) -> VariantAutoscaling:
+    return VariantAutoscaling(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name=name),
+            model_id=f"m/{name}", variant_cost="1.0"))
+
+
+def wait_for(predicate, timeout=10.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture()
+def world():
+    cluster = FakeCluster()
+    server = FakeAPIServer(cluster).start()
+    clients = []
+
+    def make_client(**kw):
+        c = RestKubeClient(Credentials(server=server.url), timeout=5.0, **kw)
+        clients.append(c)
+        return c
+
+    yield cluster, server, make_client
+    for c in clients:
+        c.stop()
+    server.shutdown()
+
+
+class TestNamespaceScopedWatch:
+    def test_scoped_watch_never_sees_other_namespaces(self, world):
+        cluster, server, make_client = world
+        client = make_client(watch_namespace="scoped-ns")
+        seen: list[tuple[str, str]] = []
+        client.watch(VariantAutoscaling.kind,
+                     lambda e, o: seen.append((e, o.metadata.namespace)))
+        time.sleep(0.3)  # stream up
+        cluster.create(make_va("mine", "scoped-ns"))
+        cluster.create(make_va("other", "other-ns"))
+        cluster.create(make_va("mine-2", "scoped-ns"))
+        wait_for(lambda: len(seen) >= 2, what="scoped events")
+        time.sleep(0.3)  # would-be delivery window for the foreign event
+        assert {ns for _, ns in seen} == {"scoped-ns"}
+        assert len(seen) == 2
+
+    def test_scoped_list_paths_namespaced(self, world):
+        cluster, server, make_client = world
+        cluster.create(make_va("a", "ns-a"))
+        cluster.create(make_va("b", "ns-b"))
+        client = make_client(watch_namespace="ns-a")
+        # Plain list() keeps its explicit-namespace contract.
+        assert len(client.list(VariantAutoscaling.kind)) == 2
+        assert len(client.list(VariantAutoscaling.kind, namespace="ns-a")) == 1
+
+    def test_scoped_configmap_watch_includes_system_namespace(
+            self, world, monkeypatch):
+        """Global ConfigMaps live in the controller namespace; a scoped
+        client must keep a stream there or hot-reload dies."""
+        monkeypatch.setenv("POD_NAMESPACE", "wva-system")
+        cluster, server, make_client = world
+        client = make_client(watch_namespace="workload-ns")
+        seen: list[str] = []
+        client.watch(ConfigMap.KIND,
+                     lambda e, o: seen.append(o.metadata.namespace))
+        time.sleep(0.3)
+        cluster.create(ConfigMap(
+            metadata=ObjectMeta(name="wva-saturation-scaling-config",
+                                namespace="wva-system"), data={}))
+        cluster.create(ConfigMap(
+            metadata=ObjectMeta(name="wva-saturation-scaling-config",
+                                namespace="workload-ns"), data={}))
+        cluster.create(ConfigMap(
+            metadata=ObjectMeta(name="unrelated", namespace="elsewhere"),
+            data={}))
+        wait_for(lambda: len(seen) >= 2, what="configmap events")
+        time.sleep(0.3)
+        assert sorted(set(seen)) == ["workload-ns", "wva-system"]
+
+
+class TestRelistSynthesis:
+    def test_forced_relist_synthesizes_added_and_deleted(self, world):
+        """After a watch gap (410 / stream error), the re-list must dispatch
+        ADDED for everything live and DELETED for everything that vanished,
+        so level-triggered handlers converge (advisor finding)."""
+        cluster, server, make_client = world
+        cluster.create(make_va("kept", "ns"))
+        cluster.create(make_va("gone", "ns"))
+        client = make_client()
+        events: list[tuple[str, str]] = []
+        client.watch(VariantAutoscaling.kind,
+                     lambda e, o: events.append((e, o.metadata.name)))
+        time.sleep(0.3)
+        # Initial list is silent (only subsequent changes dispatch).
+        kind = VariantAutoscaling.kind
+        assert events == []
+        # Simulate a gap: mutate the world while no stream is consuming it,
+        # then force a re-list exactly like the 410 path does.
+        cluster.delete(kind, "ns", "gone")
+        cluster.create(make_va("new", "ns"))
+        # Drain whatever the live stream already delivered, then re-list.
+        time.sleep(0.3)
+        events.clear()
+        client._list_for_watch(kind, "", synthesize=True)
+        added = {n for e, n in events if e == ADDED}
+        deleted = {n for e, n in events if e == DELETED}
+        assert added == {"kept", "new"}
+        # "gone" already DELETED via the live stream, so the re-list diff
+        # has nothing to synthesize for it.
+        assert deleted == set()
+
+    def test_relist_after_missed_delete(self, world):
+        """A delete the stream never saw must surface as synthetic DELETED."""
+        cluster, server, make_client = world
+        cluster.create(make_va("will-vanish", "ns"))
+        client = make_client()
+        kind = VariantAutoscaling.kind
+        events: list[tuple[str, str]] = []
+        # Seed the known-map via an initial (silent) list, with NO stream
+        # running (watch() not called -> nothing consumes the gap).
+        client._watchers.setdefault(kind, []).append(
+            lambda e, o: events.append((e, o.metadata.name)))
+        client._list_for_watch(kind, "", synthesize=False)
+        cluster.delete(kind, "ns", "will-vanish")
+        client._list_for_watch(kind, "", synthesize=True)
+        assert (DELETED, "will-vanish") in events
+
+
+class TestCleanStreamEnd:
+    def test_watch_stream_terminates_cleanly_on_timeout(self, world):
+        """timeoutSeconds expiry must end the chunked stream with the 0-length
+        terminator so clients observe EOF (not a socket timeout) and reset
+        their reconnect backoff (advisor finding)."""
+        cluster, server, make_client = world
+        url = (f"{server.url}/apis/wva.tpu.llmd.ai/v1alpha1/"
+               f"variantautoscalings?watch=true&timeoutSeconds=1")
+        t0 = time.time()
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            body = resp.read()  # returns at EOF; raises on socket timeout
+        elapsed = time.time() - t0
+        assert body == b""
+        assert elapsed < 5.0, "stream should end at the 1s server deadline"
+
+    def test_client_backoff_resets_after_clean_end(self, world):
+        """_stream_watch returning normally (clean EOF) resets backoff: the
+        watch loop reconnects immediately rather than growing toward 30s."""
+        cluster, server, make_client = world
+        client = make_client()
+        seen = threading.Event()
+        client.watch(VariantAutoscaling.kind, lambda e, o: seen.set())
+        time.sleep(0.3)
+        cluster.create(make_va("x", "ns"))
+        assert seen.wait(5.0)
+
+
+class TestStatus404Distinction:
+    def test_update_status_object_not_found_raises(self, world):
+        from wva_tpu.k8s.client import NotFoundError
+
+        cluster, server, make_client = world
+        client = make_client()
+        with pytest.raises(NotFoundError):
+            client.update_status(make_va("missing", "ns"))
+
+    def test_is_object_not_found_keys_on_details(self):
+        from wva_tpu.k8s.rest import ApiError, RestKubeClient
+
+        obj_404 = ApiError(404, '{"kind":"Status","details":{"name":"x"}}')
+        assert RestKubeClient._is_object_not_found(obj_404, "x") is True
+        # Subresource-missing 404: no details naming the object.
+        sub_404 = ApiError(
+            404, '{"kind":"Status","message":"the server could not find the '
+                 'requested resource"}')
+        assert RestKubeClient._is_object_not_found(sub_404, "x") is False
+        # Non-JSON bodies (proxies, other locales) never misclassify.
+        text_404 = ApiError(404, "nicht gefunden")
+        assert RestKubeClient._is_object_not_found(text_404, "x") is False
+
+
+class TestGlobalOptimizerWinnerMismatch:
+    def test_unmatched_accelerator_holds_steady(self):
+        """A solver allocation naming an accelerator no live variant serves
+        must hold replicas, not consolidate the fleet to zero (advisor
+        finding on engine.py:530)."""
+        from wva_tpu.engines.saturation.engine import SaturationEngine
+        from wva_tpu.interfaces import (
+            AnalyzerResult,
+            VariantReplicaState,
+        )
+        from wva_tpu.fleet.allocation import FleetAllocation
+        from wva_tpu.fleet.solver import Solution
+        from wva_tpu.pipeline.optimizer import ModelScalingRequest
+
+        # Minimal engine shell: _allocations_to_decisions only needs clock +
+        # hold state.
+        engine = SaturationEngine.__new__(SaturationEngine)
+        from wva_tpu.utils.clock import FakeClock
+
+        engine.clock = FakeClock(start=1000.0)
+        engine._migration_holds = {}
+
+        req = ModelScalingRequest(
+            model_id="m", namespace="ns",
+            result=AnalyzerResult(analyzer_name="slo", model_id="m",
+                                  namespace="ns"),
+            variant_states=[
+                VariantReplicaState(variant_name="v-old",
+                                    accelerator_name="v5e-8",
+                                    current_replicas=3, pending_replicas=0),
+            ])
+        solution = Solution(allocations={
+            "ns/m": FleetAllocation(accelerator="v5p-8", num_replicas=2)})
+        decisions = engine._allocations_to_decisions({"ns/m": req}, solution)
+        assert len(decisions) == 1
+        assert decisions[0].target_replicas == 3  # held, not zeroed

@@ -524,3 +524,59 @@ class TestAnalyzeBatchValidMask:
         assert analyzed[0] == pytest.approx(qa.min_rate_per_s, rel=1e-4)
         assert analyzed[1] == pytest.approx(mid, rel=1e-4)
         assert analyzed[2] == pytest.approx(qa.max_rate_per_s, rel=1e-4)
+
+
+class TestBucketedSizing:
+    def test_bucketed_matches_full_width_kernel(self):
+        """size_batch_bucketed is pure dispatch: results must match the
+        single K_MAX-wide kernel exactly (states above k are masked either
+        way), across candidates spanning several k buckets."""
+        import numpy as np
+
+        from wva_tpu.analyzers.queueing.queue_model import (
+            candidate_batch,
+            size_batch,
+            size_batch_bucketed,
+        )
+
+        rng = np.random.default_rng(7)
+        n = 37  # odd size: exercises padding + scatter
+        cand = candidate_batch(
+            alphas=rng.uniform(3.0, 30.0, n),
+            betas=rng.uniform(0.001, 0.05, n),
+            gammas=rng.uniform(0.00001, 0.002, n),
+            avg_in=rng.uniform(128, 2048, n),
+            avg_out=rng.uniform(64, 1024, n),
+            max_batch=rng.integers(16, 256, n),
+            k=rng.integers(64, 2048, n),  # spans all buckets incl. < min
+        )
+        ttft = np.full((n,), 1000.0, np.float32)
+        itl = np.full((n,), 50.0, np.float32)
+        tps = np.zeros((n,), np.float32)
+
+        full = size_batch(cand, ttft, itl, tps)
+        bucketed = size_batch_bucketed(cand, ttft, itl, tps)
+        for key in full:
+            np.testing.assert_allclose(
+                np.asarray(bucketed[key]), np.asarray(full[key]),
+                rtol=1e-5, atol=1e-6, err_msg=key)
+
+    def test_single_bucket_fast_path(self):
+        """All candidates in one bucket with pow2 count: no scatter copy."""
+        import numpy as np
+
+        from wva_tpu.analyzers.queueing.queue_model import (
+            candidate_batch,
+            size_batch_bucketed,
+        )
+
+        n = 8
+        cand = candidate_batch(
+            alphas=[18.0] * n, betas=[0.00267] * n, gammas=[0.00002] * n,
+            avg_in=[512] * n, avg_out=[256] * n,
+            max_batch=[96] * n, k=[200] * n)
+        out = size_batch_bucketed(
+            cand, np.full((n,), 1000.0, np.float32),
+            np.full((n,), 50.0, np.float32), np.zeros((n,), np.float32))
+        assert out["max_rate_per_s"].shape == (n,)
+        assert float(out["max_rate_per_s"][0]) > 0

@@ -128,7 +128,10 @@ def main(argv: list[str] | None = None) -> int:
     except CredentialError as e:
         log.error("no API server credentials: %s", e)
         return 1
-    client = RestKubeClient(creds)
+    # Namespace-scoped mode: watch streams hit /namespaces/<ns>/... so RBAC
+    # can be a Role and other namespaces' objects are never seen.
+    client = RestKubeClient(creds,
+                            watch_namespace=cfg.watch_namespace() or "")
     try:
         client.list("Namespace")
     except Exception as e:  # noqa: BLE001 — fail fast

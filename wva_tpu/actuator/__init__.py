@@ -54,15 +54,20 @@ class DirectActuator:
         self.client = client
 
     def scale_target_object(self, kind: str, namespace: str, name: str,
-                            replicas: int) -> bool:
+                            replicas: int, only_up: bool = False) -> bool:
         """Set spec.replicas via the scale subresource; returns True when a
-        write happened (False = already at the target)."""
+        write happened (False = already at the target). ``only_up`` never
+        reduces replicas (the fast-actuation path accelerates scale-up only;
+        scale-down stays HPA-paced)."""
         try:
             current = self.client.get(kind, namespace, name)
         except NotFoundError:
             raise
         current_replicas = getattr(current, "replicas", None)
         if current_replicas == replicas:
+            return False
+        if only_up and current_replicas is not None \
+                and replicas < current_replicas:
             return False
         self.client.patch_scale(kind, namespace, name, replicas)
         log.info("Scaled %s %s/%s: %s -> %d", kind, namespace, name,

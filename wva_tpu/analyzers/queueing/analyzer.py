@@ -34,7 +34,10 @@ from wva_tpu.analyzers.queueing.params import (
     RequestSize,
     TargetPerf,
 )
-from wva_tpu.analyzers.queueing.queue_model import candidate_batch, size_batch
+from wva_tpu.analyzers.queueing.queue_model import (
+    candidate_batch,
+    size_batch_bucketed,
+)
 from wva_tpu.analyzers.trend import DemandTrend
 from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST
 
@@ -64,6 +67,21 @@ DEFAULT_AVG_OUTPUT_TOKENS = 256.0
 # enough not to thrash on transient queue blips (≈ one engine tick).
 BACKLOG_DRAIN_HORIZON_SECONDS = 15.0
 
+# Trend fit bounds. The fast-path monitor feeds demand samples every few
+# seconds (in addition to one per engine tick), so a 10s span already holds
+# several points and the least-squares fit is stable; the sparse
+# engine-tick-only fallback is covered by min_samples.
+TREND_MIN_SPAN_SECONDS = 10.0
+TREND_MIN_SAMPLES = 3
+
+
+def demand_estimate(arrival_rate_per_min: float, backlog: float) -> float:
+    """Demand (req/s) = completion rate + backlog drained within the recovery
+    horizon. Shared by analyze() and the fast-path trend feed so the trend
+    series mixes consistent units."""
+    return (max(arrival_rate_per_min, 0.0) / 60.0
+            + max(backlog, 0.0) / BACKLOG_DRAIN_HORIZON_SECONDS)
+
 
 @dataclass
 class _Candidate:
@@ -86,7 +104,9 @@ class QueueingModelAnalyzer(Analyzer):
                  clock: Clock | None = None) -> None:
         self.profiles = profiles or PerfProfileStore()
         self.clock = clock or SYSTEM_CLOCK
-        self._demand_trend = DemandTrend()
+        self._demand_trend = DemandTrend(
+            min_span_seconds=TREND_MIN_SPAN_SECONDS,
+            min_samples=TREND_MIN_SAMPLES)
         # Last-synced config per namespace scope ("" = global); analyze()
         # resolves namespace-local > global, never another namespace's.
         self._slo_by_ns: dict[str, SLOConfigData | None] = {}
@@ -97,6 +117,16 @@ class QueueingModelAnalyzer(Analyzer):
     def prune(self, active_model_keys: set[str]) -> None:
         """Drop demand-trend series for models that no longer exist."""
         self._demand_trend.evict_missing(active_model_keys)
+
+    def observe_demand(self, namespace: str, model_id: str, now: float,
+                       arrival_rate_per_min: float, backlog: float) -> None:
+        """Feed an out-of-tick demand sample into the trend estimator (the
+        fast-path monitor calls this every few seconds, so the anticipation
+        slope is available within the first engine tick instead of after
+        several)."""
+        self._demand_trend.observe(
+            f"{namespace}|{model_id}", now,
+            demand_estimate(arrival_rate_per_min, backlog))
 
     def sync_from_config(self, cfg: SLOConfigData | None,
                          namespace: str = "") -> None:
@@ -158,9 +188,15 @@ class QueueingModelAnalyzer(Analyzer):
         demand = self._demand_per_s(input)
         # Provisioning-horizon anticipation (growth only), same semantics as
         # the V2 analyzer: scale-up sizes for projected demand, scale-down
-        # keeps using current demand.
+        # keeps using current demand. The TREND series deliberately uses the
+        # same estimate the fast-path monitor feeds (arrival rate +
+        # scheduler flow-control backlog, NO per-replica queues): mixing two
+        # demand definitions at different cadences would sawtooth the
+        # least-squares slope. Per-replica queueing still counts in the
+        # sizing demand above.
         slope = self._demand_trend.observe(
-            f"{input.namespace}|{input.model_id}", result.analyzed_at, demand)
+            f"{input.namespace}|{input.model_id}", result.analyzed_at,
+            self._trend_demand_per_s(input))
         scaling_demand = demand
         if cfg.anticipation_horizon_seconds > 0:
             scaling_demand += max(slope, 0.0) * cfg.anticipation_horizon_seconds
@@ -217,14 +253,21 @@ class QueueingModelAnalyzer(Analyzer):
         drained within a short horizon: with sub-second TTFT SLOs, a
         backlog drained over a minute is a minute of misses, so the solver
         must size recovery capacity, not just steady-state capacity."""
-        demand = 0.0
-        if input.optimizer_metrics is not None:
-            demand += max(input.optimizer_metrics.arrival_rate, 0.0) / 60.0
+        rate_per_min = (input.optimizer_metrics.arrival_rate
+                        if input.optimizer_metrics is not None else 0.0)
         backlog = sum(max(rm.queue_length, 0) for rm in input.replica_metrics)
         if input.scheduler_queue is not None:
             backlog += max(input.scheduler_queue.queue_size, 0)
-        demand += backlog / BACKLOG_DRAIN_HORIZON_SECONDS
-        return demand
+        return demand_estimate(rate_per_min, backlog)
+
+    def _trend_demand_per_s(self, input: AnalyzerInput) -> float:
+        """The trend-series demand: exactly what the fast-path monitor can
+        observe at its cadence (see :meth:`observe_demand`)."""
+        rate_per_min = (input.optimizer_metrics.arrival_rate
+                        if input.optimizer_metrics is not None else 0.0)
+        backlog = (max(input.scheduler_queue.queue_size, 0)
+                   if input.scheduler_queue is not None else 0.0)
+        return demand_estimate(rate_per_min, backlog)
 
     def _prepare_candidates(
         self, input: AnalyzerInput, targets: TargetPerf, request_size: RequestSize,
@@ -259,14 +302,18 @@ class QueueingModelAnalyzer(Analyzer):
         return candidates
 
     def _size_candidates(self, candidates: list[_Candidate]) -> list[float]:
-        """One batched size_batch call across every candidate. The batch is
+        """One batched sizing call across every candidate. The batch is
         padded to power-of-two buckets (min 8) so XLA compiles a handful of
         shapes total instead of one executable per fleet size (first TPU
         compile is 20-40s; recompiling per candidate-count would stall
-        ticks)."""
+        ticks). ``size_batch_bucketed`` also trims the state axis to the
+        fleet's largest occupancy bound — the ``k_host`` ints are already in
+        hand, so no device sync is paid for the trim decision."""
         n = len(candidates)
         bucket = max(8, 1 << (n - 1).bit_length())
         padded = candidates + [candidates[0]] * (bucket - n)
+        ks = [c.profile.max_batch_size + c.profile.max_queue_size
+              for c in padded]
         cand = candidate_batch(
             [c.profile.service_parms.alpha for c in padded],
             [c.profile.service_parms.beta for c in padded],
@@ -274,12 +321,13 @@ class QueueingModelAnalyzer(Analyzer):
             [c.request_size.avg_input_tokens for c in padded],
             [c.request_size.avg_output_tokens for c in padded],
             [c.profile.max_batch_size for c in padded],
-            [c.profile.max_batch_size + c.profile.max_queue_size for c in padded],
+            ks,
         )
-        out = size_batch(
+        out = size_batch_bucketed(
             cand,
             jnp.asarray([c.targets.target_ttft_ms for c in padded], jnp.float32),
             jnp.asarray([c.targets.target_itl_ms for c in padded], jnp.float32),
             jnp.asarray([c.targets.target_tps for c in padded], jnp.float32),
+            k_host=ks,
         )
         return [float(x) for x in out["max_rate_per_s"][:n]]

@@ -22,14 +22,25 @@ DEFAULT_WINDOW_SECONDS = 180.0
 # Slope needs at least this much time span to be meaningful; below it the
 # estimator returns 0 (no anticipation) rather than extrapolating noise.
 MIN_SPAN_SECONDS = 20.0
-MAX_SAMPLES_PER_KEY = 64
+MIN_SAMPLES = 2
+MAX_SAMPLES_PER_KEY = 256
 
 
 class DemandTrend:
-    """Thread-safe sliding-window linear-trend estimator keyed by model."""
+    """Thread-safe sliding-window linear-trend estimator keyed by model.
 
-    def __init__(self, window_seconds: float = DEFAULT_WINDOW_SECONDS) -> None:
+    ``min_span_seconds``/``min_samples`` trade anticipation latency against
+    noise: a sparse series (one sample per engine tick) needs a long span to
+    be meaningful, while a densely fed series (the fast-path monitor samples
+    every few seconds) supports a short span because the least-squares fit
+    averages many points."""
+
+    def __init__(self, window_seconds: float = DEFAULT_WINDOW_SECONDS,
+                 min_span_seconds: float = MIN_SPAN_SECONDS,
+                 min_samples: int = MIN_SAMPLES) -> None:
         self.window_seconds = window_seconds
+        self.min_span_seconds = min_span_seconds
+        self.min_samples = max(min_samples, 2)
         self._mu = threading.Lock()
         self._series: dict[str, deque[tuple[float, float]]] = {}
 
@@ -56,14 +67,13 @@ class DemandTrend:
                 del self._series[k]
             return len(stale)
 
-    @staticmethod
-    def _slope(series: deque[tuple[float, float]]) -> float:
+    def _slope(self, series: deque[tuple[float, float]]) -> float:
         n = len(series)
-        if n < 2:
+        if n < self.min_samples:
             return 0.0
         t0 = series[0][0]
         span = series[-1][0] - t0
-        if span < MIN_SPAN_SECONDS:
+        if span < self.min_span_seconds:
             return 0.0
         # Least-squares slope of demand over time.
         sum_t = sum_d = sum_tt = sum_td = 0.0

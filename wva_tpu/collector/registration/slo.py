@@ -48,6 +48,14 @@ def arrival_rate_window() -> str:
 QUERY_AVG_TTFT = "model_avg_ttft"
 QUERY_AVG_ITL = "model_avg_itl"
 
+# Short-window companion to the arrival-rate query. During a ramp the
+# long-window rate lags the true rate by ~half a window; the fast window
+# tracks it closely, so the collector reports max(long, fast). With a scrape
+# interval above the fast window the query simply returns no data and the
+# long window stands alone (rate() needs >=2 samples) — strictly additive.
+QUERY_ARRIVAL_RATE_FAST = "model_arrival_rate_fast"
+FAST_ARRIVAL_RATE_WINDOW = "10s"
+
 _NS_MODEL = '{namespace="{{.namespace}}",model_name="{{.modelID}}"}'
 
 
@@ -66,6 +74,18 @@ def register_slo_queries(source_registry: SourceRegistry) -> None:
         ),
         params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
         description=f"Model request arrival (completion) rate over {window}",
+    ))
+    ql.register_if_absent(QueryTemplate(
+        name=QUERY_ARRIVAL_RATE_FAST,
+        template=(
+            f"sum(rate(vllm:request_success_total{_NS_MODEL}"
+            f"[{FAST_ARRIVAL_RATE_WINDOW}])"
+            f" or rate(jetstream_request_success_total{_NS_MODEL}"
+            f"[{FAST_ARRIVAL_RATE_WINDOW}]))"
+        ),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+        description=("Model request completion rate over "
+                     f"{FAST_ARRIVAL_RATE_WINDOW} (ramp tracking)"),
     ))
     ql.register_if_absent(QueryTemplate(
         name=QUERY_AVG_TTFT,
@@ -99,7 +119,8 @@ def collect_optimizer_metrics(
     params = {PARAM_MODEL_ID: model_id, PARAM_NAMESPACE: namespace}
     try:
         results = metrics_source.refresh(RefreshSpec(
-            queries=[QUERY_ARRIVAL_RATE, QUERY_AVG_TTFT, QUERY_AVG_ITL],
+            queries=[QUERY_ARRIVAL_RATE, QUERY_ARRIVAL_RATE_FAST,
+                     QUERY_AVG_TTFT, QUERY_AVG_ITL],
             params=params))
     except Exception as e:  # noqa: BLE001
         log.debug("optimizer metrics unavailable for %s: %s", model_id, e)
@@ -117,6 +138,13 @@ def collect_optimizer_metrics(
     rate = first_value(QUERY_ARRIVAL_RATE)
     if rate is None:
         return None
+    # During ramps the long window under-reports by ~half a window; the fast
+    # window keeps up. max() is safe: both are completion rates of the same
+    # counters, so steady state agrees and dips fall back to the long window
+    # (scale-down damping).
+    fast = first_value(QUERY_ARRIVAL_RATE_FAST)
+    if fast is not None:
+        rate = max(rate, fast)
     return OptimizerMetrics(
         arrival_rate=rate * 60.0,  # req/s -> req/min (reference convention)
         ttft_seconds=first_value(QUERY_AVG_TTFT) or 0.0,

@@ -19,17 +19,23 @@ log = logging.getLogger(__name__)
 
 
 class InferencePoolReconciler:
-    def __init__(self, client: KubeClient, datastore: Datastore) -> None:
+    def __init__(self, client: KubeClient, datastore: Datastore,
+                 watch_namespace: str = "") -> None:
         self.client = client
         self.datastore = datastore
+        self.watch_namespace = watch_namespace
 
     def setup(self) -> None:
         self.client.watch(InferencePool.KIND, self._on_event)
-        # Seed from existing pools.
-        for pool in self.client.list(InferencePool.KIND):
+        # Seed from existing pools (scoped in namespace-scoped mode).
+        for pool in self.client.list(InferencePool.KIND,
+                                     namespace=self.watch_namespace or None):
             self.reconcile(pool)
 
     def _on_event(self, event: str, pool: InferencePool) -> None:
+        if self.watch_namespace \
+                and pool.metadata.namespace != self.watch_namespace:
+            return
         if event == DELETED:
             self.datastore.pool_delete(pool.metadata.name)
             self.datastore.namespace_untrack(

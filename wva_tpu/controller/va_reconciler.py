@@ -38,19 +38,35 @@ log = logging.getLogger(__name__)
 class VariantAutoscalingReconciler:
     def __init__(self, client: KubeClient, datastore: Datastore,
                  indexer: Indexer, clock: Clock | None = None,
-                 recorder=None) -> None:
+                 recorder=None, watch_namespace: str = "") -> None:
         self.client = client
         self.datastore = datastore
         self.indexer = indexer
         self.clock = clock or SYSTEM_CLOCK
         self.recorder = recorder  # k8s.events.EventRecorder | None
+        # Namespace-scoped mode: besides the client's scoped watch streams
+        # (RestKubeClient), events are filtered here too so the behavior is
+        # identical under any KubeClient (FakeCluster dispatches
+        # cluster-wide) and two scoped installs never fight over VAs.
+        self.watch_namespace = watch_namespace
 
     # --- wiring (reference SetupWithManager :291-319) ---
 
     # The controller's own metric-scrape contract: losing this ServiceMonitor
     # silently starves HPA/KEDA of wva_* gauges (reference
     # variantautoscaling_controller.go:330-367 — deletion alerting only).
-    SERVICEMONITOR_NAME = "wva-tpu-controller-manager-metrics"
+    # The chart names its ServiceMonitor "<release>-controller-metrics" and
+    # sets WVA_SERVICEMONITOR_NAME to match (templates/manager/
+    # deployment.yaml); the default covers kustomize installs.
+    @property
+    def servicemonitor_name(self) -> str:
+        import os
+
+        return os.environ.get("WVA_SERVICEMONITOR_NAME",
+                              "wva-tpu-controller-manager-metrics")
+
+    def _in_scope(self, namespace: str) -> bool:
+        return not self.watch_namespace or namespace == self.watch_namespace
 
     def setup(self) -> None:
         self.client.watch(VariantAutoscaling.kind, self._on_va_event)
@@ -59,7 +75,7 @@ class VariantAutoscalingReconciler:
         self.client.watch(ServiceMonitor.KIND, self._on_servicemonitor_event)
 
     def _on_servicemonitor_event(self, event: str, sm) -> None:
-        if event != DELETED or sm.metadata.name != self.SERVICEMONITOR_NAME:
+        if event != DELETED or sm.metadata.name != self.servicemonitor_name:
             return
         log.warning(
             "ServiceMonitor %s/%s deleted: wva_* metrics will stop being "
@@ -73,6 +89,8 @@ class VariantAutoscalingReconciler:
                 "signal")
 
     def _on_va_event(self, event: str, va: VariantAutoscaling) -> None:
+        if not self._in_scope(va.metadata.namespace):
+            return
         if event == DELETED:
             self.datastore.namespace_untrack(
                 VariantAutoscaling.kind, va.metadata.name, va.metadata.namespace)
@@ -87,6 +105,8 @@ class VariantAutoscalingReconciler:
         the owning VA via the index — keyed by the event object's own
         kind/apiVersion (reference handleDeploymentEvent :258-288)."""
         if not deployment_event_allowed(event):
+            return
+        if not self._in_scope(target.metadata.namespace):
             return
         try:
             va = self.indexer.find_va_for_scale_target(

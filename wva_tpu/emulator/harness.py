@@ -247,6 +247,12 @@ class EmulationHarness:
 
             if now - self._last_sfz >= self.sfz_interval:
                 self.manager.scale_from_zero.executor.tick()
+                # The fast path runs at the scale-from-zero cadence; a
+                # detected backlog forces an immediate engine tick instead
+                # of waiting out the poll interval.
+                if self.manager.fast_path_tick():
+                    self.manager.engine.executor.tick()
+                    self._last_engine = now
                 self._last_sfz = now
             if now - self._last_engine >= self.engine_interval:
                 self.manager.engine.executor.tick()

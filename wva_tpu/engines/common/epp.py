@@ -1,0 +1,54 @@
+"""Shared EPP scrape helpers for the detection engines.
+
+Both fast loops — scale-from-zero (reference ``engine.go:198-358``) and the
+scale-from-N fast path — need the same chain: resolve a VA's scale target,
+match its pod-template labels to an InferencePool, and scrape that pool's
+EPP pods for scheduler flow-control metrics. One implementation here so the
+label-matching and error semantics can never drift between them.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.collector.source.pod_scrape import ALL_METRICS_QUERY
+from wva_tpu.collector.source.source import RefreshSpec
+from wva_tpu.datastore import Datastore, PoolNotFoundError
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+
+log = logging.getLogger(__name__)
+
+
+def resolve_pool_name(client: KubeClient, datastore: Datastore,
+                      kind: str, namespace: str, name: str) -> str | None:
+    """Scale target -> owning InferencePool name (via pod-template labels);
+    None when the target or a matching pool is missing."""
+    try:
+        target = client.get(kind, namespace, name)
+    except NotFoundError:
+        log.debug("Scale target %s/%s missing", namespace, name)
+        return None
+    try:
+        pool = datastore.pool_get_from_labels(target.template.labels)
+    except PoolNotFoundError:
+        log.debug("No InferencePool matches labels of %s/%s", namespace, name)
+        return None
+    return pool.name
+
+
+def scrape_pool(datastore: Datastore, pool_name: str):
+    """Refresh the pool's EPP pod-scrape source and return the sample list,
+    or None when the source is missing / the scrape failed (per-tick
+    isolation — callers skip and retry next pass)."""
+    source = datastore.pool_get_metrics_source(pool_name)
+    if source is None:
+        return None
+    try:
+        results = source.refresh(RefreshSpec())
+    except Exception as e:  # noqa: BLE001 — scrape errors skip this tick
+        log.debug("EPP scrape failed for pool %s: %s", pool_name, e)
+        return None
+    result = results.get(ALL_METRICS_QUERY)
+    if result is None or result.has_error():
+        return None
+    return result.values

@@ -43,6 +43,22 @@ class PollingExecutor(Executor):
         # Leader gate: when set and False, ticks are skipped (the reference
         # achieves this by registering engines as leader-gated Runnables).
         self.gate = gate
+        # Out-of-band wake-up: trigger() ends the current inter-tick wait
+        # immediately (the scale-from-N fast path uses this to collapse the
+        # poll-interval share of decision latency to ~0). In simulation the
+        # harness consumes the flag instead of a thread waking.
+        self._trigger = threading.Event()
+
+    def trigger(self) -> None:
+        """Request an immediate tick (thread-safe, idempotent)."""
+        self._trigger.set()
+
+    def consume_trigger(self) -> bool:
+        """Return whether a trigger is pending and clear it (simulation
+        drivers call this to decide on an out-of-schedule tick)."""
+        was_set = self._trigger.is_set()
+        self._trigger.clear()
+        return was_set
 
     def tick(self, stop: threading.Event | None = None) -> None:
         """Execute the task once, retrying with backoff on failure."""
@@ -76,12 +92,24 @@ class PollingExecutor(Executor):
 
         simulated = isinstance(self.clock, FakeClock)
         while not stop.is_set():
+            self._trigger.clear()
             self.tick(stop)
             if simulated:
                 self.clock.sleep(self.interval)
             else:
-                # Interruptible wall-clock sleep.
-                stop.wait(self.interval)
+                self._wait_interval(stop)
+
+    def _wait_interval(self, stop: threading.Event) -> None:
+        """Wall-clock inter-tick wait, ended early by stop OR trigger().
+        Waits in short slices so both events stay responsive without a
+        selector over two Events."""
+        deadline = self.clock.now() + self.interval
+        while not stop.is_set():
+            remaining = deadline - self.clock.now()
+            if remaining <= 0:
+                return
+            if self._trigger.wait(timeout=min(remaining, 0.25)):
+                return
 
     def start_in_thread(self, stop: threading.Event) -> threading.Thread:
         thread = threading.Thread(target=self.start, args=(stop,),

@@ -1,0 +1,193 @@
+"""Scale-from-N fast path: the scale-from-zero detection loop generalized to
+ACTIVE models.
+
+The reference's separate-engine pattern
+(``internal/engines/scalefromzero/engine.go:104-110``) gives scaled-to-zero
+models a 100ms wake-up while active models wait out the 30s saturation poll.
+On TPU, where a new slice takes minutes to provision, that poll interval is
+pure added backlog: every second between "backlog appears" and "decision
+made" is another second of SLO misses stacked on top of the provisioning
+horizon. This monitor closes the gap:
+
+- every poll (100ms class) it scrapes the inference scheduler's flow-control
+  queue for each ACTIVE model (same EPP pod-scrape source scale-from-zero
+  uses); when a model's backlog reaches ``fastPathQueueThreshold`` it
+  requests an IMMEDIATE saturation-engine tick via
+  :meth:`~wva_tpu.engines.executor.PollingExecutor.trigger` (per-model
+  cooldown bounds how often backlog can force ticks);
+- every ``trend_feed_interval`` it feeds the model's demand estimate
+  (completion rate + backlog drain) into the SLO analyzer's trend estimator,
+  so the provisioning-horizon anticipation slope is available within the
+  FIRST engine tick of a ramp instead of after several.
+
+The decision itself stays in the saturation engine — one analyzer →
+optimizer → enforcer → limiter path, just invoked the moment evidence
+arrives instead of on the next poll boundary.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.collector.registration.slo import collect_optimizer_metrics
+from wva_tpu.collector.source.source import MetricsSource
+from wva_tpu.config import Config
+from wva_tpu.constants import (
+    LABEL_MODEL_NAME,
+    LABEL_TARGET_MODEL_NAME,
+    SCHEDULER_FLOW_CONTROL_QUEUE_SIZE,
+)
+from wva_tpu.datastore import Datastore
+from wva_tpu.engines.common.epp import resolve_pool_name, scrape_pool
+from wva_tpu.engines.executor import PollingExecutor
+from wva_tpu.interfaces.saturation_config import SLO_ANALYZER_NAME
+from wva_tpu.k8s.client import KubeClient
+from wva_tpu.utils import variant as variant_utils
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+DEFAULT_POLL_INTERVAL = 0.1  # scale-from-zero cadence (engine.go:108)
+DEFAULT_TREND_FEED_INTERVAL = 5.0  # Prometheus query budget: one per model
+# Target -> InferencePool resolution cache TTL: the mapping only changes on
+# redeploys, and re-resolving costs a Deployment GET per model per 100ms
+# pass against the apiserver otherwise.
+POOL_RESOLVE_TTL = 30.0
+
+
+def flow_control_backlog(values, model_id: str) -> float:
+    """Sum the scheduler flow-control queue size for one model across scraped
+    EPP samples (reference engine.go:254-264 reads the same series)."""
+    total = 0.0
+    for v in values:
+        if v.labels.get("__name__") != SCHEDULER_FLOW_CONTROL_QUEUE_SIZE:
+            continue
+        target = v.labels.get(LABEL_TARGET_MODEL_NAME, "")
+        model = v.labels.get(LABEL_MODEL_NAME, "")
+        if target == model_id or (not target and model == model_id):
+            total += max(v.value, 0.0)
+    return total
+
+
+class FastPathMonitor:
+    """Backlog watcher for active models; see module docstring."""
+
+    def __init__(self, client: KubeClient, config: Config,
+                 datastore: Datastore, engine_executor: PollingExecutor,
+                 prom_source: MetricsSource | None = None,
+                 slo_analyzer=None,
+                 clock: Clock | None = None,
+                 poll_interval: float = DEFAULT_POLL_INTERVAL,
+                 trend_feed_interval: float = DEFAULT_TREND_FEED_INTERVAL,
+                 ) -> None:
+        self.client = client
+        self.config = config
+        self.datastore = datastore
+        self.engine_executor = engine_executor
+        self.prom_source = prom_source
+        self.slo_analyzer = slo_analyzer
+        self.clock = clock or SYSTEM_CLOCK
+        self.trend_feed_interval = trend_feed_interval
+        self._last_trigger: dict[str, float] = {}  # "ns|model" -> time
+        self._last_trend_feed: dict[str, float] = {}
+        # (kind, ns, name) -> (pool_name|None, expires_at)
+        self._pool_cache: dict[tuple[str, str, str], tuple[str | None, float]] = {}
+        self.executor = PollingExecutor(self.check, poll_interval,
+                                        clock=self.clock, name="fast-path")
+
+    def start_loop(self, stop) -> None:
+        self.executor.start(stop)
+
+    # -- one monitoring pass --
+
+    def check(self) -> list[str]:
+        """One pass over active models; returns the model keys that
+        triggered an immediate engine tick (for tests/telemetry)."""
+        active = variant_utils.active_variant_autoscalings(
+            self.client, namespace=self.config.watch_namespace() or None)
+        if not active:
+            return []
+        triggered: list[str] = []
+        by_model = variant_utils.group_variant_autoscalings_by_model(active)
+        now = self.clock.now()
+        # Models sharing an InferencePool share one scrape per pass.
+        scrape_memo: dict[str, object] = {}
+        for vas in by_model.values():
+            va = vas[0]
+            namespace = va.metadata.namespace
+            model_id = va.spec.model_id
+            key = f"{namespace}|{model_id}"
+            cfg = self.config.saturation_config_for_namespace(
+                namespace).get("default")
+            if cfg is None or not cfg.fast_path_enabled:
+                continue
+            backlog = self._model_backlog(va, now, scrape_memo)
+            if backlog is None:
+                continue
+            self._maybe_feed_trend(key, namespace, model_id, cfg, backlog, now)
+            if backlog < max(cfg.fast_path_queue_threshold, 0.0) \
+                    or cfg.fast_path_queue_threshold <= 0:
+                continue
+            if now - self._last_trigger.get(key, -1e18) \
+                    < cfg.fast_path_cooldown_seconds:
+                continue
+            self._last_trigger[key] = now
+            triggered.append(key)
+            log.info("Fast path: %s backlog %.0f >= %.0f; requesting "
+                     "immediate engine tick", key, backlog,
+                     cfg.fast_path_queue_threshold)
+            self.engine_executor.trigger()
+        # Hygiene: drop state for models no longer active.
+        live = {f"{vas[0].metadata.namespace}|{vas[0].spec.model_id}"
+                for vas in by_model.values()}
+        for state in (self._last_trigger, self._last_trend_feed):
+            for stale in [k for k in state if k not in live]:
+                del state[stale]
+        return triggered
+
+    # -- internals --
+
+    def _model_backlog(self, va, now: float,
+                       scrape_memo: dict) -> float | None:
+        """Scheduler flow-control backlog for the VA's model via its pool's
+        EPP scrape source; None when the pool/scrape is unavailable.
+        The target->pool resolution is TTL-cached and the per-pool scrape is
+        memoized within one pass, so steady-state apiserver/EPP load does
+        not scale with model count at the 100ms cadence."""
+        ref = va.spec.scale_target_ref
+        cache_key = (ref.kind, va.metadata.namespace, ref.name)
+        cached = self._pool_cache.get(cache_key)
+        if cached is not None and now < cached[1]:
+            pool_name = cached[0]
+        else:
+            pool_name = resolve_pool_name(
+                self.client, self.datastore, ref.kind,
+                va.metadata.namespace, ref.name)
+            self._pool_cache[cache_key] = (pool_name, now + POOL_RESOLVE_TTL)
+        if pool_name is None:
+            return None
+        if pool_name not in scrape_memo:
+            scrape_memo[pool_name] = scrape_pool(self.datastore, pool_name)
+        values = scrape_memo[pool_name]
+        if values is None:
+            return None
+        return flow_control_backlog(values, va.spec.model_id)
+
+    def _maybe_feed_trend(self, key: str, namespace: str, model_id: str,
+                          cfg, backlog: float, now: float) -> None:
+        """Feed a demand sample into the SLO analyzer's trend estimator
+        (units are req/s — only the SLO analyzer's trend speaks them)."""
+        if (self.slo_analyzer is None or self.prom_source is None
+                or cfg.analyzer_name != SLO_ANALYZER_NAME
+                or cfg.anticipation_horizon_seconds <= 0):
+            return
+        if now - self._last_trend_feed.get(key, -1e18) \
+                < self.trend_feed_interval:
+            return
+        self._last_trend_feed[key] = now
+        metrics = collect_optimizer_metrics(
+            self.prom_source, model_id, namespace)
+        if metrics is None:
+            return
+        self.slo_analyzer.observe_demand(
+            namespace, model_id, now, metrics.arrival_rate, backlog)

@@ -109,11 +109,17 @@ class SaturationEngine:
         capacity_store: CapacityKnowledgeStore | None = None,
         clock: Clock | None = None,
         poll_interval: float = DEFAULT_ENGINE_POLL_INTERVAL,
+        direct_actuator=None,
     ) -> None:
         self.client = client
         self.config = config
         self.collector = collector
         self.actuator = actuator
+        # Optional DirectActuator for the fastActuation config: scale-UP
+        # decisions hit the scale subresource immediately instead of waiting
+        # for the external HPA loop (which still converges to the same
+        # wva_desired_replicas gauge).
+        self.direct_actuator = direct_actuator
         self.enforcer = enforcer
         self.limiter = limiter
         self.clock = clock or SYSTEM_CLOCK
@@ -487,7 +493,11 @@ class SaturationEngine:
             profiles=self.slo_analyzer.profiles,
             capacity_chips=capacity_chips)
         solution = solve(system, spec)
+        return self._allocations_to_decisions(req_by_server, solution)
 
+    def _allocations_to_decisions(self, req_by_server, solution):
+        """Fleet-solver allocations -> per-variant decisions, with
+        readiness-aware migration holds (make-before-break)."""
         decisions: list[VariantDecision] = []
         active_holds: set[str] = set()
         for name, req in req_by_server.items():
@@ -519,6 +529,20 @@ class SaturationEngine:
             if winner is not None:
                 winner_ready = winner.ready_replicas
                 migration_ready = winner_ready >= alloc.num_replicas
+            if alloc is not None and alloc.accelerator and winner is None:
+                # The solver chose an accelerator no live variant matches
+                # (variant deleted between collection and solve, or a
+                # solver/config accelerator-name mismatch). Consolidating
+                # would zero EVERY variant with nothing to migrate onto —
+                # exactly the capacity-destroying transition the hold
+                # machinery exists to prevent. Hold the fleet steady and
+                # surface the mismatch instead.
+                log.warning(
+                    "Global optimizer chose accelerator %r for model %s but "
+                    "no variant serves it (variants: %s); holding replicas "
+                    "steady", alloc.accelerator, name,
+                    [vs.accelerator_name for vs in req.variant_states])
+                alloc = None
             now = self.clock.now()
             for vs in req.variant_states:
                 hold_key = f"{name}|{vs.variant_name}"
@@ -858,6 +882,8 @@ class SaturationEngine:
             except Exception as e:  # noqa: BLE001 — emission never fails the loop
                 log.error("Failed to emit metrics for %s: %s", va_key, e)
 
+            self._maybe_fast_actuate(update_va, decision)
+
             # Persist the engine-owned status fields (OptimizationReady,
             # actuation.applied, desired alloc). Divergence from the
             # reference, whose engine-side condition writes are lost because
@@ -886,6 +912,40 @@ class SaturationEngine:
                                                           if metrics_available
                                                           else METRICS_MESSAGE_UNAVAILABLE)))
             common.fire_trigger(va.metadata.name, va.metadata.namespace)
+
+    def _maybe_fast_actuate(self, va: VariantAutoscaling,
+                            decision: VariantDecision | None) -> None:
+        """When the namespace opts into ``fastActuation``, apply scale-UP
+        decisions to the scale subresource immediately. On TPU the
+        provisioning horizon dwarfs everything else, so the HPA sync period
+        + stabilization window between "gauge moved" and "replicas moved" is
+        pure added backlog; HPA still reads the same gauge and converges to
+        the same value. Scale-down is never fast-tracked (stays HPA-paced
+        with its down-stabilization damping), and failures only log — the
+        metric path above remains the authoritative actuation channel."""
+        if self.direct_actuator is None or decision is None:
+            return
+        if decision.target_replicas <= max(decision.current_replicas, 0):
+            return
+        cfg = self.config.saturation_config_for_namespace(
+            va.metadata.namespace).get("default")
+        if cfg is None or not cfg.fast_actuation:
+            return
+        try:
+            changed = self.direct_actuator.scale_target_object(
+                va.spec.scale_target_ref.kind, va.metadata.namespace,
+                va.spec.scale_target_ref.name, decision.target_replicas,
+                only_up=True)
+        except NotFoundError:
+            return
+        except Exception as e:  # noqa: BLE001 — fast path is best-effort
+            log.warning("Fast actuation failed for %s/%s: %s",
+                        va.metadata.namespace, va.metadata.name, e)
+            return
+        if changed:
+            log.info("Fast actuation: %s/%s scaled up to %d ahead of HPA",
+                     va.metadata.namespace, va.metadata.name,
+                     decision.target_replicas)
 
     def _emit_safety_net_metrics(self, model_vas: list[VariantAutoscaling]) -> None:
         """On analysis failure, emit previous-desired or current replicas so

@@ -22,22 +22,20 @@ from wva_tpu.api.v1alpha1 import (
     TYPE_OPTIMIZATION_READY,
     VariantAutoscaling,
 )
-from wva_tpu.collector.source.pod_scrape import ALL_METRICS_QUERY
 from wva_tpu.config import Config
 from wva_tpu.constants import (
     LABEL_MODEL_NAME,
     LABEL_TARGET_MODEL_NAME,
     SCHEDULER_FLOW_CONTROL_QUEUE_SIZE,
 )
-from wva_tpu.datastore import Datastore, PoolNotFoundError
+from wva_tpu.datastore import Datastore
 from wva_tpu.engines import common
+from wva_tpu.engines.common.epp import resolve_pool_name, scrape_pool
 from wva_tpu.engines.executor import PollingExecutor
 from wva_tpu.interfaces import ACTION_SCALE_UP, VariantDecision
 from wva_tpu.k8s.client import KubeClient, NotFoundError
-from wva_tpu.k8s.objects import Deployment
 from wva_tpu.utils import variant as variant_utils
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
-from wva_tpu.collector.source.source import RefreshSpec
 
 log = logging.getLogger(__name__)
 
@@ -78,34 +76,18 @@ class ScaleFromZeroEngine:
 
     def _process_inactive_variant(self, va: VariantAutoscaling) -> None:
         """Check queued requests for the VA's model; scale 0->1 when present
-        (reference engine.go:198-358)."""
-        try:
-            deploy: Deployment = self.client.get(
-                va.spec.scale_target_ref.kind, va.metadata.namespace,
-                va.spec.scale_target_ref.name)
-        except NotFoundError:
-            log.debug("Scale target missing for %s", va.metadata.name)
+        (reference engine.go:198-358). The target->pool->scrape chain is the
+        shared engines.common.epp helper (the fast path walks the same one)."""
+        pool_name = resolve_pool_name(
+            self.client, self.datastore, va.spec.scale_target_ref.kind,
+            va.metadata.namespace, va.spec.scale_target_ref.name)
+        if pool_name is None:
+            return
+        values = scrape_pool(self.datastore, pool_name)
+        if values is None:
             return
 
-        try:
-            pool = self.datastore.pool_get_from_labels(deploy.template.labels)
-        except PoolNotFoundError:
-            log.debug("No InferencePool matches labels of %s", va.metadata.name)
-            return
-
-        source = self.datastore.pool_get_metrics_source(pool.name)
-        if source is None:
-            return
-        try:
-            results = source.refresh(RefreshSpec())
-        except Exception as e:  # noqa: BLE001 — scrape errors skip this tick
-            log.debug("EPP scrape failed for pool %s: %s", pool.name, e)
-            return
-        result = results.get(ALL_METRICS_QUERY)
-        if result is None or result.has_error():
-            return
-
-        if not self._has_pending_requests(result.values, va.spec.model_id):
+        if not self._has_pending_requests(values, va.spec.model_id):
             return
 
         try:

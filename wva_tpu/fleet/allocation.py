@@ -21,7 +21,7 @@ from wva_tpu.analyzers.queueing.params import TargetPerf
 from wva_tpu.analyzers.queueing.queue_model import (
     analyze_batch,
     candidate_batch,
-    size_batch,
+    size_batch_bucketed,
 )
 from wva_tpu.fleet.system import (
     ACCEL_PENALTY_FACTOR,
@@ -158,9 +158,11 @@ def build_candidates(
         t_tps.append(targets.target_tps)
 
     cand = candidate_batch(alphas, betas, gammas, avg_in, avg_out, max_b, ks)
-    sized = size_batch(cand, jnp.asarray(t_ttft, jnp.float32),
-                       jnp.asarray(t_itl, jnp.float32),
-                       jnp.asarray(t_tps, jnp.float32))
+    # Bucketed entry: trims the state axis to the fleet's largest k without
+    # a device sync (the ks ints are host-side already).
+    sized = size_batch_bucketed(cand, jnp.asarray(t_ttft, jnp.float32),
+                                jnp.asarray(t_itl, jnp.float32),
+                                jnp.asarray(t_tps, jnp.float32), k_host=ks)
     # One bulk device->host transfer per array (per-element float() would
     # issue a blocking sync each).
     rate_star = np.asarray(sized["throughput_per_s"]).tolist()

@@ -58,6 +58,23 @@ class SaturationScalingConfig:
     # anticipates — only growth is extrapolated.
     anticipation_horizon_seconds: float = 0.0
 
+    # Scale-from-N fast path: the 100ms backlog monitor (the scale-from-zero
+    # detection loop generalized to ACTIVE models) requests an immediate
+    # engine tick when a model's scheduler flow-control backlog reaches
+    # fastPathQueueThreshold, instead of waiting out the poll interval.
+    # Cooldown bounds how often backlog can force ticks per model.
+    fast_path_enabled: bool = True
+    fast_path_queue_threshold: float = 1.0
+    fast_path_cooldown_seconds: float = 15.0
+
+    # Apply scale-UP decisions to the scale subresource immediately instead
+    # of waiting for the external HPA to act on wva_desired_replicas (HPA
+    # still converges to the same gauge; scale-DOWN always stays HPA-paced).
+    # With TPU slices taking minutes to provision, the HPA sync interval +
+    # stabilization window is pure added backlog. Default off: the
+    # reference's contract is metric-only steady-state actuation.
+    fast_actuation: bool = False
+
     def get_analyzer_name(self) -> str:
         return self.analyzer_name
 
@@ -88,6 +105,14 @@ class SaturationScalingConfig:
             raise ValueError(
                 f"queueSpareTrigger must be >= 0, got {self.queue_spare_trigger:.1f}"
             )
+        if self.fast_path_queue_threshold < 0:
+            raise ValueError(
+                "fastPathQueueThreshold must be >= 0, got "
+                f"{self.fast_path_queue_threshold}")
+        if self.fast_path_cooldown_seconds < 0:
+            raise ValueError(
+                "fastPathCooldownSeconds must be >= 0, got "
+                f"{self.fast_path_cooldown_seconds}")
         if self.kv_cache_threshold < self.kv_spare_trigger:
             raise ValueError(
                 f"kvCacheThreshold ({self.kv_cache_threshold:.2f}) should be >= "
@@ -131,6 +156,10 @@ class SaturationScalingConfig:
         "scaleDownBoundary": "scale_down_boundary",
         "anticipationHorizonSeconds": "anticipation_horizon_seconds",
         "optimizerName": "optimizer_name",
+        "fastPathEnabled": "fast_path_enabled",
+        "fastPathQueueThreshold": "fast_path_queue_threshold",
+        "fastPathCooldownSeconds": "fast_path_cooldown_seconds",
+        "fastActuation": "fast_actuation",
     }
 
     @classmethod

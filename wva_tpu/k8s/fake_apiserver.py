@@ -77,10 +77,17 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
-    def _send_status_error(self, code: int, reason: str, message: str) -> None:
-        self._send_json(code, {
+    def _send_status_error(self, code: int, reason: str, message: str,
+                           details: dict | None = None) -> None:
+        body = {
             "kind": "Status", "apiVersion": "v1", "status": "Failure",
-            "reason": reason, "message": message, "code": code})
+            "reason": reason, "message": message, "code": code}
+        if details:
+            # Real apiservers name the missing OBJECT in Status.details;
+            # clients key the "object vs subresource missing" distinction on
+            # it (RestKubeClient._is_object_not_found).
+            body["details"] = details
+        self._send_json(code, body)
 
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
@@ -148,7 +155,7 @@ class _Handler(BaseHTTPRequestHandler):
             elif name:
                 self._send_json(200, serde.to_k8s(self.cluster.get(kind, ns, name)))
             elif query.get("watch") == "true":
-                self._serve_watch(kind, query)
+                self._serve_watch(kind, query, ns)
             else:
                 objs = self.cluster.list(kind, namespace=ns or None,
                                          label_selector=self._label_selector(query))
@@ -158,7 +165,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "metadata": {"resourceVersion": str(self.cluster._rv)},
                     "items": [serde.to_k8s(o) for o in objs]})
         except NotFoundError as e:
-            self._send_status_error(404, "NotFound", str(e))
+            self._send_status_error(404, "NotFound", str(e),
+                                    details={"name": name, "kind": kind})
 
     def do_POST(self) -> None:  # noqa: N802
         if not self._authorized():
@@ -193,7 +201,8 @@ class _Handler(BaseHTTPRequestHandler):
                 updated = self.cluster.update(obj)
             self._send_json(200, serde.to_k8s(updated))
         except NotFoundError as e:
-            self._send_status_error(404, "NotFound", str(e))
+            self._send_status_error(404, "NotFound", str(e),
+                                    details={"name": name, "kind": kind})
         except ConflictError as e:
             self._send_status_error(409, "Conflict", str(e))
 
@@ -219,7 +228,8 @@ class _Handler(BaseHTTPRequestHandler):
                     405, "MethodNotAllowed",
                     "only the scale subresource supports PATCH here")
         except NotFoundError as e:
-            self._send_status_error(404, "NotFound", str(e))
+            self._send_status_error(404, "NotFound", str(e),
+                                    details={"name": name, "kind": kind})
 
     def do_DELETE(self) -> None:  # noqa: N802
         if not self._authorized():
@@ -233,11 +243,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"kind": "Status", "apiVersion": "v1",
                                   "status": "Success"})
         except NotFoundError as e:
-            self._send_status_error(404, "NotFound", str(e))
+            self._send_status_error(404, "NotFound", str(e),
+                                    details={"name": name, "kind": kind})
 
     # --- watch streaming ---
 
-    def _serve_watch(self, kind: str, query: dict[str, str]) -> None:
+    def _serve_watch(self, kind: str, query: dict[str, str],
+                     namespace: str = "") -> None:
         """Stream watch events. Registers the handler FIRST, then replays
         every stored object whose resourceVersion is newer than the client's
         ``resourceVersion`` param as a synthetic ADDED — so mutations landing
@@ -245,10 +257,14 @@ class _Handler(BaseHTTPRequestHandler):
         lost (deletes in that gap are still missed, like a real apiserver
         past its watch cache; delivery is at-least-once, which level-
         triggered reconcilers tolerate). Honors ``timeoutSeconds`` so each
-        stream — and its thread + watcher registration — is bounded."""
+        stream — and its thread + watcher registration — is bounded. A
+        ``/namespaces/<ns>/...`` watch path only streams that namespace's
+        events, like a real apiserver."""
         events: queue.Queue = queue.Queue(maxsize=1024)
 
         def on_event(event: str, obj) -> None:
+            if namespace and (obj.metadata.namespace or "") != namespace:
+                return
             try:
                 events.put_nowait((event, obj))
             except queue.Full:
@@ -276,9 +292,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(chunk)
             self.wfile.flush()
 
+        clean_end = False
         try:
             if since_rv:
-                for obj in self.cluster.list(kind):
+                for obj in self.cluster.list(kind, namespace=namespace or None):
                     try:
                         obj_rv = int(obj.metadata.resource_version)
                     except ValueError:
@@ -293,10 +310,21 @@ class _Handler(BaseHTTPRequestHandler):
                         break
                     continue
                 send(event, obj)
+            clean_end = True
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass  # client went away
         finally:
             self.cluster.unwatch(kind, on_event)
+            if clean_end:
+                # Terminate the chunked stream so clients observe a clean
+                # end-of-stream (and their reconnect backoff resets) instead
+                # of a socket timeout.
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass
+            self.close_connection = True
 
     def log_message(self, fmt: str, *args) -> None:
         log.debug("fake-apiserver: " + fmt, *args)

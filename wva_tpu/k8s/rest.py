@@ -56,18 +56,38 @@ WATCH_BACKOFF_MAX = 30.0
 class ApiError(RuntimeError):
     def __init__(self, status: int, message: str) -> None:
         self.status = status
+        self.detail = message
         super().__init__(f"API server returned {status}: {message}")
+
+    def status_object(self) -> dict:
+        """The parsed Kubernetes Status body, {} when not JSON."""
+        try:
+            d = json.loads(self.detail)
+            return d if isinstance(d, dict) else {}
+        except (TypeError, ValueError):
+            return {}
 
 
 class RestKubeClient(KubeClient):
     def __init__(self, credentials: Credentials,
-                 timeout: float = DEFAULT_TIMEOUT) -> None:
+                 timeout: float = DEFAULT_TIMEOUT,
+                 watch_namespace: str = "") -> None:
         self.credentials = credentials
         self.timeout = timeout
+        # When set, list+watch streams for namespaced kinds hit
+        # /namespaces/<ns>/... paths, so a namespace-scoped install needs
+        # only Role-level RBAC and never sees (or reconciles) other
+        # namespaces' objects — matching the reference manager's cache
+        # scoping (cmd/main.go cache options for WATCH_NAMESPACE).
+        self.watch_namespace = watch_namespace
         self._ssl = credentials.ssl_context()
         self._mu = threading.Lock()
         self._watchers: dict[str, list[WatchHandler]] = {}
         self._watch_threads: dict[str, threading.Thread] = {}
+        # Per-kind objects already surfaced through list/watch, keyed by
+        # (namespace, name) — the diff base for synthetic events after a
+        # forced re-list (410 Gone / unexpected stream error).
+        self._known: dict[str, dict[tuple[str, str], Any]] = {}
         self._stop = threading.Event()
 
     # --- HTTP plumbing ---
@@ -172,12 +192,23 @@ class RestKubeClient(KubeClient):
             d = self._request("PUT", self._obj_path(kind, ns, name, "status"),
                               body=serde.to_k8s(obj))
         except ApiError as e:
-            if e.status == 404 and "the server could not find" in str(e):
-                # Kinds without a registered status subresource: fall back to
-                # a full update (FakeCluster allows status writes generically).
+            if e.status == 404 and not self._is_object_not_found(e, name):
+                # 404 without the object's name in the Status details means
+                # the KIND has no registered status subresource (the object
+                # itself exists): fall back to a full update (FakeCluster
+                # allows status writes generically). Keyed on the structured
+                # Status body, not the human-readable message, which varies
+                # across API-server versions/locales.
                 return self.update(obj)
             raise self._map_error(e, kind, ns, name) from None
         return serde.from_k8s(kind, d)
+
+    @staticmethod
+    def _is_object_not_found(e: ApiError, name: str) -> bool:
+        """True when a 404's Status body names the missing OBJECT (vs a
+        missing subresource/route, whose Status carries no object name)."""
+        details = e.status_object().get("details") or {}
+        return details.get("name") == name
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         try:
@@ -200,17 +231,38 @@ class RestKubeClient(KubeClient):
 
     # --- watch ---
 
+    def _watch_scopes(self, kind: str) -> list[str]:
+        """Namespaces whose streams a kind needs. Unscoped: one cluster-wide
+        stream. Scoped: the watch namespace — plus the controller (system)
+        namespace for ConfigMap/ServiceMonitor, whose global config and
+        scrape-contract objects live there (the reference's scoped cache
+        includes the controller namespace for the same reason)."""
+        if not self.watch_namespace:
+            return [""]
+        scopes = [self.watch_namespace]
+        if kind in ("ConfigMap", "ServiceMonitor"):
+            from wva_tpu.config.helpers import system_namespace
+
+            sysns = system_namespace()
+            if sysns and sysns not in scopes:
+                scopes.append(sysns)
+        return scopes
+
     def watch(self, kind: str, handler: WatchHandler) -> None:
-        """Register a handler and ensure a list+watch stream runs for kind.
-        Handler semantics match FakeCluster: invoked on every ADDED/MODIFIED/
-        DELETED after registration; exceptions are isolated."""
+        """Register a handler and ensure list+watch stream(s) run for kind
+        (one per watch scope — see _watch_scopes). Handler semantics match
+        FakeCluster: invoked on every ADDED/MODIFIED/DELETED after
+        registration; exceptions are isolated."""
         with self._mu:
             self._watchers.setdefault(kind, []).append(handler)
-            if kind not in self._watch_threads:
-                t = threading.Thread(target=self._watch_loop, args=(kind,),
-                                     name=f"watch-{kind}", daemon=True)
-                self._watch_threads[kind] = t
-                t.start()
+            for ns in self._watch_scopes(kind):
+                key = f"{kind}/{ns}"
+                if key not in self._watch_threads:
+                    t = threading.Thread(target=self._watch_loop,
+                                         args=(kind, ns),
+                                         name=f"watch-{key}", daemon=True)
+                    self._watch_threads[key] = t
+                    t.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -225,18 +277,21 @@ class RestKubeClient(KubeClient):
             except Exception:  # noqa: BLE001 — handler isolation
                 log.exception("watch handler failed for %s %s", event, kind)
 
-    def _watch_loop(self, kind: str) -> None:
+    @staticmethod
+    def _obj_key(obj: Any) -> tuple[str, str]:
+        return (obj.metadata.namespace or "", obj.metadata.name)
+
+    def _watch_loop(self, kind: str, namespace: str = "") -> None:
         backoff = WATCH_BACKOFF_INITIAL
         rv = ""
+        first_list = True
         while not self._stop.is_set():
             try:
                 if not rv:
-                    # (Re)list to obtain a consistent resourceVersion; no
-                    # synthetic events (FakeCluster watch semantics: only
-                    # subsequent changes dispatch).
-                    d = self._request("GET", self._obj_path(kind, ""))
-                    rv = (d.get("metadata") or {}).get("resourceVersion", "")
-                rv = self._stream_watch(kind, rv)
+                    rv = self._list_for_watch(kind, namespace,
+                                              synthesize=not first_list)
+                    first_list = False
+                rv = self._stream_watch(kind, namespace, rv)
                 backoff = WATCH_BACKOFF_INITIAL
             except ApiError as e:
                 if e.status == 410:  # Gone: resourceVersion too old
@@ -263,10 +318,37 @@ class RestKubeClient(KubeClient):
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, WATCH_BACKOFF_MAX)
 
-    def _stream_watch(self, kind: str, rv: str) -> str:
+    def _list_for_watch(self, kind: str, namespace: str,
+                        synthesize: bool) -> str:
+        """(Re)list to obtain a consistent resourceVersion.
+
+        The INITIAL list dispatches nothing (FakeCluster watch semantics:
+        only subsequent changes dispatch). A FORCED re-list (410 Gone /
+        unexpected error) covers an event gap, so level-triggered handlers
+        get synthetic events to converge: ADDED for every listed object and
+        DELETED for known objects that vanished during the gap — without
+        this, an object whose terminal mutation fell in the gap would stay
+        stale forever."""
+        d = self._request("GET", self._obj_path(kind, namespace))
+        rv = (d.get("metadata") or {}).get("resourceVersion", "")
+        objs = [serde.from_k8s(kind, item) for item in d.get("items") or []]
+        current = {self._obj_key(o): o for o in objs}
+        scope_key = f"{kind}/{namespace}"
+        with self._mu:
+            previous = self._known.get(scope_key, {})
+            self._known[scope_key] = current
+        if synthesize:
+            for obj in current.values():
+                self._dispatch(kind, ADDED, obj)
+            for key, obj in previous.items():
+                if key not in current:
+                    self._dispatch(kind, DELETED, obj)
+        return rv
+
+    def _stream_watch(self, kind: str, namespace: str, rv: str) -> str:
         """One watch stream; returns the last seen resourceVersion."""
         resp = self._request(
-            "GET", self._obj_path(kind, ""),
+            "GET", self._obj_path(kind, namespace),
             query={"watch": "true", "resourceVersion": rv,
                    "allowWatchBookmarks": "true",
                    "timeoutSeconds": str(WATCH_SERVER_TIMEOUT)},
@@ -289,5 +371,13 @@ class RestKubeClient(KubeClient):
                     code = (item.get("code") or 0)
                     raise ApiError(int(code) or 500, item.get("message", ""))
                 if etype in (ADDED, MODIFIED, DELETED):
-                    self._dispatch(kind, etype, serde.from_k8s(kind, item))
+                    obj = serde.from_k8s(kind, item)
+                    with self._mu:
+                        known = self._known.setdefault(f"{kind}/{namespace}",
+                                                       {})
+                        if etype == DELETED:
+                            known.pop(self._obj_key(obj), None)
+                        else:
+                            known[self._obj_key(obj)] = obj
+                    self._dispatch(kind, etype, obj)
         return rv

@@ -43,6 +43,7 @@ from wva_tpu.controller import (
 )
 from wva_tpu.datastore import Datastore
 from wva_tpu.discovery import TPUSliceDiscovery
+from wva_tpu.engines.fastpath import FastPathMonitor
 from wva_tpu.engines.saturation import SaturationEngine
 from wva_tpu.engines.scalefromzero import ScaleFromZeroEngine
 from wva_tpu.indexers import Indexer
@@ -75,6 +76,7 @@ class Manager:
     indexer: Indexer
     engine: SaturationEngine
     scale_from_zero: ScaleFromZeroEngine
+    fastpath: FastPathMonitor
     va_reconciler: VariantAutoscalingReconciler
     configmap_reconciler: ConfigMapReconciler
     pool_reconciler: InferencePoolReconciler
@@ -112,6 +114,8 @@ class Manager:
                              name="saturation-engine", daemon=True),
             threading.Thread(target=self.scale_from_zero.start_loop, args=(stop,),
                              name="scale-from-zero", daemon=True),
+            threading.Thread(target=self.fastpath.start_loop, args=(stop,),
+                             name="fast-path", daemon=True),
             threading.Thread(target=self.va_reconciler.run_trigger_loop, args=(stop,),
                              name="va-trigger-loop", daemon=True),
         ]
@@ -155,12 +159,23 @@ class Manager:
         if self.is_leader():
             self.engine.executor.tick()
             self.scale_from_zero.executor.tick()
+            self.fastpath.executor.tick()
+            self.engine.executor.consume_trigger()  # tick above covered it
         self.va_reconciler.drain_triggers()
 
     def scale_from_zero_tick(self) -> None:
         if self.is_leader():
             self.scale_from_zero.executor.tick()
         self.va_reconciler.drain_triggers()
+
+    def fast_path_tick(self) -> bool:
+        """One fast-path monitoring pass; returns True when an immediate
+        saturation tick was requested (simulation drivers run the engine
+        tick themselves — see EmulationHarness.run)."""
+        if not self.is_leader():
+            return False
+        self.fastpath.executor.tick()
+        return self.engine.executor.consume_trigger()
 
     def shutdown(self) -> None:
         """Voluntary leader step-down on exit (ReleaseOnCancel semantics)."""
@@ -234,16 +249,24 @@ def build_manager(
     engine = SaturationEngine(
         client=client, config=config, collector=collector, actuator=actuator,
         enforcer=enforcer, limiter=limiter, capacity_store=capacity_store,
-        clock=clock, poll_interval=min(config.optimization_interval() / 2, 30.0))
+        clock=clock, poll_interval=min(config.optimization_interval() / 2, 30.0),
+        direct_actuator=direct_actuator)
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,
                                           direct_actuator, clock=clock)
+    fastpath = FastPathMonitor(
+        client, config, datastore, engine.executor,
+        prom_source=prom_source, slo_analyzer=engine.slo_analyzer,
+        clock=clock)
 
     recorder = EventRecorder(client, clock=clock)
+    watch_ns = config.watch_namespace() or ""
     va_reconciler = VariantAutoscalingReconciler(client, datastore, indexer,
-                                                 clock=clock, recorder=recorder)
+                                                 clock=clock, recorder=recorder,
+                                                 watch_namespace=watch_ns)
     configmap_reconciler = ConfigMapReconciler(client, config, datastore,
                                                recorder=recorder)
-    pool_reconciler = InferencePoolReconciler(client, datastore)
+    pool_reconciler = InferencePoolReconciler(client, datastore,
+                                              watch_namespace=watch_ns)
 
     elector = None
     if config.leader_election_enabled():
@@ -254,11 +277,12 @@ def build_manager(
         # Engines only act while leading (reference cmd/main.go:378-425).
         engine.executor.gate = elector.is_leader
         scale_from_zero.executor.gate = elector.is_leader
+        fastpath.executor.gate = elector.is_leader
 
     return Manager(
         client=client, config=config, clock=clock, registry=registry,
         source_registry=source_registry, datastore=datastore, indexer=indexer,
-        engine=engine, scale_from_zero=scale_from_zero,
+        engine=engine, scale_from_zero=scale_from_zero, fastpath=fastpath,
         va_reconciler=va_reconciler, configmap_reconciler=configmap_reconciler,
         pool_reconciler=pool_reconciler, capacity_store=capacity_store,
         elector=elector,
