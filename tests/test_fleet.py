@@ -256,3 +256,46 @@ class TestAnalyzeModel:
 
     def test_unknown_server_empty(self):
         assert analyze_model(make_system(), "nope") == []
+
+
+class TestMinReplicaFloors:
+    def test_floor_protects_low_priority_minimum(self):
+        """A high-priority server sized to the whole pool must not starve a
+        lower class below min_replicas: the floor reserves one replica's
+        chips, the premium allocation is trimmed to the remainder, and the
+        pool is never oversubscribed (the engine holds unallocated servers
+        at current count, so a zero-allocation would deadlock the pool)."""
+        system = make_system(capacity={"v5e": 40, "v5p": 0})
+        # llama's SLO sizing wants ~5+ v5e replicas (the whole pool).
+        system.servers["inf/llama"].load.arrival_rate_per_min = 6000.0
+        system.servers["inf/llama"].min_replicas = 1
+        system.servers["inf/gemma"].min_replicas = 1
+        system.servers["inf/gemma"].load.arrival_rate_per_min = 600.0
+        sol = solve(system)
+        llama = sol.allocations["inf/llama"]
+        gemma = sol.allocations["inf/gemma"]
+        assert gemma.num_replicas >= 1, "floor must guarantee the minimum"
+        assert llama.chips + gemma.chips <= 40, "pool oversubscribed"
+        assert llama.num_replicas == 4  # 40 chips minus gemma's floor
+
+    def test_floor_released_when_server_allocates(self):
+        """Floors are reservations, not grants: once a server receives an
+        allocation its floor returns to the pool."""
+        system = make_system(capacity={"v5e": 80, "v5p": 0})
+        system.servers["inf/llama"].min_replicas = 1
+        system.servers["inf/gemma"].min_replicas = 1
+        sol = solve(system)
+        # Ample capacity: both get their full sizing, floors never bind.
+        assert sol.allocations["inf/llama"].num_replicas >= 1
+        assert sol.allocations["inf/gemma"].num_replicas >= 1
+        assert not sol.unallocated
+
+    def test_floors_capped_by_capacity_in_priority_order(self):
+        """When the pool cannot even cover every floor, reservation follows
+        priority order — the premium class keeps its minimum."""
+        system = make_system(capacity={"v5e": 8, "v5p": 0})
+        system.servers["inf/llama"].min_replicas = 1
+        system.servers["inf/gemma"].min_replicas = 1
+        sol = solve(system)
+        assert sol.allocations["inf/llama"].num_replicas >= 1
+        assert "inf/gemma" in sol.unallocated

@@ -69,11 +69,18 @@ class DemandTrend:
 
     def _slope(self, series: deque[tuple[float, float]]) -> float:
         n = len(series)
-        if n < self.min_samples:
+        if n < 2:
             return 0.0
         t0 = series[0][0]
         span = series[-1][0] - t0
-        if span < self.min_span_seconds:
+        # Two regimes: a densely fed series qualifies at (min_samples,
+        # min_span); a sparse one (e.g. one sample per 30s engine tick when
+        # the fast-path feed is off) falls back to the conservative
+        # 2-point / MIN_SPAN_SECONDS rule rather than waiting min_samples
+        # ticks — anticipation latency must not regress for sparse feeders.
+        dense_ok = n >= self.min_samples and span >= self.min_span_seconds
+        sparse_ok = span >= max(self.min_span_seconds, MIN_SPAN_SECONDS)
+        if not (dense_ok or sparse_ok):
             return 0.0
         # Least-squares slope of demand over time.
         sum_t = sum_d = sum_tt = sum_td = 0.0

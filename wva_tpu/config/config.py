@@ -203,6 +203,18 @@ class Config:
                     return copy.deepcopy(ns_cfg)
             return copy.deepcopy(self._saturation_global)
 
+    def fast_path_enabled_anywhere(self) -> bool:
+        """Whether ANY scope's default saturation config enables the
+        scale-from-N fast path — the monitor's cheap whole-pass gate (no
+        deepcopy; checked before any apiserver traffic)."""
+        with self._mu:
+            scopes = [self._saturation_global, *self._saturation_ns.values()]
+            for per_model in scopes:
+                d = per_model.get("default")
+                if d is not None and d.fast_path_enabled:
+                    return True
+        return False
+
     def update_saturation_config(self, cfg: SaturationConfigPerModel) -> None:
         self.update_saturation_config_for_namespace("", cfg)
 

@@ -13,10 +13,30 @@ import logging
 
 from wva_tpu.collector.source.pod_scrape import ALL_METRICS_QUERY
 from wva_tpu.collector.source.source import RefreshSpec
+from wva_tpu.constants import (
+    LABEL_MODEL_NAME,
+    LABEL_TARGET_MODEL_NAME,
+    SCHEDULER_FLOW_CONTROL_QUEUE_SIZE,
+)
 from wva_tpu.datastore import Datastore, PoolNotFoundError
 from wva_tpu.k8s.client import KubeClient, NotFoundError
 
 log = logging.getLogger(__name__)
+
+
+def flow_control_backlog(values, model_id: str) -> float:
+    """Sum the scheduler flow-control queue size for one model across scraped
+    EPP samples (reference engine.go:254-264 reads the same series). Both
+    detection loops key their triggers on this ONE implementation."""
+    total = 0.0
+    for v in values:
+        if v.labels.get("__name__") != SCHEDULER_FLOW_CONTROL_QUEUE_SIZE:
+            continue
+        target = v.labels.get(LABEL_TARGET_MODEL_NAME, "")
+        model = v.labels.get(LABEL_MODEL_NAME, "")
+        if target == model_id or (not target and model == model_id):
+            total += max(v.value, 0.0)
+    return total
 
 
 def resolve_pool_name(client: KubeClient, datastore: Datastore,

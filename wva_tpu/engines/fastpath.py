@@ -32,13 +32,12 @@ import logging
 from wva_tpu.collector.registration.slo import collect_optimizer_metrics
 from wva_tpu.collector.source.source import MetricsSource
 from wva_tpu.config import Config
-from wva_tpu.constants import (
-    LABEL_MODEL_NAME,
-    LABEL_TARGET_MODEL_NAME,
-    SCHEDULER_FLOW_CONTROL_QUEUE_SIZE,
-)
 from wva_tpu.datastore import Datastore
-from wva_tpu.engines.common.epp import resolve_pool_name, scrape_pool
+from wva_tpu.engines.common.epp import (
+    flow_control_backlog,
+    resolve_pool_name,
+    scrape_pool,
+)
 from wva_tpu.engines.executor import PollingExecutor
 from wva_tpu.interfaces.saturation_config import SLO_ANALYZER_NAME
 from wva_tpu.k8s.client import KubeClient
@@ -53,20 +52,11 @@ DEFAULT_TREND_FEED_INTERVAL = 5.0  # Prometheus query budget: one per model
 # redeploys, and re-resolving costs a Deployment GET per model per 100ms
 # pass against the apiserver otherwise.
 POOL_RESOLVE_TTL = 30.0
-
-
-def flow_control_backlog(values, model_id: str) -> float:
-    """Sum the scheduler flow-control queue size for one model across scraped
-    EPP samples (reference engine.go:254-264 reads the same series)."""
-    total = 0.0
-    for v in values:
-        if v.labels.get("__name__") != SCHEDULER_FLOW_CONTROL_QUEUE_SIZE:
-            continue
-        target = v.labels.get(LABEL_TARGET_MODEL_NAME, "")
-        model = v.labels.get(LABEL_MODEL_NAME, "")
-        if target == model_id or (not target and model == model_id):
-            total += max(v.value, 0.0)
-    return total
+# Active-VA listing cadence: the VA set changes on human timescales, so the
+# 100ms passes reuse a short-lived listing instead of hitting the apiserver
+# 10x/s (RestKubeClient has no informer cache). EPP scrapes — the actual
+# fast signal, served by pod-local HTTP — still run every pass.
+VA_LIST_INTERVAL = 1.0
 
 
 class FastPathMonitor:
@@ -92,6 +82,7 @@ class FastPathMonitor:
         self._last_trend_feed: dict[str, float] = {}
         # (kind, ns, name) -> (pool_name|None, expires_at)
         self._pool_cache: dict[tuple[str, str, str], tuple[str | None, float]] = {}
+        self._va_cache: tuple[list, float] = ([], -1e18)  # (vas, expires_at)
         self.executor = PollingExecutor(self.check, poll_interval,
                                         clock=self.clock, name="fast-path")
 
@@ -103,22 +94,33 @@ class FastPathMonitor:
     def check(self) -> list[str]:
         """One pass over active models; returns the model keys that
         triggered an immediate engine tick (for tests/telemetry)."""
-        active = variant_utils.active_variant_autoscalings(
-            self.client, namespace=self.config.watch_namespace() or None)
+        # Whole-pass gate BEFORE any apiserver traffic: with the fast path
+        # disabled everywhere, the 100ms loop must cost nothing.
+        if not self.config.fast_path_enabled_anywhere():
+            return []
+        now = self.clock.now()
+        active, expires = self._va_cache
+        if now >= expires:
+            active = variant_utils.active_variant_autoscalings(
+                self.client, namespace=self.config.watch_namespace() or None)
+            self._va_cache = (active, now + VA_LIST_INTERVAL)
         if not active:
             return []
         triggered: list[str] = []
         by_model = variant_utils.group_variant_autoscalings_by_model(active)
-        now = self.clock.now()
-        # Models sharing an InferencePool share one scrape per pass.
+        # Per-pass memos: one config resolve per namespace, one EPP scrape
+        # per InferencePool (models sharing a pool share the scrape).
         scrape_memo: dict[str, object] = {}
+        cfg_memo: dict[str, object] = {}
         for vas in by_model.values():
             va = vas[0]
             namespace = va.metadata.namespace
             model_id = va.spec.model_id
             key = f"{namespace}|{model_id}"
-            cfg = self.config.saturation_config_for_namespace(
-                namespace).get("default")
+            if namespace not in cfg_memo:
+                cfg_memo[namespace] = self.config.saturation_config_for_namespace(
+                    namespace).get("default")
+            cfg = cfg_memo[namespace]
             if cfg is None or not cfg.fast_path_enabled:
                 continue
             backlog = self._model_backlog(va, now, scrape_memo)
@@ -137,12 +139,17 @@ class FastPathMonitor:
                      "immediate engine tick", key, backlog,
                      cfg.fast_path_queue_threshold)
             self.engine_executor.trigger()
-        # Hygiene: drop state for models no longer active.
+        # Hygiene: drop state for models no longer active, and expired
+        # target->pool entries (VA/deployment churn must not grow the cache
+        # over the process lifetime).
         live = {f"{vas[0].metadata.namespace}|{vas[0].spec.model_id}"
                 for vas in by_model.values()}
         for state in (self._last_trigger, self._last_trend_feed):
             for stale in [k for k in state if k not in live]:
                 del state[stale]
+        for stale_key in [k for k, (_, exp) in self._pool_cache.items()
+                          if now >= exp]:
+            del self._pool_cache[stale_key]
         return triggered
 
     # -- internals --

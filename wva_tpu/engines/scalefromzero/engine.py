@@ -23,14 +23,13 @@ from wva_tpu.api.v1alpha1 import (
     VariantAutoscaling,
 )
 from wva_tpu.config import Config
-from wva_tpu.constants import (
-    LABEL_MODEL_NAME,
-    LABEL_TARGET_MODEL_NAME,
-    SCHEDULER_FLOW_CONTROL_QUEUE_SIZE,
-)
 from wva_tpu.datastore import Datastore
 from wva_tpu.engines import common
-from wva_tpu.engines.common.epp import resolve_pool_name, scrape_pool
+from wva_tpu.engines.common.epp import (
+    flow_control_backlog,
+    resolve_pool_name,
+    scrape_pool,
+)
 from wva_tpu.engines.executor import PollingExecutor
 from wva_tpu.interfaces import ACTION_SCALE_UP, VariantDecision
 from wva_tpu.k8s.client import KubeClient, NotFoundError
@@ -136,14 +135,6 @@ class ScaleFromZeroEngine:
 
     @staticmethod
     def _has_pending_requests(values, model_id: str) -> bool:
-        """Scan scraped EPP samples for flow-control queue size > 0 for this
-        model (reference engine.go:254-264)."""
-        for v in values:
-            if v.labels.get("__name__") != SCHEDULER_FLOW_CONTROL_QUEUE_SIZE:
-                continue
-            target = v.labels.get(LABEL_TARGET_MODEL_NAME, "")
-            model = v.labels.get(LABEL_MODEL_NAME, "")
-            if (target == model_id or (not target and model == model_id)) \
-                    and v.value > 0:
-                return True
-        return False
+        """Flow-control queue non-empty for this model (reference
+        engine.go:254-264) — shared matcher with the fast path."""
+        return flow_control_backlog(values, model_id) > 0

@@ -127,16 +127,85 @@ def _order_key(e: _Entry):
     return (e.priority, -e.delta, -e.current().value, e.server.name)
 
 
+class _Capacity:
+    """Per-accelerator-type chip budget with minimum-replica floor
+    reservations.
+
+    Without floors, a high-priority server whose (backlog-inflated) demand
+    covers the whole pool starves every lower class to ZERO replicas — and
+    because the engine holds unallocated servers at their current count, the
+    pool deadlocks oversubscribed (nobody can schedule). Floors reserve
+    ``min_replicas`` worth of chips per server up front (priority order, as
+    capacity affords); a server's own floor is released the moment it
+    receives any allocation."""
+
+    def __init__(self, available: dict[str, int]) -> None:
+        self.available = dict(available)
+        self.reserved: dict[str, int] = {}
+        self.floors: dict[str, tuple[str, int]] = {}  # server -> (type, chips)
+
+    def reserve_floor(self, name: str, acc_type: str, chips: int) -> None:
+        if self.headroom(name, acc_type) >= chips:
+            self.floors[name] = (acc_type, chips)
+            self.reserved[acc_type] = self.reserved.get(acc_type, 0) + chips
+
+    def headroom(self, name: str, acc_type: str) -> int:
+        """Chips ``name`` may claim: available minus others' floors."""
+        res = self.reserved.get(acc_type, 0)
+        own = self.floors.get(name)
+        if own is not None and own[0] == acc_type:
+            res -= own[1]
+        return self.available.get(acc_type, 0) - res
+
+    def take(self, name: str, acc_type: str, chips: int) -> bool:
+        if self.headroom(name, acc_type) < chips:
+            return False
+        self.available[acc_type] = self.available.get(acc_type, 0) - chips
+        own = self.floors.get(name)
+        if own is None:
+            return True
+        if own[0] != acc_type:
+            # Allocated on a different pool: the reservation there is moot
+            # (replicas of one server never mix pools).
+            self.release_floor(name)
+        else:
+            # Shrink the floor by what was just granted — NOT a full
+            # release: a one-replica round-robin grant must not hand the
+            # rest of this server's minimum to competitors (the floor
+            # guarantees min_replicas, not min-one).
+            remaining = own[1] - chips
+            if remaining <= 0:
+                self.release_floor(name)
+            else:
+                self.floors[name] = (acc_type, remaining)
+                self.reserved[acc_type] -= chips
+        return True
+
+    def release_floor(self, name: str) -> None:
+        own = self.floors.pop(name, None)
+        if own is not None:
+            self.reserved[own[0]] -= own[1]
+
+
 def _solve_greedy(system: FleetSystem, spec: SolverSpec,
                   entries: list[_Entry], solution: Solution) -> None:
-    available = dict(system.capacity_chips)
+    cap = _Capacity(system.capacity_chips)
+    # Floors in priority order: capacity permitting, every server keeps at
+    # least min_replicas claimable on its best candidate's pool.
+    for e in sorted(entries, key=_order_key):
+        cand = next((c for c in e.candidates
+                     if c.accelerator and c.chips_per_replica > 0), None)
+        mn = max(e.server.min_replicas, 0)
+        if cand is not None and mn > 0:
+            cap.reserve_floor(e.server.name, cand.accelerator_type,
+                              mn * cand.chips_per_replica)
     if spec.delayed_best_effort:
-        unallocated = _allocate(entries, available, solution)
-        _best_effort(spec.saturation_policy, unallocated, available, solution)
+        unallocated = _allocate(entries, cap, solution)
+        _best_effort(spec.saturation_policy, unallocated, cap, solution)
     else:
         for group in _priority_groups(entries):
-            unallocated = _allocate(group, available, solution)
-            _best_effort(spec.saturation_policy, unallocated, available, solution)
+            unallocated = _allocate(group, cap, solution)
+            _best_effort(spec.saturation_policy, unallocated, cap, solution)
     solution.unallocated = [
         e.server.name for e in entries
         if e.server.name not in solution.allocations
@@ -150,7 +219,7 @@ def _priority_groups(entries: list[_Entry]) -> list[list[_Entry]]:
     return [groups[p] for p in sorted(groups)]
 
 
-def _allocate(entries: list[_Entry], available: dict[str, int],
+def _allocate(entries: list[_Entry], cap: _Capacity,
               solution: Solution) -> list[_Entry]:
     """Greedy full-SLO allocation round (reference greedy.go:107-165).
     Returns entries that could not be satisfied at any candidate."""
@@ -161,10 +230,10 @@ def _allocate(entries: list[_Entry], available: dict[str, int],
         alloc = top.current()
         if not alloc.accelerator:  # zero-load empty allocation
             solution.allocations[top.server.name] = alloc
+            cap.release_floor(top.server.name)
             continue
         need = alloc.num_replicas * alloc.chips_per_replica
-        if available.get(alloc.accelerator_type, 0) >= need:
-            available[alloc.accelerator_type] -= need
+        if cap.take(top.server.name, alloc.accelerator_type, need):
             solution.allocations[top.server.name] = alloc
         else:
             top.cur_index += 1
@@ -178,41 +247,42 @@ def _allocate(entries: list[_Entry], available: dict[str, int],
 
 
 def _best_effort(policy: SaturationPolicy, unallocated: list[_Entry],
-                 available: dict[str, int], solution: Solution) -> None:
+                 cap: _Capacity, solution: Solution) -> None:
     """Partial allocation for servers whose full SLO sizing never fit
     (reference greedy.go:168-260)."""
     if policy == SaturationPolicy.NONE or not unallocated:
         return
     if policy == SaturationPolicy.PRIORITY_EXHAUSTIVE:
         for e in sorted(unallocated, key=_order_key):
-            _allocate_maximally(e, available, solution)
+            _allocate_maximally(e, cap, solution)
         return
     if policy == SaturationPolicy.ROUND_ROBIN:
-        _allocate_equally(sorted(unallocated, key=_order_key), available, solution)
+        _allocate_equally(sorted(unallocated, key=_order_key), cap, solution)
         return
     # PRIORITY_ROUND_ROBIN
     for group in _priority_groups(unallocated):
-        _allocate_equally(sorted(group, key=_order_key), available, solution)
+        _allocate_equally(sorted(group, key=_order_key), cap, solution)
 
 
-def _allocate_maximally(e: _Entry, available: dict[str, int],
+def _allocate_maximally(e: _Entry, cap: _Capacity,
                         solution: Solution) -> None:
     """As many replicas of the cheapest candidate as capacity affords
     (reference greedy.go:194-224 allocateMaximally)."""
+    name = e.server.name
     for alloc in e.candidates:
         if not alloc.accelerator or alloc.chips_per_replica <= 0:
             continue
         max_replicas = min(
-            available.get(alloc.accelerator_type, 0) // alloc.chips_per_replica,
+            cap.headroom(name, alloc.accelerator_type) // alloc.chips_per_replica,
             alloc.num_replicas)
         if max_replicas > 0:
             scaled = alloc.scaled_to(max_replicas)
-            available[alloc.accelerator_type] -= scaled.chips
-            solution.allocations[e.server.name] = scaled
+            cap.take(name, scaled.accelerator_type, scaled.chips)
+            solution.allocations[name] = scaled
             return
 
 
-def _allocate_equally(group: list[_Entry], available: dict[str, int],
+def _allocate_equally(group: list[_Entry], cap: _Capacity,
                       solution: Solution) -> None:
     """One replica at a time round-robin across the group until nothing fits
     (reference greedy.go:240-260+ allocateEqually)."""
@@ -225,7 +295,7 @@ def _allocate_equally(group: list[_Entry], available: dict[str, int],
         it is pinned (replicas of one server never mix pools)."""
         for alloc in e.candidates:
             if (alloc.accelerator and alloc.chips_per_replica > 0
-                    and available.get(alloc.accelerator_type, 0)
+                    and cap.headroom(e.server.name, alloc.accelerator_type)
                     >= alloc.chips_per_replica):
                 return alloc
         return None
@@ -246,8 +316,8 @@ def _allocate_equally(group: list[_Entry], available: dict[str, int],
                 continue
             if granted[name] >= alloc.num_replicas:
                 continue
-            if available.get(alloc.accelerator_type, 0) >= alloc.chips_per_replica:
-                available[alloc.accelerator_type] -= alloc.chips_per_replica
+            if cap.take(name, alloc.accelerator_type,
+                        alloc.chips_per_replica):
                 granted[name] += 1
                 progress = True
     for e in group:
