@@ -1,0 +1,114 @@
+"""Chart golden-file snapshots + real-helm divergence gate (round-2 verdict
+item 8).
+
+The subset renderer (``wva_tpu.utils.helmlite``) stands in for ``helm
+template`` in this environment; two safety nets keep that honest:
+
+1. **Golden snapshots** — the full rendered manifest for four canonical
+   value sets is committed under ``tests/goldens/chart/``; any template or
+   renderer change shows up as a reviewable diff (regenerate with
+   ``UPDATE_GOLDENS=1 pytest tests/test_chart_golden.py``).
+2. **helm parity** — when a real ``helm`` binary exists (CI), every value
+   set is ALSO rendered with ``helm template`` and compared document-by-
+   document; any semantic divergence between helmlite and helm fails the
+   suite instead of shipping (reference renders with the real binary:
+   test/chart/client_only_install_test.go:28-50).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+import yaml
+
+from wva_tpu.utils.helmlite import Renderer
+
+REPO = Path(__file__).resolve().parent.parent
+CHART = REPO / "charts" / "wva-tpu"
+GOLDEN_DIR = REPO / "tests" / "goldens" / "chart"
+
+# (name, release, namespace, --set overrides)
+VALUE_SETS = [
+    ("default", "wva-tpu", "wva-tpu-system", {}),
+    ("client-only", "wva-model-b", "wva-tpu-system", {
+        "controller.enabled": "false",
+        "llmd.modelName": "llama-v5p",
+        "va.accelerator": "v5p-8",
+    }),
+    ("scoped", "wva-tpu", "wva-tpu-system", {
+        "wva.namespaceScoped": "true",
+        "llmd.namespace": "llm-d-inference",
+    }),
+    ("tls-auth", "wva-tpu", "wva-tpu-system", {
+        "wva.metrics.secure": "true",
+        "wva.metrics.auth": "true",
+    }),
+]
+
+
+def render(release: str, namespace: str, overrides: dict[str, str]) -> str:
+    return Renderer(str(CHART), release_name=release, namespace=namespace,
+                    set_values=dict(overrides)).render_manifest(
+                        include_crds=False)
+
+
+def normalize_docs(text: str) -> dict[tuple[str, str, str], dict]:
+    """(kind, namespace, name) -> parsed doc, for order/format-insensitive
+    comparison."""
+    out = {}
+    for doc in yaml.safe_load_all(text):
+        if not doc:
+            continue
+        meta = doc.get("metadata", {})
+        out[(doc.get("kind", ""), meta.get("namespace", ""),
+             meta.get("name", ""))] = doc
+    return out
+
+
+class TestGoldenSnapshots:
+    @pytest.mark.parametrize("name,release,namespace,overrides", VALUE_SETS)
+    def test_render_matches_golden(self, name, release, namespace, overrides):
+        rendered = render(release, namespace, overrides)
+        golden_path = GOLDEN_DIR / f"{name}.yaml"
+        if os.environ.get("UPDATE_GOLDENS"):
+            golden_path.parent.mkdir(parents=True, exist_ok=True)
+            golden_path.write_text(rendered)
+        assert golden_path.exists(), \
+            f"golden {golden_path} missing; run with UPDATE_GOLDENS=1"
+        golden = golden_path.read_text()
+        if rendered != golden:
+            # Show a structural diff first (more readable than text diff).
+            assert normalize_docs(rendered) == normalize_docs(golden), \
+                f"{name}: rendered documents diverge from golden"
+            assert rendered == golden, \
+                f"{name}: rendered text differs from golden (formatting)"
+
+    def test_goldens_are_valid_manifests(self):
+        for name, *_ in VALUE_SETS:
+            docs = normalize_docs((GOLDEN_DIR / f"{name}.yaml").read_text())
+            assert docs, name
+            for (kind, _, obj_name), doc in docs.items():
+                assert kind and obj_name and doc.get("apiVersion"), (name, doc)
+
+
+@pytest.mark.skipif(shutil.which("helm") is None,
+                    reason="no helm binary in this environment")
+class TestHelmParity:
+    @pytest.mark.parametrize("name,release,namespace,overrides", VALUE_SETS)
+    def test_helmlite_matches_helm_template(self, name, release, namespace,
+                                            overrides):
+        args = ["helm", "template", release, str(CHART), "-n", namespace]
+        for k, v in overrides.items():
+            args += ["--set", f"{k}={v}"]
+        result = subprocess.run(args, capture_output=True, text=True,
+                                timeout=120)
+        assert result.returncode == 0, result.stderr
+        helm_docs = normalize_docs(result.stdout)
+        lite_docs = normalize_docs(render(release, namespace, overrides))
+        assert helm_docs.keys() == lite_docs.keys(), name
+        for key in helm_docs:
+            assert helm_docs[key] == lite_docs[key], (name, key)

@@ -150,12 +150,22 @@ def main(argv: list[str] | None = None) -> int:
     if infra.secure_metrics and tls.metrics_cert_path:
         tls_cert = f"{tls.metrics_cert_path}/{tls.metrics_cert_name or 'tls.crt'}"
         tls_key = f"{tls.metrics_cert_path}/{tls.metrics_cert_key or 'tls.key'}"
+    metrics_auth = None
+    if cfg.metrics_auth_enabled():
+        # Kubernetes-delegated scrape auth: TokenReview + SAR against the
+        # API server (reference cmd/main.go:213-219).
+        from wva_tpu.k8s.authz import TokenReviewAuthenticator
+
+        metrics_auth = TokenReviewAuthenticator(client).allowed
+        log.info("Metrics endpoint protected by TokenReview/"
+                 "SubjectAccessReview")
     endpoints = HTTPEndpoints(
         render_metrics=mgr.registry.render_text,
         healthz=mgr.healthz, readyz=mgr.readyz,
         metrics_addr=cfg.metrics_addr() or ":8443",
         health_addr=cfg.probe_addr() or ":8081",
         tls_cert_file=tls_cert, tls_key_file=tls_key,
+        metrics_auth=metrics_auth,
     ).start()
     metrics_port, health_port = endpoints.ports()
     log.info("Serving /metrics on :%d and /healthz /readyz on :%d",

@@ -24,6 +24,12 @@ DEFAULT_WINDOW_SECONDS = 180.0
 MIN_SPAN_SECONDS = 20.0
 MIN_SAMPLES = 2
 MAX_SAMPLES_PER_KEY = 256
+# A series whose average inter-sample gap is at least this is a SPARSE
+# feeder (engine ticks only, 10-30s apart) and may use the conservative
+# 2-point/20s rule; densely fed series (fast-path samples every few
+# seconds) must satisfy min_samples — a dense feeder can never
+# legitimately hold just 2 samples spanning 20s.
+SPARSE_GAP_SECONDS = 10.0
 
 
 class DemandTrend:
@@ -74,12 +80,14 @@ class DemandTrend:
         t0 = series[0][0]
         span = series[-1][0] - t0
         # Two regimes: a densely fed series qualifies at (min_samples,
-        # min_span); a sparse one (e.g. one sample per 30s engine tick when
-        # the fast-path feed is off) falls back to the conservative
-        # 2-point / MIN_SPAN_SECONDS rule rather than waiting min_samples
-        # ticks — anticipation latency must not regress for sparse feeders.
+        # min_span); a genuinely sparse one (one sample per engine tick when
+        # the fast-path feed is off — detected by its inter-sample gap)
+        # falls back to the conservative 2-point / MIN_SPAN_SECONDS rule
+        # rather than waiting min_samples ticks. The gap test keeps the
+        # min_samples noise guard binding for dense feeders.
         dense_ok = n >= self.min_samples and span >= self.min_span_seconds
-        sparse_ok = span >= max(self.min_span_seconds, MIN_SPAN_SECONDS)
+        sparse_ok = (span >= max(self.min_span_seconds, MIN_SPAN_SECONDS)
+                     and span / (n - 1) >= SPARSE_GAP_SECONDS)
         if not (dense_ok or sparse_ok):
             return 0.0
         # Least-squares slope of demand over time.

@@ -38,6 +38,9 @@ class InfrastructureConfig:
     retry_period: float = 10.0
     rest_timeout: float = 60.0
     secure_metrics: bool = True
+    # TokenReview/SubjectAccessReview gate on /metrics (reference
+    # cmd/main.go:213-219 WithAuthenticationAndAuthorization).
+    metrics_auth: bool = False
     enable_http2: bool = False
     watch_namespace: str = ""
     logger_verbosity: int = 0
@@ -130,6 +133,10 @@ class Config:
     def watch_namespace(self) -> str:
         with self._mu:
             return self.infrastructure.watch_namespace
+
+    def metrics_auth_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.metrics_auth
 
     def logger_verbosity(self) -> int:
         with self._mu:

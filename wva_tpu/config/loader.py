@@ -38,6 +38,7 @@ DEFAULTS: dict[str, Any] = {
     "LEADER_ELECTION_RETRY_PERIOD": "10s",
     "REST_CLIENT_TIMEOUT": "60s",
     "METRICS_SECURE": True,
+    "METRICS_AUTH": False,
     "ENABLE_HTTP2": False,
     "WATCH_NAMESPACE": "",
     "V": 0,
@@ -138,6 +139,7 @@ def load(flags: Mapping[str, Any] | None = None,
         retry_period=r.get_duration("LEADER_ELECTION_RETRY_PERIOD"),
         rest_timeout=r.get_duration("REST_CLIENT_TIMEOUT"),
         secure_metrics=r.get_bool("METRICS_SECURE"),
+        metrics_auth=r.get_bool("METRICS_AUTH"),
         enable_http2=r.get_bool("ENABLE_HTTP2"),
         watch_namespace=r.get_str("WATCH_NAMESPACE"),
         logger_verbosity=r.get_int("V"),

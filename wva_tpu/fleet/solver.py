@@ -235,6 +235,10 @@ def _allocate(entries: list[_Entry], cap: _Capacity,
         need = alloc.num_replicas * alloc.chips_per_replica
         if cap.take(top.server.name, alloc.accelerator_type, need):
             solution.allocations[top.server.name] = alloc
+            # The server received its (single) allocation for this solve: a
+            # residual floor (full allocation smaller than the reserved
+            # minimum's chip count) must not strand chips nobody will claim.
+            cap.release_floor(top.server.name)
         else:
             top.cur_index += 1
             if top.cur_index >= len(top.candidates):
@@ -279,6 +283,7 @@ def _allocate_maximally(e: _Entry, cap: _Capacity,
             scaled = alloc.scaled_to(max_replicas)
             cap.take(name, scaled.accelerator_type, scaled.chips)
             solution.allocations[name] = scaled
+            cap.release_floor(name)  # final allocation; no residual reserve
             return
 
 
@@ -325,3 +330,6 @@ def _allocate_equally(group: list[_Entry], cap: _Capacity,
         alloc = chosen.get(e.server.name)
         if alloc is not None and n > 0:
             solution.allocations[e.server.name] = alloc.scaled_to(n)
+        # Round-robin was this group's last chance at capacity this solve:
+        # any floor remainder would be stranded, so release it.
+        cap.release_floor(e.server.name)

@@ -1,0 +1,95 @@
+"""Kubernetes-delegated authentication/authorization for ``/metrics``.
+
+The reference protects its metrics endpoint with controller-runtime's
+``WithAuthenticationAndAuthorization`` filter (``cmd/main.go:213-219`` +
+``config/rbac/metrics_auth_role.yaml``): every scrape presents a
+ServiceAccount bearer token, the apiserver validates it via **TokenReview**,
+and a **SubjectAccessReview** checks the caller may ``get`` the ``/metrics``
+nonResourceURL. This module is that filter: stdlib-only, short-TTL decision
+cache (Prometheus scrapes every few seconds; the apiserver should not see
+one review pair per scrape).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+TOKEN_REVIEW_PATH = "/apis/authentication.k8s.io/v1/tokenreviews"
+SUBJECT_ACCESS_REVIEW_PATH = "/apis/authorization.k8s.io/v1/subjectaccessreviews"
+DECISION_CACHE_TTL = 60.0
+DECISION_CACHE_MAX = 256
+
+
+class TokenReviewAuthenticator:
+    """``allowed(authorization_header)`` gate for the metrics listener."""
+
+    def __init__(self, client, clock: Clock | None = None,
+                 cache_ttl: float = DECISION_CACHE_TTL,
+                 path: str = "/metrics") -> None:
+        self.client = client  # RestKubeClient (raw_post)
+        self.clock = clock or SYSTEM_CLOCK
+        self.cache_ttl = cache_ttl
+        self.path = path
+        self._mu = threading.Lock()
+        self._cache: dict[str, tuple[bool, float]] = {}  # token -> (ok, exp)
+
+    def allowed(self, authorization_header: str) -> bool:
+        if not authorization_header.startswith("Bearer "):
+            return False
+        token = authorization_header[len("Bearer "):].strip()
+        if not token:
+            return False
+        now = self.clock.now()
+        with self._mu:
+            cached = self._cache.get(token)
+            if cached is not None and now < cached[1]:
+                return cached[0]
+        ok = self._review(token)
+        with self._mu:
+            if len(self._cache) >= DECISION_CACHE_MAX:
+                self._cache.clear()  # bounded; refill from live reviews
+            self._cache[token] = (ok, now + self.cache_ttl)
+        return ok
+
+    def _review(self, token: str) -> bool:
+        """TokenReview (authn) then SubjectAccessReview (authz). Fail
+        CLOSED: any apiserver error denies the scrape — metrics must never
+        leak because the authorizer was unreachable."""
+        try:
+            tr = self.client.raw_post(TOKEN_REVIEW_PATH, {
+                "apiVersion": "authentication.k8s.io/v1",
+                "kind": "TokenReview",
+                "spec": {"token": token},
+            })
+        except Exception as e:  # noqa: BLE001 — fail closed
+            log.warning("TokenReview failed: %s", e)
+            return False
+        status = tr.get("status") or {}
+        if not status.get("authenticated"):
+            return False
+        user = status.get("user") or {}
+        username = user.get("username", "")
+        groups = user.get("groups") or []
+        try:
+            sar = self.client.raw_post(SUBJECT_ACCESS_REVIEW_PATH, {
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": username,
+                    "groups": groups,
+                    "nonResourceAttributes": {"path": self.path,
+                                              "verb": "get"},
+                },
+            })
+        except Exception as e:  # noqa: BLE001 — fail closed
+            log.warning("SubjectAccessReview failed: %s", e)
+            return False
+        allowed = bool((sar.get("status") or {}).get("allowed"))
+        if not allowed:
+            log.info("Metrics scrape by %s denied by RBAC", username)
+        return allowed

@@ -66,6 +66,9 @@ class _Handler(BaseHTTPRequestHandler):
     cluster: FakeCluster = None
     plurals: dict[tuple[str, str], str] = {}
     bearer_token: str = ""
+    # TokenReview / SubjectAccessReview backing state.
+    sa_tokens: dict[str, str] = {}  # token -> username
+    metrics_readers: set = set()  # usernames allowed to GET /metrics
 
     # --- helpers ---
 
@@ -171,6 +174,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802
         if not self._authorized():
             return
+        path = urlparse(self.path).path
+        if path == "/apis/authentication.k8s.io/v1/tokenreviews":
+            self._serve_token_review()
+            return
+        if path == "/apis/authorization.k8s.io/v1/subjectaccessreviews":
+            self._serve_subject_access_review()
+            return
         routed = self._route()
         if routed is None:
             return
@@ -245,6 +255,37 @@ class _Handler(BaseHTTPRequestHandler):
         except NotFoundError as e:
             self._send_status_error(404, "NotFound", str(e),
                                     details={"name": name, "kind": kind})
+
+    # --- authn/authz review APIs (TokenReview / SubjectAccessReview) ---
+
+    def _serve_token_review(self) -> None:
+        """TokenReview: validate a ServiceAccount token against the server's
+        configured token->username map (real apiservers do the same against
+        their token authenticators)."""
+        body = self._read_body()
+        token = ((body.get("spec") or {}).get("token")) or ""
+        username = self.sa_tokens.get(token)
+        status = ({"authenticated": True,
+                   "user": {"username": username,
+                            "groups": ["system:serviceaccounts",
+                                       "system:authenticated"]}}
+                  if username is not None else {"authenticated": False})
+        self._send_json(201, {"apiVersion": "authentication.k8s.io/v1",
+                              "kind": "TokenReview", "status": status})
+
+    def _serve_subject_access_review(self) -> None:
+        """SubjectAccessReview for nonResourceURLs: allowed iff the username
+        is in the server's metrics_readers set (standing in for RBAC)."""
+        body = self._read_body()
+        spec = body.get("spec") or {}
+        user = spec.get("user", "")
+        attrs = spec.get("nonResourceAttributes") or {}
+        allowed = (user in self.metrics_readers
+                   and attrs.get("verb") == "get"
+                   and attrs.get("path") == "/metrics")
+        self._send_json(201, {"apiVersion": "authorization.k8s.io/v1",
+                              "kind": "SubjectAccessReview",
+                              "status": {"allowed": allowed}})
 
     # --- watch streaming ---
 
@@ -334,12 +375,16 @@ class FakeAPIServer:
     """Serve a FakeCluster over HTTP on 127.0.0.1:<port> (0 = ephemeral)."""
 
     def __init__(self, cluster: FakeCluster, port: int = 0,
-                 bearer_token: str = "") -> None:
+                 bearer_token: str = "",
+                 sa_tokens: dict[str, str] | None = None,
+                 metrics_readers: set | None = None) -> None:
         self.cluster = cluster
         handler = type("Handler", (_Handler,), {
             "cluster": cluster,
             "plurals": _plural_index(),
             "bearer_token": bearer_token,
+            "sa_tokens": dict(sa_tokens or {}),
+            "metrics_readers": set(metrics_readers or ()),
         })
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self._server.daemon_threads = True

@@ -203,6 +203,11 @@ class RestKubeClient(KubeClient):
             raise self._map_error(e, kind, ns, name) from None
         return serde.from_k8s(kind, d)
 
+    def raw_post(self, path: str, body: dict) -> dict:
+        """POST an arbitrary API payload (TokenReview/SubjectAccessReview —
+        ephemeral review kinds that never round-trip through serde)."""
+        return self._request("POST", path, body=body)
+
     @staticmethod
     def _is_object_not_found(e: ApiError, name: str) -> bool:
         """True when a 404's Status body names the missing OBJECT (vs a

@@ -48,6 +48,10 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "wva-tpu"
     routes: dict[str, Callable[[], tuple[int, str, str]]] = {}
     bearer_token: str = ""
+    # Kubernetes-delegated gate (TokenReview + SubjectAccessReview); takes
+    # the Authorization header, returns allowed. Overrides the static
+    # bearer check when set.
+    auth_check: Callable[[str], bool] | None = None
 
     def do_GET(self) -> None:  # noqa: N802 — http.server API
         path = self.path.split("?", 1)[0]
@@ -55,7 +59,21 @@ class _Handler(BaseHTTPRequestHandler):
         if route is None:
             self.send_error(404)
             return
-        if self.bearer_token and path == "/metrics":
+        if path == "/metrics" and self.auth_check is not None:
+            try:
+                allowed = self.auth_check(self.headers.get("Authorization", ""))
+            except Exception:  # noqa: BLE001 — fail closed
+                log.exception("metrics auth check failed")
+                allowed = False
+            if not allowed:
+                # 403 like the reference's authz filter (401 only for a
+                # missing/unparseable credential).
+                if self.headers.get("Authorization", "").startswith("Bearer "):
+                    self.send_error(403)
+                else:
+                    self.send_error(401)
+                return
+        elif self.bearer_token and path == "/metrics":
             auth = self.headers.get("Authorization", "")
             if auth != f"Bearer {self.bearer_token}":
                 self.send_error(401)
@@ -123,6 +141,7 @@ class HTTPEndpoints:
         tls_cert_file: str = "",
         tls_key_file: str = "",
         metrics_bearer_token: str = "",
+        metrics_auth: Callable[[str], bool] | None = None,
     ) -> None:
         self._render = render_metrics
         self._healthz = healthz
@@ -132,6 +151,7 @@ class HTTPEndpoints:
         self.tls_cert_file = tls_cert_file
         self.tls_key_file = tls_key_file
         self.metrics_bearer_token = metrics_bearer_token
+        self.metrics_auth = metrics_auth
         self._servers: list[ThreadingHTTPServer] = []
         self._threads: list[threading.Thread] = []
         self._reloader: CertReloader | None = None
@@ -156,9 +176,12 @@ class HTTPEndpoints:
 
     def _make_server(self, bind: tuple[str, int],
                      routes: dict[str, Callable[[], tuple[int, str, str]]],
-                     use_tls: bool, bearer: str) -> ThreadingHTTPServer:
+                     use_tls: bool, bearer: str,
+                     auth_check=None) -> ThreadingHTTPServer:
         handler = type("Handler", (_Handler,),
-                       {"routes": routes, "bearer_token": bearer})
+                       {"routes": routes, "bearer_token": bearer,
+                        "auth_check": staticmethod(auth_check)
+                        if auth_check else None})
         server = ThreadingHTTPServer(bind, handler)
         server.daemon_threads = True
         if use_tls:
@@ -174,7 +197,8 @@ class HTTPEndpoints:
             use_tls = bool(self.tls_cert_file and self.tls_key_file)
             srv = self._make_server(
                 self.metrics_addr, {"/metrics": self._metrics_route},
-                use_tls, self.metrics_bearer_token)
+                use_tls, self.metrics_bearer_token,
+                auth_check=self.metrics_auth)
             self._servers.append(srv)
         if self.health_addr is not None:
             srv = self._make_server(
