@@ -313,8 +313,37 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+def _ensure_healthy_device(probe_timeout: float = 180.0) -> None:
+    """Probe the default JAX backend in a SUBPROCESS; if a trivial jit does
+    not complete in time (a wedged remote TPU tunnel blocks indefinitely and
+    is uninterruptible in-process), fall back to CPU for this bench run so
+    the driver always gets a result line. Runs before any in-process jax
+    use, so the platform override still takes effect."""
+    import subprocess
+    import sys as _sys
+
+    probe = ("import jax, jax.numpy as jnp;"
+             "print(float(jax.jit(lambda a:(a@a).sum())"
+             "(jnp.ones((256,256)))))")
+    try:
+        subprocess.run([_sys.executable, "-c", probe], check=True,
+                       capture_output=True, timeout=probe_timeout)
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+        print(f"WARNING: default JAX backend unhealthy ({type(e).__name__});"
+              " falling back to CPU for this bench run", file=_sys.stderr)
+        # Env alone is not enough: jax snapshots JAX_PLATFORMS at import,
+        # and this module's imports already pulled jax in. config.update
+        # works any time before the first backend initialization.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> None:
     t0 = time.time()
+    _ensure_healthy_device()
     baseline = run_policy("baseline")
     baseline_fast = run_policy("baseline-fast")
     ours = run_policy("ours")

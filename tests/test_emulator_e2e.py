@@ -110,3 +110,42 @@ def test_limiter_caps_at_inventory():
     h.run(1500)
     # Only 2 whole slices exist: desired can never exceed 2.
     assert h.replicas_of("llama-v5e") <= 2
+
+
+def test_target_condition_tracks_deployment_existence():
+    """TargetResolved flips False when the scale target is missing and True
+    once it exists (reference test/e2e/target_condition_test.go:128-170)."""
+    from wva_tpu.api import (
+        TYPE_TARGET_RESOLVED,
+        VariantAutoscaling,
+        VariantAutoscalingSpec,
+    )
+    from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+    from wva_tpu.k8s import Container, Deployment, PodTemplateSpec
+
+    h, _ = make_harness(load=constant(2.0))
+    # A second VA whose target deployment does not exist.
+    h.cluster.create(VariantAutoscaling(
+        metadata=ObjectMeta(
+            name="orphan", namespace=h.namespace,
+            labels={"inference.optimization/acceleratorName": "v5e-8"}),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name="orphan"),
+            model_id="org/other-model", variant_cost="10.0")))
+    h.manager.va_reconciler.reconcile("orphan", h.namespace)
+    va = h.cluster.get(VariantAutoscaling.kind, h.namespace, "orphan")
+    cond = va.get_condition(TYPE_TARGET_RESOLVED)
+    assert cond is not None and cond.status == "False"
+
+    # Creating the deployment resolves the target on the next reconcile.
+    h.cluster.create(Deployment(
+        metadata=ObjectMeta(name="orphan", namespace=h.namespace),
+        replicas=1, selector={"app": "orphan"},
+        template=PodTemplateSpec(labels={"app": "orphan"},
+                                 containers=[Container(name="srv")])))
+    h.manager.va_reconciler.reconcile("orphan", h.namespace)
+    va = h.cluster.get(VariantAutoscaling.kind, h.namespace, "orphan")
+    assert va.get_condition(TYPE_TARGET_RESOLVED).status == "True"
+    # The healthy variant's loop is unaffected by the orphan VA.
+    h.run(120)
+    assert h.replicas_of("llama-v5e") >= 1
