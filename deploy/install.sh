@@ -63,7 +63,7 @@ render_chart() {
     else
         info "no helm binary; rendering with python -m wva_tpu.utils.helmlite"
         local args=("$CHART_DIR" --release "$RELEASE_NAME" -n "$WVA_NS"
-                    --include-crds)
+                    --include-crds -f "$VALUES_FILE")
         for s in "${common_sets[@]}"; do args+=(--set "$s"); done
         (cd "$REPO_ROOT" && "$PYTHON" -m wva_tpu.utils.helmlite "${args[@]}")
     fi

@@ -147,6 +147,40 @@ class TestClientOnlyInstall:
             "inference.optimization/acceleratorName"] == "v5p-8"
 
 
+class TestValuesFiles:
+    """``-f`` values files must actually flow into the render (the round-3
+    advisor found the install.sh fallback silently ignoring VALUES_FILE)."""
+
+    def test_values_file_deep_merges_over_chart_defaults(self, tmp_path):
+        vf = tmp_path / "custom.yaml"
+        vf.write_text(
+            "wva:\n  image:\n    tag: v9.9.9\n  verbosity: 5\n")
+        docs = Renderer(CHART, values_files=[str(vf)]).render_docs()
+        deploy = next(d for d in docs if d["kind"] == "Deployment")
+        container = deploy["spec"]["template"]["spec"]["containers"][0]
+        assert container["image"].endswith(":v9.9.9")
+        # Sibling keys under wva.image survive the merge (repository is not
+        # in the overlay) — replacement would have dropped them.
+        assert container["image"].startswith("ghcr.io/llm-d/wva-tpu")
+
+    def test_set_overrides_beat_values_files(self, tmp_path):
+        vf = tmp_path / "custom.yaml"
+        vf.write_text("wva:\n  image:\n    tag: v9.9.9\n")
+        docs = Renderer(CHART, values_files=[str(vf)],
+                        set_values={"wva.image.tag": "v0.0.1"}).render_docs()
+        deploy = next(d for d in docs if d["kind"] == "Deployment")
+        image = deploy["spec"]["template"]["spec"]["containers"][0]["image"]
+        assert image.endswith(":v0.0.1")
+
+    def test_cli_accepts_values_files(self, tmp_path, capsys):
+        from wva_tpu.utils.helmlite import main as helmlite_main
+
+        vf = tmp_path / "custom.yaml"
+        vf.write_text("wva:\n  image:\n    tag: v7.7.7\n")
+        assert helmlite_main([CHART, "-f", str(vf)]) == 0
+        assert ":v7.7.7" in capsys.readouterr().out
+
+
 class TestValueToggles:
     def test_scale_to_zero_renders_its_configmap(self):
         docs = Renderer(CHART, set_values={

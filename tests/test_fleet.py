@@ -290,6 +290,24 @@ class TestMinReplicaFloors:
         assert sol.allocations["inf/gemma"].num_replicas >= 1
         assert not sol.unallocated
 
+    def test_none_policy_releases_unused_floor(self):
+        """Under saturationPolicy NONE a server that never fits gets no
+        partial allocation — so its floor reservation must be released, not
+        strand chips that a lower priority group could use (round-3 advisor
+        finding)."""
+        system = make_system(capacity={"v5e": 16, "v5p": 0})
+        # llama's SLO sizing wants ~5 v5e replicas; only 2 fit -> with NONE
+        # it stays unallocated, but its 1-replica floor (8 chips) must not
+        # survive the best-effort pass.
+        system.servers["inf/llama"].load.arrival_rate_per_min = 6000.0
+        system.servers["inf/llama"].min_replicas = 1
+        # gemma's sizing at the default rate is exactly 2 replicas (16
+        # chips) — satisfiable only if llama's floor is released.
+        sol = solve(system, SolverSpec(
+            saturation_policy=SaturationPolicy.NONE))
+        assert "inf/llama" in sol.unallocated
+        assert sol.allocations["inf/gemma"].num_replicas == 2
+
     def test_floors_capped_by_capacity_in_priority_order(self):
         """When the pool cannot even cover every floor, reservation follows
         priority order — the premium class keeps its minimum."""

@@ -82,11 +82,84 @@ class TestAuthenticator:
         assert auth.allowed(f"Bearer {READER_TOKEN}") is True
         assert calls["n"] == 4  # TTL expired -> re-reviewed
 
+    def test_allow_decisions_expire_faster_than_denies(self, world):
+        _, client = world
+        clock = FakeClock(start=1000.0)
+        auth = TokenReviewAuthenticator(client, clock=clock,
+                                        cache_ttl=60.0, allow_ttl=20.0)
+        calls = {"n": 0}
+        orig = client.raw_post
+
+        def counting(path, body):
+            calls["n"] += 1
+            return orig(path, body)
+
+        client.raw_post = counting
+        auth.allowed(f"Bearer {READER_TOKEN}")   # allow -> 20s TTL
+        auth.allowed("Bearer not-a-token")       # deny -> 60s TTL
+        base = calls["n"]
+        clock.advance(30.0)
+        # Allow entry expired (revocation takes effect within allow_ttl)...
+        auth.allowed(f"Bearer {READER_TOKEN}")
+        assert calls["n"] == base + 2  # re-reviewed (TR + SAR)
+        # ...while the deny entry is still cached (spam stays rate-limited).
+        auth.allowed("Bearer not-a-token")
+        assert calls["n"] == base + 2
+
+    def test_token_churn_evicts_lru_not_whole_cache(self, world):
+        from wva_tpu.k8s import authz as authz_mod
+
+        _, client = world
+        clock = FakeClock(start=1000.0)
+        auth = TokenReviewAuthenticator(client, clock=clock)
+        calls = {"n": 0}
+        orig = client.raw_post
+
+        def counting(path, body):
+            calls["n"] += 1
+            return orig(path, body)
+
+        client.raw_post = counting
+        auth.allowed(f"Bearer {READER_TOKEN}")
+        # Flood with unknown tokens to one short of capacity, touching the
+        # legit token in between so it stays most-recently-used.
+        for i in range(authz_mod.DECISION_CACHE_MAX - 2):
+            auth.allowed(f"Bearer junk-{i}")
+        auth.allowed(f"Bearer {READER_TOKEN}")  # refresh LRU position
+        base = calls["n"]
+        # Two more unknown tokens push past capacity: only the stalest
+        # junk entries are evicted, never the legit scraper's.
+        auth.allowed("Bearer junk-final-1")
+        auth.allowed("Bearer junk-final-2")
+        auth.allowed(f"Bearer {READER_TOKEN}")
+        # Unknown tokens cost one TokenReview each (fail authn, no SAR);
+        # the legit token is still served from cache — zero extra reviews.
+        assert calls["n"] == base + 2
+
     def test_apiserver_outage_fails_closed(self, world):
         server, client = world
         auth = TokenReviewAuthenticator(client)
         server.shutdown()
         assert auth.allowed(f"Bearer {READER_TOKEN}") is False
+
+    def test_outage_deny_is_not_cached(self, world):
+        """A review that ERRORS denies the scrape but must not be
+        remembered as an RBAC denial: the next scrape after the apiserver
+        recovers succeeds immediately, not cache_ttl later."""
+        _, client = world
+        auth = TokenReviewAuthenticator(client)
+        orig = client.raw_post
+        fail = {"on": True}
+
+        def flaky(path, body):
+            if fail["on"]:
+                raise ConnectionError("apiserver restarting")
+            return orig(path, body)
+
+        client.raw_post = flaky
+        assert auth.allowed(f"Bearer {READER_TOKEN}") is False
+        fail["on"] = False  # apiserver back within one scrape interval
+        assert auth.allowed(f"Bearer {READER_TOKEN}") is True
 
 
 class TestServedMetricsWithK8sAuth:

@@ -123,6 +123,38 @@ class TestHappyPath:
             b.close()
 
 
+class TestConcurrencyBound:
+    def test_scrape_fan_out_never_exceeds_max_concurrency(self):
+        """Large EPP fleets are scraped with bounded parallelism (reference
+        pod_scraping_source.go:249-295 uses a semaphore of 10) — concurrent,
+        but never one thread per pod."""
+        import threading
+
+        cluster, clock = make_world([(f"10.0.0.{i}", True)
+                                     for i in range(40)])
+        in_flight = {"now": 0, "peak": 0}
+        mu = threading.Lock()
+        gate = threading.Event()
+
+        def fetcher(pod):
+            with mu:
+                in_flight["now"] += 1
+                in_flight["peak"] = max(in_flight["peak"], in_flight["now"])
+                if in_flight["now"] >= 3:
+                    gate.set()  # proof the fan-out is actually parallel
+            gate.wait(timeout=5.0)
+            with mu:
+                in_flight["now"] -= 1
+            return EXPO_A
+
+        src = PodScrapingSource(cluster, "epp", NS, fetcher,
+                                max_concurrency=10, clock=clock)
+        result = src.refresh(RefreshSpec())[ALL_METRICS_QUERY]
+        assert not result.has_error()
+        assert len({v.labels["pod"] for v in result.values}) == 40
+        assert 3 <= in_flight["peak"] <= 10
+
+
 class TestAuthAndFailure:
     def test_bearer_token_required_and_sent(self):
         server = _PodServer("127.0.0.1", EXPO_A, bearer_token="scrape-tok")

@@ -254,18 +254,22 @@ def _best_effort(policy: SaturationPolicy, unallocated: list[_Entry],
                  cap: _Capacity, solution: Solution) -> None:
     """Partial allocation for servers whose full SLO sizing never fit
     (reference greedy.go:168-260)."""
-    if policy == SaturationPolicy.NONE or not unallocated:
-        return
     if policy == SaturationPolicy.PRIORITY_EXHAUSTIVE:
         for e in sorted(unallocated, key=_order_key):
             _allocate_maximally(e, cap, solution)
-        return
-    if policy == SaturationPolicy.ROUND_ROBIN:
+    elif policy == SaturationPolicy.ROUND_ROBIN:
         _allocate_equally(sorted(unallocated, key=_order_key), cap, solution)
-        return
-    # PRIORITY_ROUND_ROBIN
-    for group in _priority_groups(unallocated):
-        _allocate_equally(sorted(group, key=_order_key), cap, solution)
+    elif policy == SaturationPolicy.PRIORITY_ROUND_ROBIN:
+        for group in _priority_groups(unallocated):
+            _allocate_equally(sorted(group, key=_order_key), cap, solution)
+    # Best-effort was these servers' last chance at capacity this solve
+    # (under NONE they never had one): a floor still held by a server that
+    # ends the pass without an allocation would strand chips no one can
+    # claim — denying later priority groups allocations without the floored
+    # server gaining anything. Release every such remainder.
+    for e in unallocated:
+        if e.server.name not in solution.allocations:
+            cap.release_floor(e.server.name)
 
 
 def _allocate_maximally(e: _Entry, cap: _Capacity,

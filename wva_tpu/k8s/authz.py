@@ -22,21 +22,34 @@ log = logging.getLogger(__name__)
 TOKEN_REVIEW_PATH = "/apis/authentication.k8s.io/v1/tokenreviews"
 SUBJECT_ACCESS_REVIEW_PATH = "/apis/authorization.k8s.io/v1/subjectaccessreviews"
 DECISION_CACHE_TTL = 60.0
+DECISION_CACHE_ALLOW_TTL = 20.0
 DECISION_CACHE_MAX = 256
 
 
 class TokenReviewAuthenticator:
-    """``allowed(authorization_header)`` gate for the metrics listener."""
+    """``allowed(authorization_header)`` gate for the metrics listener.
+
+    Allow decisions get a SHORTER TTL than denies: a revoked token or
+    removed RBAC grant stops scraping within ``allow_ttl`` (20s) instead of
+    a full minute, while unauthenticated spam is still rate-limited to one
+    review pair per ``cache_ttl``. Eviction is per-entry LRU — an attacker
+    cycling unknown tokens evicts only the stalest entry, never the whole
+    cache of legitimate scrapers."""
 
     def __init__(self, client, clock: Clock | None = None,
                  cache_ttl: float = DECISION_CACHE_TTL,
+                 allow_ttl: float = DECISION_CACHE_ALLOW_TTL,
                  path: str = "/metrics") -> None:
+        from collections import OrderedDict
+
         self.client = client  # RestKubeClient (raw_post)
         self.clock = clock or SYSTEM_CLOCK
         self.cache_ttl = cache_ttl
+        self.allow_ttl = min(allow_ttl, cache_ttl)
         self.path = path
         self._mu = threading.Lock()
-        self._cache: dict[str, tuple[bool, float]] = {}  # token -> (ok, exp)
+        # token -> (ok, exp), LRU-ordered (most recent use last)
+        self._cache: "OrderedDict[str, tuple[bool, float]]" = OrderedDict()
 
     def allowed(self, authorization_header: str) -> bool:
         if not authorization_header.startswith("Bearer "):
@@ -48,18 +61,29 @@ class TokenReviewAuthenticator:
         with self._mu:
             cached = self._cache.get(token)
             if cached is not None and now < cached[1]:
+                self._cache.move_to_end(token)
                 return cached[0]
         ok = self._review(token)
+        if ok is None:
+            # Review ERRORED (apiserver blip): fail closed for this scrape
+            # but cache nothing — a healthy scraper whose re-review lands
+            # during a one-second outage must not be locked out for a full
+            # deny TTL.
+            return False
         with self._mu:
-            if len(self._cache) >= DECISION_CACHE_MAX:
-                self._cache.clear()  # bounded; refill from live reviews
-            self._cache[token] = (ok, now + self.cache_ttl)
+            self._cache.pop(token, None)
+            while len(self._cache) >= DECISION_CACHE_MAX:
+                self._cache.popitem(last=False)  # evict LRU entry only
+            ttl = self.allow_ttl if ok else self.cache_ttl
+            self._cache[token] = (ok, now + ttl)
         return ok
 
-    def _review(self, token: str) -> bool:
+    def _review(self, token: str) -> bool | None:
         """TokenReview (authn) then SubjectAccessReview (authz). Fail
         CLOSED: any apiserver error denies the scrape — metrics must never
-        leak because the authorizer was unreachable."""
+        leak because the authorizer was unreachable. Errors return ``None``
+        (deny, but uncacheable) so a transient blip is not remembered as a
+        60s RBAC denial."""
         try:
             tr = self.client.raw_post(TOKEN_REVIEW_PATH, {
                 "apiVersion": "authentication.k8s.io/v1",
@@ -68,7 +92,7 @@ class TokenReviewAuthenticator:
             })
         except Exception as e:  # noqa: BLE001 — fail closed
             log.warning("TokenReview failed: %s", e)
-            return False
+            return None
         status = tr.get("status") or {}
         if not status.get("authenticated"):
             return False
@@ -88,7 +112,7 @@ class TokenReviewAuthenticator:
             })
         except Exception as e:  # noqa: BLE001 — fail closed
             log.warning("SubjectAccessReview failed: %s", e)
-            return False
+            return None
         allowed = bool((sar.get("status") or {}).get("allowed"))
         if not allowed:
             log.info("Metrics scrape by %s denied by RBAC", username)

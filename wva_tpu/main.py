@@ -160,7 +160,11 @@ class Manager:
             self.engine.executor.tick()
             self.scale_from_zero.executor.tick()
             self.fastpath.executor.tick()
-            self.engine.executor.consume_trigger()  # tick above covered it
+            # The engine tick above ran BEFORE the fast-path scan: a backlog
+            # it just detected would otherwise wait a whole cycle, defeating
+            # the fast path in combined-tick drivers.
+            if self.engine.executor.consume_trigger():
+                self.engine.executor.tick()
         self.va_reconciler.drain_triggers()
 
     def scale_from_zero_tick(self) -> None:

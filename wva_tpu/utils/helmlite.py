@@ -57,15 +57,32 @@ def set_path(values: dict, dotted: str, raw: str) -> None:
     node[parts[-1]] = _coerce(raw)
 
 
+def deep_merge(base: dict, overlay: dict) -> dict:
+    """helm ``-f`` semantics: maps merge recursively, scalars and lists in
+    the overlay replace the base value."""
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 class Renderer:
     def __init__(self, chart_dir: str, release_name: str = "wva",
                  namespace: str = "wva-system",
-                 set_values: dict[str, str] | None = None) -> None:
+                 set_values: dict[str, str] | None = None,
+                 values_files: list[str] | None = None) -> None:
         self.chart_dir = Path(chart_dir)
         chart_meta = yaml.safe_load(
             (self.chart_dir / "Chart.yaml").read_text())
         self.values = yaml.safe_load(
             (self.chart_dir / "values.yaml").read_text()) or {}
+        # helm precedence: bundled values.yaml < -f files (in order) < --set.
+        for vf in values_files or []:
+            overlay = yaml.safe_load(Path(vf).read_text()) or {}
+            self.values = deep_merge(self.values, overlay)
         for k, v in (set_values or {}).items():
             set_path(self.values, k, v)
         self.context = {
@@ -246,6 +263,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-n", "--namespace", default="wva-system")
     p.add_argument("--set", action="append", default=[], metavar="PATH=VAL",
                    dest="set_values")
+    p.add_argument("-f", "--values", action="append", default=[],
+                   metavar="FILE", dest="values_files",
+                   help="values file merged over the chart's values.yaml "
+                        "(repeatable, helm -f semantics)")
     p.add_argument("--include-crds", action="store_true")
     args = p.parse_args(argv)
     overrides: dict[str, str] = {}
@@ -255,7 +276,8 @@ def main(argv: list[str] | None = None) -> int:
         k, v = item.split("=", 1)
         overrides[k] = v
     renderer = Renderer(args.chart_dir, release_name=args.release,
-                        namespace=args.namespace, set_values=overrides)
+                        namespace=args.namespace, set_values=overrides,
+                        values_files=args.values_files)
     print(renderer.render_manifest(include_crds=args.include_crds), end="")
     return 0
 
