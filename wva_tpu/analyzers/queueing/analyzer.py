@@ -73,6 +73,28 @@ BACKLOG_DRAIN_HORIZON_SECONDS = 15.0
 # engine-tick-only fallback is covered by min_samples.
 TREND_MIN_SPAN_SECONDS = 10.0
 TREND_MIN_SAMPLES = 3
+# Window for the slope fit: short enough that a real ramp dominates the fit
+# quickly (with a window of w, a ramp r seconds old reads as roughly
+# slope x r^2(3w-2r)/w^3 — a 180s window would halve the apparent slope for
+# 90s, sizing lag the SLO cannot afford), long enough that the fast-path
+# feed (every ~5s) still averages ~a dozen points.
+TREND_WINDOW_SECONDS = 60.0
+# Recent-suffix fit (see DemandTrend.fast_window_seconds): halves the time
+# for a fresh ramp to dominate the slope estimate.
+TREND_FAST_WINDOW_SECONDS = 30.0
+# Telemetry spin-up margin added to the arrival-rate window for the trend
+# age gate (see DemandTrend.min_age_seconds).
+TREND_MIN_AGE_MARGIN_SECONDS = 10.0
+
+
+def _trend_min_age_seconds() -> float:
+    """Age gate for new demand series: the arrival-rate query's rate()
+    window plus margin — while the backing counter series is younger than
+    its window, the measured rate climbs from 0 to the true value and the
+    fit would read the climb as a real ramp."""
+    from wva_tpu.collector.registration.slo import arrival_rate_window_seconds
+
+    return arrival_rate_window_seconds() + TREND_MIN_AGE_MARGIN_SECONDS
 
 
 def demand_estimate(arrival_rate_per_min: float, backlog: float) -> float:
@@ -105,8 +127,11 @@ class QueueingModelAnalyzer(Analyzer):
         self.profiles = profiles or PerfProfileStore()
         self.clock = clock or SYSTEM_CLOCK
         self._demand_trend = DemandTrend(
+            window_seconds=TREND_WINDOW_SECONDS,
             min_span_seconds=TREND_MIN_SPAN_SECONDS,
-            min_samples=TREND_MIN_SAMPLES)
+            min_samples=TREND_MIN_SAMPLES,
+            min_age_seconds=_trend_min_age_seconds(),
+            fast_window_seconds=TREND_FAST_WINDOW_SECONDS)
         # Last-synced config per namespace scope ("" = global); analyze()
         # resolves namespace-local > global, never another namespace's.
         self._slo_by_ns: dict[str, SLOConfigData | None] = {}
@@ -218,13 +243,59 @@ class QueueingModelAnalyzer(Analyzer):
                 utilization=0.0,
             ))
 
+        # Deficit-aware anticipation: while demand is ramping, requests
+        # arriving above the fleet's capacity accumulate as backlog until
+        # the ordered replicas become ready — so the scale-up must be sized
+        # not just for demand AT landing (the slope x horizon term above)
+        # but for DRAINING the backlog that will exist at landing. Project
+        # the deficit integral over the horizon against anticipated supply
+        # (pending replicas count: once they land mid-horizon the remaining
+        # real shortfall re-enters through the live backlog term in
+        # ``demand``, so crediting them avoids runaway re-ordering every
+        # tick while pods are provisioning).
+        if cfg.anticipation_horizon_seconds > 0 and slope > 0:
+            h = cfg.anticipation_horizon_seconds
+            # First instant (within the horizon) at which demand exceeds
+            # anticipated supply.
+            t0 = 0.0 if demand >= anticipated else \
+                min((anticipated - demand) / slope, h)
+            deficit_requests = ((demand - anticipated) * (h - t0)
+                                + slope * (h * h - t0 * t0) / 2.0)
+            if deficit_requests > 0:
+                scaling_demand += deficit_requests / BACKLOG_DRAIN_HORIZON_SECONDS
+
+        # Standing spare-capacity floor for latency-SLO models: with slices
+        # taking minutes to provision, the first minutes of any ramp are
+        # served by whatever capacity already exists — ``headroomReplicas``
+        # keeps that insurance provisioned (N+1 for TTFT SLOs). Counted as
+        # extra required capacity and shielded from scale-down.
+        headroom_capacity = 0.0
+        if cfg.headroom_replicas > 0:
+            # One headroom replica = one replica of the variant the
+            # optimizer would add first (best cost-efficiency), so the knob
+            # and the fill order agree on what "a spare replica" is.
+            pairs = [(cand.cost / cap, cap)
+                     for cand, cap in zip(candidates, per_replica) if cap > 0]
+            if pairs:
+                headroom_capacity = cfg.headroom_replicas * min(pairs)[1]
+
         result.total_supply = supply
         result.total_demand = demand
         result.utilization = demand / supply if supply > 0 else (1.0 if demand > 0 else 0.0)
         # Same anticipated-supply headroom algebra as V2
         # (saturation_v2/analyzer.go:104-138 via saturation_scaling.go:54-57).
-        result.required_capacity = max(scaling_demand / scale_up - anticipated, 0.0)
-        result.spare_capacity = max(supply - demand / scale_down, 0.0) if supply > 0 else 0.0
+        result.required_capacity = max(
+            scaling_demand / scale_up + headroom_capacity - anticipated, 0.0)
+        result.spare_capacity = max(
+            supply - demand / scale_down - headroom_capacity, 0.0) \
+            if supply > 0 else 0.0
+        # Never remove capacity while demand is growing: a scale-down
+        # decided mid-ramp cannot be corrected for a whole provisioning
+        # horizon (the replica is gone in seconds, its replacement takes
+        # minutes). Noise around zero slope just defers the scale-down to
+        # the next flat tick.
+        if cfg.anticipation_horizon_seconds > 0 and slope > 0:
+            result.spare_capacity = 0.0
         return result
 
     # -- internals --

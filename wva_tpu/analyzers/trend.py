@@ -43,26 +43,62 @@ class DemandTrend:
 
     def __init__(self, window_seconds: float = DEFAULT_WINDOW_SECONDS,
                  min_span_seconds: float = MIN_SPAN_SECONDS,
-                 min_samples: int = MIN_SAMPLES) -> None:
+                 min_samples: int = MIN_SAMPLES,
+                 min_age_seconds: float = 0.0,
+                 fast_window_seconds: float = 0.0) -> None:
         self.window_seconds = window_seconds
         self.min_span_seconds = min_span_seconds
         self.min_samples = max(min_samples, 2)
+        # Optional second fit over only the most recent samples. A fit over
+        # a window that mixes pre-ramp flat samples with a fresh ramp
+        # UNDERESTIMATES the current slope by r^2(3w-2r)/w^3 (r = ramp age,
+        # w = window) — for slow-provisioning capacity every second of
+        # underestimate is backlog at landing. The reported slope is
+        # max(full fit, recent fit); the recent fit needs its own minimum
+        # span/samples before it participates. 0 = off.
+        self.fast_window_seconds = fast_window_seconds
+        # Telemetry spin-up gate: a freshly created series climbs from 0 to
+        # the true rate as the backing rate() window fills — a pure
+        # measurement artifact that least-squares reads as a steep ramp
+        # (observed fabricating a 6-replica scale-up on flat load). Slope
+        # stays 0 until the series has existed at least this long, set by
+        # callers to their telemetry window + margin. Accepted tradeoff:
+        # series age is process-local, so a controller restart re-imposes
+        # one gate-length of anticipation blindness even though the backing
+        # counter is old and accurate — during which the demand/backlog
+        # terms still drive reactive scale-up, only the slope extrapolation
+        # is lost. The alternative (no gate) fabricates scale-ups and
+        # migration churn on EVERY new model, which is the common case.
+        self.min_age_seconds = min_age_seconds
         self._mu = threading.Lock()
         self._series: dict[str, deque[tuple[float, float]]] = {}
+        self._first_seen: dict[str, float] = {}
 
     def observe(self, key: str, now: float, demand: float) -> float:
         """Record a sample and return the current demand slope (units/s)."""
         with self._mu:
             series = self._series.setdefault(
                 key, deque(maxlen=MAX_SAMPLES_PER_KEY))
+            first_seen = self._first_seen.setdefault(key, now)
+            if now - first_seen < self.min_age_seconds:
+                # Spin-up samples are DROPPED, not merely ignored: leaving
+                # them in the window would poison the fit for a full
+                # window length after the gate lifts.
+                return 0.0
             series.append((now, demand))
             while series and now - series[0][0] > self.window_seconds:
                 series.popleft()
-            return self._slope(series)
+            slope = self._slope(series)
+            if self.fast_window_seconds > 0:
+                recent = [(t, d) for t, d in series
+                          if now - t <= self.fast_window_seconds]
+                slope = max(slope, self._slope(recent))
+            return slope
 
     def evict(self, key: str) -> None:
         with self._mu:
             self._series.pop(key, None)
+            self._first_seen.pop(key, None)
 
     def evict_missing(self, active_keys: set[str]) -> int:
         """Drop series for models no longer tracked (prevents unbounded key
@@ -71,6 +107,7 @@ class DemandTrend:
             stale = [k for k in self._series if k not in active_keys]
             for k in stale:
                 del self._series[k]
+                self._first_seen.pop(k, None)
             return len(stale)
 
     def _slope(self, series: deque[tuple[float, float]]) -> float:

@@ -45,6 +45,13 @@ def arrival_rate_window() -> str:
     return raw if re.fullmatch(r"\d+[smh]", raw) else DEFAULT_ARRIVAL_RATE_WINDOW
 
 
+def arrival_rate_window_seconds() -> float:
+    """The arrival-rate window as seconds (consumed by the demand-trend
+    spin-up gate)."""
+    raw = arrival_rate_window()
+    return float(raw[:-1]) * {"s": 1.0, "m": 60.0, "h": 3600.0}[raw[-1]]
+
+
 QUERY_AVG_TTFT = "model_avg_ttft"
 QUERY_AVG_ITL = "model_avg_itl"
 

@@ -29,10 +29,12 @@ def step_profile(steps: list[tuple[float, float]]) -> LoadProfile:
 
 
 def ramp(start_rate: float, end_rate: float, duration: float,
-         hold: float = float("inf")) -> LoadProfile:
-    """Linear ramp from start_rate to end_rate over ``duration``, then hold."""
+         hold: float = float("inf"), delay: float = 0.0) -> LoadProfile:
+    """Linear ramp from start_rate to end_rate over ``duration``, then hold.
+    ``delay`` holds the start_rate flat first (a warm pre-ramp phase)."""
 
     def profile(t: float) -> float:
+        t -= delay
         if t <= 0:
             return start_rate
         if t >= duration:

@@ -58,6 +58,16 @@ class SaturationScalingConfig:
     # anticipates — only growth is extrapolated.
     anticipation_horizon_seconds: float = 0.0
 
+    # Standing spare-capacity floor (whole replicas of the most
+    # cost-efficient variant) for latency-SLO models: the first minutes of
+    # any demand ramp are served by capacity that ALREADY exists (slices
+    # take minutes to provision), so a TTFT SLO needs provisioned insurance
+    # — N+1 keeps one replica's worth of burst headroom at all times.
+    # Counted as required capacity on scale-up and shielded from
+    # scale-down. 0 = off (the reference has no equivalent; its analyzers
+    # react to observed saturation only). SLO analyzer only.
+    headroom_replicas: int = 0
+
     # Scale-from-N fast path: the 100ms backlog monitor (the scale-from-zero
     # detection loop generalized to ACTIVE models) requests an immediate
     # engine tick when a model's scheduler flow-control backlog reaches
@@ -131,6 +141,10 @@ class SaturationScalingConfig:
                 raise ValueError(
                     "anticipationHorizonSeconds must be >= 0, got "
                     f"{self.anticipation_horizon_seconds}")
+            if self.headroom_replicas < 0:
+                raise ValueError(
+                    "headroomReplicas must be >= 0, got "
+                    f"{self.headroom_replicas}")
             if not 0 < self.scale_down_boundary <= 1:
                 raise ValueError(
                     f"scaleDownBoundary must be in (0, 1], got {self.scale_down_boundary:.2f}"
@@ -155,6 +169,7 @@ class SaturationScalingConfig:
         "scaleUpThreshold": "scale_up_threshold",
         "scaleDownBoundary": "scale_down_boundary",
         "anticipationHorizonSeconds": "anticipation_horizon_seconds",
+        "headroomReplicas": "headroom_replicas",
         "optimizerName": "optimizer_name",
         "fastPathEnabled": "fast_path_enabled",
         "fastPathQueueThreshold": "fast_path_queue_threshold",
@@ -178,6 +193,8 @@ class SaturationScalingConfig:
                         val = bool(val)
                 elif isinstance(cur, float):
                     val = float(val)
+                elif isinstance(cur, int):
+                    val = int(val)
                 setattr(cfg, attr, val)
         return cfg
 
