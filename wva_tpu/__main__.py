@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import logging
 import signal
+import ssl
 import sys
 import threading
 
@@ -93,7 +94,13 @@ def validate_prometheus(cfg, fatal: bool) -> None:
             log.error("PROMETHEUS_BASE_URL is required")
             raise SystemExit(1)
         return
-    api = HTTPPromAPI(url, bearer_token=cfg.prometheus_bearer_token())
+    try:
+        api = HTTPPromAPI.from_config(cfg.prometheus())
+    except (OSError, ssl.SSLError) as e:
+        # Unreadable/invalid CA or client-cert files are configuration
+        # errors: fail fast regardless of connectivity fatality.
+        log.error("Prometheus TLS configuration invalid: %s", e)
+        raise SystemExit(1) from None
     try:
         api.query("vector(1)")
         log.info("Prometheus API validated at %s", url)

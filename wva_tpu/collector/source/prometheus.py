@@ -56,27 +56,101 @@ class InMemoryPromAPI:
         return self.engine.query(promql)
 
 
+class _ServerNameContext(ssl.SSLContext):
+    """SSLContext that pins the SNI/verification hostname regardless of the
+    URL host — the in-cluster pattern where Prometheus is reached through a
+    Service IP while its certificate names the Service DNS (reference
+    ``internal/utils/tls.go:28`` ServerName)."""
+
+    server_name: str = ""
+
+    def wrap_socket(self, *args, **kwargs):  # noqa: D102
+        if self.server_name:
+            kwargs["server_hostname"] = self.server_name
+        return super().wrap_socket(*args, **kwargs)
+
+
 class HTTPPromAPI:
-    """PromAPI over a real Prometheus HTTP endpoint."""
+    """PromAPI over a real Prometheus HTTP endpoint.
+
+    TLS matches the reference's custom transport
+    (``internal/utils/prometheus_transport.go:18-79`` +
+    ``internal/utils/tls.go:21-70``): custom CA bundle, optional client
+    certificate (mTLS), SNI server-name override, TLS >= 1.2, and an
+    insecure-skip-verify escape hatch for dev clusters. ``token_path``
+    reads the bearer token from a file PER QUERY, so rotated
+    BoundServiceAccountToken projections are picked up without a restart
+    (the reference reads the file once at startup,
+    ``prometheus_transport.go:50-58``; documented divergence)."""
 
     def __init__(self, base_url: str, bearer_token: str = "",
                  timeout: float = DEFAULT_QUERY_TIMEOUT_SECONDS,
-                 insecure_skip_verify: bool = False) -> None:
+                 insecure_skip_verify: bool = False,
+                 ca_cert_path: str = "",
+                 client_cert_path: str = "", client_key_path: str = "",
+                 server_name: str = "", token_path: str = "") -> None:
         self.base_url = base_url.rstrip("/")
         self.bearer_token = bearer_token
+        self.token_path = token_path
         self.timeout = timeout
         self._ssl_ctx = None
         if insecure_skip_verify:
             self._ssl_ctx = ssl.create_default_context()
             self._ssl_ctx.check_hostname = False
             self._ssl_ctx.verify_mode = ssl.CERT_NONE
+        elif ca_cert_path or client_cert_path or server_name:
+            ctx = _ServerNameContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+            ctx.check_hostname = True
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            if ca_cert_path:
+                # Raises on unreadable/unparseable CA — fail fast at wiring
+                # time, not on the first query (tls.go:40-49).
+                ctx.load_verify_locations(cafile=ca_cert_path)
+            else:
+                ctx.load_default_certs()
+            if client_cert_path and client_key_path:
+                ctx.load_cert_chain(client_cert_path, client_key_path)
+            ctx.server_name = server_name
+            self._ssl_ctx = ctx
+
+    @classmethod
+    def from_config(cls, prom) -> "HTTPPromAPI":
+        """Build from a ``config.PrometheusConfig`` — the single place the
+        TLS/auth knob surface maps onto the transport, shared by runtime
+        wiring and the startup validation probe. Raises ``OSError`` /
+        ``ssl.SSLError`` on unreadable or unparseable certificate files
+        (configuration errors surface at wiring time, not first query)."""
+        return cls(
+            prom.base_url,
+            bearer_token=prom.bearer_token,
+            token_path=prom.token_path,
+            insecure_skip_verify=prom.insecure_skip_verify,
+            ca_cert_path=prom.ca_cert_path,
+            client_cert_path=prom.client_cert_path,
+            client_key_path=prom.client_key_path,
+            server_name=prom.server_name)
+
+    def _token(self) -> str:
+        if self.bearer_token:
+            return self.bearer_token
+        if self.token_path:
+            try:
+                with open(self.token_path) as f:
+                    return f.read().strip()
+            except OSError as e:
+                raise RuntimeError(
+                    f"failed to read bearer token from {self.token_path}: {e}"
+                ) from e
+        return ""
 
     def query(self, promql: str) -> list[SeriesPoint]:
         url = (f"{self.base_url}/api/v1/query?"
                + urllib.parse.urlencode({"query": promql}))
         req = urllib.request.Request(url)
-        if self.bearer_token:
-            req.add_header("Authorization", f"Bearer {self.bearer_token}")
+        token = self._token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         with urllib.request.urlopen(req, timeout=self.timeout,
                                     context=self._ssl_ctx) as resp:
             payload = json.loads(resp.read())

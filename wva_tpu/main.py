@@ -214,8 +214,7 @@ def build_manager(
     if tsdb is not None:
         prom_api = InMemoryPromAPI(tsdb)
     else:
-        prom_api = HTTPPromAPI(config.prometheus_base_url(),
-                               bearer_token=config.prometheus_bearer_token())
+        prom_api = HTTPPromAPI.from_config(config.prometheus())
     source_registry = SourceRegistry()
     prom_source = PrometheusSource(prom_api, config.prometheus_cache_config(),
                                    clock=clock)

@@ -19,7 +19,7 @@ Supported:
 - pipelines: ``| quote``, ``| default <literal>``;
 - control flow: ``{{- if <expr> }}`` / ``{{- else }}`` / ``{{- end }}``
   where <expr> is a value reference, ``not <ref>``, ``eq <ref> <literal>``,
-  or ``and <ref> <ref>``;
+  ``and <ref> <ref>``, or ``or <ref> <ref>``;
 - whitespace trimming markers ``{{-`` and ``-}}``.
 
 ``--set``-style overrides use helm's dotted-path syntax with the same
@@ -140,6 +140,8 @@ class Renderer:
             return left == right
         if expr.startswith("and "):
             return all(self._eval_cond(p) for p in expr[4:].split())
+        if expr.startswith("or "):
+            return any(self._eval_cond(p) for p in expr[3:].split())
         return bool(self._eval_value(expr))
 
     # --- template parsing ---
