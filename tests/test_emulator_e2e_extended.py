@@ -99,6 +99,40 @@ def test_slo_analyzer_drives_loop_end_to_end():
     assert sim.slo_attainment(2.0, since=h.clock.now() - 300) > 0.9
 
 
+def test_scale_up_decision_carries_full_step_chain():
+    """Round-3 verdict item 4 (reference saturation_analyzer.go:109-124):
+    every pipeline stage — analyzer, optimizer, enforcer, limiter — records
+    a DecisionStep, and the published decision carries the whole trail."""
+    from wva_tpu.engines import common
+
+    cfg = SaturationScalingConfig(analyzer_name="slo", enable_limiter=True)
+    h = EmulationHarness([spec_for("llama-v5e", LLAMA,
+                                   ramp(2.0, 50.0, 300.0, hold=1e9))],
+                         saturation_config=cfg, startup_seconds=60.0)
+    h.manager.config.update_slo_config(SLOConfigData(
+        service_classes=[ServiceClass(
+            name="premium", priority=1,
+            model_targets={LLAMA: TargetPerf(target_ttft_ms=2000.0)})],
+        profiles=[PerfProfile(
+            model_id=LLAMA, accelerator="v5e-8",
+            service_parms=ServiceParms(alpha=18.0, beta=0.00267,
+                                       gamma=0.00002),
+            max_batch_size=96, max_queue_size=384)]))
+    h.run(600)
+    assert h.replicas_of("llama-v5e") > 1, "scenario must force a scale-up"
+    decision = common.DecisionCache.get("llama-v5e", "inference")
+    assert decision is not None
+    stages = [s.name for s in decision.decision_steps]
+    assert stages[0].startswith("analyzer:slo")
+    assert stages[1].startswith("optimizer:")
+    assert "enforcer" in stages
+    assert any(s == "tpu-slice-limiter" for s in stages), stages
+    # Every step explains itself and snapshots the stage's target.
+    for s in decision.decision_steps:
+        assert s.reason, f"step {s.name} has no reason"
+        assert s.target_replicas >= 0
+
+
 def test_slo_analyzer_holds_steady_on_light_load():
     h = _slo_world(constant(2.0))
     h.run(900)

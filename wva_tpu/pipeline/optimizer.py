@@ -122,8 +122,8 @@ class CostAwareOptimizer(ScalingOptimizer):
             targets[vc.variant_name] = current - to_remove
             remaining -= to_remove * vc.per_replica_capacity
 
-    @staticmethod
     def _build_decisions(
+        self,
         req: ModelScalingRequest,
         states: dict[str, VariantReplicaState],
         capacities: dict[str, VariantCapacity],
@@ -145,7 +145,7 @@ class CostAwareOptimizer(ScalingOptimizer):
             else:
                 action = ACTION_NO_CHANGE
                 reason = "V2 steady state"
-            decisions.append(VariantDecision(
+            decision = VariantDecision(
                 variant_name=name,
                 model_id=req.model_id,
                 namespace=req.namespace,
@@ -156,5 +156,19 @@ class CostAwareOptimizer(ScalingOptimizer):
                 chips_per_replica=state.chips_per_replica,
                 action=action,
                 reason=reason,
-            ))
+            )
+            # Decision audit trail (reference saturation_analyzer.go:109-124
+            # DecisionSteps): one entry per pipeline stage. Decisions
+            # materialize here, so the analyzer's contribution is recorded
+            # first, from its result.
+            ts = req.result.analyzed_at or None
+            decision.add_step(
+                f"analyzer:{req.result.analyzer_name or 'saturation'}",
+                f"demand={req.result.total_demand:.2f} "
+                f"supply={req.result.total_supply:.2f} "
+                f"required={req.result.required_capacity:.2f} "
+                f"spare={req.result.spare_capacity:.2f}",
+                now=ts)
+            decision.add_step(f"optimizer:{self.name()}", reason, now=ts)
+            decisions.append(decision)
         return decisions
