@@ -62,6 +62,28 @@ def test_stability_under_constant_load_no_flapping():
     assert len(set(changes[-300:])) == 1
 
 
+def test_status_writes_scale_with_changes_not_ticks():
+    """Round-3 verdict item 7: at steady state the engine + reconciler must
+    not PUT the VA status every tick — writes are change-driven plus a
+    bounded lastRunTime heartbeat (engine STATUS_HEARTBEAT_SECONDS)."""
+    h, spec = make_harness(constant(2.0), engine_interval=5.0)
+    h.run(120)  # settle: scale decisions and condition flips happen here
+
+    writes = {"n": 0}
+    orig = h.cluster.update_status
+
+    def counting(obj):
+        writes["n"] += 1
+        return orig(obj)
+
+    h.cluster.update_status = counting
+    h.run(300)  # 60 engine ticks at steady state, no change in decisions
+    # Unfixed behavior: >= 2 writes per tick (engine PUT + reconciler PUT)
+    # = 120+. Fixed: only the heartbeat refresh (300s / 60s = 5) with a
+    # small margin for condition-message churn.
+    assert writes["n"] <= 12, f"status-write amplification: {writes['n']}"
+
+
 def test_scale_from_zero_on_queued_requests():
     h, spec = make_harness(SpikeProfile(idle_until=60.0, spike_rate=5.0,
                                         spike_duration=1e9), replicas=0)

@@ -29,7 +29,10 @@ from wva_tpu.indexers import Indexer
 from wva_tpu.k8s.client import ADDED, DELETED, KubeClient, NotFoundError
 from wva_tpu.k8s.objects import Deployment, LeaderWorkerSet, ServiceMonitor
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
-from wva_tpu.utils.variant import update_va_status_with_backoff
+from wva_tpu.utils.variant import (
+    update_va_status_with_backoff,
+    va_status_material,
+)
 from wva_tpu.controller.predicates import deployment_event_allowed, va_event_allowed
 
 log = logging.getLogger(__name__)
@@ -159,6 +162,7 @@ class VariantAutoscalingReconciler:
 
         self.datastore.namespace_track(VariantAutoscaling.kind, name, namespace)
         now = self.clock.now()
+        prev_material = va_status_material(va)
 
         # Resolve the scale target (any supported kind) -> TargetResolved.
         try:
@@ -176,7 +180,8 @@ class VariantAutoscalingReconciler:
                     va, REASON_TARGET_NOT_FOUND,
                     f"Scale target {va.spec.scale_target_ref.kind} "
                     f"{va.spec.scale_target_ref.name} not found")
-            update_va_status_with_backoff(self.client, va)
+            if va_status_material(va) != prev_material:
+                update_va_status_with_backoff(self.client, va)
             return
 
         # Consume the engine's decision.
@@ -190,4 +195,9 @@ class VariantAutoscalingReconciler:
                 "True" if decision.metrics_available else "False",
                 decision.metrics_reason or "MetricsMissing",
                 decision.metrics_message, now=now)
-        update_va_status_with_backoff(self.client, va)
+        # Write-on-change only: the engine triggers a reconcile every tick
+        # per VA, and a no-op PUT per trigger doubles the apiserver write
+        # load for nothing (the reference's event-driven reconciler has the
+        # same property implicitly — patches only carry diffs).
+        if va_status_material(va) != prev_material:
+            update_va_status_with_backoff(self.client, va)

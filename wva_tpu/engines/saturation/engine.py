@@ -71,6 +71,12 @@ from wva_tpu.utils.variant import namespaced_key
 log = logging.getLogger(__name__)
 
 DEFAULT_ENGINE_POLL_INTERVAL = 30.0  # reference engine.go:147
+# Max age of a VA's status ``lastRunTime`` before the engine refreshes it
+# even when nothing material changed. Status writes are otherwise
+# change-driven (the reference only patches via the event-driven
+# reconciler); without this bound a quiet model's lastRunTime would go
+# stale forever, hiding a live engine from operators.
+STATUS_HEARTBEAT_SECONDS = 60.0
 # Make-before-break migrations: max time a losing variant may hold its
 # replicas waiting for the winner's slices to become ready (TPU node-pool
 # provisioning upper bound) before forced gradual drain.
@@ -81,6 +87,9 @@ METRICS_REASON_UNAVAILABLE = REASON_METRICS_MISSING
 METRICS_MESSAGE_AVAILABLE = "Saturation metrics data is available for scaling decisions"
 METRICS_MESSAGE_UNAVAILABLE = (
     "No saturation metrics available - pods may not be ready or metrics not yet scraped")
+
+
+_status_material = variant_utils.va_status_material
 
 
 @dataclass
@@ -216,7 +225,9 @@ class SaturationEngine:
                 log.info("Scale-to-zero enforcement applied for %s", model_id)
 
             all_decisions.extend(self._targets_to_decisions(
-                targets, analysis, data.variant_states))
+                targets, analysis, data.variant_states,
+                enforcer_note=("scale-to-zero: no requests within retention"
+                               if scaled_to_zero else "")))
 
         self._apply_limiter(all_decisions)
         return all_decisions
@@ -320,6 +331,7 @@ class SaturationEngine:
                 req.model_id, req.namespace, targets, analyses, s2z_cfg)
             if scaled_to_zero:
                 log.info("Scale-to-zero enforcement applied (V2) for %s", req.model_id)
+            now = self.clock.now()
             for d in decisions:
                 if d.model_id != req.model_id or d.namespace != req.namespace:
                     continue
@@ -334,6 +346,14 @@ class SaturationEngine:
                         d.action = ACTION_NO_CHANGE
                     d.reason = (f"V2 {d.action} (optimizer: "
                                 f"{self.optimizer.name()}, enforced)")
+                    d.add_step("enforcer",
+                               ("scale-to-zero: no requests within retention"
+                                if scaled_to_zero
+                                else f"min-replica floor -> {target}"),
+                               was_constrained=True, now=now)
+                else:
+                    d.add_step("enforcer", "no policy change",
+                               now=now)
 
         self._apply_limiter(decisions)
         return decisions
@@ -598,6 +618,13 @@ class SaturationEngine:
                             else ACTION_SCALE_DOWN if target < vs.current_replicas
                             else ACTION_NO_CHANGE),
                     reason=reason)
+                d.add_step(
+                    f"analyzer:{req.result.analyzer_name or 'slo'}",
+                    f"demand={req.result.total_demand:.2f} "
+                    f"supply={req.result.total_supply:.2f} "
+                    f"required={req.result.required_capacity:.2f}",
+                    now=now)
+                d.add_step("optimizer:global", reason, now=now)
                 decisions.append(d)
         # Prune holds that did not re-assert themselves this solve (migration
         # completed, model unallocated/deleted, or retargeted under a new
@@ -761,8 +788,12 @@ class SaturationEngine:
         targets: dict[str, int],
         analysis: ModelSaturationAnalysis,
         variant_states: list[VariantReplicaState],
+        enforcer_note: str = "",
     ) -> list[VariantDecision]:
-        """Convert V1 targets to decisions (reference engine.go:586-659)."""
+        """Convert V1 targets to decisions (reference engine.go:586-659).
+        ``enforcer_note`` carries the already-applied enforcement outcome
+        into the decision audit trail (the V1 path enforces on raw targets
+        before decisions exist)."""
         analyses = {va.variant_name: va for va in analysis.variant_analyses}
         states = {s.variant_name: s for s in variant_states}
         decisions = []
@@ -795,6 +826,18 @@ class SaturationEngine:
                 decision.accelerator_name = va.accelerator_name
                 decision.cost = va.cost
                 decision.spare_capacity = va.avg_spare_kv_capacity
+            ts = analysis.analyzed_at or None
+            decision.add_step(
+                "analyzer:v1",
+                (analysis.scale_up_reason if analysis.should_scale_up
+                 else "no saturation trigger"
+                 f" (spare kv {analysis.avg_spare_kv_capacity:.2f},"
+                 f" spare queue {analysis.avg_spare_queue_length:.1f})"),
+                now=ts)
+            decision.add_step("optimizer:percentage",
+                              f"saturation-only mode: {action}", now=ts)
+            decision.add_step("enforcer", enforcer_note or "no policy change",
+                              was_constrained=bool(enforcer_note), now=ts)
             decisions.append(decision)
         return decisions
 
@@ -846,6 +889,9 @@ class SaturationEngine:
                 accelerator = update_va.status.desired_optimized_alloc.accelerator
                 reason = "No scaling decision (optimization loop)"
 
+            prev_material = _status_material(update_va)
+            prev_run_time = update_va.status.desired_optimized_alloc.last_run_time
+
             if not accelerator:
                 accelerator = variant_utils.get_accelerator_type(update_va)
             if not accelerator:
@@ -889,11 +935,18 @@ class SaturationEngine:
             # reference, whose engine-side condition writes are lost because
             # only the reconciler patches status; here the status write is a
             # cheap full-subresource put and the reconciler remains the
-            # owner of MetricsAvailable/TargetResolved.
-            try:
-                variant_utils.update_va_status_with_backoff(self.client, update_va)
-            except NotFoundError:
-                continue
+            # owner of MetricsAvailable/TargetResolved. The put is SKIPPED
+            # when nothing material changed (only lastRunTime would move):
+            # at a 5s tick with N VAs, unconditional writes are 2N API
+            # requests per tick of no-op churn. A heartbeat bound keeps
+            # lastRunTime from going permanently stale on quiet models.
+            if (_status_material(update_va) != prev_material
+                    or now - prev_run_time >= STATUS_HEARTBEAT_SECONDS):
+                try:
+                    variant_utils.update_va_status_with_backoff(
+                        self.client, update_va)
+                except NotFoundError:
+                    continue
 
             metrics_available = decision is not None
             common.DecisionCache.set(va.metadata.name, va.metadata.namespace,
@@ -903,6 +956,13 @@ class SaturationEngine:
                                          model_id=update_va.spec.model_id,
                                          accelerator_name=accelerator,
                                          target_replicas=target_replicas,
+                                         # Full pipeline audit trail rides
+                                         # along for "why did it scale?"
+                                         # consumers (reference
+                                         # DecisionSteps).
+                                         decision_steps=list(
+                                             decision.decision_steps)
+                                         if decision else [],
                                          last_run_time=now,
                                          metrics_available=metrics_available,
                                          metrics_reason=(METRICS_REASON_AVAILABLE

@@ -38,6 +38,23 @@ def update_va_status_with_backoff(client: KubeClient, va: VariantAutoscaling) ->
     )
 
 
+def va_status_material(va: VariantAutoscaling) -> tuple:
+    """The status fields that justify an API write — everything except
+    timestamps (``lastRunTime`` moves every engine tick and
+    ``lastTransitionTime`` only moves on flips already captured by the
+    condition fields here). Writers snapshot this before mutating the
+    status and skip the PUT when it is unchanged, so steady-state ticks
+    cost zero write requests per VA instead of two."""
+    alloc = va.status.desired_optimized_alloc
+    return (
+        alloc.accelerator,
+        alloc.num_replicas,
+        va.status.actuation.applied,
+        tuple((c.type, c.status, c.reason, c.message, c.observed_generation)
+              for c in va.status.conditions),
+    )
+
+
 def ready_variant_autoscalings(
     client: KubeClient, namespace: str | None = None,
 ) -> list[VariantAutoscaling]:
