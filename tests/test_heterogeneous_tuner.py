@@ -1,0 +1,340 @@
+"""Per-accelerator tuner telemetry (round-3 VERDICT item 6).
+
+The BASELINE config-4 scenario: one model (Mixtral-8x7B) served by BOTH a
+v5e and a v5p variant. Observed TTFT/ITL averaged model-wide is a blend
+across the two accelerator types, so the reference-shaped tuner had to skip
+heterogeneous fleets entirely. With per-pod latency-rate queries
+(``collector/registration/slo.py``) joined pod -> accelerator, each EKF fits
+its own accelerator's latencies — these tests prove both profiles converge
+to their OWN ground truth, not the mixture.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from wva_tpu.analyzers.queueing import (
+    PerfProfile,
+    PerfProfileStore,
+    QueueAnalyzer,
+    QueueConfig,
+    RequestSize,
+    ServiceParms,
+    TunerController,
+)
+from wva_tpu.collector.registration.slo import (
+    collect_accelerator_telemetry,
+    collect_optimizer_metrics,
+    register_slo_queries,
+)
+from wva_tpu.collector.source import TimeSeriesDB
+from wva_tpu.collector.source.prometheus import InMemoryPromAPI, PrometheusSource
+from wva_tpu.collector.source.registry import (
+    PROMETHEUS_SOURCE_NAME,
+    SourceRegistry,
+)
+from wva_tpu.engines.saturation.engine import SaturationEngine, _ModelData
+from wva_tpu.interfaces.decision import VariantReplicaState
+from wva_tpu.interfaces.replica_metrics import ReplicaMetrics
+from wva_tpu.utils.clock import FakeClock
+
+MODEL = "mistralai/Mixtral-8x7B-Instruct-v0.1"
+NS = "inference"
+REQ = RequestSize(avg_input_tokens=512, avg_output_tokens=256)
+
+# Distinct ground truths per accelerator type: v5p is roughly 2.5x faster
+# per iteration than v5e for this model. Same misfit prior for both profiles
+# so that convergence to different fixed points can only come from the
+# per-accelerator telemetry split.
+TRUE_V5E = ServiceParms(alpha=14.0, beta=0.054, gamma=0.002)
+TRUE_V5P = ServiceParms(alpha=5.0, beta=0.018, gamma=0.0007)
+PRIOR = ServiceParms(alpha=9.0, beta=0.035, gamma=0.0015)
+
+QCFG_BATCH = 64
+QCFG_QUEUE = 256
+
+
+def _make_source(clock):
+    db = TimeSeriesDB(clock=clock)
+    source = PrometheusSource(InMemoryPromAPI(db), clock=clock)
+    registry = SourceRegistry()
+    registry.register(PROMETHEUS_SOURCE_NAME, source)
+    register_slo_queries(registry)
+    return db, source
+
+
+class _PodCounters:
+    """Cumulative vLLM counters for one pod, written into the TSDB the same
+    way the serving sim does (per-pod labels on histogram sum/count)."""
+
+    def __init__(self, db, pod: str):
+        self.db = db
+        self.labels = {"pod": pod, "namespace": NS, "model_name": MODEL}
+        self.success = 0.0
+        self.ttft_sum = 0.0
+        self.ttft_count = 0.0
+        self.itl_sum = 0.0
+        self.itl_count = 0.0
+
+    def step(self, dt: float, rate_per_s: float, ttft_s: float, itl_s: float,
+             now: float) -> None:
+        reqs = rate_per_s * dt
+        self.success += reqs
+        self.ttft_sum += reqs * ttft_s
+        self.ttft_count += reqs
+        tokens = reqs * REQ.avg_output_tokens
+        self.itl_sum += tokens * itl_s
+        self.itl_count += tokens
+        add = self.db.add_sample
+        add("vllm:request_success_total", self.labels, self.success, now)
+        add("vllm:time_to_first_token_seconds_sum", self.labels,
+            self.ttft_sum, now)
+        add("vllm:time_to_first_token_seconds_count", self.labels,
+            self.ttft_count, now)
+        add("vllm:time_per_output_token_seconds_sum", self.labels,
+            self.itl_sum, now)
+        add("vllm:time_per_output_token_seconds_count", self.labels,
+            self.itl_count, now)
+
+
+class TestCollectAcceleratorTelemetry:
+    def test_groups_per_pod_rates_by_accelerator(self):
+        clock = FakeClock(start=1000.0)
+        db, source = _make_source(clock)
+        pods = {
+            "mix-v5e-0": _PodCounters(db, "mix-v5e-0"),
+            "mix-v5e-1": _PodCounters(db, "mix-v5e-1"),
+            "mix-v5p-0": _PodCounters(db, "mix-v5p-0"),
+        }
+        # 10 minutes of steady traffic: v5e pods 2 req/s at TTFT 120 ms /
+        # ITL 20 ms; the v5p pod 3 req/s at TTFT 450 ms / ITL 8 ms. 30s
+        # sampling keeps >= 2 samples inside the 1m arrival-rate window.
+        for _ in range(20):
+            now = clock.now()
+            pods["mix-v5e-0"].step(30.0, 2.0, 0.120, 0.020, now)
+            pods["mix-v5e-1"].step(30.0, 2.0, 0.120, 0.020, now)
+            pods["mix-v5p-0"].step(30.0, 3.0, 0.450, 0.008, now)
+            clock.advance(30.0)
+        telemetry = collect_accelerator_telemetry(
+            source, MODEL, NS,
+            {"mix-v5e-0": "v5e-8", "mix-v5e-1": "v5e-8",
+             "mix-v5p-0": "v5p-8"})
+        assert set(telemetry) == {"v5e-8", "v5p-8"}
+        v5e, v5p = telemetry["v5e-8"], telemetry["v5p-8"]
+        assert v5e.ttft_seconds == pytest.approx(0.120, rel=0.01)
+        assert v5e.itl_seconds == pytest.approx(0.020, rel=0.01)
+        assert v5e.pods == 2
+        # Mean per-pod rate = per-replica arrival, req/min.
+        assert v5e.arrival_rate_per_replica == pytest.approx(120.0, rel=0.05)
+        assert v5p.ttft_seconds == pytest.approx(0.450, rel=0.01)
+        assert v5p.itl_seconds == pytest.approx(0.008, rel=0.01)
+        assert v5p.arrival_rate_per_replica == pytest.approx(180.0, rel=0.05)
+
+    def test_pods_without_latency_samples_are_omitted(self):
+        clock = FakeClock(start=1000.0)
+        db, source = _make_source(clock)
+        pod = _PodCounters(db, "mix-v5e-0")
+        for _ in range(12):
+            pod.step(30.0, 2.0, 0.1, 0.02, clock.now())
+            clock.advance(30.0)
+        telemetry = collect_accelerator_telemetry(
+            source, MODEL, NS,
+            {"mix-v5e-0": "v5e-8", "mix-v5p-0": "v5p-8"})
+        assert "v5e-8" in telemetry
+        assert "v5p-8" not in telemetry  # no samples -> caller decides
+
+    def test_just_started_pod_does_not_bias_arrival_low(self):
+        """A pod present in the replica metrics but with no Prometheus
+        samples yet (just started) must not drag the per-replica arrival
+        mean down — lambda is averaged over pods that produced samples."""
+        clock = FakeClock(start=1000.0)
+        db, source = _make_source(clock)
+        pod = _PodCounters(db, "mix-v5e-0")
+        for _ in range(12):
+            pod.step(30.0, 2.0, 0.1, 0.02, clock.now())
+            clock.advance(30.0)
+        telemetry = collect_accelerator_telemetry(
+            source, MODEL, NS,
+            {"mix-v5e-0": "v5e-8", "mix-v5e-new": "v5e-8"})
+        v5e = telemetry["v5e-8"]
+        assert v5e.pods == 2
+        # 2 req/s from the serving pod, NOT halved by the sampleless pod.
+        assert v5e.arrival_rate_per_replica == pytest.approx(120.0, rel=0.05)
+
+    def test_empty_pod_map_is_cheap_noop(self):
+        clock = FakeClock(start=1000.0)
+        _, source = _make_source(clock)
+        assert collect_accelerator_telemetry(source, MODEL, NS, {}) == {}
+
+
+class _EngineStub:
+    """Just enough of SaturationEngine to run the real ``_feed_slo_tuner``."""
+
+    _feed_slo_tuner = SaturationEngine._feed_slo_tuner
+
+    def __init__(self, source, profiles: PerfProfileStore):
+        self.collector = SimpleNamespace(source=source)
+        self.slo_analyzer = SimpleNamespace(profiles=profiles)
+        self.slo_tuner = TunerController(profiles)
+
+
+def _profiles() -> PerfProfileStore:
+    store = PerfProfileStore()
+    store.sync_namespace("", [
+        PerfProfile(model_id=MODEL, accelerator="v5e-8", service_parms=PRIOR,
+                    max_batch_size=QCFG_BATCH, max_queue_size=QCFG_QUEUE),
+        PerfProfile(model_id=MODEL, accelerator="v5p-8", service_parms=PRIOR,
+                    max_batch_size=QCFG_BATCH, max_queue_size=QCFG_QUEUE),
+    ])
+    return store
+
+
+def _model_data() -> _ModelData:
+    return _ModelData(
+        model_id=MODEL, namespace=NS,
+        replica_metrics=[
+            ReplicaMetrics(pod_name="mix-v5e-0", accelerator_name="v5e-8",
+                           avg_input_tokens=REQ.avg_input_tokens,
+                           avg_output_tokens=REQ.avg_output_tokens),
+            ReplicaMetrics(pod_name="mix-v5e-1", accelerator_name="v5e-8",
+                           avg_input_tokens=REQ.avg_input_tokens,
+                           avg_output_tokens=REQ.avg_output_tokens),
+            ReplicaMetrics(pod_name="mix-v5p-0", accelerator_name="v5p-8",
+                           avg_input_tokens=REQ.avg_input_tokens,
+                           avg_output_tokens=REQ.avg_output_tokens),
+        ],
+        variant_states=[
+            VariantReplicaState(variant_name="mix-v5e",
+                                accelerator_name="v5e-8", current_replicas=2),
+            VariantReplicaState(variant_name="mix-v5p",
+                                accelerator_name="v5p-8", current_replicas=1),
+        ])
+
+
+class TestHeterogeneousFleetTuning:
+    def test_both_profiles_converge_to_own_truth(self):
+        """v5e + v5p serving the same model: after a run of per-pod
+        telemetry, BOTH profiles' alpha/beta land near their own ground
+        truth (the skip the round-3 verdict flagged is gone)."""
+        clock = FakeClock(start=5000.0)
+        db, source = _make_source(clock)
+        store = _profiles()
+        engine = _EngineStub(source, store)
+        data = _model_data()
+
+        qa_e = QueueAnalyzer(QueueConfig(max_batch_size=QCFG_BATCH,
+                                         max_queue_size=QCFG_QUEUE,
+                                         service_parms=TRUE_V5E), REQ)
+        qa_p = QueueAnalyzer(QueueConfig(max_batch_size=QCFG_BATCH,
+                                         max_queue_size=QCFG_QUEUE,
+                                         service_parms=TRUE_V5P), REQ)
+        pods = {name: _PodCounters(db, name)
+                for name in ("mix-v5e-0", "mix-v5e-1", "mix-v5p-0")}
+        rng = np.random.default_rng(42)
+
+        # Piecewise-constant load segments (8 min each, > the 5m query
+        # window) so the windowed rates settle to the true operating point;
+        # observations are fed only once each segment's window is saturated.
+        # 30s sampling keeps >= 2 samples inside the 1m arrival-rate window.
+        dt, seg_steps, segments = 30.0, 16, 8
+        for _ in range(segments):
+            rate_e = float(rng.uniform(0.5, qa_e.max_rate_per_s * 0.85))
+            rate_p = float(rng.uniform(0.5, qa_p.max_rate_per_s * 0.85))
+            m_e, m_p = qa_e.analyze(rate_e), qa_p.analyze(rate_p)
+            for step in range(seg_steps):
+                now = clock.now()
+                noise = 1.0 + rng.normal(0, 0.01)
+                for pod in ("mix-v5e-0", "mix-v5e-1"):
+                    pods[pod].step(dt, rate_e, m_e.avg_ttft_ms / 1000 * noise,
+                                   m_e.avg_token_time_ms / 1000 * noise, now)
+                pods["mix-v5p-0"].step(
+                    dt, rate_p, m_p.avg_ttft_ms / 1000 * noise,
+                    m_p.avg_token_time_ms / 1000 * noise, now)
+                clock.advance(dt)
+                if step * dt >= 300.0:
+                    metrics = collect_optimizer_metrics(source, MODEL, NS)
+                    assert metrics is not None
+                    engine._feed_slo_tuner(MODEL, NS, data, metrics)
+
+        prof_e = store.get(MODEL, "v5e-8", namespace=NS)
+        prof_p = store.get(MODEL, "v5p-8", namespace=NS)
+        assert prof_e.source == "tuner"
+        assert prof_p.source == "tuner"
+        assert prof_e.service_parms.alpha == pytest.approx(TRUE_V5E.alpha,
+                                                           rel=0.2)
+        assert prof_e.service_parms.beta == pytest.approx(TRUE_V5E.beta,
+                                                          rel=0.25)
+        assert prof_p.service_parms.alpha == pytest.approx(TRUE_V5P.alpha,
+                                                           rel=0.2)
+        assert prof_p.service_parms.beta == pytest.approx(TRUE_V5P.beta,
+                                                          rel=0.25)
+        # The regression the per-pod split exists to prevent: neither
+        # profile is dragged to the other type's operating point.
+        assert abs(prof_e.service_parms.alpha - TRUE_V5E.alpha) < \
+            abs(prof_e.service_parms.alpha - TRUE_V5P.alpha)
+        assert abs(prof_p.service_parms.alpha - TRUE_V5P.alpha) < \
+            abs(prof_p.service_parms.alpha - TRUE_V5E.alpha)
+
+    def test_heterogeneous_without_pod_latency_skips_tuning(self):
+        """Fallback safety: per-pod histograms absent (only success
+        counters), fleet heterogeneous -> no tuner step, profiles untouched
+        (model-wide latency would be a corrupting blend)."""
+        clock = FakeClock(start=5000.0)
+        db, source = _make_source(clock)
+        store = _profiles()
+        engine = _EngineStub(source, store)
+        data = _model_data()
+        labels_e = {"pod": "mix-v5e-0", "namespace": NS, "model_name": MODEL}
+        total = 0.0
+        for _ in range(12):
+            total += 60.0
+            db.add_sample("vllm:request_success_total", labels_e, total,
+                          clock.now())
+            clock.advance(30.0)
+        metrics = collect_optimizer_metrics(source, MODEL, NS)
+        assert metrics is not None
+        engine._feed_slo_tuner(MODEL, NS, data, metrics)
+        assert store.get(MODEL, "v5e-8", namespace=NS).source == "config"
+        assert store.get(MODEL, "v5p-8", namespace=NS).source == "config"
+
+    def test_homogeneous_fleet_falls_back_to_model_wide(self):
+        """A single-type fleet whose Prometheus aggregated away the ``pod``
+        label (recording rules) still tunes from the model-wide means
+        (previous behavior preserved)."""
+        clock = FakeClock(start=5000.0)
+        db, source = _make_source(clock)
+        store = _profiles()
+        engine = _EngineStub(source, store)
+        data = _ModelData(
+            model_id=MODEL, namespace=NS,
+            replica_metrics=[
+                ReplicaMetrics(pod_name="mix-v5e-0", accelerator_name="v5e-8",
+                               avg_input_tokens=REQ.avg_input_tokens,
+                               avg_output_tokens=REQ.avg_output_tokens)],
+            variant_states=[
+                VariantReplicaState(variant_name="mix-v5e",
+                                    accelerator_name="v5e-8",
+                                    current_replicas=1)])
+        qa_e = QueueAnalyzer(QueueConfig(max_batch_size=QCFG_BATCH,
+                                         max_queue_size=QCFG_QUEUE,
+                                         service_parms=TRUE_V5E), REQ)
+        # No pod label on any series: per-pod joins find nothing, the
+        # model-level means still resolve.
+        pod = _PodCounters(db, "")
+        del pod.labels["pod"]
+        rng = np.random.default_rng(7)
+        for _ in range(6):
+            rate = float(rng.uniform(0.5, qa_e.max_rate_per_s * 0.85))
+            m = qa_e.analyze(rate)
+            for step in range(16):
+                pod.step(30.0, rate, m.avg_ttft_ms / 1000,
+                         m.avg_token_time_ms / 1000, clock.now())
+                clock.advance(30.0)
+                if step * 30.0 >= 300.0:
+                    metrics = collect_optimizer_metrics(source, MODEL, NS)
+                    engine._feed_slo_tuner(MODEL, NS, data, metrics)
+        prof = store.get(MODEL, "v5e-8", namespace=NS)
+        assert prof.source == "tuner"
+        assert prof.service_parms.alpha == pytest.approx(TRUE_V5E.alpha,
+                                                         rel=0.25)

@@ -79,6 +79,33 @@ class TestKalmanTuner:
         with pytest.raises(ValueError):
             tuner.run(TunerEnvironment())  # all zeros
 
+    def test_repeated_operating_point_converges_without_divergence(self):
+        """The NORMAL engine regime: 30s ticks under slowly-varying load
+        repeat near-identical observations. Before the trust-region +
+        bounded-reacquisition fix, persistent NIS rejection inflated P
+        unboundedly and the resulting near-Newton jump slammed alpha into
+        min_state (1e-4), after which the filter rejected forever."""
+        qa = QueueAnalyzer(QCFG, REQ)
+        tuner = KalmanTuner(ServiceParms(alpha=12.0, beta=0.05, gamma=0.002))
+        rng = np.random.default_rng(11)
+        res = None
+        for _ in range(12):  # 12 operating points...
+            rate = float(rng.uniform(0.5, qa.max_rate_per_s * 0.85))
+            m = qa.analyze(rate)
+            for _ in range(6):  # ...each observed 6 consecutive ticks
+                env = TunerEnvironment(
+                    lambda_per_min=rate * 60,
+                    avg_input_tokens=REQ.avg_input_tokens,
+                    avg_output_tokens=REQ.avg_output_tokens,
+                    max_batch_size=QCFG.max_batch_size,
+                    avg_ttft_ms=m.avg_ttft_ms * (1 + rng.normal(0, 0.005)),
+                    avg_itl_ms=m.avg_token_time_ms * (1 + rng.normal(0, 0.005)))
+                res = tuner.run(env)
+        assert res.service_parms.alpha == pytest.approx(TRUE.alpha, rel=0.25)
+        assert res.service_parms.beta == pytest.approx(TRUE.beta, rel=0.25)
+        # The old failure mode: alpha pinned at the state floor.
+        assert res.service_parms.alpha > 1.0
+
 
 class TestTunerController:
     def make_store(self):

@@ -93,6 +93,21 @@ class TunerConfig:
     # profile there pins the filter permanently).
     max_consecutive_rejections: int = 3
     covariance_inflation: float = 10.0
+    # Trust region: bound each accepted step to this relative change per
+    # component (with ``min_step`` as the absolute floor so components near
+    # zero can still move). The EKF linearization is only local; an inflated
+    # covariance otherwise produces a near-Newton jump that can overshoot
+    # past the valid neighborhood, slam into ``min_state``, and leave the
+    # filter permanently NIS-rejecting — observed under repeated
+    # observations at one operating point, which is the NORMAL engine
+    # regime (30s ticks under slowly-varying load).
+    max_step_frac: float = 0.3
+    min_step: tuple[float, float, float] = (0.5, 1e-3, 1e-5)
+    # Hard re-acquisition: after this many consecutive rejections (i.e.
+    # repeated inflation didn't get NIS under the bound — the model, not the
+    # telemetry, is wrong) accept one trust-region-bounded step anyway and
+    # re-seed the covariance from the new state.
+    reacquire_after: int = 9
     # Queue bound used by the observation model, as a multiple of max batch
     # (reference config.MaxQueueToBatchRatio).
     max_queue_to_batch_ratio: int = 4
@@ -143,10 +158,9 @@ class KalmanTuner:
             raise ValueError(f"invalid initial service parms: {init}")
         self.config = config or TunerConfig()
         self.x = np.array([init.alpha, init.beta, init.gamma], dtype=np.float64)
-        pc = np.asarray(self.config.percent_change, dtype=np.float64)
         # P0 and Q from expected relative change (reference
         # configurator.go:82-91 GetStateCov).
-        self.P = np.diag((pc * self.x) ** 2)
+        self._reseed_covariance()
         self.steps = 0
         self.rejected = 0
         self._consecutive_rejections = 0
@@ -201,8 +215,7 @@ class KalmanTuner:
         nis = float(y @ s_inv @ y)
 
         gain = p_pred @ H.T @ s_inv
-        x_new = self.x + gain @ y
-        x_new = np.clip(x_new, cfg.min_state, cfg.max_state)
+        x_new = self._bounded_step(gain @ y)
         eye = np.eye(3)
         # Joseph form keeps P symmetric positive semi-definite.
         p_new = (eye - gain @ H) @ p_pred @ (eye - gain @ H).T + gain @ r @ gain.T
@@ -213,11 +226,26 @@ class KalmanTuner:
             self.x, self.P = x_prev, p_prev
             self.rejected += 1
             self._consecutive_rejections += 1
-            if self._consecutive_rejections >= cfg.max_consecutive_rejections:
+            if (self._consecutive_rejections >= cfg.reacquire_after
+                    and np.all(np.isfinite(x_new))):
+                # Inflation alone didn't bring NIS under the bound: the
+                # state, not the telemetry, is wrong (e.g. a badly misfit
+                # static profile under steady load, where every tick repeats
+                # the same operating point). Accept one bounded step toward
+                # the observation and re-seed P from the new state — the
+                # filter walks to the telemetry in <= 1/max_step_frac steps
+                # instead of rejecting forever.
+                self.x = x_new
+                self._reseed_covariance()
+                self._consecutive_rejections = 0
+                return TunedResults(service_parms=self._parms(),
+                                    innovation=tuple(y), nis=nis,
+                                    validation_failed=False)
+            if self._consecutive_rejections % max(
+                    cfg.max_consecutive_rejections, 1) == 0:
                 # Persistent mismatch: the prior, not the telemetry, is wrong.
                 # Inflate P so subsequent steps can move the state.
                 self.P = self.P * cfg.covariance_inflation
-                self._consecutive_rejections = 0
             return TunedResults(service_parms=self._parms(), innovation=tuple(y),
                                 nis=nis, validation_failed=True)
 
@@ -225,6 +253,25 @@ class KalmanTuner:
         self.x, self.P = x_new, p_new
         return TunedResults(service_parms=self._parms(), innovation=tuple(y),
                             nis=nis, validation_failed=False)
+
+    def _bounded_step(self, delta: np.ndarray) -> np.ndarray:
+        """Apply ``delta`` to the state under the trust region: each
+        component moves at most max_step_frac relative (min_step absolute
+        floor), and the result stays inside [min_state, max_state]."""
+        cfg = self.config
+        bound = np.maximum(cfg.max_step_frac * np.abs(self.x),
+                           np.asarray(cfg.min_step, dtype=np.float64))
+        return np.clip(self.x + np.clip(delta, -bound, bound),
+                       cfg.min_state, cfg.max_state)
+
+    def _reseed_covariance(self) -> None:
+        """P0-style covariance around the current state (used after hard
+        re-acquisition so P reflects the moved state, not the inflated
+        history)."""
+        pc = np.asarray(self.config.percent_change, dtype=np.float64)
+        self.P = np.diag(np.maximum(
+            (pc * self.x) ** 2,
+            (pc * np.asarray(self.config.min_step, dtype=np.float64)) ** 2))
 
     def _parms(self) -> ServiceParms:
         return ServiceParms(alpha=float(self.x[STATE_ALPHA]),

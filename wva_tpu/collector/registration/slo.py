@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import math
+from dataclasses import dataclass
 
 from wva_tpu.collector.source.query_template import QueryTemplate
 from wva_tpu.collector.source.registry import PROMETHEUS_SOURCE_NAME, SourceRegistry
@@ -55,6 +56,20 @@ def arrival_rate_window_seconds() -> float:
 QUERY_AVG_TTFT = "model_avg_ttft"
 QUERY_AVG_ITL = "model_avg_itl"
 
+# Per-pod latency-rate companions to the model-level means. Observed TTFT/ITL
+# averaged model-wide is a blend across accelerator types, useless for tuning
+# per-accelerator performance profiles; grouping the histogram sum/count
+# rates ``by (pod)`` lets the engine join each pod's latency contribution to
+# its accelerator (pod -> VA -> accelerator, the same join the replica
+# collector performs) and rebuild an exact per-accelerator mean:
+# sum(sum-rates of the type's pods) / sum(count-rates of the type's pods).
+QUERY_POD_TTFT_SUM_RATE = "model_pod_ttft_sum_rate"
+QUERY_POD_TTFT_COUNT_RATE = "model_pod_ttft_count_rate"
+QUERY_POD_ITL_SUM_RATE = "model_pod_itl_sum_rate"
+QUERY_POD_ITL_COUNT_RATE = "model_pod_itl_count_rate"
+QUERY_POD_ARRIVAL_RATE = "model_pod_arrival_rate"
+QUERY_POD_ARRIVAL_RATE_FAST = "model_pod_arrival_rate_fast"
+
 # Short-window companion to the arrival-rate query. During a ramp the
 # long-window rate lags the true rate by ~half a window; the fast window
 # tracks it closely, so the collector reports max(long, fast). With a scrape
@@ -97,10 +112,8 @@ def register_slo_queries(source_registry: SourceRegistry) -> None:
     ql.register_if_absent(QueryTemplate(
         name=QUERY_AVG_TTFT,
         template=(
-            f"sum(rate(vllm:time_to_first_token_seconds_sum{_NS_MODEL}[5m])"
-            f" or rate(jetstream_time_to_first_token_sum{_NS_MODEL}[5m]))"
-            f" / sum(rate(vllm:time_to_first_token_seconds_count{_NS_MODEL}[5m])"
-            f" or rate(jetstream_time_to_first_token_count{_NS_MODEL}[5m]))"
+            f"sum({_latency_rates(_TTFT_SUM_METRICS)})"
+            f" / sum({_latency_rates(_TTFT_COUNT_METRICS)})"
         ),
         params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
         description="Observed mean TTFT (s) over 5m",
@@ -108,14 +121,72 @@ def register_slo_queries(source_registry: SourceRegistry) -> None:
     ql.register_if_absent(QueryTemplate(
         name=QUERY_AVG_ITL,
         template=(
-            f"sum(rate(vllm:time_per_output_token_seconds_sum{_NS_MODEL}[5m])"
-            f" or rate(jetstream_time_per_output_token_sum{_NS_MODEL}[5m]))"
-            f" / sum(rate(vllm:time_per_output_token_seconds_count{_NS_MODEL}[5m])"
-            f" or rate(jetstream_time_per_output_token_count{_NS_MODEL}[5m]))"
+            f"sum({_latency_rates(_ITL_SUM_METRICS)})"
+            f" / sum({_latency_rates(_ITL_COUNT_METRICS)})"
         ),
         params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
         description="Observed mean inter-token latency (s) over 5m",
     ))
+    _register_pod_latency_queries(ql)
+
+
+# Histogram series names by engine family. JetStream's exporter names its
+# latency histograms without a unit suffix (jetstream_time_to_first_token ->
+# _sum/_count); some deployments re-export them with the Prometheus-idiomatic
+# ``_seconds`` infix, so both spellings are accepted via ``or``.
+_TTFT_SUM_METRICS = ("vllm:time_to_first_token_seconds_sum",
+                     "jetstream_time_to_first_token_sum",
+                     "jetstream_time_to_first_token_seconds_sum")
+_TTFT_COUNT_METRICS = ("vllm:time_to_first_token_seconds_count",
+                       "jetstream_time_to_first_token_count",
+                       "jetstream_time_to_first_token_seconds_count")
+_ITL_SUM_METRICS = ("vllm:time_per_output_token_seconds_sum",
+                    "jetstream_time_per_output_token_sum",
+                    "jetstream_time_per_output_token_seconds_sum")
+_ITL_COUNT_METRICS = ("vllm:time_per_output_token_seconds_count",
+                      "jetstream_time_per_output_token_count",
+                      "jetstream_time_per_output_token_seconds_count")
+
+
+def _latency_rates(metrics: tuple[str, ...], window: str = "5m") -> str:
+    return " or ".join(f"rate({m}{_NS_MODEL}[{window}])" for m in metrics)
+
+
+def _register_pod_latency_queries(ql) -> None:
+    pod_queries = {
+        QUERY_POD_TTFT_SUM_RATE: (
+            _TTFT_SUM_METRICS, "Per-pod TTFT sum rate (s/s) over 5m"),
+        QUERY_POD_TTFT_COUNT_RATE: (
+            _TTFT_COUNT_METRICS, "Per-pod TTFT sample rate (1/s) over 5m"),
+        QUERY_POD_ITL_SUM_RATE: (
+            _ITL_SUM_METRICS, "Per-pod ITL sum rate (s/s) over 5m"),
+        QUERY_POD_ITL_COUNT_RATE: (
+            _ITL_COUNT_METRICS, "Per-pod ITL sample rate (1/s) over 5m"),
+    }
+    for name, (metrics, desc) in pod_queries.items():
+        ql.register_if_absent(QueryTemplate(
+            name=name,
+            template=f"sum by (pod) ({_latency_rates(metrics)})",
+            params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+            description=desc,
+        ))
+    # Long + fast arrival windows, mirroring the model-wide pair: during a
+    # ramp the long window under-reports by ~half a window, so the
+    # per-accelerator collector takes max(long, fast) per pod too.
+    for name, window in ((QUERY_POD_ARRIVAL_RATE, arrival_rate_window()),
+                         (QUERY_POD_ARRIVAL_RATE_FAST,
+                          FAST_ARRIVAL_RATE_WINDOW)):
+        ql.register_if_absent(QueryTemplate(
+            name=name,
+            template=(
+                f"sum by (pod) (rate(vllm:request_success_total{_NS_MODEL}"
+                f"[{window}])"
+                f" or rate(jetstream_request_success_total{_NS_MODEL}"
+                f"[{window}]))"
+            ),
+            params=[PARAM_NAMESPACE, PARAM_MODEL_ID],
+            description=f"Per-pod request completion rate over {window}",
+        ))
 
 
 def collect_optimizer_metrics(
@@ -157,3 +228,101 @@ def collect_optimizer_metrics(
         ttft_seconds=first_value(QUERY_AVG_TTFT) or 0.0,
         itl_seconds=first_value(QUERY_AVG_ITL) or 0.0,
     )
+
+
+@dataclass
+class AcceleratorTelemetry:
+    """Latency/arrival telemetry for one accelerator type's share of a
+    model's fleet, rebuilt from per-pod query results. Feeds one EKF per
+    accelerator so heterogeneous fleets (the BASELINE config-4 v5e-vs-v5p
+    scenario) tune each performance profile against its own latencies
+    instead of the model-wide mixture."""
+
+    ttft_seconds: float = 0.0
+    itl_seconds: float = 0.0
+    # Mean per-pod completion rate for this accelerator's pods, req/min.
+    # Already per-replica: no division by the fleet-wide replica count.
+    arrival_rate_per_replica: float = 0.0
+    pods: int = 0
+
+
+def collect_accelerator_telemetry(
+    metrics_source: MetricsSource,
+    model_id: str,
+    namespace: str,
+    pod_accelerators: dict[str, str],
+) -> dict[str, AcceleratorTelemetry]:
+    """Per-accelerator TTFT/ITL/arrival from per-pod rates.
+
+    ``pod_accelerators`` maps pod name -> accelerator type (the caller joins
+    it from ReplicaMetrics, which already carries the pod->VA->accelerator
+    resolution). Pods with no latency samples in the window contribute
+    nothing; accelerators whose pods produced no TTFT samples are omitted so
+    the caller can fall back to model-wide telemetry or skip."""
+    if not pod_accelerators:
+        return {}
+    params = {PARAM_MODEL_ID: model_id, PARAM_NAMESPACE: namespace}
+    try:
+        results = metrics_source.refresh(RefreshSpec(
+            queries=[QUERY_POD_TTFT_SUM_RATE, QUERY_POD_TTFT_COUNT_RATE,
+                     QUERY_POD_ITL_SUM_RATE, QUERY_POD_ITL_COUNT_RATE,
+                     QUERY_POD_ARRIVAL_RATE, QUERY_POD_ARRIVAL_RATE_FAST],
+            params=params))
+    except Exception as e:  # noqa: BLE001
+        log.debug("per-pod latency telemetry unavailable for %s: %s",
+                  model_id, e)
+        return {}
+
+    def per_pod(name: str) -> dict[str, float]:
+        result = results.get(name)
+        if result is None or result.has_error():
+            return {}
+        out: dict[str, float] = {}
+        for v in result.values:
+            pod = v.labels.get("pod") or v.labels.get("pod_name") or ""
+            if pod and math.isfinite(v.value):
+                out[pod] = float(v.value)
+        return out
+
+    ttft_sum = per_pod(QUERY_POD_TTFT_SUM_RATE)
+    ttft_count = per_pod(QUERY_POD_TTFT_COUNT_RATE)
+    itl_sum = per_pod(QUERY_POD_ITL_SUM_RATE)
+    itl_count = per_pod(QUERY_POD_ITL_COUNT_RATE)
+    arrival = per_pod(QUERY_POD_ARRIVAL_RATE)
+    arrival_fast = per_pod(QUERY_POD_ARRIVAL_RATE_FAST)
+
+    acc: dict[str, dict[str, float]] = {}
+    for pod, accelerator in pod_accelerators.items():
+        if not accelerator:
+            continue
+        a = acc.setdefault(accelerator, {
+            "ttft_sum": 0.0, "ttft_count": 0.0, "itl_sum": 0.0,
+            "itl_count": 0.0, "arrival": 0.0, "arrival_pods": 0.0,
+            "pods": 0.0})
+        a["ttft_sum"] += ttft_sum.get(pod, 0.0)
+        a["ttft_count"] += ttft_count.get(pod, 0.0)
+        a["itl_sum"] += itl_sum.get(pod, 0.0)
+        a["itl_count"] += itl_count.get(pod, 0.0)
+        # Ramp correction as in collect_optimizer_metrics: the long window
+        # lags a rising rate by ~half a window, the fast one tracks it.
+        pod_arrival = arrival.get(pod)
+        pod_fast = arrival_fast.get(pod)
+        if pod_arrival is not None or pod_fast is not None:
+            a["arrival"] += max(pod_arrival or 0.0, pod_fast or 0.0)
+            # Only pods that produced arrival samples enter the per-replica
+            # mean — a just-started pod with no samples yet must not bias
+            # lambda low while the latency means reflect the serving pods.
+            a["arrival_pods"] += 1
+        a["pods"] += 1
+
+    out: dict[str, AcceleratorTelemetry] = {}
+    for accelerator, a in acc.items():
+        if a["ttft_count"] <= 0 or a["itl_count"] <= 0 or a["arrival_pods"] <= 0:
+            continue  # no samples this window; caller decides the fallback
+        out[accelerator] = AcceleratorTelemetry(
+            ttft_seconds=a["ttft_sum"] / a["ttft_count"],
+            itl_seconds=a["itl_sum"] / a["itl_count"],
+            arrival_rate_per_replica=(a["arrival"] / a["arrival_pods"]) * 60.0,
+            pods=int(a["pods"]),
+        )
+    return out

@@ -27,7 +27,10 @@ from wva_tpu.analyzers.saturation_v2 import (
     CapacityKnowledgeStore,
     SaturationV2Analyzer,
 )
-from wva_tpu.collector.registration.slo import collect_optimizer_metrics
+from wva_tpu.collector.registration.slo import (
+    collect_accelerator_telemetry,
+    collect_optimizer_metrics,
+)
 from wva_tpu.api.v1alpha1 import (
     OptimizedAlloc,
     REASON_OPTIMIZATION_SUCCEEDED,
@@ -656,26 +659,30 @@ class SaturationEngine:
     def _feed_slo_tuner(self, model_id: str, namespace: str, data: _ModelData,
                         optimizer_metrics) -> None:
         """One EKF step per accelerator from live TTFT/ITL telemetry; the
-        refined alpha/beta/gamma land in the shared PerfProfileStore."""
+        refined alpha/beta/gamma land in the shared PerfProfileStore.
+
+        Heterogeneous fleets (the BASELINE config-4 v5e-vs-v5p scenario) are
+        tuned from per-pod latency queries joined pod->accelerator, so each
+        filter fits its own accelerator's latencies. Homogeneous fleets may
+        fall back to the model-wide means (identical to the per-type mean
+        when only one type serves) when per-pod rates are unavailable —
+        e.g. a Prometheus without the per-pod histogram series."""
         if optimizer_metrics is None:
             return
         by_accel: dict[str, list[ReplicaMetrics]] = {}
         for rm in data.replica_metrics:
             if rm.accelerator_name:
                 by_accel.setdefault(rm.accelerator_name, []).append(rm)
-        # Observed TTFT/ITL is a model-wide mean blended across accelerator
-        # types; feeding it to per-accelerator filters would drag every
-        # profile toward the mixture. Key the guard on variant_states (the
-        # authoritative fleet shape) — replica_metrics alone misses variants
-        # whose pods exist but aren't scraped yet. Needs per-accelerator
-        # latency queries before tuning heterogeneous fleets.
+        per_accel = collect_accelerator_telemetry(
+            self.collector.source, model_id, namespace,
+            {rm.pod_name: rm.accelerator_name
+             for rm in data.replica_metrics if rm.pod_name})
+        # Key the homogeneity check on variant_states (the authoritative
+        # fleet shape) — replica_metrics alone misses variants whose pods
+        # exist but aren't scraped yet.
         fleet_accels = {vs.accelerator_name for vs in data.variant_states
                         if vs.accelerator_name and vs.current_replicas > 0}
-        if len(fleet_accels | set(by_accel)) > 1:
-            log.debug("Model %s served by %d accelerator types; skipping "
-                      "tuner this tick", model_id,
-                      len(fleet_accels | set(by_accel)))
-            return
+        homogeneous = len(fleet_accels | set(by_accel)) <= 1
         # arrival_rate is model-wide: attribute per-replica load using the
         # authoritative ready-replica count from variant states (replicas
         # with missing metrics still serve traffic).
@@ -690,15 +697,32 @@ class SaturationEngine:
             outs = [rm.avg_output_tokens for rm in rms if rm.avg_output_tokens > 0]
             if not ins or not outs:
                 continue
+            telemetry = per_accel.get(accelerator)
+            if telemetry is not None:
+                lambda_per_min = telemetry.arrival_rate_per_replica
+                ttft_ms = telemetry.ttft_seconds * 1000.0
+                itl_ms = telemetry.itl_seconds * 1000.0
+            elif homogeneous:
+                lambda_per_min = optimizer_metrics.arrival_rate / total_replicas
+                ttft_ms = optimizer_metrics.ttft_seconds * 1000.0
+                itl_ms = optimizer_metrics.itl_seconds * 1000.0
+            else:
+                # Model-wide latency is a cross-type blend; feeding it to a
+                # per-accelerator filter would drag the profile toward the
+                # mixture. Better no update than a corrupting one.
+                log.debug("Model %s: no per-pod latency for %s in a "
+                          "heterogeneous fleet; skipping its tuner step",
+                          model_id, accelerator)
+                continue
             env = TunerEnvironment(
                 # Filter models one replica's queue: per-replica arrival rate.
-                lambda_per_min=optimizer_metrics.arrival_rate / total_replicas,
+                lambda_per_min=lambda_per_min,
                 avg_input_tokens=sum(ins) / len(ins),
                 avg_output_tokens=sum(outs) / len(outs),
                 max_batch_size=profile.max_batch_size,
                 max_queue_size=profile.max_queue_size,
-                avg_ttft_ms=optimizer_metrics.ttft_seconds * 1000.0,
-                avg_itl_ms=optimizer_metrics.itl_seconds * 1000.0,
+                avg_ttft_ms=ttft_ms,
+                avg_itl_ms=itl_ms,
             )
             self.slo_tuner.observe(namespace, model_id, accelerator, env)
 
