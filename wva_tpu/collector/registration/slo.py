@@ -257,8 +257,9 @@ def collect_accelerator_telemetry(
     ``pod_accelerators`` maps pod name -> accelerator type (the caller joins
     it from ReplicaMetrics, which already carries the pod->VA->accelerator
     resolution). Pods with no latency samples in the window contribute
-    nothing; accelerators whose pods produced no TTFT samples are omitted so
-    the caller can fall back to model-wide telemetry or skip."""
+    nothing; an accelerator is omitted unless its pods produced TTFT *and*
+    ITL *and* arrival samples (the EKF needs all three), so the caller can
+    fall back to model-wide telemetry or skip."""
     if not pod_accelerators:
         return {}
     params = {PARAM_MODEL_ID: model_id, PARAM_NAMESPACE: namespace}
@@ -279,7 +280,9 @@ def collect_accelerator_telemetry(
             return {}
         out: dict[str, float] = {}
         for v in result.values:
-            pod = v.labels.get("pod") or v.labels.get("pod_name") or ""
+            # `sum by (pod)` leaves exactly one label; an empty pod means
+            # the deployment aggregated the label away (recording rules).
+            pod = v.labels.get("pod", "")
             if pod and math.isfinite(v.value):
                 out[pod] = float(v.value)
         return out

@@ -676,7 +676,8 @@ class SaturationEngine:
         per_accel = collect_accelerator_telemetry(
             self.collector.source, model_id, namespace,
             {rm.pod_name: rm.accelerator_name
-             for rm in data.replica_metrics if rm.pod_name})
+             for rm in data.replica_metrics
+             if rm.pod_name and rm.accelerator_name})
         # Key the homogeneity check on variant_states (the authoritative
         # fleet shape) — replica_metrics alone misses variants whose pods
         # exist but aren't scraped yet.
