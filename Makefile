@@ -102,3 +102,15 @@ test-e2e-smoke-with-setup: deploy-wva-tpu-emulated-on-kind test-e2e-smoke ## Dep
 .PHONY: test-e2e-smoke-local
 test-e2e-smoke-local: ## Same smoke assertions without a cluster: controller subprocess vs fake API server + fake Prometheus over real sockets.
 	$(PYTHON) deploy/e2e/smoke_local.py
+
+.PHONY: test-e2e-kind
+test-e2e-kind: ## Full e2e on kind: fake-TPU cluster + chart + in-cluster sim stack + saturation assertions (needs kind/kubectl/docker).
+	E2E_KIND=1 IMG=$(IMG) CLUSTER_NAME=$(CLUSTER_NAME) WVA_NS=$(WVA_NS) \
+	LLMD_NS=$(LLMD_NS) RELEASE_NAME=$(RELEASE_NAME) \
+		$(PYTHON) -m pytest tests/e2e_kind/ -v -m e2e
+
+.PHONY: test-e2e-kind-no-setup
+test-e2e-kind-no-setup: ## Same, against an already-deployed controller (skips image build + install).
+	E2E_KIND=1 E2E_KIND_NO_SETUP=1 IMG=$(IMG) CLUSTER_NAME=$(CLUSTER_NAME) \
+	WVA_NS=$(WVA_NS) LLMD_NS=$(LLMD_NS) RELEASE_NAME=$(RELEASE_NAME) \
+		$(PYTHON) -m pytest tests/e2e_kind/ -v -m e2e

@@ -1,0 +1,175 @@
+"""YAML builders for the real-kind e2e tier's in-cluster sim stack.
+
+Every pod runs the controller's own image (it contains ``wva_tpu`` and a
+CPython), so the cluster needs exactly one image and zero egress:
+
+- ``sim`` Deployment — ``python -m wva_tpu.emulator.sim_pod`` serving
+  ``vllm:*`` metrics, knobs via a mounted ConfigMap the tests patch;
+- ``prom`` Deployment + Service — ``python -m wva_tpu.emulator.prom_pod``
+  scraping the sim pods by label selector (RBAC'd pod list) and serving
+  ``/api/v1/query`` for the controller's Prometheus client.
+"""
+
+from __future__ import annotations
+
+import json
+
+SIM_APP_LABEL = "wva-e2e-sim"
+PROM_NAME = "wva-e2e-prom"
+SIM_CONFIG_NAME = "wva-e2e-sim-config"
+
+
+def sim_knobs(kv_usage: float, queue_len: int, rate_per_s: float) -> str:
+    return json.dumps({"kv_usage": kv_usage, "queue_len": queue_len,
+                       "rate_per_s": rate_per_s})
+
+
+def sim_configmap(namespace: str, kv_usage: float = 0.2,
+                  queue_len: int = 0, rate_per_s: float = 1.0) -> str:
+    return f"""apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: {SIM_CONFIG_NAME}
+  namespace: {namespace}
+data:
+  sim.json: '{sim_knobs(kv_usage, queue_len, rate_per_s)}'
+"""
+
+
+def sim_deployment(name: str, namespace: str, image: str, model_id: str,
+                   replicas: int = 1) -> str:
+    """The inference-server stand-in the VariantAutoscaling targets.
+
+    vLLM-shaped args feed the controller's engine-args parser; the
+    ``google.com/tpu`` request feeds usage discovery on the fake-TPU nodes.
+    """
+    return f"""apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {name}
+  namespace: {namespace}
+  labels: {{app: {name}, e2e-sim: "{SIM_APP_LABEL}"}}
+spec:
+  replicas: {replicas}
+  selector: {{matchLabels: {{app: {name}}}}}
+  template:
+    metadata:
+      labels: {{app: {name}, e2e-sim: "{SIM_APP_LABEL}"}}
+    spec:
+      containers:
+        - name: srv
+          image: {image}
+          imagePullPolicy: IfNotPresent
+          command: ["python", "-m", "wva_tpu.emulator.sim_pod"]
+          args: ["--max-num-batched-tokens=8192", "--max-num-seqs=256",
+                 "--block-size=16"]
+          env:
+            - name: SIM_MODEL_ID
+              value: "{model_id}"
+            - name: SIM_CONFIG_FILE
+              value: /etc/sim/sim.json
+            - name: SIM_POD_NAME
+              valueFrom: {{fieldRef: {{fieldPath: metadata.name}}}}
+            - name: SIM_NAMESPACE
+              valueFrom: {{fieldRef: {{fieldPath: metadata.namespace}}}}
+          ports: [{{containerPort: 8000, name: metrics}}]
+          readinessProbe:
+            httpGet: {{path: /healthz, port: 8000}}
+            initialDelaySeconds: 1
+            periodSeconds: 2
+          volumeMounts: [{{name: sim-config, mountPath: /etc/sim}}]
+      volumes:
+        - name: sim-config
+          configMap: {{name: {SIM_CONFIG_NAME}}}
+"""
+
+
+def prom_stack(namespace: str, sim_namespace: str, image: str) -> str:
+    """prom_pod Deployment + Service + pod-list RBAC."""
+    return f"""apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: {PROM_NAME}
+  namespace: {namespace}
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata:
+  name: {PROM_NAME}-pod-reader
+rules:
+  - apiGroups: [""]
+    resources: [pods]
+    verbs: [get, list, watch]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRoleBinding
+metadata:
+  name: {PROM_NAME}-pod-reader
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: ClusterRole
+  name: {PROM_NAME}-pod-reader
+subjects:
+  - kind: ServiceAccount
+    name: {PROM_NAME}
+    namespace: {namespace}
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {PROM_NAME}
+  namespace: {namespace}
+  labels: {{app: {PROM_NAME}}}
+spec:
+  replicas: 1
+  selector: {{matchLabels: {{app: {PROM_NAME}}}}}
+  template:
+    metadata:
+      labels: {{app: {PROM_NAME}}}
+    spec:
+      serviceAccountName: {PROM_NAME}
+      containers:
+        - name: prom
+          image: {image}
+          imagePullPolicy: IfNotPresent
+          command: ["python", "-m", "wva_tpu.emulator.prom_pod"]
+          env:
+            - name: SCRAPE_SELECTOR
+              value: "e2e-sim={SIM_APP_LABEL}"
+            - name: SCRAPE_NAMESPACE
+              value: "{sim_namespace}"
+            - name: SCRAPE_PORT
+              value: "8000"
+            - name: SCRAPE_INTERVAL
+              value: "5"
+          ports: [{{containerPort: 9090, name: http}}]
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: {PROM_NAME}
+  namespace: {namespace}
+spec:
+  selector: {{app: {PROM_NAME}}}
+  ports: [{{port: 9090, targetPort: 9090}}]
+"""
+
+
+def variant_autoscaling(name: str, namespace: str, model_id: str,
+                        accelerator: str = "v5e-8",
+                        cost: float = 10.0) -> str:
+    return f"""apiVersion: wva.tpu.llmd.ai/v1alpha1
+kind: VariantAutoscaling
+metadata:
+  name: {name}
+  namespace: {namespace}
+  labels:
+    inference.optimization/acceleratorName: {accelerator}
+spec:
+  scaleTargetRef:
+    apiVersion: apps/v1
+    kind: Deployment
+    name: {name}
+  modelID: {model_id}
+  variantCost: "{cost}"
+"""

@@ -1,0 +1,168 @@
+"""The in-cluster e2e sim stack (sim_pod + prom_pod) over real sockets.
+
+These are the cluster-free correctness tests for the components the
+real-kind tier (``tests/e2e_kind/``) deploys in pods: the vLLM metrics
+simulator and the scraping Prometheus stand-in, chained to the controller's
+own ``HTTPPromAPI`` client — the exact HTTP path the kind cluster runs.
+"""
+
+import json
+import threading
+
+import pytest
+
+from wva_tpu.collector.source.pod_scrape import parse_prometheus_text
+from wva_tpu.collector.source.prometheus import HTTPPromAPI, PrometheusSource
+from wva_tpu.collector.source.query_template import QueryTemplate
+from wva_tpu.collector.source.source import RefreshSpec
+from wva_tpu.emulator.prom_pod import ScrapingProm
+from wva_tpu.emulator.prom_server import FakePrometheusServer
+from wva_tpu.emulator.sim_pod import Counters, SimPodServer, render_metrics
+
+
+@pytest.fixture
+def sim_server(monkeypatch):
+    monkeypatch.setenv("SIM_POD_NAME", "llama-v5e-0")
+    monkeypatch.setenv("SIM_NAMESPACE", "llm-d-inference")
+    monkeypatch.setenv("SIM_KV_USAGE", "0.85")
+    monkeypatch.setenv("SIM_QUEUE_LEN", "8")
+    monkeypatch.setenv("SIM_RATE_PER_S", "4.0")
+    server = SimPodServer(port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+
+
+def _fetch(url: str) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read().decode()
+
+
+class TestSimPod:
+    def test_serves_vllm_series_with_knobs(self, sim_server):
+        text = _fetch(f"http://127.0.0.1:{sim_server.port}/metrics")
+        samples = {name: (labels, value)
+                   for name, labels, value in parse_prometheus_text(text)}
+        labels, kv = samples["vllm:kv_cache_usage_perc"]
+        assert kv == 0.85
+        assert labels["pod"] == "llama-v5e-0"
+        assert labels["namespace"] == "llm-d-inference"
+        assert labels["model_name"] == "meta-llama/Llama-3.1-8B"
+        assert samples["vllm:num_requests_waiting"][1] == 8
+        cache_labels, _ = samples["vllm:cache_config_info"]
+        assert cache_labels["num_gpu_blocks"] == "2048"
+        assert cache_labels["block_size"] == "16"
+        for required in ("vllm:request_success_total",
+                         "vllm:time_to_first_token_seconds_sum",
+                         "vllm:time_to_first_token_seconds_count",
+                         "vllm:time_per_output_token_seconds_sum",
+                         "vllm:time_per_output_token_seconds_count"):
+            assert required in samples, required
+
+    def test_counters_are_monotone(self, sim_server):
+        url = f"http://127.0.0.1:{sim_server.port}/metrics"
+
+        def success_total() -> float:
+            for name, _, value in parse_prometheus_text(_fetch(url)):
+                if name == "vllm:request_success_total":
+                    return value
+            raise AssertionError("counter missing")
+
+        first = success_total()
+        import time
+
+        time.sleep(0.05)
+        assert success_total() >= first
+
+    def test_config_file_overrides_env_per_scrape(self, sim_server,
+                                                  tmp_path, monkeypatch):
+        cfg = tmp_path / "sim.json"
+        cfg.write_text(json.dumps({"kv_usage": 0.1, "queue_len": 0}))
+        monkeypatch.setenv("SIM_CONFIG_FILE", str(cfg))
+        text = _fetch(f"http://127.0.0.1:{sim_server.port}/metrics")
+        kv = [v for n, _, v in parse_prometheus_text(text)
+              if n == "vllm:kv_cache_usage_perc"][0]
+        assert kv == 0.1  # file wins over SIM_KV_USAGE=0.85 without restart
+
+    def test_counters_advance_by_rate_times_dt(self):
+        knobs = {"model_id": "m", "kv_usage": 0.5, "queue_len": 2,
+                 "rate_per_s": 2.0, "ttft_ms": 100.0, "itl_ms": 10.0,
+                 "num_blocks": 128, "block_size": 16, "avg_in": 100.0,
+                 "avg_out": 50.0}
+        counters = Counters()
+        counters.advance(knobs, 10.0)
+        text = render_metrics(knobs, counters, "p0", "ns")
+        samples = {n: v for n, _, v in parse_prometheus_text(text)}
+        assert samples["vllm:request_success_total"] == pytest.approx(20.0)
+        assert samples["vllm:generation_tokens_total"] == pytest.approx(1000.0)
+        assert samples["vllm:time_to_first_token_seconds_sum"] == \
+            pytest.approx(2.0)
+
+    def test_rate_knob_change_keeps_counters_monotone(self):
+        """A SIM_RATE_PER_S change must only affect future increments —
+        never teleport counters (which would fake a huge rate() transient
+        in the e2e scale-up scenario)."""
+        knobs = {"model_id": "m", "kv_usage": 0.5, "queue_len": 2,
+                 "rate_per_s": 1.0, "ttft_ms": 100.0, "itl_ms": 10.0,
+                 "num_blocks": 128, "block_size": 16, "avg_in": 100.0,
+                 "avg_out": 50.0}
+        counters = Counters()
+        counters.advance(knobs, 600.0)  # 10 min at 1 req/s
+        before = counters.reqs
+        assert before == pytest.approx(600.0)
+        knobs["rate_per_s"] = 40.0
+        counters.advance(knobs, 5.0)  # one 5s scrape at the new rate
+        assert counters.reqs == pytest.approx(800.0)  # +200, not +23400
+        knobs["rate_per_s"] = 0.1  # rate DROP: counter still grows
+        counters.advance(knobs, 5.0)
+        assert counters.reqs > 800.0
+
+
+class TestPromPodChain:
+    def test_controller_client_queries_scraped_sim_metrics(self, sim_server):
+        """The full kind-cluster HTTP chain, cluster-free: HTTPPromAPI
+        (controller) -> FakePrometheusServer (prom_pod) -> scrape ->
+        SimPodServer (sim_pod)."""
+        prom = ScrapingProm(
+            lambda: [("llama-v5e-0",
+                      f"http://127.0.0.1:{sim_server.port}/metrics")],
+            interval=0.0)
+        server = FakePrometheusServer(prom.db, refresh=prom.refresh).start()
+        try:
+            api = HTTPPromAPI(server.url)
+            source = PrometheusSource(api)
+            source.query_list().register(QueryTemplate(
+                name="kv", template="vllm:kv_cache_usage_perc", params=[]))
+            results = source.refresh(RefreshSpec(queries=["kv"], params={}))
+            values = results["kv"].values
+            assert len(values) == 1
+            assert values[0].value == 0.85
+            assert values[0].labels["pod"] == "llama-v5e-0"
+        finally:
+            server.shutdown()
+
+    def test_scrape_interval_bounds_target_hits(self, sim_server):
+        hits = []
+
+        def targets():
+            hits.append(1)
+            return [("p", f"http://127.0.0.1:{sim_server.port}/metrics")]
+
+        prom = ScrapingProm(targets, interval=3600.0)
+        prom.refresh(prom.db)
+        prom.refresh(prom.db)
+        prom.refresh(prom.db)
+        assert len(hits) == 1  # re-scrape suppressed within the interval
+
+    def test_down_target_does_not_kill_cycle(self, sim_server):
+        prom = ScrapingProm(
+            lambda: [("dead", "http://127.0.0.1:1/metrics"),
+                     ("live", f"http://127.0.0.1:{sim_server.port}/metrics")],
+            interval=0.0)
+        prom.refresh(prom.db)
+        series = list(prom.db.matching_series(
+            [("__name__", "=", "vllm:kv_cache_usage_perc")]))
+        assert len(series) == 1  # the live pod landed despite the dead one

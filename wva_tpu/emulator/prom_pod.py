@@ -1,0 +1,139 @@
+"""Standalone in-cluster Prometheus stand-in for the real-kind e2e tier.
+
+The reference's kind suites deploy a full kube-prometheus stack
+(``test/e2e/suite_test.go:45-117``). This module — running in the
+controller's own image — covers the role with the repo's own machinery: it
+scrapes the ``sim_pod`` fleet's ``/metrics`` endpoints into the in-memory
+:class:`TimeSeriesDB` and serves ``/api/v1/query`` through
+:class:`FakePrometheusServer`, i.e. the exact HTTP shape the controller's
+``HTTPPromAPI`` speaks. No image pulls, no egress — the e2e cluster needs
+only the one image it already builds.
+
+Target discovery, in precedence order:
+
+- ``SCRAPE_URLS`` — comma-separated static ``http://host:port/metrics``
+  list (no K8s API needed);
+- ``SCRAPE_SELECTOR`` + ``SCRAPE_NAMESPACE`` + ``SCRAPE_PORT`` — label
+  selector (``k=v[,k2=v2]``) resolved to Ready pod IPs via the in-cluster
+  K8s client on every scrape cycle, like a Prometheus kubernetes_sd pod
+  role.
+
+``SCRAPE_INTERVAL`` (seconds, default 5) bounds how often targets are
+re-scraped; scrapes run lazily inside the query path (the
+FakePrometheusServer refresh hook), so an idle server does no work.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import urllib.request
+
+from wva_tpu.collector.source.pod_scrape import parse_prometheus_text
+from wva_tpu.collector.source.promql import TimeSeriesDB
+from wva_tpu.emulator.prom_server import FakePrometheusServer
+
+
+def _static_targets() -> list[tuple[str, str]]:
+    raw = os.environ.get("SCRAPE_URLS", "")
+    return [("", url.strip()) for url in raw.split(",") if url.strip()]
+
+
+class _PodDiscovery:
+    """Ready-pod IPs by label selector via the in-cluster K8s client."""
+
+    def __init__(self, selector: str, namespace: str, port: int) -> None:
+        self.selector = {
+            k: v for k, _, v in
+            (part.partition("=") for part in selector.split(",") if part)
+        }
+        self.namespace = namespace
+        self.port = port
+        from wva_tpu.k8s.kubeconfig import resolve_credentials
+        from wva_tpu.k8s.rest import KubeClient
+
+        self.client = KubeClient(resolve_credentials())
+
+    def targets(self) -> list[tuple[str, str]]:
+        from wva_tpu.k8s import Pod
+
+        out: list[tuple[str, str]] = []
+        for pod in self.client.list(Pod.KIND, namespace=self.namespace,
+                                    label_selector=self.selector):
+            ip = getattr(pod.status, "pod_ip", "") or ""
+            if ip and pod.is_ready():
+                out.append((pod.metadata.name,
+                            f"http://{ip}:{self.port}/metrics"))
+        return out
+
+
+class ScrapingProm:
+    """TSDB + lazy scraper; plugs into FakePrometheusServer as refresh."""
+
+    def __init__(self, target_fn, interval: float = 5.0,
+                 timeout: float = 3.0) -> None:
+        self.db = TimeSeriesDB()
+        self.target_fn = target_fn
+        self.interval = interval
+        self.timeout = timeout
+        # -inf: the first refresh must always scrape (monotonic time can be
+        # smaller than the interval right after boot).
+        self._last_scrape = float("-inf")
+
+    def refresh(self, db: TimeSeriesDB) -> None:
+        now = time.monotonic()
+        if now - self._last_scrape < self.interval:
+            return
+        try:
+            targets = self.target_fn()
+        except Exception as e:  # noqa: BLE001 — a flaky apiserver must not
+            # fail the query (nor burn the interval: retry next query).
+            print(f"target discovery failed: {e}", flush=True)
+            return
+        self._last_scrape = now
+        for pod_name, url in targets:
+            try:
+                with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                    text = r.read().decode("utf-8", "replace")
+            except Exception as e:  # noqa: BLE001 — a down pod must not
+                print(f"scrape {url}: {e}", flush=True)  # kill the cycle
+                continue
+            ts = time.time()
+            for name, labels, value in parse_prometheus_text(text):
+                if pod_name and "pod" not in labels:
+                    labels = {**labels, "pod": pod_name}
+                db.add_sample(name, labels, value, timestamp=ts)
+
+
+def main() -> None:
+    interval = float(os.environ.get("SCRAPE_INTERVAL", "5"))
+    static = _static_targets()
+    if static:
+        target_fn = lambda: static  # noqa: E731
+        mode = f"{len(static)} static urls"
+    else:
+        selector = os.environ.get("SCRAPE_SELECTOR", "")
+        if not selector:
+            raise SystemExit("set SCRAPE_URLS or SCRAPE_SELECTOR")
+        disco = _PodDiscovery(
+            selector,
+            os.environ.get("SCRAPE_NAMESPACE", "default"),
+            int(os.environ.get("SCRAPE_PORT", "8000")))
+        target_fn = disco.targets
+        mode = f"selector {selector!r} in {disco.namespace}"
+    prom = ScrapingProm(target_fn, interval=interval)
+    port = int(os.environ.get("PROM_PORT", "9090"))
+    server = FakePrometheusServer(prom.db, refresh=prom.refresh,
+                                  host="0.0.0.0", port=port)
+    server.start()
+    print(f"prom_pod serving /api/v1/query on {server.url} ({mode})",
+          flush=True)
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

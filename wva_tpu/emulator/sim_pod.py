@@ -1,0 +1,215 @@
+"""Standalone in-cluster vLLM-TPU metrics simulator pod.
+
+The real-kind e2e tier (``tests/e2e_kind/``, reference
+``test/e2e-saturation-based/e2e_saturation_test.go``) deploys this module —
+running in the controller's own image — as the inference-server stand-in,
+the way the reference deploys ``ghcr.io/llm-d/llm-d-inference-sim``
+(``test/utils/resources/llmdsim.go:16-60``). It serves a Prometheus
+``/metrics`` endpoint with the ``vllm:*`` series the collector registers,
+parameterized by environment knobs so the suite can drive saturated /
+idle phases:
+
+| Env | Meaning | Default |
+|---|---|---|
+| ``SIM_MODEL_ID`` | model_name label | ``meta-llama/Llama-3.1-8B`` |
+| ``SIM_NAMESPACE`` | namespace label (downward API) | ``""`` |
+| ``SIM_POD_NAME`` | pod label (downward API) | hostname |
+| ``SIM_KV_USAGE`` | kv_cache_usage_perc gauge | 0.3 |
+| ``SIM_QUEUE_LEN`` | num_requests_waiting gauge | 0 |
+| ``SIM_RATE_PER_S`` | request completion rate (drives counters) | 1.0 |
+| ``SIM_TTFT_MS`` / ``SIM_ITL_MS`` | latency histogram means | 200 / 20 |
+| ``SIM_NUM_BLOCKS`` / ``SIM_BLOCK_SIZE`` | cache_config_info labels | 2048 / 16 |
+| ``SIM_AVG_IN`` / ``SIM_AVG_OUT`` | token counters per request | 512 / 256 |
+| ``SIM_PORT`` | listen port | 8000 |
+
+Counters accumulate incrementally (``+= rate x dt`` per scrape) so they
+stay monotone across knob changes and ``rate()`` over any settled window
+reproduces ``SIM_RATE_PER_S``. Knobs are re-read from ``SIM_CONFIG_FILE``
+(JSON, e.g. a mounted ConfigMap) on every scrape when set, so a test can
+flip a fleet from idle to saturated with one ``kubectl patch configmap``
+and a kubelet sync instead of a rollout — the rate change takes effect
+from that instant forward instead of rewriting history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_DEFAULTS = {
+    "model_id": "meta-llama/Llama-3.1-8B",
+    "kv_usage": 0.3,
+    "queue_len": 0,
+    "rate_per_s": 1.0,
+    "ttft_ms": 200.0,
+    "itl_ms": 20.0,
+    "num_blocks": 2048,
+    "block_size": 16,
+    "avg_in": 512.0,
+    "avg_out": 256.0,
+}
+
+_ENV_KEYS = {
+    "model_id": ("SIM_MODEL_ID", str),
+    "kv_usage": ("SIM_KV_USAGE", float),
+    "queue_len": ("SIM_QUEUE_LEN", int),
+    "rate_per_s": ("SIM_RATE_PER_S", float),
+    "ttft_ms": ("SIM_TTFT_MS", float),
+    "itl_ms": ("SIM_ITL_MS", float),
+    "num_blocks": ("SIM_NUM_BLOCKS", int),
+    "block_size": ("SIM_BLOCK_SIZE", int),
+    "avg_in": ("SIM_AVG_IN", float),
+    "avg_out": ("SIM_AVG_OUT", float),
+}
+
+
+def _load_knobs() -> dict:
+    knobs = dict(_DEFAULTS)
+    for key, (env, cast) in _ENV_KEYS.items():
+        raw = os.environ.get(env)
+        if raw not in (None, ""):
+            try:
+                knobs[key] = cast(raw)
+            except ValueError:
+                pass
+    config_file = os.environ.get("SIM_CONFIG_FILE", "")
+    if config_file and os.path.exists(config_file):
+        try:
+            with open(config_file, encoding="utf-8") as f:
+                data = json.load(f)
+            for key in _DEFAULTS:
+                if key in data:
+                    knobs[key] = type(_DEFAULTS[key])(data[key])
+        except (OSError, ValueError, TypeError):
+            pass  # malformed config keeps env/default knobs
+    return knobs
+
+
+@dataclass
+class Counters:
+    """Cumulative counter state; advanced by ``rate x dt`` per scrape so a
+    knob change affects only future increments (monotone counters, correct
+    ``rate()`` transients)."""
+
+    reqs: float = 0.0
+    prompt_tokens: float = 0.0
+    gen_tokens: float = 0.0
+    ttft_sum_s: float = 0.0
+    itl_sum_s: float = 0.0
+
+    def advance(self, knobs: dict, dt: float) -> None:
+        d_reqs = max(knobs["rate_per_s"], 0.0) * max(dt, 0.0)
+        d_gen = d_reqs * knobs["avg_out"]
+        self.reqs += d_reqs
+        self.prompt_tokens += d_reqs * knobs["avg_in"]
+        self.gen_tokens += d_gen
+        self.ttft_sum_s += d_reqs * knobs["ttft_ms"] / 1000.0
+        self.itl_sum_s += d_gen * knobs["itl_ms"] / 1000.0
+
+
+def render_metrics(knobs: dict, counters: Counters, pod: str,
+                   namespace: str) -> str:
+    """vLLM-TPU exposition text for one scrape (names from
+    ``wva_tpu/constants/metrics.py``, shape matched by the collector's
+    registered queries)."""
+    labels = (f'model_name="{knobs["model_id"]}",pod="{pod}"'
+              + (f',namespace="{namespace}"' if namespace else ""))
+    cache_info = (f'num_gpu_blocks="{knobs["num_blocks"]}",'
+                  f'block_size="{knobs["block_size"]}",{labels}')
+    c = counters
+    lines = [
+        "# TYPE vllm:kv_cache_usage_perc gauge",
+        f'vllm:kv_cache_usage_perc{{{labels}}} {knobs["kv_usage"]}',
+        "# TYPE vllm:num_requests_waiting gauge",
+        f'vllm:num_requests_waiting{{{labels}}} {knobs["queue_len"]}',
+        "# TYPE vllm:cache_config_info gauge",
+        f"vllm:cache_config_info{{{cache_info}}} 1",
+        "# TYPE vllm:request_success_total counter",
+        f"vllm:request_success_total{{{labels}}} {c.reqs:.3f}",
+        "# TYPE vllm:prompt_tokens_total counter",
+        f"vllm:prompt_tokens_total{{{labels}}} {c.prompt_tokens:.3f}",
+        "# TYPE vllm:generation_tokens_total counter",
+        f"vllm:generation_tokens_total{{{labels}}} {c.gen_tokens:.3f}",
+        "# TYPE vllm:request_prompt_tokens histogram",
+        f"vllm:request_prompt_tokens_sum{{{labels}}} {c.prompt_tokens:.3f}",
+        f"vllm:request_prompt_tokens_count{{{labels}}} {c.reqs:.3f}",
+        "# TYPE vllm:request_generation_tokens histogram",
+        f"vllm:request_generation_tokens_sum{{{labels}}} {c.gen_tokens:.3f}",
+        f"vllm:request_generation_tokens_count{{{labels}}} {c.reqs:.3f}",
+        "# TYPE vllm:time_to_first_token_seconds histogram",
+        f"vllm:time_to_first_token_seconds_sum{{{labels}}} {c.ttft_sum_s:.4f}",
+        f"vllm:time_to_first_token_seconds_count{{{labels}}} {c.reqs:.3f}",
+        "# TYPE vllm:time_per_output_token_seconds histogram",
+        f"vllm:time_per_output_token_seconds_sum{{{labels}}} {c.itl_sum_s:.4f}",
+        f"vllm:time_per_output_token_seconds_count{{{labels}}} "
+        f"{c.gen_tokens:.3f}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "SimPodServer"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — quiet
+        pass
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        if self.path.split("?")[0] not in ("/metrics", "/healthz"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        if self.path.startswith("/healthz"):
+            body = b"ok"
+            ctype = "text/plain"
+        else:
+            body = self.server.render().encode()
+            ctype = "text/plain; version=0.0.4"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class SimPodServer(ThreadingHTTPServer):
+    """HTTP server facade; knobs re-read per scrape (SIM_CONFIG_FILE)."""
+
+    daemon_threads = True
+
+    def __init__(self, port: int = 0) -> None:
+        super().__init__(("0.0.0.0", port), _Handler)
+        self.pod = os.environ.get("SIM_POD_NAME") or socket.gethostname()
+        self.namespace = os.environ.get("SIM_NAMESPACE", "")
+        self.counters = Counters()
+        self._last_render = time.monotonic()
+        self._mu = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def render(self) -> str:
+        knobs = _load_knobs()
+        with self._mu:
+            now = time.monotonic()
+            self.counters.advance(knobs, now - self._last_render)
+            self._last_render = now
+            return render_metrics(knobs, self.counters, self.pod,
+                                  self.namespace)
+
+
+def main() -> None:
+    port = int(os.environ.get("SIM_PORT", "8000"))
+    server = SimPodServer(port)
+    print(f"sim_pod serving vllm:* metrics on :{server.port} "
+          f"(pod={server.pod})", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()
