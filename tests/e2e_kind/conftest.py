@@ -140,6 +140,22 @@ def cluster():
                 "--ignore-not-found=true", check=False)
         kubectl("-n", LLMD_NS, "delete", "deployment", VARIANT,
                 "--ignore-not-found=true", check=False)
+        kubectl("-n", LLMD_NS, "delete", "configmap",
+                manifests.SIM_CONFIG_NAME, "--ignore-not-found=true",
+                check=False)
+        # The prom stand-in stack, including its cluster-scoped RBAC (a
+        # stale binding would point at the wrong namespace on reuse).
+        kubectl("-n", WVA_NS, "delete", "deployment", manifests.PROM_NAME,
+                "--ignore-not-found=true", check=False)
+        kubectl("-n", WVA_NS, "delete", "service", manifests.PROM_NAME,
+                "--ignore-not-found=true", check=False)
+        kubectl("-n", WVA_NS, "delete", "serviceaccount", manifests.PROM_NAME,
+                "--ignore-not-found=true", check=False)
+        kubectl("delete", "clusterrolebinding",
+                f"{manifests.PROM_NAME}-pod-reader",
+                "--ignore-not-found=true", check=False)
+        kubectl("delete", "clusterrole", f"{manifests.PROM_NAME}-pod-reader",
+                "--ignore-not-found=true", check=False)
 
 
 @pytest.fixture(scope="session")

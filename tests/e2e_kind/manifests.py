@@ -73,6 +73,9 @@ spec:
             - name: SIM_NAMESPACE
               valueFrom: {{fieldRef: {{fieldPath: metadata.namespace}}}}
           ports: [{{containerPort: 8000, name: metrics}}]
+          resources:
+            requests: {{"google.com/tpu": 8}}
+            limits: {{"google.com/tpu": 8}}
           readinessProbe:
             httpGet: {{path: /healthz, port: 8000}}
             initialDelaySeconds: 1

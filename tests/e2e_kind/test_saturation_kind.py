@@ -59,27 +59,35 @@ class TestSaturationOnKind:
 
     def test_stability_under_constant_load(self, cluster):
         """Reference :396: with the load held constant, consecutive
-        optimization cycles must not flap the desired count."""
-        first = wait_until(lambda: desired_replicas(VARIANT),
-                           desc="a desired allocation")
+        optimization cycles must not flap the desired count. A one-step
+        monotone settle (e.g. 2 -> 3) is allowed; any revisit of an
+        abandoned value (oscillation) fails."""
+        wait_until(lambda: desired_replicas(VARIANT),
+                   desc="a desired allocation")
         import time
 
-        observed = set()
+        observed: list[int] = []
         deadline = time.monotonic() + 150  # ~2+ optimization intervals
         while time.monotonic() < deadline:
-            observed.add(desired_replicas(VARIANT))
+            n = desired_replicas(VARIANT)
+            if n is not None and (not observed or observed[-1] != n):
+                observed.append(n)
             time.sleep(10)
-        assert len(observed - {None}) <= 2, (
+        assert len(observed) <= 2, (
             f"desired flapped across {observed} under constant load")
-        assert first in observed
+        # Strict no-oscillation: values never revisit once left.
+        assert len(set(observed)) == len(observed)
 
     def test_scale_down_when_load_drops(self, cluster):
-        """Drop to idle; desired must come back down (min-replica floor 1,
-        scale-to-zero disabled by default)."""
+        """Drop to idle; desired must fall BELOW the saturated count (not
+        a vacuous pass when saturation settled at the assertion bound)."""
+        saturated = wait_until(lambda: desired_replicas(VARIANT),
+                               desc="a desired allocation before the drop")
         set_sim_load(kv_usage=0.05, queue_len=0, rate_per_s=0.2)
-        wait_until(lambda: (desired_replicas(VARIANT) or 99) <= 2,
-                   timeout=420,  # kubelet configmap sync + scale-down path
-                   desc="desired back at <= 2 after load drop")
+        wait_until(
+            lambda: (desired_replicas(VARIANT) or 99) < max(saturated, 2),
+            timeout=420,  # kubelet configmap sync + scale-down path
+            desc=f"desired below the saturated count ({saturated})")
 
     def test_current_replicas_gauge_tracks_deployment(self, cluster,
                                                       controller_metrics):

@@ -157,6 +157,34 @@ class TestPromPodChain:
         prom.refresh(prom.db)
         assert len(hits) == 1  # re-scrape suppressed within the interval
 
+    def test_kind_tier_manifests_are_valid_yaml(self):
+        """The e2e_kind YAML builders must produce parseable manifests with
+        the fields the tier depends on (TPU requests for usage discovery,
+        selector-matched labels for prom scrape discovery)."""
+        import yaml
+
+        from tests.e2e_kind import manifests as m
+
+        sim = list(yaml.safe_load_all(m.sim_deployment(
+            "llama-v5e", "llm-d-inference", "img:tag", "e2e/llama")))[0]
+        container = sim["spec"]["template"]["spec"]["containers"][0]
+        assert container["resources"]["requests"]["google.com/tpu"] == 8
+        assert sim["spec"]["template"]["metadata"]["labels"]["e2e-sim"] == \
+            m.SIM_APP_LABEL
+        prom_docs = list(yaml.safe_load_all(m.prom_stack(
+            "wva-tpu-system", "llm-d-inference", "img:tag")))
+        kinds = [d["kind"] for d in prom_docs if d]
+        assert {"ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+                "Deployment", "Service"} <= set(kinds)
+        cm = list(yaml.safe_load_all(m.sim_configmap("ns")))[0]
+        knobs = __import__("json").loads(cm["data"]["sim.json"])
+        assert set(knobs) == {"kv_usage", "queue_len", "rate_per_s"}
+        va = list(yaml.safe_load_all(m.variant_autoscaling(
+            "llama-v5e", "ns", "e2e/llama")))[0]
+        assert va["spec"]["modelID"] == "e2e/llama"
+        assert va["metadata"]["labels"][
+            "inference.optimization/acceleratorName"] == "v5e-8"
+
     def test_down_target_does_not_kill_cycle(self, sim_server):
         prom = ScrapingProm(
             lambda: [("dead", "http://127.0.0.1:1/metrics"),

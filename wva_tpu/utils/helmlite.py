@@ -195,7 +195,9 @@ class Renderer:
                     false_out, idx = self._render_block(tokens, idx + 1,
                                                         depth + 1)
                 # consume the end tag
-                assert tokens[idx][1] == "end", "expected {{ end }}"
+                if idx >= len(tokens) or tokens[idx][0] != "tag" \
+                        or tokens[idx][1] != "end":
+                    raise ValueError("unbalanced if/end in template")
                 end_trim = tokens[idx][2]
                 idx += 1
                 chosen = true_out if cond else false_out
