@@ -118,9 +118,13 @@ def run_policy(name: str) -> dict:
             # Size scale-up for the demand that will exist when a new slice
             # becomes ready (slice provisioning + model load + decision lag).
             anticipation_horizon_seconds=STARTUP_SECONDS + 30.0,
-            # N+1 insurance: a 1s-TTFT SLO against 120s slice provisioning
-            # means the first minutes of any ramp are served by capacity
-            # that already exists — keep one spare replica provisioned.
+            # Burst insurance, derived not guessed: the scenario's declared
+            # worst-credible ramp is (90-4)/300 req/s^2; the analyzer
+            # stands slope x horizon spare capacity — exactly the demand
+            # that can arrive during the provisioning blackout. (N+1
+            # headroomReplicas remains as the floor for models without a
+            # declared ramp shape.)
+            burst_slope_rps=(PEAK_RATE - 4.0) / RAMP_SECONDS,
             headroom_replicas=1,
             # Clamp desired to whole-slice inventory so unplaceable replicas
             # never sit pending.

@@ -349,6 +349,58 @@ class TestQueueingModelAnalyzer:
         with_pending = an.analyze(self.make_input(rate_per_min=60000.0, pending=3))
         assert with_pending.required_capacity < without.required_capacity
 
+    def test_burst_slope_stands_derived_headroom(self):
+        """burstSlopeRps: at FLAT low demand, the analyzer stands spare
+        capacity of slope x horizon (the demand that can arrive during the
+        provisioning blackout), and shields it from scale-down."""
+        an = QueueingModelAnalyzer()
+        an.sync_from_config(slo_cfg_for_model())
+        inp = self.make_input(rate_per_min=240.0)  # flat 4 req/s, 1 replica
+        inp.config = SaturationScalingConfig(
+            analyzer_name="slo", anticipation_horizon_seconds=150.0,
+            burst_slope_rps=0.2867)
+        res = an.analyze(inp)
+        base = an.analyze(self.make_input(rate_per_min=240.0))
+        insurance = 0.2867 * 150.0  # ~43 req/s of standing spare
+        assert res.required_capacity >= base.required_capacity + insurance - 5.0
+        assert res.spare_capacity == 0.0  # insurance never reads as spare
+
+    def test_burst_slope_takes_max_with_headroom_replicas(self):
+        """The derived insurance and the static N+k floor combine via max,
+        so a tiny declared slope never LOWERS the static headroom."""
+        an = QueueingModelAnalyzer()
+        an.sync_from_config(slo_cfg_for_model())
+        inp = self.make_input(rate_per_min=240.0)
+        inp.config = SaturationScalingConfig(
+            analyzer_name="slo", anticipation_horizon_seconds=150.0,
+            headroom_replicas=2, burst_slope_rps=0.001)
+        tiny_slope = an.analyze(inp)
+        inp2 = self.make_input(rate_per_min=240.0)
+        inp2.config = SaturationScalingConfig(
+            analyzer_name="slo", anticipation_horizon_seconds=150.0,
+            headroom_replicas=2)
+        static_only = an.analyze(inp2)
+        assert tiny_slope.required_capacity == pytest.approx(
+            static_only.required_capacity)
+
+    def test_burst_slope_config_key_and_validation(self):
+        cfg = SaturationScalingConfig.from_dict(
+            {"analyzerName": "slo", "burstSlopeRps": 0.5,
+             "anticipationHorizonSeconds": 150})
+        assert cfg.burst_slope_rps == 0.5
+        bad = SaturationScalingConfig(analyzer_name="slo",
+                                      burst_slope_rps=-1.0)
+        bad.apply_defaults()
+        with pytest.raises(ValueError, match="burstSlopeRps"):
+            bad.validate()
+        # Dead-knob rejection: a slope without a horizon stands zero
+        # insurance while looking configured.
+        no_horizon = SaturationScalingConfig(analyzer_name="slo",
+                                             burst_slope_rps=0.5)
+        no_horizon.apply_defaults()
+        with pytest.raises(ValueError, match="anticipationHorizonSeconds"):
+            no_horizon.validate()
+
     def test_missing_profile_excludes_variant(self):
         an = QueueingModelAnalyzer(profiles=PerfProfileStore())
         cfg = slo_cfg_for_model()

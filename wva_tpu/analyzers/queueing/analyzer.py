@@ -278,6 +278,18 @@ class QueueingModelAnalyzer(Analyzer):
                      for cand, cap in zip(candidates, per_replica) if cap > 0]
             if pairs:
                 headroom_capacity = cfg.headroom_replicas * min(pairs)[1]
+        if cfg.burst_slope_rps > 0 and cfg.anticipation_horizon_seconds > 0:
+            # Derived burst insurance: during the provisioning blackout
+            # (one anticipation horizon — nothing ordered after a ramp
+            # starts can land sooner), demand can grow by at most the
+            # declared worst-credible slope x horizon. Standing exactly
+            # that much spare capacity makes the knob a commitment ("this
+            # ramp shape stays in SLO"), not a guessed replica count. The
+            # inventory limiter still caps the resulting desired count, so
+            # insurance never outgrows the fleet.
+            headroom_capacity = max(
+                headroom_capacity,
+                cfg.burst_slope_rps * cfg.anticipation_horizon_seconds)
 
         result.total_supply = supply
         result.total_demand = demand

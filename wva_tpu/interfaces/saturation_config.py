@@ -68,6 +68,17 @@ class SaturationScalingConfig:
     # react to observed saturation only). SLO analyzer only.
     headroom_replicas: int = 0
 
+    # Derived burst insurance: the worst CREDIBLE demand ramp the operator
+    # commits to absorbing without SLO loss, in req/s per second. The
+    # analyzer stands spare capacity of burstSlopeRps x
+    # anticipationHorizonSeconds — exactly the demand that can arrive
+    # during the provisioning blackout (no decision made after a ramp
+    # starts can land a slice sooner than the provisioning horizon), so
+    # the standing headroom is a derived quantity, not a guessed replica
+    # count. Combined with headroomReplicas via max. 0 = off. SLO analyzer
+    # only.
+    burst_slope_rps: float = 0.0
+
     # Scale-from-N fast path: the 100ms backlog monitor (the scale-from-zero
     # detection loop generalized to ACTIVE models) requests an immediate
     # engine tick when a model's scheduler flow-control backlog reaches
@@ -145,6 +156,20 @@ class SaturationScalingConfig:
                 raise ValueError(
                     "headroomReplicas must be >= 0, got "
                     f"{self.headroom_replicas}")
+            if self.burst_slope_rps < 0:
+                raise ValueError(
+                    "burstSlopeRps must be >= 0, got "
+                    f"{self.burst_slope_rps}")
+            if self.burst_slope_rps > 0 and \
+                    self.anticipation_horizon_seconds <= 0:
+                # A knob that parses but stands zero insurance is worse
+                # than absent: the operator believes the ramp commitment
+                # holds. The insurance is slope x horizon, so the horizon
+                # must be declared too.
+                raise ValueError(
+                    "burstSlopeRps requires anticipationHorizonSeconds > 0 "
+                    "(insurance = slope x horizon; set the horizon to the "
+                    "slice provisioning + model-load time)")
             if not 0 < self.scale_down_boundary <= 1:
                 raise ValueError(
                     f"scaleDownBoundary must be in (0, 1], got {self.scale_down_boundary:.2f}"
@@ -170,6 +195,7 @@ class SaturationScalingConfig:
         "scaleDownBoundary": "scale_down_boundary",
         "anticipationHorizonSeconds": "anticipation_horizon_seconds",
         "headroomReplicas": "headroom_replicas",
+        "burstSlopeRps": "burst_slope_rps",
         "optimizerName": "optimizer_name",
         "fastPathEnabled": "fast_path_enabled",
         "fastPathQueueThreshold": "fast_path_queue_threshold",
