@@ -67,6 +67,7 @@ SLO_TTFT_SECONDS = 1.0
 WARMUP_SECONDS = 180.0
 RAMP_SECONDS = 300.0
 HOLD_SECONDS = 1500.0
+BASE_RATE = 4.0  # req/s during the warm hold and at ramp onset
 PEAK_RATE = 90.0  # req/s at peak — needs ~5 v5e-8 slices
 STARTUP_SECONDS = 120.0  # slice provisioning + model load
 
@@ -124,7 +125,7 @@ def run_policy(name: str) -> dict:
             # that can arrive during the provisioning blackout. (N+1
             # headroomReplicas remains as the floor for models without a
             # declared ramp shape.)
-            burst_slope_rps=(PEAK_RATE - 4.0) / RAMP_SECONDS,
+            burst_slope_rps=(PEAK_RATE - BASE_RATE) / RAMP_SECONDS,
             headroom_replicas=1,
             # Clamp desired to whole-slice inventory so unplaceable replicas
             # never sit pending.
@@ -145,7 +146,7 @@ def run_policy(name: str) -> dict:
         name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
         chips_per_replica=8, cost=10.0, initial_replicas=1,
         serving=ServingParams(engine="jetstream"),
-        load=ramp(4.0, PEAK_RATE, RAMP_SECONDS, hold=HOLD_SECONDS,
+        load=ramp(BASE_RATE, PEAK_RATE, RAMP_SECONDS, hold=HOLD_SECONDS,
                   delay=WARMUP_SECONDS),
         hpa=hpa,
     )
@@ -470,9 +471,10 @@ def main() -> None:
             "device_probe": device_probe,
             "scenario": {
                 "model": MODEL, "engine": "jetstream",
-                "warmup": f"{WARMUP_SECONDS:.0f}s at 4 req/s (excluded "
+                "warmup": f"{WARMUP_SECONDS:.0f}s at {BASE_RATE:.0f} req/s "
+                          "(excluded "
                           "from all measurement windows)",
-                "ramp": f"4->{PEAK_RATE} req/s over {RAMP_SECONDS:.0f}s",
+                "ramp": f"{BASE_RATE:.0f}->{PEAK_RATE} req/s over {RAMP_SECONDS:.0f}s",
                 "hold_s": HOLD_SECONDS, "slo_ttft_s": SLO_TTFT_SECONDS,
                 "slice_startup_s": STARTUP_SECONDS,
                 "vs_baseline_quoted_against": (

@@ -298,6 +298,88 @@ class TestHeterogeneousFleetTuning:
         assert store.get(MODEL, "v5e-8", namespace=NS).source == "config"
         assert store.get(MODEL, "v5p-8", namespace=NS).source == "config"
 
+    def test_live_harness_tunes_both_accelerators(self):
+        """Full-stack version (BASELINE config-4 shape): the emulated world
+        serves one model on v5e-8 (ITL 20ms) AND v5p-8 (ITL 10ms) with
+        deliberately identical misfit profiles; the engine's real
+        collection path (sim scrape -> PromQL -> per-pod queries ->
+        pod->accelerator join) must refine BOTH profiles, and the fitted
+        v5p must predict faster decode than the fitted v5e."""
+        from wva_tpu.analyzers.queueing import (
+            PerfProfile as PP,
+            QueueAnalyzer,
+            QueueConfig,
+            TargetPerf,
+        )
+        from wva_tpu.config.slo import SLOConfigData, ServiceClass
+        from wva_tpu.emulator import (
+            EmulationHarness,
+            HPAParams,
+            ServingParams,
+            VariantSpec,
+            constant,
+        )
+        from wva_tpu.interfaces import SaturationScalingConfig
+
+        hpa = HPAParams(stabilization_up_seconds=30.0,
+                        stabilization_down_seconds=1e9,  # hold the fleet
+                        sync_period_seconds=15.0)
+        specs = [
+            VariantSpec(name="mix-v5e", model_id=MODEL, accelerator="v5e-8",
+                        chips_per_replica=8, cost=8.0, initial_replicas=2,
+                        serving=ServingParams(engine="jetstream"),
+                        load=constant(12.0), hpa=hpa),
+            VariantSpec(name="mix-v5p", model_id=MODEL, accelerator="v5p-8",
+                        chips_per_replica=8, cost=24.0, initial_replicas=1,
+                        serving=ServingParams(
+                            engine="jetstream", itl_seconds=0.01,
+                            prefill_tokens_per_second=16000.0),
+                        load=None, hpa=hpa),
+        ]
+        cfg = SaturationScalingConfig(analyzer_name="slo",
+                                      fast_path_enabled=False)
+        cfg.apply_defaults()
+        h = EmulationHarness(
+            specs, saturation_config=cfg, startup_seconds=60.0,
+            nodepools=[("v5e-pool", "v5e", "2x4", 8),
+                       ("v5p-pool", "v5p", "2x4", 8)])
+        misfit = dict(max_batch_size=96, max_queue_size=384)
+        h.manager.config.update_slo_config(SLOConfigData(
+            service_classes=[ServiceClass(
+                name="premium", priority=1,
+                model_targets={MODEL: TargetPerf(target_ttft_ms=2000.0)})],
+            profiles=[
+                PP(model_id=MODEL, accelerator="v5e-8",
+                   service_parms=ServiceParms(alpha=30.0, beta=0.004,
+                                              gamma=0.00004), **misfit),
+                PP(model_id=MODEL, accelerator="v5p-8",
+                   service_parms=ServiceParms(alpha=30.0, beta=0.004,
+                                              gamma=0.00004), **misfit),
+            ],
+            tuner_enabled=True))
+        h.run(2000)
+        store = h.manager.engine.slo_analyzer.profiles
+        ns = next(iter(
+            {p.namespace for p in store.all()} - {""}), "")
+        prof_e = store.get(MODEL, "v5e-8", namespace=ns)
+        prof_p = store.get(MODEL, "v5p-8", namespace=ns)
+        assert prof_e is not None and prof_p is not None
+        assert prof_e.source == "tuner", "v5e profile untouched by tuner"
+        assert prof_p.source == "tuner", "v5p profile untouched by tuner"
+        # The fitted profiles must separate: identical priors, different
+        # hardware -> the v5p fit predicts faster decode at the same
+        # operating point.
+        req = RequestSize(avg_input_tokens=512, avg_output_tokens=256)
+        itl_e = QueueAnalyzer(QueueConfig(
+            max_batch_size=96, max_queue_size=384,
+            service_parms=prof_e.service_parms), req).analyze(4.0)
+        itl_p = QueueAnalyzer(QueueConfig(
+            max_batch_size=96, max_queue_size=384,
+            service_parms=prof_p.service_parms), req).analyze(4.0)
+        assert itl_p.avg_token_time_ms < itl_e.avg_token_time_ms, (
+            f"v5p fit ({prof_p.service_parms}) should predict faster decode "
+            f"than v5e fit ({prof_e.service_parms})")
+
     def test_homogeneous_fleet_falls_back_to_model_wide(self):
         """A single-type fleet whose Prometheus aggregated away the ``pod``
         label (recording rules) still tunes from the model-wide means
