@@ -133,6 +133,60 @@ def test_scale_up_decision_carries_full_step_chain():
         assert s.target_replicas >= 0
 
 
+def test_scaling_decision_event_surfaces_audit_trail():
+    """Every desired-replica change publishes a Normal ``ScalingDecision``
+    Event on the VA carrying the pipeline's step-by-step reasons — the
+    audit trail where operators look first (kubectl describe va)."""
+    from wva_tpu.k8s.objects import Event
+
+    cfg = SaturationScalingConfig(analyzer_name="slo", enable_limiter=True)
+    h = EmulationHarness([spec_for("llama-v5e", LLAMA,
+                                   ramp(2.0, 50.0, 300.0, hold=1e9))],
+                         saturation_config=cfg, startup_seconds=60.0)
+    h.manager.config.update_slo_config(SLOConfigData(
+        service_classes=[ServiceClass(
+            name="premium", priority=1,
+            model_targets={LLAMA: TargetPerf(target_ttft_ms=2000.0)})],
+        profiles=[PerfProfile(
+            model_id=LLAMA, accelerator="v5e-8",
+            service_parms=ServiceParms(alpha=18.0, beta=0.00267,
+                                       gamma=0.00002),
+            max_batch_size=96, max_queue_size=384)]))
+    h.run(600)
+    assert h.replicas_of("llama-v5e") > 1, "scenario must force a scale-up"
+    events = [e for e in h.cluster.list(Event.KIND, namespace="inference")
+              if e.reason == "ScalingDecision"]
+    assert events, "a desired-replica change must record a ScalingDecision"
+    msg = events[-1].message
+    assert "desired replicas" in msg and "v5e-8" in msg
+    # The trail names the pipeline stages with their reasons.
+    assert "analyzer:slo" in msg and "optimizer:" in msg
+    assert len(msg) <= 1000  # recorder truncation contract
+
+
+def test_event_recorder_preserves_distinct_transitions():
+    """A ramp's successive transitions (1->2, 2->4, 4->8) must remain
+    individually visible in `kubectl describe` — distinct messages get
+    distinct Event objects (stable message-hash key suffix); identical
+    recurrences still dedup into one event with a count."""
+    from wva_tpu.k8s import Deployment, FakeCluster
+    from wva_tpu.k8s.events import EventRecorder
+    from wva_tpu.k8s.objects import Event
+
+    cluster = FakeCluster()
+    obj = Deployment(metadata=ObjectMeta(name="llama", namespace="inference"))
+    rec = EventRecorder(cluster, component="wva-tpu")
+    for msg in ("desired replicas 1 -> 2", "desired replicas 2 -> 4",
+                "desired replicas 4 -> 8", "desired replicas 4 -> 8"):
+        rec.normal(obj, "ScalingDecision", msg)
+    events = [e for e in cluster.list(Event.KIND, namespace="inference")
+              if e.reason == "ScalingDecision"]
+    by_msg = {e.message: e.count for e in events}
+    assert by_msg == {"desired replicas 1 -> 2": 1,
+                      "desired replicas 2 -> 4": 1,
+                      "desired replicas 4 -> 8": 2}
+
+
 def test_slo_analyzer_holds_steady_on_light_load():
     h = _slo_world(constant(2.0))
     h.run(900)

@@ -158,11 +158,14 @@ class TestEventRecorder:
         assert len(events) == 1
         assert events[0].count == 2
         assert events[0].type == "Warning"
-        # Different message -> same aggregation key updates message? No:
-        # message change creates a fresh series under the same name.
+        # Different message -> different aggregation key (stable message
+        # hash in the name, like client-go): both messages stay visible
+        # instead of the new one overwriting the old series.
         rec.warning(cm, "BadConfig", "field y is invalid")
         events = cluster.list(Event.KIND, namespace="ns")
-        assert len(events) == 1 and events[0].message == "field y is invalid"
+        assert len(events) == 2
+        assert {e.message for e in events} == {"field x is invalid",
+                                               "field y is invalid"}
 
     def test_configmap_rejection_emits_event(self):
         from wva_tpu.config import new_test_config

@@ -188,6 +188,11 @@ class VariantAutoscalingReconciler:
         decision = common.DecisionCache.get(name, namespace)
         if decision is not None:
             if decision.accelerator_name or decision.target_replicas:
+                # ScalingDecision Events are emitted by the deciding engine
+                # (saturation / scale-from-zero), which sees the real
+                # old->new transition; by the time this reconciler runs the
+                # status already matches the cache, so emitting here would
+                # only double-report in a stale-trigger race.
                 va.status.desired_optimized_alloc = \
                     common.decision_to_optimized_alloc(decision)
             va.set_condition(

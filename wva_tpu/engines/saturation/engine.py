@@ -122,11 +122,15 @@ class SaturationEngine:
         clock: Clock | None = None,
         poll_interval: float = DEFAULT_ENGINE_POLL_INTERVAL,
         direct_actuator=None,
+        recorder=None,
     ) -> None:
         self.client = client
         self.config = config
         self.collector = collector
         self.actuator = actuator
+        # Optional k8s.events.EventRecorder: desired-replica changes publish
+        # a ScalingDecision Event carrying the pipeline's step trail.
+        self.recorder = recorder
         # Optional DirectActuator for the fastActuation config: scale-UP
         # decisions hit the scale subresource immediately instead of waiting
         # for the external HPA loop (which still converges to the same
@@ -932,11 +936,30 @@ class SaturationEngine:
                 common.fire_trigger(va.metadata.name, va.metadata.namespace)
                 continue
 
+            old_alloc = update_va.status.desired_optimized_alloc
+            old_desired = old_alloc.num_replicas
+            # last_run_time == 0 means the status was never written: the
+            # first population of a fresh VA is not a transition (a VA
+            # created over an already-running deployment would otherwise
+            # report a fictitious "0 -> N" scale-up).
+            had_recorded_alloc = old_alloc.last_run_time > 0
             update_va.status.desired_optimized_alloc = OptimizedAlloc(
                 accelerator=accelerator,
                 num_replicas=target_replicas,
                 last_run_time=now,
             )
+            if (self.recorder is not None and decision is not None
+                    and had_recorded_alloc
+                    and target_replicas != old_desired):
+                # The audit trail where operators look first (kubectl
+                # describe va): one Normal Event per desired change with
+                # every pipeline stage's reason.
+                trail = "; ".join(f"{s.name}: {s.reason}"
+                                  for s in decision.decision_steps) or reason
+                self.recorder.normal(
+                    update_va, "ScalingDecision",
+                    f"desired replicas {old_desired} -> {target_replicas} "
+                    f"on {accelerator}: {trail}")
             update_va.status.actuation.applied = False
             update_va.set_condition(
                 TYPE_OPTIMIZATION_READY, "True",

@@ -44,11 +44,14 @@ DEFAULT_POLL_INTERVAL = 0.1  # 100ms (reference engine.go:108)
 class ScaleFromZeroEngine:
     def __init__(self, client: KubeClient, config: Config, datastore: Datastore,
                  actuator: DirectActuator, clock: Clock | None = None,
-                 poll_interval: float = DEFAULT_POLL_INTERVAL) -> None:
+                 poll_interval: float = DEFAULT_POLL_INTERVAL,
+                 recorder=None) -> None:
         self.client = client
         self.config = config
         self.datastore = datastore
         self.actuator = actuator
+        # Optional k8s.events.EventRecorder (ScalingDecision on 0->1).
+        self.recorder = recorder
         self.clock = clock or SYSTEM_CLOCK
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock, name="scale-from-zero")
@@ -127,6 +130,13 @@ class ScaleFromZeroEngine:
                 TYPE_OPTIMIZATION_READY, "True", "ScaleFromZero",
                 "Scaled 0->1: pending requests in scheduler flow control", now=now)
             variant_utils.update_va_status_with_backoff(self.client, update_va)
+            # Inside the try: a VA deleted mid-flight must not get an audit
+            # event recorded against the now-missing object.
+            if self.recorder is not None:
+                self.recorder.normal(
+                    va, "ScalingDecision",
+                    f"desired replicas 0 -> 1 on {accelerator}: "
+                    f"{decision.reason}")
         except NotFoundError:
             pass
         common.fire_trigger(va.metadata.name, va.metadata.namespace)

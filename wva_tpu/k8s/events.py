@@ -9,6 +9,7 @@ Deduplicates like the API server's event aggregation: a repeat of the same
 from __future__ import annotations
 
 import logging
+import zlib
 
 from wva_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
 from wva_tpu.k8s.objects import Event, ObjectMeta
@@ -48,10 +49,27 @@ class EventRecorder:
     def warning(self, obj, reason: str, message: str) -> None:
         self.event(obj, TYPE_WARNING, reason, message)
 
+    # Event messages are conventionally short; the apiserver rejects very
+    # long ones (events.k8s.io caps note at 1 KiB for client-aggregated
+    # events). Truncate rather than fail the record call.
+    MAX_MESSAGE_CHARS = 1000
+
     def _record(self, obj, event_type: str, reason: str, message: str) -> None:
+        if len(message) > self.MAX_MESSAGE_CHARS:
+            message = message[:self.MAX_MESSAGE_CHARS - 3] + "..."
         now = self.clock.now()
         kind = getattr(obj, "KIND", getattr(obj, "kind", ""))
-        name = f"{obj.metadata.name}.{self.component}.{reason.lower()}"
+        # Distinct messages get distinct Event objects (message-hash name
+        # suffix, like client-go's aggregation key): a sequence of different
+        # transitions — e.g. ScalingDecision 1->2, 2->4, 4->8 — stays fully
+        # visible in `kubectl describe`, while identical recurrences still
+        # dedup into one event with a count.
+        msg_hash = f"{zlib.crc32(message.encode('utf-8')):08x}"
+        suffix = f".{self.component}.{reason.lower()}.{msg_hash}"
+        # K8s object names cap at 253 chars; trim the subject's name, never
+        # the disambiguating suffix (aggregation stays correct — two
+        # long-named objects sharing a 200-char prefix is not a real case).
+        name = obj.metadata.name[:253 - len(suffix)] + suffix
         namespace = obj.metadata.namespace
         existing: Event | None = self.client.try_get(Event.KIND, namespace, name)
         if existing is not None:

@@ -249,19 +249,20 @@ def build_manager(
                              GreedyBySaturation())
 
     capacity_store = CapacityKnowledgeStore(clock=clock)
+    recorder = EventRecorder(client, clock=clock)
     engine = SaturationEngine(
         client=client, config=config, collector=collector, actuator=actuator,
         enforcer=enforcer, limiter=limiter, capacity_store=capacity_store,
         clock=clock, poll_interval=min(config.optimization_interval() / 2, 30.0),
-        direct_actuator=direct_actuator)
+        direct_actuator=direct_actuator, recorder=recorder)
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,
-                                          direct_actuator, clock=clock)
+                                          direct_actuator, clock=clock,
+                                          recorder=recorder)
     fastpath = FastPathMonitor(
         client, config, datastore, engine.executor,
         prom_source=prom_source, slo_analyzer=engine.slo_analyzer,
         clock=clock)
 
-    recorder = EventRecorder(client, clock=clock)
     watch_ns = config.watch_namespace() or ""
     va_reconciler = VariantAutoscalingReconciler(client, datastore, indexer,
                                                  clock=clock, recorder=recorder,
