@@ -276,37 +276,52 @@ def solver_microbench() -> dict:
                 jnp.full((n,), 50.0, jnp.float32),
                 jnp.zeros((n,), jnp.float32))
 
-    @partial(jax.jit, static_argnames=("reps",))
-    def repeat_solve(cand, ttft, itl, tps, reps):
+    @partial(jax.jit, static_argnames=("reps", "impl"))
+    def repeat_solve(cand, ttft, itl, tps, reps, impl):
         # Each solve's TTFT target depends on the previous solve's output
         # (value unchanged) -> the final transfer proves reps solves ran.
         def body(_, t):
-            r = size_batch(cand, t, itl, tps)
+            r = size_batch(cand, t, itl, tps, impl=impl)
             return ttft + 0.0 * r["max_rate_per_s"]
         t = jax.lax.fori_loop(0, reps, body, ttft)
-        return size_batch(cand, t, itl, tps)["max_rate_per_s"]
+        return size_batch(cand, t, itl, tps, impl=impl)["max_rate_per_s"]
 
     out: dict = {"platform": platform}
     # Slope needs two rep counts; CPU fallback runs ~13s/solve at C=8192,
     # so it gets the minimum spread while accelerators amortize more.
     reps_lo, reps_hi = (5, 25) if platform != "cpu" else (1, 3)
+    # Both bisection backends: "xla" (lax.fori_loop) and "pallas" (the
+    # fused Mosaic kernel keeping each tile's chain VMEM-resident across
+    # all 48 iterations). The headline batch_{n} numbers quote the best;
+    # per-impl results stay visible for the comparison. Only TPU compiles
+    # the kernel natively (Mosaic); everywhere else size_batch routes
+    # pallas through the interpreter — emulation timings, not a perf path.
+    impls = ("xla", "pallas") if platform == "tpu" else ("xla",)
     for n in (1024, 8192):
         args = batch(n)
-        t0 = time.perf_counter()
-        jax.block_until_ready(size_batch(*args))
-        compile_s = time.perf_counter() - t0
-        walls = {}
-        for reps in (reps_lo, reps_hi):
-            np.asarray(repeat_solve(*args, reps=reps))  # compile + warm
-            walls[reps] = min(
-                _timed(lambda: np.asarray(repeat_solve(*args, reps=reps)))
-                for _ in range(2))
-        exec_s = (walls[reps_hi] - walls[reps_lo]) / (reps_hi - reps_lo)
-        out[f"batch_{n}"] = {
-            "compile_s": round(compile_s, 3),
-            "execute_s": round(exec_s, 6),
-            "candidates_per_s": int(n / exec_s),
-        }
+        best = None
+        per_impl = {}
+        for impl in impls:
+            t0 = time.perf_counter()
+            jax.block_until_ready(size_batch(*args, impl=impl))
+            compile_s = time.perf_counter() - t0
+            walls = {}
+            for reps in (reps_lo, reps_hi):
+                np.asarray(repeat_solve(*args, reps=reps, impl=impl))
+                walls[reps] = min(
+                    _timed(lambda: np.asarray(
+                        repeat_solve(*args, reps=reps, impl=impl)))
+                    for _ in range(2))
+            exec_s = (walls[reps_hi] - walls[reps_lo]) / (reps_hi - reps_lo)
+            per_impl[impl] = {
+                "compile_s": round(compile_s, 3),
+                "execute_s": round(exec_s, 6),
+                "candidates_per_s": int(n / exec_s),
+            }
+            if best is None or exec_s < best[1]:
+                best = (impl, exec_s)
+        out[f"batch_{n}"] = {**per_impl[best[0]], "impl": best[0],
+                             "per_impl": per_impl}
 
     # Scalar facade (one candidate at a time — the reference's solve shape,
     # pkg/analyzer/queueanalyzer.go:127-258) for the batching speedup.

@@ -1,0 +1,83 @@
+"""Pallas sizing-bisection kernel vs the XLA reference path.
+
+The kernel (``analyzers/queueing/pallas_kernel.py``) must be numerically
+interchangeable with the XLA ``lax.fori_loop`` bisection — same iteration
+count, same chain math — so these tests pin equivalence over random
+candidate populations, the candidate-padding path (C not a multiple of the
+128-lane tile), disabled targets, and the chunked driver. On CPU the kernel
+runs through the Pallas interpreter (identical math); the real Mosaic
+compile + the perf comparison run in bench.py's solver microbench on TPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from wva_tpu.analyzers.queueing.queue_model import (
+    _SIZE_CHUNK,
+    candidate_batch,
+    size_batch,
+)
+
+RATE_KEYS = ("max_rate_per_s", "rate_target_ttft_per_s",
+             "rate_target_itl_per_s", "rate_target_tps_per_s")
+
+
+def _random_batch(n, seed=0, k_hi=512):
+    rng = np.random.default_rng(seed)
+    cand = candidate_batch(
+        alphas=rng.uniform(3.0, 30.0, n),
+        betas=rng.uniform(0.001, 0.05, n),
+        gammas=rng.uniform(0.00001, 0.002, n),
+        avg_in=rng.uniform(64, 2048, n),
+        avg_out=rng.uniform(32, 1024, n),
+        max_batch=rng.integers(8, 128, n),
+        k=rng.integers(128, k_hi, n))
+    return (cand,
+            jnp.asarray(rng.uniform(100, 3000, n), jnp.float32),
+            jnp.asarray(rng.uniform(5, 100, n), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+
+
+def _assert_equivalent(args, k_cols=512, rtol=2e-3):
+    a = size_batch(*args, k_cols=k_cols, impl="xla")
+    b = size_batch(*args, k_cols=k_cols, impl="pallas")
+    for key in RATE_KEYS:
+        np.testing.assert_allclose(np.asarray(a[key]), np.asarray(b[key]),
+                                   rtol=rtol, err_msg=key)
+
+
+class TestPallasBisectionEquivalence:
+    def test_random_population_matches_xla(self):
+        _assert_equivalent(_random_batch(64, seed=1))
+
+    def test_non_lane_multiple_padding(self):
+        # 77 candidates: the kernel pads to 128 lanes; padding rows must
+        # not perturb real lanes.
+        _assert_equivalent(_random_batch(77, seed=2))
+
+    def test_single_candidate(self):
+        _assert_equivalent(_random_batch(1, seed=3))
+
+    def test_disabled_targets_yield_lam_max(self):
+        cand, ttft, itl, tps = _random_batch(16, seed=4)
+        zeros = jnp.zeros_like(ttft)
+        a = size_batch(cand, zeros, zeros, zeros, k_cols=512, impl="xla")
+        b = size_batch(cand, zeros, zeros, zeros, k_cols=512, impl="pallas")
+        np.testing.assert_allclose(np.asarray(a["max_rate_per_s"]),
+                                   np.asarray(b["max_rate_per_s"]),
+                                   rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_chunked_driver_threads_impl(self):
+        # C > _SIZE_CHUNK exercises the lax.map chunk path with the pallas
+        # body (small k keeps the CPU interpreter run fast).
+        n = _SIZE_CHUNK + 64
+        _assert_equivalent(_random_batch(n, seed=5, k_hi=192), k_cols=256)
+
+    def test_rates_are_positive_and_within_bounds(self):
+        cand, ttft, itl, tps = _random_batch(32, seed=6)
+        out = size_batch(cand, ttft, itl, tps, k_cols=512, impl="pallas")
+        rates = np.asarray(out["max_rate_per_s"])
+        assert np.all(np.isfinite(rates)) and np.all(rates > 0)

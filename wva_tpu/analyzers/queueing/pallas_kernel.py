@@ -1,0 +1,181 @@
+"""Pallas TPU kernel for the SLO sizing bisection.
+
+The hot op of the framework (SURVEY.md section 6 north star): for every
+(variant, accelerator, request-mix) candidate, bisect the arrival rate
+whose predicted TTFT/ITL meets the SLO target, 48 iterations over the
+precomputed cumulative chain ``clm[n] = sum log mu(i)``
+(:func:`wva_tpu.analyzers.queueing.queue_model._cum_log_mu`).
+
+The XLA path re-enters the fori_loop body as separate fusions; this kernel
+pins one candidate tile's chain in VMEM for the WHOLE bisection — the
+[K, 128] block is read 96 times (48 iterations x 2 SLO lanes) from VMEM
+with zero HBM traffic after the initial load.
+
+Layout: candidates ride the LANE axis (last dim, 128 per grid step) and
+chain states the sublane axis, so every reduction is a native
+sublane-direction VPU reduce producing a [1, 128] row. All per-candidate
+coefficients arrive pre-combined as [1, C] rows (the prefill affine form
+``alpha + n_serv * bc + extra`` is prepared by the wrapper), keeping the
+kernel free of candidate-scalar recomputation.
+
+Selection: ``size_batch(..., impl="pallas")`` or env
+``WVA_SOLVER_KERNEL=pallas`` (read at import). The XLA path remains the
+default and the reference numerics; equivalence is pinned by
+``tests/test_pallas_kernel.py`` (interpret mode on CPU, real kernel on
+TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Shared numerics with the XLA reference path (queue_model imports this
+# module only lazily, so the top-level import is acyclic): same iteration
+# count, same -inf sentinel, same token-factor model — a model change there
+# changes both backends.
+from wva_tpu.analyzers.queueing.queue_model import (
+    _BISECTION_ITERS,
+    _NEG_INF,
+    _token_factors,
+)
+
+LANES = 128
+
+
+def _sizing_kernel(clm_ref, coef_ref, tgt_ref, lohi_ref, out_ref):
+    """One candidate tile: full 48-iteration dual-lane bisection.
+
+    clm_ref:  [K, LANES]  cumulative log service rate (states on sublanes)
+    coef_ref: [8, LANES]  per-candidate rows: clm_at_k, k, max_batch,
+                          alpha_eff, bc, prefill_extra, has_prompt,
+                          inv_avg_out
+    tgt_ref:  [2, LANES]  TTFT / ITL targets (ms)
+    lohi_ref: [4, LANES]  lo_ttft, hi_ttft, lo_itl, hi_itl (req/ms)
+    out_ref:  [2, LANES]  lam_star per lane
+    """
+    clm = clm_ref[...]
+    # Mosaic iota is integer-only; widen to f32 after.
+    nf = jax.lax.broadcasted_iota(
+        jnp.int32, clm.shape, 0).astype(jnp.float32) + 1.0
+    clm_at_k = coef_ref[0:1, :]
+    kf = coef_ref[1:2, :]
+    minb = jnp.minimum(nf, coef_ref[2:3, :])
+    alpha_eff = coef_ref[3:4, :]
+    bc = coef_ref[4:5, :]
+    prefill_extra = coef_ref[5:6, :]
+    has_prompt = coef_ref[6:7, :]
+    inv_avg_out = coef_ref[7:8, :]
+
+    def latencies(mid):
+        """(ttft, itl) predicted at arrival rate ``mid`` ([1, LANES])."""
+        log_lam = jnp.log(jnp.maximum(mid, 1e-30))
+        logp = jnp.maximum(nf * log_lam - clm, _NEG_INF)
+        m = jnp.maximum(jnp.max(logp, axis=0, keepdims=True), 0.0)
+        w = jnp.exp(logp - m)
+        z = jnp.exp(-m) + jnp.sum(w, axis=0, keepdims=True)
+        n_sys = jnp.sum(nf * w, axis=0, keepdims=True) / z
+        n_serv = jnp.sum(minb * w, axis=0, keepdims=True) / z
+        logp_k = kf * log_lam - clm_at_k
+        p_block = jnp.exp(jnp.maximum(logp_k, _NEG_INF) - m) / z
+        x = jnp.maximum(mid * (1.0 - p_block), 1e-30)
+        avg_resp = n_sys / x
+        avg_serv = n_serv / x
+        avg_wait = jnp.maximum(avg_resp - avg_serv, 0.0)
+        prefill = (alpha_eff + n_serv * bc + prefill_extra) * has_prompt
+        itl = (avg_serv - prefill) * inv_avg_out
+        ttft = avg_wait + prefill + itl
+        return ttft, itl
+
+    tgt_t = tgt_ref[0:1, :]
+    tgt_i = tgt_ref[1:2, :]
+
+    def body(_, carry):
+        lo_t, hi_t, lo_i, hi_i = carry
+        mid_t = 0.5 * (lo_t + hi_t)
+        y_t, _ = latencies(mid_t)
+        right_t = y_t < tgt_t
+        lo_t = jnp.where(right_t, mid_t, lo_t)
+        hi_t = jnp.where(right_t, hi_t, mid_t)
+        mid_i = 0.5 * (lo_i + hi_i)
+        _, y_i = latencies(mid_i)
+        right_i = y_i < tgt_i
+        lo_i = jnp.where(right_i, mid_i, lo_i)
+        hi_i = jnp.where(right_i, hi_i, mid_i)
+        return lo_t, hi_t, lo_i, hi_i
+
+    lo_t, hi_t, lo_i, hi_i = jax.lax.fori_loop(
+        0, _BISECTION_ITERS, body,
+        (lohi_ref[0:1, :], lohi_ref[1:2, :],
+         lohi_ref[2:3, :], lohi_ref[3:4, :]))
+    out_ref[0:1, :] = 0.5 * (lo_t + hi_t)
+    out_ref[1:2, :] = 0.5 * (lo_i + hi_i)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def sizing_bisection_pallas(
+    clm: jax.Array,        # [C, K] cumulative chain (masked +inf past k)
+    clm_at_k: jax.Array,   # [C]
+    cand,                  # CandidateBatch
+    targets: jax.Array,    # [2, C] (ttft_ms, itl_ms)
+    lo0: jax.Array,        # [2, C]
+    hi0: jax.Array,        # [2, C]
+    interpret: bool = False,
+) -> jax.Array:
+    """lam_star [2, C] — drop-in for the XLA fori_loop bisection in
+    ``_size_batch_core`` (same math, same iteration count)."""
+    c, k = clm.shape
+    c_pad = -(-c // LANES) * LANES
+    pad = c_pad - c
+
+    def pad_row(x, fill):
+        x = jnp.asarray(x, jnp.float32)
+        return jnp.pad(x, ((0, pad),), constant_values=fill) if pad else x
+
+    # Transposed chain: states on sublanes, candidates on lanes. Padding
+    # candidates get clm=+inf -> w=0 everywhere (harmless bisection on a
+    # degenerate chain).
+    clm_t = jnp.pad(jnp.asarray(clm, jnp.float32).T, ((0, 0), (0, pad)),
+                    constant_values=-_NEG_INF) if pad else \
+        jnp.asarray(clm, jnp.float32).T
+
+    # Prefill affine form (queue_model._prefill_time):
+    #   prefill(n_serv) = alpha + n_serv*(beta*tc + gamma*tm)
+    #                     + (beta+gamma)*avg_in,  gated on avg_in > 0.
+    avg_in = jnp.asarray(cand.avg_input_tokens, jnp.float32)
+    avg_out = jnp.asarray(cand.avg_output_tokens, jnp.float32)
+    tc, tm = _token_factors(cand)
+    bc = cand.beta * tc + cand.gamma * tm
+    prefill_extra = (cand.beta + cand.gamma) * avg_in
+    coef = jnp.stack([
+        pad_row(clm_at_k, 0.0),
+        pad_row(cand.k.astype(jnp.float32), 1.0),
+        pad_row(cand.max_batch.astype(jnp.float32), 1.0),
+        pad_row(cand.alpha, 1.0),
+        pad_row(bc, 0.0),
+        pad_row(prefill_extra, 0.0),
+        pad_row(jnp.where(avg_in > 0, 1.0, 0.0), 0.0),
+        pad_row(1.0 / jnp.maximum(avg_out, 1.0), 1.0),
+    ])  # [8, c_pad]
+    tgt = jnp.stack([pad_row(targets[0], 1.0), pad_row(targets[1], 1.0)])
+    lohi = jnp.stack([pad_row(lo0[0], 1e-3), pad_row(hi0[0], 1e-3),
+                      pad_row(lo0[1], 1e-3), pad_row(hi0[1], 1e-3)])
+
+    grid = (c_pad // LANES,)
+    lam = pl.pallas_call(
+        _sizing_kernel,
+        out_shape=jax.ShapeDtypeStruct((2, c_pad), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((k, LANES), lambda j: (0, j)),
+            pl.BlockSpec((8, LANES), lambda j: (0, j)),
+            pl.BlockSpec((2, LANES), lambda j: (0, j)),
+            pl.BlockSpec((4, LANES), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((2, LANES), lambda j: (0, j)),
+        interpret=interpret,
+    )(clm_t, coef, tgt, lohi)
+    return lam[:, :c]

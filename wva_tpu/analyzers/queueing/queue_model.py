@@ -27,6 +27,7 @@ the reference's millisecond time unit, public rates are requests/s.
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import NamedTuple
 
@@ -335,13 +336,21 @@ def analyze_batch(rate_per_s: jax.Array, cand: CandidateBatch,
 _SIZE_CHUNK = 2048
 
 
-@partial(jax.jit, static_argnames=("k_cols",))
+# Bisection backend: "xla" (default, reference numerics) or "pallas" — the
+# fused TPU kernel in pallas_kernel.py keeping each candidate tile's chain
+# VMEM-resident across all 48 iterations. Selectable per call
+# (size_batch(..., impl=...)) or fleet-wide via WVA_SOLVER_KERNEL.
+_DEFAULT_IMPL = os.environ.get("WVA_SOLVER_KERNEL", "xla") or "xla"
+
+
+@partial(jax.jit, static_argnames=("k_cols", "impl"))
 def size_batch(
     cand: CandidateBatch,
     target_ttft_ms: jax.Array,
     target_itl_ms: jax.Array,
     target_tps: jax.Array,
     k_cols: int = K_MAX,
+    impl: str | None = None,
 ) -> dict[str, jax.Array]:
     """Chunked driver for :func:`_size_batch_core` — see its docstring.
 
@@ -352,7 +361,7 @@ def size_batch(
     c = int(cand.alpha.shape[0])
     if c <= _SIZE_CHUNK:
         return _size_batch_core(cand, target_ttft_ms, target_itl_ms,
-                                target_tps, k_cols)
+                                target_tps, k_cols, impl)
     ttft = jnp.asarray(target_ttft_ms, jnp.float32)
     itl = jnp.asarray(target_itl_ms, jnp.float32)
     tps = jnp.asarray(target_tps, jnp.float32)
@@ -367,7 +376,7 @@ def size_batch(
     cand_sh = CandidateBatch(*(shard(f) for f in cand))
     out = jax.lax.map(
         lambda args: _size_batch_core(args[0], args[1], args[2], args[3],
-                                      k_cols),
+                                      k_cols, impl),
         (cand_sh, shard(ttft), shard(itl), shard(tps)))
     return {key: v.reshape(-1)[:c] for key, v in out.items()}
 
@@ -378,6 +387,7 @@ def _size_batch_core(
     target_itl_ms: jax.Array,
     target_tps: jax.Array,
     k_cols: int = K_MAX,
+    impl: str | None = None,
 ) -> dict[str, jax.Array]:
     """Max arrival rate per candidate meeting its TTFT/ITL/TPS targets.
 
@@ -423,8 +433,26 @@ def _size_batch_core(
         hi = jnp.where(go_right, hi, mid)
         return lo, hi
 
-    lo, hi = jax.lax.fori_loop(0, _BISECTION_ITERS, body, (lo0, hi0))
-    lam_star = 0.5 * (lo + hi)
+    resolved_impl = impl or _DEFAULT_IMPL
+    if resolved_impl not in ("xla", "pallas"):
+        # A typo'd WVA_SOLVER_KERNEL silently running XLA would be a dead
+        # knob; fail loudly at trace time instead.
+        raise ValueError(
+            f"unknown solver impl {resolved_impl!r}; use 'xla' or 'pallas'")
+    if resolved_impl == "pallas":
+        from wva_tpu.analyzers.queueing.pallas_kernel import (
+            sizing_bisection_pallas,
+        )
+
+        # Interpret off-TPU (trace-time decision): the kernel targets
+        # Mosaic; CPU runs go through the Pallas interpreter so tests and
+        # the virtual-mesh dryrun exercise identical math.
+        lam_star = sizing_bisection_pallas(
+            clm, clm_at_k, cand, targets, lo0, hi0,
+            interpret=jax.default_backend() != "tpu")
+    else:
+        lo, hi = jax.lax.fori_loop(0, _BISECTION_ITERS, body, (lo0, hi0))
+        lam_star = 0.5 * (lo + hi)
 
     rate_ttft = jnp.where(targets[0] > 0, lam_star[0], lam_max)
     rate_itl = jnp.where(targets[1] > 0, lam_star[1], lam_max)

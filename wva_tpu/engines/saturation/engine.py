@@ -948,18 +948,6 @@ class SaturationEngine:
                 num_replicas=target_replicas,
                 last_run_time=now,
             )
-            if (self.recorder is not None and decision is not None
-                    and had_recorded_alloc
-                    and target_replicas != old_desired):
-                # The audit trail where operators look first (kubectl
-                # describe va): one Normal Event per desired change with
-                # every pipeline stage's reason.
-                trail = "; ".join(f"{s.name}: {s.reason}"
-                                  for s in decision.decision_steps) or reason
-                self.recorder.normal(
-                    update_va, "ScalingDecision",
-                    f"desired replicas {old_desired} -> {target_replicas} "
-                    f"on {accelerator}: {trail}")
             update_va.status.actuation.applied = False
             update_va.set_condition(
                 TYPE_OPTIMIZATION_READY, "True",
@@ -995,6 +983,22 @@ class SaturationEngine:
                         self.client, update_va)
                 except NotFoundError:
                     continue
+                if (self.recorder is not None and decision is not None
+                        and had_recorded_alloc
+                        and target_replicas != old_desired):
+                    # The audit trail where operators look first (kubectl
+                    # describe va): one Normal Event per desired change
+                    # with every pipeline stage's reason — recorded only
+                    # AFTER the transition persisted, so a VA deleted
+                    # mid-flight never gets an event for a write that
+                    # never happened (same invariant as scale-from-zero).
+                    trail = "; ".join(
+                        f"{s.name}: {s.reason}"
+                        for s in decision.decision_steps) or reason
+                    self.recorder.normal(
+                        update_va, "ScalingDecision",
+                        f"desired replicas {old_desired} -> "
+                        f"{target_replicas} on {accelerator}: {trail}")
 
             metrics_available = decision is not None
             common.DecisionCache.set(va.metadata.name, va.metadata.namespace,

@@ -51,12 +51,17 @@ class EventRecorder:
 
     # Event messages are conventionally short; the apiserver rejects very
     # long ones (events.k8s.io caps note at 1 KiB for client-aggregated
-    # events). Truncate rather than fail the record call.
-    MAX_MESSAGE_CHARS = 1000
+    # events — BYTES, so multi-byte UTF-8 must be measured encoded).
+    # Truncate rather than fail the record call.
+    MAX_MESSAGE_BYTES = 1000
 
     def _record(self, obj, event_type: str, reason: str, message: str) -> None:
-        if len(message) > self.MAX_MESSAGE_CHARS:
-            message = message[:self.MAX_MESSAGE_CHARS - 3] + "..."
+        encoded = message.encode("utf-8")
+        if len(encoded) > self.MAX_MESSAGE_BYTES:
+            # Cut on a codepoint boundary ("ignore" drops a trailing
+            # partial sequence).
+            message = encoded[:self.MAX_MESSAGE_BYTES - 3].decode(
+                "utf-8", "ignore") + "..."
         now = self.clock.now()
         kind = getattr(obj, "KIND", getattr(obj, "kind", ""))
         # Distinct messages get distinct Event objects (message-hash name
