@@ -122,9 +122,16 @@ def cluster():
         subprocess.run([os.path.join(repo_root, "deploy", "install.sh")],
                        env=env, check=True)
     kubectl("create", "namespace", LLMD_NS, check=False)
+    kubectl_apply(manifests.inference_pool_crd())
+    # CRD Establishment is asynchronous; the epp_stack below contains an
+    # InferencePool CR and would hit "no matches for kind" on a slow
+    # apiserver.
+    kubectl("wait", "--for=condition=Established", "--timeout=60s",
+            "crd/inferencepools.inference.networking.k8s.io")
     kubectl_apply(manifests.sim_configmap(LLMD_NS))
     kubectl_apply(manifests.prom_stack(WVA_NS, LLMD_NS, IMG))
     kubectl_apply(manifests.sim_deployment(VARIANT, LLMD_NS, IMG, MODEL_ID))
+    kubectl_apply(manifests.epp_stack(LLMD_NS, IMG, MODEL_ID, sim_app=VARIANT))
     kubectl_apply(manifests.variant_autoscaling(VARIANT, LLMD_NS, MODEL_ID))
     kubectl("-n", WVA_NS, "wait", "--for=condition=Available",
             f"--timeout={int(TIMEOUT)}s", "deployment",
@@ -143,6 +150,13 @@ def cluster():
         kubectl("-n", LLMD_NS, "delete", "configmap",
                 manifests.SIM_CONFIG_NAME, "--ignore-not-found=true",
                 check=False)
+        kubectl("-n", LLMD_NS, "delete", "inferencepool",
+                manifests.POOL_NAME, "--ignore-not-found=true", check=False)
+        for res in ("deployment", "service", "configmap"):
+            name = (manifests.EPP_CONFIG_NAME if res == "configmap"
+                    else manifests.EPP_NAME)
+            kubectl("-n", LLMD_NS, "delete", res, name,
+                    "--ignore-not-found=true", check=False)
         # The prom stand-in stack, including its cluster-scoped RBAC (a
         # stale binding would point at the wrong namespace on reuse).
         kubectl("-n", WVA_NS, "delete", "deployment", manifests.PROM_NAME,

@@ -158,6 +158,112 @@ spec:
 """
 
 
+EPP_NAME = "wva-e2e-epp"
+EPP_CONFIG_NAME = "wva-e2e-epp-config"
+POOL_NAME = "wva-e2e-pool"
+
+
+def epp_knobs(backlog: int) -> str:
+    return json.dumps({"epp_backlog": backlog})
+
+
+def inference_pool_crd() -> str:
+    """Minimal structural CRD for inference.networking.k8s.io/v1
+    InferencePool (the real CRD ships with gateway-api-inference-extension;
+    this test copy accepts the fields the controller reads)."""
+    return """apiVersion: apiextensions.k8s.io/v1
+kind: CustomResourceDefinition
+metadata:
+  name: inferencepools.inference.networking.k8s.io
+spec:
+  group: inference.networking.k8s.io
+  names: {kind: InferencePool, listKind: InferencePoolList,
+          plural: inferencepools, singular: inferencepool}
+  scope: Namespaced
+  versions:
+    - name: v1
+      served: true
+      storage: true
+      schema:
+        openAPIV3Schema:
+          type: object
+          properties:
+            spec:
+              type: object
+              x-kubernetes-preserve-unknown-fields: true
+"""
+
+
+def epp_stack(namespace: str, image: str, model_id: str,
+              sim_app: str) -> str:
+    """EPP (inference-scheduler endpoint picker) stand-in: sim_pod in EPP
+    mode serving the flow-control queue series, plus its ConfigMap knob,
+    Service, and the InferencePool binding the sim workload's selector to
+    this EPP — the scale-from-zero discovery path."""
+    return f"""apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: {EPP_CONFIG_NAME}
+  namespace: {namespace}
+data:
+  sim.json: '{epp_knobs(0)}'
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {EPP_NAME}
+  namespace: {namespace}
+  labels: {{app: {EPP_NAME}}}
+spec:
+  replicas: 1
+  selector: {{matchLabels: {{app: {EPP_NAME}}}}}
+  template:
+    metadata:
+      labels: {{app: {EPP_NAME}}}
+    spec:
+      containers:
+        - name: epp
+          image: {image}
+          imagePullPolicy: IfNotPresent
+          command: ["python", "-m", "wva_tpu.emulator.sim_pod"]
+          env:
+            - name: SIM_EPP
+              value: "1"
+            - name: SIM_MODEL_ID
+              value: "{model_id}"
+            - name: SIM_CONFIG_FILE
+              value: /etc/sim/sim.json
+          ports: [{{containerPort: 8000, name: metrics}}]
+          readinessProbe:
+            httpGet: {{path: /healthz, port: 8000}}
+            initialDelaySeconds: 1
+            periodSeconds: 2
+          volumeMounts: [{{name: epp-config, mountPath: /etc/sim}}]
+      volumes:
+        - name: epp-config
+          configMap: {{name: {EPP_CONFIG_NAME}}}
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: {EPP_NAME}
+  namespace: {namespace}
+spec:
+  selector: {{app: {EPP_NAME}}}
+  ports: [{{port: 8000, targetPort: 8000}}]
+---
+apiVersion: inference.networking.k8s.io/v1
+kind: InferencePool
+metadata:
+  name: {POOL_NAME}
+  namespace: {namespace}
+spec:
+  selector: {{matchLabels: {{app: {sim_app}}}}}
+  targetPortNumber: 8000
+  extensionRef: {{name: {EPP_NAME}, portNumber: 8000}}
+"""
+
+
 def variant_autoscaling(name: str, namespace: str, model_id: str,
                         accelerator: str = "v5e-8",
                         cost: float = 10.0) -> str:

@@ -1,0 +1,99 @@
+"""Scale-from-zero on a real kind cluster.
+
+Mirrors the reference's ``test/e2e/scale_from_zero_test.go``: a model
+scaled to zero replicas must wake (0 -> 1, written directly to the scale
+subresource, bypassing HPA) when the inference scheduler's flow-control
+queue reports pending requests for it. The EPP stand-in is ``sim_pod`` in
+EPP mode behind an InferencePool; the cluster-free proof of this exact
+chain (real HTTP scrape -> flow-control match -> DirectActuator) lives in
+``tests/test_e2e_sim_stack.py::TestEppSimMode``.
+
+Runs after the saturation suite (pytest collects files alphabetically;
+``test_saturation_kind.py`` < ``test_scale_from_zero_kind.py``) so the
+shared sim deployment is free to be scaled to zero here.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tests.e2e_kind import manifests
+from tests.e2e_kind.conftest import (
+    LLMD_NS,
+    VARIANT,
+    desired_replicas,
+    kubectl,
+    set_sim_load,
+    wait_until,
+)
+
+
+def _set_epp_backlog(backlog: int) -> None:
+    patch = json.dumps({"data": {"sim.json": manifests.epp_knobs(backlog)}})
+    kubectl("-n", LLMD_NS, "patch", "configmap", manifests.EPP_CONFIG_NAME,
+            "--type", "merge", "-p", patch)
+
+
+def _epp_reported_backlog() -> float | None:
+    """The backlog the EPP pod actually serves (the mounted ConfigMap can
+    lag a patch by ~60s of kubelet sync; tests must gate on this, not on
+    the patch)."""
+    r = kubectl(
+        "-n", LLMD_NS, "exec", f"deploy/{manifests.EPP_NAME}", "--",
+        "python", "-c",
+        "import urllib.request;"
+        "print(urllib.request.urlopen("
+        "'http://127.0.0.1:8000/metrics', timeout=3).read().decode())",
+        check=False)
+    if r.returncode != 0:
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("inference_extension_flow_control_queue_size"):
+            return float(line.rsplit(None, 1)[-1])
+    return None
+
+
+def _wait_epp_backlog(value: float) -> None:
+    wait_until(lambda: _epp_reported_backlog() == value, timeout=180,
+               desc=f"EPP serving backlog {value} (ConfigMap synced)")
+
+
+def _replicas() -> int:
+    r = kubectl("-n", LLMD_NS, "get", "deployment", VARIANT,
+                "-o", "jsonpath={.spec.replicas}", check=False)
+    return int(r.stdout) if r.returncode == 0 and r.stdout else -1
+
+
+class TestScaleFromZeroOnKind:
+    def test_queued_requests_wake_scaled_to_zero_model(self, cluster):
+        # Quiesce: idle load, no EPP backlog, then force the target to 0
+        # (the external operator action scale-to-zero policies produce).
+        set_sim_load(kv_usage=0.05, queue_len=0, rate_per_s=0.0)
+        _set_epp_backlog(0)
+        _wait_epp_backlog(0)
+        kubectl("-n", LLMD_NS, "scale", "deployment", VARIANT,
+                "--replicas=0")
+        wait_until(lambda: _replicas() == 0, desc="deployment at 0")
+
+        # Pending requests appear in the scheduler flow-control queue.
+        _set_epp_backlog(5)
+        wait_until(lambda: _replicas() >= 1, timeout=420,
+                   desc="direct 0 -> 1 wake on EPP backlog")
+        wait_until(lambda: (desired_replicas(VARIANT) or 0) >= 1,
+                   desc="VA status seeded with the wake decision")
+
+    def test_no_backlog_stays_at_zero(self, cluster):
+        _set_epp_backlog(0)
+        # Gate on the EPP actually serving 0 (the previous test left 5 in
+        # the ConfigMap; the 100ms wake loop would race the kubelet sync).
+        _wait_epp_backlog(0)
+        kubectl("-n", LLMD_NS, "scale", "deployment", VARIANT,
+                "--replicas=0")
+        wait_until(lambda: _replicas() == 0, desc="deployment at 0")
+        import time
+
+        time.sleep(60)  # many scale-from-zero poll cycles
+        assert _replicas() == 0, "woke without pending requests"
+        # Restore for any later suites.
+        kubectl("-n", LLMD_NS, "scale", "deployment", VARIANT,
+                "--replicas=1")

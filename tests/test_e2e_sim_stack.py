@@ -121,6 +121,107 @@ class TestSimPod:
         assert counters.reqs > 800.0
 
 
+class TestEppSimMode:
+    def test_epp_mode_serves_flow_control_series(self, monkeypatch):
+        monkeypatch.setenv("SIM_EPP", "1")
+        monkeypatch.setenv("SIM_EPP_BACKLOG", "5")
+        monkeypatch.setenv("SIM_MODEL_ID", "e2e/llama")
+        server = SimPodServer(port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            text = _fetch(f"http://127.0.0.1:{server.port}/metrics")
+        finally:
+            server.shutdown()
+        samples = {n: (labels, v)
+                   for n, labels, v in parse_prometheus_text(text)}
+        labels, size = samples["inference_extension_flow_control_queue_size"]
+        assert size == 5
+        assert labels["target_model_name"] == "e2e/llama"
+        assert "vllm:kv_cache_usage_perc" not in samples  # EPP, not a server
+
+    def test_scale_from_zero_engine_wakes_model_via_real_http(
+            self, monkeypatch):
+        """The kind-tier scale-from-zero chain, cluster-free: the REAL
+        ScaleFromZeroEngine + datastore + pod-scrape source + production
+        http_pod_fetcher scraping a live EPP-mode sim_pod over a genuine
+        socket must scale the 0-replica deployment to 1."""
+        from wva_tpu.api import (
+            ObjectMeta,
+            VariantAutoscaling,
+            VariantAutoscalingSpec,
+        )
+        from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+        from wva_tpu.collector.source import TimeSeriesDB
+        from wva_tpu.collector.source.pod_scrape import http_pod_fetcher
+        from wva_tpu.k8s import (
+            Container,
+            Deployment,
+            DeploymentStatus,
+            ExtensionRef,
+            FakeCluster,
+            InferencePool,
+            Pod,
+            PodStatus,
+            PodTemplateSpec,
+            Service,
+        )
+        from wva_tpu.main import build_manager
+        from wva_tpu.config import new_test_config
+        from wva_tpu.utils.clock import FakeClock
+
+        model = "e2e/llama"
+        ns = "llm-d-inference"
+        monkeypatch.setenv("SIM_EPP", "1")
+        monkeypatch.setenv("SIM_EPP_BACKLOG", "3")
+        monkeypatch.setenv("SIM_MODEL_ID", model)
+        server = SimPodServer(port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            clock = FakeClock(start=100_000.0)
+            cluster = FakeCluster(clock=clock)
+            cluster.create(Deployment(
+                metadata=ObjectMeta(name="llama-v5e", namespace=ns),
+                replicas=0, selector={"app": "llama"},
+                template=PodTemplateSpec(
+                    labels={"app": "llama"},
+                    containers=[Container(name="srv")]),
+                status=DeploymentStatus(replicas=0, ready_replicas=0)))
+            cluster.create(VariantAutoscaling(
+                metadata=ObjectMeta(
+                    name="llama-v5e", namespace=ns,
+                    labels={"inference.optimization/acceleratorName":
+                            "v5e-8"}),
+                spec=VariantAutoscalingSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        name="llama-v5e"),
+                    model_id=model, variant_cost="10.0")))
+            cluster.create(Service(
+                metadata=ObjectMeta(name="epp-svc", namespace=ns),
+                selector={"app": "epp"}))
+            # The EPP pod's IP is loopback: the production fetcher builds
+            # http://127.0.0.1:<simport>/metrics and hits the live server.
+            cluster.create(Pod(
+                metadata=ObjectMeta(name="epp-0", namespace=ns,
+                                    labels={"app": "epp"}),
+                status=PodStatus(phase="Running", ready=True,
+                                 pod_ip="127.0.0.1")))
+            cluster.create(InferencePool(
+                metadata=ObjectMeta(name="llama-pool", namespace=ns),
+                selector={"app": "llama"},
+                extension_ref=ExtensionRef(service_name="epp-svc")))
+            mgr = build_manager(
+                cluster, new_test_config(), clock=clock,
+                tsdb=TimeSeriesDB(clock=clock),
+                pod_fetcher=http_pod_fetcher(server.port))
+            mgr.pool_reconciler.reconcile(
+                cluster.get(InferencePool.KIND, ns, "llama-pool"))
+            mgr.scale_from_zero_tick()
+            assert cluster.get("Deployment", ns, "llama-v5e").replicas == 1
+        finally:
+            server.shutdown()
+
+
 class TestPromPodChain:
     def test_controller_client_queries_scraped_sim_metrics(self, sim_server):
         """The full kind-cluster HTTP chain, cluster-free: HTTPPromAPI
@@ -184,6 +285,16 @@ class TestPromPodChain:
         assert va["spec"]["modelID"] == "e2e/llama"
         assert va["metadata"]["labels"][
             "inference.optimization/acceleratorName"] == "v5e-8"
+        epp_docs = [d for d in yaml.safe_load_all(m.epp_stack(
+            "ns", "img:tag", "e2e/llama", sim_app="llama-v5e")) if d]
+        pool = next(d for d in epp_docs if d["kind"] == "InferencePool")
+        # The pool binds the SIM workload's selector to the EPP service on
+        # the sim_pod port — the exact shape _pool_from_k8s reads.
+        assert pool["spec"]["selector"]["matchLabels"]["app"] == "llama-v5e"
+        assert pool["spec"]["extensionRef"] == {"name": m.EPP_NAME,
+                                                "portNumber": 8000}
+        crd = list(yaml.safe_load_all(m.inference_pool_crd()))[0]
+        assert crd["spec"]["group"] == "inference.networking.k8s.io"
 
     def test_down_target_does_not_kill_cycle(self, sim_server):
         prom = ScrapingProm(

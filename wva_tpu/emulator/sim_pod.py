@@ -21,6 +21,8 @@ idle phases:
 | ``SIM_NUM_BLOCKS`` / ``SIM_BLOCK_SIZE`` | cache_config_info labels | 2048 / 16 |
 | ``SIM_AVG_IN`` / ``SIM_AVG_OUT`` | token counters per request | 512 / 256 |
 | ``SIM_PORT`` | listen port | 8000 |
+| ``SIM_EPP`` | ``1`` = EPP mode: serve ONLY the scheduler flow-control queue series (the pod plays the inference-scheduler endpoint picker) | off |
+| ``SIM_EPP_BACKLOG`` / ``SIM_EPP_BACKLOG_BYTES`` | flow-control queue gauges in EPP mode | 0 / 0 |
 
 Counters accumulate incrementally (``+= rate x dt`` per scrape) so they
 stay monotone across knob changes and ``rate()`` over any settled window
@@ -52,6 +54,11 @@ _DEFAULTS = {
     "block_size": 16,
     "avg_in": 512.0,
     "avg_out": 256.0,
+    # EPP mode (SIM_EPP=1): the pod plays the inference-scheduler endpoint
+    # picker instead of a model server, serving the flow-control queue
+    # series the scale-from-zero engine scans.
+    "epp_backlog": 0,
+    "epp_backlog_bytes": 0,
 }
 
 _ENV_KEYS = {
@@ -65,6 +72,8 @@ _ENV_KEYS = {
     "block_size": ("SIM_BLOCK_SIZE", int),
     "avg_in": ("SIM_AVG_IN", float),
     "avg_out": ("SIM_AVG_OUT", float),
+    "epp_backlog": ("SIM_EPP_BACKLOG", int),
+    "epp_backlog_bytes": ("SIM_EPP_BACKLOG_BYTES", int),
 }
 
 
@@ -152,6 +161,21 @@ def render_metrics(knobs: dict, counters: Counters, pod: str,
     return "\n".join(lines) + "\n"
 
 
+def render_epp_metrics(knobs: dict) -> str:
+    """Inference-scheduler (EPP) exposition: the flow-control queue series
+    the scale-from-zero engine and fast path scan
+    (``engines/common/epp.py``), keyed by ``target_model_name``."""
+    labels = f'target_model_name="{knobs["model_id"]}"'
+    return "\n".join([
+        "# TYPE inference_extension_flow_control_queue_size gauge",
+        f"inference_extension_flow_control_queue_size{{{labels}}} "
+        f"{knobs['epp_backlog']}",
+        "# TYPE inference_extension_flow_control_queue_bytes gauge",
+        f"inference_extension_flow_control_queue_bytes{{{labels}}} "
+        f"{knobs['epp_backlog_bytes']}",
+    ]) + "\n"
+
+
 class _Handler(BaseHTTPRequestHandler):
     server: "SimPodServer"
 
@@ -185,6 +209,7 @@ class SimPodServer(ThreadingHTTPServer):
         super().__init__(("0.0.0.0", port), _Handler)
         self.pod = os.environ.get("SIM_POD_NAME") or socket.gethostname()
         self.namespace = os.environ.get("SIM_NAMESPACE", "")
+        self.epp_mode = os.environ.get("SIM_EPP", "") == "1"
         self.counters = Counters()
         self._last_render = time.monotonic()
         self._mu = threading.Lock()
@@ -195,6 +220,8 @@ class SimPodServer(ThreadingHTTPServer):
 
     def render(self) -> str:
         knobs = _load_knobs()
+        if self.epp_mode:
+            return render_epp_metrics(knobs)
         with self._mu:
             now = time.monotonic()
             self.counters.advance(knobs, now - self._last_render)
