@@ -178,52 +178,9 @@ def deploy_with_args(args, command=None, env=None):
             name="c", command=command or [], args=args, env=env or {})]))
 
 
-def test_parse_vllm_args_forms():
-    d = deploy_with_args([
-        "--gpu-memory-utilization=0.85", "--block_size", "32",
-        "--tensor-parallel-size=4", "--max-num-seqs", "128",
-        "--enforce-eager", "--max-num-batched-tokens=4096"])
-    p = parse_engine_args(d)
-    assert p.engine == "vllm"
-    assert p.gpu_memory_utilization == 0.85
-    assert p.block_size == 32
-    assert p.tensor_parallel_size == 4
-    assert p.max_num_seqs == 128
-    assert p.enforce_eager is True
-    assert p.effective_max_batched_tokens == 4096
 
 
-def test_parse_shell_command():
-    d = deploy_with_args([], command=[
-        "/bin/sh", "-c",
-        "vllm serve 'meta-llama/Llama-3.1-8B' --max-model-len 8192 --block-size=16"])
-    p = parse_engine_args(d)
-    assert p.max_model_len == 8192
 
-
-def test_vllm_v0_engine_detection():
-    d = deploy_with_args(["--max-model-len", "4096"], env={"VLLM_USE_V1": "0"})
-    p = parse_engine_args(d)
-    assert p.is_v1_engine is False
-    # V0 without chunked prefill: unchunked -> max_model_len
-    assert p.effective_max_batched_tokens == 4096
-
-
-def test_v1_default_batched_tokens():
-    p = parse_engine_args(deploy_with_args([]))
-    assert p.effective_max_batched_tokens == 8192  # V1 chunked default
-
-
-def test_parse_jetstream_args():
-    d = deploy_with_args([
-        "--tpu_topology=2x4", "--max_concurrent_decodes=96",
-        "--max_prefill_predict_length=1024", "--max_target_length=2048"])
-    p = parse_engine_args(d)
-    assert p.engine == "jetstream"
-    assert p.tpu_topology == "2x4"
-    assert p.max_num_seqs == 96  # S = decode slots
-    assert p.effective_max_batched_tokens == 1024  # B = prefill budget
-    assert p.tokens_per_slot == 2048  # defaults to max_target_length
 
 
 def test_k2_derivation_formula():
@@ -234,15 +191,6 @@ def test_k2_derivation_formula():
     assert estimate_capacity_from_params(p, 100.0, 0.0) == 0
     assert estimate_capacity_from_params(None, 100.0, 200.0) == 0
 
-
-def test_capacity_compatibility():
-    a = parse_engine_args(deploy_with_args(["--block-size=16"]))
-    b = parse_engine_args(deploy_with_args(["--block-size=16"]))
-    c = parse_engine_args(deploy_with_args(["--block-size=32"]))
-    assert a.is_capacity_compatible(b)
-    assert not a.is_capacity_compatible(c)
-    js = parse_engine_args(deploy_with_args(["--tpu_topology=2x4"]))
-    assert not a.is_capacity_compatible(js)  # engines differ
 
 
 # --- capacity store ---

@@ -164,6 +164,43 @@ def test_scaling_decision_event_surfaces_audit_trail():
     assert len(msg) <= 1000  # recorder truncation contract
 
 
+def test_prometheus_outage_mid_ramp_keeps_signal_and_recovers():
+    """Chaos: Prometheus dies mid-ramp. The metrics safety net must keep
+    the wva_desired_replicas gauge alive at the previous desired (the
+    external HPA never starves, reference engine.go:1022-1095) and never
+    scale DOWN on missing data; when Prometheus returns, scaling resumes
+    to the demand's level."""
+    from wva_tpu.constants.metrics import WVA_DESIRED_REPLICAS
+
+    h = _slo_world(ramp(2.0, 90.0, 900.0, hold=1e9))
+    h.run(420)  # mid-ramp (~43 req/s), some scale-up has landed
+    labels = {"variant_name": "llama-v5e", "namespace": "inference",
+              "accelerator_type": "v5e-8"}
+    desired_before = h.manager.registry.get(WVA_DESIRED_REPLICAS, labels)
+    assert desired_before and desired_before > 1
+
+    api = h.manager.engine.collector.source.api
+    original_query = api.query
+
+    def outage(promql):
+        raise RuntimeError("prometheus connection refused")
+
+    api.query = outage
+    try:
+        # 9 simulated minutes of outage; the 900s ramp tops out during it,
+        # so recovery below must still grow the fleet to the 90 req/s peak.
+        h.run(540)
+        during = h.manager.registry.get(WVA_DESIRED_REPLICAS, labels)
+        # Signal alive and not scaled down on missing data.
+        assert during is not None and during >= desired_before
+    finally:
+        api.query = original_query
+    h.run(1200)  # recovery: the ramp tops out at 90 req/s (~6-7 replicas)
+    after = h.manager.registry.get(WVA_DESIRED_REPLICAS, labels)
+    assert after > desired_before, "scaling must resume after the outage"
+    assert h.replicas_of("llama-v5e") > 1
+
+
 def test_event_recorder_preserves_distinct_transitions():
     """A ramp's successive transitions (1->2, 2->4, 4->8) must remain
     individually visible in `kubectl describe` — distinct messages get

@@ -1,0 +1,185 @@
+"""Table-driven engine-args parser tests — the TPU counterpart of the
+reference's ``saturation_v2/deployment_parser_test.go`` tier: arg forms,
+shell-string splitting with quotes, env toggles, malformed-value tolerance,
+the effective-batched-tokens resolution chain for both engine families, and
+the capacity-compatibility matrix.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from wva_tpu.analyzers.saturation_v2.engine_params import (
+    EngineParams,
+    parse_engine_args,
+)
+from wva_tpu.api import ObjectMeta
+from wva_tpu.k8s import Container, Deployment, PodTemplateSpec
+
+
+def deploy(args=None, command=None, env=None, containers=None) -> Deployment:
+    if containers is None:
+        containers = [Container(name="srv", command=command or [],
+                                args=args or [], env=env or {})]
+    return Deployment(metadata=ObjectMeta(name="d"),
+                      template=PodTemplateSpec(containers=containers))
+
+
+class TestArgForms:
+    @pytest.mark.parametrize("args,field,expected", [
+        (["--block-size=32"], "block_size", 32),
+        (["--block_size", "32"], "block_size", 32),
+        (["--BLOCK-SIZE=32"], "block_size", 16),  # case-sensitive like Go
+        (["--tensor-parallel-size", "8"], "tensor_parallel_size", 8),
+        (["--gpu-memory-utilization=0.75"], "gpu_memory_utilization", 0.75),
+        (["--max-num-seqs=64.0"], "max_num_seqs", 64),  # float-form int
+        (["--kv-cache-dtype", "fp8"], "kv_cache_dtype", "fp8"),
+        (["--num-gpu-blocks-override=4096"], "num_gpu_blocks_override", 4096),
+    ])
+    def test_forms(self, args, field, expected):
+        assert getattr(parse_engine_args(deploy(args)), field) == expected
+
+    def test_bool_flag_without_value(self):
+        p = parse_engine_args(deploy(["--enforce-eager", "--block-size=32"]))
+        assert p.enforce_eager is True
+        assert p.block_size == 32
+
+    def test_malformed_values_keep_defaults(self):
+        p = parse_engine_args(deploy([
+            "--block-size=banana", "--gpu-memory-utilization=",
+            "--max-num-seqs", "--tensor-parallel-size=2x"]))
+        assert p.block_size == 16
+        assert p.gpu_memory_utilization == 0.9
+        assert p.max_num_seqs == 256
+        assert p.tensor_parallel_size == 1
+
+    def test_positional_args_skipped(self):
+        p = parse_engine_args(deploy(
+            ["serve", "meta-llama/Llama-3.1-8B", "--block-size=32"]))
+        assert p.block_size == 32
+
+    def test_none_and_empty_deployments(self):
+        assert parse_engine_args(None).effective_max_batched_tokens == 8192
+        empty = Deployment(metadata=ObjectMeta(name="d"),
+                           template=PodTemplateSpec(containers=[]))
+        assert parse_engine_args(empty).effective_max_batched_tokens == 8192
+
+    def test_multi_container_pods_merge(self):
+        p = parse_engine_args(deploy(containers=[
+            Container(name="sidecar", args=["--block-size=64"]),
+            Container(name="srv", args=["--max-num-seqs=32"])]))
+        assert p.block_size == 64
+        assert p.max_num_seqs == 32
+
+
+class TestShellStrings:
+    def test_quoted_model_names_survive(self):
+        p = parse_engine_args(deploy(command=[
+            "/bin/bash", "-c",
+            'vllm serve "org/model with space" --max-model-len 4096']))
+        assert p.max_model_len == 4096
+
+    def test_single_quotes_preserve_double(self):
+        p = parse_engine_args(deploy(command=[
+            "sh", "-c", "serve '--not-a-flag inside' --block-size=8"]))
+        assert p.block_size == 8
+
+    def test_plain_command_without_shell_wrapper(self):
+        p = parse_engine_args(deploy(
+            command=["vllm", "serve", "--block-size=8"]))
+        assert p.block_size == 8
+
+
+class TestEffectiveBatchedTokens:
+    """The resolution chain (reference :246-268): explicit > V1-chunked
+    8192 > V0-chunked 2048 > max_model_len > 2048."""
+
+    def test_explicit_wins(self):
+        p = parse_engine_args(deploy(
+            ["--max-num-batched-tokens=4096", "--max-model-len=32768"]))
+        assert p.effective_max_batched_tokens == 4096
+
+    def test_v1_chunked_default(self):
+        assert parse_engine_args(
+            deploy([])).effective_max_batched_tokens == 8192
+
+    def test_v0_unchunked_uses_model_len(self):
+        p = parse_engine_args(deploy(["--max-model-len=16384"],
+                                     env={"VLLM_USE_V1": "0"}))
+        assert p.effective_max_batched_tokens == 16384
+
+    def test_v0_small_model_len_floors_at_2048(self):
+        p = parse_engine_args(deploy(["--max-model-len=1024"],
+                                     env={"VLLM_USE_V1": "0"}))
+        assert p.effective_max_batched_tokens == 2048
+
+    def test_v0_chunked_reenabled(self):
+        p = parse_engine_args(deploy(["--enable-chunked-prefill"],
+                                     env={"VLLM_USE_V1": "0"}))
+        assert p.effective_max_batched_tokens == 2048  # V0 chunked default
+
+
+class TestJetStream:
+    def test_prefill_lengths_bucket_list(self):
+        p = parse_engine_args(deploy(
+            ["--prefill_lengths=128,256,1024", "--max_target_length=4096"]))
+        assert p.engine == "jetstream"
+        assert p.prefill_lengths == [128, 256, 1024]
+        assert p.effective_max_batched_tokens == 1024  # largest bucket
+        assert p.tokens_per_slot == 4096  # defaults to target length
+
+    def test_prefill_lengths_with_junk_entries(self):
+        p = parse_engine_args(deploy(["--prefill_lengths=128,x,512"]))
+        assert p.prefill_lengths == [128, 512]
+
+    def test_defaults_applied_when_unset(self):
+        p = parse_engine_args(deploy(["--tpu_topology=2x4"]))
+        assert p.engine == "jetstream"
+        assert p.max_concurrent_decodes == 96
+        assert p.max_target_length == 2048
+        assert p.max_num_seqs == 96  # S = decode slots, not the vLLM default
+
+    def test_explicit_prefill_budget_wins_over_buckets(self):
+        p = parse_engine_args(deploy(
+            ["--max_prefill_predict_length=2048", "--prefill_lengths=128"]))
+        assert p.effective_max_batched_tokens == 2048
+
+
+class TestCapacityCompatibility:
+    def base(self, *extra):
+        return parse_engine_args(deploy(["--block-size=16", *extra]))
+
+    def test_equal_configs_compatible(self):
+        assert self.base().is_capacity_compatible(self.base())
+
+    @pytest.mark.parametrize("extra", [
+        ["--block-size=32"],
+        ["--gpu-memory-utilization=0.5"],
+        ["--tensor-parallel-size=2"],
+        ["--num-gpu-blocks-override=128"],
+        ["--max-num-batched-tokens=1024"],
+        ["--kv-cache-dtype=fp8"],
+    ])
+    def test_capacity_knob_changes_break_compat(self, extra):
+        assert not self.base().is_capacity_compatible(self.base(*extra))
+
+    def test_cross_engine_incompatible(self):
+        vllm = self.base()
+        js = parse_engine_args(deploy(["--tpu_topology=2x4"]))
+        assert not vllm.is_capacity_compatible(js)
+        assert not js.is_capacity_compatible(vllm)
+
+    def test_none_incompatible(self):
+        assert not self.base().is_capacity_compatible(None)
+
+    def test_jetstream_topology_change_breaks_compat(self):
+        a = parse_engine_args(deploy(["--tpu_topology=2x4"]))
+        b = parse_engine_args(deploy(["--tpu_topology=4x4"]))
+        assert not a.is_capacity_compatible(b)
+        assert a.is_capacity_compatible(
+            parse_engine_args(deploy(["--tpu_topology=2x4"])))
+
+    def test_noncapacity_knobs_do_not_break_compat(self):
+        # enforce_eager affects latency, not KV capacity.
+        assert self.base().is_capacity_compatible(
+            self.base("--enforce-eager"))
