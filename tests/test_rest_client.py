@@ -364,6 +364,89 @@ class TestKubeconfig:
         creds = resolve_credentials(str(path))
         assert creds.server == "http://localhost:1"
 
+    def test_inline_data_materialized_and_cleaned_up(self, tmp_path):
+        """certificate-authority-data / client-*-data blobs become temp
+        files (ssl wants paths) and cleanup() removes them — private key
+        material must not linger."""
+        import base64
+        import os
+
+        from wva_tpu.k8s.kubeconfig import kubeconfig_credentials
+
+        b64 = base64.b64encode(b"PEMISH").decode()
+        path = tmp_path / "config"
+        path.write_text(json.dumps({
+            "current-context": "c",
+            "contexts": [{"name": "c",
+                          "context": {"cluster": "k", "user": "u"}}],
+            "clusters": [{"name": "k",
+                          "cluster": {"server": "https://h:6443",
+                                      "certificate-authority-data": b64}}],
+            "users": [{"name": "u",
+                       "user": {"client-certificate-data": b64,
+                                "client-key-data": b64}}],
+        }))
+        creds = kubeconfig_credentials(str(path))
+        files = [creds.ca_file, creds.client_cert_file, creds.client_key_file]
+        assert all(os.path.exists(f) for f in files)
+        assert open(creds.ca_file, "rb").read() == b"PEMISH"
+        creds.cleanup()
+        assert not any(os.path.exists(f) for f in files)
+
+    def test_token_file_reread_per_request(self, tmp_path):
+        """BoundServiceAccountToken rotation: bearer_token() re-reads the
+        file so a projected-token refresh is picked up without restart."""
+        from wva_tpu.k8s.kubeconfig import Credentials
+
+        tok = tmp_path / "token"
+        tok.write_text("first\n")
+        creds = Credentials(server="https://h", token_file=str(tok),
+                            token="fallback")
+        assert creds.bearer_token() == "first"
+        tok.write_text("rotated\n")
+        assert creds.bearer_token() == "rotated"
+        tok.unlink()
+        assert creds.bearer_token() == "fallback"  # unreadable -> static
+
+    def test_in_cluster_credentials(self, tmp_path, monkeypatch):
+        from wva_tpu.k8s import kubeconfig as kc
+
+        sa = tmp_path / "serviceaccount"
+        sa.mkdir()
+        (sa / "token").write_text("sa-token")
+        (sa / "ca.crt").write_text("CA")
+        monkeypatch.setattr(kc, "SERVICEACCOUNT_DIR", str(sa))
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "6443")
+        creds = kc.in_cluster_credentials()
+        assert creds.server == "https://10.0.0.1:6443"
+        assert creds.bearer_token() == "sa-token"
+        assert creds.ca_file == str(sa / "ca.crt")
+
+    def test_in_cluster_raises_outside_cluster(self, tmp_path, monkeypatch):
+        from wva_tpu.k8s import kubeconfig as kc
+
+        monkeypatch.setattr(kc, "SERVICEACCOUNT_DIR", str(tmp_path / "nope"))
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        with pytest.raises(kc.CredentialError):
+            kc.in_cluster_credentials()
+
+    def test_resolve_prefers_in_cluster_over_home_config(
+            self, tmp_path, monkeypatch):
+        """client-go loading order: no explicit path / $KUBECONFIG ->
+        in-cluster wins over ~/.kube/config."""
+        from wva_tpu.k8s import kubeconfig as kc
+
+        sa = tmp_path / "serviceaccount"
+        sa.mkdir()
+        (sa / "token").write_text("sa-token")
+        monkeypatch.setattr(kc, "SERVICEACCOUNT_DIR", str(sa))
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.2")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+        monkeypatch.delenv("KUBECONFIG", raising=False)
+        creds = kc.resolve_credentials()
+        assert creds.server == "https://10.0.0.2:443"
+
 
 class TestHTTPEndpoints:
     def _fetch(self, url, token=""):

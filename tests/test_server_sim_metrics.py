@@ -1,0 +1,74 @@
+"""Direct tests for the serving sim's SLO measurement functions.
+
+The north-star benchmark's headline value IS ``slo_attainment`` /
+``ttft_percentile`` over the sim's samples (bench.py), so their semantics —
+arrival-window bounding, survivorship-bias handling, percentile indexing —
+must be pinned independently of the harness runs that consume them.
+"""
+
+import pytest
+
+from wva_tpu.emulator.server_sim import ModelServerSim, ServingParams
+from wva_tpu.collector.source.promql import TimeSeriesDB
+from wva_tpu.utils.clock import FakeClock
+
+
+def make_sim(clock=None):
+    clock = clock or FakeClock(start=0.0)
+    sim = ModelServerSim("m", "inference", ServingParams(),
+                         TimeSeriesDB(clock=clock))
+    return sim
+
+
+class TestSLOMeasurement:
+    def seed(self, sim, samples):
+        """(arrival_ts, ttft_s) pairs injected as served requests."""
+        sim.ttft_samples.extend(samples)
+
+    def test_attainment_counts_window_arrivals_only(self):
+        sim = make_sim()
+        self.seed(sim, [(10.0, 0.1), (20.0, 5.0), (30.0, 0.2), (99.0, 9.0)])
+        # Window [15, 95): one met (0.2) and one miss (5.0).
+        assert sim.slo_attainment(1.0, since=15.0, until=95.0) == 0.5
+        # Full horizon: 2 met, 2 missed.
+        assert sim.slo_attainment(1.0) == 0.5
+
+    def test_unserved_requests_count_as_misses(self):
+        """Survivorship bias guard: a starving fleet can't report 1.0 by
+        never serving the queued tail."""
+        clock = FakeClock(start=0.0)
+        sim = make_sim(clock)
+        self.seed(sim, [(10.0, 0.1)])
+
+        class _Stuck:
+            arrived_at = 20.0
+
+        sim._unserved_requests = lambda: [_Stuck()]
+        assert sim.slo_attainment(1.0) == pytest.approx(0.5)
+
+    def test_empty_window_is_vacuous_success(self):
+        assert make_sim().slo_attainment(1.0, since=100.0) == 1.0
+
+    def test_percentile_orders_and_bounds(self):
+        sim = make_sim()
+        self.seed(sim, [(float(i), float(i)) for i in range(1, 101)])
+        assert sim.ttft_percentile(50.0) == pytest.approx(51.0)
+        assert sim.ttft_percentile(99.0) == pytest.approx(100.0)
+        assert sim.ttft_percentile(0.0) == pytest.approx(1.0)
+
+    def test_percentile_counts_unserved_age_as_lower_bound(self):
+        clock = FakeClock(start=0.0)
+        sim = make_sim(clock)
+        self.seed(sim, [(0.0, 0.1)] * 9)
+
+        class _Stuck:
+            arrived_at = 0.0
+
+        sim._unserved_requests = lambda: [_Stuck()]
+        # At now=500 the unserved request's age (500s) dominates p99.
+        assert sim.ttft_percentile(99.0, now=500.0) == pytest.approx(500.0)
+
+    def test_percentile_until_bounds_arrival_window(self):
+        sim = make_sim()
+        self.seed(sim, [(10.0, 1.0), (200.0, 50.0)])
+        assert sim.ttft_percentile(99.0, until=100.0) == pytest.approx(1.0)
