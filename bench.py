@@ -29,7 +29,13 @@ The solver microbench jits ``size_batch`` over 1k/8k candidate batches on
 the default JAX platform (the real TPU chip under the driver) and reports
 compile time, execute time, candidates/s, and the speedup over the scalar
 per-candidate facade (the reference solves one candidate at a time:
-pkg/analyzer/queueanalyzer.go:127-258).
+pkg/analyzer/queueanalyzer.go:127-258) — for both bisection backends (XLA
+fori_loop and the fused Pallas kernel), quoting the best.
+
+``detail.variant_choice`` adds the cost axis (BASELINE config 4): the same
+ramp served by a v5e-8+v5p-8 fleet under the cost-aware path vs a
+v5p-only fleet, reporting SLO attainment and integrated cost per 1k
+requests for each.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 """
@@ -83,21 +89,23 @@ FAST_HPA = dict(stabilization_up_seconds=10.0,
                 sync_period_seconds=10.0)
 
 
-def _slo_config_data():
+def _slo_config_data(model_id: str = MODEL, profiles=None):
     from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms, TargetPerf
     from wva_tpu.config.slo import SLOConfigData, ServiceClass
 
-    return SLOConfigData(
-        service_classes=[ServiceClass(
-            name="premium", priority=1,
-            model_targets={MODEL: TargetPerf(
-                target_ttft_ms=SLO_TTFT_SECONDS * 1000.0)})],
-        profiles=[PerfProfile(
-            model_id=MODEL, accelerator="v5e-8",
+    if profiles is None:
+        profiles = [PerfProfile(
+            model_id=model_id, accelerator="v5e-8",
             service_parms=ServiceParms(alpha=PROFILE_ALPHA_MS,
                                        beta=PROFILE_BETA,
                                        gamma=PROFILE_GAMMA),
-            max_batch_size=96, max_queue_size=384)])
+            max_batch_size=96, max_queue_size=384)]
+    return SLOConfigData(
+        service_classes=[ServiceClass(
+            name="premium", priority=1,
+            model_targets={model_id: TargetPerf(
+                target_ttft_ms=SLO_TTFT_SECONDS * 1000.0)})],
+        profiles=profiles)
 
 
 def run_policy(name: str) -> dict:
@@ -230,6 +238,110 @@ def run_policy(name: str) -> dict:
     }
 
 
+MIXTRAL = "mistralai/Mixtral-8x7B-Instruct-v0.1"
+
+
+def variant_choice_bench() -> dict:
+    """BASELINE config 4 (Mixtral variant choice): one model served by
+    v5e-8 (cheap) AND v5p-8 (2x faster per replica, 3x the cost). The
+    cost-aware path must serve the same ramp within SLO at materially
+    lower cost than a v5p-only fleet — the cost axis the headline
+    attainment metric doesn't capture. Cost and the request denominator
+    both cover the post-warm window (ramp + hold; 1s steps)."""
+    from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms
+
+    warm, ramp_s, hold = 120.0, 300.0, 480.0
+    peak = 60.0
+    profiles = [
+        PerfProfile(model_id=MIXTRAL, accelerator="v5e-8",
+                    service_parms=ServiceParms(alpha=PROFILE_ALPHA_MS,
+                                               beta=PROFILE_BETA,
+                                               gamma=PROFILE_GAMMA),
+                    max_batch_size=96, max_queue_size=384),
+        PerfProfile(model_id=MIXTRAL, accelerator="v5p-8",
+                    service_parms=ServiceParms(alpha=PROFILE_ALPHA_MS / 2,
+                                               beta=PROFILE_BETA / 2,
+                                               gamma=PROFILE_GAMMA / 2),
+                    max_batch_size=96, max_queue_size=384),
+    ]
+
+    def run(variants):
+        sat_cfg = SaturationScalingConfig(
+            analyzer_name="slo",
+            anticipation_horizon_seconds=STARTUP_SECONDS + 30.0,
+            burst_slope_rps=(peak - BASE_RATE) / ramp_s,
+            enable_limiter=True, fast_actuation=True)
+        sat_cfg.apply_defaults()
+        # Same fast metrics pipeline as run_policy("ours") — the window is
+        # baked at harness construction, so set it around construction.
+        os.environ["WVA_SLO_ARRIVAL_RATE_WINDOW"] = "30s"
+        harness = EmulationHarness(
+            variants, saturation_config=sat_cfg,
+            nodepools=[("v5e-pool", "v5e", "2x4", 8),
+                       ("v5p-pool", "v5p", "2x4", 8)],
+            startup_seconds=STARTUP_SECONDS, engine_interval=5.0)
+        os.environ.pop("WVA_SLO_ARRIVAL_RATE_WINDOW", None)
+        harness.config.update_slo_config(
+            _slo_config_data(MIXTRAL, profiles))
+        cost = {"v": 0.0}
+        served_at_warm = {"v": None}
+
+        def total_served(h):
+            return sum(r.success_total
+                       for r in h.sim_of_model(MIXTRAL)._replicas.values())
+
+        def watch(h, t):
+            if t >= warm:
+                if served_at_warm["v"] is None:
+                    served_at_warm["v"] = total_served(h)
+                cost["v"] += sum(h.replicas_of(s.name) * s.cost
+                                 for s in variants)  # cost-units x 1s steps
+
+        harness.run(warm + ramp_s + hold, on_step=watch)
+        sim = harness.sim_of_model(MIXTRAL)
+        start = harness.start_time + warm
+        # Numerator and denominator cover the SAME post-warm window.
+        served = int(total_served(harness) - (served_at_warm["v"] or 0))
+        return {
+            "slo_attainment": round(
+                sim.slo_attainment(SLO_TTFT_SECONDS, since=start), 4),
+            "cost_unit_seconds": round(cost["v"], 0),
+            "cost_per_1k_requests": round(cost["v"] / max(served, 1) * 1000, 1),
+            "replicas_end": {s.name: harness.replicas_of(s.name)
+                             for s in variants},
+        }
+
+    hpa = HPAParams(**FAST_HPA)
+    load = ramp(BASE_RATE, peak, ramp_s, hold=hold, delay=warm)
+    v5e = VariantSpec(name="mixtral-v5e", model_id=MIXTRAL,
+                      accelerator="v5e-8", chips_per_replica=8, cost=8.0,
+                      initial_replicas=1,
+                      serving=ServingParams(engine="jetstream"),
+                      load=load, hpa=hpa)
+    v5p_spec = dict(model_id=MIXTRAL, accelerator="v5p-8",
+                    chips_per_replica=8, cost=24.0,
+                    serving=ServingParams(engine="jetstream",
+                                          itl_seconds=0.01,
+                                          prefill_tokens_per_second=16000.0),
+                    hpa=hpa)
+    v5p_variant = VariantSpec(name="mixtral-v5p", initial_replicas=0,
+                              load=None, **v5p_spec)
+    ours = run([v5e, v5p_variant])
+    v5p_only = run([VariantSpec(name="mixtral-v5p", initial_replicas=1,
+                                load=load, **v5p_spec)])
+    savings = 1.0 - (ours["cost_per_1k_requests"]
+                     / max(v5p_only["cost_per_1k_requests"], 1e-9))
+    return {"ours": ours, "v5p_only": v5p_only,
+            "cost_savings_frac": round(savings, 3),
+            "scenario": {"model": MIXTRAL,
+                         "ramp": f"{BASE_RATE:.0f}->{peak:.0f} req/s over "
+                                 f"{ramp_s:.0f}s, hold {hold:.0f}s",
+                         # Derived from the specs — the metadata can't lie.
+                         "costs_per_replica": {
+                             v5e.accelerator: v5e.cost,
+                             v5p_variant.accelerator: v5p_variant.cost}}}
+
+
 def solver_microbench() -> dict:
     """The flagship compiled computation on the default JAX platform (the
     real chip under the driver): batched SLO sizing throughput.
@@ -297,33 +409,58 @@ def solver_microbench() -> dict:
     # the kernel natively (Mosaic); everywhere else size_batch routes
     # pallas through the interpreter — emulation timings, not a perf path.
     impls = ("xla", "pallas") if platform == "tpu" else ("xla",)
-    for n in (1024, 8192):
-        args = batch(n)
-        best = None
+    batches = {n: batch(n) for n in (1024, 8192)}
+    compile_s: dict = {}
+    exec_best: dict = {}
+    # Two sweeps spaced apart, keeping the best exec per (batch, impl):
+    # the shared chip/tunnel has multi-minute contention windows that
+    # slowed a full sweep ~20x in testing; contention only ever slows a
+    # measurement, so min-over-sweeps estimates true capability (same
+    # logic as the min-of-3 walls within a sweep).
+    sweeps = 2 if platform == "tpu" else 1
+    wall_best: dict = {}
+    for sweep in range(sweeps):
+        if sweep:
+            time.sleep(20.0)
+        for n, args in batches.items():
+            for impl in impls:
+                if (n, impl) not in compile_s:
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(size_batch(*args, impl=impl))
+                    compile_s[(n, impl)] = time.perf_counter() - t0
+                for reps in (reps_lo, reps_hi):
+                    np.asarray(repeat_solve(*args, reps=reps, impl=impl))
+                    # min-of-3 per sweep; the cross-sweep min is taken on
+                    # the WALLS (contention only ever inflates a wall),
+                    # never on the slope — min of a signed difference
+                    # would prefer a corrupted sweep whose reps_lo wall
+                    # got inflated.
+                    wall = min(
+                        _timed(lambda: np.asarray(
+                            repeat_solve(*args, reps=reps, impl=impl)))
+                        for _ in range(3))
+                    key = (n, impl, reps)
+                    if key not in wall_best or wall < wall_best[key]:
+                        wall_best[key] = wall
+    for (n, impl), _cs in compile_s.items():
+        exec_best[(n, impl)] = max(
+            (wall_best[(n, impl, reps_hi)] - wall_best[(n, impl, reps_lo)])
+            / (reps_hi - reps_lo),
+            1e-9)  # guard: a pathological wall pair must not divide by <= 0
+    for n in batches:
         per_impl = {}
+        best = None
         for impl in impls:
-            t0 = time.perf_counter()
-            jax.block_until_ready(size_batch(*args, impl=impl))
-            compile_s = time.perf_counter() - t0
-            walls = {}
-            for reps in (reps_lo, reps_hi):
-                np.asarray(repeat_solve(*args, reps=reps, impl=impl))
-                # min-of-3: the tunnel round-trip and chip contention vary
-                # run to run; the slope of minima is the stable estimator.
-                walls[reps] = min(
-                    _timed(lambda: np.asarray(
-                        repeat_solve(*args, reps=reps, impl=impl)))
-                    for _ in range(3))
-            exec_s = (walls[reps_hi] - walls[reps_lo]) / (reps_hi - reps_lo)
+            exec_s = exec_best[(n, impl)]
             per_impl[impl] = {
-                "compile_s": round(compile_s, 3),
+                "compile_s": round(compile_s[(n, impl)], 3),
                 "execute_s": round(exec_s, 6),
                 "candidates_per_s": int(n / exec_s),
             }
             if best is None or exec_s < best[1]:
                 best = (impl, exec_s)
         out[f"batch_{n}"] = {**per_impl[best[0]], "impl": best[0],
-                             "per_impl": per_impl}
+                             "per_impl": per_impl, "sweeps": sweeps}
 
     # Scalar facade (one candidate at a time — the reference's solve shape,
     # pkg/analyzer/queueanalyzer.go:127-258) for the batching speedup.
@@ -466,6 +603,7 @@ def main() -> None:
     baseline = run_policy("baseline")
     baseline_fast = run_policy("baseline-fast")
     ours = run_policy("ours")
+    variant_choice = variant_choice_bench()
     solver = solver_microbench()
     wall = time.time() - t0
 
@@ -484,6 +622,7 @@ def main() -> None:
             "ours": ours,
             "baseline": baseline,
             "baseline_fast": baseline_fast,
+            "variant_choice": variant_choice,
             "solver_microbench": solver,
             "device_probe": device_probe,
             "scenario": {

@@ -72,6 +72,29 @@ class TestFullInstall:
         assert cfg.enable_limiter is True
         assert cfg.anticipation_horizon_seconds == 150.0
         assert cfg.analyzer_name == "saturation"
+        # Burst-insurance knobs are omitted by default...
+        assert "burstSlopeRps" not in parsed
+        assert "headroomReplicas" not in parsed
+        assert cfg.burst_slope_rps == 0.0
+
+    def test_saturation_configmap_renders_burst_insurance_when_set(self):
+        """The documented values.yaml knobs must actually reach the
+        rendered ConfigMap (a doc'd-but-unrendered knob is a dead knob)."""
+        from wva_tpu.interfaces import SaturationScalingConfig
+
+        docs = Renderer(CHART, set_values={
+            "wva.analyzer": "slo",
+            "wva.capacityScaling.burstSlopeRps": "0.3",
+            "wva.capacityScaling.headroomReplicas": "1"}).render_docs()
+        cm = next(d for d in docs
+                  if d["kind"] == "ConfigMap"
+                  and d["metadata"]["name"] == "wva-saturation-scaling-config")
+        parsed = yaml.safe_load(cm["data"]["default"])
+        cfg = SaturationScalingConfig.from_dict(parsed)
+        cfg.apply_defaults()
+        cfg.validate()
+        assert cfg.burst_slope_rps == 0.3
+        assert cfg.headroom_replicas == 1
 
     def test_hpa_reads_the_wva_gauge_with_reference_defaults(self):
         docs = Renderer(CHART).render_docs()
