@@ -71,9 +71,12 @@ class TestPallasBisectionEquivalence:
 
     @pytest.mark.slow
     def test_chunked_driver_threads_impl(self):
-        # C > _SIZE_CHUNK exercises the lax.map chunk path with the pallas
-        # body (small k keeps the CPU interpreter run fast).
-        n = _SIZE_CHUNK + 64
+        # C > the PALLAS chunk bound exercises the lax.map chunk path with
+        # the pallas body, including padding (small k keeps the CPU
+        # interpreter run fast).
+        from wva_tpu.analyzers.queueing.queue_model import _SIZE_CHUNK_PALLAS
+
+        n = _SIZE_CHUNK_PALLAS + 64
         _assert_equivalent(_random_batch(n, seed=5, k_hi=192), k_cols=256)
 
     def test_rates_are_positive_and_within_bounds(self):
