@@ -89,6 +89,21 @@ FAST_HPA = dict(stabilization_up_seconds=10.0,
                 sync_period_seconds=10.0)
 
 
+import contextlib  # noqa: E402
+
+
+@contextlib.contextmanager
+def _arrival_rate_window(window: str = "30s"):
+    """The TPU build's fast metrics pipeline pairing (chart: 10s scrape +
+    30s window). The window is baked into the query registration at
+    harness construction, so wrap construction in this context."""
+    os.environ["WVA_SLO_ARRIVAL_RATE_WINDOW"] = window
+    try:
+        yield
+    finally:
+        os.environ.pop("WVA_SLO_ARRIVAL_RATE_WINDOW", None)
+
+
 def _slo_config_data(model_id: str = MODEL, profiles=None):
     from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms, TargetPerf
     from wva_tpu.config.slo import SLOConfigData, ServiceClass
@@ -158,19 +173,15 @@ def run_policy(name: str) -> dict:
                   delay=WARMUP_SECONDS),
         hpa=hpa,
     )
-    if name == "ours":
-        # The TPU build's shipped defaults pair a fast metrics pipeline with
-        # a short arrival-rate window (chart: 10s scrape + 30s window); the
-        # emulator scrapes every second, so the pairing holds here.
-        os.environ["WVA_SLO_ARRIVAL_RATE_WINDOW"] = "30s"
-    harness = EmulationHarness(
-        [spec],
-        saturation_config=sat_cfg,
-        nodepools=[("v5e-pool", "v5e", "2x4", 8)],
-        startup_seconds=STARTUP_SECONDS,
-        engine_interval=engine_interval,
-    )
-    os.environ.pop("WVA_SLO_ARRIVAL_RATE_WINDOW", None)
+    with _arrival_rate_window() if name == "ours" \
+            else contextlib.nullcontext():
+        harness = EmulationHarness(
+            [spec],
+            saturation_config=sat_cfg,
+            nodepools=[("v5e-pool", "v5e", "2x4", 8)],
+            startup_seconds=STARTUP_SECONDS,
+            engine_interval=engine_interval,
+        )
     if name == "ours":
         harness.config.update_slo_config(_slo_config_data())
 
@@ -272,15 +283,12 @@ def variant_choice_bench() -> dict:
             burst_slope_rps=(peak - BASE_RATE) / ramp_s,
             enable_limiter=True, fast_actuation=True)
         sat_cfg.apply_defaults()
-        # Same fast metrics pipeline as run_policy("ours") — the window is
-        # baked at harness construction, so set it around construction.
-        os.environ["WVA_SLO_ARRIVAL_RATE_WINDOW"] = "30s"
-        harness = EmulationHarness(
-            variants, saturation_config=sat_cfg,
-            nodepools=[("v5e-pool", "v5e", "2x4", 8),
-                       ("v5p-pool", "v5p", "2x4", 8)],
-            startup_seconds=STARTUP_SECONDS, engine_interval=5.0)
-        os.environ.pop("WVA_SLO_ARRIVAL_RATE_WINDOW", None)
+        with _arrival_rate_window():
+            harness = EmulationHarness(
+                variants, saturation_config=sat_cfg,
+                nodepools=[("v5e-pool", "v5e", "2x4", 8),
+                           ("v5p-pool", "v5p", "2x4", 8)],
+                startup_seconds=STARTUP_SECONDS, engine_interval=5.0)
         harness.config.update_slo_config(
             _slo_config_data(MIXTRAL, profiles))
         cost = {"v": 0.0}
@@ -340,6 +348,83 @@ def variant_choice_bench() -> dict:
                          "costs_per_replica": {
                              v5e.accelerator: v5e.cost,
                              v5p_variant.accelerator: v5p_variant.cost}}}
+
+
+LLAMA70B = "meta-llama/Llama-3-70B"
+
+
+def multihost_bench() -> dict:
+    """BASELINE config 3: Llama-3-70B on multi-host v5e-16 slices
+    (LeaderWorkerSet, 2 hosts x 8 chips scaling atomically — a replica is
+    ready only when BOTH hosts are). Measures SLO attainment and 1->N
+    whole-slice scale-up latency under the SLO path with burst
+    insurance, the multi-host counterpart of the headline scenario."""
+    from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms
+
+    warm, ramp_s, hold = 120.0, 300.0, 480.0
+    peak = 40.0
+    sat_cfg = SaturationScalingConfig(
+        analyzer_name="slo",
+        anticipation_horizon_seconds=STARTUP_SECONDS + 30.0,
+        burst_slope_rps=(peak - BASE_RATE) / ramp_s,
+        enable_limiter=True, fast_actuation=True)
+    sat_cfg.apply_defaults()
+    spec = VariantSpec(
+        name="llama70b-v5e16", model_id=LLAMA70B, accelerator="v5e-16",
+        chips_per_replica=8,  # per host
+        hosts_per_slice=2, cost=16.0, initial_replicas=1,
+        serving=ServingParams(engine="jetstream"),
+        load=ramp(BASE_RATE, peak, ramp_s, hold=hold, delay=warm),
+        hpa=HPAParams(**FAST_HPA))
+    with _arrival_rate_window():
+        harness = EmulationHarness(
+            [spec], saturation_config=sat_cfg,
+            # "4x4" = 16 chips = 2 x 8-chip hosts per slice -> variant
+            # v5e-16 (the slice limiter allocates whole slices per
+            # variant, so the pool topology must derive the SAME variant
+            # the VA is labeled with — "4x8" would be v5e-32 and leave
+            # zero placeable slices).
+            nodepools=[("v5e-pool", "v5e", "4x4", 8)],
+            startup_seconds=STARTUP_SECONDS, engine_interval=5.0)
+    harness.config.update_slo_config(_slo_config_data(
+        LLAMA70B, [PerfProfile(
+            model_id=LLAMA70B, accelerator="v5e-16",
+            service_parms=ServiceParms(alpha=PROFILE_ALPHA_MS,
+                                       beta=PROFILE_BETA,
+                                       gamma=PROFILE_GAMMA),
+            max_batch_size=96, max_queue_size=384)]))
+    ready_3 = {"t": None}
+    peak_groups = {"v": 1}
+
+    def watch(h, t):
+        ready = h.ready_replicas_of(spec.name)
+        if ready >= 3 and ready_3["t"] is None and t >= warm:
+            ready_3["t"] = t - warm
+        peak_groups["v"] = max(peak_groups["v"], h.replicas_of(spec.name))
+
+    harness.run(warm + ramp_s + hold, on_step=watch)
+    sim = harness.sim_of_model(LLAMA70B)
+    start = harness.start_time + warm
+    lws = harness.cluster.get("LeaderWorkerSet", harness.namespace, spec.name)
+    # The whole-group invariant, actually verified: count pods the LWS
+    # owns and compare against groups x hosts (restating replicas*2 would
+    # report the invariant as holding even when pods are orphaned).
+    owned_pods = sum(
+        1 for p in harness.cluster.list("Pod", namespace=harness.namespace)
+        if any(r.get("kind") == "LeaderWorkerSet" and r.get("name") == spec.name
+               for r in p.metadata.owner_references))
+    return {
+        "slo_attainment": round(
+            sim.slo_attainment(SLO_TTFT_SECONDS, since=start), 4),
+        "time_to_3_ready_slices_s": ready_3["t"],
+        "peak_slices": peak_groups["v"],
+        "chips_peak": peak_groups["v"] * 16,
+        "pods_per_slice": 2,
+        "whole_group_invariant_holds": owned_pods == lws.status.replicas * 2,
+        "scenario": {"model": LLAMA70B, "accelerator": "v5e-16 (LWS, 2 hosts)",
+                     "ramp": f"{BASE_RATE:.0f}->{peak:.0f} req/s over "
+                             f"{ramp_s:.0f}s, hold {hold:.0f}s"},
+    }
 
 
 def solver_microbench() -> dict:
@@ -604,6 +689,7 @@ def main() -> None:
     baseline_fast = run_policy("baseline-fast")
     ours = run_policy("ours")
     variant_choice = variant_choice_bench()
+    multihost = multihost_bench()
     solver = solver_microbench()
     wall = time.time() - t0
 
@@ -623,6 +709,7 @@ def main() -> None:
             "baseline": baseline,
             "baseline_fast": baseline_fast,
             "variant_choice": variant_choice,
+            "multihost": multihost,
             "solver_microbench": solver,
             "device_probe": device_probe,
             "scenario": {

@@ -172,6 +172,32 @@ class TestMultiHostE2E:
         lws = h.cluster.get("LeaderWorkerSet", h.namespace, "llama70b-v5e16")
         assert len(pods) == lws.status.replicas * 2
 
+    def test_slice_limiter_places_multihost_slices(self):
+        """The slice inventory must derive the SAME variant for a
+        multi-host pool that the VA is labeled with: a v5e-16 workload on
+        a 4x4-topology pool (16 chips = 2 x 8-chip hosts) scales under
+        the limiter. Regression: a topology producing a different variant
+        (e.g. 4x8 -> v5e-32) leaves zero placeable v5e-16 slices and the
+        limiter silently clamps every scale-up to current."""
+        from wva_tpu.interfaces import SaturationScalingConfig
+
+        spec = VariantSpec(
+            name="llama70b-v5e16", model_id=MODEL, accelerator="v5e-16",
+            chips_per_replica=8, hosts_per_slice=2, cost=16.0,
+            initial_replicas=1, serving=ServingParams(),
+            load=ramp(2.0, 40.0, 300.0, hold=1e9),
+            hpa=HPAParams(stabilization_up_seconds=30.0,
+                          stabilization_down_seconds=60.0,
+                          sync_period_seconds=15.0))
+        h = EmulationHarness(
+            [spec],
+            saturation_config=SaturationScalingConfig(enable_limiter=True),
+            nodepools=[("v5e-pool", "v5e", "4x4", 8)],
+            startup_seconds=60.0)
+        h.run(1200)
+        assert h.replicas_of("llama70b-v5e16") > 1, \
+            "limiter must place whole v5e-16 slices from the 4x4 pool"
+
     def test_engine_variant_state_reports_group_semantics(self):
         """chips_per_replica = hosts x per-host chips; pending counts
         not-fully-ready groups."""

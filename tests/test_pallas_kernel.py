@@ -15,7 +15,7 @@ import pytest
 import jax.numpy as jnp
 
 from wva_tpu.analyzers.queueing.queue_model import (
-    _SIZE_CHUNK,
+    _SIZE_CHUNK_PALLAS,
     candidate_batch,
     size_batch,
 )
@@ -74,8 +74,6 @@ class TestPallasBisectionEquivalence:
         # C > the PALLAS chunk bound exercises the lax.map chunk path with
         # the pallas body, including padding (small k keeps the CPU
         # interpreter run fast).
-        from wva_tpu.analyzers.queueing.queue_model import _SIZE_CHUNK_PALLAS
-
         n = _SIZE_CHUNK_PALLAS + 64
         _assert_equivalent(_random_batch(n, seed=5, k_hi=192), k_cols=256)
 
