@@ -413,14 +413,16 @@ def multihost_bench() -> dict:
         1 for p in harness.cluster.list("Pod", namespace=harness.namespace)
         if any(r.get("kind") == "LeaderWorkerSet" and r.get("name") == spec.name
                for r in p.metadata.owner_references))
+    chips_per_slice = spec.chips_per_replica * spec.hosts_per_slice
     return {
         "slo_attainment": round(
             sim.slo_attainment(SLO_TTFT_SECONDS, since=start), 4),
         "time_to_3_ready_slices_s": ready_3["t"],
         "peak_slices": peak_groups["v"],
-        "chips_peak": peak_groups["v"] * 16,
-        "pods_per_slice": 2,
-        "whole_group_invariant_holds": owned_pods == lws.status.replicas * 2,
+        "chips_peak": peak_groups["v"] * chips_per_slice,
+        "pods_per_slice": spec.hosts_per_slice,
+        "whole_group_invariant_holds": (
+            owned_pods == lws.status.replicas * spec.hosts_per_slice),
         "scenario": {"model": LLAMA70B, "accelerator": "v5e-16 (LWS, 2 hosts)",
                      "ramp": f"{BASE_RATE:.0f}->{peak:.0f} req/s over "
                              f"{ramp_s:.0f}s, hold {hold:.0f}s"},

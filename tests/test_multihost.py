@@ -42,6 +42,30 @@ def tpu_template(chips="8"):
             resources=ResourceRequirements(requests={"google.com/tpu": chips}))])
 
 
+
+def v5e16_world(topology: str, run_s: float):
+    """One v5e-16 LWS variant under ramp load on a pool of the given
+    topology — shared by the matched (4x4) and mismatched (4x8) limiter
+    tests so the spec shape stays in lockstep."""
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    spec = VariantSpec(
+        name="llama70b-v5e16", model_id=MODEL, accelerator="v5e-16",
+        chips_per_replica=8, hosts_per_slice=2, cost=16.0,
+        initial_replicas=1, serving=ServingParams(),
+        load=ramp(2.0, 40.0, 300.0, hold=1e9),
+        hpa=HPAParams(stabilization_up_seconds=30.0,
+                      stabilization_down_seconds=60.0,
+                      sync_period_seconds=15.0))
+    h = EmulationHarness(
+        [spec],
+        saturation_config=SaturationScalingConfig(enable_limiter=True),
+        nodepools=[("v5e-pool", "v5e", topology, 8)],
+        startup_seconds=60.0)
+    h.run(run_s)
+    return h
+
+
 class TestScaleTargetAdapter:
     def test_deployment_state(self):
         d = Deployment(metadata=ObjectMeta(name="d", namespace="ns"),
@@ -179,24 +203,25 @@ class TestMultiHostE2E:
         the limiter. Regression: a topology producing a different variant
         (e.g. 4x8 -> v5e-32) leaves zero placeable v5e-16 slices and the
         limiter silently clamps every scale-up to current."""
-        from wva_tpu.interfaces import SaturationScalingConfig
-
-        spec = VariantSpec(
-            name="llama70b-v5e16", model_id=MODEL, accelerator="v5e-16",
-            chips_per_replica=8, hosts_per_slice=2, cost=16.0,
-            initial_replicas=1, serving=ServingParams(),
-            load=ramp(2.0, 40.0, 300.0, hold=1e9),
-            hpa=HPAParams(stabilization_up_seconds=30.0,
-                          stabilization_down_seconds=60.0,
-                          sync_period_seconds=15.0))
-        h = EmulationHarness(
-            [spec],
-            saturation_config=SaturationScalingConfig(enable_limiter=True),
-            nodepools=[("v5e-pool", "v5e", "4x4", 8)],
-            startup_seconds=60.0)
-        h.run(1200)
+        h = v5e16_world("4x4", 1200)
         assert h.replicas_of("llama70b-v5e16") > 1, \
             "limiter must place whole v5e-16 slices from the 4x4 pool"
+
+    def test_fully_blocked_scale_up_emits_warning_event(self):
+        """The inverse of the placement test: a pool whose topology derives
+        a DIFFERENT variant (4x8 -> v5e-32) leaves zero placeable v5e-16
+        slices; the clamp produces no status change, so the engine must
+        surface a ScaleUpBlocked Warning (otherwise the misconfig is
+        invisible outside logs)."""
+        from wva_tpu.k8s.objects import Event
+
+        h = v5e16_world("4x8", 600)  # 4x8 -> v5e-32: variant mismatch
+        assert h.replicas_of("llama70b-v5e16") == 1, "clamped, as expected"
+        events = [e for e in h.cluster.list(Event.KIND, namespace=h.namespace)
+                  if e.reason == "ScaleUpBlocked"]
+        assert events, "fully blocked scale-up must be surfaced as a Warning"
+        assert events[-1].type == "Warning"
+        assert "v5e-16" in events[-1].message
 
     def test_engine_variant_state_reports_group_semantics(self):
         """chips_per_replica = hosts x per-host chips; pending counts

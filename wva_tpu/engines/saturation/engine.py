@@ -918,6 +918,24 @@ class SaturationEngine:
                 accelerator = update_va.status.desired_optimized_alloc.accelerator
                 reason = "No scaling decision (optimization loop)"
 
+            if (self.recorder is not None and decision is not None
+                    and decision.was_limited
+                    and decision.chips_allocated == 0
+                    and decision.action == ACTION_SCALE_UP):
+                # A FULLY blocked scale-up produces no status change, so
+                # without this Warning it is invisible outside logs — and
+                # zero placeable slices for a variant usually means a
+                # config error (VA accelerator label vs node-pool
+                # topology), not transient pressure. Recorder dedup
+                # aggregates repeats into one event with a count.
+                self.recorder.warning(
+                    update_va, "ScaleUpBlocked",
+                    f"scale-up blocked by "
+                    f"{decision.limited_by or 'slice inventory'}: no "
+                    f"placeable {decision.accelerator_name or 'TPU'} "
+                    "slices (verify the node-pool topology derives this "
+                    "variant and capacity exists)")
+
             prev_material = _status_material(update_va)
             prev_run_time = update_va.status.desired_optimized_alloc.last_run_time
 
