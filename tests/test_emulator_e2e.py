@@ -134,6 +134,23 @@ def test_limiter_caps_at_inventory():
     assert h.replicas_of("llama-v5e") <= 2
 
 
+def test_limited_mode_env_flag_enables_limiter_without_configmap():
+    """WVA_LIMITED_MODE (process-level feature flag) must cap allocations
+    at slice inventory even when the hot-reloadable ConfigMap leaves
+    enableLimiter off — an env-only deployment needs no ConfigMap edit.
+    Regression: the flag was parsed into Config but never consumed."""
+    from wva_tpu.config.config import FeatureFlagsConfig
+
+    cfg = SaturationScalingConfig(enable_limiter=False)
+    h, spec = make_harness(ramp(2.0, 200.0, 200.0, hold=1e9),
+                           saturation_config=cfg,
+                           nodepools=[("v5e-pool", "v5e", "2x4", 2)])
+    h.manager.config.set_features(FeatureFlagsConfig(
+        limited_mode_enabled=True))
+    h.run(1500)
+    assert h.replicas_of("llama-v5e") <= 2
+
+
 def test_target_condition_tracks_deployment_existence():
     """TargetResolved flips False when the scale target is missing and True
     once it exists (reference test/e2e/target_condition_test.go:128-170)."""

@@ -686,3 +686,33 @@ class TestCLIProcess:
                 proc.kill()
                 proc.wait()
             server.shutdown()
+
+
+class TestZeroTimeout:
+    def test_timeout_zero_means_no_timeout(self):
+        """client-go convention: REST_CLIENT_TIMEOUT=0s disables the client
+        timeout; it must NOT become urlopen(timeout=0) (non-blocking
+        sockets, every request failing instantly)."""
+        import urllib.request as ur
+
+        from wva_tpu.k8s.kubeconfig import Credentials
+        from wva_tpu.k8s.rest import RestKubeClient
+
+        seen = {}
+        real = ur.urlopen
+
+        def spy(req, timeout=-1, context=None):
+            seen["timeout"] = timeout
+            raise OSError("stop here")  # no real connection needed
+
+        ur.urlopen = spy
+        try:
+            client = RestKubeClient(
+                Credentials(server="http://127.0.0.1:1"), timeout=0.0)
+            try:
+                client.list("Namespace")
+            except Exception:  # noqa: BLE001 — the spy aborts the call
+                pass
+        finally:
+            ur.urlopen = real
+        assert seen["timeout"] is None

@@ -138,6 +138,7 @@ def main(argv: list[str] | None = None) -> int:
     # Namespace-scoped mode: watch streams hit /namespaces/<ns>/... so RBAC
     # can be a Role and other namespaces' objects are never seen.
     client = RestKubeClient(creds,
+                            timeout=cfg.rest_timeout(),
                             watch_namespace=cfg.watch_namespace() or "")
     try:
         client.list("Namespace")

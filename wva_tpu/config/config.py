@@ -134,6 +134,10 @@ class Config:
         with self._mu:
             return self.infrastructure.watch_namespace
 
+    def rest_timeout(self) -> float:
+        with self._mu:
+            return self.infrastructure.rest_timeout
+
     def metrics_auth_enabled(self) -> bool:
         with self._mu:
             return self.infrastructure.metrics_auth

@@ -372,8 +372,14 @@ class SaturationEngine:
         inventory matters everywhere — unplaceable replicas otherwise sit
         pending forever and keep the anticipated-supply math inflated)."""
         global_cfg = self.config.saturation_config().get("default")
-        if (global_cfg is None or not global_cfg.enable_limiter
-                or self.limiter is None or not decisions):
+        # Two switches, either enables: the hot-reloadable ConfigMap's
+        # enableLimiter, or the process-level WVA_LIMITED_MODE (the
+        # reference's limited-mode deployment flag, cmd flag surface) —
+        # an env-only deployment must not need a ConfigMap edit to cap
+        # allocations at inventory.
+        enabled = ((global_cfg is not None and global_cfg.enable_limiter)
+                   or self.config.limited_mode_enabled())
+        if not enabled or self.limiter is None or not decisions:
             return
         try:
             self.limiter.limit(decisions)

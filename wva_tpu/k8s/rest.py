@@ -109,9 +109,14 @@ class RestKubeClient(KubeClient):
         token = self.credentials.bearer_token()
         if token:
             req.add_header("Authorization", f"Bearer {token}")
+        # client-go convention: a configured timeout of 0 means NO timeout
+        # (urlopen's timeout=0 would mean non-blocking sockets and fail
+        # every request instantly).
+        effective = timeout or self.timeout
         try:
             resp = urllib.request.urlopen(
-                req, timeout=timeout or self.timeout, context=self._ssl)
+                req, timeout=effective if effective > 0 else None,
+                context=self._ssl)
         except urllib.error.HTTPError as e:
             detail = ""
             try:
