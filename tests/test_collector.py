@@ -224,3 +224,152 @@ def test_pod_scraping_no_service():
     cluster = FakeCluster(clock=clock)
     src = PodScrapingSource(cluster, "missing", NS, lambda p: "", clock=clock)
     assert src.refresh(RefreshSpec())["all_metrics"].values == []
+
+
+def test_freshness_classified_from_sample_age():
+    """PROMETHEUS_METRICS_CACHE_{FRESH,STALE,UNAVAILABLE}_THRESHOLD wire
+    through: replica metadata classifies the oldest load-bearing sample's
+    age instead of hardcoding FRESH."""
+    from wva_tpu.config.types import FreshnessThresholds
+    from wva_tpu.interfaces import STALE, UNAVAILABLE
+
+    cluster, tsdb, prom, collector, clock = build_world("vllm")
+    # The load-bearing queries use a 1m range window, so samples older
+    # than ~60s leave the results entirely — the live path can observe
+    # fresh/stale but never unavailable (that band exists for results
+    # served from the stale-on-error cache); pick thresholds inside the
+    # window.
+    collector.freshness = FreshnessThresholds(
+        fresh_threshold=20.0, stale_threshold=45.0,
+        unavailable_threshold=300.0)
+    va = cluster.get("VariantAutoscaling", NS, "llama-v5e")
+    args = dict(
+        deployments={f"{NS}/llama-v5e": cluster.get("Deployment", NS,
+                                                    "llama-v5e")},
+        variant_autoscalings={f"{NS}/llama-v5e": va},
+        variant_costs={f"{NS}/llama-v5e": 40.0})
+
+    fresh = collector.collect_replica_metrics(MODEL, NS, **args)
+    assert fresh and all(m.metadata.freshness == "fresh" for m in fresh)
+
+    clock.advance(30.0)  # samples now 30s old -> stale band (20..45)
+    stale = collector.collect_replica_metrics(MODEL, NS, **args)
+    assert stale and all(m.metadata.freshness == STALE for m in stale)
+    assert all(25 < m.metadata.age_seconds < 35 for m in stale)
+
+    # Past the query window samples vanish rather than classify, so the
+    # UNAVAILABLE band is pinned at the classifier level.
+    from wva_tpu.collector.replica_metrics import _freshness_metadata
+
+    md = _freshness_metadata(collected_at=1000.0, oldest_ts=900.0,
+                             thresholds=collector.freshness)
+    assert md.freshness == UNAVAILABLE and md.age_seconds == 100.0
+
+
+def test_serve_stale_on_error_rides_prometheus_blips():
+    """A failing Prometheus query serves the last good cached result
+    (bounded by the unavailable threshold) instead of erroring the tick;
+    past the bound, the error surfaces."""
+    from wva_tpu.collector.source.prometheus import (
+        InMemoryPromAPI,
+        PrometheusSource,
+    )
+    from wva_tpu.collector.source.query_template import QueryTemplate
+    from wva_tpu.collector.source.source import RefreshSpec
+    from wva_tpu.collector.source import TimeSeriesDB
+    from wva_tpu.config.types import CacheConfig, FreshnessThresholds
+    from wva_tpu.utils.clock import FakeClock
+
+    clock = FakeClock(start=1000.0)
+    db = TimeSeriesDB(clock=clock)
+    db.add_sample("m1", {"a": "b"}, 7.0)
+    api = InMemoryPromAPI(db)
+    src = PrometheusSource(api, CacheConfig(
+        ttl=10.0, freshness=FreshnessThresholds(
+            unavailable_threshold=120.0)), clock=clock)
+    src.query_list().register(QueryTemplate(name="q", template="m1",
+                                            params=[]))
+    good = src.refresh(RefreshSpec(queries=["q"], params={}))["q"]
+    assert not good.has_error()
+
+    def boom(_):
+        raise RuntimeError("prometheus down")
+
+    api_query, api.query = api.query, boom
+    clock.advance(60.0)  # past ttl, inside the unavailable bound
+    served = src.refresh(RefreshSpec(queries=["q"], params={}))["q"]
+    assert not served.has_error()
+    assert served.values[0].value == 7.0
+    assert served.collected_at == good.collected_at  # honest age
+
+    clock.advance(120.0)  # now past the unavailable bound
+    errored = src.refresh(RefreshSpec(queries=["q"], params={}))["q"]
+    assert errored.has_error()
+
+
+def test_background_fetch_expires_stale_specs():
+    """Specs not organically re-seen stop being warmed (a deleted VA's
+    queries must not hit Prometheus forever), and the warmer's own
+    refreshes do not renew them."""
+    from wva_tpu.collector.source.prometheus import (
+        InMemoryPromAPI,
+        PrometheusSource,
+    )
+    from wva_tpu.collector.source.query_template import QueryTemplate
+    from wva_tpu.collector.source.source import RefreshSpec
+    from wva_tpu.collector.source import TimeSeriesDB
+    from wva_tpu.config.types import CacheConfig
+    from wva_tpu.utils.clock import FakeClock
+
+    clock = FakeClock(start=1000.0)
+    db = TimeSeriesDB(clock=clock)
+    db.add_sample("m1", {"a": "b"}, 7.0)
+    src = PrometheusSource(InMemoryPromAPI(db),
+                           CacheConfig(fetch_interval=5.0), clock=clock)
+    src.query_list().register(QueryTemplate(name="q", template="m1",
+                                            params=[]))
+    src.refresh(RefreshSpec(queries=["q"], params={}))
+    assert src.background_fetch_once() == 1
+    # Warmer refreshes must not count as organic sightings.
+    clock.advance(src.SPEC_EXPIRY_SECONDS / 2)
+    assert src.background_fetch_once() == 1
+    clock.advance(src.SPEC_EXPIRY_SECONDS / 2 + 1)
+    assert src.background_fetch_once() == 0  # expired, dropped
+
+
+def test_background_fetch_warms_recent_specs():
+    """PROMETHEUS_METRICS_CACHE_FETCH_INTERVAL wire-through: the warmer
+    re-executes recently seen refresh specs (0 disables the thread)."""
+    import threading
+
+    from wva_tpu.collector.source.prometheus import (
+        InMemoryPromAPI,
+        PrometheusSource,
+    )
+    from wva_tpu.collector.source.query_template import QueryTemplate
+    from wva_tpu.collector.source.source import RefreshSpec
+    from wva_tpu.collector.source import TimeSeriesDB
+    from wva_tpu.config.types import CacheConfig
+
+    db = TimeSeriesDB()
+    db.add_sample("m1", {"a": "b"}, 7.0)
+    calls = {"n": 0}
+    api = InMemoryPromAPI(db)
+    real_query = api.query
+
+    def counting(q):
+        calls["n"] += 1
+        return real_query(q)
+
+    api.query = counting
+    src = PrometheusSource(api, CacheConfig(ttl=30.0, fetch_interval=5.0))
+    src.query_list().register(QueryTemplate(name="q", template="m1", params=[]))
+    src.refresh(RefreshSpec(queries=["q"], params={}))
+    before = calls["n"]
+    assert src.background_fetch_once() == 1  # the remembered spec re-ran
+    assert calls["n"] == before + 1
+    assert src.get("q", {}) is not None  # cache stays warm
+
+    # fetch_interval 0 -> no thread.
+    src0 = PrometheusSource(api, CacheConfig(ttl=30.0, fetch_interval=0.0))
+    assert src0.start_background_fetch(threading.Event()) is None

@@ -38,9 +38,9 @@ from wva_tpu.collector.source.source import (
     MetricsSource,
     RefreshSpec,
 )
+from wva_tpu.config.types import FreshnessThresholds
 from wva_tpu.constants import ACCELERATOR_NAME_LABEL_KEY
 from wva_tpu.interfaces import (
-    FRESH,
     ReplicaMetrics,
     ReplicaMetricsMetadata,
     SchedulerQueueMetrics,
@@ -59,6 +59,9 @@ class MetricsCollectionError(RuntimeError):
 @dataclass
 class _PodData:
     kv_usage: float = 0.0
+    # Oldest sample timestamp among the load-bearing queries (0 = unknown):
+    # drives the freshness classification in the emitted metadata.
+    oldest_ts: float = 0.0
     has_kv: bool = False
     queue_len: int = 0
     has_queue: bool = False
@@ -75,6 +78,17 @@ class _PodData:
     has_slots: bool = False
 
 
+def _freshness_metadata(collected_at: float, oldest_ts: float,
+                        thresholds: FreshnessThresholds) -> ReplicaMetricsMetadata:
+    """Classify the pod's sample age (0 = no timestamped samples -> FRESH,
+    the in-memory-backend case where samples are synthesized at query
+    time)."""
+    age = max(collected_at - oldest_ts, 0.0) if oldest_ts > 0 else 0.0
+    return ReplicaMetricsMetadata(
+        collected_at=collected_at, age_seconds=age,
+        freshness=thresholds.determine_status(age))
+
+
 def _finite(v: float) -> bool:
     return not (math.isnan(v) or math.isinf(v))
 
@@ -85,10 +99,15 @@ def _pod_name(labels: dict[str, str]) -> str:
 
 class ReplicaMetricsCollector:
     def __init__(self, source: MetricsSource, pod_va_mapper: PodVAMapper | None = None,
-                 clock: Clock | None = None) -> None:
+                 clock: Clock | None = None,
+                 freshness: FreshnessThresholds | None = None) -> None:
         self.source = source
         self.pod_va_mapper = pod_va_mapper
         self.clock = clock or SYSTEM_CLOCK
+        # PROMETHEUS_METRICS_CACHE_{FRESH,STALE,UNAVAILABLE}_THRESHOLD:
+        # classifies per-replica sample age into the emitted metadata
+        # (reference source.go staleness helpers).
+        self.freshness = freshness or FreshnessThresholds()
 
     def collect_replica_metrics(
         self,
@@ -132,6 +151,8 @@ class ReplicaMetricsCollector:
             d = data_for(v.labels)
             if d is not None:
                 d.kv_usage, d.has_kv = v.value, True
+                if v.timestamp > 0:
+                    d.oldest_ts = min(d.oldest_ts or v.timestamp, v.timestamp)
 
         queue = results.get(QUERY_QUEUE_LENGTH)
         if queue is not None and queue.has_error():
@@ -140,6 +161,8 @@ class ReplicaMetricsCollector:
             d = data_for(v.labels)
             if d is not None:
                 d.queue_len, d.has_queue = int(v.value), True
+                if v.timestamp > 0:
+                    d.oldest_ts = min(d.oldest_ts or v.timestamp, v.timestamp)
 
         # V2 capacity info: vLLM block config.
         for v in _ok_values(results, QUERY_CACHE_CONFIG_INFO):
@@ -241,8 +264,8 @@ class ReplicaMetricsCollector:
                 generate_backlog=data.generate_backlog,
                 slots_used=data.slots_used,
                 slots_total=data.slots_used + data.slots_available if data.has_slots else 0,
-                metadata=ReplicaMetricsMetadata(
-                    collected_at=collected_at, age_seconds=0.0, freshness=FRESH),
+                metadata=_freshness_metadata(collected_at, data.oldest_ts,
+                                             self.freshness),
             ))
         log.debug("Collected %d replica metrics for %s/%s",
                   len(out), namespace, model_id)

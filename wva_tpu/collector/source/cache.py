@@ -58,13 +58,36 @@ class MetricsCache:
                 return None
             return cached
 
+    def get_stale(self, query_name: str, params: dict[str, str],
+                  max_age: float) -> CachedValue | None:
+        """Entry lookup ignoring the TTL, bounded by ``max_age`` — the
+        serve-stale-on-error path (a Prometheus blip should ride on the
+        last good result rather than skip a whole analysis tick, up to the
+        configured unavailable threshold)."""
+        with self._mu:
+            cached = self._values.get(cache_key(query_name, params))
+            if cached is None or cached.age(self.clock) > max_age:
+                return None
+            return cached
+
     def cleanup(self) -> int:
         """Evict expired entries; returns evicted count."""
         with self._mu:
             return self._cleanup_locked(self.clock.now())
 
+    # Entries are kept past the TTL for get_stale's serve-on-error
+    # fallback; plain get() still refuses anything > ttl. The retention
+    # floor keeps the stale-serve window intact even under a tiny TTL —
+    # callers that need a longer window (the unavailable threshold) set
+    # min_retention accordingly.
+    STALE_RETENTION_FACTOR = 20.0
+    min_retention: float = 0.0
+
     def _cleanup_locked(self, now: float) -> int:
-        expired = [k for k, v in self._values.items() if now - v.cached_at > self.ttl]
+        bound = max(self.ttl * self.STALE_RETENTION_FACTOR,
+                    self.min_retention)
+        expired = [k for k, v in self._values.items()
+                   if now - v.cached_at > bound]
         for k in expired:
             del self._values[k]
         self._last_cleanup = now

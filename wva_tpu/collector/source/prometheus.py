@@ -17,6 +17,7 @@ import json
 import logging
 import math
 import ssl
+import threading
 import urllib.parse
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
@@ -205,9 +206,25 @@ class PrometheusSource(MetricsSource):
         self.api = api
         self.clock = clock or SYSTEM_CLOCK
         cache_cfg = cache_config or CacheConfig(ttl=DEFAULT_CACHE_TTL_SECONDS)
+        self.fetch_interval = cache_cfg.fetch_interval
+        self._freshness = cache_cfg.freshness
         self._cache = MetricsCache(ttl=cache_cfg.ttl,
                                    cleanup_interval=cache_cfg.cleanup_interval,
                                    clock=self.clock)
+        # A tiny TTL must not truncate the configured stale-serve window.
+        self._cache.min_retention = self._freshness.unavailable_threshold
+        # Recently refreshed (queries, params) specs, for the background
+        # cache warmer (bounded LRU; entries expire when not re-seen).
+        # Guarded by _specs_mu: engine threads remember specs while the
+        # warmer thread iterates/expires them.
+        self._recent_specs: dict[str, tuple[float, RefreshSpec]] = {}
+        self._recent_bound = 256
+        self._specs_mu = threading.Lock()
+        # Guard: the warmer's own refreshes must not renew seen_at, or
+        # specs for deleted consumers would be warmed forever. (An organic
+        # refresh racing the brief warming pass may skip one renewal; it
+        # re-registers on its next tick.)
+        self._warming = False
         self._queries = QueryList()
         # In-memory backends are fast + deterministic: run sequentially.
         if concurrent is None:
@@ -230,6 +247,17 @@ class PrometheusSource(MetricsSource):
                 promql = self._queries.build(name, escaped_params)
                 points = self.api.query(promql)
             except Exception as e:  # noqa: BLE001 — per-query isolation
+                # Serve-stale-on-error: a Prometheus blip rides on the last
+                # good result (original collected_at intact, so freshness
+                # classification downgrades it honestly) instead of
+                # skipping a whole analysis tick. Bounded by the
+                # unavailable threshold — too-old data is worse than none.
+                cached = self._cache.get_stale(
+                    name, spec.params, self._freshness.unavailable_threshold)
+                if cached is not None:
+                    log.debug("query %s failed (%s); serving cached result "
+                              "(age %.0fs)", name, e, cached.age(self.clock))
+                    return cached.result
                 log.debug("query %s failed: %s", name, e)
                 return MetricResult(query_name=name, collected_at=collected_at,
                                     error=str(e))
@@ -241,8 +269,13 @@ class PrometheusSource(MetricsSource):
                 )
                 for p in points
             ]
-            return MetricResult(query_name=name, values=values,
-                                collected_at=collected_at)
+            result = MetricResult(query_name=name, values=values,
+                                  collected_at=collected_at)
+            # Cache only genuinely fresh query results — re-caching a
+            # stale-served fallback would renew its age and let outage
+            # data outlive the unavailable bound.
+            self._cache.set(name, spec.params, result)
+            return result
 
         if self._concurrent and len(names) > 1:
             with ThreadPoolExecutor(max_workers=min(8, len(names))) as pool:
@@ -252,10 +285,73 @@ class PrometheusSource(MetricsSource):
             for name in names:
                 results[name] = run_one(name)
 
-        for name, result in results.items():
-            if not result.has_error():
-                self._cache.set(name, spec.params, result)
+        self._remember_spec(names, spec.params)
         return results
+
+    # Specs not re-seen for this long stop being warmed (a deleted VA's
+    # queries must not be re-executed forever).
+    SPEC_EXPIRY_SECONDS = 600.0
+
+    def _remember_spec(self, names, params: dict[str, str]) -> None:
+        if self._warming:
+            return
+        key = "|".join(sorted(names)) + "||" + \
+            "|".join(f"{k}={v}" for k, v in sorted(params.items()))
+        with self._specs_mu:
+            # True LRU: re-insert moves the key to the back, so eviction
+            # drops the least-recently-SEEN spec (plain assignment would
+            # keep the original insertion position and evict the hottest
+            # spec first).
+            self._recent_specs.pop(key, None)
+            self._recent_specs[key] = (self.clock.now(),
+                                       RefreshSpec(queries=list(names),
+                                                   params=dict(params)))
+            while len(self._recent_specs) > self._recent_bound:
+                evicted = next(iter(self._recent_specs))
+                self._recent_specs.pop(evicted, None)
+                # No silent caps: dropped specs lose warming + stale-serve.
+                log.warning(
+                    "warm-spec LRU full (%d): evicted %s — raise the bound "
+                    "or expect no stale-serve fallback for it",
+                    self._recent_bound, evicted[:120])
+
+    def background_fetch_once(self) -> int:
+        """Re-execute recently seen refresh specs to keep the stale-serve
+        cache alive (PROMETHEUS_METRICS_CACHE_FETCH_INTERVAL, reference
+        cache fetch loop); expired specs are dropped. Returns the number
+        of specs refreshed."""
+        now = self.clock.now()
+        live: list[RefreshSpec] = []
+        with self._specs_mu:
+            for key, (seen_at, spec) in list(self._recent_specs.items()):
+                if now - seen_at > self.SPEC_EXPIRY_SECONDS:
+                    self._recent_specs.pop(key, None)
+                else:
+                    live.append(spec)
+        self._warming = True
+        try:
+            for spec in live:
+                try:
+                    self.refresh(spec)
+                except Exception as e:  # noqa: BLE001 — warming must not crash
+                    log.debug("background fetch failed: %s", e)
+        finally:
+            self._warming = False
+        return len(live)
+
+    def start_background_fetch(self, stop) -> "threading.Thread | None":
+        """Spawn the cache warmer when fetch_interval > 0 (0 disables)."""
+        if self.fetch_interval <= 0:
+            return None
+
+        def loop():
+            while not stop.wait(self.fetch_interval):
+                self.background_fetch_once()
+
+        t = threading.Thread(target=loop, name="prometheus-cache-fetch",
+                             daemon=True)
+        t.start()
+        return t
 
     def get(self, query_name: str, params: dict[str, str]):
         return self._cache.get(query_name, params)

@@ -17,7 +17,13 @@ GLOBAL_DEFAULTS_KEY = "default"
 
 @dataclass
 class FreshnessThresholds:
-    """Age thresholds classifying metric freshness."""
+    """Age thresholds classifying metric freshness.
+
+    Each knob has a distinct job: ``fresh_threshold`` bounds FRESH,
+    ``stale_threshold`` bounds STALE (older classifies UNAVAILABLE), and
+    ``unavailable_threshold`` is the serve-stale-on-error cutoff — cached
+    results older than it are never served even as a Prometheus-outage
+    fallback (see PrometheusSource.refresh)."""
 
     fresh_threshold: float = 60.0
     stale_threshold: float = 120.0
@@ -26,7 +32,7 @@ class FreshnessThresholds:
     def determine_status(self, age_seconds: float) -> str:
         if age_seconds < self.fresh_threshold:
             return FRESH
-        if age_seconds < self.unavailable_threshold:
+        if age_seconds < self.stale_threshold:
             return STALE
         return UNAVAILABLE
 

@@ -109,6 +109,11 @@ class Manager:
 
     def start(self, stop: threading.Event) -> None:
         """Wall-clock mode: engines + trigger loop in daemon threads."""
+        # Background cache warmer (fetch_interval > 0): keeps the
+        # Prometheus result cache hot between engine ticks.
+        prom = self.source_registry.get(PROMETHEUS_SOURCE_NAME)
+        if prom is not None and hasattr(prom, "start_background_fetch"):
+            prom.start_background_fetch(stop)
         self._threads = [
             threading.Thread(target=self.engine.start_optimize_loop, args=(stop,),
                              name="saturation-engine", daemon=True),
@@ -235,7 +240,10 @@ def build_manager(
                           source_factory=pod_source_factory)
     indexer = Indexer(client)
     mapper = PodVAMapper(client, indexer)
-    collector = ReplicaMetricsCollector(prom_source, mapper, clock=clock)
+    cache_cfg = config.prometheus_cache_config()
+    collector = ReplicaMetricsCollector(
+        prom_source, mapper, clock=clock,
+        freshness=cache_cfg.freshness if cache_cfg else None)
 
     actuator = Actuator(client, registry)
     direct_actuator = DirectActuator(client)
