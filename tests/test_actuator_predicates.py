@@ -1,0 +1,224 @@
+"""Dedicated actuation-layer and event-filter tests.
+
+The reference covers these with `internal/actuator/actuator_test.go` (830
+LoC Ginkgo) and the predicates suite; until now this repo exercised both
+only transitively through the emulated e2e. Pins: real-current-replica
+reads, the 0->N ratio encoding, scale-subresource no-op/only-up semantics,
+and every predicate branch.
+"""
+
+import pytest
+
+from wva_tpu.actuator import Actuator, DirectActuator
+from wva_tpu.api.v1alpha1 import (
+    CrossVersionObjectReference,
+    ObjectMeta,
+    OptimizedAlloc,
+    VariantAutoscaling,
+    VariantAutoscalingSpec,
+    VariantAutoscalingStatus,
+)
+from wva_tpu.constants.metrics import (
+    WVA_CURRENT_REPLICAS,
+    WVA_DESIRED_RATIO,
+    WVA_DESIRED_REPLICAS,
+)
+from wva_tpu.controller import predicates
+from wva_tpu.k8s import (
+    ConfigMap,
+    Container,
+    Deployment,
+    DeploymentStatus,
+    FakeCluster,
+    LeaderWorkerSet,
+    Namespace,
+    PodTemplateSpec,
+)
+from wva_tpu.k8s.client import ADDED, DELETED, MODIFIED, NotFoundError
+from wva_tpu.metrics import MetricsRegistry
+
+NS = "inference"
+
+
+def make_va(name="llama-v5e", desired=3, accelerator="v5e-8",
+            labels=None, kind=""):
+    ref = CrossVersionObjectReference(name=name)
+    if kind:
+        ref.kind = kind
+    return VariantAutoscaling(
+        metadata=ObjectMeta(name=name, namespace=NS, labels=labels or {}),
+        spec=VariantAutoscalingSpec(scale_target_ref=ref,
+                                    model_id="m", variant_cost="10.0"),
+        status=VariantAutoscalingStatus(
+            desired_optimized_alloc=OptimizedAlloc(
+                accelerator=accelerator, num_replicas=desired)))
+
+
+def make_deploy(name="llama-v5e", replicas=2, status_replicas=None):
+    return Deployment(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        replicas=replicas, selector={"app": name},
+        template=PodTemplateSpec(labels={"app": name},
+                                 containers=[Container(name="srv")]),
+        status=DeploymentStatus(
+            replicas=replicas if status_replicas is None else status_replicas,
+            ready_replicas=replicas))
+
+
+class TestActuator:
+    def labels(self, accelerator="v5e-8"):
+        return {"variant_name": "llama-v5e", "namespace": NS,
+                "accelerator_type": accelerator}
+
+    def test_emits_real_current_and_desired(self):
+        cluster = FakeCluster()
+        cluster.create(make_deploy(replicas=2))
+        registry = MetricsRegistry()
+        Actuator(cluster, registry).emit_metrics(make_va(desired=5))
+        assert registry.get(WVA_CURRENT_REPLICAS, self.labels()) == 2.0
+        assert registry.get(WVA_DESIRED_REPLICAS, self.labels()) == 5.0
+        assert registry.get(WVA_DESIRED_RATIO, self.labels()) == 2.5
+
+    def test_zero_current_encodes_ratio_as_desired(self):
+        """0 -> N transition: ratio = N so HPA still sees a scale signal
+        (reference metrics.go:157-163)."""
+        cluster = FakeCluster()
+        cluster.create(make_deploy(replicas=0, status_replicas=0))
+        registry = MetricsRegistry()
+        Actuator(cluster, registry).emit_metrics(make_va(desired=4))
+        assert registry.get(WVA_CURRENT_REPLICAS, self.labels()) == 0.0
+        assert registry.get(WVA_DESIRED_RATIO, self.labels()) == 4.0
+
+    def test_missing_target_raises_for_caller_to_log(self):
+        registry = MetricsRegistry()
+        with pytest.raises(NotFoundError):
+            Actuator(FakeCluster(), registry).emit_metrics(make_va())
+
+    def test_status_replicas_preferred_over_spec(self):
+        """Current = OBSERVED replicas (status), not the spec's desire —
+        HPA ratio must reflect reality during a rollout."""
+        cluster = FakeCluster()
+        cluster.create(make_deploy(replicas=6, status_replicas=2))
+        registry = MetricsRegistry()
+        Actuator(cluster, registry).emit_metrics(make_va(desired=6))
+        assert registry.get(WVA_CURRENT_REPLICAS, self.labels()) == 2.0
+
+    def test_scale_from_zero_window_reports_zero_current(self):
+        """The discriminating 0->N case (reference actuator.go semantics):
+        spec already raised to N by DirectActuator, status still 0 — the
+        gauge must say current=0 and ratio=desired, NOT fall back to the
+        spec (which would hide the very window the ratio encoding exists
+        for)."""
+        cluster = FakeCluster()
+        cluster.create(make_deploy(replicas=4, status_replicas=0))
+        registry = MetricsRegistry()
+        Actuator(cluster, registry).emit_metrics(make_va(desired=4))
+        assert registry.get(WVA_CURRENT_REPLICAS, self.labels()) == 0.0
+        assert registry.get(WVA_DESIRED_RATIO, self.labels()) == 4.0
+
+
+class TestDirectActuator:
+    def test_scales_and_reports_change(self):
+        cluster = FakeCluster()
+        cluster.create(make_deploy(replicas=0))
+        act = DirectActuator(cluster)
+        assert act.scale_target_object("Deployment", NS, "llama-v5e", 1)
+        assert cluster.get("Deployment", NS, "llama-v5e").replicas == 1
+
+    def test_noop_when_already_at_target(self):
+        cluster = FakeCluster()
+        cluster.create(make_deploy(replicas=1))
+        act = DirectActuator(cluster)
+        assert act.scale_target_object("Deployment", NS, "llama-v5e", 1) \
+            is False
+
+    def test_only_up_never_reduces(self):
+        cluster = FakeCluster()
+        cluster.create(make_deploy(replicas=3))
+        act = DirectActuator(cluster)
+        assert act.scale_target_object("Deployment", NS, "llama-v5e", 1,
+                                       only_up=True) is False
+        assert cluster.get("Deployment", NS, "llama-v5e").replicas == 3
+        assert act.scale_target_object("Deployment", NS, "llama-v5e", 5,
+                                       only_up=True)
+        assert cluster.get("Deployment", NS, "llama-v5e").replicas == 5
+
+    def test_works_against_leaderworkerset(self):
+        cluster = FakeCluster()
+        cluster.create(LeaderWorkerSet(
+            metadata=ObjectMeta(name="big", namespace=NS), replicas=0, size=2,
+            template=PodTemplateSpec(labels={"app": "big"},
+                                     containers=[Container(name="srv")])))
+        act = DirectActuator(cluster)
+        assert act.scale_target_object("LeaderWorkerSet", NS, "big", 1)
+        assert cluster.get("LeaderWorkerSet", NS, "big").replicas == 1
+
+    def test_missing_target_raises(self):
+        with pytest.raises(NotFoundError):
+            DirectActuator(FakeCluster()).scale_target_object(
+                "Deployment", NS, "ghost", 1)
+
+
+class TestPredicates:
+    def ns_obj(self, name, annotations=None, labels=None):
+        return Namespace(metadata=ObjectMeta(
+            name=name, namespace="", annotations=annotations or {},
+            labels=labels or {}))
+
+    def test_va_only_create_events_pass(self, monkeypatch):
+        monkeypatch.delenv("CONTROLLER_INSTANCE", raising=False)
+        cluster = FakeCluster()
+        va = make_va()
+        assert predicates.va_event_allowed(cluster, ADDED, va)
+        assert not predicates.va_event_allowed(cluster, MODIFIED, va)
+        assert not predicates.va_event_allowed(cluster, DELETED, va)
+
+    def test_va_excluded_namespace_filtered(self, monkeypatch):
+        monkeypatch.delenv("CONTROLLER_INSTANCE", raising=False)
+        cluster = FakeCluster()
+        cluster.create(self.ns_obj(NS, annotations={
+            "wva.tpu.llmd.ai/exclude": "true"}))
+        assert not predicates.va_event_allowed(cluster, ADDED, make_va())
+
+    def test_controller_instance_isolation(self, monkeypatch):
+        monkeypatch.setenv("CONTROLLER_INSTANCE", "blue")
+        cluster = FakeCluster()
+        ours = make_va(labels={"wva.tpu.llmd.ai/controller-instance": "blue"})
+        theirs = make_va(labels={"wva.tpu.llmd.ai/controller-instance": "green"})
+        unlabeled = make_va()
+        assert predicates.va_event_allowed(cluster, ADDED, ours)
+        assert not predicates.va_event_allowed(cluster, ADDED, theirs)
+        assert not predicates.va_event_allowed(cluster, ADDED, unlabeled)
+
+    def test_deployment_events_create_delete_only(self):
+        assert predicates.deployment_event_allowed(ADDED)
+        assert predicates.deployment_event_allowed(DELETED)
+        assert not predicates.deployment_event_allowed(MODIFIED)
+
+    def test_configmap_filter_well_known_and_scope(self):
+        from wva_tpu.config import system_namespace
+
+        cluster = FakeCluster()
+        sysns = system_namespace()
+        wk = ConfigMap(metadata=ObjectMeta(
+            name="wva-saturation-scaling-config", namespace=sysns))
+        assert predicates.configmap_event_allowed(cluster, None, wk)
+        random = ConfigMap(metadata=ObjectMeta(name="random", namespace=sysns))
+        assert not predicates.configmap_event_allowed(cluster, None, random)
+        # Well-known name in a foreign, un-tracked, un-opted-in namespace.
+        foreign = ConfigMap(metadata=ObjectMeta(
+            name="wva-saturation-scaling-config", namespace="other"))
+        assert not predicates.configmap_event_allowed(cluster, None, foreign)
+        # Opt-in label on the namespace admits it.
+        cluster.create(self.ns_obj("other", labels={
+            "wva.tpu.llmd.ai/config-enabled": "true"}))
+        assert predicates.configmap_event_allowed(cluster, None, foreign)
+
+    def test_excluded_namespace_beats_optin(self):
+        cluster = FakeCluster()
+        cluster.create(self.ns_obj("other", annotations={
+            "wva.tpu.llmd.ai/exclude": "true"},
+            labels={"wva.tpu.llmd.ai/config-enabled": "true"}))
+        cm = ConfigMap(metadata=ObjectMeta(
+            name="wva-saturation-scaling-config", namespace="other"))
+        assert not predicates.configmap_event_allowed(cluster, None, cm)

@@ -33,7 +33,12 @@ class Actuator:
         target = scale_target.scale_target_state(self.client.get(
             va.spec.scale_target_ref.kind or Deployment.KIND,
             va.metadata.namespace, va.spec.scale_target_ref.name))
-        current = target.status_replicas or target.desired_replicas
+        # OBSERVED replicas only (reference actuator.go reads
+        # Status.Replicas directly): during the 0->N scale-from-zero window
+        # spec.replicas is already N while zero pods exist — a spec
+        # fallback would report current=N and hide the ratio=desired
+        # encoding HPA relies on in exactly that window.
+        current = target.status_replicas
         desired = va.status.desired_optimized_alloc.num_replicas
         accelerator = va.status.desired_optimized_alloc.accelerator
         self.registry.emit_replica_metrics(
