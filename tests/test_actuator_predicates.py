@@ -222,3 +222,48 @@ class TestPredicates:
         cm = ConfigMap(metadata=ObjectMeta(
             name="wva-saturation-scaling-config", namespace="other"))
         assert not predicates.configmap_event_allowed(cluster, None, cm)
+
+
+class TestFlowControlBacklogMatcher:
+    """engines/common/epp.py — the ONE matcher both detection loops
+    (scale-from-zero, fast path) key their triggers on."""
+
+    def val(self, value, **labels):
+        from wva_tpu.collector.source.source import MetricValue
+
+        return MetricValue(value=value, timestamp=0.0, labels={
+            "__name__": "inference_extension_flow_control_queue_size",
+            **labels})
+
+    def test_sums_target_model_matches(self):
+        from wva_tpu.engines.common.epp import flow_control_backlog
+
+        values = [self.val(3.0, target_model_name="m"),
+                  self.val(2.0, target_model_name="m"),
+                  self.val(9.0, target_model_name="other")]
+        assert flow_control_backlog(values, "m") == 5.0
+
+    def test_model_name_fallback_only_without_target(self):
+        from wva_tpu.engines.common.epp import flow_control_backlog
+
+        values = [self.val(4.0, model_name="m"),  # no target label: falls back
+                  self.val(7.0, target_model_name="other", model_name="m")]
+        # The second sample's target label says "other" — the model_name
+        # fallback must NOT resurrect it.
+        assert flow_control_backlog(values, "m") == 4.0
+
+    def test_negative_values_clamped(self):
+        from wva_tpu.engines.common.epp import flow_control_backlog
+
+        values = [self.val(-3.0, target_model_name="m"),
+                  self.val(2.0, target_model_name="m")]
+        assert flow_control_backlog(values, "m") == 2.0
+
+    def test_other_series_ignored(self):
+        from wva_tpu.collector.source.source import MetricValue
+        from wva_tpu.engines.common.epp import flow_control_backlog
+
+        stray = MetricValue(value=99.0, timestamp=0.0, labels={
+            "__name__": "inference_extension_flow_control_queue_bytes",
+            "target_model_name": "m"})
+        assert flow_control_backlog([stray], "m") == 0.0

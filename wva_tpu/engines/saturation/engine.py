@@ -806,6 +806,12 @@ class SaturationEngine:
                               va.metadata.name)
                     continue
             st = scale_target.scale_target_state(target)
+            # DECISION input, not a gauge: during the brief window where
+            # spec is raised but pods aren't created yet, counting the
+            # spec'd replicas keeps pending = current - ready positive so
+            # anticipation credits provisioning capacity instead of
+            # re-ordering it (cascade prevention). The emitted
+            # wva_current_replicas gauge uses observed status only.
             current = st.status_replicas or st.desired_replicas
             states.append(VariantReplicaState(
                 variant_name=va.metadata.name,
@@ -1092,7 +1098,11 @@ class SaturationEngine:
                 tgt = scale_target.scale_target_state(self.client.get(
                     va.spec.scale_target_ref.kind, va.metadata.namespace,
                     va.spec.scale_target_ref.name))
-                current = tgt.status_replicas or tgt.desired_replicas
+                # OBSERVED replicas only, same rule as Actuator.emit_metrics
+                # (both write the same gauges): a spec fallback here would
+                # overwrite the 0->N ratio encoding with current=N whenever
+                # the safety net fires during the scale-from-zero window.
+                current = tgt.status_replicas
             except (NotFoundError, TypeError):
                 log.debug("Safety net: scale target missing for %s",
                           va.metadata.name)
