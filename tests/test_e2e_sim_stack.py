@@ -305,3 +305,33 @@ class TestPromPodChain:
         series = list(prom.db.matching_series(
             [("__name__", "=", "vllm:kv_cache_usage_perc")]))
         assert len(series) == 1  # the live pod landed despite the dead one
+
+
+class TestPodDiscoveryConstruction:
+    def test_constructs_real_client_from_kubeconfig(self, tmp_path,
+                                                    monkeypatch):
+        """Regression (round-4 advisor, medium): _PodDiscovery instantiated
+        the abstract KubeClient base and crash-looped the kind tier's prom
+        pod at startup. It must build a concrete RestKubeClient from
+        resolved credentials."""
+        from wva_tpu.emulator.prom_pod import _PodDiscovery
+        from wva_tpu.k8s.rest import RestKubeClient
+
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text("""apiVersion: v1
+kind: Config
+clusters:
+- name: fake
+  cluster: {server: "http://127.0.0.1:1"}
+contexts:
+- name: fake
+  context: {cluster: fake, user: fake}
+current-context: fake
+users:
+- name: fake
+  user: {}
+""")
+        monkeypatch.setenv("KUBECONFIG", str(kubeconfig))
+        disco = _PodDiscovery("app=sim", "ns", 8000)
+        assert isinstance(disco.client, RestKubeClient)
+        assert disco.selector == {"app": "sim"}

@@ -221,10 +221,17 @@ class PrometheusSource(MetricsSource):
         self._recent_bound = 256
         self._specs_mu = threading.Lock()
         # Guard: the warmer's own refreshes must not renew seen_at, or
-        # specs for deleted consumers would be warmed forever. (An organic
-        # refresh racing the brief warming pass may skip one renewal; it
-        # re-registers on its next tick.)
-        self._warming = False
+        # specs for deleted consumers would be warmed forever. Thread-LOCAL
+        # so only the warmer thread's refreshes are exempt — an organic
+        # engine refresh running concurrently with a warming pass still
+        # registers its spec (a shared bool would briefly disable
+        # registration globally).
+        self._warming = threading.local()
+        # Eviction-warning rate limit: one warning (with a suppressed-count)
+        # per SPEC_EXPIRY window, not one per eviction — a deployment with
+        # more specs than the bound would otherwise warn on every refresh.
+        self._last_evict_warn = float("-inf")
+        self._evictions_since_warn = 0
         self._queries = QueryList()
         # In-memory backends are fast + deterministic: run sequentially.
         if concurrent is None:
@@ -293,7 +300,7 @@ class PrometheusSource(MetricsSource):
     SPEC_EXPIRY_SECONDS = 600.0
 
     def _remember_spec(self, names, params: dict[str, str]) -> None:
-        if self._warming:
+        if getattr(self._warming, "active", False):
             return
         key = "|".join(sorted(names)) + "||" + \
             "|".join(f"{k}={v}" for k, v in sorted(params.items()))
@@ -309,11 +316,21 @@ class PrometheusSource(MetricsSource):
             while len(self._recent_specs) > self._recent_bound:
                 evicted = next(iter(self._recent_specs))
                 self._recent_specs.pop(evicted, None)
-                # No silent caps: dropped specs lose warming + stale-serve.
-                log.warning(
-                    "warm-spec LRU full (%d): evicted %s — raise the bound "
-                    "or expect no stale-serve fallback for it",
-                    self._recent_bound, evicted[:120])
+                # No silent caps — but no log spam either: at steady state
+                # above the bound EVERY refresh evicts, so aggregate into
+                # one warning per expiry window.
+                self._evictions_since_warn += 1
+                now = self.clock.now()
+                if now - self._last_evict_warn >= self.SPEC_EXPIRY_SECONDS:
+                    log.warning(
+                        "warm-spec LRU full (bound %d): %d eviction(s) since "
+                        "last warning, latest %s — evicted specs lose "
+                        "warming + stale-serve fallback; raise the bound if "
+                        "this fleet legitimately has more specs",
+                        self._recent_bound, self._evictions_since_warn,
+                        evicted[:120])
+                    self._last_evict_warn = now
+                    self._evictions_since_warn = 0
 
     def background_fetch_once(self) -> int:
         """Re-execute recently seen refresh specs to keep the stale-serve
@@ -328,7 +345,7 @@ class PrometheusSource(MetricsSource):
                     self._recent_specs.pop(key, None)
                 else:
                     live.append(spec)
-        self._warming = True
+        self._warming.active = True
         try:
             for spec in live:
                 try:
@@ -336,7 +353,7 @@ class PrometheusSource(MetricsSource):
                 except Exception as e:  # noqa: BLE001 — warming must not crash
                     log.debug("background fetch failed: %s", e)
         finally:
-            self._warming = False
+            self._warming.active = False
         return len(live)
 
     def start_background_fetch(self, stop) -> "threading.Thread | None":

@@ -50,9 +50,9 @@ class _PodDiscovery:
         self.namespace = namespace
         self.port = port
         from wva_tpu.k8s.kubeconfig import resolve_credentials
-        from wva_tpu.k8s.rest import KubeClient
+        from wva_tpu.k8s.rest import RestKubeClient
 
-        self.client = KubeClient(resolve_credentials())
+        self.client = RestKubeClient(resolve_credentials())
 
     def targets(self) -> list[tuple[str, str]]:
         from wva_tpu.k8s import Pod
