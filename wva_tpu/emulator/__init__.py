@@ -8,7 +8,7 @@
   delays and chip-aware node binding
 - :mod:`hpa`        — HorizontalPodAutoscaler emulator acting on the
   ``wva_desired_replicas`` gauge exactly as Prometheus Adapter + HPA would
-- :mod:`loadgen`    — deterministic load profiles (constant / step / ramp)
+- :mod:`loadgen`    — load profiles (constant / step / ramp / trapezoid)
 - :mod:`harness`    — discrete-time world loop tying it all together
 """
 
@@ -16,7 +16,13 @@ from wva_tpu.emulator.profiles import add_tpu_nodepool
 from wva_tpu.emulator.server_sim import ModelServerSim, ServingParams
 from wva_tpu.emulator.kubelet import FakeKubelet
 from wva_tpu.emulator.hpa import HPAEmulator, HPAParams
-from wva_tpu.emulator.loadgen import LoadProfile, constant, ramp, step_profile
+from wva_tpu.emulator.loadgen import (
+    LoadProfile,
+    constant,
+    ramp,
+    step_profile,
+    trapezoid,
+)
 from wva_tpu.emulator.harness import EmulationHarness, VariantSpec
 
 __all__ = [
@@ -30,6 +36,7 @@ __all__ = [
     "constant",
     "ramp",
     "step_profile",
+    "trapezoid",
     "EmulationHarness",
     "VariantSpec",
 ]

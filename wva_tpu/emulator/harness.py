@@ -78,6 +78,7 @@ class EmulationHarness:
         sfz_interval: float = 1.0,
         emit_interval: float = 5.0,
         start_time: float = 1_000_000.0,
+        stochastic_seed: int | None = None,
     ) -> None:
         self.namespace = namespace
         self.variants = variants
@@ -102,6 +103,11 @@ class EmulationHarness:
                                 labels={"app": "epp"}),
             status=PodStatus(phase="Running", ready=True, pod_ip="10.0.1.1")))
 
+        # stochastic_seed: arrivals become a seeded Poisson process and
+        # request sizes draw from each ServingParams.token_mixture (one
+        # derived seed per model so worlds stay reproducible as variants are
+        # added). None = the legacy deterministic fluid world.
+        self._stochastic_seed = stochastic_seed
         self.sims: dict[str, ModelServerSim] = {}
         self._sims_by_model: dict[str, ModelServerSim] = {}
         for spec in variants:
@@ -188,8 +194,10 @@ class EmulationHarness:
         # its variants' pods, so replicas of every variant serve together.
         sim = self._sims_by_model.get(spec.model_id)
         if sim is None:
+            seed = None if self._stochastic_seed is None \
+                else self._stochastic_seed + len(self._sims_by_model)
             sim = ModelServerSim(spec.model_id, self.namespace, spec.serving,
-                                 self.tsdb)
+                                 self.tsdb, seed=seed)
             self._sims_by_model[spec.model_id] = sim
         self.sims[spec.name] = sim
 

@@ -44,6 +44,32 @@ def ramp(start_rate: float, end_rate: float, duration: float,
     return profile
 
 
+def trapezoid(base_rate: float, peak_rate: float, ramp_up: float,
+              hold: float, ramp_down: float, tail: float = 0.0,
+              delay: float = 0.0) -> LoadProfile:
+    """Full load cycle: ``delay`` at base -> linear ramp to peak over
+    ``ramp_up`` -> ``hold`` at peak -> linear descent back to base over
+    ``ramp_down`` -> ``tail`` at base -> 0. The descent + tail is what
+    scale-DOWN behavior (and the chip-seconds cost integral) is measured
+    against; ``ramp()`` ends at the peak and can't see it."""
+
+    def profile(t: float) -> float:
+        t -= delay
+        if t <= 0:
+            return base_rate
+        if t < ramp_up:
+            return base_rate + (peak_rate - base_rate) * (t / ramp_up)
+        t -= ramp_up
+        if t < hold:
+            return peak_rate
+        t -= hold
+        if t < ramp_down:
+            return peak_rate - (peak_rate - base_rate) * (t / ramp_down)
+        return base_rate if t < ramp_down + tail else 0.0
+
+    return profile
+
+
 @dataclass
 class SpikeProfile:
     """Idle -> spike -> idle, for scale-from-zero / scale-to-zero scenarios."""

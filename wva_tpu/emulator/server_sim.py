@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from wva_tpu.collector.source.promql import TimeSeriesDB
 
 
@@ -40,6 +42,20 @@ class ServingParams:
     # vLLM metric family details
     num_kv_blocks: int = 8192
     block_size: int = 16
+    # Request-size mixture for STOCHASTIC runs: ((weight, in, out), ...)
+    # components; each arrival draws one component (seeded — see
+    # ``ModelServerSim(seed=...)``). None = every request is the avg_* point
+    # values. Deterministic runs ignore the mixture (no RNG to draw with).
+    token_mixture: tuple = None
+    # Batch-aware latency physics: (alpha_ms, beta_ms, gamma_ms) of the
+    # iteration-time law T(n) = alpha + n*(beta*tc + gamma*tm) — the same
+    # law the SLO analyzer's queueing model and the reference's fitted
+    # profiles use (queueanalyzer.go:261-280). When set, prefill and
+    # per-token decode latency grow with the CURRENT batch occupancy
+    # (real continuous-batching behavior: more concurrent sequences ->
+    # slower iterations), and ttft_base/prefill_rate/itl_seconds above are
+    # ignored. None keeps the legacy fixed-latency fluid model.
+    latency_parms: tuple = None
 
     @property
     def kv_capacity_tokens(self) -> int:
@@ -57,6 +73,7 @@ class _Request:
     prefill_done_at: float = -1.0
     generated: float = 0.0
     first_token_at: float = -1.0
+    decode_seconds: float = 0.0  # accumulated decode wall time (TPOT telemetry)
 
 
 @dataclass
@@ -82,11 +99,23 @@ class ModelServerSim:
     own ServingParams (heterogeneous variants: v5e vs v5p capacity)."""
 
     def __init__(self, model_id: str, namespace: str, params: ServingParams,
-                 tsdb: TimeSeriesDB) -> None:
+                 tsdb: TimeSeriesDB, seed: int | None = None) -> None:
         self.model_id = model_id
         self.namespace = namespace
         self.params = params  # model-level workload defaults (arrivals shape)
         self.tsdb = tsdb
+        # seed != None switches arrivals to a seeded Poisson process and
+        # request sizes to the params.token_mixture draw — the stochastic
+        # regime real traffic lives in (guidellm-style generators produce
+        # bursty instantaneous rates even at a "constant" target, reference
+        # test/utils/e2eutils.go:598-621). Seeded -> reproducible.
+        self._rng = None if seed is None else np.random.default_rng(seed)
+        # Normalized mixture weights, precomputed once: _draw_request_size
+        # runs per ARRIVAL (hundreds of thousands per bench run).
+        self._mixture_p = None
+        if params.token_mixture:
+            w = np.asarray([c[0] for c in params.token_mixture], np.float64)
+            self._mixture_p = w / w.sum()
         self._replicas: dict[str, _ReplicaState] = {}
         self.scheduler_queue: list[_Request] = []
         self._arrival_carry = 0.0
@@ -96,6 +125,11 @@ class ModelServerSim:
         # not a steady-state one.
         self.ttft_samples: list[tuple[float, float]] = []
         self.rejected_requests = 0
+        # Completions across the sim's LIFETIME — per-replica success_total
+        # vanishes with the replica on scale-down (Prometheus staleness),
+        # so "requests served" measured from live replicas undercounts any
+        # run that ever scales down.
+        self.completed_total = 0
 
     # --- replica lifecycle (driven by the fake kubelet) ---
 
@@ -122,14 +156,20 @@ class ModelServerSim:
         """Advance the world by dt seconds with the given request arrival
         rate (requests/second)."""
         p = self.params
-        # 1. arrivals -> scheduler queue (integerized with carry).
-        self._arrival_carry += arrival_rate * dt
-        n_new = int(self._arrival_carry)
-        self._arrival_carry -= n_new
+        # 1. arrivals -> scheduler queue. Deterministic mode integerizes
+        # rate*dt with a carry; stochastic mode draws Poisson(rate*dt) —
+        # instantaneous-rate excursions (the thing burst headroom exists to
+        # absorb) only exist in the stochastic regime.
+        if self._rng is None:
+            self._arrival_carry += arrival_rate * dt
+            n_new = int(self._arrival_carry)
+            self._arrival_carry -= n_new
+        else:
+            n_new = int(self._rng.poisson(arrival_rate * dt))
         for _ in range(n_new):
+            in_tok, out_tok = self._draw_request_size()
             self.scheduler_queue.append(_Request(
-                arrived_at=now, in_tokens=p.avg_input_tokens,
-                out_tokens=p.avg_output_tokens))
+                arrived_at=now, in_tokens=in_tok, out_tokens=out_tok))
 
         replicas = sorted(self._replicas.values(), key=lambda r: r.name)
 
@@ -147,43 +187,95 @@ class ModelServerSim:
         for r in replicas:
             self._step_replica(r, now, dt)
 
+    def _draw_request_size(self) -> tuple[float, float]:
+        """(in_tokens, out_tokens) for one arrival: a seeded draw from the
+        params' token mixture in stochastic mode, else the point averages."""
+        p = self.params
+        if self._rng is None or self._mixture_p is None:
+            return p.avg_input_tokens, p.avg_output_tokens
+        idx = int(self._rng.choice(len(self._mixture_p), p=self._mixture_p))
+        _, in_tok, out_tok = p.token_mixture[idx]
+        return float(in_tok), float(out_tok)
+
+    @staticmethod
+    def _iteration_seconds(p: ServingParams, batch: int,
+                           active: "list[_Request]") -> float:
+        """T(n)/1000 for the batch-aware latency mode: alpha + n*(beta*tc +
+        gamma*tm) ms, with token factors from the ACTUAL active set (the
+        queueing model uses fleet averages; the physics uses what is really
+        batched together)."""
+        a, b, g = p.latency_parms
+        if active:
+            mean_in = sum(q.in_tokens for q in active) / len(active)
+            mean_out = sum(q.out_tokens for q in active) / len(active)
+        else:
+            mean_in, mean_out = p.avg_input_tokens, p.avg_output_tokens
+        tc = (mean_in + mean_out) / (mean_out + 1.0)
+        tm = mean_in + mean_out / 2.0
+        return (a + batch * (b * tc + g * tm)) / 1000.0
+
     def _step_replica(self, r: _ReplicaState, now: float, dt: float) -> None:
         p = r.params
+        batch_aware = p.latency_parms is not None
         # admit while decode slots free
         while r.queue and len(r.active) < p.max_concurrent_decodes:
             req = r.queue.pop(0)
             req.admitted_at = now
-            prefill_time = p.ttft_base_seconds + req.in_tokens / p.prefill_tokens_per_second
+            if batch_aware:
+                # prefill(n) = T(n) + (beta+gamma)*in_tokens ms at the
+                # occupancy the request joins (queueanalyzer.go:269-274).
+                _, b, g = p.latency_parms
+                t_n = self._iteration_seconds(p, len(r.active) + 1, r.active)
+                prefill_time = t_n + (b + g) * req.in_tokens / 1000.0
+            else:
+                prefill_time = (p.ttft_base_seconds
+                                + req.in_tokens / p.prefill_tokens_per_second)
             req.prefill_done_at = now + prefill_time
             r.active.append(req)
 
-        # decode: each active request past prefill generates dt/itl tokens.
-        tokens_per_step = dt / p.itl_seconds
+        # decode: each active request past prefill generates dt/itl tokens;
+        # in batch-aware mode itl grows with the replica's occupancy
+        # (itl(n) = T(n) + beta + gamma*(in + out/2), queueanalyzer.go:277).
+        if batch_aware:
+            _, b, g = p.latency_parms
+            t_n = self._iteration_seconds(p, len(r.active), r.active)
         completed = []
         for req in r.active:
             if now + dt < req.prefill_done_at:
                 continue
+            if batch_aware:
+                itl = t_n + (b + g * (req.in_tokens + req.out_tokens / 2.0)) / 1000.0
+            else:
+                itl = p.itl_seconds
             if req.first_token_at < 0:
-                req.first_token_at = max(req.prefill_done_at, now)
+                # Batch-aware mode: the first token lands one decode
+                # iteration after prefill (matching the queueing model's
+                # TTFT = wait + prefill + itl, queueanalyzer.go:148 — the
+                # EKF tuner compares this exact observable against its
+                # prediction, so the definitions must agree).
+                req.first_token_at = max(req.prefill_done_at, now) + (
+                    itl if batch_aware else 0.0)
                 ttft = req.first_token_at - req.arrived_at
                 r.ttft_sum += ttft
                 r.ttft_count += 1
                 self.ttft_samples.append((req.arrived_at, ttft))
-            effective = min(tokens_per_step,
-                            max(now + dt - req.prefill_done_at, 0.0) / p.itl_seconds)
+            decode_window = min(dt, max(now + dt - req.prefill_done_at, 0.0))
+            effective = decode_window / itl
             req.generated += effective
+            req.decode_seconds += effective * itl
             if req.generated >= req.out_tokens:
                 completed.append(req)
 
         for req in completed:
             r.active.remove(req)
             r.success_total += 1
+            self.completed_total += 1
             r.prompt_tokens_sum += req.in_tokens
             r.prompt_tokens_count += 1
             r.gen_tokens_sum += req.out_tokens
             r.gen_tokens_count += 1
-            r.tpot_sum += p.itl_seconds * req.out_tokens
-            r.tpot_count += req.out_tokens
+            r.tpot_sum += req.decode_seconds
+            r.tpot_count += req.generated
 
     # --- metric emission ---
 
