@@ -134,6 +134,25 @@ class TestTunerController:
         rng = np.random.default_rng(5)
         assert ctl.observe("ns", "m", "v5e-8", synth_env(qa, 2.0, rng)) is None
 
+    def test_occupancy_gate_skips_near_idle_observations(self):
+        """Identifiability gate: near-idle operating points cannot separate
+        alpha from the batch terms — observations below min_occupancy are
+        dropped, unknown occupancy (-1) passes through."""
+        store = self.make_store()
+        ctl = TunerController(store)
+        qa = QueueAnalyzer(QCFG, REQ)
+        rng = np.random.default_rng(6)
+        idle = synth_env(qa, 2.0, rng)
+        idle.occupancy = 0.01
+        assert ctl.observe("ns", "m", "v5e-8", idle) is None
+        assert store.get("m", "v5e-8", namespace="ns").source == "config"
+        loaded = synth_env(qa, 2.0, rng)
+        loaded.occupancy = 0.5
+        assert ctl.observe("ns", "m", "v5e-8", loaded) is not None
+        unknown = synth_env(qa, 2.0, rng)
+        assert unknown.occupancy == -1.0
+        assert ctl.observe("ns", "m", "v5e-8", unknown) is not None
+
     def test_invalid_env_is_noop(self):
         ctl = TunerController(self.make_store())
         assert ctl.observe("ns", "m", "v5e-8", TunerEnvironment()) is None

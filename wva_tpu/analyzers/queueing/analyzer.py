@@ -293,6 +293,8 @@ class QueueingModelAnalyzer(Analyzer):
 
         result.total_supply = supply
         result.total_demand = demand
+        result.scaling_demand = scaling_demand
+        result.headroom_capacity = headroom_capacity
         result.utilization = demand / supply if supply > 0 else (1.0 if demand > 0 else 0.0)
         # Same anticipated-supply headroom algebra as V2
         # (saturation_v2/analyzer.go:104-138 via saturation_scaling.go:54-57).

@@ -65,6 +65,13 @@ class TunerEnvironment:
     max_queue_size: int = 0
     avg_ttft_ms: float = 0.0  # observed
     avg_itl_ms: float = 0.0  # observed
+    # Fleet-average decode-slot occupancy (0-1) at observation time; -1 =
+    # unknown. Used by the informativeness gate (TunerConfig.min_occupancy):
+    # near-idle operating points cannot identify the batch-dependent terms —
+    # observed TTFT there is just the size-dependent floor, and fitting it
+    # drags beta to a state that matches idle latency while collapsing the
+    # predicted capacity at load.
+    occupancy: float = -1.0
 
     def valid(self) -> bool:
         vals = [self.lambda_per_min, self.avg_input_tokens,
@@ -111,6 +118,17 @@ class TunerConfig:
     # Queue bound used by the observation model, as a multiple of max batch
     # (reference config.MaxQueueToBatchRatio).
     max_queue_to_batch_ratio: int = 4
+    # Informativeness gate: skip filter steps when the fleet's decode-slot
+    # occupancy is below this (and known). alpha/beta/gamma are only jointly
+    # identifiable when batching actually happens; at near-idle every
+    # (alpha, beta) pair on a line predicts the same observation, and the
+    # EKF walks along that line to wherever the idle-latency floor points —
+    # a state that can mispredict capacity by orders of magnitude. Freezing
+    # at idle keeps the last loaded-regime fit, which is the regime sizing
+    # decisions are made in. 0.05 = a handful of occupied slots: below it
+    # the batch-dependent terms move predictions by less than the
+    # observation noise.
+    min_occupancy: float = 0.05
 
 
 @dataclass
@@ -297,6 +315,11 @@ class TunerController:
         """Feed one telemetry sample; returns the step result, or None when
         there is no profile to refine / the environment is unusable."""
         if not env.valid():
+            return None
+        if 0.0 <= env.occupancy < self.config.min_occupancy:
+            log.debug("Tuner skipping (%s, %s, %s): occupancy %.2f below "
+                      "identifiability gate %.2f", namespace, model_id,
+                      accelerator, env.occupancy, self.config.min_occupancy)
             return None
         profile = self.profiles.get(model_id, accelerator, namespace=namespace)
         if profile is None or not profile.service_parms.valid():

@@ -506,7 +506,17 @@ class SaturationEngine:
                 name=name, namespace=req.namespace, model_id=req.model_id,
                 service_class=qualified,
                 load=ServerLoad(
-                    arrival_rate_per_min=req.result.total_demand * 60.0,
+                    # Size assignments for what scale-up must cover: the
+                    # anticipated demand (trend over the provisioning
+                    # horizon + backlog drain) plus the standing headroom /
+                    # burst insurance — the same terms the per-model
+                    # decision path bakes into required_capacity. Raw
+                    # demand alone made the fleet solve lag every ramp by
+                    # a provisioning horizon and strip the insurance from
+                    # high-priority models mid-hold.
+                    arrival_rate_per_min=(
+                        max(req.result.scaling_demand, req.result.total_demand)
+                        + req.result.headroom_capacity) * 60.0,
                     avg_input_tokens=req.result.avg_input_tokens,
                     avg_output_tokens=req.result.avg_output_tokens),
                 min_replicas=1,
@@ -725,6 +735,22 @@ class SaturationEngine:
                           "heterogeneous fleet; skipping its tuner step",
                           model_id, accelerator)
                 continue
+            # Decode-slot occupancy across this accelerator's replicas (KV
+            # usage as the vLLM fallback): the tuner's identifiability gate
+            # skips near-idle observations (TunerConfig.min_occupancy).
+            slots_used = sum(rm.slots_used for rm in rms)
+            slots_total = sum(rm.slots_total for rm in rms)
+            if slots_total > 0:
+                occupancy = slots_used / slots_total
+            else:
+                # All-zero KV with no slot telemetry means "no occupancy
+                # signal", not "idle": a genuinely idle fleet produces no
+                # valid tuner environment anyway (zero arrival rate), so
+                # unknown (-1) keeps the gate from eating telemetry whose
+                # collector doesn't export occupancy.
+                kvs = [rm.kv_cache_usage for rm in rms]
+                occupancy = (sum(kvs) / len(kvs)
+                             if any(kv > 0 for kv in kvs) else -1.0)
             env = TunerEnvironment(
                 # Filter models one replica's queue: per-replica arrival rate.
                 lambda_per_min=lambda_per_min,
@@ -734,6 +760,7 @@ class SaturationEngine:
                 max_queue_size=profile.max_queue_size,
                 avg_ttft_ms=ttft_ms,
                 avg_itl_ms=itl_ms,
+                occupancy=occupancy,
             )
             self.slo_tuner.observe(namespace, model_id, accelerator, env)
 

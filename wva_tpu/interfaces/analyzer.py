@@ -56,6 +56,15 @@ class AnalyzerResult:
     # global optimizer's queueing-model candidate sizing).
     avg_input_tokens: float = 0.0
     avg_output_tokens: float = 0.0
+    # What a scale-up should size FOR (req/s): demand plus trend
+    # anticipation over the provisioning horizon plus backlog-drain
+    # projection. 0 when the analyzer doesn't compute it; consumers fall
+    # back to total_demand. The fleet-wide (global) solve uses this so its
+    # assignments anticipate the same way per-model decisions do.
+    scaling_demand: float = 0.0
+    # Standing spare capacity (req/s) the policy wants provisioned at all
+    # times (headroomReplicas floor / derived burst insurance).
+    headroom_capacity: float = 0.0
 
 
 @dataclass
