@@ -1,0 +1,84 @@
+"""Offline profile fitting tool (docs/tutorials/parameter-estimation.md).
+
+The tutorial promises its commands run end-to-end against the emulator;
+these tests ARE that promise, pinned in CI.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from wva_tpu.tools.fit_profile import (
+    design_rows,
+    emulate_benchmarks,
+    fit,
+    main,
+    profile_yaml,
+)
+
+TRUE = (18.0, 0.00267, 0.00002)
+
+
+def closed_form_point(batch: float, avg_in=512.0, avg_out=256.0,
+                      parms=TRUE) -> tuple[float, float]:
+    """(ttft_ms, itl_ms) the iteration law predicts queue-free."""
+    ttft_row, itl_row = design_rows(batch, avg_in, avg_out)
+    ttft = sum(c * p for c, p in zip(ttft_row, parms))
+    itl = sum(c * p for c, p in zip(itl_row, parms))
+    return ttft, itl
+
+
+class TestFit:
+    def test_recovers_exact_parameters_from_closed_forms(self):
+        sync = closed_form_point(1.0)
+        saturated = closed_form_point(96.0)
+        alpha, beta, gamma = fit(sync[0], sync[1], saturated[0], saturated[1],
+                                 96, 512.0, 256.0)
+        assert alpha == pytest.approx(TRUE[0], rel=1e-6)
+        assert beta == pytest.approx(TRUE[1], rel=1e-4)
+        assert gamma == pytest.approx(TRUE[2], rel=1e-3)
+
+    def test_fit_from_emulated_benchmarks_recovers_truth(self):
+        sync, saturated = emulate_benchmarks(96, 512.0, 256.0, TRUE)
+        alpha, beta, gamma = fit(sync[0], sync[1], saturated[0], saturated[1],
+                                 96, 512.0, 256.0)
+        # Measured through the discrete simulator: a few % of slack.
+        assert alpha == pytest.approx(TRUE[0], rel=0.05)
+        assert beta == pytest.approx(TRUE[1], rel=0.15)
+        assert gamma == pytest.approx(TRUE[2], rel=0.25)
+
+    def test_negative_solutions_are_clipped(self):
+        # Observations that would push gamma negative still produce a
+        # usable (>=0) profile rather than a nonsense one.
+        alpha, beta, gamma = fit(20.0, 18.0, 20.5, 18.1, 96, 512.0, 256.0)
+        assert alpha >= 0 and beta >= 0 and gamma >= 0
+
+
+class TestCLI:
+    def test_tutorial_emulate_command_runs_green(self, capsys):
+        assert main(["--emulate", "--validate", "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["validation"]["ok"] is True
+        assert all(p["nis_ok"] for p in out["validation"]["points"])
+        assert out["fit"]["alpha_ms"] == pytest.approx(TRUE[0], rel=0.05)
+
+    def test_yaml_output_is_configmap_ready(self, capsys):
+        assert main(["--emulate"]) == 0
+        yaml_text = capsys.readouterr().out
+        assert "profiles:" in yaml_text
+        assert "serviceParms:" in yaml_text
+        import yaml as yaml_mod
+
+        parsed = yaml_mod.safe_load(yaml_text)
+        entry = parsed["profiles"][0]
+        assert entry["modelID"] == "meta-llama/Llama-3.1-8B"
+        assert entry["serviceParms"]["alpha"] > 0
+
+    def test_measurement_mode_requires_all_four_numbers(self, capsys):
+        assert main(["--sync-ttft-ms", "20"]) == 2
+
+    def test_profile_yaml_shape(self):
+        text = profile_yaml("m", "v5e-8", (18.0, 0.002, 0.00002), 96, 384)
+        assert "modelID: m" in text and "accelerator: v5e-8" in text

@@ -1,0 +1,3 @@
+"""Operator tooling: offline profile fitting and related utilities
+(the TPU build's counterpart of the reference's ``hack/`` benchmark
+templates + ``docs/tutorials/parameter-estimation.md`` workflow)."""

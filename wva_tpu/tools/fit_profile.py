@@ -1,0 +1,259 @@
+"""Fit (alpha, beta, gamma) service parameters from two benchmark points.
+
+The SLO analyzer's queueing model prices one engine iteration as
+
+    T(n) = alpha + n * (beta * tc + gamma * tm)   [ms]
+
+with token factors tc = (in+out)/(out+1), tm = in + out/2 derived from the
+request mix (``queue_model.py`` ``_iteration_time``; reference
+queueanalyzer.go:261-266 — note the reference tutorial's simpler
+``ITL = alpha + beta*batch`` form is this law with the token factors folded
+into beta). From T(n):
+
+    prefill(n) = T(n) + (beta + gamma) * in                  [ms]
+    itl(n)     = T(n) + beta + gamma * (in + out/2)          [ms/token]
+    ttft(n)    = wait + prefill(n) + itl(n)                  [ms]
+
+Every observable is LINEAR in (alpha, beta, gamma), so two benchmark
+operating points — synchronous (batch 1) and saturating (batch B), the
+same two the reference tutorial collects — give four equations (TTFT and
+ITL at each point) for three unknowns: solved by non-negative least
+squares. ``--validate`` replays the fit through the full M/M/1-SD chain
+solver at both operating points and through the EKF tuner's NIS gate, so a
+bad fit is caught before it reaches the SLO ConfigMap.
+
+Modes:
+
+- measurements in, YAML out (real JetStream/vLLM benchmark results):
+    python -m wva_tpu.tools.fit_profile --model m --accelerator v5e-8 \\
+        --sync-ttft-ms 22 --sync-itl-ms 18 \\
+        --batch-ttft-ms 41 --batch-itl-ms 20 --max-batch 96 \\
+        --avg-input-tokens 512 --avg-output-tokens 256
+- ``--emulate``: generate the two benchmark points from the serving
+  emulator first (no hardware needed; the tutorial's runnable path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def token_factors(avg_in: float, avg_out: float) -> tuple[float, float]:
+    return (avg_in + avg_out) / (avg_out + 1.0), avg_in + avg_out / 2.0
+
+
+def design_rows(batch: float, avg_in: float, avg_out: float):
+    """(ttft_row, itl_row) — coefficients of (alpha, beta, gamma) for the
+    queue-free TTFT and ITL at occupancy ``batch``."""
+    tc, tm = token_factors(avg_in, avg_out)
+    # itl(n) = alpha + n*(beta*tc + gamma*tm) + beta + gamma*(in + out/2)
+    itl = (1.0, batch * tc + 1.0, batch * tm + avg_in + avg_out / 2.0)
+    # prefill(n) = alpha + n*(beta*tc + gamma*tm) + (beta + gamma)*in
+    # ttft(n) = prefill(n) + itl(n)  (queue-free)
+    ttft = (2.0,
+            2.0 * batch * tc + avg_in + 1.0,
+            2.0 * batch * tm + 2.0 * avg_in + avg_out / 2.0)
+    return ttft, itl
+
+
+def fit(sync_ttft: float, sync_itl: float, batch_ttft: float,
+        batch_itl: float, max_batch: int, avg_in: float,
+        avg_out: float) -> tuple[float, float, float]:
+    """Least-squares (alpha, beta, gamma) >= 0 from the four observations."""
+    rows, y = [], []
+    for batch, (ttft, itl) in ((1.0, (sync_ttft, sync_itl)),
+                               (float(max_batch), (batch_ttft, batch_itl))):
+        ttft_row, itl_row = design_rows(batch, avg_in, avg_out)
+        rows += [ttft_row, itl_row]
+        y += [ttft, itl]
+    a = np.asarray(rows, dtype=np.float64)
+    b = np.asarray(y, dtype=np.float64)
+    # Column scaling: beta/gamma are ~1e-3 of alpha; unscaled lstsq would
+    # spend all precision on alpha.
+    scale = np.maximum(np.abs(a).max(axis=0), 1e-12)
+    x, *_ = np.linalg.lstsq(a / scale, b, rcond=None)
+    x = np.maximum(x / scale, 0.0)
+    return float(x[0]), float(x[1]), float(x[2])
+
+
+def emulate_benchmarks(max_batch: int, avg_in: float, avg_out: float,
+                       true_parms: tuple[float, float, float]):
+    """Run the serving emulator at the two operating points and MEASURE
+    TTFT/ITL from its telemetry — the hardware-free stand-in for the real
+    benchmark jobs (the tutorial's runnable path)."""
+    from wva_tpu.collector.source.promql import TimeSeriesDB
+    from wva_tpu.emulator.server_sim import ModelServerSim, ServingParams
+
+    def run_point(concurrent: int) -> tuple[float, float]:
+        params = ServingParams(
+            engine="jetstream", max_concurrent_decodes=max_batch,
+            avg_input_tokens=avg_in, avg_output_tokens=avg_out,
+            latency_parms=true_parms)
+        sim = ModelServerSim("bench", "bench", params, TimeSeriesDB())
+        sim.set_ready_replicas(["pod-0"])
+        # Closed-loop load: keep exactly `concurrent` requests in flight by
+        # re-arriving on completion (guidellm "constant rate" semantics,
+        # reference test/utils/e2eutils.go:598-609).
+        t, dt = 0.0, 0.05
+        while t < 240.0:
+            r = sim._replicas["pod-0"]
+            in_flight = len(r.active) + len(r.queue) + len(sim.scheduler_queue)
+            missing = concurrent - in_flight
+            sim.step(t, dt, missing / dt if missing > 0 else 0.0)
+            t += dt
+        r = sim._replicas["pod-0"]
+        ttft_ms = r.ttft_sum / max(r.ttft_count, 1) * 1000.0
+        itl_ms = r.tpot_sum / max(r.tpot_count, 1) * 1000.0
+        return ttft_ms, itl_ms
+
+    sync = run_point(1)
+    saturated = run_point(max_batch)
+    return sync, saturated
+
+
+def validate(parms: tuple[float, float, float], observations,
+             max_batch: int, avg_in: float, avg_out: float) -> dict:
+    """Replay the fit through the chain solver + the tuner's NIS gate."""
+    from wva_tpu.analyzers.queueing import (
+        KalmanTuner,
+        QueueAnalyzer,
+        QueueConfig,
+        RequestSize,
+        ServiceParms,
+        TunerEnvironment,
+    )
+    from wva_tpu.analyzers.queueing.tuner import DEFAULT_MAX_NIS
+
+    sp = ServiceParms(alpha=parms[0], beta=parms[1], gamma=parms[2])
+    qa = QueueAnalyzer(
+        QueueConfig(max_batch_size=max_batch, max_queue_size=4 * max_batch,
+                    service_parms=sp),
+        RequestSize(avg_input_tokens=avg_in, avg_output_tokens=avg_out))
+    tuner = KalmanTuner(sp)
+    report = {"points": [], "max_nis_bound": DEFAULT_MAX_NIS}
+    for label, rate, (ttft_ms, itl_ms) in observations:
+        m = qa.analyze(rate)
+        env = TunerEnvironment(
+            lambda_per_min=rate * 60.0, avg_input_tokens=avg_in,
+            avg_output_tokens=avg_out, max_batch_size=max_batch,
+            avg_ttft_ms=ttft_ms, avg_itl_ms=itl_ms, occupancy=1.0)
+        result = tuner.run(env)
+        report["points"].append({
+            "point": label, "rate_per_s": round(rate, 3),
+            "observed_ttft_ms": round(ttft_ms, 2),
+            "predicted_ttft_ms": round(m.avg_ttft_ms, 2),
+            "observed_itl_ms": round(itl_ms, 2),
+            "predicted_itl_ms": round(m.avg_token_time_ms, 2),
+            "nis": round(result.nis, 3),
+            "nis_ok": bool(0 <= result.nis <= DEFAULT_MAX_NIS),
+        })
+    report["ok"] = all(p["nis_ok"] for p in report["points"])
+    return report
+
+
+def profile_yaml(model: str, accelerator: str,
+                 parms: tuple[float, float, float], max_batch: int,
+                 max_queue: int) -> str:
+    """The SLO ConfigMap ``profiles`` entry (docs/slo-config.md schema)."""
+    return (
+        "profiles:\n"
+        f"  - modelID: {model}\n"
+        f"    accelerator: {accelerator}\n"
+        f"    maxBatchSize: {max_batch}\n"
+        f"    maxQueueSize: {max_queue}\n"
+        "    serviceParms:\n"
+        f"      alpha: {parms[0]:.4f}   # ms, per-iteration base\n"
+        f"      beta: {parms[1]:.6f}   # ms per compute token per batch member\n"
+        f"      gamma: {parms[2]:.7f}  # ms per memory token per batch member\n"
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="Fit alpha/beta/gamma service parameters from sync + "
+                    "saturating benchmark points")
+    p.add_argument("--model", default="meta-llama/Llama-3.1-8B")
+    p.add_argument("--accelerator", default="v5e-8")
+    p.add_argument("--max-batch", type=int, default=96,
+                   help="engine decode slots (JetStream max_concurrent_"
+                        "decodes / vLLM max-num-seqs)")
+    p.add_argument("--max-queue", type=int, default=384)
+    p.add_argument("--avg-input-tokens", type=float, default=512.0)
+    p.add_argument("--avg-output-tokens", type=float, default=256.0)
+    p.add_argument("--sync-ttft-ms", type=float, default=None,
+                   help="measured TTFT at batch=1 (synchronous benchmark)")
+    p.add_argument("--sync-itl-ms", type=float, default=None)
+    p.add_argument("--batch-ttft-ms", type=float, default=None,
+                   help="measured TTFT at saturating batch")
+    p.add_argument("--batch-itl-ms", type=float, default=None)
+    p.add_argument("--emulate", action="store_true",
+                   help="derive the two benchmark points from the serving "
+                        "emulator instead of real measurements")
+    p.add_argument("--emulate-parms", default="18.0,0.00267,0.00002",
+                   help="ground-truth alpha,beta,gamma for --emulate")
+    p.add_argument("--validate", action="store_true",
+                   help="replay the fit through the chain solver + NIS gate")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.emulate:
+        true_parms = tuple(float(v) for v in args.emulate_parms.split(","))
+        sync, saturated = emulate_benchmarks(
+            args.max_batch, args.avg_input_tokens, args.avg_output_tokens,
+            true_parms)
+    else:
+        required = (args.sync_ttft_ms, args.sync_itl_ms,
+                    args.batch_ttft_ms, args.batch_itl_ms)
+        if any(v is None for v in required):
+            print("error: provide --sync-ttft-ms --sync-itl-ms "
+                  "--batch-ttft-ms --batch-itl-ms (or --emulate)",
+                  file=sys.stderr)
+            return 2
+        sync = (args.sync_ttft_ms, args.sync_itl_ms)
+        saturated = (args.batch_ttft_ms, args.batch_itl_ms)
+
+    parms = fit(sync[0], sync[1], saturated[0], saturated[1],
+                args.max_batch, args.avg_input_tokens,
+                args.avg_output_tokens)
+
+    out = {
+        "measurements": {"sync": {"ttft_ms": round(sync[0], 2),
+                                  "itl_ms": round(sync[1], 2)},
+                         "saturated": {"ttft_ms": round(saturated[0], 2),
+                                       "itl_ms": round(saturated[1], 2)}},
+        "fit": {"alpha_ms": round(parms[0], 4),
+                "beta_ms": round(parms[1], 6),
+                "gamma_ms": round(parms[2], 7)},
+    }
+    if args.validate:
+        # Low and mid operating points; service time from the saturated
+        # ITL. Mid = 50% of capacity: the benchmark is CLOSED-loop (fixed
+        # concurrency, no queue), so validating at near-saturation would
+        # compare it against open-loop queueing wait the benchmark never
+        # experienced.
+        service_s = (saturated[0] + args.avg_output_tokens * saturated[1]) / 1000.0
+        capacity = args.max_batch / service_s
+        out["validation"] = validate(
+            parms,
+            [("sync", 1.0 / service_s, sync),
+             ("mid-load", capacity * 0.5, saturated)],
+            args.max_batch, args.avg_input_tokens, args.avg_output_tokens)
+    if args.as_json:
+        print(json.dumps(out, indent=1))
+    else:
+        print(json.dumps(out, indent=1), file=sys.stderr)
+        print(profile_yaml(args.model, args.accelerator, parms,
+                           args.max_batch, args.max_queue))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
