@@ -4,7 +4,7 @@ under ramped load, 1 -> N slices, measuring p99-TTFT SLO attainment and
 scale-up latency — plus a device microbenchmark of the flagship compiled
 computation (the batched JAX queueing solver).
 
-THREE policies run through the SAME emulated world (serving simulator, fake
+FOUR policies run through the SAME emulated world (serving simulator, fake
 kubelet with slice-provisioning delay, HPA emulator), so the reported gain
 decomposes honestly:
 
@@ -18,12 +18,27 @@ decomposes honestly:
 - ours           — the SLO path: the batched JAX queueing-model analyzer
                    (analyzerName "slo") sizes replicas against the 1s-TTFT
                    SLO directly, with demand-trend anticipation sized to the
-                   slice-provisioning horizon and whole-slice limiting.
+                   slice-provisioning horizon and whole-slice limiting —
+                   with ORACLE calibration (profiles fitted to the sim,
+                   exact declared ramp slope): the framework's ceiling.
+- ours-realistic — the SAME SLO path under operator-grade inputs: alpha/beta/
+                   gamma start 2x off, the online EKF tuner is LIVE to walk
+                   them in, and the declared burst slope is HALF the true
+                   ramp slope. This is the number an adopter should expect.
 
-Metrics are split by phase: overall (headline, includes the ramp), ramp
-window, and steady state — the ramp tail is a provisioning-physics cost
-(120s slice startup against a 300s ramp) and must be visible, not hidden in
-an average.
+The WORLD is stochastic (seeded, reproducible): request arrivals are a
+Poisson process and request sizes draw from a 3-component token mixture,
+so instantaneous-rate excursions and length variance exist — p99 genuinely
+differs from p50, and burst headroom is absorbing real bursts, not a
+deterministic fluid. Load is a full trapezoid: warm hold -> 300s ramp ->
+peak hold -> 300s descent -> 300s base tail, and every policy reports the
+integral chip-seconds over the measured window alongside attainment, so
+over-provisioning cannot hide (the cost axis of BASELINE.md's north star).
+
+Metrics are split by phase: overall (headline: ramp onset through the tail),
+ramp window, steady state, and descent — the ramp tail is a
+provisioning-physics cost (120s slice startup against a 300s ramp) and must
+be visible, not hidden in an average.
 
 The solver microbench jits ``size_batch`` over 1k/8k candidate batches on
 the default JAX platform (the real TPU chip under the driver) and reports
@@ -55,6 +70,7 @@ from wva_tpu.emulator import (  # noqa: E402
     ServingParams,
     VariantSpec,
     ramp,
+    trapezoid,
 )
 from wva_tpu.interfaces import SaturationScalingConfig  # noqa: E402
 
@@ -72,17 +88,38 @@ SLO_TTFT_SECONDS = 1.0
 # is exactly what they are for. All three policies get the same warm hold.
 WARMUP_SECONDS = 180.0
 RAMP_SECONDS = 300.0
-HOLD_SECONDS = 1500.0
+HOLD_SECONDS = 1200.0
+DOWN_SECONDS = 300.0  # descent back to base — scale-down is measured, not cut
+TAIL_SECONDS = 300.0  # base-rate tail after the descent
 BASE_RATE = 4.0  # req/s during the warm hold and at ramp onset
 PEAK_RATE = 90.0  # req/s at peak — needs ~5 v5e-8 slices
 STARTUP_SECONDS = 120.0  # slice provisioning + model load
 
-# Queueing-model profile fitted to the emulator's serving params
-# (ServingParams defaults: ttft_base 200ms, 8000 prefill tok/s, 20ms ITL,
-# 96 decode slots) — the same fit the SLO e2e tier uses.
+# The stochastic world (seeded -> byte-for-byte reproducible): Poisson
+# arrivals + this request-size mixture (weight, in_tokens, out_tokens).
+# Weighted mean ~ (512, 253), matching the profile fit's operating point,
+# so the mixture adds VARIANCE (short chat turns vs long-context requests),
+# not a mean shift the static profiles never saw.
+STOCHASTIC_SEED = 20260730
+TOKEN_MIXTURE = ((0.50, 256, 128), (0.35, 640, 320), (0.15, 1064, 512))
+
+# ours-realistic miscalibration: profiles start this factor off true.
+MISCAL_FACTOR = 2.0
+
+# The serving world's iteration-time law (alpha_ms, beta_ms, gamma_ms):
+# the emulator runs batch-aware latency physics T(n) = alpha + n*(beta*tc +
+# gamma*tm) — the SAME law the analyzer's queueing model assumes
+# (queue_model.py _iteration_time, reference queueanalyzer.go:261-280), so
+# "oracle" profiles are genuinely oracle and the EKF tuner's 2x-off
+# recovery is a fair identification problem, not curve-fitting against a
+# foreign model class. At max batch 96 with (512, 256) tokens this gives
+# ~20ms ITL and ~18.6 req/s per-replica capacity — the same operating
+# point as the fixed-latency sim the earlier rounds benched against.
 PROFILE_ALPHA_MS = 18.0
 PROFILE_BETA = 0.00267
 PROFILE_GAMMA = 0.00002
+TRUE_PARMS = (PROFILE_ALPHA_MS, PROFILE_BETA, PROFILE_GAMMA)
+V5P_PARMS = (PROFILE_ALPHA_MS / 2, PROFILE_BETA / 2, PROFILE_GAMMA / 2)
 
 FAST_HPA = dict(stabilization_up_seconds=10.0,
                 stabilization_down_seconds=120.0,
@@ -104,26 +141,29 @@ def _arrival_rate_window(window: str = "30s"):
         os.environ.pop("WVA_SLO_ARRIVAL_RATE_WINDOW", None)
 
 
-def _slo_config_data(model_id: str = MODEL, profiles=None):
+def _slo_config_data(model_id: str = MODEL, profiles=None,
+                     miscal: float = 1.0, tuner_enabled: bool = False):
     from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms, TargetPerf
     from wva_tpu.config.slo import SLOConfigData, ServiceClass
 
     if profiles is None:
         profiles = [PerfProfile(
             model_id=model_id, accelerator="v5e-8",
-            service_parms=ServiceParms(alpha=PROFILE_ALPHA_MS,
-                                       beta=PROFILE_BETA,
-                                       gamma=PROFILE_GAMMA),
+            service_parms=ServiceParms(alpha=PROFILE_ALPHA_MS * miscal,
+                                       beta=PROFILE_BETA * miscal,
+                                       gamma=PROFILE_GAMMA * miscal),
             max_batch_size=96, max_queue_size=384)]
     return SLOConfigData(
         service_classes=[ServiceClass(
             name="premium", priority=1,
             model_targets={model_id: TargetPerf(
                 target_ttft_ms=SLO_TTFT_SECONDS * 1000.0)})],
-        profiles=profiles)
+        profiles=profiles,
+        tuner_enabled=tuner_enabled)
 
 
 def run_policy(name: str) -> dict:
+    slo_names = ("ours", "ours-realistic")
     if name == "baseline":
         # V1 defaults; the reference has no scale-from-N fast path, so it is
         # disabled for both baselines to keep the comparison honest.
@@ -136,7 +176,8 @@ def run_policy(name: str) -> dict:
         sat_cfg = SaturationScalingConfig(fast_path_enabled=False)
         hpa = HPAParams(**FAST_HPA)
         engine_interval = 10.0
-    else:  # ours
+    else:  # ours / ours-realistic
+        true_slope = (PEAK_RATE - BASE_RATE) / RAMP_SECONDS
         sat_cfg = SaturationScalingConfig(
             analyzer_name="slo",
             # Size scale-up for the demand that will exist when a new slice
@@ -147,8 +188,11 @@ def run_policy(name: str) -> dict:
             # stands slope x horizon spare capacity — exactly the demand
             # that can arrive during the provisioning blackout. (N+1
             # headroomReplicas remains as the floor for models without a
-            # declared ramp shape.)
-            burst_slope_rps=(PEAK_RATE - BASE_RATE) / RAMP_SECONDS,
+            # declared ramp shape.) ours-realistic declares only HALF the
+            # true slope — an operator's guess, not the scenario's answer
+            # key — and must cover the rest from trend anticipation.
+            burst_slope_rps=(true_slope if name == "ours"
+                             else true_slope / 2.0),
             headroom_replicas=1,
             # Clamp desired to whole-slice inventory so unplaceable replicas
             # never sit pending.
@@ -168,12 +212,15 @@ def run_policy(name: str) -> dict:
     spec = VariantSpec(
         name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
         chips_per_replica=8, cost=10.0, initial_replicas=1,
-        serving=ServingParams(engine="jetstream"),
-        load=ramp(BASE_RATE, PEAK_RATE, RAMP_SECONDS, hold=HOLD_SECONDS,
-                  delay=WARMUP_SECONDS),
+        serving=ServingParams(engine="jetstream",
+                              token_mixture=TOKEN_MIXTURE,
+                              latency_parms=TRUE_PARMS),
+        load=trapezoid(BASE_RATE, PEAK_RATE, RAMP_SECONDS, HOLD_SECONDS,
+                       DOWN_SECONDS, tail=TAIL_SECONDS,
+                       delay=WARMUP_SECONDS),
         hpa=hpa,
     )
-    with _arrival_rate_window() if name == "ours" \
+    with _arrival_rate_window() if name in slo_names \
             else contextlib.nullcontext():
         harness = EmulationHarness(
             [spec],
@@ -181,14 +228,21 @@ def run_policy(name: str) -> dict:
             nodepools=[("v5e-pool", "v5e", "2x4", 8)],
             startup_seconds=STARTUP_SECONDS,
             engine_interval=engine_interval,
+            stochastic_seed=STOCHASTIC_SEED,
         )
     if name == "ours":
         harness.config.update_slo_config(_slo_config_data())
+    elif name == "ours-realistic":
+        # Operator-grade calibration: profiles 2x off true, with the EKF
+        # tuner live to walk them toward the observed TTFT/ITL telemetry.
+        harness.config.update_slo_config(_slo_config_data(
+            miscal=MISCAL_FACTOR, tuner_enabled=True))
 
     max_replicas = {"v": 1}
     base_replicas = {"v": 1}  # replicas as of ramp onset (post-warmup)
     first_scale_up = {"t": None}
     ready_at_peak = {"t": None}
+    chip_seconds = {"v": 0.0}  # integral of allocated chips, post-warmup
 
     def watch(h: EmulationHarness, t: float) -> None:
         reps = h.replicas_of("llama-v5e")
@@ -200,11 +254,14 @@ def run_policy(name: str) -> dict:
             first_scale_up["t"] = t - WARMUP_SECONDS
         if reps > max_replicas["v"]:
             max_replicas["v"] = reps
+        if t >= WARMUP_SECONDS:
+            chip_seconds["v"] += reps * spec.chips_per_replica  # x 1s steps
         ready = h.ready_replicas_of("llama-v5e")
         if ready >= 4 and ready_at_peak["t"] is None and t >= WARMUP_SECONDS:
             ready_at_peak["t"] = t - WARMUP_SECONDS
 
-    harness.run(WARMUP_SECONDS + RAMP_SECONDS + HOLD_SECONDS, on_step=watch)
+    harness.run(WARMUP_SECONDS + RAMP_SECONDS + HOLD_SECONDS
+                + DOWN_SECONDS + TAIL_SECONDS, on_step=watch)
 
     sim = harness.sim_of_model(MODEL)
     # ALL measurement starts at ramp onset — the warm hold is excluded from
@@ -213,8 +270,10 @@ def run_policy(name: str) -> dict:
     now = harness.clock.now()
     # Phase split: the ramp window covers the ramp itself plus one full
     # provisioning horizon (decisions made during the ramp land then);
-    # steady state is everything after.
+    # steady state runs to the start of the descent; descent covers the
+    # ramp-down and the base tail (where scale-down happens).
     ramp_end = start + RAMP_SECONDS + STARTUP_SECONDS
+    descent_start = start + RAMP_SECONDS + HOLD_SECONDS
     overall = {
         "slo_attainment": sim.slo_attainment(SLO_TTFT_SECONDS, since=start),
         "p50_ttft_s": round(sim.ttft_percentile(50.0, since=start, now=now), 3),
@@ -228,9 +287,16 @@ def run_policy(name: str) -> dict:
     }
     steady = {
         "slo_attainment": sim.slo_attainment(
-            SLO_TTFT_SECONDS, since=ramp_end),
+            SLO_TTFT_SECONDS, since=ramp_end, until=descent_start),
         "p99_ttft_s": round(sim.ttft_percentile(
-            99.0, since=ramp_end, now=now), 3),
+            99.0, since=ramp_end, now=now, until=descent_start), 3),
+    }
+    descent = {
+        "slo_attainment": sim.slo_attainment(
+            SLO_TTFT_SECONDS, since=descent_start),
+        "p99_ttft_s": round(sim.ttft_percentile(
+            99.0, since=descent_start, now=now), 3),
+        "slices_at_end": harness.replicas_of("llama-v5e"),
     }
     def _rounded(d: dict) -> dict:
         return {k: (round(v, 4) if isinstance(v, float) else v)
@@ -240,12 +306,13 @@ def run_policy(name: str) -> dict:
         **_rounded(overall),
         "ramp_phase": _rounded(ramp_phase),
         "steady_state": _rounded(steady),
+        "descent": _rounded(descent),
         "scale_up_decision_latency_s": first_scale_up["t"],
         "time_to_4_ready_slices_s": ready_at_peak["t"],
         "peak_slices": max_replicas["v"],
         "chips_peak": max_replicas["v"] * 8,
-        "requests_served": int(sum(
-            r.success_total for r in sim._replicas.values())),
+        "chip_seconds": int(chip_seconds["v"]),
+        "requests_served": sim.completed_total,
     }
 
 
@@ -295,8 +362,7 @@ def variant_choice_bench() -> dict:
         served_at_warm = {"v": None}
 
         def total_served(h):
-            return sum(r.success_total
-                       for r in h.sim_of_model(MIXTRAL)._replicas.values())
+            return h.sim_of_model(MIXTRAL).completed_total
 
         def watch(h, t):
             if t >= warm:
@@ -324,13 +390,13 @@ def variant_choice_bench() -> dict:
     v5e = VariantSpec(name="mixtral-v5e", model_id=MIXTRAL,
                       accelerator="v5e-8", chips_per_replica=8, cost=8.0,
                       initial_replicas=1,
-                      serving=ServingParams(engine="jetstream"),
+                      serving=ServingParams(engine="jetstream",
+                                            latency_parms=TRUE_PARMS),
                       load=load, hpa=hpa)
     v5p_spec = dict(model_id=MIXTRAL, accelerator="v5p-8",
                     chips_per_replica=8, cost=24.0,
                     serving=ServingParams(engine="jetstream",
-                                          itl_seconds=0.01,
-                                          prefill_tokens_per_second=16000.0),
+                                          latency_parms=V5P_PARMS),
                     hpa=hpa)
     v5p_variant = VariantSpec(name="mixtral-v5p", initial_replicas=0,
                               load=None, **v5p_spec)
@@ -373,7 +439,7 @@ def multihost_bench() -> dict:
         name="llama70b-v5e16", model_id=LLAMA70B, accelerator="v5e-16",
         chips_per_replica=8,  # per host
         hosts_per_slice=2, cost=16.0, initial_replicas=1,
-        serving=ServingParams(engine="jetstream"),
+        serving=ServingParams(engine="jetstream", latency_parms=TRUE_PARMS),
         load=ramp(BASE_RATE, peak, ramp_s, hold=hold, delay=warm),
         hpa=HPAParams(**FAST_HPA))
     with _arrival_rate_window():
@@ -426,6 +492,99 @@ def multihost_bench() -> dict:
         "scenario": {"model": LLAMA70B, "accelerator": "v5e-16 (LWS, 2 hosts)",
                      "ramp": f"{BASE_RATE:.0f}->{peak:.0f} req/s over "
                              f"{ramp_s:.0f}s, hold {hold:.0f}s"},
+    }
+
+
+GEMMA = "google/gemma-7b"
+
+
+def multi_model_bench() -> dict:
+    """BASELINE config 5 (multi-model + service classes): Llama-3.1-8B
+    (premium, priority 1) and Gemma-7B (standard, priority 10) share ONE
+    v5e pool sized too small for both — 5 slices against a fleet that wants
+    ~8 at peak. The greedy fleet solver (fleet/solver.py, reference
+    pkg/core/serviceclass.go priority semantics) allocates in priority
+    order: premium must hold its SLO through the contention while standard
+    degrades gracefully to its min-replica floor instead of collapsing.
+    Stochastic world, same seed discipline as the headline."""
+    from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms, TargetPerf
+    from wva_tpu.config.slo import SLOConfigData, ServiceClass
+
+    warm, ramp_s, hold = 120.0, 300.0, 600.0
+    peak_each = 45.0  # per model; combined demand ~8 slices vs 5 available
+    pool_slices = 5
+    sat_cfg = SaturationScalingConfig(
+        analyzer_name="slo", optimizer_name="global",
+        anticipation_horizon_seconds=STARTUP_SECONDS + 30.0,
+        burst_slope_rps=(peak_each - BASE_RATE) / ramp_s,
+        enable_limiter=True,
+        # The fleet-wide assignment runs on the engine tick; the fast path
+        # is a single-model shortcut and stays off in global mode (mirrors
+        # tests/test_emulator_e2e_contention.py).
+        fast_path_enabled=False)
+    sat_cfg.apply_defaults()
+    hpa = HPAParams(**FAST_HPA)
+    load = ramp(BASE_RATE, peak_each, ramp_s, hold=hold, delay=warm)
+    serving = ServingParams(engine="jetstream", token_mixture=TOKEN_MIXTURE,
+                            latency_parms=TRUE_PARMS)
+    specs = [
+        VariantSpec(name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
+                    chips_per_replica=8, cost=8.0, initial_replicas=1,
+                    serving=serving, load=load, hpa=hpa),
+        VariantSpec(name="gemma-v5e", model_id=GEMMA, accelerator="v5e-8",
+                    chips_per_replica=8, cost=8.0, initial_replicas=1,
+                    serving=serving, load=load, hpa=hpa),
+    ]
+
+    def profile(model_id):
+        return PerfProfile(
+            model_id=model_id, accelerator="v5e-8",
+            service_parms=ServiceParms(alpha=PROFILE_ALPHA_MS,
+                                       beta=PROFILE_BETA,
+                                       gamma=PROFILE_GAMMA),
+            max_batch_size=96, max_queue_size=384)
+
+    with _arrival_rate_window():
+        harness = EmulationHarness(
+            specs, saturation_config=sat_cfg,
+            nodepools=[("v5e-pool", "v5e", "2x4", pool_slices)],
+            startup_seconds=STARTUP_SECONDS, engine_interval=5.0,
+            stochastic_seed=STOCHASTIC_SEED)
+    harness.config.update_slo_config(SLOConfigData(
+        service_classes=[
+            ServiceClass(name="premium", priority=1,
+                         model_targets={MODEL: TargetPerf(
+                             target_ttft_ms=SLO_TTFT_SECONDS * 1000.0)}),
+            ServiceClass(name="standard", priority=10,
+                         model_targets={GEMMA: TargetPerf(
+                             target_ttft_ms=SLO_TTFT_SECONDS * 1000.0)}),
+        ],
+        profiles=[profile(MODEL), profile(GEMMA)]))
+
+    harness.run(warm + ramp_s + hold)
+    start = harness.start_time + warm
+    now = harness.clock.now()
+
+    def measure(model_id, variant):
+        sim = harness.sim_of_model(model_id)
+        return {
+            "slo_attainment": round(
+                sim.slo_attainment(SLO_TTFT_SECONDS, since=start), 4),
+            "p99_ttft_s": round(
+                sim.ttft_percentile(99.0, since=start, now=now), 3),
+            "replicas_end": harness.replicas_of(variant),
+        }
+
+    return {
+        "contended": {"premium": measure(MODEL, "llama-v5e"),
+                      "standard": measure(GEMMA, "gemma-v5e")},
+        "scenario": {
+            "models": {MODEL: "premium (priority 1)",
+                       GEMMA: "standard (priority 10)"},
+            "pool": f"{pool_slices} v5e-8 slices (fleet wants ~8 at peak)",
+            "ramp": f"{BASE_RATE:.0f}->{peak_each:.0f} req/s EACH over "
+                    f"{ramp_s:.0f}s, hold {hold:.0f}s",
+        },
     }
 
 
@@ -690,28 +849,72 @@ def main() -> None:
     baseline = run_policy("baseline")
     baseline_fast = run_policy("baseline-fast")
     ours = run_policy("ours")
+    ours_realistic = run_policy("ours-realistic")
     variant_choice = variant_choice_bench()
     multihost = multihost_bench()
+    multi_model = multi_model_bench()
     solver = solver_microbench()
     wall = time.time() - t0
 
-    value = ours["slo_attainment"]
+    # HEADLINE = ours-realistic: the operator-grade configuration (2x-off
+    # profiles + live tuner + half-declared slope) under stochastic load.
+    # "ours" (oracle calibration) is the ceiling and stays visible.
+    value = ours_realistic["slo_attainment"]
     # Honest comparison: quote against the STRONGEST baseline.
     strongest = max(baseline["slo_attainment"],
                     baseline_fast["slo_attainment"])
     vs_baseline = value / strongest if strongest > 0 else float("inf")
 
-    print(json.dumps({
-        "metric": "p99_ttft_slo_attainment_ramped_1_to_N_v5e8",
+    def _headline(p: dict) -> dict:
+        return {"slo_attainment": p["slo_attainment"],
+                "p50_ttft_s": p["p50_ttft_s"], "p99_ttft_s": p["p99_ttft_s"],
+                "peak_slices": p["peak_slices"],
+                "chip_seconds": p["chip_seconds"]}
+
+    summary = {
+        "metric": "p99_ttft_slo_attainment_ramped_1_to_N_v5e8_stochastic",
         "value": round(value, 4),
         "unit": "fraction_of_requests_meeting_1s_TTFT_SLO",
         "vs_baseline": round(vs_baseline, 3),
+        # Bounded summary only — the full per-phase/per-section record goes
+        # to BENCH_LOCAL.json so the driver's line capture always parses and
+        # always contains the headline (round-4 capture truncated mid-detail
+        # and lost the one number that mattered).
         "detail": {
+            "ours_realistic": _headline(ours_realistic),
+            "ours_oracle": _headline(ours),
+            "baseline": _headline(baseline),
+            "baseline_fast": _headline(baseline_fast),
+            "variant_choice_cost_savings_frac":
+                variant_choice["cost_savings_frac"],
+            "multihost_attainment": multihost["slo_attainment"],
+            "multi_model": {
+                "premium_attainment":
+                    multi_model["contended"]["premium"]["slo_attainment"],
+                "standard_attainment":
+                    multi_model["contended"]["standard"]["slo_attainment"],
+            },
+            "solver": {
+                "platform": solver["platform"],
+                "batch_8192_candidates_per_s":
+                    solver["batch_8192"]["candidates_per_s"],
+                "batch_8192_impl": solver["batch_8192"]["impl"],
+            },
+            "world": "stochastic (seeded Poisson arrivals + token mixture)",
+            "full_detail": "BENCH_LOCAL.json",
+            "bench_wall_seconds": round(wall, 1),
+        },
+    }
+    full = {
+        **summary,
+        "detail": {
+            "ours_realistic": ours_realistic,
             "ours": ours,
             "baseline": baseline,
             "baseline_fast": baseline_fast,
             "variant_choice": variant_choice,
             "multihost": multihost,
+            "multi_model": multi_model,
             "solver_microbench": solver,
             "device_probe": device_probe,
             "scenario": {
@@ -720,15 +923,29 @@ def main() -> None:
                           "(excluded "
                           "from all measurement windows)",
                 "ramp": f"{BASE_RATE:.0f}->{PEAK_RATE} req/s over {RAMP_SECONDS:.0f}s",
-                "hold_s": HOLD_SECONDS, "slo_ttft_s": SLO_TTFT_SECONDS,
+                "hold_s": HOLD_SECONDS, "down_s": DOWN_SECONDS,
+                "tail_s": TAIL_SECONDS, "slo_ttft_s": SLO_TTFT_SECONDS,
                 "slice_startup_s": STARTUP_SECONDS,
+                "stochastic_seed": STOCHASTIC_SEED,
+                "token_mixture": [list(c) for c in TOKEN_MIXTURE],
+                "ours_realistic": {
+                    "profile_miscalibration_factor": MISCAL_FACTOR,
+                    "tuner": "EKF live (NIS-gated, trust region)",
+                    "declared_burst_slope": "half of true ramp slope"},
                 "vs_baseline_quoted_against": (
                     "baseline-fast" if baseline_fast["slo_attainment"]
                     >= baseline["slo_attainment"] else "baseline"),
             },
             "bench_wall_seconds": round(wall, 1),
         },
-    }))
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_LOCAL.json"), "w") as f:
+        json.dump(full, f, indent=1)
+    # stdout is exactly ONE bounded line (~1KB): small enough that neither
+    # head- nor tail-truncating captures can lose the headline, and
+    # parseable as a whole. The unbounded record lives in BENCH_LOCAL.json.
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":
