@@ -85,7 +85,7 @@ SLO_TTFT_SECONDS = 1.0
 # unchanged — they start at RAMP ONSET, so the warm hold adds no
 # easy-to-serve requests to the denominator; it only lets steady-state
 # policies (e.g. ``headroomReplicas``) take effect before the surge, which
-# is exactly what they are for. All three policies get the same warm hold.
+# is exactly what they are for. All policies get the same warm hold.
 WARMUP_SECONDS = 180.0
 RAMP_SECONDS = 300.0
 HOLD_SECONDS = 1200.0

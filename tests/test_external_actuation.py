@@ -1,0 +1,180 @@
+"""The external actuation loop, closed through the production API shapes
+(round-4 verdict missing #2 / next-round item 3).
+
+Chain under test:
+
+    MetricsRegistry.render_text ─► real HTTP /metrics (HTTPEndpoints)
+      ─► ExternalMetricsAdapter scrape (prometheus-adapter stand-in)
+      ─► external.metrics.k8s.io/v1beta1 REST shape
+      ─► HPAEmulator with the adapter-backed metric source
+      ─► deployment.spec.replicas patched via the scale path
+
+These tests FAIL if the gauge/label contract the controller emits, the
+ExternalMetricValueList shape, or the 0->N ratio encoding changes —
+that is their job (reference contract:
+docs/integrations/hpa-integration.md:5-15, HPA fixtures in test/e2e/).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from wva_tpu.api.v1alpha1 import ObjectMeta
+from wva_tpu.constants import WVA_DESIRED_RATIO, WVA_DESIRED_REPLICAS
+from wva_tpu.emulator.external_metrics import (
+    ExternalMetricsAdapter,
+    ExternalMetricsClient,
+    adapter_metric_source,
+    parse_label_selector,
+    quantity,
+)
+from wva_tpu.emulator.hpa import HPAEmulator, HPAParams
+from wva_tpu.k8s import Deployment, FakeCluster
+from wva_tpu.metrics import MetricsRegistry
+from wva_tpu.serving import HTTPEndpoints
+from wva_tpu.utils.clock import FakeClock
+
+NS = "inf"
+VARIANT = "llama-v5e"
+ACCEL = "v5e-8"
+
+
+@pytest.fixture
+def chain():
+    """registry -> /metrics HTTP -> adapter -> external-metrics client."""
+    registry = MetricsRegistry()
+    endpoints = HTTPEndpoints(
+        render_metrics=registry.render_text,
+        healthz=lambda: True, readyz=lambda: True,
+        metrics_addr="127.0.0.1:0", health_addr="127.0.0.1:0").start()
+    metrics_port, _ = endpoints.ports()
+    adapter = ExternalMetricsAdapter(
+        f"http://127.0.0.1:{metrics_port}/metrics").start()
+    client = ExternalMetricsClient(adapter.url)
+    yield registry, adapter, client
+    adapter.shutdown()
+    endpoints.shutdown()
+
+
+def selector():
+    return {"variant_name": VARIANT, "namespace": NS,
+            "accelerator_type": ACCEL}
+
+
+class TestAdapterAPIShape:
+    def test_serves_external_metric_value_list(self, chain):
+        registry, adapter, client = chain
+        registry.emit_replica_metrics(VARIANT, NS, ACCEL, current=2, desired=5)
+        url = (f"{adapter.url}/apis/external.metrics.k8s.io/v1beta1/"
+               f"namespaces/{NS}/{WVA_DESIRED_REPLICAS}")
+        with urllib.request.urlopen(url, timeout=5) as r:
+            body = json.loads(r.read().decode())
+        assert body["kind"] == "ExternalMetricValueList"
+        assert body["apiVersion"] == "external.metrics.k8s.io/v1beta1"
+        (item,) = body["items"]
+        assert item["metricName"] == WVA_DESIRED_REPLICAS
+        assert item["value"] == "5"
+        assert item["metricLabels"]["variant_name"] == VARIANT
+
+    def test_label_selector_filters_series(self, chain):
+        registry, adapter, client = chain
+        registry.emit_replica_metrics(VARIANT, NS, ACCEL, current=1, desired=3)
+        registry.emit_replica_metrics("other", NS, ACCEL, current=1, desired=9)
+        assert client.total(NS, WVA_DESIRED_REPLICAS, selector()) == 3.0
+        # Namespace scoping: same series is invisible from another ns.
+        assert client.total("elsewhere", WVA_DESIRED_REPLICAS,
+                            selector()) is None
+
+    def test_missing_metric_is_none_not_zero(self, chain):
+        """HPA semantics: no data means no scale signal — returning 0 would
+        scale fleets down on an adapter/scrape outage."""
+        registry, adapter, client = chain
+        assert client.total(NS, WVA_DESIRED_REPLICAS, selector()) is None
+
+    def test_quantity_encoding(self):
+        assert quantity(3.0) == "3"
+        assert quantity(2.5) == "2500m"
+
+    def test_selector_parsing(self):
+        assert parse_label_selector("a=1, b==2,") == {"a": "1", "b": "2"}
+
+
+class TestClosedLoop:
+    def make_world(self, chain, initial_replicas: int):
+        registry, adapter, client = chain
+        clock = FakeClock(start=0.0)
+        cluster = FakeCluster(clock=clock)
+        cluster.create(Deployment(
+            metadata=ObjectMeta(name=VARIANT, namespace=NS),
+            replicas=initial_replicas, selector={"app": "llama"}))
+        hpa = HPAEmulator(cluster, registry, clock,
+                          metric_source=adapter_metric_source(client))
+        hpa.add_target(NS, VARIANT, VARIANT, ACCEL, HPAParams(
+            stabilization_up_seconds=0.0, stabilization_down_seconds=0.0,
+            sync_period_seconds=10.0, min_replicas=0))
+        return registry, cluster, clock, hpa
+
+    def replicas(self, cluster) -> int:
+        return cluster.get(Deployment.KIND, NS, VARIANT).desired_replicas()
+
+    def test_gauge_moves_deployment_spec_replicas(self, chain):
+        """The whole point: a wva_desired_replicas change lands in
+        deployment.spec.replicas THROUGH the external-metrics API."""
+        registry, cluster, clock, hpa = self.make_world(chain, 1)
+        registry.emit_replica_metrics(VARIANT, NS, ACCEL, current=1, desired=4)
+        clock.advance(10.0)
+        hpa.step()
+        assert self.replicas(cluster) == 4
+        # And back down.
+        registry.emit_replica_metrics(VARIANT, NS, ACCEL, current=4, desired=2)
+        clock.advance(10.0)
+        hpa.step()
+        assert self.replicas(cluster) == 2
+
+    def test_zero_to_n_through_ratio_contract(self, chain):
+        """0->N: desired/0 is undefined, so the controller publishes
+        ratio = N (metrics.py emit_replica_metrics); HPA wakes the target
+        from zero off the desired gauge. Breaking either encoding fails
+        here."""
+        registry, cluster, clock, hpa = self.make_world(chain, 0)
+        registry.emit_replica_metrics(VARIANT, NS, ACCEL, current=0, desired=3)
+        # The ratio gauge carries the scale-FROM-zero encoding.
+        assert registry.get(WVA_DESIRED_RATIO, selector()) == 3.0
+        clock.advance(10.0)
+        hpa.step()
+        assert self.replicas(cluster) == 3
+
+    def test_scale_to_zero_defers_to_down_stabilization(self, chain):
+        registry, adapter, client = chain
+        clock = FakeClock(start=0.0)
+        cluster = FakeCluster(clock=clock)
+        cluster.create(Deployment(
+            metadata=ObjectMeta(name=VARIANT, namespace=NS),
+            replicas=2, selector={"app": "llama"}))
+        hpa = HPAEmulator(cluster, registry, clock,
+                          metric_source=adapter_metric_source(client))
+        hpa.add_target(NS, VARIANT, VARIANT, ACCEL, HPAParams(
+            stabilization_up_seconds=0.0, stabilization_down_seconds=30.0,
+            sync_period_seconds=10.0, min_replicas=0))
+        registry.emit_replica_metrics(VARIANT, NS, ACCEL, current=2, desired=0)
+        # Sustained zeros must span the 30s window (first zero observed at
+        # t=10; the window is satisfied once observations cover
+        # stabilization_down - sync_period, i.e. at t=30).
+        for _ in range(2):
+            clock.advance(10.0)
+            hpa.step()
+            assert self.replicas(cluster) == 2
+        clock.advance(10.0)
+        hpa.step()
+        assert self.replicas(cluster) == 0
+
+    def test_adapter_outage_freezes_not_scales(self, chain):
+        registry, cluster, clock, hpa = self.make_world(chain, 3)
+        # No gauge emitted at all (controller down / scrape broken):
+        # replicas must stay put.
+        clock.advance(10.0)
+        hpa.step()
+        assert self.replicas(cluster) == 3

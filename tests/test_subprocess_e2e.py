@@ -265,6 +265,42 @@ class TestSubprocessControllerE2E:
                 return False
             wait_for(gauge_scaled, 30.0, "wva_desired_replicas gauge")
 
+            # Close the EXTERNAL actuation loop against the live binary:
+            # adapter scrapes the real /metrics, HPA reads it through the
+            # external.metrics.k8s.io shape and patches the scale
+            # subresource over the apiserver's REST API — then the
+            # deployment's spec.replicas has moved, which is the one thing
+            # no in-process tier can claim.
+            from wva_tpu.emulator.external_metrics import (
+                ExternalMetricsAdapter,
+                ExternalMetricsClient,
+                adapter_metric_source,
+            )
+            from wva_tpu.emulator.hpa import HPAEmulator, HPAParams
+            from wva_tpu.k8s.kubeconfig import kubeconfig_credentials
+            from wva_tpu.k8s.rest import RestKubeClient
+            from wva_tpu.utils.clock import SYSTEM_CLOCK
+
+            adapter = ExternalMetricsAdapter(
+                f"http://127.0.0.1:{metrics_port}/metrics").start()
+            rest = RestKubeClient(kubeconfig_credentials(kubeconfig))
+            hpa = HPAEmulator(
+                rest, registry=None, clock=SYSTEM_CLOCK,
+                metric_source=adapter_metric_source(
+                    ExternalMetricsClient(adapter.url)))
+            hpa.add_target(NS, "llama-v5e", "llama-v5e", "v5e-8", HPAParams(
+                stabilization_up_seconds=0.0, stabilization_down_seconds=0.0,
+                sync_period_seconds=0.0))
+            try:
+                def deployment_scaled():
+                    hpa.step()
+                    d = cluster.get("Deployment", NS, "llama-v5e")
+                    return d.desired_replicas() >= 2
+                wait_for(deployment_scaled, 30.0,
+                         "deployment.spec.replicas via external metrics")
+            finally:
+                adapter.shutdown()
+
             # Clean shutdown path: SIGTERM -> voluntary lease release,
             # exit 0 (ReleaseOnCancel semantics, reference cmd/main.go:277).
             proc.send_signal(signal.SIGTERM)

@@ -1,0 +1,200 @@
+"""External-metrics actuation chain: a Prometheus-Adapter stand-in plus an
+adapter-backed metric source for the HPA emulator.
+
+The production loop (docs/integrations/hpa-integration.md; reference
+``docs/integrations/hpa-integration.md:5-15``) is
+
+    controller /metrics ─► Prometheus ─► Prometheus Adapter
+                                           │ external.metrics.k8s.io/v1beta1
+                         Deployment ◄─ HPA ┘
+
+:class:`ExternalMetricsAdapter` collapses the middle two hops with full
+shape fidelity on both seams: it SCRAPES a real Prometheus-text metrics
+endpoint (the controller's own ``/metrics``) and SERVES the
+``external.metrics.k8s.io/v1beta1`` REST shape HPA's external-metrics
+client consumes (ExternalMetricValueList, quantity-encoded values,
+equality labelSelector). A test driving HPA through this chain therefore
+fails if either contract breaks: the gauge names/labels the controller
+emits, or the API shape the adapter must serve.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, quote, urlparse
+
+from wva_tpu.collector.source.pod_scrape import parse_prometheus_text
+
+log = logging.getLogger(__name__)
+
+API_PREFIX = "/apis/external.metrics.k8s.io/v1beta1"
+
+
+def parse_label_selector(raw: str) -> dict[str, str]:
+    """Equality-only labelSelector (``k=v,k2=v2``) — the subset HPA's
+    external-metrics source generates from matchLabels."""
+    out: dict[str, str] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        out[key.strip()] = value.strip().lstrip("=")  # tolerate '=='
+    return out
+
+
+def quantity(value: float) -> str:
+    """Kubernetes resource.Quantity encoding (integral or milli)."""
+    if float(value).is_integer():
+        return str(int(value))
+    return f"{round(value * 1000)}m"
+
+
+def parse_quantity_str(raw: str) -> float:
+    if raw.endswith("m"):
+        return float(raw[:-1]) / 1000.0
+    return float(raw)
+
+
+class _AdapterHandler(BaseHTTPRequestHandler):
+    metrics_url: str = ""
+    scrape_timeout: float = 3.0
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        parsed = urlparse(self.path)
+        if parsed.path in (API_PREFIX, API_PREFIX + "/"):
+            # Discovery: one namespaced resource per metric is how the real
+            # adapter answers; HPA only needs the group/version to exist.
+            self._json(200, {"kind": "APIResourceList",
+                             "apiVersion": "v1",
+                             "groupVersion": "external.metrics.k8s.io/v1beta1",
+                             "resources": []})
+            return
+        parts = parsed.path.strip("/").split("/")
+        # apis/external.metrics.k8s.io/v1beta1/namespaces/{ns}/{metric}
+        if len(parts) != 6 or parts[3] != "namespaces":
+            self._json(404, {"kind": "Status", "status": "Failure",
+                             "code": 404, "message": "unknown path"})
+            return
+        namespace, metric_name = parts[4], parts[5]
+        selector = parse_label_selector(
+            (parse_qs(parsed.query).get("labelSelector") or [""])[0])
+        try:
+            with urllib.request.urlopen(self.metrics_url,
+                                        timeout=self.scrape_timeout) as r:
+                text = r.read().decode("utf-8", "replace")
+        except Exception as e:  # noqa: BLE001 — scrape failure -> API error
+            self._json(503, {"kind": "Status", "status": "Failure",
+                             "code": 503,
+                             "message": f"metrics scrape failed: {e}"})
+            return
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        items = []
+        for name, labels, value in parse_prometheus_text(text):
+            if name != metric_name:
+                continue
+            # The adapter's namespace rule: series label <-> API namespace.
+            if labels.get("namespace") != namespace:
+                continue
+            if any(labels.get(k) != v for k, v in selector.items()):
+                continue
+            items.append({"metricName": metric_name,
+                          "metricLabels": labels,
+                          "timestamp": now,
+                          "value": quantity(value)})
+        self._json(200, {"kind": "ExternalMetricValueList",
+                         "apiVersion": "external.metrics.k8s.io/v1beta1",
+                         "metadata": {},
+                         "items": items})
+
+    def _json(self, code: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt: str, *args) -> None:
+        log.debug("external-metrics-adapter: " + fmt, *args)
+
+
+class ExternalMetricsAdapter:
+    """Serve ``external.metrics.k8s.io/v1beta1`` from a scraped
+    Prometheus-text endpoint, on 127.0.0.1:<port> (0 = ephemeral)."""
+
+    def __init__(self, metrics_url: str, port: int = 0,
+                 scrape_timeout: float = 3.0) -> None:
+        handler = type("Handler", (_AdapterHandler,), {
+            "metrics_url": metrics_url,
+            "scrape_timeout": scrape_timeout,
+        })
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._server.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ExternalMetricsAdapter":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="external-metrics-adapter",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class ExternalMetricsClient:
+    """The HPA side of the seam: query one external metric the way the
+    kube-controller-manager's external-metrics client does and reduce it
+    per autoscaling/v2 AverageValue semantics (sum of series)."""
+
+    def __init__(self, api_url: str, timeout: float = 3.0) -> None:
+        self.api_url = api_url.rstrip("/")
+        self.timeout = timeout
+
+    def total(self, namespace: str, metric_name: str,
+              selector: dict[str, str]) -> float | None:
+        """Sum of matching series values; None when the metric is absent
+        (HPA treats a missing external metric as a failed scale calc, not
+        zero — zero would scale everything down on an adapter outage)."""
+        selector_raw = ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+        url = (f"{self.api_url}{API_PREFIX}/namespaces/{quote(namespace)}"
+               f"/{quote(metric_name)}?labelSelector={quote(selector_raw)}")
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            body = json.loads(r.read().decode())
+        items = body.get("items") or []
+        if not items:
+            return None
+        return sum(parse_quantity_str(i["value"]) for i in items)
+
+
+def adapter_metric_source(client: ExternalMetricsClient):
+    """Metric source for :class:`HPAEmulator`: reads
+    ``wva_desired_replicas`` through the external-metrics API instead of
+    the in-process registry — the full production chain."""
+    from wva_tpu.constants import WVA_DESIRED_REPLICAS
+
+    def source(target) -> float | None:
+        try:
+            return client.total(target.namespace, WVA_DESIRED_REPLICAS, {
+                "variant_name": target.variant_name,
+                "namespace": target.namespace,
+                "accelerator_type": target.accelerator,
+            })
+        except Exception as e:  # noqa: BLE001 — adapter outage: no signal
+            log.debug("external metric query failed: %s", e)
+            return None
+
+    return source

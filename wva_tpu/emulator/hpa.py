@@ -51,12 +51,27 @@ class _Target:
 
 
 class HPAEmulator:
-    def __init__(self, client: KubeClient, registry: MetricsRegistry,
-                 clock: Clock) -> None:
+    def __init__(self, client: KubeClient, registry: "MetricsRegistry | None",
+                 clock: Clock, metric_source=None) -> None:
+        if registry is None and metric_source is None:
+            raise ValueError("HPAEmulator needs a registry or a metric_source")
         self.client = client
         self.registry = registry
         self.clock = clock
+        # Where desired-replica signals come from: the in-process registry
+        # (default, what the harness uses) or any callable(target) ->
+        # float|None — e.g. external_metrics.adapter_metric_source, which
+        # reads through a scraped /metrics endpoint + the
+        # external.metrics.k8s.io API shape like production HPA does.
+        self._metric_source = metric_source or self._registry_metric
         self._targets: list[_Target] = []
+
+    def _registry_metric(self, t: "_Target") -> float | None:
+        return self.registry.get(WVA_DESIRED_REPLICAS, {
+            "variant_name": t.variant_name,
+            "namespace": t.namespace,
+            "accelerator_type": t.accelerator,
+        })
 
     def add_target(self, namespace: str, deployment: str, variant_name: str,
                    accelerator: str, params: HPAParams | None = None,
@@ -75,11 +90,7 @@ class HPAEmulator:
             self._sync_target(target, now)
 
     def _sync_target(self, t: _Target, now: float) -> None:
-        metric = self.registry.get(WVA_DESIRED_REPLICAS, {
-            "variant_name": t.variant_name,
-            "namespace": t.namespace,
-            "accelerator_type": t.accelerator,
-        })
+        metric = self._metric_source(t)
         if metric is None:
             return
         # Record the RAW desired (only max-clamped): the scale-to-zero path
