@@ -355,7 +355,8 @@ def variant_choice_bench() -> dict:
                 variants, saturation_config=sat_cfg,
                 nodepools=[("v5e-pool", "v5e", "2x4", 8),
                            ("v5p-pool", "v5p", "2x4", 8)],
-                startup_seconds=STARTUP_SECONDS, engine_interval=5.0)
+                startup_seconds=STARTUP_SECONDS, engine_interval=5.0,
+                stochastic_seed=STOCHASTIC_SEED)
         harness.config.update_slo_config(
             _slo_config_data(MIXTRAL, profiles))
         cost = {"v": 0.0}
@@ -451,7 +452,8 @@ def multihost_bench() -> dict:
             # the VA is labeled with — "4x8" would be v5e-32 and leave
             # zero placeable slices).
             nodepools=[("v5e-pool", "v5e", "4x4", 8)],
-            startup_seconds=STARTUP_SECONDS, engine_interval=5.0)
+            startup_seconds=STARTUP_SECONDS, engine_interval=5.0,
+            stochastic_seed=STOCHASTIC_SEED)
     harness.config.update_slo_config(_slo_config_data(
         LLAMA70B, [PerfProfile(
             model_id=LLAMA70B, accelerator="v5e-16",

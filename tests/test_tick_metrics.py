@@ -1,0 +1,126 @@
+"""Engine self-observability: tick duration + outcome metrics
+(wva_engine_tick_duration_seconds / wva_engine_ticks_total — the TPU
+build's stand-in for controller-runtime's reconcile metrics)."""
+
+from __future__ import annotations
+
+import pytest
+
+from wva_tpu.constants import (
+    WVA_ENGINE_TICK_DURATION_SECONDS,
+    WVA_ENGINE_TICKS_TOTAL,
+)
+from wva_tpu.engines.executor import PollingExecutor
+from wva_tpu.metrics import MetricsRegistry
+from wva_tpu.utils.clock import FakeClock
+
+
+def make_executor(task, registry, **kwargs):
+    ex = PollingExecutor(task, interval=10.0, clock=FakeClock(start=0.0),
+                         name="saturation", max_retries_per_tick=1, **kwargs)
+    ex.on_tick = registry.observe_tick
+    return ex
+
+
+class TestTickMetrics:
+    def test_success_increments_success_counter_and_duration(self):
+        registry = MetricsRegistry()
+        ex = make_executor(lambda: None, registry)
+        ex.tick()
+        ex.tick()
+        assert registry.get(WVA_ENGINE_TICKS_TOTAL, {
+            "engine": "saturation", "outcome": "success"}) == 2.0
+        dur = registry.get(WVA_ENGINE_TICK_DURATION_SECONDS,
+                           {"engine": "saturation"})
+        assert dur is not None and dur >= 0.0
+
+    def test_exhausted_retries_count_as_error(self):
+        registry = MetricsRegistry()
+
+        def boom():
+            raise RuntimeError("nope")
+
+        ex = make_executor(boom, registry)
+        ex.tick()
+        assert registry.get(WVA_ENGINE_TICKS_TOTAL, {
+            "engine": "saturation", "outcome": "error"}) == 1.0
+        assert registry.get(WVA_ENGINE_TICKS_TOTAL, {
+            "engine": "saturation", "outcome": "success"}) is None
+
+    def test_retry_then_success_is_one_success(self):
+        registry = MetricsRegistry()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("first attempt fails")
+
+        ex = PollingExecutor(flaky, interval=10.0, clock=FakeClock(start=0.0),
+                             name="saturation", max_retries_per_tick=3)
+        ex.on_tick = registry.observe_tick
+        ex.tick()
+        assert registry.get(WVA_ENGINE_TICKS_TOTAL, {
+            "engine": "saturation", "outcome": "success"}) == 1.0
+        assert registry.get(WVA_ENGINE_TICKS_TOTAL, {
+            "engine": "saturation", "outcome": "error"}) is None
+
+    def test_mid_retry_leadership_loss_is_not_an_error(self):
+        """A tick aborted because the gate flipped mid-retry must not ring
+        the error-rate alert — shutdown/failover would otherwise emit a
+        spurious error on every handoff."""
+        registry = MetricsRegistry()
+        leading = {"v": True}
+
+        def lose_leadership_then_fail():
+            leading["v"] = False
+            raise RuntimeError("apiserver blip")
+
+        ex = PollingExecutor(lose_leadership_then_fail, interval=10.0,
+                             clock=FakeClock(start=0.0), name="saturation",
+                             max_retries_per_tick=5,
+                             gate=lambda: leading["v"])
+        ex.on_tick = registry.observe_tick
+        ex.tick()
+        assert registry.get(WVA_ENGINE_TICKS_TOTAL, {
+            "engine": "saturation", "outcome": "error"}) is None
+        assert registry.get(WVA_ENGINE_TICKS_TOTAL, {
+            "engine": "saturation", "outcome": "success"}) is None
+
+    def test_gate_skipped_ticks_are_not_observed(self):
+        registry = MetricsRegistry()
+        ex = make_executor(lambda: None, registry, gate=lambda: False)
+        ex.tick()
+        assert registry.get(WVA_ENGINE_TICKS_TOTAL, {
+            "engine": "saturation", "outcome": "success"}) is None
+
+    def test_observer_errors_do_not_break_the_tick(self):
+        ran = {"v": False}
+
+        def task():
+            ran["v"] = True
+
+        ex = PollingExecutor(task, interval=10.0, clock=FakeClock(start=0.0),
+                             name="saturation")
+        ex.on_tick = lambda *a: (_ for _ in ()).throw(RuntimeError("bad"))
+        ex.tick()  # must not raise
+        assert ran["v"]
+
+    def test_series_render_in_exposition_text(self):
+        registry = MetricsRegistry()
+        registry.observe_tick("saturation", 0.0123, True)
+        text = registry.render_text()
+        assert 'wva_engine_ticks_total{engine="saturation",outcome="success"} 1' in text
+        assert "wva_engine_tick_duration_seconds" in text
+
+
+class TestManagerWiring:
+    def test_build_manager_wires_observers(self):
+        from test_engine_integration import make_world
+
+        mgr, cluster, tsdb, clock = make_world(kv=0.2)
+        mgr.run_once()
+        assert mgr.registry.get(WVA_ENGINE_TICKS_TOTAL, {
+            "engine": "saturation-engine", "outcome": "success"}) is not None
+        assert mgr.registry.get(WVA_ENGINE_TICK_DURATION_SECONDS, {
+            "engine": "scale-from-zero"}) is not None

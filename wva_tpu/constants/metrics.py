@@ -64,6 +64,11 @@ WVA_DESIRED_REPLICAS = "wva_desired_replicas"
 WVA_CURRENT_REPLICAS = "wva_current_replicas"
 WVA_DESIRED_RATIO = "wva_desired_ratio"
 
+# --- Controller self-observability (TPU-build addition; the reference gets
+# the equivalent from controller-runtime's reconcile metrics) ---
+WVA_ENGINE_TICK_DURATION_SECONDS = "wva_engine_tick_duration_seconds"
+WVA_ENGINE_TICKS_TOTAL = "wva_engine_ticks_total"
+
 # --- Common metric label names ---
 LABEL_MODEL_NAME = "model_name"
 LABEL_TARGET_MODEL_NAME = "target_model_name"
@@ -75,5 +80,7 @@ LABEL_ACCELERATOR_TYPE = "accelerator_type"
 LABEL_CONTROLLER_INSTANCE = "controller_instance"
 LABEL_POD = "pod"
 LABEL_METRIC_NAME = "__name__"
+LABEL_ENGINE = "engine"
+LABEL_OUTCOME = "outcome"
 
 __all__ = [n for n in dir() if n.isupper()]

@@ -11,6 +11,7 @@ from __future__ import annotations
 import abc
 import logging
 import threading
+import time
 from typing import Callable
 
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
@@ -48,6 +49,10 @@ class PollingExecutor(Executor):
         # poll-interval share of decision latency to ~0). In simulation the
         # harness consumes the flag instead of a thread waking.
         self._trigger = threading.Event()
+        # Optional observer called after every executed tick:
+        # (name, wall_seconds, ok). Wired to MetricsRegistry.observe_tick by
+        # the manager; gate-skipped ticks are not observed.
+        self.on_tick: Callable[[str, float, bool], None] | None = None
 
     def trigger(self) -> None:
         """Request an immediate tick (thread-safe, idempotent)."""
@@ -64,26 +69,45 @@ class PollingExecutor(Executor):
         """Execute the task once, retrying with backoff on failure."""
         if self.gate is not None and not self.gate():
             return
+        start = time.perf_counter()
+        outcome = "aborted"
+        try:
+            outcome = self._run_with_retries(stop)
+        finally:
+            # Aborted ticks (shutdown / leadership lost mid-retry) are NOT
+            # observed — consistent with gate-skipped ticks above, and so
+            # every controller shutdown doesn't ring the error-rate alert
+            # the docs tell operators to set on wva_engine_ticks_total.
+            if self.on_tick is not None and outcome != "aborted":
+                try:
+                    self.on_tick(self.name, time.perf_counter() - start,
+                                 outcome == "success")
+                except Exception:  # noqa: BLE001 — observability must not
+                    log.debug("tick observer failed", exc_info=True)  # bite
+
+    def _run_with_retries(self, stop: threading.Event | None) -> str:
+        """One tick's outcome: "success", "error" (retries exhausted), or
+        "aborted" (stop requested / leadership lost mid-retry)."""
         delay = RETRY_INITIAL_SECONDS
         attempt = 0
         while True:
             if stop is not None and stop.is_set():
-                return
+                return "aborted"
             # Re-check the leader gate inside the retry loop: a replica that
             # lost leadership mid-retry must not actuate when its API
             # connectivity returns (split-brain prevention).
             if self.gate is not None and not self.gate():
-                return
+                return "aborted"
             try:
                 self.task()
-                return
+                return "success"
             except Exception as e:  # noqa: BLE001 — retry boundary
                 attempt += 1
                 log.warning("%s tick failed (attempt %d): %s",
                             self.name, attempt, e)
                 if (self.max_retries_per_tick is not None
                         and attempt >= self.max_retries_per_tick):
-                    return
+                    return "error"
                 self.clock.sleep(delay)
                 delay = min(delay * RETRY_FACTOR, RETRY_CAP_SECONDS)
 

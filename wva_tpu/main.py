@@ -270,6 +270,11 @@ def build_manager(
         client, config, datastore, engine.executor,
         prom_source=prom_source, slo_analyzer=engine.slo_analyzer,
         clock=clock)
+    # Self-observability: every engine loop reports its tick duration and
+    # success/error outcome on /metrics (controller-runtime reconcile
+    # metrics equivalent).
+    for ex in (engine.executor, scale_from_zero.executor, fastpath.executor):
+        ex.on_tick = registry.observe_tick
 
     watch_ns = config.watch_namespace() or ""
     va_reconciler = VariantAutoscalingReconciler(client, datastore, indexer,

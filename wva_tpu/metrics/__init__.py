@@ -21,12 +21,16 @@ from wva_tpu.constants import (
     LABEL_ACCELERATOR_TYPE,
     LABEL_CONTROLLER_INSTANCE,
     LABEL_DIRECTION,
+    LABEL_ENGINE,
     LABEL_NAMESPACE,
+    LABEL_OUTCOME,
     LABEL_REASON,
     LABEL_VARIANT_NAME,
     WVA_CURRENT_REPLICAS,
     WVA_DESIRED_RATIO,
     WVA_DESIRED_REPLICAS,
+    WVA_ENGINE_TICK_DURATION_SECONDS,
+    WVA_ENGINE_TICKS_TOTAL,
     WVA_REPLICA_SCALING_TOTAL,
 )
 
@@ -56,6 +60,10 @@ class MetricsRegistry:
                        "Current number of replicas per variant")
         self._register(WVA_DESIRED_RATIO, "gauge",
                        "Ratio of desired to current replicas per variant")
+        self._register(WVA_ENGINE_TICK_DURATION_SECONDS, "gauge",
+                       "Wall-clock duration of the last engine tick")
+        self._register(WVA_ENGINE_TICKS_TOTAL, "counter",
+                       "Engine ticks by outcome (success|error)")
 
     def _register(self, name: str, kind: str, help_text: str) -> None:
         self._series[name] = _Series(name, kind, help_text)
@@ -103,6 +111,16 @@ class MetricsRegistry:
         else:
             ratio = float(desired)
         self.set_gauge(WVA_DESIRED_RATIO, labels, ratio)
+
+    def observe_tick(self, engine: str, seconds: float, ok: bool) -> None:
+        """Self-observability per engine loop (the reference relies on
+        controller-runtime's reconcile duration/total for this)."""
+        self.set_gauge(WVA_ENGINE_TICK_DURATION_SECONDS,
+                       {LABEL_ENGINE: engine}, seconds)
+        self.inc_counter(WVA_ENGINE_TICKS_TOTAL, {
+            LABEL_ENGINE: engine,
+            LABEL_OUTCOME: "success" if ok else "error",
+        })
 
     def record_scaling(self, variant_name: str, namespace: str, accelerator: str,
                        direction: str, reason: str) -> None:
