@@ -84,6 +84,33 @@ class TestGlobalOptimizerMode:
         assert va.status.desired_optimized_alloc.num_replicas in (0, 1)
 
 
+class TestGlobalModeAnticipationAndInsurance:
+    """The fleet solve must size from the analyzer's scaling demand +
+    standing headroom (burst insurance), not raw demand — raw demand made
+    global mode lag every ramp by a provisioning horizon and strip the
+    insurance from high-priority models mid-hold (fixed round 5)."""
+
+    def _replicas(self, burst_slope):
+        cfg = SaturationScalingConfig(
+            analyzer_name="slo", optimizer_name="global",
+            anticipation_horizon_seconds=150.0,
+            burst_slope_rps=burst_slope)
+        cfg.apply_defaults()
+        mgr, cluster, tsdb, clock = make_world(kv=0.2, saturation_cfg=cfg)
+        mgr.config.update_slo_config(slo_data())
+        heavy_load(tsdb, clock, rate_per_s=8.0)
+        mgr.run_once()
+        return get_va(cluster).status.desired_optimized_alloc.num_replicas
+
+    def test_burst_insurance_reaches_the_fleet_solve(self):
+        base = self._replicas(burst_slope=0.0)
+        insured = self._replicas(burst_slope=0.5)
+        # 0.5 req/s^2 x 150s = 75 req/s of standing spare capacity: the
+        # global assignment must provision materially more than the
+        # uninsured solve for the same live demand.
+        assert insured > base, (base, insured)
+
+
 class TestServiceMonitorAlerting:
     def test_deletion_emits_warning_event(self):
         mgr, cluster, tsdb, clock = make_world(kv=0.2)
