@@ -34,6 +34,11 @@ CLUSTER = os.environ.get("CLUSTER_NAME", "kind-wva-tpu-cluster")
 MODEL_ID = "e2e/llama-3.1-8b"
 VARIANT = "llama-v5e"
 TIMEOUT = float(os.environ.get("E2E_TIMEOUT", "300"))
+# Waits that depend on the kubelet's projected-volume sync of the sim
+# ConfigMap (up to ~90s before the sim pods even see a load change) get a
+# longer, separately tunable bound — both the scale-down and the 0->1 wake
+# assertions sit behind that sync.
+CM_SYNC_TIMEOUT = float(os.environ.get("E2E_CM_SYNC_TIMEOUT", "420"))
 
 _missing = [b for b in ("kind", "kubectl", "docker") if shutil.which(b) is None]
 
@@ -66,10 +71,35 @@ def kubectl_apply(yaml_text: str) -> None:
     kubectl("apply", "-f", "-", input_text=yaml_text)
 
 
+def cluster_diagnostics() -> str:
+    """Everything a human needs from a failed wait, collected best-effort:
+    pod states in both namespaces, recent events, and the controller log
+    tail. This tier has never run against a real cluster in CI — the first
+    failure on real hardware must be debuggable from its output alone."""
+    sections = []
+    for title, args in (
+        ("pods " + WVA_NS, ["-n", WVA_NS, "get", "pods", "-o", "wide"]),
+        ("pods " + LLMD_NS, ["-n", LLMD_NS, "get", "pods", "-o", "wide"]),
+        ("events " + LLMD_NS,
+         ["-n", LLMD_NS, "get", "events",
+          "--sort-by=.lastTimestamp"]),
+        ("controller log tail",
+         # By label, not deployment name: the chart names the deployment
+         # {Release}-controller-manager and labels it control-plane.
+         ["-n", WVA_NS, "logs", "-l", "control-plane=controller-manager",
+          "--tail=40"]),
+        ("va", ["-n", LLMD_NS, "get", "variantautoscaling", "-o", "yaml"]),
+    ):
+        r = kubectl(*args, check=False)
+        body = (r.stdout or r.stderr or "").strip()[-2000:]
+        sections.append(f"--- {title} ---\n{body}")
+    return "\n".join(sections)
+
+
 def wait_until(fn, timeout: float = TIMEOUT, interval: float = 3.0,
                desc: str = "condition"):
     """Poll ``fn`` until it returns a truthy value; fail the test on
-    timeout with the description."""
+    timeout with the description AND a cluster-state dump."""
     deadline = time.monotonic() + timeout
     last = None
     while time.monotonic() < deadline:
@@ -78,7 +108,7 @@ def wait_until(fn, timeout: float = TIMEOUT, interval: float = 3.0,
             return last
         time.sleep(interval)
     pytest.fail(f"timed out after {timeout:.0f}s waiting for {desc} "
-                f"(last={last!r})")
+                f"(last={last!r})\n{cluster_diagnostics()}")
 
 
 def va_status(name: str, namespace: str = LLMD_NS) -> dict:

@@ -16,6 +16,7 @@ import re
 
 from tests.e2e_kind.conftest import (
     LLMD_NS,
+    CM_SYNC_TIMEOUT,
     VARIANT,
     desired_replicas,
     kubectl,
@@ -86,7 +87,7 @@ class TestSaturationOnKind:
         set_sim_load(kv_usage=0.05, queue_len=0, rate_per_s=0.2)
         wait_until(
             lambda: (desired_replicas(VARIANT) or 99) < max(saturated, 2),
-            timeout=420,  # kubelet configmap sync + scale-down path
+            timeout=CM_SYNC_TIMEOUT,  # kubelet configmap sync + scale-down
             desc=f"desired below the saturated count ({saturated})")
 
     def test_current_replicas_gauge_tracks_deployment(self, cluster,

@@ -19,6 +19,7 @@ import json
 
 from tests.e2e_kind import manifests
 from tests.e2e_kind.conftest import (
+    CM_SYNC_TIMEOUT,
     LLMD_NS,
     VARIANT,
     desired_replicas,
@@ -77,7 +78,7 @@ class TestScaleFromZeroOnKind:
 
         # Pending requests appear in the scheduler flow-control queue.
         _set_epp_backlog(5)
-        wait_until(lambda: _replicas() >= 1, timeout=420,
+        wait_until(lambda: _replicas() >= 1, timeout=CM_SYNC_TIMEOUT,
                    desc="direct 0 -> 1 wake on EPP backlog")
         wait_until(lambda: (desired_replicas(VARIANT) or 0) >= 1,
                    desc="VA status seeded with the wake decision")
