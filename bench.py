@@ -302,7 +302,7 @@ def run_policy(name: str) -> dict:
         return {k: (round(v, 4) if isinstance(v, float) else v)
                 for k, v in d.items()}
 
-    return {
+    out = {
         **_rounded(overall),
         "ramp_phase": _rounded(ramp_phase),
         "steady_state": _rounded(steady),
@@ -314,6 +314,30 @@ def run_policy(name: str) -> dict:
         "chip_seconds": int(chip_seconds["v"]),
         "requests_served": sim.completed_total,
     }
+    if name == "ours-realistic":
+        # Auditability of the headline claim: the record carries the EKF's
+        # actual identification trajectory — the 2x-off start, where it
+        # ended, the ground truth, and the NIS rejection rate.
+        prof = harness.manager.engine.slo_analyzer.profiles.get(
+            MODEL, "v5e-8", namespace=harness.namespace)
+        tuners = harness.manager.engine.slo_tuner._tuners
+        stats = next(iter(tuners.values())) if tuners else None
+        sp = prof.service_parms if prof is not None else None
+        out["tuner"] = {
+            "initial_parms": {"alpha": PROFILE_ALPHA_MS * MISCAL_FACTOR,
+                              "beta": PROFILE_BETA * MISCAL_FACTOR,
+                              "gamma": PROFILE_GAMMA * MISCAL_FACTOR},
+            "final_parms": ({"alpha": round(sp.alpha, 4),
+                             "beta": round(sp.beta, 6),
+                             "gamma": round(sp.gamma, 7)}
+                            if sp is not None else None),
+            "true_parms": {"alpha": TRUE_PARMS[0], "beta": TRUE_PARMS[1],
+                           "gamma": TRUE_PARMS[2]},
+            "steps": stats.steps if stats else 0,
+            "nis_rejected": stats.rejected if stats else 0,
+            "profile_source": getattr(prof, "source", None),
+        }
+    return out
 
 
 MIXTRAL = "mistralai/Mixtral-8x7B-Instruct-v0.1"
