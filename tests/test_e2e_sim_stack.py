@@ -335,3 +335,39 @@ users:
         disco = _PodDiscovery("app=sim", "ns", 8000)
         assert isinstance(disco.client, RestKubeClient)
         assert disco.selector == {"app": "sim"}
+
+
+class TestNonFiniteTelemetry:
+    """NaN/Inf from a serving engine must not poison decisions: the
+    Prometheus source maps non-finite values to 0.0 at ingestion
+    (prometheus.py run_one) — verified through the REAL HTTP chain
+    (TSDB -> FakePrometheusServer JSON -> HTTPPromAPI -> source)."""
+
+    def test_nan_and_inf_become_zero_through_http(self):
+        import math
+
+        from wva_tpu.collector.source.promql import TimeSeriesDB
+        from wva_tpu.emulator.prom_server import FakePrometheusServer
+
+        db = TimeSeriesDB()
+        labels = {"pod": "p0", "namespace": "inf", "model_name": "m"}
+        db.add_sample("vllm:kv_cache_usage_perc", labels, float("nan"))
+        db.add_sample("vllm:num_requests_waiting", labels, float("inf"))
+        server = FakePrometheusServer(db)
+        server.start()
+        try:
+            api = HTTPPromAPI(server.url)
+            source = PrometheusSource(api)
+            source.query_list().register(QueryTemplate(
+                name="kv", template='vllm:kv_cache_usage_perc'))
+            source.query_list().register(QueryTemplate(
+                name="waiting", template='vllm:num_requests_waiting'))
+            results = source.refresh(RefreshSpec(queries=["kv", "waiting"]))
+            for name in ("kv", "waiting"):
+                assert results[name].error == ""
+                assert results[name].values, (
+                    "non-finite points must be zeroed, not dropped")
+                for v in results[name].values:
+                    assert v.value == 0.0
+        finally:
+            server.shutdown()

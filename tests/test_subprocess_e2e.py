@@ -97,21 +97,26 @@ def seed_cluster(cluster: FakeCluster) -> None:
 
 
 class MetricsFeeder(threading.Thread):
-    """Re-stamps saturated vLLM series every few seconds so the collector's
-    freshness classification sees live telemetry (the subprocess runs on
-    the system clock)."""
+    """Re-stamps vLLM series every few seconds so the collector's freshness
+    classification sees live telemetry (the subprocess runs on the system
+    clock). Defaults are saturated; kv/queue are knobs so tests can hold a
+    constant non-saturated operating point too."""
 
-    def __init__(self, db: TimeSeriesDB) -> None:
+    def __init__(self, db: TimeSeriesDB, kv: float = 0.95,
+                 queue: int = 30) -> None:
         super().__init__(name="metrics-feeder", daemon=True)
         self.db = db
+        self.kv = kv
+        self.queue = queue
         self.stop = threading.Event()
 
     def run(self) -> None:
         labels = {"pod": "llama-v5e-0", "namespace": NS, "model_name": MODEL}
         while not self.stop.is_set():
             now = time.time()
-            self.db.add_sample("vllm:kv_cache_usage_perc", labels, 0.95, now)
-            self.db.add_sample("vllm:num_requests_waiting", labels, 30, now)
+            self.db.add_sample("vllm:kv_cache_usage_perc", labels, self.kv, now)
+            self.db.add_sample("vllm:num_requests_waiting", labels,
+                               self.queue, now)
             self.db.add_sample(
                 "vllm:cache_config_info",
                 {**labels, "num_gpu_blocks": "4096", "block_size": "32"},
@@ -158,21 +163,35 @@ def http_get(url: str) -> str:
 
 
 @pytest.fixture
-def world(tmp_path):
-    cluster = FakeCluster()
-    seed_cluster(cluster)
-    apiserver = FakeAPIServer(cluster).start()
-    db = TimeSeriesDB()
-    feeder = MetricsFeeder(db)
-    feeder.start()
-    prom = FakePrometheusServer(db)
-    prom.start()
-    kubeconfig = tmp_path / "kubeconfig"
-    kubeconfig.write_text(kubeconfig_yaml(apiserver.url))
-    yield cluster, apiserver, prom, str(kubeconfig)
-    feeder.stop.set()
-    prom.shutdown()
-    apiserver.shutdown()
+def make_world(tmp_path):
+    """Factory: build the fake-cluster world with a chosen telemetry
+    operating point; everything it starts is torn down at fixture exit
+    even when the test body raises mid-setup."""
+    resources = []
+
+    def build(kv: float = 0.95, queue: int = 30):
+        cluster = FakeCluster()
+        seed_cluster(cluster)
+        apiserver = FakeAPIServer(cluster).start()
+        db = TimeSeriesDB()
+        feeder = MetricsFeeder(db, kv=kv, queue=queue)
+        feeder.start()
+        prom = FakePrometheusServer(db)
+        prom.start()
+        resources.extend([
+            feeder.stop.set, prom.shutdown, apiserver.shutdown])
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text(kubeconfig_yaml(apiserver.url))
+        return cluster, apiserver, prom, str(kubeconfig)
+
+    yield build
+    for cleanup in reversed(resources):
+        cleanup()
+
+
+@pytest.fixture
+def world(make_world):
+    return make_world()
 
 
 def spawn_controller(kubeconfig: str, prom_url: str,
@@ -306,6 +325,45 @@ class TestSubprocessControllerE2E:
             proc.send_signal(signal.SIGTERM)
             assert proc.wait(timeout=30) == 0, \
                 "controller did not exit cleanly:\n" + "".join(output[-40:])
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    def test_configmap_hot_reload_over_watch(self, make_world):
+        """Patch the saturation ConfigMap through the apiserver while the
+        binary runs: the watch-driven reconciler must apply it without a
+        restart. The telemetry is held CONSTANT and non-saturated
+        (kv 0.45 < 0.8); lowering the threshold below it via hot reload is
+        the only change — desired rising proves the reload landed.
+        (Scale-DOWN can't be asserted here: with no kubelet to complete
+        actuation, the V1 analyzer correctly blocks in-transition models.)"""
+        cluster, apiserver, prom, kubeconfig = make_world(kv=0.45, queue=2)
+        proc = spawn_controller(kubeconfig, prom.url)
+        output: list[str] = []
+        try:
+            parse_ports(proc, output)
+            drain = threading.Thread(
+                target=lambda: [output.append(l) for l in proc.stdout],
+                daemon=True)
+            drain.start()
+
+            def desired():
+                va = cluster.get("VariantAutoscaling", NS, "llama-v5e")
+                return va.status.desired_optimized_alloc.num_replicas or 0
+            # Settle at 1 under the original 0.8/5 thresholds.
+            wait_for(lambda: desired() == 1, DEADLINE,
+                     "steady desired=1 while unsaturated")
+            time.sleep(5.0)  # several ticks; must stay 1
+            assert desired() == 1
+
+            cm = cluster.get("ConfigMap", SYSTEM_NS,
+                             "wva-saturation-scaling-config")
+            cm.data = {"default": "kvCacheThreshold: 0.3\n"
+                                  "queueLengthThreshold: 1\n"}
+            cluster.update(cm)
+            wait_for(lambda: desired() >= 2, DEADLINE,
+                     "scale-up after hot-reloaded (lower) thresholds")
         finally:
             if proc.poll() is None:
                 proc.kill()
