@@ -100,7 +100,9 @@ STARTUP_SECONDS = 120.0  # slice provisioning + model load
 # Weighted mean ~ (512, 253), matching the profile fit's operating point,
 # so the mixture adds VARIANCE (short chat turns vs long-context requests),
 # not a mean shift the static profiles never saw.
-STOCHASTIC_SEED = 20260730
+# WVA_BENCH_SEED overrides for robustness sweeps (PERF.md records
+# 1/7/99 giving 1.000/1.000/0.9999 headline attainment).
+STOCHASTIC_SEED = int(os.environ.get("WVA_BENCH_SEED", "20260730"))
 TOKEN_MIXTURE = ((0.50, 256, 128), (0.35, 640, 320), (0.15, 1064, 512))
 
 # ours-realistic miscalibration: profiles start this factor off true.
