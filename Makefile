@@ -40,6 +40,14 @@ test: ## Run the unit/integration suite (CPU, virtual 8-device mesh).
 bench: ## Run the north-star benchmark (one JSON line on stdout).
 	$(PYTHON) bench.py
 
+.PHONY: test-replay
+test-replay: ## Fast decision-trace record/replay test lane (pytest -m replay).
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_blackbox.py -q -m replay
+
+.PHONY: replay-golden
+replay-golden: ## Replay the committed golden decision trace (must be zero diffs).
+	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/decision_trace_v1.jsonl
+
 .PHONY: verify-deploy-pipeline
 verify-deploy-pipeline: ## Static-check the deploy pipeline (scripts parse, manifests render, Dockerfile paths exist).
 	$(PYTHON) -m pytest tests/test_deploy_pipeline.py -x -q

@@ -164,6 +164,21 @@ def _slo_config_data(model_id: str = MODEL, profiles=None,
         tuner_enabled=tuner_enabled)
 
 
+def _bench_trace_path(policy: str) -> str | None:
+    """WVA_BENCH_TRACE=path opts the bench into decision-trace recording:
+    each policy's run spills to ``<path-root>.<policy><ext>`` (one harness
+    per policy, so one golden trace per policy), replayable offline with
+    ``python -m wva_tpu replay``."""
+    base = os.environ.get("WVA_BENCH_TRACE")
+    if not base:
+        return None
+    root, ext = os.path.splitext(base)
+    path = f"{root}.{policy}{ext or '.jsonl'}"
+    if os.path.exists(path):
+        os.remove(path)  # spill appends; a rerun must not double the trace
+    return path
+
+
 def run_policy(name: str) -> dict:
     slo_names = ("ours", "ours-realistic")
     if name == "baseline":
@@ -231,6 +246,7 @@ def run_policy(name: str) -> dict:
             startup_seconds=STARTUP_SECONDS,
             engine_interval=engine_interval,
             stochastic_seed=STOCHASTIC_SEED,
+            trace_path=_bench_trace_path(name),
         )
     if name == "ours":
         harness.config.update_slo_config(_slo_config_data())

@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""Regenerate the committed golden decision trace
+(``tests/goldens/decision_trace_v1.jsonl``).
+
+Run from the repo root (CPU platform, like the test suite):
+
+    JAX_PLATFORMS=cpu python tests/goldens/make_decision_trace.py
+
+The scenario is deliberately small and fully deterministic (FakeClock,
+seeded stochastic world): a single Llama variant on v5e-8 under a ramp that
+forces real scale-up decisions through the V1 analyzer -> enforcer ->
+decision pipeline. The committed trace is a regression anchor: future PRs
+must keep ``python -m wva_tpu replay`` on it at ZERO diffs, so only
+regenerate it when a deliberate, reviewed pipeline semantics change makes
+the old trace obsolete — and say so in the commit message.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "decision_trace_v1.jsonl")
+SEED = 20260730
+
+
+def main() -> None:
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        ramp,
+    )
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    if os.path.exists(GOLDEN):
+        os.remove(GOLDEN)  # the recorder appends; regeneration replaces
+    spec = VariantSpec(
+        name="llama-v5e", model_id="meta-llama/Llama-3.1-8B",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=1,
+        serving=ServingParams(engine="jetstream"),
+        load=ramp(2.0, 40.0, 120.0, hold=60.0),
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=60.0,
+                      sync_period_seconds=10.0))
+    harness = EmulationHarness(
+        [spec], saturation_config=SaturationScalingConfig(),
+        startup_seconds=60.0, engine_interval=30.0,
+        stochastic_seed=SEED, trace_path=GOLDEN)
+    harness.run(240.0)
+    print(f"wrote {GOLDEN}: "
+          f"{harness.flight_recorder.records_total} cycle records")
+
+
+if __name__ == "__main__":
+    main()

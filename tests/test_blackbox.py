@@ -1,0 +1,376 @@
+"""Decision flight recorder + deterministic replay (wva_tpu.blackbox).
+
+All tests here carry the ``replay`` marker so CI can run the trace/replay
+lane standalone (``make replay-golden`` / ``pytest -m replay``); they are
+sized to stay well inside the tier-1 budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import re
+
+import pytest
+
+import wva_tpu
+from wva_tpu.blackbox import FlightRecorder, ReplayEngine, load_trace
+from wva_tpu.blackbox.schema import decode, encode
+from wva_tpu.constants import (
+    WVA_TRACE_DROPPED_TOTAL,
+    WVA_TRACE_RECORDS_TOTAL,
+)
+from wva_tpu.interfaces import (
+    AnalyzerResult,
+    ReplicaMetrics,
+    SaturationScalingConfig,
+    VariantCapacity,
+)
+from wva_tpu.interfaces.replica_metrics import ReplicaMetricsMetadata
+from wva_tpu.metrics import MetricsRegistry
+from wva_tpu.utils.clock import FakeClock
+
+pytestmark = pytest.mark.replay
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "goldens", "decision_trace_v1.jsonl")
+MODEL = "meta-llama/Llama-3.1-8B"
+
+
+# --- clock discipline lint (replay determinism requires every timestamp to
+# come from the injectable clock) ---
+
+def test_only_clock_module_reads_wall_time():
+    pkg = pathlib.Path(wva_tpu.__file__).parent
+    pattern = re.compile(r"(?<![\w.])_?time\s*\.\s*time\s*\(\s*\)")
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(pkg).as_posix()
+        if rel == "utils/clock.py":
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            code = line.split("#", 1)[0]  # comments may MENTION time.time()
+            if pattern.search(code):
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "direct time.time() outside utils/clock.py breaks replay "
+        "determinism — route through the injectable Clock:\n"
+        + "\n".join(offenders))
+
+
+# --- schema round-trip ---
+
+def test_encode_decode_roundtrip():
+    rm = ReplicaMetrics(
+        pod_name="p0", kv_cache_usage=0.42, queue_length=3,
+        variant_name="v", namespace="ns", model_id=MODEL,
+        accelerator_name="v5e-8", cost=8.0,
+        metadata=ReplicaMetricsMetadata(collected_at=123.0, age_seconds=1.5),
+        total_kv_capacity_tokens=4096, slots_used=5, slots_total=96)
+    assert decode(ReplicaMetrics, encode(rm)) == rm
+
+    result = AnalyzerResult(
+        analyzer_name="slo", model_id=MODEL, namespace="ns",
+        analyzed_at=1000.5, total_supply=20.0, total_demand=15.0,
+        required_capacity=3.25,
+        variant_capacities=[VariantCapacity(
+            variant_name="v", accelerator_name="v5e-8",
+            per_replica_capacity=18.6, replica_count=2)])
+    assert decode(AnalyzerResult, encode(result)) == result
+
+    cfg = SaturationScalingConfig(analyzer_name="slo", enable_limiter=True,
+                                  burst_slope_rps=0.287,
+                                  anticipation_horizon_seconds=150.0)
+    assert decode(SaturationScalingConfig, encode(cfg)) == cfg
+
+
+# --- recorder semantics ---
+
+def test_recorder_ring_spill_and_metrics(tmp_path):
+    registry = MetricsRegistry()
+    clock = FakeClock(start=100.0)
+    rec = FlightRecorder(clock=clock, ring_size=2, registry=registry)
+    for i in range(4):
+        rec.begin_cycle("saturation-engine")
+        rec.record_model({"model_id": f"m{i}", "namespace": "ns"})
+        rec.end_cycle("success")
+    rec.flush()
+    # Ring holds the 2 newest; the 2 evicted ones had no spill file = drops.
+    snap = rec.snapshot()
+    assert [r["cycle"] for r in snap] == [3, 4]
+    assert rec.records_total == 4
+    assert rec.dropped_total == 2
+    assert registry.get(WVA_TRACE_RECORDS_TOTAL,
+                        {"engine": "saturation-engine"}) == 4.0
+    assert registry.get(WVA_TRACE_DROPPED_TOTAL,
+                        {"reason": "ring-evicted"}) == 2.0
+
+    # With a spill path, eviction is not a drop — the record is on disk.
+    path = tmp_path / "trace.jsonl"
+    rec2 = FlightRecorder(clock=clock, ring_size=1, spill_path=str(path))
+    for i in range(3):
+        rec2.begin_cycle("saturation-engine")
+        rec2.end_cycle("success")
+    rec2.close()
+    assert rec2.dropped_total == 0
+    assert [r["cycle"] for r in load_trace(str(path))] == [1, 2, 3]
+
+
+def test_recorder_post_cycle_and_orphan_events():
+    rec = FlightRecorder(clock=FakeClock(), ring_size=8)
+    rec.record_stage("reconcile", {"variant": "orphan"})  # no cycle at all
+    assert rec.dropped_total == 1
+    rec.begin_cycle("saturation-engine")
+    rec.record_stage("enforcer", {"model_id": "m"})
+    rec.end_cycle("success")
+    # After end_cycle, events attach to the pending record's post list
+    # (reconciles triggered by this cycle's decisions).
+    rec.record_stage("reconcile", {"variant": "v"})
+    rec.flush()
+    (record,) = rec.snapshot()
+    assert record["stages"] == [{"stage": "enforcer", "model_id": "m"}]
+    assert record["post"] == [{"stage": "reconcile", "variant": "v"}]
+
+
+def test_reconcile_events_attribute_only_to_deciding_cycle():
+    """A scale-from-zero decision consumed between saturation ticks must not
+    be appended to the pending saturation cycle's audit record (DecisionCache
+    is shared by both engines), and neither must a saturation decision from
+    an EARLIER cycle (the production reconciler runs on its own thread, so it
+    can consume cycle N's decision after cycle N+1 opened). Only the decision
+    stamped with the accepting cycle's own id attaches."""
+    from wva_tpu.api import (
+        ObjectMeta,
+        VariantAutoscaling,
+        VariantAutoscalingSpec,
+    )
+    from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+    from wva_tpu.controller.va_reconciler import VariantAutoscalingReconciler
+    from wva_tpu.datastore import Datastore
+    from wva_tpu.engines import common
+    from wva_tpu.indexers import Indexer
+    from wva_tpu.interfaces import VariantDecision
+    from wva_tpu.k8s import Deployment, FakeCluster
+
+    cluster = FakeCluster()
+    cluster.create(Deployment(
+        metadata=ObjectMeta(name="llama-v5e", namespace="ns")))
+    cluster.create(VariantAutoscaling(
+        metadata=ObjectMeta(name="llama-v5e", namespace="ns"),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(
+                kind="Deployment", name="llama-v5e"),
+            model_id=MODEL)))
+    rec = FlightRecorder(clock=FakeClock(), ring_size=4)
+    reconciler = VariantAutoscalingReconciler(
+        cluster, Datastore(), Indexer(cluster), clock=FakeClock(),
+        flight_recorder=rec)
+
+    rec.begin_cycle(common.SOURCE_SATURATION)
+    rec.end_cycle("success")
+    assert rec.cycle_info() == (common.SOURCE_SATURATION, 1)
+    try:
+        # Foreign engine: never attaches, whatever the cycle stamp.
+        common.DecisionCache.set("llama-v5e", "ns", VariantDecision(
+            variant_name="llama-v5e", namespace="ns", target_replicas=1,
+            accelerator_name="v5e-8"),
+            source=common.SOURCE_SCALE_FROM_ZERO)
+        reconciler.reconcile("llama-v5e", "ns")
+        # Right engine, stale cycle: the deciding cycle already committed.
+        common.DecisionCache.set("llama-v5e", "ns", VariantDecision(
+            variant_name="llama-v5e", namespace="ns", target_replicas=3,
+            accelerator_name="v5e-8"),
+            source=common.SOURCE_SATURATION, cycle=99)
+        reconciler.reconcile("llama-v5e", "ns")
+        # Right engine, the accepting cycle's own decision: attaches.
+        common.DecisionCache.set("llama-v5e", "ns", VariantDecision(
+            variant_name="llama-v5e", namespace="ns", target_replicas=2,
+            accelerator_name="v5e-8"),
+            source=common.SOURCE_SATURATION, cycle=1)
+        reconciler.reconcile("llama-v5e", "ns")
+    finally:
+        common.DecisionCache.clear()
+    rec.flush()
+    (record,) = rec.snapshot()
+    posts = [ev for ev in record["post"] if ev["stage"] == "reconcile"]
+    assert [(ev["desired"], ev["source"]) for ev in posts] == \
+        [(2, common.SOURCE_SATURATION)]
+
+
+def test_trace_config_from_env(tmp_path):
+    from wva_tpu.config import load
+
+    cfg = load(env={
+        "PROMETHEUS_BASE_URL": "http://prom:9090",
+        "WVA_TRACE_ENABLED": "true",
+        "WVA_TRACE_PATH": str(tmp_path / "t.jsonl"),
+        "WVA_TRACE_RING_SIZE": "64",
+    })
+    tc = cfg.trace_config()
+    assert tc.enabled and tc.ring_size == 64
+    assert tc.path.endswith("t.jsonl")
+
+
+# --- record -> JSONL -> parse -> replay round-trips through the real
+# pipeline (the WVA_BENCH_SEED axis of the bench world) ---
+
+def _v1_harness(trace_path: str, seed: int):
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        ramp,
+    )
+
+    spec = VariantSpec(
+        name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
+        chips_per_replica=8, cost=10.0, initial_replicas=1,
+        serving=ServingParams(engine="jetstream"),
+        load=ramp(2.0, 40.0, 90.0, hold=30.0),
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=60.0,
+                      sync_period_seconds=10.0))
+    return EmulationHarness(
+        [spec], saturation_config=SaturationScalingConfig(),
+        startup_seconds=60.0, engine_interval=30.0,
+        stochastic_seed=seed, trace_path=trace_path)
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_v1_trace_roundtrip_across_seeds(tmp_path, seed):
+    path = str(tmp_path / f"trace_{seed}.jsonl")
+    harness = _v1_harness(path, seed)
+    harness.run(180.0)
+    records = load_trace(path)
+    assert records, "harness run recorded no cycles"
+    assert all(r["engine"] == "saturation-engine" for r in records)
+    report = ReplayEngine(records).replay()
+    assert report.cycles_replayed > 0
+    assert report.decisions_recorded == report.decisions_replayed > 0
+    assert report.mismatches == [], report.mismatches
+    # The audit trail is complete: actuation events recorded in-cycle and
+    # reconciler status writes attributed post-cycle.
+    stages = {ev["stage"] for r in records
+              for ev in r.get("stages", []) + r.get("post", [])}
+    assert "actuation" in stages
+    assert "reconcile" in stages
+
+
+def test_slo_trace_roundtrip_with_limiter(tmp_path, monkeypatch):
+    from wva_tpu.analyzers.queueing import (
+        PerfProfile,
+        ServiceParms,
+        TargetPerf,
+    )
+    from wva_tpu.config.slo import SLOConfigData, ServiceClass
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        ramp,
+    )
+
+    monkeypatch.setenv("WVA_SLO_ARRIVAL_RATE_WINDOW", "30s")
+    path = str(tmp_path / "trace_slo.jsonl")
+    sat = SaturationScalingConfig(
+        analyzer_name="slo", anticipation_horizon_seconds=90.0,
+        burst_slope_rps=0.1, enable_limiter=True, fast_actuation=True)
+    sat.apply_defaults()
+    spec = VariantSpec(
+        name="llama-v5e", model_id=MODEL, accelerator="v5e-8",
+        chips_per_replica=8, cost=10.0, initial_replicas=1,
+        serving=ServingParams(engine="jetstream"),
+        load=ramp(2.0, 50.0, 90.0, hold=30.0),
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=60.0,
+                      sync_period_seconds=10.0))
+    harness = EmulationHarness(
+        [spec], saturation_config=sat, startup_seconds=60.0,
+        engine_interval=10.0, stochastic_seed=7, trace_path=path)
+    harness.config.update_slo_config(SLOConfigData(
+        service_classes=[ServiceClass(
+            name="premium", priority=1,
+            model_targets={MODEL: TargetPerf(target_ttft_ms=1000.0)})],
+        profiles=[PerfProfile(
+            model_id=MODEL, accelerator="v5e-8",
+            service_parms=ServiceParms(alpha=18.0, beta=0.00267,
+                                       gamma=0.00002),
+            max_batch_size=96, max_queue_size=384)]))
+    harness.run(150.0)
+
+    records = load_trace(path)
+    report = ReplayEngine(records).replay()
+    assert report.cycles_replayed > 0
+    assert report.mismatches == [], report.mismatches
+    # Every pipeline stage hook fired: optimizer targets, enforcer request
+    # counts, limiter inventory pools.
+    stages = {ev["stage"] for r in records for ev in r.get("stages", [])}
+    assert {"optimizer", "enforcer", "limiter"} <= stages
+
+
+# --- committed golden: the regression anchor every future PR must replay ---
+
+def test_golden_trace_replays_with_zero_diffs():
+    records = load_trace(GOLDEN)
+    assert len(records) >= 10
+    report = ReplayEngine(records).replay()
+    assert report.cycles_replayed == len(records)
+    assert report.decisions_recorded > 0
+    assert report.mismatches == [], report.mismatches
+    # The golden exercises real scale-ups, not just steady-state no-ops.
+    actions = {d["action"] for r in records for d in r["decisions"]}
+    assert "scale-up" in actions
+
+
+def test_golden_replay_is_deterministic():
+    """A second replay of the same trace is byte-identical."""
+    records = load_trace(GOLDEN)
+    first = json.dumps(ReplayEngine(records).replay().to_dict(),
+                       sort_keys=True)
+    second = json.dumps(ReplayEngine(load_trace(GOLDEN)).replay().to_dict(),
+                        sort_keys=True)
+    assert first == second
+
+
+def test_replay_cli_on_golden(capsys):
+    from wva_tpu.blackbox.replay import replay_cli
+
+    assert replay_cli([GOLDEN]) == 0
+    out = capsys.readouterr().out
+    assert "REPLAY OK (zero diffs)" in out
+
+    assert replay_cli([GOLDEN, "--json"]) == 0
+    first = capsys.readouterr().out
+    assert replay_cli([GOLDEN, "--json"]) == 0
+    second = capsys.readouterr().out
+    assert first == second  # byte-identical machine report
+    assert json.loads(first)["ok"] is True
+
+
+def test_replay_cli_detects_tampering(tmp_path, capsys):
+    """A corrupted decision (alter a target) must surface as a diff."""
+    records = load_trace(GOLDEN)
+    tampered = None
+    for r in records:
+        for d in r.get("decisions", []):
+            if d["action"] == "scale-up":
+                d["target_replicas"] += 1
+                tampered = r["cycle"]
+                break
+        if tampered is not None:
+            break
+    assert tampered is not None
+    path = tmp_path / "tampered.jsonl"
+    path.write_text("".join(
+        json.dumps(r, sort_keys=True, separators=(",", ":")) + "\n"
+        for r in records))
+
+    from wva_tpu.blackbox.replay import replay_cli
+
+    assert replay_cli([str(path)]) == 1
+    out = capsys.readouterr().out
+    assert "REPLAY FAILED" in out
+    assert "target_replicas" in out

@@ -112,6 +112,15 @@ def validate_prometheus(cfg, fatal: bool) -> None:
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "replay":
+        # Offline decision-trace replay (wva_tpu.blackbox): re-runs a
+        # recorded trace through the real pipeline and diffs decisions.
+        # No cluster, no Prometheus — dispatch before controller arg
+        # parsing so the flag surfaces stay independent.
+        from wva_tpu.blackbox.replay import replay_cli
+
+        return replay_cli(argv[1:])
     args = build_arg_parser().parse_args(argv)
     setup_logging(args.verbosity if args.verbosity is not None else 2)
 

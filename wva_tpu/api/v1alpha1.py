@@ -15,6 +15,8 @@ from __future__ import annotations
 import calendar as _calendar
 import copy
 import time as _time
+
+from wva_tpu.utils import clock as _clock
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -308,7 +310,10 @@ class VariantAutoscaling:
         """Upsert a condition; last_transition_time only moves when the status
         flips (metav1 SetStatusCondition semantics; reference conditions.go:9).
         """
-        ts = _time.time() if now is None else now
+        # SYSTEM_CLOCK fallback, never bare time.time(): simulated/replayed
+        # callers always pass ``now`` from their injected clock, and the lint
+        # in tests/test_blackbox.py keeps wall-time reads in utils/clock.py.
+        ts = _clock.SYSTEM_CLOCK.now() if now is None else now
         for c in self.status.conditions:
             if c.type == ctype:
                 if c.status != status:

@@ -1,0 +1,28 @@
+"""Decision flight recorder + deterministic replay (the "black box").
+
+Production autoscalers keep audit histories of every scaling decision
+(Google Autopilot's decision logs; AIBrix's simulation-driven tuning loop) —
+without one, a mis-sized scale-up that happened 20 minutes ago is
+undebuggable, because the inputs that produced it are gone. This package
+records one JSONL :data:`~wva_tpu.blackbox.schema.TRACE_SCHEMA_VERSION`
+record per engine cycle (metric snapshot, analyzer inputs/outputs, optimizer
+decisions, enforcer/limiter mutations, actuation outcome) into a thread-safe
+ring buffer with optional spill-to-disk, and can re-feed a recorded trace
+through the REAL analyzer -> optimizer -> enforcer -> limiter pipeline
+offline (``python -m wva_tpu replay trace.jsonl``), diffing replayed
+decisions against recorded ones bit-for-bit.
+"""
+
+from wva_tpu.blackbox.recorder import FlightRecorder
+from wva_tpu.blackbox.replay import ReplayEngine, ReplayReport, load_trace
+from wva_tpu.blackbox.schema import TRACE_SCHEMA_VERSION, decode, encode
+
+__all__ = [
+    "FlightRecorder",
+    "ReplayEngine",
+    "ReplayReport",
+    "load_trace",
+    "TRACE_SCHEMA_VERSION",
+    "decode",
+    "encode",
+]

@@ -1,0 +1,324 @@
+"""Thread-safe decision flight recorder: ring buffer + JSONL spill-to-disk.
+
+The engine executor opens a cycle record around every tick; the engine and
+the pipeline stages (optimizer / enforcer / limiter) append their inputs,
+outputs, and mutations into it; the reconciler's status writes — which run
+AFTER the tick that produced the decisions (drained triggers in simulation,
+a separate thread in production) — attach to the just-finished cycle's
+``post`` list until the next cycle opens. Committed records land in a
+bounded ring (the in-memory "black box", readable via :meth:`snapshot`) and,
+when a spill path is configured, are appended to a JSONL file that
+``python -m wva_tpu replay`` consumes.
+
+Recording is observability and must never bite: every hook is wrapped so a
+serialization error degrades to a dropped-record counter, not a failed
+engine tick.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from collections import deque
+
+from wva_tpu.blackbox.schema import TRACE_SCHEMA_VERSION, encode
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+# Writer-thread handoff bound: caps memory if the disk hangs outright.
+# Deliberately independent of ring_size — a small ring must not make the
+# spill file lossy under a normal burst the writer absorbs in milliseconds.
+SPILL_QUEUE_SIZE = 1024
+
+DROP_REASON_EVICTED = "ring-evicted"
+DROP_REASON_WRITE_ERROR = "write-error"
+DROP_REASON_WRITE_BACKLOG = "write-backlog"
+DROP_REASON_NO_CYCLE = "no-open-cycle"
+DROP_REASON_ENCODE_ERROR = "encode-error"
+
+
+class FlightRecorder:
+    """Cycle-scoped trace accumulator. All methods are thread-safe and
+    exception-safe (failures count into ``dropped_total``)."""
+
+    def __init__(self, clock: Clock | None = None, ring_size: int = 512,
+                 spill_path: str | None = None, registry=None) -> None:
+        self._mu = threading.Lock()
+        # File I/O happens on a dedicated writer thread, never on the engine
+        # tick thread: a slow or hung disk (NFS stall, error-retry) must not
+        # block begin_cycle. Committed records are handed over via a bounded
+        # queue — when the disk can't keep up the queue fills and records
+        # drop (counted), the control loop never waits. flush() is the
+        # synchronization point that drains the queue (deterministic tests,
+        # shutdown). _spill_mu guards the file handle (writer vs close).
+        self._spill_mu = threading.Lock()
+        self._spill_queue: queue.Queue | None = None
+        self.clock = clock or SYSTEM_CLOCK
+        self.ring: deque = deque(maxlen=max(int(ring_size), 1))
+        self.spill_path = spill_path
+        # MetricsRegistry (duck-typed): observe_trace_record /
+        # observe_trace_drop / observe_trace_write. None = counters only.
+        self.registry = registry
+        self._cycle_id = 0
+        self._open: dict | None = None      # record being built (in-tick)
+        self._pending: dict | None = None   # finished, accepting post events
+        self._spill_file = None
+        self.records_total = 0
+        self.dropped_total = 0
+        if self.spill_path is not None:
+            self._spill_queue = queue.Queue(maxsize=SPILL_QUEUE_SIZE)
+            threading.Thread(target=self._writer_loop,
+                             name="trace-spill-writer", daemon=True).start()
+
+    # --- cycle lifecycle (called by the engine executor) ---
+
+    def begin_cycle(self, engine: str) -> None:
+        with self._mu:
+            spill = self._commit_pending_locked()
+            self._cycle_id += 1
+            self._open = {
+                "schema": TRACE_SCHEMA_VERSION,
+                "cycle": self._cycle_id,
+                "ts": self.clock.now(),
+                "engine": engine,
+                "analyzer": "",
+                "outcome": "",
+                "models": [],
+                "stages": [],
+                "decisions": [],
+                "post": [],
+            }
+        self._spill(spill)
+
+    def end_cycle(self, outcome: str) -> None:
+        """Close the open cycle. The record stays pending (accepting ``post``
+        events from the reconciler) until the next ``begin_cycle`` or
+        :meth:`flush` commits it to the ring + spill file."""
+        with self._mu:
+            if self._open is None:
+                return
+            self._open["outcome"] = outcome
+            self._pending = self._open
+            self._open = None
+
+    def reset_cycle(self) -> None:
+        """Clear the open cycle's payload (models/stages/decisions) and
+        re-stamp its timestamp. The engine calls this at task entry so a
+        retried tick (executor retry loop) starts a clean record instead of
+        appending duplicate model entries to the failed attempt's."""
+        with self._mu:
+            if self._open is not None:
+                self._open["models"] = []
+                self._open["stages"] = []
+                self._open["decisions"] = []
+                self._open["ts"] = self.clock.now()
+
+    # --- in-cycle hooks (engine + pipeline stages) ---
+
+    def annotate(self, **fields) -> None:
+        """Merge cycle-level metadata (e.g. ``analyzer="slo"``)."""
+        with self._mu:
+            if self._open is not None:
+                self._open.update(fields)
+
+    def record_model(self, payload: dict) -> None:
+        self._append("models", payload)
+
+    def record_stage(self, stage: str, payload: dict) -> None:
+        """Pipeline-stage event. During a tick it lands in ``stages``; after
+        ``end_cycle`` (reconciler territory) it lands in the pending record's
+        ``post`` list — attributing post-tick effects to the cycle whose
+        decisions caused them."""
+        self._append("stages", {"stage": stage, **payload})
+
+    def record_stage_if(self, expected: tuple[str, int], stage: str,
+                        payload: dict) -> bool:
+        """Append a stage event ONLY if the record currently accepting
+        events still matches ``expected`` (engine, cycle id), atomically.
+        The reconciler runs on its own thread, so a separate "compare
+        cycle_info(), then record_stage()" would race the engine's
+        begin_cycle and file the event under the next cycle's record.
+        Returns whether the event was attached."""
+        try:
+            payload = encode({"stage": stage, **payload})
+        except Exception:  # noqa: BLE001
+            self._drop(DROP_REASON_ENCODE_ERROR)
+            log.debug("trace payload encoding failed", exc_info=True)
+            return False
+        with self._mu:
+            rec = self._open if self._open is not None else self._pending
+            if rec is None or (rec["engine"], rec["cycle"]) != expected:
+                return False
+            rec["stages" if self._open is not None else "post"] \
+                .append(payload)
+            return True
+
+    def record_decisions(self, decisions) -> None:
+        try:
+            encoded = [encode(d) for d in decisions]
+        except Exception:  # noqa: BLE001 — observability must not bite
+            self._drop(DROP_REASON_ENCODE_ERROR)
+            log.debug("decision encoding failed", exc_info=True)
+            return
+        with self._mu:
+            if self._open is None:
+                self._drop_locked(DROP_REASON_NO_CYCLE)
+                return
+            self._open["decisions"] = encoded
+
+    # --- internals ---
+
+    def _append(self, key: str, payload: dict) -> None:
+        try:
+            payload = encode(payload)
+        except Exception:  # noqa: BLE001
+            self._drop(DROP_REASON_ENCODE_ERROR)
+            log.debug("trace payload encoding failed", exc_info=True)
+            return
+        with self._mu:
+            if self._open is not None:
+                self._open[key].append(payload)
+            elif self._pending is not None:
+                self._pending["post"].append(payload)
+            else:
+                self._drop_locked(DROP_REASON_NO_CYCLE)
+
+    def _drop(self, reason: str) -> None:
+        with self._mu:
+            self._drop_locked(reason)
+
+    def _drop_locked(self, reason: str) -> None:
+        self.dropped_total += 1
+        if self.registry is not None:
+            try:
+                self.registry.observe_trace_drop(reason)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _commit_pending_locked(self) -> dict | None:
+        """Commit the pending record to the ring; returns the record to
+        hand to :meth:`_spill` AFTER ``_mu`` is released (None when nothing
+        to write)."""
+        record = self._pending
+        self._pending = None
+        if record is None:
+            return None
+        if self.spill_path is None and len(self.ring) == self.ring.maxlen:
+            # The evicted record was never persisted anywhere: that IS a
+            # drop. With a spill file the ring is just a hot cache.
+            self._drop_locked(DROP_REASON_EVICTED)
+        self.ring.append(record)
+        self.records_total += 1
+        if self.registry is not None:
+            try:
+                self.registry.observe_trace_record(record.get("engine", ""))
+            except Exception:  # noqa: BLE001
+                pass
+        return record if self.spill_path is not None else None
+
+    def _spill(self, record: dict | None) -> None:
+        """Hand a committed record to the writer thread, never blocking:
+        with the disk stalled the queue fills and the record drops
+        (counted), but the engine tick thread keeps making decisions."""
+        if record is None:
+            return
+        try:
+            self._spill_queue.put_nowait(record)
+        except queue.Full:
+            self._drop(DROP_REASON_WRITE_BACKLOG)
+            log.warning("trace spill backlog: writer cannot keep up with "
+                        "%s; record dropped from file (still in ring)",
+                        self.spill_path)
+
+    def _writer_loop(self) -> None:
+        while True:
+            record = self._spill_queue.get()
+            try:
+                self._write_record(record)
+            finally:
+                self._spill_queue.task_done()
+
+    def _write_record(self, record: dict) -> None:
+        start = time.perf_counter()
+        failed: Exception | None = None
+        with self._spill_mu:
+            try:
+                if self._spill_file is None:
+                    self._spill_file = open(  # noqa: SIM115 — long-lived
+                        self.spill_path, "a", encoding="utf-8")
+                self._spill_file.write(
+                    json.dumps(record, sort_keys=True, separators=(",", ":"))
+                    + "\n")
+                self._spill_file.flush()
+            except Exception as e:  # noqa: BLE001 — recording must never
+                # bite: an uncaught error (OSError, or TypeError from a
+                # non-JSON-serializable payload that slipped through
+                # encode()) would kill the writer thread and silently end
+                # all future spills.
+                failed = e
+        if failed is not None:
+            self._drop(DROP_REASON_WRITE_ERROR)
+            log.warning("trace spill to %s failed: %s", self.spill_path,
+                        failed)
+        elif self.registry is not None:
+            try:
+                self.registry.observe_trace_write(
+                    time.perf_counter() - start)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def cycle_info(self) -> tuple[str, int]:
+        """(engine, cycle id) of the record currently accepting events (the
+        open in-tick record, else the pending post-cycle one); ``("", 0)``
+        when neither. The reconciler compares this against a decision's
+        recorded (source, cycle) so an event only attaches to the exact
+        cycle whose decision it consumed — a reconcile arriving after the
+        next tick opened must not leak into that unrelated record."""
+        with self._mu:
+            rec = self._open if self._open is not None else self._pending
+            return (rec["engine"], rec["cycle"]) if rec is not None \
+                else ("", 0)
+
+    def current_cycle(self) -> int:
+        """Cycle id of the record currently accepting events (0 when none).
+        The engine stamps this onto DecisionCache entries so the reconciler
+        can attribute its trace events to the deciding cycle."""
+        return self.cycle_info()[1]
+
+    # --- reading / shutdown ---
+
+    def snapshot(self) -> list[dict]:
+        """Committed records currently held in the ring (oldest first)."""
+        with self._mu:
+            return list(self.ring)
+
+    def flush(self) -> None:
+        """Commit the pending record (if any), drain the writer queue, and
+        sync the spill file. This is the synchronization point for readers
+        of the spill file (harness teardown, replay tests) — unlike the
+        recording hooks it WAITS for the disk."""
+        with self._mu:
+            spill = self._commit_pending_locked()
+        self._spill(spill)
+        if self._spill_queue is not None:
+            self._spill_queue.join()
+        with self._spill_mu:
+            if self._spill_file is not None:
+                try:
+                    self._spill_file.flush()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self.flush()
+        with self._spill_mu:
+            if self._spill_file is not None:
+                try:
+                    self._spill_file.close()
+                except OSError:
+                    pass
+                self._spill_file = None

@@ -1,0 +1,370 @@
+"""Deterministic offline replay of recorded decision traces.
+
+``ReplayEngine`` re-feeds a recorded trace through the REAL pipeline code —
+no collector, no emulator threads, no Kubernetes:
+
+- **V1 path** (``path: "v1"``): the full analyzer -> optimizer -> enforcer
+  chain re-runs from the recorded analyzer INPUT (replica metrics, variant
+  states, saturation config): :class:`SaturationAnalyzer` is stateless given
+  an injected clock, so the whole decision is recomputed from scratch.
+- **V2/SLO paths**: the stateful analyzers (demand-trend history, EKF-tuned
+  profiles, capacity knowledge) cannot be reconstructed from a single
+  cycle, so replay starts from the recorded :class:`AnalyzerResult` and
+  re-runs the real ``CostAwareOptimizer`` -> enforcer bridge -> limiter.
+- **Enforcer**: the recorded request-count observation is fed back instead
+  of querying Prometheus (including recorded query errors, which replay the
+  fail-safe keep-targets path).
+- **Limiter**: a :class:`StaticInventory` is rebuilt from the recorded pool
+  snapshot and the real ``DefaultLimiter`` + ``GreedyBySaturation`` re-run.
+
+Cycles routed through the fleet-wide global optimizer are skipped (the
+solver consumes cluster-wide state the per-cycle record does not carry) and
+reported as such — a skip is visible, never silent.
+
+Replayed decisions are diffed field-by-field against the recorded ones;
+zero diffs means the trace is bit-for-bit reproducible. Traces recorded
+under an injected FakeClock (emulator / bench) reproduce timestamps exactly;
+wall-clock traces can use ``relax_timestamps`` to ignore time fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass, field
+
+from wva_tpu.analyzers.saturation import SaturationAnalyzer
+from wva_tpu.blackbox.schema import (
+    PATH_V1,
+    decode,
+    decode_scale_to_zero_config,
+    encode,
+)
+from wva_tpu.interfaces import (
+    AnalyzerResult,
+    ReplicaMetrics,
+    SaturationScalingConfig,
+    VariantReplicaState,
+)
+from wva_tpu.pipeline import (
+    CostAwareOptimizer,
+    DefaultLimiter,
+    Enforcer,
+    GreedyBySaturation,
+    ModelScalingRequest,
+    SCALE_TO_ZERO_REASON,
+    StaticInventory,
+    bridge_enforce,
+    saturation_targets_to_decisions,
+)
+from wva_tpu.utils.clock import FakeClock
+
+# Keys stripped everywhere when relax_timestamps is set (wall-clock traces).
+_TIME_KEYS = {"timestamp", "last_run_time", "analyzed_at"}
+
+SKIP_GLOBAL_OPTIMIZER = "global-optimizer"
+SKIP_OUTCOME = "non-success-outcome"
+
+
+def load_trace(path: str) -> list[dict]:
+    """Parse a JSONL trace file into cycle records (blank lines skipped)."""
+    records = []
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: invalid trace line: {e}") \
+                    from None
+    return records
+
+
+@dataclass
+class ReplayReport:
+    cycles_total: int = 0
+    cycles_replayed: int = 0
+    cycles_empty: int = 0
+    cycles_skipped: dict[str, int] = field(default_factory=dict)
+    decisions_recorded: int = 0
+    decisions_replayed: int = 0
+    mismatches: list[dict] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        # Zero replayed cycles means nothing was verified: a recording
+        # regression that empties every record (or stamps non-success
+        # outcomes, or routes everything to the skipped global optimizer)
+        # must fail the `make replay-golden` gate, not green-light it.
+        return not self.mismatches and self.cycles_replayed > 0
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "cycles_total": self.cycles_total,
+            "cycles_replayed": self.cycles_replayed,
+            "cycles_empty": self.cycles_empty,
+            "cycles_skipped": dict(sorted(self.cycles_skipped.items())),
+            "decisions_recorded": self.decisions_recorded,
+            "decisions_replayed": self.decisions_replayed,
+            "mismatch_count": len(self.mismatches),
+            "mismatches": self.mismatches,
+        }
+
+
+class ReplayEngine:
+    def __init__(self, records: list[dict]) -> None:
+        self.records = records
+        self.clock = FakeClock()
+        self.v1_analyzer = SaturationAnalyzer(clock=self.clock)
+        self.optimizer = CostAwareOptimizer()
+        # Replayed decisions per replayed cycle id (for --emit / inspection).
+        self.replayed: dict[int, list] = {}
+
+    # --- main loop ---
+
+    def replay(self, relax_timestamps: bool = False,
+               max_diffs: int = 1000) -> ReplayReport:
+        report = ReplayReport()
+        for rec in self.records:
+            report.cycles_total += 1
+            models = rec.get("models") or []
+            if not models:
+                report.cycles_empty += 1
+                continue
+            skip = self._skip_reason(rec, models)
+            if skip is not None:
+                report.cycles_skipped[skip] = \
+                    report.cycles_skipped.get(skip, 0) + 1
+                continue
+            self.clock.set(float(rec.get("ts", 0.0)))
+            decisions = self._replay_cycle(rec, models)
+            self.replayed[rec.get("cycle", report.cycles_total)] = decisions
+            report.cycles_replayed += 1
+            self._diff_cycle(rec, decisions, relax_timestamps,
+                             max_diffs, report)
+        return report
+
+    @staticmethod
+    def _skip_reason(rec: dict, models: list[dict]) -> str | None:
+        if rec.get("outcome") not in ("", "success", None):
+            # Error/aborted ticks may carry partial records from failed
+            # attempts — not a replay anchor.
+            return SKIP_OUTCOME
+        if any(m.get("optimizer") == "global" for m in models):
+            return SKIP_GLOBAL_OPTIMIZER
+        return None
+
+    def _replay_cycle(self, rec: dict, models: list[dict]) -> list:
+        enforcer_events = {
+            (ev.get("model_id"), ev.get("namespace")): ev
+            for ev in rec.get("stages", []) if ev.get("stage") == "enforcer"}
+        limiter_event = next(
+            (ev for ev in rec.get("stages", [])
+             if ev.get("stage") == "limiter"), None)
+
+        decisions: list = []
+        v2_requests: list[ModelScalingRequest] = []
+        for m in models:
+            if m.get("path") == PATH_V1:
+                decisions.extend(self._replay_v1_model(m, enforcer_events))
+            else:
+                v2_requests.append(self._decode_request(m))
+        if v2_requests:
+            decisions.extend(
+                self._replay_v2(v2_requests, enforcer_events))
+
+        if limiter_event is not None:
+            limits = {p["accelerator_type"]: p["limit"]
+                      for p in limiter_event.get("pools", [])}
+            limiter = DefaultLimiter(
+                limiter_event.get("name", "tpu-slice-limiter"),
+                StaticInventory(limits), GreedyBySaturation(),
+                clock=self.clock)
+            limiter.limit(decisions)
+        return decisions
+
+    # --- per-path replay ---
+
+    def _replay_v1_model(self, m: dict, enforcer_events: dict) -> list:
+        model_id, namespace = m.get("model_id", ""), m.get("namespace", "")
+        inp = m.get("input", {})
+        replica_metrics = [decode(ReplicaMetrics, x)
+                           for x in inp.get("replica_metrics", [])]
+        states = [decode(VariantReplicaState, x)
+                  for x in inp.get("variant_states", [])]
+        cfg = decode(SaturationScalingConfig, inp.get("config")) \
+            or SaturationScalingConfig()
+        recorded_ts = (m.get("analysis") or {}).get("analyzed_at")
+        if recorded_ts:
+            self.clock.set(float(recorded_ts))
+
+        analysis = self.v1_analyzer.analyze_model_saturation(
+            model_id, namespace, replica_metrics, cfg)
+        targets = self.v1_analyzer.calculate_saturation_targets(
+            analysis, states)
+
+        ev = enforcer_events.get((model_id, namespace))
+        enforcer = self._enforcer_for(ev)
+        s2z = decode_scale_to_zero_config((ev or {}).get("s2z_config"))
+        targets, scaled_to_zero = enforcer.enforce_policy(
+            model_id, namespace, targets, analysis.variant_analyses, s2z)
+        return saturation_targets_to_decisions(
+            targets, analysis, states,
+            enforcer_note=(SCALE_TO_ZERO_REASON
+                           if scaled_to_zero else ""))
+
+    def _decode_request(self, m: dict) -> ModelScalingRequest:
+        inp = m.get("input", {})
+        result = decode(AnalyzerResult, m.get("result"))
+        if result is not None and result.analyzed_at:
+            self.clock.set(result.analyzed_at)
+        return ModelScalingRequest(
+            model_id=m.get("model_id", ""),
+            namespace=m.get("namespace", ""),
+            result=result,
+            variant_states=[decode(VariantReplicaState, x)
+                            for x in inp.get("variant_states", [])])
+
+    def _replay_v2(self, requests: list[ModelScalingRequest],
+                   enforcer_events: dict) -> list:
+        decisions = self.optimizer.optimize(requests, None)
+        for req in requests:
+            ev = enforcer_events.get((req.model_id, req.namespace))
+            enforcer = self._enforcer_for(ev)
+            s2z = decode_scale_to_zero_config((ev or {}).get("s2z_config"))
+            bridge_enforce(decisions, req.model_id, req.namespace, enforcer,
+                           s2z, now=self.clock.now(),
+                           optimizer_name=self.optimizer.name())
+        return decisions
+
+    @staticmethod
+    def _enforcer_for(ev: dict | None) -> Enforcer:
+        """Enforcer whose request-count source is the RECORDED observation —
+        including recorded query errors, which replay the fail-safe
+        keep-targets branch exactly."""
+        def count_func(model_id: str, namespace: str, retention: float):
+            if ev is not None and ev.get("error"):
+                raise RuntimeError(f"recorded query error: {ev['error']}")
+            if ev is None or ev.get("request_count") is None:
+                raise LookupError(
+                    f"trace has no request count for {namespace}/{model_id}")
+            return ev["request_count"]
+        return Enforcer(count_func)
+
+    # --- diffing ---
+
+    def _diff_cycle(self, rec: dict, decisions: list,
+                    relax_timestamps: bool, max_diffs: int,
+                    report: ReplayReport) -> None:
+        recorded = rec.get("decisions") or []
+        replayed = [encode(d) for d in decisions]
+        if relax_timestamps:
+            recorded = [_strip_time_keys(d) for d in recorded]
+            replayed = [_strip_time_keys(d) for d in replayed]
+        report.decisions_recorded += len(recorded)
+        report.decisions_replayed += len(replayed)
+        cycle = rec.get("cycle")
+        if len(recorded) != len(replayed):
+            if len(report.mismatches) < max_diffs:
+                report.mismatches.append({
+                    "cycle": cycle, "kind": "decision-count",
+                    "recorded": len(recorded), "replayed": len(replayed)})
+            return
+        for i, (a, b) in enumerate(zip(recorded, replayed)):
+            for path, rec_v, rep_v in _diff_value(a, b, ""):
+                if len(report.mismatches) >= max_diffs:
+                    return
+                report.mismatches.append({
+                    "cycle": cycle,
+                    "variant": a.get("variant_name", f"#{i}"),
+                    "namespace": a.get("namespace", ""),
+                    "field": path.lstrip("."),
+                    "recorded": rec_v, "replayed": rep_v})
+
+
+_MISSING = "<missing>"
+
+
+def _strip_time_keys(value):
+    if isinstance(value, dict):
+        return {k: _strip_time_keys(v) for k, v in value.items()
+                if k not in _TIME_KEYS}
+    if isinstance(value, list):
+        return [_strip_time_keys(v) for v in value]
+    return value
+
+
+def _diff_value(recorded, replayed, path):
+    """Yield (path, recorded, replayed) for every differing leaf."""
+    if isinstance(recorded, dict) and isinstance(replayed, dict):
+        for key in sorted(set(recorded) | set(replayed)):
+            yield from _diff_value(recorded.get(key, _MISSING),
+                                   replayed.get(key, _MISSING),
+                                   f"{path}.{key}")
+        return
+    if isinstance(recorded, list) and isinstance(replayed, list):
+        if len(recorded) != len(replayed):
+            yield (f"{path}.length", len(recorded), len(replayed))
+        for i, (a, b) in enumerate(zip(recorded, replayed)):
+            yield from _diff_value(a, b, f"{path}[{i}]")
+        return
+    if isinstance(recorded, bool) != isinstance(replayed, bool) \
+            or recorded != replayed:
+        yield (path, recorded, replayed)
+
+
+# --- CLI (python -m wva_tpu replay) ---
+
+def replay_cli(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="wva-tpu replay",
+        description="Re-run a recorded decision trace through the real "
+                    "analyzer/optimizer/enforcer/limiter pipeline and diff "
+                    "replayed decisions against recorded ones.")
+    p.add_argument("trace", help="JSONL trace file (WVA_TRACE_PATH output)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full machine-readable report")
+    p.add_argument("--relax-timestamps", action="store_true",
+                   help="ignore time fields (for wall-clock traces, whose "
+                        "per-stage timestamps are not reproducible)")
+    p.add_argument("--max-diffs", type=int, default=20,
+                   help="cap on reported field mismatches (default 20)")
+    args = p.parse_args(argv)
+
+    try:
+        records = load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    engine = ReplayEngine(records)
+    report = engine.replay(relax_timestamps=args.relax_timestamps,
+                           max_diffs=args.max_diffs)
+    if args.json:
+        print(json.dumps(report.to_dict(), sort_keys=True, indent=1))
+    else:
+        d = report.to_dict()
+        print(f"cycles: {d['cycles_total']} total, "
+              f"{d['cycles_replayed']} replayed, "
+              f"{d['cycles_empty']} empty, "
+              f"skipped: {d['cycles_skipped'] or 'none'}")
+        print(f"decisions: {d['decisions_recorded']} recorded, "
+              f"{d['decisions_replayed']} replayed, "
+              f"{d['mismatch_count']} mismatched")
+        for m in report.mismatches:
+            print(f"  cycle {m.get('cycle')} "
+                  f"{m.get('namespace', '')}/{m.get('variant', '')} "
+                  f"{m.get('field', m.get('kind'))}: "
+                  f"recorded={m.get('recorded')!r} "
+                  f"replayed={m.get('replayed')!r}")
+        if report.ok:
+            print("REPLAY OK (zero diffs)")
+        elif report.cycles_replayed == 0:
+            print("REPLAY FAILED (no cycles replayed — nothing verified)")
+        else:
+            print("REPLAY FAILED")
+    return 0 if report.ok else 1

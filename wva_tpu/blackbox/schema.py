@@ -1,0 +1,112 @@
+"""DecisionTrace record schema: serialization for the flight recorder.
+
+One JSONL record per engine cycle:
+
+.. code-block:: json
+
+    {"schema": 1, "cycle": 17, "ts": 1000123.0, "engine": "saturation-engine",
+     "analyzer": "v1", "outcome": "success",
+     "models":    [{"model_id": "...", "namespace": "...", "path": "v1",
+                    "input": {"replica_metrics": [], "variant_states": [],
+                              "config": {}, "scheduler_queue": null},
+                    "analysis": {}, "targets": {},
+                    "enforced_targets": {}, "scaled_to_zero": false}],
+     "stages":    [{"stage": "enforcer", "...": "..."}],
+     "decisions": [{"variant_name": "...", "decision_steps": []}],
+     "post":      [{"stage": "reconcile", "...": "..."}]}
+
+``models`` carries everything the replay engine needs to re-run the pipeline
+(the analyzer INPUT for the stateless V1 path; the :class:`AnalyzerResult`
+for the stateful V2/SLO analyzers, whose trend/EKF state cannot be
+reconstructed from one cycle). ``stages`` carries the pipeline components'
+own events (enforcer request counts, limiter inventory pools) recorded
+during the cycle; ``post`` carries events attributed after the cycle ended
+(reconciler status writes triggered by this cycle's decisions).
+
+Encoding is plain :func:`dataclasses.asdict`; decoding is a small generic
+type-hint-driven reconstructor, so interface dataclasses round-trip without
+per-type glue. Floats round-trip exactly (JSON uses repr shortest-float).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import types
+from typing import Union, get_args, get_origin, get_type_hints
+
+TRACE_SCHEMA_VERSION = 1
+
+# Stage-event names used by the pipeline hooks.
+STAGE_ENFORCER = "enforcer"
+STAGE_OPTIMIZER = "optimizer"
+STAGE_LIMITER = "limiter"
+STAGE_ACTUATION = "actuation"
+STAGE_RECONCILE = "reconcile"
+
+# Per-model pipeline paths.
+PATH_V1 = "v1"
+PATH_V2 = "v2"
+PATH_SLO = "slo"
+
+
+def encode(obj):
+    """Dataclass / list / dict / scalar -> JSON-serializable structure."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: encode(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, dict):
+        return {k: encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode(v) for v in obj]
+    return obj
+
+
+def decode(cls, data):
+    """Reconstruct dataclass ``cls`` from :func:`encode` output. Unknown keys
+    are ignored (forward compatibility with newer trace schemas)."""
+    if data is None:
+        return None
+    hints = get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _decode_value(hints.get(f.name), data[f.name])
+    return cls(**kwargs)
+
+
+def _decode_value(tp, value):
+    if value is None or tp is None:
+        return value
+    origin = get_origin(tp)
+    # typing.Optional[X] and PEP 604 ``X | None`` have different origins.
+    if origin is Union or origin is types.UnionType:
+        for arg in get_args(tp):
+            if arg is type(None):
+                continue
+            return _decode_value(arg, value)
+        return value
+    if origin in (list, tuple):
+        args = get_args(tp)
+        elem = args[0] if args else None
+        return [_decode_value(elem, v) for v in value]
+    if origin is dict:
+        args = get_args(tp)
+        elem = args[1] if len(args) > 1 else None
+        return {k: _decode_value(elem, v) for k, v in value.items()}
+    if dataclasses.is_dataclass(tp):
+        return decode(tp, value)
+    if tp is int and isinstance(value, float):
+        return int(value)
+    return value
+
+
+def encode_scale_to_zero_config(cfg) -> dict:
+    """``ScaleToZeroConfigData`` (model -> ModelScaleToZeroConfig)."""
+    return {k: encode(v) for k, v in (cfg or {}).items()}
+
+
+def decode_scale_to_zero_config(data) -> dict:
+    from wva_tpu.config.types import ModelScaleToZeroConfig
+
+    return {k: decode(ModelScaleToZeroConfig, v)
+            for k, v in (data or {}).items()}

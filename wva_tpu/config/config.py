@@ -11,7 +11,6 @@ from __future__ import annotations
 import copy
 import logging
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING
 
@@ -20,6 +19,7 @@ if TYPE_CHECKING:  # pragma: no cover — avoids a config -> analyzers cycle
 
 from wva_tpu.config.types import CacheConfig, ScaleToZeroConfigData
 from wva_tpu.interfaces.saturation_config import SaturationScalingConfig
+from wva_tpu.utils.clock import SYSTEM_CLOCK
 
 log = logging.getLogger(__name__)
 
@@ -83,6 +83,17 @@ class FeatureFlagsConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Decision flight recorder (``wva_tpu.blackbox``): one JSONL record per
+    engine cycle, kept in a bounded in-memory ring and optionally spilled to
+    ``path`` for offline replay (``python -m wva_tpu replay``)."""
+
+    enabled: bool = False
+    path: str = ""  # "" = ring buffer only, no spill-to-disk
+    ring_size: int = 512
+
+
+@dataclass
 class ConfigSyncState:
     configmaps_bootstrap_complete: bool = False
     last_configmaps_sync_at: float = 0.0
@@ -107,6 +118,7 @@ class Config:
         self._scale_to_zero_ns: dict[str, ScaleToZeroConfigData] = {}
         self._slo_global: "SLOConfigData | None" = None
         self._slo_ns: dict[str, "SLOConfigData"] = {}
+        self._trace = TraceConfig()
 
     # --- infrastructure getters ---
 
@@ -199,6 +211,16 @@ class Config:
     def set_features(self, f: FeatureFlagsConfig) -> None:
         with self._mu:
             self._features = copy.deepcopy(f)
+
+    # --- decision trace (flight recorder) ---
+
+    def trace_config(self) -> TraceConfig:
+        with self._mu:
+            return copy.deepcopy(self._trace)
+
+    def set_trace(self, t: TraceConfig) -> None:
+        with self._mu:
+            self._trace = copy.deepcopy(t)
 
     # --- saturation config (namespace-aware; reference config.go:318-354) ---
 
@@ -311,7 +333,7 @@ class Config:
     def mark_configmaps_bootstrap_complete(self) -> None:
         with self._mu:
             self._sync.configmaps_bootstrap_complete = True
-            self._sync.last_configmaps_sync_at = time.time()
+            self._sync.last_configmaps_sync_at = SYSTEM_CLOCK.now()
             self._sync.last_configmaps_sync_error = ""
 
     def record_configmaps_sync_error(self, err: str) -> None:

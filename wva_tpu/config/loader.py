@@ -21,6 +21,7 @@ from wva_tpu.config.config import (
     InfrastructureConfig,
     PrometheusConfig,
     TLSConfig,
+    TraceConfig,
 )
 from wva_tpu.config.types import CacheConfig, FreshnessThresholds
 from wva_tpu.config.validation import validate
@@ -50,6 +51,9 @@ DEFAULTS: dict[str, Any] = {
     "METRICS_CERT_KEY": "tls.key",
     "WVA_SCALE_TO_ZERO": False,
     "WVA_LIMITED_MODE": False,
+    "WVA_TRACE_ENABLED": False,
+    "WVA_TRACE_PATH": "",
+    "WVA_TRACE_RING_SIZE": 512,
     "SCALE_FROM_ZERO_ENGINE_MAX_CONCURRENCY": 10,
     "EPP_METRIC_READER_BEARER_TOKEN": "",
     "GLOBAL_OPT_INTERVAL": "60s",
@@ -160,6 +164,11 @@ def load(flags: Mapping[str, Any] | None = None,
     ))
     cfg.set_epp(EPPConfig(
         metric_reader_bearer_token=r.get_str("EPP_METRIC_READER_BEARER_TOKEN"),
+    ))
+    cfg.set_trace(TraceConfig(
+        enabled=r.get_bool("WVA_TRACE_ENABLED"),
+        path=r.get_str("WVA_TRACE_PATH"),
+        ring_size=r.get_int("WVA_TRACE_RING_SIZE"),
     ))
 
     prom = PrometheusConfig(

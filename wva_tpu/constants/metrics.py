@@ -69,6 +69,11 @@ WVA_DESIRED_RATIO = "wva_desired_ratio"
 WVA_ENGINE_TICK_DURATION_SECONDS = "wva_engine_tick_duration_seconds"
 WVA_ENGINE_TICKS_TOTAL = "wva_engine_ticks_total"
 
+# --- Decision flight recorder health (wva_tpu.blackbox) ---
+WVA_TRACE_RECORDS_TOTAL = "wva_trace_records_total"
+WVA_TRACE_DROPPED_TOTAL = "wva_trace_dropped_total"
+WVA_TRACE_WRITE_SECONDS = "wva_trace_write_seconds"
+
 # --- Common metric label names ---
 LABEL_MODEL_NAME = "model_name"
 LABEL_TARGET_MODEL_NAME = "target_model_name"

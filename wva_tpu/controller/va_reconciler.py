@@ -41,12 +41,17 @@ log = logging.getLogger(__name__)
 class VariantAutoscalingReconciler:
     def __init__(self, client: KubeClient, datastore: Datastore,
                  indexer: Indexer, clock: Clock | None = None,
-                 recorder=None, watch_namespace: str = "") -> None:
+                 recorder=None, watch_namespace: str = "",
+                 flight_recorder=None) -> None:
         self.client = client
         self.datastore = datastore
         self.indexer = indexer
         self.clock = clock or SYSTEM_CLOCK
         self.recorder = recorder  # k8s.events.EventRecorder | None
+        # Optional blackbox.FlightRecorder: status writes that consume an
+        # engine decision are appended to the deciding cycle's trace record
+        # (its ``post`` list) — the actuation tail of the audit trail.
+        self.flight_recorder = flight_recorder
         # Namespace-scoped mode: besides the client's scoped watch streams
         # (RestKubeClient), events are filtered here too so the behavior is
         # identical under any KubeClient (FakeCluster dispatches
@@ -185,7 +190,8 @@ class VariantAutoscalingReconciler:
             return
 
         # Consume the engine's decision.
-        decision = common.DecisionCache.get(name, namespace)
+        decision, decision_source, decision_cycle = \
+            common.DecisionCache.get_entry(name, namespace)
         if decision is not None:
             if decision.accelerator_name or decision.target_replicas:
                 # ScalingDecision Events are emitted by the deciding engine
@@ -204,5 +210,25 @@ class VariantAutoscalingReconciler:
         # per VA, and a no-op PUT per trigger doubles the apiserver write
         # load for nothing (the reference's event-driven reconciler has the
         # same property implicitly — patches only carry diffs).
-        if va_status_material(va) != prev_material:
+        wrote = va_status_material(va) != prev_material
+        if wrote:
             update_va_status_with_backoff(self.client, va)
+        # Attribute the trace event only when the consumed decision came
+        # from the exact cycle currently accepting events: DecisionCache is
+        # also written by the (untraced) scale-from-zero engine, and in
+        # production this reconciler runs on its own thread, so a reconcile
+        # consuming cycle N's decision can arrive after cycle N+1 opened.
+        # Either way the event must not land in an unrelated cycle's audit
+        # record with a contradicting desired value. The compare-and-append
+        # is atomic inside the recorder — checking cycle_info() here and
+        # then appending would race the engine's begin_cycle.
+        if self.flight_recorder is not None and decision is not None:
+            self.flight_recorder.record_stage_if(
+                (decision_source, decision_cycle), "reconcile", {
+                    "variant": name, "namespace": namespace,
+                    "source": decision_source,
+                    "desired": decision.target_replicas,
+                    "accelerator": decision.accelerator_name,
+                    "metrics_available": decision.metrics_available,
+                    "wrote_status": wrote,
+                })

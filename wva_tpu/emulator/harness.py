@@ -79,6 +79,7 @@ class EmulationHarness:
         emit_interval: float = 5.0,
         start_time: float = 1_000_000.0,
         stochastic_seed: int | None = None,
+        trace_path: str | None = None,
     ) -> None:
         self.namespace = namespace
         self.variants = variants
@@ -89,6 +90,14 @@ class EmulationHarness:
         self.config = config or new_test_config()
         self.config.update_saturation_config(
             {"default": saturation_config or SaturationScalingConfig()})
+        if trace_path is not None:
+            # Decision flight recorder: every engine cycle of this emulated
+            # world lands in trace_path as JSONL, replayable offline with
+            # ``python -m wva_tpu replay`` (FakeClock timestamps make the
+            # trace bit-for-bit reproducible).
+            from wva_tpu.config import TraceConfig
+
+            self.config.set_trace(TraceConfig(enabled=True, path=trace_path))
 
         # Node pools: default = 8 single-host v5e-8 slices (north-star shape).
         for pool in (nodepools or [("v5e-pool", "v5e", "2x4", 8)]):
@@ -120,6 +129,7 @@ class EmulationHarness:
         self.manager: Manager = build_manager(
             self.cluster, self.config, clock=self.clock, tsdb=self.tsdb,
             pod_fetcher=epp_fetcher)
+        self.flight_recorder = self.manager.flight_recorder
         self.manager.engine.executor.max_retries_per_tick = 1
         self.manager.scale_from_zero.executor.max_retries_per_tick = 1
         self.manager.setup()
@@ -271,6 +281,11 @@ class EmulationHarness:
             if on_step is not None:
                 on_step(self, t)
             self.clock.advance(dt)
+        if self.flight_recorder is not None:
+            # The last cycle stays pending (accepting reconciler events)
+            # until committed; flush so the spill file is replayable as soon
+            # as run() returns.
+            self.flight_recorder.flush()
 
     # --- measurement ---
 

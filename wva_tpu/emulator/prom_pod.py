@@ -32,6 +32,7 @@ import urllib.request
 from wva_tpu.collector.source.pod_scrape import parse_prometheus_text
 from wva_tpu.collector.source.promql import TimeSeriesDB
 from wva_tpu.emulator.prom_server import FakePrometheusServer
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 
 def _static_targets() -> list[tuple[str, str]]:
@@ -71,11 +72,14 @@ class ScrapingProm:
     """TSDB + lazy scraper; plugs into FakePrometheusServer as refresh."""
 
     def __init__(self, target_fn, interval: float = 5.0,
-                 timeout: float = 3.0) -> None:
+                 timeout: float = 3.0, clock: Clock | None = None) -> None:
         self.db = TimeSeriesDB()
         self.target_fn = target_fn
         self.interval = interval
         self.timeout = timeout
+        # Sample timestamps come from the injectable clock (wall time in the
+        # standalone pod; fakeable in tests — clock discipline everywhere).
+        self.clock = clock or SYSTEM_CLOCK
         # -inf: the first refresh must always scrape (monotonic time can be
         # smaller than the interval right after boot).
         self._last_scrape = float("-inf")
@@ -98,7 +102,7 @@ class ScrapingProm:
             except Exception as e:  # noqa: BLE001 — a down pod must not
                 print(f"scrape {url}: {e}", flush=True)  # kill the cycle
                 continue
-            ts = time.time()
+            ts = self.clock.now()
             for name, labels, value in parse_prometheus_text(text):
                 if pod_name and "pod" not in labels:
                     labels = {**labels, "pod": pod_name}

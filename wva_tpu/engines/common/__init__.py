@@ -16,6 +16,14 @@ from wva_tpu.interfaces import VariantDecision
 
 DECISION_TRIGGER_BUFFER = 1000
 
+# Decision sources: which engine produced a cached decision. Values match
+# the producing executors' names (= the flight recorder's cycle ``engine``
+# field) so the reconciler can attribute its trace events to the deciding
+# engine's cycle — and drop them when an untraced engine (scale-from-zero)
+# decided between traced ticks.
+SOURCE_SATURATION = "saturation-engine"
+SOURCE_SCALE_FROM_ZERO = "scale-from-zero"
+
 
 @dataclass
 class TriggerEvent:
@@ -28,19 +36,30 @@ class TriggerEvent:
 class DecisionCacheType:
     def __init__(self) -> None:
         self._mu = threading.RLock()
-        self._decisions: dict[str, VariantDecision] = {}
+        # key -> (decision, source engine, trace cycle id that produced it;
+        # 0 = no flight recorder was active when the decision was made).
+        self._decisions: dict[str, tuple[VariantDecision, str, int]] = {}
 
     @staticmethod
     def _key(name: str, namespace: str) -> str:
         return f"{namespace}/{name}"
 
-    def set(self, name: str, namespace: str, decision: VariantDecision) -> None:
+    def set(self, name: str, namespace: str, decision: VariantDecision,
+            source: str = "", cycle: int = 0) -> None:
         with self._mu:
-            self._decisions[self._key(name, namespace)] = decision
+            self._decisions[self._key(name, namespace)] = \
+                (decision, source, cycle)
 
     def get(self, name: str, namespace: str) -> VariantDecision | None:
         with self._mu:
-            return self._decisions.get(self._key(name, namespace))
+            entry = self._decisions.get(self._key(name, namespace))
+            return entry[0] if entry is not None else None
+
+    def get_entry(self, name: str, namespace: str) \
+            -> tuple[VariantDecision | None, str, int]:
+        with self._mu:
+            return self._decisions.get(self._key(name, namespace),
+                                       (None, "", 0))
 
     def delete(self, name: str, namespace: str) -> None:
         with self._mu:

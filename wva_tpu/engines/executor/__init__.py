@@ -53,6 +53,10 @@ class PollingExecutor(Executor):
         # (name, wall_seconds, ok). Wired to MetricsRegistry.observe_tick by
         # the manager; gate-skipped ticks are not observed.
         self.on_tick: Callable[[str, float, bool], None] | None = None
+        # Optional blackbox.FlightRecorder: every executed tick opens one
+        # decision-trace cycle record that the task's pipeline stages fill.
+        # Gate-skipped ticks open no cycle (nothing ran, nothing to replay).
+        self.flight_recorder = None
 
     def trigger(self) -> None:
         """Request an immediate tick (thread-safe, idempotent)."""
@@ -69,11 +73,16 @@ class PollingExecutor(Executor):
         """Execute the task once, retrying with backoff on failure."""
         if self.gate is not None and not self.gate():
             return
+        flight = self.flight_recorder
+        if flight is not None:
+            flight.begin_cycle(self.name)
         start = time.perf_counter()
         outcome = "aborted"
         try:
             outcome = self._run_with_retries(stop)
         finally:
+            if flight is not None:
+                flight.end_cycle(outcome)
             # Aborted ticks (shutdown / leadership lost mid-retry) are NOT
             # observed — consistent with gate-skipped ticks above, and so
             # every controller shutdown doesn't ring the error-rate alert

@@ -49,12 +49,10 @@ from wva_tpu.interfaces import (
     ACTION_SCALE_DOWN,
     ACTION_SCALE_UP,
     AnalyzerInput,
-    ModelSaturationAnalysis,
     ReplicaMetrics,
     SaturationScalingConfig,
     VariantDecision,
     VariantReplicaState,
-    VariantSaturationAnalysis,
 )
 from wva_tpu.interfaces.saturation_config import SLO_ANALYZER_NAME, V2_ANALYZER_NAME
 from wva_tpu.k8s.client import KubeClient, NotFoundError
@@ -64,7 +62,10 @@ from wva_tpu.pipeline import (
     Enforcer,
     Limiter,
     ModelScalingRequest,
+    SCALE_TO_ZERO_REASON,
     ScalingOptimizer,
+    bridge_enforce,
+    saturation_targets_to_decisions,
 )
 from wva_tpu.utils import scale_target
 from wva_tpu.utils import variant as variant_utils
@@ -123,6 +124,7 @@ class SaturationEngine:
         poll_interval: float = DEFAULT_ENGINE_POLL_INTERVAL,
         direct_actuator=None,
         recorder=None,
+        flight_recorder=None,
     ) -> None:
         self.client = client
         self.config = config
@@ -148,8 +150,14 @@ class SaturationEngine:
         # Active make-before-break holds: "ns/model|variant" ->
         # (hold start time, replicas at hold start, target accelerator).
         self._migration_holds: dict[str, tuple[float, int, str]] = {}
+        # Optional blackbox.FlightRecorder (decision trace): the executor
+        # opens one cycle record per tick; the engine and pipeline stages
+        # fill it with analyzer inputs/outputs, decisions, and actuation.
+        self.flight = flight_recorder
         self.executor = PollingExecutor(self.optimize, poll_interval,
-                                        clock=self.clock, name="saturation-engine")
+                                        clock=self.clock,
+                                        name=common.SOURCE_SATURATION)
+        self.executor.flight_recorder = flight_recorder
 
     # --- loop entry ---
 
@@ -158,6 +166,10 @@ class SaturationEngine:
 
     def optimize(self) -> None:
         """One optimization tick (reference engine.go:171-277)."""
+        if self.flight is not None:
+            # Retried ticks must not stack duplicate model records into the
+            # failed attempt's cycle.
+            self.flight.reset_cycle()
         active_vas = variant_utils.active_variant_autoscalings(
             self.client, namespace=self.config.watch_namespace() or None)
         if not active_vas:
@@ -182,6 +194,9 @@ class SaturationEngine:
             global_cfg.apply_defaults()
             analyzer_name = global_cfg.analyzer_name
 
+        if self.flight is not None:
+            self.flight.annotate(analyzer=analyzer_name or "v1")
+
         # Analyzer selection by name (reference engine.go:236-254); "slo"
         # reuses the V2 optimizer/enforcer flow with the queueing-model
         # analyzer producing req/s capacities instead of token capacities.
@@ -191,6 +206,8 @@ class SaturationEngine:
         else:
             decisions = self._optimize_v1(model_groups)
 
+        if self.flight is not None:
+            self.flight.record_decisions(decisions)
         self._apply_decisions(decisions, va_map)
 
     # --- V1 path ---
@@ -224,6 +241,7 @@ class SaturationEngine:
                 model_id, namespace, data.replica_metrics, sat_cfg)
             targets = self.v1_analyzer.calculate_saturation_targets(
                 analysis, data.variant_states)
+            saturation_targets = dict(targets)  # pre-enforcement snapshot
 
             s2z_cfg = self.config.scale_to_zero_config_for_namespace(namespace)
             targets, scaled_to_zero = self.enforcer.enforce_policy(
@@ -231,9 +249,25 @@ class SaturationEngine:
             if scaled_to_zero:
                 log.info("Scale-to-zero enforcement applied for %s", model_id)
 
-            all_decisions.extend(self._targets_to_decisions(
+            if self.flight is not None:
+                self.flight.record_model({
+                    "model_id": model_id, "namespace": namespace,
+                    "path": "v1",
+                    "input": {
+                        "replica_metrics": data.replica_metrics,
+                        "variant_states": data.variant_states,
+                        "config": sat_cfg,
+                        "scheduler_queue": None,
+                    },
+                    "analysis": analysis,
+                    "targets": saturation_targets,
+                    "enforced_targets": dict(targets),
+                    "scaled_to_zero": scaled_to_zero,
+                })
+
+            all_decisions.extend(saturation_targets_to_decisions(
                 targets, analysis, data.variant_states,
-                enforcer_note=("scale-to-zero: no requests within retention"
+                enforcer_note=(SCALE_TO_ZERO_REASON
                                if scaled_to_zero else "")))
 
         self._apply_limiter(all_decisions)
@@ -246,6 +280,11 @@ class SaturationEngine:
         use_slo: bool = False,
     ) -> list[VariantDecision]:
         requests: list[ModelScalingRequest] = []
+        # Optimizer route per (model, namespace), resolved ONCE from the
+        # same sat_cfg snapshot the analysis used — the trace record and the
+        # global/local split below must agree by construction, or a config
+        # hot-reload mid-tick makes replay diverge from what actually ran.
+        routes: dict[tuple[str, str], str] = {}
         slo_cfg_by_ns: dict[str, object] = {}
         if use_slo:
             # Sync profiles once per distinct namespace per tick (not per
@@ -278,13 +317,16 @@ class SaturationEngine:
             if data is None:
                 continue
 
+            scheduler_queue = self.collector.collect_scheduler_queue_metrics(
+                model_id)
             try:
                 if use_slo:
                     result = self._run_slo_analysis(
                         model_id, namespace, data, sat_cfg,
-                        slo_cfg_by_ns.get(namespace))
+                        slo_cfg_by_ns.get(namespace), scheduler_queue)
                 else:
-                    result = self._run_v2_analysis(model_id, namespace, data, sat_cfg)
+                    result = self._run_v2_analysis(
+                        model_id, namespace, data, sat_cfg, scheduler_queue)
             except Exception as e:  # noqa: BLE001
                 log.error("%s analysis failed for %s: %s",
                           "SLO" if use_slo else "V2", model_id, e)
@@ -297,6 +339,24 @@ class SaturationEngine:
                 log.debug("SLO analyzer produced no capacities for %s; skipped",
                           model_id)
                 continue
+            routes[(model_id, namespace)] = \
+                ("global" if use_slo and sat_cfg.optimizer_name == "global"
+                 else "cost-aware")
+            if self.flight is not None:
+                self.flight.record_model({
+                    "model_id": model_id, "namespace": namespace,
+                    "path": "slo" if use_slo else "v2",
+                    # The route the optimizer split below actually takes, so
+                    # replay knows whether cost-aware replay is possible.
+                    "optimizer": routes[(model_id, namespace)],
+                    "input": {
+                        "replica_metrics": data.replica_metrics,
+                        "variant_states": data.variant_states,
+                        "config": sat_cfg,
+                        "scheduler_queue": scheduler_queue,
+                    },
+                    "result": result,
+                })
             requests.append(ModelScalingRequest(
                 model_id=model_id, namespace=namespace, result=result,
                 variant_states=data.variant_states))
@@ -305,14 +365,13 @@ class SaturationEngine:
             return []
 
         # Optimizer selection respects namespace-local config (optimizerName
-        # is resolved per request's namespace, like every other knob).
+        # is resolved per request's namespace, like every other knob) —
+        # using the route resolved above, from the same config snapshot the
+        # analysis and the trace record saw.
         global_reqs: list[ModelScalingRequest] = []
         local_reqs: list[ModelScalingRequest] = []
         for req in requests:
-            ns_cfg = self.config.saturation_config_for_namespace(
-                req.namespace).get("default")
-            if (use_slo and ns_cfg is not None
-                    and ns_cfg.optimizer_name == "global"):
+            if routes[(req.model_id, req.namespace)] == "global":
                 global_reqs.append(req)
             else:
                 local_reqs.append(req)
@@ -322,45 +381,16 @@ class SaturationEngine:
         if local_reqs:
             decisions.extend(self.optimizer.optimize(local_reqs, None))
 
-        # Enforcer bridge per model (reference engine_v2.go:76-127).
+        # Enforcer bridge per model (reference engine_v2.go:76-127) — shared
+        # with the trace replay harness (pipeline.bridge_enforce).
         for req in requests:
             s2z_cfg = self.config.scale_to_zero_config_for_namespace(req.namespace)
-            targets = {d.variant_name: d.target_replicas for d in decisions
-                       if d.model_id == req.model_id and d.namespace == req.namespace}
-            analyses = [
-                VariantSaturationAnalysis(
-                    variant_name=d.variant_name, accelerator_name=d.accelerator_name,
-                    cost=d.cost, replica_count=d.current_replicas)
-                for d in decisions
-                if d.model_id == req.model_id and d.namespace == req.namespace
-            ]
-            enforced, scaled_to_zero = self.enforcer.enforce_policy(
-                req.model_id, req.namespace, targets, analyses, s2z_cfg)
+            scaled_to_zero = bridge_enforce(
+                decisions, req.model_id, req.namespace, self.enforcer,
+                s2z_cfg, now=self.clock.now(),
+                optimizer_name=self.optimizer.name())
             if scaled_to_zero:
                 log.info("Scale-to-zero enforcement applied (V2) for %s", req.model_id)
-            now = self.clock.now()
-            for d in decisions:
-                if d.model_id != req.model_id or d.namespace != req.namespace:
-                    continue
-                target = enforced.get(d.variant_name)
-                if target is not None and target != d.target_replicas:
-                    d.target_replicas = target
-                    if target > d.current_replicas:
-                        d.action = ACTION_SCALE_UP
-                    elif target < d.current_replicas:
-                        d.action = ACTION_SCALE_DOWN
-                    else:
-                        d.action = ACTION_NO_CHANGE
-                    d.reason = (f"V2 {d.action} (optimizer: "
-                                f"{self.optimizer.name()}, enforced)")
-                    d.add_step("enforcer",
-                               ("scale-to-zero: no requests within retention"
-                                if scaled_to_zero
-                                else f"min-replica floor -> {target}"),
-                               was_constrained=True, now=now)
-                else:
-                    d.add_step("enforcer", "no policy change",
-                               now=now)
 
         self._apply_limiter(decisions)
         return decisions
@@ -387,7 +417,8 @@ class SaturationEngine:
             log.error("Limiter failed, proceeding with original decisions: %s", e)
 
     def _run_v2_analysis(self, model_id: str, namespace: str, data: _ModelData,
-                         sat_cfg: SaturationScalingConfig):
+                         sat_cfg: SaturationScalingConfig,
+                         scheduler_queue=None):
         # Pre-populate capacity store from deployment args (engine_v2.go:31-45).
         for key, va in data.variant_autoscalings.items():
             deploy = data.deployments.get(
@@ -400,7 +431,6 @@ class SaturationEngine:
             self.capacity_store.load_from_deployment(
                 namespace, model_id, va.metadata.name, accelerator, chips, deploy)
 
-        scheduler_queue = self.collector.collect_scheduler_queue_metrics(model_id)
         return self.v2_analyzer.analyze(AnalyzerInput(
             model_id=model_id, namespace=namespace,
             replica_metrics=data.replica_metrics,
@@ -657,13 +687,13 @@ class SaturationEngine:
         return decisions
 
     def _run_slo_analysis(self, model_id: str, namespace: str, data: _ModelData,
-                          sat_cfg: SaturationScalingConfig, slo_cfg):
+                          sat_cfg: SaturationScalingConfig, slo_cfg,
+                          scheduler_queue=None):
         """SLO path: attach the model's arrival-rate telemetry and run the
         queueing-model analyzer with the namespace's resolved SLO config
         (profiles were synced once for the namespace at tick start)."""
         optimizer_metrics = collect_optimizer_metrics(
             self.collector.source, model_id, namespace)
-        scheduler_queue = self.collector.collect_scheduler_queue_metrics(model_id)
         if slo_cfg is not None and slo_cfg.tuner_enabled:
             self._feed_slo_tuner(model_id, namespace, data, optimizer_metrics)
         return self.slo_analyzer.analyze(AnalyzerInput(
@@ -851,64 +881,6 @@ class SaturationEngine:
             ))
         return states
 
-    def _targets_to_decisions(
-        self,
-        targets: dict[str, int],
-        analysis: ModelSaturationAnalysis,
-        variant_states: list[VariantReplicaState],
-        enforcer_note: str = "",
-    ) -> list[VariantDecision]:
-        """Convert V1 targets to decisions (reference engine.go:586-659).
-        ``enforcer_note`` carries the already-applied enforcement outcome
-        into the decision audit trail (the V1 path enforces on raw targets
-        before decisions exist)."""
-        analyses = {va.variant_name: va for va in analysis.variant_analyses}
-        states = {s.variant_name: s for s in variant_states}
-        decisions = []
-        for variant_name in sorted(targets):
-            target = targets[variant_name]
-            state = states.get(variant_name,
-                               VariantReplicaState(variant_name=variant_name))
-            va = analyses.get(variant_name)
-            if target > state.current_replicas:
-                action = ACTION_SCALE_UP
-            elif target < state.current_replicas:
-                action = ACTION_SCALE_DOWN
-            else:
-                action = ACTION_NO_CHANGE
-            decision = VariantDecision(
-                variant_name=variant_name,
-                namespace=analysis.namespace,
-                model_id=analysis.model_id,
-                current_replicas=state.current_replicas,
-                target_replicas=target,
-                original_target_replicas=target,
-                desired_replicas=state.desired_replicas,
-                action=action,
-                saturation_based=True,
-                saturation_only=True,
-                reason=f"saturation-only mode: {action}",
-                chips_per_replica=max(state.chips_per_replica, 1),
-            )
-            if va is not None:
-                decision.accelerator_name = va.accelerator_name
-                decision.cost = va.cost
-                decision.spare_capacity = va.avg_spare_kv_capacity
-            ts = analysis.analyzed_at or None
-            decision.add_step(
-                "analyzer:v1",
-                (analysis.scale_up_reason if analysis.should_scale_up
-                 else "no saturation trigger"
-                 f" (spare kv {analysis.avg_spare_kv_capacity:.2f},"
-                 f" spare queue {analysis.avg_spare_queue_length:.1f})"),
-                now=ts)
-            decision.add_step("optimizer:percentage",
-                              f"saturation-only mode: {action}", now=ts)
-            decision.add_step("enforcer", enforcer_note or "no policy change",
-                              was_constrained=bool(enforcer_note), now=ts)
-            decisions.append(decision)
-        return decisions
-
     # --- decision application ---
 
     def _apply_decisions(
@@ -989,7 +961,10 @@ class SaturationEngine:
                                              namespace=va.metadata.namespace,
                                              metrics_available=False,
                                              metrics_reason=METRICS_REASON_UNAVAILABLE,
-                                             metrics_message=METRICS_MESSAGE_UNAVAILABLE))
+                                             metrics_message=METRICS_MESSAGE_UNAVAILABLE),
+                                         source=common.SOURCE_SATURATION,
+                                         cycle=self.flight.current_cycle()
+                                         if self.flight else 0)
                 common.fire_trigger(va.metadata.name, va.metadata.namespace)
                 continue
 
@@ -1022,6 +997,16 @@ class SaturationEngine:
                 log.error("Failed to emit metrics for %s: %s", va_key, e)
 
             self._maybe_fast_actuate(update_va, decision)
+
+            if self.flight is not None:
+                self.flight.record_stage("actuation", {
+                    "variant": va.metadata.name,
+                    "namespace": va.metadata.namespace,
+                    "accelerator": accelerator,
+                    "desired": target_replicas,
+                    "applied": update_va.status.actuation.applied,
+                    "had_decision": decision is not None,
+                })
 
             # Persist the engine-owned status fields (OptimizationReady,
             # actuation.applied, desired alloc). Divergence from the
@@ -1079,7 +1064,10 @@ class SaturationEngine:
                                                          else METRICS_REASON_UNAVAILABLE),
                                          metrics_message=(METRICS_MESSAGE_AVAILABLE
                                                           if metrics_available
-                                                          else METRICS_MESSAGE_UNAVAILABLE)))
+                                                          else METRICS_MESSAGE_UNAVAILABLE)),
+                                     source=common.SOURCE_SATURATION,
+                                     cycle=self.flight.current_cycle()
+                                     if self.flight else 0)
             common.fire_trigger(va.metadata.name, va.metadata.namespace)
 
     def _maybe_fast_actuate(self, va: VariantAutoscaling,

@@ -54,7 +54,8 @@ class ScaleFromZeroEngine:
         self.recorder = recorder
         self.clock = clock or SYSTEM_CLOCK
         self.executor = PollingExecutor(self.optimize, poll_interval,
-                                        clock=self.clock, name="scale-from-zero")
+                                        clock=self.clock,
+                                        name=common.SOURCE_SCALE_FROM_ZERO)
 
     def start_loop(self, stop) -> None:
         self.executor.start(stop)
@@ -118,7 +119,8 @@ class ScaleFromZeroEngine:
             metrics_reason="MetricsFound",
             metrics_message="Pending requests detected in scheduler queue",
         )
-        common.DecisionCache.set(va.metadata.name, va.metadata.namespace, decision)
+        common.DecisionCache.set(va.metadata.name, va.metadata.namespace,
+                                 decision, source=common.SOURCE_SCALE_FROM_ZERO)
 
         # Seed status so the reconciler and the next saturation tick agree.
         try:

@@ -4,11 +4,11 @@ limiter stages (reference ``internal/interfaces/saturation_analyzer.go:74-243``)
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 
 from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST, CrossVersionObjectReference
 from wva_tpu.interfaces.allocation import Allocation
+from wva_tpu.utils.clock import SYSTEM_CLOCK
 
 # Scaling actions (reference :219-225).
 ACTION_SCALE_UP = "scale-up"
@@ -135,6 +135,9 @@ class VariantDecision:
 
     def add_step(self, name: str, reason: str, was_constrained: bool = False,
                  now: float | None = None) -> None:
+        # Callers on the decision path pass the pipeline's injected clock
+        # time; SYSTEM_CLOCK is the fallback for ad-hoc callers only (never
+        # a bare time.time() — replay determinism, see utils/clock.py).
         self.decision_steps.append(
             DecisionStep(
                 name=name,
@@ -142,7 +145,7 @@ class VariantDecision:
                 target_replicas=self.target_replicas,
                 reason=reason,
                 was_constrained=was_constrained,
-                timestamp=time.time() if now is None else now,
+                timestamp=SYSTEM_CLOCK.now() if now is None else now,
             )
         )
 

@@ -17,6 +17,7 @@ from dataclasses import dataclass
 
 from wva_tpu.actuator import Actuator, DirectActuator
 from wva_tpu.analyzers.saturation_v2 import CapacityKnowledgeStore
+from wva_tpu.blackbox import FlightRecorder
 from wva_tpu.collector.registration import (
     register_saturation_queries,
     register_scale_to_zero_queries,
@@ -85,6 +86,8 @@ class Manager:
     # leader-gated; reconcilers and watches run on every replica (reference
     # cmd/main.go:378-425 leader-gated Runnables).
     elector: "LeaderElector | None" = None
+    # Decision flight recorder (None = tracing disabled via config).
+    flight_recorder: "FlightRecorder | None" = None
 
     _threads: list[threading.Thread] = None
     _last_election_tick: float = -1e18
@@ -187,9 +190,12 @@ class Manager:
         return self.engine.executor.consume_trigger()
 
     def shutdown(self) -> None:
-        """Voluntary leader step-down on exit (ReleaseOnCancel semantics)."""
+        """Voluntary leader step-down on exit (ReleaseOnCancel semantics);
+        flush the decision trace so the last cycle is never lost."""
         if self.elector is not None:
             self.elector.release()
+        if self.flight_recorder is not None:
+            self.flight_recorder.close()
 
 
 def build_manager(
@@ -254,7 +260,18 @@ def build_manager(
 
     discovery = TPUSliceDiscovery(client)
     limiter = DefaultLimiter("tpu-slice-limiter", SliceInventory(discovery),
-                             GreedyBySaturation())
+                             GreedyBySaturation(), clock=clock)
+
+    # Decision flight recorder (config-gated): the executor opens one cycle
+    # record per engine tick and every pipeline stage appends its part.
+    trace_cfg = config.trace_config()
+    flight = None
+    if trace_cfg.enabled:
+        flight = FlightRecorder(
+            clock=clock, ring_size=trace_cfg.ring_size,
+            spill_path=trace_cfg.path or None, registry=registry)
+        enforcer.flight_recorder = flight
+        limiter.flight_recorder = flight
 
     capacity_store = CapacityKnowledgeStore(clock=clock)
     recorder = EventRecorder(client, clock=clock)
@@ -262,7 +279,10 @@ def build_manager(
         client=client, config=config, collector=collector, actuator=actuator,
         enforcer=enforcer, limiter=limiter, capacity_store=capacity_store,
         clock=clock, poll_interval=min(config.optimization_interval() / 2, 30.0),
-        direct_actuator=direct_actuator, recorder=recorder)
+        direct_actuator=direct_actuator, recorder=recorder,
+        flight_recorder=flight)
+    if flight is not None:
+        engine.optimizer.flight_recorder = flight
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,
                                           direct_actuator, clock=clock,
                                           recorder=recorder)
@@ -279,7 +299,8 @@ def build_manager(
     watch_ns = config.watch_namespace() or ""
     va_reconciler = VariantAutoscalingReconciler(client, datastore, indexer,
                                                  clock=clock, recorder=recorder,
-                                                 watch_namespace=watch_ns)
+                                                 watch_namespace=watch_ns,
+                                                 flight_recorder=flight)
     configmap_reconciler = ConfigMapReconciler(client, config, datastore,
                                                recorder=recorder)
     pool_reconciler = InferencePoolReconciler(client, datastore,
@@ -302,5 +323,5 @@ def build_manager(
         engine=engine, scale_from_zero=scale_from_zero, fastpath=fastpath,
         va_reconciler=va_reconciler, configmap_reconciler=configmap_reconciler,
         pool_reconciler=pool_reconciler, capacity_store=capacity_store,
-        elector=elector,
+        elector=elector, flight_recorder=flight,
     )

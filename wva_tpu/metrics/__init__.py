@@ -32,6 +32,9 @@ from wva_tpu.constants import (
     WVA_ENGINE_TICK_DURATION_SECONDS,
     WVA_ENGINE_TICKS_TOTAL,
     WVA_REPLICA_SCALING_TOTAL,
+    WVA_TRACE_DROPPED_TOTAL,
+    WVA_TRACE_RECORDS_TOTAL,
+    WVA_TRACE_WRITE_SECONDS,
 )
 
 _LabelKey = tuple[tuple[str, str], ...]
@@ -64,6 +67,13 @@ class MetricsRegistry:
                        "Wall-clock duration of the last engine tick")
         self._register(WVA_ENGINE_TICKS_TOTAL, "counter",
                        "Engine ticks by outcome (success|error)")
+        self._register(WVA_TRACE_RECORDS_TOTAL, "counter",
+                       "Decision-trace cycle records committed by the "
+                       "flight recorder")
+        self._register(WVA_TRACE_DROPPED_TOTAL, "counter",
+                       "Decision-trace records or events dropped, by reason")
+        self._register(WVA_TRACE_WRITE_SECONDS, "gauge",
+                       "Wall-clock latency of the last trace spill write")
 
     def _register(self, name: str, kind: str, help_text: str) -> None:
         self._series[name] = _Series(name, kind, help_text)
@@ -121,6 +131,19 @@ class MetricsRegistry:
             LABEL_ENGINE: engine,
             LABEL_OUTCOME: "success" if ok else "error",
         })
+
+    def observe_trace_record(self, engine: str) -> None:
+        """Flight-recorder health: one committed cycle record."""
+        self.inc_counter(WVA_TRACE_RECORDS_TOTAL, {LABEL_ENGINE: engine})
+
+    def observe_trace_drop(self, reason: str) -> None:
+        """Flight-recorder health: a record/event lost (ring eviction
+        without spill, spill write error, encode error, no open cycle)."""
+        self.inc_counter(WVA_TRACE_DROPPED_TOTAL, {LABEL_REASON: reason})
+
+    def observe_trace_write(self, seconds: float) -> None:
+        """Flight-recorder health: last spill write latency."""
+        self.set_gauge(WVA_TRACE_WRITE_SECONDS, {}, seconds)
 
     def record_scaling(self, variant_name: str, namespace: str, accelerator: str,
                        direction: str, reason: str) -> None:

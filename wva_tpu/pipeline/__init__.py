@@ -4,8 +4,13 @@ from wva_tpu.pipeline.optimizer import (
     CostAwareOptimizer,
     ModelScalingRequest,
     ScalingOptimizer,
+    saturation_targets_to_decisions,
 )
-from wva_tpu.pipeline.enforcer import Enforcer
+from wva_tpu.pipeline.enforcer import (
+    Enforcer,
+    SCALE_TO_ZERO_REASON,
+    bridge_enforce,
+)
 from wva_tpu.pipeline.limiter import (
     AllocationAlgorithm,
     DefaultLimiter,
@@ -16,13 +21,17 @@ from wva_tpu.pipeline.limiter import (
     ResourceConstraints,
     ResourcePool,
     SliceInventory,
+    StaticInventory,
 )
 
 __all__ = [
     "CostAwareOptimizer",
     "ModelScalingRequest",
     "ScalingOptimizer",
+    "saturation_targets_to_decisions",
     "Enforcer",
+    "SCALE_TO_ZERO_REASON",
+    "bridge_enforce",
     "AllocationAlgorithm",
     "DefaultLimiter",
     "GreedyBySaturation",
@@ -32,4 +41,5 @@ __all__ = [
     "ResourceConstraints",
     "ResourcePool",
     "SliceInventory",
+    "StaticInventory",
 ]

@@ -16,9 +16,20 @@ from wva_tpu.config import (
     is_scale_to_zero_enabled,
     scale_to_zero_retention_seconds,
 )
-from wva_tpu.interfaces import VariantSaturationAnalysis
+from wva_tpu.interfaces import (
+    ACTION_NO_CHANGE,
+    ACTION_SCALE_DOWN,
+    ACTION_SCALE_UP,
+    VariantDecision,
+    VariantSaturationAnalysis,
+)
 
 log = logging.getLogger(__name__)
+
+# Decision-step reason stamped on scale-to-zero enforcement. Shared by the
+# V1 engine path and the replay engine: record/replay equality hinges on
+# both producing the identical string, so there is exactly one copy.
+SCALE_TO_ZERO_REASON = "scale-to-zero: no requests within retention"
 
 # (model_id, namespace, retention_seconds) -> request count; raises when the
 # count cannot be determined.
@@ -28,6 +39,10 @@ RequestCountFunc = Callable[[str, str, float], float]
 class Enforcer:
     def __init__(self, request_count_func: RequestCountFunc) -> None:
         self.request_count_func = request_count_func
+        # Optional blackbox.FlightRecorder: when set, every enforce_policy
+        # call records its request-count observation and outcome — replay
+        # re-feeds the recorded count instead of querying a collector.
+        self.flight_recorder = None
 
     def enforce_policy(
         self,
@@ -41,11 +56,23 @@ class Enforcer:
         model: zero requests over retention => all targets 0; query error =>
         keep targets. When disabled: guarantee >= 1 total replica, restored
         on the cheapest variant."""
+        trace = {"model_id": model_id, "namespace": namespace,
+                 "request_count": None, "error": None, "retention": None}
         if is_scale_to_zero_enabled(scale_to_zero_config, model_id):
-            return self._apply_scale_to_zero(
-                model_id, namespace, saturation_targets, scale_to_zero_config)
-        return self._ensure_minimum_replicas(
-            model_id, saturation_targets, variant_analyses)
+            targets, applied = self._apply_scale_to_zero(
+                model_id, namespace, saturation_targets, scale_to_zero_config,
+                trace)
+        else:
+            targets, applied = self._ensure_minimum_replicas(
+                model_id, saturation_targets, variant_analyses)
+        if self.flight_recorder is not None:
+            from wva_tpu.blackbox.schema import encode_scale_to_zero_config
+
+            trace.update(
+                targets=dict(targets), scaled_to_zero=applied,
+                s2z_config=encode_scale_to_zero_config(scale_to_zero_config))
+            self.flight_recorder.record_stage("enforcer", trace)
+        return targets, applied
 
     def _apply_scale_to_zero(
         self,
@@ -53,14 +80,21 @@ class Enforcer:
         namespace: str,
         targets: dict[str, int],
         scale_to_zero_config: ScaleToZeroConfigData,
+        trace: dict | None = None,
     ) -> tuple[dict[str, int], bool]:
         retention = scale_to_zero_retention_seconds(scale_to_zero_config, model_id)
+        if trace is not None:
+            trace["retention"] = retention
         try:
             count = self.request_count_func(model_id, namespace, retention)
         except Exception as e:  # noqa: BLE001 — fail-safe boundary
+            if trace is not None:
+                trace["error"] = str(e)
             log.warning("Failed to get request count for %s, keeping targets: %s",
                         model_id, e)
             return targets, False
+        if trace is not None:
+            trace["request_count"] = count
         if count > 0:
             return targets, False
         log.info("No requests for %s/%s in %.0fs retention, scaling to zero",
@@ -91,3 +125,51 @@ class Enforcer:
                      model_id, cheapest)
             return targets, True
         return targets, False
+
+
+def bridge_enforce(
+    decisions: list[VariantDecision],
+    model_id: str,
+    namespace: str,
+    enforcer: Enforcer,
+    scale_to_zero_config: ScaleToZeroConfigData,
+    now: float,
+    optimizer_name: str,
+) -> bool:
+    """Enforcer bridge for the V2/SLO optimizer flow (reference
+    engine_v2.go:76-127): run policy enforcement over one model's
+    optimizer-produced decisions, adjusting them in place and appending the
+    enforcer's audit step. Module-level so the trace replay harness re-runs
+    the exact production code path. Returns whether scale-to-zero applied."""
+    targets = {d.variant_name: d.target_replicas for d in decisions
+               if d.model_id == model_id and d.namespace == namespace}
+    analyses = [
+        VariantSaturationAnalysis(
+            variant_name=d.variant_name, accelerator_name=d.accelerator_name,
+            cost=d.cost, replica_count=d.current_replicas)
+        for d in decisions
+        if d.model_id == model_id and d.namespace == namespace
+    ]
+    enforced, scaled_to_zero = enforcer.enforce_policy(
+        model_id, namespace, targets, analyses, scale_to_zero_config)
+    for d in decisions:
+        if d.model_id != model_id or d.namespace != namespace:
+            continue
+        target = enforced.get(d.variant_name)
+        if target is not None and target != d.target_replicas:
+            d.target_replicas = target
+            if target > d.current_replicas:
+                d.action = ACTION_SCALE_UP
+            elif target < d.current_replicas:
+                d.action = ACTION_SCALE_DOWN
+            else:
+                d.action = ACTION_NO_CHANGE
+            d.reason = (f"V2 {d.action} (optimizer: "
+                        f"{optimizer_name}, enforced)")
+            d.add_step("enforcer",
+                       (SCALE_TO_ZERO_REASON if scaled_to_zero
+                        else f"min-replica floor -> {target}"),
+                       was_constrained=True, now=now)
+        else:
+            d.add_step("enforcer", "no policy change", now=now)
+    return scaled_to_zero

@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 
 from wva_tpu.discovery import TPUSliceDiscovery
 from wva_tpu.interfaces import VariantDecision
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 log = logging.getLogger(__name__)
 
@@ -134,6 +135,36 @@ class SliceInventory(Inventory):
                 for k, p in self._pools.items()}
 
 
+class StaticInventory(Inventory):
+    """Fixed chip pools (type -> chip limit): no discovery behind it.
+    Used by the trace replay harness (pools reconstructed from a recorded
+    limiter snapshot) and by tests that need a deterministic inventory."""
+
+    def __init__(self, limits: dict[str, int]) -> None:
+        self._pools = {
+            t: ResourcePool(accelerator_type=t, limit=int(limit))
+            for t, limit in limits.items()}
+
+    def refresh(self) -> None:
+        pass
+
+    def set_used(self, used_by_type: dict[str, int]) -> None:
+        for pool in self._pools.values():
+            pool.used = 0
+        for variant, used in used_by_type.items():
+            pool = self._pools.get(variant)
+            if pool is not None:
+                pool.used = used
+
+    def create_allocator(self) -> ResourceAllocator:
+        return _TypedSliceAllocator(self._pools)
+
+    def pools(self) -> dict[str, ResourcePool]:
+        return {k: ResourcePool(accelerator_type=p.accelerator_type,
+                                limit=p.limit, used=p.used)
+                for k, p in self._pools.items()}
+
+
 class _TypedSliceAllocator(ResourceAllocator):
     """Allocates only from the decision's own variant pool — cross-type
     allocation is impossible (reference typeAllocator :337-377)."""
@@ -187,10 +218,19 @@ class DefaultLimiter(Limiter):
     """Inventory x algorithm (reference default_limiter.go:20-121)."""
 
     def __init__(self, name: str, inventory: Inventory,
-                 algorithm: AllocationAlgorithm) -> None:
+                 algorithm: AllocationAlgorithm,
+                 clock: Clock | None = None) -> None:
         self._name = name
         self.inventory = inventory
         self.algorithm = algorithm
+        # Injected clock: limiter audit steps must be stamped from the same
+        # clock as every other pipeline stage or replay cannot reproduce
+        # them bit-for-bit.
+        self.clock = clock or SYSTEM_CLOCK
+        # Optional blackbox.FlightRecorder: when set, every limit() call
+        # records the refreshed inventory pools so replay can rebuild a
+        # StaticInventory with identical limits.
+        self.flight_recorder = None
 
     def name(self) -> str:
         return self._name
@@ -200,6 +240,14 @@ class DefaultLimiter(Limiter):
             return
         self.inventory.refresh()
         self.inventory.set_used(self._calculate_used_chips(decisions))
+        if self.flight_recorder is not None:
+            pools = self.inventory.pools()
+            self.flight_recorder.record_stage("limiter", {
+                "name": self._name,
+                "pools": [{"accelerator_type": p.accelerator_type,
+                           "limit": p.limit, "used": p.used}
+                          for _, p in sorted(pools.items())],
+            })
         allocator = self.inventory.create_allocator()
         self.algorithm.allocate(decisions, allocator)
         self._update_metadata(decisions)
@@ -215,6 +263,7 @@ class DefaultLimiter(Limiter):
         return used
 
     def _update_metadata(self, decisions: list[VariantDecision]) -> None:
+        now = self.clock.now()
         for d in decisions:
             if d.was_limited:
                 d.limited_by = self._name
@@ -227,7 +276,7 @@ class DefaultLimiter(Limiter):
                           f"for +{change} replicas")
             else:
                 reason = f"allocated {d.chips_allocated} chips for +{change} replicas"
-            d.add_step(self._name, reason, d.was_limited)
+            d.add_step(self._name, reason, d.was_limited, now=now)
 
     def compute_constraints(self, current_usage: dict[str, int]) -> ResourceConstraints:
         """V2 path: expose availability instead of mutating decisions

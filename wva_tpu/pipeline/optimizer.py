@@ -19,12 +19,73 @@ from wva_tpu.interfaces import (
     ACTION_SCALE_DOWN,
     ACTION_SCALE_UP,
     AnalyzerResult,
+    ModelSaturationAnalysis,
     VariantCapacity,
     VariantDecision,
     VariantReplicaState,
 )
 
 log = logging.getLogger(__name__)
+
+
+def saturation_targets_to_decisions(
+    targets: dict[str, int],
+    analysis: ModelSaturationAnalysis,
+    variant_states: list[VariantReplicaState],
+    enforcer_note: str = "",
+) -> list[VariantDecision]:
+    """Convert V1 saturation targets to decisions (reference
+    engine.go:586-659). Module-level (not an engine method) so the trace
+    replay harness re-runs the exact production code path offline.
+    ``enforcer_note`` carries the already-applied enforcement outcome into
+    the decision audit trail (the V1 path enforces on raw targets before
+    decisions exist)."""
+    analyses = {va.variant_name: va for va in analysis.variant_analyses}
+    states = {s.variant_name: s for s in variant_states}
+    decisions = []
+    for variant_name in sorted(targets):
+        target = targets[variant_name]
+        state = states.get(variant_name,
+                           VariantReplicaState(variant_name=variant_name))
+        va = analyses.get(variant_name)
+        if target > state.current_replicas:
+            action = ACTION_SCALE_UP
+        elif target < state.current_replicas:
+            action = ACTION_SCALE_DOWN
+        else:
+            action = ACTION_NO_CHANGE
+        decision = VariantDecision(
+            variant_name=variant_name,
+            namespace=analysis.namespace,
+            model_id=analysis.model_id,
+            current_replicas=state.current_replicas,
+            target_replicas=target,
+            original_target_replicas=target,
+            desired_replicas=state.desired_replicas,
+            action=action,
+            saturation_based=True,
+            saturation_only=True,
+            reason=f"saturation-only mode: {action}",
+            chips_per_replica=max(state.chips_per_replica, 1),
+        )
+        if va is not None:
+            decision.accelerator_name = va.accelerator_name
+            decision.cost = va.cost
+            decision.spare_capacity = va.avg_spare_kv_capacity
+        ts = analysis.analyzed_at or None
+        decision.add_step(
+            "analyzer:v1",
+            (analysis.scale_up_reason if analysis.should_scale_up
+             else "no saturation trigger"
+             f" (spare kv {analysis.avg_spare_kv_capacity:.2f},"
+             f" spare queue {analysis.avg_spare_queue_length:.1f})"),
+            now=ts)
+        decision.add_step("optimizer:percentage",
+                          f"saturation-only mode: {action}", now=ts)
+        decision.add_step("enforcer", enforcer_note or "no policy change",
+                          was_constrained=bool(enforcer_note), now=ts)
+        decisions.append(decision)
+    return decisions
 
 
 @dataclass
@@ -55,6 +116,10 @@ def _cost_efficiency(vc: VariantCapacity) -> float:
 
 
 class CostAwareOptimizer(ScalingOptimizer):
+    # Optional blackbox.FlightRecorder: when set, every optimize() call
+    # records per-model targets into the current engine cycle's trace.
+    flight_recorder = None
+
     def name(self) -> str:
         return "cost-aware"
 
@@ -73,6 +138,15 @@ class CostAwareOptimizer(ScalingOptimizer):
             elif req.result.spare_capacity > 0:
                 self._scale_down(req.result, targets)
 
+            if self.flight_recorder is not None:
+                self.flight_recorder.record_stage("optimizer", {
+                    "name": self.name(),
+                    "model_id": req.model_id,
+                    "namespace": req.namespace,
+                    "required_capacity": req.result.required_capacity,
+                    "spare_capacity": req.result.spare_capacity,
+                    "targets": dict(targets),
+                })
             decisions.extend(self._build_decisions(req, states, capacities, targets))
         return decisions
 
