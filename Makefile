@@ -40,6 +40,10 @@ test: ## Run the unit/integration suite (CPU, virtual 8-device mesh).
 bench: ## Run the north-star benchmark (one JSON line on stdout).
 	$(PYTHON) bench.py
 
+.PHONY: bench-tick
+bench-tick: ## Fleet-scale tick microbench (48 models / 96 VAs, in-memory stack): tick p50/p99 + API requests/tick vs the pre-change serial loop; merges into BENCH_LOCAL.json.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tick-only
+
 .PHONY: test-replay
 test-replay: ## Fast decision-trace record/replay test lane (pytest -m replay).
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_blackbox.py -q -m replay

@@ -210,9 +210,20 @@ def main() -> int:
             print(f"[smoke-local] scale-up decision emitted: "
                   f"wva_desired_replicas={desired}")
 
-            # VA status written through the REST path too.
-            va = cluster.get("VariantAutoscaling", NS, VARIANT)
-            alloc = va.status.desired_optimized_alloc
+            # VA status written through the REST path too. The status PUT
+            # is asynchronous relative to the gauge (the engine emits
+            # metrics, then writes status; retries/conflict-refetch can add
+            # latency under load), so poll with its OWN deadline instead of
+            # one racy read — the shared deadline may already be consumed
+            # by the gauge poll, which would skip this loop entirely.
+            deadline = time.time() + 15
+            alloc = None
+            while time.time() < deadline:
+                va = cluster.get("VariantAutoscaling", NS, VARIANT)
+                alloc = va.status.desired_optimized_alloc
+                if alloc is not None and alloc.num_replicas >= 2:
+                    break
+                time.sleep(0.5)
             assert alloc is not None and alloc.num_replicas >= 2, \
                 f"VA status not updated: {alloc}"
             print(f"[smoke-local] VA status desired_optimized_alloc="

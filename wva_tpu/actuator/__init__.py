@@ -26,11 +26,14 @@ class Actuator:
         self.client = client
         self.registry = registry
 
-    def emit_metrics(self, va: VariantAutoscaling) -> None:
+    def emit_metrics(self, va: VariantAutoscaling,
+                     client: KubeClient | None = None) -> None:
         """Read REAL current replicas from the target and emit
         current/desired/ratio gauges. Raises on missing target (caller logs
-        but never fails the loop on emission errors)."""
-        target = scale_target.scale_target_state(self.client.get(
+        but never fails the loop on emission errors). ``client`` lets the
+        engine pass its tick-scoped snapshot so the per-VA emission loop
+        costs zero API requests (the tick already LISTed every target)."""
+        target = scale_target.scale_target_state((client or self.client).get(
             va.spec.scale_target_ref.kind or Deployment.KIND,
             va.metadata.namespace, va.spec.scale_target_ref.name))
         # OBSERVED replicas only (reference actuator.go reads

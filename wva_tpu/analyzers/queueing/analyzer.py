@@ -119,6 +119,28 @@ class _Candidate:
     request_size: RequestSize = field(default_factory=RequestSize)
 
 
+@dataclass
+class SizingPlan:
+    """One model's SLO analysis, prepared up to (but not including) the
+    device sizing call.
+
+    The engine collects every model's plan, concatenates the candidates,
+    runs ONE padded shape-bucketed :meth:`QueueingModelAnalyzer.size_candidates`
+    call for the whole tick, and then :meth:`finalize`\\ s each plan with its
+    slice of the per-replica capacities — so a 50-model tick costs one
+    device dispatch instead of 50. ``analyze`` composes the same three steps
+    for single-model callers (replay, tests, fast path).
+
+    ``needs_sizing`` False means the analysis short-circuited (no SLO
+    config/targets/telemetry/candidates) and ``result`` is already final.
+    """
+
+    input: AnalyzerInput
+    result: AnalyzerResult
+    candidates: list[_Candidate] = field(default_factory=list)
+    needs_sizing: bool = False
+
+
 class QueueingModelAnalyzer(Analyzer):
     """interfaces.Analyzer implementation selected by ``analyzerName: "slo"``."""
 
@@ -167,12 +189,23 @@ class QueueingModelAnalyzer(Analyzer):
     # -- analysis --
 
     def analyze(self, input: AnalyzerInput) -> AnalyzerResult:
+        plan = self.prepare(input)
+        if not plan.needs_sizing:
+            return plan.result
+        return self.finalize(plan, self.size_candidates(plan.candidates))
+
+    def prepare(self, input: AnalyzerInput) -> SizingPlan:
+        """Everything before the device sizing call: config/targets/telemetry
+        gates and candidate prep. Pure reads of shared state (profile store,
+        config) — safe to run concurrently across models; the stateful trend
+        update happens in :meth:`finalize`."""
         result = AnalyzerResult(
             analyzer_name=self.name(),
             model_id=input.model_id,
             namespace=input.namespace,
             analyzed_at=self.clock.now(),
         )
+        plan = SizingPlan(input=input, result=result)
         slo = input.slo_config
         if slo is None:
             # Namespace-local > global resolution; NEVER another namespace's
@@ -183,11 +216,11 @@ class QueueingModelAnalyzer(Analyzer):
         if slo is None:
             log.warning("SLO analyzer selected but no SLO config loaded; "
                         "model %s skipped", input.model_id)
-            return result
+            return plan
         targets, _priority = slo.targets_for_model(input.model_id)
         if targets is None:
             log.info("No SLO targets for model %s; skipped", input.model_id)
-            return result
+            return plan
         if input.optimizer_metrics is None:
             # Unknown demand must never read as zero demand — a Prometheus
             # outage would otherwise scale the fleet down while traffic
@@ -195,17 +228,22 @@ class QueueingModelAnalyzer(Analyzer):
             # model with no metrics and enforcer.go:100-106).
             log.warning("Arrival-rate telemetry unavailable for model %s; "
                         "skipping SLO analysis this tick", input.model_id)
-            return result
+            return plan
 
         request_size = self._observed_request_size(input)
         result.avg_input_tokens = request_size.avg_input_tokens
         result.avg_output_tokens = request_size.avg_output_tokens
-        candidates = self._prepare_candidates(input, targets, request_size)
-        if not candidates:
-            return result
+        plan.candidates = self._prepare_candidates(input, targets, request_size)
+        plan.needs_sizing = bool(plan.candidates)
+        return plan
 
-        per_replica = self._size_candidates(candidates)
-
+    def finalize(self, plan: SizingPlan,
+                 per_replica: list[float]) -> AnalyzerResult:
+        """Turn sized candidates into the AnalyzerResult: supply/demand
+        aggregation, trend anticipation, headroom algebra. MUST be called
+        exactly once per sized plan and in a deterministic model order (it
+        feeds the per-model demand-trend series)."""
+        input, result, candidates = plan.input, plan.result, plan.candidates
         cfg = input.config if isinstance(input.config, SaturationScalingConfig) else SaturationScalingConfig()
         scale_up = cfg.scale_up_threshold or DEFAULT_SCALE_UP_THRESHOLD
         scale_down = cfg.scale_down_boundary or DEFAULT_SCALE_DOWN_BOUNDARY
@@ -386,7 +424,7 @@ class QueueingModelAnalyzer(Analyzer):
             ))
         return candidates
 
-    def _size_candidates(self, candidates: list[_Candidate]) -> list[float]:
+    def size_candidates(self, candidates: list[_Candidate]) -> list[float]:
         """One batched sizing call across every candidate. The batch is
         padded to power-of-two buckets (min 8) so XLA compiles a handful of
         shapes total instead of one executable per fleet size (first TPU
@@ -415,4 +453,11 @@ class QueueingModelAnalyzer(Analyzer):
             jnp.asarray([c.targets.target_tps for c in padded], jnp.float32),
             k_host=ks,
         )
-        return [float(x) for x in out["max_rate_per_s"][:n]]
+        # ONE host transfer for the whole batch: iterating the device array
+        # (`float(x) for x in ...`) costs a separate device->host read per
+        # element — ~1ms each, which at a 96-candidate fleet tick was more
+        # than the solve itself.
+        import numpy as np
+
+        return np.asarray(out["max_rate_per_s"][:n],
+                          dtype=np.float64).tolist()

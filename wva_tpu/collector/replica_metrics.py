@@ -282,13 +282,14 @@ class ReplicaMetricsCollector:
             for key in deployments:
                 dep_name = key.split("/", 1)[1]
                 if pod_name.startswith(dep_name + "-"):
-                    va = self.pod_va_mapper.va_for_scale_target_name(
-                        dep_name, namespace)
-                    return va.metadata.name if va else ""
+                    return self.pod_va_mapper.va_name_for_scale_target_name(
+                        dep_name, namespace) or ""
             return ""
         tracked = {key.split("/", 1)[1] for key in deployments}
-        va = self.pod_va_mapper.va_for_pod(pod, tracked_deployments=tracked)
-        return va.metadata.name if va else ""
+        # Name-only resolution: the join consumes nothing but the VA name,
+        # and the full-object lookup cost one VA GET per pod per tick.
+        return self.pod_va_mapper.va_name_for_pod(
+            pod, tracked_deployments=tracked) or ""
 
     def collect_scheduler_queue_metrics(self, model_id: str) -> SchedulerQueueMetrics | None:
         """Model-level flow-control queue; None when unavailable

@@ -70,16 +70,47 @@ class PodVAMapper:
             return None
         return self.va_for_scale_target_name(deploy_name, pod.metadata.namespace)
 
-    def va_for_scale_target_name(self, name: str,
-                                 namespace: str) -> VariantAutoscaling | None:
-        """Resolve a VA by scale-target NAME across the supported kinds:
-        the Deployment index key first, then the LeaderWorkerSet key (the
-        index is keyed namespace/apiVersion/kind/name)."""
-        va = self.indexer.find_va_for_deployment(name, namespace)
-        if va is None:
-            va = self.indexer.find_va_for_scale_target(
+    def va_name_for_pod(self, pod: Pod,
+                        tracked_deployments: set[str] | None = None,
+                        ) -> str | None:
+        """Like :meth:`va_for_pod` but resolves only the VA NAME from the
+        index — zero API requests. The replica-metrics join runs once per
+        pod per tick and consumes nothing but the name, so the full-object
+        fetch there was one GET per pod per tick at fleet scale."""
+        deploy_name = self.deployment_for_pod(pod)
+        if not deploy_name:
+            log.debug("pod %s has no Deployment owner", pod.metadata.name)
+            return None
+        if tracked_deployments is not None and deploy_name not in tracked_deployments:
+            return None
+        return self.va_name_for_scale_target_name(
+            deploy_name, pod.metadata.namespace)
+
+    def va_name_for_scale_target_name(self, name: str,
+                                      namespace: str) -> str | None:
+        """Index-only name resolution across the supported kinds (the
+        Deployment key first, then LeaderWorkerSet)."""
+        va_name = self.indexer.find_va_name_for_scale_target(
+            CrossVersionObjectReference(kind="Deployment", name=name,
+                                        api_version="apps/v1"), namespace)
+        if va_name is None:
+            va_name = self.indexer.find_va_name_for_scale_target(
                 CrossVersionObjectReference(
                     kind=LeaderWorkerSet.KIND, name=name,
                     api_version=LeaderWorkerSet.API_VERSION),
                 namespace)
-        return va
+        return va_name
+
+    def va_for_scale_target_name(self, name: str,
+                                 namespace: str) -> VariantAutoscaling | None:
+        """Resolve a VA by scale-target NAME across the supported kinds:
+        the Deployment index key first, then the LeaderWorkerSet key (the
+        index is keyed namespace/apiVersion/kind/name). Layered on the
+        name-only resolution so the kind-fallback chain exists once."""
+        va_name = self.va_name_for_scale_target_name(name, namespace)
+        if va_name is None:
+            return None
+        try:
+            return self.client.get(VariantAutoscaling.kind, namespace, va_name)
+        except NotFoundError:
+            return None

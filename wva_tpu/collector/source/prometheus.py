@@ -237,6 +237,13 @@ class PrometheusSource(MetricsSource):
         if concurrent is None:
             concurrent = not isinstance(api, InMemoryPromAPI)
         self._concurrent = concurrent
+        # One persistent query pool for the source's lifetime (created
+        # lazily, torn down by close()). Constructing a fresh
+        # ThreadPoolExecutor per refresh() spawned and joined up to 8
+        # threads per call — at a 5s engine tick with per-model refreshes
+        # that is hundreds of thread creations a minute for nothing.
+        self._pool: ThreadPoolExecutor | None = None
+        self._pool_mu = threading.Lock()
 
     def query_list(self) -> QueryList:
         return self._queries
@@ -285,15 +292,39 @@ class PrometheusSource(MetricsSource):
             return result
 
         if self._concurrent and len(names) > 1:
-            with ThreadPoolExecutor(max_workers=min(8, len(names))) as pool:
-                for name, result in zip(names, pool.map(run_one, names)):
-                    results[name] = result
+            for name, result in zip(names,
+                                    self._query_pool().map(run_one, names)):
+                results[name] = result
         else:
             for name in names:
                 results[name] = run_one(name)
 
         self._remember_spec(names, spec.params)
         return results
+
+    # Shared across every concurrent refresh() — the engine's analysis pool
+    # (up to 8 workers) fans per-model refreshes onto this ONE pool, so it
+    # must be sized for workers x per-refresh parallelism or it would
+    # serialize exactly the I/O overlap the analysis pool exists to exploit
+    # (the old per-call ThreadPoolExecutor gave each refresh its own 8).
+    QUERY_POOL_WORKERS = 32
+
+    def _query_pool(self) -> ThreadPoolExecutor:
+        with self._pool_mu:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.QUERY_POOL_WORKERS,
+                    thread_name_prefix="prom-query")
+            return self._pool
+
+    def close(self) -> None:
+        """Shut down the persistent query pool (source stop / process
+        shutdown). Safe to call repeatedly; a later refresh() would lazily
+        recreate the pool."""
+        with self._pool_mu:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # Specs not re-seen for this long stop being warmed (a deleted VA's
     # queries must not be re-executed forever).

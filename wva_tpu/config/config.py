@@ -45,6 +45,14 @@ class InfrastructureConfig:
     watch_namespace: str = ""
     logger_verbosity: int = 0
     optimization_interval: float = 60.0
+    # Bounded worker pool for the engine's per-model prepare->analyze stage
+    # (ENGINE_ANALYSIS_WORKERS). 0 = auto: pooled (8) against an HTTP
+    # Prometheus, where per-model collection is I/O-bound and overlaps;
+    # serial (1) against the in-memory backend, where the work is pure
+    # Python and extra threads only pay GIL tax. 1 = always serial; results
+    # merge in sorted model-key order at any width, so decisions stay
+    # byte-deterministic.
+    engine_analysis_workers: int = 0
 
 
 @dataclass
@@ -145,6 +153,12 @@ class Config:
     def watch_namespace(self) -> str:
         with self._mu:
             return self.infrastructure.watch_namespace
+
+    def engine_analysis_workers(self) -> int:
+        """Configured pool width; 0 = auto (resolved at wiring time by the
+        metrics backend: pooled for HTTP Prometheus, serial for in-memory)."""
+        with self._mu:
+            return max(0, self.infrastructure.engine_analysis_workers)
 
     def rest_timeout(self) -> float:
         with self._mu:

@@ -57,6 +57,7 @@ DEFAULTS: dict[str, Any] = {
     "SCALE_FROM_ZERO_ENGINE_MAX_CONCURRENCY": 10,
     "EPP_METRIC_READER_BEARER_TOKEN": "",
     "GLOBAL_OPT_INTERVAL": "60s",
+    "ENGINE_ANALYSIS_WORKERS": 0,  # 0 = auto (pooled for HTTP, serial in-mem)
 }
 
 
@@ -148,6 +149,7 @@ def load(flags: Mapping[str, Any] | None = None,
         watch_namespace=r.get_str("WATCH_NAMESPACE"),
         logger_verbosity=r.get_int("V"),
         optimization_interval=r.get_duration("GLOBAL_OPT_INTERVAL"),
+        engine_analysis_workers=max(0, r.get_int("ENGINE_ANALYSIS_WORKERS")),
     )
     cfg.tls = TLSConfig(
         webhook_cert_path=r.get_str("WEBHOOK_CERT_PATH"),

@@ -26,7 +26,13 @@ from wva_tpu.api.v1alpha1 import (
 from wva_tpu.datastore import Datastore
 from wva_tpu.engines import common
 from wva_tpu.indexers import Indexer
-from wva_tpu.k8s.client import ADDED, DELETED, KubeClient, NotFoundError
+from wva_tpu.k8s.client import (
+    ADDED,
+    DELETED,
+    ConflictError,
+    KubeClient,
+    NotFoundError,
+)
 from wva_tpu.k8s.objects import Deployment, LeaderWorkerSet, ServiceMonitor
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from wva_tpu.utils.variant import (
@@ -186,7 +192,14 @@ class VariantAutoscalingReconciler:
                     f"Scale target {va.spec.scale_target_ref.kind} "
                     f"{va.spec.scale_target_ref.name} not found")
             if va_status_material(va) != prev_material:
-                update_va_status_with_backoff(self.client, va)
+                try:
+                    update_va_status_with_backoff(self.client, va)
+                except ConflictError:
+                    # Lost a write race (engine/scale-from-zero status PUT
+                    # since our read). Level-triggered: the next trigger or
+                    # poll re-reconciles from a fresh read.
+                    log.debug("reconcile %s/%s: status write conflicted; "
+                              "deferring to the next trigger", namespace, name)
             return
 
         # Consume the engine's decision.
@@ -212,7 +225,15 @@ class VariantAutoscalingReconciler:
         # same property implicitly — patches only carry diffs).
         wrote = va_status_material(va) != prev_material
         if wrote:
-            update_va_status_with_backoff(self.client, va)
+            try:
+                update_va_status_with_backoff(self.client, va)
+            except ConflictError:
+                # Lost a write race; re-reconcile on the next trigger/poll
+                # from a fresh read (the trace event below records honestly
+                # that no status write landed this pass).
+                log.debug("reconcile %s/%s: status write conflicted; "
+                          "deferring to the next trigger", namespace, name)
+                wrote = False
         # Attribute the trace event only when the consumed decision came
         # from the exact cycle currently accepting events: DecisionCache is
         # also written by the (untraced) scale-from-zero engine, and in

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import math
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from wva_tpu.actuator import Actuator
@@ -57,6 +58,7 @@ from wva_tpu.interfaces import (
 from wva_tpu.interfaces.saturation_config import SLO_ANALYZER_NAME, V2_ANALYZER_NAME
 from wva_tpu.k8s.client import KubeClient, NotFoundError
 from wva_tpu.k8s.objects import Deployment, parse_quantity
+from wva_tpu.k8s.snapshot import SnapshotKubeClient
 from wva_tpu.pipeline import (
     CostAwareOptimizer,
     Enforcer,
@@ -85,6 +87,19 @@ STATUS_HEARTBEAT_SECONDS = 60.0
 # replicas waiting for the winner's slices to become ready (TPU node-pool
 # provisioning upper bound) before forced gradual drain.
 MIGRATION_HOLD_TIMEOUT = 600.0
+# Bounded worker pool for per-model prepare->analyze (ENGINE_ANALYSIS_WORKERS
+# config knob). 1 = fully serial (the pre-change loop shape); results are
+# always merged in sorted model-key order, so decisions, status writes, and
+# flight-recorder records are byte-identical at any pool width.
+DEFAULT_ANALYSIS_WORKERS = 8
+# Below this many active VAs the tick snapshot fetches scale targets with
+# memoized targeted GETs instead of one LIST per kind: on a shared cluster
+# WVA may track a handful of VAs among thousands of foreign Deployments,
+# and LISTing the whole kind each tick would cost more than a few GETs
+# (still one request per target per tick — the memo absorbs the 3-5 reads
+# each target gets per tick). VariantAutoscalings are always LISTed (they
+# are all ours).
+SNAPSHOT_LIST_MIN_VAS = 8
 
 METRICS_REASON_AVAILABLE = REASON_METRICS_FOUND
 METRICS_REASON_UNAVAILABLE = REASON_METRICS_MISSING
@@ -125,6 +140,7 @@ class SaturationEngine:
         direct_actuator=None,
         recorder=None,
         flight_recorder=None,
+        analysis_workers: int = DEFAULT_ANALYSIS_WORKERS,
     ) -> None:
         self.client = client
         self.config = config
@@ -154,6 +170,19 @@ class SaturationEngine:
         # opens one cycle record per tick; the engine and pipeline stages
         # fill it with analyzer inputs/outputs, decisions, and actuation.
         self.flight = flight_recorder
+        # Fleet-scale tick levers (docs/design/tick-scale.md). All three are
+        # independently toggleable so `make bench-tick` can reproduce the
+        # pre-change serial loop (snapshot off, workers 1, batching off)
+        # against the same world:
+        # - tick_snapshot_enabled: one LIST per kind per tick instead of
+        #   per-VA GETs (SnapshotKubeClient);
+        # - analysis_workers: bounded pool for per-model prepare->analyze;
+        # - solver_batching: one jitted sizing call across every model's
+        #   candidates in the SLO path instead of one dispatch per model.
+        self.analysis_workers = max(1, int(analysis_workers))
+        self.tick_snapshot_enabled = True
+        self.solver_batching = True
+        self._analysis_pool: ThreadPoolExecutor | None = None
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock,
                                         name=common.SOURCE_SATURATION)
@@ -164,14 +193,90 @@ class SaturationEngine:
     def start_optimize_loop(self, stop) -> None:
         self.executor.start(stop)
 
+    def close(self) -> None:
+        """Release the persistent analysis pool (process shutdown)."""
+        if self._analysis_pool is not None:
+            self._analysis_pool.shutdown(wait=False)
+            self._analysis_pool = None
+
+    def _tick_client(self) -> KubeClient:
+        """The tick's read view: a fresh snapshot client (one LIST per kind,
+        frozen for the tick) — or the live client when the snapshot lever is
+        off (bench legacy mode). Small fleets flip scale-target kinds to
+        memoized targeted GETs (see SNAPSHOT_LIST_MIN_VAS) so a shared
+        cluster's foreign Deployments are never LISTed."""
+        if not self.tick_snapshot_enabled:
+            return self.client
+        snap = SnapshotKubeClient(
+            self.client, namespace=self.config.watch_namespace() or None)
+        n_vas = len(snap.list("VariantAutoscaling",
+                              namespace=self.config.watch_namespace() or None))
+        if n_vas < SNAPSHOT_LIST_MIN_VAS:
+            snap.use_targeted_gets(("Deployment", "LeaderWorkerSet"))
+        return snap
+
+    def _map_models(self, model_groups: dict, fn, affinity=None) -> dict:
+        """Run ``fn(group_key, model_vas)`` for every model, across the
+        bounded worker pool when it pays (>1 worker and >1 model). Returns
+        ``{group_key: fn result}``. ``fn`` owns its per-model exception
+        isolation and returns tagged outcomes; an exception escaping ``fn``
+        propagates here exactly as it would from the serial loop (failing
+        the tick into the executor's retry) — but only after EVERY future
+        has finished, so a tick retry never overlaps stale workers from the
+        failed attempt.
+
+        ``affinity(group_key, model_vas)`` maps groups to a token; groups
+        sharing a token run in ONE worker, serially, in sorted key order.
+        The V2/SLO paths key it by model_id: analyzer state that is shared
+        ACROSS namespaces of the same model (k2 rolling history, capacity
+        records consulted by find_compatible) would otherwise interleave in
+        scheduler order and break the decisions-are-byte-identical-at-any-
+        pool-width guarantee."""
+        keys = sorted(model_groups)
+        if self.analysis_workers <= 1 or len(keys) <= 1:
+            return {key: fn(key, model_groups[key]) for key in keys}
+        if self._analysis_pool is None:
+            self._analysis_pool = ThreadPoolExecutor(
+                max_workers=self.analysis_workers,
+                thread_name_prefix="wva-analysis")
+        chains: dict[object, list[str]] = {}
+        for key in keys:
+            token = key if affinity is None else affinity(
+                key, model_groups[key])
+            chains.setdefault(token, []).append(key)
+
+        def run_chain(chain_keys: list[str]) -> list[tuple[str, object]]:
+            return [(k, fn(k, model_groups[k])) for k in chain_keys]
+
+        futures = [self._analysis_pool.submit(run_chain, chain)
+                   for chain in chains.values()]
+        results: dict[str, object] = {}
+        first_exc: Exception | None = None
+        for fut in futures:  # drain ALL before raising (no stale workers)
+            try:
+                for key, value in fut.result():
+                    results[key] = value
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+        return results
+
     def optimize(self) -> None:
         """One optimization tick (reference engine.go:171-277)."""
         if self.flight is not None:
             # Retried ticks must not stack duplicate model records into the
             # failed attempt's cycle.
             self.flight.reset_cycle()
+        # Tick-scoped cluster snapshot: every K8s read below (active-VA
+        # filter, per-model data prep, decision application, safety net) is
+        # served from one LIST per kind instead of a GET per VA — O(kinds)
+        # API requests per tick regardless of fleet size, and a consistent
+        # view for every model's analysis.
+        snap = self._tick_client()
         active_vas = variant_utils.active_variant_autoscalings(
-            self.client, namespace=self.config.watch_namespace() or None)
+            snap, namespace=self.config.watch_namespace() or None)
         if not active_vas:
             log.debug("No active VariantAutoscalings, skipping optimization")
             return
@@ -202,45 +307,66 @@ class SaturationEngine:
         # analyzer producing req/s capacities instead of token capacities.
         if analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
             decisions = self._optimize_v2(
-                model_groups, use_slo=analyzer_name == SLO_ANALYZER_NAME)
+                model_groups, snap, use_slo=analyzer_name == SLO_ANALYZER_NAME)
         else:
-            decisions = self._optimize_v1(model_groups)
+            decisions = self._optimize_v1(model_groups, snap)
 
         if self.flight is not None:
             self.flight.record_decisions(decisions)
-        self._apply_decisions(decisions, va_map)
+        self._apply_decisions(decisions, va_map, snap)
 
     # --- V1 path ---
 
     def _optimize_v1(
         self, model_groups: dict[str, list[VariantAutoscaling]],
+        snap: KubeClient,
     ) -> list[VariantDecision]:
+        # Stage 1 — per-model prepare + analyze, fanned across the worker
+        # pool. Workers only touch thread-safe state (snapshot reads,
+        # collector refresh, the stateless V1 analyzer); exceptions from
+        # data preparation stay isolated per model exactly as in the serial
+        # loop (analysis errors still fail the tick into the retry loop).
+        def analyze_one(group_key: str, model_vas: list[VariantAutoscaling]):
+            model_id = model_vas[0].spec.model_id
+            namespace = model_vas[0].metadata.namespace
+            sat_cfg = self.config.saturation_config_for_namespace(
+                namespace).get("default")
+            if sat_cfg is None:
+                log.info("No default saturation config for namespace %s; "
+                         "skipping model %s", namespace, model_id)
+                return ("skip", None)
+            try:
+                data = self._prepare_model_data(model_id, model_vas, snap)
+            except Exception as e:  # noqa: BLE001 — per-model isolation
+                return ("safety-net", e)
+            if data is None:
+                return ("skip", None)
+            analysis = self.v1_analyzer.analyze_model_saturation(
+                model_id, namespace, data.replica_metrics, sat_cfg)
+            targets = self.v1_analyzer.calculate_saturation_targets(
+                analysis, data.variant_states)
+            return ("ok", (data, analysis, targets, sat_cfg))
+
+        outcomes = self._map_models(model_groups, analyze_one)
+
+        # Stage 2 — enforcement, flight recording, and decision merge on the
+        # engine thread in sorted model-key order: the per-model outputs are
+        # order-independent, but the trace records, safety-net emissions and
+        # decision list must be byte-deterministic at any pool width.
         all_decisions: list[VariantDecision] = []
         for group_key in sorted(model_groups):
             model_vas = model_groups[group_key]
             model_id = model_vas[0].spec.model_id
             namespace = model_vas[0].metadata.namespace
-
-            sat_cfg_map = self.config.saturation_config_for_namespace(namespace)
-            sat_cfg = sat_cfg_map.get("default")
-            if sat_cfg is None:
-                log.info("No default saturation config for namespace %s; "
-                         "skipping model %s", namespace, model_id)
+            status, value = outcomes[group_key]
+            if status == "skip":
                 continue
-
-            try:
-                data = self._prepare_model_data(model_id, model_vas)
-            except Exception as e:  # noqa: BLE001 — per-model isolation
-                log.error("Model data preparation failed for %s: %s", model_id, e)
-                self._emit_safety_net_metrics(model_vas)
+            if status == "safety-net":
+                log.error("Model data preparation failed for %s: %s",
+                          model_id, value)
+                self._emit_safety_net_metrics(model_vas, snap)
                 continue
-            if data is None:
-                continue
-
-            analysis = self.v1_analyzer.analyze_model_saturation(
-                model_id, namespace, data.replica_metrics, sat_cfg)
-            targets = self.v1_analyzer.calculate_saturation_targets(
-                analysis, data.variant_states)
+            data, analysis, targets, sat_cfg = value
             saturation_targets = dict(targets)  # pre-enforcement snapshot
 
             s2z_cfg = self.config.scale_to_zero_config_for_namespace(namespace)
@@ -277,6 +403,7 @@ class SaturationEngine:
 
     def _optimize_v2(
         self, model_groups: dict[str, list[VariantAutoscaling]],
+        snap: KubeClient,
         use_slo: bool = False,
     ) -> list[VariantDecision]:
         requests: list[ModelScalingRequest] = []
@@ -288,50 +415,137 @@ class SaturationEngine:
         slo_cfg_by_ns: dict[str, object] = {}
         if use_slo:
             # Sync profiles once per distinct namespace per tick (not per
-            # model): the per-model resolved config is passed explicitly into
-            # analysis below.
-            for model_vas in model_groups.values():
-                ns = model_vas[0].metadata.namespace
+            # model), BEFORE the worker fan-out: the per-model resolved
+            # config is passed explicitly into analysis below, and workers
+            # must never race a profile-store sync.
+            for group_key in sorted(model_groups):
+                ns = model_groups[group_key][0].metadata.namespace
                 if ns not in slo_cfg_by_ns:
                     slo_cfg_by_ns[ns] = self.config.slo_config_for_namespace(ns)
                     self.slo_analyzer.sync_from_config(
                         slo_cfg_by_ns[ns], namespace=ns)
-        for group_key in sorted(model_groups):
-            model_vas = model_groups[group_key]
+
+        # Stage 1 — per-model prepare + analyze across the worker pool.
+        # V2 runs its full (thread-safe, per-model-keyed) analysis in the
+        # worker; the SLO path stops at a SizingPlan so every model's
+        # candidates can be sized in ONE device dispatch below. The trend
+        # update lives in finalize(), which stays on the engine thread.
+        def analyze_one(group_key: str, model_vas: list[VariantAutoscaling]):
             model_id = model_vas[0].spec.model_id
             namespace = model_vas[0].metadata.namespace
-
-            sat_cfg = self.config.saturation_config_for_namespace(namespace).get("default")
+            sat_cfg = self.config.saturation_config_for_namespace(
+                namespace).get("default")
             if sat_cfg is None:
                 log.info("No default saturation config for namespace %s; "
                          "skipping model %s", namespace, model_id)
-                continue
+                return ("skip", None)
             sat_cfg.apply_defaults()
-
             try:
-                data = self._prepare_model_data(model_id, model_vas)
-            except Exception as e:  # noqa: BLE001
-                log.error("Model data preparation failed for %s: %s", model_id, e)
-                self._emit_safety_net_metrics(model_vas)
-                continue
+                data = self._prepare_model_data(model_id, model_vas, snap)
+            except Exception as e:  # noqa: BLE001 — per-model isolation
+                return ("safety-net", ("Model data preparation", e))
             if data is None:
-                continue
-
+                return ("skip", None)
             scheduler_queue = self.collector.collect_scheduler_queue_metrics(
                 model_id)
             try:
                 if use_slo:
-                    result = self._run_slo_analysis(
+                    out = self._prepare_slo_plan(
                         model_id, namespace, data, sat_cfg,
                         slo_cfg_by_ns.get(namespace), scheduler_queue)
                 else:
-                    result = self._run_v2_analysis(
+                    out = self._run_v2_analysis(
                         model_id, namespace, data, sat_cfg, scheduler_queue)
-            except Exception as e:  # noqa: BLE001
-                log.error("%s analysis failed for %s: %s",
-                          "SLO" if use_slo else "V2", model_id, e)
-                self._emit_safety_net_metrics(model_vas)
+            except Exception as e:  # noqa: BLE001 — per-model isolation
+                return ("safety-net",
+                        (("SLO" if use_slo else "V2") + " analysis", e))
+            return ("ok", (data, sat_cfg, scheduler_queue, out))
+
+        # Same-model groups across namespaces share analyzer state (V2 k2
+        # history, capacity records): chain them into one worker so their
+        # state evolution is sorted-order deterministic.
+        outcomes = self._map_models(
+            model_groups, analyze_one,
+            affinity=lambda key, vas: vas[0].spec.model_id)
+
+        # Cross-model solver batching (SLO path): every model's candidate
+        # set rides ONE padded, shape-bucketed jitted call — a 50-model tick
+        # costs one device dispatch instead of 50. Per-plan slices are cut
+        # back out in the same sorted order they were concatenated.
+        sized: dict[str, list[float]] = {}
+        sizing_errors: dict[str, Exception] = {}
+        if use_slo:
+            # Worker outcome shape: ("ok", (data, sat_cfg, scheduler_queue,
+            # SizingPlan)) — name the plans once instead of reaching through
+            # tuple indices at every use site.
+            plans = {k: value[3] for k, (status, value) in outcomes.items()
+                     if status == "ok"}
+            batch_keys = [k for k in sorted(plans)
+                          if plans[k].needs_sizing]
+            batched_ok = False
+            if self.solver_batching and batch_keys:
+                all_candidates = [c for k in batch_keys
+                                  for c in plans[k].candidates]
+                try:
+                    per_replica = self.slo_analyzer.size_candidates(
+                        all_candidates)
+                    offset = 0
+                    for k in batch_keys:
+                        n = len(plans[k].candidates)
+                        sized[k] = per_replica[offset:offset + n]
+                        offset += n
+                    batched_ok = True
+                except Exception as e:  # noqa: BLE001 — one poisoned
+                    # candidate must not fail the whole tick: fall back to
+                    # per-model dispatches so only the bad model pays.
+                    log.warning("Batched SLO sizing failed (%s); falling "
+                                "back to per-model sizing", e)
+            if not batched_ok:
+                for k in batch_keys:
+                    try:
+                        sized[k] = self.slo_analyzer.size_candidates(
+                            plans[k].candidates)
+                    except Exception as e:  # noqa: BLE001 — per-model
+                        sizing_errors[k] = e  # isolation (safety net below)
+
+        # Stage 2 — finalize, record, and merge on the engine thread in
+        # sorted model-key order (trend updates, trace records and the
+        # request list stay byte-deterministic at any pool width).
+        for group_key in sorted(model_groups):
+            model_vas = model_groups[group_key]
+            model_id = model_vas[0].spec.model_id
+            namespace = model_vas[0].metadata.namespace
+            status, value = outcomes[group_key]
+            if status == "skip":
                 continue
+            if status == "safety-net":
+                stage, err = value
+                log.error("%s failed for %s: %s", stage, model_id, err)
+                self._emit_safety_net_metrics(model_vas, snap)
+                continue
+            data, sat_cfg, scheduler_queue, out = value
+            if group_key in sizing_errors:
+                log.error("SLO sizing failed for %s: %s", model_id,
+                          sizing_errors[group_key])
+                self._emit_safety_net_metrics(model_vas, snap)
+                continue
+            if use_slo:
+                if not out.needs_sizing:
+                    # Gated out before sizing (no config/targets/telemetry/
+                    # candidates): the skeleton result is final, and the
+                    # trend series must NOT be fed — same as the monolithic
+                    # analyze() early returns.
+                    result = out.result
+                else:
+                    try:
+                        result = self.slo_analyzer.finalize(
+                            out, sized.get(group_key, []))
+                    except Exception as e:  # noqa: BLE001 — per-model isolation
+                        log.error("SLO analysis failed for %s: %s", model_id, e)
+                        self._emit_safety_net_metrics(model_vas, snap)
+                        continue
+            else:
+                result = out
             if use_slo and not result.variant_capacities:
                 # No SLO targets/profiles for this model -> leave it to its
                 # current replica count rather than emitting zero-capacity
@@ -686,17 +900,20 @@ class SaturationEngine:
             k: v for k, v in self._migration_holds.items() if k in active_holds}
         return decisions
 
-    def _run_slo_analysis(self, model_id: str, namespace: str, data: _ModelData,
+    def _prepare_slo_plan(self, model_id: str, namespace: str, data: _ModelData,
                           sat_cfg: SaturationScalingConfig, slo_cfg,
                           scheduler_queue=None):
-        """SLO path: attach the model's arrival-rate telemetry and run the
-        queueing-model analyzer with the namespace's resolved SLO config
-        (profiles were synced once for the namespace at tick start)."""
+        """SLO path, worker half: attach the model's arrival-rate telemetry,
+        feed the tuner, and prepare the sizing plan (candidates) with the
+        namespace's resolved SLO config (profiles were synced once for the
+        namespace at tick start). The device sizing call happens ONCE per
+        tick across every model's plan (see ``_optimize_v2``), and
+        ``finalize`` runs on the engine thread."""
         optimizer_metrics = collect_optimizer_metrics(
             self.collector.source, model_id, namespace)
         if slo_cfg is not None and slo_cfg.tuner_enabled:
             self._feed_slo_tuner(model_id, namespace, data, optimizer_metrics)
-        return self.slo_analyzer.analyze(AnalyzerInput(
+        return self.slo_analyzer.prepare(AnalyzerInput(
             model_id=model_id, namespace=namespace,
             replica_metrics=data.replica_metrics,
             variant_states=data.variant_states,
@@ -798,11 +1015,15 @@ class SaturationEngine:
 
     def _prepare_model_data(
         self, model_id: str, model_vas: list[VariantAutoscaling],
+        client: KubeClient | None = None,
     ) -> _ModelData | None:
         """Collect metrics + build lookup maps (reference engine.go:677-803).
-        Returns None when no metrics are available (skip the model)."""
+        Returns None when no metrics are available (skip the model).
+        ``client`` is the tick's snapshot view (falls back to the live
+        client for direct callers like the fast path)."""
         if not model_vas:
             raise ValueError(f"no VAs provided for model {model_id}")
+        client = client or self.client
         namespace = model_vas[0].metadata.namespace
 
         # Targets of any scalable kind (Deployment, LeaderWorkerSet); keyed
@@ -816,7 +1037,7 @@ class SaturationEngine:
             variant_costs[key] = va.spec.cost()
             try:
                 target = scale_target.get_scale_target_with_backoff(
-                    self.client, va.spec.scale_target_ref.kind,
+                    client, va.spec.scale_target_ref.kind,
                     va.spec.scale_target_ref.name, va.metadata.namespace)
             except NotFoundError:
                 log.debug("No scale target for VA %s", va.metadata.name)
@@ -833,7 +1054,8 @@ class SaturationEngine:
             log.debug("No replica metrics for model %s", model_id)
             return None
 
-        variant_states = self.build_variant_states(model_vas, deployments)
+        variant_states = self.build_variant_states(model_vas, deployments,
+                                                   client=client)
         return _ModelData(
             model_id=model_id, namespace=namespace,
             replica_metrics=replica_metrics, deployments=deployments,
@@ -843,12 +1065,14 @@ class SaturationEngine:
     def build_variant_states(
         self, vas: list[VariantAutoscaling],
         deployments: dict[str, object] | None = None,
+        client: KubeClient | None = None,
     ) -> list[VariantReplicaState]:
         """Current/desired/pending replica counts per variant
         (reference engine.go:491-556). Pending counts replicas that exist but
         are not fully Ready — slice provisioning + model load take minutes on
         TPU, and for a multi-host slice one unready host keeps the whole
         replica pending (the scale-target adapter owns that math)."""
+        client = client or self.client
         states = []
         for va in vas:
             key = namespaced_key(va.metadata.namespace, va.spec.scale_target_ref.name)
@@ -856,7 +1080,7 @@ class SaturationEngine:
             if target is None:
                 try:
                     target = scale_target.get_scale_target_with_backoff(
-                        self.client, va.spec.scale_target_ref.kind,
+                        client, va.spec.scale_target_ref.kind,
                         va.spec.scale_target_ref.name, va.metadata.namespace)
                 except (NotFoundError, TypeError):
                     log.debug("Could not get scale target for VA %s",
@@ -887,10 +1111,15 @@ class SaturationEngine:
         self,
         decisions: list[VariantDecision],
         va_map: dict[str, VariantAutoscaling],
+        client: KubeClient | None = None,
     ) -> None:
         """Update VA status, emit metrics, publish cache + trigger
         (reference engine.go:805-1019). Iterates ALL active VAs so status and
-        metric emission happen every tick even without decisions."""
+        metric emission happen every tick even without decisions. Reads go
+        through the tick snapshot (``client``); status WRITES go to the live
+        client with conflict-refetch, since the snapshot's resourceVersions
+        may be stale by write time."""
+        client = client or self.client
         decision_map = {namespaced_key(d.namespace, d.variant_name): d
                         for d in decisions}
         now = self.clock.now()
@@ -901,7 +1130,7 @@ class SaturationEngine:
 
             try:
                 update_va = variant_utils.get_va_with_backoff(
-                    self.client, va.metadata.name, va.metadata.namespace)
+                    client, va.metadata.name, va.metadata.namespace)
             except NotFoundError:
                 log.debug("VA %s disappeared; skipping", va_key)
                 continue
@@ -918,7 +1147,7 @@ class SaturationEngine:
                 target_replicas = update_va.status.desired_optimized_alloc.num_replicas
                 if target_replicas <= 0:
                     try:
-                        tgt = scale_target.scale_target_state(self.client.get(
+                        tgt = scale_target.scale_target_state(client.get(
                             update_va.spec.scale_target_ref.kind,
                             update_va.metadata.namespace,
                             update_va.spec.scale_target_ref.name))
@@ -991,7 +1220,7 @@ class SaturationEngine:
                 now=now)
 
             try:
-                self.actuator.emit_metrics(update_va)
+                self.actuator.emit_metrics(update_va, client=client)
                 update_va.status.actuation.applied = True
             except Exception as e:  # noqa: BLE001 — emission never fails the loop
                 log.error("Failed to emit metrics for %s: %s", va_key, e)
@@ -1018,14 +1247,25 @@ class SaturationEngine:
             # at a 5s tick with N VAs, unconditional writes are 2N API
             # requests per tick of no-op churn. A heartbeat bound keeps
             # lastRunTime from going permanently stale on quiet models.
+            persisted = True
             if (_status_material(update_va) != prev_material
                     or now - prev_run_time >= STATUS_HEARTBEAT_SECONDS):
                 try:
-                    variant_utils.update_va_status_with_backoff(
-                        self.client, update_va)
+                    # Writes always target the LIVE client: a 409 from a
+                    # snapshot-stale resourceVersion refetches just the
+                    # conflicted VA (targeted GET) and retries, instead of
+                    # invalidating the tick's whole snapshot. old_alloc
+                    # (the alloc we READ from the snapshot) anchors the
+                    # stale-write guard — a decision newer than our read
+                    # (mid-tick scale-from-zero wake) must win, not be
+                    # reverted by this tick's pre-wake computation.
+                    _, persisted = \
+                        variant_utils.update_va_status_with_conflict_refetch(
+                            self.client, update_va, read_alloc=old_alloc)
                 except NotFoundError:
                     continue
-                if (self.recorder is not None and decision is not None
+                if (persisted
+                        and self.recorder is not None and decision is not None
                         and had_recorded_alloc
                         and target_replicas != old_desired):
                     # The audit trail where operators look first (kubectl
@@ -1041,6 +1281,17 @@ class SaturationEngine:
                         update_va, "ScalingDecision",
                         f"desired replicas {old_desired} -> "
                         f"{target_replicas} on {accelerator}: {trail}")
+
+            if not persisted:
+                # The stale-write guard dropped this VA's status write in
+                # favor of a newer concurrent decision. Publishing the
+                # stale decision onward would defeat the guard: the
+                # reconciler consumes DecisionCache from a FRESH read (no
+                # conflict possible) and would re-apply exactly the value
+                # the guard refused to write, flapping the just-woken
+                # variant back down. Skip cache + trigger; the next tick
+                # decides from the post-wake state.
+                continue
 
             metrics_available = decision is not None
             common.DecisionCache.set(va.metadata.name, va.metadata.namespace,
@@ -1104,13 +1355,17 @@ class SaturationEngine:
                      va.metadata.namespace, va.metadata.name,
                      decision.target_replicas)
 
-    def _emit_safety_net_metrics(self, model_vas: list[VariantAutoscaling]) -> None:
+    def _emit_safety_net_metrics(self, model_vas: list[VariantAutoscaling],
+                                 client: KubeClient | None = None) -> None:
         """On analysis failure, emit previous-desired or current replicas so
-        the external HPA keeps a signal (reference engine.go:1022-1095)."""
+        the external HPA keeps a signal (reference engine.go:1022-1095).
+        Scale targets come from the tick snapshot — the tick already LISTed
+        them, so the safety net must not pay fresh per-VA GETs."""
+        client = client or self.client
         for va in model_vas:
             current = 0
             try:
-                tgt = scale_target.scale_target_state(self.client.get(
+                tgt = scale_target.scale_target_state(client.get(
                     va.spec.scale_target_ref.kind, va.metadata.namespace,
                     va.spec.scale_target_ref.name))
                 # OBSERVED replicas only, same rule as Actuator.emit_metrics

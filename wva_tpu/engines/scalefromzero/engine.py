@@ -126,15 +126,22 @@ class ScaleFromZeroEngine:
         try:
             update_va = variant_utils.get_va_with_backoff(
                 self.client, va.metadata.name, va.metadata.namespace)
+            read_alloc = update_va.status.desired_optimized_alloc
             update_va.status.desired_optimized_alloc = OptimizedAlloc(
                 accelerator=accelerator, num_replicas=1, last_run_time=now)
             update_va.set_condition(
                 TYPE_OPTIMIZATION_READY, "True", "ScaleFromZero",
                 "Scaled 0->1: pending requests in scheduler flow control", now=now)
-            variant_utils.update_va_status_with_backoff(self.client, update_va)
+            # Conflict-refetch, not plain backoff: the engine/reconciler can
+            # write this VA's status concurrently, and the wake (the newest
+            # decision) must win the race, not crash the tick on a 409.
+            _, persisted = variant_utils.update_va_status_with_conflict_refetch(
+                self.client, update_va, read_alloc=read_alloc)
             # Inside the try: a VA deleted mid-flight must not get an audit
-            # event recorded against the now-missing object.
-            if self.recorder is not None:
+            # event recorded against the now-missing object — and a DROPPED
+            # write (a newer concurrent decision won) must not be audited
+            # as a persisted 0->1 transition either.
+            if persisted and self.recorder is not None:
                 self.recorder.normal(
                     va, "ScalingDecision",
                     f"desired replicas 0 -> 1 on {accelerator}: "

@@ -65,12 +65,14 @@ class Indexer:
             elif key is not None:
                 self._index.setdefault(key, set()).add(va.metadata.name)
 
-    def find_va_for_scale_target(
+    def find_va_name_for_scale_target(
         self, ref: CrossVersionObjectReference, namespace: str
-    ) -> VariantAutoscaling | None:
-        """The unique VA targeting the resource; None if absent. Raises
-        MultipleVAsError when >1 VA targets the same resource
-        (reference FindVAForScaleTarget :80-100)."""
+    ) -> str | None:
+        """Name of the unique VA targeting the resource, straight from the
+        index — NO API request. The hot collection path joins pods to VAs
+        once per pod per tick; fetching the full object there cost one GET
+        per pod per tick at fleet scale when only the name is consumed.
+        Raises MultipleVAsError when >1 VA targets the same resource."""
         key = scale_target_index_key(namespace, ref)
         with self._mu:
             names = sorted(self._index.get(key, ()))
@@ -80,8 +82,19 @@ class Indexer:
             raise MultipleVAsError(
                 f"multiple VariantAutoscalings found for {ref.kind} {namespace}/{ref.name}: {names}"
             )
+        return names[0]
+
+    def find_va_for_scale_target(
+        self, ref: CrossVersionObjectReference, namespace: str
+    ) -> VariantAutoscaling | None:
+        """The unique VA targeting the resource; None if absent. Raises
+        MultipleVAsError when >1 VA targets the same resource
+        (reference FindVAForScaleTarget :80-100)."""
+        name = self.find_va_name_for_scale_target(ref, namespace)
+        if name is None:
+            return None
         try:
-            return self._client.get(VariantAutoscaling.kind, namespace, names[0])
+            return self._client.get(VariantAutoscaling.kind, namespace, name)
         except KeyError:
             return None
 

@@ -104,6 +104,25 @@ class FakeCluster(KubeClient):
         self._watchers: dict[str, list[WatchHandler]] = {}
         self._rv = 0
         self.clock = clock or SYSTEM_CLOCK
+        # API-request accounting: (verb, kind) -> count, incremented on every
+        # client call. Lets tests assert the engine's per-tick request budget
+        # (O(kinds) LISTs, zero per-VA GETs) instead of trusting it.
+        self._requests: dict[tuple[str, str], int] = {}
+
+    # --- request accounting ---
+
+    def _count(self, verb: str, kind: str) -> None:
+        key = (verb, kind)
+        self._requests[key] = self._requests.get(key, 0) + 1
+
+    def request_counts(self) -> dict[tuple[str, str], int]:
+        """Copy of (verb, kind) -> request count since the last reset."""
+        with self._mu:
+            return dict(self._requests)
+
+    def reset_request_counts(self) -> None:
+        with self._mu:
+            self._requests.clear()
 
     # --- internals ---
 
@@ -132,6 +151,7 @@ class FakeCluster(KubeClient):
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
         with self._mu:
+            self._count("get", kind)
             stored = self._objs.get(self._key(kind, namespace, name))
             if stored is None:
                 raise NotFoundError(kind, namespace or "", name)
@@ -146,6 +166,7 @@ class FakeCluster(KubeClient):
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict[str, str] | None = None) -> list[Any]:
         with self._mu:
+            self._count("list", kind)
             out = []
             for (k, ns, _), stored in sorted(self._objs.items()):
                 if k != kind:
@@ -160,6 +181,7 @@ class FakeCluster(KubeClient):
     def create(self, obj: Any) -> Any:
         kind = _kind_of(obj)
         with self._mu:
+            self._count("create", kind)
             key = self._key(kind, obj.metadata.namespace, obj.metadata.name)
             if key in self._objs:
                 raise ConflictError(f"{kind} {key[1]}/{key[2]} already exists")
@@ -177,6 +199,7 @@ class FakeCluster(KubeClient):
     def update(self, obj: Any) -> Any:
         kind = _kind_of(obj)
         with self._mu:
+            self._count("update", kind)
             key = self._key(kind, obj.metadata.namespace, obj.metadata.name)
             cur = self._objs.get(key)
             if cur is None:
@@ -206,10 +229,26 @@ class FakeCluster(KubeClient):
     def update_status(self, obj: Any) -> Any:
         kind = _kind_of(obj)
         with self._mu:
+            self._count("update_status", kind)
             key = self._key(kind, obj.metadata.namespace, obj.metadata.name)
             cur = self._objs.get(key)
             if cur is None:
                 raise NotFoundError(kind, key[1], key[2])
+            # Same optimistic concurrency as update(): a status PUT carrying
+            # a stale resourceVersion gets 409, as a real apiserver gives.
+            # Without this, a writer working from an older read (e.g. the
+            # engine's tick-start snapshot) silently clobbers status fields
+            # a concurrent writer (the reconciler) set in between — and the
+            # engine's conflict-refetch path could never fire in any
+            # FakeCluster-backed world. rv ""/"0" skips the check, as above.
+            presented_rv = obj.metadata.resource_version
+            if presented_rv not in ("", "0") and \
+                    presented_rv != cur.obj.metadata.resource_version:
+                raise ConflictError(
+                    f"{kind} {key[1]}/{key[2]}: resourceVersion "
+                    f"{presented_rv} is stale (current "
+                    f"{cur.obj.metadata.resource_version})"
+                )
             cur.obj.status = _copy(obj.status)
             cur.obj.metadata.resource_version = self._next_rv()
             snapshot = _copy(cur.obj)
@@ -218,6 +257,7 @@ class FakeCluster(KubeClient):
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._mu:
+            self._count("delete", kind)
             key = self._key(kind, namespace, name)
             stored = self._objs.pop(key, None)
             if stored is None:
@@ -231,6 +271,7 @@ class FakeCluster(KubeClient):
         reference DirectActuator's unstructured scale-subresource handling
         (direct_actuator.go:54-121)."""
         with self._mu:
+            self._count("patch_scale", kind)
             key = self._key(kind, namespace, name)
             cur = self._objs.get(key)
             if cur is None:

@@ -69,6 +69,17 @@ class _Handler(BaseHTTPRequestHandler):
     # TokenReview / SubjectAccessReview backing state.
     sa_tokens: dict[str, str] = {}  # token -> username
     metrics_readers: set = set()  # usernames allowed to GET /metrics
+    # HTTP-level request accounting, shared with FakeAPIServer: (verb, kind)
+    # -> count, where verb is "get"/"list"/"watch"/"put"/... — the wire-level
+    # counterpart of FakeCluster's method counters, for tests asserting the
+    # per-tick request budget over real sockets.
+    http_requests: dict = {}
+    _http_requests_mu = threading.Lock()
+
+    def _count_http(self, verb: str, kind: str) -> None:
+        with self._http_requests_mu:
+            key = (verb, kind)
+            self.http_requests[key] = self.http_requests.get(key, 0) + 1
 
     # --- helpers ---
 
@@ -146,6 +157,9 @@ class _Handler(BaseHTTPRequestHandler):
         if routed is None:
             return
         kind, ns, name, sub, query = routed
+        self._count_http(
+            "get" if name else
+            ("watch" if query.get("watch") == "true" else "list"), kind)
         try:
             if name and sub == "scale":
                 obj = self.cluster.get(kind, ns, name)
@@ -185,6 +199,7 @@ class _Handler(BaseHTTPRequestHandler):
         if routed is None:
             return
         kind, ns, _, _, _ = routed
+        self._count_http("post", kind)
         try:
             obj = serde.from_k8s(kind, self._read_body())
             if ns:
@@ -201,6 +216,7 @@ class _Handler(BaseHTTPRequestHandler):
         if routed is None:
             return
         kind, ns, name, sub, _ = routed
+        self._count_http("put_status" if sub == "status" else "put", kind)
         try:
             obj = serde.from_k8s(kind, self._read_body())
             obj.metadata.namespace = ns or obj.metadata.namespace
@@ -223,6 +239,7 @@ class _Handler(BaseHTTPRequestHandler):
         if routed is None:
             return
         kind, ns, name, sub, _ = routed
+        self._count_http("patch", kind)
         body = self._read_body()
         try:
             if sub == "scale":
@@ -248,6 +265,7 @@ class _Handler(BaseHTTPRequestHandler):
         if routed is None:
             return
         kind, ns, name, _, _ = routed
+        self._count_http("delete", kind)
         try:
             self.cluster.delete(kind, ns, name)
             self._send_json(200, {"kind": "Status", "apiVersion": "v1",
@@ -379,13 +397,17 @@ class FakeAPIServer:
                  sa_tokens: dict[str, str] | None = None,
                  metrics_readers: set | None = None) -> None:
         self.cluster = cluster
+        self._http_requests: dict = {}
         handler = type("Handler", (_Handler,), {
             "cluster": cluster,
             "plurals": _plural_index(),
             "bearer_token": bearer_token,
             "sa_tokens": dict(sa_tokens or {}),
             "metrics_readers": set(metrics_readers or ()),
+            "http_requests": self._http_requests,
+            "_http_requests_mu": threading.Lock(),
         })
+        self._handler_cls = handler
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self._server.daemon_threads = True
         self._server._shutting_down = False
@@ -401,6 +423,15 @@ class FakeAPIServer:
                                         name="fake-apiserver", daemon=True)
         self._thread.start()
         return self
+
+    def request_counts(self) -> dict:
+        """Copy of (verb, kind) -> HTTP request count since start/reset."""
+        with self._handler_cls._http_requests_mu:
+            return dict(self._http_requests)
+
+    def reset_request_counts(self) -> None:
+        with self._handler_cls._http_requests_mu:
+            self._http_requests.clear()
 
     def shutdown(self) -> None:
         self._server._shutting_down = True

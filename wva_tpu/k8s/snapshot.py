@@ -1,0 +1,262 @@
+"""Tick-scoped cluster-state snapshot.
+
+At fleet scale one engine tick used to cost O(VariantAutoscalings) API
+round trips: the active-VA filter, ``_prepare_model_data``,
+``build_variant_states``, ``_apply_decisions`` and the safety net each
+issued a targeted GET per VA / scale target (Autopilot sizes its control
+loop the opposite way — one shared snapshot of cluster state evaluated by
+every job in the pass; AIBrix batches collection across models for the
+same reason). :class:`SnapshotKubeClient` makes the snapshot pattern a
+drop-in: it implements the ``KubeClient`` read surface over a per-kind
+cache filled by ONE LIST on the first read of that kind, so a tick costs
+O(kinds touched) list requests no matter how many VAs exist.
+
+Semantics:
+
+- **Reads** (``get``/``list``/``try_get``) of a snapshotted kind are served
+  from the cache. Objects are deep-copied on the way out, preserving the
+  API-server guarantee that callers cannot mutate the store (engine code
+  mutates fetched VA statuses in place before writing them back).
+- **Writes** (``create``/``update``/``update_status``/``delete``/
+  ``patch_scale``) delegate to the wrapped client untouched — and update or
+  invalidate the cached copy so a later read within the same tick sees the
+  write (read-your-writes within the tick).
+- ``refresh`` issues a TARGETED GET against the wrapped client, updating
+  the cache — for callers that must revalidate ONE object mid-tick
+  instead of discarding the whole snapshot. (Status-write conflict
+  recovery itself lives in
+  ``utils.variant.update_va_status_with_conflict_refetch``, which GETs
+  via the LIVE client; the engine's snapshot is read-mostly.)
+- Everything else (unknown kinds, ``watch``) delegates directly.
+
+A snapshot is built for ONE tick and discarded; it is not a cache with an
+invalidation problem. Within the tick the view is frozen — exactly the
+consistency the decision loop wants, since a half-tick mix of old and new
+cluster state is what produces contradictory per-model decisions.
+
+Thread-safe: the engine's per-model analysis workers read it concurrently.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any
+
+from wva_tpu.k8s.client import KubeClient, NotFoundError, _kind_of
+from wva_tpu.k8s.objects import labels_match
+
+# Kinds the saturation tick reads per-VA; one LIST each per tick, lazily —
+# a fleet with no LeaderWorkerSet targets never lists them.
+DEFAULT_SNAPSHOT_KINDS = ("VariantAutoscaling", "Deployment", "LeaderWorkerSet")
+
+# Cache sentinel for memoized NotFound in targeted-GET mode.
+_NOT_FOUND = object()
+
+
+class SnapshotKubeClient(KubeClient):
+    """Read-through, tick-scoped snapshot over a ``KubeClient``."""
+
+    def __init__(self, client: KubeClient,
+                 namespace: str | None = None,
+                 kinds: tuple[str, ...] = DEFAULT_SNAPSHOT_KINDS) -> None:
+        self.client = client
+        # Namespace scope of the snapshot LISTs (None = cluster-wide), the
+        # engine's watch-namespace. Reads outside this scope delegate.
+        self.namespace = namespace or None
+        self._kinds = frozenset(kinds)
+        self._mu = threading.Lock()
+        # kind -> {(namespace, name): obj-or-_NOT_FOUND}. A kind in
+        # _complete was fully LISTed (reads never touch the wrapped
+        # client); otherwise the cache memoizes targeted GETs — including
+        # misses — for kinds in targeted mode.
+        self._cache: dict[str, dict[tuple[str, str], Any]] = {}
+        self._complete: set[str] = set()
+        # Kinds preferring memoized targeted GETs over one LIST: on a
+        # shared cluster where WVA tracks a handful of VAs among thousands
+        # of foreign Deployments, LISTing the whole kind each tick costs
+        # more than a few targeted GETs (still memoized, so the tick's 3-5
+        # reads of each target cost ONE request). The engine flips this on
+        # for scale-target kinds when the fleet is small.
+        self._targeted: set[str] = set()
+        # Per-kind fetch locks: the snapshot LIST is a network call and must
+        # not run under _mu (it would serialize every concurrent worker's
+        # reads of ALL kinds behind one slow LIST); the per-kind lock still
+        # guarantees exactly one LIST per kind.
+        self._fetch_locks: dict[str, threading.Lock] = {}
+
+    # --- cache internals ---
+
+    def _covers(self, kind: str, namespace: str | None) -> bool:
+        if kind not in self._kinds:
+            return False
+        return self.namespace is None or namespace == self.namespace
+
+    def use_targeted_gets(self, kinds: tuple[str, ...]) -> None:
+        """Switch (not-yet-LISTed) kinds to memoized targeted GETs. Small
+        fleets on shared clusters call this before any target reads: a
+        handful of VAs does not justify LISTing a kind whose cluster-wide
+        population may be thousands of foreign objects."""
+        with self._mu:
+            for kind in kinds:
+                if kind not in self._complete:
+                    self._targeted.add(kind)
+
+    def _kind_cache(self, kind: str) -> dict[tuple[str, str], Any]:
+        """The kind's cached objects, fully LISTed once on first need. The
+        LIST runs outside ``_mu`` (under a per-kind lock) so concurrent
+        readers of other — or already-cached — kinds never block behind
+        it."""
+        with self._mu:
+            if kind in self._complete:
+                return self._cache[kind]
+            fetch_lock = self._fetch_locks.setdefault(kind, threading.Lock())
+        with fetch_lock:
+            with self._mu:
+                if kind in self._complete:
+                    return self._cache[kind]  # raced: another worker LISTed
+            listed = self.client.list(kind, namespace=self.namespace)
+            cached = {
+                (o.metadata.namespace or "", o.metadata.name): o
+                for o in listed
+            }
+            with self._mu:
+                self._cache[kind] = cached
+                self._complete.add(kind)
+                self._targeted.discard(kind)
+            return cached
+
+    # --- KubeClient read surface ---
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        if not self._covers(kind, namespace):
+            return self.client.get(kind, namespace, name)
+        with self._mu:
+            targeted = kind in self._targeted and kind not in self._complete
+        if targeted:
+            return self._memoized_get(kind, namespace, name)
+        cached = self._kind_cache(kind)
+        with self._mu:
+            obj = cached.get((namespace or "", name))
+        if obj is None or obj is _NOT_FOUND:
+            raise NotFoundError(kind, namespace or "", name)
+        return copy.deepcopy(obj)
+
+    def _memoized_get(self, kind: str, namespace: str, name: str) -> Any:
+        """Targeted-GET mode: one wrapped-client GET per object per tick,
+        memoized (misses too — repeated lookups of a deleted target must
+        not re-GET every stage)."""
+        key = (namespace or "", name)
+        with self._mu:
+            obj = self._cache.get(kind, {}).get(key)
+        if obj is None:
+            try:
+                obj = self.client.get(kind, namespace, name)
+            except NotFoundError:
+                obj = _NOT_FOUND
+            with self._mu:
+                self._cache.setdefault(kind, {})[key] = obj
+        if obj is _NOT_FOUND:
+            raise NotFoundError(kind, namespace or "", name)
+        return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Any | None:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None) -> list[Any]:
+        in_scope = kind in self._kinds and (
+            self.namespace is None or namespace == self.namespace)
+        if not in_scope:
+            return self.client.list(kind, namespace=namespace,
+                                    label_selector=label_selector)
+        cached = self._kind_cache(kind)
+        with self._mu:
+            objs = sorted(cached.items())
+        out = []
+        for (ns, _), obj in objs:
+            if namespace is not None and ns != (namespace or ""):
+                continue
+            if not labels_match(label_selector, obj.metadata.labels):
+                continue
+            out.append(copy.deepcopy(obj))
+        return out
+
+    def refresh(self, kind: str, namespace: str, name: str) -> Any:
+        """Targeted GET against the wrapped client, updating the cache:
+        revalidates ONE object mid-tick without discarding the snapshot.
+        Raises NotFoundError (and drops the cached copy) when the object
+        is gone."""
+        try:
+            obj = self.client.get(kind, namespace, name)
+        except NotFoundError:
+            with self._mu:
+                cached = self._cache.get(kind)
+                if cached is not None:
+                    cached.pop((namespace or "", name), None)
+            raise
+        self._store(kind, obj)
+        return copy.deepcopy(obj)
+
+    def _store(self, kind: str, obj: Any) -> None:
+        if kind not in self._kinds:
+            return
+        with self._mu:
+            self._cache.setdefault(kind, {})[
+                (obj.metadata.namespace or "", obj.metadata.name)] = \
+                copy.deepcopy(obj)
+
+    def _evict(self, kind: str, namespace: str, name: str) -> None:
+        with self._mu:
+            cached = self._cache.get(kind)
+            if cached is not None:
+                cached.pop((namespace or "", name), None)
+
+    # --- KubeClient write surface (delegate + keep the tick view current) ---
+
+    def create(self, obj: Any) -> Any:
+        created = self.client.create(obj)
+        self._store(_kind_of(created), created)
+        return created
+
+    def update(self, obj: Any) -> Any:
+        updated = self.client.update(obj)
+        self._store(_kind_of(updated), updated)
+        return updated
+
+    def update_status(self, obj: Any) -> Any:
+        updated = self.client.update_status(obj)
+        self._store(_kind_of(updated), updated)
+        return updated
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self.client.delete(kind, namespace, name)
+        self._evict(kind, namespace, name)
+
+    def patch_scale(self, kind: str, namespace: str, name: str,
+                    replicas: int) -> None:
+        self.client.patch_scale(kind, namespace, name, replicas)
+        if kind not in self._kinds:
+            return
+        # Refresh the cached copy rather than evict: evicting from a fully
+        # LISTed kind would make every later same-tick read of this
+        # still-existing object 404 (read-your-writes contract). One
+        # targeted GET per scale patch, proportional to actuations.
+        try:
+            self._store(kind, self.client.get(kind, namespace, name))
+        except NotFoundError:
+            self._evict(kind, namespace, name)
+
+    def watch(self, kind: str, handler) -> None:
+        self.client.watch(kind, handler)
+
+    # --- observability ---
+
+    def kinds_listed(self) -> list[str]:
+        """Kinds whose full snapshot LIST has run (for tests/metrics);
+        targeted-GET-mode kinds with memoized entries are not listed."""
+        with self._mu:
+            return sorted(self._complete)

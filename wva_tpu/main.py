@@ -46,6 +46,7 @@ from wva_tpu.datastore import Datastore
 from wva_tpu.discovery import TPUSliceDiscovery
 from wva_tpu.engines.fastpath import FastPathMonitor
 from wva_tpu.engines.saturation import SaturationEngine
+from wva_tpu.engines.saturation.engine import DEFAULT_ANALYSIS_WORKERS
 from wva_tpu.engines.scalefromzero import ScaleFromZeroEngine
 from wva_tpu.indexers import Indexer
 from wva_tpu.k8s.client import KubeClient
@@ -191,11 +192,16 @@ class Manager:
 
     def shutdown(self) -> None:
         """Voluntary leader step-down on exit (ReleaseOnCancel semantics);
-        flush the decision trace so the last cycle is never lost."""
+        flush the decision trace so the last cycle is never lost; release
+        the persistent worker pools (engine analysis, Prometheus queries)."""
         if self.elector is not None:
             self.elector.release()
         if self.flight_recorder is not None:
             self.flight_recorder.close()
+        self.engine.close()
+        prom = self.source_registry.get(PROMETHEUS_SOURCE_NAME)
+        if prom is not None and hasattr(prom, "close"):
+            prom.close()
 
 
 def build_manager(
@@ -275,12 +281,21 @@ def build_manager(
 
     capacity_store = CapacityKnowledgeStore(clock=clock)
     recorder = EventRecorder(client, clock=clock)
+    # Analysis pool width 0 = auto, resolved by the metrics backend (same
+    # rule as PrometheusSource's query concurrency): per-model collection
+    # against HTTP Prometheus is I/O-bound and overlaps across workers; the
+    # in-memory backend is pure Python, where extra threads only pay GIL
+    # tax — and simulation/bench drivers stay single-threaded-deterministic.
+    workers = config.engine_analysis_workers()
+    if workers == 0:
+        workers = 1 if tsdb is not None else DEFAULT_ANALYSIS_WORKERS
     engine = SaturationEngine(
         client=client, config=config, collector=collector, actuator=actuator,
         enforcer=enforcer, limiter=limiter, capacity_store=capacity_store,
         clock=clock, poll_interval=min(config.optimization_interval() / 2, 30.0),
         direct_actuator=direct_actuator, recorder=recorder,
-        flight_recorder=flight)
+        flight_recorder=flight,
+        analysis_workers=workers)
     if flight is not None:
         engine.optimizer.flight_recorder = flight
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,

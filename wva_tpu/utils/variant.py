@@ -9,7 +9,7 @@ import os
 
 from wva_tpu.api.v1alpha1 import VariantAutoscaling
 from wva_tpu.constants import ACCELERATOR_NAME_LABEL_KEY, CONTROLLER_INSTANCE_LABEL_KEY
-from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
 from wva_tpu.utils import scale_target
 from wva_tpu.utils.backoff import retry_with_backoff
 
@@ -31,11 +31,103 @@ def get_va_with_backoff(client: KubeClient, name: str, namespace: str) -> Varian
 
 
 def update_va_status_with_backoff(client: KubeClient, va: VariantAutoscaling) -> VariantAutoscaling:
+    # Conflict is FINAL here, not retriable: re-putting the identical stale
+    # object can never succeed. Callers working from a fresh read treat a
+    # 409 as "someone else won the race" and let their level-triggered loop
+    # re-run; writers that must win use update_va_status_with_conflict_refetch.
     return retry_with_backoff(
         lambda: client.update_status(va),
-        retriable=lambda e: not isinstance(e, NotFoundError),
+        retriable=lambda e: not isinstance(e, (NotFoundError, ConflictError)),
         description=f"update VA status {va.metadata.namespace}/{va.metadata.name}",
     )
+
+
+def merge_engine_status(fresh: VariantAutoscaling,
+                        computed: VariantAutoscaling) -> VariantAutoscaling:
+    """Graft ONLY the engine-owned status fields from ``computed`` onto a
+    freshly read VA: desired alloc, actuation, and the OptimizationReady
+    condition. A 409 on a snapshot-sourced write usually means another
+    writer (the reconciler owns TargetResolved / MetricsAvailable) updated
+    status mid-tick — transplanting the whole computed status would
+    silently revert that writer's fields to the tick-start snapshot."""
+    from wva_tpu.api.v1alpha1 import TYPE_OPTIMIZATION_READY
+
+    fresh.status.desired_optimized_alloc = \
+        computed.status.desired_optimized_alloc
+    fresh.status.actuation = computed.status.actuation
+    opt_ready = computed.get_condition(TYPE_OPTIMIZATION_READY)
+    if opt_ready is not None:
+        fresh.status.conditions = [
+            c for c in fresh.status.conditions
+            if c.type != TYPE_OPTIMIZATION_READY] + [opt_ready]
+    return fresh
+
+
+def update_va_status_with_conflict_refetch(
+    client: KubeClient, va: VariantAutoscaling, max_conflicts: int = 3,
+    read_alloc=None,
+) -> tuple[VariantAutoscaling, bool]:
+    """Status write for snapshot-sourced objects: the engine builds the VA
+    from a tick-scoped cluster snapshot, so its resourceVersion may be stale
+    by write time. On 409 the writer refetches ONLY the conflicted object
+    with a targeted GET (``client`` here must be the live client, not the
+    snapshot), grafts the engine-owned status fields onto the fresh read
+    (:func:`merge_engine_status` — concurrent reconciler writes survive),
+    and retries — the one case where a per-object GET is the right cost,
+    because it happens per conflict, not per VA per tick. Other transient
+    errors keep the plain backoff retry; NotFound propagates (VA deleted).
+
+    ``read_alloc`` is the ``desired_optimized_alloc`` the caller READ
+    (snapshot/fresh GET) before computing its new status. It anchors the
+    stale-write guard: if the conflicting fresh status carries an alloc
+    both NEWER than the read (``last_run_time``) and MATERIALLY DIFFERENT
+    from it (replicas/accelerator), another engine made a real decision
+    off state we never saw (e.g. a scale-from-zero wake mid-tick) and our
+    write is dropped. A newer timestamp alone is NOT a newer decision —
+    the engine's heartbeat re-stamps ``last_run_time`` with unchanged
+    values, and a wake racing a heartbeat must still win its write. The
+    caller's own just-stamped ``last_run_time`` must NOT be the baseline —
+    it postdates any mid-tick wake by construction, so the guard would
+    never fire exactly when it matters.
+
+    Returns ``(va, persisted)``: ``persisted`` False means the write was
+    DROPPED in favor of the newer concurrent decision (the returned object
+    is the fresh read). Callers must not publish the dropped decision
+    onward (DecisionCache, reconcile triggers, audit events) — the
+    reconciler would otherwise re-apply from a fresh read exactly the
+    stale value the guard refused to write."""
+    if read_alloc is None:
+        read_alloc = va.status.desired_optimized_alloc
+    attempt = va
+    for _ in range(max_conflicts):
+        try:
+            return retry_with_backoff(
+                lambda: client.update_status(attempt),
+                retriable=lambda e: not isinstance(
+                    e, (NotFoundError, ConflictError)),
+                description=(f"update VA status "
+                             f"{va.metadata.namespace}/{va.metadata.name}"),
+            ), True
+        except ConflictError:
+            fresh = get_va_with_backoff(
+                client, va.metadata.name, va.metadata.namespace)
+            fresh_alloc = fresh.status.desired_optimized_alloc
+            if (fresh_alloc.last_run_time > read_alloc.last_run_time
+                    and (fresh_alloc.num_replicas, fresh_alloc.accelerator)
+                    != (read_alloc.num_replicas, read_alloc.accelerator)):
+                # A decision NEWER than the state this write was computed
+                # from landed mid-tick (scale-from-zero wake, or another
+                # engine's fresher tick): grafting our stale alloc over it
+                # would revert that decision. Drop the write; the next tick
+                # decides from the post-write state.
+                log.info("VA %s/%s: conflicting status carries a newer "
+                         "decision; dropping this stale write",
+                         va.metadata.namespace, va.metadata.name)
+                return fresh, False
+            attempt = merge_engine_status(fresh, va)
+    # Last conflicted attempt already refetched; one final try without the
+    # conflict guard so persistent contention surfaces as the real error.
+    return client.update_status(attempt), True
 
 
 def va_status_material(va: VariantAutoscaling) -> tuple:
