@@ -777,12 +777,16 @@ def tick_scale_bench(n_models: int = 48, variants_per_model: int = 2,
         eng.solver_batching = batching
         if not indexed_tsdb:
             # Reproduce the pre-change metrics substrate too: full-store
-            # scans per selector and a fresh parse per query string (this
-            # PR added the name index + AST cache alongside the engine
-            # levers, so the honest baseline turns them all off).
+            # scans per selector, a fresh parse per query string, the
+            # pre-ring read path (copy-under-one-lock + linear window
+            # scans), and per-model query fan-out (grouped collection off)
+            # — PRs 2 and 3 added these levers alongside the engine ones,
+            # so the honest baseline turns them all off.
             prom_api = mgr.source_registry.get("prometheus").api
             prom_api.engine.db.use_name_index = False
+            prom_api.engine.db.legacy_reads = True
             prom_api.engine.cache_asts = False
+            eng.grouped_collection = False
         for _ in range(3):  # warm: jit compile + caches out of the timings
             eng.optimize()
             clock.advance(5.0)
@@ -845,12 +849,178 @@ def tick_scale_bench(n_models: int = 48, variants_per_model: int = 2,
             serial["api_reads_per_tick_total"]
             / max(fleet["api_reads_per_tick_total"], 1e-9), 1),
         "levers": {
-            "fleet": "snapshot + indexed TSDB + cross-model solver batching"
-                     " (auto workers: serial on the in-memory backend,"
-                     " pooled against HTTP Prometheus)",
+            "fleet": "snapshot + indexed TSDB + grouped collection +"
+                     " cross-model solver batching (auto workers: serial on"
+                     " the in-memory backend, pooled against HTTP"
+                     " Prometheus)",
             "serial_pre_change":
                 "per-VA GETs, serial models, per-model solver dispatch,"
-                " unindexed TSDB scans (the seed tick)",
+                " per-model query fan-out, unindexed copy-under-lock TSDB"
+                " scans (the seed tick)",
+        },
+    }
+
+
+def collect_scale_bench(n_models: int = 48, measured_ticks: int = 10,
+                        readers: int = 8) -> dict:
+    """Metrics-plane microbench (``make bench-collect``), two axes
+    (docs/design/metrics-plane.md):
+
+    1. **Backend queries per tick** — a 48-model in-memory fleet tick with
+       grouped collection ON vs OFF, counted by the source's backend query
+       counters (not estimated): O(templates) vs O(models x templates).
+    2. **In-memory TSDB query latency under 8 concurrent readers** — the
+       ring-buffer read path (striped locks + bisect zero-copy windows) vs
+       the honest pre-change lever (``legacy_reads``: copy-under-one-lock
+       plus linear window scans with per-sample objects).
+    """
+    import statistics
+    import threading
+
+    from wva_tpu.collector.source import (
+        InMemoryPromAPI,
+        PrometheusSource,
+        RefreshSpec,
+        SourceRegistry,
+        TimeSeriesDB,
+    )
+    from wva_tpu.collector.registration import (
+        register_saturation_queries,
+        register_scale_to_zero_queries,
+        register_slo_queries,
+    )
+    from wva_tpu.collector.source.grouped import GroupedMetricsView
+    from wva_tpu.collector.source.promql import PromQLEngine
+    from wva_tpu.utils import FakeClock
+
+    ns = "bench"
+
+    def build_db(retention_filled: float = 3600.0, step: float = 5.0):
+        """48 models x 2 pods with a counter + gauges, retention fully
+        populated so range windows pay realistic scan costs."""
+        clock = FakeClock(start=200_000.0)
+        db = TimeSeriesDB(clock=clock)
+        now = clock.now()
+        for i in range(n_models):
+            model = f"org/bench-model-{i:03d}"
+            for v in range(2):
+                pod = {"pod": f"b{i:03d}-{v}", "namespace": ns,
+                       "model_name": model}
+                t = now - retention_filled
+                while t <= now:
+                    db.add_sample("vllm:request_success_total", pod,
+                                  4.0 * (t - 190_000.0), timestamp=t)
+                    t += step
+                db.add_sample("vllm:kv_cache_usage_perc", pod, 0.4,
+                              timestamp=now)
+                db.add_sample("vllm:num_requests_waiting", pod, 1,
+                              timestamp=now)
+                db.add_sample("vllm:cache_config_info",
+                              {**pod, "num_gpu_blocks": "4096",
+                               "block_size": "32"}, 1.0, timestamp=now)
+        return db, clock
+
+    # --- axis 1: backend queries per tick (grouped ON vs OFF) ---
+
+    def queries_per_tick(grouped: bool) -> dict:
+        db, clock = build_db(retention_filled=120.0)
+        registry = SourceRegistry()
+        src = PrometheusSource(InMemoryPromAPI(db), clock=clock)
+        registry.register("prometheus", src)
+        register_saturation_queries(registry)
+        register_scale_to_zero_queries(registry)
+        register_slo_queries(registry)
+        # One "tick" = the replica-collection queries every model refreshes
+        # (the engine's per-model collection surface, driven directly so
+        # the axis isolates the metrics plane from K8s/analyzer costs).
+        replica_queries = [
+            "kv_cache_usage", "queue_length", "cache_config_info",
+            "serving_config_info", "avg_output_tokens", "avg_input_tokens",
+            "prefix_cache_hit_rate", "generate_backlog", "slots_used",
+            "slots_available"]
+        walls = []
+        src.reset_query_counts()
+        for _ in range(measured_ticks):
+            view = GroupedMetricsView(src) if grouped else src
+            t0 = time.perf_counter()
+            for i in range(n_models):
+                view.refresh(RefreshSpec(
+                    queries=replica_queries,
+                    params={"modelID": f"org/bench-model-{i:03d}",
+                            "namespace": ns}))
+            walls.append(time.perf_counter() - t0)
+            clock.advance(5.0)
+        total = src.backend_query_total()
+        src.close()
+        walls.sort()
+        return {
+            "backend_queries_per_tick": round(total / measured_ticks, 1),
+            "collection_wall_p50_ms": round(
+                statistics.median(walls) * 1000.0, 2),
+        }
+
+    grouped_on = queries_per_tick(grouped=True)
+    grouped_off = queries_per_tick(grouped=False)
+
+    # --- axis 2: TSDB query p50 under concurrent readers ---
+
+    def tsdb_read_p50(legacy: bool) -> dict:
+        db, clock = build_db(retention_filled=3600.0)
+        db.legacy_reads = legacy
+        now = clock.now()
+        per_thread = 40
+        latencies: list[list[float]] = [[] for _ in range(readers)]
+
+        def read_loop(ti: int) -> None:
+            engine = PromQLEngine(db)
+            for j in range(per_thread):
+                model = f"org/bench-model-{(ti * per_thread + j) % n_models:03d}"
+                q = ('sum(rate(vllm:request_success_total{namespace="%s",'
+                     'model_name="%s"}[1m]))' % (ns, model))
+                t0 = time.perf_counter()
+                engine.query(q, at=now)
+                latencies[ti].append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=read_loop, args=(ti,))
+                   for ti in range(readers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        flat = sorted(x for lat in latencies for x in lat)
+        return {
+            "query_p50_ms": round(
+                statistics.median(flat) * 1000.0, 3),
+            "query_p99_ms": round(
+                flat[min(len(flat) - 1, int(len(flat) * 0.99))] * 1000.0, 3),
+            "total_wall_s": round(wall, 3),
+            "queries": len(flat),
+        }
+
+    ring = tsdb_read_p50(legacy=False)
+    legacy = tsdb_read_p50(legacy=True)
+
+    return {
+        "models": n_models,
+        "measured_ticks": measured_ticks,
+        "concurrent_readers": readers,
+        "grouped_on": grouped_on,
+        "grouped_off_per_model": grouped_off,
+        "query_reduction": round(
+            grouped_off["backend_queries_per_tick"]
+            / max(grouped_on["backend_queries_per_tick"], 1e-9), 1),
+        "tsdb_ring": ring,
+        "tsdb_legacy_pre_change": legacy,
+        "tsdb_p50_speedup": round(
+            legacy["query_p50_ms"] / max(ring["query_p50_ms"], 1e-9), 2),
+        "levers": {
+            "grouped_off_per_model": "GroupedMetricsView bypassed: one "
+                                     "backend query per (model, template)",
+            "tsdb_legacy_pre_change": "TimeSeriesDB.legacy_reads: "
+                                      "copy-under-one-lock + linear window "
+                                      "scans (the pre-ring read path)",
         },
     }
 
@@ -1145,6 +1315,24 @@ def tick_main() -> None:
     }))
 
 
+def collect_main() -> None:
+    """`make bench-collect`: metrics-plane microbench only (backend
+    queries/tick grouped ON vs OFF + in-memory TSDB p50 under concurrent
+    readers), merged into BENCH_LOCAL.json, one JSON line on stdout."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    record = collect_scale_bench()
+    record["bench_wall_seconds"] = round(time.time() - t0, 1)
+    _merge_bench_local("collect_scale", record)
+    print(json.dumps({
+        "metric": "metrics_plane_backend_queries_per_tick_48_models",
+        "value": record["grouped_on"]["backend_queries_per_tick"],
+        "unit": "backend_queries_per_tick",
+        "vs_baseline": record["query_reduction"],
+        "detail": record,
+    }))
+
+
 def main() -> None:
     t0 = time.time()
     device_probe = _ensure_healthy_device()
@@ -1260,5 +1448,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--tick-only" in sys.argv:
         tick_main()
+    elif "--collect-only" in sys.argv:
+        collect_main()
     else:
         main()

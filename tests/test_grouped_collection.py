@@ -1,0 +1,636 @@
+"""Grouped per-tick metrics collection (docs/design/metrics-plane.md):
+
+1. **Rewrite rules** — every registered template rewrites into a parseable
+   fleet-wide grouped query.
+2. **Equivalence** — for every template, the demuxed per-model slice is
+   byte-identical to the per-model query result across a multi-model,
+   multi-namespace, mixed-engine (vllm + jetstream) world.
+3. **Query budget** — a 48-model tick with grouping ON issues exactly ONE
+   backend query per collected template (vs ~10 per model), asserted via
+   the source's backend query counters; decisions/statuses/trace cycles
+   are byte-identical with grouping ON vs OFF.
+4. **Fallback + stale-serve** — a backend that rejects the grouped form
+   falls back to per-model collection automatically; demuxed slices cache
+   under per-model keys so outages stale-serve per model.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from wva_tpu.collector.registration import (
+    register_saturation_queries,
+    register_scale_to_zero_queries,
+    register_slo_queries,
+)
+from wva_tpu.collector.source import (
+    GroupedMetricsView,
+    InMemoryPromAPI,
+    PrometheusSource,
+    RefreshSpec,
+    SourceRegistry,
+    TimeSeriesDB,
+    build_grouped_query,
+)
+from wva_tpu.collector.source.promql import parse_query
+from wva_tpu.collector.source.source import PARAM_MODEL_ID, PARAM_NAMESPACE
+from wva_tpu.utils import FakeClock
+
+from test_tick_scale import NS, make_fleet_world
+
+MODELS = [("org/model-a", "ns1"), ("org/model-b", "ns1"),
+          ("org/model-c", "ns2")]
+
+
+def _build_sources():
+    """One TSDB with a rich multi-model world behind TWO independent
+    sources (so per-model and grouped runs never share a cache)."""
+    clock = FakeClock(start=10_000.0)
+    db = TimeSeriesDB(clock=clock)
+    t0 = 10_000.0
+    for mi, (model, ns) in enumerate(MODELS):
+        for pi in range(2):
+            pod = {"pod": f"m{mi}-{pi}", "namespace": ns,
+                   "model_name": model}
+            if pi == 0:  # vllm engine family
+                db.add_sample("vllm:kv_cache_usage_perc", pod,
+                              0.3 + 0.1 * mi, timestamp=t0)
+                db.add_sample("vllm:num_requests_waiting", pod, 1 + mi,
+                              timestamp=t0)
+                db.add_sample("vllm:cache_config_info",
+                              {**pod, "num_gpu_blocks": "4096",
+                               "block_size": "32"}, 1.0, timestamp=t0)
+                for i in range(7):
+                    ts = t0 - 300 + i * 50
+                    db.add_sample("vllm:request_success_total", pod,
+                                  (mi + 1) * i * 10.0, timestamp=ts)
+                    db.add_sample("vllm:time_to_first_token_seconds_sum",
+                                  pod, i * 0.2 * (mi + 1), timestamp=ts)
+                    db.add_sample("vllm:time_to_first_token_seconds_count",
+                                  pod, float(i), timestamp=ts)
+                    db.add_sample("vllm:time_per_output_token_seconds_sum",
+                                  pod, i * 0.02, timestamp=ts)
+                    db.add_sample("vllm:time_per_output_token_seconds_count",
+                                  pod, float(i), timestamp=ts)
+                    db.add_sample("vllm:request_prompt_tokens_sum", pod,
+                                  i * 512.0, timestamp=ts)
+                    db.add_sample("vllm:request_prompt_tokens_count", pod,
+                                  float(i), timestamp=ts)
+                    db.add_sample("vllm:prefix_cache_hits", pod, i * 3.0,
+                                  timestamp=ts)
+                    db.add_sample("vllm:prefix_cache_queries", pod,
+                                  i * 4.0, timestamp=ts)
+            else:  # jetstream engine family
+                db.add_sample("jetstream_kv_cache_utilization", pod,
+                              0.5 + 0.05 * mi, timestamp=t0)
+                db.add_sample("jetstream_prefill_backlog_size", pod,
+                              2 * mi, timestamp=t0)
+                db.add_sample("jetstream_slots_used", pod, 10 + mi,
+                              timestamp=t0)
+                db.add_sample("jetstream_slots_available", pod, 86 - mi,
+                              timestamp=t0)
+                db.add_sample("jetstream_serving_config_info",
+                              {**pod, "max_concurrent_decodes": "96",
+                               "tokens_per_slot": "1365"}, 1.0,
+                              timestamp=t0)
+                for i in range(7):
+                    ts = t0 - 300 + i * 50
+                    db.add_sample("jetstream_request_success_total", pod,
+                                  (mi + 2) * i * 5.0, timestamp=ts)
+        # Scheduler flow-control: model-a via target_model_name, model-b via
+        # the model_name fallback (empty target), model-c via BOTH (the
+        # or-preference case: target_model_name must win).
+        if model.endswith("-a") or model.endswith("-c"):
+            db.add_sample("inference_extension_flow_control_queue_size",
+                          {"target_model_name": model}, 5.0 + mi,
+                          timestamp=t0)
+            db.add_sample("inference_extension_flow_control_queue_bytes",
+                          {"target_model_name": model}, 1000.0 * (mi + 1),
+                          timestamp=t0)
+        if model.endswith("-b") or model.endswith("-c"):
+            db.add_sample("inference_extension_flow_control_queue_size",
+                          {"model_name": model, "target_model_name": ""},
+                          99.0, timestamp=t0)
+            db.add_sample("inference_extension_flow_control_queue_bytes",
+                          {"model_name": model, "target_model_name": ""},
+                          9999.0, timestamp=t0)
+
+    def make_source():
+        registry = SourceRegistry()
+        src = PrometheusSource(InMemoryPromAPI(db), clock=clock)
+        registry.register("prometheus", src)
+        register_saturation_queries(registry)
+        register_scale_to_zero_queries(registry)
+        register_slo_queries(registry)
+        return src
+
+    return make_source(), make_source(), clock
+
+
+def _encode(result) -> str:
+    return json.dumps({
+        "query_name": result.query_name,
+        "collected_at": result.collected_at,
+        "error": result.error,
+        "values": [{"value": v.value, "timestamp": v.timestamp,
+                    "labels": v.labels} for v in result.values],
+    }, sort_keys=True)
+
+
+def test_every_registered_template_is_groupable():
+    src, _, _ = _build_sources()
+    ql = src.query_list()
+    for name in ql.names():
+        template = ql.get(name)
+        extras = {p: "30m" for p in template.params
+                  if p not in (PARAM_MODEL_ID, PARAM_NAMESPACE)}
+        gq = build_grouped_query(template, extras)
+        assert gq is not None, f"template {name} must be groupable"
+        parse_query(gq.promql)  # round-trips through the subset grammar
+        assert gq.branches, name
+
+
+def test_grouped_results_byte_identical_to_per_model():
+    """For EVERY registered template and EVERY model, the demuxed slice
+    equals the per-model query result — values, labels, timestamps and
+    collected_at."""
+    grouped_src, plain_src, clock = _build_sources()
+    view = GroupedMetricsView(grouped_src)
+    ql = plain_src.query_list()
+    for name in ql.names():
+        template = ql.get(name)
+        for model, ns in MODELS:
+            params = {PARAM_MODEL_ID: model}
+            if PARAM_NAMESPACE in template.params:
+                params[PARAM_NAMESPACE] = ns
+            for p in template.params:
+                params.setdefault(p, "30m")  # retentionPeriod etc.
+            spec = RefreshSpec(queries=[name], params=params)
+            plain = plain_src.refresh(spec)[name]
+            grouped = view.refresh(spec)[name]
+            assert _encode(grouped) == _encode(plain), \
+                f"{name} diverged for {model}/{ns}"
+
+
+def test_scheduler_or_preference_survives_grouping():
+    """model-c exposes BOTH the target_model_name series and the legacy
+    model_name fallback series; per-model `or` suppresses the fallback, and
+    the grouped demux must too."""
+    grouped_src, plain_src, _ = _build_sources()
+    view = GroupedMetricsView(grouped_src)
+    spec = RefreshSpec(queries=["scheduler_queue_size"],
+                       params={PARAM_MODEL_ID: "org/model-c"})
+    plain = plain_src.refresh(spec)["scheduler_queue_size"]
+    grouped = view.refresh(spec)["scheduler_queue_size"]
+    assert plain.values[0].value == 7.0  # target series, NOT the 99 fallback
+    assert _encode(grouped) == _encode(plain)
+
+
+def test_grouped_issues_one_backend_query_per_template():
+    grouped_src, _, _ = _build_sources()
+    view = GroupedMetricsView(grouped_src)
+    grouped_src.reset_query_counts()
+    queries = ["kv_cache_usage", "queue_length", "model_arrival_rate"]
+    for model, ns in MODELS:
+        view.refresh(RefreshSpec(
+            queries=queries,
+            params={PARAM_MODEL_ID: model, PARAM_NAMESPACE: ns}))
+    counts = grouped_src.query_counts()
+    assert counts == {f"grouped:{q}": 1 for q in queries}
+
+
+def test_grouped_fallback_when_backend_rejects():
+    """A backend erroring on the grouped form must not lose data: the view
+    falls back to per-model queries (same results), notes the rejection,
+    and later refreshes skip the grouped attempt entirely."""
+    grouped_src, plain_src, _ = _build_sources()
+
+    real_query = grouped_src.api.query
+
+    def rejecting(promql):
+        if 'model_name!=""' in promql or 'target_model_name!=""' in promql:
+            # The shape HTTPPromAPI raises for a backend "status: error"
+            # payload — a DETERMINISTIC rejection, so it pins.
+            raise RuntimeError("prometheus query failed: query too complex")
+        return real_query(promql)
+
+    grouped_src.api.query = rejecting
+    view = GroupedMetricsView(grouped_src)
+    spec = RefreshSpec(queries=["kv_cache_usage"],
+                       params={PARAM_MODEL_ID: "org/model-a",
+                               PARAM_NAMESPACE: "ns1"})
+    grouped = view.refresh(spec)["kv_cache_usage"]
+    plain = plain_src.refresh(spec)["kv_cache_usage"]
+    assert not grouped.has_error()
+    assert _encode(grouped) == _encode(plain)
+    # Rejection is sticky: the next view doesn't even try the grouped form.
+    grouped_src.reset_query_counts()
+    GroupedMetricsView(grouped_src).refresh(spec)
+    counts = grouped_src.query_counts()
+    assert "grouped:kv_cache_usage" not in counts
+    assert counts.get("kv_cache_usage") == 1
+
+
+def test_transient_backend_error_does_not_pin_grouped_off():
+    """A one-off timeout/connection error falls back per-model for THAT
+    tick only — pinning on a transient would amplify load ~models-fold
+    against a recovering backend for the whole retry window."""
+    import urllib.error
+
+    grouped_src, plain_src, _ = _build_sources()
+    real_query = grouped_src.api.query
+    blip = {"on": True}
+
+    def flaky(promql):
+        if blip["on"] and 'model_name!=""' in promql:
+            raise urllib.error.URLError("connection reset")
+        return real_query(promql)
+
+    grouped_src.api.query = flaky
+    spec = RefreshSpec(queries=["kv_cache_usage"],
+                       params={PARAM_MODEL_ID: "org/model-a",
+                               PARAM_NAMESPACE: "ns1"})
+    served = GroupedMetricsView(grouped_src).refresh(spec)["kv_cache_usage"]
+    assert not served.has_error()  # per-model fallback served the tick
+    blip["on"] = False
+    grouped_src.reset_query_counts()
+    next_tick = GroupedMetricsView(grouped_src).refresh(spec)
+    assert grouped_src.query_counts() == {"grouped:kv_cache_usage": 1}
+    assert _encode(next_tick["kv_cache_usage"]) == \
+        _encode(plain_src.refresh(spec)["kv_cache_usage"])
+
+
+def test_demuxed_slices_stale_serve_per_model():
+    """Demuxed slices land in the per-model cache: when the backend dies
+    entirely next tick, each model stale-serves ITS OWN last good slice."""
+    grouped_src, _, clock = _build_sources()
+    view = GroupedMetricsView(grouped_src)
+    spec_a = RefreshSpec(queries=["kv_cache_usage"],
+                         params={PARAM_MODEL_ID: "org/model-a",
+                                 PARAM_NAMESPACE: "ns1"})
+    spec_b = RefreshSpec(queries=["kv_cache_usage"],
+                         params={PARAM_MODEL_ID: "org/model-b",
+                                 PARAM_NAMESPACE: "ns1"})
+    good_a = view.refresh(spec_a)["kv_cache_usage"]
+    assert good_a.values
+
+    def down(_):
+        raise RuntimeError("prometheus down")
+
+    grouped_src.api.query = down
+    clock.advance(60.0)
+    tick2 = GroupedMetricsView(grouped_src)
+    served_a = tick2.refresh(spec_a)["kv_cache_usage"]
+    served_b = tick2.refresh(spec_b)["kv_cache_usage"]
+    assert not served_a.has_error()
+    assert _encode(served_a) == _encode(good_a)  # model-a's own slice
+    # model-b was demuxed + cached by model-a's grouped tick even though
+    # nobody asked for it then — per-model stale-serve still works.
+    assert not served_b.has_error()
+    assert {v.labels.get("pod") for v in served_b.values} == {"m1-0", "m1-1"}
+
+
+def test_requested_model_with_no_data_gets_empty_result_not_stale():
+    grouped_src, plain_src, _ = _build_sources()
+    view = GroupedMetricsView(grouped_src)
+    spec = RefreshSpec(queries=["kv_cache_usage"],
+                       params={PARAM_MODEL_ID: "org/ghost-model",
+                               PARAM_NAMESPACE: "ns1"})
+    grouped = view.refresh(spec)["kv_cache_usage"]
+    plain = plain_src.refresh(spec)["kv_cache_usage"]
+    assert grouped.values == [] and not grouped.has_error()
+    assert _encode(grouped) == _encode(plain)
+
+
+# --- fleet-scale query budget + determinism (mirrors PR 2's request-budget
+# and byte-identity tests, on the metrics plane) ---
+
+
+# The 10 templates one V1 tick's replica collection refreshes per model.
+REPLICA_TEMPLATES = (
+    "kv_cache_usage", "queue_length", "cache_config_info",
+    "serving_config_info", "avg_output_tokens", "avg_input_tokens",
+    "prefix_cache_hit_rate", "generate_backlog", "slots_used",
+    "slots_available",
+)
+
+
+def _prom_source(mgr):
+    return mgr.source_registry.get("prometheus")
+
+
+def test_48_model_tick_issues_one_query_per_template():
+    """The headline budget: a 48-model fleet tick with grouped collection
+    ON costs exactly ONE backend query per collected template — not one
+    per (model, template)."""
+    mgr, cluster, tsdb, clock = make_fleet_world(48)
+    mgr.run_once()  # warm (reconciler paths, snapshot, caches)
+    src = _prom_source(mgr)
+    src.reset_query_counts()
+    mgr.engine.optimize()
+    counts = src.query_counts()
+    assert counts == {f"grouped:{t}": 1 for t in REPLICA_TEMPLATES}
+    assert src.backend_query_total() == len(REPLICA_TEMPLATES)
+    mgr.shutdown()
+
+
+def test_grouped_off_pays_per_model_fanout():
+    """The compat lever reproduces the pre-change fan-out (guards the
+    bench-collect reduction claim's denominator)."""
+    n = 5
+    mgr, cluster, tsdb, clock = make_fleet_world(n)
+    mgr.engine.grouped_collection = False
+    mgr.run_once()
+    src = _prom_source(mgr)
+    src.reset_query_counts()
+    mgr.engine.optimize()
+    counts = src.query_counts()
+    assert counts == {t: n for t in REPLICA_TEMPLATES}
+    mgr.shutdown()
+
+
+def _run_fleet(grouped: bool, n: int = 6, ticks: int = 3):
+    from wva_tpu.blackbox.schema import encode
+    from wva_tpu.engines import common
+
+    common.DecisionCache.clear()
+    while not common.DecisionTrigger.empty():
+        common.DecisionTrigger.get_nowait()
+    mgr, cluster, tsdb, clock = make_fleet_world(
+        n, kv=0.78, queue=2, trace=True)
+    mgr.engine.grouped_collection = grouped
+    for _ in range(ticks):
+        mgr.run_once()
+        clock.advance(5.0)
+    mgr.flight_recorder.flush()
+    cycles = mgr.flight_recorder.snapshot()
+    statuses = {
+        va.metadata.name: encode(va.status)
+        for va in cluster.list("VariantAutoscaling", namespace=NS)}
+    mgr.shutdown()
+    return cycles, statuses
+
+
+def test_decisions_byte_identical_grouped_on_vs_off():
+    """Grouping must not change ONE byte of the engine's outputs: VA
+    statuses and flight-recorder cycle records (which embed every replica
+    metric and analyzer input) compare equal as canonical JSON."""
+    on_cycles, on_statuses = _run_fleet(grouped=True)
+    off_cycles, off_statuses = _run_fleet(grouped=False)
+
+    assert len(on_cycles) > 0 and on_statuses
+
+    def dumps(x):
+        return json.dumps(x, sort_keys=True, separators=(",", ":"))
+
+    assert dumps(on_statuses) == dumps(off_statuses)
+    assert len(on_cycles) == len(off_cycles)
+    for a, b in zip(on_cycles, off_cycles):
+        assert dumps(a) == dumps(b)
+
+
+def test_warmer_re_executes_grouped_specs_and_refreshes_slices():
+    """With grouped collection on, per-model specs never reach refresh(),
+    so the warmer must re-execute the remembered fleet-wide queries —
+    refreshing every demuxed per-model cache slice — and grouped specs
+    must expire without organic re-serves (warming never renews)."""
+    grouped_src, _, clock = _build_sources()
+    view = GroupedMetricsView(grouped_src)
+    spec = RefreshSpec(queries=["kv_cache_usage"],
+                       params={PARAM_MODEL_ID: "org/model-a",
+                               PARAM_NAMESPACE: "ns1"})
+    view.refresh(spec)
+    grouped_src.reset_query_counts()
+    clock.advance(30.0)
+    assert grouped_src.background_fetch_once() == 1
+    assert grouped_src.query_counts() == {"grouped:kv_cache_usage": 1}
+    # The warm pass refreshed OTHER models' slices too (cache age reset).
+    cached_b = grouped_src.get("kv_cache_usage",
+                               {PARAM_MODEL_ID: "org/model-b",
+                                PARAM_NAMESPACE: "ns1"})
+    assert cached_b is not None and cached_b.age(clock) == 0.0
+    # Warming must not renew the spec: it expires without organic serves.
+    clock.advance(grouped_src.SPEC_EXPIRY_SECONDS + 1)
+    assert grouped_src.background_fetch_once() == 0
+
+
+def test_parallel_cache_warmer_refreshes_all_specs_without_renewal():
+    """The warmer fans specs across its pool (concurrent sources) and its
+    refreshes still don't count as organic sightings."""
+    clock = FakeClock(start=1000.0)
+    db = TimeSeriesDB(clock=clock)
+    db.add_sample("m1", {"a": "b"}, 7.0)
+    src = PrometheusSource(InMemoryPromAPI(db), clock=clock, concurrent=True)
+    from wva_tpu.collector.source import QueryTemplate
+
+    src.query_list().register(QueryTemplate(name="q", template="m1",
+                                            params=["modelID"]))
+    for i in range(6):
+        src.refresh(RefreshSpec(queries=["q"],
+                                params={"modelID": f"m{i}"}))
+    assert src.background_fetch_once() == 6
+    # Warm refreshes must not renew seen_at (thread-local flag holds on
+    # whichever warm-pool thread ran the task).
+    clock.advance(src.SPEC_EXPIRY_SECONDS + 1)
+    assert src.background_fetch_once() == 0
+    src.close()
+
+
+def test_scoped_controller_keeps_namespace_equality_matcher():
+    """A watch-namespace-scoped controller on a shared multi-tenant
+    Prometheus must not aggregate other tenants' series: the grouped query
+    keeps namespace="<scope>" instead of the fleet-wide presence guard,
+    and scoped results still match the per-model path byte-for-byte."""
+    grouped_src, plain_src, _ = _build_sources()
+    view = GroupedMetricsView(grouped_src, scope_namespace="ns1")
+
+    issued: list[str] = []
+    real_query = grouped_src.api.query
+
+    def recording(promql):
+        issued.append(promql)
+        return real_query(promql)
+
+    grouped_src.api.query = recording
+    for model, ns in MODELS:
+        if ns != "ns1":
+            continue  # a scoped controller only ever asks about its scope
+        spec = RefreshSpec(queries=["kv_cache_usage"],
+                           params={PARAM_MODEL_ID: model,
+                                   PARAM_NAMESPACE: ns})
+        grouped = view.refresh(spec)["kv_cache_usage"]
+        plain = plain_src.refresh(spec)["kv_cache_usage"]
+        assert _encode(grouped) == _encode(plain)
+    assert len(issued) == 1  # still ONE fleet query for both ns1 models
+    assert 'namespace="ns1"' in issued[0]
+    assert 'namespace!=""' not in issued[0]
+
+
+def test_scalar_and_vector_operands_are_not_groupable():
+    """`vector(N)` parses into a bare scalar, which serialization would
+    mangle and real Prometheus rejects as an `or` operand — such templates
+    must stay on the per-model path, not ping-pong off sticky rejections."""
+    from wva_tpu.collector.source import QueryTemplate
+
+    template = QueryTemplate(
+        name="q_vec",
+        template=('sum(rate(m{namespace="{{.namespace}}",'
+                  'model_name="{{.modelID}}"}[1m])) or vector(0)'),
+        params=[PARAM_NAMESPACE, PARAM_MODEL_ID])
+    assert build_grouped_query(template, {}) is None
+
+
+def test_post_degrade_guard_uses_request_verb_not_shared_flag():
+    """Concurrent queries race the POST→GET degrade flip: a request whose
+    POST 405s after ANOTHER thread already flipped use_get must still
+    retry via GET (the guard tests the verb this request sent)."""
+    import urllib.error
+
+    from wva_tpu.collector.source import HTTPPromAPI
+
+    api = HTTPPromAPI("http://prom.invalid")
+    calls: list[bool] = []
+
+    def fake_request(promql, use_get):
+        calls.append(use_get)
+        if not use_get:
+            # Simulate the race: a concurrent thread's fallback flipped
+            # the shared flag while our POST was in flight.
+            api.use_get = True
+            raise urllib.error.HTTPError("u", 405, "method not allowed",
+                                         None, None)
+        return {"status": "success",
+                "data": {"resultType": "vector", "result": []}}
+
+    api._request = fake_request
+    assert api.query("vector(1)") == []  # retried via GET, did not raise
+    assert calls == [False, True]
+
+
+def test_enforcer_request_count_rides_the_tick_view():
+    """Scale-to-zero enforcement's per-model request counts collapse into
+    the same fleet-wide grouped query as everything else when the engine
+    hands the enforcer its tick view."""
+    from wva_tpu.collector.registration.scale_to_zero import (
+        collect_model_request_count,
+    )
+    from wva_tpu.config.types import ModelScaleToZeroConfig
+    from wva_tpu.pipeline import Enforcer
+
+    grouped_src, _, _ = _build_sources()
+    view = GroupedMetricsView(grouped_src)
+
+    def request_count(model_id, namespace, retention, source=None):
+        return collect_model_request_count(
+            source or grouped_src, model_id, namespace, retention)
+
+    request_count.supports_source = True
+    enforcer = Enforcer(request_count)
+    enforcer.metrics_source = view
+    grouped_src.reset_query_counts()
+    for model, ns in MODELS:
+        s2z = {model: ModelScaleToZeroConfig(
+            model_id=model, namespace=ns, enable_scale_to_zero=True,
+            retention_period="30m")}
+        targets, applied = enforcer.enforce_policy(
+            model, ns, {"v": 1}, [], s2z)
+        assert not applied  # every model served requests in the window
+    assert grouped_src.query_counts() == {"grouped:model_request_count": 1}
+
+
+def test_http_api_posts_form_body_and_degrades_to_get_on_405():
+    """POST is the default query verb (grouped queries exceed URL limits);
+    a GET-only backend 405s the first POST and the API handle degrades to
+    GET permanently, retrying in place. Runs over plain HTTP so it
+    executes in containers without `cryptography` (the TLS twin lives in
+    test_prometheus_tls.py)."""
+    import http.server
+    import json as _json
+    import threading
+    import urllib.parse as _up
+
+    from wva_tpu.collector.source import HTTPPromAPI
+
+    seen: list[tuple[str, str]] = []
+    reject_post = {"on": False}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: A003
+            pass
+
+        def _respond(self, method):
+            if method == "POST":
+                length = int(self.headers.get("Content-Length") or 0)
+                form = _up.parse_qs(self.rfile.read(length).decode())
+            else:
+                form = _up.parse_qs(_up.urlparse(self.path).query)
+            seen.append((method, (form.get("query") or [""])[0]))
+            if method == "POST" and reject_post["on"]:
+                self.send_response(405)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = _json.dumps({
+                "status": "success",
+                "data": {"resultType": "vector",
+                         "result": [{"metric": {"pod": "p0"},
+                                     "value": [1.0, "42"]}]}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            self._respond("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._respond("POST")
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        api = HTTPPromAPI(url)
+        assert api.query('sum(up{job="x y"})')[0].value == 42.0
+        assert seen[-1] == ("POST", 'sum(up{job="x y"})')
+
+        reject_post["on"] = True
+        api2 = HTTPPromAPI(url)
+        assert api2.query("vector(1)")[0].value == 42.0  # retried via GET
+        assert [m for m, _ in seen[-2:]] == ["POST", "GET"]
+        assert api2.use_get
+        api2.query("vector(1)")  # straight to GET now
+        assert seen[-1][0] == "GET"
+
+        api3 = HTTPPromAPI(url, use_get=True)
+        api3.query("vector(1)")
+        assert seen[-1] == ("GET", "vector(1)")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_epp_scrape_memo_collapses_per_pool():
+    from wva_tpu.engines.common.epp import ScrapeMemo, scrape_pool
+
+    calls = {"n": 0}
+
+    class FakeSource:
+        def refresh(self, spec):
+            calls["n"] += 1
+            from wva_tpu.collector.source import MetricResult
+            return {"all_metrics": MetricResult(query_name="all_metrics")}
+
+    class FakeDatastore:
+        def pool_get_metrics_source(self, name):
+            return FakeSource()
+
+    memo = ScrapeMemo()
+    ds = FakeDatastore()
+    for _ in range(5):
+        scrape_pool(ds, "pool-a", memo=memo)
+    scrape_pool(ds, "pool-b", memo=memo)
+    assert calls["n"] == 2  # one scrape per pool, not per caller

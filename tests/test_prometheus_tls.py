@@ -94,22 +94,44 @@ class _TLSPromServer:
     """Minimal HTTPS /api/v1/query server with optional client-cert
     requirement and Authorization capture."""
 
-    def __init__(self, pki, require_client_cert=False):
+    def __init__(self, pki, require_client_cert=False, reject_post=False):
         self.seen_auth: list[str] = []
+        self.seen_requests: list[tuple[str, str]] = []  # (method, query)
+        self.reject_post = reject_post
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # noqa: A003
                 pass
 
-            def do_GET(self):  # noqa: N802
+            def _respond(self, method):
+                import urllib.parse as _up
+
                 outer.seen_auth.append(self.headers.get("Authorization", ""))
+                if method == "POST":
+                    length = int(self.headers.get("Content-Length") or 0)
+                    form = _up.parse_qs(self.rfile.read(length).decode())
+                else:
+                    form = _up.parse_qs(_up.urlparse(self.path).query)
+                outer.seen_requests.append(
+                    (method, (form.get("query") or [""])[0]))
+                if method == "POST" and outer.reject_post:
+                    self.send_response(405)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 body = json.dumps(VECTOR_PAYLOAD).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                self._respond("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._respond("POST")
 
         self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -229,3 +251,36 @@ class TestTokenPath:
                           token_path=str(token_file))
         api.query("vector(1)")
         assert server.seen_auth[-1] == "Bearer direct"
+
+
+class TestQueryVerb:
+    def test_default_posts_form_encoded_body(self, pki, server):
+        """POST is the default: fleet-wide grouped queries can exceed URL
+        limits as GET query strings (real Prometheus accepts both)."""
+        api = HTTPPromAPI(server.url, ca_cert_path=pki["ca_cert"])
+        api.query('sum(up{job="x y"})')
+        method, query = server.seen_requests[-1]
+        assert method == "POST"
+        assert query == 'sum(up{job="x y"})'  # form-decoding round-trips
+
+    def test_use_get_restores_url_queries(self, pki, server):
+        api = HTTPPromAPI(server.url, ca_cert_path=pki["ca_cert"],
+                          use_get=True)
+        api.query("vector(1)")
+        method, query = server.seen_requests[-1]
+        assert method == "GET"
+        assert query == "vector(1)"
+
+    def test_405_on_post_auto_degrades_to_get(self, pki):
+        """A GET-only proxy must not black out metrics: the first 405 flips
+        the API handle to GET permanently and retries in place."""
+        s = _TLSPromServer(pki, reject_post=True)
+        try:
+            api = HTTPPromAPI(s.url, ca_cert_path=pki["ca_cert"])
+            assert api.query("vector(1)")[0].value == 42.0  # served via GET
+            assert [m for m, _ in s.seen_requests] == ["POST", "GET"]
+            api.query("vector(1)")  # subsequent queries go straight to GET
+            assert s.seen_requests[-1][0] == "GET"
+            assert api.use_get
+        finally:
+            s.close()

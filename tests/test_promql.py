@@ -239,16 +239,14 @@ class TestEngineCoverage:
 
     def test_retention_trims_old_samples(self):
         db = TimeSeriesDB(retention=100.0)
-        # Trim fires on every 256th append; write past it with old data.
         for i in range(300):
             db.add_sample("m", {}, float(i), timestamp=float(i))
         (_, samples), = db.matching_series([("__name__", "=", "m")])
-        # Trimming is lazy (once per 256 appends): the oldest retained
-        # sample honors the retention as of the LAST trim pass, i.e. one
-        # cycle of slack, never unbounded growth.
-        assert len(samples) < 300
-        assert samples[0].timestamp >= 299.0 - 100.0 - 256.0
-        assert samples[0].timestamp == 155.0  # cutoff at append #256
+        # Per-append trim: the live window NEVER holds anything older than
+        # the retention (the old `len % 256` gate left up to a cycle of
+        # slack — and never fired again once writes stopped).
+        assert samples[0].timestamp == 199.0  # exactly now - retention
+        assert len(samples) == 101
 
     def test_range_selector_without_function_is_an_error(self, tsdb):
         tsdb.add_sample("m", {}, 1.0, timestamp=100.0)
@@ -258,3 +256,114 @@ class TestEngineCoverage:
     def test_unknown_function_is_an_error(self, tsdb):
         with pytest.raises(PromQLError):
             PromQLEngine(tsdb).query("histogram_quantile(0.9, m)")
+
+
+class TestRingBufferStore:
+    """Ring-buffer storage regressions (docs/design/metrics-plane.md): trim
+    after write quiescence, bounded memory under sustained ingest, and
+    zero-copy window stability under concurrent appends/compaction."""
+
+    def test_trim_after_quiescence_via_sweep(self):
+        """A series whose writes STOP must not pin memory: the old
+        `len % 256 == 0` gate never fired again after the last append, so
+        a long emulator run leaked every quiet series forever. Any ongoing
+        ingest (other series) now sweeps quiescent ones on a time gate."""
+        from wva_tpu.utils import FakeClock
+
+        clock = FakeClock(start=0.0)
+        db = TimeSeriesDB(clock=clock, retention=100.0)
+        # Quiet series: 300 samples, then writes stop at t=299 — note 300 is
+        # NOT a multiple of 256, the old gate's worst case.
+        for i in range(300):
+            db.add_sample("quiet", {}, float(i), timestamp=float(i))
+        # Unrelated ingest far past the quiet series' retention horizon.
+        for t in range(300, 900, 10):
+            clock.set(float(t))
+            db.add_sample("busy", {}, 1.0, timestamp=float(t))
+        # The periodic sweep (triggered by busy's ingest) dropped the quiet
+        # series entirely: every sample aged out and no write renewed it.
+        assert db.matching_series([("__name__", "=", "quiet")]) == []
+        assert db.live_sample_count() <= 11  # just busy's retained window
+
+    def test_explicit_sweep_drops_expired_series(self):
+        db = TimeSeriesDB(retention=50.0)
+        db.add_sample("m", {"pod": "p"}, 1.0, timestamp=10.0)
+        assert db.sweep(1000.0) == 1
+        assert db.matching_series([("__name__", "=", "m")]) == []
+
+    def test_memory_bounded_under_sustained_ingest(self):
+        """The live region never exceeds the retention window no matter how
+        long ingest runs, and dead prefixes are compacted away (bounded
+        backing arrays, no pop(0))."""
+        db = TimeSeriesDB(retention=100.0)
+        for i in range(5000):
+            db.add_sample("m", {}, float(i), timestamp=float(i))
+        (_, samples), = db.matching_series([("__name__", "=", "m")])
+        assert len(samples) == 101
+        # Backing array bounded too: compaction keeps dead prefix < half.
+        series = next(iter(db._series.values()))
+        assert len(series.ts) <= 2 * (len(samples) + db.COMPACT_MIN_DEAD)
+
+    def test_window_snapshot_survives_concurrent_append_and_compaction(self):
+        db = TimeSeriesDB(retention=100.0)
+        for i in range(400):
+            db.add_sample("m", {}, float(i), timestamp=float(i))
+        (_, window), = db.matching_series([("__name__", "=", "m")])
+        before = [(s.timestamp, s.value) for s in window]
+        # Heavy post-snapshot ingest forces trims AND compactions.
+        for i in range(400, 3000):
+            db.add_sample("m", {}, float(i), timestamp=float(i))
+        assert [(s.timestamp, s.value) for s in window] == before
+
+    def test_concurrent_readers_and_writers(self):
+        """Striped locks: 8 readers against a live writer never crash or
+        observe torn windows (timestamps stay sorted, values consistent)."""
+        import threading
+
+        db = TimeSeriesDB(retention=1000.0)
+        for i in range(200):
+            db.add_sample("m", {"pod": f"p{i % 4}"}, float(i),
+                          timestamp=float(i))
+        stop = threading.Event()
+        errors: list[str] = []
+
+        def writer():
+            i = 200
+            while not stop.is_set():
+                db.add_sample("m", {"pod": f"p{i % 4}"}, float(i),
+                              timestamp=float(i))
+                i += 1
+
+        def reader():
+            eng = PromQLEngine(db)
+            while not stop.is_set():
+                for _, w in db.matching_series([("__name__", "=", "m")]):
+                    ts = [s.timestamp for s in w]
+                    if ts != sorted(ts):
+                        errors.append("unsorted window")
+                eng.query("max by (pod) (max_over_time(m[5m]))",
+                          at=db.clock.now())
+
+        threads = [threading.Thread(target=writer)] + \
+            [threading.Thread(target=reader) for _ in range(8)]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert errors == []
+
+    def test_legacy_reads_lever_still_correct(self):
+        """`legacy_reads` (bench-collect's pre-change lever) returns the
+        same data through the old copy-under-one-lock shape."""
+        db = TimeSeriesDB(retention=100.0)
+        for i in range(50):
+            db.add_sample("m", {"pod": "p"}, float(i), timestamp=float(i))
+        (_, fast), = db.matching_series([("__name__", "=", "m")])
+        db.legacy_reads = True
+        (_, legacy), = db.matching_series([("__name__", "=", "m")])
+        assert [(s.timestamp, s.value) for s in fast] == \
+            [(s.timestamp, s.value) for s in legacy]

@@ -109,6 +109,14 @@ class ReplicaMetricsCollector:
         # (reference source.go staleness helpers).
         self.freshness = freshness or FreshnessThresholds()
 
+    def scoped(self, source: MetricsSource) -> "ReplicaMetricsCollector":
+        """A collector bound to a different source view — the engine hands
+        each tick a collector over its tick-scoped GroupedMetricsView while
+        the mapper/clock/freshness config stay shared."""
+        return ReplicaMetricsCollector(source, self.pod_va_mapper,
+                                       clock=self.clock,
+                                       freshness=self.freshness)
+
     def collect_replica_metrics(
         self,
         model_id: str,

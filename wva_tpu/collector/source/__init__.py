@@ -17,6 +17,11 @@ from wva_tpu.collector.source.query_template import (
     escape_promql_value,
 )
 from wva_tpu.collector.source.cache import CachedValue, MetricsCache, cache_key
+from wva_tpu.collector.source.grouped import (
+    GroupedMetricsView,
+    GroupedQuery,
+    build_grouped_query,
+)
 from wva_tpu.collector.source.registry import PROMETHEUS_SOURCE_NAME, SourceRegistry
 from wva_tpu.collector.source.prometheus import (
     HTTPPromAPI,
@@ -57,6 +62,9 @@ __all__ = [
     "CachedValue",
     "MetricsCache",
     "cache_key",
+    "GroupedMetricsView",
+    "GroupedQuery",
+    "build_grouped_query",
     "PROMETHEUS_SOURCE_NAME",
     "SourceRegistry",
     "HTTPPromAPI",

@@ -1,0 +1,421 @@
+"""Grouped per-tick metrics collection (docs/design/metrics-plane.md).
+
+Every model used to issue its own ~10 templated Prometheus queries per
+engine tick, so a 48-model fleet fired ~480 HTTP queries per 5s tick —
+exactly the per-job fan-out Autopilot (Rzadca et al., EuroSys 2020)
+collapses into shared signal collection. This module makes the metrics
+plane O(query templates) per tick instead of O(models x templates):
+
+- :func:`build_grouped_query` rewrites a registered per-model template into
+  ONE fleet-wide query by parsing it (the bundled PromQL-subset parser),
+  dropping the ``model_name="..."``/``namespace="..."`` equality matchers
+  (replaced by ``label!=""`` presence guards so series without the label
+  never leak in), adding those labels to every enclosing aggregation's
+  ``by`` clause, and serializing the AST back to PromQL.
+
+- :class:`GroupedMetricsView` is a tick-scoped :class:`MetricsSource` view
+  over a :class:`~wva_tpu.collector.source.prometheus.PrometheusSource`:
+  the first caller needing a template this tick executes the fleet-wide
+  query once; its result is demultiplexed into per-(model, namespace)
+  ``MetricResult`` slices that serve every other caller — and each slice is
+  cached under the SAME per-model cache key the per-model path uses, so
+  stale-serve-on-error semantics are preserved per model. Templates the
+  rewriter cannot group, and templates a backend rejected, automatically
+  fall back to the existing per-model refresh path.
+
+Demux reproduces per-model evaluation byte-for-byte: group labels are
+stripped from every output point, and for multi-branch queries (a
+top-level ``a or b`` of aggregations, e.g. the scheduler flow-control
+pair) ``or``-preference is applied per model over the stripped label
+identity — a right-branch point survives only when no earlier branch
+produced the same series for that model.
+"""
+
+from __future__ import annotations
+
+import logging
+import urllib.error
+from dataclasses import dataclass
+
+from wva_tpu.collector.source.promql import (
+    Aggregation,
+    BinaryOp,
+    FuncCall,
+    NumberLiteral,
+    PromQLError,
+    Selector,
+    parse_query,
+    to_promql,
+)
+from wva_tpu.collector.source.query_template import (
+    QUERY_TYPE_PROMQL,
+    QueryTemplate,
+    escape_promql_value,
+)
+from wva_tpu.collector.source.source import (
+    PARAM_MODEL_ID,
+    PARAM_NAMESPACE,
+    MetricResult,
+    MetricsSource,
+    RefreshSpec,
+)
+from wva_tpu.utils.oncemap import OnceMap
+
+log = logging.getLogger(__name__)
+
+# Sentinel label values substituted for the per-model placeholders before
+# parsing; the rewriter recognizes and removes the matchers carrying them.
+MODEL_SENTINEL = "__wva_grouped_model__"
+NS_SENTINEL = "__wva_grouped_namespace__"
+
+
+class NotGroupableError(PromQLError):
+    """The template's shape is outside the rewriter's rules; callers fall
+    back to per-model collection."""
+
+
+@dataclass(frozen=True)
+class GroupedBranch:
+    """Demux descriptor for one top-level aggregation branch: which output
+    label carries the model id / namespace, and which labels to strip so
+    the demuxed slice is byte-identical to the per-model result."""
+
+    model_label: str
+    ns_label: str  # "" when the template has no namespace dimension
+    strip: tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class GroupedQuery:
+    promql: str
+    branches: tuple[GroupedBranch, ...]
+    has_namespace: bool
+
+
+def _merge_pending(into: dict[str, str], kind: str, label: str) -> None:
+    prev = into.get(kind)
+    if prev is not None and prev != label:
+        raise NotGroupableError(
+            f"conflicting {kind} labels {prev!r} vs {label!r}")
+    into[kind] = label
+
+
+def _rewrite(node, scope_namespace: str = "",
+             ) -> tuple[list[GroupedBranch], dict[str, str]]:
+    """Transform ``node`` in place. Returns (branches absorbed by
+    aggregations in this subtree, sentinel labels still pending an
+    enclosing aggregation)."""
+    if isinstance(node, NumberLiteral):
+        # `vector(N)` parses into NumberLiteral, so serialization would
+        # lose the vector() wrapper — and a bare scalar operand under `or`
+        # is invalid PromQL on a real backend. Refuse; the template stays
+        # per-model.
+        raise NotGroupableError("scalar / vector() operand")
+    if isinstance(node, Selector):
+        pending: dict[str, str] = {}
+        matchers: list[tuple[str, str, str]] = []
+        for lbl, op, val in node.matchers:
+            if val in (MODEL_SENTINEL, NS_SENTINEL):
+                if op != "=":
+                    raise NotGroupableError(
+                        f"non-equality matcher {op!r} on grouped param")
+                kind = "model" if val == MODEL_SENTINEL else "ns"
+                _merge_pending(pending, kind, lbl)
+                if kind == "ns" and scope_namespace:
+                    # A namespace-scoped controller keeps its scope as an
+                    # equality matcher — on a shared multi-tenant
+                    # Prometheus the fleet-wide query must not aggregate
+                    # every other tenant's series.
+                    matchers.append((lbl, "=", scope_namespace))
+                else:
+                    # Presence guard: the dropped equality matcher also
+                    # implied the label exists and is non-empty
+                    # (Prometheus treats a missing label as ""), so series
+                    # without it must stay out of the fleet-wide result.
+                    matchers.append((lbl, "!=", ""))
+            else:
+                matchers.append((lbl, op, val))
+        node.matchers = matchers
+        return [], pending
+    if isinstance(node, FuncCall):
+        return _rewrite(node.arg, scope_namespace)
+    if isinstance(node, Aggregation):
+        branches, pending = _rewrite(node.arg, scope_namespace)
+        if branches:
+            # An aggregation ABOVE an already-grouped aggregation would
+            # collapse the models back together; no registered template
+            # nests aggregations, so bail to per-model collection.
+            raise NotGroupableError("nested aggregation above a grouped one")
+        if pending:
+            model_label = pending.get("model")
+            if model_label is None:
+                raise NotGroupableError("namespace param without a model "
+                                        "param under one aggregation")
+            ns_label = pending.get("ns", "")
+            group_labels = [model_label] + ([ns_label] if ns_label else [])
+            for lbl in group_labels:
+                if lbl not in node.by:
+                    node.by.append(lbl)
+            branches = [GroupedBranch(model_label, ns_label,
+                                      tuple(group_labels))]
+            pending = {}
+        return branches, pending
+    if isinstance(node, BinaryOp):
+        left_branches, left_pending = _rewrite(node.left, scope_namespace)
+        right_branches, right_pending = _rewrite(node.right, scope_namespace)
+        merged = dict(left_pending)
+        for kind, label in right_pending.items():
+            _merge_pending(merged, kind, label)
+        return left_branches + right_branches, merged
+    raise NotGroupableError(f"unsupported node {node!r}")
+
+
+def build_grouped_query(template: QueryTemplate,
+                        extra_params: dict[str, str],
+                        scope_namespace: str = "") -> GroupedQuery | None:
+    """Rewrite one registered per-model template into its fleet-wide
+    grouped form, or None when the template is outside the rewrite rules.
+    ``extra_params`` are the template's non-model/namespace parameters
+    (e.g. ``retentionPeriod``), substituted before parsing — the grouped
+    query is memoized per distinct extra-param set. ``scope_namespace``
+    (a namespace-scoped controller's watch namespace) is kept as an
+    equality matcher instead of the fleet-wide presence guard."""
+    if template.type != QUERY_TYPE_PROMQL:
+        return None
+    if PARAM_MODEL_ID not in template.params:
+        return None
+    text = template.template
+    text = text.replace("{{." + PARAM_MODEL_ID + "}}", MODEL_SENTINEL)
+    has_namespace = PARAM_NAMESPACE in template.params
+    if has_namespace:
+        text = text.replace("{{." + PARAM_NAMESPACE + "}}", NS_SENTINEL)
+    for key, value in extra_params.items():
+        text = text.replace("{{." + key + "}}", escape_promql_value(value))
+    if "{{." in text:
+        return None  # unsubstituted params left: not safely groupable
+    try:
+        ast = parse_query(text)
+        branches, pending = _rewrite(ast, scope_namespace)
+        if pending:
+            raise NotGroupableError("model matcher outside any aggregation")
+        if not branches:
+            raise NotGroupableError("no model matcher found in template")
+    except PromQLError as e:
+        log.debug("template %s not groupable: %s", template.name, e)
+        return None
+    # Deduplicate identical branches (e.g. both sides of a division absorb
+    # the same labels) while preserving or-preference order.
+    seen: set[tuple[str, str]] = set()
+    unique: list[GroupedBranch] = []
+    for b in branches:
+        if (b.model_label, b.ns_label) not in seen:
+            seen.add((b.model_label, b.ns_label))
+            unique.append(b)
+    return GroupedQuery(promql=to_promql(ast), branches=tuple(unique),
+                        has_namespace=has_namespace)
+
+
+def demux_points(gq: GroupedQuery, points, make_value):
+    """Split one grouped result into per-(model, namespace) value lists.
+
+    ``make_value(labels, point)`` builds the per-model output element from
+    the stripped labels; point order within a slice follows branch order
+    then backend order, matching per-model ``left or right`` evaluation.
+    Returns ``{(model, namespace): [value, ...]}`` (namespace "" when the
+    template has no namespace dimension)."""
+    assigned: dict[tuple[str, str], list[tuple[int, tuple, object]]] = {}
+    for p in points:
+        for bi, branch in enumerate(gq.branches):
+            model = p.labels.get(branch.model_label)
+            if not model:
+                continue
+            ns = p.labels.get(branch.ns_label, "") if branch.ns_label else ""
+            stripped = {k: v for k, v in p.labels.items()
+                        if k not in branch.strip}
+            identity = tuple(sorted(stripped.items()))
+            assigned.setdefault((model, ns), []).append(
+                (bi, identity, make_value(stripped, p)))
+            break
+    out: dict[tuple[str, str], list] = {}
+    for key, entries in assigned.items():
+        # Branch-major order (stable: backend order preserved within a
+        # branch) — real Prometheus does not guarantee or-result ordering.
+        entries.sort(key=lambda e: e[0])
+        kept: list = []
+        seen_earlier: set[tuple] = set()
+        current: set[tuple] = set()
+        last_branch = -1
+        for bi, identity, value in entries:  # entries keep backend order
+            if bi != last_branch:
+                seen_earlier |= current
+                current = set()
+                last_branch = bi
+            if identity in seen_earlier:
+                continue  # or-preference: an earlier branch won this series
+            current.add(identity)
+            kept.append(value)
+        out[key] = kept
+    return out
+
+
+class GroupedMetricsView(MetricsSource):
+    """Tick-scoped grouped-collection view over a PrometheusSource.
+
+    Construct one per engine tick and hand it to every collector call site;
+    it is thread-safe (the engine's analysis workers race into it), and the
+    first worker to need a template runs the fleet-wide query while the
+    rest wait on the per-template latch. Anything non-groupable delegates
+    to the wrapped source unchanged, so disabling grouping is equivalent to
+    bypassing the view entirely."""
+
+    def __init__(self, source, scope_namespace: str = "") -> None:
+        self._source = source
+        # Namespace-scoped controllers keep their watch namespace as an
+        # equality matcher in the fleet-wide queries (shared-Prometheus
+        # tenancy: never aggregate other tenants' series).
+        self._scope_namespace = scope_namespace
+        # (name, extras) -> demuxed {(model, ns): MetricResult} | None when
+        # the grouped execution failed this tick (per-model fallback).
+        self._once = OnceMap()
+
+    # --- MetricsSource ---
+
+    def query_list(self):
+        return self._source.query_list()
+
+    def get(self, query_name: str, params: dict[str, str]):
+        return self._source.get(query_name, params)
+
+    def refresh(self, spec: RefreshSpec) -> dict[str, MetricResult]:
+        names = list(spec.queries) or self._source.query_list().names()
+        results: dict[str, MetricResult] = {}
+        passthrough: list[str] = []
+        for name in names:
+            served = self._serve_grouped(name, spec.params)
+            if served is None:
+                passthrough.append(name)
+            else:
+                results[name] = served
+        if passthrough:
+            results.update(self._source.refresh(
+                RefreshSpec(queries=passthrough, params=dict(spec.params))))
+        return results
+
+    # --- grouped execution ---
+
+    def _serve_grouped(self, name: str,
+                       params: dict[str, str]) -> MetricResult | None:
+        """The per-model slice for ``params`` from this tick's fleet-wide
+        result, or None to delegate to the per-model path."""
+        template = self._source.query_list().get(name)
+        if template is None or template.type != QUERY_TYPE_PROMQL:
+            return None
+        if PARAM_MODEL_ID not in template.params:
+            return None
+        model = params.get(PARAM_MODEL_ID)
+        if not model:
+            return None
+        for p in template.params:
+            if p not in params:
+                return None  # let the per-model path raise its usual error
+        has_ns = PARAM_NAMESPACE in template.params
+        ns = params.get(PARAM_NAMESPACE, "") if has_ns else ""
+        extras = {k: params[k] for k in template.params
+                  if k not in (PARAM_MODEL_ID, PARAM_NAMESPACE)}
+        gq = self._source.grouped_query_for(name, extras,
+                                            self._scope_namespace)
+        if gq is None:
+            return None
+        key = (name, tuple(sorted(extras.items())))
+        demuxed = self._demuxed(key, name, gq, params, has_ns)
+        if demuxed is None:
+            return None  # grouped execution failed: per-model fallback
+        # Organic serve: remember the grouped spec so the background cache
+        # warmer re-executes the fleet-wide query (refreshing EVERY
+        # demuxed per-model slice) between ticks — the grouped twin of
+        # _remember_spec on the per-model path. Warmer executions go
+        # through warm_grouped_spec/_execute and never renew.
+        self._source.remember_grouped_spec(name, extras,
+                                           self._scope_namespace)
+        result = demuxed.get((model, ns))
+        if result is None:
+            # Same outcome the per-model query would produce: an empty
+            # (but successful) result — cached under the per-model key so
+            # a later backend outage stale-serves "no data", not ancient
+            # data.
+            result = MetricResult(query_name=name, values=[],
+                                  collected_at=demuxed["__collected_at__"])
+            self._source.store_demuxed_result(name, dict(params), result)
+        return result
+
+    def _demuxed(self, key, name: str, gq: GroupedQuery,
+                 params: dict[str, str], has_ns: bool):
+        """Memoized fleet-wide execution + demux for one (template, extras)
+        this tick. Concurrent callers for the same key wait on a latch
+        instead of issuing duplicate backend queries."""
+        return self._once.get_or_compute(
+            key, lambda: self._execute(name, gq, params, has_ns))
+
+    def _execute(self, name: str, gq: GroupedQuery, params: dict[str, str],
+                 has_ns: bool):
+        collected_at = self._source.clock.now()
+        try:
+            points = self._source.execute_grouped(name, gq.promql)
+        except Exception as e:  # noqa: BLE001 — grouped failure falls back
+            log.debug("grouped query %s failed (%s); falling back to "
+                      "per-model collection", name, e)
+            # Only DETERMINISTIC rejections (the backend executed or
+            # parsed the query and said no) pin the template per-model for
+            # the retry window. A transient transport blip must fall back
+            # for this tick only — pinning on a timeout would amplify load
+            # ~models-fold against a recovering backend for 10 minutes.
+            if _is_deterministic_rejection(e):
+                self._source.note_grouped_rejection(name, e)
+            return None
+        slices = demux_points(gq, points, self._source.make_metric_value)
+        demuxed: dict = {"__collected_at__": collected_at}
+        for (model, ns), values in slices.items():
+            result = MetricResult(query_name=name, values=values,
+                                  collected_at=collected_at)
+            demuxed[(model, ns)] = result
+            # Per-model stale-serve parity: each demuxed slice lands in the
+            # source's cache under the SAME key the per-model path uses, so
+            # an outage next tick serves the per-model stale entry.
+            slice_params = dict(params)
+            slice_params[PARAM_MODEL_ID] = model
+            if has_ns:
+                slice_params[PARAM_NAMESPACE] = ns
+            self._source.store_demuxed_result(name, slice_params, result)
+        return demuxed
+
+
+def _is_deterministic_rejection(e: Exception) -> bool:
+    """Did the backend actually REJECT the grouped form (4xx / query
+    error), as opposed to failing transiently (timeout, connection
+    reset)?"""
+    if isinstance(e, urllib.error.HTTPError):
+        return 400 <= e.code < 500
+    if isinstance(e, PromQLError):
+        return True  # in-memory engine refused the query shape
+    # HTTPPromAPI surfaces a 200-with-error payload ("status": "error",
+    # e.g. errorType bad_data) as this RuntimeError: the backend parsed
+    # and refused the query.
+    return isinstance(e, RuntimeError) and "prometheus query failed" in str(e)
+
+
+def warm_grouped_spec(source, name: str, extras: dict[str, str],
+                      scope_namespace: str = "") -> bool:
+    """Re-execute one remembered fleet-wide query and refresh every demuxed
+    per-model cache slice — the cache warmer's grouped path (with grouped
+    collection on, per-model specs never reach the warmer, so without this
+    the stale-serve cache would decay to tick cadence). Returns False when
+    the template is no longer groupable or the backend failed."""
+    template = source.query_list().get(name)
+    if template is None:
+        return False
+    gq = source.grouped_query_for(name, extras, scope_namespace)
+    if gq is None:
+        return False
+    view = GroupedMetricsView(source, scope_namespace=scope_namespace)
+    has_ns = PARAM_NAMESPACE in template.params
+    return view._execute(name, gq, dict(extras), has_ns) is not None

@@ -18,6 +18,7 @@ import logging
 import math
 import ssl
 import threading
+import urllib.error
 import urllib.parse
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
@@ -82,18 +83,26 @@ class HTTPPromAPI:
     reads the bearer token from a file PER QUERY, so rotated
     BoundServiceAccountToken projections are picked up without a restart
     (the reference reads the file once at startup,
-    ``prometheus_transport.go:50-58``; documented divergence)."""
+    ``prometheus_transport.go:50-58``; documented divergence).
+
+    Queries go as POST form-encoded bodies by default (real Prometheus
+    accepts both verbs on ``/api/v1/query``): fleet-wide grouped queries
+    with many ``or``-joined metric families can exceed practical URL
+    limits as GET query strings. ``use_get=True`` restores GET for
+    read-only proxies that reject POST (PROMETHEUS_USE_GET_QUERIES)."""
 
     def __init__(self, base_url: str, bearer_token: str = "",
                  timeout: float = DEFAULT_QUERY_TIMEOUT_SECONDS,
                  insecure_skip_verify: bool = False,
                  ca_cert_path: str = "",
                  client_cert_path: str = "", client_key_path: str = "",
-                 server_name: str = "", token_path: str = "") -> None:
+                 server_name: str = "", token_path: str = "",
+                 use_get: bool = False) -> None:
         self.base_url = base_url.rstrip("/")
         self.bearer_token = bearer_token
         self.token_path = token_path
         self.timeout = timeout
+        self.use_get = use_get
         self._ssl_ctx = None
         if insecure_skip_verify:
             self._ssl_ctx = ssl.create_default_context()
@@ -130,7 +139,8 @@ class HTTPPromAPI:
             ca_cert_path=prom.ca_cert_path,
             client_cert_path=prom.client_cert_path,
             client_key_path=prom.client_key_path,
-            server_name=prom.server_name)
+            server_name=prom.server_name,
+            use_get=getattr(prom, "use_get_queries", False))
 
     def _token(self) -> str:
         if self.bearer_token:
@@ -146,18 +156,49 @@ class HTTPPromAPI:
         return ""
 
     def query(self, promql: str) -> list[SeriesPoint]:
-        url = (f"{self.base_url}/api/v1/query?"
-               + urllib.parse.urlencode({"query": promql}))
-        req = urllib.request.Request(url)
+        # Capture the verb THIS request uses: concurrent queries race the
+        # degrade flip below, and the retry guard must test what was
+        # actually sent, not the since-mutated shared flag (or every
+        # in-flight POST but the first would re-raise its 405).
+        used_get = self.use_get
+        try:
+            payload = self._request(promql, use_get=used_get)
+        except urllib.error.HTTPError as e:
+            # A GET-only proxy (405/501 on POST) must not black out every
+            # metric until an operator finds the knob: degrade this API
+            # handle to GET permanently and retry. Oversized grouped
+            # queries may then fail individually — the grouped-rejection
+            # fallback handles those per template.
+            if used_get or e.code not in (405, 501):
+                raise
+            if not self.use_get:
+                log.warning("Prometheus rejected POST /api/v1/query (%d); "
+                            "falling back to GET for all queries (set "
+                            "PROMETHEUS_USE_GET_QUERIES=true to silence "
+                            "this)", e.code)
+                self.use_get = True
+            payload = self._request(promql, use_get=True)
+        if payload.get("status") != "success":
+            raise RuntimeError(f"prometheus query failed: {payload.get('error')}")
+        return parse_prometheus_response(payload.get("data") or {})
+
+    def _request(self, promql: str, use_get: bool) -> dict:
+        encoded = urllib.parse.urlencode({"query": promql})
+        if use_get:
+            req = urllib.request.Request(
+                f"{self.base_url}/api/v1/query?{encoded}")
+        else:
+            req = urllib.request.Request(
+                f"{self.base_url}/api/v1/query", method="POST",
+                data=encoded.encode(),
+                headers={"Content-Type":
+                         "application/x-www-form-urlencoded"})
         token = self._token()
         if token:
             req.add_header("Authorization", f"Bearer {token}")
         with urllib.request.urlopen(req, timeout=self.timeout,
                                     context=self._ssl_ctx) as resp:
-            payload = json.loads(resp.read())
-        if payload.get("status") != "success":
-            raise RuntimeError(f"prometheus query failed: {payload.get('error')}")
-        return parse_prometheus_response(payload.get("data") or {})
+            return json.loads(resp.read())
 
 
 def parse_prometheus_response(data: dict) -> list[SeriesPoint]:
@@ -199,7 +240,23 @@ def parse_prometheus_response(data: dict) -> list[SeriesPoint]:
 
 class PrometheusSource(MetricsSource):
     """Executes registered queries (concurrently for HTTP backends), caches
-    results keyed by (query, params)."""
+    results keyed by (query, params).
+
+    Also the substrate for grouped per-tick collection
+    (:class:`~wva_tpu.collector.source.grouped.GroupedMetricsView`): it
+    memoizes the grouped rewrite per template, executes fleet-wide queries
+    with the same backend, tracks grouped-form rejections for automatic
+    per-model fallback, and exposes the per-model cache so demuxed slices
+    keep stale-serve semantics. ``query_counts()`` reports backend queries
+    by template name — the honest measurement the bench-collect harness
+    and the query-budget regression tests assert against."""
+
+    # GroupedMetricsView only wraps sources that carry the grouped hooks.
+    supports_grouped_collection = True
+    # A backend that rejected a grouped form is retried after this long
+    # (rejections are usually deterministic — proxy limits, unsupported
+    # grouped shape — so hammering every tick is pure waste).
+    GROUPED_REJECT_RETRY_SECONDS = 600.0
 
     def __init__(self, api: PromAPI, cache_config: CacheConfig | None = None,
                  clock: Clock | None = None, concurrent: bool | None = None) -> None:
@@ -243,7 +300,28 @@ class PrometheusSource(MetricsSource):
         # threads per call — at a 5s engine tick with per-model refreshes
         # that is hundreds of thread creations a minute for nothing.
         self._pool: ThreadPoolExecutor | None = None
+        # Separate small pool for the cache warmer: warm tasks call
+        # refresh(), whose per-query fan-out runs on the query pool above —
+        # warming on that same pool could fill every slot with warm tasks
+        # all blocked on their own inner fan-out (nested-pool deadlock).
+        self._warm_pool_handle: ThreadPoolExecutor | None = None
         self._pool_mu = threading.Lock()
+        # Backend query counters by template name ("grouped:<name>" for
+        # fleet-wide grouped executions) — the measured quantity behind
+        # the O(templates)-per-tick claim.
+        self._qc_mu = threading.Lock()
+        self._query_counts: dict[str, int] = {}
+        # Grouped-rewrite memo ((name, extras) -> GroupedQuery | None) and
+        # rejection clock per template name.
+        self._grouped_mu = threading.Lock()
+        self._grouped_cache: dict[tuple, object] = {}
+        self._grouped_rejected_at: dict[str, float] = {}
+        # Recently ORGANICALLY-served grouped specs, for the cache warmer
+        # (the grouped twin of _recent_specs: with grouping on, per-model
+        # specs never reach refresh(), so warming must re-execute the
+        # fleet-wide queries instead). Guarded by _specs_mu; warming
+        # executions never renew.
+        self._grouped_specs: dict[tuple, tuple[float, str, dict, str]] = {}
 
     def query_list(self) -> QueryList:
         return self._queries
@@ -259,6 +337,7 @@ class PrometheusSource(MetricsSource):
             collected_at = self.clock.now()
             try:
                 promql = self._queries.build(name, escaped_params)
+                self._note_query(name)
                 points = self.api.query(promql)
             except Exception as e:  # noqa: BLE001 — per-query isolation
                 # Serve-stale-on-error: a Prometheus blip rides on the last
@@ -275,14 +354,8 @@ class PrometheusSource(MetricsSource):
                 log.debug("query %s failed: %s", name, e)
                 return MetricResult(query_name=name, collected_at=collected_at,
                                     error=str(e))
-            values = [
-                MetricValue(
-                    value=0.0 if math.isnan(p.value) or math.isinf(p.value) else p.value,
-                    timestamp=p.timestamp,
-                    labels=dict(p.labels),
-                )
-                for p in points
-            ]
+            values = [self.make_metric_value(dict(p.labels), p)
+                      for p in points]
             result = MetricResult(query_name=name, values=values,
                                   collected_at=collected_at)
             # Cache only genuinely fresh query results — re-caching a
@@ -317,14 +390,126 @@ class PrometheusSource(MetricsSource):
                     thread_name_prefix="prom-query")
             return self._pool
 
+    # Warm tasks are refresh() calls whose own queries fan onto the query
+    # pool; 8 concurrent specs keeps a fleet-scale warming pass well under
+    # fetch_interval without monopolizing the query pool.
+    WARM_POOL_WORKERS = 8
+
+    def _warm_pool(self) -> ThreadPoolExecutor:
+        with self._pool_mu:
+            if self._warm_pool_handle is None:
+                self._warm_pool_handle = ThreadPoolExecutor(
+                    max_workers=self.WARM_POOL_WORKERS,
+                    thread_name_prefix="prom-warm")
+            return self._warm_pool_handle
+
     def close(self) -> None:
-        """Shut down the persistent query pool (source stop / process
-        shutdown). Safe to call repeatedly; a later refresh() would lazily
-        recreate the pool."""
+        """Shut down the persistent query + warm pools (source stop /
+        process shutdown). Safe to call repeatedly; a later refresh() would
+        lazily recreate them."""
         with self._pool_mu:
             pool, self._pool = self._pool, None
+            warm, self._warm_pool_handle = self._warm_pool_handle, None
         if pool is not None:
             pool.shutdown(wait=False)
+        if warm is not None:
+            warm.shutdown(wait=False)
+
+    # --- backend query accounting ---
+
+    def _note_query(self, name: str) -> None:
+        with self._qc_mu:
+            self._query_counts[name] = self._query_counts.get(name, 0) + 1
+
+    def query_counts(self) -> dict[str, int]:
+        """Backend queries issued since the last reset, by template name
+        (grouped executions count under ``grouped:<name>``)."""
+        with self._qc_mu:
+            return dict(self._query_counts)
+
+    def backend_query_total(self) -> int:
+        with self._qc_mu:
+            return sum(self._query_counts.values())
+
+    def reset_query_counts(self) -> None:
+        with self._qc_mu:
+            self._query_counts.clear()
+
+    # --- grouped-collection substrate (GroupedMetricsView) ---
+
+    def grouped_query_for(self, name: str, extra_params: dict[str, str],
+                          scope_namespace: str = ""):
+        """The memoized fleet-wide rewrite of template ``name`` for this
+        extra-param set (and namespace scope), or None when not groupable /
+        recently rejected."""
+        from wva_tpu.collector.source.grouped import build_grouped_query
+
+        with self._grouped_mu:
+            rejected_at = self._grouped_rejected_at.get(name)
+            if rejected_at is not None:
+                if (self.clock.now() - rejected_at
+                        < self.GROUPED_REJECT_RETRY_SECONDS):
+                    return None
+                del self._grouped_rejected_at[name]
+        template = self._queries.get(name)
+        if template is None:
+            return None
+        key = (name, tuple(sorted(extra_params.items())), scope_namespace)
+        with self._grouped_mu:
+            if key in self._grouped_cache:
+                return self._grouped_cache[key]
+        gq = build_grouped_query(template, extra_params,
+                                 scope_namespace=scope_namespace)
+        with self._grouped_mu:
+            if len(self._grouped_cache) >= 1024:
+                self._grouped_cache.clear()
+            self._grouped_cache[key] = gq
+        return gq
+
+    def execute_grouped(self, name: str, promql: str):
+        """One fleet-wide query straight through the backend (the view owns
+        demux + caching); exceptions propagate to trigger fallback."""
+        self._note_query(f"grouped:{name}")
+        return self.api.query(promql)
+
+    def remember_grouped_spec(self, name: str, extras: dict[str, str],
+                              scope_namespace: str = "") -> None:
+        """Record an organically-served grouped spec for the warmer (true
+        LRU like _remember_spec; bounded by _recent_bound)."""
+        key = (name, tuple(sorted(extras.items())), scope_namespace)
+        with self._specs_mu:
+            self._grouped_specs.pop(key, None)
+            self._grouped_specs[key] = (self.clock.now(), name,
+                                        dict(extras), scope_namespace)
+            while len(self._grouped_specs) > self._recent_bound:
+                self._grouped_specs.pop(next(iter(self._grouped_specs)))
+
+    def note_grouped_rejection(self, name: str, error: Exception) -> None:
+        """Backend rejected the grouped form: pin this template to the
+        per-model path for a while (retried after the rejection window)."""
+        with self._grouped_mu:
+            first = name not in self._grouped_rejected_at
+            self._grouped_rejected_at[name] = self.clock.now()
+        if first:
+            log.warning("grouped query %s rejected by backend (%s); "
+                        "falling back to per-model collection for %.0fs",
+                        name, error, self.GROUPED_REJECT_RETRY_SECONDS)
+
+    def store_demuxed_result(self, name: str, params: dict[str, str],
+                             result: MetricResult) -> None:
+        """Cache one demuxed per-model slice under the exact key the
+        per-model refresh path uses, preserving stale-serve semantics."""
+        self._cache.set(name, params, result)
+
+    @staticmethod
+    def make_metric_value(labels: dict[str, str], point) -> MetricValue:
+        """SeriesPoint -> MetricValue with the NaN/Inf -> 0 guard, shared
+        by the per-model and grouped demux paths so values are built
+        identically."""
+        v = point.value
+        return MetricValue(
+            value=0.0 if math.isnan(v) or math.isinf(v) else v,
+            timestamp=point.timestamp, labels=labels)
 
     # Specs not re-seen for this long stop being warmed (a deleted VA's
     # queries must not be re-executed forever).
@@ -364,28 +549,61 @@ class PrometheusSource(MetricsSource):
                     self._evictions_since_warn = 0
 
     def background_fetch_once(self) -> int:
-        """Re-execute recently seen refresh specs to keep the stale-serve
-        cache alive (PROMETHEUS_METRICS_CACHE_FETCH_INTERVAL, reference
-        cache fetch loop); expired specs are dropped. Returns the number
-        of specs refreshed."""
+        """Re-execute recently seen refresh specs — per-model AND grouped
+        fleet-wide ones (each grouped re-execution refreshes every demuxed
+        per-model cache slice) — to keep the stale-serve cache alive
+        (PROMETHEUS_METRICS_CACHE_FETCH_INTERVAL, reference cache fetch
+        loop); expired specs are dropped. Returns the number of specs
+        refreshed.
+
+        Specs warm CONCURRENTLY (bounded warm pool) against HTTP backends:
+        a serial walk at fleet scale could overrun ``fetch_interval`` and
+        let the stale-serve cache silently decay. The warming flag is
+        thread-local, so it is set inside each warm task — whichever pool
+        thread runs it — and organic refreshes on those threads still
+        register their specs."""
         now = self.clock.now()
         live: list[RefreshSpec] = []
+        grouped_live: list[tuple[str, dict, str]] = []
         with self._specs_mu:
             for key, (seen_at, spec) in list(self._recent_specs.items()):
                 if now - seen_at > self.SPEC_EXPIRY_SECONDS:
                     self._recent_specs.pop(key, None)
                 else:
                     live.append(spec)
-        self._warming.active = True
-        try:
-            for spec in live:
-                try:
-                    self.refresh(spec)
-                except Exception as e:  # noqa: BLE001 — warming must not crash
-                    log.debug("background fetch failed: %s", e)
-        finally:
-            self._warming.active = False
-        return len(live)
+            for key, (seen_at, name, extras, scope) in \
+                    list(self._grouped_specs.items()):
+                if now - seen_at > self.SPEC_EXPIRY_SECONDS:
+                    self._grouped_specs.pop(key, None)
+                else:
+                    grouped_live.append((name, extras, scope))
+
+        def warm_one(spec: RefreshSpec) -> None:
+            self._warming.active = True
+            try:
+                self.refresh(spec)
+            except Exception as e:  # noqa: BLE001 — warming must not crash
+                log.debug("background fetch failed: %s", e)
+            finally:
+                self._warming.active = False
+
+        def warm_grouped(item: tuple[str, dict, str]) -> None:
+            from wva_tpu.collector.source.grouped import warm_grouped_spec
+
+            name, extras, scope = item
+            try:
+                warm_grouped_spec(self, name, extras, scope)
+            except Exception as e:  # noqa: BLE001 — warming must not crash
+                log.debug("grouped background fetch failed: %s", e)
+
+        tasks = [(warm_one, s) for s in live] + \
+            [(warm_grouped, g) for g in grouped_live]
+        if self._concurrent and len(tasks) > 1:
+            list(self._warm_pool().map(lambda t: t[0](t[1]), tasks))
+        else:
+            for fn, arg in tasks:
+                fn(arg)
+        return len(tasks)
 
     def start_background_fetch(self, stop) -> "threading.Thread | None":
         """Spawn the cache warmer when fetch_interval > 0 (0 disables)."""

@@ -17,6 +17,13 @@ lookback (5m), aggregation over an empty vector returns an EMPTY vector (not
 0 — scale-to-zero safety depends on "no data" being distinguishable from 0),
 division drops unmatched/zero-denominator series, and ``or`` keeps the right
 side's series only when the left has no series with the same label set.
+
+Storage is array-backed ring buffers per series (``array('d')`` timestamp +
+value columns with a live-region offset): appends are O(1) amortized,
+retention trims advance the offset instead of ``pop(0)``-ing objects, and
+reads hand out :class:`SeriesWindow` views — bisect-sliced, zero-copy
+snapshots — under striped per-series locks, so concurrent engine workers
+never serialize on one store-wide mutex (docs/design/metrics-plane.md).
 """
 
 from __future__ import annotations
@@ -24,7 +31,10 @@ from __future__ import annotations
 import math
 import re
 import threading
+from array import array
+from bisect import bisect_left, bisect_right
 from dataclasses import dataclass, field
+from functools import lru_cache
 
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
@@ -50,6 +60,8 @@ def format_promql_duration(seconds: float) -> str:
     utils.FormatPrometheusDuration)."""
     if seconds <= 0:
         return "0s"
+    if seconds < 1:
+        return f"{int(math.ceil(seconds * 1000))}ms"
     if seconds % 3600 == 0:
         return f"{int(seconds // 3600)}h"
     if seconds % 60 == 0:
@@ -76,50 +88,220 @@ class SeriesPoint:
     timestamp: float
 
 
+class SeriesWindow:
+    """Zero-copy view over one series' samples in ``[lo, hi)``.
+
+    Holds references to the backing timestamp/value arrays plus bounds taken
+    under the series lock. Appends after the snapshot only extend the arrays
+    past ``hi``; compaction replaces the arrays on the series (this view
+    keeps the old ones) — so the window is immutable without copying a
+    single sample. Supports ``len``/indexing/iteration yielding
+    :class:`Sample` for compatibility with list-of-samples consumers."""
+
+    __slots__ = ("ts", "vals", "lo", "hi")
+
+    def __init__(self, ts, vals, lo: int, hi: int) -> None:
+        self.ts = ts
+        self.vals = vals
+        self.lo = lo
+        self.hi = hi
+
+    def __len__(self) -> int:
+        return self.hi - self.lo
+
+    def __getitem__(self, i: int) -> Sample:
+        n = self.hi - self.lo
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        return Sample(self.ts[self.lo + i], self.vals[self.lo + i])
+
+    def __iter__(self):
+        for i in range(self.lo, self.hi):
+            yield Sample(self.ts[i], self.vals[i])
+
+    def latest_at_or_before(self, now: float) -> Sample | None:
+        i = bisect_right(self.ts, now, self.lo, self.hi)
+        if i <= self.lo:
+            return None
+        return Sample(self.ts[i - 1], self.vals[i - 1])
+
+    def range_window(self, lo_ts: float, hi_ts: float) -> "SeriesWindow":
+        """Sub-window of samples with ``lo_ts <= timestamp <= hi_ts``
+        (bisect-sliced; no samples are touched)."""
+        i = bisect_left(self.ts, lo_ts, self.lo, self.hi)
+        j = bisect_right(self.ts, hi_ts, self.lo, self.hi)
+        return SeriesWindow(self.ts, self.vals, i, j)
+
+
+class _Series:
+    """One series' column store: parallel timestamp/value arrays with a
+    live-region start offset (the "ring"). Samples before ``start`` are
+    retention-expired garbage awaiting compaction."""
+
+    __slots__ = ("labels", "ts", "vals", "start", "last_ts")
+
+    def __init__(self, labels: dict[str, str]) -> None:
+        self.labels = labels
+        self.ts = array("d")
+        self.vals = array("d")
+        self.start = 0
+        self.last_ts = float("-inf")
+
+
+# Compiled-regex matcher cache: the registered query surface reuses a small
+# fixed set of regex matchers, and compiling per evaluation dominated regex
+# selector cost at fleet scale.
+@lru_cache(maxsize=512)
+def _compiled_re(pattern: str) -> "re.Pattern[str]":
+    return re.compile(pattern)
+
+
 class TimeSeriesDB:
-    """Append-only store of samples keyed by full label set (incl __name__)."""
+    """Append-only store of samples keyed by full label set (incl __name__).
+
+    Concurrency: one structure lock guards the series maps; sample appends
+    and window snapshots take a striped per-series lock, so readers (the
+    engine's analysis workers) and the emulator's ingest never contend on a
+    single store-wide mutex. Timestamps per series are assumed
+    non-decreasing (Prometheus rejects out-of-order appends; every producer
+    here stamps a monotone clock)."""
+
+    LOCK_STRIPES = 64
+    # Time-gated global sweep: any ongoing ingest trims QUIESCENT series
+    # too, so a series whose writes stopped cannot pin memory forever (the
+    # old `len % 256` count gate never fired again once writes ceased).
+    SWEEP_INTERVAL_SECONDS = 60.0
+    # Compact a series' dead prefix once it dominates the array (amortized
+    # O(1) per append; replaces the arrays so live zero-copy windows keep
+    # their old snapshot).
+    COMPACT_MIN_DEAD = 256
 
     def __init__(self, clock: Clock | None = None,
                  retention: float = DEFAULT_RETENTION_SECONDS) -> None:
         self.clock = clock or SYSTEM_CLOCK
         self.retention = retention
-        self._mu = threading.RLock()
-        self._series: dict[tuple, tuple[dict[str, str], list[Sample]]] = {}
-        # Metric-name index: __name__ -> series keys. Every PromQL selector
-        # names its metric with an equality matcher, so lookups touch only
-        # that metric's series — a real Prometheus resolves selectors
-        # through its label index the same way. Without it, a 96-pod fleet
-        # (~1k series) paid a full-store scan per query per model per tick,
-        # and the fake TSDB dominated the fleet-tick benchmark.
-        self._by_name: dict[str, set[tuple]] = {}
-        # Compat lever for `make bench-tick`: False reproduces the
-        # pre-index full-store scan so the pre-change tick cost is measured
-        # honestly, not against an already-optimized substrate.
+        self._mu = threading.Lock()
+        self._stripes = [threading.Lock() for _ in range(self.LOCK_STRIPES)]
+        self._series: dict[tuple, _Series] = {}
+        # Metric-name index: __name__ -> series keys (insertion-ordered dict
+        # so enumeration — and thus float-summation order in aggregations —
+        # is deterministic). Every PromQL selector names its metric with an
+        # equality matcher, so lookups touch only that metric's series — a
+        # real Prometheus resolves selectors through its label index the
+        # same way.
+        self._by_name: dict[str, dict[tuple, None]] = {}
+        self._last_sweep = float("-inf")
+        # Compat levers for `make bench-tick` / `make bench-collect`:
+        # - use_name_index=False reproduces the pre-index full-store scan;
+        # - legacy_reads=True reproduces the pre-ring read path (one global
+        #   lock held for the whole scan + a full copy of every matched
+        #   series' samples), so the before/after numbers measure the real
+        #   pre-change cost, not an already-optimized substrate.
         self.use_name_index = True
+        self.legacy_reads = False
 
     @staticmethod
     def _key(name: str, labels: dict[str, str]) -> tuple:
         return tuple(sorted({**labels, "__name__": name}.items()))
 
+    def _lock_for(self, key: tuple) -> threading.Lock:
+        return self._stripes[hash(key) % self.LOCK_STRIPES]
+
     def add_sample(self, name: str, labels: dict[str, str], value: float,
                    timestamp: float | None = None) -> None:
         ts = self.clock.now() if timestamp is None else timestamp
         key = self._key(name, labels)
-        with self._mu:
-            entry = self._series.get(key)
-            if entry is None:
-                entry = ({**labels, "__name__": name}, [])
-                self._series[key] = entry
-                self._by_name.setdefault(name, set()).add(key)
-            samples = entry[1]
-            samples.append(Sample(ts, value))
-            # Trim beyond retention occasionally.
-            if len(samples) % 256 == 0:
-                cutoff = ts - self.retention
-                while samples and samples[0].timestamp < cutoff:
-                    samples.pop(0)
+        while True:
+            s = self._series.get(key)
+            if s is None:
+                with self._mu:
+                    s = self._series.get(key)
+                    if s is None:
+                        s = _Series({**labels, "__name__": name})
+                        self._series[key] = s
+                        self._by_name.setdefault(name, {})[key] = None
+            with self._lock_for(key):
+                # A concurrent sweep may have dropped this series between
+                # the map read and taking the stripe lock; appending to the
+                # orphaned object would silently lose the sample. Re-check
+                # registration under the lock and retry (sweep only drops
+                # fully-expired series, so one retry recreates it).
+                if self._series.get(key) is not s:
+                    continue
+                s.ts.append(ts)
+                s.vals.append(value)
+                s.last_ts = ts
+                self._trim_locked(s, ts)
+                break
+        if ts - self._last_sweep >= self.SWEEP_INTERVAL_SECONDS:
+            self.sweep(ts)
 
     set_gauge = add_sample  # gauges and counters are both just samples
+
+    def _trim_locked(self, s: _Series, now: float) -> None:
+        """Advance the live-region start past retention (O(1) amortized —
+        each sample is stepped over at most once) and compact when the dead
+        prefix dominates. Caller holds the series' stripe lock."""
+        cutoff = now - self.retention
+        ts = s.ts
+        start = s.start
+        n = len(ts)
+        while start < n and ts[start] < cutoff:
+            start += 1
+        s.start = start
+        if start >= self.COMPACT_MIN_DEAD and start * 2 >= n:
+            s.ts = ts[start:]
+            s.vals = s.vals[start:]
+            s.start = 0
+
+    def sweep(self, now: float | None = None) -> int:
+        """Trim every series to retention and drop series fully expired
+        (no live samples and no write within retention). Called
+        opportunistically from ``add_sample`` on a time gate; safe to call
+        explicitly. Returns the number of series dropped."""
+        now = self.clock.now() if now is None else now
+        with self._mu:
+            if self._last_sweep >= now:
+                return 0
+            self._last_sweep = now
+            items = list(self._series.items())
+        dead: list[tuple] = []
+        for key, s in items:
+            with self._lock_for(key):
+                self._trim_locked(s, now)
+                if s.start >= len(s.ts) and now - s.last_ts > self.retention:
+                    dead.append(key)
+        dropped = 0
+        with self._mu:
+            for key in dead:
+                s = self._series.get(key)
+                if s is None:
+                    continue
+                with self._lock_for(key):
+                    if s.start < len(s.ts):  # raced a fresh append: keep
+                        continue
+                    del self._series[key]
+                    dropped += 1
+                    name = s.labels.get("__name__", "")
+                    keys = self._by_name.get(name)
+                    if keys is not None:
+                        keys.pop(key, None)
+                        if not keys:
+                            del self._by_name[name]
+        return dropped
+
+    def live_sample_count(self) -> int:
+        """Total retained (live-region) samples — the memory-bound guard
+        the trim regression tests assert against."""
+        with self._mu:
+            items = list(self._series.items())
+        total = 0
+        for key, s in items:
+            with self._lock_for(key):
+                total += len(s.ts) - s.start
+        return total
 
     def drop_series(self, name: str, labels: dict[str, str]) -> None:
         """Remove a series entirely (e.g. pod deleted — Prometheus staleness)."""
@@ -128,27 +310,55 @@ class TimeSeriesDB:
             self._series.pop(key, None)
             keys = self._by_name.get(name)
             if keys is not None:
-                keys.discard(key)
+                keys.pop(key, None)
                 if not keys:
                     del self._by_name[name]
 
     def matching_series(self, matchers: list[tuple[str, str, str]]):
-        """Series whose labels satisfy all (label, op, value) matchers."""
+        """Series whose labels satisfy all (label, op, value) matchers, as
+        ``(labels_copy, SeriesWindow)`` pairs. The windows are zero-copy
+        snapshots; concurrent appends/compactions never mutate them."""
+        if self.legacy_reads:
+            return self._matching_series_legacy(matchers)
+        name_val = None
+        if self.use_name_index:
+            for lbl, op, val in matchers:
+                if lbl == "__name__" and op == "=":
+                    name_val = val
+                    break
         with self._mu:
-            # An exact __name__ matcher narrows the scan to one metric's
-            # series via the index; remaining matchers filter labels.
-            candidates = None
-            if self.use_name_index:
-                for lbl, op, val in matchers:
-                    if lbl == "__name__" and op == "=":
-                        candidates = self._by_name.get(val, ())
-                        break
-            entries = (self._series.values() if candidates is None
-                       else [self._series[k] for k in candidates])
+            if name_val is not None:
+                keys = self._by_name.get(name_val)
+                entries = ([] if keys is None
+                           else [(k, self._series[k]) for k in keys])
+            else:
+                entries = list(self._series.items())
+        out = []
+        for key, s in entries:
+            labels = s.labels
+            if not all(_match(labels.get(lbl, ""), op, val)
+                       for lbl, op, val in matchers):
+                continue
+            with self._lock_for(key):
+                window = SeriesWindow(s.ts, s.vals, s.start, len(s.ts))
+            out.append((dict(labels), window))
+        return out
+
+    def _matching_series_legacy(self, matchers):
+        """Pre-ring read path for honest benchmarking: the whole scan holds
+        ONE lock (readers serialize) and every matched series' samples are
+        materialized into a fresh copy."""
+        with self._mu:
             out = []
-            for labels, samples in entries:
-                if all(_match(labels.get(lbl, ""), op, val) for lbl, op, val in matchers):
-                    out.append((dict(labels), list(samples)))
+            for key, s in self._series.items():
+                labels = s.labels
+                if not all(_match(labels.get(lbl, ""), op, val)
+                           for lbl, op, val in matchers):
+                    continue
+                with self._lock_for(key):
+                    window = SeriesWindow(s.ts[s.start:], s.vals[s.start:],
+                                          0, len(s.ts) - s.start)
+                out.append((dict(labels), window))
             return out
 
 
@@ -158,9 +368,9 @@ def _match(actual: str, op: str, expected: str) -> bool:
     if op == "!=":
         return actual != expected
     if op == "=~":
-        return re.fullmatch(expected, actual) is not None
+        return _compiled_re(expected).fullmatch(actual) is not None
     if op == "!~":
-        return re.fullmatch(expected, actual) is None
+        return _compiled_re(expected).fullmatch(actual) is None
     raise PromQLError(f"unknown matcher op {op!r}")
 
 
@@ -380,6 +590,43 @@ def parse_query(text: str):
     return _Parser(text).parse()
 
 
+# --- AST -> PromQL serialization (the grouped-collection rewriter's other
+# half: transformed ASTs must round-trip to query strings any Prometheus —
+# real or this subset engine — accepts) ---
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def to_promql(node) -> str:
+    """Serialize a (possibly transformed) AST back to PromQL text. Inverse
+    of :func:`parse_query` up to whitespace/duration normalization."""
+    if isinstance(node, NumberLiteral):
+        v = node.value
+        return str(int(v)) if float(v).is_integer() else repr(v)
+    if isinstance(node, Selector):
+        out = node.name
+        if node.matchers:
+            body = ",".join(f'{lbl}{op}"{_escape_label_value(val)}"'
+                            for lbl, op, val in node.matchers)
+            out += "{" + body + "}"
+        if node.range_seconds > 0:
+            out += f"[{format_promql_duration(node.range_seconds)}]"
+        return out
+    if isinstance(node, FuncCall):
+        return f"{node.func}({to_promql(node.arg)})"
+    if isinstance(node, Aggregation):
+        by = f" by ({', '.join(node.by)})" if node.by else ""
+        return f"{node.op}{by} ({to_promql(node.arg)})"
+    if isinstance(node, BinaryOp):
+        def operand(child) -> str:
+            text = to_promql(child)
+            return f"({text})" if isinstance(child, BinaryOp) else text
+        joiner = " or " if node.op == "or" else " / "
+        return operand(node.left) + joiner + operand(node.right)
+    raise PromQLError(f"cannot serialize node {node!r}")
+
+
 # --- Evaluator ---
 
 def _series_identity(labels: dict[str, str]) -> tuple:
@@ -442,26 +689,51 @@ class PromQLEngine:
     def _eval_instant(self, sel: Selector, now: float) -> list[SeriesPoint]:
         if sel.range_seconds > 0:
             raise PromQLError(f"range selector {sel.name} needs a function")
+        legacy = self.db.legacy_reads
         out = []
-        for labels, samples in self._select(sel):
-            latest = _latest_at_or_before(samples, now)
+        for labels, window in self._select(sel):
+            if legacy:
+                # Pre-ring shape: linear scan with per-sample objects.
+                latest = None
+                for s in window:
+                    if s.timestamp <= now:
+                        latest = s
+                    else:
+                        break
+            else:
+                latest = window.latest_at_or_before(now)
             if latest is None or now - latest.timestamp > self.lookback:
                 continue
             out.append(SeriesPoint(labels, latest.value, latest.timestamp))
         return out
 
     def _eval_range_func(self, call: FuncCall, now: float) -> list[SeriesPoint]:
-        window = call.arg.range_seconds
+        window_len = call.arg.range_seconds
+        legacy = self.db.legacy_reads
         out = []
-        for labels, samples in self._select(call.arg):
-            in_window = [s for s in samples if now - window <= s.timestamp <= now]
-            if not in_window:
-                continue
-            val = _apply_range_func(call.func, in_window, window)
+        for labels, window in self._select(call.arg):
+            if legacy:
+                # Pre-ring shape: full linear scan over every retained
+                # sample, materializing Sample objects for the window —
+                # the read-path cost `make bench-collect` measures as the
+                # honest before.
+                samples = [s for s in window
+                           if now - window_len <= s.timestamp <= now]
+                if not samples:
+                    continue
+                val = _apply_range_func_samples(call.func, samples,
+                                                window_len)
+                last_ts = samples[-1].timestamp
+            else:
+                in_window = window.range_window(now - window_len, now)
+                if not len(in_window):
+                    continue
+                val = _apply_range_func(call.func, in_window, window_len)
+                last_ts = in_window.ts[in_window.hi - 1]
             if val is None:
                 continue
             result_labels = {k: v for k, v in labels.items() if k != "__name__"}
-            out.append(SeriesPoint(result_labels, val, in_window[-1].timestamp))
+            out.append(SeriesPoint(result_labels, val, last_ts))
         return out
 
     def _eval_agg(self, agg: Aggregation, now: float) -> list[SeriesPoint]:
@@ -515,17 +787,43 @@ class PromQLEngine:
         raise PromQLError(f"unknown binary op {node.op!r}")
 
 
-def _latest_at_or_before(samples: list[Sample], now: float) -> Sample | None:
-    latest = None
-    for s in samples:
-        if s.timestamp <= now:
-            latest = s
-        else:
-            break
-    return latest
+def _apply_range_func(func: str, window: SeriesWindow,
+                      window_len: float) -> float | None:
+    ts, vals, lo, hi = window.ts, window.vals, window.lo, window.hi
+    if func == "max_over_time":
+        return max(vals[i] for i in range(lo, hi))
+    if func == "avg_over_time":
+        return sum(vals[i] for i in range(lo, hi)) / (hi - lo)
+    if func in ("rate", "increase"):
+        if hi - lo < 2:
+            return None
+        # Counter-reset handling: accumulate positive deltas.
+        total = 0.0
+        prev = vals[lo]
+        for i in range(lo + 1, hi):
+            v = vals[i]
+            delta = v - prev
+            total += delta if delta >= 0 else v
+            prev = v
+        span = ts[hi - 1] - ts[lo]
+        if span <= 0:
+            return None
+        # Prometheus-style bounded extrapolation: extend toward the window
+        # edges by at most ~one sample interval per side, so a series younger
+        # than the window isn't inflated to the full window.
+        window_start = ts[hi - 1] - window_len  # eval time ~ last sample
+        interval = span / (hi - lo - 1)
+        limit = interval * 1.1
+        extend_start = min(max(ts[lo] - window_start, 0.0), limit)
+        scaled = total * ((span + extend_start) / span)
+        return scaled / window_len if func == "rate" else scaled
+    raise PromQLError(f"unknown range function {func!r}")
 
 
-def _apply_range_func(func: str, samples: list[Sample], window: float) -> float | None:
+def _apply_range_func_samples(func: str, samples: list[Sample],
+                              window: float) -> float | None:
+    """Sample-list twin of :func:`_apply_range_func` — the pre-ring code
+    path, kept only for the ``legacy_reads`` bench lever. Same math."""
     values = [s.value for s in samples]
     if func == "max_over_time":
         return max(values)
@@ -534,7 +832,6 @@ def _apply_range_func(func: str, samples: list[Sample], window: float) -> float 
     if func in ("rate", "increase"):
         if len(samples) < 2:
             return None
-        # Counter-reset handling: accumulate positive deltas.
         total = 0.0
         prev = samples[0].value
         for s in samples[1:]:
@@ -544,10 +841,7 @@ def _apply_range_func(func: str, samples: list[Sample], window: float) -> float 
         span = samples[-1].timestamp - samples[0].timestamp
         if span <= 0:
             return None
-        # Prometheus-style bounded extrapolation: extend toward the window
-        # edges by at most ~one sample interval per side, so a series younger
-        # than the window isn't inflated to the full window.
-        window_start = samples[-1].timestamp - window  # eval time ~ last sample
+        window_start = samples[-1].timestamp - window
         interval = span / (len(samples) - 1)
         limit = interval * 1.1
         extend_start = min(max(samples[0].timestamp - window_start, 0.0), limit)

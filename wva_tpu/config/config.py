@@ -53,6 +53,12 @@ class InfrastructureConfig:
     # merge in sorted model-key order at any width, so decisions stay
     # byte-deterministic.
     engine_analysis_workers: int = 0
+    # Grouped per-tick metrics collection (WVA_GROUPED_COLLECTION /
+    # wva.groupedCollection): ONE fleet-wide backend query per registered
+    # template per engine tick, demuxed per (model, namespace), instead of
+    # ~10 queries per model. Off reproduces the per-model fan-out (the
+    # bench-collect baseline); results are byte-identical either way.
+    grouped_collection: bool = True
 
 
 @dataclass
@@ -75,6 +81,10 @@ class PrometheusConfig:
     client_cert_path: str = ""
     client_key_path: str = ""
     server_name: str = ""
+    # GET /api/v1/query instead of the default POST form body — for
+    # read-only proxies that reject POST. POST is the default because
+    # fleet-wide grouped queries can exceed practical URL length limits.
+    use_get_queries: bool = False
     cache: CacheConfig | None = None
 
 
@@ -159,6 +169,10 @@ class Config:
         metrics backend: pooled for HTTP Prometheus, serial for in-memory)."""
         with self._mu:
             return max(0, self.infrastructure.engine_analysis_workers)
+
+    def grouped_collection_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.grouped_collection
 
     def rest_timeout(self) -> float:
         with self._mu:

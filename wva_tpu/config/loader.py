@@ -58,6 +58,10 @@ DEFAULTS: dict[str, Any] = {
     "EPP_METRIC_READER_BEARER_TOKEN": "",
     "GLOBAL_OPT_INTERVAL": "60s",
     "ENGINE_ANALYSIS_WORKERS": 0,  # 0 = auto (pooled for HTTP, serial in-mem)
+    # One fleet-wide query per template per tick (vs per-model fan-out).
+    "WVA_GROUPED_COLLECTION": True,
+    # GET /api/v1/query instead of POST (read-only proxies).
+    "PROMETHEUS_USE_GET_QUERIES": False,
 }
 
 
@@ -150,6 +154,7 @@ def load(flags: Mapping[str, Any] | None = None,
         logger_verbosity=r.get_int("V"),
         optimization_interval=r.get_duration("GLOBAL_OPT_INTERVAL"),
         engine_analysis_workers=max(0, r.get_int("ENGINE_ANALYSIS_WORKERS")),
+        grouped_collection=r.get_bool("WVA_GROUPED_COLLECTION"),
     )
     cfg.tls = TLSConfig(
         webhook_cert_path=r.get_str("WEBHOOK_CERT_PATH"),
@@ -182,6 +187,7 @@ def load(flags: Mapping[str, Any] | None = None,
         client_cert_path=r.get_str("PROMETHEUS_CLIENT_CERT_PATH"),
         client_key_path=r.get_str("PROMETHEUS_CLIENT_KEY_PATH"),
         server_name=r.get_str("PROMETHEUS_SERVER_NAME"),
+        use_get_queries=r.get_bool("PROMETHEUS_USE_GET_QUERIES"),
         cache=_parse_cache_config(r),
     )
     cfg.set_prometheus(prom)

@@ -36,6 +36,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 — http.server API
+        self._handle(body=b"")
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server API
+        # Real Prometheus accepts form-encoded POST on /api/v1/query (the
+        # transport's default since grouped fleet-wide queries can exceed
+        # URL limits); the facade must parse the body, not just the URL.
+        length = int(self.headers.get("Content-Length") or 0)
+        self._handle(body=self.rfile.read(length) if length else b"")
+
+    def _handle(self, body: bytes) -> None:
         parsed = urllib.parse.urlparse(self.path)
         if parsed.path == "/-/healthy":
             self._send_json(200, {"status": "success"})
@@ -43,7 +53,11 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed.path != "/api/v1/query":
             self._send_json(404, {"status": "error", "error": "not found"})
             return
-        query = urllib.parse.parse_qs(parsed.query).get("query", [""])[0]
+        form = urllib.parse.parse_qs(body.decode("utf-8", "replace")) \
+            if body else {}
+        query = (form.get("query")
+                 or urllib.parse.parse_qs(parsed.query).get("query")
+                 or [""])[0]
         try:
             points = self.server.query(query)
         except Exception as e:  # noqa: BLE001 — surfaced as API error
@@ -61,8 +75,6 @@ class _Handler(BaseHTTPRequestHandler):
                 ],
             },
         })
-
-    do_POST = do_GET
 
 
 class FakePrometheusServer:

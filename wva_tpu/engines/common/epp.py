@@ -20,6 +20,7 @@ from wva_tpu.constants import (
 )
 from wva_tpu.datastore import Datastore, PoolNotFoundError
 from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.utils.oncemap import OnceMap
 
 log = logging.getLogger(__name__)
 
@@ -56,10 +57,34 @@ def resolve_pool_name(client: KubeClient, datastore: Datastore,
     return pool.name
 
 
-def scrape_pool(datastore: Datastore, pool_name: str):
+class ScrapeMemo:
+    """Tick-scoped EPP scrape fan-in: N models sharing one InferencePool
+    scrape its EPP pods ONCE per detection pass instead of once per model
+    (the same O(models) -> O(pools) collapse the grouped metrics view does
+    for PromQL templates). Thread-safe — scale-from-zero processes
+    candidates on a worker pool — with per-pool latches so concurrent
+    callers for the same pool wait instead of duplicating the scrape."""
+
+    def __init__(self) -> None:
+        self._once = OnceMap()
+
+    def get_or_scrape(self, datastore: Datastore, pool_name: str):
+        return self._once.get_or_compute(
+            pool_name, lambda: _scrape_pool_once(datastore, pool_name))
+
+
+def scrape_pool(datastore: Datastore, pool_name: str,
+                memo: ScrapeMemo | None = None):
     """Refresh the pool's EPP pod-scrape source and return the sample list,
     or None when the source is missing / the scrape failed (per-tick
-    isolation — callers skip and retry next pass)."""
+    isolation — callers skip and retry next pass). ``memo`` (tick-scoped)
+    collapses repeat scrapes of the same pool within one pass."""
+    if memo is not None:
+        return memo.get_or_scrape(datastore, pool_name)
+    return _scrape_pool_once(datastore, pool_name)
+
+
+def _scrape_pool_once(datastore: Datastore, pool_name: str):
     source = datastore.pool_get_metrics_source(pool_name)
     if source is None:
         return None

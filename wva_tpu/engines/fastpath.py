@@ -34,6 +34,7 @@ from wva_tpu.collector.source.source import MetricsSource
 from wva_tpu.config import Config
 from wva_tpu.datastore import Datastore
 from wva_tpu.engines.common.epp import (
+    ScrapeMemo,
     flow_control_backlog,
     resolve_pool_name,
     scrape_pool,
@@ -110,7 +111,7 @@ class FastPathMonitor:
         by_model = variant_utils.group_variant_autoscalings_by_model(active)
         # Per-pass memos: one config resolve per namespace, one EPP scrape
         # per InferencePool (models sharing a pool share the scrape).
-        scrape_memo: dict[str, object] = {}
+        scrape_memo = ScrapeMemo()
         cfg_memo: dict[str, object] = {}
         for vas in by_model.values():
             va = vas[0]
@@ -155,7 +156,7 @@ class FastPathMonitor:
     # -- internals --
 
     def _model_backlog(self, va, now: float,
-                       scrape_memo: dict) -> float | None:
+                       scrape_memo: ScrapeMemo) -> float | None:
         """Scheduler flow-control backlog for the VA's model via its pool's
         EPP scrape source; None when the pool/scrape is unavailable.
         The target->pool resolution is TTL-cached and the per-pool scrape is
@@ -173,9 +174,7 @@ class FastPathMonitor:
             self._pool_cache[cache_key] = (pool_name, now + POOL_RESOLVE_TTL)
         if pool_name is None:
             return None
-        if pool_name not in scrape_memo:
-            scrape_memo[pool_name] = scrape_pool(self.datastore, pool_name)
-        values = scrape_memo[pool_name]
+        values = scrape_pool(self.datastore, pool_name, memo=scrape_memo)
         if values is None:
             return None
         return flow_control_backlog(values, va.spec.model_id)

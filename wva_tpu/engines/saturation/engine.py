@@ -41,6 +41,7 @@ from wva_tpu.api.v1alpha1 import (
     VariantAutoscaling,
 )
 from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
+from wva_tpu.collector.source.grouped import GroupedMetricsView
 from wva_tpu.config import Config
 from wva_tpu.constants import TPU_RESOURCE_NAME
 from wva_tpu.engines import common
@@ -170,18 +171,22 @@ class SaturationEngine:
         # opens one cycle record per tick; the engine and pipeline stages
         # fill it with analyzer inputs/outputs, decisions, and actuation.
         self.flight = flight_recorder
-        # Fleet-scale tick levers (docs/design/tick-scale.md). All three are
-        # independently toggleable so `make bench-tick` can reproduce the
-        # pre-change serial loop (snapshot off, workers 1, batching off)
-        # against the same world:
+        # Fleet-scale tick levers (docs/design/tick-scale.md +
+        # docs/design/metrics-plane.md). All are independently toggleable so
+        # `make bench-tick` / `make bench-collect` can reproduce the
+        # pre-change loop against the same world:
         # - tick_snapshot_enabled: one LIST per kind per tick instead of
         #   per-VA GETs (SnapshotKubeClient);
         # - analysis_workers: bounded pool for per-model prepare->analyze;
         # - solver_batching: one jitted sizing call across every model's
-        #   candidates in the SLO path instead of one dispatch per model.
+        #   candidates in the SLO path instead of one dispatch per model;
+        # - grouped_collection: ONE fleet-wide backend query per registered
+        #   template per tick (GroupedMetricsView) instead of ~10 queries
+        #   per model (WVA_GROUPED_COLLECTION / wva.groupedCollection).
         self.analysis_workers = max(1, int(analysis_workers))
         self.tick_snapshot_enabled = True
         self.solver_batching = True
+        self.grouped_collection = True
         self._analysis_pool: ThreadPoolExecutor | None = None
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock,
@@ -214,6 +219,23 @@ class SaturationEngine:
         if n_vas < SNAPSHOT_LIST_MIN_VAS:
             snap.use_targeted_gets(("Deployment", "LeaderWorkerSet"))
         return snap
+
+    def _tick_collector(self) -> ReplicaMetricsCollector:
+        """The tick's metrics read view: the shared collector rebound to a
+        fresh GroupedMetricsView, so every per-model query this tick is
+        served by demuxing ONE fleet-wide query per template
+        (docs/design/metrics-plane.md) — or the collector unchanged when
+        the lever is off / the source has no grouped substrate."""
+        source = self.collector.source
+        if (self.grouped_collection
+                and getattr(source, "supports_grouped_collection", False)):
+            # A namespace-scoped controller's fleet-wide queries keep the
+            # watch namespace as an equality matcher (shared Prometheus:
+            # never aggregate other tenants' series).
+            view = GroupedMetricsView(
+                source, scope_namespace=self.config.watch_namespace() or "")
+            return self.collector.scoped(view)
+        return self.collector
 
     def _map_models(self, model_groups: dict, fn, affinity=None) -> dict:
         """Run ``fn(group_key, model_vas)`` for every model, across the
@@ -275,6 +297,21 @@ class SaturationEngine:
         # API requests per tick regardless of fleet size, and a consistent
         # view for every model's analysis.
         snap = self._tick_client()
+        # Tick-scoped metrics view, same idea on the metrics plane: one
+        # fleet-wide backend query per registered template, demuxed to
+        # every model (instead of ~10 backend queries per model per tick).
+        # The enforcer's scale-to-zero request counts ride the same view
+        # (enforcement runs on this thread only; cleared in the finally).
+        collector = self._tick_collector()
+        if collector is not self.collector:
+            self.enforcer.metrics_source = collector.source
+        try:
+            self._optimize_with(snap, collector)
+        finally:
+            self.enforcer.metrics_source = None
+
+    def _optimize_with(self, snap: KubeClient,
+                       collector: ReplicaMetricsCollector) -> None:
         active_vas = variant_utils.active_variant_autoscalings(
             snap, namespace=self.config.watch_namespace() or None)
         if not active_vas:
@@ -307,9 +344,11 @@ class SaturationEngine:
         # analyzer producing req/s capacities instead of token capacities.
         if analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
             decisions = self._optimize_v2(
-                model_groups, snap, use_slo=analyzer_name == SLO_ANALYZER_NAME)
+                model_groups, snap, use_slo=analyzer_name == SLO_ANALYZER_NAME,
+                collector=collector)
         else:
-            decisions = self._optimize_v1(model_groups, snap)
+            decisions = self._optimize_v1(model_groups, snap,
+                                          collector=collector)
 
         if self.flight is not None:
             self.flight.record_decisions(decisions)
@@ -320,7 +359,9 @@ class SaturationEngine:
     def _optimize_v1(
         self, model_groups: dict[str, list[VariantAutoscaling]],
         snap: KubeClient,
+        collector: ReplicaMetricsCollector | None = None,
     ) -> list[VariantDecision]:
+        collector = collector or self.collector
         # Stage 1 — per-model prepare + analyze, fanned across the worker
         # pool. Workers only touch thread-safe state (snapshot reads,
         # collector refresh, the stateless V1 analyzer); exceptions from
@@ -336,7 +377,8 @@ class SaturationEngine:
                          "skipping model %s", namespace, model_id)
                 return ("skip", None)
             try:
-                data = self._prepare_model_data(model_id, model_vas, snap)
+                data = self._prepare_model_data(model_id, model_vas, snap,
+                                                collector=collector)
             except Exception as e:  # noqa: BLE001 — per-model isolation
                 return ("safety-net", e)
             if data is None:
@@ -405,7 +447,9 @@ class SaturationEngine:
         self, model_groups: dict[str, list[VariantAutoscaling]],
         snap: KubeClient,
         use_slo: bool = False,
+        collector: ReplicaMetricsCollector | None = None,
     ) -> list[VariantDecision]:
+        collector = collector or self.collector
         requests: list[ModelScalingRequest] = []
         # Optimizer route per (model, namespace), resolved ONCE from the
         # same sat_cfg snapshot the analysis used — the trace record and the
@@ -441,18 +485,20 @@ class SaturationEngine:
                 return ("skip", None)
             sat_cfg.apply_defaults()
             try:
-                data = self._prepare_model_data(model_id, model_vas, snap)
+                data = self._prepare_model_data(model_id, model_vas, snap,
+                                                collector=collector)
             except Exception as e:  # noqa: BLE001 — per-model isolation
                 return ("safety-net", ("Model data preparation", e))
             if data is None:
                 return ("skip", None)
-            scheduler_queue = self.collector.collect_scheduler_queue_metrics(
+            scheduler_queue = collector.collect_scheduler_queue_metrics(
                 model_id)
             try:
                 if use_slo:
                     out = self._prepare_slo_plan(
                         model_id, namespace, data, sat_cfg,
-                        slo_cfg_by_ns.get(namespace), scheduler_queue)
+                        slo_cfg_by_ns.get(namespace), scheduler_queue,
+                        collector=collector)
                 else:
                     out = self._run_v2_analysis(
                         model_id, namespace, data, sat_cfg, scheduler_queue)
@@ -902,17 +948,19 @@ class SaturationEngine:
 
     def _prepare_slo_plan(self, model_id: str, namespace: str, data: _ModelData,
                           sat_cfg: SaturationScalingConfig, slo_cfg,
-                          scheduler_queue=None):
+                          scheduler_queue=None, collector=None):
         """SLO path, worker half: attach the model's arrival-rate telemetry,
         feed the tuner, and prepare the sizing plan (candidates) with the
         namespace's resolved SLO config (profiles were synced once for the
         namespace at tick start). The device sizing call happens ONCE per
         tick across every model's plan (see ``_optimize_v2``), and
         ``finalize`` runs on the engine thread."""
+        collector = collector or self.collector
         optimizer_metrics = collect_optimizer_metrics(
-            self.collector.source, model_id, namespace)
+            collector.source, model_id, namespace)
         if slo_cfg is not None and slo_cfg.tuner_enabled:
-            self._feed_slo_tuner(model_id, namespace, data, optimizer_metrics)
+            self._feed_slo_tuner(model_id, namespace, data, optimizer_metrics,
+                                 collector=collector)
         return self.slo_analyzer.prepare(AnalyzerInput(
             model_id=model_id, namespace=namespace,
             replica_metrics=data.replica_metrics,
@@ -924,7 +972,7 @@ class SaturationEngine:
         ))
 
     def _feed_slo_tuner(self, model_id: str, namespace: str, data: _ModelData,
-                        optimizer_metrics) -> None:
+                        optimizer_metrics, collector=None) -> None:
         """One EKF step per accelerator from live TTFT/ITL telemetry; the
         refined alpha/beta/gamma land in the shared PerfProfileStore.
 
@@ -936,12 +984,13 @@ class SaturationEngine:
         e.g. a Prometheus without the per-pod histogram series."""
         if optimizer_metrics is None:
             return
+        collector = collector or self.collector
         by_accel: dict[str, list[ReplicaMetrics]] = {}
         for rm in data.replica_metrics:
             if rm.accelerator_name:
                 by_accel.setdefault(rm.accelerator_name, []).append(rm)
         per_accel = collect_accelerator_telemetry(
-            self.collector.source, model_id, namespace,
+            collector.source, model_id, namespace,
             {rm.pod_name: rm.accelerator_name
              for rm in data.replica_metrics
              if rm.pod_name and rm.accelerator_name})
@@ -1016,14 +1065,17 @@ class SaturationEngine:
     def _prepare_model_data(
         self, model_id: str, model_vas: list[VariantAutoscaling],
         client: KubeClient | None = None,
+        collector: ReplicaMetricsCollector | None = None,
     ) -> _ModelData | None:
         """Collect metrics + build lookup maps (reference engine.go:677-803).
         Returns None when no metrics are available (skip the model).
-        ``client`` is the tick's snapshot view (falls back to the live
-        client for direct callers like the fast path)."""
+        ``client`` is the tick's snapshot view and ``collector`` the tick's
+        grouped-collection view (both fall back to the live objects for
+        direct callers like the fast path)."""
         if not model_vas:
             raise ValueError(f"no VAs provided for model {model_id}")
         client = client or self.client
+        collector = collector or self.collector
         namespace = model_vas[0].metadata.namespace
 
         # Targets of any scalable kind (Deployment, LeaderWorkerSet); keyed
@@ -1048,7 +1100,7 @@ class SaturationEngine:
             deployments[namespaced_key(va.metadata.namespace,
                                        target.metadata.name)] = target
 
-        replica_metrics = self.collector.collect_replica_metrics(
+        replica_metrics = collector.collect_replica_metrics(
             model_id, namespace, deployments, variant_autoscalings, variant_costs)
         if not replica_metrics:
             log.debug("No replica metrics for model %s", model_id)

@@ -26,6 +26,7 @@ from wva_tpu.config import Config
 from wva_tpu.datastore import Datastore
 from wva_tpu.engines import common
 from wva_tpu.engines.common.epp import (
+    ScrapeMemo,
     flow_control_backlog,
     resolve_pool_name,
     scrape_pool,
@@ -70,14 +71,19 @@ class ScaleFromZeroEngine:
         by_model = variant_utils.group_variant_autoscalings_by_model(inactive)
         candidates = [min(vas, key=lambda va: (va.spec.cost(), va.metadata.name))
                       for vas in by_model.values()]
+        # Tick-scoped scrape fan-in: candidates whose models share an
+        # InferencePool hit its EPP pods once per pass, not once each.
+        memo = ScrapeMemo()
         max_workers = max(self.config.scale_from_zero_max_concurrency(), 1)
         if len(candidates) == 1:
-            self._process_inactive_variant(candidates[0])
+            self._process_inactive_variant(candidates[0], memo)
             return
         with ThreadPoolExecutor(max_workers=min(max_workers, len(candidates))) as pool:
-            list(pool.map(self._process_inactive_variant, candidates))
+            list(pool.map(lambda va: self._process_inactive_variant(va, memo),
+                          candidates))
 
-    def _process_inactive_variant(self, va: VariantAutoscaling) -> None:
+    def _process_inactive_variant(self, va: VariantAutoscaling,
+                                  memo: ScrapeMemo | None = None) -> None:
         """Check queued requests for the VA's model; scale 0->1 when present
         (reference engine.go:198-358). The target->pool->scrape chain is the
         shared engines.common.epp helper (the fast path walks the same one)."""
@@ -86,7 +92,7 @@ class ScaleFromZeroEngine:
             va.metadata.namespace, va.spec.scale_target_ref.name)
         if pool_name is None:
             return
-        values = scrape_pool(self.datastore, pool_name)
+        values = scrape_pool(self.datastore, pool_name, memo=memo)
         if values is None:
             return
 

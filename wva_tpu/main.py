@@ -260,9 +260,15 @@ def build_manager(
     actuator = Actuator(client, registry)
     direct_actuator = DirectActuator(client)
 
-    enforcer = Enforcer(
-        lambda model_id, namespace, retention: collect_model_request_count(
-            prom_source, model_id, namespace, retention))
+    def request_count(model_id, namespace, retention, source=None):
+        # ``source`` is the engine's tick-scoped GroupedMetricsView when
+        # grouped collection is on (one fleet-wide request-count query per
+        # tick instead of one per model); the raw source otherwise.
+        return collect_model_request_count(
+            source or prom_source, model_id, namespace, retention)
+
+    request_count.supports_source = True
+    enforcer = Enforcer(request_count)
 
     discovery = TPUSliceDiscovery(client)
     limiter = DefaultLimiter("tpu-slice-limiter", SliceInventory(discovery),
@@ -296,6 +302,7 @@ def build_manager(
         direct_actuator=direct_actuator, recorder=recorder,
         flight_recorder=flight,
         analysis_workers=workers)
+    engine.grouped_collection = config.grouped_collection_enabled()
     if flight is not None:
         engine.optimizer.flight_recorder = flight
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,

@@ -32,7 +32,10 @@ log = logging.getLogger(__name__)
 SCALE_TO_ZERO_REASON = "scale-to-zero: no requests within retention"
 
 # (model_id, namespace, retention_seconds) -> request count; raises when the
-# count cannot be determined.
+# count cannot be determined. A callback that can route through a
+# tick-scoped metrics view (grouped collection) additionally accepts
+# ``source=`` and declares it by setting ``supports_source = True`` on
+# itself — older callbacks (replay harness, tests) need no change.
 RequestCountFunc = Callable[[str, str, float], float]
 
 
@@ -43,6 +46,12 @@ class Enforcer:
         # call records its request-count observation and outcome — replay
         # re-feeds the recorded count instead of querying a collector.
         self.flight_recorder = None
+        # Tick-scoped metrics source override (the engine's
+        # GroupedMetricsView): set for the duration of one engine tick so
+        # the scale-to-zero request count rides the same fleet-wide grouped
+        # query as everything else. Enforcement runs on the engine thread
+        # only, so a plain attribute is race-free.
+        self.metrics_source = None
 
     def enforce_policy(
         self,
@@ -86,7 +95,14 @@ class Enforcer:
         if trace is not None:
             trace["retention"] = retention
         try:
-            count = self.request_count_func(model_id, namespace, retention)
+            if (self.metrics_source is not None
+                    and getattr(self.request_count_func,
+                                "supports_source", False)):
+                count = self.request_count_func(
+                    model_id, namespace, retention,
+                    source=self.metrics_source)
+            else:
+                count = self.request_count_func(model_id, namespace, retention)
         except Exception as e:  # noqa: BLE001 — fail-safe boundary
             if trace is not None:
                 trace["error"] = str(e)

@@ -1,0 +1,43 @@
+"""Compute-once-per-key fan-in shared by the tick-scoped memo views
+(GroupedMetricsView's fleet-wide queries, the EPP ScrapeMemo)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class OnceMap:
+    """The first caller for a key runs ``compute`` while concurrent callers
+    for the same key wait on a latch and share the result; later callers
+    get the memoized value. Instances are tick-scoped — nothing expires.
+
+    If ``compute`` raises, ``None`` is memoized (waiters and later callers
+    see the empty result; the tick retries next time) and the exception
+    propagates to the computing caller."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._results: dict[object, object] = {}
+        self._latches: dict[object, threading.Event] = {}
+
+    def get_or_compute(self, key, compute: Callable[[], T]) -> T:
+        while True:
+            with self._mu:
+                if key in self._results:
+                    return self._results[key]  # type: ignore[return-value]
+                latch = self._latches.get(key)
+                if latch is None:
+                    self._latches[key] = threading.Event()
+                    break
+            latch.wait()
+        result: object = None
+        try:
+            result = compute()
+        finally:
+            with self._mu:
+                self._results[key] = result
+                self._latches.pop(key).set()
+        return result  # type: ignore[return-value]
