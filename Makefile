@@ -55,6 +55,17 @@ test-replay: ## Fast decision-trace record/replay test lane (pytest -m replay).
 .PHONY: replay-golden
 replay-golden: ## Replay the committed golden decision trace (must be zero diffs).
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/decision_trace_v1.jsonl
+	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/forecast_trace_v1.jsonl
+
+.PHONY: backtest-golden
+backtest-golden: ## Backtest every forecaster on the committed golden forecast trace and gate against the committed report (MAPE + under/over-provision cost; a seasonal forecaster must keep beating the linear baseline).
+	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu forecast backtest \
+		tests/goldens/forecast_trace_v1.jsonl --lead 90 --period 600 \
+		--grid-step 5 --golden tests/goldens/forecast_backtest_v1.json
+
+.PHONY: bench-forecast
+bench-forecast: ## Forecast-plane microbench (48 models): batched vs serial forecaster fit time per tick; merges detail.forecast into BENCH_LOCAL.json.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --forecast-only
 
 .PHONY: verify-deploy-pipeline
 verify-deploy-pipeline: ## Static-check the deploy pipeline (scripts parse, manifests render, Dockerfile paths exist).

@@ -1025,6 +1025,76 @@ def collect_scale_bench(n_models: int = 48, measured_ticks: int = 10,
     }
 
 
+def forecast_scale_bench(n_models: int = 48, measured_ticks: int = 30,
+                         period: float = 600.0) -> dict:
+    """Forecast-plane microbench: per-tick forecaster fit cost at fleet
+    scale, batched (ONE padded jitted call across all models — the engine's
+    production path) vs serial (one call per model — the pre-batching
+    shape). Series are seeded diurnal cycles with distinct phases so every
+    model's fit does real work; results are asserted equal so the speedup
+    compares identical outputs."""
+    import statistics
+
+    from wva_tpu.emulator.loadgen import diurnal
+    from wva_tpu.forecast import forecasters as fc
+    from wva_tpu.forecast.history import DemandHistoryStore
+
+    long_step = period / fc.SEASON_STEPS
+    grid_step = 5.0
+    store = DemandHistoryStore(window_seconds=long_step * fc.N_GRID,
+                               fine_window_seconds=grid_step * fc.N_GRID,
+                               long_gap_seconds=long_step / 2.0)
+    t_end = 3000.0
+    for m in range(n_models):
+        load = diurnal(base_rate=4.0 + 2.0 * m / n_models, amplitude=10.0,
+                       period=period, phase=period * m / n_models)
+        for i in range(int(t_end / grid_step)):
+            t = i * grid_step
+            store.observe(f"ns|model-{m:03d}", t, load(t))
+
+    def grids(now: float):
+        out = []
+        for m in range(n_models):
+            w = store.windows(f"ns|model-{m:03d}")
+            fine, nf = fc.resample(w[0], now, grid_step)
+            longg, nl = fc.resample(w[1], now, long_step)
+            out.append(fc.SeriesGrids(
+                fine=fine, fine_valid=nf, long=longg, long_valid=nl,
+                h_fine_steps=120.0 / grid_step,
+                h_long_steps=120.0 / long_step,
+                season_steps=fc.SEASON_STEPS))
+        return out
+
+    # Warm both compilation caches off the clock.
+    warm = grids(t_end)
+    fc.fit_batch(warm)
+    fc.fit_serial(warm[:1])
+
+    batched_ms, serial_ms = [], []
+    for tick in range(measured_ticks):
+        g = grids(t_end + tick * 15.0)
+        t0 = time.perf_counter()
+        b = fc.fit_batch(g)
+        batched_ms.append((time.perf_counter() - t0) * 1000.0)
+        t0 = time.perf_counter()
+        s = fc.fit_serial(g)
+        serial_ms.append((time.perf_counter() - t0) * 1000.0)
+        assert b == s, "batched and serial fits diverged"
+
+    p50 = statistics.median
+    return {
+        "n_models": n_models,
+        "measured_ticks": measured_ticks,
+        "forecasters": list(fc.FORECASTERS),
+        "grid_columns": fc.N_GRID,
+        "batched_fit_ms_p50": round(p50(batched_ms), 3),
+        "serial_fit_ms_p50": round(p50(serial_ms), 3),
+        "batched_speedup": round(p50(serial_ms) / max(p50(batched_ms), 1e-9),
+                                 2),
+        "outputs_identical": True,
+    }
+
+
 def solver_microbench() -> dict:
     """The flagship compiled computation on the default JAX platform (the
     real chip under the driver): batched SLO sizing throughput.
@@ -1333,6 +1403,24 @@ def collect_main() -> None:
     }))
 
 
+def forecast_main() -> None:
+    """`make bench-forecast` / `bench.py --forecast-only`: forecaster-fit
+    cost per tick at 48 models, batched vs serial, merged into
+    BENCH_LOCAL.json detail.forecast, one JSON line on stdout."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    record = forecast_scale_bench()
+    record["bench_wall_seconds"] = round(time.time() - t0, 1)
+    _merge_bench_local("forecast", record)
+    print(json.dumps({
+        "metric": "forecast_fit_ms_per_tick_48_models",
+        "value": record["batched_fit_ms_p50"],
+        "unit": "ms_p50_per_tick",
+        "vs_baseline": record["batched_speedup"],
+        "detail": record,
+    }))
+
+
 def main() -> None:
     t0 = time.time()
     device_probe = _ensure_healthy_device()
@@ -1450,5 +1538,7 @@ if __name__ == "__main__":
         tick_main()
     elif "--collect-only" in sys.argv:
         collect_main()
+    elif "--forecast-only" in sys.argv:
+        forecast_main()
     else:
         main()

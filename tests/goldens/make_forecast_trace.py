@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Regenerate the committed golden forecast trace + backtest report
+(``tests/goldens/forecast_trace_v1.jsonl`` /
+``tests/goldens/forecast_backtest_v1.json``).
+
+Run from the repo root (CPU platform, like the test suite):
+
+    JAX_PLATFORMS=cpu python tests/goldens/make_forecast_trace.py
+
+The scenario is a deliberately SEASONAL world: one Llama variant on v5e-8
+under a compressed diurnal cycle (period 600s instead of 24h — same
+seasonal-fit machinery, simulated seconds instead of hours), V2 token
+analyzer, forecast planner ON with the period declared. The committed
+artifacts anchor two gates:
+
+- ``make replay-golden`` territory: the trace carries ``forecast`` stage
+  events (plans + applied floors) and must replay to ZERO diffs
+  (tests/test_forecast.py);
+- ``make backtest-golden``: the backtest CLI's per-forecaster MAPE +
+  under/over-provision costs on this trace must match the committed
+  report, and a seasonal forecaster must beat the linear-trend baseline.
+
+Regenerate only on a deliberate, reviewed change to the forecaster
+numerics or the trace schema — and say so in the commit message.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TRACE = os.path.join(HERE, "forecast_trace_v1.jsonl")
+REPORT = os.path.join(HERE, "forecast_backtest_v1.json")
+SEED = 20260804
+
+PERIOD = 600.0  # compressed "day"
+LEAD = 90.0
+DURATION = 2400.0  # four full cycles
+
+
+def main() -> None:
+    from wva_tpu.config import ForecastConfig, new_test_config
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        diurnal,
+    )
+    from wva_tpu.forecast.backtest import backtest_cli
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    if os.path.exists(TRACE):
+        os.remove(TRACE)  # the recorder appends; regeneration replaces
+    cfg = new_test_config()
+    cfg.set_forecast(ForecastConfig(
+        enabled=True, seasonal_period_seconds=PERIOD, grid_step_seconds=5.0,
+        default_lead_time_seconds=LEAD, min_trust_evals=2))
+    spec = VariantSpec(
+        name="llama-v5e", model_id="meta-llama/Llama-3.1-8B",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=1,
+        serving=ServingParams(engine="jetstream"),
+        load=diurnal(base_rate=2.0, amplitude=22.0, period=PERIOD),
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=60.0,
+                      sync_period_seconds=10.0))
+    harness = EmulationHarness(
+        [spec],
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation",
+            anticipation_horizon_seconds=LEAD),
+        config=cfg, startup_seconds=60.0, engine_interval=30.0,
+        stochastic_seed=SEED, trace_path=TRACE)
+    harness.run(DURATION)
+    print(f"wrote {TRACE}: "
+          f"{harness.flight_recorder.records_total} cycle records")
+
+    rc = backtest_cli([TRACE, "--lead", str(LEAD), "--period", str(PERIOD),
+                       "--grid-step", "5",
+                       "--golden", REPORT, "--update-golden"])
+    if rc != 0:
+        raise SystemExit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,856 @@
+"""Predictive capacity planner (docs/design/forecast.md).
+
+Covers the forecast plane end to end: the two-tier history store, the
+batched forecaster registry (batched == serial, byte-for-byte), measured
+lead times, the planner's trust/demotion guardrails, floor application
+order vs the limiter, the WVA_FORECAST off-switch (byte-identical to a
+planner-less engine), forecast stage events round-tripping through the
+blackbox schema (golden forecast trace replays at zero diffs), the
+scale-from-zero pre-wake, the backtest CLI golden gate, and the loadgen
+seasonality profiles."""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import pytest
+
+from wva_tpu.analyzers.trend import DemandTrend
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+from wva_tpu.blackbox.schema import STAGE_FORECAST, decode, encode
+from wva_tpu.collector.source import TimeSeriesDB
+from wva_tpu.config import ForecastConfig, new_test_config
+from wva_tpu.config.config import TraceConfig
+from wva_tpu.emulator.loadgen import diurnal, poisson_bursts
+from wva_tpu.forecast import (
+    CapacityPlanner,
+    DemandHistoryStore,
+    ForecastPlan,
+    LeadTimeEstimator,
+    apply_forecast_floors,
+)
+from wva_tpu.forecast import forecasters as fc
+from wva_tpu.interfaces import (
+    AnalyzerResult,
+    SaturationScalingConfig,
+    VariantCapacity,
+    VariantDecision,
+    VariantReplicaState,
+)
+from wva_tpu.k8s import (
+    Container,
+    Deployment,
+    DeploymentStatus,
+    FakeCluster,
+    Pod,
+    PodStatus,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from wva_tpu.main import build_manager
+from wva_tpu.pipeline import (
+    DefaultLimiter,
+    GreedyBySaturation,
+    ModelScalingRequest,
+    StaticInventory,
+)
+from wva_tpu.utils import FakeClock
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "goldens")
+FORECAST_TRACE = os.path.join(GOLDEN_DIR, "forecast_trace_v1.jsonl")
+FORECAST_REPORT = os.path.join(GOLDEN_DIR, "forecast_backtest_v1.json")
+
+NS = "inf"
+
+
+# --- loadgen seasonality profiles (satellite) ---
+
+
+def test_diurnal_profile_shape():
+    p = diurnal(base_rate=2.0, amplitude=10.0, period=600.0)
+    assert p(0.0) == pytest.approx(2.0)
+    assert p(300.0) == pytest.approx(12.0)  # peak half a period in
+    assert p(600.0) == pytest.approx(2.0)  # trough again
+    assert p(600.0 + 300.0) == pytest.approx(12.0)
+    assert min(p(t) for t in range(0, 1200, 7)) >= 0.0
+
+
+def test_poisson_bursts_deterministic_and_bursty():
+    a = poisson_bursts(1.0, 50.0, 30.0, 120.0, seed=7)
+    b = poisson_bursts(1.0, 50.0, 30.0, 120.0, seed=7)
+    ts = [t * 3.0 for t in range(800)]
+    va = [a(t) for t in ts]
+    assert va == [b(t) for t in ts], "same seed must replay identically"
+    assert set(va) == {1.0, 50.0}, "profile is base or burst, nothing else"
+    assert 0 < va.count(50.0) < len(va)
+    c = poisson_bursts(1.0, 50.0, 30.0, 120.0, seed=8)
+    assert [c(t) for t in ts] != va, "different seed, different bursts"
+
+
+# --- history store ---
+
+
+def test_history_store_two_tier_decimation_and_eviction():
+    store = DemandHistoryStore(window_seconds=1000.0,
+                               fine_window_seconds=100.0,
+                               long_gap_seconds=50.0)
+    for i in range(200):
+        store.observe("k", float(i * 5), float(i))
+    w = store.windows("k")
+    assert w is not None
+    fine, long_ring = w
+    # Fine ring holds only the fine window; long ring is decimated.
+    assert fine.ts[fine.lo] >= 995.0 - 100.0
+    assert len(long_ring) <= 1000.0 / 50.0 + 2
+    gaps = [long_ring.ts[i + 1] - long_ring.ts[i]
+            for i in range(long_ring.lo, long_ring.hi - 1)]
+    assert min(gaps) >= 50.0
+    # Out-of-order appends are dropped, not interleaved.
+    store.observe("k", 100.0, 1.0)
+    assert store.windows("k")[0].ts[fine.hi - 1] == 995.0
+    # Idle eviction is time-based.
+    assert store.evict_idle(995.0 + 1001.0) == 1
+    assert store.windows("k") is None
+
+
+def test_history_store_stats():
+    store = DemandHistoryStore(window_seconds=1000.0)
+    store.observe("a", 10.0, 1.0)
+    store.observe("a", 20.0, 2.0)
+    st = store.stats(30.0)
+    assert st["a"].samples_fine == 2
+    assert st["a"].staleness_seconds == pytest.approx(10.0)
+
+
+# --- forecaster registry ---
+
+
+def _sinusoid_grids(n_models: int, period: float = 600.0,
+                    lead: float = 120.0):
+    grids = []
+    long_step = period / fc.SEASON_STEPS
+    for m in range(n_models):
+        store = DemandHistoryStore(window_seconds=long_step * fc.N_GRID,
+                                   fine_window_seconds=15.0 * fc.N_GRID,
+                                   long_gap_seconds=long_step / 2.0)
+        phase = m * 37.0
+        for i in range(161):
+            t = 1000.0 + i * 15.0
+            d = 10.0 + (4.0 + m) * 0.5 * (
+                1 - math.cos(2 * math.pi * ((t - phase) % period) / period))
+            store.observe("k", t, d)
+        now = 1000.0 + 160 * 15.0
+        w = store.windows("k")
+        fine, nf = fc.resample(w[0], now, 15.0)
+        longg, nl = fc.resample(w[1], now, long_step)
+        grids.append(fc.SeriesGrids(
+            fine=fine, fine_valid=nf, long=longg, long_valid=nl,
+            h_fine_steps=lead / 15.0, h_long_steps=lead / long_step,
+            season_steps=fc.SEASON_STEPS))
+    return grids
+
+
+def test_seasonal_naive_nails_a_clean_sinusoid():
+    period, lead = 600.0, 120.0
+    g = _sinusoid_grids(1, period, lead)[0]
+    out = fc.fit_batch([g])[0]
+    now = 1000.0 + 160 * 15.0
+    truth = 10.0 + 4.0 * 0.5 * (
+        1 - math.cos(2 * math.pi * ((now + lead) % period) / period))
+    assert out["seasonal_naive"] == pytest.approx(truth, rel=0.05)
+    # ...and beats the linear extrapolation on this series.
+    assert abs(out["seasonal_naive"] - truth) < abs(out["linear"] - truth)
+
+
+@pytest.mark.parametrize("n_models", [2, 5, 8])
+def test_batched_fits_byte_identical_to_serial(n_models):
+    """The padded cross-model fit must match per-model fits BIT-FOR-BIT —
+    padding and batch composition cannot leak between rows (the same
+    guarantee the SLO solver batching carries)."""
+    grids = _sinusoid_grids(n_models)
+    assert fc.fit_batch(grids) == fc.fit_serial(grids)
+
+
+def test_insufficient_history_degrades_to_persistence():
+    g = fc.SeriesGrids(fine=[0.0] * (fc.N_GRID - 1) + [7.0], fine_valid=1,
+                       long=[0.0] * (fc.N_GRID - 1) + [7.0], long_valid=1,
+                       h_fine_steps=10.0, h_long_steps=2.0,
+                       season_steps=fc.SEASON_STEPS)
+    out = fc.fit_batch([g])[0]
+    for name in fc.FORECASTERS:
+        assert out[name] == pytest.approx(7.0)
+
+
+# --- lead-time estimator ---
+
+
+def test_leadtime_measures_actuation_to_ready():
+    est = LeadTimeEstimator(quantile=0.5, default_seconds=150.0)
+    assert est.estimate("ns|m") == (150.0, False)
+    # Scale-up 1 -> 3 opens at t=100, ready catches up at t=190.
+    est.observe("ns|m", "v", "v5e-8", desired=3, ready=1, now=100.0)
+    est.observe("ns|m", "v", "v5e-8", desired=3, ready=2, now=150.0)
+    est.observe("ns|m", "v", "v5e-8", desired=3, ready=3, now=190.0)
+    lead, measured = est.estimate("ns|m")
+    assert measured and lead == pytest.approx(90.0)
+    # Accelerator-level fallback for a sibling model.
+    lead2, measured2 = est.estimate("ns|other", "v5e-8")
+    assert measured2 and lead2 == pytest.approx(90.0)
+
+
+def test_leadtime_new_model_inherits_accelerator_latencies():
+    """A model with no scale-up history of its own plans with the FLEET's
+    measured latencies for its accelerator, not the configured default."""
+    est = LeadTimeEstimator(quantile=0.5, default_seconds=150.0)
+    est.observe("ns|old", "v", "v5e-8", desired=2, ready=1, now=0.0)
+    est.observe("ns|old", "v", "v5e-8", desired=2, ready=2, now=400.0)
+    lead, measured = est.estimate("ns|new", "v5e-8")
+    assert measured and lead == pytest.approx(400.0)
+    # ...and the planner routes the model's accelerator into the ask.
+    planner = _planner()
+    planner.leadtime = est
+    planner.observe_variants("ns", "new", [VariantReplicaState(
+        variant_name="new-v5e", accelerator_name="v5e-8",
+        current_replicas=1, desired_replicas=1)], 500.0)
+    lead, measured = planner.lead_time_for("ns", "new")
+    assert measured and lead == pytest.approx(400.0)
+
+
+def test_leadtime_retarget_down_cancels_episode():
+    est = LeadTimeEstimator(default_seconds=60.0)
+    est.observe("ns|m", "v", "v5e-8", desired=5, ready=1, now=100.0)
+    # Operator scales back down before the order lands: not a sample.
+    est.observe("ns|m", "v", "v5e-8", desired=1, ready=1, now=130.0)
+    est.observe("ns|m", "v", "v5e-8", desired=1, ready=1, now=140.0)
+    assert est.estimate("ns|m") == (60.0, False)
+
+
+# --- planner: trust gate, floors, demotion ---
+
+
+def _request(demand: float, per_replica: float = 20.0,
+             replicas: int = 1) -> ModelScalingRequest:
+    return ModelScalingRequest(
+        model_id="m", namespace=NS,
+        result=AnalyzerResult(
+            analyzer_name="slo", model_id="m", namespace=NS,
+            total_demand=demand,
+            variant_capacities=[VariantCapacity(
+                variant_name="m-v5e", accelerator_name="v5e-8", cost=10.0,
+                replica_count=replicas, per_replica_capacity=per_replica)]),
+        variant_states=[VariantReplicaState(
+            variant_name="m-v5e", accelerator_name="v5e-8",
+            current_replicas=replicas, desired_replicas=replicas)])
+
+
+def _planner(**kw) -> CapacityPlanner:
+    args = dict(seasonal_period_seconds=600.0, grid_step_seconds=5.0,
+                default_lead_time_seconds=30.0, min_trust_evals=2,
+                prewake_check_interval=0.0)
+    args.update(kw)
+    return CapacityPlanner(**args)
+
+
+def test_planner_no_floor_until_trusted_then_floors_a_ramp():
+    planner = _planner()
+    t, plans_by_tick = 1000.0, []
+    for i in range(20):
+        demand = 10.0 + 0.5 * (t - 1000.0)
+        plans, floors = planner.plan([_request(demand)], t)
+        plans_by_tick.append((plans[0], floors))
+        t += 15.0
+    first = plans_by_tick[0][0]
+    assert not first.trusted and first.floor_replicas == 0
+    assert "untrusted" in first.reason
+    last, last_floors = plans_by_tick[-1]
+    # On a clean ramp the trend forecasters score well -> trusted floor
+    # sized for demand at now+lead.
+    assert last.trusted and not last.demoted
+    assert last.floor_replicas >= 1 and last.variant_name == "m-v5e"
+    assert last_floors and last_floors[0]["floor_replicas"] == \
+        last.floor_replicas
+    assert last.forecast_demand > last.demand
+    # Floor ~ forecast / (cap * util).
+    expect = math.ceil(last.forecast_demand / (20.0 * 0.85))
+    assert last.floor_replicas == expect
+
+
+def test_planner_demotes_on_unforecastable_demand():
+    planner = _planner(demote_error_threshold=0.35)
+    t = 1000.0
+    demoted_seen = False
+    for i in range(36):
+        # Adversarial period-3 swing: the 30s (2-tick) lead means neither
+        # persistence nor any smoother can track it.
+        demand = 100.0 if i % 3 == 0 else 0.0
+        plans, floors = planner.plan([_request(demand)], t)
+        if plans[0].demoted:
+            demoted_seen = True
+            assert plans[0].floor_replicas == 0 and not floors
+        t += 15.0
+    assert demoted_seen, "alternating demand must trip the demotion guard"
+
+
+def test_planner_withholds_floor_for_global_optimizer_models():
+    """A model routed through the fleet-wide global optimizer never gets a
+    floor (the solver deliberately starves low-priority models on
+    constrained pools — a floor would fight the assignment), but still
+    gets the full learning pass."""
+    planner = _planner()
+    t = 1000.0
+    for _ in range(20):
+        demand = 10.0 + 0.5 * (t - 1000.0)
+        plans, floors = planner.plan(
+            [_request(demand)], t,
+            no_floor_keys=frozenset({f"{NS}|m"}))
+        t += 15.0
+    plan = plans[0]
+    assert plan.trusted and plan.floor_replicas == 0 and not floors
+    assert "global" in plan.reason
+    assert plan.evals["linear"] > 0  # learning continued
+
+
+def test_planner_noise_gate_never_floors_epsilon_forecasts():
+    """At zero observed demand the growth ratio passes for ANY epsilon
+    forecast — without the minimum-actionable-demand gate a trusted
+    forecaster's 0.05 req/s seasonal residue would floor the variant to 1
+    replica and override scale-to-zero every tick."""
+    planner = _planner(prewake_min_demand=1.0)
+    t = 1000.0
+    for i in range(20):
+        # Tiny ramp: trains trust, but every forecast stays under the
+        # actionable threshold.
+        demand = 0.02 + 0.002 * (t - 1000.0)
+        plans, floors = planner.plan([_request(demand)], t)
+        t += 15.0
+    plan = plans[0]
+    assert plan.trusted, "the tiny ramp is perfectly forecastable"
+    assert plan.forecast_demand < 1.0
+    assert plan.floor_replicas == 0 and not floors
+    assert "below minimum actionable demand" in plan.reason
+
+
+def test_planner_growth_gate_keeps_steady_state_reactive():
+    planner = _planner()
+    t = 1000.0
+    for _ in range(20):
+        plans, floors = planner.plan([_request(50.0)], t)
+        t += 15.0
+    plan = plans[0]
+    # Flat demand forecasts flat: trusted, but no floor (growth gate).
+    assert plan.trusted and plan.floor_replicas == 0 and not floors
+
+
+def test_planner_measures_lead_time_from_variant_states():
+    planner = _planner()
+    req = _request(10.0)
+    planner.observe_variants(NS, "m", [VariantReplicaState(
+        variant_name="m-v5e", accelerator_name="v5e-8",
+        current_replicas=1, desired_replicas=3)], 1000.0)
+    planner.observe_variants(NS, "m", [VariantReplicaState(
+        variant_name="m-v5e", accelerator_name="v5e-8",
+        current_replicas=3, desired_replicas=3)], 1080.0)
+    lead, measured = planner.lead_time_for(NS, "m")
+    assert measured and lead == pytest.approx(80.0)
+    plans, _ = planner.plan([req], 1100.0)
+    assert plans[0].lead_time_seconds == pytest.approx(80.0)
+    assert plans[0].lead_time_measured
+
+
+def test_planner_evicts_all_per_key_state_with_history():
+    """Per-key planner + lead-time state follows the history store's idle
+    eviction — a long-lived controller with model churn must not leak
+    pending backtests / errors / lead-time rings for dead models."""
+    planner = _planner()
+    t = 1000.0
+    for _ in range(10):
+        planner.plan([_request(10.0 + t / 100.0)], t)
+        t += 15.0
+    planner.observe_variants(NS, "m", [VariantReplicaState(
+        variant_name="m-v5e", accelerator_name="v5e-8",
+        current_replicas=1, desired_replicas=2)], t)
+    key = planner.key_for(NS, "m")
+    assert planner._pending.get(key)
+    assert any(k[0] == key for k in planner._errors)
+    assert key in planner._accel_by_key
+    # Jump past the history window: everything for the key must go.
+    idle = t + planner.history.window_seconds + 1.0
+    planner.plan([], idle)  # a tick with the model gone
+    planner._evict_dead_keys(idle)
+    assert key not in planner._pending
+    assert not any(k[0] == key for k in planner._errors)
+    assert key not in planner._accel_by_key
+    assert key not in planner._last_plan
+    assert planner.leadtime.sample_count(key) == 0
+    assert not planner.leadtime._episodes
+
+
+def test_prewake_records_quiet_phase_zeros_even_untrusted():
+    """The zero-demand sample must land BEFORE the trust gate: an
+    untrusted scaled-to-zero model keeps learning its real (quiet)
+    pattern instead of LOCF-holding the last active demand."""
+    planner = _planner(min_trust_evals=99)  # never trusted
+    planner.observe_demand(NS, "m", 1000.0, 50.0)
+    wake, _ = planner.should_prewake(NS, "m", 1400.0)
+    assert not wake
+    w = planner.history.windows(planner.key_for(NS, "m"))
+    assert w[0].vals[w[0].hi - 1] == 0.0, \
+        "quiet-phase zero must be recorded despite the trust gate"
+
+
+# --- floor application + limiter ordering ---
+
+
+def _decision(target=1, current=1) -> VariantDecision:
+    return VariantDecision(
+        variant_name="m-v5e", namespace=NS, model_id="m",
+        accelerator_name="v5e-8", current_replicas=current,
+        target_replicas=target, chips_per_replica=8)
+
+
+def test_apply_forecast_floors_raises_never_lowers():
+    d = _decision(target=2)
+    floors = [{"namespace": NS, "variant_name": "m-v5e",
+               "floor_replicas": 5, "reason": "forecast floor"}]
+    assert apply_forecast_floors([d], floors, now=1.0) == 1
+    assert d.target_replicas == 5 and d.action == "scale-up"
+    assert d.decision_steps[-1].name == "forecast"
+    # A floor below the target is a no-op (growth only).
+    d2 = _decision(target=7)
+    assert apply_forecast_floors(
+        [d2], [{"namespace": NS, "variant_name": "m-v5e",
+                "floor_replicas": 3, "reason": "r"}], now=1.0) == 0
+    assert d2.target_replicas == 7 and not d2.decision_steps
+
+
+def test_forecast_floor_never_overrides_limiter_caps():
+    """Floors apply BEFORE the limiter, so whole-slice inventory still
+    caps the result — a forecast can never allocate chips that don't
+    exist."""
+    d = _decision(target=1)
+    apply_forecast_floors([d], [{"namespace": NS, "variant_name": "m-v5e",
+                                 "floor_replicas": 10,
+                                 "reason": "forecast floor"}], now=1.0)
+    assert d.target_replicas == 10
+    # 32 chips of v5e-8 inventory = 4 whole 8-chip slices.
+    limiter = DefaultLimiter("tpu-slice-limiter",
+                             StaticInventory({"v5e-8": 32}),
+                             GreedyBySaturation(), clock=FakeClock(start=1.0))
+    limiter.limit([d])
+    assert d.target_replicas == 4 and d.was_limited
+
+
+# --- blackbox round-trip + golden trace replay ---
+
+
+def test_forecast_plan_round_trips_through_trace_schema():
+    plan = ForecastPlan(
+        model_id="m", namespace=NS, demand=12.5, lead_time_seconds=88.0,
+        lead_time_measured=True, forecaster="seasonal_naive",
+        forecast_demand=19.25,
+        forecasts={n: 1.0 + i for i, n in enumerate(fc.FORECASTERS)},
+        errors={n: 0.1 * i for i, n in enumerate(fc.FORECASTERS)},
+        evals={n: i for i, n in enumerate(fc.FORECASTERS)},
+        trusted=True, floor_replicas=3, variant_name="m-v5e",
+        reason="forecast floor")
+    back = decode(ForecastPlan, json.loads(json.dumps(encode(plan))))
+    assert back == plan
+
+
+@pytest.mark.replay
+def test_golden_forecast_trace_replays_zero_diffs():
+    """The committed diurnal trace carries forecast stage events (plans +
+    applied floors); replay must re-apply the recorded floors and match
+    every decision byte-for-byte."""
+    from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+    records = load_trace(FORECAST_TRACE)
+    report = ReplayEngine(records).replay()
+    assert report.ok, report.to_dict()
+    assert report.cycles_replayed > 0
+    # The trace genuinely exercises the forecast plane.
+    floors = raised = 0
+    for rec in records:
+        for ev in rec.get("stages", []):
+            if ev.get("stage") == STAGE_FORECAST:
+                floors += len(ev.get("floors", []))
+                raised += ev.get("raised", 0)
+    assert floors > 0 and raised > 0, \
+        "golden trace must contain applied forecast floors"
+
+
+def test_backtest_golden_gate():
+    """`make backtest-golden` in-process: per-forecaster MAPE + under/over-
+    provision cost on the committed trace must match the committed report,
+    and a seasonal forecaster must beat the linear-trend baseline
+    (acceptance criterion)."""
+    from wva_tpu.forecast.backtest import compare_to_golden, run_backtest
+
+    report = run_backtest(FORECAST_TRACE, lead=90.0, period=600.0,
+                          grid_step=5.0, min_history=90.0)
+    with open(FORECAST_REPORT, "r", encoding="utf-8") as f:
+        golden = json.load(f)
+    assert compare_to_golden(report, golden) == []
+    assert report["seasonal_beats_linear"]
+    agg = report["aggregate"]
+    assert any(agg[n]["mape"] < agg["linear"]["mape"]
+               for n in fc.SEASONAL_FORECASTERS)
+
+
+# --- engine integration: off-switch + stage events + status ---
+
+
+def _forecast_world(forecast_enabled: bool, planner_none: bool = False,
+                    kv: float = 0.5, n_models: int = 2):
+    from wva_tpu.engines import common
+
+    common.DecisionCache.clear()
+    while not common.DecisionTrigger.empty():
+        common.DecisionTrigger.get_nowait()
+    clock = FakeClock(start=200_000.0)
+    cluster = FakeCluster(clock=clock)
+    tsdb = TimeSeriesDB(clock=clock)
+    cfg = new_test_config()
+    cfg.update_saturation_config({"default": SaturationScalingConfig(
+        analyzer_name="saturation", anticipation_horizon_seconds=120.0)})
+    cfg.set_trace(TraceConfig(enabled=True))
+    fc_cfg = cfg.forecast_config()
+    fc_cfg.enabled = forecast_enabled
+    fc_cfg.seasonal_period_seconds = 600.0
+    fc_cfg.grid_step_seconds = 5.0
+    fc_cfg.default_lead_time_seconds = 60.0
+    fc_cfg.min_trust_evals = 2
+    cfg.set_forecast(fc_cfg)
+
+    for i in range(n_models):
+        name = f"m{i:02d}-v5e"
+        model = f"org/model-{i:02d}"
+        cluster.create(Deployment(
+            metadata=ObjectMeta(name=name, namespace=NS),
+            replicas=1, selector={"app": name},
+            template=PodTemplateSpec(
+                labels={"app": name},
+                containers=[Container(
+                    name="srv",
+                    args=["--max-num-batched-tokens=8192",
+                          "--max-num-seqs=256"],
+                    resources=ResourceRequirements(
+                        requests={"google.com/tpu": "8"}))]),
+            status=DeploymentStatus(replicas=1, ready_replicas=1)))
+        cluster.create(VariantAutoscaling(
+            metadata=ObjectMeta(
+                name=name, namespace=NS,
+                labels={"inference.optimization/acceleratorName": "v5e-8"}),
+            spec=VariantAutoscalingSpec(
+                scale_target_ref=CrossVersionObjectReference(name=name),
+                model_id=model, variant_cost="10.0")))
+        cluster.create(Pod(
+            metadata=ObjectMeta(
+                name=f"{name}-0", namespace=NS, labels={"app": name},
+                owner_references=[{"kind": "Deployment", "name": name}]),
+            status=PodStatus(phase="Running", ready=True,
+                             pod_ip=f"10.1.{i}.1")))
+        pod_labels = {"pod": f"{name}-0", "namespace": NS,
+                      "model_name": model}
+        tsdb.add_sample("vllm:kv_cache_usage_perc", pod_labels, kv)
+        tsdb.add_sample("vllm:num_requests_waiting", pod_labels, 0)
+        tsdb.add_sample("vllm:cache_config_info",
+                        {**pod_labels, "num_gpu_blocks": "4096",
+                         "block_size": "32"}, 1.0)
+
+    mgr = build_manager(cluster, cfg, clock=clock, tsdb=tsdb)
+    if planner_none:
+        assert mgr.engine.forecast is not None
+        mgr.engine.forecast = None
+        mgr.scale_from_zero.forecast = None
+        mgr.fastpath.forecast = None
+    mgr.setup()
+    return mgr, cluster, tsdb, clock
+
+
+def _run_world(mgr, cluster, clock, ticks=4):
+    for _ in range(ticks):
+        mgr.run_once()
+        clock.advance(15.0)
+    mgr.flight_recorder.flush()
+    cycles = mgr.flight_recorder.snapshot()
+    statuses = {va.metadata.name: encode(va.status)
+                for va in cluster.list("VariantAutoscaling", namespace=NS)}
+    mgr.shutdown()
+    return cycles, statuses
+
+
+def test_forecast_off_is_byte_identical_to_planner_none():
+    """WVA_FORECAST=off must route to EXACTLY the planner-less engine:
+    decisions, statuses, and trace cycles byte-identical."""
+    mgr_a, cl_a, _, ck_a = _forecast_world(forecast_enabled=False)
+    cycles_a, statuses_a = _run_world(mgr_a, cl_a, ck_a)
+    assert mgr_a.engine.forecast is None  # the knob controls wiring
+
+    mgr_b, cl_b, _, ck_b = _forecast_world(forecast_enabled=True,
+                                           planner_none=True)
+    cycles_b, statuses_b = _run_world(mgr_b, cl_b, ck_b)
+
+    dumps = lambda x: json.dumps(x, sort_keys=True)  # noqa: E731
+    assert dumps(statuses_a) == dumps(statuses_b)
+    assert dumps(cycles_a) == dumps(cycles_b)
+    for rec in cycles_a:
+        assert not any(ev.get("stage") == STAGE_FORECAST
+                       for ev in rec.get("stages", []))
+
+
+def test_forecast_on_records_stage_events_and_gauges():
+    from wva_tpu.constants import (
+        WVA_FORECAST_LEAD_TIME_SECONDS,
+        WVA_TREND_SERIES_SAMPLES,
+    )
+
+    mgr, cluster, _, clock = _forecast_world(forecast_enabled=True)
+    assert mgr.engine.forecast is not None
+    cycles, _ = _run_world(mgr, cluster, clock, ticks=4)
+    events = [ev for rec in cycles for ev in rec.get("stages", [])
+              if ev.get("stage") == STAGE_FORECAST]
+    assert events, "V2 path must record forecast stage events"
+    plans = events[-1]["plans"]
+    assert {p["model_id"] for p in plans} == \
+        {"org/model-00", "org/model-01"}
+    for p in plans:
+        assert set(p["forecasts"]) == set(fc.FORECASTERS)
+        assert p["lead_time_seconds"] == pytest.approx(60.0)  # default
+    # Gauges: lead time per model + trend estimator health.
+    reg = mgr.registry
+    assert reg.get(WVA_FORECAST_LEAD_TIME_SECONDS,
+                   {"model_name": "org/model-00",
+                    "namespace": NS}) == pytest.approx(60.0)
+    assert reg.get(WVA_TREND_SERIES_SAMPLES,
+                   {"model_name": "org/model-00", "namespace": NS}) >= 1.0
+
+
+def test_deleted_model_gauges_are_removed_not_frozen():
+    """Deleting a VA must remove its wva_forecast_* / wva_trend_* gauges
+    on the next tick — an operator alerting on staleness must not see a
+    permanently fresh-looking frozen series for a dead model."""
+    from wva_tpu.constants import (
+        WVA_FORECAST_DEMAND,
+        WVA_FORECAST_LEAD_TIME_SECONDS,
+        WVA_TREND_SERIES_SAMPLES,
+    )
+
+    mgr, cluster, tsdb, clock = _forecast_world(forecast_enabled=True)
+    for _ in range(3):
+        mgr.run_once()
+        clock.advance(15.0)
+    labels = {"model_name": "org/model-01", "namespace": NS}
+    assert mgr.registry.get(WVA_FORECAST_LEAD_TIME_SECONDS,
+                            labels) is not None
+    cluster.delete("VariantAutoscaling", NS, "m01-v5e")
+    for _ in range(2):
+        mgr.run_once()
+        clock.advance(15.0)
+    assert mgr.registry.get(WVA_FORECAST_LEAD_TIME_SECONDS, labels) is None
+    assert mgr.registry.get(WVA_FORECAST_DEMAND, labels) is None
+    assert mgr.registry.get(WVA_TREND_SERIES_SAMPLES, labels) is None
+    # The surviving model's gauges stay.
+    assert mgr.registry.get(
+        WVA_FORECAST_LEAD_TIME_SECONDS,
+        {"model_name": "org/model-00", "namespace": NS}) is not None
+    mgr.shutdown()
+
+
+def test_measured_lead_time_lands_in_va_status():
+    """A completed scale-up (desired > ready, then ready catches up) must
+    surface the measured actuation->ready latency in the VA status and the
+    wva_forecast_lead_time_seconds gauge."""
+    from wva_tpu.constants import WVA_FORECAST_LEAD_TIME_SECONDS
+
+    mgr, cluster, tsdb, clock = _forecast_world(forecast_enabled=True,
+                                                n_models=1)
+    planner = mgr.engine.forecast
+    # Simulate the engine's variant-state feed across a provisioning
+    # window: desired 3 at t0, ready at t0+90.
+    t0 = clock.now()
+    planner.observe_variants(NS, "org/model-00", [VariantReplicaState(
+        variant_name="m00-v5e", accelerator_name="v5e-8",
+        current_replicas=1, desired_replicas=3)], t0)
+    planner.observe_variants(NS, "org/model-00", [VariantReplicaState(
+        variant_name="m00-v5e", accelerator_name="v5e-8",
+        current_replicas=3, desired_replicas=3)], t0 + 90.0)
+    _run_world(mgr, cluster, clock, ticks=2)
+    va = cluster.get("VariantAutoscaling", NS, "m00-v5e")
+    assert va.status.forecast_lead_time_seconds == pytest.approx(90.0)
+    assert "forecastLeadTimeSeconds" in va.status.to_dict()
+    # And absent when never measured (serialization stays pre-change).
+    fresh = VariantAutoscaling()
+    assert "forecastLeadTimeSeconds" not in fresh.status.to_dict()
+
+
+# --- scale-from-zero pre-wake ---
+
+
+class _PrewakePlanner:
+    """Trusted-planner stub: predicts demand for one model."""
+
+    def __init__(self, model_id):
+        self.model_id = model_id
+        self.calls = 0
+
+    def should_prewake(self, namespace, model_id, now):
+        self.calls += 1
+        if model_id == self.model_id:
+            return True, "forecast pre-wake: seasonal_naive predicts " \
+                         "demand 12.0 >= 1.0 at now+90s (measured lead time)"
+        return False, ""
+
+
+def test_prewake_wakes_scaled_to_zero_model_without_backlog():
+    """A trusted forecast wakes the cheapest inactive variant through the
+    REAL scale-from-zero actuation/status path (conflict-refetch guard
+    included) even though the scheduler queue is empty — and the engine's
+    next tick does not fight the wake back down."""
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        constant,
+    )
+
+    spec = VariantSpec(
+        name="llama-v5e", model_id="meta-llama/Llama-3.1-8B",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=0, serving=ServingParams(),
+        load=constant(0.0),
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      sync_period_seconds=10.0))
+    h = EmulationHarness([spec], startup_seconds=30.0)
+    h.run(30)
+    assert h.replicas_of("llama-v5e") == 0
+    stub = _PrewakePlanner("meta-llama/Llama-3.1-8B")
+    h.manager.scale_from_zero.forecast = stub
+    h.run(30)
+    assert stub.calls > 0
+    assert h.replicas_of("llama-v5e") >= 1, "pre-wake must scale 0 -> 1"
+    va = h.cluster.get("VariantAutoscaling", h.namespace, "llama-v5e")
+    assert va.status.desired_optimized_alloc.num_replicas >= 1
+    # The audit event carries the forecast reason (the engine's later
+    # heartbeat re-stamps the condition message, so look at the event).
+    events = [e for e in h.cluster.list("Event")
+              if "pre-wake" in getattr(e, "message", "")]
+    assert events, "wake must be audited with the forecast reason"
+    # Engine ticks keep running with zero demand: the wake must stick
+    # (stale-write drop logic protects the newer decision; no flap to 0).
+    h.run(60)
+    assert h.replicas_of("llama-v5e") >= 1
+
+
+def test_prewake_skipped_while_sibling_variant_serves():
+    """A model with one ACTIVE variant and one scaled-to-zero variant must
+    never pre-wake the idle one: the active variant already provides the
+    capacity, and the speculative wake would both burn a slice and feed
+    phantom zero-demand samples into the model's live history."""
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        constant,
+    )
+
+    model = "meta-llama/Llama-3.1-8B"
+    hpa = HPAParams(stabilization_up_seconds=10.0, sync_period_seconds=10.0)
+    active = VariantSpec(
+        name="llama-v5e", model_id=model, accelerator="v5e-8",
+        chips_per_replica=8, cost=10.0, initial_replicas=1,
+        serving=ServingParams(), load=constant(2.0), hpa=hpa)
+    idle = VariantSpec(
+        name="llama-v5p", model_id=model, accelerator="v5p-8",
+        chips_per_replica=8, cost=20.0, initial_replicas=0,
+        serving=ServingParams(), load=None, hpa=hpa)
+    h = EmulationHarness(
+        [active, idle],
+        nodepools=[("v5e-pool", "v5e", "2x4", 8),
+                   ("v5p-pool", "v5p", "2x2x1", 8)],
+        startup_seconds=30.0)
+    stub = _PrewakePlanner(model)  # would wake ANY asked model
+    h.manager.scale_from_zero.forecast = stub
+    h.run(60)
+    assert h.replicas_of("llama-v5e") >= 1  # sibling keeps serving
+    assert h.replicas_of("llama-v5p") == 0, \
+        "pre-wake must not fire while a sibling variant is active"
+    assert stub.calls == 0, \
+        "the planner must not even be consulted for partially-active models"
+
+
+def test_prewake_trust_gate_blocks_untrusted_models():
+    planner = _planner(prewake_min_demand=1.0)
+    wake, reason = planner.should_prewake(NS, "m", 1000.0)
+    assert not wake and reason == ""
+
+
+def test_prewake_fires_on_trusted_seasonal_forecast():
+    """Organic pre-wake: build trust on a diurnal series, then ask at the
+    trough with the next peak one lead time away."""
+    period = 600.0
+    planner = _planner(default_lead_time_seconds=150.0,
+                       prewake_min_demand=3.0, min_trust_evals=2)
+    load = diurnal(base_rate=0.0, amplitude=20.0, period=period)
+    t = 1000.0
+    for i in range(93):
+        planner.plan([_request(load(t))], t)
+        t += 15.0
+    # t = 2395: the model has gone quiet (demand ~0, scaled to zero — the
+    # engine stops feeding it), but one lead time (150s) ahead the NEXT
+    # cycle's rising edge reaches ~9. The seasonal forecaster, which
+    # dominates the rolling error on this series, must wake it EARLY —
+    # while observed demand is still below the pre-wake threshold.
+    assert load(t) < 0.1 and load(t + 150.0) > 3.0
+    wake, reason = planner.should_prewake(NS, "m", t)
+    assert wake, "trusted seasonal forecast must pre-wake"
+    assert "forecast pre-wake" in reason
+    # And at the true trough, with the horizon still inside the quiet
+    # phase, a fresh throttled check declines.
+    planner2 = _planner(default_lead_time_seconds=60.0,
+                        prewake_min_demand=3.0, min_trust_evals=2)
+    t2 = 1000.0
+    for i in range(90):
+        planner2.plan([_request(load(t2))], t2)
+        t2 += 15.0
+    assert load(t2 + 60.0) < 3.0
+    wake2, _ = planner2.should_prewake(NS, "m", t2)
+    assert not wake2, "quiet horizon must not pre-wake"
+
+
+# --- DemandTrend satellite: idle eviction + stats ---
+
+
+def test_demand_trend_idle_eviction_and_stats():
+    trend = DemandTrend(window_seconds=60.0)
+    trend.observe("live", 1000.0, 1.0)
+    trend.observe("dead", 1000.0, 1.0)
+    for i in range(10):
+        trend.observe("live", 1010.0 + i * 10.0, 2.0 + i)
+    st = trend.stats(1100.0)
+    assert set(st) == {"live", "dead"}
+    assert st["live"].samples >= 2
+    assert st["dead"].staleness_seconds == pytest.approx(100.0)
+    # Idle past the threshold (max(300, 2*window)): dead goes, live stays.
+    assert trend.evict_idle(1000.0 + 301.0) == 1
+    assert set(trend.stats(1301.0)) == {"live"}
+    # The eviction must NOT reset a live series' min_age gate state.
+    gated = DemandTrend(window_seconds=60.0, min_age_seconds=30.0)
+    gated.observe("k", 1000.0, 1.0)  # gated (dropped) sample
+    gated.evict_idle(1100.0)
+    assert gated.observe("k", 1100.0, 5.0) == 0.0  # still same first_seen
+    assert "k" in gated.stats(1100.0)
+
+
+def test_demand_trend_eviction_is_amortized_into_observe():
+    trend = DemandTrend(window_seconds=60.0)
+    trend.observe("dead", 1000.0, 1.0)
+    # A later observe on another key sweeps the idle one.
+    trend.observe("live", 2000.0, 1.0)
+    assert set(trend.stats(2000.0)) == {"live"}

@@ -213,6 +213,57 @@ def test_decisions_and_trace_byte_identical_at_any_pool_width():
         assert dumps(a) == dumps(b)
 
 
+def _run_forecast_fleet(analysis_workers: int, ticks: int = 5):
+    """Like _run_fleet but on the V2 path with the forecast planner ACTIVE
+    (default-on config): batched forecaster fits + planner state evolution
+    run per tick, and must stay byte-deterministic at any pool width."""
+    from wva_tpu.engines import common
+
+    common.DecisionCache.clear()
+    while not common.DecisionTrigger.empty():
+        common.DecisionTrigger.get_nowait()
+    mgr, cluster, tsdb, clock = make_fleet_world(
+        4, kv=0.78, queue=2, analysis_workers=analysis_workers, trace=True,
+        saturation_cfg=SaturationScalingConfig(
+            analyzer_name="saturation",
+            anticipation_horizon_seconds=120.0))
+    assert mgr.engine.forecast is not None, \
+        "forecast planner must be on by default"
+    for _ in range(ticks):
+        mgr.run_once()
+        clock.advance(15.0)
+    mgr.flight_recorder.flush()
+    cycles = mgr.flight_recorder.snapshot()
+    statuses = {
+        va.metadata.name: encode(va.status)
+        for va in cluster.list("VariantAutoscaling", namespace=NS)}
+    mgr.shutdown()
+    return cycles, statuses
+
+
+def test_forecast_fits_byte_identical_at_any_pool_width():
+    """Forecast-plane determinism (docs/design/forecast.md): the planner
+    runs on the engine thread in sorted model order and its batched JAX
+    fits are row-independent, so a forecast-active V2 world produces
+    byte-identical decisions, statuses, AND forecast stage events at
+    worker-pool width 1 and 8."""
+    serial_cycles, serial_statuses = _run_forecast_fleet(analysis_workers=1)
+    pooled_cycles, pooled_statuses = _run_forecast_fleet(analysis_workers=8)
+
+    assert len(serial_cycles) > 0 and serial_statuses
+    assert any(ev.get("stage") == "forecast"
+               for rec in serial_cycles for ev in rec.get("stages", [])), \
+        "the V2 world must actually record forecast stage events"
+
+    def dumps(x):
+        return json.dumps(x, sort_keys=True, separators=(",", ":"))
+
+    assert dumps(serial_statuses) == dumps(pooled_statuses)
+    assert len(serial_cycles) == len(pooled_cycles)
+    for a, b in zip(serial_cycles, pooled_cycles):
+        assert dumps(a) == dumps(b)
+
+
 # --- 3. Cross-model solver batching numerics ---
 
 

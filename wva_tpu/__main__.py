@@ -121,6 +121,13 @@ def main(argv: list[str] | None = None) -> int:
         from wva_tpu.blackbox.replay import replay_cli
 
         return replay_cli(argv[1:])
+    if argv and argv[0] == "forecast":
+        # Offline forecaster backtest over a recorded decision trace
+        # (wva_tpu.forecast.backtest): MAPE + under/over-provision cost
+        # per candidate forecaster. Same no-cluster dispatch as replay.
+        from wva_tpu.forecast.backtest import forecast_cli
+
+        return forecast_cli(argv[1:])
     args = build_arg_parser().parse_args(argv)
     setup_logging(args.verbosity if args.verbosity is not None else 2)
 

@@ -165,6 +165,11 @@ class QueueingModelAnalyzer(Analyzer):
         """Drop demand-trend series for models that no longer exist."""
         self._demand_trend.evict_missing(active_model_keys)
 
+    def demand_trend_stats(self, now: float):
+        """Per-key trend estimator health (engine surfaces it as
+        ``wva_trend_*`` gauges)."""
+        return self._demand_trend.stats(now)
+
     def observe_demand(self, namespace: str, model_id: str, now: float,
                        arrival_rate_per_min: float, backlog: float) -> None:
         """Feed an out-of-tick demand sample into the trend estimator (the

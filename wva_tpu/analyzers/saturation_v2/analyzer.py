@@ -89,6 +89,11 @@ class SaturationV2Analyzer(Analyzer):
         self._demand_trend.evict_missing(active_model_keys)
         self.evict_stale_history(HISTORY_EVICTION_TIMEOUT)
 
+    def demand_trend_stats(self, now: float):
+        """Per-key trend estimator health (engine surfaces it as
+        ``wva_trend_*`` gauges)."""
+        return self._demand_trend.stats(now)
+
     def evict_stale_history(self, timeout: float) -> int:
         with self._mu:
             now = self.clock.now()

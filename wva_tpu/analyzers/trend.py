@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
+from dataclasses import dataclass
 
 DEFAULT_WINDOW_SECONDS = 180.0
 # Slope needs at least this much time span to be meaningful; below it the
@@ -30,6 +31,25 @@ MAX_SAMPLES_PER_KEY = 256
 # seconds) must satisfy min_samples — a dense feeder can never
 # legitimately hold just 2 samples spanning 20s.
 SPARSE_GAP_SECONDS = 10.0
+# Idle-key eviction floor: a key whose newest sample is older than
+# max(IDLE_EVICT_MIN_SECONDS, 2*window, min_age + window) is dropped on the
+# next observe() sweep. Callers that rename/delete VAs without ever calling
+# evict_missing (long-lived controllers with churning models) would
+# otherwise accumulate dead deques forever. The floor is deliberately far
+# above any live feed cadence: evicting a LIVE series would reset its
+# first_seen and re-impose the min_age anticipation blindness.
+IDLE_EVICT_MIN_SECONDS = 300.0
+IDLE_SWEEP_INTERVAL_SECONDS = 60.0
+
+
+@dataclass
+class TrendSeriesStats:
+    """Health snapshot of one key's series (stats() hook; surfaced as
+    ``wva_trend_*`` gauges)."""
+
+    samples: int
+    staleness_seconds: float  # now - newest sample
+    age_seconds: float  # now - first_seen (min_age gate progress)
 
 
 class DemandTrend:
@@ -73,10 +93,12 @@ class DemandTrend:
         self._mu = threading.Lock()
         self._series: dict[str, deque[tuple[float, float]]] = {}
         self._first_seen: dict[str, float] = {}
+        self._last_idle_sweep = float("-inf")
 
     def observe(self, key: str, now: float, demand: float) -> float:
         """Record a sample and return the current demand slope (units/s)."""
         with self._mu:
+            self._sweep_idle_locked(now)
             series = self._series.setdefault(
                 key, deque(maxlen=MAX_SAMPLES_PER_KEY))
             first_seen = self._first_seen.setdefault(key, now)
@@ -109,6 +131,54 @@ class DemandTrend:
                 del self._series[k]
                 self._first_seen.pop(k, None)
             return len(stale)
+
+    def evict_idle(self, now: float) -> int:
+        """Force an idle-key sweep now (the time gate normally amortizes it
+        into observe()); returns how many keys were dropped."""
+        with self._mu:
+            self._last_idle_sweep = float("-inf")
+            return self._sweep_idle_locked(now)
+
+    def _idle_threshold(self) -> float:
+        return max(IDLE_EVICT_MIN_SECONDS, 2 * self.window_seconds,
+                   self.min_age_seconds + self.window_seconds)
+
+    def _sweep_idle_locked(self, now: float) -> int:
+        """Time-gated idle-key eviction: callers that never invoke
+        evict_missing (deleted/renamed VAs on a long-lived controller) must
+        not leak per-key deques forever. Caller holds the lock."""
+        if now - self._last_idle_sweep < IDLE_SWEEP_INTERVAL_SECONDS:
+            return 0
+        self._last_idle_sweep = now
+        cutoff = self._idle_threshold()
+        stale = [k for k, s in self._series.items()
+                 if not s or now - s[-1][0] > cutoff]
+        # A gated series (all samples dropped by min_age) holds an empty
+        # deque; judge it by first_seen so a model idle since creation is
+        # still evicted.
+        dropped = 0
+        for k in stale:
+            if not self._series[k] and \
+                    now - self._first_seen.get(k, now) <= cutoff:
+                continue
+            del self._series[k]
+            self._first_seen.pop(k, None)
+            dropped += 1
+        return dropped
+
+    def stats(self, now: float) -> dict[str, TrendSeriesStats]:
+        """Per-key health snapshot (sample count, staleness, age) —
+        surfaced by the engine as ``wva_trend_*`` gauges."""
+        with self._mu:
+            out = {}
+            for k, s in self._series.items():
+                out[k] = TrendSeriesStats(
+                    samples=len(s),
+                    staleness_seconds=(now - s[-1][0] if s
+                                       else float("inf")),
+                    age_seconds=now - self._first_seen.get(k, now),
+                )
+            return out
 
     def _slope(self, series: deque[tuple[float, float]]) -> float:
         n = len(series)

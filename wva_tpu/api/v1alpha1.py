@@ -259,13 +259,21 @@ class VariantAutoscalingStatus:
     desired_optimized_alloc: OptimizedAlloc = field(default_factory=OptimizedAlloc)
     actuation: ActuationStatus = field(default_factory=ActuationStatus)
     conditions: list[Condition] = field(default_factory=list)
+    # MEASURED provisioning lead time (actuation->ready quantile) the
+    # capacity planner is using as this model's forecast horizon
+    # (wva_tpu.forecast). 0 = no measurement yet / forecasting off; omitted
+    # from serialization so pre-forecast statuses stay byte-identical.
+    forecast_lead_time_seconds: float = 0.0
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        d = {
             "desiredOptimizedAlloc": self.desired_optimized_alloc.to_dict(),
             "actuation": self.actuation.to_dict(),
             "conditions": [c.to_dict() for c in self.conditions],
         }
+        if self.forecast_lead_time_seconds > 0:
+            d["forecastLeadTimeSeconds"] = self.forecast_lead_time_seconds
+        return d
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "VariantAutoscalingStatus":
@@ -275,6 +283,8 @@ class VariantAutoscalingStatus:
             ),
             actuation=ActuationStatus.from_dict(d.get("actuation") or {}),
             conditions=[Condition.from_dict(c) for c in d.get("conditions") or []],
+            forecast_lead_time_seconds=float(
+                d.get("forecastLeadTimeSeconds", 0.0) or 0.0),
         )
 
 

@@ -165,6 +165,9 @@ class ReplayEngine:
         limiter_event = next(
             (ev for ev in rec.get("stages", [])
              if ev.get("stage") == "limiter"), None)
+        forecast_event = next(
+            (ev for ev in rec.get("stages", [])
+             if ev.get("stage") == "forecast"), None)
 
         decisions: list = []
         v2_requests: list[ModelScalingRequest] = []
@@ -176,6 +179,17 @@ class ReplayEngine:
         if v2_requests:
             decisions.extend(
                 self._replay_v2(v2_requests, enforcer_events))
+
+        if forecast_event is not None:
+            # Proactive floors re-applied from the RECORDED event via the
+            # same code path the live engine used (the planner's learned
+            # state — history rings, lead-time samples, rolling errors —
+            # is not reconstructable from a single cycle).
+            from wva_tpu.forecast.apply import apply_forecast_floors
+
+            apply_forecast_floors(decisions,
+                                  forecast_event.get("floors") or [],
+                                  now=self.clock.now())
 
         if limiter_event is not None:
             limits = {p["accelerator_type"]: p["limit"]

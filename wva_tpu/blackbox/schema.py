@@ -40,6 +40,12 @@ TRACE_SCHEMA_VERSION = 1
 STAGE_ENFORCER = "enforcer"
 STAGE_OPTIMIZER = "optimizer"
 STAGE_LIMITER = "limiter"
+# Predictive capacity planner (wva_tpu.forecast): per-model plans + the
+# replica floors it applied, recorded between enforcement and the limiter.
+# Replay re-applies the RECORDED floors (like the limiter replays from the
+# recorded pool snapshot) — the planner's learned state is not
+# reconstructable from one cycle.
+STAGE_FORECAST = "forecast"
 STAGE_ACTUATION = "actuation"
 STAGE_RECONCILE = "reconcile"
 

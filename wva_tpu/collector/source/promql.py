@@ -138,7 +138,11 @@ class SeriesWindow:
 class _Series:
     """One series' column store: parallel timestamp/value arrays with a
     live-region start offset (the "ring"). Samples before ``start`` are
-    retention-expired garbage awaiting compaction."""
+    retention-expired garbage awaiting compaction. The forecast plane's
+    ``forecast/history.py`` ``RingColumns`` carries a twin of this layout
+    and of ``_trim_locked``'s compaction heuristic (kept separate: its
+    trim is per-ring-window on append, ours is store-retention under the
+    stripe locks) — keep changes to the heuristic in sync."""
 
     __slots__ = ("labels", "ts", "vals", "start", "last_ts")
 

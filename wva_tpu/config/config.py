@@ -112,6 +112,39 @@ class TraceConfig:
 
 
 @dataclass
+class ForecastConfig:
+    """Predictive capacity planner (``wva_tpu.forecast``): seasonality-aware
+    demand forecasting with measured provisioning lead times
+    (docs/design/forecast.md). Default ON; ``WVA_FORECAST=off`` restores
+    byte-identical pre-forecast decisions."""
+
+    enabled: bool = True
+    # Seasonal period the registry's seasonal forecasters fit (diurnal
+    # serving traffic: one day).
+    seasonal_period_seconds: float = 86400.0
+    # Fine-grid resolution for the recent-trend forecasters.
+    grid_step_seconds: float = 15.0
+    # Lead-time fallback until actuation->ready latencies are measured
+    # (mirrors anticipationHorizonSeconds' design point).
+    default_lead_time_seconds: float = 150.0
+    # Quantile of observed actuation->ready latencies used as the planning
+    # horizon (p90: sizing for median lead time under-provisions exactly
+    # when provisioning lands slow).
+    lead_time_quantile: float = 0.9
+    # Proactive floor sizes forecast demand against per-replica capacity at
+    # this utilization (mirrors scaleUpThreshold's role).
+    target_utilization: float = 0.85
+    # Rolling symmetric-MAPE above which a model demotes to reactive.
+    demote_error_threshold: float = 0.35
+    # Matured backtest evaluations a forecaster needs before it is trusted
+    # to move replicas.
+    min_trust_evals: int = 3
+    # Scale-from-zero pre-wake on trusted forecast demand.
+    prewake_enabled: bool = True
+    prewake_min_demand: float = 1.0
+
+
+@dataclass
 class ConfigSyncState:
     configmaps_bootstrap_complete: bool = False
     last_configmaps_sync_at: float = 0.0
@@ -137,6 +170,7 @@ class Config:
         self._slo_global: "SLOConfigData | None" = None
         self._slo_ns: dict[str, "SLOConfigData"] = {}
         self._trace = TraceConfig()
+        self._forecast = ForecastConfig()
 
     # --- infrastructure getters ---
 
@@ -249,6 +283,20 @@ class Config:
     def set_trace(self, t: TraceConfig) -> None:
         with self._mu:
             self._trace = copy.deepcopy(t)
+
+    # --- predictive capacity planner (wva_tpu.forecast) ---
+
+    def forecast_config(self) -> ForecastConfig:
+        with self._mu:
+            return copy.deepcopy(self._forecast)
+
+    def forecast_enabled(self) -> bool:
+        with self._mu:
+            return self._forecast.enabled
+
+    def set_forecast(self, f: ForecastConfig) -> None:
+        with self._mu:
+            self._forecast = copy.deepcopy(f)
 
     # --- saturation config (namespace-aware; reference config.go:318-354) ---
 

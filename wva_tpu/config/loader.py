@@ -18,6 +18,7 @@ from wva_tpu.config.config import (
     Config,
     EPPConfig,
     FeatureFlagsConfig,
+    ForecastConfig,
     InfrastructureConfig,
     PrometheusConfig,
     TLSConfig,
@@ -54,6 +55,19 @@ DEFAULTS: dict[str, Any] = {
     "WVA_TRACE_ENABLED": False,
     "WVA_TRACE_PATH": "",
     "WVA_TRACE_RING_SIZE": 512,
+    # Predictive capacity planner (wva_tpu.forecast; docs/design/forecast.md).
+    # Default on; "off"/"false"/"0" disables (decisions then byte-identical
+    # to pre-forecast builds).
+    "WVA_FORECAST": True,
+    "WVA_FORECAST_PERIOD": "24h",
+    "WVA_FORECAST_GRID_STEP": "15s",
+    "WVA_FORECAST_DEFAULT_LEAD_TIME": "150s",
+    "WVA_FORECAST_LEAD_TIME_QUANTILE": 0.9,
+    "WVA_FORECAST_TARGET_UTILIZATION": 0.85,
+    "WVA_FORECAST_DEMOTE_ERROR": 0.35,
+    "WVA_FORECAST_MIN_TRUST_EVALS": 3,
+    "WVA_FORECAST_PREWAKE": True,
+    "WVA_FORECAST_PREWAKE_MIN_DEMAND": 1.0,
     "SCALE_FROM_ZERO_ENGINE_MAX_CONCURRENCY": 10,
     "EPP_METRIC_READER_BEARER_TOKEN": "",
     "GLOBAL_OPT_INTERVAL": "60s",
@@ -96,7 +110,7 @@ class _Resolver:
         if isinstance(v, bool):
             return v
         if isinstance(v, str):
-            return v.strip().lower() in ("true", "1", "yes")
+            return v.strip().lower() in ("true", "1", "yes", "on")
         return bool(v)
 
     def get_int(self, key: str) -> int:
@@ -105,6 +119,13 @@ class _Resolver:
             return int(v)
         except (TypeError, ValueError):
             return int(DEFAULTS.get(key, 0))
+
+    def get_float(self, key: str) -> float:
+        v = self.get(key)
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return float(DEFAULTS.get(key, 0.0))
 
     def get_duration(self, key: str) -> float:
         v = self.get(key)
@@ -176,6 +197,19 @@ def load(flags: Mapping[str, Any] | None = None,
         enabled=r.get_bool("WVA_TRACE_ENABLED"),
         path=r.get_str("WVA_TRACE_PATH"),
         ring_size=r.get_int("WVA_TRACE_RING_SIZE"),
+    ))
+    cfg.set_forecast(ForecastConfig(
+        enabled=r.get_bool("WVA_FORECAST"),
+        seasonal_period_seconds=r.get_duration("WVA_FORECAST_PERIOD"),
+        grid_step_seconds=r.get_duration("WVA_FORECAST_GRID_STEP"),
+        default_lead_time_seconds=r.get_duration(
+            "WVA_FORECAST_DEFAULT_LEAD_TIME"),
+        lead_time_quantile=r.get_float("WVA_FORECAST_LEAD_TIME_QUANTILE"),
+        target_utilization=r.get_float("WVA_FORECAST_TARGET_UTILIZATION"),
+        demote_error_threshold=r.get_float("WVA_FORECAST_DEMOTE_ERROR"),
+        min_trust_evals=r.get_int("WVA_FORECAST_MIN_TRUST_EVALS"),
+        prewake_enabled=r.get_bool("WVA_FORECAST_PREWAKE"),
+        prewake_min_demand=r.get_float("WVA_FORECAST_PREWAKE_MIN_DEMAND"),
     ))
 
     prom = PrometheusConfig(

@@ -74,6 +74,22 @@ WVA_TRACE_RECORDS_TOTAL = "wva_trace_records_total"
 WVA_TRACE_DROPPED_TOTAL = "wva_trace_dropped_total"
 WVA_TRACE_WRITE_SECONDS = "wva_trace_write_seconds"
 
+# --- Predictive capacity planner (wva_tpu.forecast) ---
+# The provisioning horizon the planner is ACTUALLY using per model: the
+# measured actuation->ready latency quantile (or the configured default
+# until samples exist).
+WVA_FORECAST_LEAD_TIME_SECONDS = "wva_forecast_lead_time_seconds"
+# Chosen forecaster's demand forecast at (now + lead time).
+WVA_FORECAST_DEMAND = "wva_forecast_demand"
+# Rolling symmetric-MAPE per (model, forecaster) from matured backtests.
+WVA_FORECAST_ERROR = "wva_forecast_error"
+# 1 when the model is demoted to reactive (rolling error over threshold).
+WVA_FORECAST_DEMOTED = "wva_forecast_demoted"
+
+# --- DemandTrend estimator health (analyzers/trend.py stats() hook) ---
+WVA_TREND_SERIES_SAMPLES = "wva_trend_series_samples"
+WVA_TREND_SERIES_STALENESS_SECONDS = "wva_trend_series_staleness_seconds"
+
 # --- Common metric label names ---
 LABEL_MODEL_NAME = "model_name"
 LABEL_TARGET_MODEL_NAME = "target_model_name"
@@ -87,5 +103,6 @@ LABEL_POD = "pod"
 LABEL_METRIC_NAME = "__name__"
 LABEL_ENGINE = "engine"
 LABEL_OUTCOME = "outcome"
+LABEL_FORECASTER = "forecaster"
 
 __all__ = [n for n in dir() if n.isupper()]

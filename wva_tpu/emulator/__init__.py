@@ -19,6 +19,8 @@ from wva_tpu.emulator.hpa import HPAEmulator, HPAParams
 from wva_tpu.emulator.loadgen import (
     LoadProfile,
     constant,
+    diurnal,
+    poisson_bursts,
     ramp,
     step_profile,
     trapezoid,
@@ -34,6 +36,8 @@ __all__ = [
     "HPAParams",
     "LoadProfile",
     "constant",
+    "diurnal",
+    "poisson_bursts",
     "ramp",
     "step_profile",
     "trapezoid",

@@ -4,6 +4,8 @@
 
 from __future__ import annotations
 
+import math
+import random
 from dataclasses import dataclass
 from typing import Callable
 
@@ -66,6 +68,52 @@ def trapezoid(base_rate: float, peak_rate: float, ramp_up: float,
         if t < ramp_down:
             return peak_rate - (peak_rate - base_rate) * (t / ramp_down)
         return base_rate if t < ramp_down + tail else 0.0
+
+    return profile
+
+
+def diurnal(base_rate: float, amplitude: float, period: float,
+            phase: float = 0.0) -> LoadProfile:
+    """Sinusoidal day-cycle: ``base_rate`` at the trough (t == phase),
+    ``base_rate + amplitude`` at the peak half a period later. The
+    seasonality workload for the forecast plane (harness/bench scenarios;
+    a compressed ``period`` — minutes instead of 24h — exercises the same
+    seasonal-fit machinery in simulated seconds)."""
+
+    def profile(t: float) -> float:
+        cycle = ((t - phase) % period) / period
+        return max(0.0, base_rate
+                   + amplitude * 0.5 * (1.0 - math.cos(2 * math.pi * cycle)))
+
+    return profile
+
+
+def poisson_bursts(base_rate: float, burst_rate: float,
+                   burst_duration: float, mean_gap: float,
+                   seed: int = 0) -> LoadProfile:
+    """Seeded Poisson-arriving bursts on a base rate: burst START times are
+    a Poisson process (exponential gaps, mean ``mean_gap``, measured from
+    the previous burst's END), each burst holding ``burst_rate`` for
+    ``burst_duration``. Fully deterministic for a given seed — burst times
+    depend only on (seed, count) — so harness worlds stay byte-for-byte
+    reproducible while exercising UNPREDICTABLE demand (the anti-seasonal
+    workload: a forecaster that stays trusted through Poisson bursts is
+    overfitting, and the planner's demotion guardrail must catch it)."""
+    rng = random.Random(seed)
+    starts: list[float] = []
+    horizon = [0.0]  # next gap is drawn from this instant
+
+    def profile(t: float) -> float:
+        while horizon[0] <= t:
+            start = horizon[0] + rng.expovariate(1.0 / max(mean_gap, 1e-9))
+            starts.append(start)
+            horizon[0] = start + burst_duration
+        for s in reversed(starts):
+            if s <= t < s + burst_duration:
+                return burst_rate
+            if s + burst_duration <= t:
+                break
+        return base_rate
 
     return profile
 

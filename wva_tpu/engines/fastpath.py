@@ -70,6 +70,7 @@ class FastPathMonitor:
                  clock: Clock | None = None,
                  poll_interval: float = DEFAULT_POLL_INTERVAL,
                  trend_feed_interval: float = DEFAULT_TREND_FEED_INTERVAL,
+                 forecast_planner=None,
                  ) -> None:
         self.client = client
         self.config = config
@@ -77,6 +78,11 @@ class FastPathMonitor:
         self.engine_executor = engine_executor
         self.prom_source = prom_source
         self.slo_analyzer = slo_analyzer
+        # Optional forecast.CapacityPlanner: the trend feed's demand
+        # samples also land in the planner's history store, so forecaster
+        # fits see between-tick resolution on the fine grid (SLO analyzer
+        # only — its demand units match the planner's engine-tick feed).
+        self.forecast = forecast_planner
         self.clock = clock or SYSTEM_CLOCK
         self.trend_feed_interval = trend_feed_interval
         self._last_trigger: dict[str, float] = {}  # "ns|model" -> time
@@ -197,3 +203,9 @@ class FastPathMonitor:
             return
         self.slo_analyzer.observe_demand(
             namespace, model_id, now, metrics.arrival_rate, backlog)
+        if self.forecast is not None:
+            from wva_tpu.analyzers.queueing.analyzer import demand_estimate
+
+            self.forecast.observe_demand(
+                namespace, model_id, now,
+                demand_estimate(metrics.arrival_rate, backlog))

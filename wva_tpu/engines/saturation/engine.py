@@ -40,11 +40,25 @@ from wva_tpu.api.v1alpha1 import (
     REASON_METRICS_MISSING,
     VariantAutoscaling,
 )
+from wva_tpu.blackbox.schema import STAGE_FORECAST
 from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
 from wva_tpu.collector.source.grouped import GroupedMetricsView
 from wva_tpu.config import Config
-from wva_tpu.constants import TPU_RESOURCE_NAME
+from wva_tpu.constants import (
+    LABEL_FORECASTER,
+    LABEL_MODEL_NAME,
+    LABEL_NAMESPACE,
+    TPU_RESOURCE_NAME,
+    WVA_FORECAST_DEMAND,
+    WVA_FORECAST_DEMOTED,
+    WVA_FORECAST_ERROR,
+    WVA_FORECAST_LEAD_TIME_SECONDS,
+    WVA_TREND_SERIES_SAMPLES,
+    WVA_TREND_SERIES_STALENESS_SECONDS,
+)
 from wva_tpu.engines import common
+from wva_tpu.forecast import apply_forecast_floors
+from wva_tpu.forecast.forecasters import FORECASTERS
 from wva_tpu.engines.executor import PollingExecutor
 from wva_tpu.interfaces import (
     ACTION_NO_CHANGE,
@@ -142,6 +156,7 @@ class SaturationEngine:
         recorder=None,
         flight_recorder=None,
         analysis_workers: int = DEFAULT_ANALYSIS_WORKERS,
+        forecast_planner=None,
     ) -> None:
         self.client = client
         self.config = config
@@ -171,6 +186,19 @@ class SaturationEngine:
         # opens one cycle record per tick; the engine and pipeline stages
         # fill it with analyzer inputs/outputs, decisions, and actuation.
         self.flight = flight_recorder
+        # Optional forecast.CapacityPlanner (WVA_FORECAST, default on from
+        # build_manager): demand history + measured lead times -> proactive
+        # replica floors applied between enforcement and the limiter, on
+        # the V2/SLO paths (the V1 percentage analyzer has no demand/
+        # capacity quantities to forecast). None = pure reactive, decisions
+        # byte-identical to pre-forecast builds.
+        self.forecast = forecast_planner
+        # Label sets the trend/forecast gauge sweeps emitted last tick: a
+        # deleted model's gauges are REMOVED from the registry, not left
+        # frozen at their last value (an operator alerting on staleness
+        # must not see a permanently fresh-looking dead series).
+        self._trend_gauge_keys: set[tuple] = set()
+        self._forecast_gauge_keys: set[tuple] = set()
         # Fleet-scale tick levers (docs/design/tick-scale.md +
         # docs/design/metrics-plane.md). All are independently toggleable so
         # `make bench-tick` / `make bench-collect` can reproduce the
@@ -353,6 +381,33 @@ class SaturationEngine:
         if self.flight is not None:
             self.flight.record_decisions(decisions)
         self._apply_decisions(decisions, va_map, snap)
+        self._emit_trend_metrics(analyzer_name)
+
+    def _emit_trend_metrics(self, analyzer_name: str) -> None:
+        """Surface the active analyzer's DemandTrend health (per-key sample
+        count + staleness) as wva_trend_* gauges — the estimator silently
+        returning slope 0 for a starved series was previously invisible."""
+        registry = getattr(self.actuator, "registry", None)
+        if registry is None:
+            return
+        analyzer = (self.slo_analyzer if analyzer_name == SLO_ANALYZER_NAME
+                    else self.v2_analyzer)
+        now = self.clock.now()
+        emitted: set[tuple] = set()
+        for key, st in sorted(analyzer.demand_trend_stats(now).items()):
+            ns, _, model = key.partition("|")
+            labels = {LABEL_MODEL_NAME: model, LABEL_NAMESPACE: ns}
+            emitted.add((model, ns))
+            registry.set_gauge(WVA_TREND_SERIES_SAMPLES, labels,
+                               float(st.samples))
+            if math.isfinite(st.staleness_seconds):
+                registry.set_gauge(WVA_TREND_SERIES_STALENESS_SECONDS,
+                                   labels, st.staleness_seconds)
+        for model, ns in self._trend_gauge_keys - emitted:
+            labels = {LABEL_MODEL_NAME: model, LABEL_NAMESPACE: ns}
+            registry.remove(WVA_TREND_SERIES_SAMPLES, labels)
+            registry.remove(WVA_TREND_SERIES_STALENESS_SECONDS, labels)
+        self._trend_gauge_keys = emitted
 
     # --- V1 path ---
 
@@ -652,8 +707,72 @@ class SaturationEngine:
             if scaled_to_zero:
                 log.info("Scale-to-zero enforcement applied (V2) for %s", req.model_id)
 
+        self._apply_forecast(requests, decisions, routes)
         self._apply_limiter(decisions)
         return decisions
+
+    def _apply_forecast(self, requests: list[ModelScalingRequest],
+                        decisions: list[VariantDecision],
+                        routes: dict[tuple[str, str], str] | None = None,
+                        ) -> None:
+        """Predictive planning stage (V2/SLO paths): feed the planner this
+        tick's demand + variant states, fit every model's forecasters in
+        one batched call, and raise proactive floors on the decisions.
+        Runs on the engine thread in sorted model order (the planner's
+        learned state must evolve byte-deterministically at any analysis-
+        pool width), BEFORE the limiter so inventory caps still bind."""
+        if self.forecast is None or not requests:
+            return
+        now = self.clock.now()
+        # Models routed through the fleet-wide global optimizer still get
+        # the planner's learning pass (history, lead times, backtests) but
+        # never a floor: the solver deliberately starves low-priority
+        # models on constrained pools and sequences migrations — a
+        # per-model floor would fight both.
+        no_floor = frozenset(
+            f"{ns}|{model}" for (model, ns), route in (routes or {}).items()
+            if route == "global")
+        try:
+            plans, floors = self.forecast.plan(requests, now,
+                                               no_floor_keys=no_floor)
+        except Exception as e:  # noqa: BLE001 — forecasting must never
+            # fail a tick: reactive decisions stand as computed.
+            log.error("Forecast planning failed, staying reactive: %s", e)
+            return
+        raised = apply_forecast_floors(decisions, floors, now)
+        if raised:
+            log.info("Forecast floors raised %d decision(s)", raised)
+        if self.flight is not None and plans:
+            self.flight.record_stage(STAGE_FORECAST, {
+                "plans": plans, "floors": floors, "raised": raised})
+        registry = getattr(self.actuator, "registry", None)
+        if registry is None:
+            return
+        emitted: set[tuple] = set()
+        for plan in plans:
+            labels = {LABEL_MODEL_NAME: plan.model_id,
+                      LABEL_NAMESPACE: plan.namespace}
+            emitted.add((plan.model_id, plan.namespace))
+            registry.set_gauge(WVA_FORECAST_LEAD_TIME_SECONDS, labels,
+                               plan.lead_time_seconds)
+            registry.set_gauge(WVA_FORECAST_DEMAND, labels,
+                               plan.forecast_demand)
+            registry.set_gauge(WVA_FORECAST_DEMOTED, labels,
+                               1.0 if plan.demoted else 0.0)
+            for name, err in plan.errors.items():
+                registry.set_gauge(WVA_FORECAST_ERROR,
+                                   {**labels, LABEL_FORECASTER: name}, err)
+        # Deleted/renamed models: drop their gauges instead of exporting
+        # the last values forever.
+        for model, ns in self._forecast_gauge_keys - emitted:
+            labels = {LABEL_MODEL_NAME: model, LABEL_NAMESPACE: ns}
+            for gauge in (WVA_FORECAST_LEAD_TIME_SECONDS,
+                          WVA_FORECAST_DEMAND, WVA_FORECAST_DEMOTED):
+                registry.remove(gauge, labels)
+            for name in FORECASTERS:
+                registry.remove(WVA_FORECAST_ERROR,
+                                {**labels, LABEL_FORECASTER: name})
+        self._forecast_gauge_keys = emitted
 
     def _apply_limiter(self, decisions: list[VariantDecision]) -> None:
         """Optional slice limiter, applied on EVERY analysis path (the
@@ -1262,6 +1381,20 @@ class SaturationEngine:
                 last_run_time=now,
             )
             update_va.status.actuation.applied = False
+            # Operators can see the horizon the planner ACTUALLY uses
+            # (measured actuation->ready quantile); only measured estimates
+            # are surfaced — the default constant would be noise dressed as
+            # a measurement. Assigned unconditionally (0 clears the field):
+            # with forecasting off or the measurement evicted, the status
+            # must stop claiming a horizon nobody is using. Rounded, and it
+            # only moves when a scale-up completes, so no write churn.
+            lead_value = 0.0
+            if self.forecast is not None:
+                lead, measured = self.forecast.lead_time_for(
+                    update_va.metadata.namespace, update_va.spec.model_id)
+                if measured:
+                    lead_value = round(lead, 1)
+            update_va.status.forecast_lead_time_seconds = lead_value
             update_va.set_condition(
                 TYPE_OPTIMIZATION_READY, "True",
                 "SaturationOnlyMode" if decision is not None

@@ -46,13 +46,20 @@ class ScaleFromZeroEngine:
     def __init__(self, client: KubeClient, config: Config, datastore: Datastore,
                  actuator: DirectActuator, clock: Clock | None = None,
                  poll_interval: float = DEFAULT_POLL_INTERVAL,
-                 recorder=None) -> None:
+                 recorder=None, forecast_planner=None) -> None:
         self.client = client
         self.config = config
         self.datastore = datastore
         self.actuator = actuator
         # Optional k8s.events.EventRecorder (ScalingDecision on 0->1).
         self.recorder = recorder
+        # Optional forecast.CapacityPlanner: pre-wake a scaled-to-zero
+        # model BEFORE the first request arrives when a trusted forecaster
+        # predicts demand at (now + provisioning lead time) — the wake
+        # itself rides the exact same actuation/status path as the
+        # backlog-triggered wake (including the conflict-refetch stale-
+        # write guard), so the two can never fight.
+        self.forecast = forecast_planner
         self.clock = clock or SYSTEM_CLOCK
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock,
@@ -63,10 +70,20 @@ class ScaleFromZeroEngine:
 
     def optimize(self) -> None:
         """One detection tick (reference engine.go:122-195)."""
-        inactive = variant_utils.inactive_variant_autoscalings(
-            self.client, namespace=self.config.watch_namespace() or None)
+        active, inactive = \
+            variant_utils.partition_variant_autoscalings_by_target(
+                self.client, namespace=self.config.watch_namespace() or None)
         if not inactive:
             return
+        # Forecast pre-wakes only apply to models that are FULLY scaled to
+        # zero: a model with one variant still serving records real demand
+        # through the engine tick, and a per-variant pre-wake would burn a
+        # slice (and feed phantom zero-demand samples) for capacity the
+        # active variant already provides. The backlog-triggered wake
+        # below is unaffected — queued requests are evidence regardless of
+        # sibling variants.
+        active_models = {f"{va.metadata.namespace}|{va.spec.model_id}"
+                         for va in active}
         # Wake only the cheapest inactive variant per model.
         by_model = variant_utils.group_variant_autoscalings_by_model(inactive)
         candidates = [min(vas, key=lambda va: (va.spec.cost(), va.metadata.name))
@@ -76,14 +93,15 @@ class ScaleFromZeroEngine:
         memo = ScrapeMemo()
         max_workers = max(self.config.scale_from_zero_max_concurrency(), 1)
         if len(candidates) == 1:
-            self._process_inactive_variant(candidates[0], memo)
+            self._process_inactive_variant(candidates[0], memo, active_models)
             return
         with ThreadPoolExecutor(max_workers=min(max_workers, len(candidates))) as pool:
-            list(pool.map(lambda va: self._process_inactive_variant(va, memo),
-                          candidates))
+            list(pool.map(lambda va: self._process_inactive_variant(
+                va, memo, active_models), candidates))
 
-    def _process_inactive_variant(self, va: VariantAutoscaling,
-                                  memo: ScrapeMemo | None = None) -> None:
+    def _process_inactive_variant(
+            self, va: VariantAutoscaling, memo: ScrapeMemo | None = None,
+            active_models: set[str] | None = None) -> None:
         """Check queued requests for the VA's model; scale 0->1 when present
         (reference engine.go:198-358). The target->pool->scrape chain is the
         shared engines.common.epp helper (the fast path walks the same one)."""
@@ -96,8 +114,21 @@ class ScaleFromZeroEngine:
         if values is None:
             return
 
+        reason = "scale-from-zero: pending requests in scheduler flow control"
+        metrics_message = "Pending requests detected in scheduler queue"
         if not self._has_pending_requests(values, va.spec.model_id):
-            return
+            model_key = f"{va.metadata.namespace}|{va.spec.model_id}"
+            if active_models and model_key in active_models:
+                return  # sibling variant serving: no speculative wake
+            prewake = self._forecast_prewake(va)
+            if prewake is None:
+                return
+            reason = prewake
+            # The queue was EMPTY — the trace/cache must say the wake was
+            # speculative, not point a debugging operator at a phantom
+            # backlog.
+            metrics_message = ("Trusted demand forecast triggered a "
+                               "speculative pre-wake (no queued requests)")
 
         try:
             changed = self.actuator.scale_target_object(
@@ -120,10 +151,10 @@ class ScaleFromZeroEngine:
             current_replicas=0,
             target_replicas=1,
             last_run_time=now,
-            reason="scale-from-zero: pending requests in scheduler flow control",
+            reason=reason,
             metrics_available=True,
             metrics_reason="MetricsFound",
-            metrics_message="Pending requests detected in scheduler queue",
+            metrics_message=metrics_message,
         )
         common.DecisionCache.set(va.metadata.name, va.metadata.namespace,
                                  decision, source=common.SOURCE_SCALE_FROM_ZERO)
@@ -137,7 +168,7 @@ class ScaleFromZeroEngine:
                 accelerator=accelerator, num_replicas=1, last_run_time=now)
             update_va.set_condition(
                 TYPE_OPTIMIZATION_READY, "True", "ScaleFromZero",
-                "Scaled 0->1: pending requests in scheduler flow control", now=now)
+                f"Scaled 0->1: {reason}", now=now)
             # Conflict-refetch, not plain backoff: the engine/reconciler can
             # write this VA's status concurrently, and the wake (the newest
             # decision) must win the race, not crash the tick on a 409.
@@ -157,6 +188,21 @@ class ScaleFromZeroEngine:
         common.fire_trigger(va.metadata.name, va.metadata.namespace)
         log.info("Scale-from-zero: woke %s/%s for model %s",
                  va.metadata.namespace, va.metadata.name, va.spec.model_id)
+
+    def _forecast_prewake(self, va: VariantAutoscaling) -> str | None:
+        """Trusted-forecast pre-wake reason, or None. Throttled and
+        trust-gated by the planner (an unproven forecaster must not burn
+        chips on speculation); thread-safe for the candidate worker pool."""
+        if self.forecast is None:
+            return None
+        try:
+            wake, reason = self.forecast.should_prewake(
+                va.metadata.namespace, va.spec.model_id, self.clock.now())
+        except Exception as e:  # noqa: BLE001 — forecasting must never
+            log.debug("Forecast pre-wake check failed for %s/%s: %s",
+                      va.metadata.namespace, va.metadata.name, e)
+            return None
+        return reason if wake else None
 
     @staticmethod
     def _has_pending_requests(values, model_id: str) -> bool:

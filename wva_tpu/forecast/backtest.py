@@ -1,0 +1,277 @@
+"""Offline forecaster backtest over recorded decision traces.
+
+``python -m wva_tpu forecast backtest <trace.jsonl>`` replays the per-model
+demand series out of a flight-recorder trace (``wva_tpu.blackbox``) through
+every candidate forecaster — exactly the walk-forward loop the live
+planner's trust gate runs, but offline and over the whole trace at once —
+and scores each forecaster's MAPE plus under/over-provision cost. This is
+how an operator picks ``WVA_FORECAST_*`` knobs against their OWN production
+trace instead of trusting defaults (the AIBrix move: tune proactive scaling
+by simulation over recorded traces), and how CI gates forecaster
+regressions (``make backtest-golden`` against the committed golden report).
+
+Scoring:
+
+- **mape** — symmetric MAPE of forecast-at-(t + lead) vs realized demand
+  at t + lead, in [0, 2].
+- **under_provision_cost** — sum of demand the forecast would have left
+  unserved (realized - forecast, clipped at 0), normalized by total
+  realized demand. Under-provision is backlog and SLO misses — the
+  expensive direction on slow-provisioning TPUs.
+- **over_provision_cost** — sum of forecast excess over realized demand,
+  normalized; the chip-seconds the floor would have wasted.
+
+Only V2/SLO cycles carry an ``AnalyzerResult.total_demand``; V1 cycles are
+counted and skipped (the percentage analyzer has no demand quantity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from wva_tpu.blackbox.replay import load_trace
+from wva_tpu.forecast import forecasters as fc
+from wva_tpu.forecast.history import DemandHistoryStore
+
+# Score a matured forecast only when a realized sample exists within this
+# fraction of the lead time of the target instant.
+MATCH_TOLERANCE_FRACTION = 0.5
+
+
+def extract_series(records: list[dict]) -> tuple[dict[str, list], int]:
+    """Per-model (t, demand) series from trace records; returns
+    (series-by-key, v1 model records skipped)."""
+    series: dict[str, list[tuple[float, float]]] = {}
+    skipped = 0
+    for rec in records:
+        if rec.get("outcome") not in ("", "success", None):
+            continue
+        ts = float(rec.get("ts", 0.0))
+        for m in rec.get("models") or []:
+            result = m.get("result")
+            if result is None or "total_demand" not in result:
+                skipped += 1
+                continue
+            key = f"{m.get('namespace', '')}|{m.get('model_id', '')}"
+            t = float(result.get("analyzed_at") or ts)
+            series.setdefault(key, []).append(
+                (t, float(result["total_demand"])))
+    for vals in series.values():
+        vals.sort()
+    return series, skipped
+
+
+def backtest_series(points: list[tuple[float, float]], lead: float,
+                    period: float, grid_step: float,
+                    min_history: float) -> dict[str, dict]:
+    """Walk-forward backtest of one model's series; returns per-forecaster
+    scores."""
+    long_step = period / fc.SEASON_STEPS
+    store = DemandHistoryStore(
+        window_seconds=long_step * fc.N_GRID,
+        fine_window_seconds=grid_step * fc.N_GRID,
+        long_gap_seconds=long_step / 2.0)
+    pending: list[tuple[float, dict[str, float]]] = []
+    scored: dict[str, list[tuple[float, float]]] = {
+        name: [] for name in fc.FORECASTERS}
+    tol = max(lead * MATCH_TOLERANCE_FRACTION, grid_step)
+    t0 = points[0][0]
+    for t, d in points:
+        # Score matured forecasts against this realized sample.
+        still = []
+        for due, preds in pending:
+            if due > t:
+                still.append((due, preds))
+            elif abs(t - due) <= tol:
+                for name, p in preds.items():
+                    scored[name].append((p, d))
+        pending = still
+        store.observe("k", t, max(d, 0.0))
+        if t - t0 < min_history:
+            continue
+        windows = store.windows("k")
+        fine, nf = fc.resample(windows[0], t, grid_step)
+        longg, nl = fc.resample(windows[1], t, long_step)
+        fit = fc.fit_batch([fc.SeriesGrids(
+            fine=fine, fine_valid=nf, long=longg, long_valid=nl,
+            h_fine_steps=lead / grid_step, h_long_steps=lead / long_step,
+            season_steps=fc.SEASON_STEPS)])[0]
+        pending.append((t + lead, fit))
+
+    out = {}
+    for name, pairs in scored.items():
+        if not pairs:
+            out[name] = {"n": 0}
+            continue
+        total_real = sum(r for _, r in pairs)
+        mape = sum(abs(p - r) / max((abs(p) + abs(r)) / 2.0, 1e-6)
+                   for p, r in pairs) / len(pairs)
+        under = sum(max(r - p, 0.0) for p, r in pairs)
+        over = sum(max(p - r, 0.0) for p, r in pairs)
+        norm = max(total_real, 1e-9)
+        out[name] = {
+            "n": len(pairs),
+            "mape": round(min(mape, 2.0), 6),
+            "under_provision_cost": round(under / norm, 6),
+            "over_provision_cost": round(over / norm, 6),
+        }
+    return out
+
+
+def run_backtest(trace_path: str, lead: float, period: float,
+                 grid_step: float, min_history: float) -> dict:
+    records = load_trace(trace_path)
+    series, v1_skipped = extract_series(records)
+    models = {}
+    for key in sorted(series):
+        if len(series[key]) >= 3:
+            models[key] = backtest_series(series[key], lead, period,
+                                          grid_step, min_history)
+    agg: dict[str, dict] = {}
+    for per_model in models.values():
+        for name, s in per_model.items():
+            if not s.get("n"):
+                continue
+            a = agg.setdefault(name, {"n": 0, "mape": 0.0,
+                                      "under_provision_cost": 0.0,
+                                      "over_provision_cost": 0.0})
+            w, n = a["n"], s["n"]
+            for field in ("mape", "under_provision_cost",
+                          "over_provision_cost"):
+                a[field] = (a[field] * w + s[field] * n) / (w + n)
+            a["n"] = w + n
+    for a in agg.values():
+        for field in ("mape", "under_provision_cost", "over_provision_cost"):
+            a[field] = round(a[field], 6)
+    ranking = sorted(agg, key=lambda n: (agg[n]["mape"], n))
+    return {
+        "trace": trace_path.rsplit("/", 1)[-1],
+        "cycles": len(records),
+        "models": models,
+        "v1_model_records_skipped": v1_skipped,
+        "lead_time_seconds": lead,
+        "seasonal_period_seconds": period,
+        "aggregate": agg,
+        "ranking": ranking,
+        "best": ranking[0] if ranking else "",
+        "seasonal_beats_linear": bool(
+            agg.get("linear") and any(
+                agg.get(n, {}).get("mape", float("inf"))
+                < agg["linear"]["mape"] for n in fc.SEASONAL_FORECASTERS)),
+    }
+
+
+def compare_to_golden(report: dict, golden: dict,
+                      rel_tol: float = 1e-4) -> list[str]:
+    """Regression gate: ranking must match exactly, aggregate scores within
+    tolerance, and the seasonal-beats-linear acceptance bit must hold."""
+    problems = []
+    if report.get("ranking") != golden.get("ranking"):
+        problems.append(f"ranking changed: {golden.get('ranking')} -> "
+                        f"{report.get('ranking')}")
+    if golden.get("seasonal_beats_linear") \
+            and not report.get("seasonal_beats_linear"):
+        problems.append("seasonal forecaster no longer beats the "
+                        "linear-trend baseline")
+    for name, g in (golden.get("aggregate") or {}).items():
+        r = (report.get("aggregate") or {}).get(name)
+        if r is None:
+            problems.append(f"forecaster {name} missing from report")
+            continue
+        for field in ("mape", "under_provision_cost",
+                      "over_provision_cost", "n"):
+            gv, rv = g.get(field), r.get(field)
+            if gv is None or rv is None:
+                continue
+            if abs(rv - gv) > rel_tol * max(abs(gv), 1.0):
+                problems.append(
+                    f"{name}.{field}: golden={gv} got={rv}")
+    return problems
+
+
+def backtest_cli(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="wva-tpu forecast backtest",
+        description="Replay a recorded decision trace's demand series "
+                    "through every candidate forecaster and score MAPE + "
+                    "under/over-provision cost.")
+    p.add_argument("trace", help="JSONL decision trace (WVA_TRACE_PATH "
+                                 "output)")
+    p.add_argument("--lead", type=float, default=150.0,
+                   help="forecast horizon in seconds (default 150 — the "
+                        "provisioning lead-time design point)")
+    p.add_argument("--period", type=float, default=86400.0,
+                   help="seasonal period in seconds (default 1 day; match "
+                        "the trace's seasonality)")
+    p.add_argument("--grid-step", type=float, default=15.0,
+                   help="fine-grid resolution in seconds")
+    p.add_argument("--min-history", type=float, default=None,
+                   help="warm-up seconds before the first scored forecast "
+                        "(default: one lead time; 0 scores from the first "
+                        "sample)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full machine-readable report")
+    p.add_argument("--golden", default="",
+                   help="compare against a committed golden report; "
+                        "non-zero exit on regression")
+    p.add_argument("--update-golden", action="store_true",
+                   help="rewrite the --golden file from this run")
+    args = p.parse_args(argv)
+
+    try:
+        report = run_backtest(args.trace, args.lead, args.period,
+                              args.grid_step,
+                              args.lead if args.min_history is None
+                              else args.min_history)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report, sort_keys=True, indent=1))
+    else:
+        print(f"trace: {report['trace']} ({report['cycles']} cycles, "
+              f"{len(report['models'])} models, lead {args.lead:.0f}s, "
+              f"period {args.period:.0f}s)")
+        for name in report["ranking"]:
+            a = report["aggregate"][name]
+            print(f"  {name:15s} mape={a['mape']:.4f} "
+                  f"under={a['under_provision_cost']:.4f} "
+                  f"over={a['over_provision_cost']:.4f} n={a['n']}")
+        print(f"best: {report['best'] or 'n/a'}; seasonal beats linear: "
+              f"{report['seasonal_beats_linear']}")
+
+    if args.golden:
+        if args.update_golden:
+            slim = {k: v for k, v in report.items() if k != "models"}
+            with open(args.golden, "w", encoding="utf-8") as f:
+                json.dump(slim, f, sort_keys=True, indent=1)
+                f.write("\n")
+            print(f"wrote {args.golden}")
+            return 0
+        try:
+            with open(args.golden, "r", encoding="utf-8") as f:
+                golden = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: unreadable golden {args.golden}: {e}",
+                  file=sys.stderr)
+            return 2
+        problems = compare_to_golden(report, golden)
+        for prob in problems:
+            print(f"GOLDEN MISMATCH: {prob}")
+        print("BACKTEST GOLDEN OK" if not problems
+              else "BACKTEST GOLDEN FAILED")
+        return 0 if not problems else 1
+    return 0
+
+
+def forecast_cli(argv: list[str] | None = None) -> int:
+    """``python -m wva_tpu forecast <subcommand>`` dispatcher."""
+    argv = argv or []
+    if argv and argv[0] == "backtest":
+        return backtest_cli(argv[1:])
+    print("usage: python -m wva_tpu forecast backtest <trace.jsonl> [...]",
+          file=sys.stderr)
+    return 2

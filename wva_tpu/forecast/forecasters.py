@@ -1,0 +1,248 @@
+"""Forecaster registry: batched, jitted demand forecasts.
+
+Four candidate forecasters, Autopilot-style (Rzadca et al., EuroSys 2020 —
+fit several recommenders over sliding windows, select by replayed error):
+
+- ``linear``          — least-squares trend over the fine grid (the
+                        existing ``DemandTrend`` slope as a forecaster; the
+                        registry floor).
+- ``holt``            — double exponential smoothing (level + trend) over
+                        the fine grid; tracks ramps with less lag than the
+                        window fit.
+- ``seasonal_naive``  — demand one season ago (+ the forecast horizon) from
+                        the long grid; the classic strong baseline for
+                        diurnal serving traffic.
+- ``holt_winters``    — additive triple exponential smoothing (level +
+                        trend + per-phase seasonal terms) over the long
+                        grid.
+
+Batching discipline matches the SLO solver (``queue_model.size_batch``):
+every model's series is resampled onto fixed-width grids (``N_GRID``
+columns, LOCF), the model axis is padded to a power-of-two bucket, and ONE
+jitted call computes every forecaster for every model — a 48-model tick
+costs one dispatch, not 48. All per-model math is row-independent
+(elementwise ops, per-row reductions, per-row scan state), so batched and
+serial fits are byte-identical at any batch width — asserted by
+``tests/test_forecast.py`` and the ``test_tick_scale.py`` determinism
+suite.
+
+Two grids per model, because no single resolution serves both families:
+the **fine** grid (``grid_step_seconds``) covers the recent window for the
+trend forecasters; the **long** grid spans >= 2 seasonal periods at
+``period / (N_GRID/2)`` resolution for the seasonal ones.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+FORECASTERS = ("linear", "holt", "seasonal_naive", "holt_winters")
+SEASONAL_FORECASTERS = ("seasonal_naive", "holt_winters")
+
+# Static grid width. 160 columns cover 40min of 15s fine steps and 2+
+# seasonal periods on the long grid (step = period / 64).
+N_GRID = 160
+# Long-grid resolution: season length in steps (<= N_GRID / 2 so at least
+# two full seasons fit the grid and the seasonal state can be learned).
+SEASON_STEPS = 64
+# A fit needs this many real samples before any forecaster output is
+# trusted; below it every forecaster degrades to last-value persistence.
+MIN_VALID = 4
+
+# Smoothing constants (fixed, not per-model-tuned: the registry selects
+# between FORMS by replayed error; tuning constants per model would need
+# its own backtest loop for marginal gain).
+HOLT_ALPHA = 0.5
+HOLT_BETA = 0.2
+HW_ALPHA = 0.35
+HW_BETA = 0.1
+HW_GAMMA = 0.35
+
+
+@dataclass
+class SeriesGrids:
+    """One model's resampled inputs for the batched fit."""
+
+    fine: list[float]  # N_GRID values, newest at index N_GRID-1
+    fine_valid: int  # trailing valid count (0 = no data)
+    long: list[float]
+    long_valid: int
+    h_fine_steps: float  # forecast horizon in fine steps
+    h_long_steps: float  # forecast horizon in long steps
+    season_steps: int  # seasonal period in long steps
+
+
+def resample(window, now: float, step: float) -> tuple[list[float], int]:
+    """Sample-and-hold a SeriesWindow onto ``N_GRID`` points ending at
+    ``now`` (newest at the last index). Returns (values, valid_count):
+    points before the first sample are invalid (zero-filled)."""
+    vals = [0.0] * N_GRID
+    n = len(window)
+    if n == 0:
+        return vals, 0
+    ts0 = window.ts[window.lo]
+    j = window.hi - 1  # walk newest -> oldest
+    valid = 0
+    for i in range(N_GRID - 1, -1, -1):
+        t = now - (N_GRID - 1 - i) * step
+        if t < ts0:
+            break
+        while j > window.lo and window.ts[j] > t:
+            j -= 1
+        if window.ts[j] > t:
+            break
+        vals[i] = window.vals[j]
+        valid += 1
+    return vals, valid
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _fit_grid(fine, fine_valid, long_vals, long_valid,
+              h_fine, h_long, season, m: int):
+    """All four forecasters over ``m`` models at once. Shapes: grids
+    ``[m, N_GRID]`` float32, everything else ``[m]``. Returns
+    ``{name: [m]}`` forecasts at each model's horizon, clamped >= 0."""
+    idx = jnp.arange(N_GRID, dtype=jnp.float32)  # [N]
+    rows = jnp.arange(m)
+
+    def mask_of(valid):
+        return (idx[None, :] >= (N_GRID - valid)[:, None]).astype(jnp.float32)
+
+    def last_value(vals):
+        return vals[:, -1]
+
+    fine_m = mask_of(fine_valid)
+    long_m = mask_of(long_valid)
+
+    # -- linear: masked least-squares over the fine grid index axis --
+    n = jnp.sum(fine_m, axis=1)
+    sx = jnp.sum(fine_m * idx[None, :], axis=1)
+    sy = jnp.sum(fine_m * fine, axis=1)
+    sxx = jnp.sum(fine_m * idx[None, :] * idx[None, :], axis=1)
+    sxy = jnp.sum(fine_m * idx[None, :] * fine, axis=1)
+    denom = n * sxx - sx * sx
+    slope = jnp.where(denom > 0, (n * sxy - sx * sy)
+                      / jnp.where(denom > 0, denom, 1.0), 0.0)
+    intercept = jnp.where(n > 0, (sy - slope * sx)
+                          / jnp.where(n > 0, n, 1.0), 0.0)
+    linear = intercept + slope * (N_GRID - 1 + h_fine)
+
+    # -- holt: double exponential smoothing over the fine grid --
+    def holt_step(carry, xm):
+        level, trend, started = carry
+        x, valid = xm  # [m] each
+        new_level = HOLT_ALPHA * x + (1 - HOLT_ALPHA) * (level + trend)
+        new_trend = HOLT_BETA * (new_level - level) + (1 - HOLT_BETA) * trend
+        # First valid sample initializes the level; invalid steps carry.
+        level2 = jnp.where(started > 0, new_level, x)
+        trend2 = jnp.where(started > 0, new_trend, 0.0)
+        level = jnp.where(valid > 0, level2, level)
+        trend = jnp.where(valid > 0, trend2, trend)
+        started = jnp.maximum(started, valid)
+        return (level, trend, started), None
+
+    zeros = jnp.zeros((m,), jnp.float32)
+    (h_level, h_trend, _), _ = jax.lax.scan(
+        holt_step, (zeros, zeros, zeros), (fine.T, fine_m.T))
+    holt = h_level + h_trend * h_fine
+
+    # -- seasonal_naive: long-grid value one season before the target --
+    j = jnp.round(N_GRID - 1 + h_long - season.astype(jnp.float32))
+    j_int = jnp.clip(j.astype(jnp.int32), 0, N_GRID - 1)
+    picked = long_vals[rows, j_int]
+    j_valid = (j >= (N_GRID - long_valid).astype(jnp.float32)) \
+        & (j <= N_GRID - 1)
+    seasonal_naive = jnp.where(j_valid, picked, last_value(long_vals))
+
+    # -- holt_winters: additive triple smoothing over the long grid --
+    def hw_step(carry, xim):
+        level, trend, seas, started = carry
+        x, i, valid = xim
+        phase = jnp.mod(i.astype(jnp.int32), season)  # [m]
+        s = seas[rows, phase]
+        new_level = HW_ALPHA * (x - s) + (1 - HW_ALPHA) * (level + trend)
+        new_trend = HW_BETA * (new_level - level) + (1 - HW_BETA) * trend
+        new_s = HW_GAMMA * (x - new_level) + (1 - HW_GAMMA) * s
+        level2 = jnp.where(started > 0, new_level, x)
+        trend2 = jnp.where(started > 0, new_trend, 0.0)
+        s2 = jnp.where(started > 0, new_s, s)
+        apply = valid > 0
+        level = jnp.where(apply, level2, level)
+        trend = jnp.where(apply, trend2, trend)
+        seas = seas.at[rows, phase].set(jnp.where(apply, s2, s))
+        started = jnp.maximum(started, valid)
+        return (level, trend, seas, started), None
+
+    steps = jnp.arange(N_GRID, dtype=jnp.float32)
+    steps_b = jnp.broadcast_to(steps[:, None], (N_GRID, m))
+    (w_level, w_trend, w_seas, _), _ = jax.lax.scan(
+        hw_step,
+        (zeros, zeros, jnp.zeros((m, N_GRID), jnp.float32), zeros),
+        (long_vals.T, steps_b, long_m.T))
+    f_phase = jnp.mod(
+        jnp.round(N_GRID - 1 + h_long).astype(jnp.int32), season)
+    holt_winters = w_level + w_trend * h_long + w_seas[rows, f_phase]
+
+    # Insufficient history (either grid): persistence, the only honest
+    # answer; clamp everything at zero (demand is non-negative).
+    fallback_fine = last_value(fine)
+    fallback_long = last_value(long_vals)
+    enough_fine = fine_valid >= MIN_VALID
+    enough_long = long_valid >= MIN_VALID
+    return {
+        "linear": jnp.maximum(
+            jnp.where(enough_fine, linear, fallback_fine), 0.0),
+        "holt": jnp.maximum(
+            jnp.where(enough_fine, holt, fallback_fine), 0.0),
+        "seasonal_naive": jnp.maximum(
+            jnp.where(enough_long, seasonal_naive, fallback_long), 0.0),
+        "holt_winters": jnp.maximum(
+            jnp.where(enough_long, holt_winters, fallback_long), 0.0),
+    }
+
+
+def _bucket(m: int) -> int:
+    b = 1
+    while b < m:
+        b *= 2
+    return b
+
+
+def fit_batch(grids: list[SeriesGrids]) -> list[dict[str, float]]:
+    """ONE padded jitted fit across every model; returns one
+    ``{forecaster: forecast}`` dict per input, in order. Padding rows are
+    fully invalid and sliced off — per-model results are independent of
+    batch composition (asserted batched == serial by the test suite)."""
+    if not grids:
+        return []
+    m = _bucket(len(grids))
+
+    def pad(vals, fill=0.0):
+        return vals + [fill] * (m - len(grids))
+
+    out = _fit_grid(
+        jnp.asarray(pad([g.fine for g in grids], [0.0] * N_GRID),
+                    jnp.float32),
+        jnp.asarray(pad([g.fine_valid for g in grids], 0), jnp.float32),
+        jnp.asarray(pad([g.long for g in grids], [0.0] * N_GRID),
+                    jnp.float32),
+        jnp.asarray(pad([g.long_valid for g in grids], 0), jnp.float32),
+        jnp.asarray(pad([g.h_fine_steps for g in grids], 0.0), jnp.float32),
+        jnp.asarray(pad([g.h_long_steps for g in grids], 0.0), jnp.float32),
+        jnp.asarray(pad([max(1, min(g.season_steps, N_GRID))
+                         for g in grids], 1), jnp.int32),
+        m=m,
+    )
+    host = {k: [float(x) for x in v] for k, v in out.items()}
+    return [{k: host[k][i] for k in FORECASTERS}
+            for i in range(len(grids))]
+
+
+def fit_serial(grids: list[SeriesGrids]) -> list[dict[str, float]]:
+    """One fit call per model (the bench comparison lever and the
+    byte-equality oracle for :func:`fit_batch`)."""
+    return [fit_batch([g])[0] for g in grids]

@@ -1,0 +1,134 @@
+"""Measured provisioning lead times.
+
+Replaces the static provisioning-horizon constant
+(``anticipationHorizonSeconds``) with the per-(accelerator, model) quantile
+of OBSERVED actuation->ready latencies. The engine feeds each model's
+variant states every tick; the estimator opens an episode when a variant's
+desired replicas exceed its ready replicas (a scale-up is in flight), and
+closes it when ready catches up — the elapsed time is one lead-time sample
+covering the whole real chain: HPA/actuator reaction, slice provisioning,
+multi-host group assembly, model load, readiness. In the emulation harness
+those transitions are driven by ``emulator/kubelet.py``'s ``ready_at``
+physics; in live mode by pod readiness as reflected in scale-target status.
+
+Samples are kept in small per-(accelerator, model) rings; the estimate is a
+configurable quantile (default p90 — sizing for the common-case lead time
+under-provisions whenever provisioning lands slow, and slow is exactly when
+backlog hurts most). Fallback order: (accelerator, model) -> accelerator ->
+configured default.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from dataclasses import dataclass
+
+MAX_SAMPLES = 64
+# An episode that outlives this is abandoned (deleted variant, wedged
+# provisioning the operator resolved by other means): recording it would
+# poison the quantile with an unbounded outlier.
+EPISODE_TIMEOUT_SECONDS = 3600.0
+
+
+@dataclass
+class _Episode:
+    started: float
+    goal: int
+    accelerator: str
+
+
+class LeadTimeEstimator:
+    """Thread-safe actuation->ready latency tracker."""
+
+    def __init__(self, quantile: float = 0.9,
+                 default_seconds: float = 150.0) -> None:
+        self.quantile = min(max(quantile, 0.0), 1.0)
+        self.default_seconds = default_seconds
+        self._mu = threading.Lock()
+        # (model_key, accelerator) -> ring of observed latencies (seconds).
+        self._samples: dict[tuple[str, str], deque[float]] = {}
+        self._by_accel: dict[str, deque[float]] = {}
+        # "model_key|variant" -> open scale-up episode.
+        self._episodes: dict[str, _Episode] = {}
+
+    def observe(self, model_key: str, variant_name: str, accelerator: str,
+                desired: int, ready: int, now: float) -> None:
+        """One variant's (desired, ready) observation for this tick."""
+        ekey = f"{model_key}|{variant_name}"
+        with self._mu:
+            ep = self._episodes.get(ekey)
+            if ep is not None and (now - ep.started > EPISODE_TIMEOUT_SECONDS
+                                   or desired < ep.goal):
+                # Abandoned or retargeted down: elapsed time no longer
+                # measures one provisioning round trip.
+                del self._episodes[ekey]
+                ep = None
+            if ep is None:
+                if desired > ready:
+                    self._episodes[ekey] = _Episode(
+                        started=now, goal=desired, accelerator=accelerator)
+                return
+            if desired > ep.goal:
+                # Retarget up mid-flight: measure to the new goal (the
+                # planner cares when the full order lands).
+                ep.goal = desired
+            if ready >= ep.goal:
+                self._record(model_key, ep.accelerator, now - ep.started)
+                del self._episodes[ekey]
+
+    def _record(self, model_key: str, accelerator: str,
+                latency: float) -> None:
+        if latency <= 0:
+            return
+        ring = self._samples.setdefault(
+            (model_key, accelerator), deque(maxlen=MAX_SAMPLES))
+        ring.append(latency)
+        self._by_accel.setdefault(
+            accelerator, deque(maxlen=MAX_SAMPLES)).append(latency)
+
+    @staticmethod
+    def _quantile(samples: list[float], q: float) -> float:
+        xs = sorted(samples)
+        pos = q * (len(xs) - 1)
+        lo = int(math.floor(pos))
+        hi = min(lo + 1, len(xs) - 1)
+        return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+    def estimate(self, model_key: str,
+                 accelerator: str = "") -> tuple[float, bool]:
+        """(lead-time seconds, measured?). Fallback chain: the model's own
+        samples on ``accelerator`` -> the model's best-covered accelerator
+        -> the fleet's samples for ``accelerator`` (a NEW model inherits
+        its accelerator's measured latencies) -> the configured default
+        (measured=False)."""
+        with self._mu:
+            ring = self._samples.get((model_key, accelerator))
+            if not ring:
+                # Best-covered accelerator for the model (covers both the
+                # model-level ask and a variant that moved accelerators).
+                rings = [r for (mk, _), r in self._samples.items()
+                         if mk == model_key and r]
+                if rings:
+                    ring = max(rings, key=len)
+            if ring:
+                return self._quantile(list(ring), self.quantile), True
+            accel_ring = self._by_accel.get(accelerator)
+            if accel_ring:
+                return self._quantile(list(accel_ring), self.quantile), True
+            return self.default_seconds, False
+
+    def sample_count(self, model_key: str) -> int:
+        with self._mu:
+            return sum(len(r) for (mk, _), r in self._samples.items()
+                       if mk == model_key)
+
+    def evict_missing(self, live_keys: set[str]) -> None:
+        """Drop episodes + samples for models that no longer exist."""
+        with self._mu:
+            for k in [k for k in self._episodes
+                      if k.rsplit("|", 1)[0] not in live_keys]:
+                del self._episodes[k]
+            for k in [k for k in self._samples if k[0] not in live_keys]:
+                del self._samples[k]

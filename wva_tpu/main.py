@@ -287,6 +287,25 @@ def build_manager(
 
     capacity_store = CapacityKnowledgeStore(clock=clock)
     recorder = EventRecorder(client, clock=clock)
+    # Predictive capacity planner (WVA_FORECAST, default on): demand
+    # history + measured lead times -> proactive replica floors and
+    # scale-from-zero pre-wakes (docs/design/forecast.md). Disabled,
+    # decisions are byte-identical to pre-forecast builds.
+    forecast_planner = None
+    fc_cfg = config.forecast_config()
+    if fc_cfg.enabled:
+        from wva_tpu.forecast import CapacityPlanner
+
+        forecast_planner = CapacityPlanner(
+            seasonal_period_seconds=fc_cfg.seasonal_period_seconds,
+            grid_step_seconds=fc_cfg.grid_step_seconds,
+            default_lead_time_seconds=fc_cfg.default_lead_time_seconds,
+            lead_time_quantile=fc_cfg.lead_time_quantile,
+            target_utilization=fc_cfg.target_utilization,
+            demote_error_threshold=fc_cfg.demote_error_threshold,
+            min_trust_evals=fc_cfg.min_trust_evals,
+            prewake_enabled=fc_cfg.prewake_enabled,
+            prewake_min_demand=fc_cfg.prewake_min_demand)
     # Analysis pool width 0 = auto, resolved by the metrics backend (same
     # rule as PrometheusSource's query concurrency): per-model collection
     # against HTTP Prometheus is I/O-bound and overlaps across workers; the
@@ -301,17 +320,19 @@ def build_manager(
         clock=clock, poll_interval=min(config.optimization_interval() / 2, 30.0),
         direct_actuator=direct_actuator, recorder=recorder,
         flight_recorder=flight,
-        analysis_workers=workers)
+        analysis_workers=workers,
+        forecast_planner=forecast_planner)
     engine.grouped_collection = config.grouped_collection_enabled()
     if flight is not None:
         engine.optimizer.flight_recorder = flight
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,
                                           direct_actuator, clock=clock,
-                                          recorder=recorder)
+                                          recorder=recorder,
+                                          forecast_planner=forecast_planner)
     fastpath = FastPathMonitor(
         client, config, datastore, engine.executor,
         prom_source=prom_source, slo_analyzer=engine.slo_analyzer,
-        clock=clock)
+        clock=clock, forecast_planner=forecast_planner)
     # Self-observability: every engine loop reports its tick duration and
     # success/error outcome on /metrics (controller-runtime reconcile
     # metrics equivalent).

@@ -31,10 +31,16 @@ from wva_tpu.constants import (
     WVA_DESIRED_REPLICAS,
     WVA_ENGINE_TICK_DURATION_SECONDS,
     WVA_ENGINE_TICKS_TOTAL,
+    WVA_FORECAST_DEMAND,
+    WVA_FORECAST_DEMOTED,
+    WVA_FORECAST_ERROR,
+    WVA_FORECAST_LEAD_TIME_SECONDS,
     WVA_REPLICA_SCALING_TOTAL,
     WVA_TRACE_DROPPED_TOTAL,
     WVA_TRACE_RECORDS_TOTAL,
     WVA_TRACE_WRITE_SECONDS,
+    WVA_TREND_SERIES_SAMPLES,
+    WVA_TREND_SERIES_STALENESS_SECONDS,
 )
 
 _LabelKey = tuple[tuple[str, str], ...]
@@ -74,6 +80,24 @@ class MetricsRegistry:
                        "Decision-trace records or events dropped, by reason")
         self._register(WVA_TRACE_WRITE_SECONDS, "gauge",
                        "Wall-clock latency of the last trace spill write")
+        self._register(WVA_FORECAST_LEAD_TIME_SECONDS, "gauge",
+                       "Provisioning lead time the capacity planner uses "
+                       "per model (measured actuation->ready quantile)")
+        self._register(WVA_FORECAST_DEMAND, "gauge",
+                       "Forecast demand at (now + lead time) from the "
+                       "chosen forecaster")
+        self._register(WVA_FORECAST_ERROR, "gauge",
+                       "Rolling symmetric-MAPE per (model, forecaster) "
+                       "from matured backtests")
+        self._register(WVA_FORECAST_DEMOTED, "gauge",
+                       "1 when the model is demoted to reactive scaling "
+                       "(forecast rolling error over threshold)")
+        self._register(WVA_TREND_SERIES_SAMPLES, "gauge",
+                       "DemandTrend sliding-window sample count per model "
+                       "series")
+        self._register(WVA_TREND_SERIES_STALENESS_SECONDS, "gauge",
+                       "Age of the newest DemandTrend sample per model "
+                       "series")
 
     def _register(self, name: str, kind: str, help_text: str) -> None:
         self._series[name] = _Series(name, kind, help_text)
@@ -103,6 +127,14 @@ class MetricsRegistry:
     def get(self, name: str, labels: dict[str, str]) -> float | None:
         with self._mu:
             return self._series[name].values.get(self._key(labels))
+
+    def remove(self, name: str, labels: dict[str, str]) -> bool:
+        """Drop one label set from a series (a deleted model's gauges must
+        not keep exporting their last value forever). The TSDB mirror is
+        left alone — its retention sweep ages the series out naturally."""
+        with self._mu:
+            return self._series[name].values.pop(self._key(labels),
+                                                 None) is not None
 
     def emit_replica_metrics(self, variant_name: str, namespace: str,
                              accelerator: str, current: int, desired: int) -> None:

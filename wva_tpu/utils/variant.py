@@ -55,6 +55,13 @@ def merge_engine_status(fresh: VariantAutoscaling,
     fresh.status.desired_optimized_alloc = \
         computed.status.desired_optimized_alloc
     fresh.status.actuation = computed.status.actuation
+    # Engine-owned (the planner measures it; the engine writes 0 when no
+    # measurement is in use, which must CLEAR the field — a status stuck
+    # claiming a horizon nobody uses is worse than absent). Writers that
+    # never computed it (scale-from-zero wake) carry the value from their
+    # own fresh read, so the measurement survives those merges naturally.
+    fresh.status.forecast_lead_time_seconds = \
+        computed.status.forecast_lead_time_seconds
     opt_ready = computed.get_condition(TYPE_OPTIMIZATION_READY)
     if opt_ready is not None:
         fresh.status.conditions = [
@@ -142,6 +149,9 @@ def va_status_material(va: VariantAutoscaling) -> tuple:
         alloc.accelerator,
         alloc.num_replicas,
         va.status.actuation.applied,
+        # Quantized upstream (planner rounds to 0.1s, and the estimate only
+        # moves when a scale-up completes) so it cannot churn writes.
+        va.status.forecast_lead_time_seconds,
         tuple((c.type, c.status, c.reason, c.message, c.observed_generation)
               for c in va.status.conditions),
     )
@@ -162,9 +172,15 @@ def ready_variant_autoscalings(
     return [va for va in vas if va.metadata.deletion_timestamp is None]
 
 
-def _filter_by_target(client: KubeClient, want_active: bool,
-                      namespace: str | None = None) -> list[VariantAutoscaling]:
-    out = []
+def partition_variant_autoscalings_by_target(
+    client: KubeClient, namespace: str | None = None,
+) -> tuple[list[VariantAutoscaling], list[VariantAutoscaling]]:
+    """(active, inactive) VAs from ONE pass over the fleet — callers that
+    need both sides (the scale-from-zero engine's pre-wake must know
+    whether a model's OTHER variants are serving) must not pay the
+    per-target reads twice."""
+    active: list[VariantAutoscaling] = []
+    inactive: list[VariantAutoscaling] = []
     for va in ready_variant_autoscalings(client, namespace=namespace):
         ref = va.spec.scale_target_ref
         if not ref.name:
@@ -185,9 +201,15 @@ def _filter_by_target(client: KubeClient, want_active: bool,
         state = scale_target.scale_target_state(target)
         if state.deleted:
             continue
-        if (state.desired_replicas > 0) == want_active:
-            out.append(va)
-    return out
+        (active if state.desired_replicas > 0 else inactive).append(va)
+    return active, inactive
+
+
+def _filter_by_target(client: KubeClient, want_active: bool,
+                      namespace: str | None = None) -> list[VariantAutoscaling]:
+    active, inactive = partition_variant_autoscalings_by_target(
+        client, namespace=namespace)
+    return active if want_active else inactive
 
 
 def active_variant_autoscalings(
