@@ -44,6 +44,10 @@ bench: ## Run the north-star benchmark (one JSON line on stdout).
 bench-tick: ## Fleet-scale tick microbench (48 models / 96 VAs, in-memory stack): tick p50/p99 + API requests/tick vs the pre-change serial loop; merges into BENCH_LOCAL.json.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tick-only
 
+.PHONY: bench-tick-quiet
+bench-tick-quiet: ## Steady-state quiet-tick microbench (48 models, no demand/spec changes): tick p50 + API reads/tick with the informer + dirty-set incremental path vs informer-only vs the per-tick-LIST baseline; merges detail.incremental_tick into BENCH_LOCAL.json.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tick-quiet-only
+
 .PHONY: bench-collect
 bench-collect: ## Metrics-plane microbench (48 models): backend queries/tick grouped ON vs per-model fan-out, and in-memory TSDB query p50 under 8 concurrent readers vs the pre-ring read path; merges into BENCH_LOCAL.json.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --collect-only

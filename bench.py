@@ -632,27 +632,14 @@ def multi_model_bench() -> dict:
     }
 
 
-def tick_scale_bench(n_models: int = 48, variants_per_model: int = 2,
-                     measured_ticks: int = 15,
-                     fleet_workers: int | None = None) -> dict:
-    """Fleet-scale tick microbench (``make bench-tick``): 48 models / 96 VAs
-    on the in-memory stack (FakeCluster + TSDB), SLO analyzer path.
-
-    Two configurations run the SAME world:
-
-    - **fleet** — the shipped fast path: tick-scoped snapshot (one LIST per
-      kind), bounded per-model analysis pool, and ONE batched solver
-      dispatch for every model's candidates.
-    - **serial** — the pre-change loop shape, reproduced via the engine's
-      compat levers: per-VA GETs (snapshot off), serial per-model analysis
-      (workers 1), one solver dispatch per model (batching off).
-
-    Reports tick p50/p99 wall latency and K8s-API requests per tick for
-    both, plus the speedup. The world is deterministic (FakeClock, fixed
-    series), so the numbers measure the control loop, not noise.
-    """
-    import statistics
-
+def _build_tick_world(n_models: int, variants_per_model: int,
+                      informer: bool = True, incremental: bool = True):
+    """The shared 48-model/96-VA in-memory fleet world for the tick
+    benches (`make bench-tick` / `make bench-tick-quiet`): FakeCluster +
+    TSDB + fully wired manager on the SLO analyzer path, with a ``feed``
+    hook that refreshes every model's gauge/counter samples. ``informer``/
+    ``incremental`` map to WVA_INFORMER / WVA_INCREMENTAL so the honest
+    pre-change levers build in the same process."""
     from wva_tpu.analyzers.queueing import PerfProfile, ServiceParms, TargetPerf
     from wva_tpu.api import (
         ObjectMeta,
@@ -680,96 +667,130 @@ def tick_scale_bench(n_models: int = 48, variants_per_model: int = 2,
     ns = "bench"
     accels = ["v5e-8", "v5p-8"]
 
-    def build_world():
-        engines_common.DecisionCache.clear()
-        while not engines_common.DecisionTrigger.empty():
-            engines_common.DecisionTrigger.get_nowait()
-        clock = FakeClock(start=200_000.0)
-        cluster = FakeCluster(clock=clock)
-        tsdb = TimeSeriesDB(clock=clock)
-        cfg = new_test_config()
-        sat = SaturationScalingConfig(analyzer_name="slo")
-        sat.apply_defaults()
-        cfg.update_saturation_config({"default": sat})
+    engines_common.DecisionCache.clear()
+    while not engines_common.DecisionTrigger.empty():
+        engines_common.DecisionTrigger.get_nowait()
+    clock = FakeClock(start=200_000.0)
+    cluster = FakeCluster(clock=clock)
+    tsdb = TimeSeriesDB(clock=clock)
+    cfg = new_test_config()
+    cfg.infrastructure.informer = informer
+    cfg.infrastructure.incremental = incremental
+    sat = SaturationScalingConfig(analyzer_name="slo")
+    sat.apply_defaults()
+    cfg.update_saturation_config({"default": sat})
 
-        classes, profiles = [], []
+    classes, profiles = [], []
+    for i in range(n_models):
+        model = f"org/bench-model-{i:03d}"
+        classes.append(ServiceClass(
+            name=f"c{i:03d}", priority=1,
+            model_targets={model: TargetPerf(target_ttft_ms=1000.0)}))
+        for v in range(variants_per_model):
+            accel = accels[v % len(accels)]
+            name = f"b{i:03d}-{accel}"
+            profiles.append(PerfProfile(
+                model_id=model, accelerator=accel,
+                service_parms=ServiceParms(
+                    alpha=PROFILE_ALPHA_MS / (v + 1),
+                    beta=PROFILE_BETA / (v + 1),
+                    gamma=PROFILE_GAMMA / (v + 1)),
+                max_batch_size=96, max_queue_size=384))
+            cluster.create(Deployment(
+                metadata=ObjectMeta(name=name, namespace=ns),
+                replicas=1, selector={"app": name},
+                template=PodTemplateSpec(
+                    labels={"app": name},
+                    containers=[Container(
+                        name="srv",
+                        args=["--max-num-batched-tokens=8192",
+                              "--max-num-seqs=256"],
+                        resources=ResourceRequirements(
+                            requests={"google.com/tpu": "8"}))]),
+                status=DeploymentStatus(replicas=1, ready_replicas=1)))
+            cluster.create(VariantAutoscaling(
+                metadata=ObjectMeta(
+                    name=name, namespace=ns,
+                    labels={"inference.optimization/acceleratorName":
+                            accel}),
+                spec=VariantAutoscalingSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        name=name),
+                    model_id=model, variant_cost=str(8.0 * (v + 1)))))
+            cluster.create(Pod(
+                metadata=ObjectMeta(
+                    name=f"{name}-0", namespace=ns,
+                    labels={"app": name},
+                    owner_references=[{"kind": "Deployment",
+                                       "name": name}]),
+                status=PodStatus(phase="Running", ready=True,
+                                 pod_ip=f"10.1.{i}.{v + 1}")))
+
+    def feed(now):
+        """Fresh gauge + counter samples so KV collection and the
+        arrival-rate rate() window always have data."""
         for i in range(n_models):
             model = f"org/bench-model-{i:03d}"
-            classes.append(ServiceClass(
-                name=f"c{i:03d}", priority=1,
-                model_targets={model: TargetPerf(target_ttft_ms=1000.0)}))
             for v in range(variants_per_model):
                 accel = accels[v % len(accels)]
-                name = f"b{i:03d}-{accel}"
-                profiles.append(PerfProfile(
-                    model_id=model, accelerator=accel,
-                    service_parms=ServiceParms(
-                        alpha=PROFILE_ALPHA_MS / (v + 1),
-                        beta=PROFILE_BETA / (v + 1),
-                        gamma=PROFILE_GAMMA / (v + 1)),
-                    max_batch_size=96, max_queue_size=384))
-                cluster.create(Deployment(
-                    metadata=ObjectMeta(name=name, namespace=ns),
-                    replicas=1, selector={"app": name},
-                    template=PodTemplateSpec(
-                        labels={"app": name},
-                        containers=[Container(
-                            name="srv",
-                            args=["--max-num-batched-tokens=8192",
-                                  "--max-num-seqs=256"],
-                            resources=ResourceRequirements(
-                                requests={"google.com/tpu": "8"}))]),
-                    status=DeploymentStatus(replicas=1, ready_replicas=1)))
-                cluster.create(VariantAutoscaling(
-                    metadata=ObjectMeta(
-                        name=name, namespace=ns,
-                        labels={"inference.optimization/acceleratorName":
-                                accel}),
-                    spec=VariantAutoscalingSpec(
-                        scale_target_ref=CrossVersionObjectReference(
-                            name=name),
-                        model_id=model, variant_cost=str(8.0 * (v + 1)))))
-                cluster.create(Pod(
-                    metadata=ObjectMeta(
-                        name=f"{name}-0", namespace=ns,
-                        labels={"app": name},
-                        owner_references=[{"kind": "Deployment",
-                                           "name": name}]),
-                    status=PodStatus(phase="Running", ready=True,
-                                     pod_ip=f"10.1.{i}.{v + 1}")))
+                pod = {"pod": f"b{i:03d}-{accel}-0", "namespace": ns,
+                       "model_name": model}
+                tsdb.add_sample("vllm:kv_cache_usage_perc", pod,
+                                0.35, timestamp=now)
+                tsdb.add_sample("vllm:num_requests_waiting", pod,
+                                1, timestamp=now)
+                tsdb.add_sample("vllm:cache_config_info",
+                                {**pod, "num_gpu_blocks": "4096",
+                                 "block_size": "32"}, 1.0, timestamp=now)
+                # Monotone counter at ~4 req/s per pod.
+                tsdb.add_sample("vllm:request_success_total", pod,
+                                4.0 * (now - 199_000.0), timestamp=now)
 
-        def feed(now):
-            """Fresh gauge + counter samples so KV collection and the
-            arrival-rate rate() window always have data."""
-            for i in range(n_models):
-                model = f"org/bench-model-{i:03d}"
-                for v in range(variants_per_model):
-                    accel = accels[v % len(accels)]
-                    pod = {"pod": f"b{i:03d}-{accel}-0", "namespace": ns,
-                           "model_name": model}
-                    tsdb.add_sample("vllm:kv_cache_usage_perc", pod,
-                                    0.35, timestamp=now)
-                    tsdb.add_sample("vllm:num_requests_waiting", pod,
-                                    1, timestamp=now)
-                    tsdb.add_sample("vllm:cache_config_info",
-                                    {**pod, "num_gpu_blocks": "4096",
-                                     "block_size": "32"}, 1.0, timestamp=now)
-                    # Monotone counter at ~4 req/s per pod.
-                    tsdb.add_sample("vllm:request_success_total", pod,
-                                    4.0 * (now - 199_000.0), timestamp=now)
+    # Two samples a window apart so rate() is live from the first tick.
+    feed(clock.now() - 30.0)
+    feed(clock.now())
+    mgr = build_manager(cluster, cfg, clock=clock, tsdb=tsdb)
+    mgr.setup()
+    mgr.config.update_slo_config(SLOConfigData(
+        service_classes=classes, profiles=profiles))
+    return mgr, cluster, clock, feed
 
-        # Two samples a window apart so rate() is live from the first tick.
-        feed(clock.now() - 30.0)
-        feed(clock.now())
-        mgr = build_manager(cluster, cfg, clock=clock, tsdb=tsdb)
-        mgr.setup()
-        mgr.config.update_slo_config(SLOConfigData(
-            service_classes=classes, profiles=profiles))
-        return mgr, cluster, clock, feed
+
+def tick_scale_bench(n_models: int = 48, variants_per_model: int = 2,
+                     measured_ticks: int = 15,
+                     fleet_workers: int | None = None) -> dict:
+    """Fleet-scale tick microbench (``make bench-tick``): 48 models / 96 VAs
+    on the in-memory stack (FakeCluster + TSDB), SLO analyzer path.
+
+    Two configurations run the SAME world:
+
+    - **fleet** — the shipped fast path: tick-scoped snapshot (one LIST per
+      kind), bounded per-model analysis pool, and ONE batched solver
+      dispatch for every model's candidates.
+    - **serial** — the pre-change loop shape, reproduced via the engine's
+      compat levers: per-VA GETs (snapshot off), serial per-model analysis
+      (workers 1), one solver dispatch per model (batching off).
+
+    Reports tick p50/p99 wall latency and K8s-API requests per tick for
+    both, plus the speedup. The world is deterministic (FakeClock, fixed
+    series), so the numbers measure the control loop, not noise.
+    """
+    import statistics
+
+    from wva_tpu.engines import common as engines_common
 
     def run_mode(snapshot: bool, workers: int | None, batching: bool,
                  indexed_tsdb: bool = True) -> dict:
-        mgr, cluster, clock, feed = build_world()
+        # This bench measures the ANALYSIS pipeline, so dirty-set skipping
+        # is off in every mode — the feed's flat gauge values would let
+        # fingerprints skip most measured ticks and the "fleet" numbers
+        # would quietly stop measuring analysis at all (the quiet-tick
+        # claim lives in tick_quiet_bench). The serial/legacy lever also
+        # turns the informer off so its per-VA GETs really hit the
+        # cluster, reproducing the pre-informer request shape.
+        mgr, cluster, clock, feed = _build_tick_world(
+            n_models, variants_per_model,
+            informer=indexed_tsdb, incremental=False)
         eng = mgr.engine
         eng.tick_snapshot_enabled = snapshot
         if workers is not None:
@@ -845,9 +866,14 @@ def tick_scale_bench(n_models: int = 48, variants_per_model: int = 2,
             serial["tick_p50_ms"] / max(fleet["tick_p50_ms"], 1e-9), 2),
         "tick_p99_speedup": round(
             serial["tick_p99_ms"] / max(fleet["tick_p99_ms"], 1e-9), 2),
-        "api_reads_reduction": round(
+        # With the informer on, fleet reads/tick are exactly 0; a ratio
+        # against zero is meaningless, so report the absolute reads
+        # eliminated instead.
+        "api_reads_reduction": (round(
             serial["api_reads_per_tick_total"]
-            / max(fleet["api_reads_per_tick_total"], 1e-9), 1),
+            / fleet["api_reads_per_tick_total"], 1)
+            if fleet["api_reads_per_tick_total"]
+            else serial["api_reads_per_tick_total"]),
         "levers": {
             "fleet": "snapshot + indexed TSDB + grouped collection +"
                      " cross-model solver batching (auto workers: serial on"
@@ -857,6 +883,110 @@ def tick_scale_bench(n_models: int = 48, variants_per_model: int = 2,
                 "per-VA GETs, serial models, per-model solver dispatch,"
                 " per-model query fan-out, unindexed copy-under-lock TSDB"
                 " scans (the seed tick)",
+        },
+    }
+
+
+def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
+                     measured_ticks: int = 24,
+                     quiet_warm_ticks: int = 16) -> dict:
+    """Steady-state quiet-tick microbench (``make bench-tick-quiet``): the
+    48-model fleet with NO demand or spec changes between ticks — the
+    shape a production fleet spends most of its life in.
+
+    Three configurations run the same world in the same process:
+
+    - **incremental** — the shipped path: watch-backed informer (zero LIST
+      requests per tick) + dirty-set fingerprints (zero clean models
+      analyzed per tick; the periodic WVA_RESYNC_TICKS full pass stays on,
+      so its cost is included honestly).
+    - **informer_only** — informer on, incremental off: every tick still
+      analyzes every model but LISTs nothing.
+    - **per_tick_list** — both off: the PR-2 baseline (one LIST per kind
+      per tick, full analysis) — the honest lever.
+
+    Reports tick p50/p99 wall latency, K8s-API reads per tick, and models
+    analyzed per tick. "Quiet" is the realistic steady state: metrics ARE
+    scraped fresh every tick (new sample timestamps) but their VALUES are
+    constant — flat gauges, a linearly increasing request counter (so
+    rate() is constant). The fingerprint hashes (labels, value) only, so
+    live-but-unchanged scrapes skip; quiet warmup ticks let the
+    rate()/max_over_time windows settle onto the steady values first.
+    """
+    import statistics
+
+    from wva_tpu.engines import common as engines_common
+
+    def run_mode(informer: bool, incremental: bool) -> dict:
+        mgr, cluster, clock, feed = _build_tick_world(
+            n_models, variants_per_model,
+            informer=informer, incremental=incremental)
+        eng = mgr.engine
+        for _ in range(3 + quiet_warm_ticks):  # jit + caches + memos +
+            eng.optimize()                     # window settling
+            clock.advance(5.0)
+            feed(clock.now())
+        walls, reads, analyzed = [], {}, 0
+        for _ in range(measured_ticks):
+            cluster.reset_request_counts()
+            t0 = time.perf_counter()
+            eng.optimize()
+            walls.append(time.perf_counter() - t0)
+            analyzed += eng.last_tick_stats["analyzed"]
+            for (verb, kind), c in cluster.request_counts().items():
+                if verb in ("get", "list"):
+                    key = f"{verb}:{kind}"
+                    reads[key] = reads.get(key, 0) + c
+            clock.advance(5.0)
+            feed(clock.now())  # fresh scrapes, unchanged values
+        mgr.shutdown()
+        walls.sort()
+        per_tick_reads = {k: round(v / measured_ticks, 2)
+                          for k, v in sorted(reads.items())}
+        return {
+            "tick_p50_ms": round(statistics.median(walls) * 1000.0, 2),
+            "tick_p99_ms": round(
+                walls[min(len(walls) - 1,
+                          int(len(walls) * 0.99))] * 1000.0, 2),
+            "api_reads_per_tick": per_tick_reads,
+            "api_reads_per_tick_total": round(
+                sum(per_tick_reads.values()), 1),
+            "lists_per_tick": round(sum(
+                v for k, v in per_tick_reads.items()
+                if k.startswith("list:")), 2),
+            "models_analyzed_per_tick": round(analyzed / measured_ticks, 2),
+        }
+
+    incremental = run_mode(informer=True, incremental=True)
+    informer_only = run_mode(informer=True, incremental=False)
+    baseline = run_mode(informer=False, incremental=False)
+    engines_common.DecisionCache.clear()
+    while not engines_common.DecisionTrigger.empty():
+        engines_common.DecisionTrigger.get_nowait()
+    return {
+        "models": n_models,
+        "variant_autoscalings": n_models * variants_per_model,
+        "measured_ticks": measured_ticks,
+        "quiet_warm_ticks": quiet_warm_ticks,
+        "incremental": incremental,
+        "informer_only": informer_only,
+        "per_tick_list_baseline": baseline,
+        "quiet_tick_p50_speedup": round(
+            baseline["tick_p50_ms"]
+            / max(incremental["tick_p50_ms"], 1e-9), 2),
+        "api_reads_reduction": round(
+            baseline["api_reads_per_tick_total"]
+            / max(incremental["api_reads_per_tick_total"], 1e-9), 1)
+        if incremental["api_reads_per_tick_total"] else float(
+            baseline["api_reads_per_tick_total"]),
+        "levers": {
+            "incremental": "WVA_INFORMER + WVA_INCREMENTAL on (shipped; "
+                           "includes the periodic resync tick's cost)",
+            "informer_only": "watch store on, dirty-set off: zero LISTs, "
+                             "full analysis",
+            "per_tick_list_baseline": "both off: one LIST per kind per "
+                                      "tick + full analysis (the PR-2 "
+                                      "shape)",
         },
     }
 
@@ -1385,6 +1515,24 @@ def tick_main() -> None:
     }))
 
 
+def tick_quiet_main() -> None:
+    """`make bench-tick-quiet`: steady-state quiet-tick microbench only
+    (incremental on vs informer-only vs per-tick-LIST baseline), merged
+    into BENCH_LOCAL.json detail.incremental_tick, one JSON line."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    record = tick_quiet_bench()
+    record["bench_wall_seconds"] = round(time.time() - t0, 1)
+    _merge_bench_local("incremental_tick", record)
+    print(json.dumps({
+        "metric": "quiet_tick_latency_48_models_96_vas",
+        "value": record["incremental"]["tick_p50_ms"],
+        "unit": "ms_p50_per_tick",
+        "vs_baseline": record["quiet_tick_p50_speedup"],
+        "detail": record,
+    }))
+
+
 def collect_main() -> None:
     """`make bench-collect`: metrics-plane microbench only (backend
     queries/tick grouped ON vs OFF + in-memory TSDB p50 under concurrent
@@ -1534,7 +1682,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--tick-only" in sys.argv:
+    if "--tick-quiet-only" in sys.argv:
+        tick_quiet_main()
+    elif "--tick-only" in sys.argv:
         tick_main()
     elif "--collect-only" in sys.argv:
         collect_main()

@@ -358,8 +358,14 @@ def _run_fleet(grouped: bool, n: int = 6, ticks: int = 3):
     common.DecisionCache.clear()
     while not common.DecisionTrigger.empty():
         common.DecisionTrigger.get_nowait()
+    # One lever at a time: the dirty-set fingerprint is METRICS-BLIND with
+    # grouped collection off (no fleet-wide slices to hash), so grouping
+    # off also disables skipping — comparing grouped on/off with
+    # incremental active would diff skip-tick step timestamps, not
+    # grouping. WVA_INCREMENTAL=off has its own byte-equality gate in
+    # test_informer.py.
     mgr, cluster, tsdb, clock = make_fleet_world(
-        n, kv=0.78, queue=2, trace=True)
+        n, kv=0.78, queue=2, trace=True, incremental=False)
     mgr.engine.grouped_collection = grouped
     for _ in range(ticks):
         mgr.run_once()

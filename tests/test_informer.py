@@ -1,0 +1,614 @@
+"""Event-driven control plane (docs/design/informer.md):
+
+1. **Informer cache semantics** — watch-fed store, zero-request lists,
+   write-through on own mutations, live GETs for VAs vs store-served GETs
+   for scale targets/pods, namespace scoping, periodic resync.
+2. **Dirty-set incremental ticks** — a steady-state quiet tick performs
+   ZERO list requests and analyzes ZERO clean models; a VA spec edit, pod
+   churn, or a metric change re-analyzes exactly the dirtied model;
+   ``WVA_INCREMENTAL=off`` statuses are byte-identical; the periodic
+   resync tick re-analyzes everything.
+3. **Event nudges** — material watch events wake the engines immediately;
+   the engine's own status writes do not re-trigger it.
+4. **Watch-surface hardening** — the fake apiserver closes overflowed
+   streams with a 410 gap marker (slow-consumer regression), bounds
+   streams by ``timeoutSeconds``, filters namespace-scoped watches, and
+   replays the list->watch registration gap as synthetic ADDEDs; the REST
+   client's reconnect backoff is jittered.
+5. **Lint** — engine/pipeline hot-path modules must not LIST through the
+   raw live client (reads go through the snapshot/informer view).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import wva_tpu
+from tests.test_tick_scale import NS, make_fleet_world
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+from wva_tpu.blackbox.schema import STAGE_FINGERPRINT_SKIP, encode
+from wva_tpu.k8s import (
+    Container,
+    Credentials,
+    Deployment,
+    DeploymentStatus,
+    FakeCluster,
+    InformerKubeClient,
+    Pod,
+    PodStatus,
+    PodTemplateSpec,
+    RestKubeClient,
+)
+from wva_tpu.k8s.fake_apiserver import FakeAPIServer
+from wva_tpu.k8s.rest import (
+    WATCH_BACKOFF_MAX,
+    _jittered,
+)
+from wva_tpu.utils import FakeClock
+
+pytestmark = pytest.mark.informer
+
+
+def _va(name: str, ns: str = NS, model: str = "org/m") -> VariantAutoscaling:
+    return VariantAutoscaling(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name=name),
+            model_id=model))
+
+
+def _deployment(name: str, ns: str = NS) -> Deployment:
+    return Deployment(
+        metadata=ObjectMeta(name=name, namespace=ns), replicas=1,
+        selector={"app": name},
+        template=PodTemplateSpec(labels={"app": name},
+                                 containers=[Container(name="srv")]),
+        status=DeploymentStatus(replicas=1, ready_replicas=1))
+
+
+# --- 1. informer cache semantics ---
+
+
+def test_informer_serves_lists_with_zero_requests():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    for i in range(3):
+        cluster.create(_va(f"va{i}"))
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    cluster.reset_request_counts()
+    for _ in range(5):
+        assert len(inf.list("VariantAutoscaling", namespace=NS)) == 3
+    assert cluster.request_counts() == {}
+
+
+def test_informer_store_follows_watch_events():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    # Out-of-band create/update/delete (another controller writing to the
+    # same cluster) is visible without any list.
+    cluster.create(_va("va0"))
+    cluster.reset_request_counts()
+    assert [v.metadata.name for v in inf.list("VariantAutoscaling",
+                                              namespace=NS)] == ["va0"]
+    fresh = cluster.get("VariantAutoscaling", NS, "va0")
+    fresh.spec.model_id = "org/changed"
+    cluster.update(fresh)
+    cluster.reset_request_counts()
+    assert inf.list("VariantAutoscaling",
+                    namespace=NS)[0].spec.model_id == "org/changed"
+    cluster.delete("VariantAutoscaling", NS, "va0")
+    assert inf.list("VariantAutoscaling", namespace=NS) == []
+    assert cluster.request_counts().get(("list", "VariantAutoscaling"),
+                                        0) == 0
+
+
+def test_informer_write_through_and_isolation():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    created = inf.create(_va("va0"))
+    assert created.metadata.resource_version
+    got = inf.list("VariantAutoscaling", namespace=NS)[0]
+    got.spec.model_id = "mutated"
+    # Store isolation: callers cannot mutate the cached copy.
+    assert inf.list("VariantAutoscaling",
+                    namespace=NS)[0].spec.model_id == "org/m"
+
+
+def test_informer_va_gets_stay_live_but_target_gets_serve_from_store():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.create(_va("va0"))
+    cluster.create(_deployment("va0"))
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    cluster.reset_request_counts()
+    # VA GET: live (anchors rv-guarded status writes).
+    inf.get("VariantAutoscaling", NS, "va0")
+    assert cluster.request_counts().get(("get", "VariantAutoscaling")) == 1
+    # Deployment/Pod GETs: store-served (the scale-from-zero poll reads
+    # every VA's target each 100ms — these are the reads being absorbed).
+    cluster.reset_request_counts()
+    assert inf.get("Deployment", NS, "va0").metadata.name == "va0"
+    assert cluster.request_counts().get(("get", "Deployment"), 0) == 0
+    # Store miss falls through live.
+    with pytest.raises(KeyError):
+        inf.get("Deployment", NS, "absent")
+    assert cluster.request_counts().get(("get", "Deployment"), 0) == 1
+
+
+def test_namespace_scoped_informer_delegates_out_of_scope():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.create(_va("va0", ns="scoped"))
+    cluster.create(_va("va1", ns="other"))
+    inf = InformerKubeClient(cluster, namespace="scoped",
+                             clock=clock).start()
+    cluster.reset_request_counts()
+    assert len(inf.list("VariantAutoscaling", namespace="scoped")) == 1
+    assert cluster.request_counts().get(("list", "VariantAutoscaling"),
+                                        0) == 0
+    # Cluster-wide and foreign-namespace lists delegate to the live client
+    # (the store only holds the watch namespace).
+    assert len(inf.list("VariantAutoscaling")) == 2
+    assert len(inf.list("VariantAutoscaling", namespace="other")) == 1
+    assert cluster.request_counts().get(("list", "VariantAutoscaling"),
+                                        0) == 2
+
+
+def test_informer_periodic_resync_relists():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    inf = InformerKubeClient(cluster, clock=clock, resync_seconds=600.0)
+    inf.start()
+    assert inf.resync_if_stale() == []  # fresh: nothing to do
+    clock.advance(601.0)
+    cluster.reset_request_counts()
+    resynced = inf.resync_if_stale()
+    assert set(resynced) == set(inf.kinds)
+    assert cluster.request_counts().get(("list", "VariantAutoscaling")) == 1
+
+
+def test_informer_freshness_stats():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    clock.advance(30.0)
+    st = inf.stats()
+    assert st["VariantAutoscaling"]["synced"] == 1.0
+    assert st["VariantAutoscaling"]["age_seconds"] == pytest.approx(30.0)
+    cluster.create(_va("va0"))  # event refreshes the kind
+    assert inf.stats()["VariantAutoscaling"]["age_seconds"] == \
+        pytest.approx(0.0)
+
+
+def test_informer_zero_lists_over_rest_client(http_world):
+    """The acceptance holds over genuine HTTP too: once synced, informer
+    lists hit the REST apiserver zero times (the watch stream keeps the
+    store fresh)."""
+    cluster, server = http_world
+    cluster.create(_va("va0"))
+    client = RestKubeClient(Credentials(server=server.url), timeout=5.0)
+    try:
+        inf = InformerKubeClient(
+            client, kinds=("VariantAutoscaling",)).start()
+        deadline = time.time() + 5
+        while not client._watch_threads and time.time() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.3)  # let the list+watch stream connect
+        server.reset_request_counts()
+        for _ in range(5):
+            assert len(inf.list("VariantAutoscaling", namespace=NS)) >= 1
+        counts = server.request_counts()
+        assert counts.get(("list", "VariantAutoscaling"), 0) == 0, counts
+        # ...and a write by ANOTHER client reaches the store via the watch
+        # stream, still without a list.
+        cluster.create(_va("va1"))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if len(inf.list("VariantAutoscaling", namespace=NS)) == 2:
+                break
+            time.sleep(0.05)
+        assert len(inf.list("VariantAutoscaling", namespace=NS)) == 2
+        assert server.request_counts().get(
+            ("list", "VariantAutoscaling"), 0) == 0
+    finally:
+        client.stop()
+
+
+# --- 2. dirty-set incremental ticks ---
+
+
+def _quiet_world(n: int = 6, **kw):
+    mgr, cluster, tsdb, clock = make_fleet_world(n, **kw)
+    mgr.run_once()  # first tick: everything dirty (no memo yet)
+    clock.advance(5.0)
+    mgr.engine.optimize()  # second tick: rate windows settle
+    clock.advance(5.0)
+    return mgr, cluster, tsdb, clock
+
+
+def test_quiet_tick_zero_lists_zero_models_analyzed():
+    """The acceptance shape: a steady-state tick (no demand/spec changes)
+    costs ZERO list requests and analyzes ZERO clean models."""
+    mgr, cluster, tsdb, clock = _quiet_world(6)
+    cluster.reset_request_counts()
+    mgr.engine.optimize()
+    counts = cluster.request_counts()
+    assert not any(verb == "list" for verb, _ in counts), counts
+    assert mgr.engine.last_tick_stats == {"analyzed": 0, "skipped": 6}
+
+
+def test_va_spec_edit_dirties_exactly_that_model():
+    mgr, cluster, tsdb, clock = _quiet_world(6)
+    va = cluster.get("VariantAutoscaling", NS, "m002-v5e")
+    va.spec.variant_cost = "99.0"
+    cluster.update(va)  # spec edit: generation bumps
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_stats == {"analyzed": 1, "skipped": 5}
+    clock.advance(5.0)
+    mgr.engine.optimize()  # settles clean again
+    assert mgr.engine.last_tick_stats["analyzed"] == 0
+
+
+def test_pod_churn_dirties_exactly_that_model():
+    mgr, cluster, tsdb, clock = _quiet_world(6)
+    cluster.delete("Pod", NS, "m003-v5e-0")
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_stats == {"analyzed": 1, "skipped": 5}
+
+
+def test_metric_change_dirties_exactly_that_model():
+    mgr, cluster, tsdb, clock = _quiet_world(6)
+    tsdb.add_sample("vllm:kv_cache_usage_perc",
+                    {"pod": "m001-v5e-0", "namespace": NS,
+                     "model_name": "org/model-001"}, 0.95)
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_stats == {"analyzed": 1, "skipped": 5}
+
+
+def test_config_edit_dirties_every_model():
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    mgr, cluster, tsdb, clock = _quiet_world(4)
+    cfg = SaturationScalingConfig()
+    cfg.kv_cache_threshold = 0.5
+    mgr.config.update_saturation_config({"default": cfg})
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_stats["analyzed"] == 4
+
+
+def test_resync_tick_reanalyzes_everything():
+    mgr, cluster, tsdb, clock = _quiet_world(4)
+    mgr.engine.resync_ticks = 3
+    seen = []
+    for _ in range(6):
+        mgr.engine.optimize()
+        seen.append(mgr.engine.last_tick_stats["analyzed"])
+        clock.advance(5.0)
+    # Engine tick sequence keeps counting across the warmup ticks, so just
+    # assert the shape: full-fleet resyncs interleave with all-skip ticks.
+    assert 4 in seen and 0 in seen
+
+
+def test_incremental_off_statuses_byte_identical_over_quiet_world():
+    """WVA_INCREMENTAL=off must be byte-identical: same world, same tick
+    cadence, statuses compared via canonical JSON after quiet ticks where
+    the incremental path skips everything."""
+    def run(incremental: bool):
+        from wva_tpu.engines import common
+
+        common.DecisionCache.clear()
+        while not common.DecisionTrigger.empty():
+            common.DecisionTrigger.get_nowait()
+        mgr, cluster, tsdb, clock = make_fleet_world(
+            5, kv=0.6, queue=1, incremental=incremental)
+        for _ in range(5):
+            mgr.run_once()
+            clock.advance(5.0)
+        skipped = mgr.engine.last_tick_stats["skipped"]
+        statuses = {
+            va.metadata.name: encode(va.status)
+            for va in cluster.list("VariantAutoscaling", namespace=NS)}
+        mgr.shutdown()
+        return statuses, skipped
+
+    on_statuses, on_skipped = run(incremental=True)
+    off_statuses, off_skipped = run(incremental=False)
+    assert on_skipped > 0, "quiet ticks must actually skip"
+    assert off_skipped == 0
+    dumps = lambda x: json.dumps(x, sort_keys=True)  # noqa: E731
+    assert dumps(on_statuses) == dumps(off_statuses)
+
+
+def test_incremental_on_off_identical_over_changing_world():
+    """Over a CHANGING world every model stays dirty, so the incremental
+    path must be byte-identical to off — decisions, statuses, AND trace
+    cycles (the workers-1-vs-8 discipline)."""
+    def run(incremental: bool):
+        from wva_tpu.engines import common
+
+        common.DecisionCache.clear()
+        while not common.DecisionTrigger.empty():
+            common.DecisionTrigger.get_nowait()
+        mgr, cluster, tsdb, clock = make_fleet_world(
+            4, kv=0.78, queue=2, trace=True, incremental=incremental)
+        for i in range(4):
+            # Fresh RISING samples before EVERY engine tick: the kv
+            # template is max_over_time[1m], so values must climb to
+            # actually change the collected input — then nothing may skip.
+            # (Driven via executor.tick directly: the combined run_once
+            # fires a second, input-unchanged engine tick off the
+            # fast-path trigger, which legitimately skips.)
+            for m in range(4):
+                name = f"m{m:03d}-v5e"
+                tsdb.add_sample(
+                    "vllm:kv_cache_usage_perc",
+                    {"pod": f"{name}-0", "namespace": NS,
+                     "model_name": f"org/model-{m:03d}"},
+                    0.80 + 0.03 * i)
+            mgr.engine.executor.tick()
+            mgr.va_reconciler.drain_triggers()
+            clock.advance(5.0)
+        mgr.flight_recorder.flush()
+        cycles = mgr.flight_recorder.snapshot()
+        statuses = {
+            va.metadata.name: encode(va.status)
+            for va in cluster.list("VariantAutoscaling", namespace=NS)}
+        mgr.shutdown()
+        return cycles, statuses
+
+    on_cycles, on_statuses = run(incremental=True)
+    off_cycles, off_statuses = run(incremental=False)
+    dumps = lambda x: json.dumps(x, sort_keys=True)  # noqa: E731
+    assert dumps(on_statuses) == dumps(off_statuses)
+    assert len(on_cycles) == len(off_cycles) > 0
+    for a, b in zip(on_cycles, off_cycles):
+        assert dumps(a) == dumps(b)
+
+
+def test_skip_recorded_as_trace_stage():
+    mgr, cluster, tsdb, clock = _quiet_world(3, trace=True)
+    mgr.engine.executor.tick()  # opens a trace cycle, unlike bare optimize()
+    assert mgr.engine.last_tick_stats["skipped"] == 3
+    mgr.flight_recorder.flush()
+    last = mgr.flight_recorder.snapshot()[-1]
+    skips = [ev for ev in last.get("stages", [])
+             if ev.get("stage") == STAGE_FINGERPRINT_SKIP]
+    assert len(skips) == 3
+    assert all("model_id" in ev and "namespace" in ev for ev in skips)
+    mgr.shutdown()
+
+
+def test_safety_net_failure_forces_reanalysis():
+    """A model that fell into the safety net must NOT be skipped next tick
+    even with an unchanged fingerprint (the memo is invalidated)."""
+    mgr, cluster, tsdb, clock = _quiet_world(3)
+    eng = mgr.engine
+    key = sorted(eng._fingerprints)[0]
+    eng._invalidate_model(key)
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_stats == {"analyzed": 1, "skipped": 2}
+
+
+# --- 3. event nudges ---
+
+
+def test_material_events_nudge_listeners_status_writes_do_not():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.create(_deployment("d0"))
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    nudges: list[tuple[str, str]] = []
+    inf.add_nudge_listener(lambda kind, event, obj: nudges.append(
+        (kind, event)))
+
+    cluster.create(_va("va0"))  # ADDED: nudges
+    assert nudges[-1] == ("VariantAutoscaling", "ADDED")
+    n = len(nudges)
+
+    # Status-only write (the engine's own heartbeat path): NO nudge —
+    # generation does not move, so the nudge loop cannot retrigger itself.
+    va = cluster.get("VariantAutoscaling", NS, "va0")
+    va.status.desired_optimized_alloc.num_replicas = 3
+    cluster.update_status(va)
+    assert len(nudges) == n
+
+    # Spec edit: generation bumps -> nudge.
+    va = cluster.get("VariantAutoscaling", NS, "va0")
+    va.spec.variant_cost = "5.0"
+    cluster.update(va)
+    assert nudges[-1] == ("VariantAutoscaling", "MODIFIED")
+
+    # Scale patch on the target: nudge (generation bumps).
+    n = len(nudges)
+    cluster.patch_scale("Deployment", NS, "d0", 4)
+    assert len(nudges) == n + 1 and nudges[-1][0] == "Deployment"
+
+
+def test_manager_wires_nudges_to_executor_triggers():
+    mgr, cluster, tsdb, clock = _quiet_world(2)
+    assert hasattr(mgr.client, "add_nudge_listener")
+    # Wire exactly what Manager.start wires (without starting threads).
+    mgr.client.add_nudge_listener(
+        lambda kind, event, obj: mgr.engine.executor.trigger())
+    mgr.engine.executor.consume_trigger()  # clear
+    va = cluster.get("VariantAutoscaling", NS, "m000-v5e")
+    va.spec.variant_cost = "42.0"
+    cluster.update(va)
+    assert mgr.engine.executor.consume_trigger()
+
+
+# --- 4. watch-surface hardening ---
+
+
+@pytest.fixture()
+def http_world():
+    cluster = FakeCluster()
+    server = FakeAPIServer(cluster).start()
+    yield cluster, server
+    server.shutdown()
+
+
+def _raw_watch_lines(url: str, timeout: float = 10.0):
+    resp = urllib.request.urlopen(url, timeout=timeout)
+    for raw in resp:
+        raw = raw.strip()
+        if raw:
+            yield json.loads(raw)
+
+
+def test_slow_consumer_overflow_closes_stream_with_410(http_world,
+                                                       monkeypatch):
+    """A dropped watch event must not leave the client confidently stale:
+    on queue overflow the server closes the stream with a 410-style gap
+    marker so the watcher's re-list path fires."""
+    import wva_tpu.k8s.fake_apiserver as fas
+
+    monkeypatch.setattr(fas, "WATCH_QUEUE_MAXSIZE", 1)
+    cluster, server = http_world
+    url = (f"{server.url}/apis/wva.tpu.llmd.ai/v1alpha1/namespaces/{NS}"
+           "/variantautoscalings?watch=true&timeoutSeconds=10")
+    got: list[dict] = []
+    t = threading.Thread(
+        target=lambda: got.extend(_raw_watch_lines(url)), daemon=True)
+    t.start()
+    time.sleep(0.3)  # let the stream register its handler
+    # Burst far past the (shrunk) queue: overflow is certain.
+    for i in range(50):
+        cluster.create(_va(f"burst-{i:03d}"))
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "stream must CLOSE after overflow"
+    assert got, "some events must have streamed before the gap"
+    last = got[-1]
+    assert last["type"] == "ERROR"
+    assert last["object"]["code"] == 410
+
+
+def test_rest_client_recovers_from_overflow_via_relist(http_world,
+                                                       monkeypatch):
+    """End-to-end slow-consumer regression: with a 1-slot server queue and
+    a slow handler, events are dropped — the 410 close must drive the REST
+    client's re-list, whose synthetic ADDEDs converge the handler on every
+    object instead of leaving it stale forever."""
+    import wva_tpu.k8s.fake_apiserver as fas
+
+    monkeypatch.setattr(fas, "WATCH_QUEUE_MAXSIZE", 1)
+    cluster, server = http_world
+    client = RestKubeClient(Credentials(server=server.url), timeout=5.0)
+    # Kill reconnect waits for test speed (jitter keeps them nonzero).
+    monkeypatch.setattr("wva_tpu.k8s.rest.WATCH_BACKOFF_INITIAL", 0.05)
+    seen: set[str] = set()
+
+    def slow_handler(event, obj):
+        time.sleep(0.01)
+        if event == "ADDED":
+            seen.add(obj.metadata.name)
+
+    try:
+        client.watch("VariantAutoscaling", slow_handler)
+        time.sleep(0.5)
+        names = {f"flood-{i:03d}" for i in range(40)}
+        for name in sorted(names):
+            cluster.create(_va(name))
+        deadline = time.time() + 15
+        while not names.issubset(seen) and time.time() < deadline:
+            time.sleep(0.1)
+        assert names.issubset(seen), \
+            f"missing {sorted(names - seen)[:5]} after overflow re-list"
+    finally:
+        client.stop()
+
+
+def test_watch_timeout_seconds_bounds_stream(http_world):
+    cluster, server = http_world
+    url = (f"{server.url}/api/v1/namespaces/{NS}"
+           "/pods?watch=true&timeoutSeconds=1")
+    start = time.time()
+    lines = list(_raw_watch_lines(url))
+    elapsed = time.time() - start
+    assert lines == []  # no events; the stream still ENDS cleanly
+    assert elapsed < 5.0
+
+
+def test_namespace_scoped_watch_filters_other_namespaces(http_world):
+    cluster, server = http_world
+    url = (f"{server.url}/apis/apps/v1/namespaces/ns1"
+           "/deployments?watch=true&timeoutSeconds=2")
+    got: list[dict] = []
+    t = threading.Thread(
+        target=lambda: got.extend(_raw_watch_lines(url)), daemon=True)
+    t.start()
+    time.sleep(0.3)
+    cluster.create(_deployment("in-scope", ns="ns1"))
+    cluster.create(_deployment("out-of-scope", ns="ns2"))
+    t.join(timeout=6.0)
+    names = [ev["object"]["metadata"]["name"] for ev in got
+             if ev["type"] == "ADDED"]
+    assert names == ["in-scope"]
+
+
+def test_watch_replays_list_to_registration_gap_as_synthetic_added(
+        http_world):
+    """Mutations landing between a client's initial list and its watch
+    registration are replayed as synthetic ADDEDs (at-least-once delivery
+    — the gap noted in _serve_watch's docstring)."""
+    cluster, server = http_world
+    cluster.create(_va("pre-existing"))
+    listed_rv = cluster._rv  # what a client's initial list would carry
+    # The gap: a create AFTER the list but BEFORE the watch connects.
+    cluster.create(_va("created-in-gap"))
+    url = (f"{server.url}/apis/wva.tpu.llmd.ai/v1alpha1/namespaces/{NS}"
+           f"/variantautoscalings?watch=true&timeoutSeconds=2"
+           f"&resourceVersion={listed_rv}")
+    got = list(_raw_watch_lines(url))
+    names = [ev["object"]["metadata"]["name"] for ev in got
+             if ev["type"] == "ADDED"]
+    assert "created-in-gap" in names
+    assert "pre-existing" not in names  # rv <= listed_rv: not replayed
+
+
+def test_reconnect_backoff_jitter_bounds():
+    vals = {_jittered(8.0) for _ in range(200)}
+    assert all(4.0 <= v <= 8.0 for v in vals)
+    assert len(vals) > 100, "jitter must actually spread"
+    assert _jittered(WATCH_BACKOFF_MAX) <= WATCH_BACKOFF_MAX
+
+
+# --- 5. hot-path read lint ---
+
+
+def test_no_direct_live_client_lists_in_hot_path_modules():
+    """Engine/pipeline hot paths must read through the tick snapshot /
+    informer view, never LIST the raw live client per tick (the regression
+    this PR exists to prevent). Same discipline as the utils/clock lint."""
+    pkg = pathlib.Path(wva_tpu.__file__).parent
+    hot_paths = [
+        "engines/saturation/engine.py",
+        "engines/scalefromzero/engine.py",
+        "engines/fastpath.py",
+        "pipeline/enforcer.py",
+        "pipeline/optimizer.py",
+        "pipeline/limiter.py",
+    ]
+    pattern = re.compile(r"self\s*\.\s*client\s*\.\s*list\s*\(")
+    offenders = []
+    for rel in hot_paths:
+        path = pkg / rel
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), 1):
+            code = line.split("#", 1)[0]
+            if pattern.search(code):
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "hot-path modules must not LIST through the raw live client — "
+        "route reads through the tick snapshot / informer view:\n"
+        + "\n".join(offenders))

@@ -47,7 +47,8 @@ NS = "inf"
 def make_fleet_world(n_models: int, kv: float = 0.3, queue: int = 0,
                      saturation_cfg: SaturationScalingConfig | None = None,
                      analysis_workers: int | None = None,
-                     trace: bool = False):
+                     trace: bool = False, informer: bool = True,
+                     incremental: bool = True):
     """FakeCluster world with ``n_models`` models, one VA/Deployment/pod
     each, live metrics in the TSDB, and a wired manager."""
     clock = FakeClock(start=100_000.0)
@@ -58,6 +59,8 @@ def make_fleet_world(n_models: int, kv: float = 0.3, queue: int = 0,
         {"default": saturation_cfg or SaturationScalingConfig()})
     if analysis_workers is not None:
         cfg.infrastructure.engine_analysis_workers = analysis_workers
+    cfg.infrastructure.informer = informer
+    cfg.infrastructure.incremental = incremental
     if trace:
         cfg.set_trace(TraceConfig(enabled=True))
 
@@ -105,12 +108,30 @@ def make_fleet_world(n_models: int, kv: float = 0.3, queue: int = 0,
 # --- 1. API-request budget ---
 
 
-def test_tick_issues_o_kinds_lists_and_zero_per_va_gets():
-    """A 20-VA tick must cost one LIST per touched kind — not one GET per
-    VA per stage (the pre-change loop issued 3+ Deployment/VA GETs per VA
-    per tick)."""
+def test_informer_tick_issues_zero_lists():
+    """With the watch-backed informer (default on), a steady-state engine
+    tick issues ZERO list requests — the snapshot's per-kind LIST is served
+    from the watch-fed store (docs/design/informer.md)."""
     n = 20
     mgr, cluster, tsdb, clock = make_fleet_world(n)
+    mgr.run_once()  # warm: first tick also exercises reconciler setup paths
+    cluster.reset_request_counts()
+    clock.advance(5.0)
+    mgr.engine.optimize()  # one bare engine tick, no reconciler noise
+    counts = cluster.request_counts()
+    for kind in ("VariantAutoscaling", "Deployment", "LeaderWorkerSet",
+                 "Pod"):
+        assert counts.get(("list", kind), 0) == 0, counts
+        assert counts.get(("get", kind), 0) == 0, counts
+
+
+def test_tick_issues_o_kinds_lists_and_zero_per_va_gets():
+    """Informer OFF: a 20-VA tick costs one LIST per touched kind — not one
+    GET per VA per stage (the pre-snapshot loop issued 3+ Deployment/VA
+    GETs per VA per tick)."""
+    n = 20
+    mgr, cluster, tsdb, clock = make_fleet_world(n, informer=False,
+                                                 incremental=False)
     mgr.run_once()  # warm: first tick also exercises reconciler setup paths
     cluster.reset_request_counts()
     mgr.engine.optimize()  # one bare engine tick, no reconciler noise
@@ -127,7 +148,8 @@ def test_tick_issues_o_kinds_lists_and_zero_per_va_gets():
 
 
 def _tick_read_counts(n):
-    mgr, cluster, tsdb, clock = make_fleet_world(n)
+    mgr, cluster, tsdb, clock = make_fleet_world(n, informer=False,
+                                                 incremental=False)
     mgr.run_once()
     cluster.reset_request_counts()
     mgr.engine.optimize()
@@ -145,9 +167,10 @@ def test_tick_request_budget_independent_of_fleet_size():
 
 
 def test_small_fleet_uses_memoized_targeted_gets_not_lists():
-    """Below SNAPSHOT_LIST_MIN_VAS the tick must NOT list scale-target
-    kinds (shared clusters: thousands of foreign Deployments) — each target
-    costs ONE memoized GET per tick despite being read by 3-5 stages."""
+    """Below SNAPSHOT_LIST_MIN_VAS (informer off) the tick must NOT list
+    scale-target kinds (shared clusters: thousands of foreign Deployments)
+    — each target costs ONE memoized GET per tick despite being read by
+    3-5 stages."""
     counts = _tick_read_counts(3)
     assert counts.get(("list", "Deployment"), 0) == 0
     assert counts.get(("get", "Deployment"), 0) == 3
@@ -158,7 +181,8 @@ def test_small_fleet_uses_memoized_targeted_gets_not_lists():
 def test_legacy_mode_still_pays_per_va_gets():
     """The bench's pre-change comparison lever really reproduces the old
     request shape (guards the bench-tick speedup claim's denominator)."""
-    mgr, cluster, tsdb, clock = make_fleet_world(5)
+    mgr, cluster, tsdb, clock = make_fleet_world(5, informer=False,
+                                                 incremental=False)
     mgr.engine.tick_snapshot_enabled = False
     mgr.run_once()
     cluster.reset_request_counts()

@@ -48,6 +48,12 @@ STAGE_LIMITER = "limiter"
 STAGE_FORECAST = "forecast"
 STAGE_ACTUATION = "actuation"
 STAGE_RECONCILE = "reconcile"
+# Dirty-set incremental ticks: models whose input fingerprint was unchanged
+# this cycle, so prepare->analyze was skipped and the prior cycle's decision
+# re-emitted. Recorded so an incremental trace still explains every model's
+# outcome (replay treats skipped models exactly like no-record models: the
+# re-emitted decisions were already verified the cycle they were computed).
+STAGE_FINGERPRINT_SKIP = "fingerprint_skip"
 
 # Per-model pipeline paths.
 PATH_V1 = "v1"

@@ -348,6 +348,32 @@ class GroupedMetricsView(MetricsSource):
             self._source.store_demuxed_result(name, dict(params), result)
         return result
 
+    def slice_fingerprint(self, queries, params: dict[str, str]) -> tuple:
+        """Digest of this tick's demuxed slices for ``params`` across
+        ``queries`` — the metrics component of the engine's dirty-set
+        fingerprint (docs/design/informer.md). Serving goes through the
+        same memoized fleet-wide execution the collectors use, so
+        fingerprinting costs zero extra backend queries on a tick that
+        analyzes anything. Hashes (labels, value) only — never collection
+        timestamps, which move every tick even when the data does not.
+        Ungroupable / failed / param-incomplete templates are excluded
+        (stably, so their absence cannot churn the digest)."""
+        parts: list[tuple] = []
+        for name in queries:
+            template = self._source.query_list().get(name)
+            if template is None:
+                continue
+            if any(p not in params for p in template.params):
+                continue
+            sliced = self._serve_grouped(name, params)
+            if sliced is None:
+                continue
+            values = tuple(sorted(
+                (tuple(sorted(v.labels.items())), v.value)
+                for v in sliced.values))
+            parts.append((name, values))
+        return tuple(parts)
+
     def _demuxed(self, key, name: str, gq: GroupedQuery,
                  params: dict[str, str], has_ns: bool):
         """Memoized fleet-wide execution + demux for one (template, extras)

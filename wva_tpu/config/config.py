@@ -59,6 +59,21 @@ class InfrastructureConfig:
     # ~10 queries per model. Off reproduces the per-model fan-out (the
     # bench-collect baseline); results are byte-identical either way.
     grouped_collection: bool = True
+    # Watch-backed informer cache (WVA_INFORMER / wva.informer): the tick's
+    # per-kind LISTs are served from a watch-fed store, so steady-state
+    # ticks issue ZERO list requests (docs/design/informer.md). Off
+    # restores one LIST per kind per tick.
+    informer: bool = True
+    # Dirty-set incremental ticks (WVA_INCREMENTAL / wva.incremental): a
+    # per-model input fingerprint gates prepare->analyze; unchanged-quiet
+    # models re-emit the prior cycle's decision as a heartbeat. Off is
+    # byte-identical to always-analyze (same discipline as WVA_FORECAST=off).
+    incremental: bool = True
+    # Every Nth tick re-analyzes EVERY model regardless of fingerprints
+    # (WVA_RESYNC_TICKS) — bounds staleness from anything the fingerprint
+    # cannot see (enforcer retention windows, analyzer-internal state).
+    # 0 disables the periodic resync.
+    resync_ticks: int = 12
 
 
 @dataclass
@@ -171,6 +186,8 @@ class Config:
         self._slo_ns: dict[str, "SLOConfigData"] = {}
         self._trace = TraceConfig()
         self._forecast = ForecastConfig()
+        # Bumped on every decision-affecting hot-reload (see mutation_epoch).
+        self._epoch = 0
 
     # --- infrastructure getters ---
 
@@ -207,6 +224,29 @@ class Config:
     def grouped_collection_enabled(self) -> bool:
         with self._mu:
             return self.infrastructure.grouped_collection
+
+    def informer_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.informer
+
+    def incremental_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.incremental
+
+    def resync_ticks(self) -> int:
+        with self._mu:
+            return max(0, self.infrastructure.resync_ticks)
+
+    def mutation_epoch(self) -> int:
+        """Monotonic counter bumped by every hot-reloadable config update.
+        The engine's dirty-set fingerprints include it, so a ConfigMap edit
+        dirties every model on the next tick (a config change is an input
+        change the K8s/metrics components cannot see)."""
+        with self._mu:
+            return self._epoch
+
+    def _bump_epoch_locked(self) -> None:
+        self._epoch += 1
 
     def rest_timeout(self) -> float:
         with self._mu:
@@ -273,6 +313,7 @@ class Config:
     def set_features(self, f: FeatureFlagsConfig) -> None:
         with self._mu:
             self._features = copy.deepcopy(f)
+            self._bump_epoch_locked()
 
     # --- decision trace (flight recorder) ---
 
@@ -297,6 +338,7 @@ class Config:
     def set_forecast(self, f: ForecastConfig) -> None:
         with self._mu:
             self._forecast = copy.deepcopy(f)
+            self._bump_epoch_locked()
 
     # --- saturation config (namespace-aware; reference config.go:318-354) ---
 
@@ -311,6 +353,27 @@ class Config:
                 if ns_cfg:
                     return copy.deepcopy(ns_cfg)
             return copy.deepcopy(self._saturation_global)
+
+    def slo_tuner_enabled_for_namespace(self, namespace: str) -> bool:
+        """Cheap (no deepcopy) tuner-enabled probe — the engine's dirty-set
+        gate asks per model per tick, and copying a fleet-sized SLO config
+        (every profile) each time cost more than the analysis skipped."""
+        with self._mu:
+            cfg = self._slo_ns.get(namespace) if namespace else None
+            if cfg is None:
+                cfg = self._slo_global
+            return cfg is not None and cfg.tuner_enabled
+
+    def saturation_optimizer_name_for_namespace(self, namespace: str) -> str:
+        """Cheap (no deepcopy) default-optimizer probe, same rationale."""
+        with self._mu:
+            per_model = None
+            if namespace:
+                per_model = self._saturation_ns.get(namespace)
+            if not per_model:
+                per_model = self._saturation_global
+            cfg = per_model.get("default")
+            return cfg.optimizer_name if cfg is not None else ""
 
     def fast_path_enabled_anywhere(self) -> bool:
         """Whether ANY scope's default saturation config enables the
@@ -336,6 +399,7 @@ class Config:
                 self._saturation_global = new
             else:
                 self._saturation_ns[namespace] = new
+            self._bump_epoch_locked()
 
     # --- scale-to-zero config (namespace-aware) ---
 
@@ -362,6 +426,7 @@ class Config:
                 self._scale_to_zero_global = new
             else:
                 self._scale_to_zero_ns[namespace] = new
+            self._bump_epoch_locked()
 
     # --- SLO (queueing-model analyzer) config; peer of the saturation
     # section, hot-reloaded from the wva-slo-config ConfigMap ---
@@ -391,6 +456,7 @@ class Config:
                 self._slo_ns[namespace] = new
             else:
                 self._slo_ns.pop(namespace, None)
+            self._bump_epoch_locked()
 
     def remove_namespace_config(self, namespace: str) -> None:
         """Drop namespace-local overrides (ConfigMap deleted) so resolution
@@ -401,6 +467,8 @@ class Config:
             removed = self._saturation_ns.pop(namespace, None) is not None
             removed = self._scale_to_zero_ns.pop(namespace, None) is not None or removed
             removed = self._slo_ns.pop(namespace, None) is not None or removed
+            if removed:
+                self._bump_epoch_locked()
         if removed:
             log.info("Removed namespace-local config for %s", namespace)
 

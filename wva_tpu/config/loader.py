@@ -74,6 +74,14 @@ DEFAULTS: dict[str, Any] = {
     "ENGINE_ANALYSIS_WORKERS": 0,  # 0 = auto (pooled for HTTP, serial in-mem)
     # One fleet-wide query per template per tick (vs per-model fan-out).
     "WVA_GROUPED_COLLECTION": True,
+    # Watch-backed informer cache: steady-state ticks LIST nothing
+    # (docs/design/informer.md). Off = one LIST per kind per tick.
+    "WVA_INFORMER": True,
+    # Dirty-set incremental ticks: unchanged models skip prepare->analyze
+    # and re-emit the prior decision. Off = always-analyze (byte-identical).
+    "WVA_INCREMENTAL": True,
+    # Full re-analysis every Nth tick regardless of fingerprints (0 = off).
+    "WVA_RESYNC_TICKS": 12,
     # GET /api/v1/query instead of POST (read-only proxies).
     "PROMETHEUS_USE_GET_QUERIES": False,
 }
@@ -176,6 +184,9 @@ def load(flags: Mapping[str, Any] | None = None,
         optimization_interval=r.get_duration("GLOBAL_OPT_INTERVAL"),
         engine_analysis_workers=max(0, r.get_int("ENGINE_ANALYSIS_WORKERS")),
         grouped_collection=r.get_bool("WVA_GROUPED_COLLECTION"),
+        informer=r.get_bool("WVA_INFORMER"),
+        incremental=r.get_bool("WVA_INCREMENTAL"),
+        resync_ticks=max(0, r.get_int("WVA_RESYNC_TICKS")),
     )
     cfg.tls = TLSConfig(
         webhook_cert_path=r.get_str("WEBHOOK_CERT_PATH"),

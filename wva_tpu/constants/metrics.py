@@ -90,7 +90,21 @@ WVA_FORECAST_DEMOTED = "wva_forecast_demoted"
 WVA_TREND_SERIES_SAMPLES = "wva_trend_series_samples"
 WVA_TREND_SERIES_STALENESS_SECONDS = "wva_trend_series_staleness_seconds"
 
+# --- Watch-backed informer cache (k8s/informer.py) ---
+# Seconds since the kind's store was last confirmed fresh (watch event or
+# list); alert on this growing past the resync interval.
+WVA_INFORMER_AGE_SECONDS = "wva_informer_age_seconds"
+# 1 when the kind's initial LIST completed and the watch is registered.
+WVA_INFORMER_SYNCED = "wva_informer_synced"
+# --- Dirty-set incremental ticks (engines/saturation) ---
+# Models whose input fingerprint was unchanged this tick (analysis skipped,
+# prior decision re-emitted as a heartbeat).
+WVA_TICK_MODELS_SKIPPED = "wva_tick_models_skipped"
+# Models analyzed (dirty or resync) this tick.
+WVA_TICK_MODELS_ANALYZED = "wva_tick_models_analyzed"
+
 # --- Common metric label names ---
+LABEL_KIND = "kind"
 LABEL_MODEL_NAME = "model_name"
 LABEL_TARGET_MODEL_NAME = "target_model_name"
 LABEL_NAMESPACE = "namespace"

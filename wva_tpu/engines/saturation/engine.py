@@ -15,6 +15,7 @@ current replicas are still emitted so the external HPA never starves
 
 from __future__ import annotations
 
+import copy
 import logging
 import math
 from concurrent.futures import ThreadPoolExecutor
@@ -28,9 +29,37 @@ from wva_tpu.analyzers.saturation_v2 import (
     CapacityKnowledgeStore,
     SaturationV2Analyzer,
 )
+from wva_tpu.collector.registration.saturation import (
+    QUERY_AVG_INPUT_TOKENS,
+    QUERY_AVG_OUTPUT_TOKENS,
+    QUERY_CACHE_CONFIG_INFO,
+    QUERY_GENERATE_BACKLOG,
+    QUERY_KV_CACHE_USAGE,
+    QUERY_PREFIX_CACHE_HIT_RATE,
+    QUERY_QUEUE_LENGTH,
+    QUERY_SCHEDULER_QUEUE_BYTES,
+    QUERY_SCHEDULER_QUEUE_SIZE,
+    QUERY_SERVING_CONFIG_INFO,
+    QUERY_SLOTS_AVAILABLE,
+    QUERY_SLOTS_USED,
+)
+from wva_tpu.collector.registration.scale_to_zero import (
+    PARAM_RETENTION_PERIOD,
+    QUERY_MODEL_REQUEST_COUNT,
+)
 from wva_tpu.collector.registration.slo import (
+    QUERY_ARRIVAL_RATE,
+    QUERY_ARRIVAL_RATE_FAST,
+    QUERY_AVG_ITL,
+    QUERY_AVG_TTFT,
     collect_accelerator_telemetry,
     collect_optimizer_metrics,
+)
+from wva_tpu.collector.source.promql import format_promql_duration
+from wva_tpu.collector.source.source import PARAM_MODEL_ID, PARAM_NAMESPACE
+from wva_tpu.config.scale_to_zero import (
+    is_scale_to_zero_enabled,
+    scale_to_zero_retention_seconds,
 )
 from wva_tpu.api.v1alpha1 import (
     OptimizedAlloc,
@@ -40,12 +69,13 @@ from wva_tpu.api.v1alpha1 import (
     REASON_METRICS_MISSING,
     VariantAutoscaling,
 )
-from wva_tpu.blackbox.schema import STAGE_FORECAST
+from wva_tpu.blackbox.schema import STAGE_FINGERPRINT_SKIP, STAGE_FORECAST
 from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
 from wva_tpu.collector.source.grouped import GroupedMetricsView
 from wva_tpu.config import Config
 from wva_tpu.constants import (
     LABEL_FORECASTER,
+    LABEL_KIND,
     LABEL_MODEL_NAME,
     LABEL_NAMESPACE,
     TPU_RESOURCE_NAME,
@@ -53,6 +83,10 @@ from wva_tpu.constants import (
     WVA_FORECAST_DEMOTED,
     WVA_FORECAST_ERROR,
     WVA_FORECAST_LEAD_TIME_SECONDS,
+    WVA_INFORMER_AGE_SECONDS,
+    WVA_INFORMER_SYNCED,
+    WVA_TICK_MODELS_ANALYZED,
+    WVA_TICK_MODELS_SKIPPED,
     WVA_TREND_SERIES_SAMPLES,
     WVA_TREND_SERIES_STALENESS_SECONDS,
 )
@@ -72,8 +106,8 @@ from wva_tpu.interfaces import (
 )
 from wva_tpu.interfaces.saturation_config import SLO_ANALYZER_NAME, V2_ANALYZER_NAME
 from wva_tpu.k8s.client import KubeClient, NotFoundError
-from wva_tpu.k8s.objects import Deployment, parse_quantity
-from wva_tpu.k8s.snapshot import SnapshotKubeClient
+from wva_tpu.k8s.objects import Deployment, labels_match, parse_quantity
+from wva_tpu.k8s.snapshot import DEFAULT_SNAPSHOT_KINDS, SnapshotKubeClient
 from wva_tpu.pipeline import (
     CostAwareOptimizer,
     Enforcer,
@@ -115,6 +149,43 @@ DEFAULT_ANALYSIS_WORKERS = 8
 # each target gets per tick). VariantAutoscalings are always LISTed (they
 # are all ours).
 SNAPSHOT_LIST_MIN_VAS = 8
+# Dirty-set incremental ticks (WVA_RESYNC_TICKS): every Nth tick analyzes
+# every model regardless of fingerprints, bounding staleness from inputs
+# the fingerprint cannot see (enforcer retention windows sliding with time,
+# analyzer-internal state like trend windows and tuner filters).
+DEFAULT_RESYNC_TICKS = 12
+# Query templates whose demuxed per-model slices form the metrics component
+# of the input fingerprint: the full replica-metrics set the analyzers
+# consume, plus the scheduler flow-control backlog pair. All are served
+# from this tick's memoized fleet-wide grouped executions, so
+# fingerprinting adds zero backend queries.
+FINGERPRINT_QUERIES = (
+    QUERY_KV_CACHE_USAGE,
+    QUERY_QUEUE_LENGTH,
+    QUERY_CACHE_CONFIG_INFO,
+    QUERY_SERVING_CONFIG_INFO,
+    QUERY_AVG_OUTPUT_TOKENS,
+    QUERY_AVG_INPUT_TOKENS,
+    QUERY_PREFIX_CACHE_HIT_RATE,
+    QUERY_GENERATE_BACKLOG,
+    QUERY_SLOTS_USED,
+    QUERY_SLOTS_AVAILABLE,
+)
+# V2/SLO analyzers additionally consume the scheduler flow-control backlog;
+# the SLO analyzer also consumes the windowed demand/latency telemetry —
+# rates DECAY after traffic stops while the gauges above freeze at their
+# idle values, so without these the post-burst scale-down would wait for
+# the periodic resync (the V1 percentage analyzer reads none of them).
+FINGERPRINT_QUERIES_V2 = FINGERPRINT_QUERIES + (
+    QUERY_SCHEDULER_QUEUE_SIZE,
+    QUERY_SCHEDULER_QUEUE_BYTES,
+)
+FINGERPRINT_QUERIES_SLO = FINGERPRINT_QUERIES_V2 + (
+    QUERY_ARRIVAL_RATE,
+    QUERY_ARRIVAL_RATE_FAST,
+    QUERY_AVG_TTFT,
+    QUERY_AVG_ITL,
+)
 
 METRICS_REASON_AVAILABLE = REASON_METRICS_FOUND
 METRICS_REASON_UNAVAILABLE = REASON_METRICS_MISSING
@@ -215,6 +286,23 @@ class SaturationEngine:
         self.tick_snapshot_enabled = True
         self.solver_batching = True
         self.grouped_collection = True
+        # Dirty-set incremental ticks (docs/design/informer.md): a per-model
+        # input fingerprint (VA generations/labels, scale-target state, pod
+        # set, this tick's grouped metric slices, config epoch) gates
+        # prepare->analyze; unchanged-quiet models re-emit the prior cycle's
+        # decision as a heartbeat. WVA_INCREMENTAL=off restores
+        # analyze-everything (byte-identical outputs, like WVA_FORECAST=off).
+        self.incremental_enabled = True
+        self.resync_ticks = DEFAULT_RESYNC_TICKS
+        self._tick_seq = 0
+        # group_key ("model|ns") -> last analyzed fingerprint / the
+        # PRE-limiter decisions that analysis produced (deep copies; the
+        # limiter re-clamps the merged set every tick, so re-emitted
+        # decisions see current inventory).
+        self._fingerprints: dict[str, tuple] = {}
+        self._decision_memo: dict[str, list[VariantDecision]] = {}
+        # Introspection for tests/bench: analyzed vs skipped last tick.
+        self.last_tick_stats: dict[str, int] = {"analyzed": 0, "skipped": 0}
         self._analysis_pool: ThreadPoolExecutor | None = None
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock,
@@ -240,12 +328,24 @@ class SaturationEngine:
         cluster's foreign Deployments are never LISTed."""
         if not self.tick_snapshot_enabled:
             return self.client
+        # Informer-backed client (k8s/informer.py): the snapshot's one LIST
+        # per kind is served from the watch-fed store — zero API requests —
+        # so the small-fleet targeted-GET economy no longer applies, and
+        # Pods join the snapshot (the dirty-set fingerprint hashes the pod
+        # set only when reading it is free).
+        informer_backed = getattr(self.client, "lists_are_local", False)
+        kinds = DEFAULT_SNAPSHOT_KINDS
+        if informer_backed and "Pod" in getattr(self.client, "kinds", ()):
+            kinds = DEFAULT_SNAPSHOT_KINDS + ("Pod",)
         snap = SnapshotKubeClient(
-            self.client, namespace=self.config.watch_namespace() or None)
-        n_vas = len(snap.list("VariantAutoscaling",
-                              namespace=self.config.watch_namespace() or None))
-        if n_vas < SNAPSHOT_LIST_MIN_VAS:
-            snap.use_targeted_gets(("Deployment", "LeaderWorkerSet"))
+            self.client, namespace=self.config.watch_namespace() or None,
+            kinds=kinds)
+        if not informer_backed:
+            n_vas = len(snap.list(
+                "VariantAutoscaling",
+                namespace=self.config.watch_namespace() or None))
+            if n_vas < SNAPSHOT_LIST_MIN_VAS:
+                snap.use_targeted_gets(("Deployment", "LeaderWorkerSet"))
         return snap
 
     def _tick_collector(self) -> ReplicaMetricsCollector:
@@ -319,6 +419,11 @@ class SaturationEngine:
             # Retried ticks must not stack duplicate model records into the
             # failed attempt's cycle.
             self.flight.reset_cycle()
+        # Informer staleness backstop: re-LIST any kind whose last list is
+        # older than the resync interval (no-op on non-informer clients).
+        resync = getattr(self.client, "resync_if_stale", None)
+        if callable(resync):
+            resync()
         # Tick-scoped cluster snapshot: every K8s read below (active-VA
         # filter, per-model data prep, decision application, safety net) is
         # served from one LIST per kind instead of a GET per VA — O(kinds)
@@ -367,21 +472,32 @@ class SaturationEngine:
         if self.flight is not None:
             self.flight.annotate(analyzer=analyzer_name or "v1")
 
+        # Dirty-set gate: models whose input fingerprint is unchanged skip
+        # prepare->analyze and re-emit the prior cycle's decisions below.
+        clean, fingerprints = self._partition_clean(
+            model_groups, snap, collector, analyzer_name)
+        self._prune_incremental_state(set(model_groups))
+        self.last_tick_stats = {
+            "analyzed": len(model_groups) - len(clean),
+            "skipped": len(clean)}
+
         # Analyzer selection by name (reference engine.go:236-254); "slo"
         # reuses the V2 optimizer/enforcer flow with the queueing-model
         # analyzer producing req/s capacities instead of token capacities.
         if analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
             decisions = self._optimize_v2(
                 model_groups, snap, use_slo=analyzer_name == SLO_ANALYZER_NAME,
-                collector=collector)
+                collector=collector, clean=clean, fingerprints=fingerprints)
         else:
             decisions = self._optimize_v1(model_groups, snap,
-                                          collector=collector)
+                                          collector=collector, clean=clean,
+                                          fingerprints=fingerprints)
 
         if self.flight is not None:
             self.flight.record_decisions(decisions)
         self._apply_decisions(decisions, va_map, snap)
         self._emit_trend_metrics(analyzer_name)
+        self._emit_control_plane_metrics()
 
     def _emit_trend_metrics(self, analyzer_name: str) -> None:
         """Surface the active analyzer's DemandTrend health (per-key sample
@@ -409,20 +525,254 @@ class SaturationEngine:
             registry.remove(WVA_TREND_SERIES_STALENESS_SECONDS, labels)
         self._trend_gauge_keys = emitted
 
+    # --- dirty-set incremental ticks (docs/design/informer.md) ---
+
+    def _partition_clean(self, model_groups: dict, snap: KubeClient,
+                         collector: ReplicaMetricsCollector,
+                         analyzer_name: str,
+                         ) -> tuple[set[str], dict[str, tuple | None]]:
+        """Compute every model's input fingerprint and split the fleet into
+        clean (skip prepare->analyze, re-emit the memoized decision) and
+        dirty. A model is clean only when ALL hold: incremental is on, this
+        is not a resync tick, the fingerprint is computable (grouped
+        metrics view available), it equals last tick's, a decision memo
+        exists, and the model is not routed through the fleet-wide global
+        optimizer (whose solve couples every model's inputs — skipping one
+        would change the others' assignments)."""
+        self._tick_seq += 1
+        fingerprints: dict[str, tuple | None] = {}
+        clean: set[str] = set()
+        resync_tick = (self.resync_ticks > 0
+                       and self._tick_seq % self.resync_ticks == 0)
+        gate_open = self.incremental_enabled and not resync_tick
+        use_slo = analyzer_name == SLO_ANALYZER_NAME
+        # Fingerprint exactly the metric surface the selected analyzer
+        # consumes (fingerprinting input an analyzer never reads would
+        # cost fleet-wide queries that cannot dirty anything).
+        if analyzer_name == SLO_ANALYZER_NAME:
+            fp_queries = FINGERPRINT_QUERIES_SLO
+        elif analyzer_name == V2_ANALYZER_NAME:
+            fp_queries = FINGERPRINT_QUERIES_V2
+        else:
+            fp_queries = FINGERPRINT_QUERIES
+        # Prefetch each namespace's pod shapes ONCE per tick: the snapshot
+        # deep-copies every listed object per call, so a per-model Pod list
+        # would cost O(models x pods) copies — at 48 models / 96 pods that
+        # alone outweighed the analysis being skipped.
+        pods_by_ns: dict[str, list[tuple]] = {}
+        if (self.incremental_enabled
+                and getattr(snap, "covers_kind", lambda k: False)("Pod")):
+            for key in model_groups:
+                ns = model_groups[key][0].metadata.namespace
+                if ns not in pods_by_ns:
+                    pods_by_ns[ns] = [
+                        (pod.metadata.name, pod.metadata.labels,
+                         getattr(pod.status, "phase", ""),
+                         getattr(pod.status, "ready", False),
+                         getattr(pod.status, "pod_ip", ""))
+                        for pod in snap.list("Pod", namespace=ns)]
+        for key in sorted(model_groups):
+            model_vas = model_groups[key]
+            fp = None
+            if self.incremental_enabled:
+                try:
+                    fp = self._model_fingerprint(
+                        model_vas, snap, collector,
+                        queries=fp_queries,
+                        ns_pods=pods_by_ns.get(
+                            model_vas[0].metadata.namespace))
+                except Exception as e:  # noqa: BLE001 — a fingerprint
+                    # failure must degrade to "dirty", never fail the tick.
+                    log.debug("fingerprint failed for %s: %s", key, e)
+                    fp = None
+            fingerprints[key] = fp
+            if (gate_open and fp is not None
+                    and key in self._decision_memo
+                    and fp == self._fingerprints.get(key)
+                    and not self._route_is_global(model_vas, use_slo)
+                    and not self._tuner_active(model_vas, use_slo)):
+                clean.add(key)
+        return clean, fingerprints
+
+    def _model_fingerprint(self, model_vas: list[VariantAutoscaling],
+                           snap: KubeClient,
+                           collector: ReplicaMetricsCollector,
+                           queries: tuple[str, ...] = FINGERPRINT_QUERIES,
+                           ns_pods: list[tuple] | None = None,
+                           ) -> tuple | None:
+        """The model's decision inputs as a comparable tuple, or None when
+        the metrics plane is not fingerprintable (no grouped view — the
+        model then never skips). Components: config mutation epoch, per-VA
+        spec identity (generation moves on spec edits, never on our own
+        status writes) + labels + last written alloc, scale-target
+        resourceVersion/replica shape, the pod set (when the snapshot
+        covers Pods — informer-backed, so the read is free), and the
+        tick's demuxed grouped metric slices including the scale-to-zero
+        request count over the namespace's retention window."""
+        source = getattr(collector, "source", None)
+        if not isinstance(source, GroupedMetricsView):
+            return None
+        namespace = model_vas[0].metadata.namespace
+        model_id = model_vas[0].spec.model_id
+        parts: list[tuple] = [("epoch", self.config.mutation_epoch())]
+        selectors: list[dict] = []
+        for va in sorted(model_vas, key=lambda v: v.metadata.name):
+            alloc = va.status.desired_optimized_alloc
+            parts.append((
+                "va", va.metadata.namespace, va.metadata.name,
+                va.metadata.generation,
+                tuple(sorted((va.metadata.labels or {}).items())),
+                alloc.num_replicas, alloc.accelerator))
+            ref = va.spec.scale_target_ref
+            if not ref.name:
+                continue
+            target = snap.try_get(ref.kind, va.metadata.namespace, ref.name)
+            if target is None:
+                parts.append(("target-missing", ref.kind, ref.name))
+                continue
+            status = getattr(target, "status", None)
+            parts.append((
+                "target", ref.kind, target.metadata.name,
+                target.metadata.resource_version,
+                getattr(target, "replicas", None),
+                getattr(status, "replicas", None),
+                getattr(status, "ready_replicas", None)))
+            selector = getattr(target, "selector", None)
+            if selector:
+                selectors.append(selector)
+        if selectors and ns_pods:
+            # ns_pods is the tick's prefetched (name, labels, phase, ready,
+            # ip) pod shapes for this namespace (one snapshot list per
+            # tick, shared across models).
+            for name, labels, phase, ready, pod_ip in ns_pods:
+                if not any(labels_match(sel, labels) for sel in selectors):
+                    continue
+                parts.append(("pod", name, phase, ready, pod_ip))
+        params = {PARAM_MODEL_ID: model_id, PARAM_NAMESPACE: namespace}
+        parts.append(("metrics",
+                      source.slice_fingerprint(queries, params)))
+        # The enforcer's scale-to-zero trigger is a request count over a
+        # retention window SLIDING with time: after traffic stops, the
+        # count keeps changing (decaying) with no other input moving, and
+        # the model must stay dirty until it reaches zero — otherwise the
+        # 0-request transition the enforcer acts on would wait for the
+        # periodic resync.
+        s2z_cfg = self.config.scale_to_zero_config_for_namespace(namespace)
+        if is_scale_to_zero_enabled(s2z_cfg, model_id):
+            retention = scale_to_zero_retention_seconds(s2z_cfg, model_id)
+            parts.append(("s2z", source.slice_fingerprint(
+                (QUERY_MODEL_REQUEST_COUNT,),
+                {**params,
+                 PARAM_RETENTION_PERIOD: format_promql_duration(retention)})))
+        return tuple(parts)
+
+    def _route_is_global(self, model_vas: list[VariantAutoscaling],
+                         use_slo: bool) -> bool:
+        if not use_slo:
+            return False
+        return self.config.saturation_optimizer_name_for_namespace(
+            model_vas[0].metadata.namespace) == "global"
+
+    def _tuner_active(self, model_vas: list[VariantAutoscaling],
+                      use_slo: bool) -> bool:
+        """Tuner-enabled namespaces never skip: the EKF extracts
+        information from REPEATED observations of the same telemetry (its
+        covariance tightens every step), so an unchanged-input skip would
+        freeze profile refinement exactly when traffic is steady — the
+        condition it learns best under."""
+        if not use_slo:
+            return False
+        return self.config.slo_tuner_enabled_for_namespace(
+            model_vas[0].metadata.namespace)
+
+    def _reemit_memoized(self, group_key: str,
+                         model_vas: list[VariantAutoscaling],
+                         into: list[VariantDecision]) -> None:
+        """Append deep copies of the model's memoized pre-limiter decisions
+        and record the skip as a trace stage (replay treats re-emitted
+        models like no-record models — their decisions were verified the
+        cycle they were computed)."""
+        cached = [copy.deepcopy(d)
+                  for d in self._decision_memo.get(group_key, [])]
+        into.extend(cached)
+        if self.flight is not None:
+            self.flight.record_stage(STAGE_FINGERPRINT_SKIP, {
+                "model_id": model_vas[0].spec.model_id,
+                "namespace": model_vas[0].metadata.namespace,
+                "reemitted_decisions": len(cached),
+            })
+
+    def _memoize_model(self, group_key: str, fingerprints: dict,
+                       decisions: list[VariantDecision]) -> None:
+        """Store a model's analyzed outcome for heartbeat re-emission.
+        Decisions are memoized PRE-limiter (the limiter re-clamps the
+        merged set each tick against current inventory)."""
+        fp = fingerprints.get(group_key)
+        if fp is None:
+            # Not fingerprintable this tick: make sure no stale memo can
+            # pair with a stale fingerprint later.
+            self._decision_memo.pop(group_key, None)
+            self._fingerprints.pop(group_key, None)
+            return
+        self._decision_memo[group_key] = [copy.deepcopy(d) for d in decisions]
+        self._fingerprints[group_key] = fp
+
+    def _invalidate_model(self, group_key: str) -> None:
+        """Analysis failed (safety net): force re-analysis next tick."""
+        self._decision_memo.pop(group_key, None)
+        self._fingerprints.pop(group_key, None)
+
+    def _prune_incremental_state(self, active_group_keys: set[str]) -> None:
+        for key in list(self._fingerprints):
+            if key not in active_group_keys:
+                self._fingerprints.pop(key, None)
+        for key in list(self._decision_memo):
+            if key not in active_group_keys:
+                self._decision_memo.pop(key, None)
+
+    def _emit_control_plane_metrics(self) -> None:
+        """Dirty-set + informer-freshness gauges: operators alerting on
+        staleness need to see a wedged watch stream (age past the resync
+        interval) and how much of the fleet each tick actually analyzes."""
+        registry = getattr(self.actuator, "registry", None)
+        if registry is None:
+            return
+        registry.set_gauge(WVA_TICK_MODELS_ANALYZED, {},
+                           float(self.last_tick_stats.get("analyzed", 0)))
+        registry.set_gauge(WVA_TICK_MODELS_SKIPPED, {},
+                           float(self.last_tick_stats.get("skipped", 0)))
+        stats = getattr(self.client, "stats", None)
+        if not callable(stats) or not getattr(self.client, "lists_are_local",
+                                              False):
+            return
+        for kind, st in sorted(stats().items()):
+            labels = {LABEL_KIND: kind}
+            registry.set_gauge(WVA_INFORMER_SYNCED, labels, st["synced"])
+            if st["age_seconds"] >= 0:
+                registry.set_gauge(WVA_INFORMER_AGE_SECONDS, labels,
+                                   st["age_seconds"])
+
     # --- V1 path ---
 
     def _optimize_v1(
         self, model_groups: dict[str, list[VariantAutoscaling]],
         snap: KubeClient,
         collector: ReplicaMetricsCollector | None = None,
+        clean: set[str] | None = None,
+        fingerprints: dict[str, tuple | None] | None = None,
     ) -> list[VariantDecision]:
         collector = collector or self.collector
+        clean = clean or set()
+        fingerprints = fingerprints or {}
         # Stage 1 — per-model prepare + analyze, fanned across the worker
         # pool. Workers only touch thread-safe state (snapshot reads,
         # collector refresh, the stateless V1 analyzer); exceptions from
         # data preparation stay isolated per model exactly as in the serial
         # loop (analysis errors still fail the tick into the retry loop).
+        # Clean models (unchanged fingerprint) never reach a worker.
         def analyze_one(group_key: str, model_vas: list[VariantAutoscaling]):
+            if group_key in clean:
+                return ("clean", None)
             model_id = model_vas[0].spec.model_id
             namespace = model_vas[0].metadata.namespace
             sat_cfg = self.config.saturation_config_for_namespace(
@@ -456,11 +806,19 @@ class SaturationEngine:
             model_id = model_vas[0].spec.model_id
             namespace = model_vas[0].metadata.namespace
             status, value = outcomes[group_key]
+            if status == "clean":
+                self._reemit_memoized(group_key, model_vas, all_decisions)
+                continue
             if status == "skip":
+                # Recomputing next tick is as cheap as re-skipping and a
+                # gated-out model's inputs may gate differently: memoize
+                # "no decisions" so a clean fingerprint can skip it too.
+                self._memoize_model(group_key, fingerprints, [])
                 continue
             if status == "safety-net":
                 log.error("Model data preparation failed for %s: %s",
                           model_id, value)
+                self._invalidate_model(group_key)
                 self._emit_safety_net_metrics(model_vas, snap)
                 continue
             data, analysis, targets, sat_cfg = value
@@ -488,10 +846,12 @@ class SaturationEngine:
                     "scaled_to_zero": scaled_to_zero,
                 })
 
-            all_decisions.extend(saturation_targets_to_decisions(
+            model_decisions = saturation_targets_to_decisions(
                 targets, analysis, data.variant_states,
                 enforcer_note=(SCALE_TO_ZERO_REASON
-                               if scaled_to_zero else "")))
+                               if scaled_to_zero else ""))
+            all_decisions.extend(model_decisions)
+            self._memoize_model(group_key, fingerprints, model_decisions)
 
         self._apply_limiter(all_decisions)
         return all_decisions
@@ -503,9 +863,18 @@ class SaturationEngine:
         snap: KubeClient,
         use_slo: bool = False,
         collector: ReplicaMetricsCollector | None = None,
+        clean: set[str] | None = None,
+        fingerprints: dict[str, tuple | None] | None = None,
     ) -> list[VariantDecision]:
         collector = collector or self.collector
+        clean = clean or set()
+        fingerprints = fingerprints or {}
         requests: list[ModelScalingRequest] = []
+        # Clean models' memoized decisions, re-emitted after the fresh
+        # models' optimizer/enforcer/forecast stages (they already carry
+        # their own enforcement + floors from the cycle that computed them;
+        # only the limiter re-runs over the merged set).
+        cached_decisions: list[VariantDecision] = []
         # Optimizer route per (model, namespace), resolved ONCE from the
         # same sat_cfg snapshot the analysis used — the trace record and the
         # global/local split below must agree by construction, or a config
@@ -530,6 +899,8 @@ class SaturationEngine:
         # candidates can be sized in ONE device dispatch below. The trend
         # update lives in finalize(), which stays on the engine thread.
         def analyze_one(group_key: str, model_vas: list[VariantAutoscaling]):
+            if group_key in clean:
+                return ("clean", None)
             model_id = model_vas[0].spec.model_id
             namespace = model_vas[0].metadata.namespace
             sat_cfg = self.config.saturation_config_for_namespace(
@@ -617,17 +988,23 @@ class SaturationEngine:
             model_id = model_vas[0].spec.model_id
             namespace = model_vas[0].metadata.namespace
             status, value = outcomes[group_key]
+            if status == "clean":
+                self._reemit_memoized(group_key, model_vas, cached_decisions)
+                continue
             if status == "skip":
+                self._memoize_model(group_key, fingerprints, [])
                 continue
             if status == "safety-net":
                 stage, err = value
                 log.error("%s failed for %s: %s", stage, model_id, err)
+                self._invalidate_model(group_key)
                 self._emit_safety_net_metrics(model_vas, snap)
                 continue
             data, sat_cfg, scheduler_queue, out = value
             if group_key in sizing_errors:
                 log.error("SLO sizing failed for %s: %s", model_id,
                           sizing_errors[group_key])
+                self._invalidate_model(group_key)
                 self._emit_safety_net_metrics(model_vas, snap)
                 continue
             if use_slo:
@@ -643,6 +1020,7 @@ class SaturationEngine:
                             out, sized.get(group_key, []))
                     except Exception as e:  # noqa: BLE001 — per-model isolation
                         log.error("SLO analysis failed for %s: %s", model_id, e)
+                        self._invalidate_model(group_key)
                         self._emit_safety_net_metrics(model_vas, snap)
                         continue
             else:
@@ -653,6 +1031,7 @@ class SaturationEngine:
                 # decisions.
                 log.debug("SLO analyzer produced no capacities for %s; skipped",
                           model_id)
+                self._memoize_model(group_key, fingerprints, [])
                 continue
             routes[(model_id, namespace)] = \
                 ("global" if use_slo and sat_cfg.optimizer_name == "global"
@@ -676,52 +1055,85 @@ class SaturationEngine:
                 model_id=model_id, namespace=namespace, result=result,
                 variant_states=data.variant_states))
 
-        if not requests:
+        if not requests and not cached_decisions:
             return []
 
-        # Optimizer selection respects namespace-local config (optimizerName
-        # is resolved per request's namespace, like every other knob) —
-        # using the route resolved above, from the same config snapshot the
-        # analysis and the trace record saw.
-        global_reqs: list[ModelScalingRequest] = []
-        local_reqs: list[ModelScalingRequest] = []
-        for req in requests:
-            if routes[(req.model_id, req.namespace)] == "global":
-                global_reqs.append(req)
-            else:
-                local_reqs.append(req)
-        decisions = []
-        if global_reqs:
-            decisions.extend(self._optimize_global(global_reqs, slo_cfg_by_ns))
-        if local_reqs:
-            decisions.extend(self.optimizer.optimize(local_reqs, None))
+        decisions: list[VariantDecision] = []
+        if requests:
+            # Optimizer selection respects namespace-local config
+            # (optimizerName is resolved per request's namespace, like every
+            # other knob) — using the route resolved above, from the same
+            # config snapshot the analysis and the trace record saw.
+            global_reqs: list[ModelScalingRequest] = []
+            local_reqs: list[ModelScalingRequest] = []
+            for req in requests:
+                if routes[(req.model_id, req.namespace)] == "global":
+                    global_reqs.append(req)
+                else:
+                    local_reqs.append(req)
+            if global_reqs:
+                decisions.extend(
+                    self._optimize_global(global_reqs, slo_cfg_by_ns))
+            if local_reqs:
+                decisions.extend(self.optimizer.optimize(local_reqs, None))
 
-        # Enforcer bridge per model (reference engine_v2.go:76-127) — shared
-        # with the trace replay harness (pipeline.bridge_enforce).
-        for req in requests:
-            s2z_cfg = self.config.scale_to_zero_config_for_namespace(req.namespace)
-            scaled_to_zero = bridge_enforce(
-                decisions, req.model_id, req.namespace, self.enforcer,
-                s2z_cfg, now=self.clock.now(),
-                optimizer_name=self.optimizer.name())
-            if scaled_to_zero:
-                log.info("Scale-to-zero enforcement applied (V2) for %s", req.model_id)
+            # Enforcer bridge per model (reference engine_v2.go:76-127) —
+            # shared with the trace replay harness (pipeline.bridge_enforce).
+            for req in requests:
+                s2z_cfg = self.config.scale_to_zero_config_for_namespace(
+                    req.namespace)
+                scaled_to_zero = bridge_enforce(
+                    decisions, req.model_id, req.namespace, self.enforcer,
+                    s2z_cfg, now=self.clock.now(),
+                    optimizer_name=self.optimizer.name())
+                if scaled_to_zero:
+                    log.info("Scale-to-zero enforcement applied (V2) for %s",
+                             req.model_id)
 
-        self._apply_forecast(requests, decisions, routes)
+        self._apply_forecast(
+            requests, decisions, routes,
+            active_keys={(vas[0].spec.model_id, vas[0].metadata.namespace)
+                         for vas in model_groups.values()})
+
+        # Memoize each analyzed model's PRE-limiter decisions (with their
+        # enforcement + forecast floors baked in) for heartbeat re-emission,
+        # then merge the clean models' cached decisions back; the limiter
+        # re-clamps the whole merged set against current inventory.
+        fresh_by_key: dict[str, list[VariantDecision]] = {}
+        for d in decisions:
+            fresh_by_key.setdefault(
+                f"{d.model_id}|{d.namespace}", []).append(d)
+        for req in requests:
+            key = f"{req.model_id}|{req.namespace}"
+            self._memoize_model(key, fingerprints,
+                                fresh_by_key.get(key, []))
+        decisions.extend(cached_decisions)
         self._apply_limiter(decisions)
         return decisions
 
     def _apply_forecast(self, requests: list[ModelScalingRequest],
                         decisions: list[VariantDecision],
                         routes: dict[tuple[str, str], str] | None = None,
+                        active_keys: set[tuple[str, str]] | None = None,
                         ) -> None:
         """Predictive planning stage (V2/SLO paths): feed the planner this
         tick's demand + variant states, fit every model's forecasters in
         one batched call, and raise proactive floors on the decisions.
         Runs on the engine thread in sorted model order (the planner's
         learned state must evolve byte-deterministically at any analysis-
-        pool width), BEFORE the limiter so inventory caps still bind."""
-        if self.forecast is None or not requests:
+        pool width), BEFORE the limiter so inventory caps still bind.
+
+        ``active_keys`` is the full set of live (model, namespace) groups
+        this tick INCLUDING fingerprint-skipped ones: the gauge sweep must
+        only drop series for DELETED models, never for a quiet model whose
+        analysis was skipped (its last-emitted values are still the
+        truth)."""
+        if self.forecast is None:
+            return
+        if not requests:
+            # All-quiet tick: no planning, but deleted models' gauges must
+            # still be pruned (the sweep below).
+            self._sweep_forecast_gauges(set(), active_keys or set())
             return
         now = self.clock.now()
         # Models routed through the fleet-wide global optimizer still get
@@ -762,9 +1174,18 @@ class SaturationEngine:
             for name, err in plan.errors.items():
                 registry.set_gauge(WVA_FORECAST_ERROR,
                                    {**labels, LABEL_FORECASTER: name}, err)
-        # Deleted/renamed models: drop their gauges instead of exporting
-        # the last values forever.
-        for model, ns in self._forecast_gauge_keys - emitted:
+        self._sweep_forecast_gauges(emitted, active_keys or emitted)
+
+    def _sweep_forecast_gauges(self, emitted: set[tuple],
+                               active: set[tuple]) -> None:
+        """Deleted/renamed models: drop their gauges instead of exporting
+        the last values forever. A quiet model whose analysis was
+        fingerprint-skipped this tick (active but not emitted) keeps its
+        gauges — its last-emitted values still describe a live model."""
+        registry = getattr(self.actuator, "registry", None)
+        if registry is None:
+            return
+        for model, ns in self._forecast_gauge_keys - emitted - active:
             labels = {LABEL_MODEL_NAME: model, LABEL_NAMESPACE: ns}
             for gauge in (WVA_FORECAST_LEAD_TIME_SECONDS,
                           WVA_FORECAST_DEMAND, WVA_FORECAST_DEMOTED):
@@ -772,7 +1193,8 @@ class SaturationEngine:
             for name in FORECASTERS:
                 registry.remove(WVA_FORECAST_ERROR,
                                 {**labels, LABEL_FORECASTER: name})
-        self._forecast_gauge_keys = emitted
+        self._forecast_gauge_keys = \
+            (self._forecast_gauge_keys & active) | emitted
 
     def _apply_limiter(self, decisions: list[VariantDecision]) -> None:
         """Optional slice limiter, applied on EVERY analysis path (the

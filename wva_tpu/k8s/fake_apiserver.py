@@ -36,6 +36,11 @@ from wva_tpu.k8s.client import ConflictError, FakeCluster, NotFoundError
 
 log = logging.getLogger(__name__)
 
+# Per-stream watch event buffer. When a slow consumer lets it overflow, the
+# stream is CLOSED with a 410-style gap marker (see _serve_watch) — module
+# constant so the slow-consumer regression test can shrink it.
+WATCH_QUEUE_MAXSIZE = 1024
+
 # Path shapes (namespaced and cluster-scoped, core and group APIs).
 _PATH_RE = re.compile(
     r"^(?:/api/v1|/apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
@@ -319,7 +324,14 @@ class _Handler(BaseHTTPRequestHandler):
         stream — and its thread + watcher registration — is bounded. A
         ``/namespaces/<ns>/...`` watch path only streams that namespace's
         events, like a real apiserver."""
-        events: queue.Queue = queue.Queue(maxsize=1024)
+        events: queue.Queue = queue.Queue(maxsize=WATCH_QUEUE_MAXSIZE)
+        # Set when the event queue overflowed: the stream is now known to
+        # have a GAP, and silently continuing would leave the client
+        # confidently stale forever (its informer store would never learn
+        # about the dropped mutation). Real apiservers surface exactly this
+        # as 410 Gone when a watcher falls behind the watch cache; we emit
+        # the same ERROR event so RestKubeClient's re-list path fires.
+        overflowed = threading.Event()
 
         def on_event(event: str, obj) -> None:
             if namespace and (obj.metadata.namespace or "") != namespace:
@@ -327,7 +339,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 events.put_nowait((event, obj))
             except queue.Full:
-                pass  # slow consumer; the client will re-list on gaps
+                overflowed.set()  # gap: the serve loop 410s the stream
 
         self.cluster.watch(kind, on_event)
         try:
@@ -344,12 +356,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
-        def send(event: str, obj) -> None:
-            line = json.dumps(
-                {"type": event, "object": serde.to_k8s(obj)}).encode()
+        def send_raw(payload: dict) -> None:
+            line = json.dumps(payload).encode()
             chunk = f"{len(line) + 1:x}\r\n".encode() + line + b"\n\r\n"
             self.wfile.write(chunk)
             self.wfile.flush()
+
+        def send(event: str, obj) -> None:
+            send_raw({"type": event, "object": serde.to_k8s(obj)})
+
+        def send_gone() -> None:
+            # The 410-style gap marker (apiserver "too old resource
+            # version" shape): clients raise ApiError(410) and re-list.
+            send_raw({"type": "ERROR", "object": {
+                "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                "reason": "Expired", "code": 410,
+                "message": "watch event queue overflowed; resourceVersion "
+                           "gap — re-list required"}})
 
         clean_end = False
         try:
@@ -362,6 +385,13 @@ class _Handler(BaseHTTPRequestHandler):
                     if obj_rv > since_rv:
                         send("ADDED", obj)
             while time.monotonic() < deadline:
+                if overflowed.is_set():
+                    # Drain nothing further: events after the drop are
+                    # beyond the gap anyway. Close with the gap marker so
+                    # the client re-lists instead of trusting a stream
+                    # with a hole in it.
+                    send_gone()
+                    break
                 try:
                     event, obj = events.get(timeout=0.2)
                 except queue.Empty:

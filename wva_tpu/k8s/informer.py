@@ -1,0 +1,407 @@
+"""Watch-backed informer cache over a ``KubeClient``
+(the controller-runtime informer/cache analogue; docs/design/informer.md).
+
+The reference controller is level-triggered but informer-backed: its
+manager keeps a watch-fed cache per kind, so steady-state reconciles never
+LIST the apiserver. Our tick loop got to O(kinds) LISTs per tick
+(``SnapshotKubeClient``), but still *paid* those LISTs every tick even when
+the fleet was quiet. :class:`InformerKubeClient` removes the per-tick LIST
+entirely:
+
+- each covered kind is LISTed ONCE at start, then ADDED/MODIFIED/DELETED
+  watch events keep the store fresh (FakeCluster dispatches synchronously;
+  ``RestKubeClient`` feeds the same handlers from its list+watch streams
+  with 410 re-list and synthetic-event gap recovery);
+- ``list()`` of a covered kind is served from the store with zero API
+  requests — the tick snapshot's "one LIST per kind" becomes an in-memory
+  read;
+- ``get()`` always delegates to the live client (targeted GETs are the
+  conflict-refetch path's freshness anchor and must never be served stale)
+  and WRITES THROUGH: the fresh object updates the store;
+- our own mutations write through immediately (the returned object upserts
+  the store), so read-your-writes holds even before the echo watch event
+  arrives over a real stream;
+- a periodic resync re-LISTs a kind when no list has run for
+  ``resync_seconds`` — the backstop bounding staleness from any dropped
+  event the transport failed to surface.
+
+Staleness/fallback ladder (weakest to strongest):
+
+1. watch events (zero cost, immediate);
+2. own-write write-through + live-GET write-through (per mutation/GET);
+3. the watch transport's own recovery — ``RestKubeClient`` re-lists on
+   410 Gone / stream errors and synthesizes ADDED/DELETED events for the
+   gap; the fake apiserver closes overflowed streams with a 410 gap
+   marker so that path actually fires;
+4. periodic full resync LIST (``resync_seconds``);
+5. informer disabled: every tick LISTs, exactly the pre-informer shape.
+
+Thread-safe. Deep copies on the way in and out, preserving the KubeClient
+contract that callers cannot mutate the store.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Any, Callable
+
+from wva_tpu.k8s.client import (
+    ADDED,
+    DELETED,
+    KubeClient,
+    NotFoundError,
+    _kind_of,
+)
+from wva_tpu.k8s.objects import labels_match
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+# Kinds the control plane reads per tick. Pod rides along for the dirty-set
+# fingerprint (pod churn must dirty its model without a per-tick Pod LIST).
+DEFAULT_INFORMER_KINDS = (
+    "VariantAutoscaling", "Deployment", "LeaderWorkerSet", "Pod")
+
+# Re-LIST a kind when no list has run for this long — the backstop bounding
+# staleness from dropped events the transport never surfaced. Same design
+# point as controller-runtime's resync, tightened because our decisions act
+# on replica counts. The engine drives this from its tick (no timer thread).
+DEFAULT_RESYNC_SECONDS = 600.0
+
+# (kind, event, obj) -> None; registered via add_nudge_listener and invoked
+# on MATERIAL changes only (see _material_change).
+NudgeListener = Callable[[str, str, Any], None]
+
+# Kinds whose targeted GETs are ALSO served from the store (store hit;
+# misses fall through live). VariantAutoscaling is deliberately excluded:
+# VA GETs anchor resourceVersion-guarded status writes (conflict-refetch),
+# and serving those from a store that can lag a real watch stream by
+# milliseconds would turn every recovered 409 into another 409.
+GET_FROM_STORE_KINDS = frozenset({"Pod", "Deployment", "LeaderWorkerSet"})
+
+
+class InformerKubeClient(KubeClient):
+    """Watch-backed read-through cache wrapping a live ``KubeClient``."""
+
+    # SnapshotKubeClient/engine key on this to know per-tick LISTs are free
+    # (and that the small-fleet targeted-GET economy no longer applies).
+    lists_are_local = True
+
+    def __init__(self, client: KubeClient,
+                 kinds: tuple[str, ...] = DEFAULT_INFORMER_KINDS,
+                 namespace: str | None = None,
+                 clock: Clock | None = None,
+                 resync_seconds: float = DEFAULT_RESYNC_SECONDS) -> None:
+        self.client = client
+        self.kinds = tuple(kinds)
+        # Namespace scope of the informer LISTs (None = cluster-wide) — the
+        # controller's watch namespace. Out-of-scope reads delegate.
+        self.namespace = namespace or None
+        self.clock = clock or SYSTEM_CLOCK
+        self.resync_seconds = resync_seconds
+        self._mu = threading.Lock()
+        self._store: dict[str, dict[tuple[str, str], Any]] = {}
+        self._synced: set[str] = set()
+        self._last_list: dict[str, float] = {}
+        self._last_event: dict[str, float] = {}
+        # Kinds whose (re)LIST is in flight buffer their events instead of
+        # applying them: a wholesale store replacement must not overwrite
+        # state that changed while the LIST response was on the wire, and
+        # pre-sync events (watch registers BEFORE the initial list) must
+        # not be lost. Buffered events replay on top of the fresh list
+        # (last-writer-wins; level-triggered consumers tolerate the
+        # at-least-once ordering).
+        self._buffering: set[str] = set()
+        self._buffer: dict[str, list[tuple[str, Any]]] = {}
+        self._nudge_listeners: list[NudgeListener] = []
+        self._started = False
+
+    # --- lifecycle ---
+
+    def start(self) -> "InformerKubeClient":
+        """Register watch handlers FIRST, then seed each kind with one LIST
+        (watch-first ordering closes the created-mid-setup window; upserts
+        are idempotent so double delivery is harmless)."""
+        if self._started:
+            return self
+        self._started = True
+        for kind in self.kinds:
+            with self._mu:
+                self._buffering.add(kind)
+                self._buffer[kind] = []
+            self.client.watch(kind, self._handler_for(kind))
+            self._list_kind(kind)
+        return self
+
+    def _handler_for(self, kind: str):
+        def on_event(event: str, obj: Any) -> None:
+            self._on_event(kind, event, obj)
+        return on_event
+
+    def _list_kind(self, kind: str) -> None:
+        listed = self.client.list(kind, namespace=self.namespace)
+        now = self.clock.now()
+        with self._mu:
+            store = {
+                (o.metadata.namespace or "", o.metadata.name): o
+                for o in listed}
+            # Replay events buffered while the LIST was in flight on top
+            # of the fresh snapshot — dropping them would leave the store
+            # stale until the NEXT resync for anything that changed
+            # mid-list.
+            for event, obj in self._buffer.pop(kind, []):
+                key = (obj.metadata.namespace or "", obj.metadata.name)
+                if event == DELETED:
+                    store.pop(key, None)
+                else:
+                    store[key] = obj
+            self._buffering.discard(kind)
+            self._store[kind] = store
+            self._synced.add(kind)
+            self._last_list[kind] = now
+
+    def resync_if_stale(self) -> list[str]:
+        """Re-LIST kinds whose last list is older than ``resync_seconds``;
+        returns the kinds refreshed. Driven from the engine tick so a
+        simulated clock advances it deterministically (no timer thread)."""
+        if not self._started or self.resync_seconds <= 0:
+            return []
+        now = self.clock.now()
+        stale = [k for k in self.kinds
+                 if now - self._last_list.get(k, 0.0) > self.resync_seconds]
+        for kind in stale:
+            with self._mu:
+                self._buffering.add(kind)
+                self._buffer.setdefault(kind, [])
+            self._list_kind(kind)
+        return stale
+
+    # --- event ingestion ---
+
+    def _on_event(self, kind: str, event: str, obj: Any) -> None:
+        ns = obj.metadata.namespace or ""
+        if self.namespace is not None and ns != self.namespace:
+            return
+        key = (ns, obj.metadata.name)
+        with self._mu:
+            if kind in self._buffering:
+                # A (re)LIST is in flight: hold the event for replay on
+                # top of the fresh snapshot (no nudge — the list itself is
+                # the freshness signal, and at startup no listeners exist
+                # yet).
+                self._buffer.setdefault(kind, []).append(
+                    (event, copy.deepcopy(obj)))
+                self._last_event[kind] = self.clock.now()
+                return
+            if kind not in self._synced:
+                return  # not started for this kind
+            store = self._store.setdefault(kind, {})
+            prev = store.get(key)
+            if event == DELETED:
+                store.pop(key, None)
+            else:
+                # Deep copy: FakeCluster hands each handler its own copy,
+                # but RestKubeClient shares one object across handlers AND
+                # its re-list diff base.
+                store[key] = copy.deepcopy(obj)
+            self._last_event[kind] = self.clock.now()
+            listeners = list(self._nudge_listeners)
+        if listeners and _material_change(kind, event, prev, obj):
+            for fn in listeners:
+                try:
+                    fn(kind, event, obj)
+                except Exception:  # noqa: BLE001 — listener isolation
+                    log.exception("informer nudge listener failed for "
+                                  "%s %s", event, kind)
+
+    def _upsert(self, obj: Any) -> None:
+        kind = _kind_of(obj)
+        if kind not in self.kinds:
+            return
+        ns = obj.metadata.namespace or ""
+        if self.namespace is not None and ns != self.namespace:
+            return
+        with self._mu:
+            if kind in self._synced:
+                self._store.setdefault(kind, {})[
+                    (ns, obj.metadata.name)] = copy.deepcopy(obj)
+
+    def _discard(self, kind: str, namespace: str, name: str) -> None:
+        with self._mu:
+            store = self._store.get(kind)
+            if store is not None:
+                store.pop((namespace or "", name), None)
+
+    # --- nudges (event-driven wake-ups) ---
+
+    def add_nudge_listener(self, fn: NudgeListener) -> None:
+        """Invoke ``fn(kind, event, obj)`` on MATERIAL watch changes
+        (spec-level edits, scale/readiness moves, creates/deletes) — the
+        engines' executors hook their ``trigger()`` here so a wake no
+        longer waits out the poll interval. Status-only writes (the
+        engine's own heartbeats) never nudge: generation does not move."""
+        with self._mu:
+            self._nudge_listeners.append(fn)
+
+    # --- KubeClient read surface ---
+
+    def _covers(self, kind: str, namespace: str | None) -> bool:
+        if kind not in self.kinds:
+            return False
+        with self._mu:
+            if kind not in self._synced:
+                return False
+        return self.namespace is None or namespace == self.namespace
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        """Store-served for scale-target/pod kinds (the scale-from-zero
+        loop GETs every VA's target each 100ms poll — those reads are what
+        the informer exists to absorb); LIVE for everything else, notably
+        VariantAutoscaling, whose GETs anchor rv-guarded status writes.
+        Live results write through to the store."""
+        if kind in GET_FROM_STORE_KINDS and self._covers(kind, namespace):
+            with self._mu:
+                obj = self._store.get(kind, {}).get((namespace or "", name))
+            if obj is not None:
+                return copy.deepcopy(obj)
+            # Store miss falls through live: a just-created object's watch
+            # event may still be in flight on a real stream.
+        try:
+            obj = self.client.get(kind, namespace, name)
+        except NotFoundError:
+            if kind in self.kinds:
+                self._discard(kind, namespace, name)
+            raise
+        self._upsert(obj)
+        return obj
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Any | None:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None) -> list[Any]:
+        # A cluster-wide list from a namespace-scoped informer (or any
+        # out-of-scope/unsynced kind) must delegate: the store only holds
+        # the watch namespace.
+        if not self._covers(kind, namespace):
+            return self.client.list(kind, namespace=namespace,
+                                    label_selector=label_selector)
+        with self._mu:
+            items = sorted(self._store.get(kind, {}).items())
+        out = []
+        for (ns, _), obj in items:
+            if namespace is not None and ns != (namespace or ""):
+                continue
+            if not labels_match(label_selector, obj.metadata.labels):
+                continue
+            out.append(copy.deepcopy(obj))
+        return out
+
+    def raw_snapshot(self, kind: str,
+                     namespace: str | None = None
+                     ) -> dict[tuple[str, str], Any] | None:
+        """Zero-copy view of a covered kind's store: a shallow dict copy
+        whose VALUES are the live store objects. For callers that layer
+        their own copy-on-read isolation (``SnapshotKubeClient`` deep-
+        copies every read out of its tick cache) — the per-object deepcopy
+        ``list()`` pays would be pure waste there. Callers must NEVER
+        mutate the returned objects. None when the kind/scope is not
+        covered (caller falls back to ``list()``)."""
+        if not self._covers(kind, namespace):
+            return None
+        with self._mu:
+            store = self._store.get(kind, {})
+            if namespace is None:
+                return dict(store)
+            ns = namespace or ""
+            return {key: obj for key, obj in store.items() if key[0] == ns}
+
+    # --- KubeClient write surface (delegate + write through) ---
+
+    def create(self, obj: Any) -> Any:
+        created = self.client.create(obj)
+        self._upsert(created)
+        return created
+
+    def update(self, obj: Any) -> Any:
+        updated = self.client.update(obj)
+        self._upsert(updated)
+        return updated
+
+    def update_status(self, obj: Any) -> Any:
+        updated = self.client.update_status(obj)
+        self._upsert(updated)
+        return updated
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self.client.delete(kind, namespace, name)
+        if kind in self.kinds:
+            self._discard(kind, namespace, name)
+
+    def patch_scale(self, kind: str, namespace: str, name: str,
+                    replicas: int) -> None:
+        # No object comes back from a scale patch. FakeCluster's
+        # synchronous MODIFIED dispatch updates the store during the call;
+        # over REST the echo event lands within stream latency. EVICT the
+        # entry after delegating so a read-your-write GET in that window
+        # (the tick snapshot's follow-up, the 100ms scale-from-zero poll)
+        # misses the store and falls through LIVE instead of being served
+        # the pre-patch replica count — the live result writes back
+        # through get(). (On FakeCluster the eviction is immediately
+        # repaired by the next read; the synchronous event fired before
+        # the evict, so nothing fresh is lost either way.)
+        self.client.patch_scale(kind, namespace, name, replicas)
+        if kind in self.kinds:
+            self._discard(kind, namespace, name)
+
+    def watch(self, kind: str, handler) -> None:
+        self.client.watch(kind, handler)
+
+    # --- observability ---
+
+    def stats(self) -> dict[str, dict[str, float]]:
+        """Per-kind freshness for the ``wva_informer_*`` gauges:
+        ``{kind: {age_seconds, objects, synced}}`` where ``age_seconds``
+        is time since the last event OR list, whichever is newer."""
+        now = self.clock.now()
+        out: dict[str, dict[str, float]] = {}
+        with self._mu:
+            for kind in self.kinds:
+                freshest = max(self._last_list.get(kind, 0.0),
+                               self._last_event.get(kind, 0.0))
+                out[kind] = {
+                    "age_seconds": (now - freshest) if freshest else -1.0,
+                    "objects": float(len(self._store.get(kind, {}))),
+                    "synced": 1.0 if kind in self._synced else 0.0,
+                }
+        return out
+
+
+def _material_change(kind: str, event: str, prev: Any, obj: Any) -> bool:
+    """Is this event worth an immediate engine wake? Creates/deletes and
+    spec-level edits are; the engine's own status writes are not (status
+    subresource PUTs never move ``metadata.generation``), which is what
+    keeps the nudge loop from re-triggering itself off its own writes."""
+    if event in (ADDED, DELETED) or prev is None:
+        return True
+    if obj.metadata.generation != prev.metadata.generation:
+        return True
+    if kind == "Pod":
+        ps, pp = getattr(obj, "status", None), getattr(prev, "status", None)
+        if ps is not None and pp is not None:
+            return (ps.phase, ps.ready, ps.pod_ip) != \
+                (pp.phase, pp.ready, pp.pod_ip)
+        return False
+    if kind in ("Deployment", "LeaderWorkerSet"):
+        def shape(o):
+            st = getattr(o, "status", None)
+            return (getattr(o, "replicas", None),
+                    getattr(st, "replicas", None),
+                    getattr(st, "ready_replicas", None))
+        return shape(obj) != shape(prev)
+    return False

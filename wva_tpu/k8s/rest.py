@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import socket
 import threading
 import time
@@ -51,6 +52,23 @@ WATCH_SERVER_TIMEOUT = 300  # server closes the stream; we reconnect
 WATCH_SOCKET_TIMEOUT = 330.0
 WATCH_BACKOFF_INITIAL = 1.0
 WATCH_BACKOFF_MAX = 30.0
+# A stream must have lived at least this long before its clean end resets
+# the reconnect backoff: an apiserver accepting connections and instantly
+# closing them cleanly (crash-looping behind a load balancer) must not be
+# hammered at the initial rate forever.
+WATCH_MIN_HEALTHY_STREAM_SECONDS = 1.0
+
+# Dedicated RNG for reconnect jitter (tests can seed/patch it without
+# touching the global random state).
+_jitter_rng = random.Random()
+
+
+def _jittered(delay: float) -> float:
+    """Full jitter over [delay/2, delay]: when an apiserver restart drops
+    every kind's watch stream at once, the reconnect (and re-list) herd
+    must not land in the same instant — client-go's watch backoff jitters
+    for the same reason."""
+    return delay * (0.5 + 0.5 * _jitter_rng.random())
 
 
 class ApiError(RuntimeError):
@@ -301,23 +319,35 @@ class RestKubeClient(KubeClient):
                     rv = self._list_for_watch(kind, namespace,
                                               synthesize=not first_list)
                     first_list = False
+                stream_started = time.monotonic()
                 rv = self._stream_watch(kind, namespace, rv)
-                backoff = WATCH_BACKOFF_INITIAL
+                # Reset backoff only after a HEALTHY stream: one that ended
+                # CLEANLY (the server's `0\r\n\r\n` chunked terminator —
+                # premature closes raise and fall through to the handlers
+                # below) after actually living for a while. An
+                # instant-clean-close loop keeps growing backoff.
+                if (time.monotonic() - stream_started
+                        >= WATCH_MIN_HEALTHY_STREAM_SECONDS):
+                    backoff = WATCH_BACKOFF_INITIAL
+                else:
+                    self._stop.wait(_jittered(backoff))
+                    backoff = min(backoff * 2, WATCH_BACKOFF_MAX)
             except ApiError as e:
                 if e.status == 410:  # Gone: resourceVersion too old
                     rv = ""
                     continue
                 log.warning("watch %s failed (%s); retrying in %.0fs",
                             kind, e, backoff)
-                self._stop.wait(backoff)
+                self._stop.wait(_jittered(backoff))
                 backoff = min(backoff * 2, WATCH_BACKOFF_MAX)
             except (OSError, socket.timeout, json.JSONDecodeError) as e:
-                # Normal stream end / server outage: reconnect with the same
-                # growing backoff as API errors (a down server must not be
-                # hammered at a constant rate).
+                # Unclean stream end / server outage: reconnect with
+                # jittered growing backoff — an apiserver restart drops
+                # every client's streams at once, and the reconnect herd
+                # must spread out (thundering herd).
                 log.debug("watch %s stream ended (%s); reconnecting in %.0fs",
                           kind, e, backoff)
-                self._stop.wait(backoff)
+                self._stop.wait(_jittered(backoff))
                 backoff = min(backoff * 2, WATCH_BACKOFF_MAX)
             except Exception:  # noqa: BLE001 — one bad event (e.g. a decode
                 # error from a malformed object another client wrote) must
@@ -325,7 +355,7 @@ class RestKubeClient(KubeClient):
                 log.exception("watch %s hit an unexpected error; re-listing "
                               "in %.0fs", kind, backoff)
                 rv = ""
-                self._stop.wait(backoff)
+                self._stop.wait(_jittered(backoff))
                 backoff = min(backoff * 2, WATCH_BACKOFF_MAX)
 
     def _list_for_watch(self, kind: str, namespace: str,

@@ -115,11 +115,18 @@ class SnapshotKubeClient(KubeClient):
             with self._mu:
                 if kind in self._complete:
                     return self._cache[kind]  # raced: another worker LISTed
-            listed = self.client.list(kind, namespace=self.namespace)
-            cached = {
-                (o.metadata.namespace or "", o.metadata.name): o
-                for o in listed
-            }
+            # Informer-backed client: take its store view zero-copy — this
+            # cache only hands objects out via deepcopy (and write-through
+            # REPLACES entries, never mutates them in place), so the
+            # per-object copy list() would pay is redundant here.
+            raw = getattr(self.client, "raw_snapshot", None)
+            cached = raw(kind, self.namespace) if raw is not None else None
+            if cached is None:
+                listed = self.client.list(kind, namespace=self.namespace)
+                cached = {
+                    (o.metadata.namespace or "", o.metadata.name): o
+                    for o in listed
+                }
             with self._mu:
                 self._cache[kind] = cached
                 self._complete.add(kind)
@@ -254,6 +261,12 @@ class SnapshotKubeClient(KubeClient):
         self.client.watch(kind, handler)
 
     # --- observability ---
+
+    def covers_kind(self, kind: str) -> bool:
+        """Whether reads of ``kind`` are snapshot-served (the engine's
+        fingerprint only hashes a pod set when the tick can read Pods for
+        free — i.e. the snapshot covers them, informer-backed)."""
+        return kind in self._kinds
 
     def kinds_listed(self) -> list[str]:
         """Kinds whose full snapshot LIST has run (for tests/metrics);

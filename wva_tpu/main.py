@@ -51,6 +51,7 @@ from wva_tpu.engines.scalefromzero import ScaleFromZeroEngine
 from wva_tpu.indexers import Indexer
 from wva_tpu.k8s.client import KubeClient
 from wva_tpu.k8s.events import EventRecorder
+from wva_tpu.k8s.informer import InformerKubeClient
 from wva_tpu.leaderelection import LeaderElector, LeaderElectorConfig
 from wva_tpu.metrics import MetricsRegistry
 from wva_tpu.pipeline import (
@@ -113,6 +114,20 @@ class Manager:
 
     def start(self, stop: threading.Event) -> None:
         """Wall-clock mode: engines + trigger loop in daemon threads."""
+        # Event-driven wake-ups (wall-clock mode ONLY — simulation drivers
+        # using run_once stay tick-deterministic): material watch events on
+        # VAs/Deployments/Pods end the engines' inter-tick waits
+        # immediately, so a spec edit or a scale-from-zero-relevant change
+        # is acted on in watch latency instead of up to a full poll
+        # interval. Triggers are idempotent; event bursts collapse into one
+        # immediate tick.
+        if hasattr(self.client, "add_nudge_listener"):
+            def _nudge(kind: str, event: str, obj) -> None:
+                self.engine.executor.trigger()
+                if kind in ("VariantAutoscaling", "Deployment",
+                            "LeaderWorkerSet"):
+                    self.scale_from_zero.executor.trigger()
+            self.client.add_nudge_listener(_nudge)
         # Background cache warmer (fetch_interval > 0): keeps the
         # Prometheus result cache hot between engine ticks.
         prom = self.source_registry.get(PROMETHEUS_SOURCE_NAME)
@@ -221,6 +236,18 @@ def build_manager(
     """
     clock = clock or SYSTEM_CLOCK
 
+    # Watch-backed informer cache (WVA_INFORMER, default on;
+    # docs/design/informer.md): every per-kind LIST the control plane makes
+    # per tick is served from a watch-fed store instead — steady-state
+    # ticks issue ZERO list requests against the apiserver. Everything
+    # below (engines, reconcilers, indexer) reads through the same wrapped
+    # client; targeted GETs and all writes still hit the live client (and
+    # write through to the store).
+    if config.informer_enabled():
+        client = InformerKubeClient(
+            client, namespace=config.watch_namespace() or None,
+            clock=clock).start()
+
     registry = MetricsRegistry(
         controller_instance=get_controller_instance(),
         # Mirror wva_* gauges into the TSDB so the emulated HPA loop can
@@ -323,6 +350,8 @@ def build_manager(
         analysis_workers=workers,
         forecast_planner=forecast_planner)
     engine.grouped_collection = config.grouped_collection_enabled()
+    engine.incremental_enabled = config.incremental_enabled()
+    engine.resync_ticks = config.resync_ticks()
     if flight is not None:
         engine.optimizer.flight_recorder = flight
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,

@@ -35,7 +35,11 @@ from wva_tpu.constants import (
     WVA_FORECAST_DEMOTED,
     WVA_FORECAST_ERROR,
     WVA_FORECAST_LEAD_TIME_SECONDS,
+    WVA_INFORMER_AGE_SECONDS,
+    WVA_INFORMER_SYNCED,
     WVA_REPLICA_SCALING_TOTAL,
+    WVA_TICK_MODELS_ANALYZED,
+    WVA_TICK_MODELS_SKIPPED,
     WVA_TRACE_DROPPED_TOTAL,
     WVA_TRACE_RECORDS_TOTAL,
     WVA_TRACE_WRITE_SECONDS,
@@ -98,6 +102,16 @@ class MetricsRegistry:
         self._register(WVA_TREND_SERIES_STALENESS_SECONDS, "gauge",
                        "Age of the newest DemandTrend sample per model "
                        "series")
+        self._register(WVA_INFORMER_AGE_SECONDS, "gauge",
+                       "Seconds since the informer's per-kind store was "
+                       "last confirmed fresh (watch event or list)")
+        self._register(WVA_INFORMER_SYNCED, "gauge",
+                       "1 when the kind's initial informer LIST completed")
+        self._register(WVA_TICK_MODELS_ANALYZED, "gauge",
+                       "Models analyzed (dirty or resync) last engine tick")
+        self._register(WVA_TICK_MODELS_SKIPPED, "gauge",
+                       "Models skipped by an unchanged input fingerprint "
+                       "last engine tick (prior decision re-emitted)")
 
     def _register(self, name: str, kind: str, help_text: str) -> None:
         self._series[name] = _Series(name, kind, help_text)
