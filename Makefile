@@ -57,9 +57,10 @@ test-replay: ## Fast decision-trace record/replay test lane (pytest -m replay).
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_blackbox.py -q -m replay
 
 .PHONY: replay-golden
-replay-golden: ## Replay the committed golden decision trace (must be zero diffs).
+replay-golden: ## Replay the committed golden decision traces (must be zero diffs).
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/decision_trace_v1.jsonl
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/forecast_trace_v1.jsonl
+	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/capacity_trace_v1.jsonl
 
 .PHONY: backtest-golden
 backtest-golden: ## Backtest every forecaster on the committed golden forecast trace and gate against the committed report (MAPE + under/over-provision cost; a seasonal forecaster must keep beating the linear baseline).
@@ -70,6 +71,10 @@ backtest-golden: ## Backtest every forecaster on the committed golden forecast t
 .PHONY: bench-forecast
 bench-forecast: ## Forecast-plane microbench (48 models): batched vs serial forecaster fit time per tick; merges detail.forecast into BENCH_LOCAL.json.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --forecast-only
+
+.PHONY: bench-capacity
+bench-capacity: ## Elastic-capacity microbench (48 models, seeded preemption storm): ticks-to-reconverge per preemption + decisions/tick churn; merges detail.capacity into BENCH_LOCAL.json.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --capacity-only
 
 .PHONY: verify-deploy-pipeline
 verify-deploy-pipeline: ## Static-check the deploy pipeline (scripts parse, manifests render, Dockerfile paths exist).

@@ -1569,6 +1569,161 @@ def forecast_main() -> None:
     }))
 
 
+def capacity_storm_bench(n_models: int = 48, duration: float = 600.0,
+                         engine_interval: float = 15.0) -> dict:
+    """Elastic-capacity microbench (``make bench-capacity``): a 48-model
+    fleet on a mixed on-demand + spot pool under a seeded preemption storm
+    (bursty demand with correlated spot preemptions), FakeGkeProvisioner
+    ordering replacements. Reports, per preemption event, the engine ticks
+    until the fleet's total desired replicas re-converges to its
+    pre-preemption level (time-to-reconverge), plus decisions/tick churn
+    (variants whose desired target moved per tick) — the stability axis a
+    capacity-plane regression shows up on first."""
+    import statistics
+
+    from wva_tpu.capacity.tiers import GKE_SPOT_NODE_LABEL
+    from wva_tpu.config import new_test_config
+    from wva_tpu.constants import WVA_DESIRED_REPLICAS
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        FakeGkeProvisioner,
+        HPAParams,
+        ServingParams,
+        TierPolicy,
+        VariantSpec,
+        add_tpu_nodepool,
+        preemption_storm,
+    )
+    from wva_tpu.engines import common as engines_common
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    profile, events = preemption_storm(
+        base_rate=2.0, burst_rate=14.0, burst_duration=90.0,
+        mean_gap=150.0, horizon=duration, seed=11,
+        preemptions_per_burst=4, preemption_lag=20.0)
+    specs = [VariantSpec(
+        name=f"m{i:03d}-v5e", model_id=f"bench/model-{i:03d}",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=1, serving=ServingParams(engine="jetstream"),
+        load=profile,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=60.0,
+                      sync_period_seconds=10.0))
+        for i in range(n_models)]
+    harness = EmulationHarness(
+        specs,
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=new_test_config(),
+        nodepools=[("od-pool", "v5e", "2x4", n_models)],
+        startup_seconds=30.0, engine_interval=engine_interval,
+        stochastic_seed=20260804,
+        provisioner=lambda cluster, clock: FakeGkeProvisioner(
+            cluster, clock,
+            tiers={"on_demand": TierPolicy(provision_delay_seconds=120.0),
+                   "spot": TierPolicy(provision_delay_seconds=60.0,
+                                      preemptible=True)},
+            seed=3))
+    add_tpu_nodepool(harness.cluster, "spot-pool", "v5e", "2x4",
+                     n_models // 2,
+                     extra_labels={GKE_SPOT_NODE_LABEL: "true"})
+    harness.provisioner.schedule_preemptions(
+        [(harness.start_time + t, k) for t, k in events])
+
+    registry = harness.manager.registry
+    names = [s.name for s in specs]
+
+    def fleet_desired() -> dict[str, int]:
+        out = {}
+        for name in names:
+            v = registry.get(WVA_DESIRED_REPLICAS, {
+                "variant_name": name, "namespace": harness.namespace,
+                "accelerator_type": "v5e-8"})
+            out[name] = int(v or 0)
+        return out
+
+    churn: list[int] = []
+    tick_walls: list[float] = []
+    last = {"desired": {}, "total": 0}
+    pending: dict[float, dict] = {}  # event t -> {"before", "ticks"}
+    reconverge_ticks: dict[float, int] = {}
+    orig = harness.manager.engine.optimize
+
+    def tick_wrapper():
+        t0 = time.perf_counter()
+        orig()
+        tick_walls.append(time.perf_counter() - t0)
+        desired = fleet_desired()
+        total = sum(desired.values())
+        churn.append(sum(1 for n in names
+                         if desired[n] != last["desired"].get(n, 0)))
+        for et, st in list(pending.items()):
+            st["ticks"] += 1
+            if total >= st["before"]:
+                reconverge_ticks[et] = st["ticks"]
+                del pending[et]
+        last["desired"] = desired
+        last["total"] = total
+
+    def on_step(h, t):
+        now = h.clock.now()
+        for et, _ in events:
+            at = h.start_time + et
+            if now < at <= now + 1.0 and et not in pending \
+                    and et not in reconverge_ticks:
+                pending[et] = {"before": last["total"], "ticks": 0}
+
+    harness.manager.engine.executor.task = tick_wrapper
+    harness.run(duration, on_step=on_step)
+    harness.manager.shutdown()
+    engines_common.DecisionCache.clear()
+    while not engines_common.DecisionTrigger.empty():
+        engines_common.DecisionTrigger.get_nowait()
+
+    capman = harness.manager.engine.capacity
+    ticks_list = sorted(reconverge_ticks.values())
+    outcomes: dict[str, int] = {}
+    for _, _, _, _, outcome in capman.request_log:
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+    return {
+        "n_models": n_models,
+        "duration_s": duration,
+        "engine_interval_s": engine_interval,
+        "preemption_events": len(events),
+        "preempted_slices":
+            harness.provisioner.preempted_slices_total,
+        "reconverge_ticks_per_event": ticks_list,
+        "reconverge_ticks_p50": (statistics.median(ticks_list)
+                                 if ticks_list else None),
+        "reconverge_ticks_max": max(ticks_list) if ticks_list else None,
+        "reconverge_unresolved": len(pending),
+        "decision_churn_per_tick_mean": round(
+            sum(churn) / max(len(churn), 1), 2),
+        "decision_churn_per_tick_max": max(churn) if churn else 0,
+        "tick_p50_ms": round(
+            statistics.median(tick_walls) * 1000.0, 2) if tick_walls else 0,
+        "provision_request_outcomes": dict(sorted(outcomes.items())),
+    }
+
+
+def capacity_main() -> None:
+    """`make bench-capacity` / `bench.py --capacity-only`: preemption-storm
+    reconvergence + decision churn at 48 models, merged into
+    BENCH_LOCAL.json detail.capacity, one JSON line on stdout."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    record = capacity_storm_bench()
+    record["bench_wall_seconds"] = round(time.time() - t0, 1)
+    _merge_bench_local("capacity", record)
+    print(json.dumps({
+        "metric": "preemption_reconverge_ticks_48_models",
+        "value": record["reconverge_ticks_p50"],
+        "unit": "engine_ticks_p50_to_reconverge",
+        "vs_baseline": record["reconverge_ticks_max"],
+        "detail": record,
+    }))
+
+
 def main() -> None:
     t0 = time.time()
     device_probe = _ensure_healthy_device()
@@ -1690,5 +1845,7 @@ if __name__ == "__main__":
         collect_main()
     elif "--forecast-only" in sys.argv:
         forecast_main()
+    elif "--capacity-only" in sys.argv:
+        capacity_main()
     else:
         main()

@@ -1,0 +1,96 @@
+#!/usr/bin/env python3
+"""Regenerate the committed golden capacity trace
+(``tests/goldens/capacity_trace_v1.jsonl``).
+
+Run from the repo root (CPU platform, like the test suite):
+
+    JAX_PLATFORMS=cpu python tests/goldens/make_capacity_trace.py
+
+The scenario is a PREEMPTION STORM (ISSUE 7): one Llama variant on v5e-8
+over a mixed pool (2 on-demand + 4 spot slices), bursty demand whose
+seeded bursts each trigger a correlated spot preemption 20s in, and a
+FakeGkeProvisioner ordering replacements with measured delays. The
+committed trace anchors the ``make replay-golden`` gate for the capacity
+plane: every cycle carries a ``capacity`` stage (ledger snapshot +
+provisioning requests), decisions must replay to ZERO diffs from the
+recorded limiter pools alone (capacity influences decisions only through
+those pools), and the trace must contain preemptions and provisioning
+requests (tests/test_capacity.py).
+
+Regenerate only on a deliberate, reviewed change to the capacity plane
+or the trace schema — and say so in the commit message.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TRACE = os.path.join(HERE, "capacity_trace_v1.jsonl")
+SEED = 20260804
+
+
+def main() -> None:
+    from wva_tpu.capacity.tiers import GKE_SPOT_NODE_LABEL
+    from wva_tpu.config import TraceConfig, new_test_config
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        FakeGkeProvisioner,
+        HPAParams,
+        ServingParams,
+        TierPolicy,
+        VariantSpec,
+        add_tpu_nodepool,
+        preemption_storm,
+    )
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    if os.path.exists(TRACE):
+        os.remove(TRACE)  # the recorder appends; regeneration replaces
+
+    profile, events = preemption_storm(
+        base_rate=4.0, burst_rate=30.0, burst_duration=120.0,
+        mean_gap=200.0, horizon=900.0, seed=11,
+        preemptions_per_burst=1, preemption_lag=20.0)
+    cfg = new_test_config()
+    cfg.set_trace(TraceConfig(enabled=True, path=TRACE))
+    spec = VariantSpec(
+        name="llama-v5e", model_id="meta-llama/Llama-3.1-8B",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=2, serving=ServingParams(engine="jetstream"),
+        load=profile,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=60.0,
+                      sync_period_seconds=10.0))
+    harness = EmulationHarness(
+        [spec],
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=cfg, nodepools=[("od-pool", "v5e", "2x4", 2)],
+        startup_seconds=30.0, engine_interval=15.0,
+        stochastic_seed=SEED,
+        provisioner=lambda cluster, clock: FakeGkeProvisioner(
+            cluster, clock,
+            tiers={"on_demand": TierPolicy(provision_delay_seconds=120.0),
+                   "spot": TierPolicy(provision_delay_seconds=60.0,
+                                      preemptible=True)},
+            seed=3))
+    add_tpu_nodepool(harness.cluster, "spot-pool", "v5e", "2x4", 4,
+                     extra_labels={GKE_SPOT_NODE_LABEL: "true"})
+    harness.provisioner.schedule_preemptions(
+        [(harness.start_time + t, k) for t, k in events])
+    harness.run(900)
+    preempted = harness.provisioner.preempted_slices_total
+    accepted = [r for r in harness.manager.engine.capacity.request_log
+                if r[4] == "accepted"]
+    print(f"wrote {TRACE}: "
+          f"{harness.flight_recorder.records_total} cycle records, "
+          f"{preempted} preempted slices, "
+          f"{len(accepted)} provisioning orders")
+    assert preempted >= 2 and accepted, "storm did not exercise capacity"
+
+
+if __name__ == "__main__":
+    main()

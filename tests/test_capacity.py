@@ -1,0 +1,1054 @@
+"""Elastic capacity plane (docs/design/capacity.md):
+
+1. **Tiers** — node-label classification, weight/preference parsing.
+2. **Ledger** — discovery reconciliation retires in-flight orders FIFO
+   with measured latency; node-loss events release slices the same tick;
+   quota stockouts pin with a geometrically-decayed re-probe; credit
+   windows expire wedged orders.
+3. **Lead-time phase split** — actuation->scheduled provisioning samples
+   per (variant, tier) with per-tier fallbacks mirroring the accelerator
+   ladder; episodes that never reach scheduled (stockout) expire without
+   polluting the p90.
+4. **Manager** — shortfall -> request with dedup, tier-preference walk,
+   circuit breaker (zero repeat requests until re-probe), jittered
+   backoff on transport errors.
+5. **FakeGkeProvisioner** — delay materialization, quota denial, seeded
+   preemption of whole slices; kubelet node-loss handling.
+6. **Watch surface** — Node create/delete/status through the fake
+   apiserver watch stream with the 410 slow-consumer close.
+7. **Engine integration** — WVA_CAPACITY=off byte-identity; on-mode
+   STAGE_CAPACITY trace events + wva_capacity_* gauges; the
+   preemption-storm e2e (same-tick release, reconvergence within 3
+   ticks, stockout silence); the capacity golden replays at zero diffs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+from wva_tpu.blackbox.schema import STAGE_CAPACITY, encode
+from wva_tpu.capacity import (
+    CapacityLedger,
+    CapacityManager,
+    InFlightRequest,
+    NullProvisioner,
+    ProvisionResult,
+    SliceProvisioner,
+    TIER_ON_DEMAND,
+    TIER_RESERVATION,
+    TIER_SPOT,
+    parse_tier_preference,
+    parse_tier_weights,
+    tier_for_node_labels,
+)
+from wva_tpu.capacity.tiers import GKE_SPOT_NODE_LABEL
+from wva_tpu.config import CapacityConfig, TraceConfig, new_test_config
+from wva_tpu.discovery import TPUSliceDiscovery
+from wva_tpu.emulator import (
+    EmulationHarness,
+    FakeGkeProvisioner,
+    FakeKubelet,
+    HPAParams,
+    ServingParams,
+    TierPolicy,
+    VariantSpec,
+    add_tpu_nodepool,
+    preemption_storm,
+)
+from wva_tpu.forecast.leadtime import (
+    EPISODE_TIMEOUT_SECONDS,
+    LeadTimeEstimator,
+)
+from wva_tpu.interfaces import SaturationScalingConfig
+from wva_tpu.k8s import (
+    Container,
+    Deployment,
+    DeploymentStatus,
+    FakeCluster,
+    Node,
+    Pod,
+    PodStatus,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from wva_tpu.k8s.fake_apiserver import FakeAPIServer
+from wva_tpu.main import build_manager
+from wva_tpu.utils.clock import FakeClock
+
+pytestmark = pytest.mark.capacity
+
+NS = "inference"
+
+
+# --- helpers ---
+
+
+def _mk_provisioner(cluster, clock, **tiers):
+    policies = {
+        "reservation": TierPolicy(provision_delay_seconds=120.0,
+                                  quota_slices=2),
+        "on_demand": TierPolicy(provision_delay_seconds=240.0),
+        "spot": TierPolicy(provision_delay_seconds=60.0, preemptible=True),
+    }
+    policies.update(tiers)
+    return FakeGkeProvisioner(cluster, clock, tiers=policies, seed=7)
+
+
+class _ScriptedProvisioner(SliceProvisioner):
+    """Returns a queue of scripted results; records every call."""
+
+    def __init__(self, results):
+        self.results = list(results)
+        self.calls = []
+
+    def request_slices(self, variant, tier, count, now):
+        self.calls.append((now, variant, tier, count))
+        if self.results:
+            return self.results.pop(0)
+        return ProvisionResult(accepted=False, message="script exhausted")
+
+
+class _Cap:
+    """Minimal SliceCapacity stand-in for ledger feeds."""
+
+    def __init__(self, variant, total_slices, chips_per_slice=8,
+                 hosts_per_slice=1, tier_slices=None):
+        self.variant = variant
+        self.total_slices = total_slices
+        self.chips_per_slice = chips_per_slice
+        self.hosts_per_slice = hosts_per_slice
+        self.tier_slices = dict(tier_slices or {})
+
+
+# --- 1. tiers ---
+
+
+def test_tier_for_node_labels():
+    assert tier_for_node_labels({}) == TIER_ON_DEMAND
+    assert tier_for_node_labels(
+        {GKE_SPOT_NODE_LABEL: "true"}) == TIER_SPOT
+    assert tier_for_node_labels(
+        {"cloud.google.com/gke-preemptible": "true"}) == TIER_SPOT
+    assert tier_for_node_labels(
+        {"cloud.google.com/reservation-name": "r"}) == TIER_RESERVATION
+
+
+def test_parse_tier_weights_and_preference():
+    w = parse_tier_weights("spot=0.25, reservation=0.5")
+    assert w["spot"] == 0.25 and w["reservation"] == 0.5
+    assert w["on_demand"] == 1.0  # default survives
+    with pytest.raises(ValueError):
+        parse_tier_weights("warp_drive=0.1")
+    assert parse_tier_preference("") == (
+        TIER_RESERVATION, TIER_ON_DEMAND, TIER_SPOT)
+    assert parse_tier_preference("spot,on_demand") == (
+        TIER_SPOT, TIER_ON_DEMAND)
+    with pytest.raises(ValueError):
+        parse_tier_preference("reservation,warp_drive")
+
+
+# --- 2. ledger ---
+
+
+def test_ledger_retires_inflight_fifo_with_latency():
+    led = CapacityLedger()
+    led.observe_discovery({"v5e-8": _Cap("v5e-8", 2)}, now=0.0)
+    led.note_request(InFlightRequest(
+        request_id="a", variant="v5e-8", tier="on_demand", slices=2,
+        chips_per_slice=8, requested_at=10.0, eta=110.0))
+    led.note_request(InFlightRequest(
+        request_id="b", variant="v5e-8", tier="spot", slices=1,
+        chips_per_slice=8, requested_at=20.0, eta=120.0))
+    assert led.provisioning_chips("v5e-8", 50.0) == 24
+    # 2 slices materialize: the OLDER request (a) retires fully.
+    done = led.observe_discovery({"v5e-8": _Cap("v5e-8", 4)}, now=100.0)
+    assert [c.request.request_id for c in done] == ["a"]
+    assert done[0].latency == pytest.approx(90.0)
+    assert led.inflight_slices("v5e-8") == 1
+    # The remaining slice lands.
+    done = led.observe_discovery({"v5e-8": _Cap("v5e-8", 5)}, now=130.0)
+    assert [c.request.request_id for c in done] == ["b"]
+    assert not led.has_request("v5e-8")
+
+
+def test_ledger_node_loss_releases_slice_and_dedupes():
+    led = CapacityLedger()
+    led.observe_discovery({"v5e-8": _Cap("v5e-8", 3)}, now=0.0)
+    node = Node(metadata=ObjectMeta(name="n0", labels={
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4",
+        GKE_SPOT_NODE_LABEL: "true",
+    }))
+    node.status.allocatable = {"google.com/tpu": "8"}
+    # NotReady first, then DELETED: ONE slice lost, not two.
+    node.ready = False
+    assert led.on_node_event("MODIFIED", node, 1.0) == "v5e-8"
+    assert led.on_node_event("DELETED", node, 2.0) is None
+    assert led.ready_chips("v5e-8") == 16  # 3 - 1 slices, same tick
+    snap = led.snapshot(2.0)[0]
+    assert snap["ready"] == 2 and snap["preempted"] == 1
+    # Discovery re-confirms: the loss is now baked into ready.
+    led.observe_discovery({"v5e-8": _Cap("v5e-8", 2)}, now=10.0)
+    assert led.ready_chips("v5e-8") == 16
+    assert led.snapshot(10.0)[0]["preempted"] == 0
+
+
+def test_ledger_multi_host_slice_loss_counts_one_slice():
+    """A preempted multi-host slice produces one DELETED event PER HOST;
+    the ledger must count ONE lost slice, not one per host."""
+    led = CapacityLedger()
+    led.observe_discovery({"v5e-16": _Cap(
+        "v5e-16", 2, chips_per_slice=16, hosts_per_slice=2)}, now=0.0)
+    for h in range(2):  # both hosts of one 2-host slice
+        node = Node(metadata=ObjectMeta(name=f"mh-h{h}", labels={
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+            GKE_SPOT_NODE_LABEL: "true",
+        }))
+        node.status.allocatable = {"google.com/tpu": "8"}
+        led.on_node_event("DELETED", node, 1.0)
+    snap = led.snapshot(1.0)[0]
+    assert snap["preempted"] == 1  # one slice, not two
+    assert snap["preempted_total"] == 1
+    assert led.ready_chips("v5e-16") == 16  # the intact slice survives
+
+
+def test_ledger_notready_then_deleted_spot_still_counts_preemption():
+    """Real preemptions flip NotReady before DELETED; the loss dedup must
+    not swallow the preemption count."""
+    led = CapacityLedger()
+    led.observe_discovery({"v5e-8": _Cap("v5e-8", 2)}, now=0.0)
+    node = Node(metadata=ObjectMeta(name="s0", labels={
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4",
+        GKE_SPOT_NODE_LABEL: "true",
+    }))
+    node.status.allocatable = {"google.com/tpu": "8"}
+    node.ready = False
+    led.on_node_event("MODIFIED", node, 1.0)
+    led.on_node_event("DELETED", node, 2.0)
+    snap = led.snapshot(2.0)[0]
+    assert snap["preempted"] == 1  # loss deduped to one slice
+    assert snap["preempted_total"] == 1  # preemption still counted
+    # Discovery re-confirms: the count folds into the cumulative total.
+    led.observe_discovery({"v5e-8": _Cap("v5e-8", 1)}, now=10.0)
+    assert led.snapshot(10.0)[0]["preempted_total"] == 1
+
+
+def test_ledger_added_notready_node_is_not_a_loss():
+    """A registering node (ADDED, NotReady — the normal GKE join sequence)
+    must not deduct a slice that was never counted as ready."""
+    led = CapacityLedger()
+    led.observe_discovery({"v5e-8": _Cap("v5e-8", 2)}, now=0.0)
+    node = Node(metadata=ObjectMeta(name="joining", labels={
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4",
+    }))
+    node.status.allocatable = {"google.com/tpu": "8"}
+    node.ready = False
+    assert led.on_node_event("ADDED", node, 1.0) is None
+    assert led.ready_chips("v5e-8") == 16  # untouched
+
+
+def test_ledger_stockout_pin_decays_and_clears():
+    led = CapacityLedger()
+    until1 = led.note_stockout("v5e-8", "reservation", 0.0, 100.0)
+    assert until1 == pytest.approx(100.0)
+    assert not led.tier_open("v5e-8", "reservation", 50.0)
+    assert led.tier_open("v5e-8", "reservation", 100.0)  # re-probe window
+    # Second consecutive stockout doubles the pin; cap at 8x.
+    until2 = led.note_stockout("v5e-8", "reservation", 100.0, 100.0)
+    assert until2 == pytest.approx(300.0)
+    for i in range(6):
+        led.note_stockout("v5e-8", "reservation", 0.0, 100.0)
+    assert led.note_stockout("v5e-8", "reservation", 0.0, 100.0) \
+        == pytest.approx(800.0)  # geometric growth capped
+    led.clear_stockout("v5e-8", "reservation")
+    assert led.tier_open("v5e-8", "reservation", 0.0)
+
+
+def test_ledger_credit_window_expires_wedged_orders():
+    led = CapacityLedger()
+    led.note_request(InFlightRequest(
+        request_id="w", variant="v5e-8", tier="on_demand", slices=1,
+        chips_per_slice=8, requested_at=0.0, eta=100.0))
+    assert led.provisioning_chips("v5e-8", 140.0) == 8  # inside 1.5x lead
+    assert led.provisioning_chips("v5e-8", 160.0) == 0  # past the grace
+    expired = led.expire_overdue(160.0)
+    assert [r.request_id for r in expired] == ["w"]
+    assert not led.has_request("v5e-8")
+
+
+def test_ledger_blended_tier_weight():
+    led = CapacityLedger()
+    led.observe_discovery({"v5e-8": _Cap(
+        "v5e-8", 4, tier_slices={"on_demand": 1, "spot": 3})}, now=0.0)
+    w = led.blended_tier_weight("v5e-8", {"on_demand": 1.0, "spot": 0.2})
+    assert w == pytest.approx((1.0 + 3 * 0.2) / 4)
+    assert led.blended_tier_weight("unknown", {}) == 1.0
+
+
+# --- 3. lead-time phase split ---
+
+
+def test_leadtime_phase_split_records_both_phases():
+    est = LeadTimeEstimator(quantile=0.5, default_seconds=99.0)
+    # t=0: scale-up 0->2 opens an episode; t=60: both pods scheduled
+    # (slice provisioned); t=100: both ready.
+    est.observe("m", "v", "v5e-8", desired=2, ready=0, now=0.0,
+                scheduled=0, tier="spot")
+    est.observe("m", "v", "v5e-8", desired=2, ready=0, now=60.0,
+                scheduled=2, tier="spot")
+    est.observe("m", "v", "v5e-8", desired=2, ready=2, now=100.0,
+                scheduled=2, tier="spot")
+    prov, measured = est.provisioning_estimate("v5e-8", "spot")
+    assert measured and prov == pytest.approx(60.0)
+    total, measured = est.estimate("m", "v5e-8")
+    assert measured and total == pytest.approx(100.0)
+
+
+def test_leadtime_stockout_episode_expires_without_polluting_p90():
+    """ISSUE 7 satellite: an episode that never reaches scheduled (quota
+    stockout) must time out recording NOTHING in any phase."""
+    est = LeadTimeEstimator(default_seconds=42.0)
+    est.observe("m", "v", "v5e-8", desired=4, ready=0, now=0.0,
+                scheduled=0, tier="reservation")
+    # Hours pass; the order never materializes, then readiness appears
+    # (operator resolved it out of band) AFTER the timeout.
+    t = EPISODE_TIMEOUT_SECONDS + 10.0
+    est.observe("m", "v", "v5e-8", desired=4, ready=4, now=t,
+                scheduled=4, tier="reservation")
+    assert est.estimate("m", "v5e-8") == (42.0, False)
+    assert est.provisioning_estimate("v5e-8", "reservation") == (42.0, False)
+
+
+def test_leadtime_per_tier_fallback_mirrors_accelerator_ladder():
+    est = LeadTimeEstimator(quantile=0.5, default_seconds=7.0)
+    est.record_provisioning("v5e-8", "spot", 50.0)
+    # Exact (variant, tier).
+    assert est.provisioning_estimate("v5e-8", "spot") == (50.0, True)
+    # Variant's best-covered tier when the asked tier has no samples.
+    assert est.provisioning_estimate("v5e-8", "on_demand") == (50.0, True)
+    # Fleet-wide per-tier ring for a variant never provisioned.
+    assert est.provisioning_estimate("v6e-8", "spot") == (50.0, True)
+    # Nothing anywhere: the default, unmeasured.
+    assert est.provisioning_estimate("v6e-8", "reservation")[0] == 50.0 \
+        or est.provisioning_estimate("v6e-8", "reservation") == (7.0, False)
+
+
+def test_leadtime_phase_sum_backfills_total_estimate():
+    """A NEW model on a variant whose provisioning + serving phases were
+    measured inherits their sum as a measured horizon."""
+    est = LeadTimeEstimator(quantile=0.5, default_seconds=9.0)
+    est.record_provisioning("v5e-8", "on_demand", 80.0)
+    est.observe("other", "v", "v5e-8", desired=1, ready=0, now=0.0,
+                scheduled=0, tier="on_demand")
+    est.observe("other", "v", "v5e-8", desired=1, ready=0, now=30.0,
+                scheduled=1, tier="on_demand")
+    est.observe("other", "v", "v5e-8", desired=1, ready=1, now=50.0,
+                scheduled=1, tier="on_demand")
+    est._samples.clear()  # drop the total rings; keep the phases
+    est._by_accel.clear()
+    lead, measured = est.estimate("brand-new-model", "v5e-8")
+    assert measured
+    # provisioning p50 = {80, 30} -> 55 ; serve p50 = 20 -> 75.
+    assert lead == pytest.approx(55.0 + 20.0)
+
+
+# --- 4. manager ---
+
+
+def _manager(cluster, clock, provisioner, **kw):
+    return CapacityManager(
+        TPUSliceDiscovery(cluster), provisioner,
+        leadtime=LeadTimeEstimator(default_seconds=60.0),
+        stockout_reprobe_seconds=kw.pop("reprobe", 120.0),
+        default_lead_seconds=60.0, clock=clock, **kw)
+
+
+class _FakeDecision:
+    def __init__(self, accelerator, target, chips=8, current=0):
+        self.accelerator_name = accelerator
+        self.target_replicas = target
+        self.chips_per_replica = chips
+        self.current_replicas = current
+
+
+def test_manager_orders_shortfall_and_dedupes():
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    add_tpu_nodepool(cluster, "p", "v5e", "2x4", 1)
+    prov = _ScriptedProvisioner([
+        ProvisionResult(accepted=True, request_id="r1", eta_seconds=100.0)])
+    mgr = _manager(cluster, clock, prov)
+    # 20 replicas x 8 chips = 160 chips vs 8 ready: the per-tick order cap
+    # (8 slices) leaves a residual shortfall, which the NEXT tick must
+    # dedup against the outstanding order instead of re-ordering.
+    mgr.note_demand([_FakeDecision("v5e-8", target=20)])
+    event = mgr.tick()
+    assert [r["outcome"] for r in event["requests"]] == ["accepted"]
+    assert prov.calls == [(0.0, "v5e-8", "reservation", 8)]
+    clock.advance(15.0)
+    event = mgr.tick()
+    assert event["requests"] == []
+    assert prov.calls == [(0.0, "v5e-8", "reservation", 8)]
+    assert mgr.request_log[-1][4] == "deduped"
+    # Pool credit covers the in-flight chips.
+    assert mgr.pool_credit_chips("v5e-8") == 64
+
+
+def test_manager_bootstraps_first_order_for_undiscovered_variant():
+    """A variant no slice has ever existed for (empty cluster bootstrap)
+    must still be orderable: the decision's own chips-per-replica sizes
+    the first order."""
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)  # NO nodes at all
+    prov = _ScriptedProvisioner([
+        ProvisionResult(accepted=True, request_id="r1", eta_seconds=60.0)])
+    mgr = _manager(cluster, clock, prov)
+    mgr.note_demand([_FakeDecision("v5e-8", target=2, chips=8)])
+    event = mgr.tick()
+    assert [r["outcome"] for r in event["requests"]] == ["accepted"]
+    assert prov.calls == [(0.0, "v5e-8", "reservation", 2)]
+    # The in-flight credit surfaces as a pool even with zero discovered
+    # slices, so the limiter won't clamp the pending scale-up to zero.
+    assert mgr.credit_only_pools(set()) == {"v5e-8": 16}
+    # And the ledger snapshot carries the order's slice size, so the
+    # chips-effective gauge is honest before discovery ever reports it.
+    entry = mgr.ledger.snapshot(clock.now())[0]
+    assert entry["chips_per_slice"] == 8
+    assert entry["provisioning"] == 2
+
+
+def test_manager_circuit_breaker_blocks_repeat_requests_until_reprobe():
+    """Acceptance: a quota-stocked-out variant produces ZERO repeat
+    provisioning requests until the re-probe interval elapses."""
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    add_tpu_nodepool(cluster, "p", "v5e", "2x4", 1)
+    denied = ProvisionResult(accepted=False, quota_denied=True,
+                             message="out of stock")
+    prov = _ScriptedProvisioner([denied] * 50)
+    mgr = _manager(cluster, clock, prov,
+                   tier_preference=("reservation",), reprobe=120.0)
+    mgr.note_demand([_FakeDecision("v5e-8", target=3)])
+    mgr.tick()
+    assert len(prov.calls) == 1  # the denied probe
+    # Every tick strictly inside the 120s pin: no provisioner traffic.
+    for _ in range(7):  # t = 15 .. 105
+        clock.advance(15.0)
+        mgr.tick()
+    assert len(prov.calls) == 1, "stocked-out variant must stay silent"
+    clock.advance(15.0)  # t = 120: the re-probe window opens
+    mgr.tick()
+    assert len(prov.calls) == 2  # exactly one re-probe
+    # Second consecutive denial doubled the pin (240s): silence inside it.
+    t_probe = prov.calls[-1][0]
+    while clock.now() + 15.0 < t_probe + 240.0:
+        clock.advance(15.0)
+        mgr.tick()
+    assert len(prov.calls) == 2
+
+
+def test_manager_transport_error_backs_off_without_stockout():
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    add_tpu_nodepool(cluster, "p", "v5e", "2x4", 1)
+
+    class _Exploding(SliceProvisioner):
+        calls = 0
+
+        def request_slices(self, variant, tier, count, now):
+            type(self).calls += 1
+            raise OSError("cloud API 503")
+
+    mgr = _manager(cluster, clock, _Exploding(),
+                   tier_preference=("reservation",))
+    mgr.note_demand([_FakeDecision("v5e-8", target=3)])
+    mgr.tick()
+    assert _Exploding.calls == 1
+    # The immediate next tick is inside the jittered backoff: no call.
+    clock.advance(1.0)
+    mgr.tick()
+    assert _Exploding.calls == 1
+    # No stockout pin: the tier stays open (errors are not missing stock).
+    assert mgr.ledger.tier_open("v5e-8", "reservation", clock.now())
+    # Well past the backoff cap the retry happens.
+    clock.advance(400.0)
+    mgr.tick()
+    assert _Exploding.calls == 2
+
+
+def test_manager_transport_error_falls_through_to_next_tier():
+    """One flaky tier endpoint must not stall replacement capacity: the
+    walk continues to the next tier and only an all-tiers failure backs
+    the variant off."""
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    add_tpu_nodepool(cluster, "p", "v5e", "2x4", 1)
+
+    class _FlakyReservation(SliceProvisioner):
+        calls = []
+
+        def request_slices(self, variant, tier, count, now):
+            type(self).calls.append(tier)
+            if tier == "reservation":
+                raise OSError("reservation API 500")
+            return ProvisionResult(accepted=True, request_id="ok",
+                                   eta_seconds=60.0)
+
+    mgr = _manager(cluster, clock, _FlakyReservation())
+    mgr.note_demand([_FakeDecision("v5e-8", target=3)])
+    event = mgr.tick()
+    assert _FlakyReservation.calls == ["reservation", "on_demand"]
+    assert [r["outcome"] for r in event["requests"]] == ["accepted"]
+    assert event["requests"][0]["tier"] == "on_demand"
+
+
+def test_ledger_notready_flap_does_not_retire_inflight_order():
+    """A node flapping NotReady across a discovery pass (count dips then
+    recovers) must neither retire a pending order with a bogus lead
+    sample nor leave the loss accounted after recovery."""
+    led = CapacityLedger()
+    led.observe_discovery({"v5e-8": _Cap("v5e-8", 4)}, now=0.0)
+    led.note_request(InFlightRequest(
+        request_id="r", variant="v5e-8", tier="on_demand", slices=1,
+        chips_per_slice=8, requested_at=0.0, eta=120.0))
+    node = Node(metadata=ObjectMeta(name="flappy", labels={
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4",
+    }))
+    node.status.allocatable = {"google.com/tpu": "8"}
+    node.ready = False
+    led.on_node_event("MODIFIED", node, 5.0)
+    assert led.ready_chips("v5e-8") == 24  # loss visible same tick
+    # Discovery confirms the dip...
+    done = led.observe_discovery({"v5e-8": _Cap("v5e-8", 3)}, now=10.0)
+    assert done == []
+    # ...then the node recovers: the watch path releases the loss...
+    node.ready = True
+    led.on_node_event("MODIFIED", node, 12.0)
+    # ...and the recovered count must NOT read as order fulfillment.
+    done = led.observe_discovery({"v5e-8": _Cap("v5e-8", 4)}, now=20.0)
+    assert done == [], "flap recovery must not retire the pending order"
+    assert led.has_request("v5e-8")
+    # The order's REAL slices landing (count beyond the pre-dip peak)
+    # retire it with the true latency.
+    done = led.observe_discovery({"v5e-8": _Cap("v5e-8", 5)}, now=90.0)
+    assert [c.request.request_id for c in done] == ["r"]
+    assert done[0].latency == pytest.approx(90.0)
+
+
+def test_null_provisioner_keeps_everything_static():
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    add_tpu_nodepool(cluster, "p", "v5e", "2x4", 1)
+    mgr = _manager(cluster, clock, NullProvisioner())
+    mgr.note_demand([_FakeDecision("v5e-8", target=5)])
+    event = mgr.tick()
+    assert event["requests"] == []
+    assert mgr.pool_credit_chips("v5e-8") == 0
+
+
+# --- 5. FakeGkeProvisioner + kubelet ---
+
+
+def test_fake_gke_delay_quota_and_dedup():
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    prov = _mk_provisioner(cluster, clock)
+    r1 = prov.request_slices("v5e-8", "reservation", 2, clock.now())
+    assert r1.accepted and r1.eta_seconds == 120.0
+    # Dedup of an identical outstanding order.
+    r2 = prov.request_slices("v5e-8", "reservation", 2, clock.now())
+    assert r2.accepted and r2.request_id == r1.request_id
+    # Quota: reservation allows 2 total; a further request is denied.
+    r3 = prov.request_slices("v6e-8", "reservation", 1, clock.now())
+    assert not r3.accepted and r3.quota_denied
+    # Nothing materialized before the delay.
+    prov.step()
+    assert cluster.list("Node") == []
+    clock.advance(121.0)
+    prov.step()
+    nodes = cluster.list("Node")
+    assert len(nodes) == 2  # 2 single-host v5e-8 slices
+    slices = TPUSliceDiscovery(cluster).discover_slices()
+    assert slices["v5e-8"].total_slices == 2
+    assert slices["v5e-8"].tier_slices == {"reservation": 2}
+
+
+def test_fake_gke_preempts_whole_slices_deterministically():
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    add_tpu_nodepool(cluster, "spot-pool", "v5e", "2x4", 3,
+                     extra_labels={GKE_SPOT_NODE_LABEL: "true"})
+    add_tpu_nodepool(cluster, "od-pool", "v5e", "2x4", 2)
+    prov = _mk_provisioner(cluster, clock)
+    prov.schedule_preemptions([(10.0, 2)])
+    clock.advance(11.0)
+    prov.step()
+    assert prov.preempted_slices_total == 2
+    slices = TPUSliceDiscovery(cluster).discover_slices()
+    # On-demand untouched; exactly 2 of 3 spot slices gone.
+    assert slices["v5e-8"].tier_slices == {"on_demand": 2, "spot": 1}
+
+
+def test_kubelet_deletes_pods_of_lost_nodes_and_skips_cordoned():
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    add_tpu_nodepool(cluster, "p", "v5e", "2x4", 2)
+    cluster.create(Deployment(
+        metadata=ObjectMeta(name="d", namespace=NS), replicas=1,
+        selector={"app": "d"},
+        template=PodTemplateSpec(labels={"app": "d"}, containers=[
+            Container(name="srv", resources=ResourceRequirements(
+                requests={"google.com/tpu": "8"}))])))
+    kubelet = FakeKubelet(client=cluster, clock=clock, startup_seconds=10.0)
+    kubelet.step()
+    pod = cluster.list("Pod", namespace=NS)[0]
+    first_node = pod.node_name
+    assert first_node
+    # Cordon the OTHER node, then delete the pod's node: the replacement
+    # pod must not land on the cordoned host.
+    other = [n for n in cluster.list("Node")
+             if n.metadata.name != first_node][0]
+    other.unschedulable = True
+    cluster.update(other)
+    cluster.delete("Node", other.metadata.namespace, first_node)
+    kubelet.step()  # lost-node pass deletes the pod; reconcile recreates
+    pods = cluster.list("Pod", namespace=NS)
+    assert len(pods) == 1
+    assert pods[0].metadata.name != pod.metadata.name or \
+        pods[0].metadata.resource_version != pod.metadata.resource_version
+    assert pods[0].node_name == ""  # only the cordoned host remains
+
+
+def test_kubelet_marks_pods_on_notready_nodes_unready():
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    add_tpu_nodepool(cluster, "p", "v5e", "2x4", 1)
+    cluster.create(Deployment(
+        metadata=ObjectMeta(name="d", namespace=NS), replicas=1,
+        selector={"app": "d"},
+        template=PodTemplateSpec(labels={"app": "d"}, containers=[
+            Container(name="srv", resources=ResourceRequirements(
+                requests={"google.com/tpu": "8"}))])))
+    kubelet = FakeKubelet(client=cluster, clock=clock, startup_seconds=0.0)
+    kubelet.step()
+    clock.advance(1.0)
+    kubelet.step()
+    assert cluster.list("Pod", namespace=NS)[0].is_ready()
+    node = cluster.list("Node")[0]
+    node.ready = False
+    cluster.update(node)
+    kubelet.step()
+    assert not cluster.list("Pod", namespace=NS)[0].is_ready()
+
+
+# --- 6. Node watch surface (fake apiserver) ---
+
+
+def _raw_watch_lines(url: str, timeout: float = 10.0):
+    resp = urllib.request.urlopen(url, timeout=timeout)
+    for raw in resp:
+        raw = raw.strip()
+        if raw:
+            yield json.loads(raw)
+
+
+def _node(name: str) -> Node:
+    return Node(metadata=ObjectMeta(name=name, labels={
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4",
+    }))
+
+
+def test_node_lifecycle_streams_through_watch():
+    cluster = FakeCluster()
+    server = FakeAPIServer(cluster).start()
+    try:
+        url = f"{server.url}/api/v1/nodes?watch=true&timeoutSeconds=3"
+        got: list[dict] = []
+        t = threading.Thread(
+            target=lambda: got.extend(_raw_watch_lines(url)), daemon=True)
+        t.start()
+        time.sleep(0.3)
+        created = cluster.create(_node("n0"))
+        created.ready = False
+        updated = cluster.update(created)
+        cluster.update_status(updated)  # status subresource write
+        cluster.delete("Node", created.metadata.namespace, "n0")
+        t.join(timeout=8.0)
+        kinds = [(ev["type"], ev["object"]["kind"]) for ev in got]
+        assert ("ADDED", "Node") in kinds
+        assert ("MODIFIED", "Node") in kinds
+        assert ("DELETED", "Node") in kinds
+        # The serde round-trips spec.unschedulable + Ready condition.
+        added = next(ev["object"] for ev in got if ev["type"] == "ADDED")
+        assert added["status"]["conditions"][0]["type"] == "Ready"
+    finally:
+        server.shutdown()
+
+
+def test_node_status_patch_streams_modified_event():
+    """Kubelets PATCH node status; the fake apiserver must apply the
+    merge-patch through the status subresource and stream the MODIFIED
+    event to watchers."""
+    cluster = FakeCluster()
+    server = FakeAPIServer(cluster).start()
+    try:
+        node = _node("n0")
+        node.status.allocatable = {"google.com/tpu": "8"}
+        cluster.create(node)
+        url = f"{server.url}/api/v1/nodes?watch=true&timeoutSeconds=3"
+        got: list[dict] = []
+        t = threading.Thread(
+            target=lambda: got.extend(_raw_watch_lines(url)), daemon=True)
+        t.start()
+        time.sleep(0.3)
+        req = urllib.request.Request(
+            f"{server.url}/api/v1/nodes/n0/status",
+            data=json.dumps({"status": {
+                "allocatable": {"google.com/tpu": "0"}}}).encode(),
+            headers={"Content-Type": "application/merge-patch+json"},
+            method="PATCH")
+        body = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert body["status"]["allocatable"]["google.com/tpu"] == "0"
+        t.join(timeout=8.0)
+        mods = [ev for ev in got if ev["type"] == "MODIFIED"]
+        assert mods, "status PATCH must stream a MODIFIED event"
+        assert mods[-1]["object"]["status"]["allocatable"][
+            "google.com/tpu"] == "0"
+        assert cluster.get("Node", node.metadata.namespace,
+                           "n0").status.allocatable == {"google.com/tpu": "0"}
+    finally:
+        server.shutdown()
+
+
+def test_node_slow_consumer_overflow_closes_stream_with_410(monkeypatch):
+    """Satellite: the PR 5 slow-consumer 410-gap coverage, for the Node
+    kind — a capacity watcher that falls behind must be told to re-list,
+    not be left confidently stale about inventory."""
+    import wva_tpu.k8s.fake_apiserver as fas
+
+    monkeypatch.setattr(fas, "WATCH_QUEUE_MAXSIZE", 1)
+    cluster = FakeCluster()
+    server = FakeAPIServer(cluster).start()
+    try:
+        url = f"{server.url}/api/v1/nodes?watch=true&timeoutSeconds=10"
+        got: list[dict] = []
+        t = threading.Thread(
+            target=lambda: got.extend(_raw_watch_lines(url)), daemon=True)
+        t.start()
+        time.sleep(0.3)
+        for i in range(50):
+            cluster.create(_node(f"burst-{i:03d}"))
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "stream must CLOSE after overflow"
+        assert got and got[-1]["type"] == "ERROR"
+        assert got[-1]["object"]["code"] == 410
+    finally:
+        server.shutdown()
+
+
+def test_informer_covers_node_and_nudges_on_cordon():
+    from wva_tpu.k8s import InformerKubeClient
+
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    add_tpu_nodepool(cluster, "p", "v5e", "2x4", 2)
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    cluster.reset_request_counts()
+    # Node LISTs are store-served: zero apiserver traffic.
+    assert len(inf.list("Node")) == 2
+    assert cluster.request_counts().get(("list", "Node"), 0) == 0
+    nudges = []
+    inf.add_nudge_listener(lambda kind, event, obj:
+                           nudges.append((kind, event, obj.metadata.name)))
+    node = cluster.list("Node")[0]
+    node.unschedulable = True
+    cluster.update(node)
+    assert ("Node", "MODIFIED", node.metadata.name) in nudges
+    # The store reflects the cordon (discovery through the informer sees
+    # it without a LIST).
+    assert any(n.unschedulable for n in inf.list("Node"))
+
+
+# --- 7. engine integration ---
+
+
+def _capacity_world(capacity_enabled: bool, manager_none: bool = False,
+                    kv: float = 0.6, n_models: int = 2):
+    from wva_tpu.engines import common
+
+    common.DecisionCache.clear()
+    while not common.DecisionTrigger.empty():
+        common.DecisionTrigger.get_nowait()
+    from wva_tpu.collector.source import TimeSeriesDB
+
+    clock = FakeClock(start=300_000.0)
+    cluster = FakeCluster(clock=clock)
+    tsdb = TimeSeriesDB(clock=clock)
+    cfg = new_test_config()
+    cfg.update_saturation_config({"default": SaturationScalingConfig(
+        analyzer_name="saturation", enable_limiter=True)})
+    cfg.set_trace(TraceConfig(enabled=True))
+    cap_cfg = cfg.capacity_config()
+    cap_cfg.enabled = capacity_enabled
+    cfg.set_capacity(cap_cfg)
+    add_tpu_nodepool(cluster, "v5e-pool", "v5e", "2x4", 8)
+
+    for i in range(n_models):
+        name = f"m{i:02d}-v5e"
+        model = f"org/model-{i:02d}"
+        cluster.create(Deployment(
+            metadata=ObjectMeta(name=name, namespace=NS),
+            replicas=1, selector={"app": name},
+            template=PodTemplateSpec(
+                labels={"app": name},
+                containers=[Container(
+                    name="srv",
+                    args=["--max-num-batched-tokens=8192",
+                          "--max-num-seqs=256"],
+                    resources=ResourceRequirements(
+                        requests={"google.com/tpu": "8"}))]),
+            status=DeploymentStatus(replicas=1, ready_replicas=1)))
+        cluster.create(VariantAutoscaling(
+            metadata=ObjectMeta(
+                name=name, namespace=NS,
+                labels={"inference.optimization/acceleratorName": "v5e-8"}),
+            spec=VariantAutoscalingSpec(
+                scale_target_ref=CrossVersionObjectReference(name=name),
+                model_id=model, variant_cost="10.0")))
+        cluster.create(Pod(
+            metadata=ObjectMeta(
+                name=f"{name}-0", namespace=NS, labels={"app": name},
+                owner_references=[{"kind": "Deployment", "name": name}]),
+            status=PodStatus(phase="Running", ready=True,
+                             pod_ip=f"10.1.{i}.1")))
+        pod_labels = {"pod": f"{name}-0", "namespace": NS,
+                      "model_name": model}
+        tsdb.add_sample("vllm:kv_cache_usage_perc", pod_labels, kv)
+        tsdb.add_sample("vllm:num_requests_waiting", pod_labels, 0)
+        tsdb.add_sample("vllm:cache_config_info",
+                        {**pod_labels, "num_gpu_blocks": "4096",
+                         "block_size": "32"}, 1.0)
+
+    mgr = build_manager(cluster, cfg, clock=clock, tsdb=tsdb)
+    if manager_none:
+        assert mgr.engine.capacity is not None
+        mgr.engine.capacity = None
+        mgr.engine.limiter.inventory.capacity = None
+    mgr.setup()
+    return mgr, cluster, clock
+
+
+def _run_world(mgr, cluster, clock, ticks=4):
+    for _ in range(ticks):
+        mgr.run_once()
+        clock.advance(15.0)
+    mgr.flight_recorder.flush()
+    cycles = mgr.flight_recorder.snapshot()
+    statuses = {va.metadata.name: encode(va.status)
+                for va in cluster.list("VariantAutoscaling", namespace=NS)}
+    mgr.shutdown()
+    return cycles, statuses
+
+
+def test_capacity_off_is_byte_identical_to_manager_none():
+    """WVA_CAPACITY=off must route to EXACTLY the capacity-less engine:
+    decisions, statuses, and trace cycles byte-identical."""
+    mgr_a, cl_a, ck_a = _capacity_world(capacity_enabled=False)
+    assert mgr_a.engine.capacity is None  # the knob controls wiring
+    cycles_a, statuses_a = _run_world(mgr_a, cl_a, ck_a)
+
+    mgr_b, cl_b, ck_b = _capacity_world(capacity_enabled=True,
+                                        manager_none=True)
+    cycles_b, statuses_b = _run_world(mgr_b, cl_b, ck_b)
+
+    dumps = lambda x: json.dumps(x, sort_keys=True)  # noqa: E731
+    assert dumps(statuses_a) == dumps(statuses_b)
+    assert dumps(cycles_a) == dumps(cycles_b)
+    for rec in cycles_a:
+        assert not any(ev.get("stage") == STAGE_CAPACITY
+                       for ev in rec.get("stages", []))
+
+
+def test_capacity_on_records_stage_and_gauges():
+    from wva_tpu.constants import (
+        WVA_CAPACITY_CHIPS_EFFECTIVE,
+        WVA_CAPACITY_SLICES,
+    )
+
+    mgr, cluster, clock = _capacity_world(capacity_enabled=True)
+    assert mgr.engine.capacity is not None
+    reg = mgr.registry
+    cycles, _ = _run_world(mgr, cluster, clock)
+    events = [ev for rec in cycles for ev in rec.get("stages", [])
+              if ev.get("stage") == STAGE_CAPACITY]
+    assert events, "capacity stage must be flight-recorded"
+    ledger = events[-1]["ledger"]
+    assert ledger[0]["variant"] == "v5e-8"
+    assert ledger[0]["ready"] == 8
+    assert reg.get(WVA_CAPACITY_SLICES,
+                   {"accelerator_type": "v5e-8", "state": "ready"}) == 8.0
+    assert reg.get(WVA_CAPACITY_CHIPS_EFFECTIVE,
+                   {"accelerator_type": "v5e-8"}) == 64.0
+
+
+# --- the preemption-storm e2e (acceptance criteria) ---
+
+
+STORM_SEED = 20260804
+
+
+def _storm_world(trace_path=None):
+    profile, events = preemption_storm(
+        base_rate=4.0, burst_rate=30.0, burst_duration=120.0,
+        mean_gap=200.0, horizon=900.0, seed=11,
+        preemptions_per_burst=1, preemption_lag=20.0)
+    cfg = new_test_config()
+    if trace_path is not None:
+        cfg.set_trace(TraceConfig(enabled=True, path=trace_path))
+    spec = VariantSpec(
+        name="llama-v5e", model_id="meta-llama/Llama-3.1-8B",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=2, serving=ServingParams(engine="jetstream"),
+        load=profile,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=60.0,
+                      sync_period_seconds=10.0))
+    harness = EmulationHarness(
+        [spec],
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=cfg, nodepools=[("od-pool", "v5e", "2x4", 2)],
+        startup_seconds=30.0, engine_interval=15.0,
+        stochastic_seed=STORM_SEED,
+        provisioner=lambda cluster, clock: FakeGkeProvisioner(
+            cluster, clock,
+            tiers={"on_demand": TierPolicy(provision_delay_seconds=120.0),
+                   "spot": TierPolicy(provision_delay_seconds=60.0,
+                                      preemptible=True)},
+            seed=3))
+    add_tpu_nodepool(harness.cluster, "spot-pool", "v5e", "2x4", 4,
+                     extra_labels={GKE_SPOT_NODE_LABEL: "true"})
+    harness.provisioner.schedule_preemptions(
+        [(harness.start_time + t, k) for t, k in events])
+    return harness, events
+
+
+@pytest.mark.slow
+def test_preemption_storm_e2e():
+    """Acceptance: the fleet re-converges within 3 engine ticks of each
+    preemption, preempted chips leave the pools in the SAME tick, and
+    replacements are ordered — all asserted via wva_capacity_* gauges and
+    the flight-recorded trace."""
+    harness, events = _storm_world()
+    capman = harness.manager.engine.capacity
+
+    desired_before: dict[float, int] = {}
+    recovered: dict[float, bool] = {}
+    ticks_after: dict[float, int] = {}
+    pool_dropped: dict[float, bool] = {}
+    pool_before: dict[float, int] = {}
+    last_pool = {"limit": 0, "desired": 0}
+
+    def pool_limit():
+        pools = harness.manager.engine.limiter.inventory.pools()
+        p = pools.get("v5e-8")
+        return p.limit if p is not None else 0
+
+    engine_ticks = {"n": 0}
+    orig_tick = harness.manager.engine.optimize
+
+    def on_step(h, t):
+        now = h.clock.now()
+        for et, _ in events:
+            at = h.start_time + et
+            # Last step strictly BEFORE the preemption fires (it fires
+            # during the next 1s step): snapshot the pre-loss baseline.
+            if now < at <= now + 1.0 and et not in ticks_after:
+                desired_before[et] = last_pool["desired"]
+                pool_before[et] = last_pool["limit"]
+                ticks_after[et] = 0
+
+    # Track per-engine-tick state by wrapping optimize.
+    def tick_wrapper():
+        orig_tick()
+        engine_ticks["n"] += 1
+        limit = pool_limit()
+        from wva_tpu.constants import WVA_DESIRED_REPLICAS
+        desired = harness.manager.registry.get(
+            WVA_DESIRED_REPLICAS,
+            {"variant_name": "llama-v5e", "namespace": "inference",
+             "accelerator_type": "v5e-8"}) or 0
+        last_pool["limit"] = limit
+        last_pool["desired"] = int(desired)
+        for et in list(ticks_after):
+            if recovered.get(et):
+                continue
+            ticks_after[et] += 1
+            if ticks_after[et] == 1 and limit < pool_before[et]:
+                # Same-tick release: the first engine tick after the
+                # preemption already plans with the reduced pool.
+                pool_dropped[et] = True
+            if int(desired) >= desired_before[et] \
+                    and ticks_after[et] <= 3:
+                recovered[et] = True
+
+    harness.manager.engine.executor.task = tick_wrapper
+    harness.run(900, on_step=on_step)
+
+    assert harness.provisioner.preempted_slices_total >= 2
+    for et, _ in events:
+        assert pool_dropped.get(et), \
+            f"preempted chips not released same-tick after t={et}"
+        assert recovered.get(et), \
+            f"fleet did not re-converge within 3 ticks of t={et}"
+    # Replacement capacity was ordered and landed.
+    accepted = [r for r in capman.request_log if r[4] == "accepted"]
+    assert accepted, "storm must trigger replacement provisioning"
+    from wva_tpu.constants import WVA_CAPACITY_PREEMPTED_TOTAL
+    assert harness.manager.registry.get(
+        WVA_CAPACITY_PREEMPTED_TOTAL,
+        {"accelerator_type": "v5e-8"}) >= 2.0
+
+
+# --- capacity golden trace ---
+
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "goldens",
+                      "capacity_trace_v1.jsonl")
+
+
+def test_golden_capacity_trace_replays_zero_diffs():
+    """The committed preemption-storm trace must replay byte-for-byte:
+    capacity influences decisions only through the recorded limiter pools,
+    so the replay harness needs no capacity-specific logic."""
+    from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+    records = load_trace(GOLDEN)
+    report = ReplayEngine(records).replay()
+    assert report.ok, report.to_dict()
+    assert report.cycles_replayed > 0
+    # The trace genuinely exercises the capacity plane: preemptions seen,
+    # provisioning requested.
+    preempted = requests = 0
+    for rec in records:
+        for ev in rec.get("stages", []):
+            if ev.get("stage") == STAGE_CAPACITY:
+                requests += len(ev.get("requests", []))
+                for entry in ev.get("ledger", []):
+                    preempted = max(preempted,
+                                    entry.get("preempted_total", 0))
+    assert preempted >= 2, "golden must contain preemptions"
+    assert requests >= 1, "golden must contain provisioning requests"

@@ -131,6 +131,60 @@ def test_not_ready_nodes_excluded():
     assert d.discover() == {}
 
 
+def test_cordoned_nodes_excluded():
+    """spec.unschedulable (kubectl cordon) makes the host unavailable for
+    new replicas: a cordoned single-host slice is not schedulable
+    capacity."""
+    c = FakeCluster()
+    node = tpu_node("n0")
+    node.unschedulable = True
+    c.create(node)
+    d = TPUSliceDiscovery(c)
+    assert d.discover() == {}
+    assert d.discover_slices() == {}
+
+
+def test_multi_host_slice_with_one_cordoned_host_not_counted():
+    """Regression (ISSUE 7 satellite): a multi-host slice with ONE
+    cordoned host is partially degraded — it must not be counted as a
+    whole schedulable slice. Second intact slice in the pool still
+    counts."""
+    c = FakeCluster()
+    # Two 4x4 v5e slices (2 hosts x 8 chips each) in one pool.
+    for s in range(2):
+        for h in range(2):
+            node = tpu_node(f"s{s}-h{h}", topo="4x4", pool="pool-mh")
+            if s == 0 and h == 1:
+                node.unschedulable = True  # cordon one host of slice 0
+            c.create(node)
+    slices = TPUSliceDiscovery(c).discover_slices()
+    assert slices["v5e-16"].total_slices == 1  # only the intact slice
+    # 3 schedulable hosts' chips remain visible, but slice math floors.
+    assert slices["v5e-16"].hosts_per_slice == 2
+
+
+def test_discover_slices_reports_capacity_tiers():
+    """Nodes labeled spot / reservation split the variant's slice count
+    into tier_slices (the capacity ledger's per-tier inventory)."""
+    from wva_tpu.capacity.tiers import (
+        GKE_RESERVATION_NODE_LABEL,
+        GKE_SPOT_NODE_LABEL,
+    )
+
+    c = FakeCluster()
+    spot = tpu_node("spot0", pool="pool-spot")
+    spot.metadata.labels[GKE_SPOT_NODE_LABEL] = "true"
+    c.create(spot)
+    resv = tpu_node("resv0", pool="pool-resv")
+    resv.metadata.labels[GKE_RESERVATION_NODE_LABEL] = "resv-a"
+    c.create(resv)
+    c.create(tpu_node("od0", pool="pool-od"))
+    slices = TPUSliceDiscovery(c).discover_slices()
+    assert slices["v5e-8"].tier_slices == {
+        "spot": 1, "reservation": 1, "on_demand": 1}
+    assert slices["v5e-8"].total_slices == 3
+
+
 def test_discover_slices_four_chip_hosts():
     # Real GKE multi-host v5e pools use 4-chip hosts (ct5lp-hightpu-4t):
     # a 4x4 slice is 16 chips over 4 hosts, not 2. hosts-per-slice must come

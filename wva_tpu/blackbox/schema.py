@@ -46,6 +46,13 @@ STAGE_LIMITER = "limiter"
 # recorded pool snapshot) — the planner's learned state is not
 # reconstructable from one cycle.
 STAGE_FORECAST = "forecast"
+# Elastic capacity plane (wva_tpu.capacity): per-tick ledger snapshot
+# (ready/provisioning/preempted slices per variant, stocked-out tiers) plus
+# the provisioning requests submitted/completed/expired this cycle.
+# Recorded AFTER the limiter: capacity influences decisions only through
+# the inventory pools the limiter stage already records, so replay needs
+# no capacity-specific logic — the stage is pure observability.
+STAGE_CAPACITY = "capacity"
 STAGE_ACTUATION = "actuation"
 STAGE_RECONCILE = "reconcile"
 # Dirty-set incremental ticks: models whose input fingerprint was unchanged

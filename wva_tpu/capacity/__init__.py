@@ -1,0 +1,67 @@
+"""Elastic capacity plane: TPU slice provisioning, preemption resilience,
+and reservation/spot-aware inventory (docs/design/capacity.md).
+
+Sits between discovery and the solver: the :class:`CapacityLedger` tracks
+every variant's slices through ``ready / provisioning(ETA) / preempted /
+stocked_out``; the :class:`CapacityManager` turns post-analysis shortfalls
+into deduped, backoff-guarded, circuit-broken provisioning requests against
+a :class:`SliceProvisioner`; the limiter's pools become
+``ready + provisioning-arriving-within-lead-time``.
+
+Gated by ``WVA_CAPACITY`` (default on); off is byte-identical to the
+pre-capacity decision plane.
+
+Lazy init (PEP 562): discovery imports :mod:`wva_tpu.capacity.tiers` for
+node tier classification, and an eager ledger import here would close a
+cycle back through discovery.
+"""
+
+from wva_tpu.capacity.tiers import (  # noqa: F401 — leaf module, re-export
+    DEFAULT_TIER_COST_WEIGHTS,
+    DEFAULT_TIER_PREFERENCE,
+    TIER_ON_DEMAND,
+    TIER_RESERVATION,
+    TIER_SPOT,
+    parse_tier_preference,
+    parse_tier_weights,
+    tier_for_node_labels,
+)
+
+_LAZY = {
+    "CapacityLedger": "wva_tpu.capacity.ledger",
+    "CompletedRequest": "wva_tpu.capacity.ledger",
+    "InFlightRequest": "wva_tpu.capacity.ledger",
+    "STATE_PREEMPTED": "wva_tpu.capacity.ledger",
+    "STATE_PROVISIONING": "wva_tpu.capacity.ledger",
+    "STATE_READY": "wva_tpu.capacity.ledger",
+    "STATE_STOCKED_OUT": "wva_tpu.capacity.ledger",
+    "CapacityManager": "wva_tpu.capacity.manager",
+    "OUTCOME_ACCEPTED": "wva_tpu.capacity.manager",
+    "OUTCOME_DEDUPED": "wva_tpu.capacity.manager",
+    "OUTCOME_FAILED": "wva_tpu.capacity.manager",
+    "OUTCOME_QUOTA_DENIED": "wva_tpu.capacity.manager",
+    "NullProvisioner": "wva_tpu.capacity.provisioner",
+    "ProvisionResult": "wva_tpu.capacity.provisioner",
+    "SliceProvisioner": "wva_tpu.capacity.provisioner",
+}
+
+__all__ = [
+    "DEFAULT_TIER_COST_WEIGHTS",
+    "DEFAULT_TIER_PREFERENCE",
+    "TIER_ON_DEMAND",
+    "TIER_RESERVATION",
+    "TIER_SPOT",
+    "parse_tier_preference",
+    "parse_tier_weights",
+    "tier_for_node_labels",
+    *sorted(_LAZY),
+]
+
+
+def __getattr__(name: str):
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

@@ -1,0 +1,409 @@
+"""Elastic slice inventory ledger: per-variant accounting of capacity in
+every lifecycle state, not just capacity-at-hand.
+
+States per (variant) pool:
+
+- ``ready`` — whole schedulable slices discovery can see right now;
+- ``provisioning`` — slices ordered from the provisioner, carrying an ETA
+  (the provisioner's own estimate or the measured per-(variant, tier)
+  provisioning lead); they count toward planning capacity while their ETA
+  is credible (Autopilot's insight: plan against *measured* provisioning
+  behavior, not optimism);
+- ``preempted`` — slices lost to spot preemption / node failure since the
+  last discovery pass (the watch event arrives seconds before discovery
+  re-lists, and the pool math must not double-count the corpse);
+- ``stocked_out`` — a (variant, tier) the cloud refused on quota; pinned
+  unavailable with a time-decayed re-probe so the solver stops planning
+  capacity that cannot materialize.
+
+The ledger is deliberately clock-free (every method takes ``now``) so
+simulated worlds drive it deterministically, and lock-protected because
+node watch events land from the informer's dispatch context while the
+engine tick reads it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+
+from wva_tpu.capacity.tiers import TIER_SPOT, tier_for_node_labels
+from wva_tpu.constants.labels import (
+    GKE_TPU_ACCELERATOR_NODE_LABEL,
+    GKE_TPU_TOPOLOGY_NODE_LABEL,
+    TPU_RESOURCE_NAME,
+)
+from wva_tpu.discovery.tpu import parse_tpu_topology
+from wva_tpu.k8s.objects import parse_quantity
+
+log = logging.getLogger(__name__)
+
+STATE_READY = "ready"
+STATE_PROVISIONING = "provisioning"
+STATE_PREEMPTED = "preempted"
+STATE_STOCKED_OUT = "stocked_out"
+
+# An in-flight request keeps its planning credit until this multiple of its
+# ETA has elapsed: provisioning that runs 50% past its measured lead is no
+# longer capacity anyone should plan against (it may be wedged), but a
+# small overrun must not flap the pool.
+CREDIT_GRACE_FACTOR = 1.5
+# Consecutive stockouts grow the re-probe interval geometrically up to this
+# multiple (time-decayed re-probe: a persistent stockout is probed ever
+# less often; one success resets the streak).
+MAX_REPROBE_BACKOFF = 8
+
+
+@dataclass
+class InFlightRequest:
+    """One accepted provisioning order."""
+
+    request_id: str = ""
+    variant: str = ""
+    tier: str = ""
+    slices: int = 0
+    chips_per_slice: int = 0
+    requested_at: float = 0.0
+    eta: float = 0.0  # absolute time the slices should materialize
+
+    @property
+    def chips(self) -> int:
+        return self.slices * self.chips_per_slice
+
+    def credit_expires(self) -> float:
+        lead = max(self.eta - self.requested_at, 1.0)
+        return self.requested_at + CREDIT_GRACE_FACTOR * lead
+
+
+@dataclass
+class _VariantBook:
+    variant: str = ""
+    chips_per_slice: int = 0
+    hosts_per_slice: int = 1
+    ready_slices: int = 0
+    # Highest ready count seen while orders are in flight: growth only
+    # counts as order FULFILLMENT beyond this high-water mark, so a
+    # NotReady flap (count dips one pass, recovers the next) cannot
+    # spuriously retire an order with a bogus short lead sample. Tracks
+    # the current count whenever nothing is in flight.
+    peak_ready: int = 0
+    tier_slices: dict[str, int] = field(default_factory=dict)
+    # Slices lost to node deletion / NotReady / cordon since the last
+    # discovery pass (watch-observed; cleared when discovery re-confirms).
+    # lost_slices derives from lost_nodes grouped by hosts_per_slice: one
+    # preempted multi-host slice produces one DELETED event PER HOST, and
+    # counting each as a whole slice would overstate the loss.
+    lost_slices: int = 0
+    lost_nodes: set[str] = field(default_factory=set)
+    # Spot hosts deleted since the last discovery pass; folded into
+    # preempted_total as whole slices when discovery re-confirms (the
+    # NotReady -> DELETED sequence real preemptions produce must count
+    # once, and per-host events of one slice must count as one slice).
+    preempted_window: set[str] = field(default_factory=set)
+    inflight: dict[str, InFlightRequest] = field(default_factory=dict)
+    stockout_until: dict[str, float] = field(default_factory=dict)
+    stockout_streak: dict[str, int] = field(default_factory=dict)
+    preempted_total: int = 0
+
+
+@dataclass
+class CompletedRequest:
+    request: InFlightRequest | None = None
+    latency: float = 0.0  # request submission -> slices discovered ready
+
+
+class CapacityLedger:
+    """Thread-safe per-variant slice accounting."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._books: dict[str, _VariantBook] = {}
+
+    def _book(self, variant: str) -> _VariantBook:
+        book = self._books.get(variant)
+        if book is None:
+            book = self._books[variant] = _VariantBook(variant=variant)
+        return book
+
+    # --- discovery feed ---
+
+    def observe_discovery(self, slices: dict, now: float
+                          ) -> list[CompletedRequest]:
+        """Reconcile against a discovery snapshot (``variant ->
+        SliceCapacity``). Growth beyond the expected ready count retires
+        the oldest in-flight requests FIFO — their submission->discovered
+        latency is the measured provisioning lead the estimator and the
+        ETA math feed on. Returns the requests retired this pass."""
+        completed: list[CompletedRequest] = []
+        with self._mu:
+            for variant, cap in slices.items():
+                book = self._book(variant)
+                book.chips_per_slice = cap.chips_per_slice
+                book.hosts_per_slice = max(cap.hosts_per_slice, 1)
+                # Fulfillment = growth beyond BOTH the expected count and
+                # the in-flight-era high-water mark: a dip-and-recover
+                # (NotReady flap, transiently missing node) must not
+                # retire an order that has not actually landed. A genuine
+                # permanent shrink makes the mark conservative — the
+                # affected order then expires via its credit window and
+                # is re-ordered, which is the safe direction.
+                expected = max(book.ready_slices - book.lost_slices, 0)
+                if not book.inflight:
+                    book.peak_ready = cap.total_slices
+                growth = cap.total_slices - max(expected, book.peak_ready)
+                book.peak_ready = max(book.peak_ready, cap.total_slices)
+                if growth > 0 and book.inflight:
+                    for rid in sorted(book.inflight,
+                                      key=lambda r: book.inflight[r]
+                                      .requested_at):
+                        if growth <= 0:
+                            break
+                        req = book.inflight[rid]
+                        if req.slices <= growth:
+                            growth -= req.slices
+                            del book.inflight[rid]
+                            completed.append(CompletedRequest(
+                                request=req,
+                                latency=max(now - req.requested_at, 0.0)))
+                            # A materialized request proves the tier is not
+                            # stocked out.
+                            book.stockout_until.pop(req.tier, None)
+                            book.stockout_streak.pop(req.tier, None)
+                        else:
+                            req.slices -= growth
+                            growth = 0
+                book.ready_slices = cap.total_slices
+                book.tier_slices = dict(cap.tier_slices)
+                self._fold_window_locked(book)
+            # Variants discovery no longer reports: every slice is gone.
+            for variant, book in self._books.items():
+                if variant not in slices and (book.ready_slices
+                                              or book.lost_nodes
+                                              or book.preempted_window):
+                    book.ready_slices = 0
+                    book.tier_slices = {}
+                    self._fold_window_locked(book)
+        return completed
+
+    @staticmethod
+    def _preempted_pending(book: _VariantBook) -> int:
+        hosts = max(book.hosts_per_slice, 1)
+        return -(-len(book.preempted_window) // hosts)
+
+    def _fold_window_locked(self, book: _VariantBook) -> None:
+        """Discovery re-confirmed the variant: bake the watch-observed
+        losses into the cumulative preemption count (whole slices) and
+        reset the per-window transients."""
+        book.preempted_total += self._preempted_pending(book)
+        book.preempted_window.clear()
+        book.lost_slices = 0
+        book.lost_nodes.clear()
+
+    # --- node watch feed ---
+
+    def on_node_event(self, event: str, node, now: float) -> str | None:
+        """A node went away (DELETED) or stopped being schedulable
+        (NotReady / cordon): mark the backing slice lost so planning
+        capacity drops THIS tick, before the next discovery pass
+        re-confirms. Returns the affected variant (for the re-solve
+        nudge), or None when the node is not TPU-backed or the event is
+        not a loss."""
+        labels = node.metadata.labels or {}
+        accel = labels.get(GKE_TPU_ACCELERATOR_NODE_LABEL, "")
+        if not accel:
+            return None
+        chips = parse_quantity(
+            node.status.allocatable.get(TPU_RESOURCE_NAME, "0"))
+        info = parse_tpu_topology(
+            accel, labels.get(GKE_TPU_TOPOLOGY_NODE_LABEL, ""),
+            chips_per_host=chips)
+        if info is None:
+            return None
+        # An ADDED node is never a loss: real GKE nodes register NotReady
+        # and flip Ready later — deducting a slice that was never counted
+        # as ready would shrink planned capacity exactly while it grows.
+        if event == "ADDED":
+            return None
+        name = node.metadata.name
+        lost = (event == "DELETED"
+                or not getattr(node, "ready", True)
+                or getattr(node, "unschedulable", False))
+        if not lost:
+            # A previously-lost node RECOVERED (NotReady flap resolved,
+            # uncordoned): release the loss so planning capacity comes
+            # back without waiting for the next discovery pass.
+            with self._mu:
+                book = self._books.get(info.variant)
+                if book is not None and name in book.lost_nodes:
+                    book.lost_nodes.discard(name)
+                    hosts = max(book.hosts_per_slice, 1)
+                    book.lost_slices = min(
+                        -(-len(book.lost_nodes) // hosts),
+                        book.ready_slices)
+            return None
+        spot = tier_for_node_labels(labels) == TIER_SPOT
+        with self._mu:
+            book = self._book(info.variant)
+            if spot and event == "DELETED":
+                # Preemption accounting is per DELETED host, independent
+                # of the loss dedup: the realistic NotReady -> DELETED
+                # sequence must still count, once. Folded into
+                # preempted_total as whole slices at the next discovery
+                # pass.
+                book.preempted_window.add(name)
+            if name in book.lost_nodes:
+                return None  # NotReady then DELETED: one loss, not two
+            book.lost_nodes.add(name)
+            # One lost host degrades the whole slice containing it, but
+            # per-host events of one multi-host slice are ONE lost slice:
+            # group by the variant's hosts-per-slice (membership is not
+            # tracked, so interleaved single-host losses across slices
+            # under-count — conservative for planning, which discovery
+            # corrects on its next pass).
+            hosts = max(book.hosts_per_slice, 1)
+            book.lost_slices = min(-(-len(book.lost_nodes) // hosts),
+                                   book.ready_slices)
+        return info.variant
+
+    # --- provisioning feed ---
+
+    def note_request(self, req: InFlightRequest) -> None:
+        with self._mu:
+            book = self._book(req.variant)
+            book.inflight[req.request_id] = req
+            if book.chips_per_slice <= 0:
+                # Discovery has never reported this variant (first slices
+                # still materializing): the order's own slice size keeps
+                # snapshot()/gauges honest until discovery confirms.
+                book.chips_per_slice = req.chips_per_slice
+
+    def note_stockout(self, variant: str, tier: str, now: float,
+                      reprobe_seconds: float) -> float:
+        """Pin (variant, tier) stocked out; consecutive denials grow the
+        re-probe interval geometrically (capped). Returns the pin expiry."""
+        with self._mu:
+            book = self._book(variant)
+            streak = book.stockout_streak.get(tier, 0) + 1
+            book.stockout_streak[tier] = streak
+            mult = min(2 ** (streak - 1), MAX_REPROBE_BACKOFF)
+            until = now + reprobe_seconds * mult
+            book.stockout_until[tier] = until
+            return until
+
+    def tier_open(self, variant: str, tier: str, now: float) -> bool:
+        """May we submit a request through this tier right now? A pinned
+        tier re-opens for ONE probe once its re-probe time passes."""
+        with self._mu:
+            return now >= self._book(variant).stockout_until.get(tier, 0.0)
+
+    def clear_stockout(self, variant: str, tier: str) -> None:
+        """An accepted request proves the tier has stock again."""
+        with self._mu:
+            book = self._book(variant)
+            book.stockout_until.pop(tier, None)
+            book.stockout_streak.pop(tier, None)
+
+    def expire_overdue(self, now: float) -> list[InFlightRequest]:
+        """Drop in-flight requests whose credit window lapsed (wedged or
+        silently failed provisioning) so the pool stops planning against
+        them. The manager decides whether to re-order."""
+        expired = []
+        with self._mu:
+            for book in self._books.values():
+                for rid in [r for r, req in book.inflight.items()
+                            if now > req.credit_expires()]:
+                    expired.append(book.inflight.pop(rid))
+        return expired
+
+    # --- planning reads ---
+
+    def ready_chips(self, variant: str) -> int:
+        """Schedulable chips net of watch-observed losses discovery has
+        not re-confirmed yet (same-tick preemption release)."""
+        with self._mu:
+            book = self._books.get(variant)
+            if book is None:
+                return 0
+            return max(book.ready_slices - book.lost_slices, 0) \
+                * book.chips_per_slice
+
+    def provisioning_chips(self, variant: str, now: float) -> int:
+        """Chips of in-flight requests still inside their credit window —
+        the "arriving within lead time" pool extension."""
+        with self._mu:
+            book = self._books.get(variant)
+            if book is None:
+                return 0
+            return sum(req.chips for req in book.inflight.values()
+                       if now <= req.credit_expires())
+
+    def inflight_slices(self, variant: str) -> int:
+        with self._mu:
+            book = self._books.get(variant)
+            return sum(r.slices for r in book.inflight.values()) \
+                if book else 0
+
+    def has_request(self, variant: str) -> bool:
+        with self._mu:
+            book = self._books.get(variant)
+            return bool(book and book.inflight)
+
+    def tier_mix(self, variant: str) -> dict[str, int]:
+        with self._mu:
+            book = self._books.get(variant)
+            return dict(book.tier_slices) if book else {}
+
+    def known_variants(self) -> list[str]:
+        with self._mu:
+            return sorted(self._books)
+
+    def chips_per_slice(self, variant: str) -> int:
+        with self._mu:
+            book = self._books.get(variant)
+            return book.chips_per_slice if book else 0
+
+    def blended_tier_weight(self, variant: str,
+                            weights: dict[str, float]) -> float:
+        """Ready-slice-weighted mean of the tier cost weights — the factor
+        the fleet solver scales this variant's per-slice cost by (a
+        spot-heavy pool genuinely competes on price)."""
+        with self._mu:
+            book = self._books.get(variant)
+            if book is None or not book.tier_slices:
+                return 1.0
+            total = sum(book.tier_slices.values())
+            if total <= 0:
+                return 1.0
+            return sum(weights.get(t, 1.0) * n
+                       for t, n in book.tier_slices.items()) / total
+
+    # --- observability ---
+
+    def snapshot(self, now: float) -> list[dict]:
+        """Sorted per-variant state for the trace stage + gauges."""
+        out = []
+        with self._mu:
+            for variant in sorted(self._books):
+                book = self._books[variant]
+                ready = max(book.ready_slices - book.lost_slices, 0)
+                provisioning = sum(
+                    r.slices for r in book.inflight.values()
+                    if now <= r.credit_expires())
+                stocked = sorted(
+                    t for t, until in book.stockout_until.items()
+                    if until > now)
+                out.append({
+                    "variant": variant,
+                    "chips_per_slice": book.chips_per_slice,
+                    STATE_READY: ready,
+                    STATE_PROVISIONING: provisioning,
+                    STATE_PREEMPTED: book.lost_slices,
+                    # Cumulative, including the not-yet-folded window so a
+                    # same-tick trace/gauge read sees the loss immediately.
+                    "preempted_total": book.preempted_total
+                    + self._preempted_pending(book),
+                    "tier_slices": dict(sorted(book.tier_slices.items())),
+                    "stocked_out_tiers": stocked,
+                })
+        return out

@@ -1,0 +1,312 @@
+"""The capacity manager: the decision loop's interface to elastic slice
+inventory.
+
+Per engine tick (after analysis, around the limiter):
+
+1. ``note_demand`` snapshots the fleet's PRE-limiter desired chips per
+   variant (the limiter clamps targets to inventory, so post-limiter
+   targets can never express a shortfall);
+2. ``tick`` reconciles the ledger against a fresh discovery snapshot
+   (retiring materialized requests and recording their measured
+   provisioning lead), expires wedged orders, computes each variant's
+   shortfall against ready + in-flight capacity, and submits provisioning
+   requests — tier-preference ordered, deduped against outstanding orders,
+   jitter-backed-off after failures, and circuit-broken per (variant,
+   tier) on quota stockout;
+3. the pool the limiter and the fleet solver see is extended by
+   ``provisioning_chips`` (capacity arriving within its credited lead).
+
+Everything is flight-recorded as one ``capacity`` stage event per tick.
+The manager never mutates decisions: its influence on the decision path
+flows exclusively through the inventory pools the limiter records, which
+is what keeps capacity-enabled traces replayable from the recorded pool
+snapshot alone.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import math
+import random
+import threading
+
+from wva_tpu.capacity.ledger import CapacityLedger, InFlightRequest
+from wva_tpu.capacity.provisioner import ProvisionResult, SliceProvisioner
+from wva_tpu.capacity.tiers import (
+    DEFAULT_TIER_COST_WEIGHTS,
+    DEFAULT_TIER_PREFERENCE,
+)
+from wva_tpu.utils.backoff import BackoffState
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+# Ceiling on slices ordered for one variant in one tick: a demand spike must
+# not translate into an unbounded cloud order (the next tick re-evaluates
+# with the first batch already in flight).
+MAX_SLICES_PER_REQUEST = 8
+
+OUTCOME_ACCEPTED = "accepted"
+OUTCOME_QUOTA_DENIED = "quota_denied"
+OUTCOME_FAILED = "failed"
+OUTCOME_DEDUPED = "deduped"
+
+
+class CapacityManager:
+    """Elastic capacity control plane (``WVA_CAPACITY``, default on)."""
+
+    def __init__(self, discovery, provisioner: SliceProvisioner,
+                 leadtime=None,
+                 tier_preference: tuple[str, ...] = DEFAULT_TIER_PREFERENCE,
+                 tier_weights: dict[str, float] | None = None,
+                 stockout_reprobe_seconds: float = 300.0,
+                 default_lead_seconds: float = 180.0,
+                 clock: Clock | None = None,
+                 seed: int = 0) -> None:
+        self.discovery = discovery
+        self.provisioner = provisioner
+        # Shared with the forecast planner when forecasting is on: both
+        # planes learn from the same measured lead times.
+        self.leadtime = leadtime
+        self.ledger = CapacityLedger()
+        self.tier_preference = tuple(tier_preference)
+        self.tier_weights = dict(tier_weights or DEFAULT_TIER_COST_WEIGHTS)
+        self.stockout_reprobe_seconds = stockout_reprobe_seconds
+        self.default_lead_seconds = default_lead_seconds
+        self.clock = clock or SYSTEM_CLOCK
+        self._mu = threading.Lock()
+        self._rng = random.Random(seed)
+        self._req_ids = itertools.count(1)
+        # Pre-limiter demand snapshot for the current tick.
+        self._tick_demand: dict[str, int] = {}
+        # Per-variant jittered retry backoff for FAILED (non-quota)
+        # submissions; quota denials go through the ledger's circuit
+        # breaker instead.
+        self._backoff: dict[str, BackoffState] = {}
+        # Rolling request log for tests / the e2e's zero-repeat-requests
+        # assertion: (now, variant, tier, slices, outcome).
+        self.request_log: list[tuple[float, str, str, int, str]] = []
+        # Per-variant chips-per-replica seen in decisions: the slice-size
+        # bootstrap for variants discovery has never reported (a brand-new
+        # variant's FIRST order must be sizeable before any slice exists).
+        self._chip_hint: dict[str, int] = {}
+
+    # --- watch feed (informer nudge listener registers this) ---
+
+    def on_node_event(self, event: str, obj) -> str | None:
+        """Node watch event -> ledger loss accounting. Returns the affected
+        variant when a slice was lost (callers use it to nudge an immediate
+        re-solve in wall-clock mode)."""
+        return self.ledger.on_node_event(event, obj, self.clock.now())
+
+    # --- engine hooks ---
+
+    def note_demand(self, decisions) -> None:
+        """Snapshot the tick's PRE-limiter desired chips per variant."""
+        demand: dict[str, int] = {}
+        hints: dict[str, int] = {}
+        for d in decisions:
+            if not d.accelerator_name:
+                continue
+            per_replica = max(d.chips_per_replica, 1)
+            chips = per_replica * max(d.target_replicas, 0)
+            demand[d.accelerator_name] = \
+                demand.get(d.accelerator_name, 0) + chips
+            hints[d.accelerator_name] = max(
+                hints.get(d.accelerator_name, 0), per_replica)
+        with self._mu:
+            self._tick_demand = demand
+            self._chip_hint.update(hints)
+
+    def pool_credit_chips(self, variant: str) -> int:
+        """Extra chips the inventory pool may plan against: in-flight
+        provisioning inside its credited lead window."""
+        return self.ledger.provisioning_chips(variant, self.clock.now())
+
+    def tier_cost_weight(self, variant: str) -> float:
+        return self.ledger.blended_tier_weight(variant, self.tier_weights)
+
+    def credit_only_pools(self, existing: set[str]) -> dict[str, int]:
+        """Variants with in-flight provisioning credit but NO discovered
+        pool yet (first slices still materializing) -> credit chips, for
+        the inventory to surface as pools."""
+        now = self.clock.now()
+        out: dict[str, int] = {}
+        for variant in self.ledger.known_variants():
+            if variant in existing:
+                continue
+            credit = self.ledger.provisioning_chips(variant, now)
+            if credit > 0:
+                out[variant] = credit
+        return out
+
+    def tick(self, slices: dict | None = None) -> dict:
+        """One capacity pass; returns the ``capacity`` stage event payload
+        (ledger snapshot + this tick's provisioning activity). ``slices``
+        is the tick's discovery snapshot when the caller already computed
+        one (the limiter's inventory refresh — no point listing and
+        parsing the node fleet a second time in the same tick); None runs
+        a fresh discovery pass."""
+        now = self.clock.now()
+        if slices is None:
+            try:
+                slices = self.discovery.discover_slices()
+            except Exception as e:  # noqa: BLE001 — capacity must never
+                # fail the tick; planning degrades to last-known inventory.
+                log.error("capacity: slice discovery failed: %s", e)
+                slices = None
+        # An EMPTY snapshot is real information (every node gone) and must
+        # reconcile; only a failed discovery skips it.
+        completed = [] if slices is None \
+            else self.ledger.observe_discovery(slices, now)
+        for c in completed:
+            self._record_lead(c.request.variant, c.request.tier, c.latency)
+            self._backoff_for(c.request.variant).success()
+        expired = self.ledger.expire_overdue(now)
+        for req in expired:
+            # A silently-wedged order is a failure for backoff purposes:
+            # the next attempt for the variant is delayed, not immediate.
+            self._backoff_for(req.variant).failure(now)
+            log.warning("capacity: provisioning request %s (%s x%d via %s) "
+                        "never materialized; dropping its planning credit",
+                        req.request_id, req.variant, req.slices, req.tier)
+
+        requests = self._provision_shortfalls(slices or {}, now)
+        return {
+            "ledger": self.ledger.snapshot(now),
+            "requests": requests,
+            "completed": [{
+                "request_id": c.request.request_id,
+                "variant": c.request.variant,
+                "tier": c.request.tier,
+                "slices": c.request.slices,
+                "latency_seconds": round(c.latency, 3),
+            } for c in completed],
+            "expired": [{
+                "request_id": r.request_id, "variant": r.variant,
+                "tier": r.tier, "slices": r.slices,
+            } for r in expired],
+        }
+
+    # --- internals ---
+
+    def _backoff_for(self, variant: str) -> BackoffState:
+        with self._mu:
+            st = self._backoff.get(variant)
+            if st is None:
+                st = self._backoff[variant] = BackoffState(
+                    initial=5.0, cap=300.0, rng=self._rng)
+            return st
+
+    def _record_lead(self, variant: str, tier: str, latency: float) -> None:
+        if self.leadtime is not None and latency > 0:
+            self.leadtime.record_provisioning(variant, tier, latency)
+
+    def _lead_estimate(self, variant: str, tier: str) -> float:
+        if self.leadtime is not None:
+            lead, measured = self.leadtime.provisioning_estimate(variant,
+                                                                 tier)
+            if measured:
+                return lead
+        return self.default_lead_seconds
+
+    def _provision_shortfalls(self, slices: dict, now: float) -> list[dict]:
+        with self._mu:
+            demand = dict(self._tick_demand)
+            hints = dict(self._chip_hint)
+        requests: list[dict] = []
+        for variant in sorted(demand):
+            chips_needed = demand[variant]
+            cap = slices.get(variant)
+            # Slice size: discovery is authoritative; the ledger remembers
+            # variants discovery USED to report; the decision's own
+            # chips-per-replica bootstraps a variant no slice has ever
+            # existed for (replicas span whole slices in this domain).
+            chips_per_slice = (cap.chips_per_slice if cap is not None
+                               else self.ledger.chips_per_slice(variant)
+                               or hints.get(variant, 0))
+            if chips_per_slice <= 0:
+                continue
+            supply = self.ledger.ready_chips(variant) \
+                + self.ledger.provisioning_chips(variant, now)
+            shortfall = chips_needed - supply
+            if shortfall <= 0:
+                continue
+            if self.ledger.has_request(variant):
+                # Dedup: one outstanding order per variant. The next tick
+                # re-evaluates once it lands (or expires).
+                self._log_request(now, variant, "", 0, OUTCOME_DEDUPED)
+                continue
+            if not self._backoff_for(variant).ready(now):
+                continue
+            count = min(math.ceil(shortfall / chips_per_slice),
+                        MAX_SLICES_PER_REQUEST)
+            event = self._submit(variant, count, chips_per_slice, now)
+            if event is not None:
+                requests.append(event)
+        return requests
+
+    def _submit(self, variant: str, count: int, chips_per_slice: int,
+                now: float) -> dict | None:
+        """Walk the tier preference order, skipping circuit-broken tiers;
+        the first accepted submission wins. Every quota denial pins its
+        tier; a transport error falls through to the NEXT tier (the
+        preference order exists precisely to provide fallbacks — one flaky
+        endpoint must not stall replacement capacity) and only backs the
+        variant off when EVERY tier failed; all tiers denied/broken leaves
+        the variant stocked out until a re-probe window opens."""
+        last_error: dict | None = None
+        for tier in self.tier_preference:
+            if not self.ledger.tier_open(variant, tier, now):
+                continue
+            try:
+                result = self.provisioner.request_slices(
+                    variant, tier, count, now)
+            except Exception as e:  # noqa: BLE001 — transport errors get
+                # backoff, never a stockout pin (they are not evidence of
+                # missing stock) and never fail the tick.
+                log.warning("capacity: provisioner error for %s via %s: %s",
+                            variant, tier, e)
+                self._log_request(now, variant, tier, count, OUTCOME_FAILED)
+                last_error = {"variant": variant, "tier": tier,
+                              "slices": count, "outcome": OUTCOME_FAILED,
+                              "message": str(e)}
+                continue
+            if result.accepted:
+                lead = (result.eta_seconds if result.eta_seconds > 0
+                        else self._lead_estimate(variant, tier))
+                rid = result.request_id or \
+                    f"req-{variant}-{next(self._req_ids)}"
+                self.ledger.note_request(InFlightRequest(
+                    request_id=rid, variant=variant, tier=tier,
+                    slices=count, chips_per_slice=chips_per_slice,
+                    requested_at=now, eta=now + lead))
+                self.ledger.clear_stockout(variant, tier)
+                self._log_request(now, variant, tier, count,
+                                  OUTCOME_ACCEPTED)
+                return {"variant": variant, "tier": tier, "slices": count,
+                        "outcome": OUTCOME_ACCEPTED, "request_id": rid,
+                        "eta_seconds": round(lead, 1)}
+            if result.quota_denied:
+                until = self.ledger.note_stockout(
+                    variant, tier, now, self.stockout_reprobe_seconds)
+                self._log_request(now, variant, tier, count,
+                                  OUTCOME_QUOTA_DENIED)
+                log.warning("capacity: %s stocked out via %s until t=%.0f "
+                            "(%s)", variant, tier, until, result.message)
+                continue  # try the next tier
+            # Declined without a quota signal (NullProvisioner): nothing
+            # to order through this tier, try the next.
+        if last_error is not None:
+            # No tier accepted and at least one errored: pace the next
+            # attempt for the variant.
+            self._backoff_for(variant).failure(now)
+        return last_error
+
+    def _log_request(self, now: float, variant: str, tier: str, count: int,
+                     outcome: str) -> None:
+        with self._mu:
+            self.request_log.append((now, variant, tier, count, outcome))
+            if len(self.request_log) > 4096:
+                del self.request_log[:2048]

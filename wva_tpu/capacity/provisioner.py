@@ -1,0 +1,77 @@
+"""Slice provisioner interface: how the capacity plane asks the cloud for
+more TPU slices.
+
+The control loop never blocks on provisioning — ``request_slices`` is a
+cheap *submission* (GKE: a node-pool create/resize API call) and the
+fulfillment is observed asynchronously through discovery (nodes appearing)
+and the ledger's in-flight accounting. Quota stockouts are a first-class
+outcome, not an exception: GKE rejects the request synchronously with a
+quota error, and the caller's circuit breaker pins the (variant, tier) as
+unavailable until a time-decayed re-probe.
+
+Implementations:
+
+- :class:`wva_tpu.emulator.gke_provisioner.FakeGkeProvisioner` — the
+  emulation-world implementation with configurable provisioning delay,
+  seeded spot preemption injection, and per-tier quota stockouts;
+- :class:`NullProvisioner` — the default in live deployments until a real
+  GKE client is wired: every request is declined, so the autoscaler plans
+  strictly within discovered inventory (exactly the pre-capacity-plane
+  behavior).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+
+
+@dataclass
+class ProvisionResult:
+    """Outcome of one slice request submission."""
+
+    accepted: bool = False
+    request_id: str = ""
+    # Provisioner's own delivery estimate; 0 = unknown (the ledger then
+    # uses the measured per-(variant, tier) provisioning lead).
+    eta_seconds: float = 0.0
+    # Quota / reservation stockout: the deterministic "cannot materialize"
+    # signal that trips the circuit breaker. Transient transport errors
+    # must leave this False (they get retry-with-backoff, not a pin).
+    quota_denied: bool = False
+    message: str = ""
+
+
+class SliceProvisioner(abc.ABC):
+    """Asynchronous TPU slice provisioning (GKE node-pool create/resize)."""
+
+    @abc.abstractmethod
+    def request_slices(self, variant: str, tier: str, count: int,
+                       now: float) -> ProvisionResult:
+        """Submit a request for ``count`` whole slices of ``variant``
+        through capacity ``tier``. Must be idempotent under dedup: a
+        repeated submission for the same outstanding need returns the
+        existing request instead of double-ordering."""
+
+    def release_slices(self, variant: str, tier: str, count: int,
+                       now: float) -> None:
+        """Optional: hand back idle slices (node-pool shrink). Default
+        no-op — scale-down economics are owned by the solver's cost terms,
+        and slice teardown is deliberately conservative."""
+
+    def cancel(self, request_id: str, now: float) -> bool:
+        """Optional: cancel an in-flight request. Default no-op (GKE
+        node-pool operations are not reliably cancelable)."""
+        return False
+
+
+class NullProvisioner(SliceProvisioner):
+    """Declines every request: the autoscaler plans within discovered
+    inventory only. The safe default until a real cloud client is wired."""
+
+    def request_slices(self, variant: str, tier: str, count: int,
+                       now: float) -> ProvisionResult:
+        return ProvisionResult(
+            accepted=False,
+            message="no slice provisioner configured; planning within "
+                    "discovered inventory")

@@ -1,0 +1,89 @@
+"""Capacity cost tiers for TPU slice provisioning.
+
+GKE sells the same slice shape through three commercial channels with very
+different economics and availability semantics (AIBrix makes exactly this
+heterogeneous, cost-tiered pool structure a first-class autoscaling input):
+
+- **reservation** — pre-purchased capacity: cheapest effective rate, never
+  preempted, but a finite stock that can run out ("stocked out");
+- **on_demand** — pay-as-you-go: always the list price, subject to regional
+  quota;
+- **spot** — deeply discounted, preemptible at any moment with ~30s notice.
+
+The provisioner requests tiers in *preference order* (reservation first);
+the cost *weights* scale a variant's per-slice cost in the fleet solver so
+a spot-backed pool genuinely competes on price while reservation-backed
+capacity stays the default choice.
+
+This module is a leaf (no imports from the rest of the package) so
+discovery can classify nodes into tiers without a dependency cycle.
+"""
+
+from __future__ import annotations
+
+TIER_RESERVATION = "reservation"
+TIER_ON_DEMAND = "on_demand"
+TIER_SPOT = "spot"
+
+# Cheapest-stable-first: reservations are sunk cost, on-demand is the
+# dependable fallback, spot is last (cheap but evaporates mid-serve).
+DEFAULT_TIER_PREFERENCE: tuple[str, ...] = (
+    TIER_RESERVATION, TIER_ON_DEMAND, TIER_SPOT)
+
+# Relative cost of one slice-hour per tier (on-demand = 1.0). Roughly GKE's
+# committed-use / spot discount ballpark; operators override per deployment
+# (WVA_CAPACITY_TIER_WEIGHTS).
+DEFAULT_TIER_COST_WEIGHTS: dict[str, float] = {
+    TIER_RESERVATION: 0.6,
+    TIER_ON_DEMAND: 1.0,
+    TIER_SPOT: 0.3,
+}
+
+# GKE node labels the tier is read from.
+GKE_SPOT_NODE_LABEL = "cloud.google.com/gke-spot"
+GKE_PREEMPTIBLE_NODE_LABEL = "cloud.google.com/gke-preemptible"
+GKE_RESERVATION_NODE_LABEL = "cloud.google.com/reservation-name"
+
+
+def tier_for_node_labels(labels: dict[str, str]) -> str:
+    """Classify a node into its capacity tier from GKE labels; unlabeled
+    nodes are on-demand (the GKE default)."""
+    if labels.get(GKE_SPOT_NODE_LABEL) == "true" \
+            or labels.get(GKE_PREEMPTIBLE_NODE_LABEL) == "true":
+        return TIER_SPOT
+    if labels.get(GKE_RESERVATION_NODE_LABEL):
+        return TIER_RESERVATION
+    return TIER_ON_DEMAND
+
+
+def parse_tier_weights(raw: str) -> dict[str, float]:
+    """``"reservation=0.6,on_demand=1.0,spot=0.3"`` -> weights dict, merged
+    over the defaults (unknown tiers rejected so a typo cannot silently
+    drop a weight)."""
+    out = dict(DEFAULT_TIER_COST_WEIGHTS)
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"invalid tier weight entry {part!r}")
+        tier, _, value = part.partition("=")
+        tier = tier.strip()
+        if tier not in out:
+            raise ValueError(f"unknown capacity tier {tier!r}")
+        out[tier] = float(value)
+    return out
+
+
+def parse_tier_preference(raw: str) -> tuple[str, ...]:
+    """``"reservation,spot"`` -> preference order (subset allowed: omitting
+    a tier forbids provisioning through it)."""
+    if not raw:
+        return DEFAULT_TIER_PREFERENCE
+    tiers = tuple(t.strip() for t in raw.split(",") if t.strip())
+    for t in tiers:
+        if t not in DEFAULT_TIER_COST_WEIGHTS:
+            raise ValueError(f"unknown capacity tier {t!r}")
+    if not tiers:
+        return DEFAULT_TIER_PREFERENCE
+    return tiers

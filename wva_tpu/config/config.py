@@ -160,6 +160,32 @@ class ForecastConfig:
 
 
 @dataclass
+class CapacityConfig:
+    """Elastic capacity plane (``wva_tpu.capacity``): slice provisioning,
+    preemption resilience, reservation/spot-aware inventory
+    (docs/design/capacity.md). Default ON; ``WVA_CAPACITY=off`` restores
+    byte-identical pre-capacity decisions (same discipline as
+    ``WVA_FORECAST=off``)."""
+
+    enabled: bool = True
+    # Tier order the provisioner tries (first = preferred). Omitting a
+    # tier forbids provisioning through it.
+    tier_preference: tuple[str, ...] = (
+        "reservation", "on_demand", "spot")
+    # Relative cost of one slice-hour per tier (on-demand = 1.0); scales
+    # variant cost in the fleet solver by the pool's ready-slice blend.
+    tier_cost_weights: dict[str, float] = field(
+        default_factory=lambda: {"reservation": 0.6, "on_demand": 1.0,
+                                 "spot": 0.3})
+    # Base re-probe interval after a quota stockout pins a (variant, tier);
+    # consecutive stockouts grow it geometrically (capped at 8x).
+    stockout_reprobe_seconds: float = 300.0
+    # Provisioning-lead fallback until (variant, tier) latencies are
+    # measured — the ETA of the first order through a tier.
+    default_provision_lead_seconds: float = 180.0
+
+
+@dataclass
 class ConfigSyncState:
     configmaps_bootstrap_complete: bool = False
     last_configmaps_sync_at: float = 0.0
@@ -186,6 +212,7 @@ class Config:
         self._slo_ns: dict[str, "SLOConfigData"] = {}
         self._trace = TraceConfig()
         self._forecast = ForecastConfig()
+        self._capacity = CapacityConfig()
         # Bumped on every decision-affecting hot-reload (see mutation_epoch).
         self._epoch = 0
 
@@ -338,6 +365,21 @@ class Config:
     def set_forecast(self, f: ForecastConfig) -> None:
         with self._mu:
             self._forecast = copy.deepcopy(f)
+            self._bump_epoch_locked()
+
+    # --- elastic capacity plane (wva_tpu.capacity) ---
+
+    def capacity_config(self) -> CapacityConfig:
+        with self._mu:
+            return copy.deepcopy(self._capacity)
+
+    def capacity_enabled(self) -> bool:
+        with self._mu:
+            return self._capacity.enabled
+
+    def set_capacity(self, c: CapacityConfig) -> None:
+        with self._mu:
+            self._capacity = copy.deepcopy(c)
             self._bump_epoch_locked()
 
     # --- saturation config (namespace-aware; reference config.go:318-354) ---

@@ -15,6 +15,7 @@ from typing import Any, Mapping
 import yaml
 
 from wva_tpu.config.config import (
+    CapacityConfig,
     Config,
     EPPConfig,
     FeatureFlagsConfig,
@@ -68,6 +69,20 @@ DEFAULTS: dict[str, Any] = {
     "WVA_FORECAST_MIN_TRUST_EVALS": 3,
     "WVA_FORECAST_PREWAKE": True,
     "WVA_FORECAST_PREWAKE_MIN_DEMAND": 1.0,
+    # Elastic capacity plane (wva_tpu.capacity; docs/design/capacity.md).
+    # Default on; "off"/"false"/"0" disables (decisions then byte-identical
+    # to pre-capacity builds).
+    "WVA_CAPACITY": True,
+    # Tier preference order ("reservation,on_demand,spot"; omitting a tier
+    # forbids provisioning through it).
+    "WVA_CAPACITY_TIER_PREFERENCE": "",
+    # Per-tier cost weights, e.g. "reservation=0.6,on_demand=1.0,spot=0.3".
+    "WVA_CAPACITY_TIER_WEIGHTS": "",
+    # Base quota-stockout re-probe interval (grows geometrically on
+    # consecutive stockouts, capped at 8x).
+    "WVA_CAPACITY_STOCKOUT_REPROBE": "300s",
+    # Provisioning-lead fallback until (variant, tier) leads are measured.
+    "WVA_CAPACITY_DEFAULT_PROVISION_LEAD": "180s",
     "SCALE_FROM_ZERO_ENGINE_MAX_CONCURRENCY": 10,
     "EPP_METRIC_READER_BEARER_TOKEN": "",
     "GLOBAL_OPT_INTERVAL": "60s",
@@ -221,6 +236,23 @@ def load(flags: Mapping[str, Any] | None = None,
         min_trust_evals=r.get_int("WVA_FORECAST_MIN_TRUST_EVALS"),
         prewake_enabled=r.get_bool("WVA_FORECAST_PREWAKE"),
         prewake_min_demand=r.get_float("WVA_FORECAST_PREWAKE_MIN_DEMAND"),
+    ))
+
+    from wva_tpu.capacity.tiers import (
+        parse_tier_preference,
+        parse_tier_weights,
+    )
+
+    cfg.set_capacity(CapacityConfig(
+        enabled=r.get_bool("WVA_CAPACITY"),
+        tier_preference=parse_tier_preference(
+            r.get_str("WVA_CAPACITY_TIER_PREFERENCE")),
+        tier_cost_weights=parse_tier_weights(
+            r.get_str("WVA_CAPACITY_TIER_WEIGHTS")),
+        stockout_reprobe_seconds=r.get_duration(
+            "WVA_CAPACITY_STOCKOUT_REPROBE"),
+        default_provision_lead_seconds=r.get_duration(
+            "WVA_CAPACITY_DEFAULT_PROVISION_LEAD"),
     ))
 
     prom = PrometheusConfig(

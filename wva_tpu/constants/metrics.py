@@ -86,6 +86,24 @@ WVA_FORECAST_ERROR = "wva_forecast_error"
 # 1 when the model is demoted to reactive (rolling error over threshold).
 WVA_FORECAST_DEMOTED = "wva_forecast_demoted"
 
+# --- Elastic capacity plane (wva_tpu.capacity) ---
+# Whole slices per (variant, state): state is ready / provisioning /
+# preempted (watch-observed losses discovery has not re-confirmed yet).
+WVA_CAPACITY_SLICES = "wva_capacity_slices"
+# Chips the planner may allocate for the variant right now: ready plus
+# provisioning-arriving-within-lead-time.
+WVA_CAPACITY_CHIPS_EFFECTIVE = "wva_capacity_chips_effective"
+# 1 while the (variant, tier) is pinned stocked-out by the quota circuit
+# breaker (re-probe pending).
+WVA_CAPACITY_STOCKED_OUT = "wva_capacity_stocked_out"
+# Provisioning requests submitted, by (variant, tier, outcome).
+WVA_CAPACITY_PROVISION_TOTAL = "wva_capacity_provision_requests_total"
+# Spot slices lost to preemption (cumulative).
+WVA_CAPACITY_PREEMPTED_TOTAL = "wva_capacity_preempted_slices_total"
+# Measured slice provisioning lead (submission -> discovered ready) per
+# (variant, tier) — the actuation->scheduled phase of the lead-time split.
+WVA_CAPACITY_PROVISION_LEAD_SECONDS = "wva_capacity_provision_lead_seconds"
+
 # --- DemandTrend estimator health (analyzers/trend.py stats() hook) ---
 WVA_TREND_SERIES_SAMPLES = "wva_trend_series_samples"
 WVA_TREND_SERIES_STALENESS_SECONDS = "wva_trend_series_staleness_seconds"
@@ -118,5 +136,7 @@ LABEL_METRIC_NAME = "__name__"
 LABEL_ENGINE = "engine"
 LABEL_OUTCOME = "outcome"
 LABEL_FORECASTER = "forecaster"
+LABEL_STATE = "state"
+LABEL_TIER = "tier"
 
 __all__ = [n for n in dir() if n.isupper()]

@@ -6,6 +6,7 @@ import logging
 import os
 from dataclasses import dataclass, field
 
+from wva_tpu.capacity.tiers import tier_for_node_labels
 from wva_tpu.constants.labels import (
     GKE_NODEPOOL_NODE_LABEL,
     GKE_TPU_ACCELERATOR_NODE_LABEL,
@@ -104,6 +105,10 @@ class SliceCapacity:
     total_slices: int = 0
     total_chips: int = 0
     nodepools: list[str] = field(default_factory=list)
+    # Whole schedulable slices per capacity tier (reservation / on_demand /
+    # spot, from GKE node labels) — the capacity ledger's per-tier inventory
+    # and the fleet solver's cost-weight input.
+    tier_slices: dict[str, int] = field(default_factory=dict)
 
 
 def _parse_node_selector(selector: str) -> dict[str, str]:
@@ -136,7 +141,13 @@ class TPUSliceDiscovery:
         out = []
         for node in self.client.list(Node.KIND, label_selector=selector):
             labels = node.metadata.labels
-            if GKE_TPU_ACCELERATOR_NODE_LABEL not in labels or not node.ready:
+            if GKE_TPU_ACCELERATOR_NODE_LABEL not in labels:
+                continue
+            # Cordoned (spec.unschedulable) and NotReady hosts are not
+            # schedulable capacity. For a multi-host slice this correctly
+            # degrades the whole slice: the pool loses one host, so
+            # floor(hosts / hosts_per_slice) drops the slice.
+            if not node.ready or getattr(node, "unschedulable", False):
                 continue
             chips = parse_quantity(node.status.allocatable.get(TPU_RESOURCE_NAME, "0"))
             info = parse_tpu_topology(
@@ -174,19 +185,23 @@ class TPUSliceDiscovery:
     def _slices_from_snapshot(
         snapshot: list[tuple[Node, TpuTopologyInfo, int]],
     ) -> dict[str, SliceCapacity]:
-        pools: dict[tuple[str, str], tuple[TpuTopologyInfo, int, int]] = {}
+        pools: dict[tuple[str, str], tuple[TpuTopologyInfo, int, int, str]] = {}
         for node, info, chips in snapshot:
             pool_name = node.metadata.labels.get(
                 GKE_NODEPOOL_NODE_LABEL, node.metadata.name)
             key = (pool_name, info.variant)
+            # Node pools are tier-homogeneous on GKE (spot is a pool-level
+            # property), so the first host's labels classify the pool.
+            tier = tier_for_node_labels(node.metadata.labels)
             prev = pools.get(key)
             if prev is None:
-                pools[key] = (info, 1, chips)
+                pools[key] = (info, 1, chips, tier)
             else:
-                pools[key] = (info, prev[1] + 1, prev[2] + chips)
+                pools[key] = (info, prev[1] + 1, prev[2] + chips, prev[3])
 
         out: dict[str, SliceCapacity] = {}
-        for (pool_name, variant), (info, host_count, chip_count) in sorted(pools.items()):
+        for (pool_name, variant), (info, host_count, chip_count, tier) \
+                in sorted(pools.items()):
             slices = host_count // info.hosts
             cap = out.setdefault(variant, SliceCapacity(
                 variant=variant,
@@ -197,6 +212,8 @@ class TPUSliceDiscovery:
             cap.total_slices += slices
             cap.total_chips += chip_count
             cap.nodepools.append(pool_name)
+            if slices:
+                cap.tier_slices[tier] = cap.tier_slices.get(tier, 0) + slices
         return out
 
     # --- UsageDiscovery (reference DiscoverUsage :103-143) ---

@@ -14,6 +14,11 @@
 
 from wva_tpu.emulator.profiles import add_tpu_nodepool
 from wva_tpu.emulator.server_sim import ModelServerSim, ServingParams
+from wva_tpu.emulator.gke_provisioner import (
+    FakeGkeProvisioner,
+    TierPolicy,
+    default_tiers,
+)
 from wva_tpu.emulator.kubelet import FakeKubelet
 from wva_tpu.emulator.hpa import HPAEmulator, HPAParams
 from wva_tpu.emulator.loadgen import (
@@ -21,6 +26,7 @@ from wva_tpu.emulator.loadgen import (
     constant,
     diurnal,
     poisson_bursts,
+    preemption_storm,
     ramp,
     step_profile,
     trapezoid,
@@ -31,6 +37,9 @@ __all__ = [
     "add_tpu_nodepool",
     "ModelServerSim",
     "ServingParams",
+    "FakeGkeProvisioner",
+    "TierPolicy",
+    "default_tiers",
     "FakeKubelet",
     "HPAEmulator",
     "HPAParams",
@@ -38,6 +47,7 @@ __all__ = [
     "constant",
     "diurnal",
     "poisson_bursts",
+    "preemption_storm",
     "ramp",
     "step_profile",
     "trapezoid",

@@ -1,0 +1,273 @@
+"""Fake GKE slice provisioner: the emulation world's implementation of
+:class:`wva_tpu.capacity.SliceProvisioner`.
+
+Models the three behaviors that make TPU slice inventory *dynamic* on GKE
+(SURVEY.md section 7, ISSUE 7):
+
+- **provisioning delay** — an accepted request materializes as real Nodes
+  (via :func:`add_tpu_nodepool`, tier-labeled) only after a configurable
+  per-tier delay, so the controller must plan against capacity-in-flight;
+- **quota stockouts** — a per-tier slice quota; requests beyond it are
+  synchronously quota-denied (the stockout circuit breaker's trigger);
+- **spot preemption** — a seeded schedule of preemption events, each
+  deleting whole spot-tier slices (all hosts of the slice's node pool),
+  exactly the correlated capacity loss the ``preemption_storm`` scenario
+  injects while demand bursts.
+
+Deterministic: node names derive from a monotone counter, the preemption
+victim order from a seeded RNG, and all timing from the injected clock —
+harness worlds (and the capacity golden trace) stay byte-reproducible.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import random
+from dataclasses import dataclass, field
+
+from wva_tpu.capacity.provisioner import ProvisionResult, SliceProvisioner
+from wva_tpu.capacity.tiers import (
+    GKE_RESERVATION_NODE_LABEL,
+    GKE_SPOT_NODE_LABEL,
+    TIER_SPOT,
+)
+from wva_tpu.constants.labels import (
+    GKE_NODEPOOL_NODE_LABEL,
+    GKE_TPU_ACCELERATOR_NODE_LABEL,
+    GKE_TPU_TOPOLOGY_NODE_LABEL,
+    TPU_RESOURCE_NAME,
+)
+from wva_tpu.discovery.tpu import TPU_GENERATIONS, parse_tpu_topology
+from wva_tpu.emulator.profiles import add_tpu_nodepool
+from wva_tpu.k8s.client import KubeClient, NotFoundError
+from wva_tpu.k8s.objects import Node, parse_quantity
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class TierPolicy:
+    """One capacity tier's commercial behavior in the fake cloud."""
+
+    provision_delay_seconds: float = 180.0
+    # Total slices this tier may ever create; -1 = unlimited. Exhaustion is
+    # a quota stockout (synchronous denial), like a drained reservation.
+    quota_slices: int = -1
+    preemptible: bool = False
+
+
+def default_tiers() -> dict[str, TierPolicy]:
+    return {
+        "reservation": TierPolicy(provision_delay_seconds=120.0,
+                                  quota_slices=4),
+        "on_demand": TierPolicy(provision_delay_seconds=240.0,
+                                quota_slices=-1),
+        "spot": TierPolicy(provision_delay_seconds=90.0, quota_slices=-1,
+                           preemptible=True),
+    }
+
+
+@dataclass
+class _PendingOrder:
+    request_id: str
+    variant: str
+    tier: str
+    slices: int
+    due: float
+
+
+@dataclass
+class _OwnedPool:
+    """One node pool this provisioner created (one pool per slice, so a
+    preemption deletes exactly one whole slice's hosts)."""
+
+    pool_name: str
+    variant: str
+    tier: str
+    # (namespace, name) pairs — FakeCluster stores cluster-scoped Nodes
+    # under their metadata namespace, and a delete must match it.
+    nodes: list[tuple[str, str]] = field(default_factory=list)
+
+
+class FakeGkeProvisioner(SliceProvisioner):
+    """In-world slice provisioner over a :class:`FakeCluster`."""
+
+    def __init__(self, client: KubeClient, clock,
+                 tiers: dict[str, TierPolicy] | None = None,
+                 seed: int = 0) -> None:
+        self.client = client
+        self.clock = clock
+        self.tiers = tiers or default_tiers()
+        self._rng = random.Random(seed)
+        self._ids = itertools.count(1)
+        self._pending: list[_PendingOrder] = []
+        self._created_slices: dict[str, int] = {}  # tier -> total created
+        self._owned: list[_OwnedPool] = []
+        # Seeded preemption schedule: (at, slices_to_preempt), consumed in
+        # time order by step(). Preemptions only ever hit spot pools.
+        self._preemptions: list[tuple[float, int]] = []
+        self.preempted_slices_total = 0
+        # (now, variant, tier, count, outcome) — assertion surface.
+        self.request_log: list[tuple[float, str, str, int, str]] = []
+
+    # --- SliceProvisioner ---
+
+    def request_slices(self, variant: str, tier: str, count: int,
+                       now: float) -> ProvisionResult:
+        policy = self.tiers.get(tier)
+        if policy is None:
+            self.request_log.append((now, variant, tier, count, "no-tier"))
+            return ProvisionResult(
+                accepted=False, message=f"tier {tier!r} not offered")
+        # Dedup: an identical outstanding order is returned, not doubled.
+        for order in self._pending:
+            if order.variant == variant and order.tier == tier:
+                self.request_log.append((now, variant, tier, count,
+                                         "deduped"))
+                return ProvisionResult(
+                    accepted=True, request_id=order.request_id,
+                    eta_seconds=max(order.due - now, 0.0),
+                    message="outstanding order deduped")
+        if policy.quota_slices >= 0:
+            used = self._created_slices.get(tier, 0) \
+                + sum(o.slices for o in self._pending if o.tier == tier)
+            if used + count > policy.quota_slices:
+                self.request_log.append((now, variant, tier, count,
+                                         "quota_denied"))
+                return ProvisionResult(
+                    accepted=False, quota_denied=True,
+                    message=f"quota exceeded for tier {tier}: "
+                            f"{used}/{policy.quota_slices} slices used, "
+                            f"{count} requested")
+        rid = f"gke-op-{next(self._ids)}"
+        self._pending.append(_PendingOrder(
+            request_id=rid, variant=variant, tier=tier, slices=count,
+            due=now + policy.provision_delay_seconds))
+        self.request_log.append((now, variant, tier, count, "accepted"))
+        return ProvisionResult(
+            accepted=True, request_id=rid,
+            eta_seconds=policy.provision_delay_seconds,
+            message="node pool create scheduled")
+
+    # --- scenario controls ---
+
+    def schedule_preemptions(self, events: list[tuple[float, int]]) -> None:
+        """``[(absolute_time, slices), ...]`` spot preemption injections
+        (``preemption_storm`` emits world-relative times; the harness
+        shifts them by its start time)."""
+        self._preemptions = sorted(events)
+
+    # --- world loop ---
+
+    def step(self) -> None:
+        """Materialize due orders and fire due preemptions."""
+        now = self.clock.now()
+        due = [o for o in self._pending if o.due <= now]
+        if due:
+            self._pending = [o for o in self._pending if o.due > now]
+            for order in due:
+                self._materialize(order)
+        while self._preemptions and self._preemptions[0][0] <= now:
+            _, count = self._preemptions.pop(0)
+            self._preempt_spot_slices(count)
+
+    def _materialize(self, order: _PendingOrder) -> None:
+        gen, topology = self._shape_for(order.variant)
+        if gen is None:
+            log.warning("fake-gke: cannot materialize unknown variant %s",
+                        order.variant)
+            return
+        labels = {}
+        if self.tiers[order.tier].preemptible:
+            labels[GKE_SPOT_NODE_LABEL] = "true"
+        elif order.tier == "reservation":
+            labels[GKE_RESERVATION_NODE_LABEL] = "wva-reservation"
+        for s in range(order.slices):
+            n = self._created_slices.get(order.tier, 0)
+            self._created_slices[order.tier] = n + 1
+            pool_name = f"gke-{order.variant}-{order.tier}-{n}"
+            nodes = add_tpu_nodepool(self.client, pool_name, gen, topology,
+                                     num_slices=1, extra_labels=labels)
+            self._owned.append(_OwnedPool(
+                pool_name=pool_name, variant=order.variant, tier=order.tier,
+                nodes=[(nd.metadata.namespace, nd.metadata.name)
+                       for nd in nodes]))
+        log.info("fake-gke: materialized %d x %s via %s (%s)",
+                 order.slices, order.variant, order.tier, order.request_id)
+
+    def _shape_for(self, variant: str) -> tuple[str | None, str]:
+        """variant "v5e-8" -> (generation, topology) creating single-host
+        slices of that chip count (multi-host shapes come from explicit
+        nodepools; the elastic path provisions the common single-host
+        inventory)."""
+        gen, _, chips = variant.rpartition("-")
+        try:
+            n_chips = int(chips)
+        except ValueError:
+            return None, ""
+        for _, (short, _, _) in TPU_GENERATIONS.items():
+            if short == gen:
+                # A 1-D topology string multiplies out to the chip count.
+                return gen, f"1x{n_chips}"
+        return None, ""
+
+    def _preempt_spot_slices(self, count: int) -> None:
+        """Delete ``count`` whole spot slices (seeded victim order): the
+        ~30s GKE spot notice is below the world's tick resolution, so the
+        nodes just vanish — pods on them die with the host."""
+        spot_pools = [p for p in self._owned if p.tier == TIER_SPOT
+                      and p.nodes]
+        # Externally-created spot pools (harness nodepools with the spot
+        # label) are preemptible too — the storm must be able to hit
+        # pre-existing spot capacity, not only pools this object created.
+        external = self._external_spot_pools()
+        victims = spot_pools + external
+        self._rng.shuffle(victims)
+        for pool in victims[:count]:
+            deleted = 0
+            for ns, name in pool.nodes:
+                try:
+                    self.client.delete(Node.KIND, ns, name)
+                    deleted += 1
+                except NotFoundError:
+                    continue
+            pool.nodes = []
+            if deleted:
+                self.preempted_slices_total += 1
+                log.info("fake-gke: preempted spot slice pool %s (%s)",
+                         pool.pool_name, pool.variant)
+
+    def _external_spot_pools(self) -> list[_OwnedPool]:
+        """External spot capacity as per-SLICE victim units: a preemption
+        event takes whole slices, and lumping a multi-slice node pool into
+        one unit would let a single event wipe the pool."""
+        owned = {n for p in self._owned for n in p.nodes}
+        by_pool: dict[str, list[tuple[str, str, int]]] = {}
+        for node in self.client.list(Node.KIND):
+            labels = node.metadata.labels or {}
+            if labels.get(GKE_SPOT_NODE_LABEL) != "true":
+                continue
+            key = (node.metadata.namespace, node.metadata.name)
+            if key in owned:
+                continue
+            info = parse_tpu_topology(
+                labels.get(GKE_TPU_ACCELERATOR_NODE_LABEL, ""),
+                labels.get(GKE_TPU_TOPOLOGY_NODE_LABEL, ""),
+                chips_per_host=parse_quantity(
+                    node.status.allocatable.get(TPU_RESOURCE_NAME, "0")))
+            hosts = info.hosts if info is not None else 1
+            pool_name = labels.get(GKE_NODEPOOL_NODE_LABEL,
+                                   node.metadata.name)
+            by_pool.setdefault(pool_name, []).append((*key, hosts))
+        out: list[_OwnedPool] = []
+        for pool_name in sorted(by_pool):
+            entries = sorted(by_pool[pool_name])
+            hosts = entries[0][2]
+            for i in range(0, len(entries), max(hosts, 1)):
+                chunk = entries[i:i + max(hosts, 1)]
+                out.append(_OwnedPool(
+                    pool_name=f"{pool_name}#{i // max(hosts, 1)}",
+                    variant="", tier=TIER_SPOT,
+                    nodes=[(ns, name) for ns, name, _ in chunk]))
+        return out

@@ -80,6 +80,7 @@ class EmulationHarness:
         start_time: float = 1_000_000.0,
         stochastic_seed: int | None = None,
         trace_path: str | None = None,
+        provisioner=None,
     ) -> None:
         self.namespace = namespace
         self.variants = variants
@@ -126,9 +127,20 @@ class EmulationHarness:
             return "".join(sim.epp_exposition()
                            for sim in self._sims_by_model.values())
 
+        # Elastic capacity plane: a FakeGkeProvisioner (or any
+        # SliceProvisioner) makes slice inventory dynamic — the manager's
+        # CapacityManager orders slices through it, and run() steps it so
+        # orders materialize / preemptions fire on the world clock. A
+        # callable is a factory ``(cluster, clock) -> provisioner`` (the
+        # provisioner needs the world's cluster+clock, which only exist
+        # here).
+        if provisioner is not None and callable(provisioner) \
+                and not hasattr(provisioner, "request_slices"):
+            provisioner = provisioner(self.cluster, self.clock)
+        self.provisioner = provisioner
         self.manager: Manager = build_manager(
             self.cluster, self.config, clock=self.clock, tsdb=self.tsdb,
-            pod_fetcher=epp_fetcher)
+            pod_fetcher=epp_fetcher, slice_provisioner=provisioner)
         self.flight_recorder = self.manager.flight_recorder
         self.manager.engine.executor.max_retries_per_tick = 1
         self.manager.scale_from_zero.executor.max_retries_per_tick = 1
@@ -261,6 +273,8 @@ class EmulationHarness:
                     sim.emit_metrics(now)
                 self._last_emit = now
 
+            if self.provisioner is not None:
+                self.provisioner.step()
             self.kubelet.step()
 
             if now - self._last_sfz >= self.sfz_interval:

@@ -48,7 +48,12 @@ class FakeKubelet:
 
     def step(self) -> None:
         now = self.clock.now()
-        # Readiness first so the status refresh below sees pods that became
+        # Node losses first: pods whose host vanished (spot preemption,
+        # node-pool deletion) must die BEFORE reconciliation so the same
+        # step recreates them (the controller-manager's pod GC + ReplicaSet
+        # replacement, compressed into one pass).
+        self._handle_lost_nodes()
+        # Readiness next so the status refresh below sees pods that became
         # ready by now (otherwise statuses lag one step).
         self._mark_ready(now)
         for deploy in self.client.list(Deployment.KIND):
@@ -56,6 +61,30 @@ class FakeKubelet:
         for lws in self.client.list(LeaderWorkerSet.KIND):
             self._reconcile_lws(lws, now)
         self._retry_unscheduled(now)
+
+    def _handle_lost_nodes(self) -> None:
+        """Pods bound to deleted nodes are deleted (their owner recreates
+        them); pods on NotReady nodes lose readiness but survive (the node
+        may come back). Cordoned nodes keep their pods — cordon only blocks
+        NEW scheduling, exactly like kubectl cordon."""
+        nodes = {n.metadata.name: n for n in self.client.list(Node.KIND)}
+        for pod in self.client.list(Pod.KIND):
+            if not pod.node_name:
+                continue
+            node = nodes.get(pod.node_name)
+            if node is None:
+                try:
+                    self.client.delete(Pod.KIND, pod.metadata.namespace,
+                                       pod.metadata.name)
+                except NotFoundError:
+                    pass
+                self._pending.pop(pod.metadata.name, None)
+            elif not node.ready and pod.status.ready:
+                pod.status.ready = False
+                try:
+                    self.client.update_status(pod)
+                except NotFoundError:
+                    pass
 
     def _retry_unscheduled(self, now: float) -> None:
         """Re-attempt binding for pods stuck without a node — chips may have
@@ -234,6 +263,8 @@ class FakeKubelet:
                       for c in pod.spec.containers)
             used[pod.node_name] = used.get(pod.node_name, 0) + req
         for node in self.client.list(Node.KIND):
+            if not node.ready or getattr(node, "unschedulable", False):
+                continue  # NotReady / cordoned hosts take no new pods
             alloc = parse_quantity(node.status.allocatable.get(TPU_RESOURCE_NAME, "0"))
             if alloc - used.get(node.metadata.name, 0) >= chips_needed:
                 return node.metadata.name

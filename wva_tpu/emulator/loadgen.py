@@ -118,6 +118,49 @@ def poisson_bursts(base_rate: float, burst_rate: float,
     return profile
 
 
+def preemption_storm(base_rate: float, burst_rate: float,
+                     burst_duration: float, mean_gap: float,
+                     horizon: float, seed: int = 0,
+                     preemptions_per_burst: int = 1,
+                     preemption_lag: float = 30.0,
+                     ) -> tuple[LoadProfile, list[tuple[float, int]]]:
+    """Bursty demand with CORRELATED spot preemptions: each seeded burst
+    start also schedules a preemption event ``preemption_lag`` seconds in
+    (capacity dies exactly when demand spikes — the adversarial case for
+    the elastic capacity plane: re-converge within ticks, release the
+    preempted chips the same tick, and order replacements).
+
+    Returns ``(profile, events)`` where ``events`` is the
+    world-relative ``[(t, slices_to_preempt), ...]`` schedule for
+    :meth:`FakeGkeProvisioner.schedule_preemptions` (shift by the world's
+    start time) and ``make bench-capacity``. Burst starts are a seeded
+    Poisson process over ``[0, horizon)`` — precomputed, so the profile
+    and the schedule agree by construction and stay byte-reproducible.
+    """
+    rng = random.Random(seed)
+    starts: list[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(1.0 / max(mean_gap, 1e-9))
+        if t >= horizon:
+            break
+        starts.append(t)
+        t += burst_duration
+    events = [(round(s + preemption_lag, 3), preemptions_per_burst)
+              for s in starts
+              if s + preemption_lag < horizon]
+
+    def profile(tt: float) -> float:
+        for s in starts:
+            if s <= tt < s + burst_duration:
+                return burst_rate
+            if s > tt:
+                break
+        return base_rate
+
+    return profile, events
+
+
 @dataclass
 class SpikeProfile:
     """Idle -> spike -> idle, for scale-from-zero / scale-to-zero scenarios."""

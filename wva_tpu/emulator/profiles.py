@@ -34,12 +34,15 @@ def add_tpu_nodepool(
     topology: str,
     num_slices: int,
     chips_per_host: int | None = None,
+    extra_labels: dict[str, str] | None = None,
 ) -> list[Node]:
     """Create the hosts of ``num_slices`` whole slices of the given shape.
 
     e.g. ``add_tpu_nodepool(c, "v5e-pool", "v5e", "2x4", 8)`` creates 8
     single-host v5e-8 nodes; ``("mh-pool", "v5e", "4x4", 2,
     chips_per_host=4)`` creates 2 slices x 4 hosts of 4 chips each.
+    ``extra_labels`` rides on every host (capacity-tier labels like
+    ``cloud.google.com/gke-spot``).
     """
     accel = _ACCELERATOR_LABELS[generation]
     info = parse_tpu_topology(accel, topology,
@@ -56,6 +59,7 @@ def add_tpu_nodepool(
                         GKE_TPU_ACCELERATOR_NODE_LABEL: accel,
                         GKE_TPU_TOPOLOGY_NODE_LABEL: topology,
                         GKE_NODEPOOL_NODE_LABEL: pool_name,
+                        **(extra_labels or {}),
                     },
                 ),
                 status=NodeStatus(

@@ -69,16 +69,30 @@ from wva_tpu.api.v1alpha1 import (
     REASON_METRICS_MISSING,
     VariantAutoscaling,
 )
-from wva_tpu.blackbox.schema import STAGE_FINGERPRINT_SKIP, STAGE_FORECAST
+from wva_tpu.blackbox.schema import (
+    STAGE_CAPACITY,
+    STAGE_FINGERPRINT_SKIP,
+    STAGE_FORECAST,
+)
 from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
 from wva_tpu.collector.source.grouped import GroupedMetricsView
 from wva_tpu.config import Config
 from wva_tpu.constants import (
+    LABEL_ACCELERATOR_TYPE,
     LABEL_FORECASTER,
     LABEL_KIND,
     LABEL_MODEL_NAME,
     LABEL_NAMESPACE,
+    LABEL_OUTCOME,
+    LABEL_STATE,
+    LABEL_TIER,
     TPU_RESOURCE_NAME,
+    WVA_CAPACITY_CHIPS_EFFECTIVE,
+    WVA_CAPACITY_PREEMPTED_TOTAL,
+    WVA_CAPACITY_PROVISION_LEAD_SECONDS,
+    WVA_CAPACITY_PROVISION_TOTAL,
+    WVA_CAPACITY_SLICES,
+    WVA_CAPACITY_STOCKED_OUT,
     WVA_FORECAST_DEMAND,
     WVA_FORECAST_DEMOTED,
     WVA_FORECAST_ERROR,
@@ -228,6 +242,7 @@ class SaturationEngine:
         flight_recorder=None,
         analysis_workers: int = DEFAULT_ANALYSIS_WORKERS,
         forecast_planner=None,
+        capacity=None,
     ) -> None:
         self.client = client
         self.config = config
@@ -264,6 +279,18 @@ class SaturationEngine:
         # capacity quantities to forecast). None = pure reactive, decisions
         # byte-identical to pre-forecast builds.
         self.forecast = forecast_planner
+        # Optional capacity.CapacityManager (WVA_CAPACITY, default on from
+        # build_manager): elastic slice inventory — the limiter's pools
+        # extend to provisioning-in-flight capacity, post-analysis
+        # shortfalls become provisioning requests, preemptions release
+        # chips the same tick. None = static inventory, decisions
+        # byte-identical to pre-capacity builds.
+        self.capacity = capacity
+        # Cumulative preempted-slice counts the capacity gauge sweep saw
+        # last tick (counter emission needs deltas), and the limiter's
+        # per-tick discovery snapshot handed to the capacity pass.
+        self._capacity_preempted_seen: dict[str, int] = {}
+        self._tick_slices: dict | None = None
         # Label sets the trend/forecast gauge sweeps emitted last tick: a
         # deleted model's gauges are REMOVED from the registry, not left
         # frozen at their last value (an operator alerting on staleness
@@ -419,6 +446,11 @@ class SaturationEngine:
             # Retried ticks must not stack duplicate model records into the
             # failed attempt's cycle.
             self.flight.reset_cycle()
+        # Tick-scoped: the limiter's discovery snapshot for the capacity
+        # pass. Reset HERE, not per-path — any path that skips the limiter
+        # (no active VAs, V2 with zero requests) must leave the capacity
+        # pass on fresh discovery, never a previous tick's snapshot.
+        self._tick_slices = None
         # Informer staleness backstop: re-LIST any kind whose last list is
         # older than the resync interval (no-op on non-informer clients).
         resync = getattr(self.client, "resync_if_stale", None)
@@ -496,6 +528,7 @@ class SaturationEngine:
         if self.flight is not None:
             self.flight.record_decisions(decisions)
         self._apply_decisions(decisions, va_map, snap)
+        self._apply_capacity()
         self._emit_trend_metrics(analyzer_name)
         self._emit_control_plane_metrics()
 
@@ -1056,6 +1089,12 @@ class SaturationEngine:
                 variant_states=data.variant_states))
 
         if not requests and not cached_decisions:
+            if self.capacity is not None:
+                # The limiter (where the per-tick demand snapshot normally
+                # resets) is skipped on this path: clear it explicitly or
+                # the capacity pass would provision against LAST tick's
+                # demand.
+                self.capacity.note_demand([])
             return []
 
         decisions: list[VariantDecision] = []
@@ -1196,12 +1235,74 @@ class SaturationEngine:
         self._forecast_gauge_keys = \
             (self._forecast_gauge_keys & active) | emitted
 
+    def _apply_capacity(self) -> None:
+        """Elastic capacity pass (WVA_CAPACITY): reconcile the ledger
+        against discovery, retire/expire provisioning orders, submit
+        requests for this tick's shortfalls, flight-record the stage, and
+        emit the wva_capacity_* gauges. Runs AFTER decisions are applied:
+        capacity never mutates decisions — its influence flows through the
+        inventory pools the limiter already recorded, which keeps
+        capacity-enabled traces replayable from the pool snapshot alone."""
+        if self.capacity is None:
+            return
+        try:
+            event = self.capacity.tick(slices=self._tick_slices)
+        except Exception as e:  # noqa: BLE001 — capacity must never fail
+            # the tick: decisions stand as computed.
+            log.error("Capacity pass failed: %s", e)
+            return
+        if self.flight is not None and (
+                event["ledger"] or event["requests"]
+                or event["completed"] or event["expired"]):
+            self.flight.record_stage(STAGE_CAPACITY, event)
+        registry = getattr(self.actuator, "registry", None)
+        if registry is None:
+            return
+        for entry in event["ledger"]:
+            variant = entry["variant"]
+            vlabel = {LABEL_ACCELERATOR_TYPE: variant}
+            for state in ("ready", "provisioning", "preempted"):
+                registry.set_gauge(WVA_CAPACITY_SLICES,
+                                   {**vlabel, LABEL_STATE: state},
+                                   float(entry[state]))
+            registry.set_gauge(
+                WVA_CAPACITY_CHIPS_EFFECTIVE, vlabel,
+                float((entry["ready"] + entry["provisioning"])
+                      * entry["chips_per_slice"]))
+            stocked = set(entry["stocked_out_tiers"])
+            for tier in self.capacity.tier_preference:
+                registry.set_gauge(WVA_CAPACITY_STOCKED_OUT,
+                                   {**vlabel, LABEL_TIER: tier},
+                                   1.0 if tier in stocked else 0.0)
+            delta = entry["preempted_total"] \
+                - self._capacity_preempted_seen.get(variant, 0)
+            if delta > 0:
+                registry.inc_counter(WVA_CAPACITY_PREEMPTED_TOTAL, vlabel,
+                                     float(delta))
+            self._capacity_preempted_seen[variant] = entry["preempted_total"]
+        for req in event["requests"]:
+            registry.inc_counter(WVA_CAPACITY_PROVISION_TOTAL, {
+                LABEL_ACCELERATOR_TYPE: req["variant"],
+                LABEL_TIER: req["tier"],
+                LABEL_OUTCOME: req["outcome"],
+            })
+        for done in event["completed"]:
+            registry.set_gauge(WVA_CAPACITY_PROVISION_LEAD_SECONDS, {
+                LABEL_ACCELERATOR_TYPE: done["variant"],
+                LABEL_TIER: done["tier"],
+            }, done["latency_seconds"])
+
     def _apply_limiter(self, decisions: list[VariantDecision]) -> None:
         """Optional slice limiter, applied on EVERY analysis path (the
         reference leaves this a V1-only stage with a limited-mode TODO,
         engine.go:120-127/363-395; on TPU, clamping desired to whole-slice
         inventory matters everywhere — unplaceable replicas otherwise sit
         pending forever and keep the anticipated-supply math inflated)."""
+        if self.capacity is not None:
+            # PRE-limiter demand snapshot: the limiter clamps targets to
+            # inventory, so only the un-clamped targets can express the
+            # shortfall the provisioner should cover.
+            self.capacity.note_demand(decisions)
         global_cfg = self.config.saturation_config().get("default")
         # Two switches, either enables: the hot-reloadable ConfigMap's
         # enableLimiter, or the process-level WVA_LIMITED_MODE (the
@@ -1216,6 +1317,12 @@ class SaturationEngine:
             self.limiter.limit(decisions)
         except Exception as e:  # noqa: BLE001
             log.error("Limiter failed, proceeding with original decisions: %s", e)
+        if self.capacity is not None:
+            # Hand the limiter's just-refreshed discovery snapshot to the
+            # capacity pass (same tick, same world — a second node-fleet
+            # list + parse would be pure waste).
+            self._tick_slices = getattr(self.limiter.inventory,
+                                        "last_slices", None)
 
     def _run_v2_analysis(self, model_id: str, namespace: str, data: _ModelData,
                          sat_cfg: SaturationScalingConfig,
@@ -1317,16 +1424,27 @@ class SaturationEngine:
                         chips_per_replica=(
                             cap.chips_per_slice if cap is not None
                             else chips_by_accel.get(accel, 1)),
-                        cost=vc.cost)
+                        cost=vc.cost,
+                        # Reservation/spot-aware pricing: the pool's
+                        # ready-slice tier blend scales per-replica cost
+                        # (1.0 when the capacity plane is off).
+                        tier_cost_weight=(
+                            self.capacity.tier_cost_weight(accel)
+                            if self.capacity is not None else 1.0))
                     if cap is not None and accel not in counted_variants:
                         # Whole schedulable slices only (partial slices are
                         # unplaceable; matches the limiter's pool sizing).
                         # Each variant's slices contribute once to its
                         # generation's pool.
                         counted_variants.add(accel)
+                        chips = cap.total_slices * cap.chips_per_slice
+                        if self.capacity is not None:
+                            # Provisioning-in-flight capacity is solvable
+                            # capacity — same pool extension the limiter
+                            # applies (ready + arriving-within-lead-time).
+                            chips += self.capacity.pool_credit_chips(accel)
                         capacity_chips[gen] = (
-                            capacity_chips.get(gen, 0)
-                            + cap.total_slices * cap.chips_per_slice)
+                            capacity_chips.get(gen, 0) + chips)
                 if current is None and vc.replica_count > 0:
                     current = CurrentAlloc(
                         accelerator=accel, num_replicas=vc.replica_count,

@@ -199,7 +199,7 @@ def build_candidates(
             num_replicas=replicas[i],
             max_batch=max_b[i],
             chips_per_replica=acc.chips_per_replica,
-            cost=acc.cost * replicas[i],
+            cost=acc.effective_cost * replicas[i],
             itl_ms=itl_arr[i],
             ttft_ms=ttft_arr[i],
             rho=rho_arr[i],
@@ -232,7 +232,7 @@ def _zero_load_allocation(server: ServerSpec, acc: AcceleratorSpec,
         num_replicas=server.min_replicas,
         max_batch=server.max_batch_size or prof.max_batch_size,
         chips_per_replica=acc.chips_per_replica,
-        cost=acc.cost * server.min_replicas,
+        cost=acc.effective_cost * server.min_replicas,
     )
     alloc.value = _value_of(server, alloc)
     return alloc

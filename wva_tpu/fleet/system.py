@@ -35,6 +35,17 @@ class AcceleratorSpec:
     type: str = ""  # capacity pool key, e.g. "v5e"
     chips_per_replica: int = 8  # chips consumed by one replica (whole slice)
     cost: float = 1.0  # cost of one replica (slice) per hour
+    # Capacity-tier cost scaling (wva_tpu.capacity.tiers): the ready-slice-
+    # weighted blend of the pool's tier cost weights (reservation <
+    # on-demand, spot cheapest). 1.0 = tier-agnostic (pre-capacity
+    # behavior). The solver sees effective per-replica cost
+    # ``cost * tier_cost_weight``, so a spot-backed pool genuinely
+    # competes on price.
+    tier_cost_weight: float = 1.0
+
+    @property
+    def effective_cost(self) -> float:
+        return self.cost * self.tier_cost_weight
     # Piecewise-linear power model (idle->peak watts per chip), kept for
     # parity with the reference's accelerator power model
     # (core/accelerator.go:29-42); informational.

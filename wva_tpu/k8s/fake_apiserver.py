@@ -133,6 +133,22 @@ class _Handler(BaseHTTPRequestHandler):
         return (kind, m.group("namespace") or "", m.group("name") or "",
                 m.group("subresource") or "", query)
 
+    def _get_resolving_scope(self, kind: str, ns: str, name: str):
+        """Item lookup that tolerates the cluster-scoped path shape:
+        ``/api/v1/nodes/<name>`` carries no namespace, but FakeCluster
+        stores objects under whatever ``metadata.namespace`` they were
+        created with (ObjectMeta defaults to "default"). Fall back to a
+        by-name scan when the path gave no namespace."""
+        try:
+            return self.cluster.get(kind, ns, name)
+        except NotFoundError:
+            if ns:
+                raise
+            for obj in self.cluster.list(kind):
+                if obj.metadata.name == name:
+                    return obj
+            raise
+
     def _authorized(self) -> bool:
         if not self.bearer_token:
             return True
@@ -175,7 +191,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "spec": {"replicas": replicas},
                     "status": {"replicas": replicas}})
             elif name:
-                self._send_json(200, serde.to_k8s(self.cluster.get(kind, ns, name)))
+                self._send_json(200, serde.to_k8s(
+                    self._get_resolving_scope(kind, ns, name)))
             elif query.get("watch") == "true":
                 self._serve_watch(kind, query, ns)
             else:
@@ -255,10 +272,29 @@ class _Handler(BaseHTTPRequestHandler):
                     "metadata": {"name": name, "namespace": ns},
                     "spec": {"replicas": replicas},
                     "status": {"replicas": replicas}})
+            elif sub == "status" and name:
+                # Merge-patch on the status subresource (kubelets PATCH
+                # node status this way): overlay the patch's status onto
+                # the stored object, re-decode, and write through
+                # update_status so the MODIFIED watch event streams.
+                current = self._get_resolving_scope(kind, ns, name)
+                doc = serde.to_k8s(current)
+                patched_status = {**(doc.get("status") or {}),
+                                  **(body.get("status") or {})}
+                doc["status"] = patched_status
+                obj = serde.from_k8s(kind, doc)
+                # Cluster-scoped docs carry no namespace: target the key
+                # the object is actually stored under.
+                obj.metadata.namespace = current.metadata.namespace
+                obj.metadata.resource_version = \
+                    current.metadata.resource_version
+                updated = self.cluster.update_status(obj)
+                self._send_json(200, serde.to_k8s(updated))
             else:
                 self._send_status_error(
                     405, "MethodNotAllowed",
-                    "only the scale subresource supports PATCH here")
+                    "only the scale and status subresources support "
+                    "PATCH here")
         except NotFoundError as e:
             self._send_status_error(404, "NotFound", str(e),
                                     details={"name": name, "kind": kind})

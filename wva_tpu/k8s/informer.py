@@ -61,8 +61,17 @@ log = logging.getLogger(__name__)
 
 # Kinds the control plane reads per tick. Pod rides along for the dirty-set
 # fingerprint (pod churn must dirty its model without a per-tick Pod LIST).
+# Node feeds slice discovery (the limiter's per-tick inventory refresh) and
+# the capacity ledger's preemption detection — a node deletion / NotReady /
+# cordon flip must mark the backing slice lost and nudge a re-solve without
+# waiting out the poll interval.
 DEFAULT_INFORMER_KINDS = (
-    "VariantAutoscaling", "Deployment", "LeaderWorkerSet", "Pod")
+    "VariantAutoscaling", "Deployment", "LeaderWorkerSet", "Pod", "Node")
+
+# Cluster-scoped kinds: their objects carry no namespace, so a
+# namespace-scoped informer still covers them cluster-wide (a controller
+# watching one namespace still needs the whole node inventory).
+CLUSTER_SCOPED_KINDS = frozenset({"Node"})
 
 # Re-LIST a kind when no list has run for this long — the backstop bounding
 # staleness from dropped events the transport never surfaced. Same design
@@ -141,7 +150,9 @@ class InformerKubeClient(KubeClient):
         return on_event
 
     def _list_kind(self, kind: str) -> None:
-        listed = self.client.list(kind, namespace=self.namespace)
+        listed = self.client.list(
+            kind, namespace=None if kind in CLUSTER_SCOPED_KINDS
+            else self.namespace)
         now = self.clock.now()
         with self._mu:
             store = {
@@ -182,7 +193,8 @@ class InformerKubeClient(KubeClient):
 
     def _on_event(self, kind: str, event: str, obj: Any) -> None:
         ns = obj.metadata.namespace or ""
-        if self.namespace is not None and ns != self.namespace:
+        if self.namespace is not None and ns != self.namespace \
+                and kind not in CLUSTER_SCOPED_KINDS:
             return
         key = (ns, obj.metadata.name)
         with self._mu:
@@ -221,7 +233,8 @@ class InformerKubeClient(KubeClient):
         if kind not in self.kinds:
             return
         ns = obj.metadata.namespace or ""
-        if self.namespace is not None and ns != self.namespace:
+        if self.namespace is not None and ns != self.namespace \
+                and kind not in CLUSTER_SCOPED_KINDS:
             return
         with self._mu:
             if kind in self._synced:
@@ -253,6 +266,9 @@ class InformerKubeClient(KubeClient):
         with self._mu:
             if kind not in self._synced:
                 return False
+        if kind in CLUSTER_SCOPED_KINDS:
+            # Always LISTed cluster-wide, so any scope is served.
+            return True
         return self.namespace is None or namespace == self.namespace
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -404,4 +420,15 @@ def _material_change(kind: str, event: str, prev: Any, obj: Any) -> bool:
                     getattr(st, "replicas", None),
                     getattr(st, "ready_replicas", None))
         return shape(obj) != shape(prev)
+    if kind == "Node":
+        # Readiness / cordon flips change schedulable slice inventory: a
+        # spot preemption (NotReady then DELETED) or a cordon must trigger
+        # an immediate re-solve, not wait out the poll interval. Allocatable
+        # moves (chips appearing on a provisioning node) count too.
+        def node_shape(o):
+            st = getattr(o, "status", None)
+            return (getattr(o, "ready", None),
+                    getattr(o, "unschedulable", None),
+                    getattr(st, "allocatable", None))
+        return node_shape(obj) != node_shape(prev)
     return False

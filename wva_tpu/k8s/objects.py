@@ -167,9 +167,17 @@ class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     status: NodeStatus = field(default_factory=NodeStatus)
     ready: bool = True
+    # spec.unschedulable (kubectl cordon): the node still exists and its
+    # pods keep running, but nothing new schedules there — a cordoned host
+    # makes its whole slice unusable for NEW replicas, so discovery must
+    # not count it as schedulable capacity.
+    unschedulable: bool = False
 
     KIND = "Node"
     API_VERSION = "v1"
+
+    def schedulable(self) -> bool:
+        return self.ready and not self.unschedulable
 
 
 @dataclass

@@ -291,7 +291,7 @@ def _pod_from_k8s(d: dict[str, Any]) -> Pod:
 
 
 def _node_to_k8s(o: Node) -> dict[str, Any]:
-    return {
+    out = {
         "apiVersion": "v1", "kind": "Node",
         "metadata": _meta_to_k8s(o.metadata, namespaced=False),
         "status": {
@@ -301,10 +301,14 @@ def _node_to_k8s(o: Node) -> dict[str, Any]:
                             "status": "True" if o.ready else "False"}],
         },
     }
+    if o.unschedulable:
+        out["spec"] = {"unschedulable": True}
+    return out
 
 
 def _node_from_k8s(d: dict[str, Any]) -> Node:
     status = d.get("status") or {}
+    spec = d.get("spec") or {}
     ready = any(c.get("type") == "Ready" and c.get("status") == "True"
                 for c in status.get("conditions") or [])
     return Node(
@@ -314,6 +318,7 @@ def _node_from_k8s(d: dict[str, Any]) -> Node:
             allocatable={k: str(v)
                          for k, v in (status.get("allocatable") or {}).items()}),
         ready=ready,
+        unschedulable=bool(spec.get("unschedulable", False)),
     )
 
 

@@ -226,13 +226,17 @@ def build_manager(
     tsdb: TimeSeriesDB | None = None,
     pod_fetcher=None,
     mirror_wva_metrics: bool = True,
+    slice_provisioner=None,
 ) -> Manager:
     """Wire the full controller (reference cmd/main.go).
 
     ``tsdb`` selects the in-memory Prometheus backend (emulation/bench);
     when None, an HTTP backend against ``config.prometheus_base_url()`` is
     used. ``pod_fetcher`` overrides EPP pod scraping (in-process harness);
-    defaults to HTTP.
+    defaults to HTTP. ``slice_provisioner`` backs the elastic capacity
+    plane (WVA_CAPACITY): the emulation harness injects a
+    FakeGkeProvisioner; None leaves the NullProvisioner, which plans
+    strictly within discovered inventory.
     """
     clock = clock or SYSTEM_CLOCK
 
@@ -298,7 +302,8 @@ def build_manager(
     enforcer = Enforcer(request_count)
 
     discovery = TPUSliceDiscovery(client)
-    limiter = DefaultLimiter("tpu-slice-limiter", SliceInventory(discovery),
+    inventory = SliceInventory(discovery)
+    limiter = DefaultLimiter("tpu-slice-limiter", inventory,
                              GreedyBySaturation(), clock=clock)
 
     # Decision flight recorder (config-gated): the executor opens one cycle
@@ -333,6 +338,47 @@ def build_manager(
             min_trust_evals=fc_cfg.min_trust_evals,
             prewake_enabled=fc_cfg.prewake_enabled,
             prewake_min_demand=fc_cfg.prewake_min_demand)
+    # Elastic capacity plane (WVA_CAPACITY, default on): ledger +
+    # provisioner between discovery and the solver — pools become
+    # ready + provisioning-arriving-within-lead-time, preemptions release
+    # chips the same tick, quota stockouts circuit-break per (variant,
+    # tier) (docs/design/capacity.md). Disabled, inventory is static and
+    # decisions are byte-identical to pre-capacity builds.
+    capacity = None
+    cap_cfg = config.capacity_config()
+    if cap_cfg.enabled:
+        from wva_tpu.capacity import CapacityManager, NullProvisioner
+        from wva_tpu.forecast.leadtime import LeadTimeEstimator
+
+        # Share the forecast planner's lead-time estimator when
+        # forecasting is on: both planes learn from the same measured
+        # actuation->scheduled->ready episodes.
+        leadtime = (forecast_planner.leadtime
+                    if forecast_planner is not None
+                    else LeadTimeEstimator(
+                        default_seconds=cap_cfg
+                        .default_provision_lead_seconds))
+        capacity = CapacityManager(
+            discovery, slice_provisioner or NullProvisioner(),
+            leadtime=leadtime,
+            tier_preference=cap_cfg.tier_preference,
+            tier_weights=cap_cfg.tier_cost_weights,
+            stockout_reprobe_seconds=cap_cfg.stockout_reprobe_seconds,
+            default_lead_seconds=cap_cfg.default_provision_lead_seconds,
+            clock=clock)
+        inventory.capacity = capacity
+        # Node watch -> ledger: a deleted / NotReady / cordoned host marks
+        # its slice lost the instant the event lands (the informer's nudge
+        # then forces the immediate re-solve in wall-clock mode). Without
+        # an informer, a raw watch registration serves the same feed.
+        if hasattr(client, "add_nudge_listener"):
+            def _capacity_node_feed(kind: str, event: str, obj) -> None:
+                if kind == "Node":
+                    capacity.on_node_event(event, obj)
+            client.add_nudge_listener(_capacity_node_feed)
+        else:
+            client.watch("Node", capacity.on_node_event)
+
     # Analysis pool width 0 = auto, resolved by the metrics backend (same
     # rule as PrometheusSource's query concurrency): per-model collection
     # against HTTP Prometheus is I/O-bound and overlaps across workers; the
@@ -348,7 +394,8 @@ def build_manager(
         direct_actuator=direct_actuator, recorder=recorder,
         flight_recorder=flight,
         analysis_workers=workers,
-        forecast_planner=forecast_planner)
+        forecast_planner=forecast_planner,
+        capacity=capacity)
     engine.grouped_collection = config.grouped_collection_enabled()
     engine.incremental_enabled = config.incremental_enabled()
     engine.resync_ticks = config.resync_ticks()

@@ -26,6 +26,12 @@ from wva_tpu.constants import (
     LABEL_OUTCOME,
     LABEL_REASON,
     LABEL_VARIANT_NAME,
+    WVA_CAPACITY_CHIPS_EFFECTIVE,
+    WVA_CAPACITY_PREEMPTED_TOTAL,
+    WVA_CAPACITY_PROVISION_LEAD_SECONDS,
+    WVA_CAPACITY_PROVISION_TOTAL,
+    WVA_CAPACITY_SLICES,
+    WVA_CAPACITY_STOCKED_OUT,
     WVA_CURRENT_REPLICAS,
     WVA_DESIRED_RATIO,
     WVA_DESIRED_REPLICAS,
@@ -112,6 +118,24 @@ class MetricsRegistry:
         self._register(WVA_TICK_MODELS_SKIPPED, "gauge",
                        "Models skipped by an unchanged input fingerprint "
                        "last engine tick (prior decision re-emitted)")
+        self._register(WVA_CAPACITY_SLICES, "gauge",
+                       "Whole TPU slices per (variant, state): ready, "
+                       "provisioning (in-flight with credible ETA), "
+                       "preempted (watch-observed loss pending discovery)")
+        self._register(WVA_CAPACITY_CHIPS_EFFECTIVE, "gauge",
+                       "Chips the planner may allocate per variant: ready "
+                       "plus provisioning-arriving-within-lead-time")
+        self._register(WVA_CAPACITY_STOCKED_OUT, "gauge",
+                       "1 while the (variant, tier) is pinned stocked-out "
+                       "by the quota circuit breaker")
+        self._register(WVA_CAPACITY_PROVISION_TOTAL, "counter",
+                       "Slice provisioning requests by (variant, tier, "
+                       "outcome)")
+        self._register(WVA_CAPACITY_PREEMPTED_TOTAL, "counter",
+                       "Spot slices lost to preemption")
+        self._register(WVA_CAPACITY_PROVISION_LEAD_SECONDS, "gauge",
+                       "Measured slice provisioning lead (submission -> "
+                       "discovered ready) per (variant, tier)")
 
     def _register(self, name: str, kind: str, help_text: str) -> None:
         self._series[name] = _Series(name, kind, help_text)

@@ -99,21 +99,46 @@ class Limiter(abc.ABC):
 
 class SliceInventory(Inventory):
     """Chip pools per TPU slice variant, fed by discovery. Only chips that
-    belong to whole schedulable slices count toward the limit."""
+    belong to whole schedulable slices count toward the limit — plus, when
+    the elastic capacity plane is wired, chips of slices the provisioner
+    has in flight within their credited lead window (``ready +
+    provisioning-arriving-within-lead-time``): a scale-up the provisioner
+    is already fulfilling must not be re-clamped to zero and re-ordered."""
 
     def __init__(self, discovery: TPUSliceDiscovery) -> None:
         self.discovery = discovery
         self._pools: dict[str, ResourcePool] = {}
+        # Optional wva_tpu.capacity.CapacityManager; None = static
+        # inventory semantics, byte-identical to pre-capacity builds.
+        self.capacity = None
+        # The discovery snapshot of the LAST refresh: the engine's
+        # capacity pass runs in the same tick and reuses it instead of
+        # listing + parsing the node fleet a second time.
+        self.last_slices: dict | None = None
 
     def refresh(self) -> None:
         slices = self.discovery.discover_slices()
+        self.last_slices = slices
         pools = {}
         for variant, cap in slices.items():
+            limit = cap.total_slices * cap.chips_per_slice
+            if self.capacity is not None:
+                limit += self.capacity.pool_credit_chips(variant)
             pools[variant] = ResourcePool(
                 accelerator_type=variant,
-                limit=cap.total_slices * cap.chips_per_slice,
+                limit=limit,
                 used=self._pools.get(variant, ResourcePool()).used,
             )
+        if self.capacity is not None:
+            # A variant whose FIRST slices are still provisioning has no
+            # discovered pool yet; its in-flight credit still needs a pool
+            # or the limiter would clamp the pending scale-up to zero and
+            # the manager would re-order every tick.
+            for variant, credit in self.capacity.credit_only_pools(
+                    set(pools)).items():
+                pools[variant] = ResourcePool(
+                    accelerator_type=variant, limit=credit,
+                    used=self._pools.get(variant, ResourcePool()).used)
         self._pools = pools
 
     def set_used(self, used_by_type: dict[str, int]) -> None:

@@ -6,6 +6,8 @@ gets/status-updates and Prometheus queries).
 from __future__ import annotations
 
 import logging
+import random
+from dataclasses import dataclass, field
 from typing import Callable, TypeVar
 
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
@@ -52,3 +54,39 @@ def retry_with_backoff(
                 delay = min(delay * factor, cap)
     assert last_exc is not None
     raise last_exc
+
+
+@dataclass
+class BackoffState:
+    """Non-blocking exponential backoff with full jitter, for tick-driven
+    retry loops (the capacity provisioner must never sleep the engine
+    thread the way :func:`retry_with_backoff` would). ``ready()`` gates the
+    next attempt; ``failure()`` schedules it ``delay * [0.5, 1.0)`` jittered
+    seconds out and doubles the delay toward ``cap``; ``success()`` resets.
+
+    The jitter RNG is injected so simulated worlds stay seeded-
+    deterministic (same discipline as the REST watch reconnect backoff).
+    """
+
+    initial: float = 1.0
+    factor: float = DEFAULT_FACTOR
+    cap: float = 60.0
+    rng: random.Random | None = None
+    _delay: float = field(init=False, default=0.0)
+    _next_at: float = field(init=False, default=0.0)
+
+    def ready(self, now: float) -> bool:
+        return now >= self._next_at
+
+    def failure(self, now: float) -> float:
+        """Record a failed attempt; returns seconds until the next one."""
+        self._delay = min(self._delay * self.factor, self.cap) \
+            if self._delay > 0 else self.initial
+        rng = self.rng or random
+        wait = self._delay * (0.5 + 0.5 * rng.random())
+        self._next_at = now + wait
+        return wait
+
+    def success(self) -> None:
+        self._delay = 0.0
+        self._next_at = 0.0
