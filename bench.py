@@ -633,7 +633,8 @@ def multi_model_bench() -> dict:
 
 
 def _build_tick_world(n_models: int, variants_per_model: int,
-                      informer: bool = True, incremental: bool = True):
+                      informer: bool = True, incremental: bool = True,
+                      zero_copy: bool = True):
     """The shared 48-model/96-VA in-memory fleet world for the tick
     benches (`make bench-tick` / `make bench-tick-quiet`): FakeCluster +
     TSDB + fully wired manager on the SLO analyzer path, with a ``feed``
@@ -676,6 +677,9 @@ def _build_tick_world(n_models: int, variants_per_model: int,
     cfg = new_test_config()
     cfg.infrastructure.informer = informer
     cfg.infrastructure.incremental = incremental
+    # WVA_ZERO_COPY lever: build_manager applies it process-wide from the
+    # config, so the honest copy-on-read mode must flow through here.
+    cfg.infrastructure.zero_copy = zero_copy
     sat = SaturationScalingConfig(analyzer_name="slo")
     sat.apply_defaults()
     cfg.update_saturation_config({"default": sat})
@@ -917,29 +921,40 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
 
     from wva_tpu.engines import common as engines_common
 
-    def run_mode(informer: bool, incremental: bool) -> dict:
-        mgr, cluster, clock, feed = _build_tick_world(
-            n_models, variants_per_model,
-            informer=informer, incremental=incremental)
-        eng = mgr.engine
-        for _ in range(3 + quiet_warm_ticks):  # jit + caches + memos +
-            eng.optimize()                     # window settling
-            clock.advance(5.0)
-            feed(clock.now())
-        walls, reads, analyzed = [], {}, 0
-        for _ in range(measured_ticks):
-            cluster.reset_request_counts()
-            t0 = time.perf_counter()
-            eng.optimize()
-            walls.append(time.perf_counter() - t0)
-            analyzed += eng.last_tick_stats["analyzed"]
-            for (verb, kind), c in cluster.request_counts().items():
-                if verb in ("get", "list"):
-                    key = f"{verb}:{kind}"
-                    reads[key] = reads.get(key, 0) + c
-            clock.advance(5.0)
-            feed(clock.now())  # fresh scrapes, unchanged values
-        mgr.shutdown()
+    def run_mode(informer: bool, incremental: bool,
+                 zero_copy: bool = True) -> dict:
+        from wva_tpu.utils import freeze as frz
+
+        # The object-plane lever is process-global (build_manager applies
+        # it from the world's config); restore the shipped default after
+        # the mode.
+        try:
+            mgr, cluster, clock, feed = _build_tick_world(
+                n_models, variants_per_model,
+                informer=informer, incremental=incremental,
+                zero_copy=zero_copy)
+            eng = mgr.engine
+            for _ in range(3 + quiet_warm_ticks):  # jit + caches + memos +
+                eng.optimize()                     # window settling
+                clock.advance(5.0)
+                feed(clock.now())
+            walls, reads, analyzed, copies = [], {}, 0, []
+            for _ in range(measured_ticks):
+                cluster.reset_request_counts()
+                t0 = time.perf_counter()
+                eng.optimize()
+                walls.append(time.perf_counter() - t0)
+                analyzed += eng.last_tick_stats["analyzed"]
+                copies.append(eng.last_tick_object_copies)
+                for (verb, kind), c in cluster.request_counts().items():
+                    if verb in ("get", "list"):
+                        key = f"{verb}:{kind}"
+                        reads[key] = reads.get(key, 0) + c
+                clock.advance(5.0)
+                feed(clock.now())  # fresh scrapes, unchanged values
+            mgr.shutdown()
+        finally:
+            frz.set_zero_copy(True)
         walls.sort()
         per_tick_reads = {k: round(v / measured_ticks, 2)
                           for k, v in sorted(reads.items())}
@@ -955,11 +970,23 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
                 v for k, v in per_tick_reads.items()
                 if k.startswith("list:")), 2),
             "models_analyzed_per_tick": round(analyzed / measured_ticks, 2),
+            # K8s object copies per tick (wva_tick_object_copies): ~0 at
+            # steady state on the zero-copy plane — every copy marks an
+            # actual status write, not a read.
+            "object_copies_per_tick_p50": float(
+                statistics.median(copies)),
+            "object_copies_per_tick_max": float(max(copies)),
         }
 
     incremental = run_mode(informer=True, incremental=True)
     informer_only = run_mode(informer=True, incremental=False)
     baseline = run_mode(informer=False, incremental=False)
+    # The object-plane honest lever: the SAME shipped configuration with
+    # WVA_ZERO_COPY off — deep-copy-on-read restored everywhere
+    # (FakeCluster, informer store, snapshot fill/read-out), byte-identical
+    # decisions (tests/test_object_plane.py).
+    copy_on_read = run_mode(informer=True, incremental=True,
+                            zero_copy=False)
     engines_common.DecisionCache.clear()
     while not engines_common.DecisionTrigger.empty():
         engines_common.DecisionTrigger.get_nowait()
@@ -971,8 +998,12 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
         "incremental": incremental,
         "informer_only": informer_only,
         "per_tick_list_baseline": baseline,
+        "copy_on_read": copy_on_read,
         "quiet_tick_p50_speedup": round(
             baseline["tick_p50_ms"]
+            / max(incremental["tick_p50_ms"], 1e-9), 2),
+        "object_plane_p50_speedup": round(
+            copy_on_read["tick_p50_ms"]
             / max(incremental["tick_p50_ms"], 1e-9), 2),
         "api_reads_reduction": round(
             baseline["api_reads_per_tick_total"]
@@ -987,6 +1018,9 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
             "per_tick_list_baseline": "both off: one LIST per kind per "
                                       "tick + full analysis (the PR-2 "
                                       "shape)",
+            "copy_on_read": "shipped config with WVA_ZERO_COPY off: "
+                            "deep-copy-on-read restored everywhere (the "
+                            "pre-object-plane shape)",
         },
     }
 
@@ -1524,6 +1558,24 @@ def tick_quiet_main() -> None:
     record = tick_quiet_bench()
     record["bench_wall_seconds"] = round(time.time() - t0, 1)
     _merge_bench_local("incremental_tick", record)
+    # Object-plane extract (docs/design/object-plane.md): the shipped
+    # zero-copy path vs the SAME configuration with WVA_ZERO_COPY off
+    # (deep-copy-on-read), plus the per-tick copy accounting.
+    _merge_bench_local("object_plane", {
+        "quiet_tick_p50_ms_zero_copy":
+            record["incremental"]["tick_p50_ms"],
+        "quiet_tick_p50_ms_copy_on_read":
+            record["copy_on_read"]["tick_p50_ms"],
+        "quiet_tick_p99_ms_zero_copy":
+            record["incremental"]["tick_p99_ms"],
+        "quiet_tick_p99_ms_copy_on_read":
+            record["copy_on_read"]["tick_p99_ms"],
+        "p50_speedup": record["object_plane_p50_speedup"],
+        "object_copies_per_tick_p50":
+            record["incremental"]["object_copies_per_tick_p50"],
+        "object_copies_per_tick_max":
+            record["incremental"]["object_copies_per_tick_max"],
+    })
     print(json.dumps({
         "metric": "quiet_tick_latency_48_models_96_vas",
         "value": record["incremental"]["tick_p50_ms"],
@@ -1836,8 +1888,44 @@ def main() -> None:
     print(json.dumps(summary))
 
 
+def profile_main() -> None:
+    """`make bench-profile`: cProfile one quiet-tick bench run and dump the
+    top-N hot call sites by cumulative time (the tool that found the
+    deepcopy tax this round; PERF.md "profiling the tick"). Text goes to
+    stdout; tune N with --top N."""
+    import cProfile
+    import io
+    import pstats
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    top = 40
+    if "--top" in sys.argv:
+        top = int(sys.argv[sys.argv.index("--top") + 1])
+    mgr, cluster, clock, feed = _build_tick_world(48, 2)
+    eng = mgr.engine
+    for _ in range(19):  # jit + caches + memos + window settling
+        eng.optimize()
+        clock.advance(5.0)
+        feed(clock.now())
+    profiler = cProfile.Profile()
+    profiler.enable()
+    for _ in range(8):
+        eng.optimize()
+        clock.advance(5.0)
+        feed(clock.now())
+    profiler.disable()
+    mgr.shutdown()
+    out = io.StringIO()
+    stats = pstats.Stats(profiler, stream=out)
+    stats.sort_stats("cumulative").print_stats(top)
+    stats.sort_stats("tottime").print_stats(top)
+    print(out.getvalue())
+
+
 if __name__ == "__main__":
-    if "--tick-quiet-only" in sys.argv:
+    if "--profile" in sys.argv:
+        profile_main()
+    elif "--tick-quiet-only" in sys.argv:
         tick_quiet_main()
     elif "--tick-only" in sys.argv:
         tick_main()

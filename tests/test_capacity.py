@@ -24,6 +24,7 @@
 
 from __future__ import annotations
 
+import copy
 import json
 import os
 import threading
@@ -69,6 +70,7 @@ from wva_tpu.forecast.leadtime import (
 )
 from wva_tpu.interfaces import SaturationScalingConfig
 from wva_tpu.k8s import (
+    clone,
     Container,
     Deployment,
     DeploymentStatus,
@@ -618,8 +620,8 @@ def test_kubelet_deletes_pods_of_lost_nodes_and_skips_cordoned():
     assert first_node
     # Cordon the OTHER node, then delete the pod's node: the replacement
     # pod must not land on the cordoned host.
-    other = [n for n in cluster.list("Node")
-             if n.metadata.name != first_node][0]
+    other = clone([n for n in cluster.list("Node")
+                   if n.metadata.name != first_node][0])
     other.unschedulable = True
     cluster.update(other)
     cluster.delete("Node", other.metadata.namespace, first_node)
@@ -646,7 +648,7 @@ def test_kubelet_marks_pods_on_notready_nodes_unready():
     clock.advance(1.0)
     kubelet.step()
     assert cluster.list("Pod", namespace=NS)[0].is_ready()
-    node = cluster.list("Node")[0]
+    node = clone(cluster.list("Node")[0])
     node.ready = False
     cluster.update(node)
     kubelet.step()
@@ -681,7 +683,7 @@ def test_node_lifecycle_streams_through_watch():
             target=lambda: got.extend(_raw_watch_lines(url)), daemon=True)
         t.start()
         time.sleep(0.3)
-        created = cluster.create(_node("n0"))
+        created = clone(cluster.create(_node("n0")))
         created.ready = False
         updated = cluster.update(created)
         cluster.update_status(updated)  # status subresource write
@@ -773,7 +775,7 @@ def test_informer_covers_node_and_nudges_on_cordon():
     nudges = []
     inf.add_nudge_listener(lambda kind, event, obj:
                            nudges.append((kind, event, obj.metadata.name)))
-    node = cluster.list("Node")[0]
+    node = clone(cluster.list("Node")[0])
     node.unschedulable = True
     cluster.update(node)
     assert ("Node", "MODIFIED", node.metadata.name) in nudges
@@ -801,7 +803,7 @@ def _capacity_world(capacity_enabled: bool, manager_none: bool = False,
     cfg.update_saturation_config({"default": SaturationScalingConfig(
         analyzer_name="saturation", enable_limiter=True)})
     cfg.set_trace(TraceConfig(enabled=True))
-    cap_cfg = cfg.capacity_config()
+    cap_cfg = copy.deepcopy(cfg.capacity_config())  # thaw the frozen memo
     cap_cfg.enabled = capacity_enabled
     cfg.set_capacity(cap_cfg)
     add_tpu_nodepool(cluster, "v5e-pool", "v5e", "2x4", 8)

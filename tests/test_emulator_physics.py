@@ -27,6 +27,7 @@ from wva_tpu.emulator.loadgen import ramp
 from wva_tpu.emulator.profiles import add_tpu_nodepool
 from wva_tpu.emulator.server_sim import ModelServerSim, ServingParams
 from wva_tpu.k8s import (
+    clone,
     Container,
     Deployment,
     FakeCluster,
@@ -290,6 +291,7 @@ class TestKubeletProvisioning:
         d = cluster.get(Deployment.KIND, NS, "d")
         assert d.status.replicas == 2 and d.status.ready_replicas == 1
         # Scale to 1: the bound pod frees its chips for a later retry.
+        d = clone(d)
         d.replicas = 1
         cluster.update(d)
         kubelet.step()
@@ -365,7 +367,7 @@ class TestHPAStabilizationWindows:
     def test_down_stabilization_is_window_maximum(self):
         clock, cluster, registry, hpa = self.world(
             stabilization_up_seconds=0.0, stabilization_down_seconds=60.0)
-        d = cluster.get(Deployment.KIND, NS, "v")
+        d = clone(cluster.get(Deployment.KIND, NS, "v"))
         d.replicas = 5
         cluster.update(d)
         registry.set_gauge(WVA_DESIRED_REPLICAS, self.LABELS, 5.0)

@@ -11,6 +11,7 @@ seasonality profiles."""
 
 from __future__ import annotations
 
+import copy
 import json
 import math
 import os
@@ -518,7 +519,7 @@ def _forecast_world(forecast_enabled: bool, planner_none: bool = False,
     cfg.update_saturation_config({"default": SaturationScalingConfig(
         analyzer_name="saturation", anticipation_horizon_seconds=120.0)})
     cfg.set_trace(TraceConfig(enabled=True))
-    fc_cfg = cfg.forecast_config()
+    fc_cfg = copy.deepcopy(cfg.forecast_config())  # thaw the frozen memo
     fc_cfg.enabled = forecast_enabled
     fc_cfg.seasonal_period_seconds = 600.0
     fc_cfg.grid_step_seconds = 5.0

@@ -48,6 +48,7 @@ from wva_tpu.k8s import (
     RestKubeClient,
 )
 from wva_tpu.k8s.fake_apiserver import FakeAPIServer
+from wva_tpu.k8s.objects import FrozenObjectError, clone
 from wva_tpu.k8s.rest import (
     WATCH_BACKOFF_MAX,
     _jittered,
@@ -99,7 +100,7 @@ def test_informer_store_follows_watch_events():
     cluster.reset_request_counts()
     assert [v.metadata.name for v in inf.list("VariantAutoscaling",
                                               namespace=NS)] == ["va0"]
-    fresh = cluster.get("VariantAutoscaling", NS, "va0")
+    fresh = clone(cluster.get("VariantAutoscaling", NS, "va0"))
     fresh.spec.model_id = "org/changed"
     cluster.update(fresh)
     cluster.reset_request_counts()
@@ -118,8 +119,13 @@ def test_informer_write_through_and_isolation():
     created = inf.create(_va("va0"))
     assert created.metadata.resource_version
     got = inf.list("VariantAutoscaling", namespace=NS)[0]
-    got.spec.model_id = "mutated"
-    # Store isolation: callers cannot mutate the cached copy.
+    # Store isolation, object-plane edition: reads are frozen shared
+    # views — mutation raises instead of silently diverging, and a
+    # thawed clone never reaches the store.
+    with pytest.raises(FrozenObjectError):
+        got.spec.model_id = "mutated"
+    mutable = clone(got)
+    mutable.spec.model_id = "mutated"
     assert inf.list("VariantAutoscaling",
                     namespace=NS)[0].spec.model_id == "org/m"
 
@@ -249,7 +255,7 @@ def test_quiet_tick_zero_lists_zero_models_analyzed():
 
 def test_va_spec_edit_dirties_exactly_that_model():
     mgr, cluster, tsdb, clock = _quiet_world(6)
-    va = cluster.get("VariantAutoscaling", NS, "m002-v5e")
+    va = clone(cluster.get("VariantAutoscaling", NS, "m002-v5e"))
     va.spec.variant_cost = "99.0"
     cluster.update(va)  # spec edit: generation bumps
     mgr.engine.optimize()
@@ -417,13 +423,13 @@ def test_material_events_nudge_listeners_status_writes_do_not():
 
     # Status-only write (the engine's own heartbeat path): NO nudge —
     # generation does not move, so the nudge loop cannot retrigger itself.
-    va = cluster.get("VariantAutoscaling", NS, "va0")
+    va = clone(cluster.get("VariantAutoscaling", NS, "va0"))
     va.status.desired_optimized_alloc.num_replicas = 3
     cluster.update_status(va)
     assert len(nudges) == n
 
     # Spec edit: generation bumps -> nudge.
-    va = cluster.get("VariantAutoscaling", NS, "va0")
+    va = clone(cluster.get("VariantAutoscaling", NS, "va0"))
     va.spec.variant_cost = "5.0"
     cluster.update(va)
     assert nudges[-1] == ("VariantAutoscaling", "MODIFIED")
@@ -441,7 +447,7 @@ def test_manager_wires_nudges_to_executor_triggers():
     mgr.client.add_nudge_listener(
         lambda kind, event, obj: mgr.engine.executor.trigger())
     mgr.engine.executor.consume_trigger()  # clear
-    va = cluster.get("VariantAutoscaling", NS, "m000-v5e")
+    va = clone(cluster.get("VariantAutoscaling", NS, "m000-v5e"))
     va.spec.variant_cost = "42.0"
     cluster.update(va)
     assert mgr.engine.executor.consume_trigger()

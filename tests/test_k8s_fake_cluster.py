@@ -13,6 +13,7 @@ from wva_tpu.k8s import (
     FakeCluster,
     NotFoundError,
 )
+from wva_tpu.k8s.objects import FrozenObjectError, clone
 
 
 def make_deploy(name="d1", ns="default", replicas=1, labels=None):
@@ -26,7 +27,14 @@ def test_create_get_roundtrip_and_isolation():
     c = FakeCluster()
     c.create(make_deploy())
     got = c.get("Deployment", "default", "d1")
-    got.replicas = 99  # mutating the returned copy must not affect the store
+    # Store reads are frozen shared objects: direct mutation raises
+    # instead of silently diverging (docs/design/object-plane.md) ...
+    with pytest.raises(FrozenObjectError):
+        got.replicas = 99
+    # ... and the sanctioned copy-on-write path (clone -> mutate) leaves
+    # the store untouched.
+    mutable = clone(got)
+    mutable.replicas = 99
     assert c.get("Deployment", "default", "d1").replicas == 1
 
 
@@ -117,7 +125,7 @@ def test_update_cannot_touch_status_and_stale_rv_conflicts():
     c.update_status(status_patch)
 
     # Main-resource update with its own (stale) status must not clobber it.
-    fresh = c.get("Deployment", "default", "d1")
+    fresh = clone(c.get("Deployment", "default", "d1"))
     fresh.metadata.labels["x"] = "y"
     fresh.status.ready_replicas = 0
     updated = c.update(fresh)

@@ -15,6 +15,7 @@ from wva_tpu.emulator import (
     ramp,
 )
 from wva_tpu.k8s import (
+    clone,
     Container,
     Deployment,
     DeploymentStatus,
@@ -143,7 +144,7 @@ class TestKubeletLWS:
         clock.advance(61)
         kubelet.step()
         # Kill one host pod of the group.
-        pod = cluster.list("Pod", namespace="inf")[0]
+        pod = clone(cluster.list("Pod", namespace="inf")[0])
         pod.status.ready = False
         cluster.update_status(pod)
         kubelet.step()

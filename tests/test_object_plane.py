@@ -1,0 +1,339 @@
+"""Immutable copy-on-write object plane (docs/design/object-plane.md).
+
+1. freeze/thaw protocol semantics (utils/freeze.py)
+2. mutation-safety regression: a caller mutating a THAWED copy of a
+   listed/got object never alters the FakeCluster store, the informer
+   store, the snapshot cache, or a concurrent reader's view
+3. WVA_ZERO_COPY=off byte-equality (same discipline as WVA_FORECAST=off)
+4. steady-state ticks take ~0 object copies (wva_tick_object_copies)
+5. hot-path lint: copy.deepcopy is forbidden in k8s/ + engine/pipeline
+   modules — every K8s-object copy goes through objects.clone()
+"""
+
+import copy
+import json
+import pathlib
+import re
+
+import pytest
+
+import wva_tpu
+from test_tick_scale import NS, make_fleet_world
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
+from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
+from wva_tpu.blackbox.schema import encode
+from wva_tpu.k8s import (
+    Container,
+    Deployment,
+    DeploymentStatus,
+    FakeCluster,
+    FrozenObjectError,
+    InformerKubeClient,
+    PodTemplateSpec,
+    clone,
+)
+from wva_tpu.k8s.objects import freeze, is_frozen
+from wva_tpu.k8s.serde import from_k8s, to_k8s
+from wva_tpu.k8s.snapshot import SnapshotKubeClient
+from wva_tpu.utils import FakeClock
+from wva_tpu.utils import freeze as frz
+
+pytestmark = pytest.mark.object_plane
+
+
+def _va(name: str, ns: str = NS, model: str = "org/m") -> VariantAutoscaling:
+    return VariantAutoscaling(
+        metadata=ObjectMeta(name=name, namespace=ns,
+                            labels={"app": name}),
+        spec=VariantAutoscalingSpec(
+            scale_target_ref=CrossVersionObjectReference(name=name),
+            model_id=model))
+
+
+def _deployment(name: str, ns: str = NS) -> Deployment:
+    return Deployment(
+        metadata=ObjectMeta(name=name, namespace=ns), replicas=1,
+        selector={"app": name},
+        template=PodTemplateSpec(labels={"app": name},
+                                 containers=[Container(name="srv")]),
+        status=DeploymentStatus(replicas=1, ready_replicas=1))
+
+
+# --- 1. freeze/thaw protocol -------------------------------------------------
+
+
+def test_freeze_is_recursive_and_idempotent():
+    d = _deployment("d0")
+    assert not is_frozen(d)
+    out = freeze(d)
+    assert out is d and is_frozen(d)
+    assert is_frozen(d.metadata) and is_frozen(d.template)
+    v1 = frz.object_version(d)
+    assert v1 > 0
+    assert freeze(d) is d
+    assert frz.object_version(d) == v1, "re-freeze must not re-version"
+
+
+def test_frozen_attribute_and_container_mutation_raise():
+    d = freeze(_deployment("d0"))
+    with pytest.raises(FrozenObjectError):
+        d.replicas = 9
+    with pytest.raises(FrozenObjectError):
+        d.metadata.labels["x"] = "y"
+    with pytest.raises(FrozenObjectError):
+        d.template.containers.append(Container(name="evil"))
+    with pytest.raises(FrozenObjectError):
+        del d.replicas
+    # Frozen containers keep their base types: serde/label-matching code
+    # that isinstance-checks dict/list must keep working.
+    assert isinstance(d.metadata.labels, dict)
+    assert isinstance(d.template.containers, list)
+
+
+def test_clone_thaws_fully_and_deepcopy_is_equivalent():
+    d = freeze(_deployment("d0"))
+    for mutable in (clone(d), copy.deepcopy(d)):
+        assert not is_frozen(mutable)
+        mutable.replicas = 7
+        mutable.metadata.labels["x"] = "y"
+        mutable.template.containers.append(Container(name="extra"))
+        assert type(mutable.metadata.labels) is dict
+        assert type(mutable.template.containers) is list
+    assert d.replicas == 1 and "x" not in d.metadata.labels
+    assert len(d.template.containers) == 1
+
+
+def test_shallow_thaw_shares_frozen_subtrees():
+    d = freeze(_deployment("d0"))
+    cow = frz.shallow_thaw(d)
+    assert not is_frozen(cow)
+    assert cow.template is d.template  # structural sharing
+    cow.replicas = 5
+    frz.freeze(cow)
+    assert cow.template is d.template
+    assert d.replicas == 1
+
+
+def test_object_versions_are_monotonic_across_store_revisions():
+    c = FakeCluster()
+    c.create(_deployment("d0"))
+    v1 = frz.object_version(c.get("Deployment", NS, "d0"))
+    c.patch_scale("Deployment", NS, "d0", 4)
+    v2 = frz.object_version(c.get("Deployment", NS, "d0"))
+    assert v2 > v1 > 0
+
+
+def test_serde_interns_repeated_label_dicts_and_strings():
+    doc = to_k8s(freeze(_deployment("d0")))
+    a = from_k8s("Deployment", doc)
+    b = from_k8s("Deployment", json.loads(json.dumps(doc)))
+    # Equal label sets decode to ONE shared frozen dict + interned strings.
+    assert a.metadata.labels is b.metadata.labels
+    assert a.template.labels is b.template.labels
+    assert a.metadata.name is b.metadata.name
+    with pytest.raises(FrozenObjectError):
+        a.metadata.labels["x"] = "y"
+    # ... and a clone detaches into plain mutable dicts.
+    m = clone(a)
+    m.metadata.labels["x"] = "y"
+    assert "x" not in b.metadata.labels
+
+
+# --- 2. mutation-safety regression ------------------------------------------
+
+
+def _assert_store_isolated(reader, writer_view_factory):
+    """Shared regression body: a thawed copy of a read object is mutated
+    every which way; neither the store nor a CONCURRENT reader's already-
+    held view may change."""
+    before = to_k8s(reader())
+    held = reader()  # a concurrent reader's view, taken before mutation
+    mutable = clone(writer_view_factory())
+    mutable.spec.model_id = "mutated"
+    mutable.metadata.labels["evil"] = "yes"
+    mutable.status.desired_optimized_alloc.num_replicas = 99
+    mutable.status.conditions.append(object())  # even junk stays local
+    assert to_k8s(reader()) == before, "store changed via a thawed copy"
+    assert held.spec.model_id == "org/m"
+    assert "evil" not in held.metadata.labels
+    assert held.status.desired_optimized_alloc.num_replicas != 99
+
+
+def test_fakecluster_mutation_safety():
+    c = FakeCluster()
+    c.create(_va("va0"))
+    _assert_store_isolated(
+        lambda: c.get("VariantAutoscaling", NS, "va0"),
+        lambda: c.list("VariantAutoscaling", namespace=NS)[0])
+
+
+def test_informer_mutation_safety():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.create(_va("va0"))
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    _assert_store_isolated(
+        lambda: inf.list("VariantAutoscaling", namespace=NS)[0],
+        lambda: inf.list("VariantAutoscaling", namespace=NS)[0])
+    # The informer store itself also stayed clean (zero-request read).
+    cluster.reset_request_counts()
+    assert inf.list("VariantAutoscaling",
+                    namespace=NS)[0].spec.model_id == "org/m"
+    assert cluster.request_counts() == {}
+
+
+def test_snapshot_mutation_safety():
+    cluster = FakeCluster()
+    cluster.create(_va("va0"))
+    snap = SnapshotKubeClient(cluster)
+    _assert_store_isolated(
+        lambda: snap.get("VariantAutoscaling", NS, "va0"),
+        lambda: snap.list("VariantAutoscaling", namespace=NS)[0])
+
+
+def test_watch_handlers_share_one_frozen_instance():
+    """The informer-event double-copy regression (satellite #1): every
+    watch handler AND the store share ONE frozen instance per event —
+    zero per-handler copies, and a handler cannot corrupt its peers."""
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    seen = []
+    cluster.watch("VariantAutoscaling", lambda ev, obj: seen.append(obj))
+    created = cluster.create(_va("va0"))
+    assert len(seen) == 1
+    assert seen[0] is created, "handlers and callers share the instance"
+    assert inf.list("VariantAutoscaling", namespace=NS)[0] is seen[0], \
+        "the informer store holds the same frozen instance"
+    with pytest.raises(FrozenObjectError):
+        seen[0].spec.model_id = "boom"
+
+
+def test_zero_copy_off_restores_mutable_reads():
+    frz.set_zero_copy(False)
+    try:
+        c = FakeCluster()
+        c.create(_va("va0"))
+        got = c.get("VariantAutoscaling", NS, "va0")
+        got.spec.model_id = "mutated"  # historical copy-on-read contract
+        assert c.get("VariantAutoscaling",
+                     NS, "va0").spec.model_id == "org/m"
+    finally:
+        frz.set_zero_copy(True)
+
+
+# --- 3. WVA_ZERO_COPY=off byte equality -------------------------------------
+
+
+def test_zero_copy_off_statuses_and_trace_byte_identical():
+    """The copy-on-read lever must be byte-identical: same world, same
+    ticks, statuses AND trace cycles compared via canonical JSON (the
+    WVA_FORECAST=off discipline)."""
+    def run(zero_copy: bool):
+        from wva_tpu.engines import common
+
+        common.DecisionCache.clear()
+        while not common.DecisionTrigger.empty():
+            common.DecisionTrigger.get_nowait()
+        try:
+            mgr, cluster, tsdb, clock = make_fleet_world(
+                4, kv=0.78, queue=2, trace=True)
+            # AFTER the world builds: build_manager re-applies the lever
+            # from config (default on); read paths consult it per read.
+            frz.set_zero_copy(zero_copy)
+            for i in range(4):
+                for m in range(4):
+                    name = f"m{m:03d}-v5e"
+                    tsdb.add_sample(
+                        "vllm:kv_cache_usage_perc",
+                        {"pod": f"{name}-0", "namespace": NS,
+                         "model_name": f"org/model-{m:03d}"},
+                        0.80 + 0.03 * i)
+                mgr.engine.executor.tick()
+                mgr.va_reconciler.drain_triggers()
+                clock.advance(5.0)
+            mgr.flight_recorder.flush()
+            cycles = mgr.flight_recorder.snapshot()
+            statuses = {
+                va.metadata.name: encode(va.status)
+                for va in cluster.list("VariantAutoscaling", namespace=NS)}
+            mgr.shutdown()
+            return cycles, statuses
+        finally:
+            frz.set_zero_copy(True)
+
+    on_cycles, on_statuses = run(zero_copy=True)
+    off_cycles, off_statuses = run(zero_copy=False)
+    dumps = lambda x: json.dumps(x, sort_keys=True)  # noqa: E731
+    assert dumps(on_statuses) == dumps(off_statuses)
+    assert len(on_cycles) == len(off_cycles) > 0
+    for a, b in zip(on_cycles, off_cycles):
+        assert dumps(a) == dumps(b)
+
+
+# --- 4. steady-state ticks take ~0 object copies -----------------------------
+
+
+def test_steady_state_tick_takes_zero_object_copies():
+    """After statuses settle, a quiet tick's read path is fully zero-copy:
+    snapshot fill, LISTs, per-VA GETs, fingerprints, metric emission — no
+    K8s object is cloned unless a status write actually happens."""
+    mgr, cluster, tsdb, clock = make_fleet_world(6)
+    for _ in range(3):  # settle statuses + conditions + memos
+        mgr.engine.optimize()
+        clock.advance(5.0)
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_object_copies == 0, \
+        "steady-state tick must not copy K8s objects"
+    mgr.shutdown()
+
+
+def test_write_ticks_pay_proportional_copies_only():
+    """A dirtied model pays O(writes) clones (the COW builder), never
+    O(fleet)."""
+    n = 6
+    mgr, cluster, tsdb, clock = make_fleet_world(n)
+    for _ in range(3):
+        mgr.engine.optimize()
+        clock.advance(5.0)
+    # Dirty ONE model hard enough to change its decision.
+    tsdb.add_sample("vllm:kv_cache_usage_perc",
+                    {"pod": "m001-v5e-0", "namespace": NS,
+                     "model_name": "org/model-001"}, 0.97)
+    tsdb.add_sample("vllm:num_requests_waiting",
+                    {"pod": "m001-v5e-0", "namespace": NS,
+                     "model_name": "org/model-001"}, 9)
+    mgr.engine.optimize()
+    copies = mgr.engine.last_tick_object_copies
+    assert 0 < copies < n, f"copies should track writes, got {copies}"
+    mgr.shutdown()
+
+
+# --- 5. hot-path deepcopy lint -----------------------------------------------
+
+
+def test_no_copy_deepcopy_outside_sanctioned_clone():
+    """``copy.deepcopy`` is forbidden in k8s/ and the engine/pipeline hot
+    paths: every K8s-object copy must go through ``objects.clone()`` (so
+    the ``wva_tick_object_copies`` accounting sees it, and zero-copy reads
+    cannot silently regress into copy-on-read). Same discipline as the
+    ``self.client.list(`` lint in tests/test_informer.py."""
+    pkg = pathlib.Path(wva_tpu.__file__).parent
+    scope = sorted((pkg / "k8s").glob("*.py")) + [
+        pkg / "engines" / "saturation" / "engine.py",
+        pkg / "engines" / "scalefromzero" / "engine.py",
+        pkg / "engines" / "fastpath.py",
+        *sorted((pkg / "pipeline").glob("*.py")),
+    ]
+    assert len(scope) > 10
+    pattern = re.compile(r"copy\s*\.\s*deepcopy\s*\(")
+    offenders = []
+    for path in scope:
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            code = line.split("#", 1)[0]
+            if pattern.search(code):
+                offenders.append(
+                    f"{path.relative_to(pkg)}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "copy.deepcopy in a hot-path module — use the sanctioned "
+        "wva_tpu.k8s.objects.clone() instead:\n" + "\n".join(offenders))

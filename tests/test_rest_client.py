@@ -15,6 +15,8 @@ import urllib.request
 
 import pytest
 
+from wva_tpu.k8s.objects import clone
+
 from wva_tpu.api.v1alpha1 import (
     CrossVersionObjectReference,
     ObjectMeta,
@@ -225,8 +227,8 @@ class TestRestCRUD:
     def test_update_conflict_on_stale_rv(self, world):
         cluster, server, client = world
         client.create(_deployment())
-        a = client.get("Deployment", "inference", "llama-v5e")
-        b = client.get("Deployment", "inference", "llama-v5e")
+        a = clone(client.get("Deployment", "inference", "llama-v5e"))
+        b = clone(client.get("Deployment", "inference", "llama-v5e"))
         a.replicas = 5
         client.update(a)
         b.replicas = 7
@@ -236,7 +238,7 @@ class TestRestCRUD:
     def test_update_status_subresource_isolated(self, world):
         cluster, server, client = world
         client.create(_deployment(replicas=2))
-        d = client.get("Deployment", "inference", "llama-v5e")
+        d = clone(client.get("Deployment", "inference", "llama-v5e"))
         d.status.ready_replicas = 2
         d.replicas = 99  # must NOT leak through a status write
         client.update_status(d)
@@ -260,7 +262,7 @@ class TestRestCRUD:
                 scale_target_ref=CrossVersionObjectReference(name="v"),
                 model_id="m"))
         client.create(va)
-        got = client.get("VariantAutoscaling", "inference", "v")
+        got = clone(client.get("VariantAutoscaling", "inference", "v"))
         got.status.desired_optimized_alloc = OptimizedAlloc(
             accelerator="v5e-8", num_replicas=2)
         client.update_status(got)

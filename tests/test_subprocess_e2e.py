@@ -34,6 +34,7 @@ from wva_tpu.emulator.profiles import add_tpu_nodepool
 from wva_tpu.emulator.prom_server import FakePrometheusServer
 from wva_tpu.k8s import (
     ConfigMap,
+    clone,
     Container,
     Deployment,
     DeploymentStatus,
@@ -357,8 +358,8 @@ class TestSubprocessControllerE2E:
             time.sleep(5.0)  # several ticks; must stay 1
             assert desired() == 1
 
-            cm = cluster.get("ConfigMap", SYSTEM_NS,
-                             "wva-saturation-scaling-config")
+            cm = clone(cluster.get("ConfigMap", SYSTEM_NS,
+                                   "wva-saturation-scaling-config"))
             cm.data = {"default": "kvCacheThreshold: 0.3\n"
                                   "queueLengthThreshold: 1\n"}
             cluster.update(cm)

@@ -36,6 +36,7 @@ from wva_tpu.k8s import (
     ResourceRequirements,
 )
 from wva_tpu.k8s.client import ConflictError
+from wva_tpu.k8s.objects import FrozenObjectError, clone
 from wva_tpu.k8s.snapshot import SnapshotKubeClient
 from wva_tpu.main import build_manager
 from wva_tpu.utils import FakeClock
@@ -358,14 +359,19 @@ def test_snapshot_returns_isolated_copies():
     cluster = _mini_cluster()
     snap = SnapshotKubeClient(cluster)
     a = snap.get("VariantAutoscaling", NS, "va0")
-    a.spec.model_id = "mutated"
+    # Zero-copy snapshot reads are frozen shared views: mutation raises,
+    # and a thawed clone never reaches the cache.
+    with pytest.raises(FrozenObjectError):
+        a.spec.model_id = "mutated"
+    b = clone(a)
+    b.spec.model_id = "mutated"
     assert snap.get("VariantAutoscaling", NS, "va0").spec.model_id == "m0"
 
 
 def test_snapshot_read_your_writes_within_tick():
     cluster = _mini_cluster()
     snap = SnapshotKubeClient(cluster)
-    va = snap.get("VariantAutoscaling", NS, "va0")
+    va = clone(snap.get("VariantAutoscaling", NS, "va0"))
     va.status.desired_optimized_alloc.num_replicas = 7
     snap.update_status(va)
     assert snap.get("VariantAutoscaling", NS, "va0") \
@@ -380,7 +386,7 @@ def test_snapshot_is_frozen_until_targeted_refresh():
     snap = SnapshotKubeClient(cluster)
     snap.get("VariantAutoscaling", NS, "va0")  # populate the kind cache
     # Out-of-band write (another controller): invisible to the tick...
-    fresh = cluster.get("VariantAutoscaling", NS, "va0")
+    fresh = clone(cluster.get("VariantAutoscaling", NS, "va0"))
     fresh.status.desired_optimized_alloc.num_replicas = 42
     cluster.update_status(fresh)
     assert snap.get("VariantAutoscaling", NS, "va0") \
@@ -401,12 +407,12 @@ def test_conflict_refetch_status_write_retries_with_targeted_get():
     from wva_tpu.utils.variant import update_va_status_with_backoff
 
     cluster = _mini_cluster()
-    va = cluster.get("VariantAutoscaling", NS, "va1")  # stale-rv read
+    va = clone(cluster.get("VariantAutoscaling", NS, "va1"))  # stale-rv read
     va.status.desired_optimized_alloc.num_replicas = 3
     # Concurrent reconciler write lands before the engine's (the 409 cause):
     # its condition must SURVIVE the conflict-refetch merge — only the
     # engine-owned fields may be grafted onto the fresh read.
-    other = cluster.get("VariantAutoscaling", NS, "va1")
+    other = clone(cluster.get("VariantAutoscaling", NS, "va1"))
     other.set_condition("TargetResolved", "False", "TargetNotFound",
                         "scale target missing", now=1000.0)
     cluster.update_status(other)
@@ -431,10 +437,10 @@ def test_conflict_refetch_never_reverts_a_newer_decision():
 
     cluster = _mini_cluster()
     # Engine's snapshot read (alloc last_run_time = 0: never decided).
-    va = cluster.get("VariantAutoscaling", NS, "va2")
+    va = clone(cluster.get("VariantAutoscaling", NS, "va2"))
     read_alloc = va.status.desired_optimized_alloc
     # Mid-tick wake: desired 0 -> 1, stamped t=50.
-    wake = cluster.get("VariantAutoscaling", NS, "va2")
+    wake = clone(cluster.get("VariantAutoscaling", NS, "va2"))
     wake.status.desired_optimized_alloc = OptimizedAlloc(
         accelerator="v5e-8", num_replicas=1, last_run_time=50.0)
     cluster.update_status(wake)
@@ -459,14 +465,14 @@ def test_conflict_refetch_heartbeat_is_not_a_newer_decision():
 
     cluster = _mini_cluster()
     # Wake's fresh read: desired=0 at t=10.
-    va = cluster.get("VariantAutoscaling", NS, "va0")
+    va = clone(cluster.get("VariantAutoscaling", NS, "va0"))
     va.status.desired_optimized_alloc = OptimizedAlloc(
         accelerator="v5e-8", num_replicas=0, last_run_time=10.0)
     cluster.update_status(va)
-    wake = cluster.get("VariantAutoscaling", NS, "va0")
+    wake = clone(cluster.get("VariantAutoscaling", NS, "va0"))
     read_alloc = wake.status.desired_optimized_alloc
     # Engine heartbeat lands in between: same values, newer stamp (t=40).
-    hb = cluster.get("VariantAutoscaling", NS, "va0")
+    hb = clone(cluster.get("VariantAutoscaling", NS, "va0"))
     hb.status.desired_optimized_alloc = OptimizedAlloc(
         accelerator="v5e-8", num_replicas=0, last_run_time=40.0)
     cluster.update_status(hb)

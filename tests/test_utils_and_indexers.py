@@ -7,6 +7,7 @@ from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
 from wva_tpu.api.v1alpha1 import CrossVersionObjectReference
 from wva_tpu.datastore import Datastore, PoolNotFoundError
 from wva_tpu.indexers import Indexer, MultipleVAsError
+from wva_tpu.k8s import clone
 from wva_tpu.k8s import Deployment, FakeCluster
 from wva_tpu.utils import (
     EndpointPool,
@@ -194,7 +195,7 @@ def test_indexer_clearing_target_removes_stale_entry():
     idx.setup()
     c.create(make_va("va1", target="d1"))
     assert idx.find_va_for_deployment("d1", "default").metadata.name == "va1"
-    cleared = c.get("VariantAutoscaling", "default", "va1")
+    cleared = clone(c.get("VariantAutoscaling", "default", "va1"))
     cleared.spec.scale_target_ref = CrossVersionObjectReference(kind="", name="", api_version="")
     c.update(cleared)
     assert idx.find_va_for_deployment("d1", "default") is None

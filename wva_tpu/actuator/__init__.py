@@ -27,12 +27,18 @@ class Actuator:
         self.registry = registry
 
     def emit_metrics(self, va: VariantAutoscaling,
-                     client: KubeClient | None = None) -> None:
+                     client: KubeClient | None = None,
+                     desired: int | None = None,
+                     accelerator: str | None = None) -> None:
         """Read REAL current replicas from the target and emit
         current/desired/ratio gauges. Raises on missing target (caller logs
         but never fails the loop on emission errors). ``client`` lets the
         engine pass its tick-scoped snapshot so the per-VA emission loop
-        costs zero API requests (the tick already LISTed every target)."""
+        costs zero API requests (the tick already LISTed every target).
+        ``desired``/``accelerator`` override the VA's status values: the
+        engine emits its JUST-COMPUTED decision from the frozen snapshot
+        read, without mutating status first (the status write — and its
+        copy-on-write clone — is skipped when nothing material changed)."""
         target = scale_target.scale_target_state((client or self.client).get(
             va.spec.scale_target_ref.kind or Deployment.KIND,
             va.metadata.namespace, va.spec.scale_target_ref.name))
@@ -42,8 +48,10 @@ class Actuator:
         # fallback would report current=N and hide the ratio=desired
         # encoding HPA relies on in exactly that window.
         current = target.status_replicas
-        desired = va.status.desired_optimized_alloc.num_replicas
-        accelerator = va.status.desired_optimized_alloc.accelerator
+        if desired is None:
+            desired = va.status.desired_optimized_alloc.num_replicas
+        if accelerator is None:
+            accelerator = va.status.desired_optimized_alloc.accelerator
         self.registry.emit_replica_metrics(
             variant_name=va.metadata.name,
             namespace=va.metadata.namespace,

@@ -17,6 +17,7 @@ import copy
 import time as _time
 
 from wva_tpu.utils import clock as _clock
+from wva_tpu.utils.freeze import Freezable, intern_labels, intern_str
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -64,7 +65,7 @@ def _parse_rfc3339(s: str) -> float:
 
 
 @dataclass
-class ObjectMeta:
+class ObjectMeta(Freezable):
     """Subset of k8s ObjectMeta the framework uses."""
 
     name: str = ""
@@ -98,11 +99,17 @@ class ObjectMeta:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ObjectMeta":
+        # Decode-time interning: fleet-sized LISTs repeat the same label/
+        # annotation dicts (every pod of a variant) and the same metadata
+        # strings; decoded objects share ONE frozen dict / str instance.
+        # The shared dicts are read-only — a caller mutating a decoded
+        # object's labels must go through objects.clone(), which thaws
+        # them (docs/design/object-plane.md).
         return cls(
-            name=d.get("name", ""),
-            namespace=d.get("namespace", "default"),
-            labels=dict(d.get("labels") or {}),
-            annotations=dict(d.get("annotations") or {}),
+            name=intern_str(d.get("name", "")),
+            namespace=intern_str(d.get("namespace", "default")),
+            labels=intern_labels(d.get("labels")),
+            annotations=intern_labels(d.get("annotations")),
             uid=d.get("uid", ""),
             resource_version=str(d.get("resourceVersion", "0")),
             generation=int(d.get("generation", 1)),
@@ -116,7 +123,7 @@ class ObjectMeta:
 
 
 @dataclass
-class CrossVersionObjectReference:
+class CrossVersionObjectReference(Freezable):
     """HPA-style scale target reference (reference types :13)."""
 
     kind: str = "Deployment"
@@ -136,7 +143,7 @@ class CrossVersionObjectReference:
 
 
 @dataclass
-class Condition:
+class Condition(Freezable):
     """metav1.Condition equivalent."""
 
     type: str
@@ -169,7 +176,7 @@ class Condition:
 
 
 @dataclass
-class VariantAutoscalingSpec:
+class VariantAutoscalingSpec(Freezable):
     """Desired state (reference types :9-25).
 
     ``model_id`` is the served model identity (e.g. ``meta-llama/Llama-3.1-8B``)
@@ -212,7 +219,7 @@ class VariantAutoscalingSpec:
 
 
 @dataclass
-class OptimizedAlloc:
+class OptimizedAlloc(Freezable):
     """Target optimized allocation (reference types :46-58).
 
     ``accelerator`` is a TPU slice variant name, e.g. ``v5e-8`` (a
@@ -243,7 +250,7 @@ class OptimizedAlloc:
 
 
 @dataclass
-class ActuationStatus:
+class ActuationStatus(Freezable):
     applied: bool = False
 
     def to_dict(self) -> dict[str, Any]:
@@ -255,7 +262,7 @@ class ActuationStatus:
 
 
 @dataclass
-class VariantAutoscalingStatus:
+class VariantAutoscalingStatus(Freezable):
     desired_optimized_alloc: OptimizedAlloc = field(default_factory=OptimizedAlloc)
     actuation: ActuationStatus = field(default_factory=ActuationStatus)
     conditions: list[Condition] = field(default_factory=list)
@@ -289,7 +296,7 @@ class VariantAutoscalingStatus:
 
 
 @dataclass
-class VariantAutoscaling:
+class VariantAutoscaling(Freezable):
     """The VariantAutoscaling resource (reference types :77-86)."""
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)

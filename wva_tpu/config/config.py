@@ -19,6 +19,7 @@ if TYPE_CHECKING:  # pragma: no cover — avoids a config -> analyzers cycle
 
 from wva_tpu.config.types import CacheConfig, ScaleToZeroConfigData
 from wva_tpu.interfaces.saturation_config import SaturationScalingConfig
+from wva_tpu.utils import freeze as frz
 from wva_tpu.utils.clock import SYSTEM_CLOCK
 
 log = logging.getLogger(__name__)
@@ -74,6 +75,11 @@ class InfrastructureConfig:
     # cannot see (enforcer retention windows, analyzer-internal state).
     # 0 disables the periodic resync.
     resync_ticks: int = 12
+    # Zero-copy object plane (WVA_ZERO_COPY, default on;
+    # docs/design/object-plane.md): store reads return frozen shared
+    # objects instead of deep copies. Off restores copy-on-read —
+    # byte-identical decisions, pre-change CPU cost.
+    zero_copy: bool = True
 
 
 @dataclass
@@ -87,7 +93,7 @@ class TLSConfig:
 
 
 @dataclass
-class PrometheusConfig:
+class PrometheusConfig(frz.Freezable):
     base_url: str = ""
     bearer_token: str = ""
     token_path: str = ""
@@ -104,19 +110,19 @@ class PrometheusConfig:
 
 
 @dataclass
-class EPPConfig:
+class EPPConfig(frz.Freezable):
     metric_reader_bearer_token: str = ""
 
 
 @dataclass
-class FeatureFlagsConfig:
+class FeatureFlagsConfig(frz.Freezable):
     scale_to_zero_enabled: bool = False
     limited_mode_enabled: bool = False
     scale_from_zero_max_concurrency: int = 10
 
 
 @dataclass
-class TraceConfig:
+class TraceConfig(frz.Freezable):
     """Decision flight recorder (``wva_tpu.blackbox``): one JSONL record per
     engine cycle, kept in a bounded in-memory ring and optionally spilled to
     ``path`` for offline replay (``python -m wva_tpu replay``)."""
@@ -127,7 +133,7 @@ class TraceConfig:
 
 
 @dataclass
-class ForecastConfig:
+class ForecastConfig(frz.Freezable):
     """Predictive capacity planner (``wva_tpu.forecast``): seasonality-aware
     demand forecasting with measured provisioning lead times
     (docs/design/forecast.md). Default ON; ``WVA_FORECAST=off`` restores
@@ -160,7 +166,7 @@ class ForecastConfig:
 
 
 @dataclass
-class CapacityConfig:
+class CapacityConfig(frz.Freezable):
     """Elastic capacity plane (``wva_tpu.capacity``): slice provisioning,
     preemption resilience, reservation/spot-aware inventory
     (docs/design/capacity.md). Default ON; ``WVA_CAPACITY=off`` restores
@@ -215,6 +221,12 @@ class Config:
         self._capacity = CapacityConfig()
         # Bumped on every decision-affecting hot-reload (see mutation_epoch).
         self._epoch = 0
+        # Hot-accessor memo: section name -> FROZEN deep copy, built once
+        # per section revision and handed out by reference (the engine
+        # probes prometheus/trace/forecast/capacity config per tick, and a
+        # per-call deepcopy of each was measurable at fleet scale).
+        # Invalidated write-through by every setter.
+        self._memo: dict[str, object] = {}
 
     # --- infrastructure getters ---
 
@@ -264,6 +276,10 @@ class Config:
         with self._mu:
             return max(0, self.infrastructure.resync_ticks)
 
+    def zero_copy_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.zero_copy
+
     def mutation_epoch(self) -> int:
         """Monotonic counter bumped by every hot-reloadable config update.
         The engine's dirty-set fingerprints include it, so a ConfigMap edit
@@ -274,6 +290,19 @@ class Config:
 
     def _bump_epoch_locked(self) -> None:
         self._epoch += 1
+        self._memo.clear()
+
+    def _memoized(self, key: str, build):
+        """Frozen memo of a hot config section: pointer reads per tick
+        instead of a deepcopy per call. The returned object is immutable
+        (mutation raises) — callers needing a mutable copy deep-copy it,
+        which thaws. Setters clear the memo (hot-reload invalidation)."""
+        with self._mu:
+            hit = self._memo.get(key)
+            if hit is None:
+                hit = frz.freeze(copy.deepcopy(build()))
+                self._memo[key] = hit
+            return hit
 
     def rest_timeout(self) -> float:
         with self._mu:
@@ -298,20 +327,20 @@ class Config:
             return self._prometheus.bearer_token
 
     def prometheus_cache_config(self) -> CacheConfig | None:
-        with self._mu:
-            return copy.deepcopy(self._prometheus.cache)
+        return self.prometheus().cache
 
     def prometheus(self) -> PrometheusConfig:
-        with self._mu:
-            return copy.deepcopy(self._prometheus)
+        return self._memoized("prometheus", lambda: self._prometheus)
 
     def set_prometheus(self, p: PrometheusConfig) -> None:
         with self._mu:
             self._prometheus = copy.deepcopy(p)
+            self._memo.clear()
 
     def update_prometheus_cache_config(self, cache: CacheConfig | None) -> None:
         with self._mu:
             self._prometheus.cache = copy.deepcopy(cache)
+            self._memo.clear()
 
     # --- EPP getters ---
 
@@ -322,6 +351,7 @@ class Config:
     def set_epp(self, epp: EPPConfig) -> None:
         with self._mu:
             self._epp = copy.deepcopy(epp)
+            self._memo.clear()
 
     # --- feature flags ---
 
@@ -345,18 +375,17 @@ class Config:
     # --- decision trace (flight recorder) ---
 
     def trace_config(self) -> TraceConfig:
-        with self._mu:
-            return copy.deepcopy(self._trace)
+        return self._memoized("trace", lambda: self._trace)
 
     def set_trace(self, t: TraceConfig) -> None:
         with self._mu:
             self._trace = copy.deepcopy(t)
+            self._memo.clear()
 
     # --- predictive capacity planner (wva_tpu.forecast) ---
 
     def forecast_config(self) -> ForecastConfig:
-        with self._mu:
-            return copy.deepcopy(self._forecast)
+        return self._memoized("forecast", lambda: self._forecast)
 
     def forecast_enabled(self) -> bool:
         with self._mu:
@@ -370,8 +399,7 @@ class Config:
     # --- elastic capacity plane (wva_tpu.capacity) ---
 
     def capacity_config(self) -> CapacityConfig:
-        with self._mu:
-            return copy.deepcopy(self._capacity)
+        return self._memoized("capacity", lambda: self._capacity)
 
     def capacity_enabled(self) -> bool:
         with self._mu:

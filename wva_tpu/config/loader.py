@@ -97,6 +97,10 @@ DEFAULTS: dict[str, Any] = {
     "WVA_INCREMENTAL": True,
     # Full re-analysis every Nth tick regardless of fingerprints (0 = off).
     "WVA_RESYNC_TICKS": 12,
+    # Zero-copy object plane (docs/design/object-plane.md): store reads
+    # return frozen shared objects. Off restores deep-copy-on-read
+    # (byte-identical decisions; emergency lever).
+    "WVA_ZERO_COPY": True,
     # GET /api/v1/query instead of POST (read-only proxies).
     "PROMETHEUS_USE_GET_QUERIES": False,
 }
@@ -202,6 +206,7 @@ def load(flags: Mapping[str, Any] | None = None,
         informer=r.get_bool("WVA_INFORMER"),
         incremental=r.get_bool("WVA_INCREMENTAL"),
         resync_ticks=max(0, r.get_int("WVA_RESYNC_TICKS")),
+        zero_copy=r.get_bool("WVA_ZERO_COPY"),
     )
     cfg.tls = TLSConfig(
         webhook_cert_path=r.get_str("WEBHOOK_CERT_PATH"),

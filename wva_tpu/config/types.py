@@ -7,6 +7,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from wva_tpu.interfaces.replica_metrics import FRESH, STALE, UNAVAILABLE
+from wva_tpu.utils.freeze import Freezable
 
 # Default retention after the last request before scaling to zero.
 DEFAULT_SCALE_TO_ZERO_RETENTION_SECONDS = 10 * 60.0
@@ -16,7 +17,7 @@ GLOBAL_DEFAULTS_KEY = "default"
 
 
 @dataclass
-class FreshnessThresholds:
+class FreshnessThresholds(Freezable):
     """Age thresholds classifying metric freshness.
 
     Each knob has a distinct job: ``fresh_threshold`` bounds FRESH,
@@ -38,7 +39,7 @@ class FreshnessThresholds:
 
 
 @dataclass
-class CacheConfig:
+class CacheConfig(Freezable):
     """Metrics-cache configuration shared by all collector sources."""
 
     ttl: float = 30.0

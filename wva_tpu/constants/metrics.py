@@ -120,6 +120,11 @@ WVA_INFORMER_SYNCED = "wva_informer_synced"
 WVA_TICK_MODELS_SKIPPED = "wva_tick_models_skipped"
 # Models analyzed (dirty or resync) this tick.
 WVA_TICK_MODELS_ANALYZED = "wva_tick_models_analyzed"
+# --- Immutable object plane (docs/design/object-plane.md) ---
+# K8s object copies (objects.clone / thaw) taken during the last engine
+# tick. ~0 on steady-state ticks: reads are zero-copy frozen views, and a
+# copy happens only at a write site (copy-on-write builder).
+WVA_TICK_OBJECT_COPIES = "wva_tick_object_copies"
 
 # --- Common metric label names ---
 LABEL_KIND = "kind"

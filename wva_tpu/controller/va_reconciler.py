@@ -33,7 +33,7 @@ from wva_tpu.k8s.client import (
     KubeClient,
     NotFoundError,
 )
-from wva_tpu.k8s.objects import Deployment, LeaderWorkerSet, ServiceMonitor
+from wva_tpu.k8s.objects import Deployment, LeaderWorkerSet, ServiceMonitor, clone
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from wva_tpu.utils.variant import (
     update_va_status_with_backoff,
@@ -162,7 +162,11 @@ class VariantAutoscalingReconciler:
 
     def reconcile(self, name: str, namespace: str) -> None:
         try:
-            va = self.client.get(VariantAutoscaling.kind, namespace, name)
+            # Live reads are frozen shared views; the reconciler mutates
+            # conditions in place, so take the copy-on-write clone up
+            # front (reconciles run per trigger, not per VA per tick).
+            va = clone(self.client.get(VariantAutoscaling.kind, namespace,
+                                       name))
         except NotFoundError:
             self.datastore.namespace_untrack(VariantAutoscaling.kind, name, namespace)
             common.DecisionCache.delete(name, namespace)

@@ -16,6 +16,7 @@ from wva_tpu.api.v1alpha1 import ObjectMeta
 from wva_tpu.constants.labels import TPU_RESOURCE_NAME
 from wva_tpu.k8s.client import KubeClient, NotFoundError
 from wva_tpu.k8s.objects import (
+    clone,
     Deployment,
     LeaderWorkerSet,
     Node,
@@ -80,6 +81,7 @@ class FakeKubelet:
                     pass
                 self._pending.pop(pod.metadata.name, None)
             elif not node.ready and pod.status.ready:
+                pod = clone(pod)  # listed pods are frozen store views
                 pod.status.ready = False
                 try:
                     self.client.update_status(pod)
@@ -101,6 +103,7 @@ class FakeKubelet:
             node_name = self._find_node_with_chips(chips_needed)
             if node_name is None:
                 continue
+            pod = clone(pod)
             pod.node_name = node_name
             try:
                 self.client.update(pod)
@@ -141,6 +144,7 @@ class FakeKubelet:
         status_changed = (deploy.status.replicas != len(pods)
                           or deploy.status.ready_replicas != ready)
         if status_changed:
+            deploy = clone(deploy)
             deploy.status.replicas = len(pods)
             deploy.status.ready_replicas = ready
             deploy.status.updated_replicas = len(pods)
@@ -191,6 +195,7 @@ class FakeKubelet:
                     if len(pods) >= size and all(p.is_ready() for p in pods))
         if (lws.status.replicas != len(groups)
                 or lws.status.ready_replicas != ready):
+            lws = clone(lws)
             lws.status.replicas = len(groups)
             lws.status.ready_replicas = ready
             try:
@@ -277,6 +282,7 @@ class FakeKubelet:
             # find the pod across namespaces
             for pod in self.client.list(Pod.KIND):
                 if pod.metadata.name == name and not pod.status.ready:
+                    pod = clone(pod)
                     pod.status.phase = "Running"
                     pod.status.ready = True
                     try:

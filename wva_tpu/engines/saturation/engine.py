@@ -15,7 +15,6 @@ current replicas are still emitted so the external HPA never starves
 
 from __future__ import annotations
 
-import copy
 import logging
 import math
 from concurrent.futures import ThreadPoolExecutor
@@ -101,6 +100,7 @@ from wva_tpu.constants import (
     WVA_INFORMER_SYNCED,
     WVA_TICK_MODELS_ANALYZED,
     WVA_TICK_MODELS_SKIPPED,
+    WVA_TICK_OBJECT_COPIES,
     WVA_TREND_SERIES_SAMPLES,
     WVA_TREND_SERIES_STALENESS_SECONDS,
 )
@@ -120,8 +120,9 @@ from wva_tpu.interfaces import (
 )
 from wva_tpu.interfaces.saturation_config import SLO_ANALYZER_NAME, V2_ANALYZER_NAME
 from wva_tpu.k8s.client import KubeClient, NotFoundError
-from wva_tpu.k8s.objects import Deployment, labels_match, parse_quantity
+from wva_tpu.k8s.objects import Deployment, clone, labels_match, parse_quantity
 from wva_tpu.k8s.snapshot import DEFAULT_SNAPSHOT_KINDS, SnapshotKubeClient
+from wva_tpu.utils import freeze as frz
 from wva_tpu.pipeline import (
     CostAwareOptimizer,
     Enforcer,
@@ -209,6 +210,28 @@ METRICS_MESSAGE_UNAVAILABLE = (
 
 
 _status_material = variant_utils.va_status_material
+
+
+def _conditions_material_with(va, ctype: str, status: str, reason: str,
+                              message: str) -> tuple:
+    """The conditions slice of ``va_status_material`` AS IF
+    ``va.set_condition(ctype, status, reason, message)`` had run —
+    upsert-in-place, append-if-absent — computed without mutating the
+    (frozen, store-shared) object. Lets the writer skip both the status
+    PUT and the copy-on-write clone when nothing material would change."""
+    gen = va.metadata.generation
+    out = []
+    found = False
+    for c in va.status.conditions:
+        if c.type == ctype:
+            out.append((ctype, status, reason, message, gen))
+            found = True
+        else:
+            out.append((c.type, c.status, c.reason, c.message,
+                        c.observed_generation))
+    if not found:
+        out.append((ctype, status, reason, message, gen))
+    return tuple(out)
 
 
 @dataclass
@@ -330,6 +353,9 @@ class SaturationEngine:
         self._decision_memo: dict[str, list[VariantDecision]] = {}
         # Introspection for tests/bench: analyzed vs skipped last tick.
         self.last_tick_stats: dict[str, int] = {"analyzed": 0, "skipped": 0}
+        # K8s object copies taken during the last tick (object plane
+        # accounting; ~0 at steady state — see wva_tick_object_copies).
+        self.last_tick_object_copies = 0
         self._analysis_pool: ThreadPoolExecutor | None = None
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock,
@@ -442,6 +468,10 @@ class SaturationEngine:
 
     def optimize(self) -> None:
         """One optimization tick (reference engine.go:171-277)."""
+        # Object-plane accounting: K8s object copies taken during THIS
+        # tick (clone/thaw of a Freezable). Steady-state ticks are ~0 —
+        # reads are zero-copy frozen views; a copy marks a write site.
+        copies_at_start = frz.copy_count()
         if self.flight is not None:
             # Retried ticks must not stack duplicate model records into the
             # failed attempt's cycle.
@@ -474,6 +504,12 @@ class SaturationEngine:
             self._optimize_with(snap, collector)
         finally:
             self.enforcer.metrics_source = None
+            copies = frz.copy_count() - copies_at_start
+            self.last_tick_object_copies = copies
+            registry = getattr(self.actuator, "registry", None)
+            if registry is not None:
+                registry.set_gauge(WVA_TICK_OBJECT_COPIES, {},
+                                   float(copies))
 
     def _optimize_with(self, snap: KubeClient,
                        collector: ReplicaMetricsCollector) -> None:
@@ -725,7 +761,7 @@ class SaturationEngine:
         and record the skip as a trace stage (replay treats re-emitted
         models like no-record models — their decisions were verified the
         cycle they were computed)."""
-        cached = [copy.deepcopy(d)
+        cached = [clone(d)
                   for d in self._decision_memo.get(group_key, [])]
         into.extend(cached)
         if self.flight is not None:
@@ -747,7 +783,7 @@ class SaturationEngine:
             self._decision_memo.pop(group_key, None)
             self._fingerprints.pop(group_key, None)
             return
-        self._decision_memo[group_key] = [copy.deepcopy(d) for d in decisions]
+        self._decision_memo[group_key] = [clone(d) for d in decisions]
         self._fingerprints[group_key] = fp
 
     def _invalidate_model(self, group_key: str) -> None:
@@ -1915,12 +1951,6 @@ class SaturationEngine:
             # created over an already-running deployment would otherwise
             # report a fictitious "0 -> N" scale-up).
             had_recorded_alloc = old_alloc.last_run_time > 0
-            update_va.status.desired_optimized_alloc = OptimizedAlloc(
-                accelerator=accelerator,
-                num_replicas=target_replicas,
-                last_run_time=now,
-            )
-            update_va.status.actuation.applied = False
             # Operators can see the horizon the planner ACTUALLY uses
             # (measured actuation->ready quantile); only measured estimates
             # are surfaced — the default constant would be noise dressed as
@@ -1934,19 +1964,17 @@ class SaturationEngine:
                     update_va.metadata.namespace, update_va.spec.model_id)
                 if measured:
                     lead_value = round(lead, 1)
-            update_va.status.forecast_lead_time_seconds = lead_value
-            update_va.set_condition(
-                TYPE_OPTIMIZATION_READY, "True",
-                "SaturationOnlyMode" if decision is not None
-                else REASON_OPTIMIZATION_SUCCEEDED,
-                (f"saturation decision: {reason} (target: {target_replicas} replicas)"
-                 if decision is not None
-                 else "Optimization loop ran (no scaling change needed)"),
-                now=now)
 
+            applied = False
             try:
-                self.actuator.emit_metrics(update_va, client=client)
-                update_va.status.actuation.applied = True
+                # Emission works from the frozen snapshot read plus the
+                # computed decision values — the status mutation below is
+                # skipped entirely on no-change ticks, so the gauges must
+                # not depend on it.
+                self.actuator.emit_metrics(update_va, client=client,
+                                           desired=target_replicas,
+                                           accelerator=accelerator)
+                applied = True
             except Exception as e:  # noqa: BLE001 — emission never fails the loop
                 log.error("Failed to emit metrics for %s: %s", va_key, e)
 
@@ -1958,7 +1986,7 @@ class SaturationEngine:
                     "namespace": va.metadata.namespace,
                     "accelerator": accelerator,
                     "desired": target_replicas,
-                    "applied": update_va.status.actuation.applied,
+                    "applied": applied,
                     "had_decision": decision is not None,
                 })
 
@@ -1972,9 +2000,37 @@ class SaturationEngine:
             # at a 5s tick with N VAs, unconditional writes are 2N API
             # requests per tick of no-op churn. A heartbeat bound keeps
             # lastRunTime from going permanently stale on quiet models.
+            # The would-be material is computed WITHOUT mutating: the
+            # snapshot read is a frozen shared object, and only an actual
+            # write pays the copy-on-write clone (wva_tick_object_copies
+            # stays ~0 on steady-state ticks).
+            cond_reason = ("SaturationOnlyMode" if decision is not None
+                           else REASON_OPTIMIZATION_SUCCEEDED)
+            cond_message = (
+                f"saturation decision: {reason} "
+                f"(target: {target_replicas} replicas)"
+                if decision is not None
+                else "Optimization loop ran (no scaling change needed)")
+            new_material = (
+                accelerator, target_replicas, applied, lead_value,
+                _conditions_material_with(
+                    update_va, TYPE_OPTIMIZATION_READY, "True",
+                    cond_reason, cond_message))
             persisted = True
-            if (_status_material(update_va) != prev_material
+            if (new_material != prev_material
                     or now - prev_run_time >= STATUS_HEARTBEAT_SECONDS):
+                # Copy-on-write builder: clone -> mutate -> write.
+                update_va = clone(update_va)
+                update_va.status.desired_optimized_alloc = OptimizedAlloc(
+                    accelerator=accelerator,
+                    num_replicas=target_replicas,
+                    last_run_time=now,
+                )
+                update_va.status.actuation.applied = applied
+                update_va.status.forecast_lead_time_seconds = lead_value
+                update_va.set_condition(
+                    TYPE_OPTIMIZATION_READY, "True", cond_reason,
+                    cond_message, now=now)
                 try:
                     # Writes always target the LIVE client: a 409 from a
                     # snapshot-stale resourceVersion refetches just the

@@ -33,6 +33,7 @@ from wva_tpu.engines.common.epp import (
 )
 from wva_tpu.engines.executor import PollingExecutor
 from wva_tpu.interfaces import ACTION_SCALE_UP, VariantDecision
+from wva_tpu.k8s import objects
 from wva_tpu.k8s.client import KubeClient, NotFoundError
 from wva_tpu.utils import variant as variant_utils
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
@@ -161,8 +162,8 @@ class ScaleFromZeroEngine:
 
         # Seed status so the reconciler and the next saturation tick agree.
         try:
-            update_va = variant_utils.get_va_with_backoff(
-                self.client, va.metadata.name, va.metadata.namespace)
+            update_va = objects.clone(variant_utils.get_va_with_backoff(
+                self.client, va.metadata.name, va.metadata.namespace))
             read_alloc = update_va.status.desired_optimized_alloc
             update_va.status.desired_optimized_alloc = OptimizedAlloc(
                 accelerator=accelerator, num_replicas=1, last_run_time=now)

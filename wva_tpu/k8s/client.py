@@ -15,7 +15,6 @@ update/update-status/delete/scale + watch). Two implementations:
 from __future__ import annotations
 
 import abc
-import copy
 import logging
 import threading
 import uuid
@@ -27,6 +26,7 @@ log = logging.getLogger(__name__)
 from wva_tpu.api.v1alpha1 import VariantAutoscaling
 from wva_tpu.k8s.objects import labels_match
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from wva_tpu.utils.freeze import freeze, read_view, shallow_thaw, thaw
 
 # Watch event types.
 ADDED = "ADDED"
@@ -60,12 +60,15 @@ class KubeClient(abc.ABC):
 
     @abc.abstractmethod
     def get(self, kind: str, namespace: str, name: str) -> Any:
-        """Return a deep copy; raises NotFoundError."""
+        """Return a READ-ONLY view (frozen shared object under the
+        zero-copy plane; a deep copy with ``WVA_ZERO_COPY=off``); raises
+        NotFoundError. Callers must ``objects.clone()`` before mutating."""
 
     @abc.abstractmethod
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict[str, str] | None = None) -> list[Any]:
-        """Deep-copied objects, optionally namespace- and label-filtered."""
+        """Read-only object views (see :meth:`get`), optionally namespace-
+        and label-filtered."""
 
     @abc.abstractmethod
     def create(self, obj: Any) -> Any: ...
@@ -95,8 +98,13 @@ class _Stored:
 
 
 class FakeCluster(KubeClient):
-    """In-memory cluster. Objects are deep-copied on the way in and out so
-    callers can't mutate the store (same guarantee an API server gives)."""
+    """In-memory cluster. The store holds FROZEN objects and serves reads
+    zero-copy: callers still can't mutate the store — a mutation attempt
+    raises ``FrozenObjectError`` instead of silently diverging (stronger
+    than an API server's copy semantics; docs/design/object-plane.md).
+    Writers take a mutable view via ``objects.clone()`` first; store
+    updates are copy-on-write with structural sharing (a status write
+    shares the old spec/template subtrees)."""
 
     def __init__(self, clock: Clock | None = None) -> None:
         self._mu = threading.RLock()
@@ -142,7 +150,10 @@ class FakeCluster(KubeClient):
             handlers = list(self._watchers.get(_kind_of(obj), []))
         for handler in handlers:
             try:
-                handler(event, _copy(obj))
+                # One frozen instance shared by every handler AND the store
+                # (zero copies); with WVA_ZERO_COPY=off each handler gets
+                # its own mutable deep copy, the historical contract.
+                handler(event, read_view(obj))
             except Exception:  # noqa: BLE001
                 log.exception("watch handler failed for %s event on %s/%s",
                               event, obj.metadata.namespace, obj.metadata.name)
@@ -155,7 +166,7 @@ class FakeCluster(KubeClient):
             stored = self._objs.get(self._key(kind, namespace, name))
             if stored is None:
                 raise NotFoundError(kind, namespace or "", name)
-            return _copy(stored.obj)
+            return read_view(stored.obj)
 
     def try_get(self, kind: str, namespace: str, name: str) -> Any | None:
         try:
@@ -175,7 +186,7 @@ class FakeCluster(KubeClient):
                     continue
                 if not labels_match(label_selector, stored.obj.metadata.labels):
                     continue
-                out.append(_copy(stored.obj))
+                out.append(read_view(stored.obj))
             return out
 
     def create(self, obj: Any) -> Any:
@@ -185,16 +196,16 @@ class FakeCluster(KubeClient):
             key = self._key(kind, obj.metadata.namespace, obj.metadata.name)
             if key in self._objs:
                 raise ConflictError(f"{kind} {key[1]}/{key[2]} already exists")
-            stored = _copy(obj)
+            stored = thaw(obj)  # detach from the caller, then freeze
             stored.metadata.uid = stored.metadata.uid or str(uuid.uuid4())
             stored.metadata.resource_version = self._next_rv()
             stored.metadata.generation = 1
             if not stored.metadata.creation_timestamp:
                 stored.metadata.creation_timestamp = self.clock.now()
+            freeze(stored)
             self._objs[key] = _Stored(stored)
-            snapshot = _copy(stored)
-        self._dispatch(ADDED, snapshot)
-        return snapshot
+        self._dispatch(ADDED, stored)
+        return read_view(stored)
 
     def update(self, obj: Any) -> Any:
         kind = _kind_of(obj)
@@ -213,18 +224,20 @@ class FakeCluster(KubeClient):
                     f"{kind} {key[1]}/{key[2]}: resourceVersion {presented_rv} "
                     f"is stale (current {cur.obj.metadata.resource_version})"
                 )
-            stored = _copy(obj)
+            stored = thaw(obj)
             stored.metadata.uid = cur.obj.metadata.uid
             stored.metadata.creation_timestamp = cur.obj.metadata.creation_timestamp
-            # Status is a subresource: main-resource updates cannot touch it.
+            # Status is a subresource: main-resource updates cannot touch
+            # it. The stored status subtree is frozen, so the new revision
+            # SHARES it (structural sharing — no copy).
             if hasattr(stored, "status"):
-                stored.status = _copy(cur.obj.status)
+                stored.status = cur.obj.status
             stored.metadata.resource_version = self._next_rv()
             stored.metadata.generation = cur.obj.metadata.generation + 1
+            freeze(stored)
             self._objs[key] = _Stored(stored)
-            snapshot = _copy(stored)
-        self._dispatch(MODIFIED, snapshot)
-        return snapshot
+        self._dispatch(MODIFIED, stored)
+        return read_view(stored)
 
     def update_status(self, obj: Any) -> Any:
         kind = _kind_of(obj)
@@ -249,11 +262,18 @@ class FakeCluster(KubeClient):
                     f"{presented_rv} is stale (current "
                     f"{cur.obj.metadata.resource_version})"
                 )
-            cur.obj.status = _copy(obj.status)
-            cur.obj.metadata.resource_version = self._next_rv()
-            snapshot = _copy(cur.obj)
-        self._dispatch(MODIFIED, snapshot)
-        return snapshot
+            # Copy-on-write with structural sharing: the new revision
+            # swaps in the caller's status (detached) and a re-versioned
+            # metadata while sharing every other frozen subtree.
+            new = shallow_thaw(cur.obj)
+            new.status = thaw(obj.status)
+            meta = shallow_thaw(cur.obj.metadata)
+            meta.resource_version = self._next_rv()
+            new.metadata = meta
+            cur.obj = freeze(new)
+            stored = cur.obj
+        self._dispatch(MODIFIED, stored)
+        return read_view(stored)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._mu:
@@ -262,8 +282,7 @@ class FakeCluster(KubeClient):
             stored = self._objs.pop(key, None)
             if stored is None:
                 raise NotFoundError(kind, namespace or "", name)
-            snapshot = _copy(stored.obj)
-        self._dispatch(DELETED, snapshot)
+        self._dispatch(DELETED, stored.obj)
 
     def patch_scale(self, kind: str, namespace: str, name: str, replicas: int) -> None:
         """Works against any stored kind carrying a ``replicas`` field
@@ -280,11 +299,15 @@ class FakeCluster(KubeClient):
                 raise TypeError(f"{kind} has no scale subresource")
             if cur.obj.replicas == replicas:
                 return
-            cur.obj.replicas = replicas
-            cur.obj.metadata.resource_version = self._next_rv()
-            cur.obj.metadata.generation += 1
-            snapshot = _copy(cur.obj)
-        self._dispatch(MODIFIED, snapshot)
+            new = shallow_thaw(cur.obj)
+            new.replicas = replicas
+            meta = shallow_thaw(cur.obj.metadata)
+            meta.resource_version = self._next_rv()
+            meta.generation += 1
+            new.metadata = meta
+            cur.obj = freeze(new)
+            stored = cur.obj
+        self._dispatch(MODIFIED, stored)
 
     def watch(self, kind: str, handler: WatchHandler) -> None:
         with self._mu:
@@ -309,10 +332,6 @@ class FakeCluster(KubeClient):
 
     def variant_autoscalings(self, namespace: str | None = None) -> list[VariantAutoscaling]:
         return self.list(VariantAutoscaling.kind, namespace)
-
-
-def _copy(obj: Any) -> Any:
-    return copy.deepcopy(obj)
 
 
 def list_all(client: KubeClient, kinds: Iterable[str]) -> dict[str, list[Any]]:

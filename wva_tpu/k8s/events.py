@@ -12,7 +12,7 @@ import logging
 import zlib
 
 from wva_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
-from wva_tpu.k8s.objects import Event, ObjectMeta
+from wva_tpu.k8s.objects import Event, ObjectMeta, clone
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 log = logging.getLogger(__name__)
@@ -78,6 +78,7 @@ class EventRecorder:
         namespace = obj.metadata.namespace
         existing: Event | None = self.client.try_get(Event.KIND, namespace, name)
         if existing is not None:
+            existing = clone(existing)  # reads are frozen store views
             fresh_series = (
                 existing.message != message
                 or existing.type != event_type

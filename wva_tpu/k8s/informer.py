@@ -36,13 +36,14 @@ Staleness/fallback ladder (weakest to strongest):
 4. periodic full resync LIST (``resync_seconds``);
 5. informer disabled: every tick LISTs, exactly the pre-informer shape.
 
-Thread-safe. Deep copies on the way in and out, preserving the KubeClient
-contract that callers cannot mutate the store.
+Thread-safe. The store holds FROZEN objects (``utils.freeze``): events,
+reads and snapshot fills share ONE instance with zero copies — mutation
+attempts raise instead of corrupting the store, and writers detach via
+``objects.clone()`` (docs/design/object-plane.md).
 """
 
 from __future__ import annotations
 
-import copy
 import logging
 import threading
 from typing import Any, Callable
@@ -56,6 +57,7 @@ from wva_tpu.k8s.client import (
 )
 from wva_tpu.k8s.objects import labels_match
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from wva_tpu.utils.freeze import frozen_copy, read_view
 
 log = logging.getLogger(__name__)
 
@@ -156,7 +158,8 @@ class InformerKubeClient(KubeClient):
         now = self.clock.now()
         with self._mu:
             store = {
-                (o.metadata.namespace or "", o.metadata.name): o
+                (o.metadata.namespace or "", o.metadata.name):
+                    frozen_copy(o)
                 for o in listed}
             # Replay events buffered while the LIST was in flight on top
             # of the fresh snapshot — dropping them would leave the store
@@ -196,6 +199,14 @@ class InformerKubeClient(KubeClient):
         if self.namespace is not None and ns != self.namespace \
                 and kind not in CLUSTER_SCOPED_KINDS:
             return
+        # ONE frozen instance serves the buffer, the store, the nudge
+        # listeners and (on FakeCluster) every other watch handler — the
+        # old path deep-copied the dispatched object into the buffer AND
+        # again into the store. Dispatchers already hand out frozen
+        # objects under the zero-copy plane, so this is usually free;
+        # an unfrozen object (REST stream with the plane off) is detached
+        # once here.
+        obj = frozen_copy(obj)
         key = (ns, obj.metadata.name)
         with self._mu:
             if kind in self._buffering:
@@ -203,8 +214,7 @@ class InformerKubeClient(KubeClient):
                 # top of the fresh snapshot (no nudge — the list itself is
                 # the freshness signal, and at startup no listeners exist
                 # yet).
-                self._buffer.setdefault(kind, []).append(
-                    (event, copy.deepcopy(obj)))
+                self._buffer.setdefault(kind, []).append((event, obj))
                 self._last_event[kind] = self.clock.now()
                 return
             if kind not in self._synced:
@@ -214,10 +224,7 @@ class InformerKubeClient(KubeClient):
             if event == DELETED:
                 store.pop(key, None)
             else:
-                # Deep copy: FakeCluster hands each handler its own copy,
-                # but RestKubeClient shares one object across handlers AND
-                # its re-list diff base.
-                store[key] = copy.deepcopy(obj)
+                store[key] = obj
             self._last_event[kind] = self.clock.now()
             listeners = list(self._nudge_listeners)
         if listeners and _material_change(kind, event, prev, obj):
@@ -236,10 +243,11 @@ class InformerKubeClient(KubeClient):
         if self.namespace is not None and ns != self.namespace \
                 and kind not in CLUSTER_SCOPED_KINDS:
             return
+        stored = frozen_copy(obj)
         with self._mu:
             if kind in self._synced:
                 self._store.setdefault(kind, {})[
-                    (ns, obj.metadata.name)] = copy.deepcopy(obj)
+                    (ns, obj.metadata.name)] = stored
 
     def _discard(self, kind: str, namespace: str, name: str) -> None:
         with self._mu:
@@ -281,7 +289,7 @@ class InformerKubeClient(KubeClient):
             with self._mu:
                 obj = self._store.get(kind, {}).get((namespace or "", name))
             if obj is not None:
-                return copy.deepcopy(obj)
+                return read_view(obj)
             # Store miss falls through live: a just-created object's watch
             # event may still be in flight on a real stream.
         try:
@@ -315,7 +323,7 @@ class InformerKubeClient(KubeClient):
                 continue
             if not labels_match(label_selector, obj.metadata.labels):
                 continue
-            out.append(copy.deepcopy(obj))
+            out.append(read_view(obj))
         return out
 
     def raw_snapshot(self, kind: str,

@@ -3,19 +3,35 @@
 The reference uses client-go's generated types; this framework defines the
 narrow slices it actually consumes. All types share ``ObjectMeta`` from the
 CRD module and serialize to K8s-shaped dicts where needed.
+
+All kinds are :class:`~wva_tpu.utils.freeze.Freezable`: object stores
+(``FakeCluster``/``InformerKubeClient``/``SnapshotKubeClient``) freeze them
+and serve reads zero-copy — read results are SHARED and immutable. Callers
+that mutate must take an explicit copy first via :func:`clone` (the
+copy-on-write builder step; docs/design/object-plane.md).
 """
 
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, TypeVar
 
 from wva_tpu.api.v1alpha1 import ObjectMeta
+from wva_tpu.utils.freeze import (  # noqa: F401 — re-exported protocol
+    Freezable,
+    FrozenObjectError,
+    freeze,
+    is_frozen,
+    object_version,
+    read_view,
+    thaw,
+)
+
+_T = TypeVar("_T")
 
 
 @dataclass
-class ResourceRequirements:
+class ResourceRequirements(Freezable):
     """Container resources; values are stringly-typed K8s quantities for
     extended resources (``google.com/tpu: "8"``)."""
 
@@ -24,7 +40,7 @@ class ResourceRequirements:
 
 
 @dataclass
-class Container:
+class Container(Freezable):
     name: str = ""
     image: str = ""
     command: list[str] = field(default_factory=list)
@@ -35,7 +51,7 @@ class Container:
 
 
 @dataclass
-class PodTemplateSpec:
+class PodTemplateSpec(Freezable):
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
     containers: list[Container] = field(default_factory=list)
@@ -44,14 +60,14 @@ class PodTemplateSpec:
 
 
 @dataclass
-class DeploymentStatus:
+class DeploymentStatus(Freezable):
     replicas: int = 0
     ready_replicas: int = 0
     updated_replicas: int = 0
 
 
 @dataclass
-class Deployment:
+class Deployment(Freezable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     replicas: int | None = 1  # spec.replicas; None = K8s default (1)
     selector: dict[str, str] = field(default_factory=dict)
@@ -68,7 +84,7 @@ class Deployment:
 
 
 @dataclass
-class Lease:
+class Lease(Freezable):
     """coordination.k8s.io/v1 Lease for leader election."""
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -83,7 +99,7 @@ class Lease:
 
 
 @dataclass
-class Event:
+class Event(Freezable):
     """core/v1 Event (the recorder surface the reference gets from
     controller-runtime's EventRecorder)."""
 
@@ -103,7 +119,7 @@ class Event:
 
 
 @dataclass
-class LeaderWorkerSetStatus:
+class LeaderWorkerSetStatus(Freezable):
     """Group-level status: a "replica" is a whole leader+workers group."""
 
     replicas: int = 0  # groups that exist
@@ -111,7 +127,7 @@ class LeaderWorkerSetStatus:
 
 
 @dataclass
-class LeaderWorkerSet:
+class LeaderWorkerSet(Freezable):
     """Multi-host slice scale target (leaderworkerset.x-k8s.io/v1).
 
     One replica = one group of ``size`` pods (one per slice host) that are
@@ -136,14 +152,14 @@ class LeaderWorkerSet:
 
 
 @dataclass
-class PodStatus:
+class PodStatus(Freezable):
     phase: str = "Pending"  # Pending | Running | Succeeded | Failed
     ready: bool = False
     pod_ip: str = ""
 
 
 @dataclass
-class Pod:
+class Pod(Freezable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodTemplateSpec = field(default_factory=PodTemplateSpec)
     node_name: str = ""
@@ -157,13 +173,13 @@ class Pod:
 
 
 @dataclass
-class NodeStatus:
+class NodeStatus(Freezable):
     capacity: dict[str, str] = field(default_factory=dict)
     allocatable: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
-class Node:
+class Node(Freezable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     status: NodeStatus = field(default_factory=NodeStatus)
     ready: bool = True
@@ -181,7 +197,7 @@ class Node:
 
 
 @dataclass
-class ConfigMap:
+class ConfigMap(Freezable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     data: dict[str, str] = field(default_factory=dict)
 
@@ -190,7 +206,7 @@ class ConfigMap:
 
 
 @dataclass
-class Secret:
+class Secret(Freezable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     data: dict[str, str] = field(default_factory=dict)  # values pre-decoded
 
@@ -199,7 +215,7 @@ class Secret:
 
 
 @dataclass
-class Service:
+class Service(Freezable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: dict[str, str] = field(default_factory=dict)
     ports: dict[str, int] = field(default_factory=dict)  # name -> port
@@ -209,7 +225,7 @@ class Service:
 
 
 @dataclass
-class Namespace:
+class Namespace(Freezable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
 
     KIND = "Namespace"
@@ -217,7 +233,7 @@ class Namespace:
 
 
 @dataclass
-class ExtensionRef:
+class ExtensionRef(Freezable):
     """InferencePool's endpoint-picker (EPP) service reference."""
 
     service_name: str = ""
@@ -225,7 +241,7 @@ class ExtensionRef:
 
 
 @dataclass
-class InferencePool:
+class InferencePool(Freezable):
     """Gateway-API inference-extension InferencePool (v1 / v1alpha2 shapes
     both converge here; reference internal/utils/pool/pool.go:54-100)."""
 
@@ -239,7 +255,7 @@ class InferencePool:
 
 
 @dataclass
-class ServiceMonitor:
+class ServiceMonitor(Freezable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: dict[str, str] = field(default_factory=dict)
 
@@ -265,8 +281,20 @@ def labels_match(selector: dict[str, str] | None, labels: dict[str, str]) -> boo
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def clone(obj: _T) -> _T:
+    """The sanctioned mutable copy of a K8s object — the copy-on-write
+    builder step: ``mutable = clone(frozen_read); mutate(mutable);
+    client.update*(mutable)``. Works on frozen and unfrozen objects alike
+    (a frozen input thaws fully: nested FrozenDict/FrozenList revert to
+    dict/list). Hot-path modules are lint-forbidden from calling
+    ``copy.deepcopy`` directly (tests/test_object_plane.py) so every
+    K8s-object copy is visible to the ``wva_tick_object_copies`` counter.
+    """
+    return thaw(obj)
+
+
 def deep_copy(obj):
-    return copy.deepcopy(obj)
+    return clone(obj)
 
 
 # kind string -> class, for generic client paths

@@ -33,6 +33,7 @@ import urllib.request
 from typing import Any
 
 from wva_tpu.k8s import serde
+from wva_tpu.utils.freeze import freeze, read_view
 from wva_tpu.k8s.client import (
     ADDED,
     DELETED,
@@ -167,7 +168,7 @@ class RestKubeClient(KubeClient):
             d = self._request("GET", self._obj_path(kind, namespace, name))
         except ApiError as e:
             raise self._map_error(e, kind, namespace, name) from None
-        return serde.from_k8s(kind, d)
+        return read_view(freeze(serde.from_k8s(kind, d)))
 
     def try_get(self, kind: str, namespace: str, name: str) -> Any | None:
         try:
@@ -186,7 +187,8 @@ class RestKubeClient(KubeClient):
                               query=query or None)
         except ApiError as e:
             raise self._map_error(e, kind, namespace or "", "") from None
-        return [serde.from_k8s(kind, item) for item in d.get("items") or []]
+        return [read_view(freeze(serde.from_k8s(kind, item)))
+                for item in d.get("items") or []]
 
     def create(self, obj: Any) -> Any:
         kind = _kind_of(obj)
@@ -196,7 +198,7 @@ class RestKubeClient(KubeClient):
                               body=serde.to_k8s(obj))
         except ApiError as e:
             raise self._map_error(e, kind, ns, name) from None
-        return serde.from_k8s(kind, d)
+        return read_view(freeze(serde.from_k8s(kind, d)))
 
     def update(self, obj: Any) -> Any:
         kind = _kind_of(obj)
@@ -206,7 +208,7 @@ class RestKubeClient(KubeClient):
                               body=serde.to_k8s(obj))
         except ApiError as e:
             raise self._map_error(e, kind, ns, name) from None
-        return serde.from_k8s(kind, d)
+        return read_view(freeze(serde.from_k8s(kind, d)))
 
     def update_status(self, obj: Any) -> Any:
         kind = _kind_of(obj)
@@ -224,7 +226,7 @@ class RestKubeClient(KubeClient):
                 # across API-server versions/locales.
                 return self.update(obj)
             raise self._map_error(e, kind, ns, name) from None
-        return serde.from_k8s(kind, d)
+        return read_view(freeze(serde.from_k8s(kind, d)))
 
     def raw_post(self, path: str, body: dict) -> dict:
         """POST an arbitrary API payload (TokenReview/SubjectAccessReview —
@@ -371,7 +373,8 @@ class RestKubeClient(KubeClient):
         stale forever."""
         d = self._request("GET", self._obj_path(kind, namespace))
         rv = (d.get("metadata") or {}).get("resourceVersion", "")
-        objs = [serde.from_k8s(kind, item) for item in d.get("items") or []]
+        objs = [freeze(serde.from_k8s(kind, item))
+                for item in d.get("items") or []]
         current = {self._obj_key(o): o for o in objs}
         scope_key = f"{kind}/{namespace}"
         with self._mu:
@@ -411,7 +414,7 @@ class RestKubeClient(KubeClient):
                     code = (item.get("code") or 0)
                     raise ApiError(int(code) or 500, item.get("message", ""))
                 if etype in (ADDED, MODIFIED, DELETED):
-                    obj = serde.from_k8s(kind, item)
+                    obj = freeze(serde.from_k8s(kind, item))
                     with self._mu:
                         known = self._known.setdefault(f"{kind}/{namespace}",
                                                        {})

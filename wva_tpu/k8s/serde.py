@@ -19,6 +19,7 @@ from typing import Any, Callable
 
 from wva_tpu.api import v1alpha1
 from wva_tpu.api.v1alpha1 import ObjectMeta, VariantAutoscaling
+from wva_tpu.utils.freeze import intern_labels
 from wva_tpu.k8s.objects import (
     ConfigMap,
     Container,
@@ -212,13 +213,16 @@ def _template_to_k8s(t: PodTemplateSpec) -> dict[str, Any]:
 def _template_from_k8s(d: dict[str, Any]) -> PodTemplateSpec:
     meta = d.get("metadata") or {}
     spec = d.get("spec") or {}
+    # Interned shared label/annotation/selector dicts: every pod of a
+    # variant repeats the same few dicts across fleet-sized LISTs, and
+    # decoded objects feed frozen stores (docs/design/object-plane.md).
     return PodTemplateSpec(
-        labels=dict(meta.get("labels") or {}),
-        annotations=dict(meta.get("annotations") or {}),
+        labels=intern_labels(meta.get("labels")),
+        annotations=intern_labels(meta.get("annotations")),
         containers=[_container_from_k8s(c) for c in spec.get("containers") or []],
         init_containers=[_container_from_k8s(c)
                          for c in spec.get("initContainers") or []],
-        node_selector=dict(spec.get("nodeSelector") or {}),
+        node_selector=intern_labels(spec.get("nodeSelector")),
     )
 
 
@@ -247,7 +251,7 @@ def _deployment_from_k8s(d: dict[str, Any]) -> Deployment:
     return Deployment(
         metadata=_meta_from_k8s(d.get("metadata")),
         replicas=spec.get("replicas"),
-        selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
+        selector=intern_labels((spec.get("selector") or {}).get("matchLabels")),
         template=_template_from_k8s(spec.get("template") or {}),
         status=DeploymentStatus(
             replicas=int(status.get("replicas") or 0),

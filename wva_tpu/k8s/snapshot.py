@@ -14,9 +14,11 @@ O(kinds touched) list requests no matter how many VAs exist.
 Semantics:
 
 - **Reads** (``get``/``list``/``try_get``) of a snapshotted kind are served
-  from the cache. Objects are deep-copied on the way out, preserving the
-  API-server guarantee that callers cannot mutate the store (engine code
-  mutates fetched VA statuses in place before writing them back).
+  from the cache ZERO-COPY: cached objects are frozen
+  (``utils.freeze``), so callers cannot mutate the snapshot — a write
+  site takes an explicit mutable copy via ``objects.clone()`` first
+  (docs/design/object-plane.md). ``WVA_ZERO_COPY=off`` restores the
+  historical deep-copy-on-read contract.
 - **Writes** (``create``/``update``/``update_status``/``delete``/
   ``patch_scale``) delegate to the wrapped client untouched — and update or
   invalidate the cached copy so a later read within the same tick sees the
@@ -39,12 +41,12 @@ Thread-safe: the engine's per-model analysis workers read it concurrently.
 
 from __future__ import annotations
 
-import copy
 import threading
 from typing import Any
 
 from wva_tpu.k8s.client import KubeClient, NotFoundError, _kind_of
 from wva_tpu.k8s.objects import labels_match
+from wva_tpu.utils.freeze import frozen_copy, read_view
 
 # Kinds the saturation tick reads per-VA; one LIST each per tick, lazily —
 # a fleet with no LeaderWorkerSet targets never lists them.
@@ -115,16 +117,17 @@ class SnapshotKubeClient(KubeClient):
             with self._mu:
                 if kind in self._complete:
                     return self._cache[kind]  # raced: another worker LISTed
-            # Informer-backed client: take its store view zero-copy — this
-            # cache only hands objects out via deepcopy (and write-through
-            # REPLACES entries, never mutates them in place), so the
-            # per-object copy list() would pay is redundant here.
+            # Informer-backed client: take its store view zero-copy — the
+            # store's objects are frozen, so sharing them is safe by
+            # construction (write-through REPLACES entries, never mutates
+            # them in place).
             raw = getattr(self.client, "raw_snapshot", None)
             cached = raw(kind, self.namespace) if raw is not None else None
             if cached is None:
                 listed = self.client.list(kind, namespace=self.namespace)
                 cached = {
-                    (o.metadata.namespace or "", o.metadata.name): o
+                    (o.metadata.namespace or "", o.metadata.name):
+                        frozen_copy(o)
                     for o in listed
                 }
             with self._mu:
@@ -147,7 +150,7 @@ class SnapshotKubeClient(KubeClient):
             obj = cached.get((namespace or "", name))
         if obj is None or obj is _NOT_FOUND:
             raise NotFoundError(kind, namespace or "", name)
-        return copy.deepcopy(obj)
+        return read_view(obj)
 
     def _memoized_get(self, kind: str, namespace: str, name: str) -> Any:
         """Targeted-GET mode: one wrapped-client GET per object per tick,
@@ -161,11 +164,13 @@ class SnapshotKubeClient(KubeClient):
                 obj = self.client.get(kind, namespace, name)
             except NotFoundError:
                 obj = _NOT_FOUND
+            else:
+                obj = frozen_copy(obj)
             with self._mu:
                 self._cache.setdefault(kind, {})[key] = obj
         if obj is _NOT_FOUND:
             raise NotFoundError(kind, namespace or "", name)
-        return copy.deepcopy(obj)
+        return read_view(obj)
 
     def try_get(self, kind: str, namespace: str, name: str) -> Any | None:
         try:
@@ -189,7 +194,7 @@ class SnapshotKubeClient(KubeClient):
                 continue
             if not labels_match(label_selector, obj.metadata.labels):
                 continue
-            out.append(copy.deepcopy(obj))
+            out.append(read_view(obj))
         return out
 
     def refresh(self, kind: str, namespace: str, name: str) -> Any:
@@ -206,15 +211,15 @@ class SnapshotKubeClient(KubeClient):
                     cached.pop((namespace or "", name), None)
             raise
         self._store(kind, obj)
-        return copy.deepcopy(obj)
+        return read_view(frozen_copy(obj))
 
     def _store(self, kind: str, obj: Any) -> None:
         if kind not in self._kinds:
             return
+        stored = frozen_copy(obj)
         with self._mu:
             self._cache.setdefault(kind, {})[
-                (obj.metadata.namespace or "", obj.metadata.name)] = \
-                copy.deepcopy(obj)
+                (obj.metadata.namespace or "", obj.metadata.name)] = stored
 
     def _evict(self, kind: str, namespace: str, name: str) -> None:
         with self._mu:

@@ -19,7 +19,7 @@ import threading
 from dataclasses import dataclass
 
 from wva_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
-from wva_tpu.k8s.objects import Lease, ObjectMeta
+from wva_tpu.k8s.objects import Lease, ObjectMeta, clone
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 log = logging.getLogger(__name__)
@@ -103,6 +103,7 @@ class LeaderElector:
                 self._observed_at = now
             expired = now - self._observed_at > cfg.lease_duration
             if lease.holder_identity == self.identity:
+                lease = clone(lease)  # reads are frozen store views
                 lease.renew_time = now
                 self.client.update(lease)
                 with self._mu:
@@ -111,6 +112,7 @@ class LeaderElector:
                 self._fire(cb)
                 return True
             if not lease.holder_identity or expired:
+                lease = clone(lease)
                 lease.holder_identity = self.identity
                 lease.acquire_time = now
                 lease.renew_time = now
@@ -137,6 +139,7 @@ class LeaderElector:
             lease = self.client.try_get(
                 Lease.KIND, self.config.namespace, self.config.lease_name)
             if lease is not None and lease.holder_identity == self.identity:
+                lease = clone(lease)
                 lease.holder_identity = ""
                 self.client.update(lease)
         except (ConflictError, NotFoundError):

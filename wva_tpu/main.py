@@ -60,6 +60,7 @@ from wva_tpu.pipeline import (
     GreedyBySaturation,
     SliceInventory,
 )
+from wva_tpu.utils import freeze as frz
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from wva_tpu.utils.variant import get_controller_instance
 
@@ -239,6 +240,12 @@ def build_manager(
     strictly within discovered inventory.
     """
     clock = clock or SYSTEM_CLOCK
+
+    # Zero-copy object plane (WVA_ZERO_COPY, default on;
+    # docs/design/object-plane.md): store reads across the stack return
+    # frozen shared objects. Process-global — the lever gates read-path
+    # behavior of every store built below.
+    frz.set_zero_copy(config.zero_copy_enabled())
 
     # Watch-backed informer cache (WVA_INFORMER, default on;
     # docs/design/informer.md): every per-kind LIST the control plane makes

@@ -46,6 +46,7 @@ from wva_tpu.constants import (
     WVA_REPLICA_SCALING_TOTAL,
     WVA_TICK_MODELS_ANALYZED,
     WVA_TICK_MODELS_SKIPPED,
+    WVA_TICK_OBJECT_COPIES,
     WVA_TRACE_DROPPED_TOTAL,
     WVA_TRACE_RECORDS_TOTAL,
     WVA_TRACE_WRITE_SECONDS,
@@ -118,6 +119,9 @@ class MetricsRegistry:
         self._register(WVA_TICK_MODELS_SKIPPED, "gauge",
                        "Models skipped by an unchanged input fingerprint "
                        "last engine tick (prior decision re-emitted)")
+        self._register(WVA_TICK_OBJECT_COPIES, "gauge",
+                       "K8s object copies (copy-on-write clones) taken "
+                       "during the last engine tick; ~0 at steady state")
         self._register(WVA_CAPACITY_SLICES, "gauge",
                        "Whole TPU slices per (variant, state): ready, "
                        "provisioning (in-flight with credible ETA), "

@@ -1,0 +1,334 @@
+"""Immutable copy-on-write object plane (docs/design/object-plane.md).
+
+The K8s object stores (``FakeCluster``, ``InformerKubeClient``,
+``SnapshotKubeClient``) used to preserve the apiserver's "callers cannot
+mutate the store" guarantee by deep-copying every object on the way in AND
+out. At fleet scale that deepcopy tax dominated the quiet tick: every
+informer event, snapshot fill, LIST and per-VA GET paid O(object) Python
+allocation for objects nobody mutates. This module inverts the guarantee:
+stores hold **frozen** objects and hand them out by reference — mutation
+attempts raise :class:`FrozenObjectError` instead of silently diverging,
+and writers opt into an explicit copy via :func:`thaw` (the copy-on-write
+builder step).
+
+Protocol:
+
+- :func:`freeze` — recursively freezes a :class:`Freezable` dataclass tree
+  IN PLACE: plain ``dict``/``list`` fields are replaced by
+  :class:`FrozenDict`/:class:`FrozenList` (still ``isinstance`` their base
+  type, but every mutator raises), nested ``Freezable`` objects freeze too,
+  and the top object is stamped with a process-monotonic **version** (see
+  :func:`object_version`) so caches can compare identity cheaply.
+  Idempotent; already-frozen subtrees are shared, not re-walked.
+- :func:`thaw` — a fully mutable deep copy (``copy.deepcopy`` of a frozen
+  object does the same: deep-copying *is* the act of asking for a mutable
+  view). ``wva_tpu.k8s.objects.clone`` is the sanctioned public wrapper —
+  hot-path modules are lint-forbidden from calling ``copy.deepcopy``
+  directly.
+- :func:`shallow_thaw` — one-level COW for write sites that replace a
+  whole subtree (e.g. a status write): a new unfrozen instance whose
+  fields still REFERENCE the frozen subtrees. Reassign fields, then
+  :func:`freeze`; never mutate a shared subtree through it.
+- :func:`read_view` — what store read paths return: the frozen object
+  itself when the zero-copy plane is on, a mutable clone when it is off
+  (``WVA_ZERO_COPY=off`` restores the historical copy-on-read contract
+  byte-for-byte; decisions/statuses are identical either way).
+
+Copy accounting: every :func:`thaw`/clone of a ``Freezable`` increments a
+process counter (:func:`copy_count`); the engine reports the per-tick delta
+as ``wva_tick_object_copies``, which is ~0 on steady-state ticks — copies
+now happen only at write sites, proportional to actual writes.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import os
+import sys
+import threading
+from typing import Any, Iterable, TypeVar
+
+T = TypeVar("T")
+
+# Instance attributes stamped by freeze(); excluded from thawed copies.
+_FROZEN_ATTR = "__wva_frozen__"
+_VERSION_ATTR = "__wva_version__"
+
+_versions = itertools.count(1)
+
+# Copy accounting. A bare int += under the GIL can drop increments across
+# threads; the lock is uncontended in practice (copies are the rare path —
+# that is the point) and keeps the steady-state "~0 copies" assertion exact.
+_copy_lock = threading.Lock()
+_copies = 0
+
+
+class FrozenObjectError(TypeError):
+    """Mutation attempted on a frozen object (or frozen container).
+
+    The object came out of a zero-copy store read; callers that need to
+    mutate must take an explicit copy first (``wva_tpu.k8s.objects.clone``).
+    """
+
+
+class Freezable:
+    """Mixin for dataclasses participating in the freeze/thaw protocol.
+
+    Unfrozen instances behave exactly like plain dataclasses (the
+    dataclass-generated ``__init__`` runs through ``__setattr__`` before
+    the frozen flag exists). :func:`freeze` stamps the instance, after
+    which any attribute write raises :class:`FrozenObjectError`.
+    """
+
+    # Class-level default so unfrozen instances pay one dict-miss, not an
+    # instance attribute, on every setattr.
+    __wva_frozen__ = False
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if self.__wva_frozen__:
+            raise FrozenObjectError(
+                f"cannot set {name!r} on frozen {type(self).__name__} "
+                "(store-shared object; take a mutable copy via "
+                "wva_tpu.k8s.objects.clone() first)")
+        object.__setattr__(self, name, value)
+
+    def __delattr__(self, name: str) -> None:
+        if self.__wva_frozen__:
+            raise FrozenObjectError(
+                f"cannot delete {name!r} on frozen {type(self).__name__}")
+        object.__delattr__(self, name)
+
+    def __deepcopy__(self, memo: dict) -> "Freezable":
+        # Deep-copying a frozen object asks for a mutable view: the copy is
+        # fully thawed (FrozenDict/FrozenList revert to dict/list, nested
+        # Freezables drop their frozen stamp). Unfrozen instances deep-copy
+        # as normal. This is what keeps every pre-existing
+        # ``copy.deepcopy(obj)`` call site correct unchanged.
+        cls = type(self)
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k in (_FROZEN_ATTR, _VERSION_ATTR):
+                continue
+            object.__setattr__(new, k, copy.deepcopy(v, memo))
+        return new
+
+
+def _blocked(self, *args, **kwargs):
+    raise FrozenObjectError(
+        f"cannot mutate frozen {type(self).__name__} "
+        "(store-shared container; take a mutable copy via "
+        "wva_tpu.k8s.objects.clone() on the owning object first)")
+
+
+class FrozenDict(dict):
+    """Read-only ``dict`` (stays ``isinstance(x, dict)`` for serde and
+    label-matching code). Deep copies thaw to a plain ``dict``."""
+
+    __slots__ = ()
+
+    __setitem__ = _blocked
+    __delitem__ = _blocked
+    pop = _blocked
+    popitem = _blocked
+    clear = _blocked
+    update = _blocked
+    setdefault = _blocked
+    __ior__ = _blocked  # d |= {...} bypasses __setitem__ at the C level
+
+    def __deepcopy__(self, memo: dict) -> dict:
+        return {copy.deepcopy(k, memo): copy.deepcopy(v, memo)
+                for k, v in self.items()}
+
+    def __reduce__(self):
+        return (dict, (dict(self),))
+
+
+class FrozenList(list):
+    """Read-only ``list`` (stays ``isinstance(x, list)``). Deep copies
+    thaw to a plain ``list``."""
+
+    __slots__ = ()
+
+    __setitem__ = _blocked
+    __delitem__ = _blocked
+    append = _blocked
+    extend = _blocked
+    insert = _blocked
+    pop = _blocked
+    remove = _blocked
+    clear = _blocked
+    sort = _blocked
+    reverse = _blocked
+    __iadd__ = _blocked
+    __imul__ = _blocked
+
+    def __deepcopy__(self, memo: dict) -> list:
+        return [copy.deepcopy(v, memo) for v in self]
+
+    def __reduce__(self):
+        return (list, (list(self),))
+
+
+def _freeze_value(v: Any) -> Any:
+    if isinstance(v, Freezable):
+        return freeze(v)
+    t = type(v)
+    if t is dict:
+        return FrozenDict({k: _freeze_value(x) for k, x in v.items()})
+    if t is list:
+        return FrozenList(_freeze_value(x) for x in v)
+    # FrozenDict/FrozenList (e.g. interned label dicts) and scalars pass
+    # through untouched — already immutable.
+    return v
+
+
+def freeze(obj: T) -> T:
+    """Recursively freeze ``obj`` in place and return it. Idempotent:
+    an already-frozen object (or subtree) returns immediately, which is
+    what makes structural sharing cheap — re-freezing a COW-rebuilt object
+    only walks the fields that were actually replaced."""
+    if not isinstance(obj, Freezable) or obj.__wva_frozen__:
+        return obj
+    for k, v in list(obj.__dict__.items()):
+        fv = _freeze_value(v)
+        if fv is not v:
+            object.__setattr__(obj, k, fv)
+    object.__setattr__(obj, _FROZEN_ATTR, True)
+    object.__setattr__(obj, _VERSION_ATTR, next(_versions))
+    return obj
+
+
+def is_frozen(obj: Any) -> bool:
+    return isinstance(obj, Freezable) and obj.__wva_frozen__
+
+
+def object_version(obj: Any) -> int:
+    """Process-monotonic version stamped at freeze time; 0 when unfrozen.
+    Two reads returning the same version are the same store state — caches
+    can skip re-deriving without comparing contents."""
+    return getattr(obj, _VERSION_ATTR, 0)
+
+
+def thaw(obj: T) -> T:
+    """Fully mutable deep copy of ``obj`` (frozen or not) — the explicit
+    copy-on-write step. Counted (see :func:`copy_count`)."""
+    if isinstance(obj, Freezable):
+        global _copies
+        with _copy_lock:
+            _copies += 1
+    return copy.deepcopy(obj)
+
+
+def shallow_thaw(obj: T) -> T:
+    """One-level COW: a new UNFROZEN instance whose fields still reference
+    ``obj``'s (frozen) subtrees. For write sites that REPLACE whole fields
+    (a status write swaps ``.status`` and ``.metadata``, sharing spec/
+    template): reassign, then :func:`freeze`. Mutating a shared subtree
+    through the result is a contract violation — frozen subtrees raise."""
+    new = object.__new__(type(obj))
+    for k, v in obj.__dict__.items():
+        if k in (_FROZEN_ATTR, _VERSION_ATTR):
+            continue
+        object.__setattr__(new, k, v)
+    return new
+
+
+def frozen_copy(obj: T) -> T:
+    """A frozen instance of ``obj`` detached from the caller: the object
+    itself when already frozen (zero cost), else a frozen clone — stores
+    use this on the way IN so a caller keeping the original mutable."""
+    if is_frozen(obj):
+        return obj
+    return freeze(thaw(obj))
+
+
+# --- zero-copy lever ---------------------------------------------------------
+
+# WVA_ZERO_COPY=off restores deep-copy-on-read (the pre-object-plane
+# contract) for A/B equality testing and emergencies; stores still freeze,
+# so the off path is the historical behavior with identical semantics.
+_zero_copy = os.environ.get("WVA_ZERO_COPY", "").strip().lower() not in (
+    "off", "false", "0", "no")
+
+
+def zero_copy_enabled() -> bool:
+    return _zero_copy
+
+
+def set_zero_copy(enabled: bool) -> None:
+    global _zero_copy
+    _zero_copy = bool(enabled)
+
+
+def read_view(obj: T) -> T:
+    """What a store read path hands out: the frozen object by reference
+    (zero copies) when the plane is on, a mutable clone when off."""
+    if _zero_copy and is_frozen(obj):
+        return obj
+    return thaw(obj)
+
+
+# --- copy accounting ---------------------------------------------------------
+
+
+def copy_count() -> int:
+    """Process-total Freezable copies (thaw/clone) since start. The engine
+    reports per-tick deltas as ``wva_tick_object_copies``."""
+    with _copy_lock:
+        return _copies
+
+
+def reset_copy_count() -> None:
+    global _copies
+    with _copy_lock:
+        _copies = 0
+
+
+# --- decode-time interning ---------------------------------------------------
+
+# Fleet-sized LISTs repeat the same label/annotation dicts (every pod of a
+# variant carries the variant's labels) and the same metadata strings. The
+# serde decode path interns them so N decoded objects share ONE frozen dict
+# / one str instance — safe exactly because decoded objects feed frozen
+# stores, and thaw() detaches any mutable copy.
+_INTERN_MAX = 4096
+_intern_lock = threading.Lock()
+_interned_dicts: dict[tuple, FrozenDict] = {}
+
+_EMPTY_DICT = FrozenDict()
+
+
+def intern_str(s: str) -> str:
+    """``sys.intern`` for decode-path metadata strings (names, namespaces,
+    label keys/values): repeated across fleet-sized LISTs and compared
+    constantly (dict keys, label matching)."""
+    return sys.intern(s) if type(s) is str else s
+
+
+def intern_labels(d: dict | None) -> FrozenDict:
+    """A shared frozen copy of a small str->str dict (labels/annotations/
+    selectors). Objects across the fleet carrying equal label sets share
+    one FrozenDict; the table is bounded and resets when full (interning is
+    an optimization, never a correctness requirement)."""
+    if not d:
+        return _EMPTY_DICT
+    try:
+        key = tuple(sorted(d.items()))
+    except TypeError:  # unsortable/unhashable values: skip interning
+        return FrozenDict(d)
+    with _intern_lock:
+        hit = _interned_dicts.get(key)
+        if hit is not None:
+            return hit
+        if len(_interned_dicts) >= _INTERN_MAX:
+            _interned_dicts.clear()
+        made = FrozenDict((intern_str(k), intern_str(v)) for k, v in key)
+        _interned_dicts[key] = made
+        return made
+
+
+def interned_dict_count() -> int:
+    with _intern_lock:
+        return len(_interned_dicts)

@@ -9,6 +9,7 @@ import os
 
 from wva_tpu.api.v1alpha1 import VariantAutoscaling
 from wva_tpu.constants import ACCELERATOR_NAME_LABEL_KEY, CONTROLLER_INSTANCE_LABEL_KEY
+from wva_tpu.k8s import objects
 from wva_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
 from wva_tpu.utils import scale_target
 from wva_tpu.utils.backoff import retry_with_backoff
@@ -131,7 +132,7 @@ def update_va_status_with_conflict_refetch(
                          "decision; dropping this stale write",
                          va.metadata.namespace, va.metadata.name)
                 return fresh, False
-            attempt = merge_engine_status(fresh, va)
+            attempt = merge_engine_status(objects.clone(fresh), va)
     # Last conflicted attempt already refetched; one final try without the
     # conflict guard so persistent contention surfaces as the real error.
     return client.update_status(attempt), True
