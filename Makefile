@@ -45,12 +45,12 @@ bench-tick: ## Fleet-scale tick microbench (48 models / 96 VAs, in-memory stack)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tick-only
 
 .PHONY: bench-tick-quiet
-bench-tick-quiet: ## Steady-state quiet-tick microbench (48 models, no demand/spec changes): tick p50 + API reads/tick with the informer + dirty-set incremental path vs informer-only vs the per-tick-LIST baseline; merges detail.incremental_tick into BENCH_LOCAL.json.
-	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tick-quiet-only
+bench-tick-quiet: ## Steady-state quiet-tick microbench (48 models default, MODELS=N overrides): shipped vs fp-recompute vs informer-only vs per-tick-LIST, plus the 48/144/480 fleet-growth sweep; merges detail.incremental_tick + detail.fingerprint_plane into BENCH_LOCAL.json.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tick-quiet-only $(if $(MODELS),--models $(MODELS))
 
 .PHONY: bench-profile
-bench-profile: ## cProfile-backed hot-path dump of one quiet-tick bench run (top-N call sites by cumulative + total time) — the tool for finding the next tick hot path (PERF.md).
-	JAX_PLATFORMS=cpu $(PYTHON) bench.py --profile
+bench-profile: ## cProfile-backed hot-path dump of one quiet-tick bench run (top-N call sites by cumulative + total time; MODELS=N profiles at fleet scale, e.g. MODELS=480) — the tool for finding the next tick hot path (PERF.md).
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --profile $(if $(MODELS),--models $(MODELS))
 
 .PHONY: bench-collect
 bench-collect: ## Metrics-plane microbench (48 models): backend queries/tick grouped ON vs per-model fan-out, and in-memory TSDB query p50 under 8 concurrent readers vs the pre-ring read path; merges into BENCH_LOCAL.json.

@@ -634,7 +634,7 @@ def multi_model_bench() -> dict:
 
 def _build_tick_world(n_models: int, variants_per_model: int,
                       informer: bool = True, incremental: bool = True,
-                      zero_copy: bool = True):
+                      zero_copy: bool = True, fp_delta: bool = True):
     """The shared 48-model/96-VA in-memory fleet world for the tick
     benches (`make bench-tick` / `make bench-tick-quiet`): FakeCluster +
     TSDB + fully wired manager on the SLO analyzer path, with a ``feed``
@@ -680,6 +680,9 @@ def _build_tick_world(n_models: int, variants_per_model: int,
     # WVA_ZERO_COPY lever: build_manager applies it process-wide from the
     # config, so the honest copy-on-read mode must flow through here.
     cfg.infrastructure.zero_copy = zero_copy
+    # WVA_FP_DELTA lever (versioned fingerprint plane): off restores the
+    # recomputed per-tick fingerprint — the honest pre-change lever.
+    cfg.infrastructure.fp_delta = fp_delta
     sat = SaturationScalingConfig(analyzer_name="slo")
     sat.apply_defaults()
     cfg.update_saturation_config({"default": sat})
@@ -922,7 +925,7 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
     from wva_tpu.engines import common as engines_common
 
     def run_mode(informer: bool, incremental: bool,
-                 zero_copy: bool = True) -> dict:
+                 zero_copy: bool = True, fp_delta: bool = True) -> dict:
         from wva_tpu.utils import freeze as frz
 
         # The object-plane lever is process-global (build_manager applies
@@ -932,13 +935,14 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
             mgr, cluster, clock, feed = _build_tick_world(
                 n_models, variants_per_model,
                 informer=informer, incremental=incremental,
-                zero_copy=zero_copy)
+                zero_copy=zero_copy, fp_delta=fp_delta)
             eng = mgr.engine
             for _ in range(3 + quiet_warm_ticks):  # jit + caches + memos +
                 eng.optimize()                     # window settling
                 clock.advance(5.0)
                 feed(clock.now())
             walls, reads, analyzed, copies = [], {}, 0, []
+            phase_sums: dict[str, float] = {}
             for _ in range(measured_ticks):
                 cluster.reset_request_counts()
                 t0 = time.perf_counter()
@@ -946,6 +950,8 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
                 walls.append(time.perf_counter() - t0)
                 analyzed += eng.last_tick_stats["analyzed"]
                 copies.append(eng.last_tick_object_copies)
+                for phase, sec in eng.last_tick_phase_seconds.items():
+                    phase_sums[phase] = phase_sums.get(phase, 0.0) + sec
                 for (verb, kind), c in cluster.request_counts().items():
                     if verb in ("get", "list"):
                         key = f"{verb}:{kind}"
@@ -970,6 +976,11 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
                 v for k, v in per_tick_reads.items()
                 if k.startswith("list:")), 2),
             "models_analyzed_per_tick": round(analyzed / measured_ticks, 2),
+            # Per-phase wall time (wva_tick_phase_seconds): mean ms per
+            # tick spent in prepare | fingerprint | analyze | apply.
+            "phase_ms_mean": {
+                k: round(v * 1000.0 / measured_ticks, 2)
+                for k, v in sorted(phase_sums.items())},
             # K8s object copies per tick (wva_tick_object_copies): ~0 at
             # steady state on the zero-copy plane — every copy marks an
             # actual status write, not a read.
@@ -979,6 +990,12 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
         }
 
     incremental = run_mode(informer=True, incremental=True)
+    # The fingerprint-plane honest lever: same shipped configuration with
+    # WVA_FP_DELTA off — per-tick fingerprint RECOMPUTATION restored
+    # (sorted (labels, value) tuples per model per template, full K8s
+    # walks), byte-identical clean/dirty dynamics.
+    fp_recompute = run_mode(informer=True, incremental=True,
+                            fp_delta=False)
     informer_only = run_mode(informer=True, incremental=False)
     baseline = run_mode(informer=False, incremental=False)
     # The object-plane honest lever: the SAME shipped configuration with
@@ -996,11 +1013,15 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
         "measured_ticks": measured_ticks,
         "quiet_warm_ticks": quiet_warm_ticks,
         "incremental": incremental,
+        "fp_recompute": fp_recompute,
         "informer_only": informer_only,
         "per_tick_list_baseline": baseline,
         "copy_on_read": copy_on_read,
         "quiet_tick_p50_speedup": round(
             baseline["tick_p50_ms"]
+            / max(incremental["tick_p50_ms"], 1e-9), 2),
+        "fp_delta_p50_speedup": round(
+            fp_recompute["tick_p50_ms"]
             / max(incremental["tick_p50_ms"], 1e-9), 2),
         "object_plane_p50_speedup": round(
             copy_on_read["tick_p50_ms"]
@@ -1011,8 +1032,11 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
         if incremental["api_reads_per_tick_total"] else float(
             baseline["api_reads_per_tick_total"]),
         "levers": {
-            "incremental": "WVA_INFORMER + WVA_INCREMENTAL on (shipped; "
-                           "includes the periodic resync tick's cost)",
+            "incremental": "WVA_INFORMER + WVA_INCREMENTAL + WVA_FP_DELTA "
+                           "on (shipped; includes the periodic resync "
+                           "tick's cost)",
+            "fp_recompute": "shipped config with WVA_FP_DELTA off: "
+                            "per-tick fingerprint recomputation restored",
             "informer_only": "watch store on, dirty-set off: zero LISTs, "
                              "full analysis",
             "per_tick_list_baseline": "both off: one LIST per kind per "
@@ -1022,6 +1046,77 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
                             "deep-copy-on-read restored everywhere (the "
                             "pre-object-plane shape)",
         },
+    }
+
+
+def fingerprint_scale_sweep(models=(48, 144, 480),
+                            variants_per_model: int = 2,
+                            measured_ticks: int = 13,
+                            quiet_warm_ticks: int = 13) -> dict:
+    """Fleet-growth sweep for the versioned fingerprint plane (`make
+    bench-tick-quiet`, BENCH_LOCAL detail.fingerprint_plane): the SHIPPED
+    quiet-tick configuration at 1x / 3x / 10x fleet size, with per-phase
+    wall time. The claim under test: the per-model fingerprint cost stays
+    flat as the fleet grows (versions + memos replace per-model
+    recomputation); the residual growth is the shared fleet-wide metric
+    queries (O(series), charged once per template per tick — a real
+    Prometheus pays the same cost server-side) and the per-VA apply
+    phase."""
+    import statistics
+
+    from wva_tpu.engines import common as engines_common
+
+    out: dict[str, dict] = {}
+    for n in models:
+        mgr, cluster, clock, feed = _build_tick_world(n, variants_per_model)
+        eng = mgr.engine
+        for _ in range(3 + quiet_warm_ticks):
+            eng.optimize()
+            clock.advance(5.0)
+            feed(clock.now())
+        walls: list[float] = []
+        phase_sums: dict[str, float] = {}
+        for _ in range(measured_ticks):
+            t0 = time.perf_counter()
+            eng.optimize()
+            walls.append(time.perf_counter() - t0)
+            for phase, sec in eng.last_tick_phase_seconds.items():
+                phase_sums[phase] = phase_sums.get(phase, 0.0) + sec
+            # Fresh same-value scrapes between measured ticks — the same
+            # honest quiet definition as tick_quiet_bench: write-versions
+            # move every tick, so the STRICT reuse tier is off and the
+            # sweep measures the shipped value-version path, not a
+            # no-scrape world.
+            clock.advance(5.0)
+            feed(clock.now())
+        walls.sort()
+        out[str(n)] = {
+            "models": n,
+            "variant_autoscalings": n * variants_per_model,
+            "tick_p50_ms": round(statistics.median(walls) * 1000.0, 2),
+            "phase_ms_mean": {
+                k: round(v * 1000.0 / measured_ticks, 2)
+                for k, v in sorted(phase_sums.items())},
+        }
+        mgr.shutdown()
+        engines_common.DecisionCache.clear()
+        while not engines_common.DecisionTrigger.empty():
+            engines_common.DecisionTrigger.get_nowait()
+    lo, hi = str(models[0]), str(models[-1])
+    growth = round(out[hi]["tick_p50_ms"]
+                   / max(out[lo]["tick_p50_ms"], 1e-9), 2)
+    fp_growth = round(
+        out[hi]["phase_ms_mean"].get("fingerprint", 0.0)
+        / max(out[lo]["phase_ms_mean"].get("fingerprint", 1e-9), 1e-9), 2)
+    return {
+        "sweep": out,
+        "fleet_growth": round(models[-1] / models[0], 1),
+        "tick_p50_growth": growth,
+        "fingerprint_phase_growth": fp_growth,
+        "per_model_fingerprint_us": {
+            k: round(v["phase_ms_mean"].get("fingerprint", 0.0)
+                     * 1000.0 / v["models"], 2)
+            for k, v in out.items()},
     }
 
 
@@ -1549,15 +1644,35 @@ def tick_main() -> None:
     }))
 
 
+def _models_arg(default: int | None = None) -> int | None:
+    """--models N: fleet size override for the quiet-tick bench and the
+    profiler (`make bench-tick-quiet MODELS=480` / `make bench-profile
+    MODELS=480`)."""
+    if "--models" in sys.argv:
+        return int(sys.argv[sys.argv.index("--models") + 1])
+    return default
+
+
 def tick_quiet_main() -> None:
-    """`make bench-tick-quiet`: steady-state quiet-tick microbench only
-    (incremental on vs informer-only vs per-tick-LIST baseline), merged
-    into BENCH_LOCAL.json detail.incremental_tick, one JSON line."""
+    """`make bench-tick-quiet`: steady-state quiet-tick microbench
+    (incremental vs fp-recompute vs informer-only vs per-tick-LIST
+    baseline, merged into BENCH_LOCAL.json detail.incremental_tick) plus
+    the 48/144/480 fleet-growth sweep (detail.fingerprint_plane), one
+    JSON line. `--models N` overrides the mode-comparison fleet size."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     t0 = time.time()
-    record = tick_quiet_bench()
+    record = tick_quiet_bench(n_models=_models_arg(48))
+    sweep = fingerprint_scale_sweep()
     record["bench_wall_seconds"] = round(time.time() - t0, 1)
     _merge_bench_local("incremental_tick", record)
+    _merge_bench_local("fingerprint_plane", {
+        "quiet_tick_p50_ms": record["incremental"]["tick_p50_ms"],
+        "quiet_tick_p50_ms_fp_recompute":
+            record["fp_recompute"]["tick_p50_ms"],
+        "fp_delta_p50_speedup": record["fp_delta_p50_speedup"],
+        "phase_ms_mean": record["incremental"]["phase_ms_mean"],
+        "scale_sweep": sweep,
+    })
     # Object-plane extract (docs/design/object-plane.md): the shipped
     # zero-copy path vs the SAME configuration with WVA_ZERO_COPY off
     # (deep-copy-on-read), plus the per-tick copy accounting.
@@ -1901,7 +2016,9 @@ def profile_main() -> None:
     top = 40
     if "--top" in sys.argv:
         top = int(sys.argv[sys.argv.index("--top") + 1])
-    mgr, cluster, clock, feed = _build_tick_world(48, 2)
+    # --models N: profile at fleet scale (e.g. 480) so the next hot path
+    # is found where it actually binds, not at the comfortable size.
+    mgr, cluster, clock, feed = _build_tick_world(_models_arg(48), 2)
     eng = mgr.engine
     for _ in range(19):  # jit + caches + memos + window settling
         eng.optimize()

@@ -1,0 +1,590 @@
+"""Versioned fingerprint plane (WVA_FP_DELTA; docs/design/informer.md
+§versioned-fingerprints):
+
+1. **Equivalence** — the delta-maintained fingerprint's clean/dirty
+   dynamics match the recomputed path exactly: byte-identical statuses
+   and trace cycles with the lever off, a randomized-mutation property
+   test comparing per-tick analyzed sets, and the WVA_FP_ASSERT
+   cross-check mode staying silent over a churning world.
+2. **Slice versions** — stamped during the grouped demux, bumped iff the
+   slice's content digest moved; NaN canonicalization (the
+   never-equal-to-itself bug), empty-slice versioning, warm passes that
+   change only ``collected_at`` never bump.
+3. **Execution reuse** — TSDB per-name write/value versions gate
+   provably-identical fleet-wide query reuse (strict tier) and
+   value-stable fingerprint reuse (uniform tier); expiries re-execute.
+4. **Pod-set epochs** — the informer's per-namespace epoch moves on
+   ADDED/DELETED/material MODIFIED/relists only.
+5. **Observability + lint** — wva_tick_phase_seconds gauges; fingerprint
+   modules may not grow unannotated ``tuple(sorted(`` rebuilds.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+import random
+import re
+
+import pytest
+
+import wva_tpu
+from tests.test_tick_scale import NS, make_fleet_world
+from wva_tpu.api import ObjectMeta
+from wva_tpu.blackbox.schema import encode
+from wva_tpu.collector.registration import register_saturation_queries
+from wva_tpu.collector.source import (
+    InMemoryPromAPI,
+    MetricValue,
+    PrometheusSource,
+    RefreshSpec,
+    SourceRegistry,
+    TimeSeriesDB,
+)
+from wva_tpu.collector.source.grouped import GroupedMetricsView
+from wva_tpu.constants import LABEL_PHASE, WVA_TICK_PHASE_SECONDS
+from wva_tpu.k8s import FakeCluster, InformerKubeClient, Pod, PodStatus
+from wva_tpu.k8s.objects import clone
+from wva_tpu.utils import FakeClock
+from wva_tpu.utils import freeze as frz
+
+pytestmark = pytest.mark.fingerprint
+
+MODEL_A = "org/model-000"
+POD_A = {"pod": "m000-v5e-0", "namespace": NS, "model_name": MODEL_A}
+
+
+def _statuses(cluster):
+    return {va.metadata.name: encode(va.status)
+            for va in cluster.list("VariantAutoscaling", namespace=NS)}
+
+
+def _dumps(x):
+    return json.dumps(x, sort_keys=True)
+
+
+def _drain_bus():
+    from wva_tpu.engines import common
+
+    common.DecisionCache.clear()
+    while not common.DecisionTrigger.empty():
+        common.DecisionTrigger.get_nowait()
+
+
+# --- 1. equivalence ---
+
+
+def test_fp_delta_off_statuses_byte_identical_over_quiet_world():
+    """WVA_FP_DELTA=off restores the recomputed fingerprint with
+    byte-identical statuses — and the SAME models skip (the lever changes
+    how the fingerprint is derived, never what it says)."""
+    def run(fp_delta: bool):
+        _drain_bus()
+        mgr, cluster, tsdb, clock = make_fleet_world(
+            5, kv=0.6, queue=1, fp_delta=fp_delta)
+        skipped = 0
+        for _ in range(5):
+            mgr.run_once()
+            skipped = mgr.engine.last_tick_stats["skipped"]
+            clock.advance(5.0)
+        statuses = _statuses(cluster)
+        mgr.shutdown()
+        return statuses, skipped
+
+    on_statuses, on_skipped = run(True)
+    off_statuses, off_skipped = run(False)
+    assert on_skipped == off_skipped > 0
+    assert _dumps(on_statuses) == _dumps(off_statuses)
+
+
+def test_fp_delta_on_off_identical_over_changing_world():
+    """Changing world: every model stays dirty either way — statuses AND
+    decision-trace cycles must be byte-identical (the WVA_ZERO_COPY=off
+    discipline)."""
+    def run(fp_delta: bool):
+        _drain_bus()
+        mgr, cluster, tsdb, clock = make_fleet_world(
+            4, kv=0.78, queue=2, trace=True, fp_delta=fp_delta)
+        for i in range(4):
+            for m in range(4):
+                tsdb.add_sample(
+                    "vllm:kv_cache_usage_perc",
+                    {"pod": f"m{m:03d}-v5e-0", "namespace": NS,
+                     "model_name": f"org/model-{m:03d}"},
+                    0.80 + 0.03 * i)
+            mgr.engine.executor.tick()
+            mgr.va_reconciler.drain_triggers()
+            clock.advance(5.0)
+        mgr.flight_recorder.flush()
+        cycles = mgr.flight_recorder.snapshot()
+        statuses = _statuses(cluster)
+        mgr.shutdown()
+        return cycles, statuses
+
+    on_cycles, on_statuses = run(True)
+    off_cycles, off_statuses = run(False)
+    assert _dumps(on_statuses) == _dumps(off_statuses)
+    assert len(on_cycles) == len(off_cycles) > 0
+    for a, b in zip(on_cycles, off_cycles):
+        assert _dumps(a) == _dumps(b)
+
+
+def _mutate_world(rng, step, mgr, cluster, tsdb, clock):
+    """One randomized world mutation (or a quiet step). Mirrored across
+    the dual runs via the shared seed."""
+    roll = rng.random()
+    m = rng.randrange(4)
+    name = f"m{m:03d}-v5e"
+    model = f"org/model-{m:03d}"
+    pod = {"pod": f"{name}-0", "namespace": NS, "model_name": model}
+    if roll < 0.25:
+        tsdb.add_sample("vllm:kv_cache_usage_perc", pod,
+                        round(rng.uniform(0.2, 0.9), 3))
+    elif roll < 0.4:
+        tsdb.add_sample("vllm:kv_cache_usage_perc", pod, 0.3)  # same value
+    elif roll < 0.55:
+        va = clone(cluster.get("VariantAutoscaling", NS, name))
+        va.spec.variant_cost = str(10.0 + step)
+        cluster.update(va)
+    elif roll < 0.7:
+        pod_name = f"{name}-extra-{step}"
+        cluster.create(Pod(
+            metadata=ObjectMeta(name=pod_name, namespace=NS,
+                                labels={"app": name}),
+            status=PodStatus(phase="Running", ready=True,
+                             pod_ip=f"10.9.{m}.{step % 250}")))
+    # else: quiet step
+
+
+def test_property_versioned_dirtiness_matches_recomputed():
+    """Property test: over a seeded random mutation script, the versioned
+    fingerprint marks a model dirty on exactly the ticks the recomputed
+    one does (no background warmer runs here — an interleaved warm pass
+    may only OVER-dirty, never under)."""
+    def run(fp_delta: bool):
+        _drain_bus()
+        rng = random.Random(20260804)
+        mgr, cluster, tsdb, clock = make_fleet_world(
+            4, kv=0.3, fp_delta=fp_delta)
+        mgr.run_once()
+        clock.advance(5.0)
+        analyzed = []
+        for step in range(24):
+            _mutate_world(rng, step, mgr, cluster, tsdb, clock)
+            mgr.engine.optimize()
+            analyzed.append(mgr.engine.last_tick_stats["analyzed"])
+            clock.advance(5.0)
+        mgr.shutdown()
+        return analyzed
+
+    assert run(True) == run(False)
+
+
+def test_fp_assert_mode_stays_silent_over_churn():
+    """WVA_FP_ASSERT computes both fingerprints every tick and raises on
+    diverging equality dynamics — a churning world must not trip it."""
+    _drain_bus()
+    rng = random.Random(7)
+    mgr, cluster, tsdb, clock = make_fleet_world(4, fp_assert=True)
+    assert mgr.engine.fp_assert
+    mgr.run_once()
+    clock.advance(5.0)
+    for step in range(16):
+        _mutate_world(rng, step, mgr, cluster, tsdb, clock)
+        mgr.engine.optimize()  # raises AssertionError on divergence
+        clock.advance(5.0)
+    mgr.shutdown()
+
+
+def test_quiet_world_skips_with_fp_delta():
+    """The acceptance shape survives the new plane: quiet ticks skip
+    everything with zero list requests."""
+    _drain_bus()
+    mgr, cluster, tsdb, clock = make_fleet_world(6)
+    mgr.run_once()
+    clock.advance(5.0)
+    mgr.engine.optimize()
+    clock.advance(5.0)
+    cluster.reset_request_counts()
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_stats == {"analyzed": 0, "skipped": 6}
+    assert not any(verb == "list" for verb, _ in cluster.request_counts())
+    mgr.shutdown()
+
+
+# --- 2. slice versions ---
+
+
+def _grouped_world(n_pods: int = 2):
+    clock = FakeClock(start=50_000.0)
+    db = TimeSeriesDB(clock=clock)
+    registry = SourceRegistry()
+    src = PrometheusSource(InMemoryPromAPI(db), clock=clock)
+    registry.register("prometheus", src)
+    register_saturation_queries(registry)
+    for p in range(n_pods):
+        db.add_sample("vllm:kv_cache_usage_perc",
+                      {"pod": f"m000-v5e-{p}", "namespace": NS,
+                       "model_name": MODEL_A}, 0.4)
+    return src, db, clock
+
+
+PARAMS_A = {"modelID": MODEL_A, "namespace": NS}
+FP_QUERIES = ("kv_cache_usage", "queue_length")
+
+
+def test_slice_version_bumps_iff_value_changes():
+    src, db, clock = _grouped_world()
+    v1 = GroupedMetricsView(src).slice_versions(FP_QUERIES, PARAMS_A)
+    clock.advance(5.0)
+    # Fresh scrape, same value: version must NOT bump.
+    db.add_sample("vllm:kv_cache_usage_perc",
+                  {"pod": "m000-v5e-0", "namespace": NS,
+                   "model_name": MODEL_A}, 0.4)
+    v2 = GroupedMetricsView(src).slice_versions(FP_QUERIES, PARAMS_A)
+    assert v1 == v2
+    clock.advance(5.0)
+    db.add_sample("vllm:kv_cache_usage_perc",
+                  {"pod": "m000-v5e-0", "namespace": NS,
+                   "model_name": MODEL_A}, 0.9)
+    v3 = GroupedMetricsView(src).slice_versions(FP_QUERIES, PARAMS_A)
+    assert v3 != v2
+
+
+def test_absent_model_gets_stable_empty_version_and_dirties_on_disappear():
+    src, db, clock = _grouped_world()
+    other = {"modelID": "org/ghost", "namespace": NS}
+    e1 = GroupedMetricsView(src).slice_versions(FP_QUERIES, other)
+    clock.advance(5.0)
+    e2 = GroupedMetricsView(src).slice_versions(FP_QUERIES, other)
+    assert e1 == e2  # empty slice is versioned, and stably so
+    # A model whose series VANISH must change its version
+    # (present -> absent is a change).
+    p1 = GroupedMetricsView(src).slice_versions(FP_QUERIES, PARAMS_A)
+    for p in range(2):
+        db.drop_series("vllm:kv_cache_usage_perc",
+                       {"pod": f"m000-v5e-{p}", "namespace": NS,
+                        "model_name": MODEL_A})
+    clock.advance(5.0)
+    p2 = GroupedMetricsView(src).slice_versions(FP_QUERIES, PARAMS_A)
+    assert p1 != p2
+
+
+def test_nan_values_do_not_pin_fingerprint_dirty():
+    """Regression (NaN != NaN): a backend without the NaN->0 guard must
+    not make the fingerprint never equal itself. Both the legacy value
+    tuple and the versioned digest canonicalize non-finite floats."""
+    src, db, clock = _grouped_world()
+    # Simulate a guard-less backend: raw values pass through.
+    src.make_metric_value = lambda labels, p: MetricValue(
+        value=p.value, timestamp=p.timestamp, labels=labels)
+    db.add_sample("vllm:kv_cache_usage_perc",
+                  {"pod": "m000-v5e-0", "namespace": NS,
+                   "model_name": MODEL_A}, float("nan"))
+    fp1 = GroupedMetricsView(src).slice_fingerprint(FP_QUERIES, PARAMS_A)
+    v1 = GroupedMetricsView(src).slice_versions(FP_QUERIES, PARAMS_A)
+    clock.advance(5.0)
+    db.add_sample("vllm:kv_cache_usage_perc",
+                  {"pod": "m000-v5e-0", "namespace": NS,
+                   "model_name": MODEL_A}, float("nan"))
+    fp2 = GroupedMetricsView(src).slice_fingerprint(FP_QUERIES, PARAMS_A)
+    v2 = GroupedMetricsView(src).slice_versions(FP_QUERIES, PARAMS_A)
+    assert fp1 == fp2, "NaN canonicalization lost in slice_fingerprint"
+    assert v1 == v2, "NaN must not bump slice versions"
+
+
+def test_warm_pass_does_not_bump_slice_versions():
+    """A background grouped warm pass changes only collected_at — no
+    slice_version may move (the warmer keeping caches hot must not dirty
+    the fleet)."""
+    from wva_tpu.collector.source.grouped import warm_grouped_spec
+
+    src, db, clock = _grouped_world()
+    view = GroupedMetricsView(src)
+    view.refresh(RefreshSpec(queries=["kv_cache_usage"],
+                             params=dict(PARAMS_A)))
+    v1 = view.slice_versions(("kv_cache_usage",), PARAMS_A)
+    clock.advance(30.0)
+    assert warm_grouped_spec(src, "kv_cache_usage", {})
+    # Cache freshness advanced...
+    cached = src.get("kv_cache_usage", PARAMS_A)
+    assert cached is not None and cached.age(clock) == 0.0
+    # ...but versions did not.
+    v2 = GroupedMetricsView(src).slice_versions(("kv_cache_usage",),
+                                                PARAMS_A)
+    assert v1 == v2
+
+
+def test_warmer_replays_fp_delta_off_mode():
+    """A spec served by an UNVERSIONED view (WVA_FP_DELTA=off) must warm
+    unversioned too: the emergency lever has to bypass the version plane
+    on every path, warmer included."""
+    src, db, clock = _grouped_world()
+    view = GroupedMetricsView(src, versioned=False)
+    view.refresh(RefreshSpec(queries=["kv_cache_usage"],
+                             params=dict(PARAMS_A)))
+    clock.advance(30.0)
+    assert src.background_fetch_once() == 1
+    assert src.query_counts().get("grouped:kv_cache_usage", 0) >= 2
+    assert src.slice_book.reused_executions == 0
+    assert not src.slice_book._digests  # book never touched
+
+
+# --- 3. execution reuse (TSDB write/value versions) ---
+
+
+def test_strict_reuse_skips_backend_queries_when_nothing_written():
+    src, db, clock = _grouped_world()
+    r1 = GroupedMetricsView(src).refresh(
+        RefreshSpec(queries=["kv_cache_usage"], params=dict(PARAMS_A)))
+    src.reset_query_counts()
+    clock.advance(5.0)  # no writes at all
+    r2 = GroupedMetricsView(src).refresh(
+        RefreshSpec(queries=["kv_cache_usage"], params=dict(PARAMS_A)))
+    assert src.query_counts() == {}  # provably identical: reused
+    a, b = r1["kv_cache_usage"], r2["kv_cache_usage"]
+    assert encode(a.values) == encode(b.values)  # timestamps included
+    assert src.slice_book.reused_executions >= 1
+
+
+def test_fp_tier_reuses_on_same_value_rescrape_but_collection_does_not():
+    src, db, clock = _grouped_world()
+    view = GroupedMetricsView(src)
+    view.slice_versions(("kv_cache_usage",), PARAMS_A)
+    clock.advance(5.0)
+    for p in range(2):  # fresh scrape, same values: value-version still
+        db.add_sample("vllm:kv_cache_usage_perc",
+                      {"pod": f"m000-v5e-{p}", "namespace": NS,
+                       "model_name": MODEL_A}, 0.4)
+    src.reset_query_counts()
+    view2 = GroupedMetricsView(src)
+    view2.slice_versions(("kv_cache_usage",), PARAMS_A)
+    # Fingerprint tier: value-stable uniform evaluation reused, zero
+    # backend queries.
+    assert src.query_counts() == {}
+    # Collection in the SAME tick must see fresh timestamps: the
+    # write-version moved, so the strict tier re-executes.
+    view2.refresh(RefreshSpec(queries=["kv_cache_usage"],
+                              params=dict(PARAMS_A)))
+    assert src.query_counts() == {"grouped:kv_cache_usage": 1}
+
+
+def test_reuse_expires_when_samples_age_out():
+    src, db, clock = _grouped_world()
+    GroupedMetricsView(src).slice_versions(("kv_cache_usage",), PARAMS_A)
+    src.reset_query_counts()
+    # Past every validity horizon (the kv template's 1m range and the 5m
+    # lookback) with zero writes: reuse must NOT serve — the result set
+    # provably changed (series aged out) and the version must bump.
+    clock.advance(600.0)
+    v = GroupedMetricsView(src).slice_versions(("kv_cache_usage",),
+                                               PARAMS_A)
+    assert src.query_counts() == {"grouped:kv_cache_usage": 1}
+    assert v  # template still fingerprinted (empty slice, new version)
+
+
+def test_tsdb_write_and_value_versions():
+    clock = FakeClock(start=0.0)
+    db = TimeSeriesDB(clock=clock)
+    names = ("m",)
+    assert db.name_write_version(names) == 0
+    db.add_sample("m", {"a": "1"}, 1.0)
+    w1, v1 = db.name_write_version(names), db.name_value_version(names)
+    assert w1 > 0 and v1 > 0
+    db.add_sample("m", {"a": "1"}, 1.0)  # same value
+    assert db.name_write_version(names) > w1
+    assert db.name_value_version(names) == v1
+    db.add_sample("m", {"a": "1"}, 2.0)  # value change
+    assert db.name_value_version(names) > v1
+    # NaN -> NaN is NOT a value change (the stuck-exporter case).
+    db.add_sample("m", {"a": "2"}, float("nan"))
+    vn = db.name_value_version(names)
+    db.add_sample("m", {"a": "2"}, float("nan"))
+    assert db.name_value_version(names) == vn
+    # Dropping a series bumps both versions.
+    w2 = db.name_write_version(names)
+    db.drop_series("m", {"a": "1"})
+    assert db.name_write_version(names) > w2
+    assert db.name_value_version(names) > vn
+
+
+def test_memoized_by_version_reuses_until_object_replaced():
+    from wva_tpu.api import VariantAutoscaling, VariantAutoscalingSpec
+
+    cache: dict = {}
+    calls = []
+
+    def compute(obj):
+        calls.append(obj)
+        return obj.metadata.name
+
+    va = frz.freeze(VariantAutoscaling(
+        metadata=ObjectMeta(name="x", namespace=NS),
+        spec=VariantAutoscalingSpec(model_id="m")))
+    assert frz.memoized_by_version(cache, va, compute) == "x"
+    assert frz.memoized_by_version(cache, va, compute) == "x"
+    assert len(calls) == 1  # memo hit on the same frozen instance
+    va2 = frz.freeze(clone(va))  # replaced object: new version
+    frz.memoized_by_version(cache, va2, compute)
+    assert len(calls) == 2
+    unfrozen = clone(va)  # version 0: computed every time
+    frz.memoized_by_version(cache, unfrozen, compute)
+    frz.memoized_by_version(cache, unfrozen, compute)
+    assert len(calls) == 4
+
+
+# --- 4. pod-set epochs ---
+
+
+def _pod(name: str, ns: str = NS, ready: bool = True,
+         labels: dict | None = None) -> Pod:
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns,
+                                   labels=labels or {"app": "a"}),
+               status=PodStatus(phase="Running", ready=ready,
+                                pod_ip="10.0.0.1"))
+
+
+def test_pod_epoch_bumps_on_material_changes_only():
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    e0 = inf.pod_epoch(NS)
+    cluster.create(_pod("p1"))
+    e1 = inf.pod_epoch(NS)
+    assert e1 > e0  # ADDED
+    # Ready flip: material.
+    live = cluster.get("Pod", NS, "p1")
+    edit = clone(live)
+    edit.status.ready = False
+    cluster.update_status(edit)
+    e2 = inf.pod_epoch(NS)
+    assert e2 > e1
+    # Label edit: material (selector membership can move).
+    edit = clone(cluster.get("Pod", NS, "p1"))
+    edit.metadata.labels = {"app": "b"}
+    cluster.update(edit)
+    e3 = inf.pod_epoch(NS)
+    assert e3 > e2
+    # Deletion: material; other namespaces unaffected throughout.
+    assert inf.pod_epoch("elsewhere") == 0
+    cluster.delete("Pod", NS, "p1")
+    assert inf.pod_epoch(NS) > e3
+
+
+def test_pod_epoch_unmoved_by_unrelated_kinds_and_quiet_resync():
+    from tests.test_informer import _va
+
+    clock = FakeClock(start=1000.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.create(_pod("p1"))
+    inf = InformerKubeClient(cluster, clock=clock).start()
+    e1 = inf.pod_epoch(NS)
+    cluster.create(_va("va-x"))  # non-Pod events never bump
+    assert inf.pod_epoch(NS) == e1
+    # A Pod re-LIST bumps (wholesale replacement is conservative).
+    clock.advance(inf.resync_seconds + 1)
+    inf.resync_if_stale()
+    assert inf.pod_epoch(NS) > e1
+
+
+def test_pod_churn_still_dirties_exactly_that_model():
+    """End to end: with epoch-memoized pod parts, pod churn dirties the
+    owning model and ONLY that model."""
+    _drain_bus()
+    mgr, cluster, tsdb, clock = make_fleet_world(6)
+    mgr.run_once()
+    clock.advance(5.0)
+    mgr.engine.optimize()
+    clock.advance(5.0)
+    cluster.delete("Pod", NS, "m004-v5e-0")
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_stats == {"analyzed": 1, "skipped": 5}
+    clock.advance(5.0)
+    mgr.engine.optimize()  # settles clean again
+    assert mgr.engine.last_tick_stats["analyzed"] == 0
+    mgr.shutdown()
+
+
+# --- 5. observability + lint ---
+
+
+def test_tick_phase_gauges_emitted():
+    _drain_bus()
+    mgr, cluster, tsdb, clock = make_fleet_world(3)
+    mgr.run_once()
+    registry = mgr.registry
+    for phase in ("prepare", "fingerprint", "analyze", "apply"):
+        v = registry.get(WVA_TICK_PHASE_SECONDS, {LABEL_PHASE: phase})
+        assert v is not None and v >= 0.0, phase
+    assert set(mgr.engine.last_tick_phase_seconds) == {
+        "prepare", "fingerprint", "analyze", "apply"}
+    mgr.shutdown()
+
+
+def test_no_unannotated_fleet_sorts_in_fingerprint_modules():
+    """Hot-path lint: ``tuple(sorted(`` inside the fingerprint modules is
+    exactly the per-model-per-tick rebuild this PR removed. New call
+    sites must either go through the version plane or carry an explicit
+    ``fp-lint:`` pragma (on the line or the line above) justifying a
+    BOUNDED iterable (one slice / one label set — never fleet-sized)."""
+    pkg = pathlib.Path(wva_tpu.__file__).parent
+    modules = [
+        "engines/saturation/engine.py",
+        "collector/source/grouped.py",
+    ]
+    pattern = re.compile(r"tuple\(sorted\(")
+    offenders = []
+    for rel in modules:
+        lines = (pkg / rel).read_text().splitlines()
+        for i, line in enumerate(lines):
+            if not pattern.search(line.split("#", 1)[0]):
+                continue
+            context = line + (lines[i - 1] if i else "")
+            if "fp-lint:" in context:
+                continue
+            offenders.append(f"{rel}:{i + 1}: {line.strip()}")
+    assert not offenders, (
+        "unannotated tuple(sorted( in fingerprint modules — use the "
+        "version plane (SliceVersionBook / object-version memos) or add "
+        "an 'fp-lint: bounded (...)' pragma:\n" + "\n".join(offenders))
+
+
+def test_heartbeat_status_write_does_not_dirty_model():
+    """The engine's own 60s status heartbeat replaces the frozen VA (new
+    object_version) but must not dirty the model: the memoized VA part is
+    re-derived once and compares equal."""
+    _drain_bus()
+    mgr, cluster, tsdb, clock = make_fleet_world(3)
+    mgr.run_once()
+    clock.advance(5.0)
+    mgr.engine.optimize()
+    # Cross the heartbeat boundary: status writes happen...
+    for _ in range(14):
+        clock.advance(5.0)
+        mgr.engine.optimize()
+    # ...yet at steady state the fleet still goes fully clean.
+    clock.advance(5.0)
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_stats["analyzed"] == 0
+    mgr.shutdown()
+
+
+def test_nan_sample_in_tsdb_still_goes_clean_end_to_end():
+    """A NaN-carrying metric in the real stack (guard included) must not
+    pin the model dirty."""
+    _drain_bus()
+    mgr, cluster, tsdb, clock = make_fleet_world(3)
+    tsdb.add_sample("vllm:kv_cache_usage_perc",
+                    {"pod": "m001-v5e-0", "namespace": NS,
+                     "model_name": "org/model-001"}, math.nan)
+    mgr.run_once()
+    clock.advance(5.0)
+    mgr.engine.optimize()
+    clock.advance(5.0)
+    tsdb.add_sample("vllm:kv_cache_usage_perc",
+                    {"pod": "m001-v5e-0", "namespace": NS,
+                     "model_name": "org/model-001"}, math.nan)
+    mgr.engine.optimize()
+    assert mgr.engine.last_tick_stats["analyzed"] == 0
+    mgr.shutdown()

@@ -323,16 +323,25 @@ def _prom_source(mgr):
 
 def test_48_model_tick_issues_one_query_per_template():
     """The headline budget: a 48-model fleet tick with grouped collection
-    ON costs exactly ONE backend query per collected template — not one
-    per (model, template)."""
+    ON costs AT MOST one backend query per collected template — not one
+    per (model, template). Templates whose metrics received no TSDB
+    writes since the previous execution (and whose samples are still
+    within their validity windows) cost ZERO: the versioned fingerprint
+    plane's write-version gate proves the evaluation would be
+    byte-identical and reuses the demuxed result."""
     mgr, cluster, tsdb, clock = make_fleet_world(48)
     mgr.run_once()  # warm (reconciler paths, snapshot, caches)
     src = _prom_source(mgr)
     src.reset_query_counts()
     mgr.engine.optimize()
     counts = src.query_counts()
-    assert counts == {f"grouped:{t}": 1 for t in REPLICA_TEMPLATES}
-    assert src.backend_query_total() == len(REPLICA_TEMPLATES)
+    assert set(counts) <= {f"grouped:{t}" for t in REPLICA_TEMPLATES}
+    assert all(v == 1 for v in counts.values()), counts
+    assert src.backend_query_total() <= len(REPLICA_TEMPLATES)
+    # The gap between templates and queries is exactly the write-version
+    # reuse, not a collection hole.
+    assert src.slice_book.reused_executions >= \
+        len(REPLICA_TEMPLATES) - src.backend_query_total()
     mgr.shutdown()
 
 
@@ -411,7 +420,11 @@ def test_warmer_re_executes_grouped_specs_and_refreshes_slices():
     grouped_src.reset_query_counts()
     clock.advance(30.0)
     assert grouped_src.background_fetch_once() == 1
-    assert grouped_src.query_counts() == {"grouped:kv_cache_usage": 1}
+    # The warm pass costs AT MOST one fleet-wide query — zero when the
+    # write-version gate proves the previous execution is still
+    # byte-identical (nothing was written in the 30s gap).
+    counts = grouped_src.query_counts()
+    assert counts in ({}, {"grouped:kv_cache_usage": 1}), counts
     # The warm pass refreshed OTHER models' slices too (cache age reset).
     cached_b = grouped_src.get("kv_cache_usage",
                                {PARAM_MODEL_ID: "org/model-b",

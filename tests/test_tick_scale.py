@@ -49,7 +49,8 @@ def make_fleet_world(n_models: int, kv: float = 0.3, queue: int = 0,
                      saturation_cfg: SaturationScalingConfig | None = None,
                      analysis_workers: int | None = None,
                      trace: bool = False, informer: bool = True,
-                     incremental: bool = True):
+                     incremental: bool = True, fp_delta: bool = True,
+                     fp_assert: bool = False):
     """FakeCluster world with ``n_models`` models, one VA/Deployment/pod
     each, live metrics in the TSDB, and a wired manager."""
     clock = FakeClock(start=100_000.0)
@@ -62,6 +63,8 @@ def make_fleet_world(n_models: int, kv: float = 0.3, queue: int = 0,
         cfg.infrastructure.engine_analysis_workers = analysis_workers
     cfg.infrastructure.informer = informer
     cfg.infrastructure.incremental = incremental
+    cfg.infrastructure.fp_delta = fp_delta
+    cfg.infrastructure.fp_assert = fp_assert
     if trace:
         cfg.set_trace(TraceConfig(enabled=True))
 

@@ -33,9 +33,11 @@ produced the same series for that model.
 
 from __future__ import annotations
 
+import itertools
 import logging
+import threading
 import urllib.error
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from wva_tpu.collector.source.promql import (
     Aggregation,
@@ -90,6 +92,12 @@ class GroupedQuery:
     promql: str
     branches: tuple[GroupedBranch, ...]
     has_namespace: bool
+    # Versioned-fingerprint metadata (docs/design/informer.md
+    # §versioned-fingerprints): the metric names the query selects. The
+    # execution-reuse gate compares backend write/value versions across
+    # exactly these names; the per-evaluation validity bounds (TrackMeta)
+    # cover instant and range shapes alike.
+    metric_names: tuple[str, ...] = ()
 
 
 def _merge_pending(into: dict[str, str], kind: str, label: str) -> None:
@@ -212,7 +220,27 @@ def build_grouped_query(template: QueryTemplate,
             seen.add((b.model_label, b.ns_label))
             unique.append(b)
     return GroupedQuery(promql=to_promql(ast), branches=tuple(unique),
-                        has_namespace=has_namespace)
+                        has_namespace=has_namespace,
+                        metric_names=_selector_names(ast) or ())
+
+
+def _selector_names(node) -> tuple[str, ...] | None:
+    """Metric names one transformed AST selects — the reuse-gate metadata
+    on :class:`GroupedQuery`. None poisons the whole query (empty
+    metric_names disables reuse): a node shape this walk does not
+    understand must never UNDER-cover the version gate. Unreachable for
+    today's groupable templates (_rewrite refuses every other shape)."""
+    if isinstance(node, Selector):
+        return (node.name,)
+    if isinstance(node, (FuncCall, Aggregation)):
+        return _selector_names(node.arg)
+    if isinstance(node, BinaryOp):
+        ln = _selector_names(node.left)
+        rn = _selector_names(node.right)
+        if ln is None or rn is None:
+            return None
+        return ln + tuple(n for n in rn if n not in ln)
+    return None
 
 
 def demux_points(gq: GroupedQuery, points, make_value):
@@ -223,6 +251,22 @@ def demux_points(gq: GroupedQuery, points, make_value):
     then backend order, matching per-model ``left or right`` evaluation.
     Returns ``{(model, namespace): [value, ...]}`` (namespace "" when the
     template has no namespace dimension)."""
+    if len(gq.branches) == 1:
+        # Single-branch fast path (most templates): no or-preference is
+        # possible, so the per-point identity tuple and branch bookkeeping
+        # are dead weight — demux straight into the output lists.
+        branch = gq.branches[0]
+        strip = branch.strip
+        fast: dict[tuple[str, str], list] = {}
+        for p in points:
+            labels = p.labels
+            model = labels.get(branch.model_label)
+            if not model:
+                continue
+            ns = labels.get(branch.ns_label, "") if branch.ns_label else ""
+            stripped = {k: v for k, v in labels.items() if k not in strip}
+            fast.setdefault((model, ns), []).append(make_value(stripped, p))
+        return fast
     assigned: dict[tuple[str, str], list[tuple[int, tuple, object]]] = {}
     for p in points:
         for bi, branch in enumerate(gq.branches):
@@ -232,7 +276,8 @@ def demux_points(gq: GroupedQuery, points, make_value):
             ns = p.labels.get(branch.ns_label, "") if branch.ns_label else ""
             stripped = {k: v for k, v in p.labels.items()
                         if k not in branch.strip}
-            identity = tuple(sorted(stripped.items()))
+            identity = tuple(sorted(stripped.items()))  # fp-lint: bounded
+            # (one point's labels; multi-branch or-preference path only)
             assigned.setdefault((model, ns), []).append(
                 (bi, identity, make_value(stripped, p)))
             break
@@ -258,6 +303,183 @@ def demux_points(gq: GroupedQuery, points, make_value):
     return out
 
 
+def _canon_value(v):
+    """NaN/Inf-canonicalized value for digests and fingerprints: NaN is
+    not equal to itself, so a raw NaN in a fingerprint tuple makes the
+    fingerprint never compare equal — the model would be pinned
+    permanently dirty. Map non-finite floats to stable sentinels."""
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "Inf"
+        if v == float("-inf"):
+            return "-Inf"
+    return v
+
+
+def _slice_digest(values) -> tuple:
+    """Content digest of one demuxed per-model slice: order-insensitive
+    (sorted (labels, value) pairs), timestamp-free, NaN-canonicalized.
+    Bounded by the handful of series one model contributes to one
+    template — never fleet-sized."""
+    return tuple(sorted(  # fp-lint: bounded (one model's slice)
+        (tuple(sorted(v.labels.items())), _canon_value(v.value))
+        for v in values))
+
+
+_EMPTY_DIGEST: tuple = ()
+
+# One version book holds at most this many (spec, model) entries; past it
+# the book resets wholesale (the counter keeps climbing, so every model
+# re-dirties exactly once — the safe direction) instead of growing without
+# bound on churning fleets.
+_BOOK_MAX_ENTRIES = 65536
+
+
+@dataclass
+class _ExecMemo:
+    """One memoized fleet-wide execution with two reuse tiers:
+
+    - **strict** (collection-grade): unchanged backend write-version +
+      before ``expiry_strict`` — the evaluation is byte-identical,
+      timestamps included, so it may serve collectors.
+    - **fingerprint-grade**: unchanged VALUE-version + ``uniform`` +
+      before ``expiry_b`` — the result's values (hence slice digests and
+      versions) are provably unchanged, but timestamps may have moved
+      under same-value re-scrapes, so ONLY the timestamp-free
+      fingerprint tier may consume it."""
+
+    write_version: int
+    value_version: int
+    expiry_strict: float
+    expiry_b: float
+    uniform: bool
+    slices: dict = field(default_factory=dict)  # (model, ns) -> [values]
+    versions: dict = field(default_factory=dict)  # (model, ns) -> int
+
+
+class SliceVersionBook:
+    """Cross-tick slice-version store — the metrics half of the versioned
+    fingerprint plane (``WVA_FP_DELTA``; docs/design/informer.md).
+
+    Per (template, extras, scope) spec and per demuxed (model, namespace)
+    slice it keeps the last content digest and a store-monotonic
+    ``slice_version`` that bumps ONLY when the digest changes. The
+    engine's dirty-set fingerprint then records the version (an int)
+    instead of rebuilding and comparing the full sorted (labels, value)
+    tuple per model per tick. Digests are stamped once per fleet-wide
+    execution — inside the demux walk that already touches every slice —
+    so a quiet tick's fingerprint work is O(templates) version lookups
+    per model.
+
+    The book also memoizes whole executions (``_ExecMemo``): backed by
+    the ring-buffer TSDB's per-series write-versions and the
+    evaluation's tracked validity bounds, an unchanged write-version
+    proves byte-identical evaluation, so re-scrape-free quiet metrics
+    skip the backend query entirely. Thread-safe; shared by engine ticks and the cache warmer."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._counter = itertools.count(1)
+        # spec_key -> {(model, ns): (digest, version)}
+        self._digests: dict[tuple, dict[tuple, tuple[tuple, int]]] = {}
+        self._entries = 0
+        self._exec_memo: dict[tuple, _ExecMemo] = {}
+        # Introspection for tests/bench.
+        self.reused_executions = 0
+
+    def stamp(self, spec_key: tuple,
+              digests: dict[tuple, tuple]) -> dict[tuple, int]:
+        """Record this execution's slice digests; returns the slice
+        versions (bumped only where the digest changed)."""
+        with self._mu:
+            book = self._digests.get(spec_key)
+            if book is None:
+                book = self._digests[spec_key] = {}
+            out: dict[tuple, int] = {}
+            for key, digest in digests.items():
+                cur = book.get(key)
+                if cur is not None and cur[0] == digest:
+                    out[key] = cur[1]
+                    continue
+                version = next(self._counter)
+                if cur is None:
+                    self._entries += 1
+                book[key] = (digest, version)
+                out[key] = version
+            if self._entries > _BOOK_MAX_ENTRIES:
+                self._digests = {spec_key: book}
+                self._entries = len(book)
+                self._exec_memo.clear()
+            return out
+
+    def version_for(self, spec_key: tuple, slice_key: tuple,
+                    digest: tuple) -> int:
+        """Version of one slice given its current digest — the lazy path
+        for models ABSENT from this tick's demux (their slice digest is
+        the empty tuple, which must still version: present -> absent is a
+        change)."""
+        with self._mu:
+            book = self._digests.get(spec_key)
+            if book is None:
+                book = self._digests[spec_key] = {}
+            cur = book.get(slice_key)
+            if cur is not None and cur[0] == digest:
+                return cur[1]
+            version = next(self._counter)
+            if cur is None:
+                self._entries += 1
+            book[slice_key] = (digest, version)
+            return version
+
+    def note_execution(self, spec_key: tuple, memo: "_ExecMemo") -> None:
+        with self._mu:
+            self._exec_memo[spec_key] = memo
+
+    def reusable(self, spec_key: tuple, write_version: int | None,
+                 now: float) -> "_ExecMemo | None":
+        """Strict (collection-grade) reuse: the backend write-version for
+        the query's metric names is unchanged (no appends, no drops) and
+        no included sample has left its window yet — the evaluation would
+        be byte-identical, timestamps included."""
+        if write_version is None:
+            return None
+        with self._mu:
+            memo = self._exec_memo.get(spec_key)
+            if (memo is None or memo.write_version != write_version
+                    or now >= memo.expiry_strict):
+                return None
+            self.reused_executions += 1
+            return memo
+
+    def reusable_fp(self, spec_key: tuple, write_version: int | None,
+                    value_version: int | None,
+                    now: float) -> "_ExecMemo | None":
+        """Fingerprint-grade reuse: strict reuse, OR value-version
+        unchanged over a uniform evaluation before ``expiry_b`` — the
+        result's VALUES provably did not move, so the memoized slice
+        versions are current (timestamps may be stale, which the
+        timestamp-free fingerprint never sees)."""
+        memo = self.reusable(spec_key, write_version, now)
+        if memo is not None:
+            return memo
+        if value_version is None:
+            return None
+        with self._mu:
+            memo = self._exec_memo.get(spec_key)
+            if (memo is None or not memo.uniform
+                    or memo.value_version != value_version
+                    or now >= memo.expiry_b):
+                return None
+            self.reused_executions += 1
+            return memo
+
+    def forget_execution(self, spec_key: tuple) -> None:
+        with self._mu:
+            self._exec_memo.pop(spec_key, None)
+
+
 class GroupedMetricsView(MetricsSource):
     """Tick-scoped grouped-collection view over a PrometheusSource.
 
@@ -268,15 +490,33 @@ class GroupedMetricsView(MetricsSource):
     to the wrapped source unchanged, so disabling grouping is equivalent to
     bypassing the view entirely."""
 
-    def __init__(self, source, scope_namespace: str = "") -> None:
+    def __init__(self, source, scope_namespace: str = "",
+                 versioned: bool = True) -> None:
         self._source = source
         # Namespace-scoped controllers keep their watch namespace as an
         # equality matcher in the fleet-wide queries (shared-Prometheus
         # tenancy: never aggregate other tenants' series).
         self._scope_namespace = scope_namespace
-        # (name, extras) -> demuxed {(model, ns): MetricResult} | None when
-        # the grouped execution failed this tick (per-model fallback).
+        # Versioned fingerprint plane (WVA_FP_DELTA): stamp slice digests
+        # into the source's SliceVersionBook during demux and allow
+        # write-version-backed execution reuse. Off restores the
+        # recomputed path byte-for-byte (the book is never touched).
+        self._book = (getattr(source, "slice_book", None)
+                      if versioned else None)
+        # (name, extras, scope) -> demuxed {(model, ns): MetricResult} |
+        # None when the grouped execution failed this tick (per-model
+        # fallback).
         self._once = OnceMap()
+        # slice_versions fast path: (name, extras) -> (versions | None,
+        # spec_key, has_ns) resolved once per tick per template, so the
+        # per-model fingerprint pays one dict hit instead of re-walking
+        # template params / rewrite memo / execution latch per template.
+        # Filled idempotently (engine thread only computes fingerprints,
+        # but racing fills would agree anyway). _tpl_pre caches the
+        # params-independent template preamble (param list, ns-ness,
+        # extra-param names) per template name.
+        self._vmap: dict[tuple, tuple] = {}
+        self._tpl_pre: dict[str, tuple | None] = {}
 
     # --- MetricsSource ---
 
@@ -303,10 +543,11 @@ class GroupedMetricsView(MetricsSource):
 
     # --- grouped execution ---
 
-    def _serve_grouped(self, name: str,
-                       params: dict[str, str]) -> MetricResult | None:
-        """The per-model slice for ``params`` from this tick's fleet-wide
-        result, or None to delegate to the per-model path."""
+    def _grouped_plan(self, name: str, params: dict[str, str]):
+        """Shared precondition walk for grouped serving and fingerprint
+        versioning: (template, model, ns, has_ns, gq, spec_key), or None
+        to delegate to the per-model path. The exclusion rules are shared
+        so the fingerprint's template coverage matches serving exactly."""
         template = self._source.query_list().get(name)
         if template is None or template.type != QUERY_TYPE_PROMQL:
             return None
@@ -326,17 +567,21 @@ class GroupedMetricsView(MetricsSource):
                                             self._scope_namespace)
         if gq is None:
             return None
-        key = (name, tuple(sorted(extras.items())))
+        key = (name, tuple(sorted(extras.items())),  # fp-lint: bounded
+               self._scope_namespace)                # (template params)
+        return template, model, ns, has_ns, gq, key
+
+    def _serve_grouped(self, name: str,
+                       params: dict[str, str]) -> MetricResult | None:
+        """The per-model slice for ``params`` from this tick's fleet-wide
+        result, or None to delegate to the per-model path."""
+        plan = self._grouped_plan(name, params)
+        if plan is None:
+            return None
+        _, model, ns, has_ns, gq, key = plan
         demuxed = self._demuxed(key, name, gq, params, has_ns)
         if demuxed is None:
             return None  # grouped execution failed: per-model fallback
-        # Organic serve: remember the grouped spec so the background cache
-        # warmer re-executes the fleet-wide query (refreshing EVERY
-        # demuxed per-model slice) between ticks — the grouped twin of
-        # _remember_spec on the per-model path. Warmer executions go
-        # through warm_grouped_spec/_execute and never renew.
-        self._source.remember_grouped_spec(name, extras,
-                                           self._scope_namespace)
         result = demuxed.get((model, ns))
         if result is None:
             # Same outcome the per-model query would produce: an empty
@@ -357,7 +602,9 @@ class GroupedMetricsView(MetricsSource):
         analyzes anything. Hashes (labels, value) only — never collection
         timestamps, which move every tick even when the data does not.
         Ungroupable / failed / param-incomplete templates are excluded
-        (stably, so their absence cannot churn the digest)."""
+        (stably, so their absence cannot churn the digest). This is the
+        RECOMPUTED path (``WVA_FP_DELTA=off``); the shipped path is
+        :meth:`slice_versions`."""
         parts: list[tuple] = []
         for name in queries:
             template = self._source.query_list().get(name)
@@ -368,28 +615,199 @@ class GroupedMetricsView(MetricsSource):
             sliced = self._serve_grouped(name, params)
             if sliced is None:
                 continue
-            values = tuple(sorted(
-                (tuple(sorted(v.labels.items())), v.value)
+            # _canon_value: a raw NaN here would make the fingerprint
+            # never equal itself (NaN != NaN inside the tuple compare),
+            # silently pinning the model permanently dirty.
+            values = tuple(sorted(  # fp-lint: bounded (one model's slice)
+                (tuple(sorted(v.labels.items())), _canon_value(v.value))
                 for v in sliced.values))
             parts.append((name, values))
         return tuple(parts)
 
+    def slice_versions(self, queries, params: dict[str, str]) -> tuple:
+        """Delta-maintained twin of :meth:`slice_fingerprint`
+        (``WVA_FP_DELTA``, default on): O(templates) version lookups per
+        model instead of rebuilding sorted (labels, value) tuples. The
+        versions come from the source's :class:`SliceVersionBook`,
+        stamped once per fleet-wide execution inside :meth:`_execute`'s
+        demux walk; a version moves iff the slice's content digest moved,
+        so equality dynamics match the recomputed fingerprint exactly
+        (asserted by the equivalence mode and the property test).
+        Template exclusion rules are shared with serving via
+        :meth:`_grouped_plan`, so coverage cannot diverge."""
+        parts: list[tuple] = []
+        model = params.get(PARAM_MODEL_ID)
+        if not model:
+            return ()
+        for name in queries:
+            pre = self._tpl_pre.get(name, False)
+            if pre is False:
+                template = self._source.query_list().get(name)
+                if template is None:
+                    pre = None
+                else:
+                    tp = template.params
+                    pre = (tuple(tp), PARAM_NAMESPACE in tp,
+                           tuple(k for k in tp
+                                 if k not in (PARAM_MODEL_ID,
+                                              PARAM_NAMESPACE)))
+                self._tpl_pre[name] = pre
+            if pre is None:
+                continue
+            tparams, has_ns, extra_names = pre
+            if any(p not in params for p in tparams):
+                continue
+            extras_key = (() if not extra_names else
+                          tuple(sorted(  # fp-lint: bounded (tpl params)
+                              (k, params[k]) for k in extra_names)))
+            ns = params.get(PARAM_NAMESPACE, "") if has_ns else ""
+            mkey = (name, extras_key)
+            hit = self._vmap.get(mkey)
+            if hit is None:
+                # First model asking for this template this tick: resolve
+                # the grouped plan and run (or version-reuse) the ONE
+                # fleet-wide execution; every later model pays a dict hit.
+                plan = self._grouped_plan(name, params)
+                if plan is None:
+                    self._vmap[mkey] = hit = ("excluded", None, None)
+                else:
+                    _, _, _, _, gq, key = plan
+                    vmap = self._fp_versions(key, name, gq, params, has_ns)
+                    if vmap is None:
+                        # Failed execution: excluded this tick, like the
+                        # legacy path (not memoized as a terminal state —
+                        # the OnceMap already pins the failure per tick).
+                        hit = ("excluded", None, None)
+                    else:
+                        hit = ("ok", vmap, key)
+                        self._vmap[mkey] = hit
+            state, versions, key = hit
+            if state == "excluded":
+                continue
+            version = versions.get((model, ns))
+            if version is None:
+                # Model absent from this tick's demux: its slice is empty,
+                # which must still version (present -> absent is a change).
+                # Written back into the (cross-tick, book-memoized)
+                # versions map so later models — and later quiet ticks
+                # reusing the same memo — pay a dict hit, not a book
+                # lock round-trip.
+                version = self._book.version_for(key, (model, ns),
+                                                 _EMPTY_DIGEST)
+                versions[(model, ns)] = version
+            parts.append((name, version))
+        return tuple(parts)
+
+    def slice_versions_bulk(self, queries,
+                            pairs: list[tuple[str, str]],
+                            ) -> dict[tuple[str, str], tuple]:
+        """Template-major bulk form of :meth:`slice_versions` for the
+        engine's partition pass: resolves each template ONCE, then walks
+        the fleet with one dict lookup per (model, namespace) — the
+        per-model re-walk of template params/plan/latch state is hoisted
+        out of the O(models) loop entirely. Exclusion rules and version
+        values are identical to per-model slice_versions with
+        ``{model, namespace}`` params (the fingerprint queries' only
+        shape)."""
+        out: dict[tuple[str, str], list] = {p: [] for p in pairs}
+        if not pairs:
+            return {}
+        for name in queries:
+            first_model, first_ns = pairs[0]
+            params = {PARAM_MODEL_ID: first_model,
+                      PARAM_NAMESPACE: first_ns}
+            plan = self._grouped_plan(name, params)
+            if plan is None:
+                continue
+            template, _, _, has_ns, gq, key = plan
+            if any(p not in params for p in template.params):
+                continue
+            versions = self._fp_versions(key, name, gq, params, has_ns)
+            if versions is None:
+                continue
+            book = self._book
+            for pair in pairs:
+                model, ns = pair
+                slice_key = (model, ns if has_ns else "")
+                version = versions.get(slice_key)
+                if version is None:
+                    # Absent slice = empty digest; written back so later
+                    # models and later memo-reusing ticks pay a dict hit.
+                    version = book.version_for(key, slice_key,
+                                               _EMPTY_DIGEST)
+                    versions[slice_key] = version
+                out[pair].append((name, version))
+        return {p: tuple(parts) for p, parts in out.items()}
+
     def _demuxed(self, key, name: str, gq: GroupedQuery,
                  params: dict[str, str], has_ns: bool):
-        """Memoized fleet-wide execution + demux for one (template, extras)
-        this tick. Concurrent callers for the same key wait on a latch
-        instead of issuing duplicate backend queries."""
+        """Memoized fleet-wide execution + demux for one (template,
+        extras, scope) this tick. Concurrent callers for the same key wait
+        on a latch instead of issuing duplicate backend queries."""
         return self._once.get_or_compute(
-            key, lambda: self._execute(name, gq, params, has_ns))
+            key, lambda: self._execute(name, gq, params, has_ns, key=key))
+
+    def _fp_versions(self, key, name: str, gq: GroupedQuery,
+                     params: dict[str, str], has_ns: bool):
+        """Fingerprint-tier access to this tick's slice versions: serves
+        from the fingerprint-grade execution memo (value-version gate)
+        when possible — the memoized versions are then current even
+        though timestamps may not be, which the timestamp-free
+        fingerprint never reads. Falls through to the full (collection-
+        grade) execution otherwise. Returns the versions map or None
+        when the execution failed / the book is off."""
+        fp_key = ("fp",) + key
+
+        def compute():
+            book = self._book
+            if book is not None and gq.metric_names:
+                write_v = self._source.backend_write_version(
+                    gq.metric_names)
+                value_v = self._source.backend_value_version(
+                    gq.metric_names)
+                memo = book.reusable_fp(key, write_v, value_v,
+                                        self._source.clock.now())
+                if memo is not None:
+                    return memo.versions
+            demuxed = self._demuxed(key, name, gq, params, has_ns)
+            if demuxed is None:
+                return None
+            return demuxed.get("__versions__")
+
+        return self._once.get_or_compute(fp_key, compute)
 
     def _execute(self, name: str, gq: GroupedQuery, params: dict[str, str],
-                 has_ns: bool):
+                 has_ns: bool, key: tuple | None = None,
+                 organic: bool = True):
         collected_at = self._source.clock.now()
+        book = self._book if key is not None else None
+        write_version = value_version = None
+        if book is not None and gq.metric_names:
+            # Captured BEFORE evaluation: a write racing the query makes
+            # the memo conservatively stale (re-executes next tick), never
+            # silently fresh.
+            write_version = self._source.backend_write_version(
+                gq.metric_names)
+            value_version = self._source.backend_value_version(
+                gq.metric_names)
+            memo = book.reusable(key, write_version, collected_at)
+            if memo is not None:
+                # Provably byte-identical evaluation (no writes/drops to
+                # the query's metrics, no sample left its window): skip
+                # the backend query, re-emit the memoized slices under a
+                # fresh collected_at.
+                return self._emit_demuxed(name, params, has_ns,
+                                          memo.slices, collected_at,
+                                          versions=memo.versions, key=key,
+                                          organic=organic)
         try:
-            points = self._source.execute_grouped(name, gq.promql)
+            points, meta = self._source.execute_grouped_tracked(
+                name, gq.promql)
         except Exception as e:  # noqa: BLE001 — grouped failure falls back
             log.debug("grouped query %s failed (%s); falling back to "
                       "per-model collection", name, e)
+            if book is not None:
+                book.forget_execution(key)
             # Only DETERMINISTIC rejections (the backend executed or
             # parsed the query and said no) pin the template per-model for
             # the retry window. A transient transport blip must fall back
@@ -399,7 +817,34 @@ class GroupedMetricsView(MetricsSource):
                 self._source.note_grouped_rejection(name, e)
             return None
         slices = demux_points(gq, points, self._source.make_metric_value)
+        versions = None
+        if book is not None:
+            # Stamp slice digests in the same pass that already walked
+            # every slice; a version bumps only when its digest moved.
+            versions = book.stamp(key, {
+                slice_key: _slice_digest(values)
+                for slice_key, values in slices.items()})
+            if (write_version is not None and value_version is not None
+                    and meta is not None):
+                book.note_execution(key, _ExecMemo(
+                    write_version=write_version,
+                    value_version=value_version,
+                    expiry_strict=meta.expiry_strict,
+                    expiry_b=meta.expiry_b,
+                    uniform=meta.uniform,
+                    slices=dict(slices), versions=versions))
+        return self._emit_demuxed(name, params, has_ns, slices,
+                                  collected_at, versions=versions, key=key,
+                                  organic=organic)
+
+    def _emit_demuxed(self, name: str, params: dict[str, str], has_ns: bool,
+                      slices: dict, collected_at: float, versions=None,
+                      key: tuple | None = None, organic: bool = True):
+        """Build the tick's demuxed map from per-slice value lists and
+        refresh the per-model stale-serve cache entries."""
         demuxed: dict = {"__collected_at__": collected_at}
+        if versions is not None:
+            demuxed["__versions__"] = versions
         for (model, ns), values in slices.items():
             result = MetricResult(query_name=name, values=values,
                                   collected_at=collected_at)
@@ -412,6 +857,17 @@ class GroupedMetricsView(MetricsSource):
             if has_ns:
                 slice_params[PARAM_NAMESPACE] = ns
             self._source.store_demuxed_result(name, slice_params, result)
+        if organic and key is not None:
+            # Remember the grouped spec ONCE per execution (not once per
+            # served model) so the background cache warmer re-executes the
+            # fleet-wide query between ticks. Warmer executions come
+            # through warm_grouped_spec with organic=False and never
+            # renew. The view's versioned flag rides along so a warm pass
+            # replays it — with WVA_FP_DELTA off the warmer must behave
+            # pre-change too (no stamping, no reuse).
+            self._source.remember_grouped_spec(
+                name, dict(key[1]), self._scope_namespace,
+                versioned=self._book is not None)
         return demuxed
 
 
@@ -430,11 +886,14 @@ def _is_deterministic_rejection(e: Exception) -> bool:
 
 
 def warm_grouped_spec(source, name: str, extras: dict[str, str],
-                      scope_namespace: str = "") -> bool:
+                      scope_namespace: str = "",
+                      versioned: bool = True) -> bool:
     """Re-execute one remembered fleet-wide query and refresh every demuxed
     per-model cache slice — the cache warmer's grouped path (with grouped
     collection on, per-model specs never reach the warmer, so without this
-    the stale-serve cache would decay to tick cadence). Returns False when
+    the stale-serve cache would decay to tick cadence). ``versioned``
+    replays the engine view's WVA_FP_DELTA state: with the lever off the
+    warm pass must not touch the version book either. Returns False when
     the template is no longer groupable or the backend failed."""
     template = source.query_list().get(name)
     if template is None:
@@ -442,6 +901,10 @@ def warm_grouped_spec(source, name: str, extras: dict[str, str],
     gq = source.grouped_query_for(name, extras, scope_namespace)
     if gq is None:
         return False
-    view = GroupedMetricsView(source, scope_namespace=scope_namespace)
+    view = GroupedMetricsView(source, scope_namespace=scope_namespace,
+                              versioned=versioned)
     has_ns = PARAM_NAMESPACE in template.params
-    return view._execute(name, gq, dict(extras), has_ns) is not None
+    key = (name, tuple(sorted(extras.items())),  # fp-lint: bounded
+           scope_namespace)                      # (template params)
+    return view._execute(name, gq, dict(extras), has_ns, key=key,
+                         organic=False) is not None

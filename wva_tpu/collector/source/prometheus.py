@@ -57,6 +57,33 @@ class InMemoryPromAPI:
     def query(self, promql: str) -> list[SeriesPoint]:
         return self.engine.query(promql)
 
+    # --- versioned fingerprint plane hooks (docs/design/informer.md) ---
+
+    def write_version(self, names) -> int:
+        """Max TSDB write-version across ``names`` — the grouped view's
+        proof that nothing was written between two executions."""
+        return self.db.name_write_version(names)
+
+    def value_version(self, names) -> int:
+        """Max TSDB value-version across ``names`` (moves only on
+        value-changing appends) — the fingerprint tier's reuse gate."""
+        return self.db.name_value_version(names)
+
+    def query_tracked(self, promql: str):
+        """(points, TrackMeta) — validity metadata bounding how long the
+        result provably stays current without (value-changing) writes.
+        Routed through ``self.query`` so instance-level wrappers (test
+        fault injection) still intercept the evaluation."""
+        self.engine.begin_tracking()
+        try:
+            points = self.query(promql)
+        finally:
+            meta = self.engine.end_tracking()
+        return points, meta
+
+    def lookback_seconds(self) -> float:
+        return self.engine.lookback
+
 
 class _ServerNameContext(ssl.SSLContext):
     """SSLContext that pins the SNI/verification hostname regardless of the
@@ -311,6 +338,13 @@ class PrometheusSource(MetricsSource):
         # the O(templates)-per-tick claim.
         self._qc_mu = threading.Lock()
         self._query_counts: dict[str, int] = {}
+        # Versioned fingerprint plane (WVA_FP_DELTA; docs/design/
+        # informer.md): cross-tick slice digests/versions + write-version-
+        # gated execution memos, stamped by GroupedMetricsView during
+        # demux.
+        from wva_tpu.collector.source.grouped import SliceVersionBook
+
+        self.slice_book = SliceVersionBook()
         # Grouped-rewrite memo ((name, extras) -> GroupedQuery | None) and
         # rejection clock per template name.
         self._grouped_mu = threading.Lock()
@@ -472,15 +506,43 @@ class PrometheusSource(MetricsSource):
         self._note_query(f"grouped:{name}")
         return self.api.query(promql)
 
+    def execute_grouped_tracked(self, name: str, promql: str):
+        """``execute_grouped`` returning ``(points, TrackMeta | None)``:
+        validity metadata for execution reuse (None when the backend
+        cannot track it — HTTP Prometheus)."""
+        self._note_query(f"grouped:{name}")
+        tracked = getattr(self.api, "query_tracked", None)
+        if tracked is not None:
+            return tracked(promql)
+        return self.api.query(promql), None
+
+    def backend_write_version(self, names) -> int | None:
+        """Backend write-version across ``names`` (None = backend cannot
+        prove write-quiescence, e.g. HTTP Prometheus — execution reuse is
+        then disabled and every tick re-queries)."""
+        fn = getattr(self.api, "write_version", None)
+        return None if fn is None else fn(names)
+
+    def backend_value_version(self, names) -> int | None:
+        """Backend value-version across ``names`` (moves only on
+        value-changing appends); None = unsupported backend."""
+        fn = getattr(self.api, "value_version", None)
+        return None if fn is None else fn(names)
+
     def remember_grouped_spec(self, name: str, extras: dict[str, str],
-                              scope_namespace: str = "") -> None:
+                              scope_namespace: str = "",
+                              versioned: bool = True) -> None:
         """Record an organically-served grouped spec for the warmer (true
-        LRU like _remember_spec; bounded by _recent_bound)."""
+        LRU like _remember_spec; bounded by _recent_bound). ``versioned``
+        records whether the serving view ran the fingerprint plane, so
+        warm passes replay the same mode (WVA_FP_DELTA=off must be
+        pre-change on the warmer path too)."""
         key = (name, tuple(sorted(extras.items())), scope_namespace)
         with self._specs_mu:
             self._grouped_specs.pop(key, None)
             self._grouped_specs[key] = (self.clock.now(), name,
-                                        dict(extras), scope_namespace)
+                                        dict(extras), scope_namespace,
+                                        versioned)
             while len(self._grouped_specs) > self._recent_bound:
                 self._grouped_specs.pop(next(iter(self._grouped_specs)))
 
@@ -564,19 +626,19 @@ class PrometheusSource(MetricsSource):
         register their specs."""
         now = self.clock.now()
         live: list[RefreshSpec] = []
-        grouped_live: list[tuple[str, dict, str]] = []
+        grouped_live: list[tuple[str, dict, str, bool]] = []
         with self._specs_mu:
             for key, (seen_at, spec) in list(self._recent_specs.items()):
                 if now - seen_at > self.SPEC_EXPIRY_SECONDS:
                     self._recent_specs.pop(key, None)
                 else:
                     live.append(spec)
-            for key, (seen_at, name, extras, scope) in \
+            for key, (seen_at, name, extras, scope, versioned) in \
                     list(self._grouped_specs.items()):
                 if now - seen_at > self.SPEC_EXPIRY_SECONDS:
                     self._grouped_specs.pop(key, None)
                 else:
-                    grouped_live.append((name, extras, scope))
+                    grouped_live.append((name, extras, scope, versioned))
 
         def warm_one(spec: RefreshSpec) -> None:
             self._warming.active = True
@@ -587,12 +649,13 @@ class PrometheusSource(MetricsSource):
             finally:
                 self._warming.active = False
 
-        def warm_grouped(item: tuple[str, dict, str]) -> None:
+        def warm_grouped(item: tuple[str, dict, str, bool]) -> None:
             from wva_tpu.collector.source.grouped import warm_grouped_spec
 
-            name, extras, scope = item
+            name, extras, scope, versioned = item
             try:
-                warm_grouped_spec(self, name, extras, scope)
+                warm_grouped_spec(self, name, extras, scope,
+                                  versioned=versioned)
             except Exception as e:  # noqa: BLE001 — warming must not crash
                 log.debug("grouped background fetch failed: %s", e)
 

@@ -88,6 +88,28 @@ class SeriesPoint:
     timestamp: float
 
 
+@dataclass
+class TrackMeta:
+    """Validity metadata for one tracked evaluation (``query_tracked``) —
+    the substrate of the grouped view's execution reuse
+    (docs/design/informer.md §versioned-fingerprints).
+
+    ``expiry_strict``: with NO further appends to the involved metrics,
+    the result is byte-identical until this time (earliest point any
+    included sample can leave its range window / instant lookback).
+
+    ``expiry_b`` + ``uniform``: with only value-UNCHANGING appends, the
+    result's VALUES (not timestamps) are identical until ``expiry_b`` —
+    valid only when ``uniform`` (every matched series was included with a
+    uniform window; an excluded or mixed-value series could change the
+    result set without a value-version bump, so it disables this tier).
+    """
+
+    expiry_strict: float = float("inf")
+    expiry_b: float = float("inf")
+    uniform: bool = True
+
+
 class SeriesWindow:
     """Zero-copy view over one series' samples in ``[lo, hi)``.
 
@@ -142,9 +164,16 @@ class _Series:
     ``forecast/history.py`` ``RingColumns`` carries a twin of this layout
     and of ``_trim_locked``'s compaction heuristic (kept separate: its
     trim is per-ring-window on append, ours is store-retention under the
-    stripe locks) — keep changes to the heuristic in sync."""
+    stripe locks) — keep changes to the heuristic in sync.
 
-    __slots__ = ("labels", "ts", "vals", "start", "last_ts")
+    ``write_version`` is the store-wide monotonic stamp of this series'
+    last append — the substrate of the versioned fingerprint plane
+    (docs/design/informer.md §versioned-fingerprints): "no series of
+    metric X stamped since T" plus the evaluation's validity bounds
+    (:class:`TrackMeta`) prove a query over X evaluates identically."""
+
+    __slots__ = ("labels", "ts", "vals", "start", "last_ts",
+                 "write_version")
 
     def __init__(self, labels: dict[str, str]) -> None:
         self.labels = labels
@@ -152,6 +181,20 @@ class _Series:
         self.vals = array("d")
         self.start = 0
         self.last_ts = float("-inf")
+        self.write_version = 0
+
+    def last_value_changed(self, value: float) -> bool:
+        """Would appending ``value`` change this series' latest value?
+        NaN-aware (NaN -> NaN is NOT a change): the per-name
+        value-version must stay put under quiet re-scrapes of the same
+        reading, including a stuck-NaN exporter."""
+        n = len(self.vals)
+        if n == 0:
+            return True
+        prev = self.vals[n - 1]
+        if value != value and prev != prev:
+            return False
+        return value != prev
 
 
 # Compiled-regex matcher cache: the registered query surface reuses a small
@@ -196,6 +239,20 @@ class TimeSeriesDB:
         # real Prometheus resolves selectors through its label index the
         # same way.
         self._by_name: dict[str, dict[tuple, None]] = {}
+        # Per-metric-name write-versions: the store-wide monotonic counter
+        # value of the last append to ANY series of that name (deletes
+        # count too — a dropped series changes what a query can return).
+        # Consumers (the grouped view's fingerprint plane) compare "max
+        # version across the query's metric names" across ticks to prove
+        # nothing was written — O(names) instead of O(series x samples).
+        # _name_value_versions moves ONLY on value-CHANGING appends (and
+        # first appends / drops): a quiet fleet re-scraping the same
+        # readings every tick keeps it still, which is what lets the
+        # fingerprint tier reuse uniform-window evaluations.
+        self._ver_mu = threading.Lock()
+        self._write_counter = 0
+        self._name_versions: dict[str, int] = {}
+        self._name_value_versions: dict[str, int] = {}
         self._last_sweep = float("-inf")
         # Compat levers for `make bench-tick` / `make bench-collect`:
         # - use_name_index=False reproduces the pre-index full-store scan;
@@ -234,15 +291,54 @@ class TimeSeriesDB:
                 # fully-expired series, so one retry recreates it).
                 if self._series.get(key) is not s:
                     continue
+                value_changed = s.last_value_changed(value)
                 s.ts.append(ts)
                 s.vals.append(value)
                 s.last_ts = ts
+                s.write_version = self._bump_name_version(
+                    name, value_changed)
                 self._trim_locked(s, ts)
                 break
         if ts - self._last_sweep >= self.SWEEP_INTERVAL_SECONDS:
             self.sweep(ts)
 
     set_gauge = add_sample  # gauges and counters are both just samples
+
+    def _bump_name_version(self, name: str, value_changed: bool = True
+                           ) -> int:
+        # One store-wide lock for a 3-op critical section (int += and up
+        # to two dict writes). Deliberately NOT striped: the version gate
+        # is an equality compare, and lock-free/striped counters can lose
+        # updates or publish out of order — a consumer could then read an
+        # unchanged version across a real write and reuse a stale
+        # evaluation. Correctness over a ~100ns uncontended lock.
+        with self._ver_mu:
+            self._write_counter += 1
+            self._name_versions[name] = self._write_counter
+            if value_changed:
+                self._name_value_versions[name] = self._write_counter
+            return self._write_counter
+
+    def name_write_version(self, names) -> int:
+        """Max write-version across ``names`` (0 = never written). Two
+        equal reads bracket a window with NO appends/drops to any series
+        of those metrics — the grouped fingerprint plane's evaluation-
+        reuse gate (see :class:`~wva_tpu.collector.source.grouped.
+        SliceVersionBook`)."""
+        with self._ver_mu:
+            return max((self._name_versions.get(n, 0) for n in names),
+                       default=0)
+
+    def name_value_version(self, names) -> int:
+        """Like :meth:`name_write_version` but moved only by
+        value-CHANGING appends (and series creation/drops): quiet
+        re-scrapes of the same readings keep it still, letting the
+        fingerprint tier reuse uniform-window evaluations whose VALUES
+        provably did not move (timestamps may have — which is why only
+        the timestamp-free fingerprint tier may use this gate)."""
+        with self._ver_mu:
+            return max((self._name_value_versions.get(n, 0)
+                        for n in names), default=0)
 
     def _trim_locked(self, s: _Series, now: float) -> None:
         """Advance the live-region start past retention (O(1) amortized —
@@ -311,17 +407,27 @@ class TimeSeriesDB:
         """Remove a series entirely (e.g. pod deleted — Prometheus staleness)."""
         with self._mu:
             key = self._key(name, labels)
-            self._series.pop(key, None)
+            dropped = self._series.pop(key, None)
             keys = self._by_name.get(name)
             if keys is not None:
                 keys.pop(key, None)
                 if not keys:
                     del self._by_name[name]
+        if dropped is not None:
+            # An in-lookback series vanishing changes query results without
+            # any append; the write-version must say so.
+            self._bump_name_version(name)
 
     def matching_series(self, matchers: list[tuple[str, str, str]]):
         """Series whose labels satisfy all (label, op, value) matchers, as
-        ``(labels_copy, SeriesWindow)`` pairs. The windows are zero-copy
-        snapshots; concurrent appends/compactions never mutate them."""
+        ``(labels, SeriesWindow)`` pairs. The windows are zero-copy
+        snapshots; concurrent appends/compactions never mutate them. The
+        label dicts are the STORE's own (never mutated after series
+        creation) handed out by reference — evaluator outputs are
+        read-only by contract, and the per-series dict copy was a
+        measurable slice of fleet-wide queries at scale. Callers that
+        publish labels onward must copy (the HTTP parse path and demux
+        already build their own dicts)."""
         if self.legacy_reads:
             return self._matching_series_legacy(matchers)
         name_val = None
@@ -345,7 +451,7 @@ class TimeSeriesDB:
                 continue
             with self._lock_for(key):
                 window = SeriesWindow(s.ts, s.vals, s.start, len(s.ts))
-            out.append((dict(labels), window))
+            out.append((labels, window))
         return out
 
     def _matching_series_legacy(self, matchers):
@@ -652,6 +758,9 @@ class PromQLEngine:
         self._ast_cache: dict[str, object] = {}
         # Compat lever for `make bench-tick` (see TimeSeriesDB.use_name_index).
         self.cache_asts = True
+        # Per-thread min-included-instant-sample tracking for
+        # query_tracked (the grouped view's execution-reuse expiry bound).
+        self._track = threading.local()
 
     def _parse_cached(self, text: str):
         if not self.cache_asts:
@@ -672,6 +781,78 @@ class PromQLEngine:
         # cost more than evaluating it at fleet scale; parse once per
         # distinct string.
         return self._eval(self._parse_cached(text), now)
+
+    def query_tracked(self, text: str, at: float | None = None
+                      ) -> tuple[list[SeriesPoint], TrackMeta]:
+        """``query`` plus the evaluation's validity metadata (see
+        :class:`TrackMeta`) — how long the result provably stays current
+        without writes (strict) or with only value-unchanging re-scrapes
+        (the fingerprint tier's gate)."""
+        self.begin_tracking()
+        try:
+            points = self.query(text, at)
+        finally:
+            meta = self.end_tracking()
+        return points, meta
+
+    def begin_tracking(self) -> None:
+        """Start validity tracking on this thread (see query_tracked;
+        split out so callers routing through an instance-level ``query``
+        wrapper can still track)."""
+        self._track.meta = TrackMeta()
+        self._track.active = True
+
+    def end_tracking(self) -> TrackMeta:
+        self._track.active = False
+        return getattr(self._track, "meta", None) or TrackMeta()
+
+    def _track_instant(self, ts: float) -> None:
+        """One included instant sample: the result holds until it ages
+        past the lookback (same-value re-appends only extend that, so the
+        bound serves both tiers)."""
+        if not getattr(self._track, "active", False):
+            return
+        meta = self._track.meta
+        expiry = ts + self.lookback
+        if expiry < meta.expiry_strict:
+            meta.expiry_strict = expiry
+        if expiry < meta.expiry_b:
+            meta.expiry_b = expiry
+
+    def _track_excluded(self) -> None:
+        """A matched series was EXCLUDED (empty/thin window, lookback-
+        stale): value-unchanging appends could revive it — changing the
+        result set without a value-version bump — so the uniform tier is
+        off for this evaluation."""
+        if getattr(self._track, "active", False):
+            self._track.meta.uniform = False
+
+    def _track_range(self, func: str, window: "SeriesWindow",
+                     window_len: float) -> None:
+        """One included range window. Range-func results depend only on
+        the in-window SAMPLE SET (the extrapolation math uses sample
+        timestamps, never eval time), so with no appends the result holds
+        until the first sample departs (strict). A uniform window's VALUE
+        additionally survives same-value appends + departures until it
+        thins below the func's minimum sample count (tier b)."""
+        if not getattr(self._track, "active", False):
+            return
+        meta = self._track.meta
+        ts, vals, lo, hi = window.ts, window.vals, window.lo, window.hi
+        strict = ts[lo] + window_len
+        if strict < meta.expiry_strict:
+            meta.expiry_strict = strict
+        if not meta.uniform:
+            return
+        final = vals[hi - 1]
+        for i in range(lo, hi - 1):
+            if vals[i] != final:
+                meta.uniform = False
+                return
+        min_idx = hi - 2 if func in ("rate", "increase") else hi - 1
+        b = ts[max(lo, min_idx)] + window_len
+        if b < meta.expiry_b:
+            meta.expiry_b = b
 
     def _eval(self, node, now: float) -> list[SeriesPoint]:
         if isinstance(node, NumberLiteral):
@@ -707,7 +888,9 @@ class PromQLEngine:
             else:
                 latest = window.latest_at_or_before(now)
             if latest is None or now - latest.timestamp > self.lookback:
+                self._track_excluded()
                 continue
+            self._track_instant(latest.timestamp)
             out.append(SeriesPoint(labels, latest.value, latest.timestamp))
         return out
 
@@ -731,10 +914,13 @@ class PromQLEngine:
             else:
                 in_window = window.range_window(now - window_len, now)
                 if not len(in_window):
+                    self._track_excluded()
                     continue
+                self._track_range(call.func, in_window, window_len)
                 val = _apply_range_func(call.func, in_window, window_len)
                 last_ts = in_window.ts[in_window.hi - 1]
             if val is None:
+                self._track_excluded()
                 continue
             result_labels = {k: v for k, v in labels.items() if k != "__name__"}
             out.append(SeriesPoint(result_labels, val, last_ts))
@@ -744,10 +930,16 @@ class PromQLEngine:
         inputs = self._eval(agg.arg, now)
         if not inputs:
             return []  # Prometheus: aggregation over empty vector is empty
+        # Group keys are the sorted (label, value) item tuples — built
+        # directly from the PRE-sORTED by-label names, so the per-point
+        # dict + sort the old shape paid at fleet scale is gone while the
+        # key (and thus output ordering) stays byte-identical.
+        by_sorted = sorted(agg.by)
         groups: dict[tuple, list[SeriesPoint]] = {}
         for point in inputs:
-            key_labels = {l: point.labels.get(l, "") for l in agg.by}
-            groups.setdefault(tuple(sorted(key_labels.items())), []).append(point)
+            labels = point.labels
+            key = tuple((l, labels.get(l, "")) for l in by_sorted)
+            groups.setdefault(key, []).append(point)
         out = []
         for key, points in sorted(groups.items()):
             values = [p.value for p in points]
@@ -769,8 +961,13 @@ class PromQLEngine:
     def _eval_binop(self, node: BinaryOp, now: float) -> list[SeriesPoint]:
         left = self._eval(node.left, now)
         if node.op == "or":
-            left_ids = {_series_identity(p.labels) for p in left}
             right = self._eval(node.right, now)
+            if not right:
+                # Common registered-template shape: "vllm_metric or
+                # jetstream_metric" where one engine's family is entirely
+                # absent — skip the fleet-sized identity-set build.
+                return left
+            left_ids = {_series_identity(p.labels) for p in left}
             return left + [p for p in right if _series_identity(p.labels) not in left_ids]
         if node.op == "/":
             right = self._eval(node.right, now)

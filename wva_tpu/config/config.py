@@ -75,6 +75,18 @@ class InfrastructureConfig:
     # cannot see (enforcer retention windows, analyzer-internal state).
     # 0 disables the periodic resync.
     resync_ticks: int = 12
+    # Versioned fingerprint plane (WVA_FP_DELTA / wva.fpDelta): the
+    # dirty-set fingerprint is maintained by delta — memoized K8s
+    # components keyed on frozen object versions, informer pod-set
+    # epochs, and slice versions stamped during the grouped demux — so a
+    # quiet tick costs O(changed inputs) instead of O(models x templates
+    # x series). Off restores per-tick recomputation (byte-identical
+    # statuses and trace cycles, same discipline as WVA_ZERO_COPY=off).
+    fp_delta: bool = True
+    # Equivalence cross-check (WVA_FP_ASSERT, default off — tests and
+    # debugging only): compute BOTH fingerprint forms every tick and fail
+    # loudly when their clean/dirty dynamics diverge.
+    fp_assert: bool = False
     # Zero-copy object plane (WVA_ZERO_COPY, default on;
     # docs/design/object-plane.md): store reads return frozen shared
     # objects instead of deep copies. Off restores copy-on-read —
@@ -275,6 +287,14 @@ class Config:
     def resync_ticks(self) -> int:
         with self._mu:
             return max(0, self.infrastructure.resync_ticks)
+
+    def fp_delta_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.fp_delta
+
+    def fp_assert_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.fp_assert
 
     def zero_copy_enabled(self) -> bool:
         with self._mu:

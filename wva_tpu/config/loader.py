@@ -97,6 +97,13 @@ DEFAULTS: dict[str, Any] = {
     "WVA_INCREMENTAL": True,
     # Full re-analysis every Nth tick regardless of fingerprints (0 = off).
     "WVA_RESYNC_TICKS": 12,
+    # Versioned fingerprint plane: delta-maintained dirty-set fingerprints
+    # (slice versions + object-version memos + pod-set epochs). Off
+    # restores per-tick recomputation (byte-identical outputs).
+    "WVA_FP_DELTA": True,
+    # Cross-check versioned vs recomputed fingerprints every tick (tests/
+    # debugging only — pays both costs).
+    "WVA_FP_ASSERT": False,
     # Zero-copy object plane (docs/design/object-plane.md): store reads
     # return frozen shared objects. Off restores deep-copy-on-read
     # (byte-identical decisions; emergency lever).
@@ -206,6 +213,8 @@ def load(flags: Mapping[str, Any] | None = None,
         informer=r.get_bool("WVA_INFORMER"),
         incremental=r.get_bool("WVA_INCREMENTAL"),
         resync_ticks=max(0, r.get_int("WVA_RESYNC_TICKS")),
+        fp_delta=r.get_bool("WVA_FP_DELTA"),
+        fp_assert=r.get_bool("WVA_FP_ASSERT"),
         zero_copy=r.get_bool("WVA_ZERO_COPY"),
     )
     cfg.tls = TLSConfig(

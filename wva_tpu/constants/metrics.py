@@ -120,6 +120,10 @@ WVA_INFORMER_SYNCED = "wva_informer_synced"
 WVA_TICK_MODELS_SKIPPED = "wva_tick_models_skipped"
 # Models analyzed (dirty or resync) this tick.
 WVA_TICK_MODELS_ANALYZED = "wva_tick_models_analyzed"
+# Wall-clock seconds the last engine tick spent per phase
+# (phase="prepare" | "fingerprint" | "analyze" | "apply"): the next hot
+# path must be visible from metrics, not only from `make bench-profile`.
+WVA_TICK_PHASE_SECONDS = "wva_tick_phase_seconds"
 # --- Immutable object plane (docs/design/object-plane.md) ---
 # K8s object copies (objects.clone / thaw) taken during the last engine
 # tick. ~0 on steady-state ticks: reads are zero-copy frozen views, and a
@@ -143,5 +147,6 @@ LABEL_OUTCOME = "outcome"
 LABEL_FORECASTER = "forecaster"
 LABEL_STATE = "state"
 LABEL_TIER = "tier"
+LABEL_PHASE = "phase"
 
 __all__ = [n for n in dir() if n.isupper()]

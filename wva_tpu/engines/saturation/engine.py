@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import math
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
@@ -96,11 +97,13 @@ from wva_tpu.constants import (
     WVA_FORECAST_DEMOTED,
     WVA_FORECAST_ERROR,
     WVA_FORECAST_LEAD_TIME_SECONDS,
+    LABEL_PHASE,
     WVA_INFORMER_AGE_SECONDS,
     WVA_INFORMER_SYNCED,
     WVA_TICK_MODELS_ANALYZED,
     WVA_TICK_MODELS_SKIPPED,
     WVA_TICK_OBJECT_COPIES,
+    WVA_TICK_PHASE_SECONDS,
     WVA_TREND_SERIES_SAMPLES,
     WVA_TREND_SERIES_STALENESS_SECONDS,
 )
@@ -344,6 +347,20 @@ class SaturationEngine:
         # analyze-everything (byte-identical outputs, like WVA_FORECAST=off).
         self.incremental_enabled = True
         self.resync_ticks = DEFAULT_RESYNC_TICKS
+        # Versioned fingerprint plane (WVA_FP_DELTA, default on;
+        # docs/design/informer.md §versioned-fingerprints): the per-model
+        # fingerprint is maintained by DELTA — K8s components are memoized
+        # per (object, freeze.object_version) and re-derived only when the
+        # frozen store instance was replaced, pod components per informer
+        # pod-set epoch, and metric components are SliceVersionBook
+        # versions stamped during the grouped demux — so a quiet tick's
+        # fingerprint costs O(changed inputs), not O(models x templates x
+        # series). Off restores the recomputed path byte-for-byte.
+        self.fp_delta_enabled = True
+        # Equivalence cross-check (WVA_FP_ASSERT, tests/debugging only):
+        # compute BOTH fingerprints every tick and raise when their
+        # equality dynamics diverge.
+        self.fp_assert = False
         self._tick_seq = 0
         # group_key ("model|ns") -> last analyzed fingerprint / the
         # PRE-limiter decisions that analysis produced (deep copies; the
@@ -351,8 +368,28 @@ class SaturationEngine:
         # decisions see current inventory).
         self._fingerprints: dict[str, tuple] = {}
         self._decision_memo: dict[str, list[VariantDecision]] = {}
+        # Delta-fingerprint memos: component tuples re-derived only when
+        # their source changed. VA/target parts key on the frozen store
+        # object's process-monotonic version; per-model pod parts key on
+        # the informer's per-namespace pod-set epoch + selector identity.
+        self._va_part_memo: dict[tuple, tuple[int, tuple]] = {}
+        self._target_part_memo: dict[tuple, tuple[int, tuple, object]] = {}
+        self._pod_parts_memo: dict[str, tuple[int, tuple, tuple]] = {}
+        # Recomputed-path shadow fingerprints (fp_assert mode only).
+        self._fp_shadow: dict[str, tuple] = {}
+        self._shadow_tick: dict[str, tuple | None] = {}
+        # Epoch-gated SLO config sync: ns -> (mutation_epoch, resolved
+        # cfg). An unchanged epoch proves the resolved config is value-
+        # identical, so the per-tick fleet-sized deepcopy + re-adoption
+        # is skipped (at 480 models the profile-list copy alone was a
+        # double-digit share of the quiet tick).
+        self._slo_sync_memo: dict[str, tuple[int, object]] = {}
         # Introspection for tests/bench: analyzed vs skipped last tick.
         self.last_tick_stats: dict[str, int] = {"analyzed": 0, "skipped": 0}
+        # Wall-clock spent per tick phase (wva_tick_phase_seconds): the
+        # next hot path must be visible from metrics, not only from
+        # `make bench-profile`.
+        self.last_tick_phase_seconds: dict[str, float] = {}
         # K8s object copies taken during the last tick (object plane
         # accounting; ~0 at steady state — see wva_tick_object_copies).
         self.last_tick_object_copies = 0
@@ -414,7 +451,8 @@ class SaturationEngine:
             # watch namespace as an equality matcher (shared Prometheus:
             # never aggregate other tenants' series).
             view = GroupedMetricsView(
-                source, scope_namespace=self.config.watch_namespace() or "")
+                source, scope_namespace=self.config.watch_namespace() or "",
+                versioned=self.fp_delta_enabled)
             return self.collector.scoped(view)
         return self.collector
 
@@ -472,6 +510,8 @@ class SaturationEngine:
         # tick (clone/thaw of a Freezable). Steady-state ticks are ~0 —
         # reads are zero-copy frozen views; a copy marks a write site.
         copies_at_start = frz.copy_count()
+        phase_start = time.perf_counter()
+        self._phase_seconds: dict[str, float] = {}
         if self.flight is not None:
             # Retried ticks must not stack duplicate model records into the
             # failed attempt's cycle.
@@ -500,19 +540,29 @@ class SaturationEngine:
         collector = self._tick_collector()
         if collector is not self.collector:
             self.enforcer.metrics_source = collector.source
+        # Snapshot + collector construction, resync probe: the first slice
+        # of the "prepare" phase (the rest — VA listing, grouping — is
+        # accumulated inside _optimize_with).
+        self._phase_seconds["prepare"] = time.perf_counter() - phase_start
         try:
             self._optimize_with(snap, collector)
         finally:
             self.enforcer.metrics_source = None
             copies = frz.copy_count() - copies_at_start
             self.last_tick_object_copies = copies
+            self.last_tick_phase_seconds = dict(self._phase_seconds)
             registry = getattr(self.actuator, "registry", None)
             if registry is not None:
                 registry.set_gauge(WVA_TICK_OBJECT_COPIES, {},
                                    float(copies))
+                for phase in ("prepare", "fingerprint", "analyze", "apply"):
+                    registry.set_gauge(
+                        WVA_TICK_PHASE_SECONDS, {LABEL_PHASE: phase},
+                        round(self._phase_seconds.get(phase, 0.0), 6))
 
     def _optimize_with(self, snap: KubeClient,
                        collector: ReplicaMetricsCollector) -> None:
+        prep_start = time.perf_counter()
         active_vas = variant_utils.active_variant_autoscalings(
             snap, namespace=self.config.watch_namespace() or None)
         if not active_vas:
@@ -542,12 +592,18 @@ class SaturationEngine:
 
         # Dirty-set gate: models whose input fingerprint is unchanged skip
         # prepare->analyze and re-emit the prior cycle's decisions below.
+        fp_start = time.perf_counter()
+        self._phase_seconds["prepare"] = (
+            self._phase_seconds.get("prepare", 0.0)
+            + fp_start - prep_start)
         clean, fingerprints = self._partition_clean(
             model_groups, snap, collector, analyzer_name)
         self._prune_incremental_state(set(model_groups))
         self.last_tick_stats = {
             "analyzed": len(model_groups) - len(clean),
             "skipped": len(clean)}
+        analyze_start = time.perf_counter()
+        self._phase_seconds["fingerprint"] = analyze_start - fp_start
 
         # Analyzer selection by name (reference engine.go:236-254); "slo"
         # reuses the V2 optimizer/enforcer flow with the queueing-model
@@ -563,10 +619,13 @@ class SaturationEngine:
 
         if self.flight is not None:
             self.flight.record_decisions(decisions)
+        apply_start = time.perf_counter()
+        self._phase_seconds["analyze"] = apply_start - analyze_start
         self._apply_decisions(decisions, va_map, snap)
         self._apply_capacity()
         self._emit_trend_metrics(analyzer_name)
         self._emit_control_plane_metrics()
+        self._phase_seconds["apply"] = time.perf_counter() - apply_start
 
     def _emit_trend_metrics(self, analyzer_name: str) -> None:
         """Surface the active analyzer's DemandTrend health (per-key sample
@@ -624,36 +683,96 @@ class SaturationEngine:
             fp_queries = FINGERPRINT_QUERIES_V2
         else:
             fp_queries = FINGERPRINT_QUERIES
-        # Prefetch each namespace's pod shapes ONCE per tick: the snapshot
-        # deep-copies every listed object per call, so a per-model Pod list
-        # would cost O(models x pods) copies — at 48 models / 96 pods that
-        # alone outweighed the analysis being skipped.
-        pods_by_ns: dict[str, list[tuple]] = {}
-        if (self.incremental_enabled
-                and getattr(snap, "covers_kind", lambda k: False)("Pod")):
-            for key in model_groups:
-                ns = model_groups[key][0].metadata.namespace
-                if ns not in pods_by_ns:
-                    pods_by_ns[ns] = [
-                        (pod.metadata.name, pod.metadata.labels,
-                         getattr(pod.status, "phase", ""),
-                         getattr(pod.status, "ready", False),
-                         getattr(pod.status, "pod_ip", ""))
-                        for pod in snap.list("Pod", namespace=ns)]
+        # Tick-lazy pod shapes: listed per namespace only on the FIRST
+        # selector-bearing model that needs them (an eager per-namespace
+        # prefetch paid the walk even for fleets whose scale targets carry
+        # no selectors), and — on the delta path — only when the
+        # informer's pod-set epoch moved since the memoized walk.
+        covers_pod = getattr(snap, "covers_kind", lambda k: False)("Pod")
+        epoch_fn = getattr(self.client, "pod_epoch", None)
+        # Epochs for EVERY namespace are captured up front, BEFORE any
+        # snapshot Pod access: the snapshot fills its whole Pod kind cache
+        # on the FIRST list, so a per-namespace lazy epoch read could pair
+        # a post-event epoch with pre-event shapes for every namespace but
+        # the first — and the memo would then silently stay fresh across a
+        # real pod change. Capturing early is only ever conservative (an
+        # event landing after capture makes next tick re-walk, never skip).
+        tick_epochs: dict[str, int | None] = {}
+        if self.incremental_enabled and callable(epoch_fn):
+            for gkey in model_groups:
+                gns = model_groups[gkey][0].metadata.namespace
+                if gns not in tick_epochs:
+                    tick_epochs[gns] = epoch_fn(gns)
+        tick_shapes: dict[str, list[tuple] | None] = {}
+
+        def pod_epoch(ns: str) -> int | None:
+            return tick_epochs.get(ns)
+
+        def pods_for_ns(ns: str) -> list[tuple] | None:
+            if not covers_pod:
+                return None
+            if ns not in tick_shapes:
+                tick_shapes[ns] = [
+                    (pod.metadata.name, pod.metadata.labels,
+                     getattr(pod.status, "phase", ""),
+                     getattr(pod.status, "ready", False),
+                     getattr(pod.status, "pod_ip", ""))
+                    for pod in snap.list("Pod", namespace=ns)]
+            return tick_shapes[ns]
+
+        use_delta = (self.fp_delta_enabled
+                     and isinstance(getattr(collector, "source", None),
+                                    GroupedMetricsView))
+        # Template-major bulk pass over the fleet's metric versions: each
+        # fingerprint template is resolved once per tick, every model then
+        # pays one dict lookup per template (instead of re-walking
+        # template state per model — measurably super-linear at 480
+        # models). A bulk failure degrades to the per-model path.
+        bulk_metrics: dict | None = None
+        if use_delta and self.incremental_enabled:
+            try:
+                bulk_metrics = collector.source.slice_versions_bulk(
+                    fp_queries,
+                    [(model_groups[key][0].spec.model_id,
+                      model_groups[key][0].metadata.namespace)
+                     for key in model_groups])
+            except Exception as e:  # noqa: BLE001 — degrade per model
+                log.debug("bulk slice versions failed: %s", e)
+                bulk_metrics = None
+        # Scale-to-zero config resolves per NAMESPACE (a deepcopy), not
+        # per model — hoisted out of the per-model loop.
+        s2z_by_ns: dict[str, object] = {}
+
+        def s2z_cfg_for(ns: str):
+            if ns not in s2z_by_ns:
+                s2z_by_ns[ns] = \
+                    self.config.scale_to_zero_config_for_namespace(ns)
+            return s2z_by_ns[ns]
+
+        self._shadow_tick = {}
         for key in sorted(model_groups):
             model_vas = model_groups[key]
             fp = None
             if self.incremental_enabled:
+                pair = (model_vas[0].spec.model_id,
+                        model_vas[0].metadata.namespace)
                 try:
                     fp = self._model_fingerprint(
                         model_vas, snap, collector,
                         queries=fp_queries,
-                        ns_pods=pods_by_ns.get(
-                            model_vas[0].metadata.namespace))
+                        pods_for_ns=pods_for_ns, pod_epoch=pod_epoch,
+                        group_key=key, use_delta=use_delta,
+                        metrics_fp=(bulk_metrics.get(pair)
+                                    if bulk_metrics is not None else None),
+                        s2z_cfg_for=s2z_cfg_for)
                 except Exception as e:  # noqa: BLE001 — a fingerprint
                     # failure must degrade to "dirty", never fail the tick.
                     log.debug("fingerprint failed for %s: %s", key, e)
                     fp = None
+                if use_delta and self.fp_assert:
+                    self._assert_fp_equivalence(
+                        key, fp, model_vas, snap, collector, fp_queries,
+                        pods_for_ns)
             fingerprints[key] = fp
             if (gate_open and fp is not None
                     and key in self._decision_memo
@@ -663,11 +782,40 @@ class SaturationEngine:
                 clean.add(key)
         return clean, fingerprints
 
+    def _assert_fp_equivalence(self, key: str, fp: tuple | None, model_vas,
+                               snap, collector, fp_queries,
+                               pods_for_ns) -> None:
+        """WVA_FP_ASSERT: recompute the legacy fingerprint alongside the
+        versioned one and raise when their equality-vs-last-analyzed
+        dynamics diverge (a missed dirtiness in the delta plane would
+        freeze a model on stale decisions — fail loudly instead)."""
+        try:
+            shadow = self._model_fingerprint(
+                model_vas, snap, collector, queries=fp_queries,
+                pods_for_ns=pods_for_ns, pod_epoch=None,
+                group_key=key, use_delta=False)
+        except Exception:  # noqa: BLE001 — same degrade rule as the gate
+            shadow = None
+        self._shadow_tick[key] = shadow
+        prev_fp = self._fingerprints.get(key)
+        prev_shadow = self._fp_shadow.get(key)
+        if (fp is None or shadow is None
+                or prev_fp is None or prev_shadow is None):
+            return
+        if (fp == prev_fp) != (shadow == prev_shadow):
+            raise AssertionError(
+                f"fingerprint equivalence violated for {key}: versioned "
+                f"{'clean' if fp == prev_fp else 'dirty'} vs recomputed "
+                f"{'clean' if shadow == prev_shadow else 'dirty'}")
+
     def _model_fingerprint(self, model_vas: list[VariantAutoscaling],
                            snap: KubeClient,
                            collector: ReplicaMetricsCollector,
                            queries: tuple[str, ...] = FINGERPRINT_QUERIES,
-                           ns_pods: list[tuple] | None = None,
+                           pods_for_ns=None, pod_epoch=None,
+                           group_key: str = "", use_delta: bool = False,
+                           metrics_fp: tuple | None = None,
+                           s2z_cfg_for=None,
                            ) -> tuple | None:
         """The model's decision inputs as a comparable tuple, or None when
         the metrics plane is not fingerprintable (no grouped view — the
@@ -677,7 +825,15 @@ class SaturationEngine:
         resourceVersion/replica shape, the pod set (when the snapshot
         covers Pods — informer-backed, so the read is free), and the
         tick's demuxed grouped metric slices including the scale-to-zero
-        request count over the namespace's retention window."""
+        request count over the namespace's retention window.
+
+        ``use_delta`` (WVA_FP_DELTA) keeps every component's VALUE
+        identical but derives it incrementally: VA/target parts are
+        memoized per frozen ``object_version`` (an unreplaced store object
+        cannot have changed), per-model pod parts per informer pod-set
+        epoch, and the metrics part records SliceVersionBook versions —
+        which move iff the recomputed digest would — instead of the full
+        value tuples."""
         source = getattr(collector, "source", None)
         if not isinstance(source, GroupedMetricsView):
             return None
@@ -686,12 +842,7 @@ class SaturationEngine:
         parts: list[tuple] = [("epoch", self.config.mutation_epoch())]
         selectors: list[dict] = []
         for va in sorted(model_vas, key=lambda v: v.metadata.name):
-            alloc = va.status.desired_optimized_alloc
-            parts.append((
-                "va", va.metadata.namespace, va.metadata.name,
-                va.metadata.generation,
-                tuple(sorted((va.metadata.labels or {}).items())),
-                alloc.num_replicas, alloc.accelerator))
+            parts.append(self._va_part(va, use_delta))
             ref = va.spec.scale_target_ref
             if not ref.name:
                 continue
@@ -699,41 +850,122 @@ class SaturationEngine:
             if target is None:
                 parts.append(("target-missing", ref.kind, ref.name))
                 continue
-            status = getattr(target, "status", None)
-            parts.append((
-                "target", ref.kind, target.metadata.name,
-                target.metadata.resource_version,
-                getattr(target, "replicas", None),
-                getattr(status, "replicas", None),
-                getattr(status, "ready_replicas", None)))
-            selector = getattr(target, "selector", None)
+            tgt_part, selector = self._target_part(target, ref.kind,
+                                                   use_delta)
+            parts.append(tgt_part)
             if selector:
                 selectors.append(selector)
-        if selectors and ns_pods:
-            # ns_pods is the tick's prefetched (name, labels, phase, ready,
-            # ip) pod shapes for this namespace (one snapshot list per
-            # tick, shared across models).
-            for name, labels, phase, ready, pod_ip in ns_pods:
-                if not any(labels_match(sel, labels) for sel in selectors):
-                    continue
-                parts.append(("pod", name, phase, ready, pod_ip))
+        if selectors:
+            parts.extend(self._pod_parts(group_key, namespace, selectors,
+                                         pods_for_ns, pod_epoch, use_delta))
         params = {PARAM_MODEL_ID: model_id, PARAM_NAMESPACE: namespace}
-        parts.append(("metrics",
-                      source.slice_fingerprint(queries, params)))
+        if metrics_fp is None:
+            metrics_fp = (source.slice_versions(queries, params)
+                          if use_delta
+                          else source.slice_fingerprint(queries, params))
+        parts.append(("metrics", metrics_fp))
         # The enforcer's scale-to-zero trigger is a request count over a
         # retention window SLIDING with time: after traffic stops, the
         # count keeps changing (decaying) with no other input moving, and
         # the model must stay dirty until it reaches zero — otherwise the
         # 0-request transition the enforcer acts on would wait for the
         # periodic resync.
-        s2z_cfg = self.config.scale_to_zero_config_for_namespace(namespace)
+        s2z_cfg = (s2z_cfg_for(namespace) if s2z_cfg_for is not None else
+                   self.config.scale_to_zero_config_for_namespace(namespace))
         if is_scale_to_zero_enabled(s2z_cfg, model_id):
             retention = scale_to_zero_retention_seconds(s2z_cfg, model_id)
-            parts.append(("s2z", source.slice_fingerprint(
-                (QUERY_MODEL_REQUEST_COUNT,),
-                {**params,
-                 PARAM_RETENTION_PERIOD: format_promql_duration(retention)})))
+            s2z_params = {
+                **params,
+                PARAM_RETENTION_PERIOD: format_promql_duration(retention)}
+            parts.append(("s2z", (source.slice_versions(
+                (QUERY_MODEL_REQUEST_COUNT,), s2z_params) if use_delta
+                else source.slice_fingerprint(
+                    (QUERY_MODEL_REQUEST_COUNT,), s2z_params))))
         return tuple(parts)
+
+    def _va_part(self, va: VariantAutoscaling, use_delta: bool) -> tuple:
+        """The VA's fingerprint component, memoized per frozen
+        object_version on the delta path: a store object that was not
+        replaced cannot have changed, so the label sort and tuple build
+        run once per actual write instead of once per tick."""
+        if use_delta:
+            ver = frz.object_version(va)
+            if ver:
+                key = (va.metadata.namespace, va.metadata.name)
+                hit = self._va_part_memo.get(key)
+                if hit is not None and hit[0] == ver:
+                    return hit[1]
+                part = self._va_part_value(va)
+                self._va_part_memo[key] = (ver, part)
+                return part
+        return self._va_part_value(va)
+
+    @staticmethod
+    def _va_part_value(va: VariantAutoscaling) -> tuple:
+        alloc = va.status.desired_optimized_alloc
+        return (
+            "va", va.metadata.namespace, va.metadata.name,
+            va.metadata.generation,
+            tuple(sorted((va.metadata.labels or {}).items())),  # fp-lint:
+            alloc.num_replicas, alloc.accelerator)  # bounded (one VA)
+
+    def _target_part(self, target, kind: str,
+                     use_delta: bool) -> tuple[tuple, object]:
+        """(fingerprint component, selector) for one scale target,
+        memoized per frozen object_version on the delta path."""
+        if use_delta:
+            ver = frz.object_version(target)
+            if ver:
+                key = (target.metadata.namespace, target.metadata.name,
+                       kind)
+                hit = self._target_part_memo.get(key)
+                if hit is not None and hit[0] == ver:
+                    return hit[1], hit[2]
+                part, selector = self._target_part_value(target, kind)
+                self._target_part_memo[key] = (ver, part, selector)
+                return part, selector
+        return self._target_part_value(target, kind)
+
+    @staticmethod
+    def _target_part_value(target, kind: str) -> tuple[tuple, object]:
+        status = getattr(target, "status", None)
+        part = (
+            "target", kind, target.metadata.name,
+            target.metadata.resource_version,
+            getattr(target, "replicas", None),
+            getattr(status, "replicas", None),
+            getattr(status, "ready_replicas", None))
+        return part, getattr(target, "selector", None)
+
+    def _pod_parts(self, group_key: str, namespace: str, selectors,
+                   pods_for_ns, pod_epoch, use_delta: bool) -> tuple:
+        """The model's selector-matched pod components. On the delta path
+        the filtered tuple is memoized per (informer pod-set epoch,
+        selector identity): an unchanged epoch proves the namespace's pod
+        set did not move, so the per-model labels_match walk is skipped
+        entirely — no pod listing, no matching, O(1) per model."""
+        epoch = (pod_epoch(namespace)
+                 if use_delta and pod_epoch is not None else None)
+        sel_key: tuple = ()
+        if epoch is not None:
+            sel_key = tuple(  # fp-lint: bounded (a selector's few labels)
+                tuple(sorted(s.items())) for s in selectors)  # fp-lint: ^
+            hit = self._pod_parts_memo.get(group_key)
+            if hit is not None and hit[0] == epoch and hit[1] == sel_key:
+                return hit[2]
+        shapes = pods_for_ns(namespace) if pods_for_ns is not None else None
+        if shapes is None:
+            return ()  # snapshot does not cover Pods: nothing to memoize
+        out = tuple(
+            ("pod", name, phase, ready, pod_ip)
+            for name, labels, phase, ready, pod_ip in shapes
+            if any(labels_match(sel, labels) for sel in selectors))
+        if epoch is not None:
+            # An EMPTY walk memoizes too (the scale-to-zero steady state:
+            # selector-bearing targets with no pods) — otherwise those
+            # namespaces would re-list Pods every tick forever.
+            self._pod_parts_memo[group_key] = (epoch, sel_key, out)
+        return out
 
     def _route_is_global(self, model_vas: list[VariantAutoscaling],
                          use_slo: bool) -> bool:
@@ -757,11 +989,11 @@ class SaturationEngine:
     def _reemit_memoized(self, group_key: str,
                          model_vas: list[VariantAutoscaling],
                          into: list[VariantDecision]) -> None:
-        """Append deep copies of the model's memoized pre-limiter decisions
-        and record the skip as a trace stage (replay treats re-emitted
-        models like no-record models — their decisions were verified the
-        cycle they were computed)."""
-        cached = [clone(d)
+        """Append isolated copies of the model's memoized pre-limiter
+        decisions and record the skip as a trace stage (replay treats
+        re-emitted models like no-record models — their decisions were
+        verified the cycle they were computed)."""
+        cached = [d.isolated_copy()
                   for d in self._decision_memo.get(group_key, [])]
         into.extend(cached)
         if self.flight is not None:
@@ -782,22 +1014,41 @@ class SaturationEngine:
             # pair with a stale fingerprint later.
             self._decision_memo.pop(group_key, None)
             self._fingerprints.pop(group_key, None)
+            self._fp_shadow.pop(group_key, None)
             return
-        self._decision_memo[group_key] = [clone(d) for d in decisions]
+        self._decision_memo[group_key] = [d.isolated_copy()
+                                          for d in decisions]
         self._fingerprints[group_key] = fp
+        if self.fp_assert:
+            # The shadow baseline follows the same update discipline as
+            # the real fingerprint (only analyzed models move it), so the
+            # equivalence check compares like with like.
+            shadow = self._shadow_tick.get(group_key)
+            if shadow is not None:
+                self._fp_shadow[group_key] = shadow
+            else:
+                self._fp_shadow.pop(group_key, None)
 
     def _invalidate_model(self, group_key: str) -> None:
         """Analysis failed (safety net): force re-analysis next tick."""
         self._decision_memo.pop(group_key, None)
         self._fingerprints.pop(group_key, None)
+        self._fp_shadow.pop(group_key, None)
 
     def _prune_incremental_state(self, active_group_keys: set[str]) -> None:
-        for key in list(self._fingerprints):
-            if key not in active_group_keys:
-                self._fingerprints.pop(key, None)
-        for key in list(self._decision_memo):
-            if key not in active_group_keys:
-                self._decision_memo.pop(key, None)
+        for book in (self._fingerprints, self._decision_memo,
+                     self._fp_shadow, self._pod_parts_memo):
+            for key in list(book):
+                if key not in active_group_keys:
+                    book.pop(key, None)
+        # The per-object component memos are keyed by (ns, name[, kind]),
+        # not group key; bound them against slow leaks from churned
+        # VAs/targets by dropping the excess once they outgrow the live
+        # fleet (2 VAs + 2 targets per model is the common shape).
+        bound = 8 * max(len(active_group_keys), 1) + 64
+        for memo in (self._va_part_memo, self._target_part_memo):
+            if len(memo) > bound:
+                memo.clear()
 
     def _emit_control_plane_metrics(self) -> None:
         """Dirty-set + informer-freshness gauges: operators alerting on
@@ -954,13 +1205,33 @@ class SaturationEngine:
             # Sync profiles once per distinct namespace per tick (not per
             # model), BEFORE the worker fan-out: the per-model resolved
             # config is passed explicitly into analysis below, and workers
-            # must never race a profile-store sync.
+            # must never race a profile-store sync. The fetch+sync is
+            # gated on the config mutation epoch: an unchanged epoch means
+            # the resolved config is value-identical to last tick's, so
+            # re-deep-copying a fleet-sized profile list (and re-adopting
+            # equal profiles into the store) every tick is pure waste. The
+            # memoized cfg object is the one the analyzer already adopted;
+            # decision paths read service classes/targets from it (never
+            # mutated), and the tuner's refinements land on the SAME
+            # adopted profile objects the per-tick re-sync used to keep
+            # anyway — an epoch bump re-fetches a fresh copy either way.
+            epoch = self.config.mutation_epoch()
             for group_key in sorted(model_groups):
                 ns = model_groups[group_key][0].metadata.namespace
                 if ns not in slo_cfg_by_ns:
-                    slo_cfg_by_ns[ns] = self.config.slo_config_for_namespace(ns)
-                    self.slo_analyzer.sync_from_config(
-                        slo_cfg_by_ns[ns], namespace=ns)
+                    hit = self._slo_sync_memo.get(ns)
+                    if hit is not None and hit[0] == epoch:
+                        slo_cfg_by_ns[ns] = hit[1]
+                        continue
+                    cfg = self.config.slo_config_for_namespace(ns)
+                    self.slo_analyzer.sync_from_config(cfg, namespace=ns)
+                    self._slo_sync_memo[ns] = (epoch, cfg)
+                    slo_cfg_by_ns[ns] = cfg
+            # Namespaces whose models all disappeared must not pin a
+            # fleet-sized resolved config forever.
+            for ns in [n for n in self._slo_sync_memo
+                       if n not in slo_cfg_by_ns]:
+                del self._slo_sync_memo[ns]
 
         # Stage 1 — per-model prepare + analyze across the worker pool.
         # V2 runs its full (thread-safe, per-model-keyed) analysis in the

@@ -4,6 +4,7 @@ limiter stages (reference ``internal/interfaces/saturation_analyzer.go:74-243``)
 
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass, field
 
 from wva_tpu.api.v1alpha1 import DEFAULT_VARIANT_COST, CrossVersionObjectReference
@@ -151,3 +152,16 @@ class VariantDecision:
 
     def last_step(self) -> DecisionStep | None:
         return self.decision_steps[-1] if self.decision_steps else None
+
+    def isolated_copy(self) -> "VariantDecision":
+        """Cheap isolation copy for decision memoization/re-emission
+        (the engine's fingerprint-skip heartbeat): everything the
+        pipeline mutates after emission is either a scalar field
+        (rebinds — a shallow copy isolates) or ``decision_steps``
+        (append-only, steps themselves immutable — a fresh list
+        isolates). Nested objects are never mutated in place by any
+        pipeline stage, so sharing them is safe; a deepcopy here cost
+        O(fleet) allocations per quiet tick."""
+        d = copy.copy(self)
+        d.decision_steps = list(self.decision_steps)
+        return d

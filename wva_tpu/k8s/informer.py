@@ -127,6 +127,15 @@ class InformerKubeClient(KubeClient):
         self._buffering: set[str] = set()
         self._buffer: dict[str, list[tuple[str, Any]]] = {}
         self._nudge_listeners: list[NudgeListener] = []
+        # Per-namespace pod-set epoch (versioned fingerprint plane,
+        # docs/design/informer.md §versioned-fingerprints): bumped on
+        # Pod ADDED/DELETED, on material MODIFIED (labels / phase /
+        # readiness / IP — the shape the engine's fingerprint consumes),
+        # and wholesale on a Pod (re)LIST. An unchanged epoch proves the
+        # namespace's pod shapes did not move, so the engine skips its
+        # per-model pod walk entirely on quiet ticks.
+        self._pod_epochs: dict[str, int] = {}
+        self._pod_epoch_counter = 0
         self._started = False
 
     # --- lifecycle ---
@@ -171,6 +180,13 @@ class InformerKubeClient(KubeClient):
                     store.pop(key, None)
                 else:
                     store[key] = obj
+            if kind == "Pod":
+                # A wholesale replacement may have changed any namespace's
+                # pod set: bump every namespace seen before OR after
+                # (conservative over-dirtying; a re-LIST is rare).
+                prev = self._store.get(kind, {})
+                for ns in {k[0] for k in prev} | {k[0] for k in store}:
+                    self._bump_pod_epoch_locked(ns)
             self._buffering.discard(kind)
             self._store[kind] = store
             self._synced.add(kind)
@@ -225,6 +241,13 @@ class InformerKubeClient(KubeClient):
                 store.pop(key, None)
             else:
                 store[key] = obj
+            if kind == "Pod":
+                if event == DELETED:
+                    if prev is not None:
+                        self._bump_pod_epoch_locked(ns)
+                elif prev is None or \
+                        _pod_fp_shape(prev) != _pod_fp_shape(obj):
+                    self._bump_pod_epoch_locked(ns)
             self._last_event[kind] = self.clock.now()
             listeners = list(self._nudge_listeners)
         if listeners and _material_change(kind, event, prev, obj):
@@ -246,14 +269,36 @@ class InformerKubeClient(KubeClient):
         stored = frozen_copy(obj)
         with self._mu:
             if kind in self._synced:
-                self._store.setdefault(kind, {})[
-                    (ns, obj.metadata.name)] = stored
+                store = self._store.setdefault(kind, {})
+                prev = store.get((ns, obj.metadata.name))
+                store[(ns, obj.metadata.name)] = stored
+                if kind == "Pod" and (
+                        prev is None
+                        or _pod_fp_shape(prev) != _pod_fp_shape(stored)):
+                    self._bump_pod_epoch_locked(ns)
 
     def _discard(self, kind: str, namespace: str, name: str) -> None:
         with self._mu:
             store = self._store.get(kind)
             if store is not None:
-                store.pop((namespace or "", name), None)
+                prev = store.pop((namespace or "", name), None)
+                if kind == "Pod" and prev is not None:
+                    self._bump_pod_epoch_locked(namespace or "")
+
+    # --- pod-set epochs (versioned fingerprint plane) ---
+
+    def _bump_pod_epoch_locked(self, namespace: str) -> None:
+        self._pod_epoch_counter += 1
+        self._pod_epochs[namespace or ""] = self._pod_epoch_counter
+
+    def pod_epoch(self, namespace: str) -> int:
+        """Monotonic epoch of the namespace's pod SET AND SHAPES (labels,
+        phase, readiness, IP — exactly what the engine's fingerprint
+        consumes). Equal reads bracket a window with no material pod
+        change, letting the engine reuse its memoized per-model pod
+        components without listing or matching anything."""
+        with self._mu:
+            return self._pod_epochs.get(namespace or "", 0)
 
     # --- nudges (event-driven wake-ups) ---
 
@@ -404,6 +449,16 @@ class InformerKubeClient(KubeClient):
                     "synced": 1.0 if kind in self._synced else 0.0,
                 }
         return out
+
+
+def _pod_fp_shape(o: Any) -> tuple:
+    """The pod surface the engine's dirty-set fingerprint consumes —
+    labels (selector matching) + phase/readiness/IP. Broader than the
+    nudge-worthy shape in ``_material_change`` (label edits can move a
+    pod in or out of a model's selector without being wake-worthy)."""
+    st = getattr(o, "status", None)
+    return (o.metadata.labels, getattr(st, "phase", ""),
+            getattr(st, "ready", False), getattr(st, "pod_ip", ""))
 
 
 def _material_change(kind: str, event: str, prev: Any, obj: Any) -> bool:

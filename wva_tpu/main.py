@@ -406,6 +406,8 @@ def build_manager(
     engine.grouped_collection = config.grouped_collection_enabled()
     engine.incremental_enabled = config.incremental_enabled()
     engine.resync_ticks = config.resync_ticks()
+    engine.fp_delta_enabled = config.fp_delta_enabled()
+    engine.fp_assert = config.fp_assert_enabled()
     if flight is not None:
         engine.optimizer.flight_recorder = flight
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,

@@ -47,6 +47,7 @@ from wva_tpu.constants import (
     WVA_TICK_MODELS_ANALYZED,
     WVA_TICK_MODELS_SKIPPED,
     WVA_TICK_OBJECT_COPIES,
+    WVA_TICK_PHASE_SECONDS,
     WVA_TRACE_DROPPED_TOTAL,
     WVA_TRACE_RECORDS_TOTAL,
     WVA_TRACE_WRITE_SECONDS,
@@ -71,6 +72,9 @@ class MetricsRegistry:
         self.controller_instance = controller_instance
         # Optional TimeSeriesDB mirror (emulation harness / bench).
         self.mirror_tsdb = mirror_tsdb
+        # (name, label key) -> (last mirrored value, at) for the
+        # same-value mirror throttle (see set_gauge).
+        self._mirrored: dict[tuple, tuple[float, float]] = {}
         self._series: dict[str, _Series] = {}
         self._register(WVA_REPLICA_SCALING_TOTAL, "counter",
                        "Total number of replica scaling operations")
@@ -122,6 +126,9 @@ class MetricsRegistry:
         self._register(WVA_TICK_OBJECT_COPIES, "gauge",
                        "K8s object copies (copy-on-write clones) taken "
                        "during the last engine tick; ~0 at steady state")
+        self._register(WVA_TICK_PHASE_SECONDS, "gauge",
+                       "Wall-clock seconds the last engine tick spent per "
+                       "phase (prepare | fingerprint | analyze | apply)")
         self._register(WVA_CAPACITY_SLICES, "gauge",
                        "Whole TPU slices per (variant, state): ready, "
                        "provisioning (in-flight with credible ETA), "
@@ -149,13 +156,38 @@ class MetricsRegistry:
             labels = {**labels, LABEL_CONTROLLER_INSTANCE: self.controller_instance}
         return tuple(sorted(labels.items()))
 
+    # Mirror throttle: a same-valued gauge re-emission refreshes the TSDB
+    # mirror at most this often. Prometheus-side consumers (the emulated
+    # HPA) read instant values with the 5m lookback, so a ≤60s refresh of
+    # an UNCHANGED value is observationally identical — while at fleet
+    # scale the per-tick re-append of every quiet gauge was a measurable
+    # slice of the apply phase. Changed values always mirror immediately.
+    MIRROR_REFRESH_SECONDS = 60.0
+
     def set_gauge(self, name: str, labels: dict[str, str], value: float) -> None:
+        mirror = None
         with self._mu:
             series = self._series[name]
             key = self._key(labels)
             series.values[key] = value
-        if self.mirror_tsdb is not None:
-            self.mirror_tsdb.add_sample(name, dict(key), value)
+            if self.mirror_tsdb is not None:
+                # Throttle bookkeeping under the registry lock (check-
+                # then-act on shared state); the TSDB append itself runs
+                # outside — it has its own locks, and a racing duplicate
+                # append of the same value would be harmless anyway.
+                now = self.mirror_tsdb.clock.now()
+                last = self._mirrored.get((name, key))
+                if (last is None or last[0] != value
+                        or now - last[1] >= self.MIRROR_REFRESH_SECONDS):
+                    if len(self._mirrored) >= 65536:
+                        # Bounded against label churn (deleted variants/
+                        # models): a reset only costs one extra mirror
+                        # append per series.
+                        self._mirrored.clear()
+                    self._mirrored[(name, key)] = (value, now)
+                    mirror = self.mirror_tsdb
+        if mirror is not None:
+            mirror.add_sample(name, dict(key), value)
 
     def inc_counter(self, name: str, labels: dict[str, str], delta: float = 1.0) -> None:
         with self._mu:

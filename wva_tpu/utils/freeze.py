@@ -211,6 +211,30 @@ def object_version(obj: Any) -> int:
     return getattr(obj, _VERSION_ATTR, 0)
 
 
+def memoized_by_version(cache: dict, obj: Any, compute, bound: int = 8192):
+    """Memoize a PURE derivation of a frozen object by its
+    :func:`object_version` (versions are process-unique per freeze, so the
+    version alone is a collision-free key). Unfrozen objects compute
+    directly. The cache resets wholesale at ``bound`` (re-deriving is
+    always correct; the memo is an optimization, never a requirement).
+    Races on the plain dict are benign — concurrent fills agree.
+
+    This is what makes per-tick re-derivations over store-shared objects
+    (fingerprint components, scale-target projections, status material)
+    cost O(changed objects) instead of O(fleet) per tick
+    (docs/design/informer.md §versioned-fingerprints)."""
+    ver = object_version(obj)
+    if not ver:
+        return compute(obj)
+    hit = cache.get(ver)
+    if hit is None:
+        if len(cache) >= bound:
+            cache.clear()
+        hit = compute(obj)
+        cache[ver] = hit
+    return hit
+
+
 def thaw(obj: T) -> T:
     """Fully mutable deep copy of ``obj`` (frozen or not) — the explicit
     copy-on-write step. Counted (see :func:`copy_count`)."""

@@ -70,8 +70,22 @@ def get_scale_target_with_backoff(
     )
 
 
+# scale_target_state is a pure projection of a (usually frozen,
+# store-shared) target object; memoized per freeze version so the per-VA
+# re-projections every tick (fingerprint, emit, variant states) cost a
+# dict hit instead of a dataclass build. Consumers treat the state as
+# read-only (it shares the target's template/selector subtrees already).
+_STATE_MEMO: dict[int, "ScaleTargetState"] = {}
+
+
 def scale_target_state(obj) -> ScaleTargetState:
     """Project any supported target object to the adapter view."""
+    from wva_tpu.utils import freeze as _frz
+
+    return _frz.memoized_by_version(_STATE_MEMO, obj, _scale_target_state)
+
+
+def _scale_target_state(obj) -> ScaleTargetState:
     if isinstance(obj, LeaderWorkerSet):
         return ScaleTargetState(
             kind=LeaderWorkerSet.KIND,

@@ -138,6 +138,11 @@ def update_va_status_with_conflict_refetch(
     return client.update_status(attempt), True
 
 
+# Pure derivation of a (usually frozen, store-shared) VA — memoized per
+# freeze version so per-tick status-material snapshots cost a dict hit.
+_STATUS_MATERIAL_MEMO: dict[int, tuple] = {}
+
+
 def va_status_material(va: VariantAutoscaling) -> tuple:
     """The status fields that justify an API write — everything except
     timestamps (``lastRunTime`` moves every engine tick and
@@ -145,6 +150,13 @@ def va_status_material(va: VariantAutoscaling) -> tuple:
     condition fields here). Writers snapshot this before mutating the
     status and skip the PUT when it is unchanged, so steady-state ticks
     cost zero write requests per VA instead of two."""
+    from wva_tpu.utils import freeze as _frz
+
+    return _frz.memoized_by_version(_STATUS_MATERIAL_MEMO, va,
+                                    _va_status_material)
+
+
+def _va_status_material(va: VariantAutoscaling) -> tuple:
     alloc = va.status.desired_optimized_alloc
     return (
         alloc.accelerator,
