@@ -65,6 +65,7 @@ replay-golden: ## Replay the committed golden decision traces (must be zero diff
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/decision_trace_v1.jsonl
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/forecast_trace_v1.jsonl
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/capacity_trace_v1.jsonl
+	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/health_trace_v1.jsonl
 
 .PHONY: backtest-golden
 backtest-golden: ## Backtest every forecaster on the committed golden forecast trace and gate against the committed report (MAPE + under/over-provision cost; a seasonal forecaster must keep beating the linear baseline).
@@ -79,6 +80,10 @@ bench-forecast: ## Forecast-plane microbench (48 models): batched vs serial fore
 .PHONY: bench-capacity
 bench-capacity: ## Elastic-capacity microbench (48 models, seeded preemption storm): ticks-to-reconverge per preemption + decisions/tick churn; merges detail.capacity into BENCH_LOCAL.json.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --capacity-only
+
+.PHONY: bench-chaos
+bench-chaos: ## Chaos soak (48 models, seeded metrics blackouts / partial responses / 429 storms, health plane on vs off): asserts zero wrong-direction scale events during faults and <=3-tick recovery; merges detail.chaos into BENCH_LOCAL.json.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --chaos-only
 
 .PHONY: verify-deploy-pipeline
 verify-deploy-pipeline: ## Static-check the deploy pipeline (scripts parse, manifests render, Dockerfile paths exist).

@@ -261,6 +261,7 @@ def run_policy(name: str) -> dict:
     first_scale_up = {"t": None}
     ready_at_peak = {"t": None}
     chip_seconds = {"v": 0.0}  # integral of allocated chips, post-warmup
+    last_t = {"v": None}  # previous on_step time: the integral's real dt
 
     def watch(h: EmulationHarness, t: float) -> None:
         reps = h.replicas_of("llama-v5e")
@@ -273,7 +274,12 @@ def run_policy(name: str) -> dict:
         if reps > max_replicas["v"]:
             max_replicas["v"] = reps
         if t >= WARMUP_SECONDS:
-            chip_seconds["v"] += reps * spec.chips_per_replica  # x 1s steps
+            # Integrate over the harness's ACTUAL step size (measured from
+            # consecutive on_step times): a non-default run(dt=...) must
+            # scale chip-seconds, not silently assume 1s steps.
+            dt = t - last_t["v"] if last_t["v"] is not None else 0.0
+            chip_seconds["v"] += reps * spec.chips_per_replica * dt
+        last_t["v"] = t
         ready = h.ready_replicas_of("llama-v5e")
         if ready >= 4 and ready_at_peak["t"] is None and t >= WARMUP_SECONDS:
             ready_at_peak["t"] = t - WARMUP_SECONDS
@@ -1891,6 +1897,200 @@ def capacity_main() -> None:
     }))
 
 
+def chaos_storm_bench(n_models: int = 48, duration: float = 1200.0,
+                      engine_interval: float = 15.0) -> dict:
+    """Chaos soak (``make bench-chaos``): a 48-model fleet under seeded
+    bursty demand with CORRELATED metrics-plane faults (blackouts, partial
+    label-subset responses, 429 error rates, an apiserver storm every 4th
+    burst — ``loadgen.chaos_storm``), run twice over the SAME world seed:
+    input-health plane ON (shipped default) and OFF (pre-change behavior).
+
+    Asserts the do-no-harm acceptance criteria on the ON run:
+
+    - zero wrong-direction scale events: during a blackout or partial
+      window, no variant whose window-start desired was healthy (>= 1)
+      ever has its desired lowered (scale-to-zero included);
+    - bounded recovery: within ``recovery_ticks`` (3) engine ticks of a
+      faulted interval clearing, the health plane reports all-fresh with
+      no active clamps — desired has reconverged to trusted values.
+
+    The OFF run reports the same counters for honest comparison (partial
+    responses are the killer there: a "successful" query missing half the
+    pods halves the computed demand)."""
+    import statistics
+
+    from wva_tpu.config import new_test_config
+    from wva_tpu.constants import WVA_DESIRED_REPLICAS
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        FaultPlan,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        chaos_storm,
+    )
+    from wva_tpu.emulator.faults import (
+        KIND_METRICS_BLACKOUT,
+        KIND_METRICS_PARTIAL,
+    )
+    from wva_tpu.engines import common as engines_common
+
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    profile, windows = chaos_storm(
+        base_rate=2.0, burst_rate=14.0, burst_duration=90.0,
+        mean_gap=130.0, horizon=duration, seed=17,
+        fault_lead=20.0, fault_duration=150.0)
+    guarded = [(w.start, w.end, w.kind) for w in windows
+               if w.kind in (KIND_METRICS_BLACKOUT, KIND_METRICS_PARTIAL)]
+    # Maximal faulted intervals (any metrics fault), for recovery timing.
+    spans: list[list[float]] = []
+    for w in sorted(windows, key=lambda w: w.start):
+        if spans and w.start <= spans[-1][1]:
+            spans[-1][1] = max(spans[-1][1], w.end)
+        else:
+            spans.append([w.start, w.end])
+
+    def run_world(health_on: bool) -> dict:
+        specs = [VariantSpec(
+            name=f"m{i:03d}-v5e", model_id=f"bench/model-{i:03d}",
+            accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+            initial_replicas=1, serving=ServingParams(engine="jetstream"),
+            load=profile,
+            hpa=HPAParams(stabilization_up_seconds=10.0,
+                          stabilization_down_seconds=60.0,
+                          sync_period_seconds=10.0))
+            for i in range(n_models)]
+        harness = EmulationHarness(
+            specs,
+            saturation_config=SaturationScalingConfig(
+                analyzer_name="saturation", enable_limiter=True),
+            config=new_test_config(),
+            nodepools=[("v5e-pool", "v5e", "2x4", n_models * 2)],
+            startup_seconds=30.0, engine_interval=engine_interval,
+            stochastic_seed=20260804,
+            fault_plan=FaultPlan(list(windows), seed=17))
+        engine = harness.manager.engine
+        if not health_on:
+            engine.health = None
+        registry = harness.manager.registry
+        names = [s.name for s in specs]
+        model_of = {s.name: s.model_id for s in specs}
+        prom_api = harness.manager.source_registry.get("prometheus").api
+
+        def fleet_desired() -> dict[str, int]:
+            return {name: int(registry.get(WVA_DESIRED_REPLICAS, {
+                "variant_name": name, "namespace": harness.namespace,
+                "accelerator_type": "v5e-8"}) or 0) for name in names}
+
+        wrong_direction = 0
+        scaled_to_zero = 0
+        window_base: dict[tuple, dict[str, int]] = {}
+        recovery: dict[float, int] = {}
+        pending_recovery: dict[float, int] = {}
+        last = {"desired": {}}
+        orig = harness.manager.engine.optimize
+
+        def in_guarded(t: float) -> tuple | None:
+            for start, end, kind in guarded:
+                if start <= t < end:
+                    return (start, end, kind)
+            return None
+
+        def tick_wrapper():
+            orig()
+            now_rel = harness.clock.now() - harness.start_time
+            desired = fleet_desired()
+            span = in_guarded(now_rel)
+            if span is not None:
+                start, end, kind = span
+                base = window_base.setdefault((start, end),
+                                              dict(last["desired"]))
+                nonlocal wrong_direction, scaled_to_zero
+                for n in names:
+                    if kind == KIND_METRICS_PARTIAL and model_of[n] not in \
+                            getattr(prom_api, "dropped_models", ()):
+                        # Partial windows thin a seeded series subset;
+                        # models whose series all survived see COMPLETE
+                        # fresh data and may legitimately scale down.
+                        continue
+                    if base.get(n, 0) >= 1 and desired[n] < base[n]:
+                        wrong_direction += 1
+                        if desired[n] == 0:
+                            scaled_to_zero += 1
+            for end in list(pending_recovery):
+                pending_recovery[end] += 1
+                health = harness.manager.engine.last_tick_health
+                if not health or not any(health.values()):
+                    recovery[end] = pending_recovery.pop(end)
+            last["desired"] = desired
+
+        def on_step(h, t):
+            for start, end in spans:
+                if end <= t < end + 1.0 and end not in recovery \
+                        and end not in pending_recovery:
+                    pending_recovery[end] = 0
+
+        harness.manager.engine.executor.task = tick_wrapper
+        harness.run(duration, on_step=on_step)
+        injected = dict(getattr(
+            harness.manager.source_registry.get("prometheus").api,
+            "injected", {}))
+        harness.manager.shutdown()
+        engines_common.DecisionCache.clear()
+        while not engines_common.DecisionTrigger.empty():
+            engines_common.DecisionTrigger.get_nowait()
+        ticks = sorted(recovery.values())
+        return {
+            "wrong_direction_events": wrong_direction,
+            "scaled_to_zero_events": scaled_to_zero,
+            "recovery_ticks_per_span": ticks,
+            "recovery_ticks_max": max(ticks) if ticks else 0,
+            "recovery_ticks_p50": (statistics.median(ticks)
+                                   if ticks else None),
+            "recovery_unresolved": len(pending_recovery),
+            "faults_injected": injected,
+        }
+
+    on = run_world(health_on=True)
+    off = run_world(health_on=False)
+    assert on["wrong_direction_events"] == 0, (
+        f"health plane allowed {on['wrong_direction_events']} "
+        "wrong-direction scale events during blackout/partial windows")
+    assert on["scaled_to_zero_events"] == 0
+    assert on["recovery_unresolved"] == 0, "a faulted span never recovered"
+    assert on["recovery_ticks_max"] <= 3, (
+        f"recovery took {on['recovery_ticks_max']} ticks (> 3)")
+    return {
+        "n_models": n_models,
+        "duration_s": duration,
+        "engine_interval_s": engine_interval,
+        "fault_windows": len(windows),
+        "guarded_windows": len(guarded),
+        "health_on": on,
+        "health_off": off,
+    }
+
+
+def chaos_main() -> None:
+    """`make bench-chaos` / `bench.py --chaos-only`: seeded 48-model chaos
+    storm, health plane on vs off, merged into BENCH_LOCAL.json
+    detail.chaos, one JSON line on stdout. Raises when the do-no-harm
+    acceptance criteria fail."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    record = chaos_storm_bench()
+    record["bench_wall_seconds"] = round(time.time() - t0, 1)
+    _merge_bench_local("chaos", record)
+    print(json.dumps({
+        "metric": "chaos_wrong_direction_events_48_models",
+        "value": record["health_on"]["wrong_direction_events"],
+        "unit": "wrong_direction_scale_events_during_faults",
+        "vs_baseline": record["health_off"]["wrong_direction_events"],
+        "detail": record,
+    }))
+
+
 def main() -> None:
     t0 = time.time()
     device_probe = _ensure_healthy_device()
@@ -2052,5 +2252,7 @@ if __name__ == "__main__":
         forecast_main()
     elif "--capacity-only" in sys.argv:
         capacity_main()
+    elif "--chaos-only" in sys.argv:
+        chaos_main()
     else:
         main()

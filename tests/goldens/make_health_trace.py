@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Regenerate the committed golden chaos/health trace
+(``tests/goldens/health_trace_v1.jsonl``).
+
+Run from the repo root (CPU platform, like the test suite):
+
+    JAX_PLATFORMS=cpu python tests/goldens/make_health_trace.py
+
+The scenario is a deliberately HOSTILE world: two Llama variants on v5e-8
+under bursty load with a seeded metrics blackout landing mid-burst and
+outlasting it, then a partial (whole-pod) scrape outage later — the
+input-health plane degrades, freezes, clamps scale-downs
+(``STAGE_HEALTH`` events with clamps), and recovers through the fresh-tick
+hysteresis. The committed trace anchors ``make replay-golden``: the
+recorded clamps must re-apply through the shared ``health.apply`` path to
+ZERO decision diffs (tests/test_health.py).
+
+Regenerate only on a deliberate, reviewed change to the health-gate
+semantics or the trace schema — and say so in the commit message.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TRACE = os.path.join(HERE, "health_trace_v1.jsonl")
+SEED = 20260804
+DURATION = 900.0
+
+
+def main() -> None:
+    from wva_tpu.config import new_test_config
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        FaultPlan,
+        FaultWindow,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        trapezoid,
+    )
+    from wva_tpu.emulator.faults import (
+        KIND_METRICS_BLACKOUT,
+        KIND_METRICS_PARTIAL,
+    )
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    if os.path.exists(TRACE):
+        os.remove(TRACE)  # the recorder appends; regeneration replaces
+
+    # Burst 60..360 at 30 rps (desired climbs well past 1). A partial
+    # (whole-pod) scrape outage lands MID-BURST (150..300): the analyzer
+    # sees half the load and wants to scale down while demand is real —
+    # the coverage-degraded clamp path. Then a blackout covers the burst's
+    # END (360..720): demand collapses while inputs stay frozen-busy, and
+    # the gate freezes/holds through it, releasing via the fresh-tick
+    # hysteresis afterwards.
+    load = trapezoid(base_rate=1.0, peak_rate=30.0, ramp_up=60.0,
+                     hold=240.0, ramp_down=60.0, tail=1e9, delay=60.0)
+    plan = FaultPlan([
+        FaultWindow(kind=KIND_METRICS_PARTIAL, start=150.0, end=300.0,
+                    drop_fraction=0.5),
+        FaultWindow(kind=KIND_METRICS_BLACKOUT, start=360.0, end=720.0),
+    ], seed=SEED)
+
+    specs = [VariantSpec(
+        name=f"g{i}-v5e", model_id=f"golden/model-{i}",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=1, serving=ServingParams(engine="jetstream"),
+        load=load,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=30.0,
+                      sync_period_seconds=5.0))
+        for i in range(2)]
+    harness = EmulationHarness(
+        specs,
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=new_test_config(),
+        nodepools=[("v5e-pool", "v5e", "2x4", 8)],
+        startup_seconds=30.0, engine_interval=15.0,
+        stochastic_seed=SEED, trace_path=TRACE, fault_plan=plan)
+    harness.run(DURATION)
+    harness.manager.shutdown()
+
+    # Sanity: the trace must carry health stages WITH clamps, and replay
+    # to zero diffs, before it is worth committing.
+    import json
+
+    from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+    records = load_trace(TRACE)
+    health_events = [ev for rec in records for ev in rec.get("stages", [])
+                     if ev.get("stage") == "health"]
+    clamps = sum(len(ev.get("clamps") or []) for ev in health_events)
+    states = {s["state"] for ev in health_events
+              for s in ev.get("states", [])}
+    assert health_events, "no health stage events recorded"
+    assert clamps > 0, "no clamps recorded — nothing worth goldening"
+    assert "blackout" in states and "degraded" in states, states
+    report = ReplayEngine(records).replay()
+    assert report.ok, json.dumps(report.to_dict(), indent=1)
+    print(f"wrote {TRACE}: {len(records)} cycles, "
+          f"{len(health_events)} health events, {clamps} clamps, "
+          f"states={sorted(states)}, replay OK")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()

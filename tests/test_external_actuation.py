@@ -98,6 +98,32 @@ class TestAdapterAPIShape:
         assert quantity(3.0) == "3"
         assert quantity(2.5) == "2500m"
 
+    def test_quantity_sub_milli_keeps_precision(self):
+        """Sub-milli non-zero values must not round to "0m": real
+        resource.Quantity accepts decimalExponent forms, and a ratio like
+        4e-4 silently becoming 0 would zero an HPA signal."""
+        from wva_tpu.emulator.external_metrics import parse_quantity_str
+
+        assert quantity(0.0004) != "0m"
+        assert parse_quantity_str(quantity(0.0004)) == 0.0004
+        assert parse_quantity_str(quantity(-3.7e-7)) == -3.7e-7
+
+    def test_quantity_round_trip_property(self):
+        """Seeded property: parse(quantity(v)) is EXACT across magnitudes
+        (integral, milli, and decimal/scientific encodings)."""
+        import random
+
+        from wva_tpu.emulator.external_metrics import parse_quantity_str
+
+        rng = random.Random(20260804)
+        values = [0.0, 1.0, -1.0, 0.001, 0.0005, 1e-9, 123456.789]
+        values += [rng.uniform(-10, 10) * 10 ** rng.randint(-9, 6)
+                   for _ in range(500)]
+        values += [float(rng.randint(-10**6, 10**6)) for _ in range(100)]
+        for v in values:
+            encoded = quantity(v)
+            assert parse_quantity_str(encoded) == v, (v, encoded)
+
     def test_selector_parsing(self):
         assert parse_label_selector("a=1, b==2,") == {"a": "1", "b": "2"}
 

@@ -1,0 +1,430 @@
+"""Chaos fault-injection harness (wva_tpu/emulator/faults.py) +
+resilience of the watch/informer paths under INJECTED faults.
+
+1. **FaultPlan** — window activation, seeded determinism across runs
+   (CRC32-keyed, never process-randomized hash), pod-granular partial
+   drops.
+2. **FaultyPromAPI** — blackout/error raises classify as TRANSIENT for
+   the grouped-collection fallback (no per-model pinning); partial drops
+   whole pods and records affected models; version hooks go dark during
+   fault windows so holey results are never reuse-memoized.
+3. **FaultyKubeClient** — verb gating during apiserver windows.
+4. **Real-socket layer** — FakeAPIServer 503/429 + mid-stream watch
+   drops, FakePrometheusServer 503/partial.
+5. **Satellite**: rest.py watch-reconnect backoff and informer re-LIST
+   convergence exercised through the FAULT PLANE's injected stream drops
+   (previously only hand-rolled failures covered these paths), plus the
+   informer's resync-failure robustness (a storm-failed re-LIST must not
+   fail the tick or wedge event buffering).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from wva_tpu.api import ObjectMeta
+from wva_tpu.collector.source import TimeSeriesDB
+from wva_tpu.emulator.faults import (
+    KIND_API_BLACKOUT,
+    KIND_API_ERRORS,
+    KIND_METRICS_BLACKOUT,
+    KIND_METRICS_ERRORS,
+    KIND_METRICS_PARTIAL,
+    KIND_WATCH_DROP,
+    ChaosError,
+    FaultInjector,
+    FaultPlan,
+    FaultWindow,
+    FaultyKubeClient,
+    FaultyPromAPI,
+)
+from wva_tpu.k8s import Deployment, FakeCluster
+from wva_tpu.k8s.fake_apiserver import FakeAPIServer
+from wva_tpu.k8s.kubeconfig import Credentials
+from wva_tpu.k8s.rest import ApiError, RestKubeClient
+from wva_tpu.utils import FakeClock
+
+
+def _plan(*windows, seed=3):
+    return FaultPlan(list(windows), seed=seed)
+
+
+class TestFaultPlan:
+    def test_window_activation_and_binding(self):
+        plan = _plan(FaultWindow(kind=KIND_METRICS_BLACKOUT,
+                                 start=10.0, end=20.0))
+        assert plan.active(KIND_METRICS_BLACKOUT, 15.0) is not None
+        assert plan.active(KIND_METRICS_BLACKOUT, 20.0) is None
+        assert plan.active(KIND_API_BLACKOUT, 15.0) is None
+        plan.bind(1000.0)
+        assert plan.active(KIND_METRICS_BLACKOUT, 15.0) is None
+        assert plan.active(KIND_METRICS_BLACKOUT, 1015.0) is not None
+
+    def test_chance_is_seed_deterministic(self):
+        w = FaultWindow(kind=KIND_METRICS_ERRORS, start=0, end=10, rate=0.5)
+        a = [_plan(w, seed=9).chance(w, t / 10.0, "q") for t in range(100)]
+        b = [_plan(w, seed=9).chance(w, t / 10.0, "q") for t in range(100)]
+        assert a == b
+        assert 10 < sum(a) < 90  # genuinely probabilistic at rate 0.5
+
+    def test_partial_drops_whole_pods(self):
+        """Scrape-target granularity: one pod loses ALL its series for the
+        whole window; series identity beyond the pod does not matter."""
+        w = FaultWindow(kind=KIND_METRICS_PARTIAL, start=0, end=100,
+                        drop_fraction=0.5)
+        plan = _plan(w)
+        pods = [f"p{i}" for i in range(40)]
+        verdicts = {
+            p: plan.drops_series(w, {"pod": p, "model_name": "m",
+                                     "namespace": "ns"}) for p in pods}
+        assert 5 < sum(verdicts.values()) < 35
+        for p in pods:  # per-metric label variation never changes it
+            assert plan.drops_series(
+                w, {"pod": p, "model_name": "m", "namespace": "ns",
+                    "num_gpu_blocks": "4096"}) == verdicts[p]
+
+
+class TestFaultyPromAPI:
+    def _api(self, *windows, clock=None):
+        from wva_tpu.collector.source import InMemoryPromAPI
+
+        clock = clock or FakeClock(start=0.0)
+        tsdb = TimeSeriesDB(clock=clock)
+        for i in range(12):
+            tsdb.add_sample("vllm:kv_cache_usage_perc",
+                            {"pod": f"p{i}", "namespace": "ns",
+                             "model_name": "m"}, 0.5)
+        return FaultyPromAPI(InMemoryPromAPI(tsdb), _plan(*windows),
+                             clock=clock), clock
+
+    def test_blackout_raises_transient(self):
+        from wva_tpu.collector.source.grouped import (
+            _is_deterministic_rejection,
+        )
+
+        api, clock = self._api(FaultWindow(kind=KIND_METRICS_BLACKOUT,
+                                           start=10.0, end=20.0))
+        assert api.query("vllm:kv_cache_usage_perc")  # pre-window: fine
+        clock.advance(15.0)
+        with pytest.raises(ChaosError) as e:
+            api.query("vllm:kv_cache_usage_perc")
+        # A chaos outage must NOT pin grouped templates per-model.
+        assert not _is_deterministic_rejection(e.value)
+        clock.advance(10.0)
+        assert api.query("vllm:kv_cache_usage_perc")
+
+    def test_partial_drops_and_records_models(self):
+        api, clock = self._api(FaultWindow(kind=KIND_METRICS_PARTIAL,
+                                           start=0.0, end=50.0,
+                                           drop_fraction=0.5))
+        points = api.query("vllm:kv_cache_usage_perc")
+        assert 0 < len(points) < 12
+        assert api.dropped_models == {"m"}
+
+    def test_version_hooks_dark_during_faults(self):
+        api, clock = self._api(FaultWindow(kind=KIND_METRICS_PARTIAL,
+                                           start=10.0, end=20.0))
+        names = ("vllm:kv_cache_usage_perc",)
+        assert api.write_version(names) is not None
+        clock.advance(15.0)
+        assert api.write_version(names) is None
+        assert api.value_version(names) is None
+        # And tracked queries inside a partial window carry no reuse meta.
+        points, meta = api.query_tracked(
+            'vllm:kv_cache_usage_perc{model_name!=""}')
+        assert meta is None
+
+    def test_sequential_flag_keeps_source_deterministic(self):
+        from wva_tpu.collector.source import PrometheusSource
+
+        api, _ = self._api()
+        source = PrometheusSource(api)
+        assert source._concurrent is False
+
+
+class TestFaultyKubeClient:
+    def test_api_blackout_gates_verbs(self):
+        clock = FakeClock(start=0.0)
+        cluster = FakeCluster(clock=clock)
+        cluster.create(Deployment(metadata=ObjectMeta(name="d", namespace="ns"),
+                                  replicas=1))
+        client = FaultyKubeClient(
+            cluster, _plan(FaultWindow(kind=KIND_API_BLACKOUT,
+                                       start=10.0, end=20.0)), clock=clock)
+        assert client.get("Deployment", "ns", "d") is not None
+        assert client.list("Deployment", namespace="ns")
+        clock.advance(15.0)
+        with pytest.raises(ChaosError):
+            client.get("Deployment", "ns", "d")
+        with pytest.raises(ChaosError):
+            client.list("Deployment", namespace="ns")
+        # Non-verb surface (watch registration, clock) passes through.
+        client.watch("Deployment", lambda e, o: None)
+        clock.advance(10.0)
+        assert client.get("Deployment", "ns", "d") is not None
+
+
+class TestInformerResyncRobustness:
+    def test_failed_resync_never_fails_and_keeps_applying_events(self):
+        """A storm-failed re-LIST must not raise out of resync_if_stale,
+        must not wedge the kind in buffering mode (watch events keep
+        landing in the store), and must retry the next call."""
+        from wva_tpu.k8s.informer import InformerKubeClient
+
+        clock = FakeClock(start=0.0)
+        cluster = FakeCluster(clock=clock)
+        cluster.create(Deployment(metadata=ObjectMeta(name="d0",
+                                                      namespace="ns"),
+                                  replicas=1))
+        plan = _plan(FaultWindow(kind=KIND_API_BLACKOUT,
+                                 start=700.0, end=1400.0))
+        faulty = FaultyKubeClient(cluster, plan, clock=clock)
+        informer = InformerKubeClient(faulty, clock=clock).start()
+        assert len(informer.list("Deployment", namespace="ns")) == 1
+
+        clock.advance(800.0)  # past resync AND inside the storm
+        refreshed = informer.resync_if_stale()  # must NOT raise
+        assert "Deployment" not in refreshed
+        # Watch events still apply to the store during the storm.
+        cluster.create(Deployment(metadata=ObjectMeta(name="d1",
+                                                      namespace="ns"),
+                                  replicas=1))
+        names = {d.metadata.name
+                 for d in informer.list("Deployment", namespace="ns")}
+        assert names == {"d0", "d1"}
+
+        clock.advance(700.0)  # storm over; next resync succeeds
+        refreshed = informer.resync_if_stale()
+        assert "Deployment" in refreshed
+        assert len(informer.list("Deployment", namespace="ns")) == 2
+
+    def test_failed_resync_buffered_replay_still_nudges(self):
+        """Events buffered during a FAILED re-LIST must fire the nudge
+        listeners on replay: no successful list exists as an alternative
+        freshness signal, and the capacity plane's Node feed / executor
+        wake-ups would otherwise silently miss the change."""
+        from wva_tpu.k8s.informer import InformerKubeClient
+
+        clock = FakeClock(start=0.0)
+        cluster = FakeCluster(clock=clock)
+        cluster.create(Deployment(metadata=ObjectMeta(name="d0",
+                                                      namespace="ns"),
+                                  replicas=1))
+        plan = _plan(FaultWindow(kind=KIND_API_BLACKOUT,
+                                 start=700.0, end=1400.0))
+        faulty = FaultyKubeClient(cluster, plan, clock=clock)
+        informer = InformerKubeClient(faulty, clock=clock).start()
+        nudged = []
+        informer.add_nudge_listener(
+            lambda kind, event, obj: nudged.append((kind, event,
+                                                    obj.metadata.name)))
+        clock.advance(800.0)  # stale + storming
+
+        # The failed re-LIST leaves the kind buffering; an event arriving
+        # mid-list lands in the buffer and must nudge on the replay.
+        # Simulate the in-flight interleaving deterministically: enter
+        # buffering, deliver the event, then abort like the failure path.
+        with informer._mu:
+            informer._buffering.add("Deployment")
+            informer._buffer.setdefault("Deployment", [])
+        cluster.create(Deployment(metadata=ObjectMeta(name="d1",
+                                                      namespace="ns"),
+                                  replicas=1))
+        assert not nudged  # buffered, not applied yet
+        informer._abort_buffering("Deployment")
+        assert ("Deployment", "ADDED", "d1") in nudged
+        assert {d.metadata.name
+                for d in informer.list("Deployment", namespace="ns")} \
+            == {"d0", "d1"}
+
+
+NS = "inference"
+
+
+def _wait(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestRealSocketFaults:
+    def test_apiserver_injects_503_and_429(self):
+        cluster = FakeCluster()
+        fi = FaultInjector()
+        server = FakeAPIServer(cluster, fault_injector=fi).start()
+        try:
+            client = RestKubeClient(Credentials(server=server.url),
+                                    timeout=5.0)
+            cluster.create(Deployment(
+                metadata=ObjectMeta(name="d", namespace=NS), replicas=1))
+            assert client.get("Deployment", NS, "d") is not None
+            fi.force(KIND_API_ERRORS, status=503)
+            with pytest.raises(ApiError) as e:
+                client.get("Deployment", NS, "d")
+            assert e.value.status == 503
+            fi.force(KIND_API_ERRORS, status=429)
+            with pytest.raises(ApiError) as e:
+                client.get("Deployment", NS, "d")
+            assert e.value.status == 429
+            fi.clear()
+            assert client.get("Deployment", NS, "d") is not None
+        finally:
+            server.shutdown()
+
+    def test_prom_server_injects_faults_and_partials(self):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from wva_tpu.emulator.prom_server import FakePrometheusServer
+
+        tsdb = TimeSeriesDB()
+        for i in range(8):
+            tsdb.add_sample("vllm:kv_cache_usage_perc",
+                            {"pod": f"p{i}", "namespace": NS,
+                             "model_name": "m"}, 0.5)
+        server = FakePrometheusServer(tsdb).start()
+        fi = FaultInjector()
+        server.set_fault_injector(fi)
+        try:
+            url = (server.url + "/api/v1/query?query="
+                   + urllib.parse.quote("vllm:kv_cache_usage_perc"))
+
+            def fetch():
+                with urllib.request.urlopen(url, timeout=5.0) as r:
+                    return _json.loads(r.read())
+
+            assert len(fetch()["data"]["result"]) == 8
+            fi.force(KIND_METRICS_ERRORS, status=503)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                fetch()
+            assert e.value.code == 503
+            fi.clear()
+            fi.plan = _plan(FaultWindow(kind=KIND_METRICS_PARTIAL,
+                                        start=0.0, end=1e12,
+                                        drop_fraction=0.5))
+            fi.force(KIND_METRICS_PARTIAL)
+            assert 0 < len(fetch()["data"]["result"]) < 8
+        finally:
+            server.shutdown()
+
+    def test_watch_drop_storm_backoff_and_relist_convergence(self,
+                                                             monkeypatch):
+        """The satellite: rest.py's reconnect path driven by INJECTED
+        stream drops. During a drop storm the watch thread must back off
+        (bounded reconnect attempts, jittered growth) instead of
+        hammering; once faults clear, the forced re-list's synthetic
+        events converge the handler on everything that changed during the
+        gaps."""
+        from wva_tpu.k8s import rest as rest_mod
+
+        # Fast, bounded backoff so the storm proves growth in test time.
+        monkeypatch.setattr(rest_mod, "WATCH_BACKOFF_INITIAL", 0.05)
+        monkeypatch.setattr(rest_mod, "WATCH_BACKOFF_MAX", 0.4)
+
+        cluster = FakeCluster()
+        fi = FaultInjector()
+        server = FakeAPIServer(cluster, fault_injector=fi).start()
+        client = RestKubeClient(Credentials(server=server.url), timeout=5.0)
+        seen: dict[str, str] = {}
+        lock = threading.Lock()
+
+        def handler(event, obj):
+            with lock:
+                seen[obj.metadata.name] = event
+
+        try:
+            client.watch("Deployment", handler)
+            # Let the stream register its server-side handler: an empty
+            # cluster lists at resourceVersion 0, so events landing before
+            # registration would fall in the initial gap by design.
+            assert _wait(lambda: any(
+                verb == "watch"
+                for (verb, kind) in server.request_counts()))
+            time.sleep(0.2)
+            cluster.create(Deployment(
+                metadata=ObjectMeta(name="d0", namespace=NS), replicas=1))
+            assert _wait(lambda: "d0" in seen)
+
+            # Storm: every active stream is dropped UNCLEANLY, immediately.
+            fi.force(KIND_WATCH_DROP)
+            server.reset_request_counts()
+            time.sleep(1.5)
+            watch_attempts = sum(
+                n for (verb, kind), n in server.request_counts().items()
+                if verb == "watch" and kind == "Deployment")
+            # 1.5s of instant drops with growing jittered backoff from
+            # 0.05s (cap 0.4s): attempts stay bounded — without backoff
+            # this would be hundreds.
+            assert 1 <= watch_attempts <= 20, watch_attempts
+
+            # A mutation lands while the stream is down (dropped streams
+            # mean the event may fall in a gap).
+            cluster.create(Deployment(
+                metadata=ObjectMeta(name="d1", namespace=NS), replicas=1))
+            fi.clear()
+            # Convergence via the forced re-list's synthetic ADDED.
+            assert _wait(lambda: "d1" in seen, timeout=15.0), seen
+        finally:
+            client.stop()
+            server.shutdown()
+
+    def test_informer_over_rest_converges_through_drop_storm(self,
+                                                             monkeypatch):
+        """Informer-on-REST: injected stream drops + a mid-gap change;
+        the informer's store must converge once the storm clears (re-LIST
+        + synthetic events feed its upsert path)."""
+        from wva_tpu.k8s import rest as rest_mod
+        from wva_tpu.k8s.informer import InformerKubeClient
+
+        monkeypatch.setattr(rest_mod, "WATCH_BACKOFF_INITIAL", 0.05)
+        monkeypatch.setattr(rest_mod, "WATCH_BACKOFF_MAX", 0.3)
+
+        cluster = FakeCluster()
+        fi = FaultInjector()
+        server = FakeAPIServer(cluster, fault_injector=fi).start()
+        client = RestKubeClient(Credentials(server=server.url), timeout=5.0)
+        cluster.create(Deployment(
+            metadata=ObjectMeta(name="d0", namespace=NS), replicas=1))
+        informer = None
+        try:
+            informer = InformerKubeClient(client, clock=FakeClock(
+                start=0.0)).start()
+            assert len(informer.list("Deployment", namespace=NS)) == 1
+            fi.force(KIND_WATCH_DROP)
+            time.sleep(0.3)
+            cluster.create(Deployment(
+                metadata=ObjectMeta(name="d1", namespace=NS), replicas=1))
+            fi.clear()
+            assert _wait(
+                lambda: len(informer.list("Deployment", namespace=NS)) == 2,
+                timeout=15.0)
+        finally:
+            client.stop()
+            server.shutdown()
+
+
+class TestChaosStormSchedule:
+    def test_chaos_storm_seeded_and_correlated(self):
+        from wva_tpu.emulator import chaos_storm
+
+        p1, w1 = chaos_storm(base_rate=1.0, burst_rate=10.0,
+                             burst_duration=60.0, mean_gap=120.0,
+                             horizon=1200.0, seed=5)
+        p2, w2 = chaos_storm(base_rate=1.0, burst_rate=10.0,
+                             burst_duration=60.0, mean_gap=120.0,
+                             horizon=1200.0, seed=5)
+        assert [(w.kind, w.start, w.end) for w in w1] \
+            == [(w.kind, w.start, w.end) for w in w2]
+        assert w1, "horizon must produce at least one fault window"
+        ts = [t / 2.0 for t in range(2400)]
+        assert [p1(t) for t in ts] == [p2(t) for t in ts]
+        # Every fault window starts INSIDE a burst (correlation).
+        for w in w1:
+            assert p1(w.start) == 10.0, (w.kind, w.start)

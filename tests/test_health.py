@@ -1,0 +1,672 @@
+"""Input-health plane (docs/design/health.md).
+
+Covers the robustness tentpole end to end: the per-model trust ladder
+(FRESH -> DEGRADED -> BLACKOUT over cached-slice ages, scrape coverage,
+and control-plane staleness) with K-tick fresh hysteresis, the do-no-harm
+decision gate (hold last-known-good under degradation, freeze under
+blackout, hard-forbid scale-to-zero), the ``WVA_HEALTH=off`` byte-identity
+discipline (statuses AND trace cycles, like ``WVA_FORECAST=off``), the
+``InputsHealthy`` status condition + ``wva_input_health`` gauges, the
+``STAGE_HEALTH`` trace stage replaying through the shared
+``health.apply`` path (golden chaos trace at zero diffs), capacity
+release-holds during blackout, forecast-floor withholding, and the tick
+overrun counter."""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+
+import pytest
+
+from wva_tpu.api import ObjectMeta, VariantAutoscaling, VariantAutoscalingSpec
+from wva_tpu.api.v1alpha1 import (
+    CrossVersionObjectReference,
+    REASON_INPUTS_BLACKOUT,
+    REASON_INPUTS_DEGRADED,
+    REASON_INPUTS_FRESH,
+    REASON_INPUTS_RECOVERING,
+    TYPE_INPUTS_HEALTHY,
+)
+from wva_tpu.blackbox.schema import STAGE_HEALTH, encode
+from wva_tpu.collector.source import TimeSeriesDB
+from wva_tpu.config import HealthConfig, new_test_config
+from wva_tpu.config.config import TraceConfig
+from wva_tpu.constants import WVA_INPUT_HEALTH, WVA_TICK_OVERRUNS_TOTAL
+from wva_tpu.emulator import (
+    EmulationHarness,
+    FaultPlan,
+    FaultWindow,
+    HPAParams,
+    ServingParams,
+    VariantSpec,
+    constant,
+    trapezoid,
+)
+from wva_tpu.emulator.faults import (
+    KIND_METRICS_BLACKOUT,
+    KIND_METRICS_PARTIAL,
+)
+from wva_tpu.health import (
+    BLACKOUT,
+    DEGRADED,
+    FRESH,
+    InputHealth,
+    InputHealthMonitor,
+    apply_health_clamps,
+)
+from wva_tpu.interfaces import (
+    ACTION_NO_CHANGE,
+    ACTION_SCALE_DOWN,
+    SaturationScalingConfig,
+    VariantDecision,
+)
+from wva_tpu.k8s import (
+    Container,
+    Deployment,
+    DeploymentStatus,
+    FakeCluster,
+    Pod,
+    PodStatus,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from wva_tpu.main import build_manager
+from wva_tpu.utils import FakeClock
+
+NS = "inf"
+
+
+# --- monitor: the trust ladder ---
+
+
+def test_ladder_age_thresholds():
+    mon = InputHealthMonitor(degraded_after=120.0, freeze_after=300.0)
+    h = mon.observe("m|ns", now=1000.0, metrics_age=0.0)
+    assert (h.state, h.allow_scale_down) == (FRESH, True)
+    # Age accrues from the last good observation, not per-call input.
+    h = mon.observe("m|ns", now=1130.0, metrics_age=None)
+    assert h.state == DEGRADED and not h.allow_scale_down
+    h = mon.observe("m|ns", now=1400.0, metrics_age=None)
+    assert h.state == BLACKOUT
+    assert h.age_seconds == pytest.approx(400.0)
+
+
+def test_fresh_observation_resets_age():
+    mon = InputHealthMonitor(degraded_after=120.0, freeze_after=300.0)
+    mon.observe("m|ns", now=0.0, metrics_age=0.0)
+    mon.observe("m|ns", now=200.0, metrics_age=None)  # degraded
+    h = mon.observe("m|ns", now=230.0, metrics_age=5.0)
+    assert h.state == FRESH
+    assert h.age_seconds == pytest.approx(5.0)
+
+
+def test_recovery_hysteresis_holds_k_ticks():
+    """After any degradation, scale-down stays forbidden until
+    recovery_ticks CONSECUTIVE fresh observations."""
+    mon = InputHealthMonitor(degraded_after=60.0, recovery_ticks=3)
+    mon.observe("m|ns", now=0.0, metrics_age=0.0)
+    mon.observe("m|ns", now=100.0, metrics_age=None)  # degraded
+    states = [mon.observe("m|ns", now=100.0 + 15 * i, metrics_age=0.0)
+              for i in range(1, 5)]
+    assert [s.allow_scale_down for s in states] == [False, False, True, True]
+    assert all(s.state == FRESH for s in states)
+    # A relapse mid-recovery resets the streak.
+    mon.observe("m|ns", now=300.0, metrics_age=None)
+    h = mon.observe("m|ns", now=400.0, metrics_age=0.0)
+    assert h.state == FRESH and not h.allow_scale_down
+
+
+def test_never_unhealthy_model_allows_scale_down_immediately():
+    mon = InputHealthMonitor(recovery_ticks=3)
+    h = mon.observe("m|ns", now=0.0, metrics_age=0.0)
+    assert h.allow_scale_down  # no hysteresis without a prior episode
+
+
+def test_coverage_shortfall_degrades_even_when_fresh():
+    """A 'successful' partial response (ages fine, pods missing) must
+    classify DEGRADED: the analyzer would read the hidden load as absent."""
+    mon = InputHealthMonitor()
+    h = mon.observe("m|ns", now=0.0, metrics_age=0.0, scraped=2, ready=2)
+    assert h.state == FRESH
+    h = mon.observe("m|ns", now=15.0, metrics_age=0.0, scraped=1, ready=2)
+    assert h.state == DEGRADED and "coverage" in h.reason
+    # Legit scale-down: ready shrinks with (or before) the scrape set.
+    h = mon.observe("m|ns", now=120.0, metrics_age=0.0, scraped=1, ready=1)
+    assert h.state == FRESH
+
+
+def test_coverage_scrape_lag_on_scale_up_is_not_degraded():
+    """Real Prometheus: a just-ready pod's series lag a scrape interval.
+    ready growing past scraped for ONE tick (nothing dropped) must stay
+    FRESH; a persisting shortfall classifies on the second tick."""
+    mon = InputHealthMonitor()
+    mon.observe("m|ns", now=0.0, metrics_age=0.0, scraped=4, ready=4)
+    h = mon.observe("m|ns", now=15.0, metrics_age=0.0, scraped=4, ready=5)
+    assert h.state == FRESH  # scale-up scrape lag, not a fault
+    h = mon.observe("m|ns", now=30.0, metrics_age=0.0, scraped=5, ready=5)
+    assert h.state == FRESH
+    # Persisting shortfall (series never appeared) flags on tick 2.
+    mon.observe("m|ns", now=45.0, metrics_age=0.0, scraped=5, ready=6)
+    h = mon.observe("m|ns", now=60.0, metrics_age=0.0, scraped=5, ready=6)
+    assert h.state == DEGRADED
+
+
+def test_gate_out_of_band_scale_up_is_never_reverted():
+    """An operator raising replicas during a blackout (current > held)
+    must not be scaled back down by the frozen last-known-good — the gate
+    floors at max(held, current) in every untrusted state."""
+    mon = InputHealthMonitor()
+    blackout = InputHealth(state=BLACKOUT, allow_scale_down=False)
+    assert mon.gate_target(blackout, target=1, current=4, held=1) == 4
+    degraded = InputHealth(state=DEGRADED, allow_scale_down=False)
+    assert mon.gate_target(degraded, target=1, current=4, held=1) == 4
+
+
+def test_unknown_age_on_first_sight_is_fresh():
+    """Controller restart into an outage (empty cache): no age basis —
+    never invent an infinite outage."""
+    mon = InputHealthMonitor()
+    h = mon.observe("m|ns", now=5000.0, metrics_age=None)
+    assert h.state == FRESH
+
+
+def test_control_plane_staleness_participates():
+    mon = InputHealthMonitor(degraded_after=120.0)
+    h = mon.observe("m|ns", now=0.0, metrics_age=0.0, control_age=150.0)
+    assert h.state == DEGRADED
+
+
+# --- monitor: the gate ---
+
+
+def test_gate_degraded_holds_lkg_but_allows_scale_up():
+    mon = InputHealthMonitor()
+    h = InputHealth(state=DEGRADED, allow_scale_down=False)
+    assert mon.gate_target(h, target=1, current=3, held=3) == 3  # held
+    assert mon.gate_target(h, target=5, current=3, held=3) == 5  # up OK
+    # No LKG recorded: current replicas are the floor.
+    assert mon.gate_target(h, target=0, current=2, held=None) == 2
+
+
+def test_gate_blackout_freezes_and_forbids_zero():
+    mon = InputHealthMonitor()
+    h = InputHealth(state=BLACKOUT, allow_scale_down=False)
+    assert mon.gate_target(h, target=1, current=3, held=4) == 4  # frozen
+    assert mon.gate_target(h, target=9, current=3, held=4) == 4  # up frozen
+    assert mon.gate_target(h, target=0, current=3, held=None) == 3
+    # A model already at zero stays at zero (no phantom wake).
+    assert mon.gate_target(h, target=0, current=0, held=0) == 0
+
+
+def test_gate_recovery_window_holds_like_degraded():
+    mon = InputHealthMonitor()
+    h = InputHealth(state=FRESH, allow_scale_down=False)
+    assert mon.gate_target(h, target=1, current=3, held=3) == 3
+    assert mon.gate_target(h, target=4, current=3, held=3) == 4
+
+
+def test_note_emitted_tracks_lkg_except_blackout():
+    mon = InputHealthMonitor()
+    mon.note_emitted(NS, "v", 3, FRESH)
+    assert mon.held_desired(NS, "v") == 3
+    mon.note_emitted(NS, "v", 5, DEGRADED)  # allowed scale-up raises LKG
+    assert mon.held_desired(NS, "v") == 5
+    mon.note_emitted(NS, "v", 1, BLACKOUT)  # frozen ticks never move it
+    assert mon.held_desired(NS, "v") == 5
+    mon.prune(set(), set())
+    assert mon.held_desired(NS, "v") is None
+
+
+def test_apply_health_clamps_rewrites_decision():
+    d = VariantDecision(variant_name="v", namespace=NS, model_id="m",
+                        current_replicas=3, target_replicas=1,
+                        action=ACTION_SCALE_DOWN)
+    changed = apply_health_clamps([d], [{
+        "variant_name": "v", "namespace": NS, "target_replicas": 3,
+        "state": DEGRADED, "reason": "input health degraded"}], now=7.0)
+    assert changed == 1
+    assert d.target_replicas == 3
+    assert d.action == ACTION_NO_CHANGE
+    assert d.decision_steps[-1].name == "health"
+    # Idempotent when the target already matches.
+    assert apply_health_clamps([d], [{
+        "variant_name": "v", "namespace": NS, "target_replicas": 3,
+        "state": DEGRADED, "reason": "x"}], now=8.0) == 0
+
+
+# --- source: the age probe ---
+
+
+def test_slice_age_grows_through_stale_serve():
+    from wva_tpu.collector.source import (
+        InMemoryPromAPI,
+        PrometheusSource,
+        SourceRegistry,
+    )
+    from wva_tpu.collector.registration import register_saturation_queries
+    from wva_tpu.collector.registration.saturation import QUERY_KV_CACHE_USAGE
+    from wva_tpu.collector.source.source import RefreshSpec
+
+    clock = FakeClock(start=1000.0)
+    tsdb = TimeSeriesDB(clock=clock)
+    tsdb.add_sample("vllm:kv_cache_usage_perc",
+                    {"pod": "p0", "namespace": NS, "model_name": "m"}, 0.5)
+
+    class FlakyAPI:
+        def __init__(self, inner):
+            self.inner, self.fail = inner, False
+
+        def query(self, promql):
+            if self.fail:
+                raise ConnectionError("outage")
+            return self.inner.query(promql)
+
+    api = FlakyAPI(InMemoryPromAPI(tsdb))
+    source = PrometheusSource(api, clock=clock, concurrent=False)
+    reg = SourceRegistry()
+    reg.register("prometheus", source)
+    register_saturation_queries(reg)
+    params = {"modelID": "m", "namespace": NS}
+    queries = (QUERY_KV_CACHE_USAGE,)
+    assert source.slice_age_seconds(queries, params) is None  # never seen
+    source.refresh(RefreshSpec(queries=[QUERY_KV_CACHE_USAGE],
+                               params=params))
+    assert source.slice_age_seconds(queries, params) == pytest.approx(0.0)
+    api.fail = True
+    clock.advance(200.0)
+    result = source.refresh(RefreshSpec(queries=[QUERY_KV_CACHE_USAGE],
+                                        params=params))
+    # Stale-served (old data, no re-cache): the age keeps growing.
+    assert result[QUERY_KV_CACHE_USAGE].values
+    assert source.slice_age_seconds(queries, params) == pytest.approx(200.0)
+    api.fail = False
+    source.refresh(RefreshSpec(queries=[QUERY_KV_CACHE_USAGE],
+                               params=params))
+    assert source.slice_age_seconds(queries, params) == pytest.approx(0.0)
+
+
+# --- engine integration: a FakeCluster world (mirrors test_forecast) ---
+
+
+def _health_world(health_enabled: bool, monitor_none: bool = False,
+                  n_models: int = 2):
+    from wva_tpu.engines import common
+
+    common.DecisionCache.clear()
+    while not common.DecisionTrigger.empty():
+        common.DecisionTrigger.get_nowait()
+    clock = FakeClock(start=300_000.0)
+    cluster = FakeCluster(clock=clock)
+    tsdb = TimeSeriesDB(clock=clock)
+    cfg = new_test_config()
+    cfg.update_saturation_config({"default": SaturationScalingConfig(
+        analyzer_name="saturation")})
+    cfg.set_trace(TraceConfig(enabled=True))
+    h_cfg = copy.deepcopy(cfg.health_config())  # thaw the frozen memo
+    h_cfg.enabled = health_enabled
+    cfg.set_health(h_cfg)
+
+    for i in range(n_models):
+        name = f"h{i:02d}-v5e"
+        model = f"org/model-{i:02d}"
+        cluster.create(Deployment(
+            metadata=ObjectMeta(name=name, namespace=NS),
+            replicas=1, selector={"app": name},
+            template=PodTemplateSpec(
+                labels={"app": name},
+                containers=[Container(
+                    name="srv",
+                    args=["--max-num-seqs=256"],
+                    resources=ResourceRequirements(
+                        requests={"google.com/tpu": "8"}))]),
+            status=DeploymentStatus(replicas=1, ready_replicas=1)))
+        cluster.create(VariantAutoscaling(
+            metadata=ObjectMeta(
+                name=name, namespace=NS,
+                labels={"inference.optimization/acceleratorName": "v5e-8"}),
+            spec=VariantAutoscalingSpec(
+                scale_target_ref=CrossVersionObjectReference(name=name),
+                model_id=model, variant_cost="10.0")))
+        cluster.create(Pod(
+            metadata=ObjectMeta(
+                name=f"{name}-0", namespace=NS, labels={"app": name},
+                owner_references=[{"kind": "Deployment", "name": name}]),
+            status=PodStatus(phase="Running", ready=True,
+                             pod_ip=f"10.2.{i}.1")))
+        pod_labels = {"pod": f"{name}-0", "namespace": NS,
+                      "model_name": model}
+        tsdb.add_sample("vllm:kv_cache_usage_perc", pod_labels, 0.4)
+        tsdb.add_sample("vllm:num_requests_waiting", pod_labels, 0)
+        tsdb.add_sample("vllm:cache_config_info",
+                        {**pod_labels, "num_gpu_blocks": "4096",
+                         "block_size": "32"}, 1.0)
+
+    mgr = build_manager(cluster, cfg, clock=clock, tsdb=tsdb)
+    if monitor_none:
+        assert mgr.engine.health is not None
+        mgr.engine.health = None
+    mgr.setup()
+    return mgr, cluster, tsdb, clock
+
+
+def _run_world(mgr, cluster, clock, ticks=4):
+    for _ in range(ticks):
+        mgr.run_once()
+        clock.advance(15.0)
+    mgr.flight_recorder.flush()
+    cycles = mgr.flight_recorder.snapshot()
+    statuses = {va.metadata.name: encode(va.status)
+                for va in cluster.list("VariantAutoscaling", namespace=NS)}
+    mgr.shutdown()
+    return cycles, statuses
+
+
+def test_health_off_is_byte_identical_to_monitor_none():
+    """WVA_HEALTH=off must route to EXACTLY the monitor-less engine:
+    statuses AND trace cycles byte-identical (the WVA_FORECAST=off
+    discipline)."""
+    mgr_a, cl_a, _, ck_a = _health_world(health_enabled=False)
+    assert mgr_a.engine.health is None  # the knob controls wiring
+    cycles_a, statuses_a = _run_world(mgr_a, cl_a, ck_a)
+
+    mgr_b, cl_b, _, ck_b = _health_world(health_enabled=True,
+                                         monitor_none=True)
+    cycles_b, statuses_b = _run_world(mgr_b, cl_b, ck_b)
+
+    dumps = lambda x: json.dumps(x, sort_keys=True)  # noqa: E731
+    assert dumps(statuses_a) == dumps(statuses_b)
+    assert dumps(cycles_a) == dumps(cycles_b)
+    for name, status in statuses_a.items():
+        assert all(c["type"] != TYPE_INPUTS_HEALTHY
+                   for c in status["conditions"]), name
+
+
+def test_health_on_fault_free_world_changes_nothing_but_condition():
+    """In a fault-free world the plane must be a pure observer: decisions
+    and trace cycles identical to off, with only the InputsHealthy=True
+    condition added to statuses — and ZERO health stage events."""
+    mgr_a, cl_a, _, ck_a = _health_world(health_enabled=False)
+    cycles_a, statuses_a = _run_world(mgr_a, cl_a, ck_a)
+    mgr_b, cl_b, _, ck_b = _health_world(health_enabled=True)
+    cycles_b, statuses_b = _run_world(mgr_b, cl_b, ck_b)
+
+    dumps = lambda x: json.dumps(x, sort_keys=True)  # noqa: E731
+    assert dumps(cycles_a) == dumps(cycles_b)  # decisions + stages equal
+    for rec in cycles_b:
+        assert not any(ev.get("stage") == STAGE_HEALTH
+                       for ev in rec.get("stages", []))
+    for name, status in statuses_b.items():
+        conds = {c["type"]: c for c in status["conditions"]}
+        assert conds[TYPE_INPUTS_HEALTHY]["status"] == "True"
+        assert conds[TYPE_INPUTS_HEALTHY]["reason"] == REASON_INPUTS_FRESH
+        # Stripping the new condition recovers the off-world status.
+        stripped = dict(status)
+        stripped["conditions"] = [c for c in status["conditions"]
+                                  if c["type"] != TYPE_INPUTS_HEALTHY]
+        assert dumps(stripped) == dumps(statuses_a[name])
+
+
+def test_health_gauges_emitted_and_swept():
+    mgr, cluster, _, clock = _health_world(health_enabled=True)
+    for _ in range(2):
+        mgr.run_once()
+        clock.advance(15.0)
+    labels = {"model_name": "org/model-01", "namespace": NS,
+              "state": "fresh"}
+    assert mgr.registry.get(WVA_INPUT_HEALTH, labels) == 1.0
+    assert mgr.registry.get(WVA_INPUT_HEALTH,
+                            {**labels, "state": "blackout"}) == 0.0
+    cluster.delete("VariantAutoscaling", NS, "h01-v5e")
+    for _ in range(2):
+        mgr.run_once()
+        clock.advance(15.0)
+    assert mgr.registry.get(WVA_INPUT_HEALTH, labels) is None
+    assert mgr.registry.get(WVA_INPUT_HEALTH, {
+        "model_name": "org/model-00", "namespace": NS,
+        "state": "fresh"}) == 1.0
+    mgr.shutdown()
+
+
+# --- harness integration: injected faults drive the full ladder ---
+
+
+def _chaos_world(windows, load=None, n_models=1, duration=600.0,
+                 trace_path=None, on_step=None, engine_interval=15.0):
+    harness = EmulationHarness(
+        [VariantSpec(
+            name=f"c{i}-v5e", model_id=f"chaos/model-{i}",
+            accelerator="v5e-8", chips_per_replica=8,
+            serving=ServingParams(engine="jetstream"),
+            load=load or constant(3.0),
+            hpa=HPAParams(stabilization_up_seconds=10.0,
+                          stabilization_down_seconds=30.0,
+                          sync_period_seconds=5.0))
+         for i in range(n_models)],
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=new_test_config(),
+        startup_seconds=30.0, engine_interval=engine_interval,
+        trace_path=trace_path,
+        fault_plan=FaultPlan(list(windows), seed=11))
+    harness.run(duration, on_step=on_step)
+    return harness
+
+
+@pytest.mark.slow
+def test_blackout_ladder_condition_and_freeze():
+    """A sustained metrics blackout must walk the model FRESH -> DEGRADED
+    -> BLACKOUT (condition False, reasons in order), freeze desired, and
+    recover through the hysteresis window after the fault clears."""
+    seen = []
+
+    def watch(h, t):
+        if t % 15 == 0:
+            va = h.cluster.get("VariantAutoscaling", h.namespace, "c0-v5e")
+            cond = va.get_condition(TYPE_INPUTS_HEALTHY)
+            if cond is not None:
+                seen.append((t, cond.reason, cond.status))
+
+    harness = _chaos_world(
+        [FaultWindow(kind=KIND_METRICS_BLACKOUT, start=60.0, end=460.0)],
+        duration=600.0, on_step=watch)
+    reasons = [r for _, r, _ in seen]
+    for expected in (REASON_INPUTS_FRESH, REASON_INPUTS_DEGRADED,
+                     REASON_INPUTS_BLACKOUT, REASON_INPUTS_RECOVERING):
+        assert expected in reasons, (expected, sorted(set(reasons)))
+    # Ladder ordering: degraded strictly before blackout, recovery after.
+    assert reasons.index(REASON_INPUTS_DEGRADED) \
+        < reasons.index(REASON_INPUTS_BLACKOUT) \
+        < reasons.index(REASON_INPUTS_RECOVERING)
+    # Statuses during degradation carry status=False.
+    by_reason = {r: s for _, r, s in seen}
+    assert by_reason[REASON_INPUTS_DEGRADED] == "False"
+    assert by_reason[REASON_INPUTS_BLACKOUT] == "False"
+    assert by_reason[REASON_INPUTS_RECOVERING] == "True"
+    # And it ends fresh with scale-downs re-enabled.
+    assert reasons[-1] == REASON_INPUTS_FRESH
+    assert harness.manager.engine.last_tick_health == {
+        "degraded": 0, "blackout": 0, "recovering": 0, "clamped": 0}
+    harness.manager.shutdown()
+
+
+@pytest.mark.slow
+def test_partial_outage_holds_scale_down_and_records_clamps():
+    """A whole-pod partial scrape outage during real load must trigger the
+    coverage DEGRADED state and clamp the induced scale-down; the clamps
+    land in STAGE_HEALTH events that replay to zero diffs."""
+    import tempfile
+
+    from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+    # Busy burst; the partial window drops pod series mid-burst.
+    load = trapezoid(base_rate=1.0, peak_rate=30.0, ramp_up=60.0,
+                     hold=240.0, ramp_down=60.0, tail=1e9, delay=60.0)
+    with tempfile.TemporaryDirectory() as td:
+        trace = os.path.join(td, "chaos.jsonl")
+        desired = []
+
+        def watch(h, t):
+            import wva_tpu.constants as C
+            v = h.manager.registry.get(C.WVA_DESIRED_REPLICAS, {
+                "variant_name": "c0-v5e", "namespace": h.namespace,
+                "accelerator_type": "v5e-8"})
+            desired.append((t, int(v or 0)))
+
+        harness = _chaos_world(
+            [FaultWindow(kind=KIND_METRICS_PARTIAL, start=150.0,
+                         end=300.0, drop_fraction=0.6)],
+            load=load, duration=450.0, trace_path=trace, on_step=watch)
+        harness.manager.shutdown()
+        peak_before = max(v for t, v in desired if t < 150.0)
+        in_window = [v for t, v in desired if 150.0 <= t < 300.0]
+        # Do-no-harm: desired never dropped below its window-entry level
+        # while pods were hidden (it had scaled up by then).
+        entry = next(v for t, v in desired if t >= 150.0)
+        assert peak_before >= 2  # the burst genuinely scaled it up
+        assert min(in_window) >= entry
+
+        records = load_trace(trace)
+        events = [ev for rec in records for ev in rec.get("stages", [])
+                  if ev.get("stage") == STAGE_HEALTH]
+        assert events
+        assert any(s["state"] == DEGRADED for ev in events
+                   for s in ev.get("states", []))
+        report = ReplayEngine(records).replay()
+        assert report.ok, report.to_dict()
+
+
+# --- golden chaos trace ---
+
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "goldens",
+                      "health_trace_v1.jsonl")
+
+
+@pytest.mark.replay
+def test_golden_health_trace_replays_zero_diffs():
+    """The committed chaos trace must replay byte-for-byte: recorded
+    STAGE_HEALTH clamps re-apply through the shared health.apply path, so
+    replay needs no monitor state."""
+    from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+    records = load_trace(GOLDEN)
+    report = ReplayEngine(records).replay()
+    assert report.ok, report.to_dict()
+    assert report.cycles_replayed > 0
+    clamps = states = 0
+    state_set = set()
+    for rec in records:
+        for ev in rec.get("stages", []):
+            if ev.get("stage") == STAGE_HEALTH:
+                clamps += len(ev.get("clamps") or [])
+                states += len(ev.get("states") or [])
+                state_set |= {s["state"] for s in ev.get("states", [])}
+    assert clamps > 0, "golden must contain do-no-harm clamps"
+    assert {DEGRADED, BLACKOUT} <= state_set, state_set
+
+
+# --- plane interplay ---
+
+
+def test_capacity_hold_releases_skips_order_expiry():
+    from wva_tpu.capacity import CapacityManager, NullProvisioner
+    from wva_tpu.capacity.ledger import InFlightRequest
+
+    clock = FakeClock(start=0.0)
+
+    class NoDiscovery:
+        def discover_slices(self):
+            return {}
+
+    mgr = CapacityManager(NoDiscovery(), NullProvisioner(), clock=clock)
+    for rid, variant in (("r1", "v5e-8"), ("r2", "v5p-8")):
+        mgr.ledger.note_request(InFlightRequest(
+            request_id=rid, variant=variant, tier="on_demand", slices=2,
+            chips_per_slice=8, requested_at=0.0, eta=10.0))
+    clock.advance(1000.0)  # far past 1.5x lead: would normally expire
+    # Per-variant hold: the blacked-out model's variant keeps its credit,
+    # the unrelated healthy variant's wedged order still expires.
+    event = mgr.tick(slices={}, hold_releases=frozenset({"v5e-8"}))
+    assert [r["request_id"] for r in event["expired"]] == ["r2"]
+    event = mgr.tick(slices={}, hold_releases=True)  # blunt hold-all
+    assert event["expired"] == []
+    event = mgr.tick(slices={})
+    assert [r["request_id"] for r in event["expired"]] == ["r1"]
+
+
+def test_blackout_withholds_forecast_floors():
+    """_apply_forecast's no-floor set must include blacked-out models."""
+    mgr, _, _, clock = _health_world(health_enabled=True, n_models=1)
+    engine = mgr.engine
+    engine._tick_health = {
+        "org/model-00|inf": InputHealth(state=BLACKOUT,
+                                        allow_scale_down=False)}
+    assert engine._blackout_keys() == {"inf|org/model-00"}
+    mgr.shutdown()
+
+
+def test_disabling_health_clears_stale_condition():
+    """A VA carrying InputsHealthy (written while the plane was on) must
+    have it REMOVED once the plane is disabled — a permanent
+    frozen-on-untrusted-inputs report over a gate that no longer exists
+    would mislead operators and alerts forever."""
+    mgr, cluster, _, clock = _health_world(health_enabled=True, n_models=1)
+    for _ in range(2):
+        mgr.run_once()
+        clock.advance(15.0)
+    va = cluster.get("VariantAutoscaling", NS, "h00-v5e")
+    assert va.get_condition(TYPE_INPUTS_HEALTHY) is not None
+    mgr.engine.health = None  # the WVA_HEALTH=off wiring
+    for _ in range(2):
+        mgr.run_once()
+        clock.advance(15.0)
+    va = cluster.get("VariantAutoscaling", NS, "h00-v5e")
+    assert va.get_condition(TYPE_INPUTS_HEALTHY) is None
+    mgr.shutdown()
+
+
+def test_executor_overrun_counter():
+    from wva_tpu.engines.executor import PollingExecutor
+    from wva_tpu.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    clock = FakeClock(start=0.0)
+
+    def slow_task():
+        import time as _t
+        _t.sleep(0.05)
+
+    ex = PollingExecutor(slow_task, interval=0.01, clock=clock,
+                         name="test-engine")
+    ex.on_overrun = registry.observe_tick_overrun
+    ex.tick()
+    assert registry.get(WVA_TICK_OVERRUNS_TOTAL,
+                        {"engine": "test-engine"}) == 1.0
+    ex.interval = 10.0
+    ex.tick()  # under the interval: no overrun counted
+    assert registry.get(WVA_TICK_OVERRUNS_TOTAL,
+                        {"engine": "test-engine"}) == 1.0
+
+
+def test_health_config_loads_from_env():
+    from wva_tpu.config import load
+
+    cfg = load(env={"PROMETHEUS_BASE_URL": "http://x:9090",
+                    "WVA_HEALTH": "off",
+                    "WVA_HEALTH_DEGRADED_AFTER": "90s",
+                    "WVA_HEALTH_FREEZE_AFTER": "240s",
+                    "WVA_HEALTH_RECOVERY_TICKS": "5"})
+    h = cfg.health_config()
+    assert h.enabled is False
+    assert h.degraded_after_seconds == 90.0
+    assert h.freeze_after_seconds == 240.0
+    assert h.recovery_ticks == 5
+    cfg2 = load(env={"PROMETHEUS_BASE_URL": "http://x:9090"})
+    assert cfg2.health_config().enabled is True
+
+
+def test_health_config_constructor_defaults():
+    h = HealthConfig()
+    assert h.enabled and h.degraded_after_seconds < h.freeze_after_seconds

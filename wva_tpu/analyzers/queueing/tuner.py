@@ -72,6 +72,14 @@ class TunerEnvironment:
     # drags beta to a state that matches idle latency while collapsing the
     # predicted capacity at load.
     occupancy: float = -1.0
+    # Fleet-average KV-cache usage (0-1) at observation time; -1 = unknown.
+    # A FALLBACK idle signal for collectors without slot telemetry (vLLM):
+    # KV usage is a DIFFERENT scale from decode-slot occupancy (one
+    # long-context request can fill half the KV cache at batch 1; hundreds
+    # of short requests can batch heavily at a few percent KV), so it is
+    # only ever compared against its own near-idle threshold
+    # (TunerConfig.min_kv_usage), never against min_occupancy.
+    kv_occupancy: float = -1.0
 
     def valid(self) -> bool:
         vals = [self.lambda_per_min, self.avg_input_tokens,
@@ -129,6 +137,12 @@ class TunerConfig:
     # the batch-dependent terms move predictions by less than the
     # observation noise.
     min_occupancy: float = 0.05
+    # Binary idle gate for the KV-usage FALLBACK signal (slot telemetry
+    # absent): below this the fleet is effectively not decoding and the
+    # observation is uninformative; above it the filter steps — KV usage
+    # carries no batch-size information, so no finer comparison is sound
+    # (a 0.03 KV fleet can be batching 50 short requests per replica).
+    min_kv_usage: float = 0.02
 
 
 @dataclass
@@ -320,6 +334,19 @@ class TunerController:
             log.debug("Tuner skipping (%s, %s, %s): occupancy %.2f below "
                       "identifiability gate %.2f", namespace, model_id,
                       accelerator, env.occupancy, self.config.min_occupancy)
+            return None
+        if (env.occupancy < 0.0
+                and 0.0 <= env.kv_occupancy < self.config.min_kv_usage):
+            # No slot telemetry: KV usage serves only as a binary
+            # idle/non-idle signal against ITS OWN threshold — comparing
+            # it to min_occupancy mis-gated both directions (long-context/
+            # low-batch passed as "busy"; short-request/high-batch was
+            # skipped as "idle" and starved the filter of its most
+            # informative regime).
+            log.debug("Tuner skipping (%s, %s, %s): KV usage %.3f below "
+                      "idle gate %.3f (no slot telemetry)", namespace,
+                      model_id, accelerator, env.kv_occupancy,
+                      self.config.min_kv_usage)
             return None
         profile = self.profiles.get(model_id, accelerator, namespace=namespace)
         if profile is None or not profile.service_parms.valid():

@@ -36,6 +36,11 @@ DEFAULT_VARIANT_COST = 10.0
 TYPE_TARGET_RESOLVED = "TargetResolved"
 TYPE_METRICS_AVAILABLE = "MetricsAvailable"
 TYPE_OPTIMIZATION_READY = "OptimizationReady"
+# Input-health plane (wva_tpu.health, TPU-build addition): whether the
+# decisions in this status were made on trusted inputs. False means the
+# engine is in do-no-harm mode for this model (scale-down held / desired
+# frozen) — the status says so instead of degrading silently.
+TYPE_INPUTS_HEALTHY = "InputsHealthy"
 
 # --- Condition reasons (reference :113-141) ---
 REASON_METRICS_FOUND = "MetricsFound"
@@ -49,6 +54,28 @@ REASON_INVALID_CONFIGURATION = "InvalidConfiguration"
 REASON_SKIPPED_PROCESSING = "SkippedProcessing"
 REASON_TARGET_FOUND = "TargetFound"
 REASON_TARGET_NOT_FOUND = "TargetNotFound"
+REASON_INPUTS_FRESH = "InputsFresh"
+REASON_INPUTS_RECOVERING = "InputsRecovering"
+REASON_INPUTS_DEGRADED = "InputsDegraded"
+REASON_INPUTS_BLACKOUT = "InputsBlackout"
+
+# InputsHealthy condition content per health-ladder state. Messages are
+# deliberately STABLE per state (no embedded ages): a changing message
+# would make the status material every tick and turn the health plane
+# into per-tick write churn.
+HEALTH_CONDITIONS: dict[str, tuple[str, str, str]] = {
+    "fresh": ("True", REASON_INPUTS_FRESH,
+              "Collector and control-plane inputs are fresh"),
+    "recovering": ("True", REASON_INPUTS_RECOVERING,
+                   "Inputs fresh again; scale-down resumes after the "
+                   "recovery hysteresis window"),
+    "degraded": ("False", REASON_INPUTS_DEGRADED,
+                 "Inputs degraded (stale or partial): last-known-good "
+                 "desired held, scale-down forbidden"),
+    "blackout": ("False", REASON_INPUTS_BLACKOUT,
+                 "Inputs blacked out: desired frozen at last-known-good, "
+                 "scale-to-zero hard-forbidden"),
+}
 
 
 def _rfc3339(ts: float) -> str:

@@ -168,6 +168,9 @@ class ReplayEngine:
         forecast_event = next(
             (ev for ev in rec.get("stages", [])
              if ev.get("stage") == "forecast"), None)
+        health_event = next(
+            (ev for ev in rec.get("stages", [])
+             if ev.get("stage") == "health"), None)
 
         decisions: list = []
         v2_requests: list[ModelScalingRequest] = []
@@ -199,6 +202,18 @@ class ReplayEngine:
                 StaticInventory(limits), GreedyBySaturation(),
                 clock=self.clock)
             limiter.limit(decisions)
+
+        if health_event is not None:
+            # Do-no-harm clamps re-applied from the RECORDED event through
+            # the same shared path the live gate used (health.apply) — the
+            # monitor's state (ages, hysteresis streaks, last-known-good
+            # holds) is not reconstructable from one cycle. Post-limiter,
+            # matching the live ordering: holds and freezes are absolute.
+            from wva_tpu.health.apply import apply_health_clamps
+
+            apply_health_clamps(decisions,
+                                health_event.get("clamps") or [],
+                                now=self.clock.now())
         return decisions
 
     # --- per-path replay ---
@@ -275,6 +290,18 @@ class ReplayEngine:
                     relax_timestamps: bool, max_diffs: int,
                     report: ReplayReport) -> None:
         recorded = rec.get("decisions") or []
+        # Mixed incremental cycles: models whose analysis was fingerprint-
+        # skipped had their PRIOR cycle's decisions re-emitted — replay
+        # cannot recompute them from this cycle's (absent) model record,
+        # and they were verified the cycle they were computed. Exclude
+        # them from the diff instead of failing on decision count.
+        skipped = {(ev.get("model_id"), ev.get("namespace"))
+                   for ev in rec.get("stages", [])
+                   if ev.get("stage") == "fingerprint_skip"}
+        if skipped:
+            recorded = [d for d in recorded
+                        if (d.get("model_id"), d.get("namespace"))
+                        not in skipped]
         replayed = [encode(d) for d in decisions]
         if relax_timestamps:
             recorded = [_strip_time_keys(d) for d in recorded]

@@ -61,6 +61,14 @@ STAGE_RECONCILE = "reconcile"
 # outcome (replay treats skipped models exactly like no-record models: the
 # re-emitted decisions were already verified the cycle they were computed).
 STAGE_FINGERPRINT_SKIP = "fingerprint_skip"
+# Input-health plane (wva_tpu.health): per-model trust states this cycle
+# plus the do-no-harm clamps the gate applied to final decisions. Recorded
+# AFTER the limiter; replay re-applies the RECORDED clamps through the same
+# shared code path (health.apply) — monitor state (ages, hysteresis
+# streaks, last-known-good holds) is not reconstructable from one cycle.
+# Only cycles where something was non-FRESH (or clamped) record the stage,
+# so a fault-free world's trace carries no health events.
+STAGE_HEALTH = "health"
 
 # Per-model pipeline paths.
 PATH_V1 = "v1"

@@ -304,13 +304,21 @@ class CapacityLedger:
             book.stockout_until.pop(tier, None)
             book.stockout_streak.pop(tier, None)
 
-    def expire_overdue(self, now: float) -> list[InFlightRequest]:
+    def expire_overdue(self, now: float,
+                       hold_variants: frozenset[str] = frozenset(),
+                       ) -> list[InFlightRequest]:
         """Drop in-flight requests whose credit window lapsed (wedged or
         silently failed provisioning) so the pool stops planning against
-        them. The manager decides whether to re-order."""
+        them. The manager decides whether to re-order. ``hold_variants``
+        (the input-health plane's blacked-out variants) keep their orders'
+        planning credit: a confirmation that cannot be observed is not a
+        wedge — while every OTHER variant's expiry proceeds on its own
+        trusted evidence."""
         expired = []
         with self._mu:
-            for book in self._books.values():
+            for variant, book in self._books.items():
+                if variant in hold_variants:
+                    continue
                 for rid in [r for r, req in book.inflight.items()
                             if now > req.credit_expires()]:
                     expired.append(book.inflight.pop(rid))

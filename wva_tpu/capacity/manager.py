@@ -141,13 +141,24 @@ class CapacityManager:
                 out[variant] = credit
         return out
 
-    def tick(self, slices: dict | None = None) -> dict:
+    def tick(self, slices: dict | None = None,
+             hold_releases: frozenset[str] | bool = frozenset()) -> dict:
         """One capacity pass; returns the ``capacity`` stage event payload
         (ledger snapshot + this tick's provisioning activity). ``slices``
         is the tick's discovery snapshot when the caller already computed
         one (the limiter's inventory refresh — no point listing and
         parsing the node fleet a second time in the same tick); None runs
-        a fresh discovery pass."""
+        a fresh discovery pass. ``hold_releases`` (the engine's input-
+        health BLACKOUT signal) names the VARIANTS whose orders must not
+        surrender capacity this tick: their in-flight orders are not
+        expired (dropping the planning credit would shrink the pools the
+        solver sees, and an order wedged during a metrics blackout often
+        just means its confirmation is blind too). Per-variant on purpose
+        — one model's blackout must not suppress expiry of an unrelated
+        healthy variant's genuinely wedged order. ``True`` holds every
+        variant (tests / blunt callers); ordering for real shortfalls
+        continues either way, since frozen demand can still be
+        under-supplied after a preemption."""
         now = self.clock.now()
         if slices is None:
             try:
@@ -163,7 +174,11 @@ class CapacityManager:
         for c in completed:
             self._record_lead(c.request.variant, c.request.tier, c.latency)
             self._backoff_for(c.request.variant).success()
-        expired = self.ledger.expire_overdue(now)
+        if hold_releases is True:
+            hold = frozenset(self.ledger.known_variants())
+        else:
+            hold = frozenset(hold_releases or ())
+        expired = self.ledger.expire_overdue(now, hold_variants=hold)
         for req in expired:
             # A silently-wedged order is a failure for backoff purposes:
             # the next attempt for the variant is delayed, not immediate.

@@ -70,6 +70,14 @@ class MetricsCache:
                 return None
             return cached
 
+    def peek(self, query_name: str, params: dict[str, str],
+             ) -> CachedValue | None:
+        """Entry lookup ignoring BOTH the TTL and the stale-serve bound —
+        the input-health plane's age probe (how old is the newest data we
+        could possibly be deciding on?). Never used to serve data."""
+        with self._mu:
+            return self._values.get(cache_key(query_name, params))
+
     def cleanup(self) -> int:
         """Evict expired entries; returns evicted count."""
         with self._mu:

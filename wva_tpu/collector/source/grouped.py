@@ -526,6 +526,14 @@ class GroupedMetricsView(MetricsSource):
     def get(self, query_name: str, params: dict[str, str]):
         return self._source.get(query_name, params)
 
+    def slice_age_seconds(self, queries, params: dict[str, str],
+                          ) -> float | None:
+        """Input-health age probe, delegated to the wrapped source's
+        per-model cache — the grouped demux refreshes exactly those
+        entries, so the probe sees grouped and per-model collection
+        identically."""
+        return self._source.slice_age_seconds(queries, params)
+
     def refresh(self, spec: RefreshSpec) -> dict[str, MetricResult]:
         names = list(spec.queries) or self._source.query_list().names()
         results: dict[str, MetricResult] = {}

@@ -318,8 +318,12 @@ class PrometheusSource(MetricsSource):
         self._evictions_since_warn = 0
         self._queries = QueryList()
         # In-memory backends are fast + deterministic: run sequentially.
+        # Wrappers over an in-memory backend (the chaos fault injector)
+        # declare themselves with a `sequential` attribute so simulated
+        # worlds stay single-threaded-deterministic.
         if concurrent is None:
-            concurrent = not isinstance(api, InMemoryPromAPI)
+            concurrent = not (isinstance(api, InMemoryPromAPI)
+                              or getattr(api, "sequential", False))
         self._concurrent = concurrent
         # One persistent query pool for the source's lifetime (created
         # lazily, torn down by close()). Constructing a fresh
@@ -684,3 +688,21 @@ class PrometheusSource(MetricsSource):
 
     def get(self, query_name: str, params: dict[str, str]):
         return self._cache.get(query_name, params)
+
+    def slice_age_seconds(self, queries, params: dict[str, str],
+                          ) -> float | None:
+        """Input-health probe: age of the OLDEST cached entry among
+        ``queries`` for these params, ignoring TTL and the stale-serve
+        bound. A healthy tick re-caches every slice (directly or through
+        the grouped demux), so the age collapses to ~0; during an outage
+        refresh() stale-serves WITHOUT re-caching, so the age grows
+        monotonically — exactly the quantity the degraded/blackout ladder
+        classifies. None = nothing cached (never collected, or the entry
+        aged past the retention sweep — the monitor keeps its own
+        last-good clock so None never resets an outage)."""
+        now = self.clock.now()
+        ages = [now - entry.cached_at
+                for name in queries
+                for entry in (self._cache.peek(name, params),)
+                if entry is not None]
+        return max(ages) if ages else None

@@ -178,6 +178,31 @@ class ForecastConfig(frz.Freezable):
 
 
 @dataclass
+class HealthConfig(frz.Freezable):
+    """Input-health plane (``wva_tpu.health``): per-model trust ladder over
+    collector slice ages, scrape coverage, and control-plane staleness,
+    with a do-no-harm gate on final decisions (docs/design/health.md).
+    Default ON; ``WVA_HEALTH=off`` restores byte-identical pre-health
+    decisions, statuses, and traces in a fault-free world (same discipline
+    as ``WVA_FORECAST=off``)."""
+
+    enabled: bool = True
+    # Input age past which a model is DEGRADED: last-known-good desired is
+    # held, scale-UP stays allowed, scale-down is forbidden. Aligned with
+    # the collector's stale_threshold vocabulary.
+    degraded_after_seconds: float = 120.0
+    # Input age past which a model is BLACKOUT: desired freezes at the
+    # last-known-good value, scale-to-zero is hard-forbidden, forecast
+    # floors and capacity releases are withheld. Aligned with the
+    # serve-stale cutoff (unavailable_threshold).
+    freeze_after_seconds: float = 300.0
+    # Consecutive FRESH ticks required after a degradation before
+    # scale-downs resume (the first fresh slice after an outage may still
+    # describe a world half-way through recovering).
+    recovery_ticks: int = 3
+
+
+@dataclass
 class CapacityConfig(frz.Freezable):
     """Elastic capacity plane (``wva_tpu.capacity``): slice provisioning,
     preemption resilience, reservation/spot-aware inventory
@@ -231,6 +256,7 @@ class Config:
         self._trace = TraceConfig()
         self._forecast = ForecastConfig()
         self._capacity = CapacityConfig()
+        self._health = HealthConfig()
         # Bumped on every decision-affecting hot-reload (see mutation_epoch).
         self._epoch = 0
         # Hot-accessor memo: section name -> FROZEN deep copy, built once
@@ -428,6 +454,20 @@ class Config:
     def set_capacity(self, c: CapacityConfig) -> None:
         with self._mu:
             self._capacity = copy.deepcopy(c)
+            self._bump_epoch_locked()
+
+    # --- input-health plane (wva_tpu.health) ---
+
+    def health_config(self) -> HealthConfig:
+        return self._memoized("health", lambda: self._health)
+
+    def health_enabled(self) -> bool:
+        with self._mu:
+            return self._health.enabled
+
+    def set_health(self, h: HealthConfig) -> None:
+        with self._mu:
+            self._health = copy.deepcopy(h)
             self._bump_epoch_locked()
 
     # --- saturation config (namespace-aware; reference config.go:318-354) ---

@@ -20,6 +20,7 @@ from wva_tpu.config.config import (
     EPPConfig,
     FeatureFlagsConfig,
     ForecastConfig,
+    HealthConfig,
     InfrastructureConfig,
     PrometheusConfig,
     TLSConfig,
@@ -69,6 +70,21 @@ DEFAULTS: dict[str, Any] = {
     "WVA_FORECAST_MIN_TRUST_EVALS": 3,
     "WVA_FORECAST_PREWAKE": True,
     "WVA_FORECAST_PREWAKE_MIN_DEMAND": 1.0,
+    # Input-health plane (wva_tpu.health; docs/design/health.md).
+    # Default on; "off"/"false"/"0" disables (decisions, statuses, and
+    # traces then byte-identical to pre-health builds in a fault-free
+    # world).
+    "WVA_HEALTH": True,
+    # Input age past which a model is DEGRADED (hold last-known-good,
+    # allow scale-up, forbid scale-down).
+    "WVA_HEALTH_DEGRADED_AFTER": "120s",
+    # Input age past which a model is BLACKOUT (freeze desired,
+    # hard-forbid scale-to-zero, withhold forecast floors and capacity
+    # releases).
+    "WVA_HEALTH_FREEZE_AFTER": "300s",
+    # Consecutive fresh ticks before scale-downs resume after a
+    # degradation.
+    "WVA_HEALTH_RECOVERY_TICKS": 3,
     # Elastic capacity plane (wva_tpu.capacity; docs/design/capacity.md).
     # Default on; "off"/"false"/"0" disables (decisions then byte-identical
     # to pre-capacity builds).
@@ -250,6 +266,13 @@ def load(flags: Mapping[str, Any] | None = None,
         min_trust_evals=r.get_int("WVA_FORECAST_MIN_TRUST_EVALS"),
         prewake_enabled=r.get_bool("WVA_FORECAST_PREWAKE"),
         prewake_min_demand=r.get_float("WVA_FORECAST_PREWAKE_MIN_DEMAND"),
+    ))
+
+    cfg.set_health(HealthConfig(
+        enabled=r.get_bool("WVA_HEALTH"),
+        degraded_after_seconds=r.get_duration("WVA_HEALTH_DEGRADED_AFTER"),
+        freeze_after_seconds=r.get_duration("WVA_HEALTH_FREEZE_AFTER"),
+        recovery_ticks=r.get_int("WVA_HEALTH_RECOVERY_TICKS"),
     ))
 
     from wva_tpu.capacity.tiers import (

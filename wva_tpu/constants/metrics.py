@@ -68,6 +68,16 @@ WVA_DESIRED_RATIO = "wva_desired_ratio"
 # the equivalent from controller-runtime's reconcile metrics) ---
 WVA_ENGINE_TICK_DURATION_SECONDS = "wva_engine_tick_duration_seconds"
 WVA_ENGINE_TICKS_TOTAL = "wva_engine_ticks_total"
+# Ticks whose wall-clock duration exceeded the engine's poll interval: the
+# loop is falling behind its own cadence (apiserver latency injection,
+# metrics-backend timeouts, or genuine fleet growth). Alert on rate > 0.
+WVA_TICK_OVERRUNS_TOTAL = "wva_tick_overruns_total"
+
+# --- Input-health plane (wva_tpu.health) ---
+# Per-model trust ladder: one series per (model, namespace, state) with
+# value 1 for the current state and 0 otherwise (state is
+# fresh | degraded | blackout). Alert on degraded/blackout == 1.
+WVA_INPUT_HEALTH = "wva_input_health"
 
 # --- Decision flight recorder health (wva_tpu.blackbox) ---
 WVA_TRACE_RECORDS_TOTAL = "wva_trace_records_total"

@@ -143,7 +143,14 @@ class VariantAutoscalingReconciler:
                 ev = common.DecisionTrigger.get_nowait()
             except queue.Empty:
                 break
-            self.reconcile(ev.name, ev.namespace)
+            try:
+                self.reconcile(ev.name, ev.namespace)
+            except Exception as e:  # noqa: BLE001 — same isolation as
+                # run_trigger_loop: one VA's transient apiserver failure
+                # (storm-injected 503s) must not abort the whole drain and
+                # strand every later trigger in the queue.
+                log.error("reconcile %s/%s failed: %s",
+                          ev.namespace, ev.name, e)
             processed += 1
         return processed
 

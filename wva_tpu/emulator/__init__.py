@@ -9,6 +9,9 @@
 - :mod:`hpa`        — HorizontalPodAutoscaler emulator acting on the
   ``wva_desired_replicas`` gauge exactly as Prometheus Adapter + HPA would
 - :mod:`loadgen`    — load profiles (constant / step / ramp / trapezoid)
+- :mod:`faults`     — chaos fault-injection plans (blackouts, 5xx/429
+  rates, latency, partial responses, watch drops) wrapping the
+  controller's input surfaces
 - :mod:`harness`    — discrete-time world loop tying it all together
 """
 
@@ -21,8 +24,16 @@ from wva_tpu.emulator.gke_provisioner import (
 )
 from wva_tpu.emulator.kubelet import FakeKubelet
 from wva_tpu.emulator.hpa import HPAEmulator, HPAParams
+from wva_tpu.emulator.faults import (
+    FaultInjector,
+    FaultPlan,
+    FaultWindow,
+    FaultyKubeClient,
+    FaultyPromAPI,
+)
 from wva_tpu.emulator.loadgen import (
     LoadProfile,
+    chaos_storm,
     constant,
     diurnal,
     poisson_bursts,
@@ -43,7 +54,13 @@ __all__ = [
     "FakeKubelet",
     "HPAEmulator",
     "HPAParams",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultWindow",
+    "FaultyKubeClient",
+    "FaultyPromAPI",
     "LoadProfile",
+    "chaos_storm",
     "constant",
     "diurnal",
     "poisson_bursts",

@@ -49,10 +49,20 @@ def parse_label_selector(raw: str) -> dict[str, str]:
 
 
 def quantity(value: float) -> str:
-    """Kubernetes resource.Quantity encoding (integral or milli)."""
-    if float(value).is_integer():
-        return str(int(value))
-    return f"{round(value * 1000)}m"
+    """Kubernetes resource.Quantity encoding: integral, milli, or — for
+    values the milli form cannot represent exactly — decimal/scientific
+    notation (real resource.Quantity accepts decimalExponent forms like
+    ``4e-07``). The old unconditional ``round(v*1000)m`` encoded any
+    sub-milli non-zero value as ``0m``, silently zeroing small ratios."""
+    v = float(value)
+    if v.is_integer():
+        return str(int(v))
+    milli = v * 1000.0
+    if milli.is_integer():
+        return f"{int(milli)}m"
+    # repr is the shortest round-tripping decimal ("0.0123", "1.23e-05"):
+    # lossless, and a valid Quantity decimalExponent string.
+    return repr(v)
 
 
 def parse_quantity_str(raw: str) -> float:

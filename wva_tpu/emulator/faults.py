@@ -1,0 +1,391 @@
+"""Chaos fault-injection harness (docs/design/health.md §chaos).
+
+Seeded, scripted fault plans that wrap every input surface the controller
+trusts — the metrics backend, the apiserver, the EPP pod scrape — with the
+failure modes AIBrix's taxonomy ranks dominant for LLM-serving control
+loops: sustained blackouts, 5xx/429 error rates, latency injection,
+PARTIAL label-subset responses (the nastiest: a "successful" query missing
+half the pods), and watch-stream drops.
+
+Two injection layers, same :class:`FaultPlan`:
+
+- **In-process** (the deterministic :class:`EmulationHarness` world):
+  :class:`FaultyPromAPI` wraps the in-memory PromAPI and
+  :class:`FaultyKubeClient` wraps the FakeCluster — pure functions of the
+  injected FakeClock, so chaos worlds stay byte-reproducible per seed.
+- **Real-socket** (rest-client / smoke tests): :class:`FaultInjector`
+  hooks into ``FakeAPIServer`` and ``FakePrometheusServer`` to send
+  503/429s, inject latency, and drop watch streams UNCLEANLY mid-flight
+  (exercising the reconnect/backoff/re-list paths with injected faults
+  instead of hand-rolled ones).
+
+Windows are world-relative seconds; ``FaultPlan.bind(origin)`` shifts them
+onto the world clock. Randomized decisions (error rates, partial drops)
+derive from CRC32 of the seed + a stable salt — never from Python's
+process-randomized ``hash`` — so a plan replays identically across runs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+# Fault kinds (FaultWindow.kind).
+KIND_METRICS_BLACKOUT = "metrics_blackout"
+KIND_METRICS_ERRORS = "metrics_errors"
+KIND_METRICS_PARTIAL = "metrics_partial"
+KIND_METRICS_LATENCY = "metrics_latency"
+KIND_API_BLACKOUT = "apiserver_blackout"
+KIND_API_ERRORS = "apiserver_errors"
+KIND_API_LATENCY = "apiserver_latency"
+KIND_WATCH_DROP = "watch_drop"
+KIND_EPP_BLACKOUT = "epp_blackout"
+
+METRICS_KINDS = (KIND_METRICS_BLACKOUT, KIND_METRICS_ERRORS,
+                 KIND_METRICS_PARTIAL)
+
+
+@dataclass(frozen=True)
+class FaultWindow:
+    """One scripted fault: ``kind`` active over ``[start, end)`` (world-
+    relative seconds; see FaultPlan.bind)."""
+
+    kind: str
+    start: float
+    end: float
+    # Error probability per request for *_errors kinds (1.0 = every one).
+    rate: float = 1.0
+    # HTTP status the injected failure emulates (503 outage / 429 rate
+    # limit); carried into in-process error messages too.
+    status: int = 503
+    # Injected per-request delay for *_latency kinds (real-socket layers
+    # only: a FakeClock world cannot sleep inside a call).
+    latency_seconds: float = 0.0
+    # Fraction of result series dropped for metrics_partial (stable per
+    # series per window — the same pods stay missing all window).
+    drop_fraction: float = 0.5
+
+
+class FaultPlan:
+    """A seeded schedule of fault windows, queryable by (kind, now)."""
+
+    def __init__(self, windows: list[FaultWindow], seed: int = 0,
+                 origin: float = 0.0) -> None:
+        self.windows = sorted(windows, key=lambda w: (w.start, w.kind))
+        self.seed = seed
+        self.origin = origin
+
+    def bind(self, origin: float) -> "FaultPlan":
+        """Shift world-relative windows onto the world clock (the harness
+        calls this with its start time)."""
+        self.origin = origin
+        return self
+
+    def shifted(self, w: FaultWindow) -> tuple[float, float]:
+        return w.start + self.origin, w.end + self.origin
+
+    def active(self, kind: str, now: float) -> FaultWindow | None:
+        for w in self.windows:
+            if w.kind != kind:
+                continue
+            start, end = self.shifted(w)
+            if start <= now < end:
+                return w
+        return None
+
+    def metrics_faulted(self, now: float) -> bool:
+        return any(self.active(k, now) is not None for k in METRICS_KINDS)
+
+    def _det01(self, *key) -> float:
+        """Deterministic uniform [0,1) from the seed + a stable salt
+        (CRC32 of the repr — process-hash-randomization-proof)."""
+        data = repr((self.seed,) + key).encode()
+        return (zlib.crc32(data) % 100_000) / 100_000.0
+
+    def chance(self, w: FaultWindow, now: float, salt: str) -> bool:
+        """Seeded per-request error decision for *_errors windows."""
+        return self._det01("err", w.kind, w.start, round(now, 3),
+                           salt) < w.rate
+
+    def drops_series(self, w: FaultWindow, labels: dict[str, str]) -> bool:
+        """Seeded drop decision for metrics_partial windows, at SCRAPE
+        TARGET granularity: Prometheus partial outages lose whole targets
+        (a shard down, a federation upstream dark), so a dropped pod loses
+        ALL its series for the window's whole duration — never random
+        per-series noise. Series without a pod label (model-level
+        aggregates) drop by their full label identity."""
+        key = labels.get("pod") or labels.get("pod_name")
+        ident = (key,) if key else tuple(sorted(labels.items()))
+        return self._det01("partial", w.start, ident) < w.drop_fraction
+
+    def describe(self) -> list[dict]:
+        return [{"kind": w.kind, "start": w.start, "end": w.end,
+                 "rate": w.rate, "status": w.status,
+                 "drop_fraction": w.drop_fraction} for w in self.windows]
+
+
+class ChaosError(ConnectionError):
+    """Injected transport failure. A ConnectionError on purpose: the
+    grouped-collection fallback must classify it TRANSIENT (no per-model
+    pinning), exactly like a real backend outage."""
+
+
+class FaultyPromAPI:
+    """PromAPI wrapper applying a FaultPlan to every query — the
+    in-process metrics fault layer for the deterministic harness world.
+
+    Blackout/error windows raise (PrometheusSource then stale-serves);
+    partial windows silently drop a seeded label subset from successful
+    results (the failure mode ages cannot detect — the input-health
+    plane's coverage signal exists for it). During any active metrics
+    fault the versioned-fingerprint backend hooks go dark (no execution
+    memos recorded, no reuse) so a partial result can never be
+    version-reused past its window."""
+
+    # Keep PrometheusSource single-threaded-deterministic over the
+    # wrapped in-memory backend.
+    sequential = True
+
+    def __init__(self, api, plan: FaultPlan, clock: Clock | None = None,
+                 ) -> None:
+        self.api = api
+        self.plan = plan
+        self.clock = clock or SYSTEM_CLOCK
+        # Injected failures by kind, for bench/tests introspection.
+        self.injected: dict[str, int] = {}
+        # model_name labels of series dropped by partial windows — lets
+        # the chaos bench assert do-no-harm exactly for the models whose
+        # inputs were actually thinned.
+        self.dropped_models: set[str] = set()
+
+    def _count(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def _gate(self, promql: str, now: float) -> None:
+        w = self.plan.active(KIND_METRICS_BLACKOUT, now)
+        if w is not None:
+            self._count(KIND_METRICS_BLACKOUT)
+            raise ChaosError(
+                f"chaos: metrics backend blackout (injected {w.status})")
+        w = self.plan.active(KIND_METRICS_ERRORS, now)
+        if w is not None and self.plan.chance(w, now, promql):
+            self._count(KIND_METRICS_ERRORS)
+            raise ChaosError(
+                f"chaos: metrics backend error (injected {w.status})")
+
+    def _post(self, points, now: float):
+        w = self.plan.active(KIND_METRICS_PARTIAL, now)
+        if w is None:
+            return points
+        kept = []
+        for p in points:
+            labels = dict(p.labels)
+            if self.plan.drops_series(w, labels):
+                model = labels.get("model_name")
+                if model:
+                    self.dropped_models.add(model)
+                continue
+            kept.append(p)
+        if len(kept) != len(points):
+            self._count(KIND_METRICS_PARTIAL)
+        return kept
+
+    def query(self, promql: str):
+        now = self.clock.now()
+        self._gate(promql, now)
+        return self._post(self.api.query(promql), now)
+
+    def query_tracked(self, promql: str):
+        now = self.clock.now()
+        self._gate(promql, now)
+        tracked = getattr(self.api, "query_tracked", None)
+        if tracked is None:
+            return self._post(self.api.query(promql), now), None
+        points, meta = tracked(promql)
+        if self.plan.active(KIND_METRICS_PARTIAL, now) is not None:
+            # Never memoize a partial evaluation: version-gated reuse
+            # would serve the holey result past the fault window.
+            return self._post(points, now), None
+        return points, meta
+
+    def write_version(self, names):
+        if self.plan.metrics_faulted(self.clock.now()):
+            return None  # no reuse proofs while inputs are being faulted
+        fn = getattr(self.api, "write_version", None)
+        return None if fn is None else fn(names)
+
+    def value_version(self, names):
+        if self.plan.metrics_faulted(self.clock.now()):
+            return None
+        fn = getattr(self.api, "value_version", None)
+        return None if fn is None else fn(names)
+
+
+class FaultyKubeClient:
+    """KubeClient wrapper applying a FaultPlan's apiserver windows to the
+    verbs the control plane issues — the in-process twin of the HTTP-level
+    :class:`FaultInjector`. Watch delivery stays in-process (stream drops
+    are an HTTP-transport phenomenon; the real-socket layer owns them)."""
+
+    def __init__(self, client, plan: FaultPlan,
+                 clock: Clock | None = None) -> None:
+        self._inner = client
+        self._plan = plan
+        self._clock = clock or getattr(client, "clock", SYSTEM_CLOCK)
+        self.injected: dict[str, int] = {}
+
+    def _gate(self, verb: str, ident: str = "") -> None:
+        now = self._clock.now()
+        w = self._plan.active(KIND_API_BLACKOUT, now)
+        if w is None:
+            w = self._plan.active(KIND_API_ERRORS, now)
+            if w is None or not self._plan.chance(w, now,
+                                                  f"{verb}:{ident}"):
+                return
+        self.injected[verb] = self.injected.get(verb, 0) + 1
+        raise ChaosError(
+            f"chaos: apiserver unavailable for {verb} {ident} "
+            f"(injected {w.status})")
+
+    # Intercepted verbs (everything else delegates via __getattr__).
+
+    def get(self, kind, namespace, name):
+        self._gate("get", f"{kind}/{namespace}/{name}")
+        return self._inner.get(kind, namespace, name)
+
+    def try_get(self, kind, namespace, name):
+        self._gate("get", f"{kind}/{namespace}/{name}")
+        return self._inner.try_get(kind, namespace, name)
+
+    def list(self, kind, namespace=None, label_selector=None):
+        self._gate("list", kind)
+        return self._inner.list(kind, namespace=namespace,
+                                label_selector=label_selector)
+
+    def create(self, obj):
+        self._gate("create", type(obj).__name__)
+        return self._inner.create(obj)
+
+    def update(self, obj):
+        self._gate("update", type(obj).__name__)
+        return self._inner.update(obj)
+
+    def update_status(self, obj):
+        self._gate("update_status", type(obj).__name__)
+        return self._inner.update_status(obj)
+
+    def delete(self, kind, namespace, name):
+        self._gate("delete", f"{kind}/{namespace}/{name}")
+        return self._inner.delete(kind, namespace, name)
+
+    def patch_scale(self, kind, namespace, name, replicas):
+        self._gate("patch_scale", f"{kind}/{namespace}/{name}")
+        return self._inner.patch_scale(kind, namespace, name, replicas)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@dataclass
+class FaultAction:
+    """What the HTTP layer should do to one request."""
+
+    status: int = 503
+    latency_seconds: float = 0.0
+
+
+@dataclass
+class FaultInjector:
+    """HTTP-level injector for the real-socket fakes (FakeAPIServer /
+    FakePrometheusServer). Drives from a FaultPlan on a clock, or — for
+    deterministic tests that toggle faults around specific requests — from
+    imperatively forced kinds (:meth:`force` / :meth:`clear`)."""
+
+    plan: FaultPlan | None = None
+    clock: Clock = SYSTEM_CLOCK
+    _forced: dict[str, FaultWindow] = field(default_factory=dict)
+    _mu: threading.Lock = field(default_factory=threading.Lock)
+    counts: dict[str, int] = field(default_factory=dict)
+
+    def force(self, kind: str, status: int = 503, rate: float = 1.0,
+              latency_seconds: float = 0.0,
+              drop_fraction: float = 0.5) -> None:
+        with self._mu:
+            self._forced[kind] = FaultWindow(
+                kind=kind, start=0.0, end=float("inf"), rate=rate,
+                status=status, latency_seconds=latency_seconds,
+                drop_fraction=drop_fraction)
+
+    def clear(self, kind: str | None = None) -> None:
+        with self._mu:
+            if kind is None:
+                self._forced.clear()
+            else:
+                self._forced.pop(kind, None)
+
+    def _active(self, kind: str) -> FaultWindow | None:
+        with self._mu:
+            w = self._forced.get(kind)
+        if w is not None:
+            return w
+        if self.plan is not None:
+            return self.plan.active(kind, self.clock.now())
+        return None
+
+    def _count(self, kind: str) -> None:
+        with self._mu:
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+
+    def api_fault(self, verb: str, path: str) -> FaultAction | None:
+        return self._fault(KIND_API_LATENCY,
+                           (KIND_API_BLACKOUT, KIND_API_ERRORS),
+                           f"{verb}:{path}")
+
+    def metrics_fault(self, query: str) -> FaultAction | None:
+        return self._fault(KIND_METRICS_LATENCY,
+                           (KIND_METRICS_BLACKOUT, KIND_METRICS_ERRORS),
+                           query)
+
+    def _fault(self, latency_kind: str, failure_kinds: tuple[str, ...],
+               salt: str) -> FaultAction | None:
+        """Shared per-request decision: injected latency rides along with
+        a failure; a latency-only window sleeps here and lets the request
+        proceed."""
+        w = self._active(latency_kind)
+        latency = w.latency_seconds if w is not None else 0.0
+        for kind in failure_kinds:
+            w = self._active(kind)
+            if w is not None and (w.rate >= 1.0 or self._chance(w, salt)):
+                self._count(w.kind)
+                return FaultAction(status=w.status, latency_seconds=latency)
+        if latency > 0:
+            time.sleep(latency)  # latency-only window: slow, not failed
+        return None
+
+    def filter_points(self, points):
+        """metrics_partial for the real-socket Prometheus facade."""
+        w = self._active(KIND_METRICS_PARTIAL)
+        if w is None:
+            return points
+        plan = self.plan or FaultPlan([], seed=0)
+        kept = [p for p in points
+                if not plan.drops_series(w, dict(p.labels))]
+        if len(kept) != len(points):
+            self._count(KIND_METRICS_PARTIAL)
+        return kept
+
+    def watch_drop_now(self) -> bool:
+        """Should the currently-streaming watch be dropped UNCLEANLY
+        right now? Polled from the fake apiserver's stream loop."""
+        if self._active(KIND_WATCH_DROP) is not None:
+            self._count(KIND_WATCH_DROP)
+            return True
+        return False
+
+    def _chance(self, w: FaultWindow, salt: str) -> bool:
+        plan = self.plan or FaultPlan([], seed=0)
+        return plan.chance(w, self.clock.now(), salt)

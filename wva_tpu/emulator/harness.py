@@ -81,6 +81,7 @@ class EmulationHarness:
         stochastic_seed: int | None = None,
         trace_path: str | None = None,
         provisioner=None,
+        fault_plan=None,
     ) -> None:
         self.namespace = namespace
         self.variants = variants
@@ -138,9 +139,39 @@ class EmulationHarness:
                 and not hasattr(provisioner, "request_slices"):
             provisioner = provisioner(self.cluster, self.clock)
         self.provisioner = provisioner
+        # Chaos fault injection (emulator/faults.py): a FaultPlan wraps the
+        # MANAGER'S views of the world — metrics backend, kube client, EPP
+        # scrape — while the world itself (kubelet, HPA, sims) keeps
+        # running on the raw cluster: faults blind the controller, not
+        # physics. Windows are world-relative; bound to start_time here.
+        self.fault_plan = fault_plan
+        manager_client = self.cluster
+        manager_prom_api = None
+        manager_fetcher = epp_fetcher
+        if fault_plan is not None:
+            from wva_tpu.collector.source import InMemoryPromAPI
+            from wva_tpu.emulator.faults import (
+                KIND_EPP_BLACKOUT,
+                FaultyKubeClient,
+                FaultyPromAPI,
+            )
+
+            fault_plan.bind(start_time)
+            manager_prom_api = FaultyPromAPI(
+                InMemoryPromAPI(self.tsdb), fault_plan, clock=self.clock)
+            manager_client = FaultyKubeClient(self.cluster, fault_plan,
+                                              clock=self.clock)
+
+            def manager_fetcher(pod, _inner=epp_fetcher):
+                if fault_plan.active(KIND_EPP_BLACKOUT,
+                                     self.clock.now()) is not None:
+                    raise ConnectionError("chaos: EPP scrape blackout")
+                return _inner(pod)
+
         self.manager: Manager = build_manager(
-            self.cluster, self.config, clock=self.clock, tsdb=self.tsdb,
-            pod_fetcher=epp_fetcher, slice_provisioner=provisioner)
+            manager_client, self.config, clock=self.clock, tsdb=self.tsdb,
+            pod_fetcher=manager_fetcher, slice_provisioner=provisioner,
+            prom_api=manager_prom_api)
         self.flight_recorder = self.manager.flight_recorder
         self.manager.engine.executor.max_retries_per_tick = 1
         self.manager.scale_from_zero.executor.max_retries_per_tick = 1

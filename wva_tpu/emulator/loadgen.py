@@ -161,6 +161,80 @@ def preemption_storm(base_rate: float, burst_rate: float,
     return profile, events
 
 
+def chaos_storm(base_rate: float, burst_rate: float,
+                burst_duration: float, mean_gap: float,
+                horizon: float, seed: int = 0,
+                fault_lead: float = 20.0,
+                fault_duration: float = 150.0,
+                error_rate: float = 0.6,
+                drop_fraction: float = 0.5,
+                ) -> tuple[LoadProfile, list]:
+    """Bursty demand with CORRELATED input faults: each seeded burst also
+    schedules a metrics-plane fault starting ``fault_lead`` seconds into
+    the burst and outlasting it by design (``fault_duration`` >
+    ``burst_duration - fault_lead``) — so the burst ENDS while the fault
+    is live. The inputs then freeze (blackout) or thin out (partial /
+    error-rate) at the busy operating point while real demand drops: the
+    maximally misleading shape for a serve-stale control loop, which sees
+    "still busy" data it must not trust in either direction, and the shape
+    the do-no-harm gate's zero-wrong-direction guarantee is benched
+    against (``make bench-chaos``).
+
+    Fault kinds rotate deterministically per burst (blackout -> partial ->
+    error-rate -> blackout with apiserver storm), all derived from
+    ``seed``. Returns ``(profile, windows)`` where ``windows`` is the
+    world-relative :class:`~wva_tpu.emulator.faults.FaultWindow` list for
+    ``FaultPlan(windows, seed=seed).bind(start_time)``.
+    """
+    from wva_tpu.emulator.faults import (
+        KIND_API_ERRORS,
+        KIND_METRICS_BLACKOUT,
+        KIND_METRICS_ERRORS,
+        KIND_METRICS_PARTIAL,
+        FaultWindow,
+    )
+
+    rng = random.Random(seed)
+    starts: list[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(1.0 / max(mean_gap, 1e-9))
+        if t >= horizon:
+            break
+        starts.append(t)
+        t += burst_duration
+    windows: list = []
+    rotation = (KIND_METRICS_BLACKOUT, KIND_METRICS_PARTIAL,
+                KIND_METRICS_ERRORS, KIND_METRICS_BLACKOUT)
+    for i, s in enumerate(starts):
+        f_start = round(s + fault_lead, 3)
+        f_end = round(min(f_start + fault_duration, horizon), 3)
+        if f_end <= f_start:
+            continue
+        kind = rotation[i % len(rotation)]
+        windows.append(FaultWindow(
+            kind=kind, start=f_start, end=f_end,
+            rate=error_rate if kind == KIND_METRICS_ERRORS else 1.0,
+            status=429 if kind == KIND_METRICS_ERRORS else 503,
+            drop_fraction=drop_fraction))
+        if i % len(rotation) == 3:
+            # Every 4th burst doubles as an apiserver storm riding the
+            # metrics blackout: resync LISTs and status writes fail too.
+            windows.append(FaultWindow(
+                kind=KIND_API_ERRORS, start=f_start, end=f_end,
+                rate=error_rate, status=503))
+
+    def profile(tt: float) -> float:
+        for s in starts:
+            if s <= tt < s + burst_duration:
+                return burst_rate
+            if s > tt:
+                break
+        return base_rate
+
+    return profile, windows
+
+
 @dataclass
 class SpikeProfile:
     """Idle -> spike -> idle, for scale-from-zero / scale-to-zero scenarios."""

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
@@ -58,12 +59,24 @@ class _Handler(BaseHTTPRequestHandler):
         query = (form.get("query")
                  or urllib.parse.parse_qs(parsed.query).get("query")
                  or [""])[0]
+        fi = getattr(self.server, "fault_injector", None)
+        if fi is not None:
+            act = fi.metrics_fault(query)
+            if act is not None:
+                if act.latency_seconds > 0:
+                    time.sleep(act.latency_seconds)
+                self._send_json(act.status, {
+                    "status": "error", "errorType": "unavailable",
+                    "error": "chaos fault injection"})
+                return
         try:
             points = self.server.query(query)
         except Exception as e:  # noqa: BLE001 — surfaced as API error
             self._send_json(400, {"status": "error", "errorType": "bad_data",
                                   "error": str(e)})
             return
+        if fi is not None:
+            points = fi.filter_points(points)
         self._send_json(200, {
             "status": "success",
             "data": {
@@ -96,7 +109,13 @@ class FakePrometheusServer:
         self._httpd.daemon_threads = True
         # Expose query() to handlers through the server object.
         self._httpd.query = self.query  # type: ignore[attr-defined]
+        # Optional emulator.faults.FaultInjector (chaos harness):
+        # 503/429/latency before the query, partial series drops after.
+        self._httpd.fault_injector = None  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
+
+    def set_fault_injector(self, fi) -> None:
+        self._httpd.fault_injector = fi  # type: ignore[attr-defined]
 
     @property
     def url(self) -> str:

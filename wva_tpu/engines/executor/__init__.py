@@ -53,6 +53,10 @@ class PollingExecutor(Executor):
         # (name, wall_seconds, ok). Wired to MetricsRegistry.observe_tick by
         # the manager; gate-skipped ticks are not observed.
         self.on_tick: Callable[[str, float, bool], None] | None = None
+        # Optional observer called when a tick's wall-clock duration
+        # exceeded the poll interval (the loop is falling behind its own
+        # cadence). Wired to MetricsRegistry.observe_tick_overrun.
+        self.on_overrun: Callable[[str], None] | None = None
         # Optional blackbox.FlightRecorder: every executed tick opens one
         # decision-trace cycle record that the task's pipeline stages fill.
         # Gate-skipped ticks open no cycle (nothing ran, nothing to replay).
@@ -87,12 +91,18 @@ class PollingExecutor(Executor):
             # observed — consistent with gate-skipped ticks above, and so
             # every controller shutdown doesn't ring the error-rate alert
             # the docs tell operators to set on wva_engine_ticks_total.
+            elapsed = time.perf_counter() - start
             if self.on_tick is not None and outcome != "aborted":
                 try:
-                    self.on_tick(self.name, time.perf_counter() - start,
-                                 outcome == "success")
+                    self.on_tick(self.name, elapsed, outcome == "success")
                 except Exception:  # noqa: BLE001 — observability must not
                     log.debug("tick observer failed", exc_info=True)  # bite
+            if (self.on_overrun is not None and outcome != "aborted"
+                    and self.interval > 0 and elapsed > self.interval):
+                try:
+                    self.on_overrun(self.name)
+                except Exception:  # noqa: BLE001 — observability must not
+                    log.debug("overrun observer failed", exc_info=True)
 
     def _run_with_retries(self, stop: threading.Event | None) -> str:
         """One tick's outcome: "success", "error" (retries exhausted), or

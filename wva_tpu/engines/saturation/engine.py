@@ -62,8 +62,10 @@ from wva_tpu.config.scale_to_zero import (
     scale_to_zero_retention_seconds,
 )
 from wva_tpu.api.v1alpha1 import (
+    HEALTH_CONDITIONS,
     OptimizedAlloc,
     REASON_OPTIMIZATION_SUCCEEDED,
+    TYPE_INPUTS_HEALTHY,
     TYPE_OPTIMIZATION_READY,
     REASON_METRICS_FOUND,
     REASON_METRICS_MISSING,
@@ -73,7 +75,10 @@ from wva_tpu.blackbox.schema import (
     STAGE_CAPACITY,
     STAGE_FINGERPRINT_SKIP,
     STAGE_FORECAST,
+    STAGE_HEALTH,
 )
+from wva_tpu.health import BLACKOUT, FRESH, HEALTH_STATES, InputHealth
+from wva_tpu.health.apply import apply_health_clamps
 from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
 from wva_tpu.collector.source.grouped import GroupedMetricsView
 from wva_tpu.config import Config
@@ -100,6 +105,7 @@ from wva_tpu.constants import (
     LABEL_PHASE,
     WVA_INFORMER_AGE_SECONDS,
     WVA_INFORMER_SYNCED,
+    WVA_INPUT_HEALTH,
     WVA_TICK_MODELS_ANALYZED,
     WVA_TICK_MODELS_SKIPPED,
     WVA_TICK_OBJECT_COPIES,
@@ -205,6 +211,14 @@ FINGERPRINT_QUERIES_SLO = FINGERPRINT_QUERIES_V2 + (
     QUERY_AVG_ITL,
 )
 
+# Load-bearing queries whose cached-slice age classifies a model's metrics
+# freshness for the input-health plane: the pair whose failure aborts
+# collection (KV usage + queue length) — if THESE are old, every decision
+# quantity is old. A healthy tick re-caches them (grouped demux or
+# per-model refresh); stale-serve during an outage does not, so the cache
+# age is exactly "how old is the data we are deciding on".
+HEALTH_AGE_QUERIES = (QUERY_KV_CACHE_USAGE, QUERY_QUEUE_LENGTH)
+
 METRICS_REASON_AVAILABLE = REASON_METRICS_FOUND
 METRICS_REASON_UNAVAILABLE = REASON_METRICS_MISSING
 METRICS_MESSAGE_AVAILABLE = "Saturation metrics data is available for scaling decisions"
@@ -215,25 +229,29 @@ METRICS_MESSAGE_UNAVAILABLE = (
 _status_material = variant_utils.va_status_material
 
 
-def _conditions_material_with(va, ctype: str, status: str, reason: str,
-                              message: str) -> tuple:
+def _conditions_material_with(va, *upserts: tuple[str, str, str, str],
+                              drop: tuple[str, ...] = ()) -> tuple:
     """The conditions slice of ``va_status_material`` AS IF
-    ``va.set_condition(ctype, status, reason, message)`` had run —
-    upsert-in-place, append-if-absent — computed without mutating the
-    (frozen, store-shared) object. Lets the writer skip both the status
-    PUT and the copy-on-write clone when nothing material would change."""
+    ``va.set_condition(ctype, status, reason, message)`` had run for each
+    upsert in order — upsert-in-place, append-if-absent — and any ``drop``
+    types had been removed, computed without mutating the (frozen,
+    store-shared) object. Lets the writer skip both the status PUT and
+    the copy-on-write clone when nothing material would change."""
     gen = va.metadata.generation
+    by_type = {u[0]: u for u in upserts}
     out = []
-    found = False
     for c in va.status.conditions:
-        if c.type == ctype:
-            out.append((ctype, status, reason, message, gen))
-            found = True
+        if c.type in drop:
+            continue
+        u = by_type.pop(c.type, None)
+        if u is not None:
+            out.append((u[0], u[1], u[2], u[3], gen))
         else:
             out.append((c.type, c.status, c.reason, c.message,
                         c.observed_generation))
-    if not found:
-        out.append((ctype, status, reason, message, gen))
+    for u in upserts:
+        if u[0] in by_type:  # not present on the object: appended in order
+            out.append((u[0], u[1], u[2], u[3], gen))
     return tuple(out)
 
 
@@ -269,6 +287,7 @@ class SaturationEngine:
         analysis_workers: int = DEFAULT_ANALYSIS_WORKERS,
         forecast_planner=None,
         capacity=None,
+        health=None,
     ) -> None:
         self.client = client
         self.config = config
@@ -312,6 +331,27 @@ class SaturationEngine:
         # chips the same tick. None = static inventory, decisions
         # byte-identical to pre-capacity builds.
         self.capacity = capacity
+        # Optional health.InputHealthMonitor (WVA_HEALTH, default on from
+        # build_manager): per-model input-trust ladder (FRESH -> DEGRADED
+        # -> BLACKOUT) over collector slice ages, scrape coverage, and
+        # control-plane staleness, gating final decisions do-no-harm
+        # (docs/design/health.md). None = pre-health behavior: decisions,
+        # statuses, and traces byte-identical in a fault-free world.
+        self.health = health
+        # Tick-scoped health state: per-model classification (gate +
+        # condition + gauges consume it) and per-model scrape coverage
+        # (scraped pods vs expected ready pods, captured during analysis).
+        self._tick_health: dict[str, InputHealth] = {}
+        self._tick_coverage: dict[str, tuple[int, int]] = {}
+        # Accelerator variants serving BLACKED-OUT models this tick: the
+        # capacity pass holds exactly these variants' order expiry
+        # (per-variant — one model's blackout must not suppress an
+        # unrelated healthy variant's wedge detection).
+        self._tick_hold_variants: frozenset[str] = frozenset()
+        self._health_gauge_keys: set[tuple] = set()
+        # Introspection for bench-chaos: non-fresh models + clamps applied
+        # last tick.
+        self.last_tick_health: dict[str, int] = {}
         # Cumulative preempted-slice counts the capacity gauge sweep saw
         # last tick (counter emission needs deltas), and the limiter's
         # per-tick discovery snapshot handed to the capacity pass.
@@ -608,6 +648,7 @@ class SaturationEngine:
         # Analyzer selection by name (reference engine.go:236-254); "slo"
         # reuses the V2 optimizer/enforcer flow with the queueing-model
         # analyzer producing req/s capacities instead of token capacities.
+        self._tick_coverage = {}
         if analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
             decisions = self._optimize_v2(
                 model_groups, snap, use_slo=analyzer_name == SLO_ANALYZER_NAME,
@@ -617,6 +658,11 @@ class SaturationEngine:
                                           collector=collector, clean=clean,
                                           fingerprints=fingerprints)
 
+        # Input-health gate (WVA_HEALTH): the do-no-harm clamp on FINAL
+        # (post-limiter) decisions — holds/freezes are absolute, so they
+        # must have the last word — recorded as a stage so replay
+        # re-applies them, BEFORE the decisions themselves are recorded.
+        self._apply_health_gate(decisions, va_map)
         if self.flight is not None:
             self.flight.record_decisions(decisions)
         apply_start = time.perf_counter()
@@ -625,6 +671,7 @@ class SaturationEngine:
         self._apply_capacity()
         self._emit_trend_metrics(analyzer_name)
         self._emit_control_plane_metrics()
+        self._emit_health_metrics()
         self._phase_seconds["apply"] = time.perf_counter() - apply_start
 
     def _emit_trend_metrics(self, analyzer_name: str) -> None:
@@ -652,6 +699,184 @@ class SaturationEngine:
             registry.remove(WVA_TREND_SERIES_SAMPLES, labels)
             registry.remove(WVA_TREND_SERIES_STALENESS_SECONDS, labels)
         self._trend_gauge_keys = emitted
+
+    # --- input-health plane (docs/design/health.md) ---
+
+    def _note_coverage(self, group_key: str, data: "_ModelData") -> None:
+        """Capture this tick's scrape coverage for one analyzed model:
+        distinct pods that answered the metrics queries vs the pods the
+        ready fleet should expose (ready slices x hosts per slice). A
+        partial label-subset response from the metrics backend looks like
+        a SUCCESSFUL query with fewer pods — ages never move, but the
+        analyzer would see half the load and scale down; coverage is the
+        signal that catches it."""
+        if self.health is None:
+            return
+        scraped = len({rm.pod_name for rm in data.replica_metrics
+                       if rm.pod_name})
+        # Expected floor in SLICES: every ready slice exposes at least one
+        # scrapable pod (leader) regardless of hosts-per-slice, while a
+        # host-count comparison would flag leader-only multi-host engines
+        # as permanently partial.
+        expected = sum(vs.ready_replicas for vs in data.variant_states)
+        self._tick_coverage[group_key] = (scraped, expected)
+
+    def _control_plane_staleness(self) -> float:
+        """K8s-side input age BEYOND the informer's resync bound. A healthy
+        informer store is never older than resync_seconds (the per-tick
+        resync re-LISTs it), so only the excess counts — during an
+        apiserver storm the re-LIST fails, events stop, and this grows.
+        0 for non-informer clients (every tick LISTs live)."""
+        stats_fn = getattr(self.client, "stats", None)
+        if not callable(stats_fn) or not getattr(self.client,
+                                                 "lists_are_local", False):
+            return 0.0
+        resync = float(getattr(self.client, "resync_seconds", 0.0) or 0.0)
+        worst = 0.0
+        for st in stats_fn().values():
+            age = st.get("age_seconds", -1.0)
+            if age >= 0:
+                worst = max(worst, age - resync)
+        return max(0.0, worst)
+
+    def _assess_health(self, model_groups: dict,
+                       collector: ReplicaMetricsCollector) -> None:
+        """Classify every model's input trust this tick. Runs after the
+        per-model analysis merge (the coverage signal needs this tick's
+        scraped-pod counts) and BEFORE forecast floors and the decision
+        gate consume the classification. Models that skipped analysis
+        (clean fingerprint) still classify — their cache ages and the
+        control-plane staleness are tick-global signals."""
+        self._tick_health = {}
+        if self.health is None:
+            return
+        now = self.clock.now()
+        control_age = self._control_plane_staleness()
+        age_fn = getattr(getattr(collector, "source", None),
+                         "slice_age_seconds", None)
+        for key in sorted(model_groups):
+            vas = model_groups[key]
+            age = None
+            if callable(age_fn):
+                try:
+                    age = age_fn(HEALTH_AGE_QUERIES, {
+                        PARAM_MODEL_ID: vas[0].spec.model_id,
+                        PARAM_NAMESPACE: vas[0].metadata.namespace})
+                except Exception:  # noqa: BLE001 — the probe must never
+                    age = None     # fail the tick; unknown age degrades
+            scraped, expected = self._tick_coverage.get(key, (None, None))
+            self._tick_health[key] = self.health.observe(
+                key, now, metrics_age=age, control_age=control_age,
+                scraped=scraped, ready=expected)
+
+    def _blackout_keys(self) -> frozenset[str]:
+        """``ns|model`` keys (the forecast no-floor key shape) of models
+        in BLACKOUT: proactive floors are withheld — a floor computed from
+        history is still a capacity CHANGE, and blackout means no input
+        justifies changing anything."""
+        out = set()
+        for key, h in self._tick_health.items():
+            if h.state == BLACKOUT:
+                model, _, ns = key.rpartition("|")
+                out.add(f"{ns}|{model}")
+        return frozenset(out)
+
+    def _apply_health_gate(self, decisions: list[VariantDecision],
+                           va_map: dict[str, VariantAutoscaling]) -> None:
+        """The do-no-harm clamp on final decisions (docs/design/health.md):
+        DEGRADED and recovery-window models keep scale-ups but hold the
+        last-known-good floor; BLACKOUT models freeze desired outright and
+        never scale a serving variant to zero. Clamps are flight-recorded
+        (STAGE_HEALTH) so replay re-applies them via the shared
+        health.apply path."""
+        if self.health is None:
+            self.last_tick_health = {}
+            self._tick_hold_variants = frozenset()
+            return
+        now = self.clock.now()
+        stats = {"degraded": 0, "blackout": 0, "recovering": 0,
+                 "clamped": 0}
+        for h in self._tick_health.values():
+            if h.state == BLACKOUT:
+                stats["blackout"] += 1
+            elif h.state != FRESH:
+                stats["degraded"] += 1
+            elif not h.allow_scale_down:
+                stats["recovering"] += 1
+        clamps: list[dict] = []
+        for d in decisions:
+            h = self._tick_health.get(f"{d.model_id}|{d.namespace}")
+            if h is None:
+                continue
+            held = self.health.held_desired(d.namespace, d.variant_name)
+            target = self.health.gate_target(h, d.target_replicas,
+                                             d.current_replicas, held)
+            if target != d.target_replicas:
+                verb = "frozen" if h.state == BLACKOUT else "held"
+                clamps.append({
+                    "variant_name": d.variant_name,
+                    "namespace": d.namespace,
+                    "model_id": d.model_id,
+                    "state": h.state,
+                    "target_replicas": target,
+                    "reason": (f"input health {h.state}: desired {verb} at "
+                               f"{target} ({h.reason})"),
+                })
+        stats["clamped"] = apply_health_clamps(decisions, clamps, now=now)
+        # Post-gate targets become the new last-known-good (BLACKOUT ticks
+        # never move it — the frozen value IS the LKG); blacked-out
+        # models' variants are collected for the capacity expiry hold.
+        hold_variants: set[str] = set()
+        for d in decisions:
+            h = self._tick_health.get(f"{d.model_id}|{d.namespace}")
+            if h is not None and h.state == BLACKOUT and d.accelerator_name:
+                hold_variants.add(d.accelerator_name)
+            self.health.note_emitted(d.namespace, d.variant_name,
+                                     d.target_replicas,
+                                     h.state if h is not None else FRESH)
+        self._tick_hold_variants = frozenset(hold_variants)
+        self.health.prune(
+            set(self._tick_health),
+            {(va.metadata.namespace, va.metadata.name)
+             for va in va_map.values()})
+        self.last_tick_health = stats
+        if self.flight is not None and (
+                clamps or stats["degraded"] or stats["blackout"]
+                or stats["recovering"]):
+            states = []
+            for key in sorted(self._tick_health):
+                h = self._tick_health[key]
+                model, _, ns = key.rpartition("|")
+                states.append({
+                    "model_id": model, "namespace": ns, "state": h.state,
+                    "age_seconds": round(h.age_seconds, 3),
+                    "allow_scale_down": h.allow_scale_down,
+                })
+            self.flight.record_stage(STAGE_HEALTH, {
+                "states": states, "clamps": clamps})
+
+    def _emit_health_metrics(self) -> None:
+        """wva_input_health{model, namespace, state} one-hot gauges, swept
+        for deleted models like the trend/forecast gauges."""
+        registry = getattr(self.actuator, "registry", None)
+        if registry is None or self.health is None:
+            return
+        emitted: set[tuple] = set()
+        for key in sorted(self._tick_health):
+            h = self._tick_health[key]
+            model, _, ns = key.rpartition("|")
+            labels = {LABEL_MODEL_NAME: model, LABEL_NAMESPACE: ns}
+            emitted.add((model, ns))
+            for state in HEALTH_STATES:
+                registry.set_gauge(WVA_INPUT_HEALTH,
+                                   {**labels, LABEL_STATE: state},
+                                   1.0 if state == h.state else 0.0)
+        for model, ns in self._health_gauge_keys - emitted:
+            labels = {LABEL_MODEL_NAME: model, LABEL_NAMESPACE: ns}
+            for state in HEALTH_STATES:
+                registry.remove(WVA_INPUT_HEALTH,
+                                {**labels, LABEL_STATE: state})
+        self._health_gauge_keys = emitted
 
     # --- dirty-set incremental ticks (docs/design/informer.md) ---
 
@@ -1142,6 +1367,7 @@ class SaturationEngine:
                 self._emit_safety_net_metrics(model_vas, snap)
                 continue
             data, analysis, targets, sat_cfg = value
+            self._note_coverage(group_key, data)
             saturation_targets = dict(targets)  # pre-enforcement snapshot
 
             s2z_cfg = self.config.scale_to_zero_config_for_namespace(namespace)
@@ -1173,6 +1399,7 @@ class SaturationEngine:
             all_decisions.extend(model_decisions)
             self._memoize_model(group_key, fingerprints, model_decisions)
 
+        self._assess_health(model_groups, collector)
         self._apply_limiter(all_decisions)
         return all_decisions
 
@@ -1341,6 +1568,7 @@ class SaturationEngine:
                 self._emit_safety_net_metrics(model_vas, snap)
                 continue
             data, sat_cfg, scheduler_queue, out = value
+            self._note_coverage(group_key, data)
             if group_key in sizing_errors:
                 log.error("SLO sizing failed for %s: %s", model_id,
                           sizing_errors[group_key])
@@ -1394,6 +1622,12 @@ class SaturationEngine:
             requests.append(ModelScalingRequest(
                 model_id=model_id, namespace=namespace, result=result,
                 variant_states=data.variant_states))
+
+        # Health classification needs this tick's coverage (captured in the
+        # merge above) and must exist BEFORE forecast floors consume the
+        # blackout set — and even on all-quiet ticks, for the status
+        # condition and gauges.
+        self._assess_health(model_groups, collector)
 
         if not requests and not cached_decisions:
             if self.capacity is not None:
@@ -1490,6 +1724,11 @@ class SaturationEngine:
         no_floor = frozenset(
             f"{ns}|{model}" for (model, ns), route in (routes or {}).items()
             if route == "global")
+        # Blacked-out models get the planner's learning pass but never a
+        # floor: a floor is a capacity CHANGE, and blackout means no
+        # trusted input justifies changing anything (the health gate would
+        # freeze it back anyway — withholding keeps the trace honest).
+        no_floor = no_floor | self._blackout_keys()
         try:
             plans, floors = self.forecast.plan(requests, now,
                                                no_floor_keys=no_floor)
@@ -1553,7 +1792,15 @@ class SaturationEngine:
         if self.capacity is None:
             return
         try:
-            event = self.capacity.tick(slices=self._tick_slices)
+            # Blacked-out models withhold capacity releases for THEIR
+            # variants this tick: in-flight orders keep their planning
+            # credit (an expiry surrenders capacity that would have to be
+            # re-ordered on recovery) — per variant, so an unrelated
+            # healthy variant's genuinely wedged order still expires on
+            # its own trusted evidence.
+            event = self.capacity.tick(
+                slices=self._tick_slices,
+                hold_releases=self._tick_hold_variants)
         except Exception as e:  # noqa: BLE001 — capacity must never fail
             # the tick: decisions stand as computed.
             log.error("Capacity pass failed: %s", e)
@@ -2002,17 +2249,21 @@ class SaturationEngine:
             # skips near-idle observations (TunerConfig.min_occupancy).
             slots_used = sum(rm.slots_used for rm in rms)
             slots_total = sum(rm.slots_total for rm in rms)
-            if slots_total > 0:
-                occupancy = slots_used / slots_total
-            else:
-                # All-zero KV with no slot telemetry means "no occupancy
-                # signal", not "idle": a genuinely idle fleet produces no
-                # valid tuner environment anyway (zero arrival rate), so
-                # unknown (-1) keeps the gate from eating telemetry whose
-                # collector doesn't export occupancy.
-                kvs = [rm.kv_cache_usage for rm in rms]
-                occupancy = (sum(kvs) / len(kvs)
-                             if any(kv > 0 for kv in kvs) else -1.0)
+            occupancy = (slots_used / slots_total if slots_total > 0
+                         else -1.0)
+            # KV usage rides along as its OWN signal: when slot telemetry
+            # is absent (vLLM collectors), the tuner gates on it as a
+            # binary idle/non-idle check against min_kv_usage — never
+            # compared to the slot-scale min_occupancy (the scales differ:
+            # long-context/low-batch is KV-high/slots-low, short-request/
+            # high-batch is KV-low/slots-high). All-zero KV with no slot
+            # telemetry stays "no signal" (-1): a genuinely idle fleet
+            # produces no valid tuner environment anyway (zero arrival
+            # rate), so unknown keeps the gate from eating telemetry
+            # whose collector doesn't export occupancy.
+            kvs = [rm.kv_cache_usage for rm in rms]
+            kv_occupancy = (sum(kvs) / len(kvs)
+                            if any(kv > 0 for kv in kvs) else -1.0)
             env = TunerEnvironment(
                 # Filter models one replica's queue: per-replica arrival rate.
                 lambda_per_min=lambda_per_min,
@@ -2023,6 +2274,7 @@ class SaturationEngine:
                 avg_ttft_ms=ttft_ms,
                 avg_itl_ms=itl_ms,
                 occupancy=occupancy,
+                kv_occupancy=kv_occupancy,
             )
             self.slo_tuner.observe(namespace, model_id, accelerator, env)
 
@@ -2282,11 +2534,34 @@ class SaturationEngine:
                 f"(target: {target_replicas} replicas)"
                 if decision is not None
                 else "Optimization loop ran (no scaling change needed)")
+            upserts = [(TYPE_OPTIMIZATION_READY, "True",
+                        cond_reason, cond_message)]
+            # Input-health condition (WVA_HEALTH): the status says when a
+            # decision was made blind instead of degrading silently.
+            # Content is keyed off the ladder state with STABLE messages,
+            # so a steady health state never churns status writes; with
+            # the health plane off the condition is never written
+            # (pre-change status bytes).
+            health_state = None
+            drop_conds: tuple[str, ...] = ()
+            if self.health is not None:
+                h = self._tick_health.get(
+                    f"{update_va.spec.model_id}|{update_va.metadata.namespace}")
+                if h is not None:
+                    health_state = (h.state if h.state != FRESH
+                                    or h.allow_scale_down else "recovering")
+                    upserts.append((TYPE_INPUTS_HEALTHY,)
+                                   + HEALTH_CONDITIONS[health_state])
+            elif update_va.get_condition(TYPE_INPUTS_HEALTHY) is not None:
+                # Plane disabled after a condition was written (operator
+                # turned WVA_HEALTH off mid-incident): remove it, or the
+                # status would report frozen-on-untrusted-inputs forever
+                # while decisions actually flow normally.
+                drop_conds = (TYPE_INPUTS_HEALTHY,)
             new_material = (
                 accelerator, target_replicas, applied, lead_value,
-                _conditions_material_with(
-                    update_va, TYPE_OPTIMIZATION_READY, "True",
-                    cond_reason, cond_message))
+                _conditions_material_with(update_va, *upserts,
+                                          drop=drop_conds))
             persisted = True
             if (new_material != prev_material
                     or now - prev_run_time >= STATUS_HEARTBEAT_SECONDS):
@@ -2302,6 +2577,16 @@ class SaturationEngine:
                 update_va.set_condition(
                     TYPE_OPTIMIZATION_READY, "True", cond_reason,
                     cond_message, now=now)
+                if health_state is not None:
+                    status_v, h_reason, h_message = \
+                        HEALTH_CONDITIONS[health_state]
+                    update_va.set_condition(
+                        TYPE_INPUTS_HEALTHY, status_v, h_reason,
+                        h_message, now=now)
+                elif drop_conds:
+                    update_va.status.conditions = [
+                        c for c in update_va.status.conditions
+                        if c.type not in drop_conds]
                 try:
                     # Writes always target the LIVE client: a 409 from a
                     # snapshot-stale resourceVersion refetches just the

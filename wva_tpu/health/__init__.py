@@ -1,0 +1,25 @@
+"""Input-health plane (``WVA_HEALTH``, default on; docs/design/health.md):
+per-model trust classification (FRESH -> DEGRADED -> BLACKOUT) over
+collector slice ages, scrape coverage, and control-plane staleness, plus
+the do-no-harm decision gate the engine applies post-limiter."""
+
+from wva_tpu.health.apply import HEALTH_STEP, apply_health_clamps
+from wva_tpu.health.monitor import (
+    BLACKOUT,
+    DEGRADED,
+    FRESH,
+    HEALTH_STATES,
+    InputHealth,
+    InputHealthMonitor,
+)
+
+__all__ = [
+    "BLACKOUT",
+    "DEGRADED",
+    "FRESH",
+    "HEALTH_STATES",
+    "HEALTH_STEP",
+    "InputHealth",
+    "InputHealthMonitor",
+    "apply_health_clamps",
+]

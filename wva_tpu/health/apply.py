@@ -1,0 +1,51 @@
+"""Shared application of health-gate clamps to decisions.
+
+One function used by BOTH the live engine (which computes the clamps from
+monitor state) and trace replay (which re-applies the RECORDED clamps —
+monitor state, like the forecast planner's, is not reconstructable from a
+single cycle). Sharing the mutation keeps recorded and replayed decisions
+byte-identical.
+
+Deliberately import-light (no JAX, no engine modules): the offline replay
+CLI must stay cheap to load.
+"""
+
+from __future__ import annotations
+
+from wva_tpu.interfaces import (
+    ACTION_NO_CHANGE,
+    ACTION_SCALE_DOWN,
+    ACTION_SCALE_UP,
+)
+
+# Step/reason prefix on every health-gated decision (greppable in events,
+# statuses, and traces).
+HEALTH_STEP = "health"
+
+
+def apply_health_clamps(decisions, clamps, now: float = 0.0) -> int:
+    """Apply health clamps (``[{variant_name, namespace, target_replicas,
+    state, reason}]``) to matching decisions in place; returns how many
+    decisions changed. The clamp value REPLACES the target (holds and
+    freezes are absolute, unlike forecast floors which only raise)."""
+    if not clamps:
+        return 0
+    by_key = {(d.namespace, d.variant_name): d for d in decisions}
+    changed = 0
+    for clamp in clamps:
+        d = by_key.get((clamp.get("namespace", ""),
+                        clamp.get("variant_name", "")))
+        if d is None:
+            continue
+        target = int(clamp.get("target_replicas", d.target_replicas))
+        if target == d.target_replicas:
+            continue
+        d.target_replicas = target
+        d.action = (ACTION_SCALE_UP if target > d.current_replicas
+                    else ACTION_SCALE_DOWN if target < d.current_replicas
+                    else ACTION_NO_CHANGE)
+        reason = clamp.get("reason", "input health hold")
+        d.reason = reason
+        d.add_step(HEALTH_STEP, reason, now=now)
+        changed += 1
+    return changed

@@ -1,0 +1,218 @@
+"""Input-health plane: per-model trust classification for the decision loop
+(docs/design/health.md).
+
+The serve-stale cache (`collector/source/cache.py`) keeps the engine fed
+through a Prometheus outage — which is exactly why a sustained outage is
+dangerous: analysis keeps running on arbitrarily old slices and can scale a
+busy model down, or to zero, on frozen data. Autopilot's core safety
+property ("never act on inputs you can't trust") maps here to a per-model
+ladder:
+
+- ``FRESH``     — inputs young and complete: decisions flow unchanged.
+- ``DEGRADED``  — input age past ``degraded_after`` OR the scraped-replica
+  coverage regressed below the ready fleet (partial label-subset
+  responses look like a successful query): hold the last-known-good
+  desired, allow scale-UP (queue/backlog pressure may be real), forbid
+  scale-down.
+- ``BLACKOUT``  — input age past ``freeze_after``: freeze desired at the
+  last-known-good value and hard-forbid scale-to-zero.
+
+Exiting the ladder is hysteretic: ``recovery_ticks`` CONSECUTIVE fresh
+observations are required before scale-downs resume (the first fresh slice
+after an outage may still describe a world half-way through recovering).
+
+The monitor is pure bookkeeping — the engine feeds it observed ages and
+coverage each tick and applies the returned gate to final decisions; the
+clamps are flight-recorded (``STAGE_HEALTH``) so replay re-applies them
+byte-for-byte without reconstructing monitor state.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+# Ladder states (also the `state` label values of wva_input_health).
+FRESH = "fresh"
+DEGRADED = "degraded"
+BLACKOUT = "blackout"
+HEALTH_STATES = (FRESH, DEGRADED, BLACKOUT)
+
+# Defaults: aligned with the freshness-threshold vocabulary the collector
+# already classifies sample age with (stale_threshold / the serve-stale
+# cutoff unavailable_threshold).
+DEFAULT_DEGRADED_AFTER = 120.0
+DEFAULT_FREEZE_AFTER = 300.0
+DEFAULT_RECOVERY_TICKS = 3
+
+
+@dataclass
+class InputHealth:
+    """One model's classification this tick."""
+
+    state: str = FRESH
+    age_seconds: float = 0.0
+    # False while in the post-outage hysteresis window (state is FRESH but
+    # scale-downs have not resumed yet) and in every non-FRESH state.
+    allow_scale_down: bool = True
+    reason: str = ""
+
+
+@dataclass
+class _ModelBook:
+    # Newest instant the model's inputs were observed fresh-from-backend
+    # (None = never observed; not 0.0, which is a legal clock reading).
+    last_good_at: float | None = None
+    fresh_streak: int = 0
+    in_recovery: bool = False
+    # Coverage bookkeeping: consecutive ticks with fewer scraped pods
+    # than ready replicas, and the scraped count of the last FULL pass.
+    cov_shortfall_ticks: int = 0
+    last_full_scraped: int | None = None
+
+
+class InputHealthMonitor:
+    """Tracks per-model input trust across ticks (thread-safe: the engine
+    observes on its own thread, tests poke from others)."""
+
+    def __init__(self, degraded_after: float = DEFAULT_DEGRADED_AFTER,
+                 freeze_after: float = DEFAULT_FREEZE_AFTER,
+                 recovery_ticks: int = DEFAULT_RECOVERY_TICKS) -> None:
+        self.degraded_after = degraded_after
+        self.freeze_after = max(freeze_after, degraded_after)
+        self.recovery_ticks = max(1, int(recovery_ticks))
+        self._mu = threading.Lock()
+        self._books: dict[str, _ModelBook] = {}
+        # (namespace, variant) -> the desired value last emitted through
+        # the gate while inputs were trusted (or raised by an allowed
+        # scale-up) — the "last-known-good desired" a hold pins to.
+        self._held: dict[tuple[str, str], int] = {}
+
+    # --- per-tick observation ---
+
+    def observe(self, key: str, now: float,
+                metrics_age: float | None = None,
+                control_age: float = 0.0,
+                scraped: int | None = None,
+                ready: int | None = None) -> InputHealth:
+        """Classify one model. ``metrics_age`` is the age of its oldest
+        load-bearing cached slice (None = nothing cached this tick — the
+        age keeps growing from the last good observation); ``control_age``
+        is the K8s-side staleness beyond the informer's resync bound;
+        ``scraped``/``ready`` feed the coverage regression check (None =
+        not measured this tick, e.g. a fingerprint-skipped model)."""
+        with self._mu:
+            book = self._books.setdefault(key, _ModelBook())
+            if metrics_age is not None:
+                book.last_good_at = (now - metrics_age
+                                     if book.last_good_at is None
+                                     else max(book.last_good_at,
+                                              now - metrics_age))
+            elif book.last_good_at is None:
+                # Never observed (fresh model, or restart into an outage
+                # with an empty cache): no age basis — start the clock now
+                # rather than inventing an infinite outage.
+                book.last_good_at = now
+            age = max(now - book.last_good_at, control_age)
+
+            # Coverage: fewer pods answered than replicas are READY. A
+            # legitimately shrinking fleet keeps scraped >= ready (ready
+            # drops with — or before — the scrape set; deleted pods'
+            # series even outlive them by the staleness window), so a
+            # shortfall means the metrics plane is hiding serving pods:
+            # the analyzer would read the missing load as absent and
+            # scale down. ``ready`` is counted in SLICES (not hosts):
+            # multi-host engines that expose metrics from the leader only
+            # must not read as permanently partial. Against a REAL
+            # Prometheus a just-ready pod's series lag by a scrape
+            # interval, so a shortfall classifies only when the scraped
+            # count DROPPED below the last full pass (an existing pod's
+            # series vanished — never scrape lag) or the shortfall
+            # persisted a second tick (a lagging series appears by then;
+            # a genuinely hidden pod does not).
+            cov_ok = True
+            if scraped is not None:
+                if ready and scraped < ready:
+                    book.cov_shortfall_ticks += 1
+                    dropped = (book.last_full_scraped is not None
+                               and scraped < book.last_full_scraped)
+                    cov_ok = not (dropped
+                                  or book.cov_shortfall_ticks >= 2)
+                else:
+                    book.cov_shortfall_ticks = 0
+                    book.last_full_scraped = scraped
+
+            if age > self.freeze_after:
+                state, reason = BLACKOUT, (
+                    f"inputs older than {self.freeze_after:.0f}s")
+            elif age > self.degraded_after:
+                state, reason = DEGRADED, (
+                    f"inputs older than {self.degraded_after:.0f}s")
+            elif not cov_ok:
+                state, reason = DEGRADED, (
+                    "scraped replica coverage below ready fleet")
+            else:
+                state, reason = FRESH, ""
+
+            if state == FRESH:
+                book.fresh_streak += 1
+                if (book.in_recovery
+                        and book.fresh_streak >= self.recovery_ticks):
+                    book.in_recovery = False
+            else:
+                book.fresh_streak = 0
+                book.in_recovery = True
+            allow_down = state == FRESH and not book.in_recovery
+            if state == FRESH and book.in_recovery:
+                reason = (f"fresh {book.fresh_streak}/{self.recovery_ticks}"
+                          " ticks since degradation")
+            return InputHealth(state=state, age_seconds=age,
+                               allow_scale_down=allow_down, reason=reason)
+
+    # --- gate ---
+
+    def held_desired(self, namespace: str, variant: str) -> int | None:
+        with self._mu:
+            return self._held.get((namespace, variant))
+
+    def gate_target(self, health: InputHealth, target: int, current: int,
+                    held: int | None) -> int:
+        """The do-no-harm target for one variant decision. FRESH with
+        scale-down allowed passes through; the hysteresis window and
+        DEGRADED hold the last-known-good floor (scale-ups pass);
+        BLACKOUT freezes at the last-known-good value and never lets a
+        serving variant reach zero.
+
+        Both floors take max(held, current): CURRENT replicas may exceed
+        our last-known-good when an out-of-band actor raised them (an
+        operator scaling up manually exactly because the autoscaler is
+        blind) — emitting the stale held value would be a scale-down on
+        untrusted inputs, the one thing this gate exists to forbid. The
+        symmetric case (our own in-flight scale-down, current still
+        draining above held) resolves the same way: keeping capacity is
+        the do-no-harm direction."""
+        if health.state == BLACKOUT:
+            frozen = max(held if held is not None else 0, current, 0)
+            return frozen
+        if health.state == DEGRADED or not health.allow_scale_down:
+            floor = max(held if held is not None else 0, current)
+            return max(target, floor)
+        return target
+
+    def note_emitted(self, namespace: str, variant: str, target: int,
+                     state: str) -> None:
+        """Record the gate's final output as the new last-known-good.
+        BLACKOUT ticks never move it (the frozen value IS the LKG);
+        DEGRADED ticks can only have raised it (allowed scale-ups)."""
+        if state != BLACKOUT:
+            with self._mu:
+                self._held[(namespace, variant)] = target
+
+    def prune(self, active_keys: set[str],
+              active_variants: set[tuple[str, str]]) -> None:
+        """Deleted models/variants must not pin state forever."""
+        with self._mu:
+            for key in [k for k in self._books if k not in active_keys]:
+                del self._books[key]
+            for vk in [k for k in self._held if k not in active_variants]:
+                del self._held[vk]

@@ -80,11 +80,33 @@ class _Handler(BaseHTTPRequestHandler):
     # per-tick request budget over real sockets.
     http_requests: dict = {}
     _http_requests_mu = threading.Lock()
+    # Optional emulator.faults.FaultInjector (chaos harness): consulted
+    # before every verb (503/429/latency) and inside the watch stream
+    # loop (unclean mid-flight drops).
+    fault_injector = None
 
     def _count_http(self, verb: str, kind: str) -> None:
         with self._http_requests_mu:
             key = (verb, kind)
             self.http_requests[key] = self.http_requests.get(key, 0) + 1
+
+    def _inject_fault(self, verb: str) -> bool:
+        """Chaos hook: when a fault plan says this request fails, answer
+        with the injected status (after any injected latency) and skip
+        the real handler. Returns True when the request was consumed."""
+        fi = self.fault_injector
+        if fi is None:
+            return False
+        act = fi.api_fault(verb, self.path)
+        if act is None:
+            return False
+        if act.latency_seconds > 0:
+            time.sleep(act.latency_seconds)
+        self._send_status_error(
+            act.status,
+            "TooManyRequests" if act.status == 429 else "ServiceUnavailable",
+            "chaos fault injection")
+        return True
 
     # --- helpers ---
 
@@ -172,6 +194,8 @@ class _Handler(BaseHTTPRequestHandler):
     # --- verbs ---
 
     def do_GET(self) -> None:  # noqa: N802
+        if self._inject_fault("get"):
+            return
         if not self._authorized():
             return
         routed = self._route()
@@ -208,6 +232,8 @@ class _Handler(BaseHTTPRequestHandler):
                                     details={"name": name, "kind": kind})
 
     def do_POST(self) -> None:  # noqa: N802
+        if self._inject_fault("post"):
+            return
         if not self._authorized():
             return
         path = urlparse(self.path).path
@@ -232,6 +258,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status_error(409, "AlreadyExists", str(e))
 
     def do_PUT(self) -> None:  # noqa: N802
+        if self._inject_fault("put"):
+            return
         if not self._authorized():
             return
         routed = self._route()
@@ -255,6 +283,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status_error(409, "Conflict", str(e))
 
     def do_PATCH(self) -> None:  # noqa: N802
+        if self._inject_fault("patch"):
+            return
         if not self._authorized():
             return
         routed = self._route()
@@ -300,6 +330,8 @@ class _Handler(BaseHTTPRequestHandler):
                                     details={"name": name, "kind": kind})
 
     def do_DELETE(self) -> None:  # noqa: N802
+        if self._inject_fault("delete"):
+            return
         if not self._authorized():
             return
         routed = self._route()
@@ -411,6 +443,7 @@ class _Handler(BaseHTTPRequestHandler):
                            "gap — re-list required"}})
 
         clean_end = False
+        dropped = False
         try:
             if since_rv:
                 for obj in self.cluster.list(kind, namespace=namespace or None):
@@ -428,6 +461,14 @@ class _Handler(BaseHTTPRequestHandler):
                     # with a hole in it.
                     send_gone()
                     break
+                if (self.fault_injector is not None
+                        and self.fault_injector.watch_drop_now()):
+                    # Chaos: kill the stream UNCLEANLY (no chunked
+                    # terminator) — the client must treat it as a gap and
+                    # go through its re-list + backoff path, exactly like
+                    # an apiserver crash mid-stream.
+                    dropped = True
+                    break
                 try:
                     event, obj = events.get(timeout=0.2)
                 except queue.Empty:
@@ -435,7 +476,7 @@ class _Handler(BaseHTTPRequestHandler):
                         break
                     continue
                 send(event, obj)
-            clean_end = True
+            clean_end = not dropped
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass  # client went away
         finally:
@@ -461,7 +502,8 @@ class FakeAPIServer:
     def __init__(self, cluster: FakeCluster, port: int = 0,
                  bearer_token: str = "",
                  sa_tokens: dict[str, str] | None = None,
-                 metrics_readers: set | None = None) -> None:
+                 metrics_readers: set | None = None,
+                 fault_injector=None) -> None:
         self.cluster = cluster
         self._http_requests: dict = {}
         handler = type("Handler", (_Handler,), {
@@ -472,6 +514,7 @@ class FakeAPIServer:
             "metrics_readers": set(metrics_readers or ()),
             "http_requests": self._http_requests,
             "_http_requests_mu": threading.Lock(),
+            "fault_injector": fault_injector,
         })
         self._handler_cls = handler
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -489,6 +532,11 @@ class FakeAPIServer:
                                         name="fake-apiserver", daemon=True)
         self._thread.start()
         return self
+
+    def set_fault_injector(self, fi) -> None:
+        """Install/replace the chaos FaultInjector live (tests toggle
+        faults around specific requests)."""
+        self._handler_cls.fault_injector = fi
 
     def request_counts(self) -> dict:
         """Copy of (verb, kind) -> HTTP request count since start/reset."""

@@ -195,18 +195,65 @@ class InformerKubeClient(KubeClient):
     def resync_if_stale(self) -> list[str]:
         """Re-LIST kinds whose last list is older than ``resync_seconds``;
         returns the kinds refreshed. Driven from the engine tick so a
-        simulated clock advances it deterministically (no timer thread)."""
+        simulated clock advances it deterministically (no timer thread).
+
+        A FAILED re-LIST (apiserver storm) must not fail the caller's
+        tick, and — crucially — must not leave the kind wedged in
+        buffering mode: buffered events are replayed onto the EXISTING
+        store so the watch stream keeps the informer as fresh as it can
+        be while the list path is down, and the next tick retries the
+        list. Without the replay, one failed resync froze the store until
+        the next SUCCESSFUL list even though live events kept arriving —
+        exactly the silent-staleness failure the input-health plane
+        exists to classify."""
         if not self._started or self.resync_seconds <= 0:
             return []
         now = self.clock.now()
         stale = [k for k in self.kinds
                  if now - self._last_list.get(k, 0.0) > self.resync_seconds]
+        refreshed = []
         for kind in stale:
             with self._mu:
                 self._buffering.add(kind)
                 self._buffer.setdefault(kind, [])
-            self._list_kind(kind)
-        return stale
+            try:
+                self._list_kind(kind)
+                refreshed.append(kind)
+            except Exception as e:  # noqa: BLE001 — a storm-failed list
+                # degrades to watch-fed staleness, never a failed tick.
+                log.warning("informer resync LIST failed for %s "
+                            "(retrying next tick): %s", kind, e)
+                self._abort_buffering(kind)
+        return refreshed
+
+    def _abort_buffering(self, kind: str) -> None:
+        """A (re)LIST failed: exit buffering mode by applying the held
+        events to the CURRENT store (the same application path _on_event
+        uses), so the watch stream keeps the store converging while the
+        list path is down. Unlike successful-list replay — where the list
+        itself is the freshness signal — NO other signal exists here, so
+        material buffered events must still fire the nudge listeners
+        (executor wake-ups, the capacity plane's Node feed)."""
+        replayed: list[tuple[str, Any, Any]] = []
+        with self._mu:
+            self._buffering.discard(kind)
+            buffered = self._buffer.pop(kind, [])
+            if kind not in self._synced:
+                return  # initial list never succeeded: nothing to apply to
+            for event, obj in buffered:
+                prev = self._apply_event_locked(kind, event, obj)
+                replayed.append((event, prev, obj))
+            listeners = list(self._nudge_listeners)
+        if not listeners:
+            return
+        for event, prev, obj in replayed:
+            if _material_change(kind, event, prev, obj):
+                for fn in listeners:
+                    try:
+                        fn(kind, event, obj)
+                    except Exception:  # noqa: BLE001 — listener isolation
+                        log.exception("informer nudge listener failed for "
+                                      "%s %s (buffered replay)", event, kind)
 
     # --- event ingestion ---
 
@@ -235,19 +282,7 @@ class InformerKubeClient(KubeClient):
                 return
             if kind not in self._synced:
                 return  # not started for this kind
-            store = self._store.setdefault(kind, {})
-            prev = store.get(key)
-            if event == DELETED:
-                store.pop(key, None)
-            else:
-                store[key] = obj
-            if kind == "Pod":
-                if event == DELETED:
-                    if prev is not None:
-                        self._bump_pod_epoch_locked(ns)
-                elif prev is None or \
-                        _pod_fp_shape(prev) != _pod_fp_shape(obj):
-                    self._bump_pod_epoch_locked(ns)
+            prev = self._apply_event_locked(kind, event, obj)
             self._last_event[kind] = self.clock.now()
             listeners = list(self._nudge_listeners)
         if listeners and _material_change(kind, event, prev, obj):
@@ -257,6 +292,28 @@ class InformerKubeClient(KubeClient):
                 except Exception:  # noqa: BLE001 — listener isolation
                     log.exception("informer nudge listener failed for "
                                   "%s %s", event, kind)
+
+    def _apply_event_locked(self, kind: str, event: str, obj: Any) -> Any:
+        """Apply one watch event to the store (caller holds the lock),
+        bumping the namespace's pod-set epoch on material pod changes.
+        The SINGLE application path shared by live events (_on_event) and
+        failed-resync buffered-event replay (_abort_buffering) — the two
+        must never drift. Returns the previously stored object."""
+        ns = obj.metadata.namespace or ""
+        key = (ns, obj.metadata.name)
+        store = self._store.setdefault(kind, {})
+        prev = store.get(key)
+        if event == DELETED:
+            store.pop(key, None)
+            if kind == "Pod" and prev is not None:
+                self._bump_pod_epoch_locked(ns)
+        else:
+            store[key] = obj
+            if kind == "Pod" and (
+                    prev is None
+                    or _pod_fp_shape(prev) != _pod_fp_shape(obj)):
+                self._bump_pod_epoch_locked(ns)
+        return prev
 
     def _upsert(self, obj: Any) -> None:
         kind = _kind_of(obj)

@@ -228,6 +228,7 @@ def build_manager(
     pod_fetcher=None,
     mirror_wva_metrics: bool = True,
     slice_provisioner=None,
+    prom_api=None,
 ) -> Manager:
     """Wire the full controller (reference cmd/main.go).
 
@@ -237,7 +238,9 @@ def build_manager(
     defaults to HTTP. ``slice_provisioner`` backs the elastic capacity
     plane (WVA_CAPACITY): the emulation harness injects a
     FakeGkeProvisioner; None leaves the NullProvisioner, which plans
-    strictly within discovered inventory.
+    strictly within discovered inventory. ``prom_api`` overrides the
+    metrics backend entirely (the chaos harness wraps the in-memory API
+    with a fault injector); None derives it from ``tsdb``/config.
     """
     clock = clock or SYSTEM_CLOCK
 
@@ -266,10 +269,11 @@ def build_manager(
         mirror_tsdb=tsdb if mirror_wva_metrics else None,
     )
 
-    if tsdb is not None:
-        prom_api = InMemoryPromAPI(tsdb)
-    else:
-        prom_api = HTTPPromAPI.from_config(config.prometheus())
+    if prom_api is None:
+        if tsdb is not None:
+            prom_api = InMemoryPromAPI(tsdb)
+        else:
+            prom_api = HTTPPromAPI.from_config(config.prometheus())
     source_registry = SourceRegistry()
     prom_source = PrometheusSource(prom_api, config.prometheus_cache_config(),
                                    clock=clock)
@@ -386,6 +390,23 @@ def build_manager(
         else:
             client.watch("Node", capacity.on_node_event)
 
+    # Input-health plane (WVA_HEALTH, default on): per-model trust ladder
+    # over collector slice ages, scrape coverage, and control-plane
+    # staleness, with a do-no-harm gate on final decisions — hold
+    # last-known-good under degradation, freeze under blackout, K-tick
+    # hysteresis before scale-downs resume (docs/design/health.md).
+    # Disabled, decisions/statuses/traces are byte-identical to pre-health
+    # builds in a fault-free world.
+    health = None
+    health_cfg = config.health_config()
+    if health_cfg.enabled:
+        from wva_tpu.health import InputHealthMonitor
+
+        health = InputHealthMonitor(
+            degraded_after=health_cfg.degraded_after_seconds,
+            freeze_after=health_cfg.freeze_after_seconds,
+            recovery_ticks=health_cfg.recovery_ticks)
+
     # Analysis pool width 0 = auto, resolved by the metrics backend (same
     # rule as PrometheusSource's query concurrency): per-model collection
     # against HTTP Prometheus is I/O-bound and overlaps across workers; the
@@ -402,7 +423,8 @@ def build_manager(
         flight_recorder=flight,
         analysis_workers=workers,
         forecast_planner=forecast_planner,
-        capacity=capacity)
+        capacity=capacity,
+        health=health)
     engine.grouped_collection = config.grouped_collection_enabled()
     engine.incremental_enabled = config.incremental_enabled()
     engine.resync_ticks = config.resync_ticks()
@@ -423,6 +445,9 @@ def build_manager(
     # metrics equivalent).
     for ex in (engine.executor, scale_from_zero.executor, fastpath.executor):
         ex.on_tick = registry.observe_tick
+        # A tick longer than its poll interval means the loop is falling
+        # behind its own cadence — surfaced as wva_tick_overruns_total.
+        ex.on_overrun = registry.observe_tick_overrun
 
     watch_ns = config.watch_namespace() or ""
     va_reconciler = VariantAutoscalingReconciler(client, datastore, indexer,

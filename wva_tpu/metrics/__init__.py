@@ -43,10 +43,12 @@ from wva_tpu.constants import (
     WVA_FORECAST_LEAD_TIME_SECONDS,
     WVA_INFORMER_AGE_SECONDS,
     WVA_INFORMER_SYNCED,
+    WVA_INPUT_HEALTH,
     WVA_REPLICA_SCALING_TOTAL,
     WVA_TICK_MODELS_ANALYZED,
     WVA_TICK_MODELS_SKIPPED,
     WVA_TICK_OBJECT_COPIES,
+    WVA_TICK_OVERRUNS_TOTAL,
     WVA_TICK_PHASE_SECONDS,
     WVA_TRACE_DROPPED_TOTAL,
     WVA_TRACE_RECORDS_TOTAL,
@@ -88,6 +90,13 @@ class MetricsRegistry:
                        "Wall-clock duration of the last engine tick")
         self._register(WVA_ENGINE_TICKS_TOTAL, "counter",
                        "Engine ticks by outcome (success|error)")
+        self._register(WVA_TICK_OVERRUNS_TOTAL, "counter",
+                       "Ticks whose wall-clock duration exceeded the "
+                       "engine's poll interval (the loop is falling "
+                       "behind its own cadence)")
+        self._register(WVA_INPUT_HEALTH, "gauge",
+                       "Per-model input-health ladder: 1 for the current "
+                       "state (fresh | degraded | blackout), 0 otherwise")
         self._register(WVA_TRACE_RECORDS_TOTAL, "counter",
                        "Decision-trace cycle records committed by the "
                        "flight recorder")
@@ -237,6 +246,13 @@ class MetricsRegistry:
             LABEL_ENGINE: engine,
             LABEL_OUTCOME: "success" if ok else "error",
         })
+
+    def observe_tick_overrun(self, engine: str) -> None:
+        """A tick ran longer than the engine's poll interval: the loop is
+        falling behind its cadence (latency injection, backend timeouts,
+        or genuine fleet growth). Counted separately from tick outcomes —
+        an overrunning loop usually still 'succeeds'."""
+        self.inc_counter(WVA_TICK_OVERRUNS_TOTAL, {LABEL_ENGINE: engine})
 
     def observe_trace_record(self, engine: str) -> None:
         """Flight-recorder health: one committed cycle record."""
