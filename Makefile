@@ -66,6 +66,7 @@ replay-golden: ## Replay the committed golden decision traces (must be zero diff
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/forecast_trace_v1.jsonl
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/capacity_trace_v1.jsonl
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/health_trace_v1.jsonl
+	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/boot_trace_v1.jsonl
 
 .PHONY: backtest-golden
 backtest-golden: ## Backtest every forecaster on the committed golden forecast trace and gate against the committed report (MAPE + under/over-provision cost; a seasonal forecaster must keep beating the linear baseline).
@@ -84,6 +85,10 @@ bench-capacity: ## Elastic-capacity microbench (48 models, seeded preemption sto
 .PHONY: bench-chaos
 bench-chaos: ## Chaos soak (48 models, seeded metrics blackouts / partial responses / 429 storms, health plane on vs off): asserts zero wrong-direction scale events during faults and <=3-tick recovery; merges detail.chaos into BENCH_LOCAL.json.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --chaos-only
+
+.PHONY: bench-failover
+bench-failover: ## Crash-restart + leader-flap storm (48 models, two managers over one world, seeded kills/flaps, checkpoint on AND off): asserts zero wrong-direction scale events in every restart/handover window, zero dual-actuation (one writer per lease epoch), and <=5-tick post-restart reconvergence; merges detail.failover into BENCH_LOCAL.json. FAILOVER_SMOKE=1 runs the short CI shape.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --failover-only $(if $(FAILOVER_SMOKE),--smoke)
 
 .PHONY: verify-deploy-pipeline
 verify-deploy-pipeline: ## Static-check the deploy pipeline (scripts parse, manifests render, Dockerfile paths exist).

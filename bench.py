@@ -2091,6 +2091,252 @@ def chaos_main() -> None:
     }))
 
 
+def failover_storm_bench(n_models: int = 48, duration: float = 1200.0,
+                         engine_interval: float = 15.0,
+                         checkpoint: bool = True, seed: int = 23) -> dict:
+    """Crash-restart + leader-flap storm (``make bench-failover``): a
+    48-model fleet under steady high load with TWO manager processes over
+    one world (leader election on), a seeded schedule of process
+    kill/restarts (mid-tick and between-tick, crash and clean) and
+    voluntary leader flaps, plus one PARTIAL metrics window overlapping a
+    restart (the amnesia trap: the rebooted process sees successful-
+    looking queries missing half the pods).
+
+    Asserts the resilience acceptance criteria:
+
+    - zero wrong-direction scale events inside every restart/handover
+      window (same detection as the chaos bench: a variant whose
+      window-start desired was healthy never has it lowered);
+    - zero dual-actuation: every actuation write (VA status, scale
+      subresource) is attributed to (writer identity, lease epoch) via
+      the per-process SeverableKubeClient boundary — no epoch has two
+      writers, and no actuation ever carries a None epoch (a non-leader
+      never writes);
+    - post-restart reconvergence <= 5 engine ticks (boot ramp released,
+      no clamps) for events outside fault windows;
+    - ``checkpoint=False`` (WVA_CHECKPOINT=off) keeps the same
+      zero-wrong-direction guarantee on the boot ramp alone.
+    """
+    from wva_tpu.config.loader import load as load_config
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        FaultPlan,
+        FaultWindow,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        trapezoid,
+    )
+    from wva_tpu.emulator.faults import (
+        KIND_METRICS_PARTIAL,
+        seeded_leader_flaps,
+        seeded_restarts,
+    )
+    from wva_tpu.engines import common as engines_common
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    cfg = load_config(env={
+        "PROMETHEUS_BASE_URL": "http://prometheus.test:9090",
+        "LEADER_ELECT": "true",
+        "WVA_CHECKPOINT": "true" if checkpoint else "off",
+        "WVA_CHECKPOINT_INTERVAL": "4",
+    })
+    restarts = seeded_restarts(seed, horizon=duration, n=3)
+    flaps = seeded_leader_flaps(seed + 1, horizon=duration, n=2)
+    # One partial window straddling the SECOND restart: the rebooted
+    # process must hold through data it cannot yet distrust.
+    trap = FaultWindow(kind=KIND_METRICS_PARTIAL,
+                       start=restarts[1].at - 30.0,
+                       end=restarts[1].at + 120.0, drop_fraction=0.5)
+    # Steady high load: desired replicas should NEVER legitimately drop,
+    # so any drop inside a restart/handover window is wrong-direction by
+    # construction.
+    load = trapezoid(base_rate=6.0, peak_rate=6.0, ramp_up=1.0, hold=1e9,
+                     ramp_down=1.0, tail=0.0, delay=0.0)
+    specs = [VariantSpec(
+        name=f"f{i:03d}-v5e", model_id=f"bench/fo-model-{i:03d}",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=1, serving=ServingParams(engine="jetstream"),
+        load=load,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=60.0,
+                      sync_period_seconds=10.0))
+        for i in range(n_models)]
+    harness = EmulationHarness(
+        specs,
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=cfg, nodepools=[("v5e-pool", "v5e", "2x4", n_models * 2)],
+        startup_seconds=30.0, engine_interval=engine_interval,
+        stochastic_seed=20260804,
+        fault_plan=FaultPlan([trap], seed=seed))
+    harness.manager.elector.identity = "replica-a"
+    harness.add_standby("replica-b")
+
+    # --- dual-actuation ledger: every actuation write attributed to
+    # (identity, lease epoch) through the per-process boundary ---
+    actuations: list[tuple[str, str, object]] = []
+
+    def attach_ledger(mgr, identity: str) -> None:
+        boundary = mgr.process_boundary
+
+        def on_write(verb, args, _mgr=mgr, _id=identity):
+            if verb not in ("update_status", "patch_scale"):
+                return
+            actuations.append((_id, verb, _mgr.elector.fencing_token()))
+        boundary.on_write = on_write
+
+    attach_ledger(harness.manager, "replica-a")
+    attach_ledger(harness.standbys[0], "replica-b")
+
+    names = [s.name for s in specs]
+
+    def leader():
+        for m in harness._all_managers():
+            if m.is_leader():
+                return m
+        return None
+
+    def fleet_desired() -> dict[str, int]:
+        # Durable VA status, NOT a per-process gauge registry: a freshly
+        # restarted manager exports nothing until its first leading tick,
+        # and reading its empty registry as desired=0 would count every
+        # handover gap as a fleet-wide scale-down.
+        return {va.metadata.name:
+                va.status.desired_optimized_alloc.num_replicas
+                for va in harness.cluster.variant_autoscalings(
+                    namespace=harness.namespace)}
+
+    # Event windows: [event, event + 5 ticks + handover allowance].
+    window_span = 5 * engine_interval + 90.0
+    events = sorted([(e.at, "restart", e) for e in restarts]
+                    + [(t, "flap", None) for t in flaps])
+    event_state: dict[float, dict] = {
+        at: {"kind": kind, "base": None, "reconverged": None,
+             "in_fault": trap.start <= at < trap.end}
+        for at, kind, _ in events}
+    wrong_direction = 0
+    restart_count = {"n": 0}
+    last_desired: dict[str, int] = {}
+
+    def on_step(h, t):
+        nonlocal wrong_direction
+        for at, kind, ev in events:
+            if at <= t < at + 1.0 and event_state[at]["base"] is None:
+                event_state[at]["base"] = dict(last_desired)
+                if kind == "restart":
+                    restart_count["n"] += 1
+                    if ev.mid_tick:
+                        h.manager.engine.crash_before_apply = True
+                        h.manager.engine.executor.tick()
+                    ident = f"replica-a-r{restart_count['n']}"
+                    h.restart_manager(release_lease=ev.clean, identity=ident)
+                    attach_ledger(h.manager, ident)
+                else:
+                    lead = leader()
+                    if lead is not None:
+                        lead.elector.release()
+        desired = fleet_desired()
+        for at, st in event_state.items():
+            if st["base"] is None:
+                continue
+            if at <= t < at + window_span:
+                for n in names:
+                    if st["base"].get(n, 0) >= 1 \
+                            and desired.get(n, 0) < st["base"][n]:
+                        wrong_direction += 1
+            if st["reconverged"] is None and t > at + 5.0 \
+                    and not st["in_fault"]:
+                lead = leader()
+                if lead is not None:
+                    stats = lead.engine.last_tick_health
+                    ticks = lead.engine._tick_seq
+                    if ticks >= 1 and stats \
+                            and not stats.get("boot_held") \
+                            and not stats.get("clamped"):
+                        st["reconverged"] = min(ticks, int(
+                            (t - at) / engine_interval) + 1)
+        last_desired.clear()
+        last_desired.update(desired)
+
+    harness.run(duration, on_step=on_step)
+    harness.manager.shutdown()
+    for m in harness.standbys:
+        m.shutdown()
+    engines_common.DecisionCache.clear()
+    while not engines_common.DecisionTrigger.empty():
+        engines_common.DecisionTrigger.get_nowait()
+
+    # --- assertions ---
+    by_epoch: dict[object, set[str]] = {}
+    none_epoch_writes = 0
+    for ident, verb, epoch in actuations:
+        if epoch is None:
+            none_epoch_writes += 1
+        else:
+            by_epoch.setdefault(epoch, set()).add(ident)
+    dual = {e: sorted(ws) for e, ws in by_epoch.items() if len(ws) > 1}
+    reconv = [st["reconverged"] for st in event_state.values()
+              if st["reconverged"] is not None]
+    handovers = len([1 for _, k, e in events
+                     if k == "flap" or (e is not None and e.clean)])
+    assert wrong_direction == 0, (
+        f"{wrong_direction} wrong-direction scale events inside "
+        "restart/handover windows")
+    assert not dual, f"dual actuation: two writers in one epoch: {dual}"
+    assert none_epoch_writes == 0, (
+        f"{none_epoch_writes} actuations without a lease epoch "
+        "(non-leader wrote)")
+    assert reconv and max(reconv) <= 5, (
+        f"post-restart reconvergence took {reconv} ticks (> 5)")
+    return {
+        "checkpoint": checkpoint,
+        "restarts": [{"at": e.at, "mid_tick": e.mid_tick,
+                      "clean": e.clean} for e in restarts],
+        "leader_flaps": flaps,
+        "handovers": handovers,
+        "wrong_direction_events": wrong_direction,
+        "dual_actuation_epochs": len(dual),
+        "actuations_recorded": len(actuations),
+        "epochs_seen": len(by_epoch),
+        "reconverge_ticks": reconv,
+        "reconverge_ticks_max": max(reconv) if reconv else None,
+    }
+
+
+def failover_main() -> None:
+    """`make bench-failover` / `bench.py --failover-only`: seeded 48-model
+    crash-restart + leader-flap storm, checkpoint on AND off over the same
+    seed, merged into BENCH_LOCAL.json detail.failover, one JSON line.
+    Raises when any resilience acceptance criterion fails. `--smoke` runs
+    the short CI shape (12 models, 600s)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    smoke = "--smoke" in sys.argv
+    n_models = 12 if smoke else 48
+    duration = 600.0 if smoke else 1200.0
+    t0 = time.time()
+    on = failover_storm_bench(n_models=n_models, duration=duration,
+                              checkpoint=True)
+    off = failover_storm_bench(n_models=n_models, duration=duration,
+                               checkpoint=False)
+    record = {
+        "n_models": n_models,
+        "duration_s": duration,
+        "checkpoint_on": on,
+        "checkpoint_off": off,
+        "bench_wall_seconds": round(time.time() - t0, 1),
+    }
+    if not smoke:
+        _merge_bench_local("failover", record)
+    print(json.dumps({
+        "metric": "failover_wrong_direction_events_48_models",
+        "value": on["wrong_direction_events"],
+        "unit": "wrong_direction_scale_events_in_restart_windows",
+        "vs_baseline": on["reconverge_ticks_max"],
+        "detail": record,
+    }))
+
+
 def main() -> None:
     t0 = time.time()
     device_probe = _ensure_healthy_device()
@@ -2254,5 +2500,7 @@ if __name__ == "__main__":
         capacity_main()
     elif "--chaos-only" in sys.argv:
         chaos_main()
+    elif "--failover-only" in sys.argv:
+        failover_main()
     else:
         main()

@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Regenerate the committed golden boot/restart trace
+(``tests/goldens/boot_trace_v1.jsonl``).
+
+Run from the repo root (CPU platform, like the test suite):
+
+    JAX_PLATFORMS=cpu python tests/goldens/make_boot_trace.py
+
+The scenario exercises the crash-restart resilience plane end to end: two
+variants under bursty load, the controller CRASHES mid-burst (no lease
+release, decisions computed but never applied) while a metrics blackout is
+in flight, and a fresh incarnation boots against the same world. The new
+process warm-starts its last-known-goods from durable VA status
+(``STAGE_BOOT`` with ``recovered.held_seeded > 0``), the do-no-harm boot
+ramp holds every model DEGRADED-equivalent until inputs prove fresh
+(clamps recorded as ``STAGE_HEALTH`` state "boot"), and recovery decisions
+replay byte-for-byte through the shared health.apply path.
+
+The committed trace anchors ``make replay-golden``: recorded boot/health
+clamps must re-apply to ZERO decision diffs (tests/test_resilience.py).
+Regenerate only on a deliberate, reviewed change to boot-ramp/health-gate
+semantics or the trace schema — and say so in the commit message.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TRACE = os.path.join(HERE, "boot_trace_v1.jsonl")
+SEED = 20260804
+CRASH_AT = 240.0
+DURATION_AFTER = 480.0
+
+
+def main() -> None:
+    from wva_tpu.config.loader import load as load_config
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        FaultPlan,
+        FaultWindow,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        trapezoid,
+    )
+    from wva_tpu.emulator.faults import KIND_METRICS_PARTIAL
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    if os.path.exists(TRACE):
+        os.remove(TRACE)  # the recorder appends; regeneration replaces
+
+    cfg = load_config(env={
+        "PROMETHEUS_BASE_URL": "http://prometheus.test:9090",
+        "WVA_TRACE_ENABLED": "true",
+        "WVA_TRACE_PATH": TRACE,
+        # A tight checkpoint cadence so the pre-crash run persists one.
+        "WVA_CHECKPOINT_INTERVAL": "4",
+    })
+
+    # Burst 60..360 at 24 rps; a PARTIAL (whole-pod) scrape outage covers
+    # the crash window (210..420): the rebooted process sees successful-
+    # looking queries missing half the pods — ages look fine, demand looks
+    # halved, the analyzer wants a scale-down — and has none of the
+    # cross-tick coverage memory the health ladder needs for one tick.
+    # Exactly the amnesia window the boot ramp exists for: it holds until
+    # coverage proves full, then the ladder's own DEGRADED classification
+    # takes over for the rest of the window.
+    load = trapezoid(base_rate=1.0, peak_rate=24.0, ramp_up=60.0,
+                     hold=240.0, ramp_down=60.0, tail=1e9, delay=60.0)
+    plan = FaultPlan([
+        FaultWindow(kind=KIND_METRICS_PARTIAL, start=210.0, end=420.0,
+                    drop_fraction=0.5),
+    ], seed=SEED)
+
+    specs = [VariantSpec(
+        name=f"b{i}-v5e", model_id=f"golden/boot-model-{i}",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=1, serving=ServingParams(engine="jetstream"),
+        load=load,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=30.0,
+                      sync_period_seconds=5.0))
+        for i in range(2)]
+    harness = EmulationHarness(
+        specs,
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=cfg,
+        nodepools=[("v5e-pool", "v5e", "2x4", 8)],
+        startup_seconds=30.0, engine_interval=15.0,
+        stochastic_seed=SEED, fault_plan=plan)
+    harness.run(CRASH_AT)
+    # Crash mid-tick: the fence kill point fires between analyze and
+    # apply — decisions computed, nothing actuated, lease (none here)
+    # not released, process memory gone.
+    harness.manager.engine.crash_before_apply = True
+    harness.manager.engine.executor.tick()
+    harness.restart_manager(release_lease=False)
+    harness.run(DURATION_AFTER)
+    harness.manager.shutdown()
+
+    # Sanity before committing: the trace must carry a boot stage with
+    # warm-start seeds, boot-ramp clamps, and replay to zero diffs.
+    import json
+
+    from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+    records = load_trace(TRACE)
+    boot_events = [ev for rec in records for ev in rec.get("stages", [])
+                   if ev.get("stage") == "boot"]
+    health_events = [ev for rec in records for ev in rec.get("stages", [])
+                     if ev.get("stage") == "health"]
+    boot_clamps = [c for ev in health_events
+                   for c in (ev.get("clamps") or [])
+                   if c.get("state") == "boot"]
+    assert boot_events, "no boot stage recorded"
+    assert any(ev.get("recovered", {}).get("held_seeded", 0) > 0
+               for ev in boot_events), "warm start seeded nothing"
+    assert boot_clamps, "boot ramp clamped nothing — nothing worth goldening"
+    report = ReplayEngine(records).replay()
+    assert report.ok, json.dumps(report.to_dict(), indent=1)
+    print(f"wrote {TRACE}: {len(records)} cycles, {len(boot_events)} boot "
+          f"events, {len(boot_clamps)} boot clamps, replay OK")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()

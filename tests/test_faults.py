@@ -428,3 +428,139 @@ class TestChaosStormSchedule:
         # Every fault window starts INSIDE a burst (correlation).
         for w in w1:
             assert p1(w.start) == 10.0, (w.kind, w.start)
+
+
+class TestLeaderElectionUnderStorms:
+    """Satellite (PR 11): the LeaderElector driven through a
+    FaultyKubeClient 429/5xx storm — bounded acquire behavior, no
+    split-brain, renews surviving transient conflicts."""
+
+    def _world(self, *windows, seed=9):
+        from wva_tpu.leaderelection import LeaderElector, LeaderElectorConfig
+
+        clock = FakeClock(start=1000.0)
+        cluster = FakeCluster(clock=clock)
+        plan = FaultPlan(list(windows), seed=seed)
+        cfg = LeaderElectorConfig()
+        a = LeaderElector(FaultyKubeClient(cluster, plan, clock=clock),
+                          "pod-a", cfg, clock=clock)
+        b = LeaderElector(FaultyKubeClient(cluster, plan, clock=clock),
+                          "pod-b", cfg, clock=clock)
+        return clock, cluster, a, b
+
+    def test_no_split_brain_through_full_blackout(self):
+        """A leads; a long apiserver blackout lands. A self-demotes at its
+        renew deadline; B cannot acquire through the storm either — and at
+        NO instant are both leaders. After the storm clears, exactly one
+        wins."""
+        clock, cluster, a, b = self._world(
+            FaultWindow(kind=KIND_API_BLACKOUT, start=30.0, end=300.0))
+        # Windows are world-relative; bind to the world clock origin.
+        a.client._plan.bind(1000.0)
+        assert a.tick() is True
+        leaders_seen = []
+        for _ in range(40):  # 400s: storm covers 1030..1300
+            clock.advance(10)
+            ra, rb = a.tick(), b.tick()
+            both = a.is_leader() and b.is_leader()
+            leaders_seen.append((ra, rb))
+            assert not both, "split-brain during apiserver storm"
+        # Post-storm: exactly one leader (B observed the stale lease for a
+        # full lease_duration during/after the storm and may take over, or
+        # A re-acquired — either is legal, both is not).
+        assert a.is_leader() != b.is_leader() or not a.is_leader()
+        assert any(ra or rb for ra, rb in leaders_seen[-5:]), \
+            "nobody recovered leadership after the storm cleared"
+
+    def test_error_rate_storm_bounded_retries_and_recovery(self):
+        """A seeded 60% 429 storm: ticks fail sometimes, but each tick
+        issues a BOUNDED number of requests (no internal retry loops), the
+        holder keeps leadership through transient errors (renew-deadline
+        discipline, not insta-demotion), and renews resume between
+        errors."""
+        clock, cluster, a, b = self._world(
+            FaultWindow(kind=KIND_API_ERRORS, start=0.0, end=600.0,
+                        rate=0.6, status=429))
+        a.client._plan.bind(1000.0)
+        # Acquire may take a few attempts through the error rate.
+        for _ in range(20):
+            if a.tick():
+                break
+            clock.advance(10)
+        assert a.is_leader()
+        for _ in range(30):
+            clock.advance(10)
+            before = sum(cluster.request_counts().values())
+            a.tick()
+            b.tick()
+            after = sum(cluster.request_counts().values())
+            # Bounded per tick: get + update per elector, once more for
+            # the single conflict re-observe — never an unbounded loop.
+            assert after - before <= 8
+            assert not (a.is_leader() and b.is_leader())
+        # The holder survived the storm: 60% errors never opened a
+        # renew-deadline-sized gap at a 10s retry period.
+        assert a.is_leader() and not b.is_leader()
+
+    def test_renew_survives_transient_conflict(self):
+        """A conflicting write lands on the lease between the holder's
+        read and update (409): the holder re-observes immediately and
+        renews against the fresh resourceVersion instead of demoting."""
+        from wva_tpu.k8s.objects import Lease, clone
+        from wva_tpu.leaderelection import LeaderElector, LeaderElectorConfig
+
+        clock = FakeClock(start=1000.0)
+        cluster = FakeCluster(clock=clock)
+        a = LeaderElector(cluster, "pod-a", LeaderElectorConfig(),
+                          clock=clock)
+        assert a.tick() is True
+
+        class _ConflictOnce:
+            def __init__(self, inner):
+                self._inner = inner
+                self.armed = True
+
+            def update(self, obj):
+                if self.armed and obj.KIND == Lease.KIND:
+                    self.armed = False
+                    # Simulate a concurrent writer: bump the stored lease
+                    # so the caller's rv is stale, then let the real 409
+                    # surface.
+                    held = self._inner.get(Lease.KIND,
+                                           obj.metadata.namespace,
+                                           obj.metadata.name)
+                    bumped = clone(held)
+                    self._inner.update(bumped)
+                return self._inner.update(obj)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        a.client = _ConflictOnce(cluster)
+        clock.advance(10)
+        assert a.tick() is True, "transient 409 must not demote the holder"
+        assert a.is_leader()
+        lease = cluster.get(Lease.KIND, a.config.namespace,
+                            a.config.lease_name)
+        assert lease.holder_identity == "pod-a"
+        assert lease.renew_time == clock.now()
+
+
+class TestSeededProcessChaosSchedules:
+    def test_restart_and_flap_schedules_are_seeded(self):
+        from wva_tpu.emulator.faults import (
+            seeded_leader_flaps,
+            seeded_restarts,
+        )
+
+        r1 = seeded_restarts(7, horizon=1200.0, n=3)
+        r2 = seeded_restarts(7, horizon=1200.0, n=3)
+        assert r1 == r2
+        assert len(r1) == 3
+        ats = [e.at for e in r1]
+        assert ats == sorted(ats)
+        assert all(b - a >= 120.0 for a, b in zip(ats, ats[1:]))
+        assert seeded_restarts(8, horizon=1200.0, n=3) != r1
+        f1 = seeded_leader_flaps(7, horizon=1200.0, n=3)
+        assert f1 == seeded_leader_flaps(7, horizon=1200.0, n=3)
+        assert all(b - a >= 120.0 for a, b in zip(f1, f1[1:]))

@@ -487,7 +487,8 @@ def test_blackout_ladder_condition_and_freeze():
     # And it ends fresh with scale-downs re-enabled.
     assert reasons[-1] == REASON_INPUTS_FRESH
     assert harness.manager.engine.last_tick_health == {
-        "degraded": 0, "blackout": 0, "recovering": 0, "clamped": 0}
+        "degraded": 0, "blackout": 0, "recovering": 0, "clamped": 0,
+        "boot_held": 0}
     harness.manager.shutdown()
 
 

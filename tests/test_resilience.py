@@ -1,0 +1,619 @@
+"""Crash-restart resilience plane (wva_tpu/resilience;
+docs/design/resilience.md): checkpoint round-trips, warm-start recovery,
+the do-no-harm boot ramp, lease-epoch fencing, and the
+non-leader-never-writes discipline."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from wva_tpu.capacity.ledger import CapacityLedger, InFlightRequest
+from wva_tpu.config import new_test_config
+from wva_tpu.forecast.leadtime import LeadTimeEstimator
+from wva_tpu.health import InputHealthMonitor
+from wva_tpu.k8s import FakeCluster
+from wva_tpu.k8s.objects import ConfigMap
+from wva_tpu.leaderelection import LeaderElector, LeaderElectorConfig
+from wva_tpu.resilience import (
+    CHECKPOINT_DATA_KEY,
+    BootRamp,
+    CheckpointStore,
+    LeadershipLostError,
+    canonical_json,
+    warm_start,
+)
+from wva_tpu.utils.clock import FakeClock
+
+GOLDEN_BOOT = os.path.join(os.path.dirname(__file__),
+                           "goldens", "boot_trace_v1.jsonl")
+
+
+# --- seeded checkpoint round-trip property test (mirrors the PR-9
+# fingerprint property-test style: random mutation sequences, assert the
+# invariant after every step) ---
+
+
+class _Cap:
+    def __init__(self, chips=8, hosts=1, total=4):
+        self.chips_per_slice = chips
+        self.hosts_per_slice = hosts
+        self.total_slices = total
+        self.tier_slices = {"on_demand": total}
+
+
+def _mutate_ledger(rng: random.Random, ledger: CapacityLedger,
+                   now: float) -> None:
+    op = rng.randrange(5)
+    variant = rng.choice(["v5e-8", "v5e-16", "v6e-8"])
+    if op == 0:
+        ledger.note_request(InFlightRequest(
+            request_id=f"req-{rng.randrange(1_000_000)}", variant=variant,
+            tier=rng.choice(["reservation", "on_demand", "spot"]),
+            slices=rng.randrange(1, 5), chips_per_slice=8,
+            requested_at=now, eta=now + rng.uniform(30, 600)))
+    elif op == 1:
+        ledger.note_stockout(variant, rng.choice(["on_demand", "spot"]),
+                             now, reprobe_seconds=rng.uniform(60, 600))
+    elif op == 2:
+        ledger.observe_discovery(
+            {variant: _Cap(total=rng.randrange(0, 8))}, now)
+    elif op == 3:
+        ledger.expire_overdue(now + rng.uniform(0, 2000))
+    else:
+        ledger.clear_stockout(variant, "on_demand")
+
+
+def _mutate_health(rng: random.Random, mon: InputHealthMonitor,
+                   now: float) -> None:
+    key = f"model-{rng.randrange(4)}|ns"
+    op = rng.randrange(3)
+    if op == 0:
+        mon.observe(key, now, metrics_age=rng.uniform(0, 600),
+                    scraped=rng.randrange(0, 5), ready=rng.randrange(0, 5))
+    elif op == 1:
+        mon.observe(key, now, metrics_age=None)
+    else:
+        mon.note_emitted("ns", f"var-{rng.randrange(4)}",
+                         rng.randrange(0, 9), "fresh")
+
+
+def _mutate_leadtime(rng: random.Random, lt: LeadTimeEstimator) -> None:
+    if rng.randrange(2):
+        lt.record_provisioning(rng.choice(["v5e-8", "v5e-16"]),
+                               rng.choice(["spot", "on_demand"]),
+                               rng.uniform(1, 900))
+    else:
+        lt._record(f"m{rng.randrange(3)}|ns", "v5e-8", rng.uniform(1, 900))
+
+
+class TestCheckpointRoundTrip:
+    @pytest.mark.parametrize("seed", [1, 7, 42, 20260804])
+    def test_save_load_round_trips_byte_identically(self, seed):
+        """Property: after ANY seeded mutation sequence, export -> restore
+        into fresh objects -> export again is byte-identical, and the
+        canonical JSON round-trips through json.loads unchanged."""
+        rng = random.Random(seed)
+        ledger, mon, lt = CapacityLedger(), InputHealthMonitor(), \
+            LeadTimeEstimator()
+        now = 1_000_000.0
+        for step in range(rng.randrange(20, 60)):
+            now += rng.uniform(0.1, 30.0)
+            _mutate_ledger(rng, ledger, now)
+            _mutate_health(rng, mon, now)
+            _mutate_leadtime(rng, lt)
+            state = {"capacity": ledger.export_state(),
+                     "health": mon.export_state(),
+                     "leadtime": lt.export_state()}
+            encoded = canonical_json(state)
+            decoded = json.loads(encoded)
+            ledger2, mon2, lt2 = CapacityLedger(), InputHealthMonitor(), \
+                LeadTimeEstimator()
+            ledger2.restore_state(decoded["capacity"])
+            mon2.restore_state(decoded["health"])
+            lt2.restore_state(decoded["leadtime"])
+            state2 = {"capacity": ledger2.export_state(),
+                      "health": mon2.export_state(),
+                      "leadtime": lt2.export_state()}
+            assert canonical_json(state2) == encoded, \
+                f"round-trip diverged at step {step} (seed {seed})"
+
+    def test_restored_planner_trust_round_trips(self):
+        from wva_tpu.forecast import CapacityPlanner
+
+        p1 = CapacityPlanner()
+        with p1._mu:
+            p1._errors[("ns|m", "holt")] = (0.12, 7)
+            p1._errors[("ns|m", "linear")] = (0.44, 9)
+            p1._demand_scale["ns|m"] = 3.5
+            p1._accel_by_key["ns|m"] = "v5e-8"
+        state = p1.export_trust()
+        p2 = CapacityPlanner()
+        assert p2.restore_trust(json.loads(canonical_json(state))) == 2
+        assert canonical_json(p2.export_trust()) == canonical_json(state)
+        # Trust survives: the restored best forecaster passes the gate.
+        with p2._mu:
+            best, err, evals = p2._best_trusted_locked("ns|m")
+        assert best == "holt" and evals == 7
+
+
+class TestCheckpointStore:
+    def _store(self, interval=1):
+        clock = FakeClock(start=1000.0)
+        cluster = FakeCluster(clock=clock)
+        return clock, cluster, CheckpointStore(
+            cluster, namespace="wva-system", interval_ticks=interval,
+            clock=clock)
+
+    def test_save_and_load(self):
+        clock, cluster, store = self._store()
+        assert store.maybe_save(1, 3, lambda: {"health": {"held": []}})
+        data = store.load()
+        assert data is not None and data["epoch"] == 3
+        assert data["health"] == {"held": []}
+
+    def test_interval_throttles_writes(self):
+        clock, cluster, store = self._store(interval=5)
+        calls = []
+
+        def payload():
+            calls.append(1)
+            return {}
+        assert store.maybe_save(5, 0, payload)
+        for tick in range(6, 10):
+            assert not store.maybe_save(tick, 0, payload)
+        assert store.maybe_save(10, 0, payload)
+        assert len(calls) == 2  # payload gathered only on real writes
+        assert cluster.request_counts().get(("update", "ConfigMap"), 0) \
+            + cluster.request_counts().get(("create", "ConfigMap"), 0) == 2
+
+    def test_newer_epoch_fences_stale_writer(self):
+        clock, cluster, store_new = self._store()
+        store_old = CheckpointStore(cluster, namespace="wva-system",
+                                    interval_ticks=1, clock=clock)
+        assert store_new.maybe_save(1, epoch=5, payload_fn=lambda: {})
+        assert not store_old.maybe_save(1, epoch=3, payload_fn=lambda: {})
+        assert store_old.skipped_fenced == 1
+        assert store_new.load()["epoch"] == 5
+
+    def test_unparsable_checkpoint_degrades_to_none(self):
+        clock, cluster, store = self._store()
+        store.maybe_save(1, 0, lambda: {})
+        cm = cluster.get(ConfigMap.KIND, "wva-system", store.name)
+        from wva_tpu.k8s.objects import clone
+
+        bad = clone(cm)
+        bad.data = {CHECKPOINT_DATA_KEY: "{not json"}
+        cluster.update(bad)
+        assert store.load() is None
+
+    def test_save_failure_never_raises(self):
+        clock, cluster, store = self._store()
+
+        def exploding():
+            raise RuntimeError("gather failed")
+        assert store.maybe_save(1, 0, exploding) is False
+
+
+class TestBootRamp:
+    def test_holds_until_proven_then_releases_permanently(self):
+        ramp = BootRamp(hold_ticks=3)
+        assert ramp.active and ramp.holding("m|ns")
+        ramp.release("m|ns")
+        assert not ramp.holding("m|ns")
+        assert ramp.holding("other|ns")
+
+    def test_expires_after_hold_ticks(self):
+        ramp = BootRamp(hold_ticks=2)
+        ramp.note_tick()
+        assert ramp.active
+        ramp.note_tick()
+        assert not ramp.active and not ramp.holding("m|ns")
+
+    def test_zero_hold_ticks_is_inert(self):
+        ramp = BootRamp(hold_ticks=0)
+        assert not ramp.active and not ramp.holding("m|ns")
+
+
+class TestWarmStart:
+    def test_seeds_held_from_va_status(self):
+        from test_engine_integration import make_world, get_va
+
+        mgr, cluster, tsdb, clock = make_world(kv=0.85, queue=8)
+        mgr.run_once()
+        va = get_va(cluster)
+        desired = va.status.desired_optimized_alloc.num_replicas
+        assert desired >= 1
+        mon = InputHealthMonitor()
+        report = warm_start(cluster, None, clock.now(), health=mon)
+        assert report.held_seeded >= 1
+        assert mon.held_desired(va.metadata.namespace,
+                                va.metadata.name) == desired
+
+    def test_checkpoint_restores_orders_and_trust(self):
+        clock = FakeClock(start=5000.0)
+        cluster = FakeCluster(clock=clock)
+        store = CheckpointStore(cluster, namespace="wva-system",
+                                interval_ticks=1, clock=clock)
+        ledger = CapacityLedger()
+        ledger.note_request(InFlightRequest(
+            request_id="r1", variant="v5e-8", tier="on_demand", slices=2,
+            chips_per_slice=8, requested_at=4990.0, eta=5200.0))
+        store.maybe_save(1, 0, lambda: {
+            "capacity": ledger.export_state(),
+            "health": InputHealthMonitor().export_state()})
+
+        class _Cap2:
+            ledger = CapacityLedger()
+            leadtime = None
+        cap = _Cap2()
+        report = warm_start(cluster, None, clock.now(), capacity=cap,
+                            store=store)
+        assert report.checkpoint_loaded
+        assert report.orders_restored == 1
+        assert cap.ledger.provisioning_chips("v5e-8", clock.now()) == 16
+
+    def test_content_corrupt_checkpoint_degrades_per_section(self):
+        # A schema-valid but content-corrupt section (hand edit, truncated
+        # write, type drift) must degrade THAT section to the boot ramp and
+        # still restore the others — never crash-loop process start by
+        # failing every restart against the same bad ConfigMap.
+        clock = FakeClock(start=5000.0)
+        cluster = FakeCluster(clock=clock)
+        store = CheckpointStore(cluster, namespace="wva-system",
+                                interval_ticks=1, clock=clock)
+        ledger = CapacityLedger()
+        ledger.note_request(InFlightRequest(
+            request_id="r1", variant="v5e-8", tier="on_demand", slices=2,
+            chips_per_slice=8, requested_at=4990.0, eta=5200.0))
+        store.maybe_save(1, 0, lambda: {
+            "capacity": ledger.export_state(),
+            "health": {"held": [["ns", "v", "not-a-number"]]}})
+
+        class _Cap2:
+            ledger = CapacityLedger()
+            leadtime = None
+        cap = _Cap2()
+        mon = InputHealthMonitor()
+        report = warm_start(FakeCluster(clock=clock), None, clock.now(),
+                            health=mon, capacity=cap,
+                            store=store)  # VA list from an empty cluster
+        assert report.checkpoint_loaded
+        assert report.orders_restored == 1  # healthy section restored
+        assert report.health_books_restored == 0  # corrupt one skipped
+
+    def test_restored_inflight_order_never_reused_as_request_id(self):
+        # The fallback request-id counter restarts at 1 in every process;
+        # after a checkpoint restore the ledger may already hold
+        # req-<variant>-1 from the previous incarnation — reusing it would
+        # silently overwrite the restored order in note_request.
+        from wva_tpu.capacity.manager import CapacityManager
+
+        mgr = CapacityManager(None, None)
+        mgr.ledger.note_request(InFlightRequest(
+            request_id="req-v5e-8-1", variant="v5e-8", tier="on_demand",
+            slices=2, chips_per_slice=8, requested_at=10.0, eta=200.0))
+        assert mgr._next_req_id("v5e-8") == "req-v5e-8-2"
+        assert mgr._next_req_id("v5e-8") == "req-v5e-8-3"
+
+    def test_missing_checkpoint_degrades_quietly(self):
+        clock = FakeClock(start=5000.0)
+        cluster = FakeCluster(clock=clock)
+        store = CheckpointStore(cluster, namespace="wva-system",
+                                clock=clock)
+        report = warm_start(cluster, None, clock.now(),
+                            health=InputHealthMonitor(), store=store)
+        assert not report.checkpoint_loaded
+        assert not report.recovered_anything()
+
+
+class TestFencingToken:
+    def _pair(self):
+        clock = FakeClock(start=1000.0)
+        cluster = FakeCluster(clock=clock)
+        cfg = LeaderElectorConfig()
+        return clock, cluster, \
+            LeaderElector(cluster, "pod-a", cfg, clock=clock), \
+            LeaderElector(cluster, "pod-b", cfg, clock=clock)
+
+    def test_token_changes_across_handover(self):
+        clock, cluster, a, b = self._pair()
+        a.tick()
+        epoch_a = a.fencing_token()
+        assert epoch_a is not None
+        assert b.fencing_token() is None
+        a.release()
+        clock.advance(1)
+        b.tick()
+        epoch_b = b.fencing_token()
+        assert epoch_b is not None and epoch_b != epoch_a
+        # The deposed leader's token is gone, not stale.
+        assert a.fencing_token() is None
+
+    def test_token_stable_across_renewals(self):
+        clock, cluster, a, b = self._pair()
+        a.tick()
+        epoch = a.fencing_token()
+        for _ in range(5):
+            clock.advance(10)
+            a.tick()
+            assert a.fencing_token() == epoch
+
+    def test_token_none_past_renew_deadline(self):
+        clock, cluster, a, b = self._pair()
+        a.tick()
+        clock.advance(51)  # renew deadline (50s) passed without a renew
+        assert a.fencing_token() is None
+
+
+class TestEngineFencing:
+    def test_deposed_mid_tick_never_applies(self):
+        """Leadership lost between analyze and apply: the tick dies with
+        LeadershipLostError and NOT ONE status write lands."""
+        from test_engine_integration import make_world
+
+        mgr, cluster, tsdb, clock = make_world(kv=0.9, queue=20)
+        elector = LeaderElector(cluster, "me", LeaderElectorConfig(),
+                                clock=clock)
+        elector.tick()
+        tokens = iter([elector.fencing_token(), None])
+        mgr.engine.fence = lambda: next(tokens)
+        cluster.reset_request_counts()
+        with pytest.raises(LeadershipLostError):
+            mgr.engine.optimize()
+        counts = cluster.request_counts()
+        for verb in ("update", "update_status", "patch_scale", "create",
+                     "delete"):
+            writes = {k: v for k, v in counts.items() if k[0] == verb}
+            assert not writes, f"deposed leader wrote: {writes}"
+
+    def test_stable_epoch_applies_normally(self):
+        from test_engine_integration import make_world, get_va
+
+        mgr, cluster, tsdb, clock = make_world(kv=0.9, queue=20)
+        elector = LeaderElector(cluster, "me", LeaderElectorConfig(),
+                                clock=clock)
+        elector.tick()
+        mgr.engine.fence = elector.fencing_token
+        mgr.engine.optimize()
+        assert get_va(cluster).status.desired_optimized_alloc \
+            .num_replicas >= 2
+
+
+class TestNonLeaderNeverWrites:
+    def test_demoted_manager_writes_nothing(self):
+        """The satellite regression: a manager that lost the lease runs
+        its full run_once loop — engine, scale-from-zero, fast path,
+        trigger drain — and issues ZERO write verbs, even with stale
+        decisions queued from its leadership era."""
+        from test_engine_integration import make_world
+        from wva_tpu.engines import common as engines_common
+        from wva_tpu.interfaces import VariantDecision
+
+        mgr, cluster, tsdb, clock = make_world(kv=0.9, queue=20)
+        mgr.elector = LeaderElector(cluster, "me", LeaderElectorConfig(),
+                                    clock=clock)
+        mgr.engine.executor.gate = mgr.elector.is_leader
+        mgr.scale_from_zero.executor.gate = mgr.elector.is_leader
+        mgr.fastpath.executor.gate = mgr.elector.is_leader
+        mgr.scale_from_zero.write_gate = mgr.elector.is_leader
+        mgr.va_reconciler.gate = mgr.elector.is_leader
+        # Lead for a tick so real state (status, cache) exists...
+        mgr.run_once()
+        # ...then lose the lease to a competitor, with a STALE decision
+        # still queued (the poison the reconciler drain must not flush).
+        mgr.elector.release()
+        other = LeaderElector(cluster, "other", LeaderElectorConfig(),
+                              clock=clock)
+        other.tick()
+        engines_common.DecisionCache.set(
+            "llama-v5e", "inference",
+            VariantDecision(variant_name="llama-v5e",
+                            namespace="inference", target_replicas=9,
+                            metrics_available=True),
+            source=engines_common.SOURCE_SATURATION)
+        engines_common.fire_trigger("llama-v5e", "inference")
+        clock.advance(mgr.elector.config.retry_period)
+        cluster.reset_request_counts()
+        for _ in range(3):
+            mgr.run_once()
+            mgr.scale_from_zero_tick()
+            clock.advance(2.0)
+        writes = {k: v for k, v in cluster.request_counts().items()
+                  if k[0] in ("update", "update_status", "patch_scale",
+                              "create", "delete")
+                  and k[1] != "Lease"}  # election traffic is allowed
+        assert not writes, f"demoted manager wrote: {writes}"
+        # The stale trigger stayed queued for a future leader, and the
+        # demoted replica never flushed it.
+        engines_common.DecisionCache.clear()
+        while not engines_common.DecisionTrigger.empty():
+            engines_common.DecisionTrigger.get_nowait()
+
+
+def _quiet_world(env):
+    """A small fault-free harness world for byte-identity lever tests."""
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        trapezoid,
+    )
+    from wva_tpu.interfaces import SaturationScalingConfig
+    from wva_tpu.config.loader import load as load_config
+
+    cfg = load_config(env={**env, "PROMETHEUS_BASE_URL":
+                           "http://prometheus.test:9090"})
+    load = trapezoid(base_rate=1.0, peak_rate=16.0, ramp_up=60.0,
+                     hold=120.0, ramp_down=60.0, tail=1e9, delay=30.0)
+    specs = [VariantSpec(
+        name=f"r{i}-v5e", model_id=f"res/model-{i}", accelerator="v5e-8",
+        chips_per_replica=8, cost=10.0, initial_replicas=1,
+        serving=ServingParams(engine="jetstream"), load=load,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=30.0,
+                      sync_period_seconds=5.0)) for i in range(2)]
+    harness = EmulationHarness(
+        specs,
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=cfg, nodepools=[("v5e-pool", "v5e", "2x4", 8)],
+        startup_seconds=30.0, engine_interval=15.0, stochastic_seed=77)
+    return harness
+
+
+def _statuses(harness):
+    out = []
+    for va in sorted(harness.cluster.variant_autoscalings(),
+                     key=lambda v: v.metadata.name):
+        out.append(json.dumps(va.status.to_dict(), sort_keys=True))
+    return out
+
+
+@pytest.mark.slow
+class TestResilienceLeverByteIdentity:
+    def test_fault_free_world_identical_on_vs_off(self):
+        """WVA_RESILIENCE discipline (same as WVA_HEALTH): in a fault-free
+        world the lever changes NOTHING — statuses byte-identical over a
+        changing-load run, and the boot ramp releases every model on the
+        first proven-fresh tick without a single clamp."""
+        from wva_tpu.engines import common as engines_common
+
+        results = {}
+        for lever in ("true", "false"):
+            harness = _quiet_world({"WVA_RESILIENCE": lever})
+            harness.run(300.0)
+            results[lever] = _statuses(harness)
+            stats = harness.manager.engine.last_tick_health
+            assert stats.get("boot_held", 0) == 0
+            harness.manager.shutdown()
+            engines_common.DecisionCache.clear()
+            while not engines_common.DecisionTrigger.empty():
+                engines_common.DecisionTrigger.get_nowait()
+        assert results["true"] == results["false"]
+
+
+@pytest.mark.slow
+class TestRestartRecovery:
+    def _drain_globals(self):
+        from wva_tpu.engines import common as engines_common
+
+        engines_common.DecisionCache.clear()
+        while not engines_common.DecisionTrigger.empty():
+            engines_common.DecisionTrigger.get_nowait()
+
+    def test_crash_restart_reconverges_and_recovers_state(self):
+        """Kill the manager mid-run (no lease release, mid-tick), rebuild
+        it against the same world: warm start re-seeds the LKGs from VA
+        status, the boot ramp releases on the first proven-fresh tick,
+        and desired replicas never drop through the restart window."""
+        harness = _quiet_world({"WVA_RESILIENCE": "true"})
+        try:
+            harness.run(180.0)  # mid-burst: desired has climbed
+            before = {s.name: harness.replicas_of(s.name)
+                      for s in harness.variants}
+            assert any(v >= 2 for v in before.values())
+            # Crash mid-tick: decisions computed, never applied.
+            harness.manager.engine.crash_before_apply = True
+            harness.manager.engine.executor.tick()
+            harness.restart_manager(release_lease=False)
+            report = harness.manager.engine.boot_report
+            assert report is not None and report.held_seeded >= 2
+            # Reconvergence: within 5 engine ticks the ramp has released
+            # every model and no clamps are active.
+            reconverged_at = None
+            for tick in range(1, 6):
+                harness.run(harness.engine_interval)
+                stats = harness.manager.engine.last_tick_health
+                if stats and not stats.get("boot_held") \
+                        and not stats.get("clamped"):
+                    reconverged_at = tick
+                    break
+            assert reconverged_at is not None and reconverged_at <= 5
+            after = {s.name: harness.replicas_of(s.name)
+                     for s in harness.variants}
+            for name, prev in before.items():
+                assert after[name] >= 1, f"{name} lost capacity on restart"
+        finally:
+            harness.manager.shutdown()
+            self._drain_globals()
+
+    def test_checkpoint_persists_and_restores_across_restart(self):
+        harness = _quiet_world({"WVA_RESILIENCE": "true",
+                                "WVA_CHECKPOINT_INTERVAL": "2"})
+        try:
+            harness.run(180.0)
+            store = harness.manager.engine.checkpointer
+            assert store is not None and store.saves >= 1
+            data = store.load()
+            assert data is not None and "health" in data
+            harness.restart_manager()
+            report = harness.manager.engine.boot_report
+            assert report.checkpoint_loaded
+            assert report.health_books_restored >= 1
+        finally:
+            harness.manager.shutdown()
+            self._drain_globals()
+
+    def test_checkpoint_off_still_boots_with_ramp(self):
+        harness = _quiet_world({"WVA_RESILIENCE": "true",
+                                "WVA_CHECKPOINT": "off"})
+        try:
+            harness.run(120.0)
+            assert harness.manager.engine.checkpointer is None
+            harness.restart_manager()
+            assert harness.manager.engine.checkpointer is None
+            assert harness.manager.engine.boot_ramp is not None
+            report = harness.manager.engine.boot_report
+            assert not report.checkpoint_loaded
+            assert report.held_seeded >= 1  # VA status still seeds LKGs
+            harness.run(60.0)
+        finally:
+            harness.manager.shutdown()
+            self._drain_globals()
+
+    def test_severed_manager_goes_dark(self):
+        """A 'crashed' incarnation must not keep writing from its watch
+        handlers — the severable boundary disconnects it from the world."""
+        harness = _quiet_world({"WVA_RESILIENCE": "true"})
+        try:
+            harness.run(60.0)
+            old = harness.manager
+            harness.restart_manager()
+            harness.cluster.reset_request_counts()
+            # Poke the world: the dead manager's reconciler must not react.
+            harness.run(30.0)
+            from wva_tpu.emulator.faults import ChaosError
+
+            # The informer serves lists from its local store; any verb
+            # that actually reaches the apiserver must hit the severed
+            # boundary and die like a real dead process's socket.
+            with pytest.raises(ChaosError):
+                old.process_boundary.list("VariantAutoscaling")
+        finally:
+            harness.manager.shutdown()
+            self._drain_globals()
+
+
+@pytest.mark.replay
+class TestBootGolden:
+    def test_boot_golden_replays_with_zero_diffs(self):
+        from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+        records = load_trace(GOLDEN_BOOT)
+        boot_events = [ev for rec in records
+                       for ev in rec.get("stages", [])
+                       if ev.get("stage") == "boot"]
+        assert boot_events, "golden carries no boot stage"
+        assert any(ev.get("recovered", {}).get("held_seeded", 0) > 0
+                   for ev in boot_events)
+        report = ReplayEngine(records).replay()
+        assert report.ok, json.dumps(report.to_dict(), indent=1)
+        assert report.cycles_replayed > 0

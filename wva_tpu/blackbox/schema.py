@@ -61,6 +61,15 @@ STAGE_RECONCILE = "reconcile"
 # outcome (replay treats skipped models exactly like no-record models: the
 # re-emitted decisions were already verified the cycle they were computed).
 STAGE_FINGERPRINT_SKIP = "fingerprint_skip"
+# Crash-restart resilience plane (wva_tpu.resilience): recorded ONCE, on
+# the first cycle after a boot that actually recovered something (warm-
+# start seeds, checkpoint rehydration) or is still ramp-holding models.
+# Pure observability: the boot ramp's do-no-harm clamps ride the health
+# stage below (state "boot") and replay through the same shared
+# health.apply path, so replay needs no boot-specific logic. A fresh
+# fault-free boot records nothing — traces stay byte-identical with the
+# plane off.
+STAGE_BOOT = "boot"
 # Input-health plane (wva_tpu.health): per-model trust states this cycle
 # plus the do-no-harm clamps the gate applied to final decisions. Recorded
 # AFTER the limiter; replay re-applies the RECORDED clamps through the same

@@ -357,6 +357,11 @@ class CapacityLedger:
             book = self._books.get(variant)
             return bool(book and book.inflight)
 
+    def has_inflight_id(self, variant: str, request_id: str) -> bool:
+        with self._mu:
+            book = self._books.get(variant)
+            return bool(book and request_id in book.inflight)
+
     def tier_mix(self, variant: str) -> dict[str, int]:
         with self._mu:
             book = self._books.get(variant)
@@ -385,6 +390,83 @@ class CapacityLedger:
                 return 1.0
             return sum(weights.get(t, 1.0) * n
                        for t, n in book.tier_slices.items()) / total
+
+    # --- crash-restart checkpoint (wva_tpu.resilience) ---
+
+    def export_state(self) -> dict:
+        """Serializable per-variant books for the resilience checkpoint.
+        Watch-window transients (lost nodes, preemption window) are NOT
+        exported — they describe sub-discovery-interval state the next
+        discovery pass re-derives; what must survive a restart is the
+        in-flight order book (planning credit + lead measurement anchors),
+        the stockout circuit breakers, and the fulfillment baseline
+        (ready/peak counts — without them a restored order would be
+        spuriously 'retired' by the first discovery pass re-reporting the
+        pre-crash fleet as growth). Sorted everywhere: equal state must
+        serialize byte-identically."""
+        variants = {}
+        with self._mu:
+            for variant in sorted(self._books):
+                book = self._books[variant]
+                variants[variant] = {
+                    "chips_per_slice": book.chips_per_slice,
+                    "hosts_per_slice": book.hosts_per_slice,
+                    "ready_slices": book.ready_slices,
+                    "peak_ready": book.peak_ready,
+                    "preempted_total": book.preempted_total
+                    + self._preempted_pending(book),
+                    "inflight": [{
+                        "request_id": r.request_id,
+                        "tier": r.tier,
+                        "slices": r.slices,
+                        "chips_per_slice": r.chips_per_slice,
+                        "requested_at": r.requested_at,
+                        "eta": r.eta,
+                    } for r in sorted(book.inflight.values(),
+                                      key=lambda r: r.request_id)],
+                    "stockout_until": dict(sorted(
+                        book.stockout_until.items())),
+                    "stockout_streak": dict(sorted(
+                        book.stockout_streak.items())),
+                }
+        return {"variants": variants}
+
+    def restore_state(self, state: dict) -> dict:
+        """Rehydrate from :meth:`export_state` output (boot warm-start).
+        Restored orders keep their ORIGINAL ETAs: one that wedged while
+        the controller was down exceeds its credit window on the first
+        post-boot tick and is expired-and-reordered — the safe direction
+        (an unknown order may still land, in which case the fleet briefly
+        over-provisions; it never plans against phantom credit). Returns
+        restore counts for the warm-start report."""
+        orders = stockouts = 0
+        with self._mu:
+            for variant in sorted(state.get("variants", {})):
+                v = state["variants"][variant]
+                book = self._book(variant)
+                book.chips_per_slice = int(v.get("chips_per_slice", 0))
+                book.hosts_per_slice = max(int(v.get("hosts_per_slice", 1)),
+                                           1)
+                book.ready_slices = int(v.get("ready_slices", 0))
+                book.peak_ready = int(v.get("peak_ready", 0))
+                book.preempted_total = int(v.get("preempted_total", 0))
+                for r in v.get("inflight", []):
+                    req = InFlightRequest(
+                        request_id=str(r.get("request_id", "")),
+                        variant=variant,
+                        tier=str(r.get("tier", "")),
+                        slices=int(r.get("slices", 0)),
+                        chips_per_slice=int(r.get("chips_per_slice", 0)),
+                        requested_at=float(r.get("requested_at", 0.0)),
+                        eta=float(r.get("eta", 0.0)))
+                    book.inflight[req.request_id] = req
+                    orders += 1
+                for tier, until in v.get("stockout_until", {}).items():
+                    book.stockout_until[str(tier)] = float(until)
+                    stockouts += 1
+                for tier, streak in v.get("stockout_streak", {}).items():
+                    book.stockout_streak[str(tier)] = int(streak)
+        return {"orders": orders, "stockouts": stockouts}
 
     # --- observability ---
 

@@ -214,6 +214,18 @@ class CapacityManager:
                     initial=5.0, cap=300.0, rng=self._rng)
             return st
 
+    def _next_req_id(self, variant: str) -> str:
+        # The counter restarts at 1 in every process, but the ledger may
+        # hold checkpoint-restored in-flight orders from a previous
+        # incarnation under the same scheme — reusing such an id would
+        # silently overwrite the restored record in note_request and drop
+        # its planning credit. Skip taken ids (deterministic, so seeded
+        # worlds replay).
+        while True:
+            rid = f"req-{variant}-{next(self._req_ids)}"
+            if not self.ledger.has_inflight_id(variant, rid):
+                return rid
+
     def _record_lead(self, variant: str, tier: str, latency: float) -> None:
         if self.leadtime is not None and latency > 0:
             self.leadtime.record_provisioning(variant, tier, latency)
@@ -291,8 +303,7 @@ class CapacityManager:
             if result.accepted:
                 lead = (result.eta_seconds if result.eta_seconds > 0
                         else self._lead_estimate(variant, tier))
-                rid = result.request_id or \
-                    f"req-{variant}-{next(self._req_ids)}"
+                rid = result.request_id or self._next_req_id(variant)
                 self.ledger.note_request(InFlightRequest(
                     request_id=rid, variant=variant, tier=tier,
                     slices=count, chips_per_slice=chips_per_slice,

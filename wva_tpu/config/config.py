@@ -203,6 +203,33 @@ class HealthConfig(frz.Freezable):
 
 
 @dataclass
+class ResilienceConfig(frz.Freezable):
+    """Crash-restart resilience plane (``wva_tpu.resilience``): warm-start
+    recovery from durable VA status + a checkpoint ConfigMap, a do-no-harm
+    boot ramp for the first ticks after process start, and lease-epoch
+    fencing through the apply phase (docs/design/resilience.md). Default
+    ON; ``WVA_RESILIENCE=off`` restores byte-identical pre-resilience
+    decisions, statuses, and traces in a fault-free world (same discipline
+    as ``WVA_HEALTH``)."""
+
+    enabled: bool = True
+    # Durable soft-state checkpoint (WVA_CHECKPOINT): capacity in-flight
+    # orders/stockouts, health last-known-goods, forecast trust, measured
+    # lead times, written to the wva-resilience-checkpoint ConfigMap. Off
+    # falls back to warm-start-from-VA-status + the boot ramp alone (the
+    # zero-wrong-direction guarantee holds either way).
+    checkpoint_enabled: bool = True
+    # Engine ticks between checkpoint writes (rv-guarded; at most one
+    # ConfigMap update per interval).
+    checkpoint_interval_ticks: int = 20
+    # Engine ticks every model stays DEGRADED-equivalent after boot
+    # (scale-up allowed, scale-down/zero forbidden) unless its inputs
+    # prove fresh earlier. Size so hold_ticks x engine interval covers
+    # the health ladder's restart grace (degraded_after seconds).
+    startup_hold_ticks: int = 10
+
+
+@dataclass
 class CapacityConfig(frz.Freezable):
     """Elastic capacity plane (``wva_tpu.capacity``): slice provisioning,
     preemption resilience, reservation/spot-aware inventory
@@ -257,6 +284,7 @@ class Config:
         self._forecast = ForecastConfig()
         self._capacity = CapacityConfig()
         self._health = HealthConfig()
+        self._resilience = ResilienceConfig()
         # Bumped on every decision-affecting hot-reload (see mutation_epoch).
         self._epoch = 0
         # Hot-accessor memo: section name -> FROZEN deep copy, built once
@@ -468,6 +496,20 @@ class Config:
     def set_health(self, h: HealthConfig) -> None:
         with self._mu:
             self._health = copy.deepcopy(h)
+            self._bump_epoch_locked()
+
+    # --- crash-restart resilience plane (wva_tpu.resilience) ---
+
+    def resilience_config(self) -> ResilienceConfig:
+        return self._memoized("resilience", lambda: self._resilience)
+
+    def resilience_enabled(self) -> bool:
+        with self._mu:
+            return self._resilience.enabled
+
+    def set_resilience(self, r: ResilienceConfig) -> None:
+        with self._mu:
+            self._resilience = copy.deepcopy(r)
             self._bump_epoch_locked()
 
     # --- saturation config (namespace-aware; reference config.go:318-354) ---

@@ -23,6 +23,7 @@ from wva_tpu.config.config import (
     HealthConfig,
     InfrastructureConfig,
     PrometheusConfig,
+    ResilienceConfig,
     TLSConfig,
     TraceConfig,
 )
@@ -85,6 +86,22 @@ DEFAULTS: dict[str, Any] = {
     # Consecutive fresh ticks before scale-downs resume after a
     # degradation.
     "WVA_HEALTH_RECOVERY_TICKS": 3,
+    # Crash-restart resilience plane (wva_tpu.resilience;
+    # docs/design/resilience.md). Default on; "off"/"false"/"0" disables
+    # warm-start recovery, the boot ramp, lease-epoch fencing, and the
+    # checkpoint (decisions/statuses/traces then byte-identical to
+    # pre-resilience builds in a fault-free world).
+    "WVA_RESILIENCE": True,
+    # Durable soft-state checkpoint ConfigMap (off = boot-ramp-only
+    # recovery, same zero-wrong-direction guarantee).
+    "WVA_CHECKPOINT": True,
+    # Engine ticks between checkpoint writes.
+    "WVA_CHECKPOINT_INTERVAL": 20,
+    # Engine ticks every model stays DEGRADED-equivalent after boot unless
+    # its inputs prove fresh earlier (scale-up allowed, scale-down/zero
+    # forbidden). Size to cover WVA_HEALTH_DEGRADED_AFTER at the engine
+    # interval.
+    "WVA_STARTUP_HOLD_TICKS": 10,
     # Elastic capacity plane (wva_tpu.capacity; docs/design/capacity.md).
     # Default on; "off"/"false"/"0" disables (decisions then byte-identical
     # to pre-capacity builds).
@@ -273,6 +290,13 @@ def load(flags: Mapping[str, Any] | None = None,
         degraded_after_seconds=r.get_duration("WVA_HEALTH_DEGRADED_AFTER"),
         freeze_after_seconds=r.get_duration("WVA_HEALTH_FREEZE_AFTER"),
         recovery_ticks=r.get_int("WVA_HEALTH_RECOVERY_TICKS"),
+    ))
+
+    cfg.set_resilience(ResilienceConfig(
+        enabled=r.get_bool("WVA_RESILIENCE"),
+        checkpoint_enabled=r.get_bool("WVA_CHECKPOINT"),
+        checkpoint_interval_ticks=max(1, r.get_int("WVA_CHECKPOINT_INTERVAL")),
+        startup_hold_ticks=max(0, r.get_int("WVA_STARTUP_HOLD_TICKS")),
     ))
 
     from wva_tpu.capacity.tiers import (

@@ -79,6 +79,27 @@ WVA_TICK_OVERRUNS_TOTAL = "wva_tick_overruns_total"
 # fresh | degraded | blackout). Alert on degraded/blackout == 1.
 WVA_INPUT_HEALTH = "wva_input_health"
 
+# --- Crash-restart resilience plane (wva_tpu.resilience) ---
+# Models still held by the do-no-harm boot ramp this tick (DEGRADED-
+# equivalent: scale-up allowed, scale-down forbidden, until their inputs
+# prove fresh). Non-zero long after a restart means the metrics plane
+# never proved fresh — investigate the inputs, not the autoscaler.
+WVA_BOOT_RAMP_MODELS_HELD = "wva_boot_ramp_models_held"
+# Items recovered by the boot warm start, one series per
+# source = held | orders | stockouts | trust | leadtime | health_books.
+# All-zero after a restart means the checkpoint was missing/unreadable
+# and VA statuses were empty — the boot ramp alone carried recovery.
+WVA_BOOT_RECOVERED_ITEMS = "wva_boot_recovered_items"
+# The lease epoch (Lease.leaseTransitions at acquisition) this process is
+# acting under; emitted only while leading. Two processes exporting the
+# same epoch simultaneously would indicate broken fencing — alert on it.
+WVA_LEADER_EPOCH = "wva_leader_epoch"
+# Resilience-checkpoint writes since process start, and the world time of
+# the newest one. A flat-lining writes counter with the plane enabled
+# means checkpoint persistence is failing (RBAC, conflicts, fencing).
+WVA_CHECKPOINT_WRITES = "wva_checkpoint_writes"
+WVA_CHECKPOINT_LAST_SAVE_TIMESTAMP = "wva_checkpoint_last_save_timestamp"
+
 # --- Decision flight recorder health (wva_tpu.blackbox) ---
 WVA_TRACE_RECORDS_TOTAL = "wva_trace_records_total"
 WVA_TRACE_DROPPED_TOTAL = "wva_trace_dropped_total"
@@ -158,5 +179,6 @@ LABEL_FORECASTER = "forecaster"
 LABEL_STATE = "state"
 LABEL_TIER = "tier"
 LABEL_PHASE = "phase"
+LABEL_SOURCE = "source"
 
 __all__ = [n for n in dir() if n.isupper()]

@@ -63,6 +63,15 @@ class VariantAutoscalingReconciler:
         # identical under any KubeClient (FakeCluster dispatches
         # cluster-wide) and two scoped installs never fight over VAs.
         self.watch_namespace = watch_namespace
+        # Leader gate for the decision-trigger drain (None = always;
+        # build_manager wires the elector's is_leader when election is
+        # on). DecisionCache is populated only while this process leads —
+        # but entries (and queued triggers) from a leadership era must not
+        # be flushed AFTER demotion: the new leader recomputes, and a
+        # standby replaying stale decisions would be a second writer.
+        # Spec/ConfigMap watch reconciliation is not gated — only the
+        # decision-consuming trigger drain.
+        self.gate = None
 
     # --- wiring (reference SetupWithManager :291-319) ---
 
@@ -138,6 +147,8 @@ class VariantAutoscalingReconciler:
         """Consume pending DecisionTrigger events (the channel-watch analogue;
         reference SetupWithManager :313). Returns processed count."""
         processed = 0
+        if self.gate is not None and not self.gate():
+            return 0  # demoted: triggers stay queued for the leader
         while processed < max_events:
             try:
                 ev = common.DecisionTrigger.get_nowait()
@@ -160,6 +171,8 @@ class VariantAutoscalingReconciler:
                 ev = common.DecisionTrigger.get(timeout=0.2)
             except queue.Empty:
                 continue
+            if self.gate is not None and not self.gate():
+                continue  # demoted mid-wait: drop the stale trigger
             try:
                 self.reconcile(ev.name, ev.namespace)
             except Exception as e:  # noqa: BLE001

@@ -290,6 +290,121 @@ class FaultyKubeClient:
         return getattr(self._inner, name)
 
 
+class SeverableKubeClient:
+    """Per-process-lifetime client boundary for the restart chaos harness.
+
+    A 'crashed' manager must go fully dark: its informer and reconciler
+    watch handlers were registered on the SHARED world cluster and would
+    otherwise keep firing (and writing!) from beyond the grave — an
+    artifact no real process exhibits. Each manager incarnation gets its
+    own severable wrapper; :meth:`sever` unregisters every watch handler
+    the incarnation installed and makes every later verb raise
+    :class:`ChaosError` (a dead process cannot reach the apiserver)."""
+
+    # Verbs that mutate the world — the failover bench's dual-actuation
+    # ledger hooks these per incarnation.
+    WRITE_VERBS = ("create", "update", "update_status", "delete",
+                   "patch_scale")
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+        self._dead = False
+        self._watches: list[tuple[str, object]] = []
+        # Optional (verb, args) observer fired before each write verb —
+        # the bench attributes every actuation to (writer identity, lease
+        # epoch) through it and asserts one writer per epoch.
+        self.on_write = None
+
+    def watch(self, kind: str, handler) -> None:
+        def guarded(event, obj, _h=handler):
+            if not self._dead:
+                _h(event, obj)
+        self._watches.append((kind, guarded))
+        self._inner.watch(kind, guarded)
+
+    def sever(self) -> None:
+        self._dead = True
+        unwatch = getattr(self._inner, "unwatch", None)
+        for kind, handler in self._watches:
+            if callable(unwatch):
+                try:
+                    unwatch(kind, handler)
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+        self._watches.clear()
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+
+        def guard(*args, **kwargs):
+            if self._dead:
+                raise ChaosError(
+                    f"chaos: severed process called {name} after death")
+            if self.on_write is not None and name in self.WRITE_VERBS:
+                self.on_write(name, args)
+            return attr(*args, **kwargs)
+        return guard
+
+
+@dataclass(frozen=True)
+class RestartEvent:
+    """One scheduled manager kill/rebuild. ``at`` is world-relative
+    seconds; ``mid_tick`` kills between analyze and apply (the engine's
+    ``crash_before_apply`` hook — decisions computed, never actuated)
+    instead of between ticks; ``clean`` releases the lease on the way down
+    (voluntary step-down) instead of crashing with it held."""
+
+    at: float
+    mid_tick: bool = False
+    clean: bool = False
+
+
+def _seeded_instants(seed: int, salt: str, horizon: float, n: int,
+                     min_gap: float, settle: float) -> list[float]:
+    """CRC32-jittered instants spread over ``[settle, horizon - settle]``
+    with at least ``min_gap`` between them (process-hash-proof — same
+    discipline as FaultPlan). Shared by the restart and leader-flap
+    schedules so their spacing math can never silently diverge."""
+    span = max(horizon - 2 * settle, min_gap * max(n, 1))
+    instants: list[float] = []
+    last = settle - min_gap
+    for i in range(n):
+        base = settle + span * (i + 0.5) / n
+        jitter = ((zlib.crc32(repr((seed, salt, i)).encode())
+                   % 1000) / 1000.0 - 0.5) * min_gap * 0.5
+        at = max(base + jitter, last + min_gap)
+        last = at
+        instants.append(round(at, 1))
+    return instants
+
+
+def seeded_restarts(seed: int, horizon: float, n: int = 3,
+                    min_gap: float = 120.0,
+                    settle: float = 180.0) -> list[RestartEvent]:
+    """Seeded kill/restart schedule: ``n`` restarts spread over
+    ``[settle, horizon - settle]`` with at least ``min_gap`` between them,
+    alternating tick phases and crash/clean deterministically from the
+    seed."""
+    return [RestartEvent(
+        at=at,
+        mid_tick=zlib.crc32(repr((seed, "phase", i)).encode()) % 2 == 0,
+        clean=zlib.crc32(repr((seed, "clean", i)).encode()) % 4 == 0)
+        for i, at in enumerate(
+            _seeded_instants(seed, "restart", horizon, n, min_gap, settle))]
+
+
+def seeded_leader_flaps(seed: int, horizon: float, n: int = 3,
+                        min_gap: float = 120.0,
+                        settle: float = 180.0) -> list[float]:
+    """Seeded leader-flap storm: world-relative instants at which the
+    CURRENT leader voluntarily releases the lease, forcing a handover to
+    the standby (and back, next flap). Same spacing discipline as
+    :func:`seeded_restarts`."""
+    return _seeded_instants(seed, "flap", horizon, n, min_gap, settle)
+
+
 @dataclass
 class FaultAction:
     """What the HTTP layer should do to one request."""

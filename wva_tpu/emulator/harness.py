@@ -168,14 +168,19 @@ class EmulationHarness:
                     raise ConnectionError("chaos: EPP scrape blackout")
                 return _inner(pod)
 
-        self.manager: Manager = build_manager(
-            manager_client, self.config, clock=self.clock, tsdb=self.tsdb,
-            pod_fetcher=manager_fetcher, slice_provisioner=provisioner,
-            prom_api=manager_prom_api)
+        # World-side views shared by every manager incarnation (a restarted
+        # process reconnects to the same faulted backend); each incarnation
+        # additionally gets its OWN SeverableKubeClient so a 'crashed'
+        # manager's watch handlers go dark instead of writing from beyond
+        # the grave (see restart_manager).
+        self._world_client = manager_client
+        self._manager_prom_api = manager_prom_api
+        self._manager_fetcher = manager_fetcher
+        # Standby manager processes (leader-election worlds): they share
+        # the world but only ever act while holding the lease.
+        self.standbys: list[Manager] = []
+        self.manager: Manager = self._build_manager()
         self.flight_recorder = self.manager.flight_recorder
-        self.manager.engine.executor.max_retries_per_tick = 1
-        self.manager.scale_from_zero.executor.max_retries_per_tick = 1
-        self.manager.setup()
 
         self.kubelet = FakeKubelet(client=self.cluster, clock=self.clock,
                                    startup_seconds=startup_seconds)
@@ -269,6 +274,85 @@ class EmulationHarness:
             f"--num-gpu-blocks-override={p.num_kv_blocks}",
         ]
 
+    # --- process lifecycle (crash-restart + failover chaos) ---
+
+    def _build_manager(self, identity: str | None = None) -> Manager:
+        """One manager 'process' over the shared world. Every incarnation
+        gets its own severable client boundary (faults.SeverableKubeClient)
+        so teardown can disconnect its watch handlers — a real dead
+        process stops receiving events; the in-process sim must too."""
+        from wva_tpu.emulator.faults import SeverableKubeClient
+
+        boundary = SeverableKubeClient(self._world_client)
+        mgr = build_manager(
+            boundary, self.config, clock=self.clock, tsdb=self.tsdb,
+            pod_fetcher=self._manager_fetcher,
+            slice_provisioner=self.provisioner,
+            prom_api=self._manager_prom_api)
+        mgr.process_boundary = boundary
+        if mgr.elector is not None and identity:
+            mgr.elector.identity = identity
+        mgr.engine.executor.max_retries_per_tick = 1
+        mgr.scale_from_zero.executor.max_retries_per_tick = 1
+        mgr.setup()
+        return mgr
+
+    def restart_manager(self, release_lease: bool = False,
+                        identity: str | None = None) -> Manager:
+        """Kill the active manager and boot a fresh one against the SAME
+        FakeCluster/TSDB — a controller crash-restart. ``release_lease``
+        selects clean shutdown (voluntary step-down) vs crash (the lease
+        rides out its duration, or the standby takes over). Process-global
+        decision state (DecisionCache/DecisionTrigger) is cleared so the
+        new 'process' boots with empty memory — but only when no standby
+        manager shares this (in-process) global bus: a real crash never
+        erases a surviving replica's memory, so with standbys attached the
+        survivor keeps its cached decisions and queued triggers. The
+        restarted incarnation then inherits the shared store, a residual
+        sim artifact bounded by the reconciler's leader gate (a non-leader
+        never drains it). In-flight soft state survives only through the
+        resilience plane's checkpoint + VA status."""
+        from wva_tpu.engines import common as engines_common
+
+        old = self.manager
+        if old.elector is not None and not release_lease:
+            old.elector.config.release_on_exit = False
+        old.shutdown()
+        boundary = getattr(old, "process_boundary", None)
+        if boundary is not None:
+            boundary.sever()
+        if not self.standbys:
+            engines_common.DecisionCache.clear()
+            while not engines_common.DecisionTrigger.empty():
+                engines_common.DecisionTrigger.get_nowait()
+        self.manager = self._build_manager(identity=identity)
+        self.flight_recorder = self.manager.flight_recorder
+        self._refresh_hpa_registry()
+        return self.manager
+
+    def add_standby(self, identity: str) -> Manager:
+        """Attach a standby manager process (requires leader election in
+        the config, or both would act). It runs the same executor cadence
+        as the primary inside run(); the leader gates decide who acts."""
+        standby = self._build_manager(identity=identity)
+        self.standbys.append(standby)
+        return standby
+
+    def _all_managers(self) -> list[Manager]:
+        return [self.manager, *self.standbys]
+
+    def _refresh_hpa_registry(self) -> None:
+        """Point the HPA emulator at the acting leader's gauge registry —
+        the stand-in for 'Prometheus scrapes whichever replica exports'.
+        Without election every manager 'leads'; the primary wins."""
+        if not hasattr(self, "hpa"):
+            return  # still inside __init__; HPA attaches to self.manager
+        for mgr in self._all_managers():
+            if mgr.is_leader():
+                self.hpa.registry = mgr.registry
+                return
+        self.hpa.registry = self.manager.registry
+
     # --- the world loop ---
 
     def _sync_sims(self) -> None:
@@ -308,19 +392,30 @@ class EmulationHarness:
                 self.provisioner.step()
             self.kubelet.step()
 
+            # Leader election (no-op without an elector): every manager
+            # process runs its acquire/renew loop — throttled internally
+            # to the elector's retry period — and the HPA emulator reads
+            # gauges from whichever replica currently exports them.
+            if self.standbys or self.manager.elector is not None:
+                for mgr in self._all_managers():
+                    mgr.election_tick()
+                self._refresh_hpa_registry()
             if now - self._last_sfz >= self.sfz_interval:
-                self.manager.scale_from_zero.executor.tick()
-                # The fast path runs at the scale-from-zero cadence; a
-                # detected backlog forces an immediate engine tick instead
-                # of waiting out the poll interval.
-                if self.manager.fast_path_tick():
-                    self.manager.engine.executor.tick()
-                    self._last_engine = now
+                for mgr in self._all_managers():
+                    mgr.scale_from_zero.executor.tick()
+                    # The fast path runs at the scale-from-zero cadence; a
+                    # detected backlog forces an immediate engine tick
+                    # instead of waiting out the poll interval.
+                    if mgr.fast_path_tick():
+                        mgr.engine.executor.tick()
+                        self._last_engine = now
                 self._last_sfz = now
             if now - self._last_engine >= self.engine_interval:
-                self.manager.engine.executor.tick()
+                for mgr in self._all_managers():
+                    mgr.engine.executor.tick()
                 self._last_engine = now
-            self.manager.va_reconciler.drain_triggers()
+            for mgr in self._all_managers():
+                mgr.va_reconciler.drain_triggers()
             self.hpa.step()
 
             if on_step is not None:

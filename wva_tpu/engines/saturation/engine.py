@@ -72,11 +72,13 @@ from wva_tpu.api.v1alpha1 import (
     VariantAutoscaling,
 )
 from wva_tpu.blackbox.schema import (
+    STAGE_BOOT,
     STAGE_CAPACITY,
     STAGE_FINGERPRINT_SKIP,
     STAGE_FORECAST,
     STAGE_HEALTH,
 )
+from wva_tpu.resilience import LeadershipLostError, SimulatedCrash
 from wva_tpu.health import BLACKOUT, FRESH, HEALTH_STATES, InputHealth
 from wva_tpu.health.apply import apply_health_clamps
 from wva_tpu.collector.replica_metrics import ReplicaMetricsCollector
@@ -103,9 +105,15 @@ from wva_tpu.constants import (
     WVA_FORECAST_ERROR,
     WVA_FORECAST_LEAD_TIME_SECONDS,
     LABEL_PHASE,
+    LABEL_SOURCE,
+    WVA_BOOT_RAMP_MODELS_HELD,
+    WVA_BOOT_RECOVERED_ITEMS,
+    WVA_CHECKPOINT_LAST_SAVE_TIMESTAMP,
+    WVA_CHECKPOINT_WRITES,
     WVA_INFORMER_AGE_SECONDS,
     WVA_INFORMER_SYNCED,
     WVA_INPUT_HEALTH,
+    WVA_LEADER_EPOCH,
     WVA_TICK_MODELS_ANALYZED,
     WVA_TICK_MODELS_SKIPPED,
     WVA_TICK_OBJECT_COPIES,
@@ -288,6 +296,8 @@ class SaturationEngine:
         forecast_planner=None,
         capacity=None,
         health=None,
+        boot_ramp=None,
+        checkpointer=None,
     ) -> None:
         self.client = client
         self.config = config
@@ -338,6 +348,38 @@ class SaturationEngine:
         # (docs/design/health.md). None = pre-health behavior: decisions,
         # statuses, and traces byte-identical in a fault-free world.
         self.health = health
+        # Crash-restart resilience plane (WVA_RESILIENCE, default on from
+        # build_manager; wva_tpu/resilience):
+        # - boot_ramp: do-no-harm startup hold — every model is DEGRADED-
+        #   equivalent (scale-up allowed, down forbidden) until its inputs
+        #   PROVE fresh or WVA_STARTUP_HOLD_TICKS elapse. Requires the
+        #   health plane (the ramp rides its gate); inert without it.
+        # - checkpointer: resilience.CheckpointStore — durable soft-state
+        #   snapshot (capacity orders, health LKGs, forecast trust, lead
+        #   times) written at most every WVA_CHECKPOINT_INTERVAL ticks.
+        # - fence: the elector's fencing_token callable (None = election
+        #   disabled). Captured at tick start, re-checked between analyze
+        #   and apply: a leader deposed mid-tick raises instead of
+        #   actuating.
+        # - boot_report: WarmStartReport from build_manager's warm_start,
+        #   recorded once as STAGE_BOOT on the first traced cycle that has
+        #   something to say.
+        self.boot_ramp = boot_ramp
+        self.checkpointer = checkpointer
+        self.fence = None
+        self.boot_report = None
+        self._boot_recorded = False
+        # Chaos-harness hook (emulator restart storms): when armed, the
+        # fence check raises SimulatedCrash — the tick dies with decisions
+        # computed but never applied, exactly a process kill mid-tick.
+        self.crash_before_apply = False
+        self._tick_epoch: int | None = None
+        # Models whose inputs were observed with a REAL backend age this
+        # tick (slice_age_seconds returned a value) — the boot ramp's
+        # proof-of-freshness signal, distinct from the health monitor's
+        # restart-bootstrap "clock starts now" freshness.
+        self._tick_age_observed: set[str] = set()
+        self._tick_ramp_holds: frozenset[str] = frozenset()
         # Tick-scoped health state: per-model classification (gate +
         # condition + gauges consume it) and per-model scrape coverage
         # (scraped pods vs expected ready pods, captured during analysis).
@@ -552,6 +594,17 @@ class SaturationEngine:
         copies_at_start = frz.copy_count()
         phase_start = time.perf_counter()
         self._phase_seconds: dict[str, float] = {}
+        # Fencing token for this tick (wva_tpu/resilience): the lease
+        # epoch we act under. Captured BEFORE any work and re-checked
+        # between analyze and apply — losing it mid-tick aborts before a
+        # single write. None fence = election disabled (always leader).
+        if self.fence is not None:
+            self._tick_epoch = self.fence()
+            if self._tick_epoch is None:
+                raise LeadershipLostError(
+                    "leadership lost before tick start; not analyzing")
+        else:
+            self._tick_epoch = None
         if self.flight is not None:
             # Retried ticks must not stack duplicate model records into the
             # failed attempt's cycle.
@@ -667,12 +720,60 @@ class SaturationEngine:
             self.flight.record_decisions(decisions)
         apply_start = time.perf_counter()
         self._phase_seconds["analyze"] = apply_start - analyze_start
+        # Fence re-check between analyze and apply (wva_tpu/resilience):
+        # a leader deposed while analyzing must never actuate — the lease
+        # epoch captured at tick start must still be ours. Every write
+        # below additionally rides rv-guarded paths, so even a check that
+        # races a handover by microseconds cannot dual-actuate.
+        self._check_fence()
         self._apply_decisions(decisions, va_map, snap)
         self._apply_capacity()
         self._emit_trend_metrics(analyzer_name)
         self._emit_control_plane_metrics()
         self._emit_health_metrics()
+        self._maybe_checkpoint()
         self._phase_seconds["apply"] = time.perf_counter() - apply_start
+
+    def _check_fence(self) -> None:
+        """Raise unless this process still holds the lease epoch the tick
+        started under. Also the chaos harness's kill point: an armed
+        ``crash_before_apply`` dies here — decisions computed, nothing
+        applied — simulating a process crash mid-tick."""
+        if self.crash_before_apply:
+            self.crash_before_apply = False
+            raise SimulatedCrash(
+                "chaos: process killed between analyze and apply")
+        if self.fence is None:
+            return
+        current = self.fence()
+        if current is None or current != self._tick_epoch:
+            raise LeadershipLostError(
+                f"leadership lost mid-tick (epoch {self._tick_epoch} -> "
+                f"{current}); not applying decisions")
+
+    def _maybe_checkpoint(self) -> None:
+        """Durable soft-state checkpoint, throttled by the store. Runs at
+        the very end of the apply phase so the snapshot reflects what this
+        tick actually committed; the store fences and rv-guards the write
+        and never raises."""
+        if self.checkpointer is None:
+            return
+        self.checkpointer.maybe_save(self._tick_seq, self._tick_epoch,
+                                     self._checkpoint_payload)
+
+    def _checkpoint_payload(self) -> dict:
+        payload: dict = {}
+        if self.capacity is not None:
+            payload["capacity"] = self.capacity.ledger.export_state()
+        if self.health is not None:
+            payload["health"] = self.health.export_state()
+        if self.forecast is not None:
+            payload["forecast"] = self.forecast.export_trust()
+        leadtime = (self.forecast.leadtime if self.forecast is not None
+                    else getattr(self.capacity, "leadtime", None))
+        if leadtime is not None:
+            payload["leadtime"] = leadtime.export_state()
+        return payload
 
     def _emit_trend_metrics(self, analyzer_name: str) -> None:
         """Surface the active analyzer's DemandTrend health (per-key sample
@@ -748,6 +849,7 @@ class SaturationEngine:
         (clean fingerprint) still classify — their cache ages and the
         control-plane staleness are tick-global signals."""
         self._tick_health = {}
+        self._tick_age_observed = set()
         if self.health is None:
             return
         now = self.clock.now()
@@ -764,6 +866,14 @@ class SaturationEngine:
                         PARAM_NAMESPACE: vas[0].metadata.namespace})
                 except Exception:  # noqa: BLE001 — the probe must never
                     age = None     # fail the tick; unknown age degrades
+            if age is not None:
+                # A REAL backend observation exists for this model — the
+                # boot ramp's proof-of-freshness signal. The monitor's
+                # restart bootstrap ("never observed: start the clock
+                # now") deliberately does NOT count: a restart into an
+                # outage looks fresh to the age ladder for degraded_after
+                # seconds, exactly the window the ramp covers.
+                self._tick_age_observed.add(key)
             scraped, expected = self._tick_coverage.get(key, (None, None))
             self._tick_health[key] = self.health.observe(
                 key, now, metrics_age=age, control_age=control_age,
@@ -792,10 +902,48 @@ class SaturationEngine:
         if self.health is None:
             self.last_tick_health = {}
             self._tick_hold_variants = frozenset()
+            self._tick_ramp_holds = frozenset()
+            # WVA_HEALTH=off leaves no ramp/clamp path, but a warm start
+            # that recovered capacity/forecast/leadtime state still owes
+            # its one STAGE_BOOT observability record.
+            self._maybe_record_boot_stage(set())
             return
         now = self.clock.now()
+        # Do-no-harm boot ramp (wva_tpu/resilience): models still inside
+        # the startup hold are DEGRADED-equivalent until their inputs
+        # PROVE fresh — a FRESH classification backed by a real backend
+        # age this tick releases the hold permanently; anything else
+        # (restart-bootstrap freshness, degradation, no observation)
+        # keeps it. In a fault-free world every model proves fresh on the
+        # first tick and nothing is ever clamped — byte-identical to the
+        # ramp being off.
+        ramp_holds: set[str] = set()
+        if self.boot_ramp is not None and self.boot_ramp.active:
+            for key in sorted(self._tick_health):
+                if not self.boot_ramp.holding(key):
+                    continue
+                h = self._tick_health[key]
+                # Full scrape coverage is part of the proof: the ladder's
+                # coverage signal needs cross-tick memory (a shortfall
+                # classifies when it DROPPED below the last full pass or
+                # persisted a second tick) — memory a freshly booted
+                # process does not have, so a restart into a partial
+                # window would look FRESH for exactly one tick. A
+                # measured shortfall keeps the hold; the ladder takes
+                # over on the next tick.
+                scraped, expected = self._tick_coverage.get(
+                    key, (None, None))
+                covered = (scraped is None or not expected
+                           or scraped >= expected)
+                if (h.state == FRESH and h.allow_scale_down
+                        and key in self._tick_age_observed and covered):
+                    self.boot_ramp.release(key)
+                else:
+                    ramp_holds.add(key)
+            self.boot_ramp.note_tick()
+        self._tick_ramp_holds = frozenset(ramp_holds)
         stats = {"degraded": 0, "blackout": 0, "recovering": 0,
-                 "clamped": 0}
+                 "clamped": 0, "boot_held": len(ramp_holds)}
         for h in self._tick_health.values():
             if h.state == BLACKOUT:
                 stats["blackout"] += 1
@@ -805,22 +953,36 @@ class SaturationEngine:
                 stats["recovering"] += 1
         clamps: list[dict] = []
         for d in decisions:
-            h = self._tick_health.get(f"{d.model_id}|{d.namespace}")
+            key = f"{d.model_id}|{d.namespace}"
+            h = self._tick_health.get(key)
             if h is None:
                 continue
             held = self.health.held_desired(d.namespace, d.variant_name)
             target = self.health.gate_target(h, d.target_replicas,
                                              d.current_replicas, held)
+            state, verb = h.state, (
+                "frozen" if h.state == BLACKOUT else "held")
+            reason = h.reason
+            if key in ramp_holds:
+                # Ramp floor on top of the ladder's own gate: scale-ups
+                # pass, nothing drops below max(last-known-good, current)
+                # until this model's inputs prove fresh.
+                floor = max(held if held is not None else 0,
+                            d.current_replicas)
+                if floor > target:
+                    target = floor
+                if target != d.target_replicas and h.state == FRESH:
+                    state, verb = "boot", "held"
+                    reason = "inputs not yet proven fresh since restart"
             if target != d.target_replicas:
-                verb = "frozen" if h.state == BLACKOUT else "held"
                 clamps.append({
                     "variant_name": d.variant_name,
                     "namespace": d.namespace,
                     "model_id": d.model_id,
-                    "state": h.state,
+                    "state": state,
                     "target_replicas": target,
-                    "reason": (f"input health {h.state}: desired {verb} at "
-                               f"{target} ({h.reason})"),
+                    "reason": (f"input health {state}: desired {verb} at "
+                               f"{target} ({reason})"),
                 })
         stats["clamped"] = apply_health_clamps(decisions, clamps, now=now)
         # Post-gate targets become the new last-known-good (BLACKOUT ticks
@@ -840,9 +1002,10 @@ class SaturationEngine:
             {(va.metadata.namespace, va.metadata.name)
              for va in va_map.values()})
         self.last_tick_health = stats
+        self._maybe_record_boot_stage(ramp_holds)
         if self.flight is not None and (
                 clamps or stats["degraded"] or stats["blackout"]
-                or stats["recovering"]):
+                or stats["recovering"] or stats["boot_held"]):
             states = []
             for key in sorted(self._tick_health):
                 h = self._tick_health[key]
@@ -854,6 +1017,30 @@ class SaturationEngine:
                 })
             self.flight.record_stage(STAGE_HEALTH, {
                 "states": states, "clamps": clamps})
+
+    def _maybe_record_boot_stage(self, ramp_holds: set[str]) -> None:
+        """STAGE_BOOT: one observability record on the first traced cycle
+        after a boot worth talking about — warm start recovered state, or
+        the ramp is still holding models. A fresh fault-free boot records
+        nothing, keeping traces byte-identical to the plane being off."""
+        if self._boot_recorded or self.flight is None:
+            return
+        recovered = (self.boot_report.recovered_anything()
+                     if self.boot_report is not None else False)
+        if not recovered and not ramp_holds:
+            self._boot_recorded = True
+            return
+        self._boot_recorded = True
+        self.flight.record_stage(STAGE_BOOT, {
+            "recovered": (self.boot_report.to_dict()
+                          if self.boot_report is not None else {}),
+            "ramp_holding": sorted(ramp_holds),
+            "ramp_ticks_remaining": (
+                max(self.boot_ramp.hold_ticks - self.boot_ramp._ticks, 0)
+                if self.boot_ramp is not None else 0),
+            "epoch": self._tick_epoch if self._tick_epoch is not None
+            else -1,
+        })
 
     def _emit_health_metrics(self) -> None:
         """wva_input_health{model, namespace, state} one-hot gauges, swept
@@ -1286,6 +1473,7 @@ class SaturationEngine:
                            float(self.last_tick_stats.get("analyzed", 0)))
         registry.set_gauge(WVA_TICK_MODELS_SKIPPED, {},
                            float(self.last_tick_stats.get("skipped", 0)))
+        self._emit_resilience_metrics(registry)
         stats = getattr(self.client, "stats", None)
         if not callable(stats) or not getattr(self.client, "lists_are_local",
                                               False):
@@ -1296,6 +1484,35 @@ class SaturationEngine:
             if st["age_seconds"] >= 0:
                 registry.set_gauge(WVA_INFORMER_AGE_SECONDS, labels,
                                    st["age_seconds"])
+
+    def _emit_resilience_metrics(self, registry) -> None:
+        """wva_boot_* / wva_leader_epoch / wva_checkpoint_* gauges
+        (wva_tpu/resilience). Emitted only when the corresponding piece is
+        wired — a resilience-off build exports no new series."""
+        if self.boot_ramp is not None:
+            registry.set_gauge(WVA_BOOT_RAMP_MODELS_HELD, {},
+                               float(len(self._tick_ramp_holds)))
+        if self.boot_report is not None:
+            for source, count in (
+                    ("held", self.boot_report.held_seeded),
+                    ("orders", self.boot_report.orders_restored),
+                    ("stockouts", self.boot_report.stockouts_restored),
+                    ("health_books",
+                     self.boot_report.health_books_restored),
+                    ("trust", self.boot_report.trust_restored),
+                    ("leadtime",
+                     self.boot_report.leadtime_rings_restored)):
+                registry.set_gauge(WVA_BOOT_RECOVERED_ITEMS,
+                                   {LABEL_SOURCE: source}, float(count))
+        if self._tick_epoch is not None:
+            registry.set_gauge(WVA_LEADER_EPOCH, {},
+                               float(self._tick_epoch))
+        if self.checkpointer is not None:
+            registry.set_gauge(WVA_CHECKPOINT_WRITES, {},
+                               float(self.checkpointer.saves))
+            if self.checkpointer.last_saved_at >= 0:
+                registry.set_gauge(WVA_CHECKPOINT_LAST_SAVE_TIMESTAMP, {},
+                                   self.checkpointer.last_saved_at)
 
     # --- V1 path ---
 

@@ -62,6 +62,13 @@ class ScaleFromZeroEngine:
         # write guard), so the two can never fight.
         self.forecast = forecast_planner
         self.clock = clock or SYSTEM_CLOCK
+        # Leadership re-check immediately before any write (None = always
+        # allowed). The executor's gate stops TICKS while demoted, but a
+        # tick that STARTED while leading fans candidates across a worker
+        # pool — a mid-tick demotion (renew deadline passing, storm) must
+        # stop those workers at the write boundary, not let a deposed
+        # replica wake a model the new leader is already managing.
+        self.write_gate = None
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock,
                                         name=common.SOURCE_SCALE_FROM_ZERO)
@@ -131,6 +138,10 @@ class ScaleFromZeroEngine:
             metrics_message = ("Trusted demand forecast triggered a "
                                "speculative pre-wake (no queued requests)")
 
+        if self.write_gate is not None and not self.write_gate():
+            # Demoted between tick start and this candidate's decision:
+            # the new leader's own loop owns the wake now.
+            return
         try:
             changed = self.actuator.scale_target_object(
                 va.spec.scale_target_ref.kind, va.metadata.namespace,

@@ -219,6 +219,57 @@ class LeadTimeEstimator:
             return sum(len(r) for (mk, _), r in self._samples.items()
                        if mk == model_key)
 
+    # --- crash-restart checkpoint (wva_tpu.resilience) ---
+
+    @staticmethod
+    def _export_rings(store: dict, split_key: bool) -> list:
+        if split_key:
+            return [[k[0], k[1], list(ring)]
+                    for k, ring in sorted(store.items()) if ring]
+        return [[k, list(ring)] for k, ring in sorted(store.items())
+                if ring]
+
+    def export_state(self) -> dict:
+        """Serializable sample rings for the resilience checkpoint — the
+        measured actuation->ready and provisioning latencies every horizon
+        decision keys on (losing them re-opens the default-constant
+        under-provisioning window after every restart). Open episodes are
+        NOT exported: their (desired, ready) anchors do not survive the
+        restart gap, and a re-opened episode mid-scale-up would record a
+        bogus short sample."""
+        with self._mu:
+            return {
+                "samples": self._export_rings(self._samples, True),
+                "by_accel": self._export_rings(self._by_accel, False),
+                "prov": self._export_rings(self._prov, True),
+                "prov_by_tier": self._export_rings(self._prov_by_tier,
+                                                   False),
+                "serve": self._export_rings(self._serve, False),
+            }
+
+    def restore_state(self, state: dict) -> int:
+        """Rehydrate from :meth:`export_state` output (boot warm-start).
+        Returns how many rings were restored."""
+        restored = 0
+        with self._mu:
+            for model_key, accel, values in state.get("samples", []):
+                ring = self._ring(self._samples, (str(model_key),
+                                                  str(accel)))
+                ring.extend(float(v) for v in values)
+                restored += 1
+            for variant, tier, values in state.get("prov", []):
+                ring = self._ring(self._prov, (str(variant), str(tier)))
+                ring.extend(float(v) for v in values)
+                restored += 1
+            for store_name, store in (("by_accel", self._by_accel),
+                                      ("prov_by_tier", self._prov_by_tier),
+                                      ("serve", self._serve)):
+                for key, values in state.get(store_name, []):
+                    ring = self._ring(store, str(key))
+                    ring.extend(float(v) for v in values)
+                    restored += 1
+        return restored
+
     def evict_missing(self, live_keys: set[str]) -> None:
         """Drop episodes + samples for models that no longer exist."""
         with self._mu:

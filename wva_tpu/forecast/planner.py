@@ -460,3 +460,44 @@ class CapacityPlanner:
         """History-store stats keyed by model key (for trend/forecast
         gauges)."""
         return self.history.stats(now)
+
+    # -- crash-restart checkpoint (wva_tpu.resilience) --
+
+    def export_trust(self) -> dict:
+        """Serializable trust state for the resilience checkpoint: rolling
+        backtest errors (the trust gate's entire evidence base — weeks of
+        matured evaluations a restart would otherwise discard), the
+        per-model demand scale the error denominator floors on, and the
+        dominant-accelerator map lead-time fallbacks key on. Pending
+        (not-yet-matured) forecasts are NOT exported — they score against
+        the in-memory demand history, which does not survive either.
+        Sorted everywhere: equal state serializes byte-identically."""
+        with self._mu:
+            return {
+                "errors": [[key, name, err, evals]
+                           for (key, name), (err, evals)
+                           in sorted(self._errors.items())],
+                "demand_scale": [[k, v] for k, v
+                                 in sorted(self._demand_scale.items())],
+                "accel": [[k, v] for k, v
+                          in sorted(self._accel_by_key.items())],
+            }
+
+    def restore_trust(self, state: dict) -> int:
+        """Rehydrate from :meth:`export_trust` output (boot warm-start).
+        A restored model whose best forecaster already passed the trust
+        gate resumes proactive floors as soon as fresh demand history
+        rebuilds — instead of re-earning ``min_trust_evals`` matured
+        backtests from scratch after every restart. Returns how many
+        (model, forecaster) error entries were restored."""
+        restored = 0
+        with self._mu:
+            for key, name, err, evals in state.get("errors", []):
+                self._errors[(str(key), str(name))] = \
+                    (float(err), int(evals))
+                restored += 1
+            for key, value in state.get("demand_scale", []):
+                self._demand_scale[str(key)] = float(value)
+            for key, accel in state.get("accel", []):
+                self._accel_by_key[str(key)] = str(accel)
+        return restored

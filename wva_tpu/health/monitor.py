@@ -208,6 +208,47 @@ class InputHealthMonitor:
             with self._mu:
                 self._held[(namespace, variant)] = target
 
+    # --- crash-restart warm start (wva_tpu.resilience) ---
+
+    def seed_held(self, namespace: str, variant: str, desired: int) -> None:
+        """Boot warm-start: seed the last-known-good desired from durable
+        VA status (``status.desiredOptimizedAlloc`` survives any crash).
+        Overwrites — the caller orders its sources freshest-last."""
+        with self._mu:
+            self._held[(namespace, variant)] = int(desired)
+
+    def export_state(self) -> dict:
+        """Serializable held/books state for the resilience checkpoint
+        (sorted; equal state serializes byte-identically). Tuple keys
+        flatten to lists — JSON has no tuple keys."""
+        with self._mu:
+            return {
+                "held": [[ns, variant, desired]
+                         for (ns, variant), desired
+                         in sorted(self._held.items())],
+                "books": [[key, book.last_good_at, book.in_recovery]
+                          for key, book in sorted(self._books.items())],
+            }
+
+    def restore_state(self, state: dict) -> int:
+        """Rehydrate from :meth:`export_state` output. The fresh-streak
+        restarts at zero: a restored in-recovery model must re-earn its
+        ``recovery_ticks`` consecutive fresh observations in THIS process
+        before scale-downs resume (the safe direction). Returns how many
+        books were restored."""
+        restored = 0
+        with self._mu:
+            for ns, variant, desired in state.get("held", []):
+                self._held[(str(ns), str(variant))] = int(desired)
+            for key, last_good_at, in_recovery in state.get("books", []):
+                book = self._books.setdefault(str(key), _ModelBook())
+                if last_good_at is not None:
+                    book.last_good_at = float(last_good_at)
+                book.in_recovery = bool(in_recovery)
+                book.fresh_streak = 0
+                restored += 1
+        return restored
+
     def prune(self, active_keys: set[str],
               active_variants: set[tuple[str, str]]) -> None:
         """Deleted models/variants must not pin state forever."""

@@ -64,6 +64,13 @@ class LeaderElector:
         # never by comparing another replica's renew_time to our clock.
         self._observed_record: tuple[str, float] | None = None
         self._observed_at = -1e18
+        # Lease-epoch fencing token: the lease's lease_transitions value
+        # at OUR acquisition. Every handover bumps it (expired-acquire
+        # increments; a fresh create starts a new counter), so two
+        # tenures — even of the same identity — never share an epoch. The
+        # engine stamps it through the apply phase; see
+        # wva_tpu/resilience (fenced failover).
+        self._epoch = -1
         self.on_started_leading = None  # optional callbacks
         self.on_stopped_leading = None
 
@@ -81,49 +88,99 @@ class LeaderElector:
         self._fire(cb)
         return False
 
-    def tick(self) -> bool:
-        """One acquire-or-renew attempt; returns leadership after the step."""
-        cfg = self.config
-        now = self.clock.now()
-        try:
-            lease = self.client.try_get(Lease.KIND, cfg.namespace, cfg.lease_name)
-            if lease is None:
-                self.client.create(Lease(
-                    metadata=ObjectMeta(name=cfg.lease_name,
-                                        namespace=cfg.namespace),
-                    holder_identity=self.identity,
-                    lease_duration_seconds=int(cfg.lease_duration),
-                    acquire_time=now, renew_time=now, lease_transitions=0))
-                self._became_leader(now, "acquired (new lease)")
-                return True
+    def fencing_token(self) -> int | None:
+        """Lease epoch while leading (renew-deadline aware), else None.
+        Callers stamp it through their write phases: a token captured
+        before a handover never matches the token after it, so a deposed
+        process can be fenced even when its own clock has not yet demoted
+        it."""
+        if not self.is_leader():
+            return None
+        with self._mu:
+            return self._epoch if self._epoch >= 0 else None
 
-            record = (lease.holder_identity, lease.renew_time)
-            if record != self._observed_record:
-                self._observed_record = record
-                self._observed_at = now
-            expired = now - self._observed_at > cfg.lease_duration
-            if lease.holder_identity == self.identity:
-                lease = clone(lease)  # reads are frozen store views
-                lease.renew_time = now
-                self.client.update(lease)
-                with self._mu:
-                    self._renewed_at = now
-                    cb = self._set_leader(True)
-                self._fire(cb)
-                return True
-            if not lease.holder_identity or expired:
-                lease = clone(lease)
-                lease.holder_identity = self.identity
-                lease.acquire_time = now
-                lease.renew_time = now
-                lease.lease_transitions += 1
-                self.client.update(lease)
-                self._became_leader(now, "acquired (expired lease)")
-                return True
+    def tick(self) -> bool:
+        """One acquire-or-renew attempt; returns leadership after the step.
+
+        Transient-failure discipline (apiserver storms — see
+        tests/test_faults.py): a transport error neither demotes nor
+        acquires — the renew-deadline self-demotion in :meth:`is_leader`
+        is the ONLY way connectivity loss costs leadership, and the
+        observed-lease expiry rule is the only way it is gained, so a
+        storm can never produce two leaders. A ConflictError gets ONE
+        immediate re-observe: the holder whose renew raced a conflicting
+        write re-reads the lease and renews against the fresh
+        resourceVersion instead of demoting on a transient 409; a genuine
+        lost race shows another holder on re-read and demotes properly.
+        """
+        try:
+            return self._tick_once()
         except ConflictError:
-            log.debug("Lease race lost by %s; retrying next period", self.identity)
+            try:
+                return self._tick_once()
+            except ConflictError:
+                log.debug("Lease race lost by %s; retrying next period",
+                          self.identity)
+            except NotFoundError:
+                pass
+            except Exception as e:  # noqa: BLE001 — transient, see above
+                log.warning("leader-election retry failed for %s: %s",
+                            self.identity, e)
+                return self.is_leader()
         except NotFoundError:
             pass
+        except Exception as e:  # noqa: BLE001 — transient, see above
+            log.warning("leader-election tick failed for %s: %s",
+                        self.identity, e)
+            return self.is_leader()
+        with self._mu:
+            cb = self._set_leader(False)
+        self._fire(cb)
+        return False
+
+    def _tick_once(self) -> bool:
+        """One acquire-or-renew attempt; raises on client errors (the
+        caller owns retry/demotion policy) and demotes on observing
+        another live holder."""
+        cfg = self.config
+        now = self.clock.now()
+        lease = self.client.try_get(Lease.KIND, cfg.namespace, cfg.lease_name)
+        if lease is None:
+            self.client.create(Lease(
+                metadata=ObjectMeta(name=cfg.lease_name,
+                                    namespace=cfg.namespace),
+                holder_identity=self.identity,
+                lease_duration_seconds=int(cfg.lease_duration),
+                acquire_time=now, renew_time=now, lease_transitions=0))
+            self._became_leader(now, 0, "acquired (new lease)")
+            return True
+
+        record = (lease.holder_identity, lease.renew_time)
+        if record != self._observed_record:
+            self._observed_record = record
+            self._observed_at = now
+        expired = now - self._observed_at > cfg.lease_duration
+        if lease.holder_identity == self.identity:
+            epoch = lease.lease_transitions
+            lease = clone(lease)  # reads are frozen store views
+            lease.renew_time = now
+            self.client.update(lease)
+            with self._mu:
+                self._renewed_at = now
+                self._epoch = epoch
+                cb = self._set_leader(True)
+            self._fire(cb)
+            return True
+        if not lease.holder_identity or expired:
+            lease = clone(lease)
+            lease.holder_identity = self.identity
+            lease.acquire_time = now
+            lease.renew_time = now
+            lease.lease_transitions += 1
+            self.client.update(lease)
+            self._became_leader(now, lease.lease_transitions,
+                                "acquired (expired lease)")
+            return True
         with self._mu:
             cb = self._set_leader(False)
         self._fire(cb)
@@ -150,12 +207,14 @@ class LeaderElector:
 
     # -- internals --
 
-    def _became_leader(self, now: float, how: str) -> None:
+    def _became_leader(self, now: float, epoch: int, how: str) -> None:
         with self._mu:
             self._renewed_at = now
+            self._epoch = epoch
             cb = self._set_leader(True)
         self._fire(cb)
-        log.info("Leader election: %s %s", self.identity, how)
+        log.info("Leader election: %s %s (epoch %d)", self.identity, how,
+                 epoch)
 
     def _set_leader(self, value: bool):
         """State flip under the lock; returns the transition callback to run

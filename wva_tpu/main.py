@@ -407,6 +407,35 @@ def build_manager(
             freeze_after=health_cfg.freeze_after_seconds,
             recovery_ticks=health_cfg.recovery_ticks)
 
+    # Crash-restart resilience plane (WVA_RESILIENCE, default on): on
+    # boot, re-seed health last-known-goods from durable VA status and
+    # rehydrate capacity/forecast/lead-time soft state from the
+    # rv-guarded checkpoint ConfigMap (WVA_CHECKPOINT); run every model
+    # through a do-no-harm boot ramp (WVA_STARTUP_HOLD_TICKS) until its
+    # inputs prove fresh; fence the apply phase with the lease epoch
+    # (docs/design/resilience.md). Disabled, boots are blind (pre-change
+    # behavior) and decisions/statuses/traces are byte-identical in a
+    # fault-free world.
+    boot_ramp = checkpointer = boot_report = None
+    res_cfg = config.resilience_config()
+    if res_cfg.enabled:
+        from wva_tpu.config.helpers import system_namespace
+        from wva_tpu.resilience import BootRamp, CheckpointStore, warm_start
+
+        if res_cfg.checkpoint_enabled:
+            checkpointer = CheckpointStore(
+                client, namespace=system_namespace(),
+                interval_ticks=res_cfg.checkpoint_interval_ticks,
+                clock=clock)
+        boot_report = warm_start(
+            client, config.watch_namespace() or None, clock.now(),
+            health=health, capacity=capacity, forecast=forecast_planner,
+            store=checkpointer)
+        if health is not None:
+            # The ramp rides the health gate; without the health plane it
+            # has no clamp path and stays inert.
+            boot_ramp = BootRamp(res_cfg.startup_hold_ticks)
+
     # Analysis pool width 0 = auto, resolved by the metrics backend (same
     # rule as PrometheusSource's query concurrency): per-model collection
     # against HTTP Prometheus is I/O-bound and overlaps across workers; the
@@ -424,7 +453,10 @@ def build_manager(
         analysis_workers=workers,
         forecast_planner=forecast_planner,
         capacity=capacity,
-        health=health)
+        health=health,
+        boot_ramp=boot_ramp,
+        checkpointer=checkpointer)
+    engine.boot_report = boot_report
     engine.grouped_collection = config.grouped_collection_enabled()
     engine.incremental_enabled = config.incremental_enabled()
     engine.resync_ticks = config.resync_ticks()
@@ -469,6 +501,21 @@ def build_manager(
         engine.executor.gate = elector.is_leader
         scale_from_zero.executor.gate = elector.is_leader
         fastpath.executor.gate = elector.is_leader
+        # A demoted manager must stop EVERY write path, not just the
+        # engine tick: the scale-from-zero wake re-checks leadership
+        # immediately before actuating (its worker pool can outlive a
+        # mid-tick demotion), and the reconciler's decision-trigger drain
+        # is leader-gated (DecisionCache entries from the leadership era
+        # must not be flushed by a standby). See the non-leader-never-
+        # writes regression in tests/test_resilience.py.
+        scale_from_zero.write_gate = elector.is_leader
+        va_reconciler.gate = elector.is_leader
+        if res_cfg.enabled:
+            # Lease-epoch fencing through the apply phase: captured at
+            # tick start, re-checked between analyze and apply — a
+            # deposed leader mid-tick can never actuate
+            # (docs/design/resilience.md).
+            engine.fence = elector.fencing_token
 
     return Manager(
         client=client, config=config, clock=clock, registry=registry,

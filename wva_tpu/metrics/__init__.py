@@ -43,7 +43,12 @@ from wva_tpu.constants import (
     WVA_FORECAST_LEAD_TIME_SECONDS,
     WVA_INFORMER_AGE_SECONDS,
     WVA_INFORMER_SYNCED,
+    WVA_BOOT_RAMP_MODELS_HELD,
+    WVA_BOOT_RECOVERED_ITEMS,
+    WVA_CHECKPOINT_LAST_SAVE_TIMESTAMP,
+    WVA_CHECKPOINT_WRITES,
     WVA_INPUT_HEALTH,
+    WVA_LEADER_EPOCH,
     WVA_REPLICA_SCALING_TOTAL,
     WVA_TICK_MODELS_ANALYZED,
     WVA_TICK_MODELS_SKIPPED,
@@ -156,6 +161,24 @@ class MetricsRegistry:
         self._register(WVA_CAPACITY_PROVISION_LEAD_SECONDS, "gauge",
                        "Measured slice provisioning lead (submission -> "
                        "discovered ready) per (variant, tier)")
+        self._register(WVA_BOOT_RAMP_MODELS_HELD, "gauge",
+                       "Models still held DEGRADED-equivalent by the "
+                       "post-restart boot ramp (inputs not yet proven "
+                       "fresh)")
+        self._register(WVA_BOOT_RECOVERED_ITEMS, "gauge",
+                       "Items recovered by boot warm start, per source "
+                       "(held | orders | stockouts | health_books | "
+                       "trust | leadtime)")
+        self._register(WVA_LEADER_EPOCH, "gauge",
+                       "Lease epoch (leaseTransitions at acquisition) "
+                       "this process acts under; exported only while "
+                       "leading")
+        self._register(WVA_CHECKPOINT_WRITES, "gauge",
+                       "Resilience-checkpoint ConfigMap writes since "
+                       "process start")
+        self._register(WVA_CHECKPOINT_LAST_SAVE_TIMESTAMP, "gauge",
+                       "Timestamp of the newest resilience-checkpoint "
+                       "write")
 
     def _register(self, name: str, kind: str, help_text: str) -> None:
         self._series[name] = _Series(name, kind, help_text)

@@ -1,0 +1,230 @@
+"""Crash-restart resilience plane (docs/design/resilience.md).
+
+PR 10 made the engine do-no-harm while its *inputs* fail; this package makes
+the controller itself survivable. Three cooperating pieces:
+
+- **Warm-start recovery** (:func:`warm_start`): on boot, re-seed the
+  input-health plane's last-known-good desireds from durable VA status
+  (``status.desiredOptimizedAlloc`` — written every tick, survives any
+  crash) and rehydrate the capacity ledger, forecast trust scores, and
+  measured lead-time samples from a compact rv-guarded checkpoint
+  ConfigMap (:class:`CheckpointStore`). Orders submitted after the last
+  checkpoint are simply absent from it — the shortfall re-orders, which is
+  the safe direction (extra capacity arriving, never phantom credit).
+
+- **Do-no-harm boot ramp** (:class:`BootRamp`): for the first
+  ``WVA_STARTUP_HOLD_TICKS`` engine ticks every model is treated as
+  DEGRADED-equivalent (scale-UP allowed, scale-down/scale-to-zero
+  forbidden) until its inputs PROVE fresh — a real backend observation
+  classified FRESH, not the health monitor's restart-bootstrap "the clock
+  starts now" freshness. In a fault-free world the first tick proves every
+  model fresh and the ramp releases without clamping anything, so
+  decisions, statuses, and traces are byte-identical to the plane being
+  off (same discipline as ``WVA_HEALTH``).
+
+- **Fenced leader failover**: the elector exposes a lease-epoch fencing
+  token (``lease_transitions`` at acquisition — bumped by every handover);
+  the engine captures it at tick start and re-checks it between analyze
+  and apply. A leader deposed mid-tick raises
+  :class:`LeadershipLostError` instead of actuating — combined with the
+  rv-guarded status writes, two processes can never both actuate inside
+  one epoch.
+
+Everything is ``WVA_RESILIENCE``-gated (default on); the durable
+checkpoint alone is additionally ``WVA_CHECKPOINT``-gated so operators can
+fall back to the boot ramp only (``WVA_CHECKPOINT=off``) with the same
+zero-wrong-direction guarantee.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from wva_tpu.resilience.checkpoint import (
+    CHECKPOINT_CONFIGMAP_NAME,
+    CHECKPOINT_DATA_KEY,
+    CHECKPOINT_SCHEMA_VERSION,
+    CheckpointStore,
+    canonical_json,
+)
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "CHECKPOINT_CONFIGMAP_NAME",
+    "CHECKPOINT_DATA_KEY",
+    "CHECKPOINT_SCHEMA_VERSION",
+    "BootRamp",
+    "CheckpointStore",
+    "LeadershipLostError",
+    "SimulatedCrash",
+    "WarmStartReport",
+    "canonical_json",
+    "warm_start",
+]
+
+
+class LeadershipLostError(RuntimeError):
+    """Raised by the engine's fence check when leadership (or the lease
+    epoch) changed between analyze and apply: a deposed leader mid-tick
+    must never actuate. The executor's retry loop re-checks its leader
+    gate and aborts the tick."""
+
+
+class SimulatedCrash(RuntimeError):
+    """Chaos-harness injection: the process 'dies' between analyze and
+    apply (decisions computed, never actuated). Raised by the engine when
+    its ``crash_before_apply`` hook is armed; the harness then tears the
+    manager down and rebuilds it against the same world."""
+
+
+class BootRamp:
+    """Per-model do-no-harm hold for the first ticks after process start.
+
+    A model is *held* (scale-down forbidden, scale-up allowed) until its
+    inputs prove fresh — the health monitor classified it FRESH from a
+    REAL backend observation this tick — or the ramp expires after
+    ``hold_ticks`` engine ticks, by which time the age-based health ladder
+    has taken over (its restart-bootstrap grace is ``degraded_after``
+    seconds; size ``hold_ticks`` to cover it at your engine interval).
+    Single-threaded by design: only the engine tick touches it.
+    """
+
+    def __init__(self, hold_ticks: int) -> None:
+        self.hold_ticks = max(0, int(hold_ticks))
+        self._ticks = 0
+        self._released: set[str] = set()
+
+    @property
+    def active(self) -> bool:
+        return self._ticks < self.hold_ticks
+
+    def holding(self, key: str) -> bool:
+        return self.active and key not in self._released
+
+    def release(self, key: str) -> None:
+        """Inputs proved fresh for this model: the hold ends permanently
+        (the health ladder owns any later degradation)."""
+        self._released.add(key)
+
+    def note_tick(self) -> None:
+        self._ticks += 1
+
+
+@dataclass
+class WarmStartReport:
+    """What boot recovery found — feeds the ``STAGE_BOOT`` trace stage and
+    the ``wva_boot_recovered_items`` gauges."""
+
+    held_seeded: int = 0
+    orders_restored: int = 0
+    stockouts_restored: int = 0
+    health_books_restored: int = 0
+    trust_restored: int = 0
+    leadtime_rings_restored: int = 0
+    checkpoint_loaded: bool = False
+    checkpoint_age_seconds: float = -1.0
+
+    def recovered_anything(self) -> bool:
+        return bool(self.checkpoint_loaded or self.held_seeded)
+
+    def to_dict(self) -> dict:
+        return {
+            "held_seeded": self.held_seeded,
+            "orders_restored": self.orders_restored,
+            "stockouts_restored": self.stockouts_restored,
+            "health_books_restored": self.health_books_restored,
+            "trust_restored": self.trust_restored,
+            "leadtime_rings_restored": self.leadtime_rings_restored,
+            "checkpoint_loaded": self.checkpoint_loaded,
+            "checkpoint_age_seconds": round(self.checkpoint_age_seconds, 3),
+        }
+
+
+def warm_start(client, watch_namespace: str | None, now: float,
+               health=None, capacity=None, forecast=None,
+               store: CheckpointStore | None = None) -> WarmStartReport:
+    """Boot-time state recovery. Best-effort on purpose: a storming
+    apiserver at boot degrades to the boot ramp (which exists exactly for
+    the nothing-recovered case), never fails process start.
+
+    Ordering: the checkpoint restores first, then durable VA status
+    OVERRIDES the health last-known-goods — the engine writes status every
+    tick but checkpoints only every ``WVA_CHECKPOINT_INTERVAL`` ticks, so
+    status is the fresher record of what we last asked for.
+    """
+    report = WarmStartReport()
+
+    if store is not None:
+        data = None
+        try:
+            data = store.load()
+        except Exception as e:  # noqa: BLE001 — recovery is best-effort
+            log.warning("resilience: checkpoint load failed: %s", e)
+        if data is not None:
+            # Each section restores independently: a schema-valid but
+            # content-corrupt checkpoint (truncated write, hand edit) must
+            # degrade that section to the boot ramp, never crash-loop the
+            # process by failing every restart against the same ConfigMap.
+            report.checkpoint_loaded = True
+            try:
+                saved_at = float(data.get("saved_at", 0.0))
+            except (TypeError, ValueError):
+                saved_at = 0.0
+            if saved_at > 0:
+                report.checkpoint_age_seconds = max(now - saved_at, 0.0)
+            if capacity is not None and "capacity" in data:
+                try:
+                    restored = capacity.ledger.restore_state(data["capacity"])
+                    report.orders_restored = restored.get("orders", 0)
+                    report.stockouts_restored = restored.get("stockouts", 0)
+                except Exception as e:  # noqa: BLE001 — see above
+                    log.warning(
+                        "resilience: capacity checkpoint corrupt, "
+                        "skipping section: %s", e)
+            if health is not None and "health" in data:
+                try:
+                    report.health_books_restored = \
+                        health.restore_state(data["health"])
+                except Exception as e:  # noqa: BLE001
+                    log.warning(
+                        "resilience: health checkpoint corrupt, "
+                        "skipping section: %s", e)
+            if forecast is not None and "forecast" in data:
+                try:
+                    report.trust_restored = \
+                        forecast.restore_trust(data["forecast"])
+                except Exception as e:  # noqa: BLE001
+                    log.warning(
+                        "resilience: forecast checkpoint corrupt, "
+                        "skipping section: %s", e)
+            leadtime = (forecast.leadtime if forecast is not None
+                        else getattr(capacity, "leadtime", None))
+            if leadtime is not None and "leadtime" in data:
+                try:
+                    report.leadtime_rings_restored = \
+                        leadtime.restore_state(data["leadtime"])
+                except Exception as e:  # noqa: BLE001
+                    log.warning(
+                        "resilience: leadtime checkpoint corrupt, "
+                        "skipping section: %s", e)
+
+    if health is not None:
+        try:
+            vas = client.list("VariantAutoscaling",
+                              namespace=watch_namespace or None)
+        except Exception as e:  # noqa: BLE001 — see above
+            log.warning("resilience: VA warm-start listing failed: %s", e)
+            vas = []
+        for va in vas:
+            alloc = va.status.desired_optimized_alloc
+            # last_run_time == 0 means the status was never written — a
+            # fresh VA has no last-known-good to seed.
+            if alloc.last_run_time > 0 and alloc.num_replicas >= 0:
+                health.seed_held(va.metadata.namespace, va.metadata.name,
+                                 alloc.num_replicas)
+                report.held_seeded += 1
+    if report.recovered_anything():
+        log.info("resilience: warm start recovered %s", report.to_dict())
+    return report

@@ -1,0 +1,151 @@
+"""Durable controller-soft-state checkpoint (ConfigMap-backed).
+
+The controller's decision-critical soft state — capacity in-flight orders
+and stockout pins, health last-known-goods, forecast trust scores, measured
+lead-time samples — dies with the process. This store serializes it to ONE
+compact ConfigMap (``wva-resilience-checkpoint`` in the controller's
+namespace), written at most every ``interval_ticks`` engine ticks through
+the same client every other write uses (so the informer's write-through
+keeps the store coherent), and rv-guarded: a conflicting write means
+another process owns the checkpoint now, and this round is simply skipped.
+
+Fencing: every checkpoint carries the writer's lease epoch. A deposed
+leader (older epoch) finding a NEWER epoch in the stored checkpoint skips
+its write — combined with the rv guard, a stale process can never clobber
+the new leader's recovery state.
+
+Serialization is canonical (sorted keys, fixed separators, lists instead
+of tuple-keyed dicts) so ``save -> load -> save`` round-trips
+byte-identically — the property test in tests/test_resilience.py holds the
+plane to that.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from wva_tpu.k8s.client import ConflictError, KubeClient
+from wva_tpu.k8s.objects import ConfigMap, ObjectMeta, clone
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+CHECKPOINT_CONFIGMAP_NAME = "wva-resilience-checkpoint"
+CHECKPOINT_DATA_KEY = "checkpoint.json"
+CHECKPOINT_SCHEMA_VERSION = 1
+
+
+def canonical_json(payload: dict) -> str:
+    """Deterministic encoding: byte-identical for equal state."""
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"))
+
+
+class CheckpointStore:
+    """Throttled, fenced, rv-guarded checkpoint writer/reader."""
+
+    def __init__(self, client: KubeClient, namespace: str,
+                 interval_ticks: int = 20,
+                 name: str = CHECKPOINT_CONFIGMAP_NAME,
+                 clock: Clock | None = None) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.name = name
+        self.interval_ticks = max(1, int(interval_ticks))
+        self.clock = clock or SYSTEM_CLOCK
+        # Introspection for tests/bench.
+        self.saves = 0
+        self.skipped_fenced = 0
+        self.skipped_conflict = 0
+        self.last_saved_at = -1.0
+        self._last_save_tick = 0
+
+    # --- write path ---
+
+    def maybe_save(self, tick_seq: int, epoch: int | None,
+                   payload_fn) -> bool:
+        """Write a checkpoint when the tick interval elapsed. ``payload_fn``
+        is called only when a write will actually be attempted (gathering
+        fleet state is not free). NEVER raises — a checkpoint failure must
+        not fail the engine tick."""
+        if tick_seq - self._last_save_tick < self.interval_ticks:
+            return False
+        try:
+            payload = dict(payload_fn())
+            payload["schema"] = CHECKPOINT_SCHEMA_VERSION
+            payload["saved_at"] = self.clock.now()
+            payload["epoch"] = epoch if epoch is not None else -1
+            saved = self._write(payload)
+        except Exception as e:  # noqa: BLE001 — never fail the tick
+            log.warning("resilience: checkpoint save failed: %s", e)
+            return False
+        if saved:
+            self._last_save_tick = tick_seq
+            self.saves += 1
+            self.last_saved_at = payload["saved_at"]
+        return saved
+
+    def _write(self, payload: dict) -> bool:
+        body = canonical_json(payload)
+        existing = self.client.try_get(ConfigMap.KIND, self.namespace,
+                                       self.name)
+        if existing is None:
+            self.client.create(ConfigMap(
+                metadata=ObjectMeta(name=self.name,
+                                    namespace=self.namespace),
+                data={CHECKPOINT_DATA_KEY: body}))
+            return True
+        # Fence: a stored checkpoint from a NEWER lease epoch means another
+        # process leads now; a deposed writer must not clobber its state.
+        stored_epoch = self._epoch_of(existing)
+        ours = payload.get("epoch", -1)
+        if stored_epoch is not None and ours >= 0 and stored_epoch > ours:
+            self.skipped_fenced += 1
+            log.warning(
+                "resilience: checkpoint fenced (stored epoch %d > ours %d);"
+                " not writing", stored_epoch, ours)
+            return False
+        cm = clone(existing)
+        cm.data = dict(cm.data)
+        cm.data[CHECKPOINT_DATA_KEY] = body
+        try:
+            # rv-guarded: the clone carries the read resourceVersion, so a
+            # concurrent writer (new leader) wins and we skip this round.
+            self.client.update(cm)
+        except ConflictError:
+            self.skipped_conflict += 1
+            return False
+        return True
+
+    @staticmethod
+    def _epoch_of(cm) -> int | None:
+        try:
+            data = json.loads(cm.data.get(CHECKPOINT_DATA_KEY, ""))
+            epoch = int(data.get("epoch", -1))
+            return epoch if epoch >= 0 else None
+        except (ValueError, TypeError, AttributeError):
+            return None
+
+    # --- read path ---
+
+    def load(self) -> dict | None:
+        """The stored checkpoint payload, or None (absent / unparsable /
+        future schema). Never raises for malformed content — boot recovery
+        degrades to the ramp."""
+        cm = self.client.try_get(ConfigMap.KIND, self.namespace, self.name)
+        if cm is None:
+            return None
+        try:
+            data = json.loads(cm.data.get(CHECKPOINT_DATA_KEY, ""))
+        except (ValueError, AttributeError):
+            log.warning("resilience: stored checkpoint is unparsable; "
+                        "ignoring")
+            return None
+        if not isinstance(data, dict) \
+                or data.get("schema") != CHECKPOINT_SCHEMA_VERSION:
+            log.warning("resilience: stored checkpoint schema %r != %d; "
+                        "ignoring", data.get("schema") if isinstance(
+                            data, dict) else None,
+                        CHECKPOINT_SCHEMA_VERSION)
+            return None
+        return data
