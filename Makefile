@@ -45,7 +45,7 @@ bench-tick: ## Fleet-scale tick microbench (48 models / 96 VAs, in-memory stack)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tick-only
 
 .PHONY: bench-tick-quiet
-bench-tick-quiet: ## Steady-state quiet-tick microbench (48 models default, MODELS=N overrides): shipped vs fp-recompute vs informer-only vs per-tick-LIST, plus the 48/144/480 fleet-growth sweep; merges detail.incremental_tick + detail.fingerprint_plane into BENCH_LOCAL.json.
+bench-tick-quiet: ## Steady-state quiet-tick microbench (48 models default, MODELS=N overrides): shipped vs fp-recompute vs informer-only vs per-tick-LIST, plus the 48/144/480/2000 fleet-growth sweep; merges detail.incremental_tick + detail.fingerprint_plane into BENCH_LOCAL.json.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tick-quiet-only $(if $(MODELS),--models $(MODELS))
 
 .PHONY: bench-profile
@@ -67,6 +67,7 @@ replay-golden: ## Replay the committed golden decision traces (must be zero diff
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/capacity_trace_v1.jsonl
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/health_trace_v1.jsonl
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/boot_trace_v1.jsonl
+	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/shard_trace_v1.jsonl
 
 .PHONY: backtest-golden
 backtest-golden: ## Backtest every forecaster on the committed golden forecast trace and gate against the committed report (MAPE + under/over-provision cost; a seasonal forecaster must keep beating the linear baseline).
@@ -89,6 +90,10 @@ bench-chaos: ## Chaos soak (48 models, seeded metrics blackouts / partial respon
 .PHONY: bench-failover
 bench-failover: ## Crash-restart + leader-flap storm (48 models, two managers over one world, seeded kills/flaps, checkpoint on AND off): asserts zero wrong-direction scale events in every restart/handover window, zero dual-actuation (one writer per lease epoch), and <=5-tick post-restart reconvergence; merges detail.failover into BENCH_LOCAL.json. FAILOVER_SMOKE=1 runs the short CI shape.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --failover-only $(if $(FAILOVER_SMOKE),--smoke)
+
+.PHONY: bench-shard
+bench-shard: ## Sharded active-active engine bench (480-model world, 4 consistent-hash shards over one FakeCluster): asserts fleet decisions byte-identical to the unsharded engine, per-shard quiet-tick p50 < 30ms, and a seeded shard crash rebalancing with zero wrong-direction scale events + <=5-tick reconvergence; plus the 480/2000-model single-vs-sharded sweep; merges detail.shard_plane into BENCH_LOCAL.json. SHARD_SMOKE=1 runs the short two-shard CI shape.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --shard-only $(if $(SHARD_SMOKE),--smoke)
 
 .PHONY: verify-deploy-pipeline
 verify-deploy-pipeline: ## Static-check the deploy pipeline (scripts parse, manifests render, Dockerfile paths exist).

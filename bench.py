@@ -640,7 +640,8 @@ def multi_model_bench() -> dict:
 
 def _build_tick_world(n_models: int, variants_per_model: int,
                       informer: bool = True, incremental: bool = True,
-                      zero_copy: bool = True, fp_delta: bool = True):
+                      zero_copy: bool = True, fp_delta: bool = True,
+                      sharding: int = 0):
     """The shared 48-model/96-VA in-memory fleet world for the tick
     benches (`make bench-tick` / `make bench-tick-quiet`): FakeCluster +
     TSDB + fully wired manager on the SLO analyzer path, with a ``feed``
@@ -689,6 +690,14 @@ def _build_tick_world(n_models: int, variants_per_model: int,
     # WVA_FP_DELTA lever (versioned fingerprint plane): off restores the
     # recomputed per-tick fingerprint — the honest pre-change lever.
     cfg.infrastructure.fp_delta = fp_delta
+    # WVA_SHARDING lever (sharded active-active engine): >0 splits the
+    # engine into that many consistent-hash shard workers with the fleet
+    # merge on top (docs/design/sharding.md); build_manager wires the
+    # whole plane from config, exactly like a real deployment.
+    if sharding:
+        from wva_tpu.config.config import ShardingConfig
+
+        cfg.set_sharding(ShardingConfig(enabled=True, shards=sharding))
     sat = SaturationScalingConfig(analyzer_name="slo")
     sat.apply_defaults()
     cfg.update_saturation_config({"default": sat})
@@ -1055,19 +1064,21 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
     }
 
 
-def fingerprint_scale_sweep(models=(48, 144, 480),
+def fingerprint_scale_sweep(models=(48, 144, 480, 2000),
                             variants_per_model: int = 2,
                             measured_ticks: int = 13,
                             quiet_warm_ticks: int = 13) -> dict:
     """Fleet-growth sweep for the versioned fingerprint plane (`make
     bench-tick-quiet`, BENCH_LOCAL detail.fingerprint_plane): the SHIPPED
-    quiet-tick configuration at 1x / 3x / 10x fleet size, with per-phase
-    wall time. The claim under test: the per-model fingerprint cost stays
-    flat as the fleet grows (versions + memos replace per-model
+    quiet-tick configuration at 1x / 3x / 10x / ~42x fleet size, with
+    per-phase wall time. The claim under test: the per-model fingerprint
+    cost stays flat as the fleet grows (versions + memos replace per-model
     recomputation); the residual growth is the shared fleet-wide metric
     queries (O(series), charged once per template per tick — a real
     Prometheus pays the same cost server-side) and the per-VA apply
-    phase."""
+    phase (batched since the shard plane PR). The 2000-model point is the
+    single-engine ceiling the sharded plane (`make bench-shard`,
+    detail.shard_plane) divides across workers."""
     import statistics
 
     from wva_tpu.engines import common as engines_common
@@ -1663,8 +1674,9 @@ def tick_quiet_main() -> None:
     """`make bench-tick-quiet`: steady-state quiet-tick microbench
     (incremental vs fp-recompute vs informer-only vs per-tick-LIST
     baseline, merged into BENCH_LOCAL.json detail.incremental_tick) plus
-    the 48/144/480 fleet-growth sweep (detail.fingerprint_plane), one
-    JSON line. `--models N` overrides the mode-comparison fleet size."""
+    the 48/144/480/2000 fleet-growth sweep (detail.fingerprint_plane),
+    one JSON line. `--models N` overrides the mode-comparison fleet
+    size."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     t0 = time.time()
     record = tick_quiet_bench(n_models=_models_arg(48))
@@ -2449,6 +2461,238 @@ def main() -> None:
     print(json.dumps(summary))
 
 
+def shard_plane_bench(n_models: int = 480, shards: int = 4,
+                      variants_per_model: int = 2,
+                      measured_ticks: int = 8,
+                      quiet_warm_ticks: int = 12) -> dict:
+    """Sharded active-active engine bench (``make bench-shard``;
+    docs/design/sharding.md): the 480-model quiet world run unsharded and
+    as ``shards`` consistent-hash shard workers over ONE FakeCluster.
+
+    Asserts the acceptance criteria outright:
+
+    - fleet-wide decisions (all VA statuses) byte-identical between the
+      sharded and unsharded runs at every measured tick boundary;
+    - per-shard quiet-tick analysis p50 under 30 ms at 480 models / 4
+      shards (the distributed wall time a process-per-shard deployment
+      would pay);
+    - one seeded shard crash rebalances with ZERO wrong-direction scale
+      events and reconvergence (holds drained, statuses stable) within 5
+      fleet ticks.
+    """
+    import statistics
+
+    from wva_tpu.emulator.faults import seeded_shard_crashes
+    from wva_tpu.engines import common as engines_common
+
+    def statuses(cluster):
+        return [json.dumps(va.status.to_dict(), sort_keys=True)
+                for va in sorted(cluster.variant_autoscalings(),
+                                 key=lambda v: (v.metadata.namespace,
+                                                v.metadata.name))]
+
+    def drain_globals():
+        engines_common.DecisionCache.clear()
+        while not engines_common.DecisionTrigger.empty():
+            engines_common.DecisionTrigger.get_nowait()
+
+    def run_world(shard_count: int, crash: bool = False) -> dict:
+        mgr, cluster, clock, feed = _build_tick_world(
+            n_models, variants_per_model, sharding=shard_count)
+        eng = mgr.engine
+        try:
+            for _ in range(3 + quiet_warm_ticks):
+                eng.optimize()
+                clock.advance(5.0)
+                feed(clock.now())
+            walls, shard_walls, status_trail = [], [], []
+            for _ in range(measured_ticks):
+                t0 = time.perf_counter()
+                eng.optimize()
+                walls.append(time.perf_counter() - t0)
+                if eng.shard_plane is not None \
+                        and eng.shard_plane.last_worker_seconds:
+                    # The distributed wall time: the SLOWEST shard's
+                    # analysis (workers run concurrently as processes;
+                    # the in-process plane drives them serially and
+                    # times each).
+                    shard_walls.append(
+                        max(eng.shard_plane.last_worker_seconds.values()))
+                status_trail.append(statuses(cluster))
+                clock.advance(5.0)
+                feed(clock.now())
+            out = {
+                "tick_p50_ms": round(
+                    statistics.median(walls) * 1000.0, 2),
+                "status_trail": status_trail,
+            }
+            if shard_walls:
+                out["per_shard_analyze_p50_ms"] = round(
+                    statistics.median(shard_walls) * 1000.0, 2)
+                out["per_shard_analyze_max_ms"] = round(
+                    max(shard_walls) * 1000.0, 2)
+            if not crash:
+                return out
+            # --- seeded shard-crash rebalance (the sharded world only) ---
+            event = seeded_shard_crashes(
+                seed=42, horizon=1200.0, shards=shard_count, n=1)[0]
+            pre = {va.metadata.name:
+                   va.status.desired_optimized_alloc.num_replicas
+                   for va in cluster.variant_autoscalings()}
+            eng.shard_plane.kill_shard(event.shard,
+                                       release_lease=event.clean)
+            wrong = 0
+            reconverged_at = None
+            prev = None
+            for tick in range(1, 9):
+                eng.optimize()
+                cur = {va.metadata.name:
+                       va.status.desired_optimized_alloc.num_replicas
+                       for va in cluster.variant_autoscalings()}
+                wrong += sum(1 for k, v in cur.items() if v < pre[k])
+                if (reconverged_at is None and prev == cur
+                        and not eng.shard_plane.hold_keys()):
+                    reconverged_at = tick
+                prev = cur
+                clock.advance(5.0)
+                feed(clock.now())
+            moved = eng.shard_plane.rebalance_total
+            assert wrong == 0, \
+                f"{wrong} wrong-direction scale events during rebalance"
+            assert reconverged_at is not None and reconverged_at <= 5, \
+                f"rebalance did not reconverge within 5 ticks " \
+                f"(reconverged_at={reconverged_at})"
+            out["crash"] = {
+                "killed_shard": event.shard,
+                "clean_death": event.clean,
+                "models_rebalanced": moved,
+                "wrong_direction_events": wrong,
+                "reconverged_ticks": reconverged_at,
+            }
+            return out
+        finally:
+            mgr.shutdown()
+            drain_globals()
+
+    single = run_world(0)
+    sharded = run_world(shards, crash=True)
+    identical = single["status_trail"] == sharded["status_trail"]
+    assert identical, \
+        "sharded decisions diverged from the unsharded engine"
+    if n_models >= 480 and shards >= 4:
+        assert sharded["per_shard_analyze_p50_ms"] < 30.0, \
+            f"per-shard quiet-tick p50 " \
+            f"{sharded['per_shard_analyze_p50_ms']}ms >= 30ms"
+    single.pop("status_trail")
+    sharded.pop("status_trail")
+    return {
+        "models": n_models,
+        "variant_autoscalings": n_models * variants_per_model,
+        "shards": shards,
+        "measured_ticks": measured_ticks,
+        "single_engine": single,
+        "sharded": sharded,
+        "decisions_byte_identical": identical,
+        "shard_speedup_distributed": round(
+            single["tick_p50_ms"]
+            / max(sharded["per_shard_analyze_p50_ms"], 1e-9), 2),
+    }
+
+
+def shard_scale_sweep(models=(480, 2000), shards: int = 4,
+                      variants_per_model: int = 2,
+                      measured_ticks: int = 5,
+                      quiet_warm_ticks: int = 8) -> dict:
+    """Single-engine vs ``shards``-shard quiet-tick times side by side at
+    fleet scale — the 2000-model point ROADMAP item 1 asked for. The
+    sharded column reports BOTH the in-process fleet tick (all shards
+    driven serially + merge + apply: the single-binary cost) and the
+    slowest shard's analysis time (the distributed wall a
+    process-per-shard deployment pays)."""
+    import statistics
+
+    from wva_tpu.engines import common as engines_common
+
+    def measure(n: int, shard_count: int) -> dict:
+        mgr, cluster, clock, feed = _build_tick_world(
+            n, variants_per_model, sharding=shard_count)
+        eng = mgr.engine
+        try:
+            for _ in range(3 + quiet_warm_ticks):
+                eng.optimize()
+                clock.advance(5.0)
+                feed(clock.now())
+            walls, shard_walls = [], []
+            phase_sums: dict[str, float] = {}
+            for _ in range(measured_ticks):
+                t0 = time.perf_counter()
+                eng.optimize()
+                walls.append(time.perf_counter() - t0)
+                for phase, sec in eng.last_tick_phase_seconds.items():
+                    phase_sums[phase] = phase_sums.get(phase, 0.0) + sec
+                if eng.shard_plane is not None \
+                        and eng.shard_plane.last_worker_seconds:
+                    shard_walls.append(
+                        max(eng.shard_plane.last_worker_seconds.values()))
+                clock.advance(5.0)
+                feed(clock.now())
+            out = {
+                "tick_p50_ms": round(
+                    statistics.median(walls) * 1000.0, 2),
+                "phase_ms_mean": {
+                    k: round(v * 1000.0 / measured_ticks, 2)
+                    for k, v in sorted(phase_sums.items())},
+            }
+            if shard_walls:
+                out["per_shard_analyze_p50_ms"] = round(
+                    statistics.median(shard_walls) * 1000.0, 2)
+            return out
+        finally:
+            mgr.shutdown()
+            engines_common.DecisionCache.clear()
+            while not engines_common.DecisionTrigger.empty():
+                engines_common.DecisionTrigger.get_nowait()
+
+    out: dict[str, dict] = {}
+    for n in models:
+        out[str(n)] = {
+            "models": n,
+            "single_engine": measure(n, 0),
+            f"sharded_{shards}": measure(n, shards),
+        }
+    return {"sweep": out, "shards": shards}
+
+
+def shard_main() -> None:
+    """`make bench-shard` / `bench.py --shard-only`: sharded-vs-unsharded
+    byte-identity + per-shard latency + seeded rebalance assertions,
+    plus the 480/2000-model single-vs-sharded sweep, merged into
+    BENCH_LOCAL.json detail.shard_plane. `--smoke` (SHARD_SMOKE=1) runs
+    the short two-shard CI shape (24 models, no 2000-point sweep)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    smoke = "--smoke" in sys.argv
+    t0 = time.time()
+    if smoke:
+        record = shard_plane_bench(n_models=24, shards=2,
+                                   measured_ticks=5, quiet_warm_ticks=8)
+        sweep = None
+    else:
+        record = shard_plane_bench()
+        sweep = shard_scale_sweep()
+    record["bench_wall_seconds"] = round(time.time() - t0, 1)
+    if sweep is not None:
+        record["scale_sweep"] = sweep
+        _merge_bench_local("shard_plane", record)
+    print(json.dumps({
+        "metric": "per_shard_quiet_tick_latency"
+                  f"_{record['models']}_models_{record['shards']}_shards",
+        "value": record["sharded"].get("per_shard_analyze_p50_ms"),
+        "unit": "ms_p50_per_shard_tick",
+        "vs_baseline": record["shard_speedup_distributed"],
+        "detail": record,
+    }))
+
+
 def profile_main() -> None:
     """`make bench-profile`: cProfile one quiet-tick bench run and dump the
     top-N hot call sites by cumulative time (the tool that found the
@@ -2502,5 +2746,7 @@ if __name__ == "__main__":
         chaos_main()
     elif "--failover-only" in sys.argv:
         failover_main()
+    elif "--shard-only" in sys.argv:
+        shard_main()
     else:
         main()

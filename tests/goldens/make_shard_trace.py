@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Regenerate the committed golden sharded-engine trace
+(``tests/goldens/shard_trace_v1.jsonl``).
+
+Run from the repo root (CPU platform, like the test suite):
+
+    JAX_PLATFORMS=cpu python tests/goldens/make_shard_trace.py
+
+The scenario exercises the sharded active-active engine end to end: six
+models under a 3-shard consistent-hash plane ride a diurnal-shaped burst,
+and the seeded schedule (``seeded_shard_crashes``) kills shard 1 cleanly
+at t≈442 — mid ramp-DOWN, just as a partial-scrape window opens — so its
+model rebalances to a surviving shard whose analyzer and health state
+start empty while measured demand looks halved. Exactly the window the
+rebalance ramp exists for: the move records ``STAGE_SHARD`` (moves +
+holds opened), the held
+model's would-be scale-down records as a ``STAGE_HEALTH`` clamp with state
+"rebalance", and every clamp replays byte-for-byte through the shared
+health.apply path — replay needs no shard-specific logic.
+
+The committed trace anchors ``make replay-golden``: recorded shard/health
+stages must re-apply to ZERO decision diffs (tests/test_shard.py).
+Regenerate only on a deliberate, reviewed change to rebalance/health-gate
+semantics or the trace schema — and say so in the commit message.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TRACE = os.path.join(HERE, "shard_trace_v1.jsonl")
+SEED = 20260804
+SHARDS = 3
+HORIZON = 900.0
+
+
+def main() -> None:
+    from wva_tpu.config.loader import load as load_config
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        FaultPlan,
+        FaultWindow,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        trapezoid,
+    )
+    from wva_tpu.emulator.faults import (
+        KIND_METRICS_PARTIAL,
+        seeded_shard_crashes,
+    )
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    if os.path.exists(TRACE):
+        os.remove(TRACE)  # the recorder appends; regeneration replaces
+
+    cfg = load_config(env={
+        "PROMETHEUS_BASE_URL": "http://prometheus.test:9090",
+        "WVA_TRACE_ENABLED": "true",
+        "WVA_TRACE_PATH": TRACE,
+        "WVA_SHARDING": "true",
+        "WVA_SHARD_COUNT": str(SHARDS),
+    })
+
+    # The seeded crash (shard 1, clean, t=442.1) lands mid ramp-down, just
+    # after a PARTIAL (whole-pod) scrape outage opens (435..560, half the
+    # pods). The new owner's health book for the moved model is EMPTY, and
+    # the monitor's first-tick coverage grace reads the shortfall as FRESH
+    # — but the fleet's proof-of-freshness check sees scraped < ready, so
+    # the rebalance hold stays while the halved-demand analysis wants a
+    # scale-down: exactly the clamp recorded as STAGE_HEALTH state
+    # "rebalance". One tick later the ladder's own DEGRADED classification
+    # takes over for the rest of the window (the designed handoff).
+    event = seeded_shard_crashes(seed=SEED, horizon=HORIZON, shards=SHARDS,
+                                 n=1)[0]
+    load = trapezoid(base_rate=2.0, peak_rate=20.0, ramp_up=180.0,
+                     hold=160.0, ramp_down=100.0, tail=1e9, delay=60.0)
+    plan = FaultPlan([
+        FaultWindow(kind=KIND_METRICS_PARTIAL, start=435.0, end=560.0,
+                    drop_fraction=0.5),
+    ], seed=SEED)
+
+    specs = [VariantSpec(
+        name=f"s{i}-v5e", model_id=f"golden/shard-model-{i}",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=2, serving=ServingParams(engine="jetstream"),
+        load=load,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=30.0,
+                      sync_period_seconds=5.0))
+        for i in range(6)]
+    harness = EmulationHarness(
+        specs,
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=cfg,
+        nodepools=[("v5e-pool", "v5e", "2x4", 24)],
+        startup_seconds=30.0, engine_interval=15.0,
+        stochastic_seed=SEED, fault_plan=plan)
+    harness.run(event.at)
+    harness.crash_shard(event.shard, clean=event.clean)
+    harness.run(HORIZON - event.at)
+    harness.manager.shutdown()
+
+    # Sanity before committing: the trace must carry a shard stage with
+    # real moves, rebalance-ramp clamps, and replay to zero diffs.
+    import json
+
+    from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+    records = load_trace(TRACE)
+    shard_events = [ev for rec in records for ev in rec.get("stages", [])
+                    if ev.get("stage") == "shard"]
+    health_events = [ev for rec in records for ev in rec.get("stages", [])
+                     if ev.get("stage") == "health"]
+    rebalance_clamps = [c for ev in health_events
+                        for c in (ev.get("clamps") or [])
+                        if c.get("state") == "rebalance"]
+    assert shard_events, "no shard stage recorded"
+    assert any(ev.get("moves") for ev in shard_events), \
+        "shard crash moved nothing — nothing worth goldening"
+    assert rebalance_clamps, \
+        "rebalance ramp clamped nothing — nothing worth goldening"
+    report = ReplayEngine(records).replay()
+    assert report.ok, json.dumps(report.to_dict(), indent=1)
+    print(f"wrote {TRACE}: {len(records)} cycles, {len(shard_events)} shard "
+          f"events, {len(rebalance_clamps)} rebalance clamps, replay OK")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()

@@ -372,3 +372,60 @@ class TestNamespaceScopedInstall:
             d for d in docs if d["kind"] == "ClusterRole"
             and d["metadata"]["name"] == "wva-tpu-manager-role"]
         assert len(manager_cluster_roles) == 1
+
+
+class TestShardingValues:
+    """The sharded active-active engine's chart surface
+    (wva.sharding.{enabled,shards,workers}; docs/design/sharding.md):
+    env wiring into the deployment and the leader-election Role
+    enumerating exactly the Lease names the code acquires — a name drift
+    between wva_tpu/constants/leases.py and the chart fails here instead
+    of failing at runtime with a Forbidden."""
+
+    @staticmethod
+    def _lease_role(docs, release="wva-tpu"):
+        return next(d for d in docs if d["kind"] == "Role"
+                    and d["metadata"]["name"]
+                    == f"{release}-leader-election-role")
+
+    def test_default_install_is_unsharded_with_leader_lease_only(self):
+        from wva_tpu.constants import DEFAULT_LEADER_ELECTION_LEASE
+
+        docs = Renderer(CHART, release_name="wva-tpu").render_docs()
+        deploy = next(d for d in docs if d["kind"] == "Deployment")
+        env = {e["name"]: e.get("value") for e in
+               deploy["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env.get("WVA_SHARDING") == "false"
+        assert env.get("LEADER_ELECTION_ID") == DEFAULT_LEADER_ELECTION_LEASE
+        named = [rule for rule in self._lease_role(docs)["rules"]
+                 if rule.get("resourceNames")]
+        assert len(named) == 1
+        assert named[0]["resourceNames"] == [DEFAULT_LEADER_ELECTION_LEASE]
+        # create cannot be scoped by resourceName; it must ride a
+        # separate, unnamed rule.
+        create = [rule for rule in self._lease_role(docs)["rules"]
+                  if "create" in rule.get("verbs", [])
+                  and "leases" in rule.get("resources", [])]
+        assert create and not any(r.get("resourceNames") for r in create)
+
+    def test_sharded_install_enumerates_the_shard_lease_family(self):
+        from wva_tpu.constants import (
+            DEFAULT_LEADER_ELECTION_LEASE,
+            shard_lease_names,
+        )
+
+        docs = Renderer(CHART, release_name="wva-tpu", set_values={
+            "wva.sharding.enabled": "true",
+            "wva.sharding.shards": "3",
+            "wva.sharding.workers": "2",
+        }).render_docs()
+        deploy = next(d for d in docs if d["kind"] == "Deployment")
+        env = {e["name"]: e.get("value") for e in
+               deploy["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env.get("WVA_SHARDING") == "true"
+        assert env.get("WVA_SHARD_COUNT") == "3"
+        assert env.get("WVA_SHARD_WORKERS") == "2"
+        named = next(rule for rule in self._lease_role(docs)["rules"]
+                     if rule.get("resourceNames"))
+        assert named["resourceNames"] == \
+            [DEFAULT_LEADER_ELECTION_LEASE] + shard_lease_names(3)

@@ -60,6 +60,12 @@ class Actuator:
             desired=desired,
         )
 
+    def emit_metrics_batch(self, entries) -> None:
+        """Batched gauge emission for the apply phase: ``entries`` of
+        ``(variant_name, namespace, accelerator, current, desired)``,
+        one registry lock pass for the whole fleet."""
+        self.registry.emit_replica_metrics_batch(entries)
+
 
 class DirectActuator:
     """Scale-subresource actuator (reference direct_actuator.go:37-121).

@@ -70,6 +70,15 @@ STAGE_FINGERPRINT_SKIP = "fingerprint_skip"
 # fault-free boot records nothing — traces stay byte-identical with the
 # plane off.
 STAGE_BOOT = "boot"
+# Sharded active-active engine (wva_tpu.shard): recorded ONLY on cycles
+# where shard topology changed — a shard joined/left/crashed and the
+# consistent-hash ring moved model ownership (moves + the rebalance holds
+# opened). Steady-state sharded cycles record nothing, so sharded traces
+# stay byte-identical to the unsharded engine's (and to each other at any
+# shard count). Pure observability: the rebalance ramp's do-no-harm clamps
+# ride STAGE_HEALTH (state "rebalance") and replay through the shared
+# health.apply path, so replay needs no shard-specific logic.
+STAGE_SHARD = "shard"
 # Input-health plane (wva_tpu.health): per-model trust states this cycle
 # plus the do-no-harm clamps the gate applied to final decisions. Recorded
 # AFTER the limiter; replay re-applies the RECORDED clamps through the same

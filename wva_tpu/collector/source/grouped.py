@@ -601,6 +601,23 @@ class GroupedMetricsView(MetricsSource):
             self._source.store_demuxed_result(name, dict(params), result)
         return result
 
+    def warm_fleet_queries(self, params: dict[str, str]) -> None:
+        """Execute every groupable template's fleet-wide query into this
+        tick view's memo (idempotent — later callers hit the OnceMap).
+        The sharded fleet tick warms its SHARED view here before driving
+        the shard workers, so the backend's share of the tick (the
+        O(series) fleet-wide evaluation a real Prometheus computes
+        server-side) is paid once at the fleet level instead of inside
+        whichever worker happens to touch a template first. Serving and
+        digest stamping are exactly what the first organic toucher would
+        have done — decisions and fingerprints are byte-identical."""
+        for name in self._source.query_list().names():
+            try:
+                self._serve_grouped(name, params)
+            except Exception:  # noqa: BLE001 — warm failures re-surface
+                # (or fall back per-model) on the organic serve path.
+                log.debug("fleet warm failed for %s", name, exc_info=True)
+
     def slice_fingerprint(self, queries, params: dict[str, str]) -> tuple:
         """Digest of this tick's demuxed slices for ``params`` across
         ``queries`` — the metrics component of the engine's dirty-set

@@ -17,6 +17,7 @@ from typing import TYPE_CHECKING
 if TYPE_CHECKING:  # pragma: no cover — avoids a config -> analyzers cycle
     from wva_tpu.config.slo import SLOConfigData
 
+from wva_tpu.constants.leases import DEFAULT_LEADER_ELECTION_LEASE
 from wva_tpu.config.types import CacheConfig, ScaleToZeroConfigData
 from wva_tpu.interfaces.saturation_config import SaturationScalingConfig
 from wva_tpu.utils import freeze as frz
@@ -33,7 +34,7 @@ class InfrastructureConfig:
     metrics_addr: str = "0"
     probe_addr: str = ":8081"
     enable_leader_election: bool = False
-    leader_election_id: str = "72dd1cf1.wva.tpu.llmd.ai"
+    leader_election_id: str = DEFAULT_LEADER_ELECTION_LEASE
     lease_duration: float = 60.0
     renew_deadline: float = 50.0
     retry_period: float = 10.0
@@ -230,6 +231,33 @@ class ResilienceConfig(frz.Freezable):
 
 
 @dataclass
+class ShardingConfig(frz.Freezable):
+    """Sharded active-active engine (``wva_tpu.shard``;
+    docs/design/sharding.md): consistent-hash model ownership across N
+    shard workers under per-shard Leases, fleet-level solve over per-shard
+    summaries. Default OFF (topology changes are opt-in); on, decisions /
+    statuses / traces are byte-identical to the unsharded engine at any
+    shard count — the fleet merge is a sorted-order reassembly."""
+
+    enabled: bool = False
+    # Consistent-hash shards (one Lease each: wva-tpu-shard-<i>).
+    shards: int = 4
+    # Worker PROCESSES the deployment runs (the chart's replica shape for
+    # process-per-shard deployments; the in-process plane ignores it — one
+    # process holds every shard lease).
+    workers: int = 1
+    # Fleet ticks a rebalanced model stays under the rebalance ramp
+    # (scale-up allowed, nothing below max(last-known-good, current))
+    # unless its inputs prove fresh earlier — the per-model boot-ramp
+    # discipline applied to ownership moves.
+    rebalance_hold_ticks: int = 5
+    # A shard summary older than this covers nothing (its models get no
+    # decision; apply holds their previous desired). Generous vs the
+    # engine interval so one slow worker tick never blanks its partition.
+    summary_stale_seconds: float = 90.0
+
+
+@dataclass
 class CapacityConfig(frz.Freezable):
     """Elastic capacity plane (``wva_tpu.capacity``): slice provisioning,
     preemption resilience, reservation/spot-aware inventory
@@ -285,6 +313,7 @@ class Config:
         self._capacity = CapacityConfig()
         self._health = HealthConfig()
         self._resilience = ResilienceConfig()
+        self._sharding = ShardingConfig()
         # Bumped on every decision-affecting hot-reload (see mutation_epoch).
         self._epoch = 0
         # Hot-accessor memo: section name -> FROZEN deep copy, built once
@@ -510,6 +539,20 @@ class Config:
     def set_resilience(self, r: ResilienceConfig) -> None:
         with self._mu:
             self._resilience = copy.deepcopy(r)
+            self._bump_epoch_locked()
+
+    # --- sharded active-active engine (wva_tpu.shard) ---
+
+    def sharding_config(self) -> ShardingConfig:
+        return self._memoized("sharding", lambda: self._sharding)
+
+    def sharding_enabled(self) -> bool:
+        with self._mu:
+            return self._sharding.enabled
+
+    def set_sharding(self, s: "ShardingConfig") -> None:
+        with self._mu:
+            self._sharding = copy.deepcopy(s)
             self._bump_epoch_locked()
 
     # --- saturation config (namespace-aware; reference config.go:318-354) ---

@@ -24,10 +24,12 @@ from wva_tpu.config.config import (
     InfrastructureConfig,
     PrometheusConfig,
     ResilienceConfig,
+    ShardingConfig,
     TLSConfig,
     TraceConfig,
 )
 from wva_tpu.config.types import CacheConfig, FreshnessThresholds
+from wva_tpu.constants.leases import DEFAULT_LEADER_ELECTION_LEASE
 from wva_tpu.config.validation import validate
 from wva_tpu.utils.durations import parse_duration, parse_duration_or_default
 
@@ -37,7 +39,7 @@ DEFAULTS: dict[str, Any] = {
     "METRICS_BIND_ADDRESS": "0",
     "HEALTH_PROBE_BIND_ADDRESS": ":8081",
     "LEADER_ELECT": False,
-    "LEADER_ELECTION_ID": "72dd1cf1.wva.tpu.llmd.ai",
+    "LEADER_ELECTION_ID": DEFAULT_LEADER_ELECTION_LEASE,
     "LEADER_ELECTION_LEASE_DURATION": "60s",
     "LEADER_ELECTION_RENEW_DEADLINE": "50s",
     "LEADER_ELECTION_RETRY_PERIOD": "10s",
@@ -102,6 +104,23 @@ DEFAULTS: dict[str, Any] = {
     # forbidden). Size to cover WVA_HEALTH_DEGRADED_AFTER at the engine
     # interval.
     "WVA_STARTUP_HOLD_TICKS": 10,
+    # Sharded active-active engine (wva_tpu.shard; docs/design/sharding.md).
+    # Default OFF (a topology change is opt-in); on, the engine splits into
+    # N consistent-hash shard workers (one Lease each) publishing per-shard
+    # summaries to the fleet solve — byte-identical decisions at any shard
+    # count, WVA_SHARDING=off byte-identical to the unsharded engine.
+    "WVA_SHARDING": False,
+    # Consistent-hash shards (and Leases wva-tpu-shard-0..N-1).
+    "WVA_SHARD_COUNT": 4,
+    # Worker processes for process-per-shard deployments (the in-process
+    # plane holds every shard lease in one process regardless).
+    "WVA_SHARD_WORKERS": 1,
+    # Fleet ticks a rebalanced model stays under the rebalance ramp unless
+    # its inputs prove fresh earlier.
+    "WVA_SHARD_REBALANCE_HOLD": 5,
+    # Summaries older than this cover nothing (their models hold previous
+    # desired).
+    "WVA_SHARD_SUMMARY_STALE": "90s",
     # Elastic capacity plane (wva_tpu.capacity; docs/design/capacity.md).
     # Default on; "off"/"false"/"0" disables (decisions then byte-identical
     # to pre-capacity builds).
@@ -297,6 +316,14 @@ def load(flags: Mapping[str, Any] | None = None,
         checkpoint_enabled=r.get_bool("WVA_CHECKPOINT"),
         checkpoint_interval_ticks=max(1, r.get_int("WVA_CHECKPOINT_INTERVAL")),
         startup_hold_ticks=max(0, r.get_int("WVA_STARTUP_HOLD_TICKS")),
+    ))
+
+    cfg.set_sharding(ShardingConfig(
+        enabled=r.get_bool("WVA_SHARDING"),
+        shards=max(1, r.get_int("WVA_SHARD_COUNT")),
+        workers=max(1, r.get_int("WVA_SHARD_WORKERS")),
+        rebalance_hold_ticks=max(0, r.get_int("WVA_SHARD_REBALANCE_HOLD")),
+        summary_stale_seconds=r.get_duration("WVA_SHARD_SUMMARY_STALE"),
     ))
 
     from wva_tpu.capacity.tiers import (

@@ -10,10 +10,22 @@ from wva_tpu.constants.labels import (
     GKE_TPU_TOPOLOGY_NODE_LABEL,
     TPU_RESOURCE_NAME,
 )
+from wva_tpu.constants.leases import (
+    DEFAULT_LEADER_ELECTION_LEASE,
+    FLEET_SHARD_ID,
+    SHARD_LEASE_PREFIX,
+    shard_lease_name,
+    shard_lease_names,
+)
 from wva_tpu.constants.metrics import *  # noqa: F401,F403
 from wva_tpu.constants.metrics import __all__ as _metrics_all
 
 __all__ = [
+    "DEFAULT_LEADER_ELECTION_LEASE",
+    "FLEET_SHARD_ID",
+    "SHARD_LEASE_PREFIX",
+    "shard_lease_name",
+    "shard_lease_names",
     "CONTROLLER_INSTANCE_LABEL_KEY",
     "NAMESPACE_CONFIG_ENABLED_LABEL_KEY",
     "NAMESPACE_EXCLUDE_ANNOTATION_KEY",

@@ -1,0 +1,43 @@
+"""Coordination lease names (coordination.k8s.io Leases).
+
+Every Lease the controller acquires is named here — the chart's RBAC
+(`charts/wva-tpu/templates/rbac/leader-election-role.yaml`) enumerates the
+same names per release, so a name drift between code and chart fails the
+chart goldens instead of failing at runtime with a Forbidden.
+
+The sharded active-active engine (``wva_tpu/shard``;
+docs/design/sharding.md) generalizes the single leader-election Lease into
+a lease-per-shard family: shards ``0..N-1`` each have their own Lease
+(``wva-tpu-shard-<i>``), and the distinguished **fleet** shard — the one
+that runs the fleet-level solve and the apply phase — rides the existing
+leader-election Lease, so unsharded deployments keep exactly one Lease and
+sharded ones add N.
+"""
+
+from __future__ import annotations
+
+# The controller-manager leader-election Lease (reference cmd/main.go
+# LeaderElectionID). In sharded mode this IS the `fleet` shard's lease:
+# holding it entitles a process to consume shard summaries, run the
+# fleet-level solve, and apply decisions.
+DEFAULT_LEADER_ELECTION_LEASE = "72dd1cf1.wva.tpu.llmd.ai"
+
+# Shard lease family: one Lease per consistent-hash shard. A worker may
+# hold several (the in-process plane holds all of them); each is acquired,
+# renewed, and fenced with the same discipline as the leader lease
+# (lease_transitions epoch = the shard's fencing token).
+SHARD_LEASE_PREFIX = "wva-tpu-shard"
+
+# The distinguished fleet shard's id in metrics/labels ("shard" label).
+FLEET_SHARD_ID = "fleet"
+
+
+def shard_lease_name(shard: int) -> str:
+    """Lease name for consistent-hash shard ``shard`` (0-based)."""
+    return f"{SHARD_LEASE_PREFIX}-{int(shard)}"
+
+
+def shard_lease_names(shards: int) -> list[str]:
+    """Every shard Lease a ``shards``-way deployment acquires (the fleet
+    shard's lease — the leader-election Lease — is configured separately)."""
+    return [shard_lease_name(i) for i in range(int(shards))]

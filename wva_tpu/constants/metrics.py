@@ -160,6 +160,19 @@ WVA_TICK_PHASE_SECONDS = "wva_tick_phase_seconds"
 # tick. ~0 on steady-state ticks: reads are zero-copy frozen views, and a
 # copy happens only at a write site (copy-on-write builder).
 WVA_TICK_OBJECT_COPIES = "wva_tick_object_copies"
+# --- Sharded active-active engine (wva_tpu/shard; docs/design/sharding.md) ---
+# 1 when this process's shard-lease manager holds the shard's lease
+# (shard="0".."N-1" | "fleet"); one-hot per shard.
+WVA_SHARD_OWNER = "wva_shard_owner"
+# Models the consistent-hash ring assigns to each shard this tick.
+WVA_SHARD_MODELS_OWNED = "wva_shard_models_owned"
+# Ownership moves (model reassigned to a different shard) since process
+# start: shard join/leave/crash rebalances.
+WVA_SHARD_REBALANCE_TOTAL = "wva_shard_rebalance_total"
+# Age of the newest summary the fleet solve consumed from each shard. In
+# the in-process plane this is ~0; process-per-shard deployments alert on
+# it (a wedged shard worker stops publishing).
+WVA_SHARD_SUMMARY_AGE_SECONDS = "wva_shard_summary_age_seconds"
 
 # --- Common metric label names ---
 LABEL_KIND = "kind"
@@ -180,5 +193,6 @@ LABEL_STATE = "state"
 LABEL_TIER = "tier"
 LABEL_PHASE = "phase"
 LABEL_SOURCE = "source"
+LABEL_SHARD = "shard"
 
 __all__ = [n for n in dir() if n.isupper()]

@@ -406,6 +406,42 @@ def seeded_leader_flaps(seed: int, horizon: float, n: int = 3,
 
 
 @dataclass
+class ShardCrashEvent:
+    """One seeded shard-worker crash (wva_tpu/shard rebalance chaos)."""
+
+    at: float               # world-relative seconds
+    shard: int              # which shard worker dies
+    clean: bool             # lease released (fast move) vs ridden out
+    revive_at: float | None = None  # None = stays dead (permanent leave)
+
+
+def seeded_shard_crashes(seed: int, horizon: float, shards: int,
+                         n: int = 2, min_gap: float = 120.0,
+                         settle: float = 180.0,
+                         revive_after: float | None = None,
+                         ) -> list[ShardCrashEvent]:
+    """Seeded shard-crash/rebalance schedule: ``n`` crashes spread over
+    ``[settle, horizon - settle]``, each killing a deterministically
+    chosen shard (never shard 0 when >1 shard exists, so at least one
+    stable shard anchors the ring across the storm). ``revive_after``
+    re-joins the shard that long after its crash — a join is a rebalance
+    too, and the determinism tests replay both directions."""
+    events = []
+    for i, at in enumerate(
+            _seeded_instants(seed, "shard", horizon, n, min_gap, settle)):
+        lo = 1 if shards > 1 else 0
+        shard = lo + zlib.crc32(repr((seed, "shard-pick", i)).encode()) \
+            % max(shards - lo, 1)
+        events.append(ShardCrashEvent(
+            at=at, shard=shard,
+            clean=zlib.crc32(repr((seed, "shard-clean", i)).encode())
+            % 2 == 0,
+            revive_at=(at + revive_after
+                       if revive_after is not None else None)))
+    return events
+
+
+@dataclass
 class FaultAction:
     """What the HTTP layer should do to one request."""
 

@@ -353,6 +353,24 @@ class EmulationHarness:
                 return
         self.hpa.registry = self.manager.registry
 
+    # --- sharded-engine chaos (wva_tpu/shard) ---
+
+    @property
+    def shard_plane(self):
+        """The manager's shard plane (None when WVA_SHARDING is off)."""
+        return self.manager.engine.shard_plane
+
+    def crash_shard(self, shard: int, clean: bool = True) -> None:
+        """Kill one shard worker mid-run. ``clean`` releases its Lease
+        (ownership moves within ~a retry period); a crash rides out the
+        lease duration first — both rebalance under the rebalance ramp."""
+        self.shard_plane.kill_shard(shard, release_lease=clean)
+
+    def revive_shard(self, shard: int) -> None:
+        """Re-join a killed shard (a join rebalances too: it steals ~1/N
+        of every surviving shard's models back)."""
+        self.shard_plane.revive_shard(shard)
+
     # --- the world loop ---
 
     def _sync_sims(self) -> None:

@@ -77,6 +77,7 @@ from wva_tpu.blackbox.schema import (
     STAGE_FINGERPRINT_SKIP,
     STAGE_FORECAST,
     STAGE_HEALTH,
+    STAGE_SHARD,
 )
 from wva_tpu.resilience import LeadershipLostError, SimulatedCrash
 from wva_tpu.health import BLACKOUT, FRESH, HEALTH_STATES, InputHealth
@@ -369,6 +370,24 @@ class SaturationEngine:
         self.fence = None
         self.boot_report = None
         self._boot_recorded = False
+        # Sharded active-active engine (wva_tpu/shard;
+        # docs/design/sharding.md). Exactly one of these is ever set:
+        # - shard_plane (fleet role): the engine stops analyzing models
+        #   itself — shard workers analyze their consistent-hash partitions
+        #   and this engine merges their summaries in sorted model order,
+        #   runs the fleet-level solve for global-routed models, then the
+        #   limiter / health gate / apply exactly as before. None +
+        #   shard_ctx None = the unsharded engine, byte-identical to
+        #   pre-shard builds (WVA_SHARDING=off).
+        # - shard_ctx (shard-worker role): analysis stops BEFORE the
+        #   limiter and publishes a ShardCapture (pre-limiter decisions,
+        #   fleet-solve arrays, health signals, buffered trace records)
+        #   instead of applying anything.
+        self.shard_plane = None
+        self.shard_ctx = None
+        # Fleet-installed shared tick collector for shard workers (see
+        # _tick_collector); always None outside a plane-driven worker tick.
+        self.tick_collector_override = None
         # Chaos-harness hook (emulator restart storms): when armed, the
         # fence check raises SimulatedCrash — the tick dies with decisions
         # computed but never applied, exactly a process kill mid-tick.
@@ -525,7 +544,14 @@ class SaturationEngine:
         fresh GroupedMetricsView, so every per-model query this tick is
         served by demuxing ONE fleet-wide query per template
         (docs/design/metrics-plane.md) — or the collector unchanged when
-        the lever is off / the source has no grouped substrate."""
+        the lever is off / the source has no grouped substrate.
+
+        Shard-worker role: the fleet installs its own tick view here
+        (``tick_collector_override``) so every worker in a fleet tick
+        shares ONE set of fleet-wide executions and version resolutions —
+        exactly the unsharded engine's cost, instead of once per worker."""
+        if self.tick_collector_override is not None:
+            return self.tick_collector_override
         source = self.collector.source
         if (self.grouped_collection
                 and getattr(source, "supports_grouped_collection", False)):
@@ -663,6 +689,12 @@ class SaturationEngine:
             return
 
         model_groups = variant_utils.group_variant_autoscalings_by_model(active_vas)
+        # Shard-worker role: analyze only the owned consistent-hash
+        # partition, stop before the limiter, and publish a summary —
+        # nothing below (health gate, apply, capacity) runs on a worker.
+        if self.shard_ctx is not None:
+            self._shard_analyze(model_groups, snap, collector, prep_start)
+            return
         va_map = {namespaced_key(va.metadata.namespace, va.metadata.name): va
                   for va in active_vas}
 
@@ -685,16 +717,23 @@ class SaturationEngine:
 
         # Dirty-set gate: models whose input fingerprint is unchanged skip
         # prepare->analyze and re-emit the prior cycle's decisions below.
+        # In sharded fleet mode the WORKERS own fingerprints and memos —
+        # the fleet engine only advances its tick sequence (checkpoint
+        # cadence) and merges what the shards shipped.
         fp_start = time.perf_counter()
         self._phase_seconds["prepare"] = (
             self._phase_seconds.get("prepare", 0.0)
             + fp_start - prep_start)
-        clean, fingerprints = self._partition_clean(
-            model_groups, snap, collector, analyzer_name)
-        self._prune_incremental_state(set(model_groups))
-        self.last_tick_stats = {
-            "analyzed": len(model_groups) - len(clean),
-            "skipped": len(clean)}
+        if self.shard_plane is None:
+            clean, fingerprints = self._partition_clean(
+                model_groups, snap, collector, analyzer_name)
+            self._prune_incremental_state(set(model_groups))
+            self.last_tick_stats = {
+                "analyzed": len(model_groups) - len(clean),
+                "skipped": len(clean)}
+        else:
+            self._tick_seq += 1
+            clean, fingerprints = set(), {}
         analyze_start = time.perf_counter()
         self._phase_seconds["fingerprint"] = analyze_start - fp_start
 
@@ -702,7 +741,10 @@ class SaturationEngine:
         # reuses the V2 optimizer/enforcer flow with the queueing-model
         # analyzer producing req/s capacities instead of token capacities.
         self._tick_coverage = {}
-        if analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
+        if self.shard_plane is not None:
+            decisions = self._optimize_sharded(model_groups, snap,
+                                               collector, analyzer_name)
+        elif analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
             decisions = self._optimize_v2(
                 model_groups, snap, use_slo=analyzer_name == SLO_ANALYZER_NAME,
                 collector=collector, clean=clean, fingerprints=fingerprints)
@@ -942,8 +984,31 @@ class SaturationEngine:
                     ramp_holds.add(key)
             self.boot_ramp.note_tick()
         self._tick_ramp_holds = frozenset(ramp_holds)
+        # Rebalance ramp (wva_tpu/shard): a model whose consistent-hash
+        # owner just changed is held exactly like a boot-ramp model — its
+        # new shard's analyzer state (trends, tuner filters, hysteresis
+        # books) starts empty, so the first analyses after a move must not
+        # be trusted with scale-downs until the inputs PROVE fresh (same
+        # proof as the boot ramp: FRESH classification + a real backend
+        # age + full measured coverage) or the hold expires.
+        rebalance_holds: set[str] = set()
+        if self.shard_plane is not None:
+            for key in sorted(self.shard_plane.hold_keys()):
+                h = self._tick_health.get(key)
+                scraped, expected = self._tick_coverage.get(
+                    key, (None, None))
+                covered = (scraped is None or not expected
+                           or scraped >= expected)
+                if (h is not None and h.state == FRESH
+                        and h.allow_scale_down
+                        and key in self._tick_age_observed and covered):
+                    self.shard_plane.release_hold(key)
+                else:
+                    rebalance_holds.add(key)
         stats = {"degraded": 0, "blackout": 0, "recovering": 0,
                  "clamped": 0, "boot_held": len(ramp_holds)}
+        if self.shard_plane is not None:
+            stats["rebalance_held"] = len(rebalance_holds)
         for h in self._tick_health.values():
             if h.state == BLACKOUT:
                 stats["blackout"] += 1
@@ -963,17 +1028,25 @@ class SaturationEngine:
             state, verb = h.state, (
                 "frozen" if h.state == BLACKOUT else "held")
             reason = h.reason
-            if key in ramp_holds:
+            if key in ramp_holds or key in rebalance_holds:
                 # Ramp floor on top of the ladder's own gate: scale-ups
                 # pass, nothing drops below max(last-known-good, current)
-                # until this model's inputs prove fresh.
+                # until this model's inputs prove fresh. Shared by the
+                # boot ramp (process restart) and the rebalance ramp
+                # (shard ownership move) — same do-no-harm semantics,
+                # distinct trace states.
                 floor = max(held if held is not None else 0,
                             d.current_replicas)
                 if floor > target:
                     target = floor
                 if target != d.target_replicas and h.state == FRESH:
-                    state, verb = "boot", "held"
-                    reason = "inputs not yet proven fresh since restart"
+                    if key in ramp_holds:
+                        state, verb = "boot", "held"
+                        reason = "inputs not yet proven fresh since restart"
+                    else:
+                        state, verb = "rebalance", "held"
+                        reason = ("inputs not yet proven fresh since "
+                                  "shard rebalance")
             if target != d.target_replicas:
                 clamps.append({
                     "variant_name": d.variant_name,
@@ -1646,36 +1719,7 @@ class SaturationEngine:
         routes: dict[tuple[str, str], str] = {}
         slo_cfg_by_ns: dict[str, object] = {}
         if use_slo:
-            # Sync profiles once per distinct namespace per tick (not per
-            # model), BEFORE the worker fan-out: the per-model resolved
-            # config is passed explicitly into analysis below, and workers
-            # must never race a profile-store sync. The fetch+sync is
-            # gated on the config mutation epoch: an unchanged epoch means
-            # the resolved config is value-identical to last tick's, so
-            # re-deep-copying a fleet-sized profile list (and re-adopting
-            # equal profiles into the store) every tick is pure waste. The
-            # memoized cfg object is the one the analyzer already adopted;
-            # decision paths read service classes/targets from it (never
-            # mutated), and the tuner's refinements land on the SAME
-            # adopted profile objects the per-tick re-sync used to keep
-            # anyway — an epoch bump re-fetches a fresh copy either way.
-            epoch = self.config.mutation_epoch()
-            for group_key in sorted(model_groups):
-                ns = model_groups[group_key][0].metadata.namespace
-                if ns not in slo_cfg_by_ns:
-                    hit = self._slo_sync_memo.get(ns)
-                    if hit is not None and hit[0] == epoch:
-                        slo_cfg_by_ns[ns] = hit[1]
-                        continue
-                    cfg = self.config.slo_config_for_namespace(ns)
-                    self.slo_analyzer.sync_from_config(cfg, namespace=ns)
-                    self._slo_sync_memo[ns] = (epoch, cfg)
-                    slo_cfg_by_ns[ns] = cfg
-            # Namespaces whose models all disappeared must not pin a
-            # fleet-sized resolved config forever.
-            for ns in [n for n in self._slo_sync_memo
-                       if n not in slo_cfg_by_ns]:
-                del self._slo_sync_memo[ns]
+            slo_cfg_by_ns = self._sync_slo_config(model_groups)
 
         # Stage 1 — per-model prepare + analyze across the worker pool.
         # V2 runs its full (thread-safe, per-model-keyed) analysis in the
@@ -1868,15 +1912,31 @@ class SaturationEngine:
                     global_reqs.append(req)
                 else:
                     local_reqs.append(req)
+            if self.shard_ctx is not None and global_reqs:
+                # Shard-worker role: fleet-solved models ship as compact
+                # demand/latency/capacity arrays in the summary — the
+                # solve couples every shard's models, so only the fleet
+                # lease-holder may run it (docs/design/sharding.md).
+                self._capture_global_requests(global_reqs)
+                global_reqs = []
             if global_reqs:
                 decisions.extend(
                     self._optimize_global(global_reqs, slo_cfg_by_ns))
             if local_reqs:
+                self._trace_section("optimizer")
                 decisions.extend(self.optimizer.optimize(local_reqs, None))
 
             # Enforcer bridge per model (reference engine_v2.go:76-127) —
             # shared with the trace replay harness (pipeline.bridge_enforce).
+            # A shard worker enforces only its locally-optimized models:
+            # fleet-solved decisions do not exist yet — the fleet runs the
+            # same bridge over them after the solve.
+            self._trace_section("enforce")
             for req in requests:
+                if (self.shard_ctx is not None
+                        and routes[(req.model_id, req.namespace)]
+                        == "global"):
+                    continue
                 s2z_cfg = self.config.scale_to_zero_config_for_namespace(
                     req.namespace)
                 scaled_to_zero = bridge_enforce(
@@ -1886,6 +1946,7 @@ class SaturationEngine:
                 if scaled_to_zero:
                     log.info("Scale-to-zero enforcement applied (V2) for %s",
                              req.model_id)
+            self._trace_section("models")
 
         self._apply_forecast(
             requests, decisions, routes,
@@ -1907,6 +1968,331 @@ class SaturationEngine:
         decisions.extend(cached_decisions)
         self._apply_limiter(decisions)
         return decisions
+
+    # --- sharded active-active engine (wva_tpu/shard;
+    # --- docs/design/sharding.md) ---
+
+    def _trace_section(self, name: str) -> None:
+        """Mark which ordered section of the unsharded in-cycle record
+        stream the engine is currently emitting from. Only the shard
+        worker's TraceBuffer implements it — the real FlightRecorder (and
+        None) ignore sections, so the unsharded paths are untouched."""
+        begin = getattr(self.flight, "begin_section", None)
+        if begin is not None:
+            begin(name)
+
+    def _capture_global_requests(self, reqs: list[ModelScalingRequest]) -> None:
+        """Shard-worker role: encode fleet-solved models' analysis outputs
+        as compact arrays (the same blackbox codec replay trusts for
+        bit-for-bit reproduction) into the tick's capture."""
+        from wva_tpu.blackbox.schema import encode as bb_encode
+        from wva_tpu.shard.summary import ENTRY_GLOBAL, ModelEntry
+
+        cap = self.shard_ctx.capture
+        for req in reqs:
+            key = f"{req.model_id}|{req.namespace}"
+            cap.entries[key] = ModelEntry(
+                group_key=key, model_id=req.model_id,
+                namespace=req.namespace, kind=ENTRY_GLOBAL,
+                global_request={
+                    "result": bb_encode(req.result),
+                    "variant_states": [bb_encode(vs)
+                                       for vs in req.variant_states]})
+
+    @staticmethod
+    def _decode_global_request(entry) -> ModelScalingRequest:
+        from wva_tpu.blackbox.schema import decode as bb_decode
+        from wva_tpu.interfaces import AnalyzerResult, VariantReplicaState
+
+        gr = entry.global_request or {}
+        return ModelScalingRequest(
+            model_id=entry.model_id, namespace=entry.namespace,
+            result=bb_decode(AnalyzerResult, gr.get("result")),
+            variant_states=[bb_decode(VariantReplicaState, v)
+                            for v in gr.get("variant_states", [])])
+
+    def _replay_trace_records(self, records) -> None:
+        """Append buffered shard-worker records to the live cycle in the
+        given order. Payloads were encoded at capture time by the same
+        codec the recorder uses, so re-recording them is byte-identical."""
+        if self.flight is None:
+            return
+        for _section, _gk, _seq, kind, payload in records:
+            if kind == "model":
+                self.flight.record_model(payload)
+            else:
+                stage = payload.get("stage", "")
+                self.flight.record_stage(
+                    stage, {k: v for k, v in payload.items()
+                            if k != "stage"})
+
+    def forget_forecast_gauges(self, keys: set[tuple[str, str]]) -> None:
+        """Rebalance bookkeeping: a model moved to another shard — drop it
+        from THIS worker engine's forecast-gauge tracking set WITHOUT
+        removing the registry series (the new owner keeps emitting them;
+        a registry.remove here would blank live gauges for a tick)."""
+        self._forecast_gauge_keys -= set(keys)
+        self._trend_gauge_keys -= set(keys)
+
+    def _shard_analyze(self, model_groups: dict, snap: KubeClient,
+                       collector: ReplicaMetricsCollector,
+                       prep_start: float) -> None:
+        """Shard-worker analysis tick: the unsharded prepare → fingerprint
+        → analyze pipeline over the owned consistent-hash partition only,
+        ending in a ShardCapture instead of the limiter/apply phases. Every
+        per-model quantity (analyzer state, fingerprints, decision memos,
+        forecast learning, health classification) evolves exactly as the
+        unsharded engine's would for these models — which is what makes
+        the fleet's sorted-order merge byte-identical."""
+        from wva_tpu.shard.summary import (
+            ENTRY_CACHED,
+            ENTRY_LOCAL,
+            HealthSignals,
+            ModelEntry,
+        )
+
+        ctx = self.shard_ctx
+        owned = {k: v for k, v in model_groups.items()
+                 if ctx.owns(v[0].spec.model_id)}
+        active_keys = {
+            f"{vas[0].metadata.namespace}|{vas[0].spec.model_id}"
+            for vas in owned.values()}
+        self.v2_analyzer.prune(active_keys)
+        self.slo_analyzer.prune(active_keys)
+
+        analyzer_name = ""
+        global_cfg = self.config.saturation_config().get("default")
+        if global_cfg is not None:
+            global_cfg.apply_defaults()
+            analyzer_name = global_cfg.analyzer_name
+
+        fp_start = time.perf_counter()
+        self._phase_seconds["prepare"] = (
+            self._phase_seconds.get("prepare", 0.0) + fp_start - prep_start)
+        clean, fingerprints = self._partition_clean(
+            owned, snap, collector, analyzer_name)
+        self._prune_incremental_state(set(owned))
+        self.last_tick_stats = {
+            "analyzed": len(owned) - len(clean),
+            "skipped": len(clean)}
+        analyze_start = time.perf_counter()
+        self._phase_seconds["fingerprint"] = analyze_start - fp_start
+
+        self._tick_coverage = {}
+        if analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
+            decisions = self._optimize_v2(
+                owned, snap, use_slo=analyzer_name == SLO_ANALYZER_NAME,
+                collector=collector, clean=clean, fingerprints=fingerprints)
+        else:
+            decisions = self._optimize_v1(owned, snap, collector=collector,
+                                          clean=clean,
+                                          fingerprints=fingerprints)
+        self._phase_seconds["analyze"] = \
+            time.perf_counter() - analyze_start
+
+        cap = ctx.capture
+        by_key: dict[str, list[VariantDecision]] = {}
+        for d in decisions:
+            by_key.setdefault(f"{d.model_id}|{d.namespace}", []).append(d)
+        for key in sorted(owned):
+            if key in cap.entries:  # fleet-solved: captured at the split
+                continue
+            vas = owned[key]
+            cap.entries[key] = ModelEntry(
+                group_key=key, model_id=vas[0].spec.model_id,
+                namespace=vas[0].metadata.namespace,
+                kind=ENTRY_CACHED if key in clean else ENTRY_LOCAL,
+                decisions=by_key.get(key, []))
+        # Health: the worker's own monitor classified its models inside
+        # the analyzer path (_assess_health); ship classification + the
+        # proof-of-freshness signals the fleet's gate and ramps consume.
+        # The fleet monitor keeps the last-known-good desireds, so holds
+        # survive rebalances; only classification state is shard-local.
+        for key in sorted(self._tick_health):
+            h = self._tick_health[key]
+            scraped, ready = self._tick_coverage.get(key, (None, None))
+            cap.health[key] = HealthSignals(
+                state=h.state, age_seconds=h.age_seconds,
+                allow_scale_down=h.allow_scale_down, reason=h.reason,
+                age_observed=key in self._tick_age_observed,
+                scraped=scraped, ready=ready)
+        if self.health is not None:
+            self.health.prune(
+                set(self._tick_health),
+                {(va.metadata.namespace, va.metadata.name)
+                 for vas in owned.values() for va in vas})
+        cap.analyzed = self.last_tick_stats["analyzed"]
+        cap.skipped = self.last_tick_stats["skipped"]
+        cap.tick_seq = self._tick_seq
+        cap.control_age = self._control_plane_staleness()
+        cap.published_at = self.clock.now()
+
+    def _optimize_sharded(self, model_groups: dict, snap: KubeClient,
+                          collector: ReplicaMetricsCollector,
+                          analyzer_name: str) -> list[VariantDecision]:
+        """Fleet role: merge this tick's shard captures in sorted model
+        order, run the fleet-level solve over the shards' compact
+        summaries, re-run the enforcer bridge for fleet-solved models, and
+        hand the merged pre-limiter decision set to the shared limiter →
+        health gate → apply pipeline. Models no live shard covered this
+        tick produce no decision — the apply phase then holds their
+        previous desired, the do-no-harm direction."""
+        from wva_tpu.shard.summary import (
+            ENTRY_CACHED,
+            ENTRY_GLOBAL,
+            ENTRY_LOCAL,
+            SECTION_ENFORCE,
+            SECTION_MODELS,
+            SECTION_OPTIMIZER,
+            TraceBuffer,
+        )
+        from wva_tpu.health import InputHealth
+
+        use_slo = analyzer_name == SLO_ANALYZER_NAME
+        tick = self.shard_plane.gather(model_groups, collector=collector)
+
+        def section(records, name):
+            return sorted((r for r in records if r[0] == name),
+                          key=lambda r: (r[1], r[2]))
+
+        # 1. The per-model record stream, exactly as the unsharded stage-2
+        # merge loop would have emitted it: sorted by group key (records
+        # within one group keep their shard-side emission order).
+        self._replay_trace_records(section(tick.trace, SECTION_MODELS))
+
+        # 2. Fleet-level solve over the shards' summaries, then the
+        # enforcer bridge for the solved models (records buffered so the
+        # merged enforcer stream below stays in sorted request order).
+        decisions: list[VariantDecision] = []
+        keys = sorted(tick.entries)
+        global_entries = [tick.entries[k] for k in keys
+                          if tick.entries[k].kind == ENTRY_GLOBAL]
+        fleet_enforce: list = []
+        if global_entries:
+            slo_cfg_by_ns = (self._sync_slo_config(model_groups)
+                             if use_slo else {})
+            reqs = [self._decode_global_request(e) for e in global_entries]
+            decisions.extend(self._optimize_global(reqs, slo_cfg_by_ns))
+            buf = TraceBuffer()
+            buf.begin_section(SECTION_ENFORCE)
+            saved = self.enforcer.flight_recorder
+            self.enforcer.flight_recorder = buf
+            try:
+                for req in reqs:
+                    s2z_cfg = \
+                        self.config.scale_to_zero_config_for_namespace(
+                            req.namespace)
+                    scaled = bridge_enforce(
+                        decisions, req.model_id, req.namespace,
+                        self.enforcer, s2z_cfg, now=self.clock.now(),
+                        optimizer_name=self.optimizer.name())
+                    if scaled:
+                        log.info("Scale-to-zero enforcement applied "
+                                 "(fleet solve) for %s", req.model_id)
+            finally:
+                self.enforcer.flight_recorder = saved
+            fleet_enforce = buf.records
+
+        # 3. + 4. Optimizer stages (shard-local cost-aware passes), then
+        # the enforcer stream — shard-local and fleet-solved records merged
+        # into one sorted-request-order sequence.
+        self._replay_trace_records(section(tick.trace, SECTION_OPTIMIZER))
+        self._replay_trace_records(
+            section(list(tick.trace) + fleet_enforce, SECTION_ENFORCE))
+
+        # 5. ONE merged forecast stage (plans in the planner's own
+        # (namespace, model) order across every shard).
+        if self.flight is not None and tick.plans:
+            def plan_key(p):
+                return (p.get("namespace", ""), p.get("model_id", ""))
+            self.flight.record_stage(STAGE_FORECAST, {
+                "plans": sorted(tick.plans, key=plan_key),
+                "floors": sorted(tick.floors, key=plan_key),
+                "raised": tick.raised})
+
+        # 6. Merge decisions. The unsharded orders differ per path: V1
+        # interleaves fresh and re-emitted decisions per sorted group; the
+        # V2/SLO path appends fleet-solved, then fresh local, then cached.
+        if analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
+            for k in keys:
+                if tick.entries[k].kind == ENTRY_LOCAL:
+                    decisions.extend(tick.entries[k].decisions)
+            for k in keys:
+                if tick.entries[k].kind == ENTRY_CACHED:
+                    decisions.extend(tick.entries[k].decisions)
+        else:
+            for k in keys:
+                decisions.extend(tick.entries[k].decisions)
+
+        # 7. Topology-change observability: recorded ONLY when ownership
+        # moved (steady-state sharded traces stay byte-identical to the
+        # unsharded engine's).
+        if self.flight is not None and (tick.moves or tick.stale):
+            self.flight.record_stage(STAGE_SHARD, {
+                "moves": list(tick.moves),
+                "holds_opened": sorted(tick.holds_opened),
+                "alive_shards": sorted(tick.alive),
+                "stale_shards": sorted(tick.stale),
+                "uncovered_models": sorted(tick.uncovered),
+            })
+
+        self.last_tick_stats = {"analyzed": tick.analyzed,
+                                "skipped": tick.skipped}
+
+        # 8. Per-model trust state from the owners' shipped signals: the
+        # fleet gate, boot ramp, and rebalance ramp all consume these.
+        self._tick_health = {}
+        self._tick_age_observed = set()
+        self._tick_coverage = {}
+        if self.health is not None:
+            for key in sorted(tick.health):
+                hs = tick.health[key]
+                self._tick_health[key] = InputHealth(
+                    state=hs.state, age_seconds=hs.age_seconds,
+                    allow_scale_down=hs.allow_scale_down,
+                    reason=hs.reason)
+                if hs.age_observed:
+                    self._tick_age_observed.add(key)
+                if hs.scraped is not None or hs.ready is not None:
+                    self._tick_coverage[key] = (hs.scraped, hs.ready)
+
+        self._apply_limiter(decisions)
+        return decisions
+
+    def _sync_slo_config(self, model_groups: dict) -> dict[str, object]:
+        """Sync SLO profiles once per distinct namespace per tick (not per
+        model), BEFORE the worker fan-out: the per-model resolved config is
+        passed explicitly into analysis, and workers must never race a
+        profile-store sync. The fetch+sync is gated on the config mutation
+        epoch: an unchanged epoch means the resolved config is
+        value-identical to last tick's, so re-deep-copying a fleet-sized
+        profile list (and re-adopting equal profiles into the store) every
+        tick is pure waste. The memoized cfg object is the one the analyzer
+        already adopted; decision paths read service classes/targets from
+        it (never mutated), and the tuner's refinements land on the SAME
+        adopted profile objects the per-tick re-sync used to keep anyway —
+        an epoch bump re-fetches a fresh copy either way. Shared by the
+        per-model analysis path and the sharded fleet solve (which needs
+        the resolved classes + profiles for ``_optimize_global``)."""
+        slo_cfg_by_ns: dict[str, object] = {}
+        epoch = self.config.mutation_epoch()
+        for group_key in sorted(model_groups):
+            ns = model_groups[group_key][0].metadata.namespace
+            if ns not in slo_cfg_by_ns:
+                hit = self._slo_sync_memo.get(ns)
+                if hit is not None and hit[0] == epoch:
+                    slo_cfg_by_ns[ns] = hit[1]
+                    continue
+                cfg = self.config.slo_config_for_namespace(ns)
+                self.slo_analyzer.sync_from_config(cfg, namespace=ns)
+                self._slo_sync_memo[ns] = (epoch, cfg)
+                slo_cfg_by_ns[ns] = cfg
+        # Namespaces whose models all disappeared must not pin a
+        # fleet-sized resolved config forever.
+        for ns in [n for n in self._slo_sync_memo
+                   if n not in slo_cfg_by_ns]:
+            del self._slo_sync_memo[ns]
+        return slo_cfg_by_ns
 
     def _apply_forecast(self, requests: list[ModelScalingRequest],
                         decisions: list[VariantDecision],
@@ -1956,7 +2342,18 @@ class SaturationEngine:
         raised = apply_forecast_floors(decisions, floors, now)
         if raised:
             log.info("Forecast floors raised %d decision(s)", raised)
-        if self.flight is not None and plans:
+        if self.shard_ctx is not None:
+            # Shard-worker role: the fleet records ONE merged forecast
+            # stage across every shard's plans (sorted by namespace/model,
+            # the planner's own order) — per-shard stage records would
+            # break trace byte-identity with the unsharded engine.
+            from wva_tpu.blackbox.schema import encode as bb_encode
+
+            cap = self.shard_ctx.capture
+            cap.plans = [bb_encode(p) for p in plans]
+            cap.floors = list(floors)
+            cap.floors_raised = raised
+        elif self.flight is not None and plans:
             self.flight.record_stage(STAGE_FORECAST, {
                 "plans": plans, "floors": floors, "raised": raised})
         registry = getattr(self.actuator, "registry", None)
@@ -2069,6 +2466,11 @@ class SaturationEngine:
         engine.go:120-127/363-395; on TPU, clamping desired to whole-slice
         inventory matters everywhere — unplaceable replicas otherwise sit
         pending forever and keep the anticipated-supply math inflated)."""
+        if self.shard_ctx is not None:
+            # Shard-worker role: slice inventory is a FLEET resource — only
+            # the fleet lease-holder clamps the merged decision set against
+            # it (and feeds the capacity plane's demand snapshot).
+            return
         if self.capacity is not None:
             # PRE-limiter demand snapshot: the limiter clamps targets to
             # inventory, so only the un-clamped targets can express the
@@ -2605,12 +3007,28 @@ class SaturationEngine:
         metric emission happen every tick even without decisions. Reads go
         through the tick snapshot (``client``); status WRITES go to the live
         client with conflict-refetch, since the snapshot's resourceVersions
-        may be stale by write time."""
+        may be stale by write time.
+
+        Batched per tick (PERF.md ~36 µs/VA apply residual): a pure
+        MATERIALIZE pass computes every VA's outcome (target, conditions,
+        would-be status material, observed replicas) from the frozen
+        snapshot reads; the fleet's gauges then land in ONE registry lock
+        pass; and only then does the per-VA write pass run — trace events,
+        status PUTs (changed VAs only), audit events, and cache/trigger
+        publication, in the same sorted order as before. Per-VA values,
+        statuses, and trace records are byte-identical to the per-VA loop;
+        only the locking/emission shape changes."""
         client = client or self.client
         decision_map = {namespaced_key(d.namespace, d.variant_name): d
                         for d in decisions}
         now = self.clock.now()
+        # Per-namespace fast-actuation probe memo: the per-VA
+        # saturation-config resolution is a fleet-sized deepcopy, paid
+        # once per namespace per tick instead of once per VA.
+        fast_by_ns: dict[str, bool] = {}
 
+        # --- pass 1: materialize (pure; no writes, no registry) ---
+        staged: list[dict] = []
         for va_key in sorted(va_map):
             va = va_map[va_key]
             decision = decision_map.get(va_key)
@@ -2621,6 +3039,19 @@ class SaturationEngine:
             except NotFoundError:
                 log.debug("VA %s disappeared; skipping", va_key)
                 continue
+
+            # ONE observed-target read serves both the no-decision
+            # fallback and the gauge emission (the per-VA loop read the
+            # same frozen snapshot object twice).
+            tgt_state = None
+            tgt_err: Exception | None = None
+            try:
+                tgt_state = scale_target.scale_target_state(client.get(
+                    update_va.spec.scale_target_ref.kind or Deployment.KIND,
+                    update_va.metadata.namespace,
+                    update_va.spec.scale_target_ref.name))
+            except Exception as e:  # noqa: BLE001 — degraded per VA below
+                tgt_err = e
 
             if decision is not None:
                 target_replicas = decision.target_replicas
@@ -2633,17 +3064,110 @@ class SaturationEngine:
                 # (reference engine.go:866-877).
                 target_replicas = update_va.status.desired_optimized_alloc.num_replicas
                 if target_replicas <= 0:
-                    try:
-                        tgt = scale_target.scale_target_state(client.get(
-                            update_va.spec.scale_target_ref.kind,
-                            update_va.metadata.namespace,
-                            update_va.spec.scale_target_ref.name))
-                        target_replicas = tgt.status_replicas or \
-                            tgt.desired_replicas
-                    except (NotFoundError, TypeError):
-                        target_replicas = 0
+                    target_replicas = (
+                        (tgt_state.status_replicas
+                         or tgt_state.desired_replicas)
+                        if tgt_state is not None else 0)
                 accelerator = update_va.status.desired_optimized_alloc.accelerator
                 reason = "No scaling decision (optimization loop)"
+
+            prev_material = _status_material(update_va)
+            prev_run_time = update_va.status.desired_optimized_alloc.last_run_time
+
+            if not accelerator:
+                accelerator = variant_utils.get_accelerator_type(update_va)
+            if not accelerator:
+                # Can't produce a sensible status; still publish (in the
+                # write pass, keeping trigger order) metrics-missing state
+                # so the reconciler sets MetricsAvailable=False.
+                staged.append({"kind": "noaccel", "va": va})
+                continue
+
+            old_alloc = update_va.status.desired_optimized_alloc
+            # last_run_time == 0 means the status was never written: the
+            # first population of a fresh VA is not a transition (a VA
+            # created over an already-running deployment would otherwise
+            # report a fictitious "0 -> N" scale-up).
+            # Operators can see the horizon the planner ACTUALLY uses
+            # (measured actuation->ready quantile); only measured estimates
+            # are surfaced — the default constant would be noise dressed as
+            # a measurement. Assigned unconditionally (0 clears the field):
+            # with forecasting off or the measurement evicted, the status
+            # must stop claiming a horizon nobody is using. Rounded, and it
+            # only moves when a scale-up completes, so no write churn.
+            lead_value = 0.0
+            if self.forecast is not None:
+                lead, measured = self.forecast.lead_time_for(
+                    update_va.metadata.namespace, update_va.spec.model_id)
+                if measured:
+                    lead_value = round(lead, 1)
+
+            # The gauges work from the frozen snapshot read plus the
+            # computed decision values — the status mutation below is
+            # skipped entirely on no-change ticks, so they must not
+            # depend on it. A failed target read degrades this VA to
+            # applied=False (previous per-VA emit semantics).
+            applied = tgt_err is None
+            if tgt_err is not None:
+                log.error("Failed to emit metrics for %s: %s", va_key,
+                          tgt_err)
+            staged.append({
+                "kind": "full", "va": va, "va_key": va_key,
+                "update_va": update_va, "decision": decision,
+                "target_replicas": target_replicas,
+                "accelerator": accelerator, "reason": reason,
+                "applied": applied,
+                "current": tgt_state.status_replicas
+                if tgt_state is not None else 0,
+                "lead_value": lead_value,
+                "prev_material": prev_material,
+                "prev_run_time": prev_run_time,
+                "old_alloc": old_alloc,
+            })
+
+        # --- pass 2: one batched gauge emission for the whole fleet ---
+        try:
+            # Emission never fails the loop (the per-VA loop's rule): a
+            # registry/mirror failure here costs this tick's gauges, not
+            # the status writes, cache publications, and triggers below.
+            self.actuator.emit_metrics_batch(
+                (s["va"].metadata.name, s["va"].metadata.namespace,
+                 s["accelerator"], s["current"], s["target_replicas"])
+                for s in staged if s["kind"] == "full" and s["applied"])
+        except Exception as e:  # noqa: BLE001 — see above
+            log.error("Batched replica-gauge emission failed: %s", e)
+
+        # --- pass 3: writes, events, trace, cache/trigger (sorted order
+        # --- preserved — identical per-VA record and trigger sequence) ---
+        for s in staged:
+            va = s["va"]
+            if s["kind"] == "noaccel":
+                common.DecisionCache.set(va.metadata.name, va.metadata.namespace,
+                                         VariantDecision(
+                                             variant_name=va.metadata.name,
+                                             namespace=va.metadata.namespace,
+                                             metrics_available=False,
+                                             metrics_reason=METRICS_REASON_UNAVAILABLE,
+                                             metrics_message=METRICS_MESSAGE_UNAVAILABLE),
+                                         source=common.SOURCE_SATURATION,
+                                         cycle=self.flight.current_cycle()
+                                         if self.flight else 0)
+                common.fire_trigger(va.metadata.name, va.metadata.namespace)
+                continue
+
+            va_key = s["va_key"]
+            update_va = s["update_va"]
+            decision = s["decision"]
+            target_replicas = s["target_replicas"]
+            accelerator = s["accelerator"]
+            reason = s["reason"]
+            applied = s["applied"]
+            lead_value = s["lead_value"]
+            prev_material = s["prev_material"]
+            prev_run_time = s["prev_run_time"]
+            old_alloc = s["old_alloc"]
+            old_desired = old_alloc.num_replicas
+            had_recorded_alloc = old_alloc.last_run_time > 0
 
             if (self.recorder is not None and decision is not None
                     and decision.was_limited
@@ -2663,62 +3187,7 @@ class SaturationEngine:
                     "slices (verify the node-pool topology derives this "
                     "variant and capacity exists)")
 
-            prev_material = _status_material(update_va)
-            prev_run_time = update_va.status.desired_optimized_alloc.last_run_time
-
-            if not accelerator:
-                accelerator = variant_utils.get_accelerator_type(update_va)
-            if not accelerator:
-                # Can't produce a sensible status; still publish metrics-missing
-                # state so the reconciler sets MetricsAvailable=False.
-                common.DecisionCache.set(va.metadata.name, va.metadata.namespace,
-                                         VariantDecision(
-                                             variant_name=va.metadata.name,
-                                             namespace=va.metadata.namespace,
-                                             metrics_available=False,
-                                             metrics_reason=METRICS_REASON_UNAVAILABLE,
-                                             metrics_message=METRICS_MESSAGE_UNAVAILABLE),
-                                         source=common.SOURCE_SATURATION,
-                                         cycle=self.flight.current_cycle()
-                                         if self.flight else 0)
-                common.fire_trigger(va.metadata.name, va.metadata.namespace)
-                continue
-
-            old_alloc = update_va.status.desired_optimized_alloc
-            old_desired = old_alloc.num_replicas
-            # last_run_time == 0 means the status was never written: the
-            # first population of a fresh VA is not a transition (a VA
-            # created over an already-running deployment would otherwise
-            # report a fictitious "0 -> N" scale-up).
-            had_recorded_alloc = old_alloc.last_run_time > 0
-            # Operators can see the horizon the planner ACTUALLY uses
-            # (measured actuation->ready quantile); only measured estimates
-            # are surfaced — the default constant would be noise dressed as
-            # a measurement. Assigned unconditionally (0 clears the field):
-            # with forecasting off or the measurement evicted, the status
-            # must stop claiming a horizon nobody is using. Rounded, and it
-            # only moves when a scale-up completes, so no write churn.
-            lead_value = 0.0
-            if self.forecast is not None:
-                lead, measured = self.forecast.lead_time_for(
-                    update_va.metadata.namespace, update_va.spec.model_id)
-                if measured:
-                    lead_value = round(lead, 1)
-
-            applied = False
-            try:
-                # Emission works from the frozen snapshot read plus the
-                # computed decision values — the status mutation below is
-                # skipped entirely on no-change ticks, so the gauges must
-                # not depend on it.
-                self.actuator.emit_metrics(update_va, client=client,
-                                           desired=target_replicas,
-                                           accelerator=accelerator)
-                applied = True
-            except Exception as e:  # noqa: BLE001 — emission never fails the loop
-                log.error("Failed to emit metrics for %s: %s", va_key, e)
-
-            self._maybe_fast_actuate(update_va, decision)
+            self._maybe_fast_actuate(update_va, decision, fast_by_ns)
 
             if self.flight is not None:
                 self.flight.record_stage("actuation", {
@@ -2876,7 +3345,9 @@ class SaturationEngine:
             common.fire_trigger(va.metadata.name, va.metadata.namespace)
 
     def _maybe_fast_actuate(self, va: VariantAutoscaling,
-                            decision: VariantDecision | None) -> None:
+                            decision: VariantDecision | None,
+                            fast_by_ns: dict[str, bool] | None = None,
+                            ) -> None:
         """When the namespace opts into ``fastActuation``, apply scale-UP
         decisions to the scale subresource immediately. On TPU the
         provisioning horizon dwarfs everything else, so the HPA sync period
@@ -2889,9 +3360,18 @@ class SaturationEngine:
             return
         if decision.target_replicas <= max(decision.current_replicas, 0):
             return
-        cfg = self.config.saturation_config_for_namespace(
-            va.metadata.namespace).get("default")
-        if cfg is None or not cfg.fast_actuation:
+        # The per-namespace config resolution deep-copies a fleet-sized
+        # section; the apply pass memoizes the probe per tick.
+        ns = va.metadata.namespace
+        if fast_by_ns is not None and ns in fast_by_ns:
+            enabled = fast_by_ns[ns]
+        else:
+            cfg = self.config.saturation_config_for_namespace(
+                ns).get("default")
+            enabled = cfg is not None and cfg.fast_actuation
+            if fast_by_ns is not None:
+                fast_by_ns[ns] = enabled
+        if not enabled:
             return
         try:
             changed = self.direct_actuator.scale_target_object(

@@ -69,6 +69,12 @@ class ScaleFromZeroEngine:
         # stop those workers at the write boundary, not let a deposed
         # replica wake a model the new leader is already managing.
         self.write_gate = None
+        # Shard-scoped wake scanning (wva_tpu/shard; process-per-shard
+        # deployments): a predicate over model_id — candidates outside
+        # this worker's consistent-hash partition are another shard's to
+        # wake. None = scan everything (unsharded, and the in-process
+        # plane where the fleet manager owns the whole scan).
+        self.ownership_filter = None
         self.executor = PollingExecutor(self.optimize, poll_interval,
                                         clock=self.clock,
                                         name=common.SOURCE_SCALE_FROM_ZERO)
@@ -96,6 +102,11 @@ class ScaleFromZeroEngine:
         by_model = variant_utils.group_variant_autoscalings_by_model(inactive)
         candidates = [min(vas, key=lambda va: (va.spec.cost(), va.metadata.name))
                       for vas in by_model.values()]
+        if self.ownership_filter is not None:
+            candidates = [va for va in candidates
+                          if self.ownership_filter(va.spec.model_id)]
+            if not candidates:
+                return
         # Tick-scoped scrape fan-in: candidates whose models share an
         # InferencePool hit its EPP pods once per pass, not once each.
         memo = ScrapeMemo()

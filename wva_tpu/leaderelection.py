@@ -18,6 +18,7 @@ import logging
 import threading
 from dataclasses import dataclass
 
+from wva_tpu.constants.leases import DEFAULT_LEADER_ELECTION_LEASE
 from wva_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
 from wva_tpu.k8s.objects import Lease, ObjectMeta, clone
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
@@ -31,7 +32,7 @@ DEFAULT_RETRY_PERIOD = 10.0
 
 @dataclass
 class LeaderElectorConfig:
-    lease_name: str = "72dd1cf1.wva.tpu.llmd.ai"
+    lease_name: str = DEFAULT_LEADER_ELECTION_LEASE
     # "" resolves to the controller's namespace (POD_NAMESPACE-aware) at
     # elector construction, matching every other component's scoping.
     namespace: str = ""

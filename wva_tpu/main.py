@@ -214,6 +214,11 @@ class Manager:
             self.elector.release()
         if self.flight_recorder is not None:
             self.flight_recorder.close()
+        if self.engine.shard_plane is not None:
+            # Voluntary shard-lease step-down + worker pool release: a
+            # clean shutdown hands every shard to a successor in ~one
+            # retry period instead of a lease timeout.
+            self.engine.shard_plane.shutdown()
         self.engine.close()
         prom = self.source_registry.get(PROMETHEUS_SOURCE_NAME)
         if prom is not None and hasattr(prom, "close"):
@@ -462,6 +467,21 @@ def build_manager(
     engine.resync_ticks = config.resync_ticks()
     engine.fp_delta_enabled = config.fp_delta_enabled()
     engine.fp_assert = config.fp_assert_enabled()
+    # Sharded active-active engine (WVA_SHARDING, default off;
+    # docs/design/sharding.md): N shard workers — each the existing
+    # snapshot+analysis stack scoped to a consistent-hash partition under
+    # its own Lease — publish per-shard summaries; THIS engine becomes the
+    # fleet role (merge, fleet-level solve, limiter/health/apply). The
+    # distinguished `fleet` shard rides the leader-election lease below.
+    if config.sharding_enabled():
+        from wva_tpu.shard import build_shard_plane
+
+        engine.shard_plane = build_shard_plane(
+            client=client, config=config, clock=clock, collector=collector,
+            actuator=actuator, prom_source=prom_source,
+            forecast_planner=forecast_planner, analysis_workers=workers,
+            identity=f"{os.uname().nodename}-{os.getpid()}",
+            registry=registry)
     if flight is not None:
         engine.optimizer.flight_recorder = flight
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,

@@ -50,6 +50,10 @@ from wva_tpu.constants import (
     WVA_INPUT_HEALTH,
     WVA_LEADER_EPOCH,
     WVA_REPLICA_SCALING_TOTAL,
+    WVA_SHARD_MODELS_OWNED,
+    WVA_SHARD_OWNER,
+    WVA_SHARD_REBALANCE_TOTAL,
+    WVA_SHARD_SUMMARY_AGE_SECONDS,
     WVA_TICK_MODELS_ANALYZED,
     WVA_TICK_MODELS_SKIPPED,
     WVA_TICK_OBJECT_COPIES,
@@ -179,6 +183,18 @@ class MetricsRegistry:
         self._register(WVA_CHECKPOINT_LAST_SAVE_TIMESTAMP, "gauge",
                        "Timestamp of the newest resilience-checkpoint "
                        "write")
+        self._register(WVA_SHARD_OWNER, "gauge",
+                       "1 while this process's lease manager holds the "
+                       "shard's Lease (shard=\"0\"..\"N-1\" | \"fleet\")")
+        self._register(WVA_SHARD_MODELS_OWNED, "gauge",
+                       "Models the consistent-hash ring assigns to each "
+                       "shard this tick")
+        self._register(WVA_SHARD_REBALANCE_TOTAL, "gauge",
+                       "Model ownership moves (shard join/leave/crash "
+                       "rebalances) since process start")
+        self._register(WVA_SHARD_SUMMARY_AGE_SECONDS, "gauge",
+                       "Age of the newest summary the fleet solve "
+                       "consumed from each shard")
 
     def _register(self, name: str, kind: str, help_text: str) -> None:
         self._series[name] = _Series(name, kind, help_text)
@@ -197,29 +213,34 @@ class MetricsRegistry:
     MIRROR_REFRESH_SECONDS = 60.0
 
     def set_gauge(self, name: str, labels: dict[str, str], value: float) -> None:
-        mirror = None
         with self._mu:
-            series = self._series[name]
-            key = self._key(labels)
-            series.values[key] = value
-            if self.mirror_tsdb is not None:
-                # Throttle bookkeeping under the registry lock (check-
-                # then-act on shared state); the TSDB append itself runs
-                # outside — it has its own locks, and a racing duplicate
-                # append of the same value would be harmless anyway.
-                now = self.mirror_tsdb.clock.now()
-                last = self._mirrored.get((name, key))
-                if (last is None or last[0] != value
-                        or now - last[1] >= self.MIRROR_REFRESH_SECONDS):
-                    if len(self._mirrored) >= 65536:
-                        # Bounded against label churn (deleted variants/
-                        # models): a reset only costs one extra mirror
-                        # append per series.
-                        self._mirrored.clear()
-                    self._mirrored[(name, key)] = (value, now)
-                    mirror = self.mirror_tsdb
+            mirror = self._set_gauge_locked(name, self._key(labels), value)
         if mirror is not None:
-            mirror.add_sample(name, dict(key), value)
+            self.mirror_tsdb.add_sample(name, dict(mirror[0]), mirror[1])
+
+    def _set_gauge_locked(self, name: str, key: _LabelKey,
+                          value: float) -> "tuple[_LabelKey, float] | None":
+        """Gauge update under the registry lock; returns the (key, value)
+        to mirror into the TSDB AFTER the lock is released (None when the
+        same-value throttle absorbs it). Throttle bookkeeping stays under
+        the lock (check-then-act on shared state); the TSDB append itself
+        runs outside — it has its own locks, and a racing duplicate append
+        of the same value would be harmless anyway."""
+        self._series[name].values[key] = value
+        if self.mirror_tsdb is None:
+            return None
+        now = self.mirror_tsdb.clock.now()
+        last = self._mirrored.get((name, key))
+        if (last is None or last[0] != value
+                or now - last[1] >= self.MIRROR_REFRESH_SECONDS):
+            if len(self._mirrored) >= 65536:
+                # Bounded against label churn (deleted variants/
+                # models): a reset only costs one extra mirror
+                # append per series.
+                self._mirrored.clear()
+            self._mirrored[(name, key)] = (value, now)
+            return (key, value)
+        return None
 
     def inc_counter(self, name: str, labels: dict[str, str], delta: float = 1.0) -> None:
         with self._mu:
@@ -245,20 +266,40 @@ class MetricsRegistry:
     def emit_replica_metrics(self, variant_name: str, namespace: str,
                              accelerator: str, current: int, desired: int) -> None:
         """Gauges for the external actuator (reference metrics.go:137-165).
-        Scale-from-zero encoding: current==0 && desired>0 => ratio = desired,
-        since desired/0 is undefined but HPA needs a >1 signal."""
-        labels = {
-            LABEL_VARIANT_NAME: variant_name,
-            LABEL_NAMESPACE: namespace,
-            LABEL_ACCELERATOR_TYPE: accelerator,
-        }
-        self.set_gauge(WVA_DESIRED_REPLICAS, labels, float(desired))
-        self.set_gauge(WVA_CURRENT_REPLICAS, labels, float(current))
-        if current > 0:
-            ratio = desired / current
-        else:
-            ratio = float(desired)
-        self.set_gauge(WVA_DESIRED_RATIO, labels, ratio)
+        One shared encoding with the engine's batched apply path — see
+        :meth:`emit_replica_metrics_batch` for the ratio rule."""
+        self.emit_replica_metrics_batch(
+            [(variant_name, namespace, accelerator, current, desired)])
+
+    def emit_replica_metrics_batch(self, entries) -> None:
+        """Replica gauges for ``entries`` of ``(variant_name, namespace,
+        accelerator, current, desired)`` — the single shared encoding
+        (scale-from-zero: current==0 && desired>0 => ratio = desired,
+        since desired/0 is undefined but HPA needs a >1 signal). The
+        engine's apply phase passes the whole fleet: the per-VA loop paid
+        three lock round-trips per VA (3N acquisitions per tick); the
+        fleet's gauge updates ride ONE lock pass, with the TSDB mirror
+        appends collected and performed outside it (same values, same
+        throttle — only the locking shape changes)."""
+        mirrors: list[tuple[str, _LabelKey, float]] = []
+        with self._mu:
+            for variant_name, namespace, accelerator, current, desired \
+                    in entries:
+                key = self._key({
+                    LABEL_VARIANT_NAME: variant_name,
+                    LABEL_NAMESPACE: namespace,
+                    LABEL_ACCELERATOR_TYPE: accelerator,
+                })
+                ratio = desired / current if current > 0 else float(desired)
+                for name, value in (
+                        (WVA_DESIRED_REPLICAS, float(desired)),
+                        (WVA_CURRENT_REPLICAS, float(current)),
+                        (WVA_DESIRED_RATIO, ratio)):
+                    mirror = self._set_gauge_locked(name, key, value)
+                    if mirror is not None:
+                        mirrors.append((name, mirror[0], mirror[1]))
+        for name, key, value in mirrors:
+            self.mirror_tsdb.add_sample(name, dict(key), value)
 
     def observe_tick(self, engine: str, seconds: float, ok: bool) -> None:
         """Self-observability per engine loop (the reference relies on

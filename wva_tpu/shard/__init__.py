@@ -1,0 +1,45 @@
+"""Sharded active-active engine (docs/design/sharding.md).
+
+Consistent-hash model ownership across N shard workers under per-shard
+Leases, with the global optimizer running as a fleet-level solve over
+compact per-shard summaries — the ROADMAP-1b subsystem that takes the
+control plane past one process. ``WVA_SHARDING`` gates the whole plane
+(default off; on with one shard — or off — the engine is byte-identical
+to the unsharded build, and decisions stay byte-identical at ANY shard
+count: the fleet merge is a sorted-order reassembly of exactly what the
+single engine would have computed).
+
+PEP 562 lazy exports: importing ``wva_tpu.shard`` costs nothing until the
+plane is actually built (the unsharded engine never pays for it).
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "HashRing": "wva_tpu.shard.hashring",
+    "ownership_moves": "wva_tpu.shard.hashring",
+    "ShardLeaseManager": "wva_tpu.shard.lease",
+    "ShardCapture": "wva_tpu.shard.summary",
+    "ModelEntry": "wva_tpu.shard.summary",
+    "HealthSignals": "wva_tpu.shard.summary",
+    "TraceBuffer": "wva_tpu.shard.summary",
+    "InProcessSummaryBus": "wva_tpu.shard.summary",
+    "ConfigMapSummaryBus": "wva_tpu.shard.summary",
+    "capture_to_payload": "wva_tpu.shard.summary",
+    "payload_to_capture": "wva_tpu.shard.summary",
+    "ShardPlane": "wva_tpu.shard.plane",
+    "ShardWorker": "wva_tpu.shard.plane",
+    "PlaneTick": "wva_tpu.shard.plane",
+    "build_shard_plane": "wva_tpu.shard.plane",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

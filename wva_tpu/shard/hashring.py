@@ -1,0 +1,83 @@
+"""Consistent-hash model ownership (docs/design/sharding.md §ownership).
+
+Every model (keyed by ``model_id`` — NOT the per-namespace group key, so a
+model served in several namespaces lands on ONE shard and its cross-
+namespace analyzer state — V2 k2 history, capacity records, tuner filters —
+stays single-writer, the same invariant the analysis pool's affinity chains
+enforce) hashes onto a ring of virtual nodes. Each live shard contributes
+``vnodes`` points; a model is owned by the shard whose point follows its
+hash clockwise.
+
+Properties the plane relies on:
+
+- **Deterministic**: pure function of the id and the live-shard set (CRC32
+  + fmix32 avalanche, no process state) — every worker and the fleet
+  solve compute identical ownership.
+- **Minimal movement**: a shard leaving moves only ITS models (each to the
+  next point's owner); joining steals ~1/N of every other shard's models.
+  A modulo assignment would reshuffle nearly everything on every topology
+  change, turning each rebalance into a fleet-wide warm-start.
+"""
+
+from __future__ import annotations
+
+import bisect
+import zlib
+
+DEFAULT_VNODES = 64
+
+
+def _h32(data: str) -> int:
+    """CRC32 finalized with murmur3's fmix32 avalanche. Raw CRC32 is
+    LINEAR in its input: sequential model ids ("org/model-0","org/model-1",
+    …) produce structured hash deltas that cluster on the ring — measured
+    8/8 of a sequential 8-model fleet landing on one shard of three. The
+    mixer is a bijection (no entropy lost) whose avalanche scatters those
+    structured deltas uniformly."""
+    h = zlib.crc32(data.encode("utf-8")) & 0xFFFFFFFF
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+class HashRing:
+    """Immutable ring over a set of shard ids (ints)."""
+
+    def __init__(self, shards: list[int] | tuple[int, ...] | set[int],
+                 vnodes: int = DEFAULT_VNODES) -> None:
+        self.shards = tuple(sorted(set(int(s) for s in shards)))
+        self.vnodes = max(1, int(vnodes))
+        points: list[tuple[int, int]] = []
+        for shard in self.shards:
+            for v in range(self.vnodes):
+                points.append((_h32(f"shard-{shard}-vnode-{v}"), shard))
+        # Hash collisions between vnodes resolve by shard id (sorted tuple
+        # ordering) — deterministic regardless of insertion order.
+        points.sort()
+        self._hashes = [p[0] for p in points]
+        self._owners = [p[1] for p in points]
+
+    def owner(self, model_id: str) -> int:
+        """The shard owning ``model_id`` (raises on an empty ring — the
+        caller decides what an ownerless fleet means)."""
+        if not self._hashes:
+            raise ValueError("hash ring has no shards")
+        idx = bisect.bisect_right(self._hashes, _h32(model_id))
+        if idx == len(self._hashes):
+            idx = 0
+        return self._owners[idx]
+
+    def assign(self, model_ids) -> dict[str, int]:
+        """Ownership map for a batch of model ids."""
+        return {m: self.owner(m) for m in model_ids}
+
+
+def ownership_moves(old: dict[str, int], new: dict[str, int]) -> list[str]:
+    """Model ids whose owner CHANGED between two assignments (previously
+    unseen models are arrivals, not moves — a fresh model has no prior
+    shard state to warm-start from, so it needs no rebalance hold)."""
+    return sorted(m for m, s in new.items()
+                  if m in old and old[m] != s)

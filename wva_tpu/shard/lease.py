@@ -1,0 +1,119 @@
+"""Shard leases: the leader-election protocol generalized to a
+lease-per-shard family (docs/design/sharding.md §lease-protocol).
+
+Each consistent-hash shard ``0..N-1`` is guarded by its own
+coordination.k8s.io Lease (:func:`wva_tpu.constants.shard_lease_name`),
+acquired and renewed with the exact :class:`~wva_tpu.leaderelection.
+LeaderElector` discipline the controller-manager lease already uses —
+skew-safe expiry, renew-deadline self-demotion, storm-tolerant ticks, and
+the PR-11 fencing token (``lease_transitions`` at acquisition) stamped
+through everything the shard publishes. A worker process may hold several
+shards (the in-process plane holds all of them); the distinguished
+**fleet** shard rides the existing leader-election lease, owned by the
+:class:`~wva_tpu.main.Manager`'s elector.
+
+Liveness is the rebalance signal: a shard whose lease this manager cannot
+observe as held-and-fresh is *dead* for ownership purposes — the ring
+drops it and its models move to the surviving shards under the rebalance
+ramp."""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.constants.leases import shard_lease_name
+from wva_tpu.k8s.client import KubeClient
+from wva_tpu.leaderelection import LeaderElector, LeaderElectorConfig
+from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+
+class ShardLeaseManager:
+    """Acquire/renew the shard-lease family for one worker process."""
+
+    def __init__(self, client: KubeClient, identity: str, shards: int,
+                 namespace: str = "", lease_duration: float | None = None,
+                 renew_deadline: float | None = None,
+                 retry_period: float | None = None,
+                 clock: Clock | None = None) -> None:
+        self.clock = clock or SYSTEM_CLOCK
+        self.shards = max(1, int(shards))
+        self._electors: dict[int, LeaderElector] = {}
+        self._dead: set[int] = set()
+        self._last_tick = -1e18
+        kwargs = {}
+        if lease_duration is not None:
+            kwargs["lease_duration"] = lease_duration
+        if renew_deadline is not None:
+            kwargs["renew_deadline"] = renew_deadline
+        if retry_period is not None:
+            kwargs["retry_period"] = retry_period
+        for shard in range(self.shards):
+            cfg = LeaderElectorConfig(
+                lease_name=shard_lease_name(shard), namespace=namespace,
+                **kwargs)
+            self._electors[shard] = LeaderElector(
+                client, identity=identity, config=cfg, clock=self.clock)
+        self.retry_period = next(iter(self._electors.values())) \
+            .config.retry_period
+
+    def tick(self) -> set[int]:
+        """One acquire-or-renew pass over every shard lease this process
+        competes for (throttled to the retry period like
+        ``Manager.election_tick``); returns the shards held after it."""
+        now = self.clock.now()
+        if now - self._last_tick < self.retry_period \
+                and self._last_tick > -1e17:
+            return self.held()
+        self._last_tick = now
+        for shard, elector in self._electors.items():
+            if shard in self._dead:
+                continue
+            try:
+                elector.tick()
+            except Exception as e:  # noqa: BLE001 — one lease's transport
+                # error must not stall the family; the elector's own
+                # renew-deadline discipline bounds the damage.
+                log.warning("shard %d lease tick failed: %s", shard, e)
+        return self.held()
+
+    def held(self) -> set[int]:
+        """Shards whose leases read as held-and-fresh. Deliberately NOT
+        filtered by the dead set: a severed shard (crash without release)
+        keeps its lease until the elector's renew-deadline self-demotion
+        expires it — ``tick`` skips dead shards' renewals, so expiry is
+        exactly the lease riding out its duration, and the ring keeps the
+        shard (its models uncovered, held at previous desired) until then.
+        A clean ``kill`` released the lease, so it drops out immediately."""
+        return {s for s, e in self._electors.items() if e.is_leader()}
+
+    def fencing_token(self, shard: int) -> int | None:
+        elector = self._electors.get(shard)
+        return None if elector is None else elector.fencing_token()
+
+    def release(self, shard: int) -> None:
+        elector = self._electors.get(shard)
+        if elector is not None:
+            elector.release()
+
+    def release_all(self) -> None:
+        for shard in self._electors:
+            self.release(shard)
+
+    # --- chaos hooks (emulator / bench) ---
+
+    def kill(self, shard: int) -> None:
+        """Simulate the shard worker's process dying: release the lease so
+        ownership moves in ~one retry period (a crash without release rides
+        out the lease duration instead — use ``sever``)."""
+        self.release(shard)
+        self._dead.add(shard)
+
+    def sever(self, shard: int) -> None:
+        """Crash without release: the lease rides out its duration before
+        another worker (or the ring) can declare the shard dead."""
+        self._dead.add(shard)
+
+    def revive(self, shard: int) -> None:
+        self._dead.discard(shard)

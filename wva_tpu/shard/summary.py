@@ -1,0 +1,306 @@
+"""Per-shard summaries: the only thing a shard ships to the fleet solve
+(docs/design/sharding.md §summary-schema).
+
+A shard's analysis tick produces a :class:`ShardCapture` — compact
+per-model entries (pre-limiter decisions for locally-optimized models,
+demand/latency/capacity arrays for fleet-solved ones, raw health signals)
+plus the buffered trace records — never object graphs: no K8s objects, no
+analyzer state, no collector views cross the shard boundary. The fleet
+lease-holder merges captures in sorted model order, which is what makes
+sharded decisions byte-identical to the unsharded engine's.
+
+Two transports:
+
+- **In-process** (emulator / bench / single-binary deployments): captures
+  pass by reference through :class:`InProcessSummaryBus`.
+- **ConfigMap** (process-per-shard deployments): :class:`ConfigMapSummaryBus`
+  publishes each capture as canonical JSON in ``wva-shard-summary-<i>``
+  (rv-guarded writes, the checkpoint ConfigMap discipline) and the fleet
+  reads + ages them — ``wva_shard_summary_age_seconds`` is the alert.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+
+from wva_tpu.blackbox.schema import encode
+
+log = logging.getLogger(__name__)
+
+SUMMARY_CONFIGMAP_PREFIX = "wva-shard-summary"
+SUMMARY_DATA_KEY = "summary"
+SUMMARY_SCHEMA_VERSION = 1
+
+# Model-entry kinds.
+ENTRY_LOCAL = "local"      # freshly analyzed, per-model optimizer ran
+ENTRY_CACHED = "cached"    # fingerprint-clean: memoized decisions re-emitted
+ENTRY_GLOBAL = "global"    # routed to the fleet-level solve: arrays only
+
+# Trace-buffer sections, mirroring the unsharded engine's in-cycle record
+# order so the fleet merge can reproduce the exact stream:
+#   models    — per-group records from the stage-2 merge loop (model
+#               records, fingerprint_skip stages; the V1 path's enforcer
+#               stages too — V1 enforces inside the loop),
+#   optimizer — the V2/SLO cost-aware optimizer's per-request stages
+#               (emitted after every model record, before enforcement),
+#   enforce   — the V2/SLO bridge_enforce pass (one enforcer stage per
+#               request, AFTER every optimizer stage).
+SECTION_MODELS = "models"
+SECTION_OPTIMIZER = "optimizer"
+SECTION_ENFORCE = "enforce"
+
+
+class TraceBuffer:
+    """FlightRecorder facade for shard workers: captures ``record_model`` /
+    ``record_stage`` calls (pre-encoded, exactly as the real recorder
+    would) instead of appending to a live cycle, tagged with the section
+    the engine is currently emitting from. The fleet merge interleaves
+    buffered records from every shard in sorted model order per section."""
+
+    def __init__(self) -> None:
+        # (section, group_key, seq, kind, payload); seq keeps same-group
+        # records in emission order after the sort.
+        self.records: list[tuple[str, str, int, str, dict]] = []
+        self._section = SECTION_MODELS
+        self._seq = 0
+
+    def begin_section(self, section: str) -> None:
+        self._section = section
+
+    @staticmethod
+    def _group_key(payload: dict) -> str:
+        return f"{payload.get('model_id', '')}|{payload.get('namespace', '')}"
+
+    def record_model(self, payload: dict) -> None:
+        self._record("model", payload)
+
+    def record_stage(self, stage: str, payload: dict) -> None:
+        self._record("stage", {"stage": stage, **payload})
+
+    def _record(self, kind: str, payload: dict) -> None:
+        try:
+            payload = encode(payload)
+        except Exception:  # noqa: BLE001 — same never-bite rule as the
+            log.debug("shard trace encode failed", exc_info=True)  # recorder
+            return
+        self._seq += 1
+        self.records.append((self._section, self._group_key(payload),
+                             self._seq, kind, payload))
+
+    # The engine consults the recorder for the current cycle id when
+    # publishing DecisionCache entries; workers never publish, but keep the
+    # surface total so shard-mode code paths can't crash on it.
+    def current_cycle(self) -> int:
+        return 0
+
+    def annotate(self, **fields) -> None:  # cycle metadata is fleet-owned
+        pass
+
+    def reset_cycle(self) -> None:
+        """Engine task entry (retried ticks must not stack records)."""
+        self.records = []
+        self._section = SECTION_MODELS
+        self._seq = 0
+
+
+@dataclass
+class ModelEntry:
+    """One model group's contribution to the fleet solve."""
+
+    group_key: str                  # "model_id|namespace"
+    model_id: str
+    namespace: str
+    kind: str                       # ENTRY_LOCAL | ENTRY_CACHED | ENTRY_GLOBAL
+    # Pre-limiter decisions (local/cached): the fleet re-clamps the merged
+    # set against current inventory, exactly like the unsharded engine.
+    decisions: list = field(default_factory=list)
+    # Fleet-solve inputs (kind == ENTRY_GLOBAL): the AnalyzerResult's
+    # demand/latency/capacity arrays + variant replica states, encoded —
+    # reconstructed into a ModelScalingRequest by the fleet (the same
+    # encode/decode pair replay trusts for bit-for-bit reproduction).
+    global_request: dict | None = None
+
+
+@dataclass
+class HealthSignals:
+    """One model's shipped trust state: the owning shard's monitor runs
+    the ladder (its hysteresis books are shard-local — a rebalance resets
+    them, which the rebalance ramp covers exactly like a process restart);
+    the fleet's gate consumes the classification plus the
+    proof-of-freshness signals, while the last-known-good desired map
+    stays fleet-side so holds survive ownership moves."""
+
+    state: str = "fresh"
+    age_seconds: float = 0.0
+    allow_scale_down: bool = True
+    reason: str = ""
+    age_observed: bool = False      # a REAL backend age existed this tick
+    scraped: int | None = None
+    ready: int | None = None
+
+
+@dataclass
+class ShardCapture:
+    """One shard's full analysis output for one tick."""
+
+    shard_id: int = 0
+    epoch: int = -1                 # shard-lease fencing token at capture
+    tick_seq: int = 0
+    published_at: float = 0.0
+    control_age: float = 0.0        # shard-side K8s staleness beyond resync
+    entries: dict[str, ModelEntry] = field(default_factory=dict)
+    health: dict[str, HealthSignals] = field(default_factory=dict)
+    # Forecast stage pieces (merged into ONE fleet STAGE_FORECAST record).
+    plans: list = field(default_factory=list)
+    floors: list = field(default_factory=list)
+    floors_raised: int = 0
+    trace: list = field(default_factory=list)   # TraceBuffer.records
+    analyzed: int = 0
+    skipped: int = 0
+
+
+def capture_to_payload(cap: ShardCapture) -> dict:
+    """Canonical JSON-able form for the ConfigMap transport. Decisions and
+    plans serialize through the blackbox encoder; the in-process bus skips
+    this entirely (references cross no process boundary there)."""
+    return {
+        "schema": SUMMARY_SCHEMA_VERSION,
+        "shard_id": cap.shard_id,
+        "epoch": cap.epoch,
+        "tick_seq": cap.tick_seq,
+        "published_at": cap.published_at,
+        "control_age": cap.control_age,
+        "analyzed": cap.analyzed,
+        "skipped": cap.skipped,
+        "entries": {
+            k: {
+                "group_key": e.group_key,
+                "model_id": e.model_id,
+                "namespace": e.namespace,
+                "kind": e.kind,
+                "decisions": [encode(d) for d in e.decisions],
+                "global_request": e.global_request,
+            } for k, e in sorted(cap.entries.items())},
+        "health": {
+            k: {"state": h.state, "age_seconds": h.age_seconds,
+                "allow_scale_down": h.allow_scale_down,
+                "reason": h.reason, "age_observed": h.age_observed,
+                "scraped": h.scraped, "ready": h.ready}
+            for k, h in sorted(cap.health.items())},
+        "plans": [encode(p) for p in cap.plans],
+        "floors": list(cap.floors),
+        "floors_raised": cap.floors_raised,
+        "trace": [list(r) for r in cap.trace],
+    }
+
+
+def payload_to_capture(data: dict) -> ShardCapture:
+    """Inverse of :func:`capture_to_payload`. Decisions come back as
+    :class:`~wva_tpu.interfaces.VariantDecision`; plans stay encoded (the
+    fleet only re-sorts and records them)."""
+    from wva_tpu.blackbox.schema import decode
+    from wva_tpu.interfaces import VariantDecision
+
+    cap = ShardCapture(
+        shard_id=int(data.get("shard_id", 0)),
+        epoch=int(data.get("epoch", -1)),
+        tick_seq=int(data.get("tick_seq", 0)),
+        published_at=float(data.get("published_at", 0.0)),
+        control_age=float(data.get("control_age", 0.0)),
+        analyzed=int(data.get("analyzed", 0)),
+        skipped=int(data.get("skipped", 0)),
+        plans=list(data.get("plans", [])),
+        floors=list(data.get("floors", [])),
+        floors_raised=int(data.get("floors_raised", 0)),
+        trace=[tuple(r) for r in data.get("trace", [])],
+    )
+    for k, e in (data.get("entries") or {}).items():
+        cap.entries[k] = ModelEntry(
+            group_key=e.get("group_key", k),
+            model_id=e.get("model_id", ""),
+            namespace=e.get("namespace", ""),
+            kind=e.get("kind", ENTRY_LOCAL),
+            decisions=[decode(VariantDecision, d)
+                       for d in e.get("decisions", [])],
+            global_request=e.get("global_request"),
+        )
+    for k, h in (data.get("health") or {}).items():
+        cap.health[k] = HealthSignals(
+            state=h.get("state", "fresh"),
+            age_seconds=float(h.get("age_seconds", 0.0)),
+            allow_scale_down=bool(h.get("allow_scale_down", True)),
+            reason=h.get("reason", ""),
+            age_observed=bool(h.get("age_observed", False)),
+            scraped=h.get("scraped"), ready=h.get("ready"))
+    return cap
+
+
+class InProcessSummaryBus:
+    """Reference-passing bus for the in-process plane (one capture slot per
+    shard, overwritten per tick)."""
+
+    def __init__(self) -> None:
+        self._slots: dict[int, ShardCapture] = {}
+
+    def publish(self, cap: ShardCapture) -> None:
+        self._slots[cap.shard_id] = cap
+
+    def read(self, shard_id: int) -> ShardCapture | None:
+        return self._slots.get(shard_id)
+
+
+class ConfigMapSummaryBus:
+    """ConfigMap transport for process-per-shard deployments: rv-guarded
+    publish (a deposed shard worker's stale write 409s harmlessly), read
+    with age derived from the payload's ``published_at``."""
+
+    def __init__(self, client, namespace: str) -> None:
+        self.client = client
+        self.namespace = namespace
+
+    def _name(self, shard_id: int) -> str:
+        return f"{SUMMARY_CONFIGMAP_PREFIX}-{shard_id}"
+
+    def publish(self, cap: ShardCapture) -> None:
+        from wva_tpu.k8s.client import ConflictError
+        from wva_tpu.k8s.objects import ConfigMap, ObjectMeta, clone
+
+        payload = json.dumps(capture_to_payload(cap), sort_keys=True,
+                             separators=(",", ":"))
+        name = self._name(cap.shard_id)
+        try:
+            existing = self.client.try_get(ConfigMap.KIND, self.namespace,
+                                           name)
+            if existing is None:
+                self.client.create(ConfigMap(
+                    metadata=ObjectMeta(name=name, namespace=self.namespace),
+                    data={SUMMARY_DATA_KEY: payload}))
+            else:
+                cm = clone(existing)
+                cm.data = {SUMMARY_DATA_KEY: payload}
+                self.client.update(cm)
+        except ConflictError:
+            # Another worker holds a newer view of this shard's summary —
+            # exactly the fencing outcome we want; next tick re-publishes.
+            log.debug("shard summary publish conflicted for %s", name)
+        except Exception as e:  # noqa: BLE001 — publishing must never fail
+            log.warning("shard summary publish failed for %s: %s", name, e)
+
+    def read(self, shard_id: int) -> ShardCapture | None:
+        from wva_tpu.k8s.objects import ConfigMap
+
+        try:
+            cm = self.client.try_get(ConfigMap.KIND, self.namespace,
+                                     self._name(shard_id))
+        except Exception as e:  # noqa: BLE001 — a storming apiserver reads
+            log.warning("shard summary read failed: %s", e)  # as absent
+            return None
+        if cm is None or not cm.data.get(SUMMARY_DATA_KEY):
+            return None
+        try:
+            return payload_to_capture(json.loads(cm.data[SUMMARY_DATA_KEY]))
+        except (ValueError, TypeError, KeyError) as e:
+            log.warning("shard summary %d corrupt: %s", shard_id, e)
+            return None

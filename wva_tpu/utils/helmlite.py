@@ -20,6 +20,10 @@ Supported:
 - control flow: ``{{- if <expr> }}`` / ``{{- else }}`` / ``{{- end }}``
   where <expr> is a value reference, ``not <ref>``, ``eq <ref> <literal>``,
   ``and <ref> <ref>``, or ``or <ref> <ref>``;
+- counted loops: ``{{- range $i := until (int <ref-or-int>) }}`` /
+  ``{{- end }}`` with ``{{ $i }}`` references in the body (sprig ``until``
+  semantics: 0..n-1) — the chart RBAC uses this to enumerate the shard
+  lease family from ``wva.sharding.shards``;
 - whitespace trimming markers ``{{-`` and ``-}}``.
 
 ``--set``-style overrides use helm's dotted-path syntax with the same
@@ -35,6 +39,8 @@ from pathlib import Path
 import yaml
 
 _TAG_RE = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+_MISSING = object()
 
 
 def _coerce(raw: str):
@@ -91,6 +97,8 @@ class Renderer:
             "Chart": {"Name": chart_meta.get("name", ""),
                       "Version": str(chart_meta.get("version", ""))},
         }
+        # range-scoped template variables ($i and friends).
+        self._vars: dict[str, object] = {}
 
     # --- expression evaluation ---
 
@@ -110,6 +118,14 @@ class Renderer:
             value = head[1:-1]
         elif head.startswith("."):
             value = self._resolve_ref(head)
+        elif head.startswith("$."):
+            # $.Values... — the root context, reachable from inside range
+            # scopes exactly like helm's $.
+            value = self._resolve_ref(head[1:])
+        elif head.startswith("$"):
+            if head[1:] not in self._vars:
+                raise ValueError(f"undefined template variable {head!r}")
+            value = self._vars[head[1:]]
         else:
             value = _coerce(head)
         for stage in stages[1:]:
@@ -204,6 +220,45 @@ class Renderer:
                 if trim_after:  # "{{- if x -}}": trim the branch body start
                     chosen = re.sub(r"^[ \t]*\n?", "", chosen)
                 emit(chosen)
+                trim_next = end_trim
+                continue
+            if expr.startswith("range "):
+                m = re.fullmatch(
+                    r"range\s+\$(\w+)\s*:=\s*until\s+"
+                    r"\(\s*int\s+(\S+)\s*\)", expr[:])
+                if m is None:
+                    raise ValueError(
+                        f"unsupported range expression {expr!r} (only "
+                        "'range $var := until (int <ref>)' is supported)")
+                var, count_expr = m.group(1), m.group(2)
+                try:
+                    count = max(0, int(self._eval_value(count_expr) or 0))
+                except (TypeError, ValueError):
+                    count = 0
+                saved = self._vars.get(var, _MISSING)
+                body_out: list[str] = []
+                # Each iteration re-renders the same token span; a zero-
+                # iteration range still renders once (discarded) purely to
+                # locate the matching end tag.
+                for i in range(max(count, 1)):
+                    self._vars[var] = i
+                    one, body_idx = self._render_block(tokens, idx + 1,
+                                                       depth + 1)
+                    if count and trim_after:
+                        one = re.sub(r"^[ \t]*\n?", "", one)
+                    if count:
+                        body_out.append(one)
+                if saved is _MISSING:
+                    self._vars.pop(var, None)
+                else:
+                    self._vars[var] = saved
+                idx = body_idx
+                if idx >= len(tokens) or tokens[idx][0] != "tag" \
+                        or tokens[idx][1] != "end":
+                    raise ValueError("unbalanced range/end in template")
+                end_trim = tokens[idx][2]
+                idx += 1
+                emit("".join(body_out))
                 trim_next = end_trim
                 continue
             if expr in ("else", "end"):
