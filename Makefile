@@ -52,6 +52,10 @@ bench-tick-quiet: ## Steady-state quiet-tick microbench (48 models default, MODE
 bench-profile: ## cProfile-backed hot-path dump of one quiet-tick bench run (top-N call sites by cumulative + total time; MODELS=N profiles at fleet scale, e.g. MODELS=480) — the tool for finding the next tick hot path (PERF.md).
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --profile $(if $(MODELS),--models $(MODELS))
 
+.PHONY: bench-analyze
+bench-analyze: ## Fused decision-plane sweep (48/480/1000/2000 models, SLO path): device dispatches/tick and analyze-phase p50 with WVA_FUSED on vs off (staged per-stage dispatches, byte-identical decisions); merges detail.fused_plane into BENCH_LOCAL.json.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --analyze-only
+
 .PHONY: bench-collect
 bench-collect: ## Metrics-plane microbench (48 models): backend queries/tick grouped ON vs per-model fan-out, and in-memory TSDB query p50 under 8 concurrent readers vs the pre-ring read path; merges into BENCH_LOCAL.json.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --collect-only

@@ -638,10 +638,22 @@ def multi_model_bench() -> dict:
     }
 
 
+def _drain_decision_bus():
+    """The DecisionCache/DecisionTrigger bus is process-global: every
+    bench section leaves it as clean as it found it, or later sections
+    would drain this section's stale triggers into their own (clean)
+    worlds."""
+    from wva_tpu.engines import common as engines_common
+
+    engines_common.DecisionCache.clear()
+    while not engines_common.DecisionTrigger.empty():
+        engines_common.DecisionTrigger.get_nowait()
+
+
 def _build_tick_world(n_models: int, variants_per_model: int,
                       informer: bool = True, incremental: bool = True,
                       zero_copy: bool = True, fp_delta: bool = True,
-                      sharding: int = 0):
+                      sharding: int = 0, fused: bool = True):
     """The shared 48-model/96-VA in-memory fleet world for the tick
     benches (`make bench-tick` / `make bench-tick-quiet`): FakeCluster +
     TSDB + fully wired manager on the SLO analyzer path, with a ``feed``
@@ -675,9 +687,7 @@ def _build_tick_world(n_models: int, variants_per_model: int,
     ns = "bench"
     accels = ["v5e-8", "v5p-8"]
 
-    engines_common.DecisionCache.clear()
-    while not engines_common.DecisionTrigger.empty():
-        engines_common.DecisionTrigger.get_nowait()
+    _drain_decision_bus()
     clock = FakeClock(start=200_000.0)
     cluster = FakeCluster(clock=clock)
     tsdb = TimeSeriesDB(clock=clock)
@@ -690,6 +700,9 @@ def _build_tick_world(n_models: int, variants_per_model: int,
     # WVA_FP_DELTA lever (versioned fingerprint plane): off restores the
     # recomputed per-tick fingerprint — the honest pre-change lever.
     cfg.infrastructure.fp_delta = fp_delta
+    # WVA_FUSED lever (one-jitted-program decision plane): off restores
+    # the staged per-stage dispatches — the honest pre-change lever.
+    cfg.infrastructure.fused = fused
     # WVA_SHARDING lever (sharded active-active engine): >0 splits the
     # engine into that many consistent-hash shard workers with the fleet
     # merge on top (docs/design/sharding.md); build_manager wires the
@@ -874,9 +887,7 @@ def tick_scale_bench(n_models: int = 48, variants_per_model: int = 2,
     # clean as build_world() found it, or the policy runs that follow in a
     # full `make bench` would drain this bench's stale triggers into their
     # own (clean) worlds.
-    engines_common.DecisionCache.clear()
-    while not engines_common.DecisionTrigger.empty():
-        engines_common.DecisionTrigger.get_nowait()
+    _drain_decision_bus()
     return {
         "models": n_models,
         "variant_autoscalings": n_models * variants_per_model,
@@ -1019,9 +1030,7 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
     # decisions (tests/test_object_plane.py).
     copy_on_read = run_mode(informer=True, incremental=True,
                             zero_copy=False)
-    engines_common.DecisionCache.clear()
-    while not engines_common.DecisionTrigger.empty():
-        engines_common.DecisionTrigger.get_nowait()
+    _drain_decision_bus()
     return {
         "models": n_models,
         "variant_autoscalings": n_models * variants_per_model,
@@ -1064,7 +1073,7 @@ def tick_quiet_bench(n_models: int = 48, variants_per_model: int = 2,
     }
 
 
-def fingerprint_scale_sweep(models=(48, 144, 480, 2000),
+def fingerprint_scale_sweep(models=(48, 144, 480, 1000, 2000),
                             variants_per_model: int = 2,
                             measured_ticks: int = 13,
                             quiet_warm_ticks: int = 13) -> dict:
@@ -1116,9 +1125,7 @@ def fingerprint_scale_sweep(models=(48, 144, 480, 2000),
                 for k, v in sorted(phase_sums.items())},
         }
         mgr.shutdown()
-        engines_common.DecisionCache.clear()
-        while not engines_common.DecisionTrigger.empty():
-            engines_common.DecisionTrigger.get_nowait()
+        _drain_decision_bus()
     lo, hi = str(models[0]), str(models[-1])
     growth = round(out[hi]["tick_p50_ms"]
                    / max(out[lo]["tick_p50_ms"], 1e-9), 2)
@@ -1135,6 +1142,98 @@ def fingerprint_scale_sweep(models=(48, 144, 480, 2000),
                      * 1000.0 / v["models"], 2)
             for k, v in out.items()},
     }
+
+
+def analyze_plane_bench(models=(48, 480, 1000, 2000),
+                        variants_per_model: int = 2,
+                        measured_ticks: int = 7,
+                        warm_ticks: int = 3) -> dict:
+    """Fused decision-plane sweep (``make bench-analyze``, BENCH_LOCAL
+    ``detail.fused_plane``): the SLO analyze phase at 1x/10x/~21x/~42x
+    fleet size with WVA_FUSED on vs off, measuring
+
+    - **device dispatches per tick** (utils.dispatch deltas around each
+      engine tick) — the tentpole's headline: the fused path launches
+      ONE dispatch per analyzing tick (sizing + forecast fits + gather
+      fused), the staged path one per stage;
+    - **analyze-phase p50 ms** (``wva_tick_phase_seconds{phase=analyze}``
+      via ``engine.last_tick_phase_seconds``) — which also exposes,
+      honestly, how much of the phase is Python finalize/optimizer/
+      enforcer vs device work at each scale.
+
+    Every tick analyzes every model (incremental off, the tick_scale
+    discipline): a fingerprint-skipped model launches nothing, so quiet
+    ticks would measure the skip plane, not the decision plane."""
+    import statistics
+
+    from wva_tpu.engines import common as engines_common
+    from wva_tpu.utils import dispatch as dispatch_counter
+
+    out: dict[str, dict] = {}
+    for n in models:
+        point: dict[str, dict] = {}
+        for label, fused_on in (("fused", True), ("staged", False)):
+            mgr, cluster, clock, feed = _build_tick_world(
+                n, variants_per_model, incremental=False, fused=fused_on)
+            eng = mgr.engine
+            for _ in range(warm_ticks):
+                eng.optimize()
+                clock.advance(5.0)
+                feed(clock.now())
+            analyze_ms: list[float] = []
+            dispatches: list[int] = []
+            for _ in range(measured_ticks):
+                d0 = dispatch_counter.count()
+                eng.optimize()
+                dispatches.append(dispatch_counter.count() - d0)
+                analyze_ms.append(
+                    eng.last_tick_phase_seconds.get("analyze", 0.0)
+                    * 1000.0)
+                clock.advance(5.0)
+                feed(clock.now())
+            mgr.shutdown()
+            _drain_decision_bus()
+            point[label] = {
+                "analyze_p50_ms": round(
+                    statistics.median(analyze_ms), 2),
+                "dispatches_per_tick": round(
+                    sum(dispatches) / len(dispatches), 2),
+            }
+        point["models"] = n
+        point["analyze_p50_speedup"] = round(
+            point["staged"]["analyze_p50_ms"]
+            / max(point["fused"]["analyze_p50_ms"], 1e-9), 2)
+        out[str(n)] = point
+    return {
+        "sweep": out,
+        "levers": {
+            "fused": "WVA_FUSED on (shipped): one fused dispatch per "
+                     "analyzing tick",
+            "staged": "WVA_FUSED off: one dispatch per stage (batched "
+                      "sizing + forecast fit), byte-identical decisions",
+        },
+    }
+
+
+def analyze_main() -> None:
+    """`make bench-analyze`: the fused decision-plane sweep, merged into
+    BENCH_LOCAL.json detail.fused_plane, one JSON line on stdout."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    record = analyze_plane_bench()
+    record["bench_wall_seconds"] = round(time.time() - t0, 1)
+    _merge_bench_local("fused_plane", record)
+    k1 = "1000" if "1000" in record["sweep"] else \
+        max(record["sweep"], key=int)
+    print(json.dumps({
+        "metric": "fused_analyze_phase_1000_models",
+        "value": record["sweep"][k1]["fused"]["analyze_p50_ms"],
+        "unit": "ms_p50_per_tick",
+        "vs_baseline": record["sweep"][k1]["analyze_p50_speedup"],
+        "dispatches_per_tick":
+            record["sweep"][k1]["fused"]["dispatches_per_tick"],
+        "detail": record,
+    }))
 
 
 def collect_scale_bench(n_models: int = 48, measured_ticks: int = 10,
@@ -1861,9 +1960,7 @@ def capacity_storm_bench(n_models: int = 48, duration: float = 600.0,
     harness.manager.engine.executor.task = tick_wrapper
     harness.run(duration, on_step=on_step)
     harness.manager.shutdown()
-    engines_common.DecisionCache.clear()
-    while not engines_common.DecisionTrigger.empty():
-        engines_common.DecisionTrigger.get_nowait()
+    _drain_decision_bus()
 
     capman = harness.manager.engine.capacity
     ticks_list = sorted(reconverge_ticks.values())
@@ -2049,9 +2146,7 @@ def chaos_storm_bench(n_models: int = 48, duration: float = 1200.0,
             harness.manager.source_registry.get("prometheus").api,
             "injected", {}))
         harness.manager.shutdown()
-        engines_common.DecisionCache.clear()
-        while not engines_common.DecisionTrigger.empty():
-            engines_common.DecisionTrigger.get_nowait()
+        _drain_decision_bus()
         ticks = sorted(recovery.values())
         return {
             "wrong_direction_events": wrong_direction,
@@ -2275,9 +2370,7 @@ def failover_storm_bench(n_models: int = 48, duration: float = 1200.0,
     harness.manager.shutdown()
     for m in harness.standbys:
         m.shutdown()
-    engines_common.DecisionCache.clear()
-    while not engines_common.DecisionTrigger.empty():
-        engines_common.DecisionTrigger.get_nowait()
+    _drain_decision_bus()
 
     # --- assertions ---
     by_epoch: dict[object, set[str]] = {}
@@ -2492,9 +2585,7 @@ def shard_plane_bench(n_models: int = 480, shards: int = 4,
                                                 v.metadata.name))]
 
     def drain_globals():
-        engines_common.DecisionCache.clear()
-        while not engines_common.DecisionTrigger.empty():
-            engines_common.DecisionTrigger.get_nowait()
+        _drain_decision_bus()
 
     def run_world(shard_count: int, crash: bool = False) -> dict:
         mgr, cluster, clock, feed = _build_tick_world(
@@ -2649,9 +2740,7 @@ def shard_scale_sweep(models=(480, 2000), shards: int = 4,
             return out
         finally:
             mgr.shutdown()
-            engines_common.DecisionCache.clear()
-            while not engines_common.DecisionTrigger.empty():
-                engines_common.DecisionTrigger.get_nowait()
+            _drain_decision_bus()
 
     out: dict[str, dict] = {}
     for n in models:
@@ -2736,6 +2825,8 @@ if __name__ == "__main__":
         tick_quiet_main()
     elif "--tick-only" in sys.argv:
         tick_main()
+    elif "--analyze-only" in sys.argv:
+        analyze_main()
     elif "--collect-only" in sys.argv:
         collect_main()
     elif "--forecast-only" in sys.argv:

@@ -367,3 +367,107 @@ class TestRingBufferStore:
         (_, legacy), = db.matching_series([("__name__", "=", "m")])
         assert [(s.timestamp, s.value) for s in fast] == \
             [(s.timestamp, s.value) for s in legacy]
+
+
+class TestDeltaRangeEval:
+    """Delta-maintained range evaluation (ROADMAP item 1a residual):
+    per-series rolling accumulators updated on the appended suffix, so a
+    quiet series' rate/*_over_time evaluation is a memo hit and a live
+    series' evaluation folds only its new samples — byte-identical to
+    the scanning evaluator (the lever contract)."""
+
+    QUERIES = ("rate(m[30s])", "increase(m[60s])",
+               "avg_over_time(m[45s])", "max_over_time(m[45s])")
+
+    def _run(self, delta: bool, steps: int = 300, seed: int = 7):
+        import random
+
+        clock = FakeClock(start=1000.0)
+        db = TimeSeriesDB(clock=clock, retention=120.0)
+        db.delta_range_eval = delta
+        eng = PromQLEngine(db)
+        rng = random.Random(seed)
+        out = []
+        for _ in range(steps):
+            clock.advance(rng.choice([1.0, 3.0, 7.0]))
+            for s in range(5):
+                if rng.random() < 0.7:
+                    v = rng.choice([rng.uniform(0, 100), float("nan"),
+                                    0.0, -0.0, rng.uniform(0, 5)])
+                    db.add_sample("m", {"s": str(s)}, v)
+            for q in self.QUERIES:
+                pts = eng.query(q)
+                out.append([(tuple(sorted(p.labels.items())),
+                             repr(p.value), p.timestamp) for p in pts])
+        return out, db
+
+    def test_byte_identical_to_scanning_evaluator(self):
+        """Seeded random workload — NaNs, signed zeros, counter resets,
+        retention trims — evaluates bit-for-bit identically with the
+        delta path on and off (repr captures every bit incl. NaN/-0.0)."""
+        on, db_on = self._run(True)
+        off, _ = self._run(False)
+        assert on == off
+        # The delta path actually engaged (not vacuous equality).
+        assert db_on.range_hits + db_on.range_extends > 0
+
+    def test_unchanged_window_is_memo_hit(self):
+        """Re-evaluating an unchanged window does zero fold work."""
+        db = TimeSeriesDB(clock=FakeClock(start=1000.0))
+        eng = PromQLEngine(db)
+        for i in range(10):
+            db.add_sample("q", {}, float(i), timestamp=1000.0 + i)
+        for q in ("rate(q[60s])", "avg_over_time(q[60s])",
+                  "max_over_time(q[60s])"):
+            eng.query(q, at=1010.0)
+            scans, extends = db.range_scans, db.range_extends
+            again = eng.query(q, at=1010.0)
+            assert (db.range_scans, db.range_extends) == (scans, extends)
+            assert again == eng.query(q, at=1010.0)
+
+    def test_appended_suffix_extends_instead_of_rescanning(self):
+        db = TimeSeriesDB(clock=FakeClock(start=1000.0))
+        eng = PromQLEngine(db)
+        for i in range(10):
+            db.add_sample("q", {}, float(i), timestamp=1000.0 + i)
+        (r0,) = eng.query("rate(q[60s])", at=1009.0)
+        scans = db.range_scans
+        db.add_sample("q", {}, 11.0, timestamp=1010.0)
+        (r1,) = eng.query("rate(q[60s])", at=1010.0)
+        assert db.range_scans == scans  # extension, not rescan
+        assert db.range_extends >= 1
+        db.delta_range_eval = False
+        (r1_scan,) = eng.query("rate(q[60s])", at=1010.0)
+        assert repr(r1.value) == repr(r1_scan.value)
+
+    def test_left_edge_movement_rescans_exactly(self):
+        """Samples expiring out of the window force a rescan whose
+        result matches the scanning evaluator bit-for-bit."""
+        db = TimeSeriesDB(clock=FakeClock(start=1000.0))
+        eng = PromQLEngine(db)
+        for i in range(20):
+            db.add_sample("q", {}, float(i * i), timestamp=1000.0 + i)
+        eng.query("increase(q[10s])", at=1012.0)
+        (moved,) = eng.query("increase(q[10s])", at=1017.0)
+        db.delta_range_eval = False
+        (scanned,) = eng.query("increase(q[10s])", at=1017.0)
+        assert repr(moved.value) == repr(scanned.value)
+
+    def test_compaction_invalidates_memo_safely(self):
+        """Compaction replaces the backing arrays; the memo anchors on
+        the array object, so a compacted series rescans instead of
+        serving a stale accumulator."""
+        clock = FakeClock(start=0.0)
+        db = TimeSeriesDB(clock=clock, retention=50.0)
+        eng = PromQLEngine(db)
+        # Enough appends past retention to trigger the dead-prefix
+        # compaction (COMPACT_MIN_DEAD = 256).
+        for i in range(700):
+            db.add_sample("q", {}, float(i % 13), timestamp=float(i))
+        (a,) = eng.query("avg_over_time(q[40s])", at=699.0)
+        db.add_sample("q", {}, 5.0, timestamp=700.0)
+        (b,) = eng.query("avg_over_time(q[40s])", at=700.0)
+        db.delta_range_eval = False
+        (b_scan,) = eng.query("avg_over_time(q[40s])", at=700.0)
+        assert repr(b.value) == repr(b_scan.value)
+        assert a is not None

@@ -242,6 +242,14 @@ class QueueingModelAnalyzer(Analyzer):
         plan.needs_sizing = bool(plan.candidates)
         return plan
 
+    def plan_demand(self, plan: SizingPlan) -> float:
+        """The demand (req/s) :meth:`finalize` will report as
+        ``total_demand`` — a pure function of the prepared input, exposed
+        so the fused decision plane can feed the forecast planner BEFORE
+        the device dispatch (the value is bitwise what finalize computes
+        from the same plan)."""
+        return self._demand_per_s(plan.input)
+
     def finalize(self, plan: SizingPlan,
                  per_replica: list[float]) -> AnalyzerResult:
         """Turn sized candidates into the AnalyzerResult: supply/demand
@@ -437,27 +445,12 @@ class QueueingModelAnalyzer(Analyzer):
         ticks). ``size_batch_bucketed`` also trims the state axis to the
         fleet's largest occupancy bound — the ``k_host`` ints are already in
         hand, so no device sync is paid for the trim decision."""
+        from wva_tpu.utils import dispatch
+
+        dispatch.note()
         n = len(candidates)
-        bucket = max(8, 1 << (n - 1).bit_length())
-        padded = candidates + [candidates[0]] * (bucket - n)
-        ks = [c.profile.max_batch_size + c.profile.max_queue_size
-              for c in padded]
-        cand = candidate_batch(
-            [c.profile.service_parms.alpha for c in padded],
-            [c.profile.service_parms.beta for c in padded],
-            [c.profile.service_parms.gamma for c in padded],
-            [c.request_size.avg_input_tokens for c in padded],
-            [c.request_size.avg_output_tokens for c in padded],
-            [c.profile.max_batch_size for c in padded],
-            ks,
-        )
-        out = size_batch_bucketed(
-            cand,
-            jnp.asarray([c.targets.target_ttft_ms for c in padded], jnp.float32),
-            jnp.asarray([c.targets.target_itl_ms for c in padded], jnp.float32),
-            jnp.asarray([c.targets.target_tps for c in padded], jnp.float32),
-            k_host=ks,
-        )
+        cand, t_ttft, t_itl, t_tps, ks = build_sizing_batch(candidates)
+        out = size_batch_bucketed(cand, t_ttft, t_itl, t_tps, k_host=ks)
         # ONE host transfer for the whole batch: iterating the device array
         # (`float(x) for x in ...`) costs a separate device->host read per
         # element — ~1ms each, which at a 96-candidate fleet tick was more
@@ -466,3 +459,37 @@ class QueueingModelAnalyzer(Analyzer):
 
         return np.asarray(out["max_rate_per_s"][:n],
                           dtype=np.float64).tolist()
+
+
+def build_sizing_batch(candidates: list[_Candidate]):
+    """THE sizing-batch construction: pad the candidate list to its
+    power-of-two bucket (min 8, repeating the first candidate — padding
+    rows are sliced off and row-independent) and lay the profiles /
+    request mixes / targets out as device arrays. Shared by
+    :meth:`QueueingModelAnalyzer.size_candidates` and the fused decision
+    plane's grid builder (wva_tpu/fused/grids.py) — one builder, so the
+    fused program's candidate axis can never drift from the staged batch
+    (the WVA_FUSED bitwise on/off contract). Returns
+    ``(CandidateBatch, t_ttft, t_itl, t_tps, ks)`` with ``ks`` the
+    padded occupancy bounds (host ints, for the state-axis trim)."""
+    n = len(candidates)
+    bucket = max(8, 1 << (n - 1).bit_length())
+    padded = candidates + [candidates[0]] * (bucket - n)
+    ks = [c.profile.max_batch_size + c.profile.max_queue_size
+          for c in padded]
+    cand = candidate_batch(
+        [c.profile.service_parms.alpha for c in padded],
+        [c.profile.service_parms.beta for c in padded],
+        [c.profile.service_parms.gamma for c in padded],
+        [c.request_size.avg_input_tokens for c in padded],
+        [c.request_size.avg_output_tokens for c in padded],
+        [c.profile.max_batch_size for c in padded],
+        ks,
+    )
+    t_ttft = jnp.asarray([c.targets.target_ttft_ms for c in padded],
+                         jnp.float32)
+    t_itl = jnp.asarray([c.targets.target_itl_ms for c in padded],
+                        jnp.float32)
+    t_tps = jnp.asarray([c.targets.target_tps for c in padded],
+                        jnp.float32)
+    return cand, t_ttft, t_itl, t_tps, ks

@@ -491,6 +491,23 @@ def _size_batch_core(
 _K_COLS_MIN = 256
 
 
+def k_cols_for(k_host) -> int:
+    """THE state-axis trim rule: smallest power of two (>= 256, capped at
+    K_MAX) covering the batch's largest occupancy bound. Shared by
+    :func:`size_batch_bucketed` and the fused decision plane's grid
+    builder — one rule, so the fused program's k_cols can never drift
+    from the staged dispatch's (bitwise equality either way, but drift
+    would silently recompile)."""
+    import numpy as np
+
+    ks = np.asarray(k_host)
+    k_max = int(ks.max()) if ks.size else K_MAX
+    k_cols = _K_COLS_MIN
+    while k_cols < k_max:
+        k_cols *= 2
+    return min(k_cols, K_MAX)
+
+
 def size_batch_bucketed(
     cand: CandidateBatch,
     target_ttft_ms,
@@ -518,12 +535,7 @@ def size_batch_bucketed(
     """
     import numpy as np
 
-    ks = np.asarray(cand.k) if k_host is None else np.asarray(k_host)
-    k_max = int(ks.max()) if ks.size else K_MAX
-    k_cols = _K_COLS_MIN
-    while k_cols < k_max:
-        k_cols *= 2
-    k_cols = min(k_cols, K_MAX)
+    k_cols = k_cols_for(np.asarray(cand.k) if k_host is None else k_host)
     return size_batch(cand,
                       jnp.asarray(target_ttft_ms, jnp.float32),
                       jnp.asarray(target_itl_ms, jnp.float32),

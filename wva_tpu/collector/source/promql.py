@@ -120,13 +120,17 @@ class SeriesWindow:
     single sample. Supports ``len``/indexing/iteration yielding
     :class:`Sample` for compatibility with list-of-samples consumers."""
 
-    __slots__ = ("ts", "vals", "lo", "hi")
+    __slots__ = ("ts", "vals", "lo", "hi", "series")
 
-    def __init__(self, ts, vals, lo: int, hi: int) -> None:
+    def __init__(self, ts, vals, lo: int, hi: int, series=None) -> None:
         self.ts = ts
         self.vals = vals
         self.lo = lo
         self.hi = hi
+        # Backing _Series (non-legacy reads only): the anchor for the
+        # delta-maintained range-function memo. None on legacy windows
+        # and sub-windows of anonymous callers — evaluation then scans.
+        self.series = series
 
     def __len__(self) -> int:
         return self.hi - self.lo
@@ -154,7 +158,7 @@ class SeriesWindow:
         (bisect-sliced; no samples are touched)."""
         i = bisect_left(self.ts, lo_ts, self.lo, self.hi)
         j = bisect_right(self.ts, hi_ts, self.lo, self.hi)
-        return SeriesWindow(self.ts, self.vals, i, j)
+        return SeriesWindow(self.ts, self.vals, i, j, series=self.series)
 
 
 class _Series:
@@ -173,7 +177,7 @@ class _Series:
     (:class:`TrackMeta`) prove a query over X evaluates identically."""
 
     __slots__ = ("labels", "ts", "vals", "start", "last_ts",
-                 "write_version")
+                 "write_version", "range_memo")
 
     def __init__(self, labels: dict[str, str]) -> None:
         self.labels = labels
@@ -182,6 +186,14 @@ class _Series:
         self.start = 0
         self.last_ts = float("-inf")
         self.write_version = 0
+        # Delta-maintained range-function accumulators, keyed by
+        # (func, window_len): (ts array ref, lo, hi, accumulator,
+        # result). See _apply_range_func_delta — the rolling state that
+        # makes a quiet series' rate/*_over_time evaluation free and a
+        # live series' evaluation O(new samples) instead of O(window).
+        # Entries are immutable tuples replaced atomically (GIL), so
+        # concurrent readers race benignly.
+        self.range_memo: dict[tuple, tuple] = {}
 
     def last_value_changed(self, value: float) -> bool:
         """Would appending ``value`` change this series' latest value?
@@ -262,6 +274,17 @@ class TimeSeriesDB:
         #   pre-change cost, not an already-optimized substrate.
         self.use_name_index = True
         self.legacy_reads = False
+        # Delta-maintained range evaluation (ROADMAP item 1a): per-series
+        # rolling accumulators make rate/*_over_time free for unchanged
+        # windows and O(new samples) for appended ones, byte-identical to
+        # the scanning evaluator (tests/test_promql.py). Off restores the
+        # per-eval window scan.
+        self.delta_range_eval = True
+        # Introspection for the equality/cost tests: full window folds vs
+        # suffix extensions vs memo hits since process start.
+        self.range_scans = 0
+        self.range_extends = 0
+        self.range_hits = 0
 
     @staticmethod
     def _key(name: str, labels: dict[str, str]) -> tuple:
@@ -450,7 +473,8 @@ class TimeSeriesDB:
                        for lbl, op, val in matchers):
                 continue
             with self._lock_for(key):
-                window = SeriesWindow(s.ts, s.vals, s.start, len(s.ts))
+                window = SeriesWindow(s.ts, s.vals, s.start, len(s.ts),
+                                      series=s)
             out.append((labels, window))
         return out
 
@@ -917,7 +941,12 @@ class PromQLEngine:
                     self._track_excluded()
                     continue
                 self._track_range(call.func, in_window, window_len)
-                val = _apply_range_func(call.func, in_window, window_len)
+                if self.db.delta_range_eval:
+                    val = _apply_range_func_delta(call.func, in_window,
+                                                  window_len, self.db)
+                else:
+                    val = _apply_range_func(call.func, in_window,
+                                            window_len)
                 last_ts = in_window.ts[in_window.hi - 1]
             if val is None:
                 self._track_excluded()
@@ -986,6 +1015,126 @@ class PromQLEngine:
                 out.append(SeriesPoint(p.labels, p.value / match.value, p.timestamp))
             return out
         raise PromQLError(f"unknown binary op {node.op!r}")
+
+
+def _fold_range_acc(func: str, vals, lo: int, hi: int) -> float:
+    """Left fold of the range function's accumulator over ``[lo, hi)`` —
+    operation-for-operation the same fold the scanning evaluator runs
+    (sum / running max / positive-delta total), so a fold extended over
+    an appended suffix is bitwise the fold recomputed from scratch."""
+    if func == "max_over_time":
+        m = vals[lo]
+        for i in range(lo + 1, hi):
+            v = vals[i]
+            if v > m:
+                m = v
+        return m
+    if func == "avg_over_time":
+        total = 0.0
+        for i in range(lo, hi):
+            total += vals[i]
+        return total
+    # rate / increase: positive-delta accumulation with counter-reset
+    # handling, exactly _apply_range_func's loop.
+    total = 0.0
+    prev = vals[lo]
+    for i in range(lo + 1, hi):
+        v = vals[i]
+        delta = v - prev
+        total += delta if delta >= 0 else v
+        prev = v
+    return total
+
+
+def _extend_range_acc(func: str, vals, m_hi: int, hi: int,
+                      acc: float) -> float:
+    """Continue the fold from a memoized prefix ``[lo, m_hi)`` over the
+    appended suffix ``[m_hi, hi)``. A left fold's partial result plus the
+    remaining terms in order IS the full fold — no re-association, so
+    the extension is exact (the byte-equality the lever test asserts)."""
+    if func == "max_over_time":
+        m = acc
+        for i in range(m_hi, hi):
+            v = vals[i]
+            if v > m:
+                m = v
+        return m
+    if func == "avg_over_time":
+        total = acc
+        for i in range(m_hi, hi):
+            total += vals[i]
+        return total
+    total = acc
+    prev = vals[m_hi - 1]
+    for i in range(m_hi, hi):
+        v = vals[i]
+        delta = v - prev
+        total += delta if delta >= 0 else v
+        prev = v
+    return total
+
+
+def _range_result(func: str, acc: float, ts, lo: int, hi: int,
+                  window_len: float) -> float | None:
+    """Finish a range function from its accumulator: O(1) — everything
+    else the scanning evaluator derives comes from the window's first/
+    last timestamps and the sample count."""
+    if func == "max_over_time":
+        return acc
+    if func == "avg_over_time":
+        return acc / (hi - lo)
+    if hi - lo < 2:
+        return None
+    span = ts[hi - 1] - ts[lo]
+    if span <= 0:
+        return None
+    window_start = ts[hi - 1] - window_len
+    interval = span / (hi - lo - 1)
+    limit = interval * 1.1
+    extend_start = min(max(ts[lo] - window_start, 0.0), limit)
+    scaled = acc * ((span + extend_start) / span)
+    return scaled / window_len if func == "rate" else scaled
+
+
+def _apply_range_func_delta(func: str, window: SeriesWindow,
+                            window_len: float, db: TimeSeriesDB
+                            ) -> float | None:
+    """Delta-maintained twin of :func:`_apply_range_func` (ROADMAP item
+    1a): per-(series, func, window) rolling accumulators keyed to the in-
+    window sample set. An unchanged window (quiet series) returns the
+    memoized result with zero fold work; an appended window extends the
+    fold over only the new samples; a window whose LEFT edge moved
+    (samples expired out) rescans — the left fold cannot be un-folded
+    exactly, and byte-equality with the scanning evaluator is the
+    contract. The memo anchors on the backing array OBJECT (compaction
+    replaces arrays, so a replaced ring can never alias a stale memo),
+    holding the old array alive at most until the next evaluation
+    refreshes the entry. Counters (range_hits/extends/scans) are test
+    introspection, not synchronized."""
+    s = window.series
+    if s is None:
+        db.range_scans += 1
+        return _apply_range_func(func, window, window_len)
+    ts, vals, lo, hi = window.ts, window.vals, window.lo, window.hi
+    key = (func, window_len)
+    memo = s.range_memo.get(key)
+    acc = None
+    if memo is not None and memo[0] is ts and memo[1] == lo:
+        _ref, _lo, m_hi, m_acc, m_val = memo
+        if m_hi == hi:
+            db.range_hits += 1
+            return m_val
+        if hi > m_hi:
+            db.range_extends += 1
+            acc = _extend_range_acc(func, vals, m_hi, hi, m_acc)
+    if acc is None:
+        db.range_scans += 1
+        acc = _fold_range_acc(func, vals, lo, hi)
+    val = _range_result(func, acc, ts, lo, hi, window_len)
+    if len(s.range_memo) >= 16:  # bound pathological window_len churn
+        s.range_memo.clear()
+    s.range_memo[key] = (ts, lo, hi, acc, val)
+    return val
 
 
 def _apply_range_func(func: str, window: SeriesWindow,

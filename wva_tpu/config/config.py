@@ -93,6 +93,14 @@ class InfrastructureConfig:
     # objects instead of deep copies. Off restores copy-on-read —
     # byte-identical decisions, pre-change CPU cost.
     zero_copy: bool = True
+    # One-jitted-program decision plane (WVA_FUSED / wva.fused, default
+    # on; docs/design/fused-plane.md): the SLO path's sizing bisections,
+    # forecast fits, and trusted-forecast selection fuse into ONE device
+    # dispatch per tick on fixed padded grids (per-model dynamics as mask
+    # columns), reused by the fleet solve and the limiter's masked grant
+    # pass. Off restores the staged per-stage dispatches — byte-identical
+    # statuses and trace cycles (same discipline as WVA_FP_DELTA=off).
+    fused: bool = True
 
 
 @dataclass
@@ -382,6 +390,10 @@ class Config:
     def zero_copy_enabled(self) -> bool:
         with self._mu:
             return self.infrastructure.zero_copy
+
+    def fused_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.fused
 
     def mutation_epoch(self) -> int:
         """Monotonic counter bumped by every hot-reloadable config update.

@@ -160,6 +160,11 @@ DEFAULTS: dict[str, Any] = {
     # return frozen shared objects. Off restores deep-copy-on-read
     # (byte-identical decisions; emergency lever).
     "WVA_ZERO_COPY": True,
+    # One-jitted-program decision plane (docs/design/fused-plane.md): the
+    # SLO path's sizing + forecast fits + trusted-forecast selection run
+    # as ONE device dispatch per tick. Off restores the staged per-stage
+    # dispatches (byte-identical statuses and traces).
+    "WVA_FUSED": True,
     # GET /api/v1/query instead of POST (read-only proxies).
     "PROMETHEUS_USE_GET_QUERIES": False,
 }
@@ -268,6 +273,7 @@ def load(flags: Mapping[str, Any] | None = None,
         fp_delta=r.get_bool("WVA_FP_DELTA"),
         fp_assert=r.get_bool("WVA_FP_ASSERT"),
         zero_copy=r.get_bool("WVA_ZERO_COPY"),
+        fused=r.get_bool("WVA_FUSED"),
     )
     cfg.tls = TLSConfig(
         webhook_cert_path=r.get_str("WEBHOOK_CERT_PATH"),

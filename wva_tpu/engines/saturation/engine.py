@@ -440,6 +440,21 @@ class SaturationEngine:
         self.tick_snapshot_enabled = True
         self.solver_batching = True
         self.grouped_collection = True
+        # One-jitted-program decision plane (WVA_FUSED, default on;
+        # docs/design/fused-plane.md): on the SLO path, the tick's whole
+        # numeric pipeline — every model's queueing-solve sizing, every
+        # model's forecast fit, and the trusted-forecast selection — runs
+        # as ONE device dispatch on fixed padded grids, with per-model
+        # dynamics as mask columns and one host transfer of the result
+        # arrays; the fleet solve and the limiter's masked grant pass
+        # reuse it. Off restores the staged per-stage dispatches
+        # (byte-identical statuses AND trace cycles, tested like
+        # WVA_FP_DELTA=off).
+        self.fused_enabled = True
+        # The fused dispatch's per-(model, ns, accelerator) sized rates,
+        # reused by this tick's fleet solve (_optimize_global) instead of
+        # a second sizing dispatch. Tick-scoped; None = staged sizing.
+        self._tick_presized: dict | None = None
         # Dirty-set incremental ticks (docs/design/informer.md): a per-model
         # input fingerprint (VA generations/labels, scale-target state, pod
         # set, this tick's grouped metric slices, config epoch) gates
@@ -640,6 +655,10 @@ class SaturationEngine:
         # (no active VAs, V2 with zero requests) must leave the capacity
         # pass on fresh discovery, never a previous tick's snapshot.
         self._tick_slices = None
+        # Tick-scoped: the fused dispatch's sized pairs for the fleet
+        # solve. Reset here so a failed/absent fused pass never leaves a
+        # previous tick's rates for _optimize_global to consume.
+        self._tick_presized = None
         # Informer staleness backstop: re-LIST any kind whose last list is
         # older than the resync interval (no-op on non-informer clients).
         resync = getattr(self.client, "resync_if_stale", None)
@@ -1774,6 +1793,7 @@ class SaturationEngine:
         # back out in the same sorted order they were concatenated.
         sized: dict[str, list[float]] = {}
         sizing_errors: dict[str, Exception] = {}
+        fused_prep = None
         if use_slo:
             # Worker outcome shape: ("ok", (data, sat_cfg, scheduler_queue,
             # SizingPlan)) — name the plans once instead of reaching through
@@ -1783,7 +1803,35 @@ class SaturationEngine:
             batch_keys = [k for k in sorted(plans)
                           if plans[k].needs_sizing]
             batched_ok = False
-            if self.solver_batching and batch_keys:
+            if self.fused_enabled and batch_keys:
+                # One-jitted-program decision plane (WVA_FUSED): sizing +
+                # forecast fits in ONE dispatch; the fleet solve below
+                # reuses the sized pairs. Grid build and dispatch degrade
+                # separately: a dispatch failure KEEPS the prepared
+                # forecast pass (whose learning mutations already ran) so
+                # the staged fallback fits over the prepared grids
+                # instead of re-observing this tick's demand — the
+                # degradation path stays byte-identical to WVA_FUSED=off.
+                grids = None
+                try:
+                    grids, fused_prep = self._fused_prepare(
+                        plans, batch_keys, outcomes, slo_cfg_by_ns)
+                except Exception as e:  # noqa: BLE001 — the lever must
+                    # degrade to the staged path, never fail the tick.
+                    log.warning("Fused grid build failed (%s); staged "
+                                "dispatches this tick", e)
+                    fused_prep = None
+                if grids is not None:
+                    try:
+                        sized = self._fused_dispatch(grids, fused_prep)
+                        batched_ok = True
+                    except Exception as e:  # noqa: BLE001 — same.
+                        log.warning("Fused decision program failed (%s); "
+                                    "falling back to staged dispatches",
+                                    e)
+                        sized = {}
+                        self._tick_presized = None
+            if not batched_ok and self.solver_batching and batch_keys:
                 all_candidates = [c for k in batch_keys
                                   for c in plans[k].candidates]
                 try:
@@ -1951,7 +1999,8 @@ class SaturationEngine:
         self._apply_forecast(
             requests, decisions, routes,
             active_keys={(vas[0].spec.model_id, vas[0].metadata.namespace)
-                         for vas in model_groups.values()})
+                         for vas in model_groups.values()},
+            prepared=fused_prep)
 
         # Memoize each analyzed model's PRE-limiter decisions (with their
         # enforcement + forecast floors baked in) for heartbeat re-emission,
@@ -2298,7 +2347,7 @@ class SaturationEngine:
                         decisions: list[VariantDecision],
                         routes: dict[tuple[str, str], str] | None = None,
                         active_keys: set[tuple[str, str]] | None = None,
-                        ) -> None:
+                        prepared=None) -> None:
         """Predictive planning stage (V2/SLO paths): feed the planner this
         tick's demand + variant states, fit every model's forecasters in
         one batched call, and raise proactive floors on the decisions.
@@ -2318,15 +2367,25 @@ class SaturationEngine:
             # still be pruned (the sweep below).
             self._sweep_forecast_gauges(set(), active_keys or set())
             return
-        now = self.clock.now()
+        # Fused path: the planner's learning pass already ran (and the
+        # fits rode the tick's one dispatch) at the prepared timestamp —
+        # the planning loop must score/stamp against the same instant.
+        now = prepared.now if prepared is not None else self.clock.now()
         # Models routed through the fleet-wide global optimizer still get
         # the planner's learning pass (history, lead times, backtests) but
         # never a floor: the solver deliberately starves low-priority
         # models on constrained pools and sequences migrations — a
-        # per-model floor would fight both.
-        no_floor = frozenset(
-            f"{ns}|{model}" for (model, ns), route in (routes or {}).items()
-            if route == "global")
+        # per-model floor would fight both. On fused ticks the set IS the
+        # grid's global-routed mask column (same predicate over the same
+        # models; it may additionally cover a model whose finalize failed
+        # — that model has no plan, so the extra key is inert).
+        if prepared is not None:
+            no_floor = prepared.global_no_floor
+        else:
+            no_floor = frozenset(
+                f"{ns}|{model}"
+                for (model, ns), route in (routes or {}).items()
+                if route == "global")
         # Blacked-out models get the planner's learning pass but never a
         # floor: a floor is a capacity CHANGE, and blackout means no
         # trusted input justifies changing anything (the health gate would
@@ -2334,7 +2393,8 @@ class SaturationEngine:
         no_floor = no_floor | self._blackout_keys()
         try:
             plans, floors = self.forecast.plan(requests, now,
-                                               no_floor_keys=no_floor)
+                                               no_floor_keys=no_floor,
+                                               prepared=prepared)
         except Exception as e:  # noqa: BLE001 — forecasting must never
             # fail a tick: reactive decisions stand as computed.
             log.error("Forecast planning failed, staying reactive: %s", e)
@@ -2657,7 +2717,17 @@ class SaturationEngine:
             service_classes=service_classes,
             profiles=self.slo_analyzer.profiles,
             capacity_chips=capacity_chips)
-        solution = solve(system, spec)
+        # Fused tick: every (model, accelerator) pair was already sized
+        # inside the tick's one dispatch — the solve reuses those rates
+        # instead of re-dispatching (bitwise-identical sizing; see
+        # fleet.allocation.build_candidates). None on staged ticks and on
+        # the sharded fleet role (the workers sized their partitions) —
+        # passed positionally-optional so test doubles of solve() keep
+        # their two-argument shape.
+        if self._tick_presized:
+            solution = solve(system, spec, presized=self._tick_presized)
+        else:
+            solution = solve(system, spec)
         return self._allocations_to_decisions(req_by_server, solution)
 
     def _allocations_to_decisions(self, req_by_server, solution):
@@ -2777,6 +2847,83 @@ class SaturationEngine:
         self._migration_holds = {
             k: v for k, v in self._migration_holds.items() if k in active_holds}
         return decisions
+
+    def _fused_prepare(self, plans: dict, batch_keys: list[str],
+                       outcomes: dict, slo_cfg_by_ns: dict):
+        """The one-jitted-program decision plane's grid build (WVA_FUSED;
+        docs/design/fused-plane.md).
+
+        Lays the tick out on fixed grids — the candidate axis exactly as
+        ``size_candidates`` would batch it, the model axis from the
+        forecast planner's prepared pass (demand observation, idle
+        eviction, grid resampling, backtest scoring, trust selection all
+        run BEFORE the dispatch; every input is prepare-stage data) with
+        the per-model dynamics as mask columns. The entries the planner
+        mutates on are built FIRST, so a lookup failure here degrades to
+        the staged path before any planner state moved.
+
+        Returns ``(FleetGrids, PreparedTick | None)``. The global-routed
+        mask column becomes the prepared tick's no-floor partition (the
+        set ``_apply_forecast`` would otherwise derive per-model from
+        routes); tuner/zero columns describe the remaining dynamics and
+        are asserted against the world by the property tests."""
+        from wva_tpu import fused
+
+        prep = None
+        if self.forecast is not None:
+            now = self.clock.now()
+            entries = []
+            by_pkey = {}
+            for key in batch_keys:
+                data, sat_cfg, _sq, plan = outcomes[key][1]
+                entries.append((plan.input.namespace, plan.input.model_id,
+                                self.slo_analyzer.plan_demand(plan),
+                                data.variant_states))
+                by_pkey[self.forecast.key_for(
+                    plan.input.namespace, plan.input.model_id)] = (
+                        data, sat_cfg, plan)
+            prep = self.forecast.prepare_tick(entries, now)
+        grids = fused.FleetGrids()
+        fused.build_candidate_axis(grids, plans, batch_keys)
+        if prep is not None:
+            global_routed, tuner_enabled, zero = [], [], []
+            for pkey in prep.keys:
+                data, sat_cfg, plan = by_pkey[pkey]
+                global_routed.append(sat_cfg.optimizer_name == "global")
+                slo_cfg = slo_cfg_by_ns.get(plan.input.namespace)
+                tuner_enabled.append(bool(
+                    slo_cfg is not None
+                    and getattr(slo_cfg, "tuner_enabled", False)))
+                # Zero READY supply: scaled to zero with lingering
+                # telemetry, or freshly waking with every replica still
+                # provisioning — a FULLY scaled-to-zero model without
+                # metrics never reaches sizing at all (skip path).
+                zero.append(not any(vs.ready_replicas > 0
+                                    for vs in data.variant_states))
+            fused.build_model_axis(
+                grids, prep.grids, prep.keys, prep.trust_idx,
+                prep.trusted, global_routed, tuner_enabled, zero)
+            prep.global_no_floor = frozenset(
+                k for k, g in zip(prep.keys, global_routed) if g)
+        return grids, prep
+
+    def _fused_dispatch(self, grids, prep) -> dict[str, list[float]]:
+        """Run the fused program: ONE jitted dispatch computing every
+        candidate's sizing bisection and every model's forecaster fits,
+        one host transfer. Fills the prepared tick's fits/chosen and
+        stashes the per-(model, ns, accelerator) sized pairs for this
+        tick's fleet solve. All downstream host stages (finalize,
+        optimizer, enforcer, floors, limiter) consume bitwise the values
+        the staged dispatches produce — what keeps WVA_FUSED=off
+        byte-identical."""
+        from wva_tpu import fused
+
+        result = fused.run(grids)
+        if prep is not None:
+            prep.fits = result.fits
+            prep.chosen = result.chosen
+        self._tick_presized = result.presized
+        return result.per_replica
 
     def _prepare_slo_plan(self, model_id: str, namespace: str, data: _ModelData,
                           sat_cfg: SaturationScalingConfig, slo_cfg,

@@ -82,10 +82,14 @@ class _Entry:
         return self.candidates[min(self.cur_index, len(self.candidates) - 1)]
 
 
-def solve(system: FleetSystem, spec: SolverSpec | None = None) -> Solution:
-    """Compute desired allocations for every server (reference solver.go:32-59)."""
+def solve(system: FleetSystem, spec: SolverSpec | None = None,
+          presized: dict | None = None) -> Solution:
+    """Compute desired allocations for every server (reference
+    solver.go:32-59). ``presized`` — the fused decision plane's per-pair
+    sizing, passed through to :func:`build_candidates` so a fused tick's
+    fleet solve re-dispatches nothing."""
     spec = spec or SolverSpec()
-    candidates = build_candidates(system)
+    candidates = build_candidates(system, presized=presized)
 
     entries: list[_Entry] = []
     for name in sorted(candidates):

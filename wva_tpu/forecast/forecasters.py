@@ -219,6 +219,9 @@ def fit_batch(grids: list[SeriesGrids]) -> list[dict[str, float]]:
     batch composition (asserted batched == serial by the test suite)."""
     if not grids:
         return []
+    from wva_tpu.utils import dispatch
+
+    dispatch.note()
     m = _bucket(len(grids))
 
     def pad(vals, fill=0.0):

@@ -89,6 +89,37 @@ class _Pending:
     forecasts: dict[str, float]
 
 
+@dataclass
+class PreparedTick:
+    """The planning pass split at the device boundary (the fused decision
+    plane, docs/design/fused-plane.md): everything :meth:`plan` does
+    BEFORE the forecaster fit — demand/variant observation, idle
+    eviction, grid resampling, backtest scoring, trust selection — done
+    up front so the fit itself can ride the tick's ONE fused dispatch.
+    The engine fills ``fits``/``chosen`` from the fused result and hands
+    the whole object back to :meth:`plan`, which then runs the same
+    per-model planning loop the staged path runs.
+
+    ``trust_idx``/``trusted`` are the model axis's mask columns: the
+    selected forecaster's registry index (UNTRUSTED = no forecaster past
+    the gate; the program gathers the linear floor) and whether its
+    rolling error clears the demotion threshold.
+    """
+
+    now: float = 0.0
+    keys: list[str] = field(default_factory=list)
+    grids: list = field(default_factory=list)
+    horizons: list[tuple[float, bool]] = field(default_factory=list)
+    trust_idx: list[int] = field(default_factory=list)
+    trusted: list[bool] = field(default_factory=list)
+    fits: list[dict[str, float]] | None = None
+    chosen: list[float] | None = None
+    # The global-routed mask column as the engine's no-floor partition:
+    # keys of models the fleet-wide solver owns (a per-model floor would
+    # fight its deliberate starvation/migration sequencing).
+    global_no_floor: frozenset = frozenset()
+
+
 class CapacityPlanner:
     """Thread-safe predictive planner; one instance per engine."""
 
@@ -180,8 +211,54 @@ class CapacityPlanner:
 
     # -- planning --
 
+    def prepare_tick(self, entries, now: float) -> PreparedTick:
+        """Everything :meth:`plan` does before the forecaster fit, for
+        the fused decision plane. ``entries`` are ``(namespace,
+        model_id, demand, variant_states)`` tuples for the models that
+        will produce scaling requests this tick; they are processed in
+        the exact (namespace, model_id) order ``plan`` sorts requests
+        into, so the planner's learned state (history rings, lead-time
+        samples — including the shared per-accelerator fallback rings —
+        idle eviction, backtest scores) evolves byte-identically to the
+        staged pass.
+
+        Backtest scoring and trust selection run here too: scoring
+        depends only on history + pending entries (all pre-fit state),
+        nothing matures between this call and the per-model planning
+        loop within one tick, and ``_plan_model``'s own scoring call is
+        then a no-op — which is what makes the trust-index column the
+        device gather reads agree with the host's trust rule.
+
+        Caveat: if a model observed here never reaches :meth:`plan`
+        (a downstream per-model failure), its demand sample and scores
+        stay — one extra history point on an abnormal path."""
+        ordered = sorted(entries, key=lambda e: (e[0], e[1]))
+        prep = PreparedTick(now=now)
+        for ns, model, demand, variant_states in ordered:
+            key = self.key_for(ns, model)
+            self.observe_demand(ns, model, now, demand)
+            self.observe_variants(ns, model, variant_states, now)
+            prep.keys.append(key)
+        self._evict_dead_keys(now)
+        for key in prep.keys:
+            lead, measured = self._estimate_lead(key)
+            prep.grids.append(self._grids_for(key, now, lead))
+            prep.horizons.append((lead, measured))
+            with self._mu:
+                self._score_matured(key, now)
+                best, best_err, _ = self._best_trusted_locked(key)
+            if best is None:
+                prep.trust_idx.append(-1)
+                prep.trusted.append(False)
+            else:
+                prep.trust_idx.append(fc.FORECASTERS.index(best))
+                prep.trusted.append(
+                    best_err <= self.demote_error_threshold)
+        return prep
+
     def plan(self, requests, now: float,
-             no_floor_keys: frozenset[str] = frozenset()
+             no_floor_keys: frozenset[str] = frozenset(),
+             prepared: PreparedTick | None = None,
              ) -> tuple[list[ForecastPlan], list[dict]]:
         """One planning pass over this tick's models. ``requests`` are the
         engine's :class:`ModelScalingRequest`s (result + variant states).
@@ -192,34 +269,71 @@ class CapacityPlanner:
         (the fleet-wide global optimizer deliberately starves low-priority
         models on constrained pools; a per-model floor would fight that
         assignment). They still get the full learning pass (history,
-        lead times, backtest scoring) — only the floor is withheld."""
-        reqs = sorted(requests, key=lambda r: (r.namespace, r.model_id))
-        keyed = []
-        for req in reqs:
-            if req.result is None:
-                continue
-            key = self.key_for(req.namespace, req.model_id)
-            self.observe_demand(req.namespace, req.model_id, now,
-                                req.result.total_demand)
-            self.observe_variants(req.namespace, req.model_id,
-                                  req.variant_states, now)
-            keyed.append((key, req))
-        self._evict_dead_keys(now)
+        lead times, backtest scoring) — only the floor is withheld.
 
-        grids, horizons = [], []
-        for key, req in keyed:
-            lead, measured = self._estimate_lead(key)
-            grids.append(self._grids_for(key, now, lead))
-            horizons.append((lead, measured))
-        fits = (fc.fit_batch([g for g in grids]) if self.batched
-                else fc.fit_serial([g for g in grids]))
+        ``prepared`` — a :class:`PreparedTick` from :meth:`prepare_tick`.
+        The learning pass (observation, eviction, scoring) already ran,
+        so it must NOT run again: requests are matched to prepared rows
+        by key (a downstream per-model failure may have dropped some —
+        the surviving subset reuses its rows; row-independent fits make
+        the subset bitwise what a fresh fit would produce). When the
+        fused dispatch failed, ``prepared.fits`` is None and the fit
+        runs here as its own (staged) dispatch over the prepared grids —
+        the degradation path stays byte-identical to WVA_FUSED=off.
+        Only a request whose key was never prepared (should not happen)
+        forces the full staged pass, which re-observes — a benign
+        duplicate on an already-abnormal path."""
+        reqs = sorted(requests, key=lambda r: (r.namespace, r.model_id))
+        live_reqs = [r for r in reqs if r.result is not None]
+        if prepared is not None:
+            req_keys = [self.key_for(r.namespace, r.model_id)
+                        for r in live_reqs]
+            if not set(req_keys) <= set(prepared.keys):
+                prepared = None
+        chosen: list[float] | None = None
+        if prepared is not None:
+            rows = {k: i for i, k in enumerate(prepared.keys)}
+            idx = [rows[k] for k in req_keys]
+            keyed = list(zip(req_keys, live_reqs))
+            grids = [prepared.grids[i] for i in idx]
+            horizons = [prepared.horizons[i] for i in idx]
+            if prepared.fits is not None:
+                fits = [prepared.fits[i] for i in idx]
+                chosen = ([prepared.chosen[i] for i in idx]
+                          if prepared.chosen is not None else None)
+            else:
+                fits = (fc.fit_batch(grids) if self.batched
+                        else fc.fit_serial(grids))
+        else:
+            keyed = []
+            for req in reqs:
+                if req.result is None:
+                    continue
+                key = self.key_for(req.namespace, req.model_id)
+                self.observe_demand(req.namespace, req.model_id, now,
+                                    req.result.total_demand)
+                self.observe_variants(req.namespace, req.model_id,
+                                      req.variant_states, now)
+                keyed.append((key, req))
+            self._evict_dead_keys(now)
+
+            grids, horizons = [], []
+            for key, req in keyed:
+                lead, measured = self._estimate_lead(key)
+                grids.append(self._grids_for(key, now, lead))
+                horizons.append((lead, measured))
+            fits = (fc.fit_batch([g for g in grids]) if self.batched
+                    else fc.fit_serial([g for g in grids]))
 
         plans: list[ForecastPlan] = []
         floors: list[dict] = []
-        for (key, req), grid, fit, (lead, measured) in zip(
-                keyed, grids, fits, horizons):
+        for i, ((key, req), grid, fit, (lead, measured)) in enumerate(zip(
+                keyed, grids, fits, horizons)):
             plan = self._plan_model(key, req, fit, lead, measured, now,
-                                    floor_allowed=key not in no_floor_keys)
+                                    floor_allowed=key not in no_floor_keys,
+                                    forecast_value=(
+                                        chosen[i] if chosen is not None
+                                        else None))
             plans.append(plan)
             if plan.floor_replicas > 0 and plan.variant_name:
                 floors.append({
@@ -233,7 +347,8 @@ class CapacityPlanner:
 
     def _plan_model(self, key: str, req, fit: dict[str, float],
                     lead: float, measured: bool, now: float,
-                    floor_allowed: bool = True) -> ForecastPlan:
+                    floor_allowed: bool = True,
+                    forecast_value: float | None = None) -> ForecastPlan:
         demand = max(req.result.total_demand, 0.0)
         plan = ForecastPlan(
             model_id=req.model_id, namespace=req.namespace, demand=demand,
@@ -249,14 +364,20 @@ class CapacityPlanner:
                 plan.errors[name] = round(err, 6)
                 plan.evals[name] = evals
             best, best_err, best_evals = self._best_trusted_locked(key)
+        # The fused plane's device gather already selected this model's
+        # forecast through the trust-index column; the gathered value is
+        # bitwise the registry array element the staged reads below pick
+        # (same device array), so either source yields the same plan.
+        if forecast_value is None:
+            forecast_value = fit[best if best is not None else "linear"]
         if best is None:
             plan.forecaster = "linear"  # floor of the registry, untrusted
-            plan.forecast_demand = fit["linear"]
+            plan.forecast_demand = forecast_value
             plan.reason = (f"forecast untrusted ({self.min_trust_evals} "
                            "scored backtests required); reactive")
         elif best_err > self.demote_error_threshold:
             plan.forecaster = best
-            plan.forecast_demand = fit[best]
+            plan.forecast_demand = forecast_value
             plan.demoted = True
             plan.reason = (f"forecast demoted: best rolling error "
                            f"{best_err:.2f} > "
@@ -264,7 +385,7 @@ class CapacityPlanner:
         else:
             plan.trusted = True
             plan.forecaster = best
-            plan.forecast_demand = fit[best]
+            plan.forecast_demand = forecast_value
             if floor_allowed:
                 self._maybe_floor(plan, req, best_evals)
             else:

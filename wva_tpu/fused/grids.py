@@ -1,0 +1,171 @@
+"""Fixed-grid builders for the fused decision program.
+
+One tick's numeric inputs — every model's sizing candidates, forecast
+history grids, and per-model dynamics — are laid out as padded,
+shape-bucketed struct-of-arrays so the whole analyze phase compiles to a
+bounded set of XLA executables (docs/design/fused-plane.md):
+
+- **Candidate axis** ``[C]``: the concatenation of every sized model's
+  ``SizingPlan.candidates`` in sorted group-key order — byte-for-byte the
+  batch :meth:`QueueingModelAnalyzer.size_candidates` would build, with
+  the same power-of-two bucket (min 8) and the same state-axis trim
+  (``k_cols``), so fused and staged sizing are bitwise identical.
+- **Model axis** ``[M]``: the forecast planner's fine/long LOCF grids
+  (``fit_batch``'s exact padding: power-of-two bucket from 1) plus the
+  per-model dynamics as **mask columns** — tuner-enabled, global-routed,
+  forecast-trusted (with the trusted forecaster as an index column the
+  host gathers through), zero-ready-supply (scaled to zero with
+  lingering telemetry / still provisioning). Padded rows are fully
+  invalid and sliced off on the host.
+
+The bucket policy is the recompile bound: a model joining or leaving
+changes only the padding inside the current bucket, so the program
+compiles at most once per (candidate bucket, k_cols, model bucket)
+triple across any fleet-size trajectory (asserted by
+``tests/test_fused_plane.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from wva_tpu.analyzers.queueing.analyzer import build_sizing_batch
+from wva_tpu.analyzers.queueing.queue_model import (
+    K_MAX,
+    CandidateBatch,
+    k_cols_for,
+)
+from wva_tpu.forecast import forecasters as fc
+
+# Index column value for models with no trusted forecaster: the program
+# gathers the registry floor ("linear") for them — exactly the value the
+# planner's untrusted branch reports.
+UNTRUSTED = -1
+_LINEAR_IDX = fc.FORECASTERS.index("linear")
+
+
+def candidate_bucket(n: int) -> int:
+    """The sizing batch bucket: power of two, min 8 — the rule
+    ``build_sizing_batch`` applies (exposed for the recompile-guard
+    test's bucket arithmetic)."""
+    return max(8, 1 << (n - 1).bit_length()) if n else 8
+
+
+@dataclass
+class FleetGrids:
+    """One tick's padded device inputs + the host bookkeeping to slice
+    results back out."""
+
+    # -- candidate axis (sizing) --
+    cand: CandidateBatch | None = None
+    t_ttft: object = None  # [C_b] float32
+    t_itl: object = None
+    t_tps: object = None
+    n_candidates: int = 0
+    k_cols: int = K_MAX
+    # group_key -> (start, end) slice of the candidate axis.
+    cand_slices: dict[str, tuple[int, int]] = field(default_factory=dict)
+    # (model_id, namespace, accelerator) -> candidate row (first
+    # occurrence): the fleet solve's candidate builder reuses the fused
+    # sizing through this index instead of re-dispatching.
+    cand_index: dict[tuple[str, str, str], int] = field(default_factory=dict)
+
+    # -- model axis (forecast + mask columns) --
+    n_models: int = 0
+    m_bucket: int = 0
+    fine: object = None  # [M_b, N_GRID] float32
+    fine_valid: object = None  # [M_b]
+    long: object = None
+    long_valid: object = None
+    h_fine: object = None
+    h_long: object = None
+    season: object = None  # [M_b] int32
+    # Host int array [n_models]: the selected forecaster's registry
+    # index per model (UNTRUSTED rows carry the linear-floor index) —
+    # applied as one vectorized gather over the transferred fit stack.
+    trust_idx: object = None
+    model_keys: list[str] = field(default_factory=list)  # planner keys
+
+    # -- mask columns (host numpy, length n_models) — the per-model
+    # dynamics that used to be Python branches. trusted + trust_idx
+    # drive the forecast gather over the transferred fit stack;
+    # global_mask becomes the prepared tick's no-floor partition
+    # (PreparedTick.global_no_floor); tuner/zero describe the remaining
+    # dynamics and are asserted against the world by the property tests.
+    trusted_mask: object = None
+    global_mask: object = None
+    tuner_mask: object = None
+    zero_mask: object = None
+
+
+def build_candidate_axis(grids: FleetGrids, plans: dict, batch_keys) -> None:
+    """Fill the candidate axis from the sized plans, mirroring
+    ``size_candidates``'s padding byte-for-byte."""
+    order: list[tuple[str, object]] = []
+    for key in batch_keys:
+        start = len(order)
+        order.extend((key, c) for c in plans[key].candidates)
+        grids.cand_slices[key] = (start, len(order))
+    n = len(order)
+    grids.n_candidates = n
+    if not n:
+        return
+    # THE shared builder + trim rule (analyzers/queueing): the fused
+    # candidate axis is byte-for-byte the staged sizing batch.
+    (grids.cand, grids.t_ttft, grids.t_itl, grids.t_tps,
+     ks) = build_sizing_batch([c for _, c in order])
+    grids.k_cols = k_cols_for(ks)
+    for i, (key, c) in enumerate(order):
+        model, _, ns = key.rpartition("|")
+        grids.cand_index.setdefault((model, ns, c.accelerator), i)
+
+
+def build_model_axis(grids: FleetGrids, series: list[fc.SeriesGrids],
+                     model_keys: list[str], trust_idx: list[int],
+                     trusted, global_routed, tuner_enabled,
+                     scaled_to_zero) -> None:
+    """Fill the model axis from the planner's prepared grids, mirroring
+    ``fit_batch``'s padding byte-for-byte, plus the mask columns."""
+    grids.n_models = len(series)
+    grids.model_keys = list(model_keys)
+    grids.trusted_mask = np.asarray(trusted, dtype=bool)
+    grids.global_mask = np.asarray(global_routed, dtype=bool)
+    grids.tuner_mask = np.asarray(tuner_enabled, dtype=bool)
+    grids.zero_mask = np.asarray(scaled_to_zero, dtype=bool)
+    if not series:
+        return
+    m = 1
+    while m < len(series):
+        m *= 2
+    grids.m_bucket = m
+
+    def pad(vals, fill):
+        return vals + [fill] * (m - len(series))
+
+    grids.fine = jnp.asarray(
+        pad([g.fine for g in series], [0.0] * fc.N_GRID), jnp.float32)
+    grids.fine_valid = jnp.asarray(
+        pad([g.fine_valid for g in series], 0), jnp.float32)
+    grids.long = jnp.asarray(
+        pad([g.long for g in series], [0.0] * fc.N_GRID), jnp.float32)
+    grids.long_valid = jnp.asarray(
+        pad([g.long_valid for g in series], 0), jnp.float32)
+    grids.h_fine = jnp.asarray(
+        pad([g.h_fine_steps for g in series], 0.0), jnp.float32)
+    grids.h_long = jnp.asarray(
+        pad([g.h_long_steps for g in series], 0.0), jnp.float32)
+    grids.season = jnp.asarray(
+        pad([max(1, min(g.season_steps, fc.N_GRID)) for g in series], 1),
+        jnp.int32)
+    # The gather column: the trusted forecaster's registry index, or the
+    # linear floor for untrusted models (what the planner's untrusted
+    # branch reports as forecast_demand). Host-side: the gather runs
+    # over the TRANSFERRED fit stack — an in-program consumer of the fit
+    # arrays would perturb their bits via XLA multi-output fusion (see
+    # program._core).
+    grids.trust_idx = np.asarray(
+        [i if i >= 0 else _LINEAR_IDX for i in trust_idx],
+        dtype=np.int64)

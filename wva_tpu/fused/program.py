@@ -1,0 +1,144 @@
+"""The fused decision program: one jitted, donated device dispatch per
+tick for the whole numeric decision pipeline (docs/design/fused-plane.md).
+
+Composes the EXACT jitted subcomputations the staged path dispatches
+separately — ``size_batch`` (queueing solve, including its chunked
+``lax.map`` form and the Pallas kernel selection) and the forecaster
+registry's ``_fit_grid`` — inside one ``jax.jit``. jit-of-jit inlines
+the inner traces, so the fused program runs the same HLO subgraphs the
+staged dispatches compile; outputs are bitwise identical (asserted by
+``tests/test_fused_plane.py``), which is what lets ``WVA_FUSED`` flip
+with byte-identical statuses and trace cycles. The trusted-forecast
+selection (the trust-index mask column) runs as a vectorized gather
+over the transferred fit stack on the host — see :func:`_core` for why
+it must not consume the fit arrays in-program.
+
+Buffers are donated on TPU (every grid is rebuilt from host state each
+tick, so the previous tick's device buffers are dead the moment the next
+dispatch launches); donation is skipped on CPU where XLA does not
+implement it and would only warn.
+
+The one host transfer: a single ``jax.device_get`` of the full output
+pytree — sized candidate arrays, the four forecaster fits, and the
+gathered per-model chosen forecast.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import numpy as np
+
+from wva_tpu.analyzers.queueing.queue_model import size_batch
+from wva_tpu.forecast import forecasters as fc
+from wva_tpu.fused.grids import UNTRUSTED, FleetGrids
+from wva_tpu.utils import dispatch
+
+# Donation is a TPU/GPU win (grids are dead after the dispatch); XLA CPU
+# does not implement it and logs a warning per compile.
+_DONATE = tuple(range(11)) if jax.default_backend() == "tpu" else ()
+
+
+@partial(jax.jit, static_argnames=("k_cols", "m"),
+         donate_argnums=_DONATE)
+def _core(cand, t_ttft, t_itl, t_tps,
+          fine, fine_valid, long_vals, long_valid, h_fine, h_long,
+          season, k_cols: int, m: int):
+    """Sizing + forecast fits; the fused program.
+
+    The fit arrays are PURE outputs, deliberately unconsumed inside the
+    program: any in-program consumer (e.g. a trust-index gather) invites
+    XLA's multi-output fusion to re-schedule the fit reductions, which
+    perturbs float bits vs the staged ``_fit_grid`` dispatch — measured,
+    and an ``optimization_barrier`` does not prevent it. The
+    trusted-forecast selection therefore happens as a vectorized gather
+    over the transferred stack on the host (see :func:`run`), where
+    picking elements cannot perturb them."""
+    sized = size_batch(cand, t_ttft, t_itl, t_tps, k_cols=k_cols)
+    fits = fc._fit_grid(fine, fine_valid, long_vals, long_valid,
+                        h_fine, h_long, season, m=m)
+    return sized, fits
+
+
+@partial(jax.jit, static_argnames=("k_cols",),
+         donate_argnums=tuple(range(4)) if _DONATE else ())
+def _sizing_only(cand, t_ttft, t_itl, t_tps, k_cols: int):
+    """The forecast-less form (WVA_FORECAST=off): still one dispatch."""
+    return size_batch(cand, t_ttft, t_itl, t_tps, k_cols=k_cols)
+
+
+def program_cache_size() -> int:
+    """Compiled-executable count across both program forms — the
+    recompile-guard's instrument (one compile per padding bucket, ever)."""
+    return int(_core._cache_size() + _sizing_only._cache_size())
+
+
+@dataclass
+class FusedResult:
+    """Host-side view of one fused dispatch."""
+
+    # group_key -> per-replica SLO capacities (req/s), the exact list
+    # ``size_candidates`` would have returned for that model's plan.
+    per_replica: dict[str, list[float]] = field(default_factory=dict)
+    # (model_id, namespace, accelerator) -> sized row for the fleet
+    # solve's candidate builder (throughput at the binding rate).
+    presized: dict[tuple[str, str, str], float] = field(
+        default_factory=dict)
+    # Per-model forecaster fits + the gathered trusted forecast, in
+    # model-axis order (the planner's prepared-tick key order).
+    fits: list[dict[str, float]] = field(default_factory=list)
+    chosen: list[float] = field(default_factory=list)
+
+
+def run(grids: FleetGrids) -> FusedResult:
+    """Execute the fused program for one tick's grids: ONE device
+    dispatch, ONE host transfer."""
+    if grids.n_candidates == 0:
+        raise ValueError("fused program needs at least one candidate")
+    dispatch.note()
+    if grids.m_bucket:
+        sized, fits = _core(
+            grids.cand, grids.t_ttft, grids.t_itl, grids.t_tps,
+            grids.fine, grids.fine_valid, grids.long, grids.long_valid,
+            grids.h_fine, grids.h_long, grids.season,
+            k_cols=grids.k_cols, m=grids.m_bucket)
+        sized, fits = jax.device_get((sized, fits))
+    else:
+        sized = jax.device_get(_sizing_only(
+            grids.cand, grids.t_ttft, grids.t_itl, grids.t_tps,
+            k_cols=grids.k_cols))
+        fits = None
+
+    out = FusedResult()
+    n = grids.n_candidates
+    # Same conversion as the staged reads: float64 python lists built
+    # from the float32 device values (bit-preserving).
+    rates = np.asarray(sized["max_rate_per_s"][:n],
+                       dtype=np.float64).tolist()
+    throughput = np.asarray(sized["throughput_per_s"][:n]).tolist()
+    for key, (lo, hi) in grids.cand_slices.items():
+        out.per_replica[key] = rates[lo:hi]
+    for pair_key, idx in grids.cand_index.items():
+        out.presized[pair_key] = throughput[idx]
+    if fits is not None:
+        nm = grids.n_models
+        stack = np.stack([np.asarray(fits[name])[:nm]
+                          for name in fc.FORECASTERS])  # [F, nm]
+        host = {name: [float(x) for x in stack[f]]
+                for f, name in enumerate(fc.FORECASTERS)}
+        out.fits = [{name: host[name][i] for name in fc.FORECASTERS}
+                    for i in range(nm)]
+        # The trusted-forecast mask column: one vectorized gather over
+        # the transferred stack — each model's selected forecaster
+        # (trust index; the linear floor for untrusted rows, exactly
+        # what the planner's untrusted branch reports) picks its
+        # forecast. Element selection is bit-preserving, so the chosen
+        # value IS the plan's forecast_demand.
+        idx = np.asarray(grids.trust_idx[:nm], dtype=np.int64)
+        out.chosen = [float(x) for x in stack[idx, np.arange(nm)]]
+    return out
+
+
+__all__ = ["FusedResult", "run", "program_cache_size", "UNTRUSTED"]

@@ -467,6 +467,13 @@ def build_manager(
     engine.resync_ticks = config.resync_ticks()
     engine.fp_delta_enabled = config.fp_delta_enabled()
     engine.fp_assert = config.fp_assert_enabled()
+    # One-jitted-program decision plane (WVA_FUSED, default on;
+    # docs/design/fused-plane.md): one device dispatch per SLO tick, and
+    # the limiter's grant pass flips to the equivalent masked arithmetic.
+    engine.fused_enabled = config.fused_enabled()
+    if hasattr(limiter, "algorithm") and hasattr(limiter.algorithm,
+                                                 "vectorized"):
+        limiter.algorithm.vectorized = config.fused_enabled()
     # Sharded active-active engine (WVA_SHARDING, default off;
     # docs/design/sharding.md): N shard workers — each the existing
     # snapshot+analysis stack scoped to a consistent-hash partition under

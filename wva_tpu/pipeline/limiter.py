@@ -208,7 +208,24 @@ class _TypedSliceAllocator(ResourceAllocator):
 
 class GreedyBySaturation(AllocationAlgorithm):
     """Allocate to the most saturated variants first
-    (reference greedy_saturation_algorithm.go:34-106)."""
+    (reference greedy_saturation_algorithm.go:34-106).
+
+    Two equivalent implementations of the grant pass:
+
+    - **sequential** (default): one ``try_allocate`` round trip per
+      scale-up decision — the reference shape.
+    - **masked** (``vectorized = True``, set by the fused decision plane
+      WVA_FUSED): per-pool clamp arithmetic over the whole sorted
+      decision array at once. Greedy sequential consumption from a pool
+      is exactly ``grant_i = clip(avail - cum_prev_requests_i, 0,
+      req_i)`` — a cumulative sum plus masks, no per-decision branches.
+      Integer math, so the two forms are equal by construction
+      (property-asserted in tests/test_fused_plane.py).
+    """
+
+    # Flipped on by the fused decision plane (WVA_FUSED); default off so
+    # standalone Limiter users keep the reference shape.
+    vectorized = False
 
     def name(self) -> str:
         return "greedy-by-saturation"
@@ -219,8 +236,51 @@ class GreedyBySaturation(AllocationAlgorithm):
                       if d.target_replicas > d.current_replicas]
         # Most saturated first (lowest spare), then cheapest.
         candidates.sort(key=lambda d: (d.spare_capacity, d.cost))
+        if self.vectorized and isinstance(allocator, _TypedSliceAllocator):
+            self._allocate_masked(candidates, allocator)
+            return
         for d in candidates:
             self._allocate_for_decision(d, allocator)
+
+    @staticmethod
+    def _allocate_masked(candidates: list[VariantDecision],
+                         allocator: "_TypedSliceAllocator") -> None:
+        """The masked grant pass. For each pool, in the sorted decision
+        order: every decision before the exhaustion point receives its
+        full request, the decision at the exhaustion point receives the
+        remainder (the pool consumes the unusable sub-replica tail, as
+        the sequential allocator does), everything after receives 0."""
+        import numpy as np
+
+        if not candidates:
+            return
+        n = len(candidates)
+        chips_per = np.array(
+            [d.chips_per_replica if d.chips_per_replica > 0 else 1
+             for d in candidates], dtype=np.int64)
+        needed = np.array(
+            [d.target_replicas - d.current_replicas for d in candidates],
+            dtype=np.int64)
+        requested = needed * chips_per
+        grants = np.zeros(n, dtype=np.int64)
+        names = [d.accelerator_name for d in candidates]
+        for pool_name in dict.fromkeys(names):
+            pool = allocator._pools.get(pool_name)
+            if pool is None:
+                continue  # unknown variant: grant stays 0
+            mask = np.fromiter((nm == pool_name for nm in names),
+                               dtype=bool, count=n)
+            req = requested[mask]
+            cum_prev = np.concatenate(([0], np.cumsum(req)[:-1]))
+            granted = np.clip(pool.available - cum_prev, 0, req)
+            grants[mask] = granted
+            pool.used += int(granted.sum())
+        replicas = grants // chips_per
+        for d, r, need, cp in zip(candidates, replicas, needed, chips_per):
+            d.chips_allocated = int(r * cp)
+            d.target_replicas = d.current_replicas + int(r)
+            if r < need:
+                d.was_limited = True
 
     @staticmethod
     def _allocate_for_decision(d: VariantDecision,

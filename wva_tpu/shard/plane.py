@@ -377,6 +377,9 @@ def build_shard_plane(client, config, clock, collector, actuator,
         engine.resync_ticks = config.resync_ticks()
         engine.fp_delta_enabled = config.fp_delta_enabled()
         engine.fp_assert = config.fp_assert_enabled()
+        # Each worker fuses its own partition's analyze phase into one
+        # dispatch (the fleet role never sizes — workers ship results).
+        engine.fused_enabled = config.fused_enabled()
         return ShardWorker(shard_id, engine)
 
     workers = {i: make_worker(i) for i in range(shard_cfg.shards)}
