@@ -99,6 +99,10 @@ bench-failover: ## Crash-restart + leader-flap storm (48 models, two managers ov
 bench-shard: ## Sharded active-active engine bench (480-model world, 4 consistent-hash shards over one FakeCluster): asserts fleet decisions byte-identical to the unsharded engine, per-shard quiet-tick p50 < 30ms, and a seeded shard crash rebalancing with zero wrong-direction scale events + <=5-tick reconvergence; plus the 480/2000-model single-vs-sharded sweep; merges detail.shard_plane into BENCH_LOCAL.json. SHARD_SMOKE=1 runs the short two-shard CI shape.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --shard-only $(if $(SHARD_SMOKE),--smoke)
 
+.PHONY: bench-spans
+bench-spans: ## Obs-plane A/B (48 + 480 models): quiet-tick p50 with WVA_SPANS on vs off (overhead target < 3%; the off lever is asserted zero-cost — no recorder built) plus the 4-shard stitched fleet-tick span-tree assertion; merges detail.obs_plane into BENCH_LOCAL.json.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --spans-only
+
 .PHONY: verify-deploy-pipeline
 verify-deploy-pipeline: ## Static-check the deploy pipeline (scripts parse, manifests render, Dockerfile paths exist).
 	$(PYTHON) -m pytest tests/test_deploy_pipeline.py -x -q

@@ -89,7 +89,8 @@ def _dumps(x):
 def make_slo_world(n_models: int = 6, fused: bool = True,
                    trace: bool = False, sharding: int = 0,
                    dynamics: bool = False, fast_trust: bool = False,
-                   zero_models: tuple = (), forecast: bool = True):
+                   zero_models: tuple = (), forecast: bool = True,
+                   spans: bool = True):
     """SLO-path fleet world: one VA/Deployment/pod per model, live KV +
     queue + arrival-rate telemetry, per-model SLO targets and profiles.
 
@@ -117,6 +118,10 @@ def make_slo_world(n_models: int = 6, fused: bool = True,
         from wva_tpu.config.config import ShardingConfig
 
         cfg.set_sharding(ShardingConfig(enabled=True, shards=sharding))
+    if not spans:
+        from wva_tpu.config.config import ObsConfig
+
+        cfg.set_obs(ObsConfig(spans=False))
     sat = SaturationScalingConfig(analyzer_name="slo")
     sat.apply_defaults()
     cfg.update_saturation_config({"default": sat})

@@ -1,0 +1,231 @@
+"""Gauge-sweep coverage: every plane that exports per-model (or
+per-variant) gauges must REMOVE them when the model is deleted — a frozen
+last value on a dead series reads as a live, permanently-healthy model to
+anyone alerting on it. One parameterized test per plane (forecast, trend,
+health — per (model, namespace); capacity — per accelerator variant), in
+unsharded AND sharded topology, replacing the ad-hoc per-plane checks."""
+
+from __future__ import annotations
+
+import pytest
+
+from wva_tpu.constants import (
+    LABEL_ACCELERATOR_TYPE,
+    LABEL_MODEL_NAME,
+    LABEL_NAMESPACE,
+    LABEL_STATE,
+    LABEL_TIER,
+    WVA_CAPACITY_CHIPS_EFFECTIVE,
+    WVA_CAPACITY_SLICES,
+    WVA_CAPACITY_STOCKED_OUT,
+    WVA_FORECAST_DEMAND,
+    WVA_FORECAST_DEMOTED,
+    WVA_FORECAST_LEAD_TIME_SECONDS,
+    WVA_INPUT_HEALTH,
+    WVA_TREND_SERIES_SAMPLES,
+)
+from wva_tpu.health import HEALTH_STATES
+
+
+def _world(n_models=3, sharding=0):
+    from test_fused_plane import _drain_bus, make_slo_world
+
+    _drain_bus()
+    return make_slo_world(n_models=n_models, sharding=sharding)
+
+
+def _delete_model(cluster, i, ns="fused"):
+    name = f"f{i:03d}-v5e"
+    cluster.delete("VariantAutoscaling", ns, name)
+    cluster.delete("Pod", ns, f"{name}-0")
+    cluster.delete("Deployment", ns, name)
+
+
+# (plane, gauge names with label builders) — every per-model family.
+def _model_labels(model, ns):
+    return {LABEL_MODEL_NAME: model, LABEL_NAMESPACE: ns}
+
+
+PLANES = {
+    "forecast": lambda model, ns: [
+        (WVA_FORECAST_DEMAND, _model_labels(model, ns)),
+        (WVA_FORECAST_DEMOTED, _model_labels(model, ns)),
+        (WVA_FORECAST_LEAD_TIME_SECONDS, _model_labels(model, ns)),
+    ],
+    "trend": lambda model, ns: [
+        (WVA_TREND_SERIES_SAMPLES, _model_labels(model, ns)),
+    ],
+    "health": lambda model, ns: [
+        (WVA_INPUT_HEALTH, {**_model_labels(model, ns),
+                            LABEL_STATE: state})
+        for state in HEALTH_STATES
+    ],
+}
+
+
+@pytest.mark.parametrize("plane", sorted(PLANES))
+@pytest.mark.parametrize("sharding", [0, 2],
+                         ids=["unsharded", "sharded-2"])
+def test_plane_removes_model_gauges_on_deletion(plane, sharding):
+    mgr, cluster, tsdb, clock, feed = _world(sharding=sharding)
+    ns = "fused"
+    doomed = "org/fused-model-002"
+    try:
+        # Ticks until every plane has emitted gauges for the doomed model.
+        for _ in range(3):
+            mgr.engine.optimize()
+            clock.advance(5.0)
+            feed(clock.now())
+        gauges = PLANES[plane](doomed, ns)
+        for name, labels in gauges:
+            assert mgr.registry.get(name, labels) is not None, \
+                f"{plane}: {name} never emitted for the live model"
+        _delete_model(cluster, 2)
+        for _ in range(2):
+            mgr.engine.optimize()
+            clock.advance(5.0)
+            feed(clock.now())
+        for name, labels in gauges:
+            assert mgr.registry.get(name, labels) is None, \
+                (f"{plane}: {name}{labels} still exported after the "
+                 f"model was deleted — gauge sweep missing")
+        # The surviving models keep theirs — the sweep is per-model.
+        for name, labels in PLANES[plane]("org/fused-model-000", ns):
+            assert mgr.registry.get(name, labels) is not None
+    finally:
+        mgr.shutdown()
+
+
+def test_capacity_plane_removes_variant_gauges():
+    """The capacity gauges are keyed per accelerator VARIANT (slices are
+    fleet resources, not model resources): when a variant leaves the
+    ledger its gauges are removed, not frozen. Driven through the
+    engine's capacity pass with a stub manager so the ledger transition
+    (variant present -> absent) is explicit."""
+    from test_fused_plane import make_slo_world
+
+    mgr, cluster, tsdb, clock, feed = _world(n_models=2)
+    try:
+        eng = mgr.engine
+        entry = {
+            "variant": "v5e-8", "ready": 2, "provisioning": 1,
+            "preempted": 0, "chips_per_slice": 8,
+            "stocked_out_tiers": [], "preempted_total": 0,
+        }
+
+        class StubCapacity:
+            tier_preference = ("reservation", "on_demand", "spot")
+            ledger_entries = [entry]
+
+            def tick(self, slices=None, hold_releases=frozenset()):
+                return {"ledger": list(self.ledger_entries),
+                        "requests": [], "completed": [], "expired": []}
+
+            def note_demand(self, decisions):
+                pass
+
+        eng.capacity = StubCapacity()
+        eng._apply_capacity()
+        vlabel = {LABEL_ACCELERATOR_TYPE: "v5e-8"}
+        assert mgr.registry.get(WVA_CAPACITY_SLICES,
+                                {**vlabel, LABEL_STATE: "ready"}) == 2.0
+        assert mgr.registry.get(WVA_CAPACITY_CHIPS_EFFECTIVE,
+                                vlabel) == 24.0
+        assert mgr.registry.get(
+            WVA_CAPACITY_STOCKED_OUT,
+            {**vlabel, LABEL_TIER: "spot"}) == 0.0
+        # The variant leaves the ledger (last slice gone, VAs deleted):
+        # every capacity GAUGE for it is removed.
+        eng.capacity.ledger_entries = []
+        eng._apply_capacity()
+        for state in ("ready", "provisioning", "preempted"):
+            assert mgr.registry.get(WVA_CAPACITY_SLICES,
+                                    {**vlabel, LABEL_STATE: state}) is None
+        assert mgr.registry.get(WVA_CAPACITY_CHIPS_EFFECTIVE,
+                                vlabel) is None
+        for tier in ("reservation", "on_demand", "spot"):
+            assert mgr.registry.get(WVA_CAPACITY_STOCKED_OUT,
+                                    {**vlabel, LABEL_TIER: tier}) is None
+    finally:
+        mgr.shutdown()
+
+
+def test_dead_shard_trend_stats_never_shadow_live_owner():
+    """A crashed worker's frozen DemandTrend entries must not overwrite
+    the new owner's fresh stats in the fleet's wva_trend_* aggregation:
+    dead workers are skipped outright, and a key two live workers both
+    hold (a rebalanced model whose OLD owner's analyzer still carries
+    its stale series) resolves to the freshest entry — not whichever
+    shard id sorts last."""
+    from types import SimpleNamespace
+
+    from wva_tpu.constants import (
+        WVA_TREND_SERIES_STALENESS_SECONDS as STALENESS,
+    )
+
+    mgr, cluster, tsdb, clock, feed = _world(n_models=4, sharding=2)
+    ns = "fused"
+    model = "org/fused-model-000"
+    key = f"{ns}|{model}"
+    try:
+        for _ in range(2):
+            mgr.engine.optimize()
+            clock.advance(5.0)
+            feed(clock.now())
+        plane = mgr.engine.shard_plane
+
+        def stats_fn(staleness):
+            return lambda now: {key: SimpleNamespace(
+                samples=3, staleness_seconds=staleness)}
+
+        # Worker 1 is the stale ex-owner (sorts LAST — blind update order
+        # would let it win); worker 0 is the live owner with fresh stats.
+        plane.workers[0].engine.slo_analyzer.demand_trend_stats = \
+            stats_fn(5.0)
+        plane.workers[1].engine.slo_analyzer.demand_trend_stats = \
+            stats_fn(500.0)
+        mgr.engine._emit_trend_metrics("slo")
+        labels = {LABEL_MODEL_NAME: model, LABEL_NAMESPACE: ns}
+        assert mgr.registry.get(STALENESS, labels) == 5.0
+
+        # Kill the stale worker outright: its entries stop participating
+        # even when the live side has no entry for the key at all.
+        plane.workers[0].engine.slo_analyzer.demand_trend_stats = \
+            lambda now: {}
+        plane.kill_shard(1)
+        mgr.engine._emit_trend_metrics("slo")
+        assert mgr.registry.get(STALENESS, labels) is None
+    finally:
+        mgr.shutdown()
+
+
+def test_shard_plane_ownership_gauge_tracks_deletion():
+    """The shard plane's per-shard ownership counts follow model
+    deletion (the fleet's per-model planes above already cover gauge
+    REMOVAL in sharded topology — ownership is the shard plane's own
+    surface)."""
+    from wva_tpu.constants import LABEL_SHARD, WVA_SHARD_MODELS_OWNED
+
+    mgr, cluster, tsdb, clock, feed = _world(n_models=4, sharding=2)
+    try:
+        for _ in range(2):
+            mgr.engine.optimize()
+            clock.advance(5.0)
+            feed(clock.now())
+        owned_before = sum(
+            mgr.registry.get(WVA_SHARD_MODELS_OWNED,
+                             {LABEL_SHARD: str(s)}) or 0
+            for s in (0, 1))
+        assert owned_before == 4
+        _delete_model(cluster, 3)
+        for _ in range(2):
+            mgr.engine.optimize()
+            clock.advance(5.0)
+            feed(clock.now())
+        owned_after = sum(
+            mgr.registry.get(WVA_SHARD_MODELS_OWNED,
+                             {LABEL_SHARD: str(s)}) or 0
+            for s in (0, 1))
+        assert owned_after == 3
+    finally:
+        mgr.shutdown()

@@ -1,0 +1,46 @@
+"""Metrics↔docs drift lint: every metric name registered in
+``wva_tpu/metrics`` must have a row in docs/metrics-health-monitoring.md,
+and every ``wva_*`` metric-shaped token in that doc must be a registered
+series — a metric an operator cannot look up (or a documented series the
+controller never exports) is drift, caught at tier-1 instead of in an
+incident review."""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from wva_tpu.metrics import MetricsRegistry
+
+DOC = Path(__file__).resolve().parent.parent / "docs" / \
+    "metrics-health-monitoring.md"
+
+# Doc tokens matching the wva_ prefix that are NOT metric names.
+NON_METRIC_TOKENS = {
+    "wva_tpu",          # the package name
+}
+
+
+def _registered() -> set[str]:
+    return set(MetricsRegistry()._series)
+
+
+def _doc_tokens() -> set[str]:
+    text = DOC.read_text(encoding="utf-8")
+    return set(re.findall(r"\bwva_[a-z0-9_]+\b", text)) - NON_METRIC_TOKENS
+
+
+def test_every_registered_metric_is_documented():
+    missing = _registered() - _doc_tokens()
+    assert not missing, (
+        f"metrics registered in wva_tpu/metrics but absent from {DOC.name}:"
+        f" {sorted(missing)} — add a row to the output-metrics table")
+
+
+def test_every_documented_metric_is_registered():
+    phantom = _doc_tokens() - _registered()
+    assert not phantom, (
+        f"wva_* series documented in {DOC.name} but never registered:"
+        f" {sorted(phantom)} — remove the row or register the metric "
+        f"(package names and similar non-metric tokens belong in "
+        f"NON_METRIC_TOKENS)")

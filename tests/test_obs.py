@@ -1,0 +1,530 @@
+"""Obs plane (wva_tpu/obs; docs/design/observability.md): span recorder
+semantics, engine tick-tree shape, cross-shard stitching, the WVA_SPANS
+off-lever byte-identity guarantee, the slow-tick flight recorder, OTLP
+export, phase exemplars, JSON logging, and the `wva explain` CLI against
+the committed goldens (so the CLI can never rot against the trace
+schema)."""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+
+import pytest
+
+from wva_tpu.blackbox.schema import encode
+from wva_tpu.obs import logjson
+from wva_tpu.obs.explain import explain_cli, explain_model
+from wva_tpu.obs.otlp import OtlpExporter, to_otlp
+from wva_tpu.obs.spans import SpanRecorder
+from wva_tpu.utils import FakeClock
+
+GOLDENS = os.path.join(os.path.dirname(__file__), "goldens")
+
+
+def _load_cycles(name):
+    with open(os.path.join(GOLDENS, name), encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _tree_names(tree, depth=0):
+    yield depth, tree["name"]
+    for child in tree.get("children", ()):
+        yield from _tree_names(child, depth + 1)
+
+
+def _find(tree, name):
+    if tree.get("name") == name:
+        return tree
+    for child in tree.get("children", ()):
+        hit = _find(child, name)
+        if hit is not None:
+            return hit
+    return None
+
+
+def _count(tree):
+    return 1 + sum(_count(c) for c in tree.get("children", ()))
+
+
+# --- 1. recorder semantics ---
+
+
+class TestSpanRecorder:
+    def test_nesting_ids_and_timestamps(self):
+        clock = FakeClock(start=1000.0)
+        rec = SpanRecorder(clock=clock)
+        rec.begin_tick(engine="e")
+        with rec.span("outer", a=1):
+            clock.advance(1.0)
+            with rec.span("inner"):
+                pass
+        tree = rec.end_tick("success")
+        assert tree["trace_id"] == "t00000001"
+        assert tree["span_id"] == "s1" and tree["name"] == "tick"
+        outer = tree["children"][0]
+        assert outer["span_id"] == "s2" and outer["attrs"] == {"a": 1}
+        inner = outer["children"][0]
+        assert inner["span_id"] == "s3"
+        # World-clock timestamps: inner started after the advance.
+        assert inner["ts"] == 1001.0 and tree["ts"] == 1000.0
+        # Second tick: fresh span ids, next trace id — deterministic.
+        rec.begin_tick(engine="e")
+        t2 = rec.end_tick("success")
+        assert t2["trace_id"] == "t00000002" and t2["span_id"] == "s1"
+
+    def test_span_outside_tick_drops_counted(self):
+        rec = SpanRecorder(clock=FakeClock())
+        with rec.span("orphan"):
+            pass
+        assert rec.dropped_total == 1
+        assert rec.snapshot() == []
+
+    def test_ring_bound_and_spill(self, tmp_path):
+        spill = tmp_path / "spans.jsonl"
+        rec = SpanRecorder(clock=FakeClock(), ring_size=2,
+                           spill_path=str(spill))
+        for _ in range(5):
+            rec.begin_tick(engine="e")
+            rec.end_tick("success")
+        rec.flush()
+        assert len(rec.snapshot()) == 2  # ring bounded
+        lines = [json.loads(line) for line in
+                 spill.read_text().splitlines()]
+        assert [t["trace_id"] for t in lines] == [
+            f"t{i:08d}" for i in range(1, 6)]  # spill lossless
+        # Spilled trees evict from the ring without counting as drops.
+        assert rec.dropped_total == 0
+        rec.close()
+
+    def test_ring_eviction_without_spill_counts_drop(self):
+        rec = SpanRecorder(clock=FakeClock(), ring_size=1)
+        for _ in range(3):
+            rec.begin_tick(engine="e")
+            rec.end_tick("success")
+        assert rec.dropped_total == 2
+
+    def test_graft_renames_ids_and_attaches(self):
+        rec = SpanRecorder(clock=FakeClock())
+        rec.begin_tick(engine="fleet")
+        worker_tree = {"schema": 1, "trace_id": "t00000001",
+                       "outcome": "success", "span_id": "s1",
+                       "name": "shard_tick", "ts": 0.0, "dur_ms": 1.0,
+                       "attrs": {"shard": 2},
+                       "children": [{"span_id": "s2", "name": "phase:x",
+                                     "ts": 0.0, "dur_ms": 0.5}]}
+        rec.graft([worker_tree])
+        tree = rec.end_tick("success")
+        grafted = tree["children"][0]
+        assert grafted["span_id"] == "sh2:s1"
+        assert grafted["children"][0]["span_id"] == "sh2:s2"
+        # Graft must not leak the worker's own envelope fields.
+        assert "trace_id" not in grafted and "schema" not in grafted
+
+    def test_slow_tick_threshold_dumps(self, tmp_path):
+        rec = SpanRecorder(clock=FakeClock(), slow_tick_ms=0.0001,
+                           slow_dump_dir=str(tmp_path))
+        rec.begin_tick(engine="e")
+        rec.end_tick("success")
+        assert rec.slow_dumps_total == 1
+        dumps = list(tmp_path.iterdir())
+        assert len(dumps) == 1
+        payload = json.loads(dumps[0].read_text())
+        assert payload["reason"] == "slow-tick"
+        assert payload["trace_id"] == "t00000001"
+
+    def test_overrun_hook_dumps_last_tree(self, tmp_path):
+        rec = SpanRecorder(clock=FakeClock(), slow_dump_dir=str(tmp_path))
+        rec.begin_tick(engine="e")
+        rec.end_tick("success")
+        rec.note_overrun("e")
+        payload = json.loads(next(tmp_path.iterdir()).read_text())
+        assert payload["reason"] == "overrun"
+
+
+# --- 2. OTLP export ---
+
+
+class TestOtlp:
+    def test_to_otlp_shape_and_deterministic_ids(self):
+        tree = {"trace_id": "t00000007", "span_id": "s1", "name": "tick",
+                "ts": 100.0, "dur_ms": 12.0, "attrs": {"engine": "e"},
+                "children": [{"span_id": "s2", "name": "phase:analyze",
+                              "ts": 100.0, "dur_ms": 10.0}]}
+        body = to_otlp(tree)
+        spans = body["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert [s["name"] for s in spans] == ["tick", "phase:analyze"]
+        root, child = spans
+        assert len(root["traceId"]) == 32 and len(root["spanId"]) == 16
+        assert child["parentSpanId"] == root["spanId"]
+        assert child["traceId"] == root["traceId"]
+        assert int(child["endTimeUnixNano"]) - \
+            int(child["startTimeUnixNano"]) == int(10.0 * 1e6)
+        # Determinism: same tree -> same ids.
+        assert to_otlp(tree) == body
+
+    def test_exporter_posts_in_background(self):
+        posted = []
+        exp = OtlpExporter("http://example.invalid/v1/traces",
+                           post=posted.append)
+        exp.submit({"trace_id": "t00000001", "span_id": "s1",
+                    "name": "tick", "ts": 0.0, "dur_ms": 1.0})
+        exp.flush()
+        assert len(posted) == 1
+        body = json.loads(posted[0])
+        assert body["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert exp.exported_total == 1
+        exp.close()
+
+    def test_exporter_failure_never_raises(self):
+        def boom(_):
+            raise OSError("collector down")
+        exp = OtlpExporter("http://example.invalid", post=boom)
+        exp.submit({"trace_id": "t1", "span_id": "s1", "name": "tick",
+                    "ts": 0.0, "dur_ms": 1.0})
+        exp.flush()
+        assert exp.failed_total == 1
+        exp.close()
+
+
+# --- 3. engine tick tree + byte identity + stitching ---
+
+
+def _world(**kw):
+    from test_fused_plane import _drain_bus, make_slo_world
+
+    _drain_bus()
+    return make_slo_world(**kw)
+
+
+def _run_ticks(mgr, clock, feed, n, rate=None):
+    for i in range(n):
+        mgr.engine.optimize()
+        clock.advance(5.0)
+        feed(clock.now(), **({"rate_scale": rate(i)} if rate else {}))
+
+
+class TestEngineSpans:
+    def test_tick_tree_shape(self):
+        mgr, cluster, tsdb, clock, feed = _world(n_models=3)
+        try:
+            _run_ticks(mgr, clock, feed, 2)
+            tree = mgr.spans.last_tree()
+            names = {n for _, n in _tree_names(tree)}
+            # tick -> phase -> per-model prepare/analyze -> fused dispatch
+            # -> backend query: the span model the design doc promises.
+            for expected in ("tick", "phase:prepare", "phase:fingerprint",
+                            "phase:analyze", "phase:apply", "model",
+                            "prepare", "analyze", "fused_dispatch",
+                            "backend_query", "health_gate"):
+                assert expected in names, f"missing span {expected}"
+            model_span = _find(tree, "model")
+            assert model_span["attrs"]["model"].startswith("org/fused-")
+            # Per-model spans nest under the analyze phase.
+            analyze = _find(tree, "phase:analyze")
+            assert _find(analyze, "model") is not None
+        finally:
+            mgr.shutdown()
+
+    def test_status_write_span_only_on_writes(self):
+        mgr, cluster, tsdb, clock, feed = _world(n_models=2)
+        try:
+            mgr.engine.optimize()  # first tick writes fresh statuses
+            first = mgr.spans.last_tree()
+            assert _find(first, "status_write") is not None
+            # Quiet ticks (unchanged statuses) write nothing.
+            _run_ticks(mgr, clock, feed, 3)
+            quiet = mgr.spans.last_tree()
+            assert _find(quiet, "status_write") is None
+        finally:
+            mgr.shutdown()
+
+    def test_spans_off_statuses_and_cycles_byte_identical(self):
+        from test_fused_plane import NS, _dumps, _statuses
+
+        def run(spans_on):
+            mgr, cluster, tsdb, clock, feed = _world(
+                n_models=5, trace=True, spans=spans_on)
+            try:
+                # Through the executor (cycles only record there) with
+                # reconciler drains — the full traced-tick shape.
+                for i in range(5):
+                    mgr.engine.executor.tick()
+                    mgr.va_reconciler.drain_triggers()
+                    clock.advance(5.0)
+                    feed(clock.now(), rate_scale=1.0 + 0.4 * i)
+                mgr.flight_recorder.flush()
+                cycles = mgr.flight_recorder.snapshot()
+                assert cycles and cycles[-1]["decisions"], \
+                    "world must actually record traced decisions"
+                statuses = _statuses(cluster, [NS])
+                return _dumps(statuses), _dumps(cycles), mgr.spans
+            finally:
+                mgr.shutdown()
+
+        on_st, on_cy, on_spans = run(True)
+        off_st, off_cy, off_spans = run(False)
+        assert on_st == off_st
+        assert on_cy == off_cy
+        assert on_spans is not None and on_spans.ticks_total == 5
+        # Off-lever zero cost: no recorder object exists at all.
+        assert off_spans is None
+
+    def test_four_shard_tick_is_one_stitched_trace(self):
+        mgr, cluster, tsdb, clock, feed = _world(n_models=8, sharding=4)
+        try:
+            _run_ticks(mgr, clock, feed, 2)
+            trees = mgr.spans.snapshot()
+            tree = trees[-1]
+            workers = [c for c in tree["children"]
+                       if c["name"] == "shard_tick"]
+            shards = sorted(c["attrs"]["shard"] for c in workers)
+            assert shards == [0, 1, 2, 3]
+            # Worker span ids are shard-namespaced — unique in the trace.
+            assert {c["span_id"] for c in workers} == {
+                "sh0:s1", "sh1:s1", "sh2:s1", "sh3:s1"}
+            assert _find(tree, "fleet_merge") is not None
+            # Every worker subtree carries its own phase spans.
+            for w in workers:
+                assert _find(w, "phase:analyze") is not None
+        finally:
+            mgr.shutdown()
+
+    def test_capture_payload_roundtrip_and_off_shape(self):
+        from wva_tpu.shard.summary import (
+            ShardCapture,
+            capture_to_payload,
+            payload_to_capture,
+        )
+
+        # Spans off: the payload carries NO spans key — byte-identical to
+        # pre-obs summaries.
+        bare = capture_to_payload(ShardCapture(shard_id=1))
+        assert "spans" not in bare and "span_ctx" not in bare
+        cap = ShardCapture(shard_id=1, spans=[{"span_id": "s1",
+                                               "name": "shard_tick"}],
+                           span_ctx=["t00000009", 1])
+        back = payload_to_capture(json.loads(json.dumps(
+            capture_to_payload(cap))))
+        assert back.spans == cap.spans
+        assert back.span_ctx == ["t00000009", 1]
+
+    def test_phase_exemplars_rendered(self):
+        from wva_tpu.constants import LABEL_PHASE, WVA_TICK_PHASE_SECONDS
+
+        mgr, cluster, tsdb, clock, feed = _world(n_models=2)
+        try:
+            mgr.engine.optimize()
+            ex = mgr.registry.get_exemplar(WVA_TICK_PHASE_SECONDS,
+                                           {LABEL_PHASE: "analyze"})
+            assert ex is not None
+            assert ex["trace_id"] == mgr.spans.trace_id
+            assert ex["span_id"].startswith("s")
+            text = mgr.registry.render_text()
+            assert "# exemplar: wva_tick_phase_seconds" in text
+            # Exemplars are comment lines: every non-comment line still
+            # parses as classic exposition (name{labels} value).
+            for line in text.splitlines():
+                assert line.startswith("#") or " " in line
+        finally:
+            mgr.shutdown()
+
+    def test_failed_prepare_still_commits_error_tree(self):
+        # A failure BEFORE the analysis body (snapshot LIST, collector
+        # construction, fence check) must still commit the tick tree with
+        # outcome=error and leave no open root — an abandoned tree would
+        # vanish uncounted and stale log context would tag the executor's
+        # retry lines.
+        mgr, cluster, tsdb, clock, feed = _world(n_models=2)
+        try:
+            def boom():
+                raise RuntimeError("chaos: snapshot LIST failed")
+
+            mgr.engine._tick_client = boom
+            with pytest.raises(RuntimeError):
+                mgr.engine.optimize()
+            trees = mgr.spans.snapshot()
+            assert trees and trees[-1]["outcome"] == "error"
+            assert mgr.spans._root is None
+            assert logjson.current_context() == {}
+        finally:
+            mgr.shutdown()
+
+    def test_spans_metrics_counted(self):
+        from wva_tpu.constants import LABEL_ENGINE, WVA_SPANS_TICKS_TOTAL
+
+        mgr, cluster, tsdb, clock, feed = _world(n_models=2)
+        try:
+            _run_ticks(mgr, clock, feed, 3)
+            assert mgr.registry.get(
+                WVA_SPANS_TICKS_TOTAL,
+                {LABEL_ENGINE: "saturation-engine"}) == 3.0
+        finally:
+            mgr.shutdown()
+
+
+# --- 4. explain CLI against the committed goldens ---
+
+
+class TestExplain:
+    def test_health_clamp_named_as_setter(self):
+        cycles = _load_cycles("health_trace_v1.jsonl")
+        report = explain_model(cycles, "golden/model-0", cycle_id=17)
+        v = report["variants"][0]
+        assert v["set_by"] == "health"
+        assert "degraded" in v["set_by_reason"]
+        assert v["health_clamp"]["state"] == "degraded"
+        # The chain still shows every stage's word before the clamp.
+        stages = [s["stage"] for s in v["steps"]]
+        assert stages[0].startswith("analyzer:")
+        assert "tpu-slice-limiter" in stages and stages[-1] == "health"
+
+    def test_forecast_floor_named_as_setter(self):
+        cycles = _load_cycles("forecast_trace_v1.jsonl")
+        report = explain_model(cycles, "meta-llama/Llama-3.1-8B",
+                               cycle_id=13)
+        v = report["variants"][0]
+        assert v["set_by"] == "forecast"
+        assert v["forecast_floor"]["floor_replicas"] >= 1
+
+    def test_shard_golden_covers_floor_and_clamp_history(self):
+        # The acceptance shape: ONE model whose history holds a forecast
+        # floor AND a health (rebalance) clamp, each correctly named as
+        # the stage that set the final desired of its cycle.
+        cycles = _load_cycles("shard_trace_v1.jsonl")
+        floor = explain_model(cycles, "golden/shard-model-0", cycle_id=31)
+        assert floor["variants"][0]["set_by"] == "forecast"
+        clamp = explain_model(cycles, "golden/shard-model-0", cycle_id=36)
+        assert clamp["variants"][0]["set_by"] == "health"
+        assert clamp["variants"][0]["health_clamp"]["state"] == "rebalance"
+
+    def test_latest_cycle_default_and_reemit_note(self):
+        cycles = _load_cycles("shard_trace_v1.jsonl")
+        report = explain_model(cycles, "golden/shard-model-0")
+        assert report["cycle"] == max(
+            c["cycle"] for c in cycles
+            if any(d.get("model_id") == "golden/shard-model-0"
+                   for d in c.get("decisions", ())))
+
+    def test_cli_text_and_json_and_exit_codes(self, capsys):
+        path = os.path.join(GOLDENS, "shard_trace_v1.jsonl")
+        rc = explain_cli(["golden/shard-model-0", "--trace", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "final desired set by:" in out
+        rc = explain_cli(["golden/shard-model-0", "--trace", path,
+                          "--json"])
+        out = capsys.readouterr().out
+        parsed = json.loads(out)
+        assert parsed["variants"][0]["set_by"]
+        # Unknown model: exit 1 with the models actually seen.
+        rc = explain_cli(["no/such-model", "--trace", path])
+        assert rc == 1
+        # No trace: exit 2.
+        assert explain_cli(["m"]) == 2 \
+            if not os.environ.get("WVA_TRACE_PATH") else True
+
+
+# --- 5. JSON logging ---
+
+
+class TestJsonLogging:
+    def test_json_formatter_carries_context(self):
+        logger = logging.getLogger("wva-test-json")
+        stream = io.StringIO()
+        handler = logging.StreamHandler(stream)
+        handler.setFormatter(logjson.JsonLogFormatter())
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        was_active = logjson.ACTIVE
+        try:
+            logjson.ACTIVE = True
+            logjson.set_context(tick="t00000042", model="org/m",
+                                shard=2)
+            logger.info("scaling %s", "up")
+        finally:
+            logjson.clear_context()
+            logjson.ACTIVE = was_active
+            logger.removeHandler(handler)
+        record = json.loads(stream.getvalue())
+        assert record["message"] == "scaling up"
+        assert record["tick"] == "t00000042"
+        assert record["model"] == "org/m"
+        assert record["shard"] == 2
+        assert record["level"] == "INFO"
+        assert record["logger"] == "wva-test-json"
+
+    def test_context_is_thread_local_and_clearable(self):
+        import threading
+
+        logjson.set_context(model="a")
+        seen = {}
+
+        def other():
+            seen["ctx"] = logjson.current_context()
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert seen["ctx"] == {}
+        logjson.clear_context("model")
+        assert logjson.current_context() == {}
+
+    def test_unserializable_extra_degrades(self):
+        logger = logging.getLogger("wva-test-json2")
+        stream = io.StringIO()
+        handler = logging.StreamHandler(stream)
+        handler.setFormatter(logjson.JsonLogFormatter())
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        try:
+            logjson.set_context(weird=object())
+            logger.info("still fine")
+        finally:
+            logjson.clear_context()
+            logger.removeHandler(handler)
+        record = json.loads(stream.getvalue())
+        assert record["message"] == "still fine"
+
+    def test_plain_default_does_no_context_work(self):
+        # The engine stamps log context ONLY while the JSON formatter is
+        # installed — the plain default pays nothing.
+        assert logjson.ACTIVE is False
+
+    def test_engine_stamps_context_when_active(self):
+        mgr, cluster, tsdb, clock, feed = _world(n_models=2)
+        seen = {}
+        orig_clear = logjson.clear_context
+
+        def spy_clear(*fields):
+            if "tick" in fields:
+                seen.update(logjson.current_context())
+            orig_clear(*fields)
+
+        was_active = logjson.ACTIVE
+        logjson.ACTIVE = True
+        logjson.clear_context = spy_clear
+        try:
+            mgr.engine.optimize()
+        finally:
+            logjson.ACTIVE = was_active
+            logjson.clear_context = orig_clear
+            orig_clear()
+            mgr.shutdown()
+        assert seen.get("engine") == "saturation-engine"
+        assert seen.get("tick") == "t00000001"
+
+
+# --- 6. encode() stays span-free ---
+
+
+def test_decision_encode_untouched_by_spans():
+    """Spans never leak into the blackbox encoding path (the byte-identity
+    guarantee rests on the two planes being disjoint)."""
+    from wva_tpu.interfaces import VariantDecision
+
+    d = VariantDecision(variant_name="v", namespace="ns", model_id="m")
+    payload = encode(d)
+    assert "span" not in json.dumps(payload)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import ssl
 import sys
@@ -62,11 +63,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
-def setup_logging(verbosity: int) -> None:
+def setup_logging(verbosity: int, log_format: str = "") -> None:
     logging.basicConfig(
         level=_VERBOSITY_LEVELS.get(max(0, min(verbosity, 5)), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
         stream=sys.stderr)
+    # Structured logging (WVA_LOG_FORMAT=json): the existing loggers run
+    # through a JSON formatter carrying tick/model/shard context fields.
+    # Plain stays the default — byte-identical to pre-change logs.
+    fmt = (log_format or os.environ.get("WVA_LOG_FORMAT", "")).lower()
+    if fmt == "json":
+        from wva_tpu.obs.logjson import install
+
+        install()
 
 
 def flags_from_args(args: argparse.Namespace) -> dict:
@@ -128,6 +137,14 @@ def main(argv: list[str] | None = None) -> int:
         from wva_tpu.forecast.backtest import forecast_cli
 
         return forecast_cli(argv[1:])
+    if argv and argv[0] == "explain":
+        # Decision provenance (wva_tpu.obs.explain): walk the newest
+        # trace cycle that decided a model and print the causal chain of
+        # its final desired number through every pipeline stage. Same
+        # no-cluster dispatch as replay.
+        from wva_tpu.obs.explain import explain_cli
+
+        return explain_cli(argv[1:])
     args = build_arg_parser().parse_args(argv)
     setup_logging(args.verbosity if args.verbosity is not None else 2)
 
@@ -144,6 +161,12 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     if args.verbosity is None:
         setup_logging(cfg.logger_verbosity())
+    if cfg.obs_config().log_format == "json":
+        # Config-file/env route to structured logs (flags won the
+        # verbosity; the format is orthogonal).
+        from wva_tpu.obs.logjson import install
+
+        install()
 
     try:
         creds = resolve_credentials(args.kubeconfig or None,

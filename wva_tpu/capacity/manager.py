@@ -91,6 +91,10 @@ class CapacityManager:
         # bootstrap for variants discovery has never reported (a brand-new
         # variant's FIRST order must be sizeable before any slice exists).
         self._chip_hint: dict[str, int] = {}
+        # Obs plane (WVA_SPANS): build_manager installs the engine's span
+        # recorder here so provisioning orders appear in the tick tree.
+        # None = off (zero cost).
+        self.spans = None
 
     # --- watch feed (informer nudge listener registers this) ---
 
@@ -269,7 +273,16 @@ class CapacityManager:
                 continue
             count = min(math.ceil(shortfall / chips_per_slice),
                         MAX_SLICES_PER_REQUEST)
-            event = self._submit(variant, count, chips_per_slice, now)
+            if self.spans is not None:
+                with self.spans.span("capacity_order", variant=variant,
+                                     slices=count) as sp:
+                    event = self._submit(variant, count, chips_per_slice,
+                                         now)
+                    if event is not None:
+                        self.spans.annotate(sp, tier=event["tier"],
+                                            outcome=event["outcome"])
+            else:
+                event = self._submit(variant, count, chips_per_slice, now)
             if event is not None:
                 requests.append(event)
         return requests

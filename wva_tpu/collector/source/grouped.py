@@ -491,8 +491,11 @@ class GroupedMetricsView(MetricsSource):
     bypassing the view entirely."""
 
     def __init__(self, source, scope_namespace: str = "",
-                 versioned: bool = True) -> None:
+                 versioned: bool = True, spans=None) -> None:
         self._source = source
+        # Obs plane (WVA_SPANS): backend query + demux spans, recorded
+        # under the engine's current tick tree. None = off (zero cost).
+        self._spans = spans
         # Namespace-scoped controllers keep their watch namespace as an
         # equality matcher in the fleet-wide queries (shared-Prometheus
         # tenancy: never aggregate other tenants' series).
@@ -825,10 +828,14 @@ class GroupedMetricsView(MetricsSource):
                                           memo.slices, collected_at,
                                           versions=memo.versions, key=key,
                                           organic=organic)
+        qspan = (self._spans.begin_span("backend_query", template=name)
+                 if self._spans is not None else None)
         try:
             points, meta = self._source.execute_grouped_tracked(
                 name, gq.promql)
         except Exception as e:  # noqa: BLE001 — grouped failure falls back
+            if self._spans is not None:
+                self._spans.end_span(qspan, outcome="fallback")
             log.debug("grouped query %s failed (%s); falling back to "
                       "per-model collection", name, e)
             if book is not None:
@@ -858,6 +865,10 @@ class GroupedMetricsView(MetricsSource):
                     expiry_b=meta.expiry_b,
                     uniform=meta.uniform,
                     slices=dict(slices), versions=versions))
+        if self._spans is not None:
+            # One span covers query + demux + digest stamping — the
+            # collector's whole backend round-trip for this template.
+            self._spans.end_span(qspan, slices=len(slices))
         return self._emit_demuxed(name, params, has_ns, slices,
                                   collected_at, versions=versions, key=key,
                                   organic=organic)

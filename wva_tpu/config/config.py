@@ -292,6 +292,38 @@ class CapacityConfig(frz.Freezable):
 
 
 @dataclass
+class ObsConfig(frz.Freezable):
+    """Observability plane (``wva_tpu.obs``; docs/design/observability.md):
+    hierarchical tick span recorder with cross-shard stitching, slow-tick
+    flight recorder, optional OTLP export, structured JSON logging.
+    Spans are strictly out-of-band: ``WVA_SPANS`` on OR off, statuses,
+    DecisionTrace cycles, and all replay goldens are byte-identical —
+    the lever gates only whether the recorder exists."""
+
+    # WVA_SPANS: span-structured tick tracing (default on; off is
+    # zero-cost — no recorder is built, no span objects allocated).
+    spans: bool = True
+    # Completed tick trees kept in the in-memory ring (WVA_SPANS_RING).
+    spans_ring: int = 64
+    # JSONL spill path for tick trees (WVA_SPANS_PATH; "" = ring only).
+    spans_path: str = ""
+    # Slow-tick flight recorder (WVA_TRACE_SLOW_TICK_MS): a tick whose
+    # wall time crosses this threshold auto-dumps its full span tree.
+    # 0 disables the threshold; executor overruns (tick > poll interval)
+    # always dump, riding the wva_tick_overruns_total hook.
+    slow_tick_ms: float = 0.0
+    # Directory for slow-tick dumps ("" = <tmpdir>/wva-slow-ticks).
+    slow_dump_dir: str = ""
+    # OTLP/HTTP JSON traces endpoint (WVA_OTLP_ENDPOINT, e.g.
+    # http://otel-collector:4318/v1/traces; "" disables export). Stdlib
+    # HTTP only — no OpenTelemetry SDK dependency.
+    otlp_endpoint: str = ""
+    # WVA_LOG_FORMAT: "plain" (default, byte-identical to pre-change
+    # logs) or "json" (one object per line with tick/model/shard context).
+    log_format: str = "plain"
+
+
+@dataclass
 class ConfigSyncState:
     configmaps_bootstrap_complete: bool = False
     last_configmaps_sync_at: float = 0.0
@@ -322,6 +354,7 @@ class Config:
         self._health = HealthConfig()
         self._resilience = ResilienceConfig()
         self._sharding = ShardingConfig()
+        self._obs = ObsConfig()
         # Bumped on every decision-affecting hot-reload (see mutation_epoch).
         self._epoch = 0
         # Hot-accessor memo: section name -> FROZEN deep copy, built once
@@ -566,6 +599,22 @@ class Config:
         with self._mu:
             self._sharding = copy.deepcopy(s)
             self._bump_epoch_locked()
+
+    # --- observability plane (wva_tpu.obs) ---
+
+    def obs_config(self) -> "ObsConfig":
+        return self._memoized("obs", lambda: self._obs)
+
+    def spans_enabled(self) -> bool:
+        with self._mu:
+            return self._obs.spans
+
+    def set_obs(self, o: "ObsConfig") -> None:
+        # Pure observability: no decision-affecting epoch bump — spans
+        # must not dirty every model's config fingerprint.
+        with self._mu:
+            self._obs = copy.deepcopy(o)
+            self._memo.clear()
 
     # --- saturation config (namespace-aware; reference config.go:318-354) ---
 

@@ -22,6 +22,7 @@ from wva_tpu.config.config import (
     ForecastConfig,
     HealthConfig,
     InfrastructureConfig,
+    ObsConfig,
     PrometheusConfig,
     ResilienceConfig,
     ShardingConfig,
@@ -121,6 +122,27 @@ DEFAULTS: dict[str, Any] = {
     # Summaries older than this cover nothing (their models hold previous
     # desired).
     "WVA_SHARD_SUMMARY_STALE": "90s",
+    # Observability plane (wva_tpu.obs; docs/design/observability.md).
+    # Span-structured tick tracing, default on; strictly out-of-band —
+    # statuses, traces, and goldens are byte-identical either way, and
+    # "off" builds no recorder at all (zero cost).
+    "WVA_SPANS": True,
+    # Completed tick span trees kept in the in-memory ring.
+    "WVA_SPANS_RING": 64,
+    # JSONL spill path for tick trees ("" = ring only).
+    "WVA_SPANS_PATH": "",
+    # Slow-tick flight recorder: a tick slower than this many
+    # milliseconds auto-dumps its full span tree (0 = threshold off;
+    # executor overruns always dump).
+    "WVA_TRACE_SLOW_TICK_MS": 0.0,
+    # Directory for slow-tick dumps ("" = <tmpdir>/wva-slow-ticks).
+    "WVA_SLOW_TICK_DIR": "",
+    # OTLP/HTTP JSON traces endpoint ("" disables export; stdlib HTTP,
+    # no OpenTelemetry dependency).
+    "WVA_OTLP_ENDPOINT": "",
+    # Log output format: "plain" (byte-identical to pre-change logs) or
+    # "json" (one object per line with tick/model/shard context fields).
+    "WVA_LOG_FORMAT": "plain",
     # Elastic capacity plane (wva_tpu.capacity; docs/design/capacity.md).
     # Default on; "off"/"false"/"0" disables (decisions then byte-identical
     # to pre-capacity builds).
@@ -330,6 +352,16 @@ def load(flags: Mapping[str, Any] | None = None,
         workers=max(1, r.get_int("WVA_SHARD_WORKERS")),
         rebalance_hold_ticks=max(0, r.get_int("WVA_SHARD_REBALANCE_HOLD")),
         summary_stale_seconds=r.get_duration("WVA_SHARD_SUMMARY_STALE"),
+    ))
+
+    cfg.set_obs(ObsConfig(
+        spans=r.get_bool("WVA_SPANS"),
+        spans_ring=max(1, r.get_int("WVA_SPANS_RING")),
+        spans_path=r.get_str("WVA_SPANS_PATH"),
+        slow_tick_ms=max(0.0, r.get_float("WVA_TRACE_SLOW_TICK_MS")),
+        slow_dump_dir=r.get_str("WVA_SLOW_TICK_DIR"),
+        otlp_endpoint=r.get_str("WVA_OTLP_ENDPOINT"),
+        log_format=(r.get_str("WVA_LOG_FORMAT") or "plain").lower(),
     ))
 
     from wva_tpu.capacity.tiers import (

@@ -174,6 +174,22 @@ WVA_SHARD_REBALANCE_TOTAL = "wva_shard_rebalance_total"
 # it (a wedged shard worker stops publishing).
 WVA_SHARD_SUMMARY_AGE_SECONDS = "wva_shard_summary_age_seconds"
 
+# --- Fleet-tick tracing plane (wva_tpu/obs; docs/design/observability.md) ---
+# Tick span trees committed by the span recorder (one per engine tick
+# while WVA_SPANS is on).
+WVA_SPANS_TICKS_TOTAL = "wva_spans_ticks_total"
+# Spans or tick trees dropped, by reason (ring eviction without spill,
+# spill write error/backlog, encode error, span outside a tick).
+WVA_SPANS_DROPPED_TOTAL = "wva_spans_dropped_total"
+# Slow-tick flight-recorder dumps written, by reason (overrun — the tick
+# ran longer than its poll interval — or slow-tick — it crossed
+# WVA_TRACE_SLOW_TICK_MS). Each dump is the full span tree of the slow
+# tick; the log line carries the path.
+WVA_SLOW_TICK_DUMPS_TOTAL = "wva_slow_tick_dumps_total"
+# OTLP/HTTP span exports, by outcome (success | error | dropped). Only
+# emitted when WVA_OTLP_ENDPOINT is set.
+WVA_OTLP_EXPORTS_TOTAL = "wva_otlp_exports_total"
+
 # --- Common metric label names ---
 LABEL_KIND = "kind"
 LABEL_MODEL_NAME = "model_name"

@@ -19,6 +19,7 @@ import logging
 import math
 import time
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager, nullcontext
 from dataclasses import dataclass, field
 
 from wva_tpu.actuator import Actuator
@@ -79,6 +80,7 @@ from wva_tpu.blackbox.schema import (
     STAGE_HEALTH,
     STAGE_SHARD,
 )
+from wva_tpu.obs import logjson
 from wva_tpu.resilience import LeadershipLostError, SimulatedCrash
 from wva_tpu.health import BLACKOUT, FRESH, HEALTH_STATES, InputHealth
 from wva_tpu.health.apply import apply_health_clamps
@@ -417,6 +419,11 @@ class SaturationEngine:
         # last tick (counter emission needs deltas), and the limiter's
         # per-tick discovery snapshot handed to the capacity pass.
         self._capacity_preempted_seen: dict[str, int] = {}
+        # Variants whose capacity gauges were emitted last tick: a variant
+        # that left the ledger (its last slice gone, its VAs deleted) has
+        # its wva_capacity_* GAUGES removed instead of freezing at their
+        # last value (counters stay — rate() semantics).
+        self._capacity_gauge_keys: set[str] = set()
         self._tick_slices: dict | None = None
         # Label sets the trend/forecast gauge sweeps emitted last tick: a
         # deleted model's gauges are REMOVED from the registry, not left
@@ -506,6 +513,17 @@ class SaturationEngine:
         # next hot path must be visible from metrics, not only from
         # `make bench-profile`.
         self.last_tick_phase_seconds: dict[str, float] = {}
+        # Obs plane (WVA_SPANS; docs/design/observability.md): the span
+        # recorder build_manager installs when spans are on. Every tick
+        # opens one span tree — tick -> phase -> per-model prepare/analyze
+        # -> fused dispatch / backend queries / capacity orders / status
+        # writes — strictly out-of-band (statuses, traces, and goldens
+        # byte-identical with the lever off OR on). None = off: no
+        # recorder exists, the guards below cost one attribute read.
+        self.spans = None
+        self._span_root = None
+        self._cur_phase_span = None
+        self._span_phases: dict[str, object] = {}
         # K8s object copies taken during the last tick (object plane
         # accounting; ~0 at steady state — see wva_tick_object_copies).
         self.last_tick_object_copies = 0
@@ -575,9 +593,58 @@ class SaturationEngine:
             # never aggregate other tenants' series).
             view = GroupedMetricsView(
                 source, scope_namespace=self.config.watch_namespace() or "",
-                versioned=self.fp_delta_enabled)
+                versioned=self.fp_delta_enabled, spans=self.spans)
             return self.collector.scoped(view)
         return self.collector
+
+    # --- obs-plane span helpers (WVA_SPANS; no-ops when spans are off) ---
+
+    def _begin_phase_span(self, name: str) -> None:
+        """Open the named phase span under the tick root, closing the
+        previous phase's (phases are strictly sequential)."""
+        if self.spans is None:
+            return
+        self._end_phase_span()
+        span = self.spans.begin_span(f"phase:{name}",
+                                     parent=self._span_root)
+        self._cur_phase_span = span
+        # Helper threads (analysis pool, query warmers) with no open span
+        # of their own attribute to the phase that spawned their work.
+        self.spans.set_default_parent(span)
+        if span is not None:
+            self._span_phases[name] = span
+
+    def _end_phase_span(self) -> None:
+        if self.spans is not None and self._cur_phase_span is not None:
+            self.spans.end_span(self._cur_phase_span)
+            self._cur_phase_span = None
+            self.spans.set_default_parent(None)
+
+    def _obs_span(self, name: str, **attrs):
+        """Scoped span under the calling thread's innermost open span
+        (falls back to the current phase / tick root)."""
+        if self.spans is None:
+            return nullcontext()
+        return self.spans.span(name, **attrs)
+
+    @contextmanager
+    def _model_span(self, model_id: str, namespace: str):
+        """Per-model prepare/analyze span (parented to the analyze phase —
+        the worker pool's threads have no open span of their own) plus the
+        model field for JSON log context. Only analyzed (dirty) models
+        pass through here, so quiet-tick cost stays near zero."""
+        if logjson.ACTIVE:
+            logjson.set_context(model=model_id, model_namespace=namespace)
+        try:
+            if self.spans is None:
+                yield
+            else:
+                with self.spans.span("model", parent=self._cur_phase_span,
+                                     model=model_id, namespace=namespace):
+                    yield
+        finally:
+            if logjson.ACTIVE:
+                logjson.clear_context("model", "model_namespace")
 
     def _map_models(self, model_groups: dict, fn, affinity=None) -> dict:
         """Run ``fn(group_key, model_vas)`` for every model, across the
@@ -635,55 +702,86 @@ class SaturationEngine:
         copies_at_start = frz.copy_count()
         phase_start = time.perf_counter()
         self._phase_seconds: dict[str, float] = {}
-        # Fencing token for this tick (wva_tpu/resilience): the lease
-        # epoch we act under. Captured BEFORE any work and re-checked
-        # between analyze and apply — losing it mid-tick aborts before a
-        # single write. None fence = election disabled (always leader).
-        if self.fence is not None:
-            self._tick_epoch = self.fence()
-            if self._tick_epoch is None:
-                raise LeadershipLostError(
-                    "leadership lost before tick start; not analyzing")
-        else:
-            self._tick_epoch = None
-        if self.flight is not None:
-            # Retried ticks must not stack duplicate model records into the
-            # failed attempt's cycle.
-            self.flight.reset_cycle()
-        # Tick-scoped: the limiter's discovery snapshot for the capacity
-        # pass. Reset HERE, not per-path — any path that skips the limiter
-        # (no active VAs, V2 with zero requests) must leave the capacity
-        # pass on fresh discovery, never a previous tick's snapshot.
-        self._tick_slices = None
-        # Tick-scoped: the fused dispatch's sized pairs for the fleet
-        # solve. Reset here so a failed/absent fused pass never leaves a
-        # previous tick's rates for _optimize_global to consume.
-        self._tick_presized = None
-        # Informer staleness backstop: re-LIST any kind whose last list is
-        # older than the resync interval (no-op on non-informer clients).
-        resync = getattr(self.client, "resync_if_stale", None)
-        if callable(resync):
-            resync()
-        # Tick-scoped cluster snapshot: every K8s read below (active-VA
-        # filter, per-model data prep, decision application, safety net) is
-        # served from one LIST per kind instead of a GET per VA — O(kinds)
-        # API requests per tick regardless of fleet size, and a consistent
-        # view for every model's analysis.
-        snap = self._tick_client()
-        # Tick-scoped metrics view, same idea on the metrics plane: one
-        # fleet-wide backend query per registered template, demuxed to
-        # every model (instead of ~10 backend queries per model per tick).
-        # The enforcer's scale-to-zero request counts ride the same view
-        # (enforcement runs on this thread only; cleared in the finally).
-        collector = self._tick_collector()
-        if collector is not self.collector:
-            self.enforcer.metrics_source = collector.source
-        # Snapshot + collector construction, resync probe: the first slice
-        # of the "prepare" phase (the rest — VA listing, grouping — is
-        # accumulated inside _optimize_with).
-        self._phase_seconds["prepare"] = time.perf_counter() - phase_start
+        # One span tree per tick (obs plane). Shard-worker role records
+        # under the fleet's adopted trace context; the fleet stitches the
+        # worker subtrees under its own tick span after gather.
+        if self.spans is not None:
+            self._span_phases = {}
+            self._span_root = self.spans.begin_tick(
+                engine=self.executor.name)
+            self._begin_phase_span("prepare")
+        if logjson.ACTIVE:
+            logjson.set_context(
+                engine=self.executor.name,
+                tick=(self.spans.trace_id
+                      if self.spans is not None else None),
+                shard=(self.shard_ctx.capture.shard_id
+                       if self.shard_ctx is not None else None))
+        tick_ok = False
+        # Everything below the span/logctx setup runs inside ONE
+        # try/finally: a failure anywhere in the prepare section (fence
+        # check, informer resync, snapshot LIST, collector construction)
+        # must still commit the tick's span tree with outcome "error" and
+        # clear the JSON-log context — an abandoned open root would
+        # silently vanish (uncounted) and the executor's retry/backoff
+        # log lines would carry a stale tick id.
         try:
+            # Fencing token for this tick (wva_tpu/resilience): the lease
+            # epoch we act under. Captured BEFORE any work and re-checked
+            # between analyze and apply — losing it mid-tick aborts before
+            # a single write. None fence = election disabled (always
+            # leader).
+            if self.fence is not None:
+                self._tick_epoch = self.fence()
+                if self._tick_epoch is None:
+                    raise LeadershipLostError(
+                        "leadership lost before tick start; not analyzing")
+            else:
+                self._tick_epoch = None
+            if self.flight is not None:
+                # Retried ticks must not stack duplicate model records
+                # into the failed attempt's cycle.
+                self.flight.reset_cycle()
+            # Tick-scoped: the limiter's discovery snapshot for the
+            # capacity pass. Reset HERE, not per-path — any path that
+            # skips the limiter (no active VAs, V2 with zero requests)
+            # must leave the capacity pass on fresh discovery, never a
+            # previous tick's snapshot.
+            self._tick_slices = None
+            # Tick-scoped: the fused dispatch's sized pairs for the fleet
+            # solve. Reset here so a failed/absent fused pass never
+            # leaves a previous tick's rates for _optimize_global to
+            # consume.
+            self._tick_presized = None
+            # Informer staleness backstop: re-LIST any kind whose last
+            # list is older than the resync interval (no-op on
+            # non-informer clients).
+            resync = getattr(self.client, "resync_if_stale", None)
+            if callable(resync):
+                resync()
+            # Tick-scoped cluster snapshot: every K8s read below
+            # (active-VA filter, per-model data prep, decision
+            # application, safety net) is served from one LIST per kind
+            # instead of a GET per VA — O(kinds) API requests per tick
+            # regardless of fleet size, and a consistent view for every
+            # model's analysis.
+            snap = self._tick_client()
+            # Tick-scoped metrics view, same idea on the metrics plane:
+            # one fleet-wide backend query per registered template,
+            # demuxed to every model (instead of ~10 backend queries per
+            # model per tick). The enforcer's scale-to-zero request
+            # counts ride the same view (enforcement runs on this thread
+            # only; cleared in the finally).
+            collector = self._tick_collector()
+            if collector is not self.collector:
+                self.enforcer.metrics_source = collector.source
+            # Snapshot + collector construction, resync probe: the first
+            # slice of the "prepare" phase (the rest — VA listing,
+            # grouping — is accumulated inside _optimize_with).
+            self._phase_seconds["prepare"] = \
+                time.perf_counter() - phase_start
             self._optimize_with(snap, collector)
+            tick_ok = True
         finally:
             self.enforcer.metrics_source = None
             copies = frz.copy_count() - copies_at_start
@@ -697,6 +795,21 @@ class SaturationEngine:
                     registry.set_gauge(
                         WVA_TICK_PHASE_SECONDS, {LABEL_PHASE: phase},
                         round(self._phase_seconds.get(phase, 0.0), 6))
+                if self.spans is not None:
+                    # Span-id exemplars next to wva_tick_phase_seconds:
+                    # a slow phase sample links straight to the span that
+                    # timed it (comment-line exemplars; see registry).
+                    for phase, sp in self._span_phases.items():
+                        registry.set_exemplar(
+                            WVA_TICK_PHASE_SECONDS, {LABEL_PHASE: phase},
+                            {"trace_id": self.spans.trace_id,
+                             "span_id": sp.span_id})
+            if self.spans is not None:
+                self._end_phase_span()
+                self._span_root = None
+                self.spans.end_tick("success" if tick_ok else "error")
+            if logjson.ACTIVE:
+                logjson.clear_context("engine", "tick", "shard")
 
     def _optimize_with(self, snap: KubeClient,
                        collector: ReplicaMetricsCollector) -> None:
@@ -743,6 +856,7 @@ class SaturationEngine:
         self._phase_seconds["prepare"] = (
             self._phase_seconds.get("prepare", 0.0)
             + fp_start - prep_start)
+        self._begin_phase_span("fingerprint")
         if self.shard_plane is None:
             clean, fingerprints = self._partition_clean(
                 model_groups, snap, collector, analyzer_name)
@@ -755,6 +869,7 @@ class SaturationEngine:
             clean, fingerprints = set(), {}
         analyze_start = time.perf_counter()
         self._phase_seconds["fingerprint"] = analyze_start - fp_start
+        self._begin_phase_span("analyze")
 
         # Analyzer selection by name (reference engine.go:236-254); "slo"
         # reuses the V2 optimizer/enforcer flow with the queueing-model
@@ -776,11 +891,13 @@ class SaturationEngine:
         # (post-limiter) decisions — holds/freezes are absolute, so they
         # must have the last word — recorded as a stage so replay
         # re-applies them, BEFORE the decisions themselves are recorded.
-        self._apply_health_gate(decisions, va_map)
+        with self._obs_span("health_gate"):
+            self._apply_health_gate(decisions, va_map)
         if self.flight is not None:
             self.flight.record_decisions(decisions)
         apply_start = time.perf_counter()
         self._phase_seconds["analyze"] = apply_start - analyze_start
+        self._begin_phase_span("apply")
         # Fence re-check between analyze and apply (wva_tpu/resilience):
         # a leader deposed while analyzing must never actuate — the lease
         # epoch captured at tick start must still be ours. Every write
@@ -846,8 +963,32 @@ class SaturationEngine:
         analyzer = (self.slo_analyzer if analyzer_name == SLO_ANALYZER_NAME
                     else self.v2_analyzer)
         now = self.clock.now()
+        stats = dict(analyzer.demand_trend_stats(now))
+        if self.shard_plane is not None:
+            # Sharded fleet role: the trends live in the WORKERS' analyzer
+            # state (this engine never analyzes) — aggregate the in-process
+            # workers' stats so wva_trend_* keeps existing (and sweeping)
+            # at any shard count. Dead workers are skipped, and a key
+            # reported by several workers (a rebalanced model whose old
+            # owner's analyzer still holds its stale series) resolves to
+            # the FRESHEST entry — the live owner's, not whichever shard
+            # id sorts last. Process-per-shard workers are not reachable
+            # here; their models' trend health is observable on the
+            # worker processes' own /metrics.
+            for shard in sorted(self.shard_plane.workers):
+                worker = self.shard_plane.workers[shard]
+                if worker.dead:
+                    continue
+                wa = (worker.engine.slo_analyzer
+                      if analyzer_name == SLO_ANALYZER_NAME
+                      else worker.engine.v2_analyzer)
+                for key, st in wa.demand_trend_stats(now).items():
+                    cur = stats.get(key)
+                    if (cur is None or st.staleness_seconds
+                            < cur.staleness_seconds):
+                        stats[key] = st
         emitted: set[tuple] = set()
-        for key, st in sorted(analyzer.demand_trend_stats(now).items()):
+        for key, st in sorted(stats.items()):
             ns, _, model = key.partition("|")
             labels = {LABEL_MODEL_NAME: model, LABEL_NAMESPACE: ns}
             emitted.add((model, ns))
@@ -1629,6 +1770,11 @@ class SaturationEngine:
                 return ("clean", None)
             model_id = model_vas[0].spec.model_id
             namespace = model_vas[0].metadata.namespace
+            with self._model_span(model_id, namespace):
+                return analyze_one_inner(model_id, namespace, model_vas)
+
+        def analyze_one_inner(model_id: str, namespace: str,
+                              model_vas: list[VariantAutoscaling]):
             sat_cfg = self.config.saturation_config_for_namespace(
                 namespace).get("default")
             if sat_cfg is None:
@@ -1636,16 +1782,19 @@ class SaturationEngine:
                          "skipping model %s", namespace, model_id)
                 return ("skip", None)
             try:
-                data = self._prepare_model_data(model_id, model_vas, snap,
-                                                collector=collector)
+                with self._obs_span("prepare"):
+                    data = self._prepare_model_data(model_id, model_vas,
+                                                    snap,
+                                                    collector=collector)
             except Exception as e:  # noqa: BLE001 — per-model isolation
                 return ("safety-net", e)
             if data is None:
                 return ("skip", None)
-            analysis = self.v1_analyzer.analyze_model_saturation(
-                model_id, namespace, data.replica_metrics, sat_cfg)
-            targets = self.v1_analyzer.calculate_saturation_targets(
-                analysis, data.variant_states)
+            with self._obs_span("analyze"):
+                analysis = self.v1_analyzer.analyze_model_saturation(
+                    model_id, namespace, data.replica_metrics, sat_cfg)
+                targets = self.v1_analyzer.calculate_saturation_targets(
+                    analysis, data.variant_states)
             return ("ok", (data, analysis, targets, sat_cfg))
 
         outcomes = self._map_models(model_groups, analyze_one)
@@ -1750,6 +1899,11 @@ class SaturationEngine:
                 return ("clean", None)
             model_id = model_vas[0].spec.model_id
             namespace = model_vas[0].metadata.namespace
+            with self._model_span(model_id, namespace):
+                return analyze_one_inner(model_id, namespace, model_vas)
+
+        def analyze_one_inner(model_id: str, namespace: str,
+                              model_vas: list[VariantAutoscaling]):
             sat_cfg = self.config.saturation_config_for_namespace(
                 namespace).get("default")
             if sat_cfg is None:
@@ -1758,8 +1912,10 @@ class SaturationEngine:
                 return ("skip", None)
             sat_cfg.apply_defaults()
             try:
-                data = self._prepare_model_data(model_id, model_vas, snap,
-                                                collector=collector)
+                with self._obs_span("prepare"):
+                    data = self._prepare_model_data(model_id, model_vas,
+                                                    snap,
+                                                    collector=collector)
             except Exception as e:  # noqa: BLE001 — per-model isolation
                 return ("safety-net", ("Model data preparation", e))
             if data is None:
@@ -1767,14 +1923,16 @@ class SaturationEngine:
             scheduler_queue = collector.collect_scheduler_queue_metrics(
                 model_id)
             try:
-                if use_slo:
-                    out = self._prepare_slo_plan(
-                        model_id, namespace, data, sat_cfg,
-                        slo_cfg_by_ns.get(namespace), scheduler_queue,
-                        collector=collector)
-                else:
-                    out = self._run_v2_analysis(
-                        model_id, namespace, data, sat_cfg, scheduler_queue)
+                with self._obs_span("analyze"):
+                    if use_slo:
+                        out = self._prepare_slo_plan(
+                            model_id, namespace, data, sat_cfg,
+                            slo_cfg_by_ns.get(namespace), scheduler_queue,
+                            collector=collector)
+                    else:
+                        out = self._run_v2_analysis(
+                            model_id, namespace, data, sat_cfg,
+                            scheduler_queue)
             except Exception as e:  # noqa: BLE001 — per-model isolation
                 return ("safety-net",
                         (("SLO" if use_slo else "V2") + " analysis", e))
@@ -1823,7 +1981,9 @@ class SaturationEngine:
                     fused_prep = None
                 if grids is not None:
                     try:
-                        sized = self._fused_dispatch(grids, fused_prep)
+                        with self._obs_span("fused_dispatch",
+                                            models=len(batch_keys)):
+                            sized = self._fused_dispatch(grids, fused_prep)
                         batched_ok = True
                     except Exception as e:  # noqa: BLE001 — same.
                         log.warning("Fused decision program failed (%s); "
@@ -1945,6 +2105,13 @@ class SaturationEngine:
                 # the capacity pass would provision against LAST tick's
                 # demand.
                 self.capacity.note_demand([])
+            # This path skips _apply_forecast (nothing to plan), but the
+            # gauge sweep must still run: a worker whose LAST owned model
+            # was deleted would otherwise export that model's forecast
+            # gauges forever (live groups stay protected via active).
+            self._sweep_forecast_gauges(
+                set(), {(vas[0].spec.model_id, vas[0].metadata.namespace)
+                        for vas in model_groups.values()})
             return []
 
         decisions: list[VariantDecision] = []
@@ -2118,6 +2285,7 @@ class SaturationEngine:
         fp_start = time.perf_counter()
         self._phase_seconds["prepare"] = (
             self._phase_seconds.get("prepare", 0.0) + fp_start - prep_start)
+        self._begin_phase_span("fingerprint")
         clean, fingerprints = self._partition_clean(
             owned, snap, collector, analyzer_name)
         self._prune_incremental_state(set(owned))
@@ -2126,6 +2294,7 @@ class SaturationEngine:
             "skipped": len(clean)}
         analyze_start = time.perf_counter()
         self._phase_seconds["fingerprint"] = analyze_start - fp_start
+        self._begin_phase_span("analyze")
 
         self._tick_coverage = {}
         if analyzer_name in (V2_ANALYZER_NAME, SLO_ANALYZER_NAME):
@@ -2198,7 +2367,16 @@ class SaturationEngine:
         from wva_tpu.health import InputHealth
 
         use_slo = analyzer_name == SLO_ANALYZER_NAME
-        tick = self.shard_plane.gather(model_groups, collector=collector)
+        tick = self.shard_plane.gather(model_groups, collector=collector,
+                                       spans=self.spans)
+        # Stitch: every worker's span subtree — stamped with (fleet tick
+        # id, shard id) in its ShardCapture — grafts under THIS tick's
+        # span, so a 4-shard fleet tick is still ONE trace.
+        if self.spans is not None and tick.spans:
+            self.spans.graft(tick.spans)
+        merge_span = (self.spans.begin_span("fleet_merge",
+                                            shards=len(tick.alive))
+                      if self.spans is not None else None)
 
         def section(records, name):
             return sorted((r for r in records if r[0] == name),
@@ -2305,6 +2483,8 @@ class SaturationEngine:
                 if hs.scraped is not None or hs.ready is not None:
                     self._tick_coverage[key] = (hs.scraped, hs.ready)
 
+        if self.spans is not None:
+            self.spans.end_span(merge_span, decisions=len(decisions))
         self._apply_limiter(decisions)
         return decisions
 
@@ -2472,9 +2652,10 @@ class SaturationEngine:
             # re-ordered on recovery) — per variant, so an unrelated
             # healthy variant's genuinely wedged order still expires on
             # its own trusted evidence.
-            event = self.capacity.tick(
-                slices=self._tick_slices,
-                hold_releases=self._tick_hold_variants)
+            with self._obs_span("capacity"):
+                event = self.capacity.tick(
+                    slices=self._tick_slices,
+                    hold_releases=self._tick_hold_variants)
         except Exception as e:  # noqa: BLE001 — capacity must never fail
             # the tick: decisions stand as computed.
             log.error("Capacity pass failed: %s", e)
@@ -2519,6 +2700,23 @@ class SaturationEngine:
                 LABEL_ACCELERATOR_TYPE: done["variant"],
                 LABEL_TIER: done["tier"],
             }, done["latency_seconds"])
+        # Gauge sweep (same discipline as the trend/forecast/health
+        # planes): a variant that left the ledger stops exporting its
+        # capacity gauges instead of freezing at the last value.
+        emitted_variants = {entry["variant"] for entry in event["ledger"]}
+        for variant in self._capacity_gauge_keys - emitted_variants:
+            vlabel = {LABEL_ACCELERATOR_TYPE: variant}
+            for state in ("ready", "provisioning", "preempted"):
+                registry.remove(WVA_CAPACITY_SLICES,
+                                {**vlabel, LABEL_STATE: state})
+            registry.remove(WVA_CAPACITY_CHIPS_EFFECTIVE, vlabel)
+            for tier in self.capacity.tier_preference:
+                registry.remove(WVA_CAPACITY_STOCKED_OUT,
+                                {**vlabel, LABEL_TIER: tier})
+                registry.remove(WVA_CAPACITY_PROVISION_LEAD_SECONDS,
+                                {**vlabel, LABEL_TIER: tier})
+            self._capacity_preempted_seen.pop(variant, None)
+        self._capacity_gauge_keys = emitted_variants
 
     def _apply_limiter(self, decisions: list[VariantDecision]) -> None:
         """Optional slice limiter, applied on EVERY analysis path (the
@@ -3429,9 +3627,14 @@ class SaturationEngine:
                     # stale-write guard — a decision newer than our read
                     # (mid-tick scale-from-zero wake) must win, not be
                     # reverted by this tick's pre-wake computation.
-                    _, persisted = \
-                        variant_utils.update_va_status_with_conflict_refetch(
-                            self.client, update_va, read_alloc=old_alloc)
+                    with self._obs_span("status_write",
+                                        variant=update_va.metadata.name,
+                                        namespace=update_va.metadata
+                                        .namespace):
+                        _, persisted = variant_utils\
+                            .update_va_status_with_conflict_refetch(
+                                self.client, update_va,
+                                read_alloc=old_alloc)
                 except NotFoundError:
                     continue
                 if (persisted

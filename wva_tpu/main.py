@@ -91,6 +91,8 @@ class Manager:
     elector: "LeaderElector | None" = None
     # Decision flight recorder (None = tracing disabled via config).
     flight_recorder: "FlightRecorder | None" = None
+    # Obs-plane span recorder (None = WVA_SPANS off).
+    spans: "object | None" = None
 
     _threads: list[threading.Thread] = None
     _last_election_tick: float = -1e18
@@ -214,6 +216,8 @@ class Manager:
             self.elector.release()
         if self.flight_recorder is not None:
             self.flight_recorder.close()
+        if self.spans is not None:
+            self.spans.close()
         if self.engine.shard_plane is not None:
             # Voluntary shard-lease step-down + worker pool release: a
             # clean shutdown hands every shard to a successor in ~one
@@ -508,6 +512,37 @@ def build_manager(
         # behind its own cadence — surfaced as wva_tick_overruns_total.
         ex.on_overrun = registry.observe_tick_overrun
 
+    # Obs plane (WVA_SPANS, default on; docs/design/observability.md):
+    # span-structured tick tracing with a slow-tick flight recorder and
+    # optional OTLP export. Strictly out-of-band — statuses, traces, and
+    # goldens are byte-identical with the lever off OR on; off builds no
+    # recorder at all (the off-lever is zero-cost, asserted by
+    # `make bench-spans`).
+    spans = None
+    obs_cfg = config.obs_config()
+    if obs_cfg.spans:
+        from wva_tpu.obs import SpanRecorder
+
+        spans = SpanRecorder(
+            clock=clock, ring_size=obs_cfg.spans_ring,
+            spill_path=obs_cfg.spans_path or None,
+            slow_tick_ms=obs_cfg.slow_tick_ms,
+            slow_dump_dir=obs_cfg.slow_dump_dir,
+            otlp_endpoint=obs_cfg.otlp_endpoint,
+            registry=registry, engine=engine.executor.name)
+        engine.spans = spans
+        if capacity is not None:
+            capacity.spans = spans
+        # Slow-tick flight recorder rides the overrun hook: a tick that
+        # outran its poll interval dumps the span tree that explains it.
+        def _engine_overrun(name: str,
+                            _observe=registry.observe_tick_overrun,
+                            _spans=spans) -> None:
+            _observe(name)
+            _spans.note_overrun(name)
+
+        engine.executor.on_overrun = _engine_overrun
+
     watch_ns = config.watch_namespace() or ""
     va_reconciler = VariantAutoscalingReconciler(client, datastore, indexer,
                                                  clock=clock, recorder=recorder,
@@ -550,5 +585,5 @@ def build_manager(
         engine=engine, scale_from_zero=scale_from_zero, fastpath=fastpath,
         va_reconciler=va_reconciler, configmap_reconciler=configmap_reconciler,
         pool_reconciler=pool_reconciler, capacity_store=capacity_store,
-        elector=elector, flight_recorder=flight,
+        elector=elector, flight_recorder=flight, spans=spans,
     )

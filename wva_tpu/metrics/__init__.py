@@ -49,8 +49,12 @@ from wva_tpu.constants import (
     WVA_CHECKPOINT_WRITES,
     WVA_INPUT_HEALTH,
     WVA_LEADER_EPOCH,
+    WVA_OTLP_EXPORTS_TOTAL,
     WVA_REPLICA_SCALING_TOTAL,
     WVA_SHARD_MODELS_OWNED,
+    WVA_SLOW_TICK_DUMPS_TOTAL,
+    WVA_SPANS_DROPPED_TOTAL,
+    WVA_SPANS_TICKS_TOTAL,
     WVA_SHARD_OWNER,
     WVA_SHARD_REBALANCE_TOTAL,
     WVA_SHARD_SUMMARY_AGE_SECONDS,
@@ -86,6 +90,11 @@ class MetricsRegistry:
         # (name, label key) -> (last mirrored value, at) for the
         # same-value mirror throttle (see set_gauge).
         self._mirrored: dict[tuple, tuple[float, float]] = {}
+        # (name, label key) -> {label: value} exemplar (span/trace ids
+        # from the obs plane). Rendered as comment lines next to the
+        # series — the classic text format has no exemplar syntax, and a
+        # trailing OpenMetrics exemplar would break classic parsers.
+        self._exemplars: dict[tuple, dict[str, str]] = {}
         self._series: dict[str, _Series] = {}
         self._register(WVA_REPLICA_SCALING_TOTAL, "counter",
                        "Total number of replica scaling operations")
@@ -195,6 +204,18 @@ class MetricsRegistry:
         self._register(WVA_SHARD_SUMMARY_AGE_SECONDS, "gauge",
                        "Age of the newest summary the fleet solve "
                        "consumed from each shard")
+        self._register(WVA_SPANS_TICKS_TOTAL, "counter",
+                       "Tick span trees committed by the obs-plane span "
+                       "recorder")
+        self._register(WVA_SPANS_DROPPED_TOTAL, "counter",
+                       "Spans or tick trees dropped by the span "
+                       "recorder, by reason")
+        self._register(WVA_SLOW_TICK_DUMPS_TOTAL, "counter",
+                       "Slow-tick flight-recorder dumps written (full "
+                       "span tree of an overrunning or over-threshold "
+                       "tick), by reason")
+        self._register(WVA_OTLP_EXPORTS_TOTAL, "counter",
+                       "OTLP/HTTP span exports, by outcome")
 
     def _register(self, name: str, kind: str, help_text: str) -> None:
         self._series[name] = _Series(name, kind, help_text)
@@ -260,8 +281,23 @@ class MetricsRegistry:
         not keep exporting their last value forever). The TSDB mirror is
         left alone — its retention sweep ages the series out naturally."""
         with self._mu:
-            return self._series[name].values.pop(self._key(labels),
-                                                 None) is not None
+            key = self._key(labels)
+            self._exemplars.pop((name, key), None)
+            return self._series[name].values.pop(key, None) is not None
+
+    def set_exemplar(self, name: str, labels: dict[str, str],
+                     exemplar: dict[str, str]) -> None:
+        """Attach an exemplar (span/trace ids) to one series label set.
+        Surfaced as a ``# exemplar:`` comment line in the text exposition
+        so operators can jump from a slow ``wva_tick_phase_seconds``
+        sample straight to the span that timed it."""
+        with self._mu:
+            self._exemplars[(name, self._key(labels))] = dict(exemplar)
+
+    def get_exemplar(self, name: str,
+                     labels: dict[str, str]) -> dict[str, str] | None:
+        with self._mu:
+            return self._exemplars.get((name, self._key(labels)))
 
     def emit_replica_metrics(self, variant_name: str, namespace: str,
                              accelerator: str, current: int, desired: int) -> None:
@@ -331,6 +367,23 @@ class MetricsRegistry:
         """Flight-recorder health: last spill write latency."""
         self.set_gauge(WVA_TRACE_WRITE_SECONDS, {}, seconds)
 
+    def observe_span_tick(self, engine: str) -> None:
+        """Obs plane: one committed tick span tree."""
+        self.inc_counter(WVA_SPANS_TICKS_TOTAL, {LABEL_ENGINE: engine})
+
+    def observe_span_drop(self, reason: str) -> None:
+        """Obs plane: a span or tick tree lost (ring eviction without
+        spill, spill error/backlog, encode error, span outside a tick)."""
+        self.inc_counter(WVA_SPANS_DROPPED_TOTAL, {LABEL_REASON: reason})
+
+    def observe_slow_tick_dump(self, reason: str) -> None:
+        """Obs plane: a slow-tick flight-recorder dump was written."""
+        self.inc_counter(WVA_SLOW_TICK_DUMPS_TOTAL, {LABEL_REASON: reason})
+
+    def observe_otlp_export(self, outcome: str) -> None:
+        """Obs plane: one OTLP export attempt (success|error|dropped)."""
+        self.inc_counter(WVA_OTLP_EXPORTS_TOTAL, {LABEL_OUTCOME: outcome})
+
     def record_scaling(self, variant_name: str, namespace: str, accelerator: str,
                        direction: str, reason: str) -> None:
         self.inc_counter(WVA_REPLICA_SCALING_TOTAL, {
@@ -353,6 +406,16 @@ class MetricsRegistry:
                     label_str = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
                     suffix = f"{{{label_str}}}" if label_str else ""
                     lines.append(f"{name}{suffix} {series.values[key]:g}")
+                    exemplar = self._exemplars.get((name, key))
+                    if exemplar:
+                        ex_str = ",".join(
+                            f'{k}="{_escape(str(v))}"'
+                            for k, v in sorted(exemplar.items()))
+                        # Comment line, not a trailing OpenMetrics
+                        # exemplar: classic-format parsers must keep
+                        # scraping this endpoint unchanged.
+                        lines.append(f"# exemplar: {name}{suffix} "
+                                     f"{{{ex_str}}}")
         return "\n".join(lines) + "\n"
 
 

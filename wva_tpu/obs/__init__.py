@@ -1,0 +1,34 @@
+"""Observability plane (docs/design/observability.md): hierarchical tick
+span recorder with cross-shard stitching (``spans``), slow-tick flight
+recorder, optional OTLP/HTTP export (``otlp``), structured JSON logging
+(``logjson``), and the ``wva explain`` decision-provenance CLI
+(``explain``).
+
+PEP-562 lazy like ``wva_tpu.capacity``: the explain CLI must import
+without pulling the recorder's threading machinery, and nothing here may
+ever import JAX.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "SpanRecorder": ("wva_tpu.obs.spans", "SpanRecorder"),
+    "Span": ("wva_tpu.obs.spans", "Span"),
+    "OtlpExporter": ("wva_tpu.obs.otlp", "OtlpExporter"),
+    "to_otlp": ("wva_tpu.obs.otlp", "to_otlp"),
+    "JsonLogFormatter": ("wva_tpu.obs.logjson", "JsonLogFormatter"),
+    "explain_cli": ("wva_tpu.obs.explain", "explain_cli"),
+    "explain_model": ("wva_tpu.obs.explain", "explain_model"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)

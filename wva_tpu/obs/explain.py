@@ -1,0 +1,258 @@
+"""``python -m wva_tpu explain <model>`` — decision provenance from a
+recorded DecisionTrace (docs/design/observability.md §explain).
+
+Walks the newest trace cycle that decided the model and prints, per
+variant, the causal chain of the final desired-replica number through the
+pipeline: analyzer -> optimizer -> enforcer -> forecast floor -> limiter
+-> health / boot / rebalance clamp — each stage's target and reason, with
+the stage that LAST moved the number called out. The chain comes from the
+``decision_steps`` every pipeline stage already appends (the same records
+replay verifies byte-for-byte), cross-referenced with the cycle's stage
+events (forecast floors, health clamps, fingerprint skips) for the "why".
+
+No cluster, no Prometheus, no JAX — this must work on a laptop against a
+downloaded trace file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+def _load_cycles(path: str) -> list[dict]:
+    cycles = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                cycles.append(json.loads(line))
+            except ValueError:
+                continue
+    return cycles
+
+
+def _cycle_mentions(cycle: dict, model: str, namespace: str) -> bool:
+    def ns_ok(ns: str) -> bool:
+        return not namespace or ns == namespace
+
+    for d in cycle.get("decisions", ()):
+        if d.get("model_id") == model and ns_ok(d.get("namespace", "")):
+            return True
+    for m in cycle.get("models", ()):
+        if m.get("model_id") == model and ns_ok(m.get("namespace", "")):
+            return True
+    return False
+
+
+def _stage_events(cycle: dict, stage: str) -> list[dict]:
+    return [s for s in cycle.get("stages", ())
+            if s.get("stage") == stage]
+
+
+def _health_clamp_for(cycle: dict, namespace: str,
+                      variant: str) -> dict | None:
+    for ev in _stage_events(cycle, "health"):
+        for clamp in ev.get("clamps", ()):
+            if (clamp.get("namespace") == namespace
+                    and clamp.get("variant_name") == variant):
+                return clamp
+    return None
+
+
+def _health_state_for(cycle: dict, model: str,
+                      namespace: str) -> dict | None:
+    for ev in _stage_events(cycle, "health"):
+        for st in ev.get("states", ()):
+            if (st.get("model_id") == model
+                    and st.get("namespace") == namespace):
+                return st
+    return None
+
+
+def _floor_for(cycle: dict, namespace: str, variant: str) -> dict | None:
+    for ev in _stage_events(cycle, "forecast"):
+        for floor in ev.get("floors", ()):
+            if (floor.get("namespace") == namespace
+                    and floor.get("variant_name") == variant):
+                return floor
+    return None
+
+
+def _was_skipped(cycle: dict, model: str, namespace: str) -> bool:
+    for ev in _stage_events(cycle, "fingerprint_skip"):
+        if (ev.get("model_id") == model
+                and (not namespace or ev.get("namespace") == namespace)):
+            return True
+    return False
+
+
+def explain_decision(cycle: dict, decision: dict) -> dict:
+    """One variant's provenance: the step chain annotated with which step
+    moved the running target, and the last mover (= the stage that set
+    the final desired number)."""
+    model = decision.get("model_id", "")
+    ns = decision.get("namespace", "")
+    variant = decision.get("variant_name", "")
+    current = int(decision.get("current_replicas", 0))
+    steps = []
+    running = current
+    last_mover = None
+    for step in decision.get("decision_steps", ()):
+        target = int(step.get("target_replicas", running))
+        moved = target != running
+        entry = {
+            "stage": step.get("name", ""),
+            "target_replicas": target,
+            "moved": moved,
+            "constrained": bool(step.get("was_constrained", False)),
+            "reason": step.get("reason", ""),
+        }
+        if moved:
+            last_mover = entry
+        running = target
+        steps.append(entry)
+    final = int(decision.get("target_replicas", running))
+    if last_mover is None and steps:
+        # Nothing moved the number off current: the analyzer's first word
+        # WAS the final word.
+        last_mover = steps[0]
+    out = {
+        "model_id": model,
+        "namespace": ns,
+        "variant_name": variant,
+        "accelerator": decision.get("accelerator_name", ""),
+        "current_replicas": current,
+        "final_desired": final,
+        "action": decision.get("action", ""),
+        "steps": steps,
+        "set_by": last_mover["stage"] if last_mover else "",
+        "set_by_reason": last_mover["reason"] if last_mover else "",
+    }
+    clamp = _health_clamp_for(cycle, ns, variant)
+    if clamp is not None:
+        out["health_clamp"] = {"state": clamp.get("state", ""),
+                               "reason": clamp.get("reason", "")}
+    floor = _floor_for(cycle, ns, variant)
+    if floor is not None:
+        out["forecast_floor"] = {
+            "floor_replicas": floor.get("floor_replicas", 0),
+            "reason": floor.get("reason", "")}
+    state = _health_state_for(cycle, model, ns)
+    if state is not None:
+        out["input_health"] = state.get("state", "")
+    return out
+
+
+def explain_model(cycles: list[dict], model: str, namespace: str = "",
+                  cycle_id: int | None = None) -> dict | None:
+    """Newest (or ``cycle_id``) cycle's provenance for every variant of
+    the model. None when no cycle decided the model."""
+    chosen = None
+    for cycle in reversed(cycles):
+        if cycle_id is not None and cycle.get("cycle") != cycle_id:
+            continue
+        if _cycle_mentions(cycle, model, namespace):
+            chosen = cycle
+            break
+    if chosen is None:
+        return None
+    variants = [
+        explain_decision(chosen, d) for d in chosen.get("decisions", ())
+        if d.get("model_id") == model
+        and (not namespace or d.get("namespace") == namespace)]
+    return {
+        "model_id": model,
+        "cycle": chosen.get("cycle"),
+        "ts": chosen.get("ts"),
+        "engine": chosen.get("engine", ""),
+        "analyzer": chosen.get("analyzer", ""),
+        "outcome": chosen.get("outcome", ""),
+        "reemitted": _was_skipped(chosen, model, namespace),
+        "variants": variants,
+    }
+
+
+def _print_text(report: dict, out) -> None:
+    head = (f"model {report['model_id']} — cycle {report['cycle']} "
+            f"@ ts {report['ts']} ({report['engine']}, "
+            f"analyzer={report['analyzer'] or 'v1'}, "
+            f"outcome={report['outcome']})")
+    print(head, file=out)
+    if report["reemitted"]:
+        print("  note: input fingerprint unchanged this cycle — the "
+              "decisions below were re-emitted from the cycle that "
+              "computed them", file=out)
+    for v in report["variants"]:
+        ns_variant = f"{v['namespace']}/{v['variant_name']}"
+        print(f"\nvariant {ns_variant} ({v['accelerator'] or '?'}): "
+              f"current {v['current_replicas']} -> final desired "
+              f"{v['final_desired']} [{v['action']}]", file=out)
+        if v.get("input_health"):
+            print(f"  input health this cycle: {v['input_health']}",
+                  file=out)
+        for step in v["steps"]:
+            marker = "->" if step["moved"] else "  "
+            constrained = " (constrained)" if step["constrained"] else ""
+            print(f"  {marker} {step['stage']:<24} "
+                  f"{step['target_replicas']:>4}{constrained}  "
+                  f"{step['reason']}", file=out)
+        if v.get("forecast_floor"):
+            f = v["forecast_floor"]
+            print(f"  forecast floor in play: {f['floor_replicas']} "
+                  f"({f['reason']})", file=out)
+        if v.get("health_clamp"):
+            c = v["health_clamp"]
+            print(f"  health clamp in play: state={c['state']} "
+                  f"({c['reason']})", file=out)
+        print(f"  final desired set by: {v['set_by']}"
+              + (f' — "{v["set_by_reason"]}"' if v["set_by_reason"]
+                 else ""), file=out)
+
+
+def explain_cli(argv: list[str] | None = None, out=None) -> int:
+    out = out or sys.stdout
+    p = argparse.ArgumentParser(
+        prog="wva-tpu explain",
+        description="Print the causal chain of a model's latest desired-"
+                    "replica decision from a recorded decision trace.")
+    p.add_argument("model", help="model id (spec.modelID), e.g. "
+                                 "meta-llama/Llama-3.1-8B")
+    p.add_argument("--trace", default=os.environ.get("WVA_TRACE_PATH", ""),
+                   help="decision-trace JSONL path (default: "
+                        "$WVA_TRACE_PATH)")
+    p.add_argument("--namespace", default="",
+                   help="restrict to one namespace")
+    p.add_argument("--cycle", type=int, default=None,
+                   help="explain this cycle id instead of the newest")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    args = p.parse_args(argv)
+    if not args.trace:
+        print("error: no trace file (--trace or WVA_TRACE_PATH)",
+              file=sys.stderr)
+        return 2
+    try:
+        cycles = _load_cycles(args.trace)
+    except OSError as e:
+        print(f"error: cannot read {args.trace}: {e}", file=sys.stderr)
+        return 2
+    if not cycles:
+        print(f"error: no cycles in {args.trace}", file=sys.stderr)
+        return 2
+    report = explain_model(cycles, args.model, args.namespace, args.cycle)
+    if report is None:
+        known = sorted({d.get("model_id", "")
+                        for c in cycles for d in c.get("decisions", ())})
+        print(f"error: no cycle in {args.trace} decided model "
+              f"{args.model!r}; models seen: {', '.join(known) or '-'}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report, sort_keys=True), file=out)
+    else:
+        _print_text(report, out)
+    return 0

@@ -1,0 +1,90 @@
+"""Structured logging option (``WVA_LOG_FORMAT=json``).
+
+Routes the existing stdlib ``logging`` loggers through a JSON formatter:
+one object per line with ``ts`` / ``level`` / ``logger`` / ``message``,
+plus whatever tick context the control plane has declared — the engine
+stamps the current tick id (and shard id in shard-worker role) around
+``optimize()``, and the per-model analysis stamps the model being
+analyzed, so a grep for one model's id finds every log line its analysis
+produced. The plain format stays the default and is byte-identical to
+pre-change logs: context is only COLLECTED while the JSON formatter is
+installed (``ACTIVE`` below), so the default path does zero extra work.
+
+Context is thread-local on purpose: the per-model analysis pool runs
+models on worker threads, and each worker's lines must carry ITS model,
+not whichever model the engine thread touched last.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+
+# Flipped by install(); the engine checks it before stamping context so
+# the default plain format pays nothing.
+ACTIVE = False
+
+_local = threading.local()
+
+
+def set_context(**fields) -> None:
+    """Merge fields into the calling thread's log context (None deletes)."""
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        ctx = _local.ctx = {}
+    for k, v in fields.items():
+        if v is None:
+            ctx.pop(k, None)
+        else:
+            ctx[k] = v
+
+
+def clear_context(*fields) -> None:
+    """Drop the named fields (or everything, with no args)."""
+    ctx = getattr(_local, "ctx", None)
+    if not ctx:
+        return
+    if not fields:
+        ctx.clear()
+        return
+    for k in fields:
+        ctx.pop(k, None)
+
+
+def current_context() -> dict:
+    return dict(getattr(_local, "ctx", None) or {})
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per record. Exceptions render as a ``exc`` string
+    field; non-serializable extras degrade to ``repr`` — a log line must
+    never raise."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        out.update(getattr(_local, "ctx", None) or {})
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        try:
+            return json.dumps(out, sort_keys=True, default=repr)
+        except (TypeError, ValueError):
+            return json.dumps({"ts": round(record.created, 6),
+                               "level": "ERROR", "logger": __name__,
+                               "message": "unserializable log record"})
+
+
+def install(root: logging.Logger | None = None) -> None:
+    """Swap every handler's formatter on the (root) logger for the JSON
+    formatter and start collecting tick context."""
+    global ACTIVE
+    root = root or logging.getLogger()
+    formatter = JsonLogFormatter()
+    for handler in root.handlers:
+        handler.setFormatter(formatter)
+    ACTIVE = True

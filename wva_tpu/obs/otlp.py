@@ -1,0 +1,139 @@
+"""Optional OTLP/HTTP JSON export of tick span trees (no new dependency:
+stdlib ``urllib`` against ``WVA_OTLP_ENDPOINT``, e.g. an OpenTelemetry
+collector's ``http://host:4318/v1/traces``).
+
+Export is strictly fire-and-forget on a background thread behind a
+bounded queue — the engine tick hands a finished tree over and moves on;
+a slow or dead collector fills the queue and trees drop (counted), never
+blocking the control loop. Trace/span ids are deterministic hex digests
+of the recorder's readable ids, so the same simulated world exports the
+same OTLP ids.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import queue
+import threading
+import urllib.request
+
+log = logging.getLogger(__name__)
+
+EXPORT_QUEUE_SIZE = 64
+EXPORT_TIMEOUT_SECONDS = 2.0
+
+_SERVICE_NAME = "wva-tpu"
+
+
+def _hex_id(text: str, nbytes: int) -> str:
+    """Deterministic OTLP id: first ``nbytes`` of sha1(text), hex."""
+    return hashlib.sha1(text.encode()).hexdigest()[: nbytes * 2]
+
+
+def _flatten(tree: dict, trace_id: str, parent_hex: str,
+             out: list[dict]) -> None:
+    span_hex = _hex_id(f"{trace_id}/{tree.get('span_id', '')}", 8)
+    start_ns = int(tree.get("ts", 0.0) * 1e9)
+    end_ns = start_ns + int(tree.get("dur_ms", 0.0) * 1e6)
+    attrs = [{"key": k, "value": {"stringValue": str(v)}}
+             for k, v in sorted((tree.get("attrs") or {}).items())]
+    attrs.append({"key": "wva.span_id",
+                  "value": {"stringValue": tree.get("span_id", "")}})
+    span = {
+        "traceId": _hex_id(trace_id, 16),
+        "spanId": span_hex,
+        "name": tree.get("name", ""),
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(start_ns),
+        "endTimeUnixNano": str(end_ns),
+        "attributes": attrs,
+    }
+    if parent_hex:
+        span["parentSpanId"] = parent_hex
+    out.append(span)
+    for child in tree.get("children", ()):
+        _flatten(child, trace_id, span_hex, out)
+
+
+def to_otlp(tree: dict) -> dict:
+    """One tick tree -> an OTLP/JSON ExportTraceServiceRequest body."""
+    spans: list[dict] = []
+    _flatten(tree, tree.get("trace_id", ""), "", spans)
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [{
+                "key": "service.name",
+                "value": {"stringValue": _SERVICE_NAME},
+            }]},
+            "scopeSpans": [{
+                "scope": {"name": "wva_tpu.obs"},
+                "spans": spans,
+            }],
+        }],
+    }
+
+
+class OtlpExporter:
+    """Bounded-queue background exporter. ``submit`` never blocks."""
+
+    def __init__(self, endpoint: str, registry=None,
+                 post=None) -> None:
+        self.endpoint = endpoint
+        self.registry = registry
+        # Injectable transport for tests: post(body_bytes) -> None.
+        self._post = post or self._http_post
+        self.exported_total = 0
+        self.failed_total = 0
+        self._queue: queue.Queue = queue.Queue(maxsize=EXPORT_QUEUE_SIZE)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="otlp-exporter", daemon=True)
+        self._thread.start()
+
+    def submit(self, tree: dict) -> None:
+        try:
+            self._queue.put_nowait(tree)
+        except queue.Full:
+            self._observe("dropped")
+            log.debug("OTLP export queue full; tick tree dropped")
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                tree = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                body = json.dumps(to_otlp(tree)).encode()
+                self._post(body)
+                self.exported_total += 1
+                self._observe("success")
+            except Exception as e:  # noqa: BLE001 — export must never bite
+                self.failed_total += 1
+                self._observe("error")
+                log.debug("OTLP export to %s failed: %s", self.endpoint, e)
+            finally:
+                self._queue.task_done()
+
+    def _http_post(self, body: bytes) -> None:
+        req = urllib.request.Request(
+            self.endpoint, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req,
+                                    timeout=EXPORT_TIMEOUT_SECONDS) as resp:
+            resp.read()
+
+    def _observe(self, outcome: str) -> None:
+        if self.registry is not None:
+            try:
+                self.registry.observe_otlp_export(outcome)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def flush(self) -> None:
+        self._queue.join()
+
+    def close(self) -> None:
+        self._stop.set()

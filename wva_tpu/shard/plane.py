@@ -83,6 +83,10 @@ class PlaneTick:
     holds_opened: list[str] = field(default_factory=list)
     stale: list[int] = field(default_factory=list)
     uncovered: list[str] = field(default_factory=list)
+    # Worker span subtrees (obs plane): each capture's serialized tick
+    # tree, stamped (fleet tick id, shard id) — the fleet engine grafts
+    # them under its own tick span (docs/design/observability.md).
+    spans: list = field(default_factory=list)
 
 
 class ShardWorker:
@@ -93,9 +97,23 @@ class ShardWorker:
         self.engine = engine
         self.dead = False
         self.last_analyze_seconds = 0.0
+        # Lazily-built span recorder for this worker's analysis ticks
+        # (obs plane): created only when the FLEET records spans, records
+        # under the fleet's adopted (tick id, shard id) context, and the
+        # resulting subtree ships in the ShardCapture. Ring of 2 — the
+        # fleet grafts each tree the same tick it was recorded.
+        self._spans = None
+
+    def _ensure_spans(self, clock: Clock):
+        if self._spans is None:
+            from wva_tpu.obs.spans import SpanRecorder
+
+            self._spans = SpanRecorder(clock=clock, ring_size=2)
+        return self._spans
 
     def analyze(self, owned_model_ids: frozenset, epoch: int,
-                clock: Clock, collector=None) -> ShardCapture:
+                clock: Clock, collector=None,
+                fleet_spans=None) -> ShardCapture:
         """One worker analysis tick over the owned partition. The engine's
         flight recorder is swapped for a TraceBuffer so every record the
         unsharded engine would have emitted is captured, section-tagged,
@@ -113,6 +131,14 @@ class ShardWorker:
         eng.enforcer.flight_recorder = buf
         eng.optimizer.flight_recorder = buf
         eng.tick_collector_override = collector
+        wrec = None
+        if fleet_spans is not None:
+            # Record this worker tick under the FLEET's span context:
+            # the subtree ships in the capture, stamped (fleet tick id,
+            # shard id), and the fleet grafts it under its tick span.
+            wrec = self._ensure_spans(clock)
+            wrec.adopt(fleet_spans.trace_id, self.shard_id)
+            eng.spans = wrec
         t0 = time.perf_counter()
         try:
             eng.optimize()
@@ -123,7 +149,10 @@ class ShardWorker:
             eng.enforcer.flight_recorder = None
             eng.optimizer.flight_recorder = None
             eng.tick_collector_override = None
+            eng.spans = None
         cap.trace = buf.records
+        if wrec is not None:
+            cap.spans, cap.span_ctx = wrec.take_capture_spans()
         return cap
 
 
@@ -154,7 +183,8 @@ class ShardPlane:
 
     # --- fleet-tick entry point ---
 
-    def gather(self, model_groups: dict, collector=None) -> PlaneTick:
+    def gather(self, model_groups: dict, collector=None,
+               spans=None) -> PlaneTick:
         now = self.clock.now()
         # Warm the fleet's shared tick view ONCE before any worker's timed
         # analysis: the fleet-wide grouped evaluations (O(series) — what a
@@ -240,7 +270,8 @@ class ShardPlane:
                 epoch = self.leases.fencing_token(shard)
                 if epoch is not None:
                     cap = worker.analyze(owned, epoch, self.clock,
-                                         collector=collector)
+                                         collector=collector,
+                                         fleet_spans=spans)
                     self.bus.publish(cap)
                     self.last_worker_seconds[shard] = \
                         worker.last_analyze_seconds
@@ -262,6 +293,7 @@ class ShardPlane:
             for key, hs in cap.health.items():
                 tick.health[key] = hs
             tick.trace.extend(cap.trace)
+            tick.spans.extend(cap.spans)
             tick.plans.extend(cap.plans)
             tick.floors.extend(cap.floors)
             tick.raised += cap.floors_raised

@@ -159,13 +159,25 @@ class ShardCapture:
     trace: list = field(default_factory=list)   # TraceBuffer.records
     analyzed: int = 0
     skipped: int = 0
+    # Obs plane: this worker tick's serialized span tree(s) plus the
+    # (fleet tick id, shard id) context they were recorded under — the
+    # fleet grafts them into ONE stitched fleet-tick trace. Empty when
+    # spans are off (the payload then stays byte-identical to pre-obs
+    # builds).
+    spans: list = field(default_factory=list)
+    span_ctx: list = field(default_factory=list)
 
 
 def capture_to_payload(cap: ShardCapture) -> dict:
     """Canonical JSON-able form for the ConfigMap transport. Decisions and
     plans serialize through the blackbox encoder; the in-process bus skips
     this entirely (references cross no process boundary there)."""
+    payload_extra = {}
+    if cap.spans:
+        payload_extra["spans"] = list(cap.spans)
+        payload_extra["span_ctx"] = list(cap.span_ctx)
     return {
+        **payload_extra,
         "schema": SUMMARY_SCHEMA_VERSION,
         "shard_id": cap.shard_id,
         "epoch": cap.epoch,
@@ -215,6 +227,8 @@ def payload_to_capture(data: dict) -> ShardCapture:
         floors=list(data.get("floors", [])),
         floors_raised=int(data.get("floors_raised", 0)),
         trace=[tuple(r) for r in data.get("trace", [])],
+        spans=list(data.get("spans", [])),
+        span_ctx=list(data.get("span_ctx", [])),
     )
     for k, e in (data.get("entries") or {}).items():
         cap.entries[k] = ModelEntry(
