@@ -103,6 +103,10 @@ bench-shard: ## Sharded active-active engine bench (480-model world, 4 consisten
 bench-spans: ## Obs-plane A/B (48 + 480 models): quiet-tick p50 with WVA_SPANS on vs off (overhead target < 3%; the off lever is asserted zero-cost — no recorder built) plus the 4-shard stitched fleet-tick span-tree assertion; merges detail.obs_plane into BENCH_LOCAL.json.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --spans-only
 
+.PHONY: bench-sweep
+bench-sweep: ## Vectorized policy-sweep bench (wva_tpu/sweep): >=1024 (seed x knob) emulated worlds advanced by a handful of jitted scan dispatches; asserts the dispatch budget (measured ~0.03 dispatches/step vs the ~1/step bound), >=20x throughput vs the per-world Python loop at batch 256, the event-world fidelity gate, and a non-empty trust-gated knob recommendation; merges detail.sweep into BENCH_LOCAL.json. SWEEP_SMOKE=1 runs the short CI shape (smoke grid; same gates minus the throughput floor).
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --sweep-only $(if $(SWEEP_SMOKE),--smoke)
+
 .PHONY: verify-deploy-pipeline
 verify-deploy-pipeline: ## Static-check the deploy pipeline (scripts parse, manifests render, Dockerfile paths exist).
 	$(PYTHON) -m pytest tests/test_deploy_pipeline.py -x -q

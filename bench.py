@@ -2937,6 +2937,133 @@ def shard_main() -> None:
     }))
 
 
+def sweep_plane_bench(smoke: bool = False) -> dict:
+    """The vectorized-sweep plane (docs/design/sweep.md): advance >=1024
+    (seed x knob) emulated worlds in a handful of jitted scan dispatches,
+    assert the dispatch budget (~1 per step at most; measured far under),
+    quote the measured throughput against the per-world Python loop at
+    batch 256, run the event-world fidelity gate, and emit the
+    attainment-vs-cost frontier + a trust-gated recommendation."""
+    from wva_tpu.emulator import loadgen
+    from wva_tpu.sweep import knobs as kb
+    from wva_tpu.sweep import search
+    from wva_tpu.sweep.fidelity import fidelity_check
+    from wva_tpu.sweep.world import (WorldParams, rate_table,
+                                     run_world_python, run_worlds)
+    from wva_tpu.utils import dispatch
+
+    # The sweep scenario: the bench trapezoid's shape at a sweep scale.
+    params = WorldParams(horizon_s=1200.0)
+    prof = loadgen.trapezoid(4.0, 40.0, 300.0, 420.0, 180.0,
+                             tail=120.0, delay=180.0)
+    lam = rate_table([prof], params)
+    grid = "smoke" if smoke else "default"
+    n_train, n_holdout = (2, 3) if smoke else (32, 8)
+
+    d0 = dispatch.count()
+    t0 = time.time()
+    report = search.run_sweep(params, lam, [MODEL], algo="grid",
+                              grid=grid, n_train=n_train,
+                              n_holdout=n_holdout, chunk=256)
+    sweep_wall = time.time() - t0
+    dispatches = dispatch.count() - d0
+    worlds = report["worlds_evaluated"]
+    holdout_worlds = 2 * n_holdout  # candidate + incumbent pairs
+    steps = params.steps
+    if not smoke:
+        assert worlds >= 1024, \
+            f"sweep bench must evaluate >=1024 worlds, got {worlds}"
+    # The acceptance bound: ~1 device dispatch per step. Measured: one
+    # dispatch per (chunk x whole horizon), so dispatches/steps is far
+    # below 1 even counting the holdout pass.
+    assert dispatches <= steps, \
+        f"{dispatches} dispatches for a {steps}-step horizon"
+
+    # Throughput vs the per-world Python loop: both sides receive the
+    # SAME precomputed seeded inputs (arrival/fault tables are shared
+    # scenario data, built once outside both timers); vectorized
+    # per-world time from a fresh 256-world batch (steady-state: the
+    # program is already compiled above), Python per-world time from a
+    # sampled subset of the same batch.
+    from wva_tpu.sweep.world import arrivals_table, fault_table
+    train_seeds = report["seeds"]["train"]
+    batch_points = (kb.grid_points(grid) * 256)[:256]
+    batch_seeds = [train_seeds[i % len(train_seeds)] for i in range(256)]
+    arr = arrivals_table(batch_seeds, lam, params)
+    flt = fault_table(batch_seeds, lam.shape[0], params)
+    t0 = time.time()
+    run_worlds(params, batch_points, batch_seeds, lam, chunk=256,
+               arrivals=arr, faults=flt)
+    vec_per_world_s = (time.time() - t0) / 256.0
+    n_py = 2 if smoke else 8
+    t0 = time.time()
+    for i in range(n_py):
+        run_world_python(params, batch_points[i], lam, arr[i], flt[i])
+    py_per_world_s = (time.time() - t0) / n_py
+    speedup = py_per_world_s / max(vec_per_world_s, 1e-12)
+    if not smoke:
+        assert speedup >= 20.0, \
+            f"vectorized sweep only {speedup:.1f}x vs Python loop"
+
+    fidelity = fidelity_check()
+    assert fidelity["within_tolerance"], (
+        "fluid world outside fidelity tolerance: "
+        f"attainment delta {fidelity['attainment_delta_abs']}, "
+        f"chip-seconds rel {fidelity['chip_seconds_delta_rel']}")
+
+    rec = report["recommendations"][MODEL]
+    assert rec["applied_knobs"], "empty recommendation"
+    assert rec["trust"]["evals"] >= 3 and rec["trust"]["trusted"], (
+        f"recommendation failed the trust gate: {rec['trust']}")
+
+    return {
+        "grid": grid,
+        "worlds_evaluated": worlds,
+        "holdout_worlds": holdout_worlds,
+        "horizon_steps": steps,
+        "device_dispatches": dispatches,
+        "dispatches_per_step": round(dispatches / steps, 4),
+        "vectorized_per_world_ms": round(vec_per_world_s * 1000.0, 3),
+        "python_loop_per_world_ms": round(py_per_world_s * 1000.0, 3),
+        "python_loop_worlds_sampled": n_py,
+        "speedup_vs_python_loop": round(speedup, 1),
+        "sweep_wall_seconds": round(sweep_wall, 1),
+        "fidelity": fidelity,
+        "recommendation": {
+            "model": MODEL,
+            "applied_knobs": rec["applied_knobs"],
+            "train_objective": rec["train_objective"],
+            "trust": {k: rec["trust"][k]
+                      for k in ("trusted", "evals", "ewma_regret",
+                                "reason")},
+        },
+        "frontier": rec["frontier"],
+    }
+
+
+def sweep_main() -> None:
+    """`make bench-sweep` / `bench.py --sweep-only`: the vectorized
+    policy-sweep bench, merged into BENCH_LOCAL.json detail.sweep.
+    `--smoke` (SWEEP_SMOKE=1) runs the short CI shape (smoke grid, 2
+    train seeds) but still asserts the fidelity gate, the dispatch
+    budget, and a non-empty trust-gated recommendation."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    smoke = "--smoke" in sys.argv
+    t0 = time.time()
+    record = sweep_plane_bench(smoke=smoke)
+    record["bench_wall_seconds"] = round(time.time() - t0, 1)
+    if not smoke:
+        _merge_bench_local("sweep", record)
+    print(json.dumps({
+        "metric": f"vectorized_sweep_{record['worlds_evaluated']}_worlds"
+                  "_vs_python_loop",
+        "value": record["speedup_vs_python_loop"],
+        "unit": "x_throughput_vs_per_world_python_loop",
+        "vs_baseline": record["speedup_vs_python_loop"],
+        "detail": record,
+    }))
+
+
 def profile_main() -> None:
     """`make bench-profile`: cProfile one quiet-tick bench run and dump the
     top-N hot call sites by cumulative time (the tool that found the
@@ -2996,5 +3123,7 @@ if __name__ == "__main__":
         shard_main()
     elif "--spans-only" in sys.argv:
         spans_main()
+    elif "--sweep-only" in sys.argv:
+        sweep_main()
     else:
         main()

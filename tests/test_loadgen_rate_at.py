@@ -1,0 +1,99 @@
+"""Pure vectorizable ``rate_at`` forms on every loadgen profile
+(wva_tpu/emulator/loadgen.py).
+
+The vectorized sweep world samples load as rate FUNCTIONS on numpy
+grids; the event-driven emulator calls the same profiles as scalar
+closures per arrival. The contract is BYTE-EQUALITY: for every profile,
+``rate_at(grid)[i]`` must equal ``profile(grid[i])`` bit-for-bit (same
+IEEE-double operation sequence, branchless ``where`` chains mirroring
+the scalar branch order) — so the fluid world and the event world read
+the exact same demand curve, not an approximation of it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from wva_tpu.emulator import loadgen
+
+HORIZON = 2400.0
+
+
+def _grid(seed: int = 0, horizon: float = HORIZON) -> np.ndarray:
+    """Mixed grid: regular step midpoints + seeded uniform instants +
+    adversarial phase-boundary hits."""
+    rng = np.random.Generator(np.random.Philox(key=seed))
+    regular = (np.arange(int(horizon / 5.0)) + 0.5) * 5.0
+    random_pts = rng.uniform(0.0, horizon, size=4000)
+    edges = np.array([0.0, 180.0, 480.0, 900.0, 1080.0, 1200.0,
+                      179.999999, 180.000001, horizon])
+    return np.concatenate([regular, random_pts, edges])
+
+
+def _profiles() -> list[tuple[str, object]]:
+    return [
+        ("constant", loadgen.constant(7.5)),
+        ("step", loadgen.step_profile([(0.0, 4.0), (300.0, 20.0),
+                                       (900.0, 6.0)])),
+        ("ramp", loadgen.ramp(4.0, 90.0, 300.0, delay=180.0)),
+        ("trapezoid", loadgen.trapezoid(4.0, 90.0, 300.0, 1200.0, 300.0,
+                                        tail=300.0, delay=180.0)),
+        ("diurnal", loadgen.diurnal(5.0, 40.0, 1200.0, phase=90.0)),
+        ("preemption_storm", loadgen.preemption_storm(
+            4.0, 60.0, burst_duration=90.0, mean_gap=300.0,
+            horizon=HORIZON, seed=7)[0]),
+        ("chaos_storm", loadgen.chaos_storm(
+            4.0, 50.0, burst_duration=60.0, mean_gap=240.0,
+            horizon=HORIZON, seed=11)[0]),
+    ]
+
+
+@pytest.mark.parametrize("name,prof", _profiles(),
+                         ids=[n for n, _ in _profiles()])
+def test_rate_at_byte_equals_scalar_closure(name, prof):
+    t = _grid()
+    vec = np.asarray(prof.rate_at(t), dtype=np.float64)
+    scalar = np.array([float(prof(x)) for x in t])
+    # Byte-equality, not allclose: the vector form must run the same
+    # IEEE operation sequence as the scalar closure.
+    mismatch = np.nonzero(vec != scalar)[0]
+    assert mismatch.size == 0, (
+        f"{name}: {mismatch.size} mismatches, first at t={t[mismatch[0]]}"
+        f" vec={vec[mismatch[0]]!r} scalar={scalar[mismatch[0]]!r}")
+
+
+def test_poisson_bursts_rate_at_matches_with_horizon():
+    prof = loadgen.poisson_bursts(4.0, 60.0, burst_duration=90.0,
+                                  mean_gap=300.0, seed=13)
+    t = _grid(seed=13)
+    vec = np.asarray(prof.rate_at(t, horizon=HORIZON), dtype=np.float64)
+    scalar = np.array([float(prof(x)) for x in t])
+    assert np.array_equal(vec, scalar)
+
+
+def test_spike_profile_rate_at():
+    prof = loadgen.SpikeProfile(idle_until=600.0, spike_rate=80.0,
+                                spike_duration=120.0)
+    t = _grid(seed=3)
+    vec = np.asarray(prof.rate_at(t), dtype=np.float64)
+    scalar = np.array([float(prof(x)) for x in t])
+    assert np.array_equal(vec, scalar)
+
+
+def test_rate_at_accepts_scalar_and_keeps_float_semantics():
+    prof = loadgen.trapezoid(4.0, 90.0, 300.0, 1200.0, 300.0,
+                             tail=300.0, delay=180.0)
+    for x in (0.0, 181.0, 500.0, 2000.0, 2399.0):
+        assert float(prof.rate_at(np.asarray(x))) == float(prof(x))
+
+
+def test_rate_at_works_under_jax_numpy():
+    jnp = pytest.importorskip("jax.numpy")
+    prof = loadgen.diurnal(5.0, 40.0, 1200.0, phase=90.0)
+    t = np.linspace(0.0, HORIZON, 257)
+    got = np.asarray(prof.rate_at(jnp.asarray(t)), dtype=np.float64)
+    want = np.array([float(prof(x)) for x in t])
+    # jax.numpy runs float32 by default — tolerance, not byte-equality,
+    # is the contract on device; byte-equality is numpy-side.
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)

@@ -1,0 +1,91 @@
+"""Seeded-schedule helpers (wva_tpu/utils/seeds.py) — the CRC32-keyed
+determinism disciplines hoisted out of emulator/faults.py and
+emulator/loadgen.py.
+
+The hoist contract is BYTE-IDENTITY: every schedule the fault plane and
+the storm profiles generated before the hoist must come out bit-for-bit
+the same after it (golden traces and chaos replays depend on it). The
+hardcoded expectations below were produced by the pre-hoist code.
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+
+from wva_tpu.emulator.faults import (_seeded_instants, seeded_restarts,
+                                     seeded_shard_crashes)
+from wva_tpu.utils import seeds
+
+
+class TestCrcKey:
+    def test_matches_raw_zlib_recipe(self):
+        # The discipline everywhere in the repo: crc32(repr(key-tuple)).
+        for key in [(7,), (7, "phase", 3), (42, "shard-pick", 0)]:
+            assert seeds.crc_key(*key) == zlib.crc32(repr(key).encode())
+
+    def test_det01_range_and_determinism(self):
+        vals = [seeds.det01(s, "salt", i) for s in (1, 2) for i in range(50)]
+        assert all(0.0 <= v < 1.0 for v in vals)
+        assert vals == [seeds.det01(s, "salt", i)
+                        for s in (1, 2) for i in range(50)]
+
+    def test_stable_across_processes(self):
+        # CRC32 of a repr is process-invariant (unlike hash()); pin one
+        # value so an accidental swap to hash() fails loudly.
+        assert seeds.crc_key(42, "phase", 0) \
+            == zlib.crc32(repr((42, "phase", 0)).encode())
+
+
+class TestSeededInstants:
+    def test_spacing_and_settle(self):
+        instants = seeds.seeded_instants(7, "restart", 1200.0, n=3,
+                                         min_gap=180.0, settle=240.0)
+        assert len(instants) == 3
+        assert instants[0] >= 240.0 - 180.0 * 0.25  # settle minus jitter
+        for a, b in zip(instants, instants[1:]):
+            assert b - a >= 180.0
+
+    def test_alias_is_the_hoisted_function(self):
+        # faults._seeded_instants must BE the hoisted helper, not a
+        # diverged copy.
+        assert _seeded_instants is seeds.seeded_instants
+
+
+class TestSeededBurstStarts:
+    def test_matches_scalar_random_recurrence(self):
+        # The exact pre-hoist recurrence from loadgen's storm profiles.
+        for seed, mean_gap, dur, horizon in [(7, 200.0, 60.0, 1800.0),
+                                             (123, 90.0, 30.0, 600.0)]:
+            rng = random.Random(seed)
+            expect, t = [], 0.0
+            while True:
+                t += rng.expovariate(1.0 / max(mean_gap, 1e-9))
+                if t >= horizon:
+                    break
+                expect.append(t)
+                t += dur
+            got = seeds.seeded_burst_starts(seed, mean_gap, dur, horizon)
+            assert got == expect  # byte-identical floats
+
+    def test_empty_when_gap_exceeds_horizon(self):
+        assert seeds.seeded_burst_starts(1, 1e9, 10.0, 100.0) == []
+
+
+class TestFaultScheduleByteIdentity:
+    """Pre-hoist golden values: these exact schedules were produced by
+    the in-module implementations before the seeds.py hoist."""
+
+    def test_seeded_restarts_golden(self):
+        got = [(e.at, e.mid_tick, e.clean)
+               for e in seeded_restarts(42, 1200.0)]
+        assert got == [(314.5, False, True), (578.2, True, False),
+                       (856.8, False, False)]
+
+    def test_seeded_shard_crashes_golden(self):
+        got = [(e.at, e.shard, e.clean)
+               for e in seeded_shard_crashes(42, 1200.0, 4, n=1)]
+        assert got == [(592.0, 2, True)]
+
+    def test_restarts_deterministic(self):
+        assert seeded_restarts(7, 2400.0) == seeded_restarts(7, 2400.0)

@@ -1,0 +1,88 @@
+"""The sweep CLI (python -m wva_tpu sweep) + the forecast backtest's
+--knobs integration: artifact writing, determinism of the written file,
+and the recommendations JSON feeding back into the backtest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from wva_tpu.__main__ import main as wva_main
+
+GOLDEN_TRACE = os.path.join(os.path.dirname(__file__), "goldens",
+                            "forecast_trace_v1.jsonl")
+
+
+@pytest.fixture(scope="module")
+def recs_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("sweep") / "recs.json")
+    rc = wva_main(["sweep", "--smoke", "--sweep-seed", "7",
+                   "--out", path])
+    assert rc == 0
+    return path
+
+
+class TestSweepCli:
+    def test_writes_wellformed_artifact(self, recs_path):
+        with open(recs_path, encoding="utf-8") as f:
+            data = json.load(f)
+        assert data["recommendations"], "empty recommendations"
+        rec = next(iter(data["recommendations"].values()))
+        assert rec["applied_knobs"]
+        assert "trusted" in rec["trust"]
+        assert data["seeds"]["train"] and data["seeds"]["holdout"]
+
+    def test_rerun_byte_identical(self, recs_path, tmp_path):
+        again = str(tmp_path / "recs2.json")
+        rc = wva_main(["sweep", "--smoke", "--sweep-seed", "7",
+                       "--out", again])
+        assert rc == 0
+        with open(recs_path, "rb") as a, open(again, "rb") as b:
+            assert a.read() == b.read()
+
+    def test_batch_width_byte_identical(self, recs_path, tmp_path):
+        narrow = str(tmp_path / "recs_narrow.json")
+        rc = wva_main(["sweep", "--smoke", "--sweep-seed", "7",
+                       "--batch", "1", "--out", narrow])
+        assert rc == 0
+        with open(recs_path, "rb") as a, open(narrow, "rb") as b:
+            assert a.read() == b.read()
+
+    def test_unknown_algo_rejected(self, capsys):
+        with pytest.raises(SystemExit):
+            wva_main(["sweep", "--algo", "annealing"])
+
+
+class TestBacktestKnobs:
+    def test_backtest_accepts_knobs(self, recs_path, capsys):
+        rc = wva_main(["forecast", "backtest", GOLDEN_TRACE,
+                       "--knobs", recs_path])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "knobs:" in out
+        assert "recommends" in out
+
+    def test_backtest_knobs_json_report(self, recs_path, capsys):
+        rc = wva_main(["forecast", "backtest", GOLDEN_TRACE,
+                       "--knobs", recs_path, "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["knobs"]["recommended_forecaster"]
+        assert "backtest_validates" in report["knobs"]
+
+    def test_backtest_bad_knobs_path(self, capsys):
+        rc = wva_main(["forecast", "backtest", GOLDEN_TRACE,
+                       "--knobs", "/nonexistent/recs.json"])
+        assert rc == 2
+
+    def test_explicit_grid_step_wins(self, recs_path, capsys):
+        rc = wva_main(["forecast", "backtest", GOLDEN_TRACE,
+                       "--knobs", recs_path, "--grid-step", "15",
+                       "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["grid_step"] == 15.0 if "grid_step" in report \
+            else True

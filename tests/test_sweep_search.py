@@ -1,0 +1,126 @@
+"""Policy-search drivers + trust gating (wva_tpu/sweep/search.py).
+
+The acceptance properties: same seed + knob grid => byte-identical
+recommendations JSON at vmap widths 1 and 256; recommendations are
+walk-forward trust-gated (a candidate that loses out of sample ships
+``trusted: false`` and the incumbent stays applied); degenerate knob
+points can never win a sweep.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from wva_tpu.emulator import loadgen
+from wva_tpu.sweep import knobs as kb
+from wva_tpu.sweep import search
+from wva_tpu.sweep.world import WorldParams, rate_table
+
+PARAMS = WorldParams(horizon_s=1200.0)
+MODEL = "meta-llama/Llama-3.1-8B"
+
+
+@pytest.fixture(scope="module")
+def lam():
+    prof = loadgen.trapezoid(4.0, 40.0, 300.0, 420.0, 180.0,
+                             tail=120.0, delay=180.0)
+    return rate_table([prof], PARAMS)
+
+
+class TestForecasterChoicesInSync:
+    def test_matches_forecast_registry(self):
+        from wva_tpu.forecast import forecasters as fc
+        registry = getattr(fc, "FORECASTERS", None)
+        if registry is None:
+            pytest.skip("no FORECASTERS registry exported")
+        assert set(kb.FORECASTER_CHOICES) <= set(registry)
+
+
+class TestByteDeterminism:
+    def test_chunk_1_vs_256_byte_identical_json(self, lam):
+        kwargs = dict(algo="grid", grid="smoke", n_train=2, n_holdout=3,
+                      sweep_seed=7)
+        wide = search.run_sweep(PARAMS, lam, [MODEL], chunk=256, **kwargs)
+        narrow = search.run_sweep(PARAMS, lam, [MODEL], chunk=1, **kwargs)
+        assert search.dump_recommendations(wide) \
+            == search.dump_recommendations(narrow)
+
+    def test_rerun_is_byte_identical(self, lam):
+        kwargs = dict(algo="cem", n_train=2, n_holdout=3, sweep_seed=3,
+                      generations=2, population=6)
+        a = search.run_sweep(PARAMS, lam, [MODEL], **kwargs)
+        b = search.run_sweep(PARAMS, lam, [MODEL], **kwargs)
+        assert search.dump_recommendations(a) \
+            == search.dump_recommendations(b)
+
+    def test_split_seeds_disjoint_and_deterministic(self):
+        train, holdout = search.split_seeds(8, 4, sweep_seed=0)
+        train2, holdout2 = search.split_seeds(8, 4, sweep_seed=0)
+        assert (train, holdout) == (train2, holdout2)
+        assert not set(train) & set(holdout)
+
+
+class TestDegenerateExclusion:
+    def test_poisoned_points_never_win(self, lam):
+        points = kb.grid_points("smoke") + [
+            kb.PolicyKnobs(target_utilization=float("nan")),
+            kb.PolicyKnobs(freeze_after_s=1.0)]  # < degraded_after
+        train, _ = search.split_seeds(2, 0)
+        scores, att, chips, n = search.evaluate_points(
+            PARAMS, points, train, lam)
+        assert n == len(points) * 2
+        assert (scores[len(kb.grid_points('smoke')):] <= -1.0e8).all()
+        order = np.argsort(-scores[:, 0], kind="stable")
+        assert int(order[0]) < len(kb.grid_points("smoke"))
+
+
+class TestTrustGate:
+    def test_losing_candidate_not_trusted(self, lam):
+        # A deliberately bad candidate (no headroom, reactive-only at a
+        # starved operating point) must not out-score the defaults out
+        # of sample -> untrusted -> incumbent stays applied.
+        bad = kb.PolicyKnobs(engine_interval_s=30.0, headroom_replicas=0.0,
+                             target_utilization=0.95, burst_slope_rps=0.0)
+        _, holdout = search.split_seeds(0, 4)
+        gate = search.walk_forward(PARAMS, bad, kb.DEFAULT_KNOBS,
+                                   holdout, lam, 0)
+        assert gate["evals"] == 4
+        assert not gate["trusted"]
+        assert gate["ewma_regret"] > search.TRUST_MAX_REGRET
+
+    def test_too_few_evals_not_trusted(self, lam):
+        _, holdout = search.split_seeds(0, 2)
+        gate = search.walk_forward(PARAMS, kb.DEFAULT_KNOBS,
+                                   kb.DEFAULT_KNOBS, holdout, lam, 0)
+        assert not gate["trusted"]
+        assert "evals" in gate["reason"]
+
+    def test_untrusted_recommendation_applies_incumbent(self, lam):
+        result = search.SweepResult(
+            points=[kb.PolicyKnobs(engine_interval_s=30.0,
+                                   headroom_replicas=0.0,
+                                   target_utilization=0.95,
+                                   burst_slope_rps=0.0)],
+            scores=np.array([[0.99]]), attainment=np.array([[0.99]]),
+            chip_seconds=np.array([[1.0]]), worlds_evaluated=1,
+            algo="grid")
+        _, holdout = search.split_seeds(0, 4)
+        report = search.recommend(PARAMS, result, holdout, lam, [MODEL])
+        rec = report["recommendations"][MODEL]
+        if not rec["trust"]["trusted"]:
+            assert rec["applied_knobs"] == rec["incumbent_knobs"]
+        else:  # candidate legitimately won out of sample
+            assert rec["applied_knobs"] == kb.config_dict(result.points[0])
+
+
+class TestFrontier:
+    def test_frontier_monotone(self, lam):
+        train, _ = search.split_seeds(3, 0)
+        result = search.grid_search(PARAMS, lam, train, grid="smoke")
+        front = search.frontier(result)
+        assert front, "smoke grid must yield a non-empty frontier"
+        chips = [f["chip_seconds"] for f in front]
+        atts = [f["attainment"] for f in front]
+        assert chips == sorted(chips)
+        assert atts == sorted(atts)

@@ -1,0 +1,144 @@
+"""The vectorized emulated world (wva_tpu/sweep/world.py).
+
+1. **Batch-width bitwise invariance** — the acceptance property: the
+   same worlds at vmap chunk 1 and chunk 256 produce bit-identical
+   float32 results (all randomness is host-precomputed per world seed;
+   the device scan is lane-independent elementwise arithmetic).
+2. **Scalar cross-check** — the jitted scan matches the per-world
+   Python reference loop (same recurrence) within float tolerance.
+3. **NaN / degenerate knobs score as losses, never crash** — fixed
+   shapes carry poisoned worlds through; the score guard flags them.
+4. **Dispatch accounting** — ONE noted dispatch per (chunk x horizon).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from wva_tpu.emulator import loadgen
+from wva_tpu.sweep import knobs as kb
+from wva_tpu.sweep.world import (LOSS_SCORE, WorldParams, arrivals_table,
+                                 fault_table, rate_table, run_world_python,
+                                 run_worlds, score_objective)
+from wva_tpu.utils import dispatch
+
+PARAMS = WorldParams(horizon_s=1200.0)
+
+
+@pytest.fixture(scope="module")
+def scenario():
+    prof = loadgen.trapezoid(4.0, 40.0, 300.0, 420.0, 180.0,
+                             tail=120.0, delay=180.0)
+    lam = rate_table([prof], PARAMS)
+    points = kb.grid_points("smoke")
+    seeds = list(range(100, 100 + len(points)))
+    arr = arrivals_table(seeds, lam, PARAMS)
+    flt = fault_table(seeds, lam.shape[0], PARAMS)
+    return lam, points, seeds, arr, flt
+
+
+class TestTables:
+    def test_rate_table_shape_and_nonnegative(self, scenario):
+        lam, *_ = scenario
+        assert lam.shape == (1, PARAMS.steps)
+        assert lam.dtype == np.float32
+        assert (lam >= 0).all()
+
+    def test_arrivals_keyed_by_world_seed_alone(self, scenario):
+        lam, _, seeds, arr, _ = scenario
+        # Same seed in a different batch position draws the same stream.
+        solo = arrivals_table([seeds[3]], lam, PARAMS)
+        assert np.array_equal(solo[0], arr[3])
+
+    def test_fault_table_keyed_by_world_seed_alone(self, scenario):
+        lam, _, seeds, _, flt = scenario
+        solo = fault_table([seeds[2]], lam.shape[0], PARAMS)
+        assert np.array_equal(solo[0], flt[2])
+
+
+class TestBatchWidthInvariance:
+    def test_chunk_1_vs_256_bitwise_identical(self, scenario):
+        lam, points, seeds, arr, flt = scenario
+        wide = run_worlds(PARAMS, points, seeds, lam, chunk=256,
+                          arrivals=arr, faults=flt)
+        narrow = run_worlds(PARAMS, points, seeds, lam, chunk=1,
+                            arrivals=arr, faults=flt)
+        for key in ("attainment", "chip_seconds", "wrong_direction",
+                    "objective", "score"):
+            assert np.array_equal(wide[key], narrow[key]), key
+
+    def test_odd_chunk_width_too(self, scenario):
+        lam, points, seeds, arr, flt = scenario
+        wide = run_worlds(PARAMS, points, seeds, lam, chunk=256,
+                          arrivals=arr, faults=flt)
+        odd = run_worlds(PARAMS, points, seeds, lam, chunk=3,
+                         arrivals=arr, faults=flt)
+        assert np.array_equal(wide["objective"], odd["objective"])
+
+
+class TestScalarCrossCheck:
+    def test_jitted_matches_python_reference(self, scenario):
+        lam, points, seeds, arr, flt = scenario
+        res = run_worlds(PARAMS, points, seeds, lam, chunk=256,
+                         arrivals=arr, faults=flt)
+        for i, k in enumerate(points):
+            ref = run_world_python(PARAMS, k, lam, arr[i], flt[i])
+            for key in ("attainment", "chip_seconds", "wrong_direction"):
+                assert res[key][i, 0] == pytest.approx(
+                    ref[key][0], rel=5e-3, abs=1e-3), (key, i)
+
+
+class TestDegenerateKnobs:
+    def test_nan_knob_scores_loss_without_crash(self, scenario):
+        lam, points, seeds, *_ = scenario
+        poisoned = points + [
+            kb.PolicyKnobs(target_utilization=float("nan")),
+            kb.PolicyKnobs(engine_interval_s=float("inf")),
+            kb.PolicyKnobs(level_gain=float("nan"),
+                           grid_step_s=float("nan"))]
+        all_seeds = seeds + [991, 992, 993]
+        res = run_worlds(PARAMS, poisoned, all_seeds, lam)
+        assert (res["objective"][len(points):] == LOSS_SCORE).all()
+        # Healthy lanes are untouched by the poisoned neighbors.
+        assert np.isfinite(res["objective"][:len(points)]).all()
+        assert (res["objective"][:len(points)] > LOSS_SCORE).all()
+
+    def test_inverted_thresholds_flagged_degenerate(self):
+        k = kb.PolicyKnobs(degraded_after_s=300.0, freeze_after_s=60.0)
+        assert kb.is_degenerate(k)
+        res = {"attainment": np.ones((1, 1)),
+               "chip_seconds": np.zeros((1, 1)),
+               "wrong_direction": np.zeros((1, 1))}
+        obj = score_objective(PARAMS, res, np.array([True]))
+        assert obj[0, 0] == LOSS_SCORE
+
+    def test_defaults_not_degenerate(self):
+        assert not kb.is_degenerate(kb.DEFAULT_KNOBS)
+
+
+class TestDispatchAccounting:
+    def test_one_dispatch_per_chunk(self, scenario):
+        lam, points, seeds, arr, flt = scenario
+        before = dispatch.count()
+        run_worlds(PARAMS, points, seeds, lam, chunk=256,
+                   arrivals=arr, faults=flt)
+        assert dispatch.count() - before == 1  # 8 worlds, one chunk
+        before = dispatch.count()
+        run_worlds(PARAMS, points, seeds, lam, chunk=2,
+                   arrivals=arr, faults=flt)
+        assert dispatch.count() - before == len(points) // 2
+
+
+class TestKnobVectorRoundTrip:
+    def test_round_trip(self):
+        k = kb.PolicyKnobs(engine_interval_s=7.0, forecaster=2.0)
+        assert kb.from_vector(kb.to_vector(k)) == k
+
+    def test_config_dict_names_forecaster(self):
+        d = kb.config_dict(kb.PolicyKnobs(forecaster=2.0))
+        assert d["forecaster"] == "seasonal_naive"
+
+    def test_grid_sizes(self):
+        assert len(kb.grid_points("smoke")) == 8
+        assert len(kb.grid_points("default")) == 48

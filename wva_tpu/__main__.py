@@ -145,6 +145,14 @@ def main(argv: list[str] | None = None) -> int:
         from wva_tpu.obs.explain import explain_cli
 
         return explain_cli(argv[1:])
+    if argv and argv[0] == "sweep":
+        # Offline vectorized policy search (wva_tpu.sweep): thousands of
+        # (seed x knob) emulated worlds per device dispatch, trust-gated
+        # knob recommendations JSON out. Same no-cluster dispatch as
+        # replay.
+        from wva_tpu.sweep.cli import sweep_cli
+
+        return sweep_cli(argv[1:])
     args = build_arg_parser().parse_args(argv)
     setup_logging(args.verbosity if args.verbosity is not None else 2)
 

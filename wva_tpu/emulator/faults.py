@@ -29,9 +29,9 @@ from __future__ import annotations
 
 import threading
 import time
-import zlib
 from dataclasses import dataclass, field
 
+from wva_tpu.utils import seeds
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 # Fault kinds (FaultWindow.kind).
@@ -102,9 +102,9 @@ class FaultPlan:
 
     def _det01(self, *key) -> float:
         """Deterministic uniform [0,1) from the seed + a stable salt
-        (CRC32 of the repr — process-hash-randomization-proof)."""
-        data = repr((self.seed,) + key).encode()
-        return (zlib.crc32(data) % 100_000) / 100_000.0
+        (CRC32 of the repr — process-hash-randomization-proof; shared
+        discipline in :mod:`wva_tpu.utils.seeds`)."""
+        return seeds.det01(self.seed, *key)
 
     def chance(self, w: FaultWindow, now: float, salt: str) -> bool:
         """Seeded per-request error decision for *_errors windows."""
@@ -361,23 +361,9 @@ class RestartEvent:
     clean: bool = False
 
 
-def _seeded_instants(seed: int, salt: str, horizon: float, n: int,
-                     min_gap: float, settle: float) -> list[float]:
-    """CRC32-jittered instants spread over ``[settle, horizon - settle]``
-    with at least ``min_gap`` between them (process-hash-proof — same
-    discipline as FaultPlan). Shared by the restart and leader-flap
-    schedules so their spacing math can never silently diverge."""
-    span = max(horizon - 2 * settle, min_gap * max(n, 1))
-    instants: list[float] = []
-    last = settle - min_gap
-    for i in range(n):
-        base = settle + span * (i + 0.5) / n
-        jitter = ((zlib.crc32(repr((seed, salt, i)).encode())
-                   % 1000) / 1000.0 - 0.5) * min_gap * 0.5
-        at = max(base + jitter, last + min_gap)
-        last = at
-        instants.append(round(at, 1))
-    return instants
+# Hoisted to wva_tpu.utils.seeds (shared with loadgen's burst trains);
+# the alias keeps this module's historical import surface.
+_seeded_instants = seeds.seeded_instants
 
 
 def seeded_restarts(seed: int, horizon: float, n: int = 3,
@@ -389,8 +375,8 @@ def seeded_restarts(seed: int, horizon: float, n: int = 3,
     seed."""
     return [RestartEvent(
         at=at,
-        mid_tick=zlib.crc32(repr((seed, "phase", i)).encode()) % 2 == 0,
-        clean=zlib.crc32(repr((seed, "clean", i)).encode()) % 4 == 0)
+        mid_tick=seeds.crc_key(seed, "phase", i) % 2 == 0,
+        clean=seeds.crc_key(seed, "clean", i) % 4 == 0)
         for i, at in enumerate(
             _seeded_instants(seed, "restart", horizon, n, min_gap, settle))]
 
@@ -430,12 +416,11 @@ def seeded_shard_crashes(seed: int, horizon: float, shards: int,
     for i, at in enumerate(
             _seeded_instants(seed, "shard", horizon, n, min_gap, settle)):
         lo = 1 if shards > 1 else 0
-        shard = lo + zlib.crc32(repr((seed, "shard-pick", i)).encode()) \
+        shard = lo + seeds.crc_key(seed, "shard-pick", i) \
             % max(shards - lo, 1)
         events.append(ShardCrashEvent(
             at=at, shard=shard,
-            clean=zlib.crc32(repr((seed, "shard-clean", i)).encode())
-            % 2 == 0,
+            clean=seeds.crc_key(seed, "shard-clean", i) % 2 == 0,
             revive_at=(at + revive_after
                        if revive_after is not None else None)))
     return events

@@ -1,5 +1,21 @@
 """Deterministic load profiles
 (equivalent of ``test/utils/e2eutils.go:494`` CreateLoadGeneratorJob).
+
+Every factory returns the scalar ``t_seconds -> requests/second`` closure
+the event-driven harness steps, and attaches a **pure vectorizable twin**
+as ``profile.rate_at(t_array)``: the same piecewise law expressed
+branchlessly (``where`` masks over whole time grids, never Python
+branches on element values), so the sweep plane's vectorized world
+(``wva_tpu/sweep/``) can precompute ``[M, T]`` rate tables — or trace the
+profile inside ``jit`` — from the exact generators the event world runs.
+``rate_at`` is byte-exact against the scalar closure on float64 grids
+(same IEEE-double operation sequence; asserted by
+``tests/test_loadgen_rate_at.py``).
+
+Seeded burst trains (``poisson_bursts`` / the storm profiles) share one
+recurrence — :func:`wva_tpu.utils.seeds.seeded_burst_starts` — so the
+lazy scalar closure and the eagerly-precomputed vector form agree on
+every burst that starts inside the evaluated horizon.
 """
 
 from __future__ import annotations
@@ -9,12 +25,55 @@ import random
 from dataclasses import dataclass
 from typing import Callable
 
+import numpy as np
+
+from wva_tpu.utils.seeds import seeded_burst_starts
+
 # t_seconds -> requests/second
 LoadProfile = Callable[[float], float]
 
 
+def _xp(t):
+    """Array namespace for ``t``: jax.numpy for JAX inputs (traced or
+    concrete), numpy otherwise — so ``rate_at`` stays importable and
+    byte-exact (float64) without JAX on the path, yet traces cleanly
+    inside ``jit``/``vmap`` when handed device arrays."""
+    if type(t).__module__.split(".")[0] in ("jax", "jaxlib"):
+        import jax.numpy as xp
+
+        return xp
+    return np
+
+
+def _burst_rate_at(starts, burst_duration: float, base_rate: float,
+                   burst_rate: float):
+    """Branchless membership test against a precomputed burst train:
+    rate is ``burst_rate`` wherever some ``start <= t < start + dur``."""
+    starts = np.asarray(starts, dtype=np.float64)
+
+    def rate_at(t):
+        xp = _xp(t)
+        tt = xp.asarray(t)
+        if not starts.size:
+            return xp.zeros(tt.shape) + base_rate
+        hit = ((tt[..., None] >= starts)
+               & (tt[..., None] < starts + burst_duration)).any(axis=-1)
+        return xp.where(hit, burst_rate, base_rate)
+
+    return rate_at
+
+
 def constant(rate: float) -> LoadProfile:
-    return lambda t: rate
+    def profile(t: float) -> float:
+        return rate
+
+    def rate_at(t):
+        xp = _xp(t)
+        tt = xp.asarray(t)
+        return xp.zeros(tt.shape) + rate
+
+    profile.rate_at = rate_at
+    return profile
 
 
 def step_profile(steps: list[tuple[float, float]]) -> LoadProfile:
@@ -27,6 +86,15 @@ def step_profile(steps: list[tuple[float, float]]) -> LoadProfile:
                 rate = r
         return rate
 
+    def rate_at(t):
+        xp = _xp(t)
+        tt = xp.asarray(t)
+        out = xp.zeros(tt.shape)
+        for start, r in steps:  # static step list — not a value branch
+            out = xp.where(tt >= start, r, out)
+        return out
+
+    profile.rate_at = rate_at
     return profile
 
 
@@ -43,6 +111,15 @@ def ramp(start_rate: float, end_rate: float, duration: float,
             return end_rate if t < duration + hold else 0.0
         return start_rate + (end_rate - start_rate) * (t / duration)
 
+    def rate_at(t):
+        xp = _xp(t)
+        t1 = xp.asarray(t) - delay
+        interp = start_rate + (end_rate - start_rate) * (t1 / duration)
+        after = xp.where(t1 < duration + hold, end_rate, 0.0)
+        return xp.where(t1 <= 0, start_rate,
+                        xp.where(t1 >= duration, after, interp))
+
+    profile.rate_at = rate_at
     return profile
 
 
@@ -69,6 +146,20 @@ def trapezoid(base_rate: float, peak_rate: float, ramp_up: float,
             return peak_rate - (peak_rate - base_rate) * (t / ramp_down)
         return base_rate if t < ramp_down + tail else 0.0
 
+    def rate_at(t):
+        xp = _xp(t)
+        t1 = xp.asarray(t) - delay
+        t2 = t1 - ramp_up
+        t3 = t2 - hold
+        up = base_rate + (peak_rate - base_rate) * (t1 / ramp_up)
+        down = peak_rate - (peak_rate - base_rate) * (t3 / ramp_down)
+        r = xp.where(t3 < ramp_down + tail, base_rate, 0.0)
+        r = xp.where(t3 < ramp_down, down, r)
+        r = xp.where(t2 < hold, peak_rate, r)
+        r = xp.where(t1 < ramp_up, up, r)
+        return xp.where(t1 <= 0, base_rate, r)
+
+    profile.rate_at = rate_at
     return profile
 
 
@@ -85,6 +176,14 @@ def diurnal(base_rate: float, amplitude: float, period: float,
         return max(0.0, base_rate
                    + amplitude * 0.5 * (1.0 - math.cos(2 * math.pi * cycle)))
 
+    def rate_at(t):
+        xp = _xp(t)
+        cycle = ((xp.asarray(t) - phase) % period) / period
+        return xp.maximum(
+            0.0, base_rate
+            + amplitude * 0.5 * (1.0 - xp.cos(2 * math.pi * cycle)))
+
+    profile.rate_at = rate_at
     return profile
 
 
@@ -98,7 +197,14 @@ def poisson_bursts(base_rate: float, burst_rate: float,
     depend only on (seed, count) — so harness worlds stay byte-for-byte
     reproducible while exercising UNPREDICTABLE demand (the anti-seasonal
     workload: a forecaster that stays trusted through Poisson bursts is
-    overfitting, and the planner's demotion guardrail must catch it)."""
+    overfitting, and the planner's demotion guardrail must catch it).
+
+    The scalar closure extends its burst train lazily; ``rate_at``
+    precomputes the SAME train (same seed, same recurrence —
+    :func:`seeded_burst_starts`) out to the evaluated grid's maximum (or
+    an explicit ``horizon=`` for traced inputs), so both forms agree on
+    every burst that can affect the requested instants.
+    """
     rng = random.Random(seed)
     starts: list[float] = []
     horizon = [0.0]  # next gap is drawn from this instant
@@ -115,6 +221,16 @@ def poisson_bursts(base_rate: float, burst_rate: float,
                 break
         return base_rate
 
+    def rate_at(t, horizon: float | None = None):
+        if horizon is None:
+            # Concrete grids only: a traced array has no host max — pass
+            # horizon= explicitly to keep the form jit-traceable.
+            horizon = float(np.max(np.asarray(t))) + burst_duration
+        train = seeded_burst_starts(seed, mean_gap, burst_duration, horizon)
+        return _burst_rate_at(train, burst_duration, base_rate,
+                              burst_rate)(t)
+
+    profile.rate_at = rate_at
     return profile
 
 
@@ -137,15 +253,7 @@ def preemption_storm(base_rate: float, burst_rate: float,
     Poisson process over ``[0, horizon)`` — precomputed, so the profile
     and the schedule agree by construction and stay byte-reproducible.
     """
-    rng = random.Random(seed)
-    starts: list[float] = []
-    t = 0.0
-    while True:
-        t += rng.expovariate(1.0 / max(mean_gap, 1e-9))
-        if t >= horizon:
-            break
-        starts.append(t)
-        t += burst_duration
+    starts = seeded_burst_starts(seed, mean_gap, burst_duration, horizon)
     events = [(round(s + preemption_lag, 3), preemptions_per_burst)
               for s in starts
               if s + preemption_lag < horizon]
@@ -158,6 +266,8 @@ def preemption_storm(base_rate: float, burst_rate: float,
                 break
         return base_rate
 
+    profile.rate_at = _burst_rate_at(starts, burst_duration, base_rate,
+                                     burst_rate)
     return profile, events
 
 
@@ -194,15 +304,7 @@ def chaos_storm(base_rate: float, burst_rate: float,
         FaultWindow,
     )
 
-    rng = random.Random(seed)
-    starts: list[float] = []
-    t = 0.0
-    while True:
-        t += rng.expovariate(1.0 / max(mean_gap, 1e-9))
-        if t >= horizon:
-            break
-        starts.append(t)
-        t += burst_duration
+    starts = seeded_burst_starts(seed, mean_gap, burst_duration, horizon)
     windows: list = []
     rotation = (KIND_METRICS_BLACKOUT, KIND_METRICS_PARTIAL,
                 KIND_METRICS_ERRORS, KIND_METRICS_BLACKOUT)
@@ -232,6 +334,8 @@ def chaos_storm(base_rate: float, burst_rate: float,
                 break
         return base_rate
 
+    profile.rate_at = _burst_rate_at(starts, burst_duration, base_rate,
+                                     burst_rate)
     return profile, windows
 
 
@@ -247,3 +351,10 @@ class SpikeProfile:
         if self.idle_until <= t < self.idle_until + self.spike_duration:
             return self.spike_rate
         return 0.0
+
+    def rate_at(self, t):
+        xp = _xp(t)
+        tt = xp.asarray(t)
+        hit = (tt >= self.idle_until) \
+            & (tt < self.idle_until + self.spike_duration)
+        return xp.where(hit, self.spike_rate, 0.0)

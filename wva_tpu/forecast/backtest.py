@@ -205,8 +205,18 @@ def backtest_cli(argv: list[str] | None = None) -> int:
     p.add_argument("--period", type=float, default=86400.0,
                    help="seasonal period in seconds (default 1 day; match "
                         "the trace's seasonality)")
-    p.add_argument("--grid-step", type=float, default=15.0,
-                   help="fine-grid resolution in seconds")
+    p.add_argument("--grid-step", type=float, default=None,
+                   help="fine-grid resolution in seconds (default 15, or "
+                        "the --knobs recommendation's "
+                        "WVA_FORECAST_GRID_STEP)")
+    p.add_argument("--knobs", default="",
+                   help="sweep recommendations JSON (python -m wva_tpu "
+                        "sweep --out): apply its WVA_FORECAST_GRID_STEP "
+                        "and report whether this trace's best forecaster "
+                        "validates its recommendation")
+    p.add_argument("--knobs-model", default="",
+                   help="model key inside --knobs (default: its only "
+                        "model)")
     p.add_argument("--min-history", type=float, default=None,
                    help="warm-up seconds before the first scored forecast "
                         "(default: one lead time; 0 scores from the first "
@@ -220,6 +230,31 @@ def backtest_cli(argv: list[str] | None = None) -> int:
                    help="rewrite the --golden file from this run")
     args = p.parse_args(argv)
 
+    # Tuned-knob application (the sweep plane's artifact): the
+    # recommendation's observation window maps onto the backtest's fine
+    # grid; its forecaster pick is validated against this trace's
+    # ranking. Explicit --grid-step still wins.
+    knob_info = None
+    if args.knobs:
+        try:
+            with open(args.knobs, "r", encoding="utf-8") as f:
+                recs = json.load(f)["recommendations"]
+            model = args.knobs_model or sorted(recs)[0]
+            applied = recs[model]["applied_knobs"]
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: unusable --knobs {args.knobs}: {e}",
+                  file=sys.stderr)
+            return 2
+        knob_info = {"path": args.knobs, "model": model,
+                     "recommended_forecaster": applied.get("forecaster"),
+                     "trusted": bool(recs[model]["trust"]["trusted"])}
+        if args.grid_step is None:
+            step = applied.get("WVA_FORECAST_GRID_STEP")
+            if step is not None:
+                args.grid_step = float(step)
+    if args.grid_step is None:
+        args.grid_step = 15.0
+
     try:
         report = run_backtest(args.trace, args.lead, args.period,
                               args.grid_step,
@@ -228,6 +263,12 @@ def backtest_cli(argv: list[str] | None = None) -> int:
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    if knob_info is not None:
+        knob_info["backtest_best"] = report["best"]
+        knob_info["backtest_validates"] = bool(
+            report["best"] == knob_info["recommended_forecaster"])
+        report["knobs"] = knob_info
 
     if args.json:
         print(json.dumps(report, sort_keys=True, indent=1))
@@ -242,10 +283,17 @@ def backtest_cli(argv: list[str] | None = None) -> int:
                   f"over={a['over_provision_cost']:.4f} n={a['n']}")
         print(f"best: {report['best'] or 'n/a'}; seasonal beats linear: "
               f"{report['seasonal_beats_linear']}")
+        if knob_info is not None:
+            print(f"knobs: {knob_info['path']} recommends "
+                  f"{knob_info['recommended_forecaster']} "
+                  f"(trusted={knob_info['trusted']}); backtest "
+                  f"{'validates' if knob_info['backtest_validates'] else 'disagrees'}"
+                  f" (best={knob_info['backtest_best'] or 'n/a'})")
 
     if args.golden:
         if args.update_golden:
-            slim = {k: v for k, v in report.items() if k != "models"}
+            slim = {k: v for k, v in report.items()
+                    if k not in ("models", "knobs")}
             with open(args.golden, "w", encoding="utf-8") as f:
                 json.dump(slim, f, sort_keys=True, indent=1)
                 f.write("\n")

@@ -1,0 +1,36 @@
+"""Offline policy-sweep plane (docs/design/sweep.md).
+
+Two halves, both offline and read-only over the live planes:
+
+- :mod:`wva_tpu.sweep.world` — the vectorized emulated world: the
+  batch-aware latency physics of ``emulator/server_sim.py`` and the
+  fluid scaling dynamics (desired -> provisioning-lead-delayed ready
+  replicas, fault windows) re-expressed as pure fixed-shape JAX step
+  functions on ``[W, M]`` grids, advanced by ONE ``jit(lax.scan)``
+  device dispatch per (chunk, horizon) — thousands of (seed x knob)
+  worlds per dispatch instead of one Python event loop per world.
+- :mod:`wva_tpu.sweep.search` — grid / CEM / ES drivers over the typed
+  :class:`~wva_tpu.sweep.knobs.PolicyKnobs` space, scoring each world on
+  the existing bench objective (SLO attainment, chip-seconds,
+  wrong-direction events) and emitting per-model tuned-knob
+  recommendations gated by the forecast planner's walk-forward trust
+  discipline (out-of-sample holdout seeds, ``min_trust_evals``, an EWMA
+  regret demotion threshold).
+
+``python -m wva_tpu sweep`` (:mod:`wva_tpu.sweep.cli`) writes the
+recommendations JSON artifact; ``make bench-sweep`` records the
+attainment-vs-cost frontier and the vectorized-vs-event-world fidelity
+gate into ``BENCH_LOCAL.json detail.sweep``.
+"""
+
+from wva_tpu.sweep.knobs import DEFAULT_KNOBS, KNOB_FIELDS, PolicyKnobs
+from wva_tpu.sweep.world import WorldParams, run_worlds, run_world_python
+
+__all__ = [
+    "DEFAULT_KNOBS",
+    "KNOB_FIELDS",
+    "PolicyKnobs",
+    "WorldParams",
+    "run_worlds",
+    "run_world_python",
+]

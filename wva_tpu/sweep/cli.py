@@ -1,0 +1,119 @@
+"""``python -m wva_tpu sweep`` — the offline policy-search CLI.
+
+No cluster, no Prometheus: builds the vectorized world from a named
+load shape, drives the chosen search algorithm over train seeds,
+walk-forward trust-gates the winner on holdout seeds, and writes the
+recommendations JSON artifact (deterministic: same seed + grid =>
+byte-identical file at any ``--batch`` width). The artifact's
+``applied_knobs`` block maps directly onto config keys
+(``WVA_*`` env vars / saturation ConfigMap entries) and feeds
+``python -m wva_tpu forecast backtest --knobs``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# Named load shapes the sweep can size against without a recorded trace.
+# All mirror bench phases (warm hold -> ramp -> hold -> descent -> tail)
+# at sweep-friendly scales.
+SCENARIOS = {
+    "trapezoid": dict(base_rate=4.0, peak_rate=40.0, ramp_s=300.0,
+                      hold_s=420.0, down_s=180.0, tail_s=120.0,
+                      delay_s=180.0),
+    "bench": dict(base_rate=4.0, peak_rate=90.0, ramp_s=300.0,
+                  hold_s=1200.0, down_s=300.0, tail_s=300.0,
+                  delay_s=180.0),
+}
+DEFAULT_MODEL = "meta-llama/Llama-3.1-8B"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="wva_tpu sweep",
+        description="Vectorized policy sweep: thousands of (seed x knob) "
+                    "emulated worlds per device dispatch, trust-gated "
+                    "knob recommendations out.")
+    p.add_argument("--algo", choices=("grid", "cem", "es"), default="grid")
+    p.add_argument("--grid", choices=("smoke", "default", "full"),
+                   default="default",
+                   help="knob grid for --algo grid (default: default)")
+    p.add_argument("--scenario", choices=sorted(SCENARIOS),
+                   default="trapezoid")
+    p.add_argument("--model", default=DEFAULT_MODEL,
+                   help="model id the recommendation is keyed under")
+    p.add_argument("--seeds", type=int, default=8,
+                   help="train world-seeds per knob point (default: 8)")
+    p.add_argument("--holdout", type=int, default=4,
+                   help="held-out seeds for walk-forward trust (default: 4)")
+    p.add_argument("--sweep-seed", type=int, default=0,
+                   help="master seed deriving every world seed and sampler "
+                        "draw (default: 0)")
+    p.add_argument("--horizon", type=float, default=None,
+                   help="override world horizon seconds")
+    p.add_argument("--batch", type=int, default=256,
+                   help="vmap chunk width (results are bitwise identical "
+                        "across widths; default: 256)")
+    p.add_argument("--generations", type=int, default=4,
+                   help="CEM/ES generations (default: 4)")
+    p.add_argument("--population", type=int, default=32,
+                   help="CEM/ES population per generation (default: 32)")
+    p.add_argument("--smoke", action="store_true",
+                   help="small fast sweep (smoke grid, 2 train + 3 "
+                        "holdout seeds, short horizon)")
+    p.add_argument("--out", default=None,
+                   help="write the recommendations JSON here")
+    p.add_argument("--json", action="store_true",
+                   help="print the report JSON to stdout")
+    return p
+
+
+def sweep_cli(argv: list[str]) -> int:
+    args = _build_parser().parse_args(argv)
+
+    # JAX import deferred past arg parsing: --help stays instant.
+    from wva_tpu.emulator import loadgen
+    from wva_tpu.sweep import search
+    from wva_tpu.sweep.world import WorldParams, rate_table
+
+    sc = SCENARIOS[args.scenario]
+    if args.smoke:
+        args.grid = "smoke"
+        args.seeds, args.holdout = 2, 3
+        args.generations, args.population = 2, 8
+    horizon = args.horizon if args.horizon is not None else (
+        sc["delay_s"] + sc["ramp_s"] + sc["hold_s"] + sc["down_s"]
+        + sc["tail_s"])
+    params = WorldParams(horizon_s=float(horizon))
+    prof = loadgen.trapezoid(sc["base_rate"], sc["peak_rate"], sc["ramp_s"],
+                             sc["hold_s"], sc["down_s"], tail=sc["tail_s"],
+                             delay=sc["delay_s"])
+    lam = rate_table([prof], params)
+
+    report = search.run_sweep(
+        params, lam, [args.model], algo=args.algo, grid=args.grid,
+        n_train=args.seeds, n_holdout=args.holdout,
+        sweep_seed=args.sweep_seed, chunk=max(args.batch, 1),
+        generations=args.generations, population=args.population)
+    report["scenario"] = {"name": args.scenario, **sc,
+                          "horizon_s": float(horizon)}
+
+    payload = search.dump_recommendations(report)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload)
+        print(f"wrote {args.out}", file=sys.stderr)
+    if args.json or not args.out:
+        print(payload, end="")
+    rec = report["recommendations"][args.model]
+    print(f"sweep: {report['worlds_evaluated']} worlds, best train "
+          f"objective {rec['train_objective']}, trusted="
+          f"{rec['trust']['trusted']} ({rec['trust']['reason']})",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(sweep_cli(sys.argv[1:]))

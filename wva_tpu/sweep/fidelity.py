@@ -1,0 +1,175 @@
+"""The fidelity gate: the vectorized fluid world vs the event-driven
+twin on a shared scenario.
+
+The sweep's authority rests on the ``[W, M]`` fluid recurrence tracking
+the event-driven :class:`~wva_tpu.emulator.EmulationHarness` — the
+per-request simulator the bench's headline numbers come from. This
+module runs BOTH on the same trapezoid surge (same latency-law
+parameters, same provisioning lead, same engine cadence, same measured
+quantities: whole-run SLO attainment and the chip-seconds integral of
+allocated replicas) and reports the deltas.
+
+The comparison is **distribution-level, not per-request**: the fluid
+world averages several seeded Poisson arrival streams against one
+seeded event run, because the two worlds cannot share a request stream
+— one draws per-request interarrivals and token sizes, the other draws
+per-step Poisson counts against the same rate function. The stated
+tolerances (:data:`ATTAINMENT_TOLERANCE` absolute,
+:data:`CHIP_SECONDS_TOLERANCE` relative) are what the gate asserts in
+``make bench-sweep`` and CI smoke; the measured deltas land in
+``BENCH_LOCAL.json detail.sweep.fidelity`` and PERF.md, honestly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import numpy as np
+
+from wva_tpu.sweep import knobs as kb
+from wva_tpu.sweep.world import WorldParams, rate_table, run_worlds
+
+# Gate tolerances — measured on the default scenario below and stated in
+# PERF.md. Attainment is compared absolutely (both sides are fractions
+# of arrivals), chip-seconds relatively (scale depends on the scenario).
+ATTAINMENT_TOLERANCE = 0.08
+CHIP_SECONDS_TOLERANCE = 0.30
+
+# Default matched scenario: the bench trapezoid's shape at a reduced
+# peak so the event run stays cheap enough for CI smoke. All phase
+# durations mirror bench.py's structure (warm hold -> ramp -> hold ->
+# descent -> tail).
+DEFAULT_SCENARIO = dict(base_rate=4.0, peak_rate=24.0, warmup_s=180.0,
+                        ramp_s=300.0, hold_s=420.0, down_s=180.0,
+                        tail_s=120.0, startup_s=120.0, event_seed=20260730,
+                        world_seeds=(101, 102, 103))
+
+
+def _event_run(sc: dict) -> dict:
+    """One event-driven run: the bench's "ours" harness construction
+    (slo analyzer, anticipation horizon = startup + 30, derived burst
+    slope, fast HPA, 5s engine) measured over the WHOLE run — the fluid
+    world has no warmup exclusion, so neither does this side."""
+    from wva_tpu.analyzers.queueing import (PerfProfile, ServiceParms,
+                                            TargetPerf)
+    from wva_tpu.config.slo import ServiceClass, SLOConfigData
+    from wva_tpu.emulator import (EmulationHarness, HPAParams,
+                                  ServingParams, VariantSpec, trapezoid)
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    model = "meta-llama/Llama-3.1-8B"
+    true_slope = (sc["peak_rate"] - sc["base_rate"]) / sc["ramp_s"]
+    sat_cfg = SaturationScalingConfig(
+        analyzer_name="slo",
+        anticipation_horizon_seconds=sc["startup_s"] + 30.0,
+        burst_slope_rps=true_slope,
+        headroom_replicas=1,
+        enable_limiter=True,
+        fast_actuation=True)
+    sat_cfg.apply_defaults()
+    spec = VariantSpec(
+        name="llama-v5e", model_id=model, accelerator="v5e-8",
+        chips_per_replica=8, cost=10.0, initial_replicas=1,
+        serving=ServingParams(engine="jetstream",
+                              latency_parms=(18.0, 0.00267, 0.00002)),
+        load=trapezoid(sc["base_rate"], sc["peak_rate"], sc["ramp_s"],
+                       sc["hold_s"], sc["down_s"], tail=sc["tail_s"],
+                       delay=sc["warmup_s"]),
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=120.0,
+                      sync_period_seconds=10.0),
+    )
+    os.environ["WVA_SLO_ARRIVAL_RATE_WINDOW"] = "30s"
+    try:
+        harness = EmulationHarness(
+            [spec],
+            saturation_config=sat_cfg,
+            nodepools=[("v5e-pool", "v5e", "2x4", 8)],
+            startup_seconds=sc["startup_s"],
+            engine_interval=5.0,
+            stochastic_seed=sc["event_seed"])
+    finally:
+        os.environ.pop("WVA_SLO_ARRIVAL_RATE_WINDOW", None)
+    harness.config.update_slo_config(SLOConfigData(
+        service_classes=[ServiceClass(
+            name="premium", priority=1,
+            model_targets={model: TargetPerf(target_ttft_ms=1000.0)})],
+        profiles=[PerfProfile(
+            model_id=model, accelerator="v5e-8",
+            service_parms=ServiceParms(alpha=18.0, beta=0.00267,
+                                       gamma=0.00002),
+            max_batch_size=96, max_queue_size=384)],
+        tuner_enabled=False))
+
+    chip_seconds = {"v": 0.0}
+    last_t = {"v": None}
+
+    def watch(h, t: float) -> None:
+        reps = h.replicas_of("llama-v5e")
+        dt = t - last_t["v"] if last_t["v"] is not None else 0.0
+        chip_seconds["v"] += reps * spec.chips_per_replica * dt
+        last_t["v"] = t
+
+    horizon = (sc["warmup_s"] + sc["ramp_s"] + sc["hold_s"]
+               + sc["down_s"] + sc["tail_s"])
+    harness.run(horizon, on_step=watch)
+    sim = harness.sim_of_model(model)
+    return {
+        "slo_attainment": float(sim.slo_attainment(
+            1.0, since=harness.start_time)),
+        "chip_seconds": float(chip_seconds["v"]),
+        "requests": int(sim.completed_total),
+    }
+
+
+def _fluid_run(sc: dict, chunk: int = 256) -> dict:
+    """The matched fluid run: same rate function, same physics constants,
+    shipped default knobs with the scenario's derived burst slope,
+    averaged over the scenario's world seeds."""
+    from wva_tpu.emulator import loadgen
+
+    horizon = (sc["warmup_s"] + sc["ramp_s"] + sc["hold_s"]
+               + sc["down_s"] + sc["tail_s"])
+    params = WorldParams(horizon_s=horizon, startup_s=sc["startup_s"],
+                         fault_mean_gap_s=0.0)
+    prof = loadgen.trapezoid(sc["base_rate"], sc["peak_rate"], sc["ramp_s"],
+                             sc["hold_s"], sc["down_s"], tail=sc["tail_s"],
+                             delay=sc["warmup_s"])
+    lam = rate_table([prof], params)
+    true_slope = (sc["peak_rate"] - sc["base_rate"]) / sc["ramp_s"]
+    k = kb.PolicyKnobs(burst_slope_rps=true_slope)
+    world_seeds = list(sc["world_seeds"])
+    res = run_worlds(params, [k] * len(world_seeds), world_seeds, lam,
+                     chunk=chunk)
+    return {
+        "slo_attainment": float(res["attainment"][:, 0].mean()),
+        "chip_seconds": float(res["chip_seconds"][:, 0].mean()),
+        "per_seed_attainment": [round(float(v), 6)
+                                for v in res["attainment"][:, 0]],
+    }
+
+
+def fidelity_check(scenario: dict | None = None, chunk: int = 256) -> dict:
+    """Run both worlds on the shared scenario and gate the deltas.
+    Returns the full evidence record (both sides' measurements, deltas,
+    tolerances, pass verdict) for BENCH_LOCAL.json / PERF.md."""
+    sc = dict(DEFAULT_SCENARIO)
+    if scenario:
+        sc.update(scenario)
+    event = _event_run(sc)
+    fluid = _fluid_run(sc, chunk=chunk)
+    att_delta = abs(fluid["slo_attainment"] - event["slo_attainment"])
+    denom = max(abs(event["chip_seconds"]), 1e-9)
+    chip_rel = abs(fluid["chip_seconds"] - event["chip_seconds"]) / denom
+    return {
+        "scenario": {k: v for k, v in sc.items() if k != "world_seeds"},
+        "event": event,
+        "fluid": fluid,
+        "attainment_delta_abs": round(att_delta, 6),
+        "chip_seconds_delta_rel": round(chip_rel, 6),
+        "tolerance": {"attainment_abs": ATTAINMENT_TOLERANCE,
+                      "chip_seconds_rel": CHIP_SECONDS_TOLERANCE},
+        "within_tolerance": bool(att_delta <= ATTAINMENT_TOLERANCE
+                                 and chip_rel <= CHIP_SECONDS_TOLERANCE),
+    }

@@ -1,0 +1,216 @@
+"""The typed policy-knob space the sweep searches.
+
+One :class:`PolicyKnobs` instance is one candidate controller
+configuration: engine cadence, saturation headrooms, forecaster
+selection + trust thresholds, observation smoothing (the EKF-prior
+analog in the fluid world), and the input-health degraded/freeze/
+recovery thresholds. The dataclass is the single source of truth for
+
+- the **vector form** (:func:`to_vector` / :func:`from_vector`): a fixed
+  field order (``KNOB_FIELDS``) mapping knobs onto the ``[W, K]`` device
+  array the vectorized world consumes;
+- the **config mapping** (:data:`CONFIG_KEYS`): each knob's operator-
+  facing name — a ``WVA_*`` env var where one exists, a saturation
+  ConfigMap key otherwise — so a recommendations JSON artifact is
+  directly applicable to a deployment;
+- the **degeneracy predicate** (:func:`is_degenerate`): NaN / non-finite
+  / inverted-threshold knob points are carried through the sweep and
+  scored as losses (never crash the batch — the acceptance criterion for
+  injected-NaN worlds).
+
+JAX-free on purpose: the CLI can validate and serialize knob artifacts
+without touching a device.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import asdict, dataclass, fields
+
+# Keep in sync with wva_tpu.forecast.forecasters.FORECASTERS (asserted by
+# tests/test_sweep_search.py without importing the JAX module here).
+FORECASTER_CHOICES = ("linear", "holt", "seasonal_naive", "holt_winters")
+
+
+@dataclass(frozen=True)
+class PolicyKnobs:
+    """One candidate policy configuration (defaults = shipped config)."""
+
+    # Engine cadence (GLOBAL_OPT_INTERVAL; bench "ours" runs 5s).
+    engine_interval_s: float = 5.0
+    # Saturation sizing: spare whole replicas on top of the sized demand
+    # (saturation ConfigMap headroomReplicas).
+    headroom_replicas: float = 1.0
+    # Per-replica sizing operating point: fraction of max batch occupancy
+    # replicas are sized to sustain (WVA_FORECAST_TARGET_UTILIZATION).
+    target_utilization: float = 0.85
+    # Declared worst-credible ramp (req/s^2): the analyzer stands
+    # slope x provisioning-horizon spare capacity (burstSlopeRPS).
+    burst_slope_rps: float = 0.15
+    # Forecaster selection (index into FORECASTER_CHOICES; the fluid
+    # world runs the Holt family, richer members map onto its gains).
+    forecaster: float = 1.0
+    # Observation smoothing window (WVA_FORECAST_GRID_STEP): the EWMA
+    # window the observed-rate estimate integrates over.
+    grid_step_s: float = 15.0
+    # Holt level/trend gains — the fluid analog of the EKF priors (how
+    # hard the forecast state tracks fresh observations).
+    level_gain: float = 0.5
+    trend_gain: float = 0.2
+    # Forecast trust gate (WVA_FORECAST_MIN_TRUST_EVALS /
+    # WVA_FORECAST_DEMOTE_ERROR).
+    min_trust_evals: float = 3.0
+    demote_error: float = 0.35
+    # Input-health thresholds (WVA_HEALTH_*): consecutive faulted
+    # seconds before scale-down locks / the freeze, clean ticks required
+    # before scale-down resumes.
+    degraded_after_s: float = 120.0
+    freeze_after_s: float = 300.0
+    recovery_ticks: float = 3.0
+
+
+KNOB_FIELDS: tuple[str, ...] = tuple(
+    f.name for f in fields(PolicyKnobs))
+
+DEFAULT_KNOBS = PolicyKnobs()
+
+# Operator-facing key per knob: WVA_* env var where the live config has
+# one, saturation ConfigMap key otherwise.
+CONFIG_KEYS: dict[str, str] = {
+    "engine_interval_s": "GLOBAL_OPT_INTERVAL",
+    "headroom_replicas": "saturation.headroomReplicas",
+    "target_utilization": "WVA_FORECAST_TARGET_UTILIZATION",
+    "burst_slope_rps": "saturation.burstSlopeRPS",
+    "forecaster": "forecaster",
+    "grid_step_s": "WVA_FORECAST_GRID_STEP",
+    "level_gain": "ekf.level_gain",
+    "trend_gain": "ekf.trend_gain",
+    "min_trust_evals": "WVA_FORECAST_MIN_TRUST_EVALS",
+    "demote_error": "WVA_FORECAST_DEMOTE_ERROR",
+    "degraded_after_s": "WVA_HEALTH_DEGRADED_AFTER",
+    "freeze_after_s": "WVA_HEALTH_FREEZE_AFTER",
+    "recovery_ticks": "WVA_HEALTH_RECOVERY_TICKS",
+}
+
+# (lo, hi) box per knob — the CEM/ES samplers clip into it; grid axes
+# live inside it.
+BOUNDS: dict[str, tuple[float, float]] = {
+    "engine_interval_s": (5.0, 30.0),
+    "headroom_replicas": (0.0, 3.0),
+    "target_utilization": (0.5, 0.95),
+    "burst_slope_rps": (0.0, 0.4),
+    "forecaster": (0.0, float(len(FORECASTER_CHOICES) - 1)),
+    "grid_step_s": (5.0, 60.0),
+    "level_gain": (0.1, 0.9),
+    "trend_gain": (0.02, 0.6),
+    "min_trust_evals": (1.0, 8.0),
+    "demote_error": (0.1, 0.8),
+    "degraded_after_s": (30.0, 300.0),
+    "freeze_after_s": (120.0, 900.0),
+    "recovery_ticks": (1.0, 6.0),
+}
+
+
+def to_vector(k: PolicyKnobs) -> list[float]:
+    """Fixed-order float vector (the device row for one world)."""
+    d = asdict(k)
+    return [float(d[name]) for name in KNOB_FIELDS]
+
+
+def from_vector(vec) -> PolicyKnobs:
+    return PolicyKnobs(**{name: float(v)
+                          for name, v in zip(KNOB_FIELDS, vec)})
+
+
+def is_degenerate(k: PolicyKnobs) -> bool:
+    """True when a knob point cannot describe a runnable controller —
+    such worlds are still evaluated (fixed shapes) but scored as losses.
+    """
+    vec = to_vector(k)
+    if any(not math.isfinite(v) for v in vec):
+        return True
+    return (k.engine_interval_s <= 0
+            or k.target_utilization <= 0 or k.target_utilization > 1.0
+            or k.headroom_replicas < 0
+            or k.grid_step_s <= 0
+            or not (0 <= k.forecaster < len(FORECASTER_CHOICES))
+            or k.level_gain <= 0 or k.level_gain > 1
+            or k.trend_gain < 0 or k.trend_gain > 1
+            or k.min_trust_evals < 0
+            or k.demote_error <= 0
+            or k.degraded_after_s <= 0
+            or k.freeze_after_s < k.degraded_after_s
+            or k.recovery_ticks < 0)
+
+
+def clip(k: PolicyKnobs) -> PolicyKnobs:
+    """Project a sampled point into the knob box (CEM/ES proposals)."""
+    vec = to_vector(k)
+    out = []
+    for name, v in zip(KNOB_FIELDS, vec):
+        lo, hi = BOUNDS[name]
+        out.append(min(max(v, lo), hi) if math.isfinite(v) else v)
+    return from_vector(out)
+
+
+def config_dict(k: PolicyKnobs) -> dict[str, float | str]:
+    """The operator-facing mapping written into a recommendations JSON:
+    config key -> value (forecaster by name, durations in seconds)."""
+    d = asdict(k)
+    out: dict[str, float | str] = {}
+    for name in KNOB_FIELDS:
+        key = CONFIG_KEYS[name]
+        if name == "forecaster":
+            idx = int(round(d[name]))
+            idx = min(max(idx, 0), len(FORECASTER_CHOICES) - 1)
+            out[key] = FORECASTER_CHOICES[idx]
+        elif name in ("min_trust_evals", "recovery_ticks",
+                      "headroom_replicas"):
+            out[key] = int(round(d[name]))
+        else:
+            out[key] = round(float(d[name]), 6)
+    return out
+
+
+# -- knob grids ----------------------------------------------------------
+
+# The default grid crossed with seeds clears the >=1024-world bench floor
+# (48 combos x 32 seeds = 1536); smoke keeps CI short.
+GRID_AXES: dict[str, dict[str, list[float]]] = {
+    "smoke": {
+        "engine_interval_s": [5.0, 15.0],
+        "headroom_replicas": [0.0, 1.0],
+        "target_utilization": [0.7, 0.9],
+    },
+    "default": {
+        "engine_interval_s": [5.0, 10.0, 30.0],
+        "headroom_replicas": [0.0, 1.0],
+        "target_utilization": [0.7, 0.85],
+        "burst_slope_rps": [0.0, 0.287],
+        "forecaster": [0.0, 1.0],
+    },
+    "full": {
+        "engine_interval_s": [5.0, 10.0, 20.0, 30.0],
+        "headroom_replicas": [0.0, 1.0, 2.0],
+        "target_utilization": [0.6, 0.7, 0.85, 0.95],
+        "burst_slope_rps": [0.0, 0.143, 0.287],
+        "forecaster": [0.0, 1.0],
+        "demote_error": [0.2, 0.35, 0.5],
+    },
+}
+
+
+def grid_points(grid: str = "default",
+                base: PolicyKnobs | None = None) -> list[PolicyKnobs]:
+    """Cartesian product of the named grid's axes over ``base``
+    (deterministic order: axis insertion order x value order)."""
+    axes = GRID_AXES[grid]
+    base = base or DEFAULT_KNOBS
+    names = list(axes)
+    points = []
+    for combo in itertools.product(*(axes[n] for n in names)):
+        d = asdict(base)
+        d.update(dict(zip(names, combo)))
+        points.append(PolicyKnobs(**d))
+    return points

@@ -1,0 +1,526 @@
+"""The vectorized emulated world: ``[W, M]`` grids of policy worlds
+advanced by one ``jit(lax.scan)`` dispatch per chunk.
+
+Physics, re-expressed fluidly from the event-driven twin:
+
+- **Serving** mirrors ``emulator/server_sim.py``'s batch-aware latency
+  law — ``T(n) = alpha + n*(beta*tc + gamma*tm)`` ms per decode
+  iteration, prefill ``T(n) + (beta+gamma)*in_tokens`` — through the
+  queueing model's per-replica service rate
+  (``analyzers/queueing/queue_model.py _service_rate``):
+  ``r(n) = n / (prefill(n) + out_tokens * T(n))`` requests/ms. A step
+  serves ``min(queue + arrivals, ready * r(B_max) * dt)`` and estimates
+  TTFT as queue-wait + prefill at the operating occupancy; arrivals
+  whose estimate exceeds the SLO (or that overflow the per-replica queue
+  bound) are misses — the fluid analog of ``slo_attainment`` counting
+  unserved arrivals against the target.
+- **Scaling dynamics** mirror the harness: desired replicas actuate
+  through a ``startup_s``-deep provisioning pipeline (scale-ups become
+  ready one lead later; scale-downs are immediate), scale-down waits out
+  a stabilization window, and chip-seconds integrate DESIRED replicas —
+  exactly the bench's cost integral.
+- **The controller** is the knob-parameterized fluid policy: EWMA
+  observed rate (``grid_step_s`` window, stale-held through fault
+  windows), a Holt level/trend forecast (``level_gain``/``trend_gain``,
+  the EKF-prior analog) projected one provisioning lead ahead and
+  trust-gated by ``min_trust_evals``/``demote_error`` walk-forward
+  error, burst-slope anticipation, headroom replicas, and the health
+  plane's degraded/freeze/recovery thresholds over seeded fault windows.
+
+Everything is fixed-shape and branch-free (masks, never Python branches
+on traced values), so per-world results are **bitwise independent of the
+batch width** — world ``w`` computes the identical float32 lane whether
+it rides in a chunk of 1 or 256 (asserted by
+``tests/test_sweep_world.py``). All randomness (Poisson arrivals, fault
+windows) is precomputed on the host from per-world seeds
+(``numpy.random.Philox`` / :mod:`wva_tpu.utils.seeds`), keyed by the
+world seed alone — never by batch position.
+
+:func:`run_world_python` is the same recurrence as a per-world scalar
+Python loop — the honest baseline ``make bench-sweep`` quotes the
+vectorized throughput against, and the cross-check the fidelity tests
+pin the jitted program to.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+from wva_tpu.sweep import knobs as kb
+from wva_tpu.utils import dispatch, seeds
+
+_EPS = 1e-6
+# Objective score assigned to NaN / degenerate / non-finite worlds: a
+# loss no healthy world can reach, so they can never win a sweep.
+LOSS_SCORE = -1.0e9
+
+
+@dataclass(frozen=True)
+class WorldParams:
+    """The scenario: serving physics + scaling dynamics + horizon (world
+    -invariant; knobs vary per world, these do not). Defaults match the
+    north-star bench scenario (bench.py TRUE_PARMS et al.)."""
+
+    alpha_ms: float = 18.0
+    beta_ms: float = 0.00267
+    gamma_ms: float = 0.00002
+    avg_input_tokens: float = 512.0
+    avg_output_tokens: float = 256.0
+    max_batch: int = 96
+    queue_bound: int = 64          # per-replica admission bound
+    chips_per_replica: int = 8
+    slo_ttft_s: float = 1.0
+    startup_s: float = 120.0       # provisioning + model load lead
+    down_stabilization_s: float = 120.0
+    dt: float = 5.0                # step = one fast engine tick
+    horizon_s: float = 2400.0
+    max_replicas: int = 32
+    # Objective weights: attainment minus normalized chip-seconds minus
+    # wrong-direction events (the bench objective's three axes).
+    cost_weight: float = 0.25
+    wrong_direction_weight: float = 0.02
+    # Seeded input-fault windows (health-knob pressure): mean gap between
+    # fault windows and their duration; 0 gap disables.
+    fault_mean_gap_s: float = 600.0
+    fault_duration_s: float = 90.0
+
+    @property
+    def steps(self) -> int:
+        return int(round(self.horizon_s / self.dt))
+
+    @property
+    def lead_steps(self) -> int:
+        return max(int(round(self.startup_s / self.dt)), 1)
+
+
+# -- the shared latency law (scalar, used by both the python reference
+# -- and, through jnp broadcasting, the jitted program) ------------------
+
+def iteration_ms(p: WorldParams, n, xp=math):  # noqa: ARG001 — xp unused
+    tc = (p.avg_input_tokens + p.avg_output_tokens) \
+        / (p.avg_output_tokens + 1.0)
+    tm = p.avg_input_tokens + p.avg_output_tokens / 2.0
+    return p.alpha_ms + n * (p.beta_ms * tc + p.gamma_ms * tm)
+
+
+def prefill_ms(p: WorldParams, n):
+    return iteration_ms(p, n) + (p.beta_ms + p.gamma_ms) \
+        * p.avg_input_tokens
+
+
+def replica_rps(p: WorldParams, n):
+    """Per-replica sustainable throughput (req/s) at batch occupancy
+    ``n`` — ``queue_model._service_rate`` scaled from req/ms."""
+    denom = prefill_ms(p, n) + p.avg_output_tokens * iteration_ms(p, n)
+    return 1000.0 * n / denom
+
+
+# -- host-side seeded inputs --------------------------------------------
+
+def rate_table(profiles, params: WorldParams) -> np.ndarray:
+    """``[M, T]`` float32 true-rate table from loadgen profiles' pure
+    ``rate_at`` forms, sampled at step midpoints."""
+    t = (np.arange(params.steps, dtype=np.float64) + 0.5) * params.dt
+    rows = []
+    for prof in profiles:
+        rate_at = getattr(prof, "rate_at", None)
+        if rate_at is not None:
+            rows.append(np.asarray(rate_at(t), dtype=np.float64))
+        else:  # plain callable fallback (scalar closure per instant)
+            rows.append(np.array([float(prof(x)) for x in t]))
+    return np.maximum(np.asarray(rows, dtype=np.float64), 0.0) \
+        .astype(np.float32)
+
+
+def arrivals_table(world_seeds, lam: np.ndarray,
+                   params: WorldParams) -> np.ndarray:
+    """``[W, M, T]`` seeded Poisson arrivals (requests per step). One
+    counter-based Philox stream per world, keyed by the world seed alone
+    — batch composition can never perturb a world's draw."""
+    out = np.empty((len(world_seeds),) + lam.shape, dtype=np.float32)
+    expect = lam.astype(np.float64) * params.dt
+    for i, s in enumerate(world_seeds):
+        g = np.random.Generator(np.random.Philox(key=int(s) & (2**64 - 1)))
+        out[i] = g.poisson(expect)
+    return out
+
+
+def fault_table(world_seeds, n_models: int,
+                params: WorldParams) -> np.ndarray:
+    """``[W, M, T]`` float32 0/1 input-fault windows: a seeded burst
+    train per (world, model) (same recurrence as the chaos storms —
+    :func:`wva_tpu.utils.seeds.seeded_burst_starts`)."""
+    mask = np.zeros((len(world_seeds), n_models, params.steps),
+                    dtype=np.float32)
+    if params.fault_mean_gap_s <= 0:
+        return mask
+    t = (np.arange(params.steps, dtype=np.float64) + 0.5) * params.dt
+    for i, s in enumerate(world_seeds):
+        for m in range(n_models):
+            starts = seeds.seeded_burst_starts(
+                seeds.crc_key(int(s), "sweep-fault", m),
+                params.fault_mean_gap_s, params.fault_duration_s,
+                params.horizon_s)
+            for st in starts:
+                window = (t >= st) & (t < st + params.fault_duration_s)
+                mask[i, m] = np.maximum(mask[i, m],
+                                        window.astype(np.float32))
+    return mask
+
+
+# -- the jitted program --------------------------------------------------
+
+def _build_scan(params: WorldParams):
+    """Compile-once scan over the horizon for a fixed (W, M) chunk shape;
+    returns a jitted fn(knob_cols, lam, arrivals, faults) -> outputs."""
+    import jax
+    import jax.numpy as jnp
+
+    p = params
+    T, L = p.steps, p.lead_steps
+    f32 = jnp.float32
+    rate_full = replica_rps(p, float(p.max_batch))
+    stab_steps = max(int(round(p.down_stabilization_s / p.dt)), 1)
+
+    def make_step(k):  # k: dict of [W,1] knob columns
+        def step(carry, xs):
+            (q, ready, desired, pipe, obs, level, trend, err, evals,
+             fault_run, recovery, since_up, last_lam,
+             attained, total, chip_s, wd) = carry
+            t, lam_t, a, f = xs  # [], [M], [W,M], [W,M]
+
+            # Provisioning pipeline head matures into ready replicas.
+            ready = ready + pipe[..., 0]
+            pipe = jnp.concatenate(
+                [pipe[..., 1:], jnp.zeros_like(pipe[..., :1])], axis=-1)
+
+            # Serving at full-batch throughput; queue-wait + prefill TTFT.
+            cap_rps = ready * rate_full
+            wait_s = q / jnp.maximum(cap_rps, _EPS)
+            occ = jnp.clip(
+                (q + a) / jnp.maximum(cap_rps * p.dt, _EPS) * p.max_batch,
+                1.0, float(p.max_batch))
+            ttft = wait_s + prefill_ms(p, occ) / 1000.0
+            ok = (ttft <= p.slo_ttft_s).astype(f32)
+            backlog = q + a
+            served = jnp.minimum(backlog, cap_rps * p.dt)
+            q_next = backlog - served
+            drop = jnp.maximum(q_next - p.queue_bound * ready, 0.0)
+            q_next = q_next - drop
+            attained = attained + jnp.maximum(a * ok - drop, 0.0)
+            total = total + a
+
+            # Observation: EWMA of measured rate, stale-held through faults.
+            g_obs = jnp.clip(p.dt / jnp.maximum(k["grid_step_s"], p.dt),
+                             0.0, 1.0)
+            measured = a / p.dt
+            obs = jnp.where(f > 0, obs, obs + g_obs * (measured - obs))
+            fault_run = jnp.where(f > 0, fault_run + 1.0, 0.0)
+            recovery = jnp.where(f > 0, k["recovery_ticks"],
+                                 jnp.maximum(recovery - 1.0, 0.0))
+
+            # Engine cadence per world (knob; NaN-safe static bounds).
+            ki_f = k["engine_interval_s"] / p.dt
+            ki = jnp.clip(jnp.where(jnp.isfinite(ki_f), jnp.round(ki_f), 1.0),
+                          1.0, float(T)).astype(jnp.int32)
+            act = (jnp.mod(t, ki) == 0)
+
+            # Holt forecast state (level/trend), updated at act steps from
+            # clean observations; one-lead-ahead projection; walk-forward
+            # trust (EWMA symmetric error vs realized, min-evals gate) —
+            # the planner's discipline in fluid form.
+            upd = act & (f <= 0)
+            pred_now = level + trend
+            sm_err = jnp.abs(pred_now - obs) \
+                / jnp.maximum((jnp.abs(pred_now) + jnp.abs(obs)) / 2.0, _EPS)
+            err = jnp.where(upd, err + 0.2 * (sm_err - err), err)
+            evals = jnp.where(upd, evals + 1.0, evals)
+            ga, gb = k["level_gain"], k["trend_gain"]
+            new_level = jnp.where(upd, ga * obs + (1 - ga) * (level + trend),
+                                  level)
+            trend = jnp.where(upd, gb * (new_level - level) + (1 - gb) * trend,
+                              trend)
+            level = new_level
+            trusted = (evals >= k["min_trust_evals"]) \
+                & (err <= k["demote_error"])
+            lead_intervals = float(L) / jnp.maximum(ki.astype(f32), 1.0) + 1.0
+            forecast = level + trend * lead_intervals
+
+            # Sizing: cover max(observed + burst insurance, trusted
+            # forecast) at the target-occupancy service rate, plus headroom.
+            r_target = 1000.0 * k["occ_target"] / (
+                prefill_ms(p, k["occ_target"])
+                + p.avg_output_tokens * iteration_ms(p, k["occ_target"]))
+            reactive = obs + k["burst_slope_rps"] * p.startup_s
+            target_rate = jnp.maximum(reactive,
+                                      jnp.where(trusted, forecast, 0.0))
+            desired_raw = jnp.ceil(
+                target_rate / jnp.maximum(r_target, _EPS)) \
+                + k["headroom_replicas"]
+            desired_raw = jnp.clip(desired_raw, 1.0, float(p.max_replicas))
+
+            # Health gating + down-stabilization.
+            degraded = fault_run * p.dt >= k["degraded_after_s"]
+            frozen = fault_run * p.dt >= k["freeze_after_s"]
+            can_down = (since_up >= float(stab_steps)) & ~degraded \
+                & (recovery <= 0)
+            up = desired_raw > desired
+            desired_new = jnp.where(
+                up, desired_raw,
+                jnp.where(can_down, desired_raw, desired))
+            desired_new = jnp.where(frozen, desired, desired_new)
+            desired_new = jnp.where(act, desired_new, desired)
+            wd_event = act & (desired_new < desired) \
+                & (lam_t > last_lam + _EPS)
+            wd = wd + wd_event.astype(f32)
+            last_lam = jnp.where(act, jnp.zeros_like(last_lam) + lam_t,
+                                 last_lam)
+            since_up = jnp.where(act & (desired_new > desired),
+                                 0.0, since_up + 1.0)
+            desired = desired_new
+
+            # Actuation: downs immediate, ups through the pipeline tail.
+            pending = pipe.sum(axis=-1)
+            excess = jnp.maximum(ready - desired, 0.0)
+            ready = ready - excess
+            short = jnp.maximum(desired - (ready + pending), 0.0)
+            pipe = pipe.at[..., L - 1].add(short)
+
+            chip_s = chip_s + desired * p.chips_per_replica * p.dt
+            carry = (q_next, ready, desired, pipe, obs, level, trend, err,
+                     evals, fault_run, recovery, since_up, last_lam,
+                     attained, total, chip_s, wd)
+            return carry, None
+
+        return step
+
+    @partial(jax.jit, static_argnames=("w", "m"))
+    def program(knob_rows, lam, arrivals, faults, init_replicas, w, m):
+        cols = {name: knob_rows[:, i:i + 1]
+                for i, name in enumerate(kb.KNOB_FIELDS)}
+        # Occupancy operating point from the utilization knob (NaN flows
+        # through to the score guard).
+        cols["occ_target"] = jnp.clip(
+            cols["target_utilization"] * p.max_batch, 1.0,
+            float(p.max_batch))
+        step = make_step(cols)
+        zero = jnp.zeros((w, m), f32)
+        init = jnp.zeros((w, m), f32) + init_replicas
+        carry = (zero, init, init, jnp.zeros((w, m, L), f32),
+                 zero, zero, zero, zero, zero, zero, zero,
+                 zero + float(stab_steps), zero,
+                 zero, zero, zero, zero)
+        ts = jnp.arange(T, dtype=jnp.int32)
+        carry, _ = jax.lax.scan(
+            step, carry, (ts, lam.T, arrivals.transpose(2, 0, 1),
+                          faults.transpose(2, 0, 1)))
+        (q, ready, desired, pipe, obs, level, trend, err, evals,
+         fault_run, recovery, since_up, last_lam,
+         attained, total, chip_s, wd) = carry
+        attain = attained / jnp.maximum(total, 1.0)
+        return attain, chip_s, wd, total
+
+    return program
+
+
+_PROGRAMS: dict = {}
+
+
+def _program_for(params: WorldParams):
+    key = params
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        prog = _PROGRAMS[key] = _build_scan(params)
+    return prog
+
+
+def run_worlds(params: WorldParams, knob_list, world_seeds, lam: np.ndarray,
+               chunk: int = 256, init_replicas: float = 1.0,
+               arrivals: np.ndarray | None = None,
+               faults: np.ndarray | None = None) -> dict:
+    """Advance ``len(knob_list) == len(world_seeds)`` worlds through the
+    whole horizon. ONE device dispatch per (chunk, horizon) — the
+    dispatch counter is noted per call so ``make bench-sweep`` can
+    assert dispatches/step as a measured quantity.
+
+    Returns per-world arrays: ``attainment [W, M]``,
+    ``chip_seconds [W, M]``, ``wrong_direction [W, M]``,
+    ``objective [W, M]`` (LOSS_SCORE for NaN/degenerate worlds) and the
+    fleet ``score [W]``. Results are bitwise independent of ``chunk``.
+    """
+    import jax.numpy as jnp
+
+    w_total = len(knob_list)
+    assert w_total == len(world_seeds)
+    m, t = lam.shape
+    assert t == params.steps
+    if arrivals is None:
+        arrivals = arrivals_table(world_seeds, lam, params)
+    if faults is None:
+        faults = fault_table(world_seeds, m, params)
+    rows = np.asarray([kb.to_vector(k) for k in knob_list],
+                      dtype=np.float32)
+    degenerate = np.asarray([kb.is_degenerate(k) for k in knob_list])
+
+    prog = _program_for(params)
+    lam_dev = jnp.asarray(lam, jnp.float32)
+    outs = {"attainment": [], "chip_seconds": [], "wrong_direction": [],
+            "arrivals_total": []}
+    for lo in range(0, w_total, max(chunk, 1)):
+        hi = min(lo + max(chunk, 1), w_total)
+        attain, chip_s, wd, total = prog(
+            jnp.asarray(rows[lo:hi]), lam_dev,
+            jnp.asarray(arrivals[lo:hi]), jnp.asarray(faults[lo:hi]),
+            float(init_replicas), hi - lo, m)
+        dispatch.note()  # ONE dispatch per chunk x whole horizon
+        outs["attainment"].append(np.asarray(attain))
+        outs["chip_seconds"].append(np.asarray(chip_s))
+        outs["wrong_direction"].append(np.asarray(wd))
+        outs["arrivals_total"].append(np.asarray(total))
+    res = {k: np.concatenate(v, axis=0) for k, v in outs.items()}
+    res["objective"] = score_objective(params, res, degenerate)
+    res["score"] = res["objective"].mean(axis=1)
+    res["degenerate"] = degenerate
+    return res
+
+
+def score_objective(params: WorldParams, res: dict,
+                    degenerate=None) -> np.ndarray:
+    """The bench objective per (world, model): attainment minus
+    normalized chip-seconds minus wrong-direction events. Non-finite
+    worlds (NaN knobs that flowed through the physics) and host-flagged
+    degenerate knob points score LOSS_SCORE — a loss, never a crash."""
+    chip_norm = res["chip_seconds"] / max(
+        params.chips_per_replica * params.max_replicas * params.horizon_s,
+        _EPS)
+    obj = (res["attainment"] - params.cost_weight * chip_norm
+           - params.wrong_direction_weight * res["wrong_direction"])
+    finite = np.isfinite(obj) & np.isfinite(res["attainment"]) \
+        & np.isfinite(res["chip_seconds"])
+    obj = np.where(finite, obj, LOSS_SCORE)
+    if degenerate is not None:
+        obj = np.where(degenerate[:, None], LOSS_SCORE, obj)
+    return obj.astype(np.float64)
+
+
+# -- the scalar reference world (baseline + cross-check) -----------------
+
+def run_world_python(params: WorldParams, k, lam: np.ndarray,
+                     arrivals: np.ndarray, faults: np.ndarray | None = None,
+                     init_replicas: float = 1.0) -> dict:
+    """One world, per-step Python loop — the same recurrence the scan
+    runs, in scalar float arithmetic. This is the per-world event-loop
+    cost model the vectorized throughput is honestly quoted against
+    (``make bench-sweep``), and the cross-check the jitted program's
+    numerics are pinned to (tests)."""
+    p = params
+    vec = kb.to_vector(k)
+    kd = dict(zip(kb.KNOB_FIELDS, vec))
+    m_models, t_steps = lam.shape
+    L = p.lead_steps
+    stab_steps = max(int(round(p.down_stabilization_s / p.dt)), 1)
+    rate_full = replica_rps(p, float(p.max_batch))
+    occ_target = min(max(kd["target_utilization"] * p.max_batch, 1.0),
+                     float(p.max_batch))
+    r_target = replica_rps(p, occ_target)
+    if faults is None:
+        faults = np.zeros_like(lam)
+
+    out = {"attainment": np.zeros(m_models),
+           "chip_seconds": np.zeros(m_models),
+           "wrong_direction": np.zeros(m_models)}
+    for m in range(m_models):
+        q = 0.0
+        ready = desired = float(init_replicas)
+        pipe = [0.0] * L
+        obs = level = trend = err = evals = 0.0
+        fault_run = recovery = last_lam = 0.0
+        since_up = float(stab_steps)
+        attained = total = chip_s = wd = 0.0
+        ki = int(min(max(round(kd["engine_interval_s"] / p.dt), 1),
+                     t_steps)) \
+            if math.isfinite(kd["engine_interval_s"]) else 1
+        g_obs = min(max(p.dt / max(kd["grid_step_s"], p.dt), 0.0), 1.0)
+        for t in range(t_steps):
+            lam_t = float(lam[m, t])
+            a = float(arrivals[m, t])
+            f = float(faults[m, t])
+            ready += pipe.pop(0)
+            pipe.append(0.0)
+            cap_rps = ready * rate_full
+            wait_s = q / max(cap_rps, _EPS)
+            occ = min(max((q + a) / max(cap_rps * p.dt, _EPS)
+                          * p.max_batch, 1.0), float(p.max_batch))
+            ttft = wait_s + prefill_ms(p, occ) / 1000.0
+            ok = 1.0 if ttft <= p.slo_ttft_s else 0.0
+            backlog = q + a
+            served = min(backlog, cap_rps * p.dt)
+            q = backlog - served
+            drop = max(q - p.queue_bound * ready, 0.0)
+            q -= drop
+            attained += max(a * ok - drop, 0.0)
+            total += a
+            measured = a / p.dt
+            if f <= 0:
+                obs = obs + g_obs * (measured - obs)
+                fault_run = 0.0
+                recovery = max(recovery - 1.0, 0.0)
+            else:
+                fault_run += 1.0
+                recovery = kd["recovery_ticks"]
+            act = (t % ki == 0)
+            if act and f <= 0:
+                pred_now = level + trend
+                sm = abs(pred_now - obs) \
+                    / max((abs(pred_now) + abs(obs)) / 2.0, _EPS)
+                err = err + 0.2 * (sm - err)
+                evals += 1.0
+                ga, gb = kd["level_gain"], kd["trend_gain"]
+                new_level = ga * obs + (1 - ga) * (level + trend)
+                trend = gb * (new_level - level) + (1 - gb) * trend
+                level = new_level
+            trusted = (evals >= kd["min_trust_evals"]
+                       and err <= kd["demote_error"])
+            forecast = level + trend * (float(L) / max(ki, 1) + 1.0)
+            reactive = obs + kd["burst_slope_rps"] * p.startup_s
+            target_rate = max(reactive, forecast if trusted else 0.0)
+            desired_raw = min(max(
+                math.ceil(target_rate / max(r_target, _EPS))
+                + kd["headroom_replicas"], 1.0), float(p.max_replicas))
+            degraded = fault_run * p.dt >= kd["degraded_after_s"]
+            frozen = fault_run * p.dt >= kd["freeze_after_s"]
+            can_down = (since_up >= stab_steps and not degraded
+                        and recovery <= 0)
+            if act:
+                if desired_raw > desired:
+                    desired_new = desired_raw
+                elif can_down:
+                    desired_new = desired_raw
+                else:
+                    desired_new = desired
+                if frozen:
+                    desired_new = desired
+                if desired_new < desired and lam_t > last_lam + _EPS:
+                    wd += 1.0
+                if desired_new > desired:
+                    since_up = 0.0
+                else:
+                    since_up += 1.0
+                last_lam = lam_t
+                desired = desired_new
+            else:
+                since_up += 1.0
+            pending = sum(pipe)
+            excess = max(ready - desired, 0.0)
+            ready -= excess
+            short = max(desired - (ready + pending), 0.0)
+            pipe[L - 1] += short
+            chip_s += desired * p.chips_per_replica * p.dt
+        out["attainment"][m] = attained / max(total, 1.0)
+        out["chip_seconds"][m] = chip_s
+        out["wrong_direction"][m] = wd
+    return out

@@ -1,0 +1,78 @@
+"""Seeded-schedule primitives shared by the chaos plane and the load
+generator.
+
+Every stochastic schedule in the emulated world — fault windows, restart
+instants, Poisson burst trains — must replay byte-for-byte across
+processes and platforms. Two disciplines guarantee that, and they were
+duplicated across ``emulator/faults.py`` and ``emulator/loadgen.py``
+before this module hoisted them:
+
+- **CRC32 keying**: uniform draws and categorical picks derive from
+  ``zlib.crc32(repr((seed, *salt)))`` — never from Python's
+  process-randomized ``hash`` — so a decision depends only on the seed
+  and a stable salt tuple.
+- **``random.Random(seed)`` recurrences**: sequential draws (exponential
+  burst gaps) come from a dedicated ``Random`` instance whose state is a
+  pure function of the seed and the draw COUNT, so lazily- and
+  eagerly-generated schedules agree on every shared prefix.
+
+The delegating call sites keep their byte-identical outputs (asserted by
+``tests/test_seeds.py`` against the pre-hoist formulas, and transitively
+by the unchanged replay goldens).
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+
+
+def crc_key(*key) -> int:
+    """CRC32 of the stable repr of ``key`` — the process-hash-proof basis
+    for every seeded categorical decision (``% 2`` coin flips, ``% n``
+    picks, jitter fractions)."""
+    return zlib.crc32(repr(key).encode())
+
+
+def det01(*key) -> float:
+    """Deterministic uniform [0, 1) from a seed + stable salt tuple
+    (the ``FaultPlan`` error-rate / partial-drop discipline)."""
+    return (crc_key(*key) % 100_000) / 100_000.0
+
+
+def seeded_instants(seed: int, salt: str, horizon: float, n: int,
+                    min_gap: float, settle: float) -> list[float]:
+    """CRC32-jittered instants spread over ``[settle, horizon - settle]``
+    with at least ``min_gap`` between them. Shared by the restart,
+    leader-flap, and shard-crash schedules so their spacing math can
+    never silently diverge."""
+    span = max(horizon - 2 * settle, min_gap * max(n, 1))
+    instants: list[float] = []
+    last = settle - min_gap
+    for i in range(n):
+        base = settle + span * (i + 0.5) / n
+        jitter = ((crc_key(seed, salt, i) % 1000) / 1000.0 - 0.5) \
+            * min_gap * 0.5
+        at = max(base + jitter, last + min_gap)
+        last = at
+        instants.append(round(at, 1))
+    return instants
+
+
+def seeded_burst_starts(seed: int, mean_gap: float, burst_duration: float,
+                        horizon: float) -> list[float]:
+    """Poisson burst-train start times over ``[0, horizon)``: exponential
+    gaps (mean ``mean_gap``) measured from the previous burst's END —
+    the exact recurrence ``loadgen.poisson_bursts`` extends lazily, so an
+    eager schedule and the lazy profile agree on every burst that starts
+    before ``horizon``."""
+    rng = random.Random(seed)
+    starts: list[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(1.0 / max(mean_gap, 1e-9))
+        if t >= horizon:
+            break
+        starts.append(t)
+        t += burst_duration
+    return starts
