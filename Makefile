@@ -53,8 +53,8 @@ bench-profile: ## cProfile-backed hot-path dump of one quiet-tick bench run (top
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --profile $(if $(MODELS),--models $(MODELS))
 
 .PHONY: bench-analyze
-bench-analyze: ## Fused decision-plane sweep (48/480/1000/2000 models, SLO path): device dispatches/tick and analyze-phase p50 with WVA_FUSED on vs off (staged per-stage dispatches, byte-identical decisions); merges detail.fused_plane into BENCH_LOCAL.json.
-	JAX_PLATFORMS=cpu $(PYTHON) bench.py --analyze-only
+bench-analyze: ## Fused decision-plane sweep (48/480/1000/2000/4000 models, SLO path): device dispatches/tick and analyze-phase p50 with WVA_FUSED on vs off (staged per-stage dispatches, byte-identical decisions), plus the vec-vs-loop host-stage breakdown at 1000 models; merges detail.fused_plane into BENCH_LOCAL.json. ANALYZE_SMOKE=1 runs the short CI assertion shape (1.0 dispatches/tick + WVA_VEC_DECIDE=off byte-equality).
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --analyze-only $(if $(ANALYZE_SMOKE),--smoke)
 
 .PHONY: bench-collect
 bench-collect: ## Metrics-plane microbench (48 models): backend queries/tick grouped ON vs per-model fan-out, and in-memory TSDB query p50 under 8 concurrent readers vs the pre-ring read path; merges into BENCH_LOCAL.json.

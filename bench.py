@@ -1151,13 +1151,13 @@ def fingerprint_scale_sweep(models=(48, 144, 480, 1000, 2000),
     }
 
 
-def analyze_plane_bench(models=(48, 480, 1000, 2000),
+def analyze_plane_bench(models=(48, 480, 1000, 2000, 4000),
                         variants_per_model: int = 2,
                         measured_ticks: int = 7,
                         warm_ticks: int = 3) -> dict:
     """Fused decision-plane sweep (``make bench-analyze``, BENCH_LOCAL
-    ``detail.fused_plane``): the SLO analyze phase at 1x/10x/~21x/~42x
-    fleet size with WVA_FUSED on vs off, measuring
+    ``detail.fused_plane``): the SLO analyze phase at 1x/10x/~21x/~42x/
+    ~83x fleet size with WVA_FUSED on vs off, measuring
 
     - **device dispatches per tick** (utils.dispatch deltas around each
       engine tick) — the tentpole's headline: the fused path launches
@@ -1173,6 +1173,7 @@ def analyze_plane_bench(models=(48, 480, 1000, 2000),
     ticks would measure the skip plane, not the decision plane."""
     import statistics
 
+    from wva_tpu import fused as fused_mod
     from wva_tpu.engines import common as engines_common
     from wva_tpu.utils import dispatch as dispatch_counter
 
@@ -1180,6 +1181,9 @@ def analyze_plane_bench(models=(48, 480, 1000, 2000),
     for n in models:
         point: dict[str, dict] = {}
         for label, fused_on in (("fused", True), ("staged", False)):
+            # Per-run memo reset: each measured configuration pays its own
+            # first-solve tick, so points are independent of run order.
+            fused_mod.clear_solve_memo()
             mgr, cluster, clock, feed = _build_tick_world(
                 n, variants_per_model, incremental=False, fused=fused_on)
             eng = mgr.engine
@@ -1213,20 +1217,146 @@ def analyze_plane_bench(models=(48, 480, 1000, 2000),
         out[str(n)] = point
     return {
         "sweep": out,
+        "host_breakdown": _host_stage_breakdown(
+            1000, variants_per_model, measured_ticks, warm_ticks),
         "levers": {
             "fused": "WVA_FUSED on (shipped): one fused dispatch per "
                      "analyzing tick",
             "staged": "WVA_FUSED off: one dispatch per stage (batched "
                       "sizing + forecast fit), byte-identical decisions",
+            "host_breakdown": "per-stage host ms at 1000 models, fused "
+                              "on: WVA_VEC_DECIDE on (vec: fleet-wide "
+                              "row arithmetic) vs off (loop: per-model "
+                              "Python), trace off so trace_materialize "
+                              "shows the deferred-steps win",
         },
     }
 
 
+def _host_stage_breakdown(n_models: int, variants_per_model: int,
+                          measured_ticks: int, warm_ticks: int) -> dict:
+    """Vec-vs-loop A/B of the decision stage's host time
+    (``engine.last_tick_stage_seconds``): finalize / optimize / enforce /
+    trace-materialize p50 ms per tick at ``n_models`` models, fused on.
+    The enforce row is where the loop form's O(models x decisions)
+    rescans show; trace_materialize is ~0 either way because these
+    worlds run with the flight recorder off."""
+    import statistics
+
+    from wva_tpu import fused as fused_mod
+
+    out: dict[str, object] = {"models": n_models}
+    for label, vec in (("vec", True), ("loop", False)):
+        fused_mod.clear_solve_memo()
+        mgr, cluster, clock, feed = _build_tick_world(
+            n_models, variants_per_model, incremental=False, fused=True)
+        eng = mgr.engine
+        eng.vec_decide = vec
+        for _ in range(warm_ticks):
+            eng.optimize()
+            clock.advance(5.0)
+            feed(clock.now())
+        stages: dict[str, list[float]] = {}
+        analyze_ms: list[float] = []
+        for _ in range(measured_ticks):
+            eng.optimize()
+            for k, v in eng.last_tick_stage_seconds.items():
+                stages.setdefault(k, []).append(v * 1000.0)
+            analyze_ms.append(
+                eng.last_tick_phase_seconds.get("analyze", 0.0) * 1000.0)
+            clock.advance(5.0)
+            feed(clock.now())
+        mgr.shutdown()
+        _drain_decision_bus()
+        row = {f"{k}_p50_ms": round(statistics.median(v), 3)
+               for k, v in sorted(stages.items())}
+        row["analyze_p50_ms"] = round(statistics.median(analyze_ms), 2)
+        out[label] = row
+    vec_row, loop_row = out["vec"], out["loop"]
+    out["stage_speedups"] = {
+        k: round(loop_row[k] / max(vec_row[k], 1e-9), 2)
+        for k in ("finalize_p50_ms", "optimize_p50_ms", "enforce_p50_ms")
+        if k in vec_row and k in loop_row}
+    return out
+
+
+def analyze_smoke() -> dict:
+    """ANALYZE_SMOKE=1 CI shape (mirrors SHARD_SMOKE/SWEEP_SMOKE):
+    asserts the decision plane's two hard contracts on a small changing
+    world instead of measuring latency —
+
+    1. exactly **1.0 device dispatches per analyzing tick** on the
+       fused path (solve-memo hit ticks dispatch the forecast fits,
+       miss ticks the full program — either way one dispatch);
+    2. **WVA_VEC_DECIDE=off byte-identical statuses** at every tick
+       (the vectorized finalize/optimize/enforce passes vs the
+       per-model loops).
+    """
+    from wva_tpu import fused as fused_mod
+    from wva_tpu.blackbox.schema import encode
+    from wva_tpu.utils import dispatch as dispatch_counter
+
+    n_models, warm_ticks, measured_ticks = 24, 2, 5
+
+    def run(vec: bool):
+        fused_mod.clear_solve_memo()
+        mgr, cluster, clock, feed = _build_tick_world(
+            n_models, 2, incremental=False, fused=True)
+        eng = mgr.engine
+        eng.vec_decide = vec
+        for _ in range(warm_ticks):
+            eng.optimize()
+            clock.advance(5.0)
+            feed(clock.now())
+        snaps: list[str] = []
+        dispatches: list[int] = []
+        for _ in range(measured_ticks):
+            d0 = dispatch_counter.count()
+            eng.optimize()
+            dispatches.append(dispatch_counter.count() - d0)
+            snap = {
+                f"{va.metadata.namespace}/{va.metadata.name}":
+                    encode(va.status)
+                for va in cluster.list("VariantAutoscaling",
+                                       namespace="bench")}
+            snaps.append(json.dumps(snap, sort_keys=True))
+            clock.advance(5.0)
+            feed(clock.now())
+        mgr.shutdown()
+        _drain_decision_bus()
+        return snaps, dispatches
+
+    vec_snaps, vec_dispatches = run(True)
+    loop_snaps, _ = run(False)
+    per_tick = sum(vec_dispatches) / len(vec_dispatches)
+    assert per_tick == 1.0, \
+        f"fused analyze tick: expected 1.0 dispatches/tick, got {per_tick}"
+    assert vec_snaps == loop_snaps, \
+        "WVA_VEC_DECIDE=off statuses diverged from the vectorized path"
+    return {"smoke": True, "models": n_models,
+            "measured_ticks": measured_ticks,
+            "dispatches_per_tick": per_tick,
+            "vec_off_byte_identical": True}
+
+
 def analyze_main() -> None:
     """`make bench-analyze`: the fused decision-plane sweep, merged into
-    BENCH_LOCAL.json detail.fused_plane, one JSON line on stdout."""
+    BENCH_LOCAL.json detail.fused_plane, one JSON line on stdout.
+    `--smoke` (ANALYZE_SMOKE=1) runs the short CI assertion shape (24
+    models; 1.0 dispatches/tick + vec-off byte-equality, no latency
+    sweep, no BENCH_LOCAL merge)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     t0 = time.time()
+    if "--smoke" in sys.argv:
+        record = analyze_smoke()
+        record["bench_wall_seconds"] = round(time.time() - t0, 1)
+        print(json.dumps({
+            "metric": "analyze_smoke_dispatches_per_tick",
+            "value": record["dispatches_per_tick"],
+            "unit": "dispatches_per_tick",
+            "detail": record,
+        }))
+        return
     record = analyze_plane_bench()
     record["bench_wall_seconds"] = round(time.time() - t0, 1)
     _merge_bench_local("fused_plane", record)

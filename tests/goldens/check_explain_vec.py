@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""CI check: ``wva explain`` against a freshly generated
+WVA_VEC_DECIDE=on decision trace (the vectorized decision stage,
+docs/design/fused-plane.md §host-vectorization).
+
+Generates the SAME seeded emulated scenario twice — vectorized decisions
+on and off — and asserts:
+
+1. the vec-ON trace explains cleanly: every variant's ``decision_steps``
+   chain is non-empty and every ``set_by`` verdict names a known
+   pipeline stage (the vectorized passes append the same step records
+   the loops did);
+2. the chains are **unchanged**: per model, the explain output
+   (steps, set_by, final_desired) under vec-ON is identical to vec-OFF.
+
+Run from the repo root (CPU platform, like the test suite):
+
+    JAX_PLATFORMS=cpu python tests/goldens/check_explain_vec.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+SEED = 20260806
+MODELS = 3
+HORIZON = 240.0
+
+# The stage vocabulary a set_by verdict may name (blackbox.schema): the
+# analyzer's opening word (suffixed "analyzer:<name>") plus every stage
+# that can move the target.
+KNOWN_STAGES = {"analyzer", "optimizer", "enforcer", "limiter", "forecast",
+                "capacity", "health", "shard", "actuation"}
+
+
+def _drain_bus() -> None:
+    from wva_tpu.engines import common
+
+    common.DecisionCache.clear()
+    while not common.DecisionTrigger.empty():
+        common.DecisionTrigger.get_nowait()
+
+
+def generate(vec: bool, path: str) -> None:
+    from wva_tpu.config.loader import load as load_config
+    from wva_tpu.emulator import (
+        EmulationHarness,
+        HPAParams,
+        ServingParams,
+        VariantSpec,
+        trapezoid,
+    )
+    from wva_tpu.interfaces import SaturationScalingConfig
+
+    _drain_bus()
+    cfg = load_config(env={
+        "PROMETHEUS_BASE_URL": "http://prometheus.test:9090",
+        "WVA_TRACE_ENABLED": "true",
+        "WVA_TRACE_PATH": path,
+        "WVA_VEC_DECIDE": "true" if vec else "false",
+    })
+    load = trapezoid(base_rate=2.0, peak_rate=16.0, ramp_up=60.0,
+                     hold=40.0, ramp_down=40.0, tail=1e9, delay=20.0)
+    specs = [VariantSpec(
+        name=f"e{i}-v5e", model_id=f"explain/vec-model-{i}",
+        accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+        initial_replicas=1, serving=ServingParams(engine="jetstream"),
+        load=load,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=30.0,
+                      sync_period_seconds=5.0))
+        for i in range(MODELS)]
+    harness = EmulationHarness(
+        specs,
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=cfg,
+        nodepools=[("v5e-pool", "v5e", "2x4", 12)],
+        startup_seconds=15.0, engine_interval=15.0,
+        stochastic_seed=SEED)
+    harness.run(HORIZON)
+    harness.manager.shutdown()
+    _drain_bus()
+
+
+def explain_all(path: str) -> dict:
+    from wva_tpu.blackbox.replay import load_trace
+    from wva_tpu.obs.explain import explain_model
+
+    cycles = load_trace(path)
+    assert cycles, f"{path}: empty trace"
+    out = {}
+    for i in range(MODELS):
+        model = f"explain/vec-model-{i}"
+        report = explain_model(cycles, model)
+        assert report.get("variants"), f"{model}: no variants explained"
+        for v in report["variants"]:
+            assert v["steps"], f"{model}: empty decision_steps chain"
+            assert v["set_by"].split(":", 1)[0] in KNOWN_STAGES, \
+                f"{model}: unknown set_by stage {v['set_by']!r}"
+        out[model] = [{"variant": v["variant_name"],
+                       "steps": v["steps"],
+                       "set_by": v["set_by"],
+                       "final_desired": v["final_desired"]}
+                      for v in report["variants"]]
+    return out
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as tmp:
+        vec_path = os.path.join(tmp, "vec_on.jsonl")
+        loop_path = os.path.join(tmp, "vec_off.jsonl")
+        generate(True, vec_path)
+        generate(False, loop_path)
+        vec = explain_all(vec_path)
+        loop = explain_all(loop_path)
+    assert json.dumps(vec, sort_keys=True) == \
+        json.dumps(loop, sort_keys=True), \
+        "vec-ON explain output diverged from vec-OFF"
+    n_steps = sum(len(v["steps"]) for vs in vec.values() for v in vs)
+    print(f"explain vec-check OK: {MODELS} models, {n_steps} steps in the "
+          f"final cycle's chains, set_by stages "
+          f"{sorted({v['set_by'] for vs in vec.values() for v in vs})}, "
+          "vec-on == vec-off")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()

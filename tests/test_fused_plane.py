@@ -90,7 +90,8 @@ def make_slo_world(n_models: int = 6, fused: bool = True,
                    trace: bool = False, sharding: int = 0,
                    dynamics: bool = False, fast_trust: bool = False,
                    zero_models: tuple = (), forecast: bool = True,
-                   spans: bool = True):
+                   spans: bool = True, vec_decide: bool = True,
+                   solve_memo: bool = True):
     """SLO-path fleet world: one VA/Deployment/pod per model, live KV +
     queue + arrival-rate telemetry, per-model SLO targets and profiles.
 
@@ -105,6 +106,8 @@ def make_slo_world(n_models: int = 6, fused: bool = True,
     tsdb = TimeSeriesDB(clock=clock)
     cfg = new_test_config()
     cfg.infrastructure.fused = fused
+    cfg.infrastructure.vec_decide = vec_decide
+    cfg.infrastructure.solve_memo = solve_memo
     if trace:
         cfg.set_trace(TraceConfig(enabled=True))
     if not forecast:
@@ -457,9 +460,9 @@ def test_mask_columns_reflect_world_dynamics(monkeypatch):
     captured = {}
     real_run = fused_mod.run
 
-    def spy(grids):
+    def spy(grids, **kwargs):
         captured["grids"] = grids
-        return real_run(grids)
+        return real_run(grids, **kwargs)
 
     monkeypatch.setattr(fused_mod, "run", spy)
     _drain_bus()

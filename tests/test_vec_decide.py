@@ -1,0 +1,136 @@
+"""Vectorized decision stage lever (WVA_VEC_DECIDE;
+docs/design/fused-plane.md §host-vectorization):
+
+Seeded randomized-dynamics property tests asserting the vectorized
+finalize/optimize/enforce passes are byte-identical to the per-model
+host loops they replace — statuses AND decision-trace cycles — over
+worlds exercising every mask column (tuner-enabled, global-routed,
+untrusted-forecast, scaled-to-zero), at shard counts 1 and 4, under
+WVA_FUSED on and off, and with the WVA_SOLVE_MEMO delta-sizing memo on
+and off.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tests.test_fused_plane import (
+    NS,
+    NS_GLOBAL,
+    NS_TUNER,
+    _drain_bus,
+    _dumps,
+    _statuses,
+    make_slo_world,
+)
+
+pytestmark = pytest.mark.fused
+
+ALL_NS = [NS, NS_GLOBAL, NS_TUNER]
+ZERO = (3, 4)
+
+
+def _run_random_world(vec: bool, *, fused: bool = True, shards: int = 0,
+                      trace: bool = False, solve_memo: bool = True,
+                      vec_assert: bool = False, seed: int = 1234,
+                      steps: int = 8):
+    """Drive a seeded randomized-dynamics world for ``steps`` ticks,
+    snapshotting every VA status after each tick. Demand drifts every
+    tick; KV samples mutate randomly; models span the plain / global-
+    optimized / tuner-enabled namespaces with two scaled-to-zero models.
+    Returns (per-tick status snaps, trace cycles or None)."""
+    from wva_tpu import fused as fused_mod
+
+    _drain_bus()
+    fused_mod.clear_solve_memo()
+    mgr, cluster, tsdb, clock, feed = make_slo_world(
+        6, fused=fused, trace=trace, sharding=shards, dynamics=True,
+        fast_trust=True, zero_models=ZERO, vec_decide=vec,
+        solve_memo=solve_memo)
+    if vec_assert:
+        mgr.engine.vec_assert = True
+    rng = random.Random(seed)
+    snaps = []
+    for _ in range(steps):
+        if trace:
+            mgr.engine.executor.tick()
+            mgr.va_reconciler.drain_triggers()
+        else:
+            mgr.run_once()
+        clock.advance(5.0)
+        feed(clock.now(), rate_scale=1.0 + rng.uniform(-0.4, 0.9))
+        if rng.random() < 0.4:
+            i = rng.randrange(6)
+            if i not in ZERO:
+                ns = ALL_NS[i % 3]
+                pod = {"pod": f"f{i:03d}-v5e-0", "namespace": ns,
+                       "model_name": f"org/fused-model-{i:03d}"}
+                tsdb.add_sample("vllm:kv_cache_usage_perc", pod,
+                                round(rng.uniform(0.15, 0.95), 3),
+                                timestamp=clock.now())
+        snaps.append(_statuses(cluster, ALL_NS))
+    cycles = None
+    if trace:
+        mgr.flight_recorder.flush()
+        cycles = mgr.flight_recorder.snapshot()
+    mgr.shutdown()
+    return snaps, cycles
+
+
+def _assert_snaps_equal(on, off, label):
+    assert len(on) == len(off) > 0, label
+    for t, (a, b) in enumerate(zip(on, off)):
+        assert _dumps(a) == _dumps(b), f"{label}: tick {t} diverged"
+
+
+def test_vec_decide_off_byte_identical_fused_on_and_off():
+    """WVA_VEC_DECIDE=off restores the per-model loops with
+    byte-identical statuses at every tick of a randomized-dynamics
+    world, whether the device plane is fused or staged."""
+    for fused in (True, False):
+        on, _ = _run_random_world(True, fused=fused)
+        off, _ = _run_random_world(False, fused=fused)
+        _assert_snaps_equal(on, off, f"fused={fused}")
+
+
+def test_vec_decide_off_identical_trace_cycles():
+    """Decision-trace cycles — the full provenance plane, including the
+    deferred step-dict materialization — are byte-identical vec vs
+    loop on a changing world."""
+    on_snaps, on_cycles = _run_random_world(True, trace=True)
+    off_snaps, off_cycles = _run_random_world(False, trace=True)
+    _assert_snaps_equal(on_snaps, off_snaps, "trace world statuses")
+    assert len(on_cycles) == len(off_cycles) > 0
+    for a, b in zip(on_cycles, off_cycles):
+        assert _dumps(a) == _dumps(b)
+
+
+def test_vec_decide_off_byte_identical_at_shard_counts():
+    """Vec-vs-loop byte-identity holds under the sharded active-active
+    engine: each worker runs the vectorized decision stage over its own
+    partition."""
+    for shards in (1, 4):
+        on, _ = _run_random_world(True, shards=shards)
+        off, _ = _run_random_world(False, shards=shards)
+        _assert_snaps_equal(on, off, f"shards={shards}")
+
+
+def test_vec_assert_mode_runs_and_matches():
+    """WVA_VEC_ASSERT cross-check mode: the vectorized passes run with
+    shadow per-model loops asserting agreement in-line. A changing
+    world completes every tick without tripping the cross-check, and
+    statuses are byte-identical to a plain vec run."""
+    plain, _ = _run_random_world(True)
+    checked, _ = _run_random_world(True, vec_assert=True)
+    _assert_snaps_equal(plain, checked, "vec_assert")
+
+
+def test_solve_memo_off_byte_identical():
+    """WVA_SOLVE_MEMO=off (full re-solve every tick) is byte-identical
+    to memoized delta sizing: a candidate's sized rate is a pure
+    function of its solve key."""
+    on, _ = _run_random_world(True, solve_memo=True)
+    off, _ = _run_random_world(True, solve_memo=False)
+    _assert_snaps_equal(on, off, "solve_memo")

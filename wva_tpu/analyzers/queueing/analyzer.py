@@ -105,6 +105,108 @@ def demand_estimate(arrival_rate_per_min: float, backlog: float) -> float:
             + max(backlog, 0.0) / BACKLOG_DRAIN_HORIZON_SECONDS)
 
 
+def finalize_algebra(
+    demand: float,
+    slope: float,
+    supply: float,
+    anticipated: float,
+    best_headroom_capacity: float | None,
+    scale_up: float,
+    scale_down: float,
+    horizon: float,
+    headroom_replicas: float,
+    burst_slope_rps: float,
+) -> tuple[float, float, float, float, float]:
+    """The scalar supply/demand headroom algebra of :meth:`finalize` as a
+    pure function — the ONE source of truth shared by the per-model path
+    and the vectorized fleet pass (``wva_tpu.pipeline.vectorized``), whose
+    WVA_VEC_ASSERT cross-check replays exactly these ops per row. Returns
+    ``(scaling_demand, headroom_capacity, utilization, required_capacity,
+    spare_capacity)``."""
+    # Provisioning-horizon anticipation (growth only): scale-up sizes for
+    # projected demand, scale-down keeps using current demand.
+    scaling_demand = demand
+    if horizon > 0:
+        scaling_demand += max(slope, 0.0) * horizon
+    # Deficit-aware anticipation: while demand is ramping, requests arriving
+    # above the fleet's capacity accumulate as backlog until the ordered
+    # replicas become ready — size the scale-up to DRAIN the backlog that
+    # will exist at landing, not just for demand AT landing. Pending
+    # replicas count (anticipated): once they land mid-horizon the real
+    # remaining shortfall re-enters through the live backlog term.
+    if horizon > 0 and slope > 0:
+        t0 = 0.0 if demand >= anticipated else \
+            min((anticipated - demand) / slope, horizon)
+        deficit_requests = ((demand - anticipated) * (horizon - t0)
+                            + slope * (horizon * horizon - t0 * t0) / 2.0)
+        if deficit_requests > 0:
+            scaling_demand += deficit_requests / BACKLOG_DRAIN_HORIZON_SECONDS
+    # Standing spare-capacity floor (headroomReplicas / burstSlope): one
+    # headroom replica = one replica of the variant the optimizer would add
+    # first (best cost-efficiency — the caller resolves that pair).
+    headroom_capacity = 0.0
+    if headroom_replicas > 0 and best_headroom_capacity is not None:
+        headroom_capacity = headroom_replicas * best_headroom_capacity
+    if burst_slope_rps > 0 and horizon > 0:
+        headroom_capacity = max(headroom_capacity, burst_slope_rps * horizon)
+    utilization = demand / supply if supply > 0 else (1.0 if demand > 0 else 0.0)
+    # Same anticipated-supply headroom algebra as V2
+    # (saturation_v2/analyzer.go:104-138 via saturation_scaling.go:54-57).
+    required_capacity = max(
+        scaling_demand / scale_up + headroom_capacity - anticipated, 0.0)
+    spare_capacity = max(
+        supply - demand / scale_down - headroom_capacity, 0.0) \
+        if supply > 0 else 0.0
+    # Never remove capacity while demand is growing: a scale-down decided
+    # mid-ramp cannot be corrected for a whole provisioning horizon.
+    if horizon > 0 and slope > 0:
+        spare_capacity = 0.0
+    return (scaling_demand, headroom_capacity, utilization,
+            required_capacity, spare_capacity)
+
+
+def accumulate_capacities(
+    result: AnalyzerResult,
+    candidates: list["_Candidate"],
+    per_replica: list[float],
+    headroom_replicas: float,
+) -> tuple[float, float, float | None]:
+    """The candidate walk of :meth:`finalize`: append one VariantCapacity
+    per sized candidate and return ``(supply, anticipated,
+    best_headroom_capacity)``. The left-to-right scalar sums are kept —
+    summation order is exactly where a numpy reduction would stop being
+    bitwise-identical to the per-model path — and shared with the
+    vectorized fleet pass so both paths run THIS walk."""
+    supply = 0.0
+    anticipated = 0.0
+    for cand, cap in zip(candidates, per_replica):
+        total = cap * cand.ready
+        supply += total
+        anticipated += cap * (cand.ready + cand.pending)
+        result.variant_capacities.append(VariantCapacity(
+            variant_name=cand.variant_name,
+            accelerator_name=cand.accelerator,
+            cost=cand.cost,
+            replica_count=cand.ready,
+            pending_replicas=cand.pending,
+            per_replica_capacity=cap,
+            total_capacity=total,
+            total_demand=0.0,
+            utilization=0.0,
+        ))
+    best_headroom_capacity = None
+    if headroom_replicas > 0:
+        # One headroom replica = one replica of the best cost-efficiency
+        # variant (ties break on capacity via the tuple compare), so the
+        # knob and the optimizer's fill order agree on what "a spare
+        # replica" is.
+        pairs = [(cand.cost / cap, cap)
+                 for cand, cap in zip(candidates, per_replica) if cap > 0]
+        if pairs:
+            best_headroom_capacity = min(pairs)[1]
+    return supply, anticipated, best_headroom_capacity
+
+
 @dataclass
 class _Candidate:
     """One (variant, accelerator) sizing candidate prepared for the batch."""
@@ -262,105 +364,24 @@ class QueueingModelAnalyzer(Analyzer):
         scale_down = cfg.scale_down_boundary or DEFAULT_SCALE_DOWN_BOUNDARY
 
         demand = self._demand_per_s(input)
-        # Provisioning-horizon anticipation (growth only), same semantics as
-        # the V2 analyzer: scale-up sizes for projected demand, scale-down
-        # keeps using current demand. The TREND series deliberately uses the
-        # same estimate the fast-path monitor feeds (arrival rate +
-        # scheduler flow-control backlog, NO per-replica queues): mixing two
-        # demand definitions at different cadences would sawtooth the
-        # least-squares slope. Per-replica queueing still counts in the
-        # sizing demand above.
+        # The TREND series deliberately uses the same estimate the
+        # fast-path monitor feeds (arrival rate + scheduler flow-control
+        # backlog, NO per-replica queues): mixing two demand definitions at
+        # different cadences would sawtooth the least-squares slope.
+        # Per-replica queueing still counts in the sizing demand above.
         slope = self._demand_trend.observe(
             f"{input.namespace}|{input.model_id}", result.analyzed_at,
             self._trend_demand_per_s(input))
-        scaling_demand = demand
-        if cfg.anticipation_horizon_seconds > 0:
-            scaling_demand += max(slope, 0.0) * cfg.anticipation_horizon_seconds
-        supply = 0.0
-        anticipated = 0.0
-        for cand, cap in zip(candidates, per_replica):
-            total = cap * cand.ready
-            supply += total
-            anticipated += cap * (cand.ready + cand.pending)
-            result.variant_capacities.append(VariantCapacity(
-                variant_name=cand.variant_name,
-                accelerator_name=cand.accelerator,
-                cost=cand.cost,
-                replica_count=cand.ready,
-                pending_replicas=cand.pending,
-                per_replica_capacity=cap,
-                total_capacity=total,
-                total_demand=0.0,
-                utilization=0.0,
-            ))
-
-        # Deficit-aware anticipation: while demand is ramping, requests
-        # arriving above the fleet's capacity accumulate as backlog until
-        # the ordered replicas become ready — so the scale-up must be sized
-        # not just for demand AT landing (the slope x horizon term above)
-        # but for DRAINING the backlog that will exist at landing. Project
-        # the deficit integral over the horizon against anticipated supply
-        # (pending replicas count: once they land mid-horizon the remaining
-        # real shortfall re-enters through the live backlog term in
-        # ``demand``, so crediting them avoids runaway re-ordering every
-        # tick while pods are provisioning).
-        if cfg.anticipation_horizon_seconds > 0 and slope > 0:
-            h = cfg.anticipation_horizon_seconds
-            # First instant (within the horizon) at which demand exceeds
-            # anticipated supply.
-            t0 = 0.0 if demand >= anticipated else \
-                min((anticipated - demand) / slope, h)
-            deficit_requests = ((demand - anticipated) * (h - t0)
-                                + slope * (h * h - t0 * t0) / 2.0)
-            if deficit_requests > 0:
-                scaling_demand += deficit_requests / BACKLOG_DRAIN_HORIZON_SECONDS
-
-        # Standing spare-capacity floor for latency-SLO models: with slices
-        # taking minutes to provision, the first minutes of any ramp are
-        # served by whatever capacity already exists — ``headroomReplicas``
-        # keeps that insurance provisioned (N+1 for TTFT SLOs). Counted as
-        # extra required capacity and shielded from scale-down.
-        headroom_capacity = 0.0
-        if cfg.headroom_replicas > 0:
-            # One headroom replica = one replica of the variant the
-            # optimizer would add first (best cost-efficiency), so the knob
-            # and the fill order agree on what "a spare replica" is.
-            pairs = [(cand.cost / cap, cap)
-                     for cand, cap in zip(candidates, per_replica) if cap > 0]
-            if pairs:
-                headroom_capacity = cfg.headroom_replicas * min(pairs)[1]
-        if cfg.burst_slope_rps > 0 and cfg.anticipation_horizon_seconds > 0:
-            # Derived burst insurance: during the provisioning blackout
-            # (one anticipation horizon — nothing ordered after a ramp
-            # starts can land sooner), demand can grow by at most the
-            # declared worst-credible slope x horizon. Standing exactly
-            # that much spare capacity makes the knob a commitment ("this
-            # ramp shape stays in SLO"), not a guessed replica count. The
-            # inventory limiter still caps the resulting desired count, so
-            # insurance never outgrows the fleet.
-            headroom_capacity = max(
-                headroom_capacity,
-                cfg.burst_slope_rps * cfg.anticipation_horizon_seconds)
-
+        supply, anticipated, best_headroom = accumulate_capacities(
+            result, candidates, per_replica, cfg.headroom_replicas)
+        (result.scaling_demand, result.headroom_capacity,
+         result.utilization, result.required_capacity,
+         result.spare_capacity) = finalize_algebra(
+            demand, slope, supply, anticipated, best_headroom,
+            scale_up, scale_down, cfg.anticipation_horizon_seconds,
+            cfg.headroom_replicas, cfg.burst_slope_rps)
         result.total_supply = supply
         result.total_demand = demand
-        result.scaling_demand = scaling_demand
-        result.headroom_capacity = headroom_capacity
-        result.utilization = demand / supply if supply > 0 else (1.0 if demand > 0 else 0.0)
-        # Same anticipated-supply headroom algebra as V2
-        # (saturation_v2/analyzer.go:104-138 via saturation_scaling.go:54-57).
-        result.required_capacity = max(
-            scaling_demand / scale_up + headroom_capacity - anticipated, 0.0)
-        result.spare_capacity = max(
-            supply - demand / scale_down - headroom_capacity, 0.0) \
-            if supply > 0 else 0.0
-        # Never remove capacity while demand is growing: a scale-down
-        # decided mid-ramp cannot be corrected for a whole provisioning
-        # horizon (the replica is gone in seconds, its replacement takes
-        # minutes). Noise around zero slope just defers the scale-down to
-        # the next flat tick.
-        if cfg.anticipation_horizon_seconds > 0 and slope > 0:
-            result.spare_capacity = 0.0
         return result
 
     # -- internals --

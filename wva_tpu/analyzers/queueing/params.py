@@ -182,13 +182,19 @@ class PerfProfileStore:
 
     def get(self, model_id: str, accelerator: str,
             namespace: str = "") -> PerfProfile | None:
-        """Namespace-local profile if present, else the global one."""
-        with self._lock:
-            if namespace:
-                prof = self._profiles.get(self._key(namespace, model_id, accelerator))
-                if prof is not None:
-                    return prof
-            return self._profiles.get(self._key("", model_id, accelerator))
+        """Namespace-local profile if present, else the global one.
+
+        Lock-free: dict reads are atomic under the GIL, writers either
+        mutate entries in place (atomic set) or swap the whole dict
+        (``sync_namespace``), and a read racing a writer legitimately
+        sees either side of it — the same outcomes the locked read had,
+        minus the RLock convoy the analyze pool paid per model."""
+        profiles = self._profiles
+        if namespace:
+            prof = profiles.get(self._key(namespace, model_id, accelerator))
+            if prof is not None:
+                return prof
+        return profiles.get(self._key("", model_id, accelerator))
 
     def sync_namespace(self, namespace: str, profiles: list[PerfProfile]) -> None:
         """Adopt the config's profile set for one namespace scope: config-

@@ -520,6 +520,17 @@ class GroupedMetricsView(MetricsSource):
         # extra-param names) per template name.
         self._vmap: dict[tuple, tuple] = {}
         self._tpl_pre: dict[str, tuple | None] = {}
+        # Serving-tier plan memo (one view = one tick): the per-model
+        # serve path used to re-walk template params and rebuild the
+        # grouped query for every (model, template) pair — O(models *
+        # templates) re-resolution per tick for plans that depend only on
+        # the template and its non-model params. _plan_pre memoizes the
+        # params-independent preamble (template, param list, ns-ness,
+        # extra-param names; None = ungroupable template), _plan_gq the
+        # resolved grouped query per (template, extras) — so a 1k-model
+        # refresh pays one dict hit per serve instead of a full re-plan.
+        self._plan_pre: dict[str, tuple | None] = {}
+        self._plan_gq: dict[tuple, tuple] = {}
 
     # --- MetricsSource ---
 
@@ -558,28 +569,45 @@ class GroupedMetricsView(MetricsSource):
         """Shared precondition walk for grouped serving and fingerprint
         versioning: (template, model, ns, has_ns, gq, spec_key), or None
         to delegate to the per-model path. The exclusion rules are shared
-        so the fingerprint's template coverage matches serving exactly."""
-        template = self._source.query_list().get(name)
-        if template is None or template.type != QUERY_TYPE_PROMQL:
+        so the fingerprint's template coverage matches serving exactly.
+        The params-independent legs (template resolution, grouped-query
+        construction) are memoized per view — see ``_plan_pre``."""
+        pre = self._plan_pre.get(name, False)
+        if pre is False:
+            template = self._source.query_list().get(name)
+            if (template is None or template.type != QUERY_TYPE_PROMQL
+                    or PARAM_MODEL_ID not in template.params):
+                pre = None
+            else:
+                tp = template.params
+                pre = (template, tuple(tp), PARAM_NAMESPACE in tp,
+                       tuple(k for k in tp
+                             if k not in (PARAM_MODEL_ID, PARAM_NAMESPACE)))
+            self._plan_pre[name] = pre
+        if pre is None:
             return None
-        if PARAM_MODEL_ID not in template.params:
-            return None
+        template, tparams, has_ns, extra_names = pre
         model = params.get(PARAM_MODEL_ID)
         if not model:
             return None
-        for p in template.params:
+        for p in tparams:
             if p not in params:
                 return None  # let the per-model path raise its usual error
-        has_ns = PARAM_NAMESPACE in template.params
         ns = params.get(PARAM_NAMESPACE, "") if has_ns else ""
-        extras = {k: params[k] for k in template.params
-                  if k not in (PARAM_MODEL_ID, PARAM_NAMESPACE)}
-        gq = self._source.grouped_query_for(name, extras,
-                                            self._scope_namespace)
+        # Template-order extras dict (what grouped_query_for always saw);
+        # the sorted tuple is both the memo key and the spec key.
+        extras = {k: params[k] for k in extra_names}
+        ekey = tuple(sorted(extras.items()))  # fp-lint: bounded
+        hit = self._plan_gq.get((name, ekey))
+        if hit is None:
+            gq = self._source.grouped_query_for(name, extras,
+                                                self._scope_namespace)
+            key = (name, ekey, self._scope_namespace)
+            hit = (gq, key)
+            self._plan_gq[(name, ekey)] = hit
+        gq, key = hit
         if gq is None:
             return None
-        key = (name, tuple(sorted(extras.items())),  # fp-lint: bounded
-               self._scope_namespace)                # (template params)
         return template, model, ns, has_ns, gq, key
 
     def _serve_grouped(self, name: str,

@@ -466,11 +466,32 @@ class TimeSeriesDB:
                            else [(k, self._series[k]) for k in keys])
             else:
                 entries = list(self._series.items())
+        # Pre-split the matchers once per query instead of re-dispatching
+        # _match per (series, matcher): equality tests become direct dict
+        # compares inside the loop, and the name matcher the index
+        # already satisfied is dropped. At fleet scale the scan visits
+        # thousands of series per select — the per-series function-call
+        # fan-out was a measurable slice of every fleet-wide evaluation.
+        eq: list[tuple[str, str]] = []
+        rest: list[tuple[str, str, str]] = []
+        for lbl, op, val in matchers:
+            if lbl == "__name__" and op == "=" and val == name_val:
+                continue  # every indexed entry carries this name
+            if op == "=":
+                eq.append((lbl, val))
+            else:
+                rest.append((lbl, op, val))
         out = []
         for key, s in entries:
             labels = s.labels
-            if not all(_match(labels.get(lbl, ""), op, val)
-                       for lbl, op, val in matchers):
+            ok = True
+            for lbl, val in eq:
+                if labels.get(lbl, "") != val:
+                    ok = False
+                    break
+            if not ok or (rest and not all(
+                    _match(labels.get(lbl, ""), op, val)
+                    for lbl, op, val in rest)):
                 continue
             with self._lock_for(key):
                 window = SeriesWindow(s.ts, s.vals, s.start, len(s.ts),

@@ -101,6 +101,26 @@ class InfrastructureConfig:
     # pass. Off restores the staged per-stage dispatches — byte-identical
     # statuses and trace cycles (same discipline as WVA_FP_DELTA=off).
     fused: bool = True
+    # Vectorized decision stage (WVA_VEC_DECIDE / wva.vecDecide, default
+    # on; docs/design/fused-plane.md §host-vectorization): the SLO path's
+    # post-dispatch host pipeline — finalize's supply/demand algebra, the
+    # cost-aware optimizer's greedy fills, the enforcer bridge — runs as
+    # fleet-wide row arithmetic over the [M] model axis
+    # (pipeline.vectorized). Off restores the per-model loops
+    # (byte-identical statuses and trace cycles).
+    vec_decide: bool = True
+    # Equivalence cross-check (WVA_VEC_ASSERT, default off — tests and
+    # debugging only): run BOTH decision-stage forms every tick and raise
+    # on the first diverging bit.
+    vec_assert: bool = False
+    # Delta-sizing solve memo (WVA_SOLVE_MEMO / wva.solveMemo, default
+    # on; docs/design/fused-plane.md §host-vectorization): candidate rows
+    # whose complete solve key (profile parms, request mix, bounds,
+    # targets) is unchanged reuse the memoized sized rate; a tick with no
+    # changed rows dispatches only the forecast fits — still one
+    # dispatch. Off = full re-solve every tick (byte-identical either
+    # way; sizing is a pure per-row function of the key).
+    solve_memo: bool = True
 
 
 @dataclass
@@ -427,6 +447,18 @@ class Config:
     def fused_enabled(self) -> bool:
         with self._mu:
             return self.infrastructure.fused
+
+    def vec_decide_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.vec_decide
+
+    def vec_assert_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.vec_assert
+
+    def solve_memo_enabled(self) -> bool:
+        with self._mu:
+            return self.infrastructure.solve_memo
 
     def mutation_epoch(self) -> int:
         """Monotonic counter bumped by every hot-reloadable config update.

@@ -187,6 +187,20 @@ DEFAULTS: dict[str, Any] = {
     # as ONE device dispatch per tick. Off restores the staged per-stage
     # dispatches (byte-identical statuses and traces).
     "WVA_FUSED": True,
+    # Vectorized decision stage (docs/design/fused-plane.md
+    # §host-vectorization): the SLO path's post-dispatch host pipeline
+    # (finalize algebra, cost-aware fills, enforcer bridge) runs as
+    # fleet-wide row arithmetic over the model axis. Off restores the
+    # per-model loops (byte-identical statuses and traces).
+    "WVA_VEC_DECIDE": True,
+    # Cross-check vectorized vs per-model decision stages every tick
+    # (tests/debugging only — pays both costs).
+    "WVA_VEC_ASSERT": False,
+    # Delta-sizing solve memo (docs/design/fused-plane.md
+    # §host-vectorization): candidate rows with unchanged solve keys
+    # reuse the memoized sized rate; zero-change ticks dispatch only the
+    # forecast fits. Off = full re-solve every tick (byte-identical).
+    "WVA_SOLVE_MEMO": True,
     # GET /api/v1/query instead of POST (read-only proxies).
     "PROMETHEUS_USE_GET_QUERIES": False,
 }
@@ -296,6 +310,9 @@ def load(flags: Mapping[str, Any] | None = None,
         fp_assert=r.get_bool("WVA_FP_ASSERT"),
         zero_copy=r.get_bool("WVA_ZERO_COPY"),
         fused=r.get_bool("WVA_FUSED"),
+        vec_decide=r.get_bool("WVA_VEC_DECIDE"),
+        vec_assert=r.get_bool("WVA_VEC_ASSERT"),
+        solve_memo=r.get_bool("WVA_SOLVE_MEMO"),
     )
     cfg.tls = TLSConfig(
         webhook_cert_path=r.get_str("WEBHOOK_CERT_PATH"),

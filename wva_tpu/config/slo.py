@@ -62,18 +62,52 @@ class SLOConfigData:
     # (SURVEY.md section 2 L(-1)); here it is wired but opt-in.
     tuner_enabled: bool = False
 
+    # Lazy model -> (targets, priority, class name, owner class) index.
+    # The linear class walk is O(classes) per lookup, which turns the
+    # engine's per-model resolution into O(models * classes) per tick —
+    # quadratic on fleets provisioned one-class-per-model. The guard must
+    # itself be O(1) (an O(classes) signature walk per lookup would just
+    # re-pay the scan): class-list length + entry-count total + default
+    # identity catch appends/removals, and every hit is verified against
+    # the owning class's live dict, so in-place replacement of a model's
+    # entry can never serve a stale target.
+    _index: dict | None = field(default=None, repr=False, compare=False)
+    _index_sig: tuple | None = field(default=None, repr=False, compare=False)
+    _index_entries: int = field(default=-1, repr=False, compare=False)
+
+    def _model_index(self) -> dict:
+        sig = (len(self.service_classes), id(self.default_targets))
+        if self._index is None or self._index_sig != sig:
+            index: dict[str, tuple] = {}
+            total = 0
+            for sc in self.service_classes:
+                total += len(sc.model_targets)
+                for model_id, t in sc.model_targets.items():
+                    prior = index.get(model_id)
+                    if prior is None or sc.priority < prior[1]:
+                        index[model_id] = (t, sc.priority, sc.name, sc)
+            self._index = index
+            self._index_sig = sig
+            self._index_entries = total
+        return self._index
+
+    def _resolve(self, model_id: str) -> tuple | None:
+        hit = self._model_index().get(model_id)
+        if hit is None:
+            return None
+        targets, _priority, _name, owner = hit
+        if owner.model_targets.get(model_id) is not targets:
+            # In-place replacement under an unchanged signature: rebuild.
+            self._index = None
+            hit = self._model_index().get(model_id)
+        return hit
+
     def targets_for_model(self, model_id: str) -> tuple[TargetPerf | None, int]:
         """Resolve (targets, priority) for a model: best (lowest-priority-value)
         service class listing it, else the default targets."""
-        best: tuple[TargetPerf, int] | None = None
-        for sc in self.service_classes:
-            t = sc.model_targets.get(model_id)
-            if t is None:
-                continue
-            if best is None or sc.priority < best[1]:
-                best = (t, sc.priority)
-        if best is not None:
-            return best
+        hit = self._resolve(model_id)
+        if hit is not None:
+            return hit[0], hit[1]
         if self.default_targets is not None:
             return self.default_targets, DEFAULT_SERVICE_CLASS_PRIORITY
         return None, DEFAULT_SERVICE_CLASS_PRIORITY
@@ -81,12 +115,8 @@ class SLOConfigData:
     def class_for_model(self, model_id: str) -> str | None:
         """Name of the best (lowest-priority-value) service class listing the
         model; None when unlisted (and no classes would match)."""
-        best: tuple[str, int] | None = None
-        for sc in self.service_classes:
-            if model_id in sc.model_targets:
-                if best is None or sc.priority < best[1]:
-                    best = (sc.name, sc.priority)
-        return best[0] if best is not None else None
+        hit = self._resolve(model_id)
+        return hit[2] if hit is not None else None
 
 
 def _parse_targets(raw: dict) -> TargetPerf:

@@ -153,6 +153,7 @@ from wva_tpu.pipeline import (
     bridge_enforce,
     saturation_targets_to_decisions,
 )
+from wva_tpu.pipeline import vectorized
 from wva_tpu.utils import scale_target
 from wva_tpu.utils import variant as variant_utils
 from wva_tpu.utils.clock import SYSTEM_CLOCK, Clock
@@ -458,6 +459,28 @@ class SaturationEngine:
         # (byte-identical statuses AND trace cycles, tested like
         # WVA_FP_DELTA=off).
         self.fused_enabled = True
+        # Vectorized decision stage (WVA_VEC_DECIDE, default on;
+        # docs/design/fused-plane.md §host-vectorization): the SLO path's
+        # post-dispatch host pipeline — finalize's supply/demand algebra,
+        # the cost-aware optimizer's greedy fills, and the enforcer
+        # bridge — runs as fleet-wide row arithmetic over the [M] model
+        # axis (pipeline.vectorized) instead of per-model Python. Off
+        # restores the per-model loops (byte-identical statuses AND trace
+        # cycles, tested like WVA_FUSED=off). Works identically under
+        # staged and fused ticks and inside shard workers.
+        self.vec_decide = True
+        # Equivalence cross-check (WVA_VEC_ASSERT, tests/debugging only):
+        # run BOTH decision-stage forms every tick and raise on the first
+        # diverging bit.
+        self.vec_assert = False
+        # Delta-sizing solve memo (WVA_SOLVE_MEMO, default on;
+        # docs/design/fused-plane.md §host-vectorization): candidate rows
+        # whose complete solve key (profile parms, request mix, bounds,
+        # targets) is unchanged reuse the memoized sized rate; a tick
+        # with zero changed rows dispatches only the forecast fits (still
+        # one dispatch). Off = full re-solve every tick (byte-identical
+        # either way — sizing is a pure per-row function of the key).
+        self.solve_memo = True
         # The fused dispatch's per-(model, ns, accelerator) sized rates,
         # reused by this tick's fleet solve (_optimize_global) instead of
         # a second sizing dispatch. Tick-scoped; None = staged sizing.
@@ -513,6 +536,12 @@ class SaturationEngine:
         # next hot path must be visible from metrics, not only from
         # `make bench-profile`.
         self.last_tick_phase_seconds: dict[str, float] = {}
+        # Host-stage breakdown of the v2 decision stage (bench-analyze's
+        # host_breakdown instrument): wall seconds the LAST tick spent in
+        # finalize / optimize / enforce / trace-materialize, under
+        # whichever decision-stage form (vectorized or per-model loop)
+        # ran — the A/B the bench reports.
+        self.last_tick_stage_seconds: dict[str, float] = {}
         # Obs plane (WVA_SPANS; docs/design/observability.md): the span
         # recorder build_manager installs when spans are on. Every tick
         # opens one span tree — tick -> phase -> per-model prepare/analyze
@@ -2019,6 +2048,39 @@ class SaturationEngine:
         # Stage 2 — finalize, record, and merge on the engine thread in
         # sorted model-key order (trend updates, trace records and the
         # request list stay byte-deterministic at any pool width).
+        #
+        # Vectorized decision stage (WVA_VEC_DECIDE): finalize's
+        # supply/demand algebra runs as ONE fleet-wide numpy float64
+        # column pass over the eligible models — in the SAME sorted order
+        # the loop below walks, so the per-key trend estimators evolve
+        # byte-identically. The loop then consumes the precomputed
+        # results; an errored model degrades alone through the same
+        # invalidate + safety-net path as a per-model finalize raise.
+        stage_s = {"finalize": 0.0, "optimize": 0.0, "enforce": 0.0,
+                   "trace_materialize": 0.0}
+        self.last_tick_stage_seconds = stage_s
+        vec_finalized: dict[str, object] = {}
+        vec_finalize_errors: dict[str, Exception] = {}
+        if self.vec_decide and use_slo:
+            vec_items = []
+            for group_key in sorted(model_groups):
+                status, value = outcomes[group_key]
+                if status != "ok" or group_key in sizing_errors:
+                    continue
+                plan = value[3]
+                if not plan.needs_sizing:
+                    continue
+                vec_items.append((group_key, plan,
+                                  sized.get(group_key, [])))
+            if vec_items:
+                _t0 = time.perf_counter()
+                with self._obs_span("vec_finalize",
+                                    models=len(vec_items)):
+                    vec_finalized, vec_finalize_errors = \
+                        vectorized.finalize_fleet(
+                            self.slo_analyzer, vec_items,
+                            assert_mode=self.vec_assert)
+                stage_s["finalize"] += time.perf_counter() - _t0
         for group_key in sorted(model_groups):
             model_vas = model_groups[group_key]
             model_id = model_vas[0].spec.model_id
@@ -2051,12 +2113,22 @@ class SaturationEngine:
                     # trend series must NOT be fed — same as the monolithic
                     # analyze() early returns.
                     result = out.result
+                elif group_key in vec_finalized:
+                    result = vec_finalized[group_key]
                 else:
-                    try:
-                        result = self.slo_analyzer.finalize(
-                            out, sized.get(group_key, []))
-                    except Exception as e:  # noqa: BLE001 — per-model isolation
-                        log.error("SLO analysis failed for %s: %s", model_id, e)
+                    err = vec_finalize_errors.get(group_key)
+                    result = None
+                    if err is None:
+                        _t0 = time.perf_counter()
+                        try:
+                            result = self.slo_analyzer.finalize(
+                                out, sized.get(group_key, []))
+                        except Exception as e:  # noqa: BLE001 — per-model
+                            err = e  # isolation (handled just below)
+                        stage_s["finalize"] += time.perf_counter() - _t0
+                    if err is not None:
+                        log.error("SLO analysis failed for %s: %s",
+                                  model_id, err)
                         self._invalidate_model(group_key)
                         self._emit_safety_net_metrics(model_vas, snap)
                         continue
@@ -2074,6 +2146,7 @@ class SaturationEngine:
                 ("global" if use_slo and sat_cfg.optimizer_name == "global"
                  else "cost-aware")
             if self.flight is not None:
+                _t0 = time.perf_counter()
                 self.flight.record_model({
                     "model_id": model_id, "namespace": namespace,
                     "path": "slo" if use_slo else "v2",
@@ -2088,6 +2161,7 @@ class SaturationEngine:
                     },
                     "result": result,
                 })
+                stage_s["trace_materialize"] += time.perf_counter() - _t0
             requests.append(ModelScalingRequest(
                 model_id=model_id, namespace=namespace, result=result,
                 variant_states=data.variant_states))
@@ -2138,29 +2212,93 @@ class SaturationEngine:
                 decisions.extend(
                     self._optimize_global(global_reqs, slo_cfg_by_ns))
             if local_reqs:
+                _t0 = time.perf_counter()
                 self._trace_section("optimizer")
-                decisions.extend(self.optimizer.optimize(local_reqs, None))
+                # Vectorized decision stage (WVA_VEC_DECIDE): the
+                # cost-aware greedy fills run as masked [M, V] column
+                # passes across every request at once; custom optimizers
+                # keep their per-request loop.
+                if (self.vec_decide
+                        and type(self.optimizer) is CostAwareOptimizer):
+                    local_decisions = vectorized.cost_aware_fleet(
+                        self.optimizer, local_reqs)
+                    if self.vec_assert:
+                        saved_fr = self.optimizer.flight_recorder
+                        self.optimizer.flight_recorder = None
+                        try:
+                            shadow = self.optimizer.optimize(
+                                local_reqs, None)
+                        finally:
+                            self.optimizer.flight_recorder = saved_fr
+                        vectorized.assert_equal_decisions(
+                            local_decisions, shadow, "optimizer")
+                    decisions.extend(local_decisions)
+                else:
+                    decisions.extend(
+                        self.optimizer.optimize(local_reqs, None))
+                stage_s["optimize"] += time.perf_counter() - _t0
 
             # Enforcer bridge per model (reference engine_v2.go:76-127) —
             # shared with the trace replay harness (pipeline.bridge_enforce).
             # A shard worker enforces only its locally-optimized models:
             # fleet-solved decisions do not exist yet — the fleet runs the
             # same bridge over them after the solve.
+            _t0 = time.perf_counter()
             self._trace_section("enforce")
-            for req in requests:
-                if (self.shard_ctx is not None
+            enforce_keys = [
+                (req.model_id, req.namespace) for req in requests
+                if not (self.shard_ctx is not None
                         and routes[(req.model_id, req.namespace)]
-                        == "global"):
-                    continue
-                s2z_cfg = self.config.scale_to_zero_config_for_namespace(
-                    req.namespace)
-                scaled_to_zero = bridge_enforce(
-                    decisions, req.model_id, req.namespace, self.enforcer,
-                    s2z_cfg, now=self.clock.now(),
-                    optimizer_name=self.optimizer.name())
-                if scaled_to_zero:
-                    log.info("Scale-to-zero enforcement applied (V2) for %s",
-                             req.model_id)
+                        == "global")]
+            if self.vec_decide:
+                # WVA_VEC_DECIDE: one grouping pass + per-model slices
+                # instead of rescanning the whole decision list per model
+                # (O(decisions) total vs O(models x decisions)).
+                # isolated_copy, not deepcopy: stages rebind scalars and
+                # append (immutable) steps — the shadow enforce pass
+                # needs no deeper isolation, and the hot-path lint
+                # forbids deepcopy here.
+                shadow_decisions = (
+                    [d.isolated_copy() for d in decisions]
+                    if self.vec_assert else None)
+                vectorized.enforce_fleet(
+                    decisions, enforce_keys, self.enforcer,
+                    self.config.scale_to_zero_config_for_namespace,
+                    now=self.clock.now,
+                    optimizer_name=self.optimizer.name(),
+                    on_scaled_to_zero=lambda mid, _ns: log.info(
+                        "Scale-to-zero enforcement applied (V2) for %s",
+                        mid))
+                if shadow_decisions is not None:
+                    saved_fr = self.enforcer.flight_recorder
+                    self.enforcer.flight_recorder = None
+                    try:
+                        for model_id, namespace in enforce_keys:
+                            bridge_enforce(
+                                shadow_decisions, model_id, namespace,
+                                self.enforcer,
+                                self.config
+                                .scale_to_zero_config_for_namespace(
+                                    namespace),
+                                now=self.clock.now(),
+                                optimizer_name=self.optimizer.name())
+                    finally:
+                        self.enforcer.flight_recorder = saved_fr
+                    vectorized.assert_equal_decisions(
+                        decisions, shadow_decisions, "enforcer")
+            else:
+                for model_id, namespace in enforce_keys:
+                    s2z_cfg = \
+                        self.config.scale_to_zero_config_for_namespace(
+                            namespace)
+                    scaled_to_zero = bridge_enforce(
+                        decisions, model_id, namespace, self.enforcer,
+                        s2z_cfg, now=self.clock.now(),
+                        optimizer_name=self.optimizer.name())
+                    if scaled_to_zero:
+                        log.info("Scale-to-zero enforcement applied (V2) "
+                                 "for %s", model_id)
+            stage_s["enforce"] += time.perf_counter() - _t0
             self._trace_section("models")
 
         self._apply_forecast(
@@ -2405,17 +2543,31 @@ class SaturationEngine:
             saved = self.enforcer.flight_recorder
             self.enforcer.flight_recorder = buf
             try:
-                for req in reqs:
-                    s2z_cfg = \
-                        self.config.scale_to_zero_config_for_namespace(
-                            req.namespace)
-                    scaled = bridge_enforce(
-                        decisions, req.model_id, req.namespace,
-                        self.enforcer, s2z_cfg, now=self.clock.now(),
-                        optimizer_name=self.optimizer.name())
-                    if scaled:
-                        log.info("Scale-to-zero enforcement applied "
-                                 "(fleet solve) for %s", req.model_id)
+                if self.vec_decide:
+                    # WVA_VEC_DECIDE: one grouping pass over the solved
+                    # decisions instead of a full rescan per model.
+                    vectorized.enforce_fleet(
+                        decisions,
+                        [(req.model_id, req.namespace) for req in reqs],
+                        self.enforcer,
+                        self.config.scale_to_zero_config_for_namespace,
+                        now=self.clock.now,
+                        optimizer_name=self.optimizer.name(),
+                        on_scaled_to_zero=lambda mid, _ns: log.info(
+                            "Scale-to-zero enforcement applied "
+                            "(fleet solve) for %s", mid))
+                else:
+                    for req in reqs:
+                        s2z_cfg = \
+                            self.config.scale_to_zero_config_for_namespace(
+                                req.namespace)
+                        scaled = bridge_enforce(
+                            decisions, req.model_id, req.namespace,
+                            self.enforcer, s2z_cfg, now=self.clock.now(),
+                            optimizer_name=self.optimizer.name())
+                        if scaled:
+                            log.info("Scale-to-zero enforcement applied "
+                                     "(fleet solve) for %s", req.model_id)
             finally:
                 self.enforcer.flight_recorder = saved
             fleet_enforce = buf.records
@@ -3116,7 +3268,7 @@ class SaturationEngine:
         byte-identical."""
         from wva_tpu import fused
 
-        result = fused.run(grids)
+        result = fused.run(grids, memo=self.solve_memo)
         if prep is not None:
             prep.fits = result.fits
             prep.chosen = result.chosen

@@ -20,8 +20,11 @@ from wva_tpu.fused.grids import (
 from wva_tpu.fused.program import (
     UNTRUSTED,
     FusedResult,
+    clear_solve_memo,
     program_cache_size,
     run,
+    solve_memo_counters,
+    solve_memo_size,
 )
 
 __all__ = [
@@ -31,7 +34,10 @@ __all__ = [
     "build_candidate_axis",
     "build_model_axis",
     "candidate_bucket",
+    "clear_solve_memo",
     "k_cols_for",
     "program_cache_size",
     "run",
+    "solve_memo_counters",
+    "solve_memo_size",
 ]

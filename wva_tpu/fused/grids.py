@@ -72,6 +72,13 @@ class FleetGrids:
     # occurrence): the fleet solve's candidate builder reuses the fused
     # sizing through this index instead of re-dispatching.
     cand_index: dict[tuple[str, str, str], int] = field(default_factory=dict)
+    # Per-row solve keys (the COMPLETE numeric input of one candidate's
+    # sizing: profile parms, request mix, batch/queue bounds, SLO
+    # targets) for the delta-sizing memo (WVA_SOLVE_MEMO; program.py).
+    # Sizing is a pure per-row function of these values — padding rows
+    # and the k_cols trim are bitwise-neutral by the batch contract — so
+    # an unchanged key means an unchanged sized rate.
+    cand_rows: list[tuple] = field(default_factory=list)
 
     # -- model axis (forecast + mask columns) --
     n_models: int = 0
@@ -101,6 +108,21 @@ class FleetGrids:
     zero_mask: object = None
 
 
+def solve_key(c) -> tuple:
+    """The complete numeric input of one candidate's sizing solve, as a
+    hashable key (exactly the values ``build_sizing_batch`` lays out for
+    the row, pre-cast). Two candidates with equal keys size to bitwise
+    the same rate/throughput — the delta-sizing memo's contract."""
+    parms = c.profile.service_parms
+    return (parms.alpha, parms.beta, parms.gamma,
+            c.request_size.avg_input_tokens,
+            c.request_size.avg_output_tokens,
+            c.profile.max_batch_size,
+            c.profile.max_batch_size + c.profile.max_queue_size,
+            c.targets.target_ttft_ms, c.targets.target_itl_ms,
+            c.targets.target_tps)
+
+
 def build_candidate_axis(grids: FleetGrids, plans: dict, batch_keys) -> None:
     """Fill the candidate axis from the sized plans, mirroring
     ``size_candidates``'s padding byte-for-byte."""
@@ -113,6 +135,7 @@ def build_candidate_axis(grids: FleetGrids, plans: dict, batch_keys) -> None:
     grids.n_candidates = n
     if not n:
         return
+    grids.cand_rows = [solve_key(c) for _, c in order]
     # THE shared builder + trim rule (analyzers/queueing): the fused
     # candidate axis is byte-for-byte the staged sizing batch.
     (grids.cand, grids.t_ttft, grids.t_itl, grids.t_tps,
@@ -142,24 +165,34 @@ def build_model_axis(grids: FleetGrids, series: list[fc.SeriesGrids],
         m *= 2
     grids.m_bucket = m
 
-    def pad(vals, fill):
-        return vals + [fill] * (m - len(series))
+    # numpy-first staging: converting the Python rows with np.asarray and
+    # shipping ONE contiguous buffer to jnp is bitwise the same cast
+    # (C double -> float32) jnp.asarray applied per element, without the
+    # 100k+-element pytree walk the list-of-lists form paid per tick.
+    n = len(series)
 
-    grids.fine = jnp.asarray(
-        pad([g.fine for g in series], [0.0] * fc.N_GRID), jnp.float32)
-    grids.fine_valid = jnp.asarray(
-        pad([g.fine_valid for g in series], 0), jnp.float32)
-    grids.long = jnp.asarray(
-        pad([g.long for g in series], [0.0] * fc.N_GRID), jnp.float32)
-    grids.long_valid = jnp.asarray(
-        pad([g.long_valid for g in series], 0), jnp.float32)
-    grids.h_fine = jnp.asarray(
-        pad([g.h_fine_steps for g in series], 0.0), jnp.float32)
-    grids.h_long = jnp.asarray(
-        pad([g.h_long_steps for g in series], 0.0), jnp.float32)
-    grids.season = jnp.asarray(
-        pad([max(1, min(g.season_steps, fc.N_GRID)) for g in series], 1),
-        jnp.int32)
+    def pad2(rows):
+        a = np.asarray(rows, dtype=np.float32)
+        if m > n:
+            a = np.concatenate(
+                [a, np.zeros((m - n, a.shape[1]), dtype=np.float32)])
+        return jnp.asarray(a)
+
+    def pad1(vals, fill, dtype=np.float32):
+        a = np.asarray(vals, dtype=dtype)
+        if m > n:
+            a = np.concatenate([a, np.full(m - n, fill, dtype=dtype)])
+        return jnp.asarray(a)
+
+    grids.fine = pad2([g.fine for g in series])
+    grids.fine_valid = pad1([g.fine_valid for g in series], 0)
+    grids.long = pad2([g.long for g in series])
+    grids.long_valid = pad1([g.long_valid for g in series], 0)
+    grids.h_fine = pad1([g.h_fine_steps for g in series], 0.0)
+    grids.h_long = pad1([g.h_long_steps for g in series], 0.0)
+    grids.season = pad1(
+        [max(1, min(g.season_steps, fc.N_GRID)) for g in series], 1,
+        dtype=np.int32)
     # The gather column: the trusted forecaster's registry index, or the
     # linear floor for untrusted models (what the planner's untrusted
     # branch reports as forecast_demand). Host-side: the gather runs

@@ -478,6 +478,12 @@ def build_manager(
     if hasattr(limiter, "algorithm") and hasattr(limiter.algorithm,
                                                  "vectorized"):
         limiter.algorithm.vectorized = config.fused_enabled()
+    # Vectorized decision stage (WVA_VEC_DECIDE, default on;
+    # docs/design/fused-plane.md §host-vectorization): finalize/optimize/
+    # enforce as fleet-wide row arithmetic instead of per-model loops.
+    engine.vec_decide = config.vec_decide_enabled()
+    engine.vec_assert = config.vec_assert_enabled()
+    engine.solve_memo = config.solve_memo_enabled()
     # Sharded active-active engine (WVA_SHARDING, default off;
     # docs/design/sharding.md): N shard workers — each the existing
     # snapshot+analysis stack scoped to a consistent-hash partition under

@@ -1,0 +1,521 @@
+"""Fleet-wide vectorized decision stage (WVA_VEC_DECIDE, default on;
+docs/design/fused-plane.md §host-vectorization).
+
+PR 13 fused the tick's device computation into ONE dispatch; what remained
+of the analyze phase was per-model host Python — finalize's supply/demand
+algebra, the cost-aware optimizer's greedy fills, and the enforcer bridge's
+full-decision-list rescans. This module re-expresses those stages as row
+arithmetic over the ``[M]`` model axis:
+
+- :func:`finalize_fleet` — the finalize algebra as numpy float64 column
+  passes with mask columns (anticipation-horizon, ramping-slope,
+  headroom, burst, zero-supply), one pass for the whole tick. The
+  candidate walk (VariantCapacity materialization + left-to-right supply
+  sums) and the trend observe stay scalar per row: summation order and
+  estimator statefulness are exactly where vector forms stop being
+  bitwise-identical, and byte-equality with the per-model path is the
+  contract (same discipline as WVA_FUSED=off).
+- :func:`cost_aware_fleet` — the CostAwareOptimizer's efficiency-ranked
+  scale-up fill and most-expensive-first scale-down become masked
+  ``[M, V]`` column passes (one iteration per variant rank, all models at
+  once); decision objects and their step dicts are then materialized FROM
+  the target arrays in one batch walk via the optimizer's own
+  ``_build_decisions`` (byte-identical strings/steps by construction).
+- :func:`enforce_fleet` — ``bridge_enforce`` semantics at O(decisions)
+  total: the per-model bridge rescans the WHOLE decision list per model
+  (O(models x decisions) — quadratic on one-model-per-decision fleets);
+  here decisions are grouped once and each model's enforcement runs over
+  its own slice, same per-model enforce_policy calls in the same order.
+
+WVA_VEC_DECIDE=off restores the per-model loops (byte-identical statuses
+AND trace cycles); WVA_VEC_ASSERT=1 runs both forms and raises on the
+first diverging bit (tests/debugging only — pays both costs).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from wva_tpu.analyzers.queueing.analyzer import (
+    BACKLOG_DRAIN_HORIZON_SECONDS,
+    SizingPlan,
+    accumulate_capacities,
+    finalize_algebra,
+)
+from wva_tpu.interfaces import (
+    ACTION_NO_CHANGE,
+    ACTION_SCALE_DOWN,
+    ACTION_SCALE_UP,
+    DEFAULT_SCALE_DOWN_BOUNDARY,
+    DEFAULT_SCALE_UP_THRESHOLD,
+    AnalyzerResult,
+    SaturationScalingConfig,
+    VariantDecision,
+    VariantSaturationAnalysis,
+)
+from wva_tpu.pipeline.enforcer import SCALE_TO_ZERO_REASON, Enforcer
+from wva_tpu.pipeline.optimizer import ModelScalingRequest
+
+log = logging.getLogger(__name__)
+
+
+def _bit_eq(a: float, b: float) -> bool:
+    """Bitwise-equality for the assert mode: NaN == NaN, else ==."""
+    return a == b or (a != a and b != b)
+
+
+# ---------------------------------------------------------------------------
+# Stage 1 — vectorized finalize
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _FinalizeRow:
+    """One model's scalars extracted for the column pass."""
+
+    key: str
+    plan: SizingPlan
+    demand: float
+    trend_demand: float
+    supply: float
+    anticipated: float
+    best_headroom: float | None
+    scale_up: float
+    scale_down: float
+    horizon: float
+    headroom_replicas: float
+    burst: float
+    slope: float = 0.0
+
+
+def _extract_row(analyzer, key: str, plan: SizingPlan,
+                 per_replica: list[float]) -> _FinalizeRow:
+    input = plan.input
+    cfg = input.config if isinstance(input.config, SaturationScalingConfig) \
+        else SaturationScalingConfig()
+    # Everything here is side-effect-free EXCEPT accumulate_capacities
+    # (appends VariantCapacity to plan.result — same partial state the
+    # scalar path leaves behind if finalize raises mid-walk).
+    demand = analyzer._demand_per_s(input)
+    trend_demand = analyzer._trend_demand_per_s(input)
+    supply, anticipated, best_headroom = accumulate_capacities(
+        plan.result, plan.candidates, per_replica, cfg.headroom_replicas)
+    return _FinalizeRow(
+        key=key, plan=plan, demand=demand, trend_demand=trend_demand,
+        supply=supply, anticipated=anticipated, best_headroom=best_headroom,
+        scale_up=cfg.scale_up_threshold or DEFAULT_SCALE_UP_THRESHOLD,
+        scale_down=cfg.scale_down_boundary or DEFAULT_SCALE_DOWN_BOUNDARY,
+        horizon=cfg.anticipation_horizon_seconds,
+        headroom_replicas=cfg.headroom_replicas,
+        burst=cfg.burst_slope_rps)
+
+
+def _algebra_columns(rows: list[_FinalizeRow]) -> tuple:
+    """:func:`finalize_algebra` over ``[M]`` float64 columns. Every
+    elementwise op (+ - * / maximum minimum where-select) is the same IEEE
+    double op the scalar path runs, applied under the scalar path's branch
+    conditions as masks; anything order-sensitive (summation) never enters
+    this function."""
+    demand = np.array([r.demand for r in rows], dtype=np.float64)
+    slope = np.array([r.slope for r in rows], dtype=np.float64)
+    supply = np.array([r.supply for r in rows], dtype=np.float64)
+    anticipated = np.array([r.anticipated for r in rows], dtype=np.float64)
+    best = np.array([0.0 if r.best_headroom is None else r.best_headroom
+                     for r in rows], dtype=np.float64)
+    has_best = np.array([r.best_headroom is not None for r in rows],
+                        dtype=bool)
+    scale_up = np.array([r.scale_up for r in rows], dtype=np.float64)
+    scale_down = np.array([r.scale_down for r in rows], dtype=np.float64)
+    horizon = np.array([r.horizon for r in rows], dtype=np.float64)
+    headroom_n = np.array([r.headroom_replicas for r in rows],
+                          dtype=np.float64)
+    burst = np.array([r.burst for r in rows], dtype=np.float64)
+
+    scaling = demand.copy()
+    m_h = horizon > 0
+    if m_h.any():
+        scaling[m_h] = demand[m_h] + np.maximum(slope[m_h], 0.0) * horizon[m_h]
+    # Deficit-aware anticipation, on the (horizon > 0, slope > 0) rows only
+    # (compressed so the masked-out rows never see the divisions).
+    m_d = m_h & (slope > 0)
+    if m_d.any():
+        d, a = demand[m_d], anticipated[m_d]
+        s, h = slope[m_d], horizon[m_d]
+        t0 = np.where(d >= a, 0.0, np.minimum((a - d) / s, h))
+        deficit = (d - a) * (h - t0) + s * (h * h - t0 * t0) / 2.0
+        upd = scaling[m_d]
+        pos = deficit > 0
+        upd[pos] = upd[pos] + deficit[pos] / BACKLOG_DRAIN_HORIZON_SECONDS
+        scaling[m_d] = upd
+    headroom = np.zeros_like(demand)
+    m_p = (headroom_n > 0) & has_best
+    headroom[m_p] = headroom_n[m_p] * best[m_p]
+    m_b = (burst > 0) & m_h
+    if m_b.any():
+        headroom[m_b] = np.maximum(headroom[m_b], burst[m_b] * horizon[m_b])
+    util = np.where(demand > 0, 1.0, 0.0)
+    m_s = supply > 0
+    util[m_s] = demand[m_s] / supply[m_s]
+    required = np.maximum(scaling / scale_up + headroom - anticipated, 0.0)
+    spare = np.zeros_like(demand)
+    spare[m_s] = np.maximum(
+        supply[m_s] - demand[m_s] / scale_down[m_s] - headroom[m_s], 0.0)
+    spare[m_d] = 0.0
+    return scaling, headroom, util, required, spare
+
+
+def finalize_fleet(
+    analyzer,
+    items: list[tuple[str, SizingPlan, list[float]]],
+    assert_mode: bool = False,
+) -> tuple[dict[str, AnalyzerResult], dict[str, Exception]]:
+    """Finalize every sized plan of the tick in one fleet pass. ``items``
+    MUST be in the engine's sorted merge order — the demand-trend observes
+    run in exactly that order, like the per-model loop. Returns
+    ``(results_by_key, errors_by_key)``; an errored model degrades alone
+    (the engine applies the same invalidate + safety-net handling as a
+    per-model finalize raise)."""
+    rows: list[_FinalizeRow] = []
+    errors: dict[str, Exception] = {}
+    for key, plan, per_replica in items:
+        try:
+            rows.append(_extract_row(analyzer, key, plan, per_replica))
+        except Exception as e:  # noqa: BLE001 — per-model isolation
+            errors[key] = e
+    # Trend observes AFTER each row's extraction succeeded and in item
+    # order: per-key estimator state evolves exactly as under the loop.
+    for r in rows:
+        input = r.plan.input
+        r.slope = analyzer._demand_trend.observe(
+            f"{input.namespace}|{input.model_id}",
+            r.plan.result.analyzed_at, r.trend_demand)
+    results: dict[str, AnalyzerResult] = {}
+    if not rows:
+        return results, errors
+    try:
+        cols = _algebra_columns(rows)
+    except Exception:  # noqa: BLE001 — the observes already ran, so the
+        # degradation is the (pure) scalar algebra per row, never a
+        # re-observe.
+        log.exception("Vectorized finalize algebra failed; scalar fallback")
+        cols = None
+    for i, r in enumerate(rows):
+        result = r.plan.result
+        scalar = None
+        if cols is None or assert_mode:
+            scalar = finalize_algebra(
+                r.demand, r.slope, r.supply, r.anticipated, r.best_headroom,
+                r.scale_up, r.scale_down, r.horizon, r.headroom_replicas,
+                r.burst)
+        if cols is None:
+            values = scalar
+        else:
+            values = tuple(float(c[i]) for c in cols)
+            if assert_mode:
+                names = ("scaling_demand", "headroom_capacity",
+                         "utilization", "required_capacity",
+                         "spare_capacity")
+                for name, vec_v, sc_v in zip(names, values, scalar):
+                    if not _bit_eq(vec_v, sc_v):
+                        raise AssertionError(
+                            f"WVA_VEC_ASSERT: finalize[{r.key}].{name} "
+                            f"diverged: vectorized {vec_v!r} != scalar "
+                            f"{sc_v!r}")
+        (result.scaling_demand, result.headroom_capacity,
+         result.utilization, result.required_capacity,
+         result.spare_capacity) = values
+        result.total_supply = r.supply
+        result.total_demand = r.demand
+        results[r.key] = result
+    return results, errors
+
+
+# ---------------------------------------------------------------------------
+# Stage 2 — vectorized cost-aware optimize
+# ---------------------------------------------------------------------------
+
+
+def cost_aware_fleet(optimizer,
+                     requests: list[ModelScalingRequest],
+                     ) -> list[VariantDecision]:
+    """``CostAwareOptimizer.optimize`` with the greedy fills flipped to
+    masked ``[M, V]`` column passes: one pass per variant RANK (sorted by
+    cost-efficiency for scale-up, cost-descending for scale-down) updates
+    every model's remaining capacity and integer targets at once. Flight
+    records and decision objects are then materialized per request in
+    request order from the target arrays — through the optimizer's own
+    ``_build_decisions``, so reasons/steps/ordering are byte-identical by
+    construction. Rows whose required/spare are non-finite fall back to
+    the scalar fills (so pathological inputs raise exactly what the loop
+    would)."""
+    live = [r for r in requests if r.result is not None]
+    if not live:
+        return []
+    M = len(live)
+    required = np.array([r.result.required_capacity for r in live],
+                        dtype=np.float64)
+    spare = np.array([r.result.spare_capacity for r in live],
+                     dtype=np.float64)
+    finite = np.isfinite(required) & np.isfinite(spare)
+    up = finite & (required > 0)
+    down = finite & ~up & (spare > 0)
+    bad = set(np.nonzero(~finite)[0].tolist())
+
+    # Per-request variant tables. The target-name universe is the state
+    # names (dict insertion order) plus any capacity name the scale-up fill
+    # first touches — exactly the keys targets.get()/targets[...] would
+    # create in the loop.
+    rows_idx = np.arange(M)
+    slot_names: list[list[str]] = []   # universe per request, slot order
+    slot_of: list[dict[str, int]] = []
+    cap_rows: list[list[float]] = []
+    cost_rows: list[list[float]] = []
+    eff_rows: list[list[float]] = []
+    cslot_rows: list[list[int]] = []
+    base_len: list[int] = []           # state-name prefix length
+    for r in live:
+        names: dict[str, int] = {}
+        base: dict[str, int] = {}
+        for s in r.variant_states:
+            if s.variant_name not in names:
+                names[s.variant_name] = len(names)
+            # Dict-comprehension semantics: later duplicates overwrite.
+            base[s.variant_name] = s.current_replicas
+        base_len.append(len(names))
+        caps, costs, effs, slots = [], [], [], []
+        for vc in r.result.variant_capacities:
+            if vc.variant_name not in names:
+                names[vc.variant_name] = len(names)
+            caps.append(vc.per_replica_capacity)
+            costs.append(vc.cost)
+            effs.append(vc.cost / vc.per_replica_capacity
+                        if vc.per_replica_capacity > 0 else np.inf)
+            slots.append(names[vc.variant_name])
+        slot_names.append(list(names))
+        slot_of.append(names)
+        cap_rows.append(caps)
+        cost_rows.append(costs)
+        eff_rows.append(effs)
+        cslot_rows.append(slots)
+    U = max(len(n) for n in slot_names)
+    V = max((len(c) for c in cap_rows), default=0)
+    tgt = np.zeros((M, max(U, 1)), dtype=np.int64)
+    present = np.zeros((M, max(U, 1)), dtype=bool)
+    for i, r in enumerate(live):
+        for s in r.variant_states:
+            j = slot_of[i][s.variant_name]
+            tgt[i, j] = s.current_replicas
+            present[i, j] = True
+    added_order: list[list[int]] = [[] for _ in range(M)]
+
+    if V and (up.any() or down.any()):
+        cap = np.zeros((M, V), dtype=np.float64)
+        cost = np.full((M, V), -np.inf)     # padding sorts LAST cost-desc
+        eff = np.full((M, V), np.inf)       # padding sorts LAST by eff
+        cslot = np.zeros((M, V), dtype=np.int64)
+        cvalid = np.zeros((M, V), dtype=bool)
+        for i in range(M):
+            n = len(cap_rows[i])
+            if n:
+                cap[i, :n] = cap_rows[i]
+                cost[i, :n] = cost_rows[i]
+                eff[i, :n] = eff_rows[i]
+                cslot[i, :n] = cslot_rows[i]
+                cvalid[i, :n] = True
+
+        if up.any():
+            # Scale-up: fill required capacity cheapest-efficiency-first
+            # (stable sort = Python sorted's tie order). Pending replicas
+            # are NOT skipped — the analyzer already counted them.
+            order = np.argsort(eff, axis=1, kind="stable")
+            rem = required.copy()
+            act_up = up.copy()
+            for i in bad:
+                act_up[i] = False
+            for j in range(V):
+                occ = order[:, j]
+                c = cap[rows_idx, occ]
+                act = act_up & (rem > 0) & (c > 0) & cvalid[rows_idx, occ]
+                if not act.any():
+                    continue
+                needed = np.ceil(rem[act] / c[act])
+                slots = cslot[rows_idx, occ]
+                hit_r, hit_s = rows_idx[act], slots[act]
+                new = ~present[hit_r, hit_s]
+                tgt[hit_r, hit_s] += needed.astype(np.int64)
+                present[hit_r, hit_s] = True
+                for r_i, s_i in zip(hit_r[new].tolist(), hit_s[new].tolist()):
+                    added_order[r_i].append(s_i)
+                rem[act] = rem[act] - needed * c[act]
+
+        if down.any():
+            # Scale-down: remove whole replicas most-expensive-first while
+            # spare covers them, cheapest protected at 1 only when it is
+            # the last variant with replicas.
+            order = np.argsort(-cost, axis=1, kind="stable")
+            cost_valid = np.where(cvalid, cost, np.inf)
+            cheap_occ = np.argmin(cost_valid, axis=1)  # FIRST minimum
+            cheap_slot = cslot[rows_idx, cheap_occ]
+            has_caps = cvalid.any(axis=1)
+            rem = spare.copy()
+            act_dn = down.copy()
+            for i in bad:
+                act_dn[i] = False
+            for j in range(V):
+                occ = order[:, j]
+                c = cap[rows_idx, occ]
+                act = act_dn & (rem > 0) & (c > 0) & cvalid[rows_idx, occ]
+                if not act.any():
+                    continue
+                slots = cslot[rows_idx, occ]
+                current = tgt[rows_idx, slots]
+                # Protect the cheapest at 1 only when no OTHER target is
+                # positive — evaluated NOW, against this column's state.
+                pos = (tgt > 0) & present
+                pos_cnt = pos.sum(axis=1)
+                cheap_pos = pos[rows_idx, cheap_slot]
+                other_has = (pos_cnt - cheap_pos.astype(np.int64)) > 0
+                min_rep = np.where(
+                    has_caps & (slots == cheap_slot) & ~other_has, 1, 0)
+                removable = current - min_rep
+                can = act & (removable > 0)
+                if not can.any():
+                    continue
+                quot = np.zeros(M, dtype=np.float64)
+                np.floor_divide(rem, c, out=quot, where=can)
+                to_remove = np.minimum(quot.astype(np.int64), removable)
+                can &= to_remove > 0
+                if not can.any():
+                    continue
+                tgt[rows_idx[can], slots[can]] = \
+                    current[can] - to_remove[can]
+                rem[can] = rem[can] - to_remove[can] * c[can]
+
+    # Materialize: flight records + decisions per request, request order.
+    flight = optimizer.flight_recorder
+    decisions: list[VariantDecision] = []
+    for i, req in enumerate(live):
+        states = {s.variant_name: s for s in req.variant_states}
+        capacities = {vc.variant_name: vc
+                      for vc in req.result.variant_capacities}
+        if i in bad:
+            # Non-finite capacity algebra: run the loop's own fills so any
+            # raise (e.g. ceil of infinity) is exactly the loop's raise.
+            targets = {s.variant_name: s.current_replicas
+                       for s in req.variant_states}
+            if req.result.required_capacity > 0:
+                optimizer._scale_up(req.result, targets)
+            elif req.result.spare_capacity > 0:
+                optimizer._scale_down(req.result, targets)
+        else:
+            names = slot_names[i]
+            targets = {}
+            for j in range(base_len[i]):
+                targets[names[j]] = int(tgt[i, j])
+            for j in added_order[i]:
+                targets[names[j]] = int(tgt[i, j])
+        if flight is not None:
+            flight.record_stage("optimizer", {
+                "name": optimizer.name(),
+                "model_id": req.model_id,
+                "namespace": req.namespace,
+                "required_capacity": req.result.required_capacity,
+                "spare_capacity": req.result.spare_capacity,
+                "targets": dict(targets),
+            })
+        decisions.extend(
+            optimizer._build_decisions(req, states, capacities, targets))
+    return decisions
+
+
+# ---------------------------------------------------------------------------
+# Stage 3 — fleet enforcer bridge
+# ---------------------------------------------------------------------------
+
+
+def enforce_fleet(
+    decisions: list[VariantDecision],
+    model_keys: list[tuple[str, str]],
+    enforcer: Enforcer,
+    s2z_config_for: Callable[[str], object],
+    now: float | Callable[[], float],
+    optimizer_name: str,
+    on_scaled_to_zero: Callable[[str, str], None] | None = None,
+) -> list[tuple[str, str]]:
+    """``bridge_enforce`` over every model in ``model_keys`` order at
+    O(decisions) total: ONE grouping pass replaces the per-model rescans
+    of the whole decision list (group order preserves list order, so the
+    per-model targets/analyses/clamp walk sees exactly the subsequence the
+    bridge's filters saw). Same enforce_policy calls, same in-place
+    mutations, same audit steps. ``on_scaled_to_zero`` fires right after a
+    model's enforcement (so caller log lines interleave exactly as the
+    loop's did); a callable ``now`` is read once per model, exactly like
+    the loop's per-request clock reads. Returns the scaled-to-zero keys."""
+    by_key: dict[tuple[str, str], list[VariantDecision]] = {}
+    for d in decisions:
+        by_key.setdefault((d.model_id, d.namespace), []).append(d)
+    scaled_keys: list[tuple[str, str]] = []
+    for model_id, namespace in model_keys:
+        now_v = now() if callable(now) else now
+        group = by_key.get((model_id, namespace), [])
+        targets = {d.variant_name: d.target_replicas for d in group}
+        analyses = [
+            VariantSaturationAnalysis(
+                variant_name=d.variant_name,
+                accelerator_name=d.accelerator_name,
+                cost=d.cost, replica_count=d.current_replicas)
+            for d in group
+        ]
+        enforced, scaled_to_zero = enforcer.enforce_policy(
+            model_id, namespace, targets, analyses,
+            s2z_config_for(namespace))
+        for d in group:
+            target = enforced.get(d.variant_name)
+            if target is not None and target != d.target_replicas:
+                d.target_replicas = target
+                if target > d.current_replicas:
+                    d.action = ACTION_SCALE_UP
+                elif target < d.current_replicas:
+                    d.action = ACTION_SCALE_DOWN
+                else:
+                    d.action = ACTION_NO_CHANGE
+                d.reason = (f"V2 {d.action} (optimizer: "
+                            f"{optimizer_name}, enforced)")
+                d.add_step("enforcer",
+                           (SCALE_TO_ZERO_REASON if scaled_to_zero
+                            else f"min-replica floor -> {target}"),
+                           was_constrained=True, now=now_v)
+            else:
+                d.add_step("enforcer", "no policy change", now=now_v)
+        if scaled_to_zero:
+            scaled_keys.append((model_id, namespace))
+            if on_scaled_to_zero is not None:
+                on_scaled_to_zero(model_id, namespace)
+    return scaled_keys
+
+
+# ---------------------------------------------------------------------------
+# WVA_VEC_ASSERT helpers
+# ---------------------------------------------------------------------------
+
+
+def assert_equal_decisions(vec: list[VariantDecision],
+                           loop: list[VariantDecision],
+                           stage: str) -> None:
+    """Raise on the first divergence between the vectorized and per-model
+    decision lists (dataclass equality covers every field including the
+    audit steps and their timestamps)."""
+    if len(vec) != len(loop):
+        raise AssertionError(
+            f"WVA_VEC_ASSERT: {stage} produced {len(vec)} decisions "
+            f"vectorized vs {len(loop)} scalar")
+    for i, (a, b) in enumerate(zip(vec, loop)):
+        if a != b:
+            raise AssertionError(
+                f"WVA_VEC_ASSERT: {stage} decision {i} "
+                f"({a.model_id}/{a.variant_name}) diverged:\n"
+                f"  vectorized: {a!r}\n  scalar:     {b!r}")

@@ -412,6 +412,12 @@ def build_shard_plane(client, config, clock, collector, actuator,
         # Each worker fuses its own partition's analyze phase into one
         # dispatch (the fleet role never sizes — workers ship results).
         engine.fused_enabled = config.fused_enabled()
+        # ... and runs the vectorized decision stage over its own
+        # partition (finalize columns + cost-aware fills + enforcer
+        # grouping are per-partition row arithmetic).
+        engine.vec_decide = config.vec_decide_enabled()
+        engine.vec_assert = config.vec_assert_enabled()
+        engine.solve_memo = config.solve_memo_enabled()
         return ShardWorker(shard_id, engine)
 
     workers = {i: make_worker(i) for i in range(shard_cfg.shards)}

@@ -8,6 +8,9 @@ from typing import Callable, TypeVar
 
 T = TypeVar("T")
 
+# Distinguishes "absent" from a memoized None on the lock-free hit path.
+_MISS = object()
+
 
 class OnceMap:
     """The first caller for a key runs ``compute`` while concurrent callers
@@ -24,6 +27,15 @@ class OnceMap:
         self._latches: dict[object, threading.Event] = {}
 
     def get_or_compute(self, key, compute: Callable[[], T]) -> T:
+        # Lock-free hit path: keys are write-once (committed under the
+        # lock, never mutated or expired within the instance's lifetime),
+        # so a bare read either sees the committed value or misses and
+        # falls through to the locked slow path. At a 1000-model tick the
+        # per-model metric serves hit this ~16k times — the lock
+        # round-trip was a measurable share of the analyze phase.
+        hit = self._results.get(key, _MISS)
+        if hit is not _MISS:
+            return hit  # type: ignore[return-value]
         while True:
             with self._mu:
                 if key in self._results:
