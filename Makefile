@@ -72,6 +72,7 @@ replay-golden: ## Replay the committed golden decision traces (must be zero diff
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/health_trace_v1.jsonl
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/boot_trace_v1.jsonl
 	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/shard_trace_v1.jsonl
+	JAX_PLATFORMS=cpu $(PYTHON) -m wva_tpu replay tests/goldens/federation_trace_v1.jsonl
 
 .PHONY: backtest-golden
 backtest-golden: ## Backtest every forecaster on the committed golden forecast trace and gate against the committed report (MAPE + under/over-provision cost; a seasonal forecaster must keep beating the linear baseline).
@@ -94,6 +95,10 @@ bench-chaos: ## Chaos soak (48 models, seeded metrics blackouts / partial respon
 .PHONY: bench-failover
 bench-failover: ## Crash-restart + leader-flap storm (48 models, two managers over one world, seeded kills/flaps, checkpoint on AND off): asserts zero wrong-direction scale events in every restart/handover window, zero dual-actuation (one writer per lease epoch), and <=5-tick post-restart reconvergence; merges detail.failover into BENCH_LOCAL.json. FAILOVER_SMOKE=1 runs the short CI shape.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --failover-only $(if $(FAILOVER_SMOKE),--smoke)
+
+.PHONY: bench-federation
+bench-federation: ## Federated-fleet storm (3 emulated regions in lockstep, follow-the-sun load, seeded regional spot-preemption storm + one full-region metrics blackout) vs the same seeded world fault-free: asserts zero global SLO-attainment loss, zero wrong-direction scale events in the blacked-out region, and spill directives draining <=5 arbiter ticks after re-admission; merges detail.federation into BENCH_LOCAL.json. FEDERATION_SMOKE=1 runs the short CI shape (2 models/region, 600s).
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --federation-only $(if $(FEDERATION_SMOKE),--smoke)
 
 .PHONY: bench-shard
 bench-shard: ## Sharded active-active engine bench (480-model world, 4 consistent-hash shards over one FakeCluster): asserts fleet decisions byte-identical to the unsharded engine, per-shard quiet-tick p50 < 30ms, and a seeded shard crash rebalancing with zero wrong-direction scale events + <=5-tick reconvergence; plus the 480/2000-model single-vs-sharded sweep; merges detail.shard_plane into BENCH_LOCAL.json. SHARD_SMOKE=1 runs the short two-shard CI shape.

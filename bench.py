@@ -2727,6 +2727,234 @@ def failover_main() -> None:
     }))
 
 
+def federation_storm_bench(models_per_region: int = 4,
+                           duration: float = 900.0,
+                           engine_interval: float = 15.0,
+                           seed: int = 29,
+                           faults: bool = True) -> dict:
+    """Federated-fleet storm (``make bench-federation``): THREE emulated
+    regions in lockstep (docs/design/federation.md) under follow-the-sun
+    diurnal load, with a seeded regional spot-preemption storm in
+    ``eu-west4`` and one FULL-REGION metrics blackout in ``us-east1``
+    (every model's inputs go dark; the input-health plane freezes the
+    region). The same seeded world runs fault-free for the baseline.
+
+    Asserts the federation acceptance criteria on the faulted run:
+
+    - zero global SLO-attainment loss vs the no-fault run (physics keep
+      serving through a metrics blackout; the frozen region holds its
+      footprint while the arbiter raises spill standby elsewhere);
+    - zero wrong-direction scale events in the blacked-out region: no
+      variant whose window-start desired was healthy (>= 1) ever has it
+      lowered inside the blackout window;
+    - spill actually happened (directives from the dark region landed in
+      a healthy region) and reconverged: once the dark region's capture
+      classifies healthy again, directives drain within 5 arbiter ticks
+      (re-admission hysteresis is 3).
+    """
+    from wva_tpu.config import HealthConfig, new_test_config
+    from wva_tpu.constants import WVA_DESIRED_REPLICAS
+    from wva_tpu.emulator import (
+        FakeGkeProvisioner,
+        FaultPlan,
+        FaultWindow,
+        FederatedHarness,
+        HPAParams,
+        RegionSpec,
+        ServingParams,
+        TierPolicy,
+        VariantSpec,
+        add_tpu_nodepool,
+        diurnal,
+        preemption_storm,
+        regional,
+    )
+    from wva_tpu.emulator.faults import KIND_METRICS_BLACKOUT
+
+    regions = ("us-east1", "eu-west4", "asia-ne1")
+    dark = "us-east1"
+    stormy = "eu-west4"
+    blackout = FaultWindow(kind=KIND_METRICS_BLACKOUT,
+                          start=duration * 0.3, end=duration * 0.6)
+    _, preemptions = preemption_storm(
+        base_rate=2.0, burst_rate=10.0, burst_duration=90.0,
+        mean_gap=200.0, horizon=duration, seed=seed,
+        preemptions_per_burst=2, preemption_lag=20.0)
+
+    def cfg():
+        # Tightened health thresholds so the blackout freezes the region
+        # well inside the window (the golden-trace discipline).
+        c = new_test_config()
+        c.set_health(HealthConfig(degraded_after_seconds=30.0,
+                                  freeze_after_seconds=60.0,
+                                  recovery_ticks=2))
+        return c
+
+    def specs(region_index: int) -> list:
+        base = diurnal(base_rate=2.0, amplitude=8.0, period=600.0)
+        load = regional(base, region_index, len(regions), period=600.0)
+        return [VariantSpec(
+            name=f"m{i:03d}-v5e", model_id=f"bench/fed-model-{i:03d}",
+            accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+            initial_replicas=2, serving=ServingParams(engine="jetstream"),
+            load=load,
+            hpa=HPAParams(stabilization_up_seconds=10.0,
+                          stabilization_down_seconds=60.0,
+                          sync_period_seconds=10.0))
+            for i in range(models_per_region)]
+
+    def spot_provisioner(cluster, clock):
+        return FakeGkeProvisioner(
+            cluster, clock,
+            tiers={"on_demand": TierPolicy(provision_delay_seconds=120.0),
+                   "spot": TierPolicy(provision_delay_seconds=60.0,
+                                      preemptible=True)},
+            seed=seed)
+
+    fh = FederatedHarness(
+        [RegionSpec(
+            name=name, variants=specs(i), config=cfg(),
+            saturation_config=None,
+            fault_plan=(FaultPlan([blackout], seed=seed)
+                        if faults and name == dark else None),
+            nodepools=[("v5e-pool", "v5e", "2x4", models_per_region * 3)],
+            provisioner=spot_provisioner if name == stormy else None)
+         for i, name in enumerate(regions)],
+        namespace="inference", engine_interval=engine_interval,
+        startup_seconds=30.0, stochastic_seed=20260807)
+    from wva_tpu.capacity.tiers import GKE_SPOT_NODE_LABEL
+
+    add_tpu_nodepool(fh.cluster(stormy).cluster, "spot-pool", "v5e", "2x4",
+                     models_per_region,
+                     extra_labels={GKE_SPOT_NODE_LABEL: "true"})
+    if faults:
+        fh.cluster(stormy).provisioner.schedule_preemptions(
+            [(fh.start_time + t, k) for t, k in preemptions])
+
+    names = [f"m{i:03d}-v5e" for i in range(models_per_region)]
+
+    def region_desired(name: str) -> dict[str, int]:
+        registry = fh.cluster(name).manager.registry
+        return {n: int(registry.get(WVA_DESIRED_REPLICAS, {
+            "variant_name": n, "namespace": "inference",
+            "accelerator_type": "v5e-8"}) or 0) for n in names}
+
+    wrong_direction = 0
+    spill_events = 0
+    spill_targets: set[str] = set()
+    dark_base: dict[str, int] = {}
+    plan_track = {"last_tick": 0, "healthy_tick": None,
+                  "last_spill_tick": None, "window_seen": False}
+
+    def on_step(h, t):
+        nonlocal wrong_direction, spill_events
+        if faults and blackout.start <= t < blackout.end:
+            desired = region_desired(dark)
+            if not dark_base:
+                dark_base.update(desired)
+            plan_track["window_seen"] = True
+            for n in names:
+                if dark_base.get(n, 0) >= 1 and desired[n] < dark_base[n]:
+                    wrong_direction += 1
+        plan = h.last_plan()
+        if not plan or plan["tick"] == plan_track["last_tick"]:
+            return
+        plan_track["last_tick"] = plan["tick"]
+        spills = [d for ds in plan.get("directives", {}).values()
+                  for d in ds if dark in d.get("source_region", "")]
+        if spills:
+            spill_events += len(spills)
+            spill_targets.update(d["target_region"] for d in spills)
+            plan_track["last_spill_tick"] = plan["tick"]
+        dark_state = plan.get("region_states", {}).get(dark, {})
+        if (plan_track["window_seen"] and t >= blackout.end
+                and plan_track["healthy_tick"] is None
+                and dark_state.get("state") == "healthy"):
+            plan_track["healthy_tick"] = plan["tick"]
+
+    fh.run(duration, on_step=on_step)
+    attainment = {}
+    for name in regions:
+        harness = fh.cluster(name)
+        sims = list(harness.sims.values())
+        attainment[name] = round(min(
+            sim.slo_attainment(SLO_TTFT_SECONDS, since=harness.start_time)
+            for sim in sims), 4)
+        harness.manager.shutdown()
+    _drain_decision_bus()
+    global_attainment = round(min(attainment.values()), 4)
+
+    record = {
+        "regions": list(regions),
+        "models_per_region": models_per_region,
+        "duration_s": duration,
+        "engine_interval_s": engine_interval,
+        "blackout_window": [blackout.start, blackout.end],
+        "preemption_events": len(preemptions),
+        "slo_attainment_per_region": attainment,
+        "slo_attainment_global": global_attainment,
+        "wrong_direction_events_dark_region": wrong_direction,
+        "spill_directive_events": spill_events,
+        "spill_targets": sorted(spill_targets),
+        "arbiter_region": fh.arbiter_region(),
+    }
+    if faults:
+        assert wrong_direction == 0, (
+            f"{wrong_direction} wrong-direction scale events in the "
+            "blacked-out region")
+        assert spill_events > 0, "blackout produced no spill directives"
+        assert plan_track["healthy_tick"] is not None, (
+            "dark region never classified healthy after the window")
+        reconverge = max((plan_track["last_spill_tick"] or 0)
+                        - plan_track["healthy_tick"] + 1, 0)
+        record["spill_reconverge_arbiter_ticks"] = reconverge
+        assert reconverge <= 5, (
+            f"spill directives drained {reconverge} arbiter ticks after "
+            "re-admission (> 5)")
+    return record
+
+
+def federation_main() -> None:
+    """`make bench-federation` / `bench.py --federation-only`: 3-region
+    federated storm (regional preemptions + full-region blackout) vs the
+    same seeded world fault-free, merged into BENCH_LOCAL.json
+    detail.federation, one JSON line. Raises when any federation
+    acceptance criterion fails (zero global SLO-attainment loss, zero
+    wrong-direction scale events in the dark region, spill + <=5-tick
+    reconvergence). `--smoke` runs the short CI shape (2 models/region,
+    600s)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    smoke = "--smoke" in sys.argv
+    models = 2 if smoke else 4
+    duration = 600.0 if smoke else 900.0
+    t0 = time.time()
+    faulted = federation_storm_bench(models_per_region=models,
+                                     duration=duration, faults=True)
+    baseline = federation_storm_bench(models_per_region=models,
+                                      duration=duration, faults=False)
+    loss = round(baseline["slo_attainment_global"]
+                 - faulted["slo_attainment_global"], 4)
+    assert loss <= 0.0, (
+        f"global SLO attainment lost {loss} vs the no-fault run "
+        f"({faulted['slo_attainment_global']} faulted vs "
+        f"{baseline['slo_attainment_global']} clean)")
+    record = {
+        "faulted": faulted,
+        "no_fault_baseline": baseline,
+        "slo_attainment_loss": loss,
+        "bench_wall_seconds": round(time.time() - t0, 1),
+    }
+    if not smoke:
+        _merge_bench_local("federation", record)
+    print(json.dumps({
+        "metric": "federation_slo_attainment_loss_3_regions",
+        "value": loss,
+        "unit": "global_slo_attainment_delta_vs_no_fault",
+        "vs_baseline": faulted["spill_directive_events"],
+        "detail": record,
+    }))
+
+
 def main() -> None:
     t0 = time.time()
     device_probe = _ensure_healthy_device()
@@ -3249,6 +3477,8 @@ if __name__ == "__main__":
         chaos_main()
     elif "--failover-only" in sys.argv:
         failover_main()
+    elif "--federation-only" in sys.argv:
+        federation_main()
     elif "--shard-only" in sys.argv:
         shard_main()
     elif "--spans-only" in sys.argv:

@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""CI check: ``wva explain`` against the committed golden federation
+trace (``tests/goldens/federation_trace_v1.jsonl``,
+docs/design/federation.md).
+
+Finds the cycles where the federation spill floor set the final desired
+number in the spill TARGET region's trace and asserts, for each:
+
+1. ``set_by`` names ``federation`` — the raise-only directive appended
+   its decision step through the shared ``federation.apply`` path;
+2. the attached ``federation_spill`` provenance carries the source ->
+   target region pair the arbiter recorded (``us-east1`` ->
+   ``asia-ne1`` in the golden scenario);
+3. the human-readable rendering prints the "federation spill in play"
+   line with that pair.
+
+Run from the repo root (CPU platform, like the test suite):
+
+    JAX_PLATFORMS=cpu python tests/goldens/check_explain_federation.py
+"""
+
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TRACE = os.path.join(HERE, "federation_trace_v1.jsonl")
+MODEL = "golden/fed-model-0"
+NS = "inference"
+SOURCE = "us-east1"
+TARGET = "asia-ne1"
+
+
+def main() -> int:
+    from wva_tpu.obs.explain import explain_cli
+
+    with open(TRACE, encoding="utf-8") as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    setters = [rec["cycle"] for rec in records
+               for d in rec.get("decisions", [])
+               if d.get("model_id") == MODEL and d.get("decision_steps")
+               and d["decision_steps"][-1]["name"] == "federation"]
+    assert setters, "golden has no federation-set cycle"
+
+    for cycle in setters:
+        buf = io.StringIO()
+        rc = explain_cli([MODEL, "--trace", TRACE, "--namespace", NS,
+                          "--cycle", str(cycle), "--json"], out=buf)
+        assert rc == 0, f"explain failed for cycle {cycle}"
+        report = json.loads(buf.getvalue())
+        (variant,) = report["variants"]
+        assert variant["set_by"] == "federation", (cycle, variant["set_by"])
+        spill = variant["federation_spill"]
+        assert spill["source_region"] == SOURCE, spill
+        assert spill["target_region"] == TARGET, spill
+        assert spill["spill_replicas"] > 0, spill
+
+        text = io.StringIO()
+        rc = explain_cli([MODEL, "--trace", TRACE, "--namespace", NS,
+                          "--cycle", str(cycle)], out=text)
+        assert rc == 0
+        rendered = text.getvalue()
+        assert f"federation spill in play: {SOURCE} -> {TARGET}" in rendered
+        assert "final desired set by: federation" in rendered
+
+    print(f"explain OK: {len(setters)} federation-set cycles "
+          f"({SOURCE} -> {TARGET}) verified in {os.path.basename(TRACE)}")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Regenerate the committed golden federation trace
+(``tests/goldens/federation_trace_v1.jsonl``).
+
+Run from the repo root (CPU platform, like the test suite):
+
+    JAX_PLATFORMS=cpu python tests/goldens/make_federation_trace.py
+
+The scenario is a 3-region federated fleet (docs/design/federation.md)
+under follow-the-sun diurnal load: ``us-east1`` takes a seeded metrics
+blackout mid-run, its input-health plane goes dark, and the capacity
+arbiter sheds a bounded standby of its frozen footprint to the
+healthiest candidate region — which, with symmetric capacity, the
+ranking resolves by region name to ``asia-ne1``. The committed trace is
+the TARGET region's: it carries ``STAGE_FEDERATION`` events whose spill
+directives must re-apply through the shared ``federation.apply`` path to
+ZERO decision diffs (tests/test_federation.py, ``make replay-golden``),
+and cycles where ``federation`` is the final setter for the ``wva
+explain`` CI check (tests/goldens/check_explain_federation.py).
+
+Regenerate only on a deliberate, reviewed change to the federation
+semantics or the trace schema — and say so in the commit message.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TRACE = os.path.join(HERE, "federation_trace_v1.jsonl")
+TARGET_REGION = "asia-ne1"
+DARK_REGION = "us-east1"
+REGIONS = (DARK_REGION, TARGET_REGION, "eu-west4")
+SEED = 20260807
+DURATION = 480.0
+
+
+def main() -> None:
+    import shutil
+    import tempfile
+
+    from wva_tpu.config import HealthConfig, new_test_config
+    from wva_tpu.emulator import (
+        FaultPlan,
+        FaultWindow,
+        FederatedHarness,
+        HPAParams,
+        RegionSpec,
+        ServingParams,
+        VariantSpec,
+        diurnal,
+        regional,
+    )
+    from wva_tpu.emulator.faults import KIND_METRICS_BLACKOUT
+
+    if os.path.exists(TRACE):
+        os.remove(TRACE)  # the recorder appends; regeneration replaces
+
+    # Each region sees the same diurnal curve phase-shifted by a third of
+    # the period (the follow-the-sun wrapper): one region peaks while
+    # another troughs. The blackout lands on us-east1 at 120..420 — with
+    # the tightened health thresholds below its models freeze around
+    # t=180 and the arbiter sheds standby to the target region until the
+    # window ends plus the re-admission hysteresis.
+    def cfg():
+        c = new_test_config()
+        c.set_health(HealthConfig(degraded_after_seconds=30.0,
+                                  freeze_after_seconds=60.0,
+                                  recovery_ticks=2))
+        return c
+
+    def specs(i):
+        base = diurnal(base_rate=2.0, amplitude=18.0, period=600.0)
+        return [VariantSpec(
+            name="m0-v5e", model_id="golden/fed-model-0",
+            accelerator="v5e-8", chips_per_replica=8, cost=10.0,
+            initial_replicas=1, serving=ServingParams(engine="jetstream"),
+            load=regional(base, i, len(REGIONS), period=600.0),
+            hpa=HPAParams(stabilization_up_seconds=10.0,
+                          stabilization_down_seconds=30.0,
+                          sync_period_seconds=5.0))]
+
+    plan = FaultPlan([FaultWindow(kind=KIND_METRICS_BLACKOUT,
+                                  start=120.0, end=420.0)], seed=SEED)
+    tmp = tempfile.mkdtemp(prefix="fed-golden-")
+    try:
+        fh = FederatedHarness(
+            [RegionSpec(name=name, variants=specs(i), config=cfg(),
+                        fault_plan=plan if name == DARK_REGION else None,
+                        nodepools=[("v5e-pool", "v5e", "2x4", 8)])
+             for i, name in enumerate(REGIONS)],
+            namespace="inference", engine_interval=15.0,
+            startup_seconds=30.0, stochastic_seed=SEED, trace_dir=tmp)
+        fh.run(DURATION)
+        for harness in fh.clusters.values():
+            harness.manager.shutdown()
+        shutil.copyfile(os.path.join(tmp, f"{TARGET_REGION}.jsonl"), TRACE)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # Sanity: the trace must carry federation stages WITH spill
+    # directives, cycles where federation set the final number, and
+    # replay to zero diffs, before it is worth committing.
+    import json
+
+    from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+    records = load_trace(TRACE)
+    fed_events = [ev for rec in records for ev in rec.get("stages", [])
+                  if ev.get("stage") == "federation"]
+    spills = [d for ev in fed_events for d in ev.get("directives", [])]
+    assert fed_events, "no federation stage events recorded"
+    assert spills, "no spill directives — nothing worth goldening"
+    assert all(d["source_region"] == DARK_REGION
+               and d["target_region"] == TARGET_REGION for d in spills)
+    setters = [rec["cycle"] for rec in records
+               for d in rec.get("decisions", [])
+               if d.get("decision_steps")
+               and d["decision_steps"][-1]["name"] == "federation"]
+    assert setters, "no cycle where federation set the final number"
+    report = ReplayEngine(records).replay()
+    assert report.ok, json.dumps(report.to_dict(), indent=1)
+    print(f"wrote {TRACE}: {len(records)} cycles, "
+          f"{len(fed_events)} federation events, {len(spills)} spill "
+          f"directives, federation-set cycles={setters}, replay OK")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()

@@ -1,0 +1,643 @@
+"""Multi-cluster capacity federation (docs/design/federation.md).
+
+Covers the federation tentpole end to end: the ClusterCapture codec and
+both bus transports, the deterministic capacity arbiter (order-invariant
+merges, per-region tier-weight arbitrage, blackout shed + re-admission
+hysteresis), the raise-only directive apply path, the
+``WVA_FEDERATION=off`` byte-identity discipline (statuses AND trace
+cycles, the ``WVA_HEALTH=off`` standard), the ``STAGE_FEDERATION`` trace
+stage replaying through the shared ``federation.apply`` path, the
+federated emulation harness (seeded blackout -> spill -> recovery), the
+``wva explain`` federation provenance, and the gauge sweep.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+
+import pytest
+
+from wva_tpu.blackbox.schema import STAGE_FEDERATION, encode
+from wva_tpu.capacity.tiers import (
+    DEFAULT_TIER_COST_WEIGHTS,
+    TIER_ON_DEMAND,
+    TIER_RESERVATION,
+    TIER_SPOT,
+    parse_region_tier_weights,
+)
+from wva_tpu.config import FederationConfig, HealthConfig, new_test_config
+from wva_tpu.constants import (
+    LABEL_MODEL_NAME,
+    LABEL_NAMESPACE,
+    LABEL_REGION,
+    LABEL_SOURCE,
+    LABEL_STATE,
+    WVA_FEDERATION_CAPTURE_AGE_SECONDS,
+    WVA_FEDERATION_REGION_STATE,
+    WVA_FEDERATION_SPILL_REPLICAS,
+)
+from wva_tpu.emulator import (
+    FaultPlan,
+    FaultWindow,
+    FederatedHarness,
+    HPAParams,
+    RegionSpec,
+    ServingParams,
+    VariantSpec,
+    trapezoid,
+)
+from wva_tpu.emulator.faults import KIND_METRICS_BLACKOUT
+from wva_tpu.emulator.harness import EmulationHarness
+from wva_tpu.federation import (
+    CapacityArbiter,
+    ClusterCapture,
+    ConfigMapCaptureBus,
+    FederationPlane,
+    InProcessCaptureBus,
+    ModelDemand,
+    RegionModelHealth,
+    VariantCapacity,
+    apply_federation_directives,
+    capture_to_payload,
+    classify_capture,
+    demand_key,
+    payload_to_capture,
+)
+from wva_tpu.federation.arbiter import (
+    REGION_BLACKOUT,
+    REGION_DEGRADED,
+    REGION_HEALTHY,
+)
+from wva_tpu.interfaces import (
+    ACTION_NO_CHANGE,
+    ACTION_SCALE_UP,
+    SaturationScalingConfig,
+    VariantDecision,
+)
+from wva_tpu.k8s import FakeCluster
+from wva_tpu.metrics import MetricsRegistry
+from wva_tpu.utils import FakeClock
+
+NS = "inference"
+SEED = 20260807
+
+
+def _dumps(x):
+    return json.dumps(x, sort_keys=True)
+
+
+# --- capture fixtures -----------------------------------------------------
+
+
+def _capture(region: str, *, now: float = 100.0, target: int = 2,
+             current: int = 2, health_state: str = "fresh",
+             reservation: int = 0, lead: float = 120.0,
+             stocked_out: tuple[str, ...] = (), provisioning: int = 0,
+             tier_weights: dict[str, float] | None = None,
+             model: str = "fed/model-0", variant: str = "m0-v5e",
+             accelerator: str = "v5e-8") -> ClusterCapture:
+    key = demand_key(NS, variant)
+    return ClusterCapture(
+        region=region, epoch=7, tick_seq=1, published_at=now,
+        demand={key: ModelDemand(
+            variant_name=variant, namespace=NS, model_id=model,
+            accelerator_name=accelerator, current_replicas=current,
+            target_replicas=target, chips_per_replica=8)},
+        health={f"{model}|{NS}": RegionModelHealth(
+            state=health_state, age_seconds=1.0,
+            allow_scale_down=health_state == "fresh",
+            reason=f"{health_state} input")},
+        capacity={accelerator: VariantCapacity(
+            variant=accelerator, chips_per_slice=8, ready=current,
+            provisioning=provisioning, preempted=0,
+            tier_slices={TIER_RESERVATION: reservation},
+            stocked_out_tiers=list(stocked_out), lead_seconds=lead)},
+        tier_weights=dict(tier_weights or DEFAULT_TIER_COST_WEIGHTS))
+
+
+ALL_TIERS = (TIER_RESERVATION, TIER_ON_DEMAND, TIER_SPOT)
+
+
+# --- codec + transports ---------------------------------------------------
+
+
+def test_capture_codec_roundtrip():
+    cap = _capture("us-east1", reservation=3, stocked_out=(TIER_SPOT,),
+                   provisioning=1, tier_weights={TIER_SPOT: 0.22})
+    back = payload_to_capture(capture_to_payload(cap))
+    assert back == cap
+    # Canonical payloads are byte-stable regardless of dict build order.
+    assert (_dumps(capture_to_payload(cap))
+            == _dumps(capture_to_payload(back)))
+
+
+def test_configmap_bus_roundtrip_and_corruption():
+    clock = FakeClock(start=500.0)
+    hub = FakeCluster(clock=clock)
+    bus = ConfigMapCaptureBus(hub, namespace="wva-system",
+                              regions=("eu-west4", "us-east1"))
+    a = _capture("us-east1", now=500.0)
+    b = _capture("eu-west4", now=500.0, reservation=2)
+    bus.publish(a)
+    bus.publish(b)
+    got = bus.read_all()
+    assert got == {"us-east1": a, "eu-west4": b}
+    plan = {"schema": 1, "tick": 3, "directives": {}}
+    bus.publish_plan(plan)
+    assert bus.read_plan() == plan
+    # A corrupt payload reads as absent (ages into BLACKOUT), never raises.
+    from wva_tpu.k8s.objects import clone
+
+    cm = clone(hub.get("ConfigMap", "wva-system",
+                       "wva-federation-capture-us-east1"))
+    cm.data = {"capture": "{not json"}
+    hub.update(cm)
+    assert set(bus.read_all()) == {"eu-west4"}
+
+
+# --- per-region tier weights (the satellite bugfix) -----------------------
+
+
+def test_parse_region_tier_weights():
+    parsed = parse_region_tier_weights(
+        "us-east1=spot:0.2,reservation:0.5|eu-west4=spot:0.45")
+    assert parsed["us-east1"][TIER_SPOT] == 0.2
+    assert parsed["us-east1"][TIER_RESERVATION] == 0.5
+    # Unspecified tiers inherit the process defaults.
+    assert (parsed["us-east1"][TIER_ON_DEMAND]
+            == DEFAULT_TIER_COST_WEIGHTS[TIER_ON_DEMAND])
+    assert parsed["eu-west4"][TIER_SPOT] == 0.45
+    assert parse_region_tier_weights("") == {}
+    for bad in ("us-east1", "=spot:0.2", "us-east1=spot",
+                "us-east1=warp:0.2"):
+        with pytest.raises(ValueError):
+            parse_region_tier_weights(bad)
+
+
+def test_region_spot_discount_does_not_leak_across_regions():
+    """The regression the bugfix exists for: one region's spot discount
+    must price ONLY that region's candidacy. Two otherwise-identical
+    candidate regions; only the discounted one gets cheaper."""
+    arb = CapacityArbiter(region_tier_weights={
+        "eu-west4": {**DEFAULT_TIER_COST_WEIGHTS, TIER_SPOT: 0.05}})
+    caps = {
+        "us-east1": _capture("us-east1", target=6, current=2,
+                             stocked_out=ALL_TIERS),
+        "eu-west4": _capture("eu-west4"),
+        "asia-ne1": _capture("asia-ne1"),
+    }
+    assert arb._weights_for("eu-west4", caps["eu-west4"])[TIER_SPOT] == 0.05
+    # The un-overridden region keeps its own (default) pricing.
+    assert (arb._weights_for("asia-ne1", caps["asia-ne1"])[TIER_SPOT]
+            == DEFAULT_TIER_COST_WEIGHTS[TIER_SPOT])
+    plan = arb.tick(caps, now=100.0)
+    (directive,) = plan["directives"]["eu-west4"]
+    assert directive["source_region"] == "us-east1"
+    assert directive["target_region"] == "eu-west4"
+    # Flip the override to the other region: the ranking flips with it.
+    arb2 = CapacityArbiter(region_tier_weights={
+        "asia-ne1": {**DEFAULT_TIER_COST_WEIGHTS, TIER_SPOT: 0.05}})
+    plan2 = arb2.tick(caps, now=100.0)
+    assert list(plan2["directives"]) == ["asia-ne1"]
+
+
+def test_federation_config_region_weights_load_from_env():
+    from wva_tpu.config.loader import load
+
+    cfg = load(env={
+        "PROMETHEUS_BASE_URL": "http://prom.test:9090",
+        "WVA_FEDERATION_REGION": "us-east1",
+        "WVA_FEDERATION_REGIONS": "us-east1,eu-west4",
+        "WVA_FEDERATION_REGION_TIER_WEIGHTS": "us-east1=spot:0.2",
+    })
+    fed = cfg.federation_config()
+    assert fed.enabled and fed.region == "us-east1"
+    assert fed.regions == ("us-east1", "eu-west4")
+    assert fed.region_tier_weights["us-east1"][TIER_SPOT] == 0.2
+
+
+# --- classification + hysteresis ------------------------------------------
+
+
+def test_classify_capture_ladder():
+    fresh = _capture("r")
+    assert classify_capture(fresh, age=0.0, stale_seconds=90.0) \
+        == REGION_HEALTHY
+    assert classify_capture(None, age=0.0, stale_seconds=90.0) \
+        == REGION_BLACKOUT
+    assert classify_capture(fresh, age=91.0, stale_seconds=90.0) \
+        == REGION_BLACKOUT
+    degraded = _capture("r", health_state="degraded")
+    assert classify_capture(degraded, age=0.0, stale_seconds=90.0) \
+        == REGION_DEGRADED
+    dark = _capture("r", health_state="blackout")
+    assert classify_capture(dark, age=0.0, stale_seconds=90.0) \
+        == REGION_BLACKOUT
+
+
+def test_blackout_shed_and_readmit_hysteresis():
+    arb = CapacityArbiter(readmit_ticks=2, spill_max_replicas=4)
+    dark = {
+        "us-east1": _capture("us-east1", target=3, current=3,
+                             health_state="blackout"),
+        "eu-west4": _capture("eu-west4", reservation=2),
+    }
+    plan = arb.tick(dark, now=10.0)
+    assert plan["region_states"]["us-east1"]["state"] == REGION_BLACKOUT
+    assert plan["region_states"]["us-east1"]["shedding"] is True
+    (d,) = plan["directives"]["eu-west4"]
+    assert d["spill_replicas"] == 3
+    assert "input-health blackout" in d["reason"]
+    # The shed is a bounded standby of the frozen footprint.
+    assert d["floor_replicas"] == dark["eu-west4"].demand[
+        demand_key(NS, "m0-v5e")].target_replicas + 3
+
+    healthy = {
+        "us-east1": _capture("us-east1", now=20.0, target=3, current=3),
+        "eu-west4": _capture("eu-west4", now=20.0, reservation=2),
+    }
+    # First healthy tick: still shedding (hysteresis), reason flips.
+    plan = arb.tick(healthy, now=20.0)
+    st = plan["region_states"]["us-east1"]
+    assert st["state"] == REGION_HEALTHY and st["shedding"] is True
+    assert st["readmit_in"] == 1
+    (d,) = plan["directives"]["eu-west4"]
+    assert "re-admission hysteresis" in d["reason"]
+    # A degraded wobble resets the re-admission window.
+    wobble = dict(healthy)
+    wobble["us-east1"] = _capture("us-east1", now=30.0, target=3, current=3,
+                                  health_state="degraded")
+    plan = arb.tick(wobble, now=30.0)
+    assert plan["region_states"]["us-east1"]["readmit_in"] == 2
+    # Two consecutive healthy ticks re-admit; directives stop.
+    arb.tick(healthy, now=40.0)
+    plan = arb.tick(healthy, now=50.0)
+    st = plan["region_states"]["us-east1"]
+    assert st["shedding"] is False and st["readmit_in"] == 0
+    assert plan["directives"] == {}
+
+
+def test_blackout_shed_lever_off_freezes_instead():
+    arb = CapacityArbiter(blackout_shed=False)
+    caps = {
+        "us-east1": _capture("us-east1", health_state="blackout"),
+        "eu-west4": _capture("eu-west4"),
+    }
+    plan = arb.tick(caps, now=10.0)
+    assert plan["region_states"]["us-east1"]["state"] == REGION_BLACKOUT
+    assert plan["directives"] == {}
+
+
+def test_stockout_spill_sizes_unserved_growth():
+    """Stockout spill = target - current - provisioning-in-flight, gated
+    on the WHOLE tier-preference walk being stockout-pinned."""
+    arb = CapacityArbiter(spill_max_replicas=10)
+    caps = {
+        "us-east1": _capture("us-east1", target=7, current=2,
+                             provisioning=2, stocked_out=ALL_TIERS),
+        "eu-west4": _capture("eu-west4", reservation=1),
+    }
+    plan = arb.tick(caps, now=10.0)
+    (d,) = plan["directives"]["eu-west4"]
+    # 7 wanted - 2 running - 2 provisioning slices (8 chips / 8 per
+    # replica = 2 replicas in flight) = 3 unserved.
+    assert d["spill_replicas"] == 3
+    assert "tier stockout" in d["reason"]
+    # One open tier anywhere in the walk -> the home region can still
+    # place growth; no spill.
+    partial = {
+        "us-east1": _capture("us-east1", target=7, current=2,
+                             stocked_out=(TIER_RESERVATION, TIER_SPOT)),
+        "eu-west4": _capture("eu-west4", reservation=1),
+    }
+    assert CapacityArbiter().tick(partial, now=10.0)["directives"] == {}
+
+
+def test_target_ranking_prefers_reservation_then_lead():
+    arb = CapacityArbiter()
+    caps = {
+        "src": _capture("src", target=6, current=2, stocked_out=ALL_TIERS),
+        "a-slow-reserved": _capture("a-slow-reserved", reservation=4,
+                                    lead=900.0),
+        "b-fast-unreserved": _capture("b-fast-unreserved", lead=30.0),
+    }
+    plan = arb.tick(caps, now=10.0)
+    # Ready reservation slices trump a shorter measured lead.
+    assert list(plan["directives"]) == ["a-slow-reserved"]
+    caps["a-slow-reserved"].capacity["v5e-8"].tier_slices.clear()
+    plan = arb.tick(caps, now=20.0)
+    assert list(plan["directives"]) == ["b-fast-unreserved"]
+
+
+# --- determinism properties -----------------------------------------------
+
+
+def _random_capture(rng: random.Random, region: str,
+                    now: float) -> ClusterCapture:
+    health = rng.choice(["fresh", "fresh", "degraded", "blackout"])
+    return _capture(
+        region, now=now - rng.choice([0.0, 5.0, 120.0]),
+        target=rng.randrange(0, 9), current=rng.randrange(0, 5),
+        health_state=health, reservation=rng.randrange(0, 4),
+        lead=rng.choice([30.0, 120.0, 900.0]),
+        stocked_out=rng.choice([(), ALL_TIERS,
+                                (TIER_RESERVATION, TIER_ON_DEMAND)]),
+        provisioning=rng.randrange(0, 3))
+
+
+@pytest.mark.parametrize("n_regions", [1, 2, 3])
+def test_arbiter_plan_invariant_across_arrival_orders(n_regions):
+    """Seeded property: the arbiter's plan is byte-identical no matter
+    which order captures arrived in — at region counts 1, 2, and 3."""
+    regions = [f"region-{i}" for i in range(n_regions)]
+    rng = random.Random(SEED + n_regions)
+    for round_no in range(6):
+        now = 100.0 * (round_no + 1)
+        caps = {r: _random_capture(rng, r, now) for r in regions}
+        plans = []
+        for order in itertools.permutations(regions):
+            arb = CapacityArbiter(capture_stale_seconds=90.0)
+            # Replay the arbiter's prior-tick book deterministically so
+            # hysteresis state matches across orders.
+            arb.tick({r: caps[r] for r in order}, now=now)
+            shuffled = {}
+            for r in order:
+                shuffled[r] = caps[r]
+            plans.append(_dumps(arb.tick(shuffled, now=now + 30.0)))
+        assert len(set(plans)) == 1, f"round {round_no} diverged"
+
+
+# --- the raise-only apply path --------------------------------------------
+
+
+def _decision(variant="m0-v5e", target=2, current=2):
+    return VariantDecision(
+        variant_name=variant, namespace=NS, model_id="fed/model-0",
+        accelerator_name="v5e-8", action=ACTION_NO_CHANGE,
+        current_replicas=current, target_replicas=target,
+        chips_per_replica=8)
+
+
+def test_apply_federation_directives_is_raise_only():
+    d = _decision(target=5, current=5)
+    directives = [{"variant_name": "m0-v5e", "namespace": NS,
+                   "floor_replicas": 3, "reason": "spill"}]
+    assert apply_federation_directives([d], directives, now=10.0) == 0
+    assert d.target_replicas == 5 and not d.decision_steps
+
+    directives[0]["floor_replicas"] = 8
+    assert apply_federation_directives([d], directives, now=10.0) == 1
+    assert d.target_replicas == 8
+    assert d.action == ACTION_SCALE_UP
+    step = d.decision_steps[-1]
+    assert step.name == "federation"
+    # Unknown variants are skipped, never raise.
+    stray = [{"variant_name": "ghost", "namespace": NS,
+              "floor_replicas": 9}]
+    assert apply_federation_directives([d], stray, now=10.0) == 0
+
+
+# --- the plane: stage triviality + gauges ---------------------------------
+
+
+def test_plane_stage_only_when_nontrivial_and_gauge_sweep():
+    registry = MetricsRegistry()
+    bus = InProcessCaptureBus()
+    plane = FederationPlane("eu-west4", bus,
+                            arbiter=CapacityArbiter(readmit_ticks=2),
+                            registry=registry)
+    other = _capture("us-east1", now=10.0, health_state="blackout",
+                     target=3, current=3)
+    bus.publish(other)
+    decisions = [_decision(target=2, current=2)]
+    directives, stage = plane.tick(decisions, {}, None, now=10.0)
+    (d,) = directives
+    assert d["source_region"] == "us-east1"
+    assert stage is not None and stage["region"] == "eu-west4"
+    assert stage["directives"] == directives
+    spill_labels = {LABEL_MODEL_NAME: "fed/model-0", LABEL_NAMESPACE: NS,
+                    LABEL_SOURCE: "us-east1", LABEL_REGION: "eu-west4"}
+    assert registry.get(WVA_FEDERATION_SPILL_REPLICAS, spill_labels) == 3.0
+    assert registry.get(WVA_FEDERATION_REGION_STATE,
+                        {LABEL_REGION: "us-east1",
+                         LABEL_STATE: "blackout"}) == 1.0
+    assert registry.get(WVA_FEDERATION_CAPTURE_AGE_SECONDS,
+                        {LABEL_REGION: "eu-west4"}) == 0.0
+
+    # Recovery: healthy captures, hysteresis drains, then the stage goes
+    # quiet and the spill gauge is swept.
+    bus.publish(_capture("us-east1", now=20.0, target=3, current=3))
+    directives, stage = plane.tick(decisions, {}, None, now=20.0)
+    assert stage is not None  # still shedding (hysteresis)
+    directives, stage = plane.tick(decisions, {}, None, now=30.0)
+    assert directives == [] and stage is None
+    assert registry.get(WVA_FEDERATION_SPILL_REPLICAS, spill_labels) is None
+    assert registry.get(WVA_FEDERATION_REGION_STATE,
+                        {LABEL_REGION: "us-east1",
+                         LABEL_STATE: "healthy"}) == 1.0
+
+
+def test_plane_ignores_stale_plan():
+    bus = InProcessCaptureBus()
+    bus.publish_plan({"schema": 1, "tick": 9, "published_at": 0.0,
+                      "region_states": {"x": {"state": "blackout"}},
+                      "directives": {"eu-west4": [{"variant_name": "m0-v5e",
+                                                   "namespace": NS,
+                                                   "floor_replicas": 9}]}})
+    plane = FederationPlane("eu-west4", bus, plan_stale_seconds=90.0)
+    directives, stage = plane.tick([], {}, None, now=1000.0)
+    assert directives == [] and stage is None
+
+
+# --- harness worlds -------------------------------------------------------
+
+
+def _fed_specs(peak=25.0):
+    load = trapezoid(base_rate=1.0, peak_rate=peak, ramp_up=60.0,
+                     hold=240.0, ramp_down=60.0, tail=1e9, delay=60.0)
+    return [VariantSpec(
+        name="m0-v5e", model_id="fed/model-0", accelerator="v5e-8",
+        chips_per_replica=8, cost=10.0, initial_replicas=1,
+        serving=ServingParams(engine="jetstream"), load=load,
+        hpa=HPAParams(stabilization_up_seconds=10.0,
+                      stabilization_down_seconds=30.0,
+                      sync_period_seconds=5.0))]
+
+
+def _fast_health_config(federation_enabled=True):
+    cfg = new_test_config()
+    cfg.set_health(HealthConfig(degraded_after_seconds=30.0,
+                                freeze_after_seconds=60.0,
+                                recovery_ticks=2))
+    if not federation_enabled:
+        cfg.set_federation(FederationConfig(enabled=False))
+    return cfg
+
+
+def _default_config(federation_enabled=True):
+    # Default health thresholds: a fault-free world never leaves FRESH,
+    # which is what the byte-identity discipline demands.
+    cfg = new_test_config()
+    if not federation_enabled:
+        cfg.set_federation(FederationConfig(enabled=False))
+    return cfg
+
+
+def _statuses(harness):
+    out = {}
+    for va in harness.cluster.list("VariantAutoscaling",
+                                   namespace=harness.namespace):
+        out[f"{harness.namespace}/{va.metadata.name}"] = encode(va.status)
+    return out
+
+
+def _load_trace(path):
+    from wva_tpu.blackbox.replay import load_trace
+
+    return load_trace(path)
+
+
+def _run_plain(tmp_path, tag):
+    trace = str(tmp_path / f"plain-{tag}.jsonl")
+    harness = EmulationHarness(
+        _fed_specs(), namespace=NS,
+        saturation_config=SaturationScalingConfig(
+            analyzer_name="saturation", enable_limiter=True),
+        config=_default_config(),
+        nodepools=[("v5e-pool", "v5e", "2x4", 8)],
+        startup_seconds=30.0, engine_interval=15.0,
+        stochastic_seed=SEED, trace_path=trace)
+    harness.run(300.0)
+    statuses = _statuses(harness)
+    harness.manager.shutdown()
+    return statuses, _load_trace(trace)
+
+
+def _run_federated(tmp_path, tag, *, federate, federation_enabled=True):
+    trace_dir = tmp_path / f"fed-{tag}"
+    trace_dir.mkdir()
+    fh = FederatedHarness(
+        [RegionSpec(name="us-east1", variants=_fed_specs(),
+                    config=_default_config(federation_enabled),
+                    saturation_config=SaturationScalingConfig(
+                        analyzer_name="saturation", enable_limiter=True),
+                    nodepools=[("v5e-pool", "v5e", "2x4", 8)])],
+        namespace=NS, engine_interval=15.0, startup_seconds=30.0,
+        stochastic_seed=SEED, trace_dir=str(trace_dir), federate=federate)
+    fh.run(300.0)
+    harness = fh.cluster("us-east1")
+    statuses = _statuses(harness)
+    harness.manager.shutdown()
+    return statuses, _load_trace(str(trace_dir / "us-east1.jsonl"))
+
+
+def test_federation_off_is_byte_identical_to_unfederated(tmp_path):
+    """WVA_FEDERATION=off (and a lone unfederated harness) must be
+    byte-identical — statuses AND trace cycles — to the same seeded
+    world run through the federated harness. A fault-free single-region
+    world with the plane ON is held to the same standard: the stage is
+    recorded only when non-trivial."""
+    base_statuses, base_cycles = _run_plain(tmp_path, "base")
+    assert base_cycles, "world recorded no cycles"
+
+    off_statuses, off_cycles = _run_federated(tmp_path, "off",
+                                              federate=True,
+                                              federation_enabled=False)
+    assert _dumps(base_statuses) == _dumps(off_statuses)
+    assert _dumps(base_cycles) == _dumps(off_cycles)
+
+    # Plane ON in the same fault-free world: a pure observer. Decisions
+    # and statuses are byte-identical; the only trace delta allowed is
+    # the plane's OWN stage events (recorded when a region wobbles off
+    # healthy), every one with zero directives.
+    on_statuses, on_cycles = _run_federated(tmp_path, "on", federate=True)
+    assert _dumps(base_statuses) == _dumps(on_statuses)
+    stripped = []
+    for rec in on_cycles:
+        rec = dict(rec)
+        rec["stages"] = [ev for ev in rec.get("stages", [])
+                         if ev.get("stage") != STAGE_FEDERATION]
+        stripped.append(rec)
+    assert _dumps(base_cycles) == _dumps(stripped)
+    for rec in on_cycles:
+        for ev in rec.get("stages", []):
+            if ev.get("stage") == STAGE_FEDERATION:
+                assert ev["directives"] == []
+
+
+def test_federated_blackout_spills_and_replays(tmp_path):
+    """The e2e arc: a seeded 2-region world where one region's metrics
+    black out -> the arbiter sheds its footprint to the healthy region
+    (raise-only floors, STAGE_FEDERATION recorded) -> the trace replays
+    through the shared apply path at zero diffs -> ``wva explain`` names
+    federation as the setter."""
+    from wva_tpu.blackbox.replay import ReplayEngine
+    from wva_tpu.obs.explain import explain_model
+
+    trace_dir = tmp_path / "fed-blackout"
+    trace_dir.mkdir()
+    plan = FaultPlan([FaultWindow(kind=KIND_METRICS_BLACKOUT,
+                                  start=90.0, end=330.0)], seed=SEED)
+    cfg_dark = _fast_health_config()
+    cfg_ok = _fast_health_config()
+    fh = FederatedHarness(
+        [RegionSpec(name="us-east1", variants=_fed_specs(),
+                    config=cfg_dark, fault_plan=plan,
+                    nodepools=[("v5e-pool", "v5e", "2x4", 8)]),
+         RegionSpec(name="eu-west4", variants=_fed_specs(),
+                    config=cfg_ok,
+                    nodepools=[("v5e-pool", "v5e", "2x4", 8)])],
+        namespace=NS, engine_interval=15.0, startup_seconds=30.0,
+        stochastic_seed=SEED, trace_dir=str(trace_dir))
+    fh.run(420.0)
+    assert fh.arbiter_region() == "us-east1"  # first region ticks first
+    for harness in fh.clusters.values():
+        harness.manager.shutdown()
+
+    records = _load_trace(str(trace_dir / "eu-west4.jsonl"))
+    fed_events = [ev for rec in records for ev in rec.get("stages", [])
+                  if ev.get("stage") == STAGE_FEDERATION]
+    assert fed_events, "no federation stage events recorded"
+    spills = [d for ev in fed_events for d in ev.get("directives", [])]
+    assert spills and all(d["source_region"] == "us-east1" and
+                          d["target_region"] == "eu-west4" for d in spills)
+    report = ReplayEngine(records).replay()
+    assert report.ok, json.dumps(report.to_dict(), indent=1)
+
+    # Provenance: the first cycle whose directive RAISED the target names
+    # federation as the setter, with source -> target in the reason.
+    raised = [rec["cycle"] for rec in records
+              for d in rec.get("decisions", [])
+              if any(s.get("name") == "federation"
+                     for s in d.get("decision_steps", []))
+              if d["decision_steps"][-1]["name"] == "federation"]
+    assert raised, "no cycle where federation set the final number"
+    exp = explain_model(records, "fed/model-0", NS, cycle_id=raised[0])
+    v = exp["variants"][0]
+    assert v["set_by"] == "federation"
+    assert v["federation_spill"]["source_region"] == "us-east1"
+    assert v["federation_spill"]["target_region"] == "eu-west4"
+
+
+def test_golden_federation_trace_replays_zero_diffs():
+    """The committed federation trace must replay byte-for-byte: recorded
+    STAGE_FEDERATION directives re-apply through the shared
+    federation.apply path, so replay needs no arbiter state."""
+    import os
+
+    from wva_tpu.blackbox.replay import ReplayEngine, load_trace
+
+    golden = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "goldens", "federation_trace_v1.jsonl")
+    records = load_trace(golden)
+    report = ReplayEngine(records).replay()
+    assert report.ok, report.to_dict()
+    assert report.cycles_replayed > 0
+    spills = [d for rec in records for ev in rec.get("stages", [])
+              if ev.get("stage") == STAGE_FEDERATION
+              for d in ev.get("directives", [])]
+    assert spills, "golden must contain spill directives"
+    assert {(d["source_region"], d["target_region"]) for d in spills} \
+        == {("us-east1", "asia-ne1")}

@@ -97,3 +97,20 @@ def test_rate_at_works_under_jax_numpy():
     # jax.numpy runs float32 by default — tolerance, not byte-equality,
     # is the contract on device; byte-equality is numpy-side.
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("region_index,n_regions", [(0, 3), (1, 3), (2, 3)])
+def test_regional_shift_preserves_byte_equality(region_index, n_regions):
+    """The follow-the-sun wrapper: region i's curve is the base profile
+    shifted by i/n of the period — and its vectorized twin stays
+    byte-equal to the scalar closure (the identical IEEE-double
+    subtraction runs before the wrapped law on both paths)."""
+    base = loadgen.diurnal(5.0, 40.0, 1200.0, phase=90.0)
+    prof = loadgen.regional(base, region_index, n_regions, period=1200.0)
+    t = _grid(seed=region_index)
+    vec = np.asarray(prof.rate_at(t), dtype=np.float64)
+    scalar = np.array([float(prof(x)) for x in t])
+    assert np.array_equal(vec, scalar)
+    # The shift is real: region 0 is the unshifted base; others differ.
+    shift = 1200.0 * region_index / n_regions
+    assert float(prof(500.0)) == float(base(500.0 - shift))

@@ -171,6 +171,9 @@ class ReplayEngine:
         health_event = next(
             (ev for ev in rec.get("stages", [])
              if ev.get("stage") == "health"), None)
+        federation_event = next(
+            (ev for ev in rec.get("stages", [])
+             if ev.get("stage") == "federation"), None)
 
         decisions: list = []
         v2_requests: list[ModelScalingRequest] = []
@@ -214,6 +217,20 @@ class ReplayEngine:
             apply_health_clamps(decisions,
                                 health_event.get("clamps") or [],
                                 now=self.clock.now())
+
+        if federation_event is not None:
+            # Spill floors re-applied from the RECORDED plan slice through
+            # the shared federation.apply path — the arbiter's state
+            # (hysteresis books, other regions' captures) is not
+            # reconstructable from one cycle. After the health gate,
+            # matching the live ordering: a raise-only floor on a healthy
+            # target never fights a local freeze.
+            from wva_tpu.federation.apply import apply_federation_directives
+
+            apply_federation_directives(decisions,
+                                        federation_event.get("directives")
+                                        or [],
+                                        now=self.clock.now())
         return decisions
 
     # --- per-path replay ---

@@ -87,6 +87,16 @@ STAGE_SHARD = "shard"
 # Only cycles where something was non-FRESH (or clamped) record the stage,
 # so a fault-free world's trace carries no health events.
 STAGE_HEALTH = "health"
+# Multi-cluster federation plane (wva_tpu.federation): the arbiter plan as
+# THIS region saw it — region states (with capture ages and re-admission
+# hysteresis) plus the spill directives applied to this region's final
+# decisions. Recorded AFTER the health gate; replay re-applies the
+# RECORDED directives through the shared federation.apply path — arbiter
+# state (hysteresis books, other regions' captures) is not
+# reconstructable from one cycle. Only cycles with a directive or a
+# non-healthy region record the stage, so a healthy fleet's traces (and
+# any single-cluster deployment's) stay byte-identical to the plane off.
+STAGE_FEDERATION = "federation"
 
 # Per-model pipeline paths.
 PATH_V1 = "v1"

@@ -131,6 +131,14 @@ class CapacityManager:
     def tier_cost_weight(self, variant: str) -> float:
         return self.ledger.blended_tier_weight(variant, self.tier_weights)
 
+    def provisioning_lead(self, variant: str) -> float:
+        """Best measured provisioning lead across the tier walk (the
+        federation capture's per-variant lead signal); falls back to the
+        configured default when nothing has been measured yet."""
+        return min((self._lead_estimate(variant, tier)
+                    for tier in self.tier_preference),
+                   default=self.default_lead_seconds)
+
     def credit_only_pools(self, existing: set[str]) -> dict[str, int]:
         """Variants with in-flight provisioning credit but NO discovered
         pool yet (first slices still materializing) -> credit chips, for

@@ -75,6 +75,44 @@ def parse_tier_weights(raw: str) -> dict[str, float]:
     return out
 
 
+def parse_region_tier_weights(raw: str) -> dict[str, dict[str, float]]:
+    """``"us-east1=spot:0.2,reservation:0.5|eu-west4=spot:0.45"`` ->
+    per-region weight overrides, each region merged over the defaults.
+
+    ``WVA_CAPACITY_TIER_WEIGHTS`` is parsed once per process, which was
+    fine while one process served one region — but the federation arbiter
+    prices EVERY region's candidacy, and pricing them all with the
+    arbiter's local env var would let one region's spot discount distort
+    another region's arbitrage. Regions absent from the override keep the
+    weights their own capture shipped (wva_tpu/federation/arbiter.py)."""
+    out: dict[str, dict[str, float]] = {}
+    for block in (raw or "").split("|"):
+        block = block.strip()
+        if not block:
+            continue
+        if "=" not in block:
+            raise ValueError(f"invalid region tier weight block {block!r}")
+        region, _, spec = block.partition("=")
+        region = region.strip()
+        if not region:
+            raise ValueError(f"empty region in tier weight block {block!r}")
+        weights = dict(DEFAULT_TIER_COST_WEIGHTS)
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part:
+                raise ValueError(
+                    f"invalid tier weight entry {part!r} for {region!r}")
+            tier, _, value = part.partition(":")
+            tier = tier.strip()
+            if tier not in weights:
+                raise ValueError(f"unknown capacity tier {tier!r}")
+            weights[tier] = float(value)
+        out[region] = weights
+    return out
+
+
 def parse_tier_preference(raw: str) -> tuple[str, ...]:
     """``"reservation,spot"`` -> preference order (subset allowed: omitting
     a tier forbids provisioning through it)."""

@@ -312,6 +312,48 @@ class CapacityConfig(frz.Freezable):
 
 
 @dataclass
+class FederationConfig(frz.Freezable):
+    """Multi-cluster capacity federation (``wva_tpu.federation``;
+    docs/design/federation.md): per-region capture export, one elected
+    capacity arbiter, raise-only cross-region spill directives. Default
+    ON, but the plane is only constructed when ``region`` is set — the
+    single-cluster default and ``WVA_FEDERATION=off`` are byte-identical
+    to the unfederated engine in statuses AND trace cycles (same
+    discipline as ``WVA_SHARDING=off``)."""
+
+    enabled: bool = True
+    # This cluster's region name (WVA_FEDERATION_REGION). "" = not part
+    # of a federation: no capture export, no plane.
+    region: str = ""
+    # Every region the arbiter should read captures for on the ConfigMap
+    # bus (WVA_FEDERATION_REGIONS, comma-separated; the in-process bus
+    # discovers regions from published captures and ignores this).
+    regions: tuple[str, ...] = ()
+    # Lease the fleet's single arbiter is elected under (the existing
+    # fenced-lease discipline; one Lease on the hub cluster).
+    arbiter_lease: str = "wva-tpu-federation-arbiter"
+    # A capture (or arbiter plan) older than this is treated as absent:
+    # the region classifies BLACKOUT, a dead arbiter's floors age out.
+    capture_stale_seconds: float = 90.0
+    # Cap on replicas one directive may spill into a target region per
+    # model — bounds how hard a dark region can lean on a healthy one.
+    spill_max_replicas: int = 4
+    # Consecutive HEALTHY arbiter ticks a shedding region must string
+    # together before re-admission (boot-ramp-style hysteresis; a
+    # flapping region cannot thrash spill capacity).
+    readmit_ticks: int = 3
+    # Blackout-aware failover lever: shed a dark region's bounded standby
+    # to healthy regions instead of freezing the fleet.
+    blackout_shed: bool = True
+    # Per-region tier cost weight overrides for the arbitrage ranking
+    # (WVA_FEDERATION_REGION_TIER_WEIGHTS). Regions absent here are
+    # priced with the weights their own capture shipped — never with
+    # another process's WVA_CAPACITY_TIER_WEIGHTS.
+    region_tier_weights: dict[str, dict[str, float]] = field(
+        default_factory=dict)
+
+
+@dataclass
 class ObsConfig(frz.Freezable):
     """Observability plane (``wva_tpu.obs``; docs/design/observability.md):
     hierarchical tick span recorder with cross-shard stitching, slow-tick
@@ -374,6 +416,7 @@ class Config:
         self._health = HealthConfig()
         self._resilience = ResilienceConfig()
         self._sharding = ShardingConfig()
+        self._federation = FederationConfig()
         self._obs = ObsConfig()
         # Bumped on every decision-affecting hot-reload (see mutation_epoch).
         self._epoch = 0
@@ -630,6 +673,20 @@ class Config:
     def set_sharding(self, s: "ShardingConfig") -> None:
         with self._mu:
             self._sharding = copy.deepcopy(s)
+            self._bump_epoch_locked()
+
+    # --- multi-cluster federation plane (wva_tpu.federation) ---
+
+    def federation_config(self) -> "FederationConfig":
+        return self._memoized("federation", lambda: self._federation)
+
+    def federation_enabled(self) -> bool:
+        with self._mu:
+            return self._federation.enabled
+
+    def set_federation(self, f: "FederationConfig") -> None:
+        with self._mu:
+            self._federation = copy.deepcopy(f)
             self._bump_epoch_locked()
 
     # --- observability plane (wva_tpu.obs) ---

@@ -19,6 +19,7 @@ from wva_tpu.config.config import (
     Config,
     EPPConfig,
     FeatureFlagsConfig,
+    FederationConfig,
     ForecastConfig,
     HealthConfig,
     InfrastructureConfig,
@@ -122,6 +123,32 @@ DEFAULTS: dict[str, Any] = {
     # Summaries older than this cover nothing (their models hold previous
     # desired).
     "WVA_SHARD_SUMMARY_STALE": "90s",
+    # Multi-cluster capacity federation (wva_tpu.federation;
+    # docs/design/federation.md). Default ON, but the plane only exists
+    # once WVA_FEDERATION_REGION names this cluster's region — the
+    # single-cluster default (and "off") is byte-identical to the
+    # unfederated engine in statuses and trace cycles.
+    "WVA_FEDERATION": True,
+    # This cluster's region name ("" = not federated).
+    "WVA_FEDERATION_REGION": "",
+    # Comma-separated fleet region list for the ConfigMap capture bus.
+    "WVA_FEDERATION_REGIONS": "",
+    # Arbiter election Lease on the hub cluster.
+    "WVA_FEDERATION_ARBITER_LEASE": "wva-tpu-federation-arbiter",
+    # Captures/plans older than this are absent (region -> BLACKOUT; a
+    # dead arbiter's spill floors age out).
+    "WVA_FEDERATION_CAPTURE_STALE": "90s",
+    # Max replicas one directive may spill into a target region per model.
+    "WVA_FEDERATION_SPILL_MAX": 4,
+    # Consecutive healthy arbiter ticks before a shedding region is
+    # re-admitted (boot-ramp-style hysteresis).
+    "WVA_FEDERATION_READMIT_TICKS": 3,
+    # Blackout-aware failover: shed a dark region's bounded standby to
+    # healthy regions instead of freezing the fleet.
+    "WVA_FEDERATION_BLACKOUT_SHED": True,
+    # Per-region tier cost weight overrides for the arbitrage ranking,
+    # e.g. "us-east1=spot:0.2,reservation:0.5|eu-west4=spot:0.45".
+    "WVA_FEDERATION_REGION_TIER_WEIGHTS": "",
     # Observability plane (wva_tpu.obs; docs/design/observability.md).
     # Span-structured tick tracing, default on; strictly out-of-band —
     # statuses, traces, and goldens are byte-identical either way, and
@@ -369,6 +396,24 @@ def load(flags: Mapping[str, Any] | None = None,
         workers=max(1, r.get_int("WVA_SHARD_WORKERS")),
         rebalance_hold_ticks=max(0, r.get_int("WVA_SHARD_REBALANCE_HOLD")),
         summary_stale_seconds=r.get_duration("WVA_SHARD_SUMMARY_STALE"),
+    ))
+
+    from wva_tpu.capacity.tiers import parse_region_tier_weights
+
+    cfg.set_federation(FederationConfig(
+        enabled=r.get_bool("WVA_FEDERATION"),
+        region=r.get_str("WVA_FEDERATION_REGION").strip(),
+        regions=tuple(
+            s.strip() for s in
+            r.get_str("WVA_FEDERATION_REGIONS").split(",") if s.strip()),
+        arbiter_lease=(r.get_str("WVA_FEDERATION_ARBITER_LEASE")
+                       or "wva-tpu-federation-arbiter"),
+        capture_stale_seconds=r.get_duration("WVA_FEDERATION_CAPTURE_STALE"),
+        spill_max_replicas=max(0, r.get_int("WVA_FEDERATION_SPILL_MAX")),
+        readmit_ticks=max(0, r.get_int("WVA_FEDERATION_READMIT_TICKS")),
+        blackout_shed=r.get_bool("WVA_FEDERATION_BLACKOUT_SHED"),
+        region_tier_weights=parse_region_tier_weights(
+            r.get_str("WVA_FEDERATION_REGION_TIER_WEIGHTS")),
     ))
 
     cfg.set_obs(ObsConfig(

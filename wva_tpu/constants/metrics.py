@@ -190,6 +190,19 @@ WVA_SLOW_TICK_DUMPS_TOTAL = "wva_slow_tick_dumps_total"
 # emitted when WVA_OTLP_ENDPOINT is set.
 WVA_OTLP_EXPORTS_TOTAL = "wva_otlp_exports_total"
 
+# --- Federation plane (wva_tpu/federation; docs/design/federation.md) ---
+# Replicas the arbiter's current plan spills into each target region
+# (region=target, source=source region(s), per spilled model); 0-swept
+# when a directive retires.
+WVA_FEDERATION_SPILL_REPLICAS = "wva_federation_spill_replicas"
+# Arbiter classification per region (state="healthy" | "degraded" |
+# "blackout"); one-hot, from the last published plan.
+WVA_FEDERATION_REGION_STATE = "wva_federation_region_state"
+# Age of each region's newest ClusterCapture as the arbiter last saw it.
+# A capture older than WVA_FEDERATION_CAPTURE_STALE classifies the region
+# BLACKOUT — alert before that.
+WVA_FEDERATION_CAPTURE_AGE_SECONDS = "wva_federation_capture_age_seconds"
+
 # --- Common metric label names ---
 LABEL_KIND = "kind"
 LABEL_MODEL_NAME = "model_name"
@@ -210,5 +223,6 @@ LABEL_TIER = "tier"
 LABEL_PHASE = "phase"
 LABEL_SOURCE = "source"
 LABEL_SHARD = "shard"
+LABEL_REGION = "region"
 
 __all__ = [n for n in dir() if n.isupper()]

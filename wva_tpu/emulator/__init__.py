@@ -39,10 +39,12 @@ from wva_tpu.emulator.loadgen import (
     poisson_bursts,
     preemption_storm,
     ramp,
+    regional,
     step_profile,
     trapezoid,
 )
 from wva_tpu.emulator.harness import EmulationHarness, VariantSpec
+from wva_tpu.emulator.federation import FederatedHarness, RegionSpec
 
 __all__ = [
     "add_tpu_nodepool",
@@ -66,8 +68,11 @@ __all__ = [
     "poisson_bursts",
     "preemption_storm",
     "ramp",
+    "regional",
     "step_profile",
     "trapezoid",
     "EmulationHarness",
     "VariantSpec",
+    "FederatedHarness",
+    "RegionSpec",
 ]

@@ -1,0 +1,149 @@
+"""Multi-cluster emulation: N :class:`EmulationHarness` worlds — one per
+region, each with its own clock, cluster, fault plan, and manager —
+advanced in lockstep plus a shared **hub**: an in-process capture bus and
+a FakeCluster carrying the federation arbiter Lease
+(docs/design/federation.md §emulation).
+
+Region order is deterministic (the listed order): each world step advances
+the regions in that order, so the first region's engine tick acquires the
+arbiter lease first and arbitration is reproducible. Per-region fault
+plans bind to the shared start time — a metrics blackout in one region
+blinds only that region's manager while every world's physics keeps
+running, which is exactly the shape `make bench-federation` leans on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from wva_tpu.emulator.harness import EmulationHarness, VariantSpec
+from wva_tpu.federation import (
+    CapacityArbiter,
+    FederationPlane,
+    InProcessCaptureBus,
+)
+from wva_tpu.k8s import FakeCluster
+from wva_tpu.leaderelection import LeaderElector, LeaderElectorConfig
+from wva_tpu.utils.clock import FakeClock
+
+
+@dataclass
+class RegionSpec:
+    """One region's world: variants + optional per-region config, fault
+    plan, node pools, and slice provisioner (factory or instance — the
+    same contract as :class:`EmulationHarness`)."""
+
+    name: str
+    variants: list[VariantSpec] = field(default_factory=list)
+    config: object | None = None
+    fault_plan: object | None = None
+    nodepools: list[tuple[str, str, str, int]] | None = None
+    provisioner: object | None = None
+    saturation_config: object | None = None
+
+
+class FederatedHarness:
+    """N regions in lockstep + the federation plane wired through an
+    in-process capture bus and a hub-cluster arbiter lease. With
+    ``federate=False`` (or ``WVA_FEDERATION=off`` in a region's config)
+    no plane is attached anywhere and every region behaves exactly like a
+    standalone :class:`EmulationHarness` — the byte-identity lever test
+    rides this (tests/test_federation.py)."""
+
+    def __init__(self, regions: list[RegionSpec],
+                 namespace: str = "inference",
+                 engine_interval: float = 30.0,
+                 startup_seconds: float = 120.0,
+                 start_time: float = 1_000_000.0,
+                 stochastic_seed: int | None = None,
+                 trace_dir: str | None = None,
+                 federate: bool = True,
+                 region_tier_weights: dict[str, dict[str, float]]
+                 | None = None) -> None:
+        if len({rs.name for rs in regions}) != len(regions):
+            raise ValueError("region names must be unique")
+        self.start_time = start_time
+        self.hub_clock = FakeClock(start=start_time)
+        self.hub = FakeCluster(clock=self.hub_clock)
+        self.bus = InProcessCaptureBus()
+        self.region_names: list[str] = [rs.name for rs in regions]
+        self.clusters: dict[str, EmulationHarness] = {}
+        self.planes: dict[str, FederationPlane] = {}
+        for i, rs in enumerate(regions):
+            harness = EmulationHarness(
+                rs.variants, namespace=namespace,
+                saturation_config=rs.saturation_config,
+                config=rs.config, nodepools=rs.nodepools,
+                startup_seconds=startup_seconds,
+                engine_interval=engine_interval,
+                start_time=start_time,
+                stochastic_seed=(None if stochastic_seed is None
+                                 else stochastic_seed + 1000003 * i),
+                trace_path=(None if trace_dir is None
+                            else f"{trace_dir}/{rs.name}.jsonl"),
+                provisioner=rs.provisioner,
+                fault_plan=rs.fault_plan)
+            self.clusters[rs.name] = harness
+            if not federate or not harness.config.federation_enabled():
+                continue
+            fed = harness.config.federation_config()
+            # The arbiter lease lives on the hub cluster; each region's
+            # elector ticks on its OWN clock (all clocks advance in
+            # lockstep, so lease expiry semantics match production skew
+            # behavior: a region observes the lease age on its own time).
+            elector = LeaderElector(
+                self.hub, identity=f"wva-{rs.name}",
+                config=LeaderElectorConfig(lease_name=fed.arbiter_lease,
+                                           namespace="wva-system"),
+                clock=harness.clock)
+            arbiter = CapacityArbiter(
+                tier_preference=harness.config.capacity_config()
+                .tier_preference,
+                region_tier_weights=(region_tier_weights
+                                     if region_tier_weights is not None
+                                     else fed.region_tier_weights),
+                capture_stale_seconds=fed.capture_stale_seconds,
+                spill_max_replicas=fed.spill_max_replicas,
+                readmit_ticks=fed.readmit_ticks,
+                blackout_shed=fed.blackout_shed)
+            plane = FederationPlane(
+                region=rs.name, bus=self.bus, elector=elector,
+                arbiter=arbiter, clock=harness.clock,
+                registry=harness.manager.registry,
+                plan_stale_seconds=fed.capture_stale_seconds)
+            harness.manager.engine.federation = plane
+            self.planes[rs.name] = plane
+
+    # --- the lockstep world loop -----------------------------------------
+
+    def run(self, duration: float, dt: float = 1.0, on_step=None) -> None:
+        """Advance every region ``duration`` simulated seconds in
+        lockstep: each world step runs the regions in listed order, then
+        the hub clock advances, then ``on_step(self, t)``."""
+        steps = int(duration / dt)
+        for _ in range(steps):
+            t = self.hub_clock.now() - self.start_time
+            for name in self.region_names:
+                self.clusters[name].step(dt)
+            self.hub_clock.advance(dt)
+            if on_step is not None:
+                on_step(self, t)
+        for harness in self.clusters.values():
+            if harness.flight_recorder is not None:
+                harness.flight_recorder.flush()
+
+    # --- observation ------------------------------------------------------
+
+    def cluster(self, name: str) -> EmulationHarness:
+        return self.clusters[name]
+
+    def arbiter_region(self) -> str | None:
+        """Which region's plane currently holds the arbiter lease."""
+        for name, plane in self.planes.items():
+            if plane.elector is not None and plane.elector.is_leader():
+                return name
+        return None
+
+    def last_plan(self) -> dict | None:
+        """The arbiter's most recently published fleet plan."""
+        return self.bus.read_plan()

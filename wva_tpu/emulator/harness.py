@@ -389,61 +389,68 @@ class EmulationHarness:
         """Advance the world ``duration`` simulated seconds."""
         steps = int(duration / dt)
         for _ in range(steps):
-            now = self.clock.now()
-            t = now - self.start_time
-
-            self._sync_sims()
-            # Model-level load: sum of load profiles across the model's specs.
-            rates: dict[str, float] = {}
-            for spec in self.variants:
-                if spec.load is not None:
-                    rates[spec.model_id] = rates.get(spec.model_id, 0.0) + spec.load(t)
-            for model_id, sim in self._sims_by_model.items():
-                sim.step(now, dt, rates.get(model_id, 0.0))
-
-            if now - self._last_emit >= self.emit_interval:
-                for sim in self._sims_by_model.values():
-                    sim.emit_metrics(now)
-                self._last_emit = now
-
-            if self.provisioner is not None:
-                self.provisioner.step()
-            self.kubelet.step()
-
-            # Leader election (no-op without an elector): every manager
-            # process runs its acquire/renew loop — throttled internally
-            # to the elector's retry period — and the HPA emulator reads
-            # gauges from whichever replica currently exports them.
-            if self.standbys or self.manager.elector is not None:
-                for mgr in self._all_managers():
-                    mgr.election_tick()
-                self._refresh_hpa_registry()
-            if now - self._last_sfz >= self.sfz_interval:
-                for mgr in self._all_managers():
-                    mgr.scale_from_zero.executor.tick()
-                    # The fast path runs at the scale-from-zero cadence; a
-                    # detected backlog forces an immediate engine tick
-                    # instead of waiting out the poll interval.
-                    if mgr.fast_path_tick():
-                        mgr.engine.executor.tick()
-                        self._last_engine = now
-                self._last_sfz = now
-            if now - self._last_engine >= self.engine_interval:
-                for mgr in self._all_managers():
-                    mgr.engine.executor.tick()
-                self._last_engine = now
-            for mgr in self._all_managers():
-                mgr.va_reconciler.drain_triggers()
-            self.hpa.step()
-
-            if on_step is not None:
-                on_step(self, t)
-            self.clock.advance(dt)
+            self.step(dt, on_step=on_step)
         if self.flight_recorder is not None:
             # The last cycle stays pending (accepting reconciler events)
             # until committed; flush so the spill file is replayable as soon
             # as run() returns.
             self.flight_recorder.flush()
+
+    def step(self, dt: float = 1.0, on_step=None) -> None:
+        """One world step (sims -> physics -> managers -> clock). Public
+        so the multi-cluster FederatedHarness can advance N clusters in
+        lockstep (wva_tpu/emulator/federation.py); run() is this in a
+        loop plus the final trace flush."""
+        now = self.clock.now()
+        t = now - self.start_time
+
+        self._sync_sims()
+        # Model-level load: sum of load profiles across the model's specs.
+        rates: dict[str, float] = {}
+        for spec in self.variants:
+            if spec.load is not None:
+                rates[spec.model_id] = rates.get(spec.model_id, 0.0) + spec.load(t)
+        for model_id, sim in self._sims_by_model.items():
+            sim.step(now, dt, rates.get(model_id, 0.0))
+
+        if now - self._last_emit >= self.emit_interval:
+            for sim in self._sims_by_model.values():
+                sim.emit_metrics(now)
+            self._last_emit = now
+
+        if self.provisioner is not None:
+            self.provisioner.step()
+        self.kubelet.step()
+
+        # Leader election (no-op without an elector): every manager
+        # process runs its acquire/renew loop — throttled internally
+        # to the elector's retry period — and the HPA emulator reads
+        # gauges from whichever replica currently exports them.
+        if self.standbys or self.manager.elector is not None:
+            for mgr in self._all_managers():
+                mgr.election_tick()
+            self._refresh_hpa_registry()
+        if now - self._last_sfz >= self.sfz_interval:
+            for mgr in self._all_managers():
+                mgr.scale_from_zero.executor.tick()
+                # The fast path runs at the scale-from-zero cadence; a
+                # detected backlog forces an immediate engine tick
+                # instead of waiting out the poll interval.
+                if mgr.fast_path_tick():
+                    mgr.engine.executor.tick()
+                    self._last_engine = now
+            self._last_sfz = now
+        if now - self._last_engine >= self.engine_interval:
+            for mgr in self._all_managers():
+                mgr.engine.executor.tick()
+            self._last_engine = now
+        for mgr in self._all_managers():
+            mgr.va_reconciler.drain_triggers()
+        self.hpa.step()
+
+        if on_step is not None:
+            on_step(self, t)
+        self.clock.advance(dt)
 
     # --- measurement ---
 

@@ -187,6 +187,27 @@ def diurnal(base_rate: float, amplitude: float, period: float,
     return profile
 
 
+def regional(profile: LoadProfile, region_index: int, n_regions: int,
+             period: float) -> LoadProfile:
+    """Follow-the-sun wrapper: region ``i`` of ``n`` sees ``profile``
+    time-shifted by ``i/n`` of the diurnal ``period``, so one region
+    peaks while another troughs (the cross-region spill headroom the
+    federation bench leans on). Works on any profile; the vectorized
+    ``rate_at`` twin applies the identical shift (same IEEE-double
+    subtraction before the wrapped law), preserving byte-exactness."""
+    shift = period * (region_index / max(n_regions, 1))
+
+    def shifted(t: float) -> float:
+        return profile(t - shift)
+
+    def rate_at(t):
+        xp = _xp(t)
+        return profile.rate_at(xp.asarray(t) - shift)
+
+    shifted.rate_at = rate_at
+    return shifted
+
+
 def poisson_bursts(base_rate: float, burst_rate: float,
                    burst_duration: float, mean_gap: float,
                    seed: int = 0) -> LoadProfile:

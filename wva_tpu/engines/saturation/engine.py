@@ -75,11 +75,13 @@ from wva_tpu.api.v1alpha1 import (
 from wva_tpu.blackbox.schema import (
     STAGE_BOOT,
     STAGE_CAPACITY,
+    STAGE_FEDERATION,
     STAGE_FINGERPRINT_SKIP,
     STAGE_FORECAST,
     STAGE_HEALTH,
     STAGE_SHARD,
 )
+from wva_tpu.federation.apply import apply_federation_directives
 from wva_tpu.obs import logjson
 from wva_tpu.resilience import LeadershipLostError, SimulatedCrash
 from wva_tpu.health import BLACKOUT, FRESH, HEALTH_STATES, InputHealth
@@ -388,6 +390,14 @@ class SaturationEngine:
         #   instead of applying anything.
         self.shard_plane = None
         self.shard_ctx = None
+        # Multi-cluster federation plane (WVA_FEDERATION + a configured
+        # region; wva_tpu/federation): publishes this region's
+        # ClusterCapture each tick, arbitrates the fleet while holding the
+        # arbiter lease, and applies the arbiter's raise-only spill
+        # directives AFTER the health gate (docs/design/federation.md).
+        # None = single-cluster engine, byte-identical to pre-federation
+        # builds.
+        self.federation = None
         # Fleet-installed shared tick collector for shard workers (see
         # _tick_collector); always None outside a plane-driven worker tick.
         self.tick_collector_override = None
@@ -922,6 +932,15 @@ class SaturationEngine:
         # re-applies them, BEFORE the decisions themselves are recorded.
         with self._obs_span("health_gate"):
             self._apply_health_gate(decisions, va_map)
+        # Federation gate (WVA_FEDERATION + region): capture export +
+        # raise-only spill floors from the arbiter plan. Runs AFTER the
+        # health gate (targets are healthy regions, and a raise-only
+        # floor cannot fight a local freeze) and BEFORE the decisions are
+        # recorded, so replay re-applies the recorded directives in the
+        # same position.
+        if self.federation is not None:
+            with self._obs_span("federation_gate"):
+                self._apply_federation_gate(decisions)
         if self.flight is not None:
             self.flight.record_decisions(decisions)
         apply_start = time.perf_counter()
@@ -1279,6 +1298,28 @@ class SaturationEngine:
                 })
             self.flight.record_stage(STAGE_HEALTH, {
                 "states": states, "clamps": clamps})
+
+    def _apply_federation_gate(self, decisions: list[VariantDecision]
+                               ) -> None:
+        """Multi-cluster federation tick (docs/design/federation.md):
+        export this region's capture, arbitrate while holding the arbiter
+        lease, then raise final decisions to the plan's spill floors via
+        the shared federation.apply path. The stage is recorded only when
+        the plan is non-trivial, so healthy fleets trace byte-identically
+        to the plane being off."""
+        now = self.clock.now()
+        epoch = self._tick_epoch if self._tick_epoch is not None else -1
+        try:
+            directives, stage = self.federation.tick(
+                decisions, self._tick_health, self.capacity, now,
+                epoch=epoch)
+        except Exception:  # noqa: BLE001 — federation must never fail a
+            log.warning("federation gate failed", exc_info=True)  # tick
+            return
+        if directives:
+            apply_federation_directives(decisions, directives, now=now)
+        if self.flight is not None and stage is not None:
+            self.flight.record_stage(STAGE_FEDERATION, stage)
 
     def _maybe_record_boot_stage(self, ramp_holds: set[str]) -> None:
         """STAGE_BOOT: one observability record on the first traced cycle

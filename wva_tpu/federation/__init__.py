@@ -1,0 +1,87 @@
+"""Multi-cluster capacity federation (docs/design/federation.md).
+
+N per-cluster engines each export a compact :class:`ClusterCapture`; one
+elected **capacity arbiter** merges them and emits raise-only spill
+directives — cross-cluster spill on stockout, reservation/spot arbitrage
+with per-region cost weights, and blackout-aware failover with
+re-admission hysteresis. ``WVA_FEDERATION`` is the lever (default on);
+off — or simply leaving ``WVA_FEDERATION_REGION`` unset — is
+byte-identical in statuses and trace cycles to the unfederated engine.
+"""
+
+from wva_tpu.federation.apply import (
+    FEDERATION_STEP_NAME,
+    apply_federation_directives,
+)
+from wva_tpu.federation.arbiter import (
+    REGION_BLACKOUT,
+    REGION_DEGRADED,
+    REGION_HEALTHY,
+    CapacityArbiter,
+    classify_capture,
+)
+from wva_tpu.federation.capture import (
+    ClusterCapture,
+    ConfigMapCaptureBus,
+    InProcessCaptureBus,
+    ModelDemand,
+    RegionModelHealth,
+    VariantCapacity,
+    capture_to_payload,
+    demand_key,
+    payload_to_capture,
+)
+from wva_tpu.federation.plane import FederationPlane
+
+__all__ = [
+    "FEDERATION_STEP_NAME",
+    "apply_federation_directives",
+    "REGION_BLACKOUT",
+    "REGION_DEGRADED",
+    "REGION_HEALTHY",
+    "CapacityArbiter",
+    "classify_capture",
+    "ClusterCapture",
+    "ConfigMapCaptureBus",
+    "InProcessCaptureBus",
+    "ModelDemand",
+    "RegionModelHealth",
+    "VariantCapacity",
+    "capture_to_payload",
+    "demand_key",
+    "payload_to_capture",
+    "FederationPlane",
+    "build_federation_plane",
+]
+
+
+def build_federation_plane(client, config, clock, registry=None,
+                           identity: str = "wva"):
+    """Production wiring: ConfigMap capture bus + arbiter lease on the hub
+    cluster this controller's kubeconfig points at (``client``). Returns
+    None when federation is off or no region name is configured — the
+    engine then never constructs the plane, keeping the single-cluster
+    default byte-identical to pre-federation builds."""
+    fed = config.federation_config()
+    if not fed.enabled or not fed.region:
+        return None
+    from wva_tpu.config.helpers import system_namespace
+    from wva_tpu.leaderelection import LeaderElector, LeaderElectorConfig
+
+    bus = ConfigMapCaptureBus(client, namespace=system_namespace(),
+                              regions=fed.regions or (fed.region,))
+    elector = LeaderElector(
+        client, f"{identity}-{fed.region}",
+        config=LeaderElectorConfig(lease_name=fed.arbiter_lease),
+        clock=clock)
+    arbiter = CapacityArbiter(
+        tier_preference=config.capacity_config().tier_preference,
+        region_tier_weights=fed.region_tier_weights,
+        capture_stale_seconds=fed.capture_stale_seconds,
+        spill_max_replicas=fed.spill_max_replicas,
+        readmit_ticks=fed.readmit_ticks,
+        blackout_shed=fed.blackout_shed)
+    return FederationPlane(
+        region=fed.region, bus=bus, elector=elector, arbiter=arbiter,
+        clock=clock, registry=registry,
+        plan_stale_seconds=fed.capture_stale_seconds)

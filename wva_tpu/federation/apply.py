@@ -1,0 +1,43 @@
+"""Federation directive application — shared by the live engine and trace
+replay (docs/design/federation.md §spill-semantics).
+
+Kept free of JAX and federation-plane imports: the replay CLI re-applies
+RECORDED spill directives (the ``federation`` stage event in the decision
+trace) without re-running the arbiter, exactly like the health replay
+re-applies recorded clamps — so a trace recorded with federation on
+replays to zero diffs.
+"""
+
+from __future__ import annotations
+
+from wva_tpu.interfaces import ACTION_SCALE_UP, VariantDecision
+
+FEDERATION_STEP_NAME = "federation"
+
+
+def apply_federation_directives(decisions: list[VariantDecision],
+                                directives: list[dict], now: float) -> int:
+    """Raise each targeted variant's desired to its spill floor (never
+    lowers — the arbiter only ever ADDS capacity in the TARGET region for
+    growth its source region cannot serve; scale-down stays local and
+    reactive, so a bad arbiter can at worst over-provision, never starve).
+    Runs AFTER the health gate: targets are healthy regions by
+    construction, and a raise-only floor cannot fight a local freeze.
+    Returns how many decisions were raised."""
+    if not directives:
+        return 0
+    by_variant = {(d.namespace, d.variant_name): d for d in decisions}
+    raised = 0
+    for f in directives:
+        d = by_variant.get((f.get("namespace", ""), f.get("variant_name", "")))
+        floor = int(f.get("floor_replicas", 0))
+        if d is None or floor <= d.target_replicas:
+            continue
+        d.target_replicas = floor
+        if floor > d.current_replicas:
+            d.action = ACTION_SCALE_UP
+        reason = f.get("reason", "")
+        d.reason = reason or d.reason
+        d.add_step(FEDERATION_STEP_NAME, reason, now=now)
+        raised += 1
+    return raised

@@ -1,0 +1,284 @@
+"""The global capacity arbiter (docs/design/federation.md §arbiter).
+
+One elected controller (the existing fenced-lease discipline, lease
+``wva-tpu-federation-arbiter``) merges every region's
+:class:`~wva_tpu.federation.capture.ClusterCapture` and emits a fleet
+plan: per-region spill directives plus the region-state ledger. Three
+behaviours, all raise-only in the target region:
+
+- **Cross-cluster spill** — a model whose home region is stocked out
+  across its whole tier-preference walk (or dark, below) gets its
+  unserved growth routed to the candidate region with the most ready
+  reservation slices, then the shortest measured provisioning lead, then
+  the cheapest per-region blended tier cost, then region name.
+- **Reservation/spot arbitrage** — that ranking prices each candidate
+  with ITS OWN region's tier cost weights (per-region overridable via
+  federation config), so one region's spot discount never distorts
+  another's ranking.
+- **Blackout-aware failover** — a region whose input-health plane is
+  BLACKOUT (or whose capture has gone stale) sheds a bounded standby of
+  its frozen footprint to healthy regions instead of freezing the fleet;
+  re-admission takes ``readmit_ticks`` consecutive healthy arbiter ticks
+  (boot-ramp-style hysteresis), so a flapping region cannot thrash spill
+  capacity.
+
+Everything here is pure and deterministic: captures are processed in
+sorted region order, demand in sorted key order — byte-identical plans
+across capture arrival orders (tests/test_federation.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from wva_tpu.capacity.tiers import (
+    DEFAULT_TIER_COST_WEIGHTS,
+    DEFAULT_TIER_PREFERENCE,
+    TIER_RESERVATION,
+)
+from wva_tpu.federation.capture import ClusterCapture
+
+PLAN_SCHEMA_VERSION = 1
+
+# Region classifications (the plan's ``region_states`` values).
+REGION_HEALTHY = "healthy"
+REGION_DEGRADED = "degraded"
+REGION_BLACKOUT = "blackout"
+
+# Input-health ladder states as they appear in capture health signals
+# (mirrors wva_tpu/health constants; string-matched so this module stays
+# import-light for tests that build captures by hand).
+_FRESH = "fresh"
+_BLACKOUT = "blackout"
+
+
+@dataclass
+class _RegionBook:
+    """Arbiter-side hysteresis state for one region."""
+
+    shedding: bool = False
+    readmit_in: int = 0
+
+
+def classify_capture(cap: ClusterCapture | None, age: float,
+                     stale_seconds: float) -> str:
+    """Pure classification of one region from its capture + age. A
+    missing or stale capture is BLACKOUT — the arbiter cannot tell a dead
+    hub link from a dead region, and shedding standby capacity is the
+    safe direction for both. A region where at least half the models
+    report input-health BLACKOUT is dark; any non-fresh model degrades."""
+    if cap is None or age > stale_seconds:
+        return REGION_BLACKOUT
+    total = len(cap.health)
+    if total:
+        dark = sum(1 for h in cap.health.values() if h.state == _BLACKOUT)
+        if dark * 2 >= total and dark > 0:
+            return REGION_BLACKOUT
+        if any(h.state != _FRESH for h in cap.health.values()):
+            return REGION_DEGRADED
+    return REGION_HEALTHY
+
+
+class CapacityArbiter:
+    """Deterministic fleet merge: captures in → plan out. State is the
+    per-region hysteresis book only; a leadership move restarts it cold,
+    which (like a process restart) errs toward keeping spill standby a
+    few extra ticks — the do-no-harm direction."""
+
+    def __init__(self,
+                 tier_preference: tuple[str, ...] = DEFAULT_TIER_PREFERENCE,
+                 region_tier_weights: dict[str, dict[str, float]] | None = None,
+                 capture_stale_seconds: float = 90.0,
+                 spill_max_replicas: int = 4,
+                 readmit_ticks: int = 3,
+                 blackout_shed: bool = True) -> None:
+        self.tier_preference = tuple(tier_preference)
+        self.region_tier_weights = {
+            r: dict(w) for r, w in (region_tier_weights or {}).items()}
+        self.capture_stale_seconds = capture_stale_seconds
+        self.spill_max_replicas = spill_max_replicas
+        self.readmit_ticks = readmit_ticks
+        self.blackout_shed = blackout_shed
+        self._books: dict[str, _RegionBook] = {}
+        self._tick = 0
+
+    # --- per-region pricing ---------------------------------------------
+
+    def _weights_for(self, region: str, cap: ClusterCapture | None
+                     ) -> dict[str, float]:
+        """A region is priced with its own weights: the federation-config
+        override wins, then the weights the region shipped in its capture,
+        then the process defaults. Keyed per region so one region's spot
+        discount cannot leak into another's ranking (the tiers.py env var
+        is per-process and would otherwise apply fleet-wide)."""
+        override = self.region_tier_weights.get(region)
+        if override:
+            return override
+        if cap is not None and cap.tier_weights:
+            return cap.tier_weights
+        return DEFAULT_TIER_COST_WEIGHTS
+
+    def _cheapest_open_tier_weight(self, region: str, cap: ClusterCapture,
+                                   accelerator: str) -> float:
+        weights = self._weights_for(region, cap)
+        vc = cap.capacity.get(accelerator)
+        stocked = set(vc.stocked_out_tiers) if vc is not None else set()
+        open_weights = [weights.get(t, 1.0) for t in self.tier_preference
+                        if t not in stocked]
+        return min(open_weights) if open_weights else max(
+            weights.values(), default=1.0)
+
+    # --- candidate ranking ----------------------------------------------
+
+    def _rank_targets(self, source: str, key: str, model_id: str,
+                      accelerator: str, captures: dict[str, ClusterCapture],
+                      states: dict[str, dict]) -> list[str]:
+        """Healthy, non-shedding regions serving the same (demand key,
+        model) ranked: ready reservation slices desc, measured lead asc,
+        own-region blended cost asc, region name asc."""
+        ranked = []
+        for region in sorted(captures):
+            if region == source:
+                continue
+            st = states[region]
+            if st["state"] != REGION_HEALTHY or st["shedding"]:
+                continue
+            cap = captures[region]
+            entry = cap.demand.get(key)
+            if entry is None or entry.model_id != model_id:
+                continue
+            vc = cap.capacity.get(accelerator)
+            reservation_ready = (
+                vc.tier_slices.get(TIER_RESERVATION, 0) if vc else 0)
+            lead = vc.lead_seconds if vc else float("inf")
+            cost = self._cheapest_open_tier_weight(region, cap, accelerator)
+            ranked.append(((-reservation_ready, lead, cost, region), region))
+        ranked.sort(key=lambda t: t[0])
+        return [region for _, region in ranked]
+
+    # --- spill sizing ----------------------------------------------------
+
+    @staticmethod
+    def _provisioning_replicas(cap: ClusterCapture, accelerator: str,
+                               chips_per_replica: int) -> int:
+        vc = cap.capacity.get(accelerator)
+        if vc is None:
+            return 0
+        chips = vc.provisioning * vc.chips_per_slice
+        return chips // max(chips_per_replica, 1)
+
+    def _stockout_unserved(self, cap: ClusterCapture, entry) -> int:
+        """Growth a healthy region cannot place: wants more replicas than
+        it runs + has provisioning, with every preferred tier stockout-
+        pinned for that accelerator."""
+        vc = cap.capacity.get(entry.accelerator_name)
+        if vc is None or not set(self.tier_preference) <= set(
+                vc.stocked_out_tiers):
+            return 0
+        inflight = self._provisioning_replicas(
+            cap, entry.accelerator_name, entry.chips_per_replica)
+        return max(entry.target_replicas - entry.current_replicas - inflight,
+                   0)
+
+    # --- the merge -------------------------------------------------------
+
+    def tick(self, captures: dict[str, ClusterCapture], now: float,
+             epoch: int = -1) -> dict:
+        """One arbiter pass: classify every region (with re-admission
+        hysteresis), then walk demand in sorted order emitting raise-only
+        spill directives keyed by TARGET region."""
+        self._tick += 1
+        regions = sorted(set(captures) | set(self._books))
+        states: dict[str, dict] = {}
+        for region in regions:
+            cap = captures.get(region)
+            age = max(now - cap.published_at, 0.0) if cap is not None else 0.0
+            raw = classify_capture(cap, age, self.capture_stale_seconds)
+            book = self._books.setdefault(region, _RegionBook())
+            if raw == REGION_BLACKOUT:
+                book.shedding = True
+                book.readmit_in = self.readmit_ticks
+            elif book.shedding:
+                if raw == REGION_HEALTHY:
+                    book.readmit_in -= 1
+                    if book.readmit_in <= 0:
+                        book.shedding = False
+                        book.readmit_in = 0
+                else:
+                    # Degraded ticks do not count toward re-admission —
+                    # the region must PROVE healthy for the full window.
+                    book.readmit_in = self.readmit_ticks
+            states[region] = {
+                "state": raw,
+                "capture_age": round(age, 3),
+                "shedding": book.shedding,
+                "readmit_in": book.readmit_in if book.shedding else 0,
+            }
+        # Drop books for regions that vanished from the fleet.
+        for region in list(self._books):
+            if region not in captures:
+                del self._books[region]
+
+        directives: dict[str, list[dict]] = {}
+        # floors accumulate per (target region, demand key) so two sources
+        # spilling the same model stack instead of overwriting.
+        floors: dict[tuple[str, str], dict] = {}
+        for source in sorted(captures):
+            cap = captures[source]
+            st = states[source]
+            dark = st["state"] == REGION_BLACKOUT or st["shedding"]
+            for key in sorted(cap.demand):
+                entry = cap.demand[key]
+                if dark:
+                    if not self.blackout_shed:
+                        continue
+                    spill = min(
+                        max(entry.target_replicas, entry.current_replicas),
+                        self.spill_max_replicas)
+                    why = ("input-health blackout"
+                           if st["state"] == REGION_BLACKOUT
+                           else "re-admission hysteresis")
+                else:
+                    spill = min(self._stockout_unserved(cap, entry),
+                                self.spill_max_replicas)
+                    why = "tier stockout"
+                if spill <= 0:
+                    continue
+                targets = self._rank_targets(
+                    source, key, entry.model_id, entry.accelerator_name,
+                    captures, states)
+                if not targets:
+                    continue
+                target = targets[0]
+                slot = floors.get((target, key))
+                if slot is None:
+                    base = captures[target].demand[key].target_replicas
+                    slot = {
+                        "variant_name": entry.variant_name,
+                        "namespace": entry.namespace,
+                        "model_id": entry.model_id,
+                        "floor_replicas": base,
+                        "spill_replicas": 0,
+                        "source_region": source,
+                        "target_region": target,
+                    }
+                    floors[(target, key)] = slot
+                    directives.setdefault(target, []).append(slot)
+                else:
+                    # Multiple sources: keep them all in the provenance.
+                    sources = set(slot["source_region"].split("+"))
+                    sources.add(source)
+                    slot["source_region"] = "+".join(sorted(sources))
+                slot["floor_replicas"] += spill
+                slot["spill_replicas"] += spill
+                slot["reason"] = (
+                    f"federation spill: +{slot['spill_replicas']} replicas "
+                    f"from {slot['source_region']} ({why}) -> {target}")
+        return {
+            "schema": PLAN_SCHEMA_VERSION,
+            "tick": self._tick,
+            "epoch": epoch,
+            "published_at": now,
+            "region_states": states,
+            "directives": {r: directives[r] for r in sorted(directives)},
+        }

@@ -1,0 +1,273 @@
+"""Per-cluster captures: the only thing a region ships to the global
+capacity arbiter (docs/design/federation.md §capture-schema).
+
+Each engine tick produces a :class:`ClusterCapture` — compact per-model
+demand entries (post-health-gate targets), the capacity ledger's per-variant
+snapshot with measured provisioning leads, the input-health plane's raw
+per-model signals, and the region's effective tier cost weights — never
+object graphs: no K8s objects, no analyzer state, no collector views cross
+the region boundary. The arbiter merges captures in sorted region order,
+which is what makes its decisions byte-identical across capture arrival
+orders (tests/test_federation.py).
+
+Two transports, mirroring the shard summary bus:
+
+- **In-process** (emulator / bench / multi-cluster harness): captures and
+  the arbiter's published plan pass by reference through
+  :class:`InProcessCaptureBus`.
+- **ConfigMap** (one hub cluster shared by every region's controller):
+  :class:`ConfigMapCaptureBus` publishes each capture as canonical JSON in
+  ``wva-federation-capture-<region>`` and the arbiter's plan in
+  ``wva-federation-plan`` (rv-guarded writes, the checkpoint ConfigMap
+  discipline) — ``wva_federation_capture_age_seconds`` is the alert.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+
+log = logging.getLogger(__name__)
+
+CAPTURE_CONFIGMAP_PREFIX = "wva-federation-capture"
+CAPTURE_DATA_KEY = "capture"
+PLAN_CONFIGMAP_NAME = "wva-federation-plan"
+PLAN_DATA_KEY = "plan"
+CAPTURE_SCHEMA_VERSION = 1
+
+
+@dataclass
+class ModelDemand:
+    """One variant's demand/position in its home region: the post-health-
+    gate target (what the region would run if it could) next to what it
+    actually runs — the gap is what the arbiter may spill elsewhere."""
+
+    variant_name: str = ""
+    namespace: str = ""
+    model_id: str = ""
+    accelerator_name: str = ""
+    current_replicas: int = 0
+    target_replicas: int = 0
+    chips_per_replica: int = 1
+
+
+@dataclass
+class RegionModelHealth:
+    """One model's input-health classification as shipped to the arbiter
+    (the region's own ladder runs locally; the arbiter only consumes the
+    classification — same split as the shard plane's HealthSignals)."""
+
+    state: str = "fresh"
+    age_seconds: float = 0.0
+    allow_scale_down: bool = True
+    reason: str = ""
+
+
+@dataclass
+class VariantCapacity:
+    """One variant's ledger row + measured provisioning lead. ``ready``/
+    ``provisioning``/``preempted`` are slices; ``tier_slices`` is the
+    per-tier ready inventory the arbitrage ranking walks."""
+
+    variant: str = ""
+    chips_per_slice: int = 1
+    ready: int = 0
+    provisioning: int = 0
+    preempted: int = 0
+    tier_slices: dict[str, int] = field(default_factory=dict)
+    stocked_out_tiers: list[str] = field(default_factory=list)
+    lead_seconds: float = 0.0
+
+
+@dataclass
+class ClusterCapture:
+    """One region's full federation export for one engine tick."""
+
+    region: str = ""
+    epoch: int = -1                 # region lease fencing token at capture
+    tick_seq: int = 0
+    published_at: float = 0.0
+    demand: dict[str, ModelDemand] = field(default_factory=dict)
+    health: dict[str, RegionModelHealth] = field(default_factory=dict)
+    capacity: dict[str, VariantCapacity] = field(default_factory=dict)
+    # The region's effective tier cost weights (after any per-region
+    # override) — the arbitrage ranking input.
+    tier_weights: dict[str, float] = field(default_factory=dict)
+
+
+def demand_key(namespace: str, variant_name: str) -> str:
+    return f"{namespace}|{variant_name}"
+
+
+def capture_to_payload(cap: ClusterCapture) -> dict:
+    """Canonical JSON-able form for the ConfigMap transport; the
+    in-process bus skips this entirely (references cross no process
+    boundary there)."""
+    return {
+        "schema": CAPTURE_SCHEMA_VERSION,
+        "region": cap.region,
+        "epoch": cap.epoch,
+        "tick_seq": cap.tick_seq,
+        "published_at": cap.published_at,
+        "demand": {
+            k: {"variant_name": d.variant_name, "namespace": d.namespace,
+                "model_id": d.model_id,
+                "accelerator_name": d.accelerator_name,
+                "current_replicas": d.current_replicas,
+                "target_replicas": d.target_replicas,
+                "chips_per_replica": d.chips_per_replica}
+            for k, d in sorted(cap.demand.items())},
+        "health": {
+            k: {"state": h.state, "age_seconds": h.age_seconds,
+                "allow_scale_down": h.allow_scale_down, "reason": h.reason}
+            for k, h in sorted(cap.health.items())},
+        "capacity": {
+            k: {"variant": c.variant, "chips_per_slice": c.chips_per_slice,
+                "ready": c.ready, "provisioning": c.provisioning,
+                "preempted": c.preempted,
+                "tier_slices": dict(sorted(c.tier_slices.items())),
+                "stocked_out_tiers": sorted(c.stocked_out_tiers),
+                "lead_seconds": c.lead_seconds}
+            for k, c in sorted(cap.capacity.items())},
+        "tier_weights": dict(sorted(cap.tier_weights.items())),
+    }
+
+
+def payload_to_capture(data: dict) -> ClusterCapture:
+    """Inverse of :func:`capture_to_payload`."""
+    cap = ClusterCapture(
+        region=str(data.get("region", "")),
+        epoch=int(data.get("epoch", -1)),
+        tick_seq=int(data.get("tick_seq", 0)),
+        published_at=float(data.get("published_at", 0.0)),
+        tier_weights={k: float(v)
+                      for k, v in (data.get("tier_weights") or {}).items()},
+    )
+    for k, d in (data.get("demand") or {}).items():
+        cap.demand[k] = ModelDemand(
+            variant_name=d.get("variant_name", ""),
+            namespace=d.get("namespace", ""),
+            model_id=d.get("model_id", ""),
+            accelerator_name=d.get("accelerator_name", ""),
+            current_replicas=int(d.get("current_replicas", 0)),
+            target_replicas=int(d.get("target_replicas", 0)),
+            chips_per_replica=int(d.get("chips_per_replica", 1)))
+    for k, h in (data.get("health") or {}).items():
+        cap.health[k] = RegionModelHealth(
+            state=h.get("state", "fresh"),
+            age_seconds=float(h.get("age_seconds", 0.0)),
+            allow_scale_down=bool(h.get("allow_scale_down", True)),
+            reason=h.get("reason", ""))
+    for k, c in (data.get("capacity") or {}).items():
+        cap.capacity[k] = VariantCapacity(
+            variant=c.get("variant", k),
+            chips_per_slice=int(c.get("chips_per_slice", 1)),
+            ready=int(c.get("ready", 0)),
+            provisioning=int(c.get("provisioning", 0)),
+            preempted=int(c.get("preempted", 0)),
+            tier_slices={t: int(n)
+                         for t, n in (c.get("tier_slices") or {}).items()},
+            stocked_out_tiers=list(c.get("stocked_out_tiers") or []),
+            lead_seconds=float(c.get("lead_seconds", 0.0)))
+    return cap
+
+
+class InProcessCaptureBus:
+    """Reference-passing bus for the multi-cluster harness (one capture
+    slot per region + one global plan slot, overwritten per tick)."""
+
+    def __init__(self) -> None:
+        self._captures: dict[str, ClusterCapture] = {}
+        self._plan: dict | None = None
+
+    def publish(self, cap: ClusterCapture) -> None:
+        self._captures[cap.region] = cap
+
+    def read_all(self) -> dict[str, ClusterCapture]:
+        return dict(self._captures)
+
+    def publish_plan(self, plan: dict) -> None:
+        self._plan = plan
+
+    def read_plan(self) -> dict | None:
+        return self._plan
+
+
+class ConfigMapCaptureBus:
+    """ConfigMap transport against a shared hub cluster: rv-guarded
+    publish (a deposed arbiter's stale plan write 409s harmlessly), reads
+    that treat corrupt or missing payloads as absent — an absent capture
+    ages into BLACKOUT classification on the arbiter side, which is the
+    safe direction."""
+
+    def __init__(self, client, namespace: str,
+                 regions: tuple[str, ...] = ()) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.regions = tuple(regions)
+
+    def _capture_name(self, region: str) -> str:
+        return f"{CAPTURE_CONFIGMAP_PREFIX}-{region}"
+
+    def _put(self, name: str, key: str, payload: str) -> None:
+        from wva_tpu.k8s.client import ConflictError
+        from wva_tpu.k8s.objects import ConfigMap, ObjectMeta, clone
+
+        try:
+            existing = self.client.try_get(ConfigMap.KIND, self.namespace,
+                                           name)
+            if existing is None:
+                self.client.create(ConfigMap(
+                    metadata=ObjectMeta(name=name, namespace=self.namespace),
+                    data={key: payload}))
+            else:
+                cm = clone(existing)
+                cm.data = {key: payload}
+                self.client.update(cm)
+        except ConflictError:
+            # Another writer holds a newer view — exactly the fencing
+            # outcome we want; next tick re-publishes.
+            log.debug("federation publish conflicted for %s", name)
+        except Exception as e:  # noqa: BLE001 — publishing must never fail
+            log.warning("federation publish failed for %s: %s", name, e)
+
+    def _get(self, name: str, key: str) -> dict | None:
+        from wva_tpu.k8s.objects import ConfigMap
+
+        try:
+            cm = self.client.try_get(ConfigMap.KIND, self.namespace, name)
+        except Exception as e:  # noqa: BLE001 — a storming hub reads
+            log.warning("federation read failed for %s: %s", name, e)
+            return None                             # as absent
+        if cm is None or not cm.data.get(key):
+            return None
+        try:
+            return json.loads(cm.data[key])
+        except (ValueError, TypeError) as e:
+            log.warning("federation payload %s corrupt: %s", name, e)
+            return None
+
+    def publish(self, cap: ClusterCapture) -> None:
+        self._put(self._capture_name(cap.region), CAPTURE_DATA_KEY,
+                  json.dumps(capture_to_payload(cap), sort_keys=True,
+                             separators=(",", ":")))
+
+    def read_all(self) -> dict[str, ClusterCapture]:
+        out: dict[str, ClusterCapture] = {}
+        for region in self.regions:
+            data = self._get(self._capture_name(region), CAPTURE_DATA_KEY)
+            if data is None:
+                continue
+            try:
+                out[region] = payload_to_capture(data)
+            except (ValueError, TypeError, KeyError) as e:
+                log.warning("federation capture %s corrupt: %s", region, e)
+        return out
+
+    def publish_plan(self, plan: dict) -> None:
+        self._put(PLAN_CONFIGMAP_NAME, PLAN_DATA_KEY,
+                  json.dumps(plan, sort_keys=True, separators=(",", ":")))
+
+    def read_plan(self) -> dict | None:
+        return self._get(PLAN_CONFIGMAP_NAME, PLAN_DATA_KEY)

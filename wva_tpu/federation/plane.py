@@ -1,0 +1,202 @@
+"""Per-engine federation plane (docs/design/federation.md §plane).
+
+One :class:`FederationPlane` rides each region's engine tick, AFTER the
+health gate and BEFORE decisions are flight-recorded:
+
+1. export this region's :class:`ClusterCapture` (post-health-gate targets,
+   ledger snapshot + measured leads, raw health signals, effective tier
+   weights) to the capture bus;
+2. if this controller holds the arbiter lease (the existing fenced-lease
+   discipline), merge every region's capture through
+   :class:`~wva_tpu.federation.arbiter.CapacityArbiter` and publish the
+   fleet plan;
+3. read the current plan back and hand THIS region's spill directives to
+   the engine, which applies them via the shared
+   :func:`~wva_tpu.federation.apply.apply_federation_directives` path and
+   records STAGE_FEDERATION — only when the plan is non-trivial, so a
+   healthy single-region fleet's traces stay byte-identical to the plane
+   being off.
+
+The plane is attached only when a region name is configured
+(``WVA_FEDERATION_REGION``); the default single-cluster deployment never
+constructs it, which is what makes ``WVA_FEDERATION=off`` trivially
+byte-identical — and the explicit off-lever is regression-tested anyway.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from wva_tpu.constants import (
+    LABEL_MODEL_NAME,
+    LABEL_NAMESPACE,
+    LABEL_REGION,
+    LABEL_SOURCE,
+    LABEL_STATE,
+    WVA_FEDERATION_CAPTURE_AGE_SECONDS,
+    WVA_FEDERATION_REGION_STATE,
+    WVA_FEDERATION_SPILL_REPLICAS,
+)
+from wva_tpu.federation.arbiter import (
+    REGION_BLACKOUT,
+    REGION_DEGRADED,
+    REGION_HEALTHY,
+    CapacityArbiter,
+)
+from wva_tpu.federation.capture import (
+    ClusterCapture,
+    ModelDemand,
+    RegionModelHealth,
+    VariantCapacity,
+    demand_key,
+)
+
+log = logging.getLogger(__name__)
+
+REGION_STATES = (REGION_HEALTHY, REGION_DEGRADED, REGION_BLACKOUT)
+
+
+class FederationPlane:
+    """One region's capture/arbiter/directive loop. ``bus`` is either the
+    in-process bus (harness) or the ConfigMap bus against the hub
+    cluster; ``elector`` is a :class:`~wva_tpu.leaderelection.LeaderElector`
+    on the shared arbiter lease (None = always arbitrate, for tests and
+    single-binary fleets)."""
+
+    def __init__(self, region: str, bus, elector=None,
+                 arbiter: CapacityArbiter | None = None,
+                 clock=None, registry=None,
+                 plan_stale_seconds: float = 90.0) -> None:
+        self.region = region
+        self.bus = bus
+        self.elector = elector
+        self.arbiter = arbiter
+        self.clock = clock
+        self.registry = registry
+        self.plan_stale_seconds = plan_stale_seconds
+        self._tick_seq = 0
+        self._spill_gauge_keys: set[tuple] = set()
+        self._region_gauge_keys: set[str] = set()
+
+    # --- capture export --------------------------------------------------
+
+    def build_capture(self, decisions, tick_health, capacity,
+                      now: float, epoch: int = -1) -> ClusterCapture:
+        """Compact export of this region's tick: demand from the final
+        (post-health-gate) decisions, capacity from the ledger snapshot
+        plus the lead-time estimator, health from the tick's raw signals."""
+        cap = ClusterCapture(region=self.region, epoch=epoch,
+                             tick_seq=self._tick_seq, published_at=now)
+        for d in decisions:
+            cap.demand[demand_key(d.namespace, d.variant_name)] = ModelDemand(
+                variant_name=d.variant_name, namespace=d.namespace,
+                model_id=d.model_id, accelerator_name=d.accelerator_name,
+                current_replicas=d.current_replicas,
+                target_replicas=d.target_replicas,
+                chips_per_replica=d.chips_per_replica)
+        for key in sorted(tick_health or {}):
+            h = tick_health[key]
+            cap.health[key] = RegionModelHealth(
+                state=h.state, age_seconds=round(h.age_seconds, 3),
+                allow_scale_down=h.allow_scale_down, reason=h.reason)
+        if capacity is not None:
+            cap.tier_weights = dict(capacity.tier_weights)
+            for row in capacity.ledger.snapshot(now):
+                variant = row["variant"]
+                cap.capacity[variant] = VariantCapacity(
+                    variant=variant,
+                    chips_per_slice=row["chips_per_slice"],
+                    ready=row["ready"],
+                    provisioning=row["provisioning"],
+                    preempted=row["preempted"],
+                    tier_slices=dict(row["tier_slices"]),
+                    stocked_out_tiers=list(row["stocked_out_tiers"]),
+                    lead_seconds=round(
+                        capacity.provisioning_lead(variant), 1))
+        return cap
+
+    # --- the per-tick loop -----------------------------------------------
+
+    def tick(self, decisions, tick_health, capacity, now: float,
+             epoch: int = -1) -> tuple[list[dict], dict | None]:
+        """Publish capture, arbitrate if leading, return (this region's
+        spill directives, the STAGE_FEDERATION payload or None)."""
+        self._tick_seq += 1
+        try:
+            self.bus.publish(self.build_capture(
+                decisions, tick_health, capacity, now, epoch=epoch))
+        except Exception:  # noqa: BLE001 — export must never fail a tick
+            log.warning("federation capture publish failed", exc_info=True)
+        leading = (self.elector.tick() if self.elector is not None
+                   else self.arbiter is not None)
+        if leading and self.arbiter is not None:
+            fence = (self.elector.fencing_token()
+                     if self.elector is not None else epoch)
+            try:
+                plan = self.arbiter.tick(self.bus.read_all(), now,
+                                         epoch=fence if fence is not None
+                                         else -1)
+                self.bus.publish_plan(plan)
+            except Exception:  # noqa: BLE001
+                log.warning("federation arbiter tick failed", exc_info=True)
+        plan = self.bus.read_plan()
+        if plan is not None and (now - float(plan.get("published_at", now))
+                                 > self.plan_stale_seconds):
+            # A dead arbiter's last plan ages out instead of pinning spill
+            # floors forever; the next elected arbiter republishes.
+            plan = None
+        directives = list((plan or {}).get(
+            "directives", {}).get(self.region, []))
+        states = (plan or {}).get("region_states", {})
+        self._emit_metrics(states, directives)
+        stage = None
+        nontrivial = bool(directives) or any(
+            s.get("state") != REGION_HEALTHY or s.get("shedding")
+            for s in states.values())
+        if nontrivial:
+            stage = {
+                "region": self.region,
+                "plan_tick": int((plan or {}).get("tick", 0)),
+                "states": [{"region": r, **states[r]}
+                           for r in sorted(states)],
+                "directives": directives,
+            }
+        return directives, stage
+
+    # --- gauges ----------------------------------------------------------
+
+    def _emit_metrics(self, states: dict, directives: list[dict]) -> None:
+        registry = self.registry
+        if registry is None:
+            return
+        emitted_regions: set[str] = set()
+        for region in sorted(states):
+            st = states[region]
+            emitted_regions.add(region)
+            for state in REGION_STATES:
+                registry.set_gauge(
+                    WVA_FEDERATION_REGION_STATE,
+                    {LABEL_REGION: region, LABEL_STATE: state},
+                    1.0 if state == st.get("state") else 0.0)
+            registry.set_gauge(WVA_FEDERATION_CAPTURE_AGE_SECONDS,
+                               {LABEL_REGION: region},
+                               float(st.get("capture_age", 0.0)))
+        for region in self._region_gauge_keys - emitted_regions:
+            for state in REGION_STATES:
+                registry.remove(WVA_FEDERATION_REGION_STATE,
+                                {LABEL_REGION: region, LABEL_STATE: state})
+            registry.remove(WVA_FEDERATION_CAPTURE_AGE_SECONDS,
+                            {LABEL_REGION: region})
+        self._region_gauge_keys = emitted_regions
+        emitted_spills: set[tuple] = set()
+        for d in directives:
+            labels = {LABEL_MODEL_NAME: d.get("model_id", ""),
+                      LABEL_NAMESPACE: d.get("namespace", ""),
+                      LABEL_SOURCE: d.get("source_region", ""),
+                      LABEL_REGION: d.get("target_region", "")}
+            emitted_spills.add(tuple(sorted(labels.items())))
+            registry.set_gauge(WVA_FEDERATION_SPILL_REPLICAS, labels,
+                               float(d.get("spill_replicas", 0)))
+        for key in self._spill_gauge_keys - emitted_spills:
+            registry.remove(WVA_FEDERATION_SPILL_REPLICAS, dict(key))
+        self._spill_gauge_keys = emitted_spills

@@ -378,11 +378,21 @@ def build_manager(
                     else LeadTimeEstimator(
                         default_seconds=cap_cfg
                         .default_provision_lead_seconds))
+        # Per-region tier weight override (wva_tpu/federation): a
+        # federated region prices its OWN pools with its region's weights
+        # so one region's spot discount (the per-process
+        # WVA_CAPACITY_TIER_WEIGHTS) never distorts another region's
+        # arbitrage (tests/test_federation.py).
+        fed_cfg = config.federation_config()
+        tier_weights = cap_cfg.tier_cost_weights
+        if fed_cfg.enabled and fed_cfg.region:
+            tier_weights = fed_cfg.region_tier_weights.get(
+                fed_cfg.region, tier_weights)
         capacity = CapacityManager(
             discovery, slice_provisioner or NullProvisioner(),
             leadtime=leadtime,
             tier_preference=cap_cfg.tier_preference,
-            tier_weights=cap_cfg.tier_cost_weights,
+            tier_weights=tier_weights,
             stockout_reprobe_seconds=cap_cfg.stockout_reprobe_seconds,
             default_lead_seconds=cap_cfg.default_provision_lead_seconds,
             clock=clock)
@@ -499,6 +509,18 @@ def build_manager(
             forecast_planner=forecast_planner, analysis_workers=workers,
             identity=f"{os.uname().nodename}-{os.getpid()}",
             registry=registry)
+    # Multi-cluster federation plane (WVA_FEDERATION, default on;
+    # docs/design/federation.md): constructed only when this cluster
+    # names its region — capture export + arbiter election over the
+    # ConfigMap bus on the hub cluster this kubeconfig points at. The
+    # single-cluster default builds nothing and stays byte-identical to
+    # pre-federation builds.
+    if config.federation_enabled() and config.federation_config().region:
+        from wva_tpu.federation import build_federation_plane
+
+        engine.federation = build_federation_plane(
+            client, config, clock=clock, registry=registry,
+            identity=f"{os.uname().nodename}-{os.getpid()}")
     if flight is not None:
         engine.optimizer.flight_recorder = flight
     scale_from_zero = ScaleFromZeroEngine(client, config, datastore,

@@ -37,6 +37,9 @@ from wva_tpu.constants import (
     WVA_DESIRED_REPLICAS,
     WVA_ENGINE_TICK_DURATION_SECONDS,
     WVA_ENGINE_TICKS_TOTAL,
+    WVA_FEDERATION_CAPTURE_AGE_SECONDS,
+    WVA_FEDERATION_REGION_STATE,
+    WVA_FEDERATION_SPILL_REPLICAS,
     WVA_FORECAST_DEMAND,
     WVA_FORECAST_DEMOTED,
     WVA_FORECAST_ERROR,
@@ -216,6 +219,15 @@ class MetricsRegistry:
                        "tick), by reason")
         self._register(WVA_OTLP_EXPORTS_TOTAL, "counter",
                        "OTLP/HTTP span exports, by outcome")
+        self._register(WVA_FEDERATION_SPILL_REPLICAS, "gauge",
+                       "Replicas the federation arbiter's current plan "
+                       "spills into each target region, per model")
+        self._register(WVA_FEDERATION_REGION_STATE, "gauge",
+                       "Arbiter classification per region (healthy | "
+                       "degraded | blackout); one-hot")
+        self._register(WVA_FEDERATION_CAPTURE_AGE_SECONDS, "gauge",
+                       "Age of each region's newest ClusterCapture as "
+                       "the arbiter last saw it")
 
     def _register(self, name: str, kind: str, help_text: str) -> None:
         self._series[name] = _Series(name, kind, help_text)

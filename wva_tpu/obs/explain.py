@@ -4,7 +4,8 @@ recorded DecisionTrace (docs/design/observability.md §explain).
 Walks the newest trace cycle that decided the model and prints, per
 variant, the causal chain of the final desired-replica number through the
 pipeline: analyzer -> optimizer -> enforcer -> forecast floor -> limiter
--> health / boot / rebalance clamp — each stage's target and reason, with
+-> health / boot / rebalance clamp -> federation spill floor — each
+stage's target and reason, with
 the stage that LAST moved the number called out. The chain comes from the
 ``decision_steps`` every pipeline stage already appends (the same records
 replay verifies byte-for-byte), cross-referenced with the cycle's stage
@@ -70,6 +71,16 @@ def _health_state_for(cycle: dict, model: str,
             if (st.get("model_id") == model
                     and st.get("namespace") == namespace):
                 return st
+    return None
+
+
+def _federation_directive_for(cycle: dict, namespace: str,
+                              variant: str) -> dict | None:
+    for ev in _stage_events(cycle, "federation"):
+        for d in ev.get("directives", ()):
+            if (d.get("namespace") == namespace
+                    and d.get("variant_name") == variant):
+                return d
     return None
 
 
@@ -144,6 +155,14 @@ def explain_decision(cycle: dict, decision: dict) -> dict:
     state = _health_state_for(cycle, model, ns)
     if state is not None:
         out["input_health"] = state.get("state", "")
+    spill = _federation_directive_for(cycle, ns, variant)
+    if spill is not None:
+        out["federation_spill"] = {
+            "source_region": spill.get("source_region", ""),
+            "target_region": spill.get("target_region", ""),
+            "floor_replicas": spill.get("floor_replicas", 0),
+            "spill_replicas": spill.get("spill_replicas", 0),
+            "reason": spill.get("reason", "")}
     return out
 
 
@@ -208,6 +227,11 @@ def _print_text(report: dict, out) -> None:
             c = v["health_clamp"]
             print(f"  health clamp in play: state={c['state']} "
                   f"({c['reason']})", file=out)
+        if v.get("federation_spill"):
+            s = v["federation_spill"]
+            print(f"  federation spill in play: "
+                  f"{s['source_region']} -> {s['target_region']} "
+                  f"+{s['spill_replicas']} ({s['reason']})", file=out)
         print(f"  final desired set by: {v['set_by']}"
               + (f' — "{v["set_by_reason"]}"' if v["set_by_reason"]
                  else ""), file=out)
